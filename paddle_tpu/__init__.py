@@ -1,0 +1,29 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle (Fluid era).  See SURVEY.md for the blueprint.
+
+Two API surfaces, mirroring the reference:
+* ``paddle_tpu.fluid`` — the Fluid static-graph + dygraph API
+  (reference: python/paddle/fluid/).
+* top-level 2.0-preview style aliases (reference: python/paddle/).
+"""
+from . import framework
+from .framework import (
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+    Program,
+    Variable,
+    program_guard,
+    default_main_program,
+    default_startup_program,
+    in_dygraph_mode,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+from . import ops
+from .executor import Executor
+from .backward import append_backward, gradients
+from .framework.scope import global_scope, scope_guard, LoDTensor, Scope
+
+__version__ = "0.1.0"

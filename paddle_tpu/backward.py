@@ -1,0 +1,204 @@
+"""Static autodiff: append_backward as a program rewrite.
+
+Capability parity with the reference's ``fluid.backward.append_backward``
+(reference: python/paddle/fluid/backward.py:1193, core loop
+_append_backward_ops_:843, repeated-grad dedup _addup_repetitive_outputs_
+:372, no-grad pruning :454).  Grad ops are real ops in the program — so
+distribution transpilers can rewrite the backward graph (insert
+allreduce, recompute, AMP casts) exactly like the reference — while each
+grad op's *kernel* is jax.vjp replay of the forward lowering
+(ops/registry.py), deduplicated by XLA CSE at compile time.
+
+Repeated-grad accumulation is done online: when a second partial for
+``X@GRAD`` is produced it is renamed and immediately summed.  This is
+safe because in reverse order every producer of ``X@GRAD`` (grad of a
+consumer of X) is emitted before any consumer of ``X@GRAD`` (grad of X's
+producer).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .framework import unique_name
+from .framework.core import (
+    EMPTY_VAR_NAME,
+    GRAD_SUFFIX,
+    Block,
+    Parameter,
+    Program,
+    Variable,
+)
+from .framework.dtype import VarType
+from .ops import registry
+
+# Reference op-role attr values (framework.h OpRole) so transpilers /
+# AMP passes can classify ops the same way the reference does.
+class OpRole:
+    Forward = 0
+    Backward = 1
+    Optimize = 2
+    RPC = 3
+    Dist = 4
+    LRSched = 16
+    Loss = 256
+
+
+OP_ROLE_KEY = "op_role"
+OP_ROLE_VAR_KEY = "op_role_var"
+
+
+def _ensure_grad_var(block: Block, grad_name: str):
+    if grad_name == EMPTY_VAR_NAME or block.has_var(grad_name):
+        return
+    fwd_name = grad_name[: -len(GRAD_SUFFIX)] if grad_name.endswith(GRAD_SUFFIX) else None
+    base = grad_name
+    # handle renamed accumulation slots: X@GRAD@RENAME@0
+    if "@RENAME" in grad_name:
+        base = grad_name.split("@RENAME")[0]
+        fwd_name = base[: -len(GRAD_SUFFIX)] if base.endswith(GRAD_SUFFIX) else None
+    fvar = block._find_var_recursive(fwd_name) if fwd_name else None
+    if fvar is not None:
+        block.create_var(
+            name=grad_name, shape=fvar.shape, dtype=fvar.dtype, persistable=False
+        )
+    else:
+        block.create_var(name=grad_name, shape=(), dtype=VarType.FP32)
+
+
+def _collect_no_grad(block: Block, no_grad_set) -> Set[str]:
+    names = set(no_grad_set or [])
+    for var in block.vars.values():
+        if var.stop_gradient and not isinstance(var, Parameter):
+            names.add(var.name)
+        if isinstance(var, Parameter) and not var.trainable:
+            names.add(var.name)
+    return names
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set=None,
+    callbacks=None,
+    checkpoints=None,
+) -> List[Tuple[Parameter, Variable]]:
+    """Append grad ops for ``loss`` and return [(param, grad_var)]."""
+    block = loss.block
+    program = block.program
+    no_grad_names = _collect_no_grad(block, no_grad_set)
+
+    loss_idx = None
+    for i, op_ in enumerate(block.ops):
+        if loss.name in op_.output_arg_names:
+            loss_idx = i
+    if loss_idx is None:
+        raise ValueError(f"loss var {loss.name!r} is not produced by any op")
+
+    # d(loss)/d(loss) = 1
+    loss_grad_name = loss.name + GRAD_SUFFIX
+    _ensure_grad_var(block, loss_grad_name)
+    block.append_op(
+        "fill_constant",
+        outputs={"Out": [loss_grad_name]},
+        attrs={
+            "shape": list(loss.shape),
+            "value": 1.0,
+            "dtype": int(loss.dtype),
+            OP_ROLE_KEY: OpRole.Backward | OpRole.Loss,
+        },
+    )
+
+    known_grads: Set[str] = {loss_grad_name}
+    produced: Set[str] = {loss_grad_name}
+
+    for op_ in reversed(block.ops[: loss_idx + 1]):
+        if not registry.has_grad(op_.type):
+            continue
+        out_grads = [n + GRAD_SUFFIX for n in op_.output_arg_names if n != EMPTY_VAR_NAME]
+        if not any(g in known_grads for g in out_grads):
+            continue
+        grad_descs = registry.make_grad_ops(op_, no_grad_names)
+        for desc in grad_descs:
+            # rewrite unavailable input grads to @EMPTY@ (treated as zeros)
+            for slot, names in desc["inputs"].items():
+                if slot.endswith(GRAD_SUFFIX):
+                    desc["inputs"][slot] = [
+                        n if n in known_grads or not n.endswith(GRAD_SUFFIX) else EMPTY_VAR_NAME
+                        for n in names
+                    ]
+            # online accumulation of repeated grads
+            accum_pairs = []
+            for slot, names in desc["outputs"].items():
+                new_names = []
+                for n in names:
+                    if n == EMPTY_VAR_NAME or not n.endswith(GRAD_SUFFIX):
+                        new_names.append(n)
+                        continue
+                    if n in produced:
+                        renamed = unique_name.generate(n + "@RENAME")
+                        accum_pairs.append((n, renamed))
+                        new_names.append(renamed)
+                    else:
+                        new_names.append(n)
+                desc["outputs"][slot] = new_names
+
+            for slot, names in {**desc["inputs"], **desc["outputs"]}.items():
+                for n in names:
+                    _ensure_grad_var(block, n)
+            attrs = dict(desc.get("attrs") or {})
+            attrs.setdefault(OP_ROLE_KEY, OpRole.Backward)
+            block.append_op(
+                desc["type"], inputs=desc["inputs"], outputs=desc["outputs"], attrs=attrs
+            )
+            for target, renamed in accum_pairs:
+                block.append_op(
+                    "sum",
+                    inputs={"X": [target, renamed]},
+                    outputs={"Out": [target]},
+                    attrs={OP_ROLE_KEY: OpRole.Backward},
+                )
+            for slot, names in desc["outputs"].items():
+                for n in names:
+                    if n == EMPTY_VAR_NAME:
+                        continue
+                    base = n.split("@RENAME")[0]
+                    if base.endswith(GRAD_SUFFIX):
+                        known_grads.add(base)
+                        produced.add(base)
+
+    # collect (param, grad) pairs
+    params: List[Parameter]
+    if parameter_list is not None:
+        params = [
+            block.var_recursive(p) if isinstance(p, str) else p for p in parameter_list
+        ]
+    else:
+        params = program.all_parameters()
+    result = []
+    for p in params:
+        if not getattr(p, "trainable", True) or p.name in no_grad_names:
+            continue
+        gname = p.name + GRAD_SUFFIX
+        if gname in known_grads:
+            gvar = block.var_recursive(gname)
+            result.append((p, gvar))
+    return result
+
+
+def gradients(
+    targets, inputs, target_gradients=None, no_grad_set=None
+) -> List[Variable]:
+    """reference: fluid.gradients / backward.py gradients()."""
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError("gradients() supports a single target for now")
+    append_backward(targets[0], no_grad_set=no_grad_set)
+    block = targets[0].block
+    outs = []
+    for v in inputs:
+        gname = v.name + GRAD_SUFFIX
+        outs.append(block.var_recursive(gname) if block._find_var_recursive(gname) else None)
+    return outs

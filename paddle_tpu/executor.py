@@ -1,0 +1,249 @@
+"""Executor: lowers whole Programs to XLA via a single jax.jit trace.
+
+Capability parity with the reference Executor
+(reference: paddle/fluid/framework/executor.cc:184 Executor::Run,
+executor.cc:380 Prepare, python/paddle/fluid/executor.py:461) — redesigned
+TPU-first.  Where the reference interprets the program op-by-op
+(RunPartialPreparedContext's hot loop, executor.cc:469-476, dispatching a
+CUDA kernel per op), this executor *traces* the block once — each op's
+registered lowering emits jax primitives into one function — and compiles
+the whole thing with ``jax.jit``.  XLA then fuses across op boundaries,
+which is the analog of ``Executor::Prepare``'s create-ops-once caching plus
+the reference's fusion passes, for free.
+
+Mutable Scope semantics (optimizer ops updating params in place,
+SURVEY.md §7 hard-part 2) become functional state threading: the compiled
+function takes ``(feed, state)`` and returns ``(fetches, new_state)``;
+state is every var that is read before written (parameters, optimizer
+moments, RNG key) plus every persistable var written (so startup programs
+initialize the scope through the same path).  Param buffers are donated to
+XLA so updates are in-place in HBM.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .framework.core import Program, Variable, default_main_program
+from .framework.dtype import to_numpy_dtype
+from .framework.place import CPUPlace, Place, _get_paddle_place
+from .framework.scope import LoDTensor, Scope, global_scope
+from .ops import registry
+
+logger = logging.getLogger(__name__)
+
+RNG_VAR = registry.LowerCtx.RNG_VAR
+
+
+class _Compiled:
+    __slots__ = ("fn", "state_in", "state_out", "fetch_names")
+
+    def __init__(self, fn, state_in, state_out, fetch_names):
+        self.fn = fn
+        self.state_in = state_in
+        self.state_out = state_out
+        self.fetch_names = fetch_names
+
+
+def _fetch_name(f) -> str:
+    if isinstance(f, Variable):
+        return f.name
+    if isinstance(f, str):
+        return f
+    raise TypeError(f"bad fetch entry: {f!r}")
+
+
+def as_numpy(value):
+    if isinstance(value, LoDTensor):
+        return value.numpy()
+    return np.asarray(value)
+
+
+class Executor:
+    """reference: python/paddle/fluid/executor.py:461 Executor."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = _get_paddle_place(place)
+        self._cache: Dict[tuple, _Compiled] = {}
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        program=None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        feed_var_name: str = "feed",
+        fetch_var_name: str = "fetch",
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+        use_prune: bool = False,
+    ):
+        if self._closed:
+            raise RuntimeError("Executor is closed")
+        from .parallel.compiled_program import CompiledProgram
+
+        if isinstance(program, CompiledProgram):
+            return program._run(self, feed, fetch_list, scope, return_numpy)
+        if program is None:
+            program = default_main_program()
+        scope = scope or global_scope()
+        feed = dict(feed or {})
+        fetch_names = [_fetch_name(f) for f in (fetch_list or [])]
+
+        compiled = self._compile(program, feed, fetch_names, scope)
+        return self._execute(compiled, feed, fetch_names, scope, return_numpy, program)
+
+    # ------------------------------------------------------------------
+    def _compile(self, program: Program, feed, fetch_names, scope) -> _Compiled:
+        feed_spec = tuple(
+            sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype)) for k, v in feed.items())
+        )
+        key = (id(program), program._version, feed_spec, tuple(fetch_names))
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+
+        block = program.global_block()
+        feed_names = set(feed)
+        written: set = set()
+        state_in: List[str] = []
+        uses_rng = False
+        for op_ in block.ops:
+            d = registry.OPS.get(op_.type)
+            if d is not None and d.stateful:
+                uses_rng = True
+            if op_.type.endswith("_grad"):
+                uses_rng = uses_rng  # replay may use rng only for stateful fwd
+            for name in op_.input_arg_names:
+                if (
+                    name not in written
+                    and name not in feed_names
+                    and name != "@EMPTY@"
+                    and name not in state_in
+                ):
+                    state_in.append(name)
+            written.update(op_.output_arg_names)
+        written.discard("@EMPTY@")
+
+        state_out: List[str] = []
+        for name in written:
+            var = block._find_var_recursive(name)
+            if (var is not None and var.persistable) or scope.has(name):
+                state_out.append(name)
+        state_out.sort()
+        if uses_rng:
+            if RNG_VAR not in state_in:
+                state_in.append(RNG_VAR)
+            if RNG_VAR not in state_out:
+                state_out.append(RNG_VAR)
+
+        ops = list(block.ops)
+        fetch = list(fetch_names)
+        souts = list(state_out)
+
+        # Donate only buffers that are both read and re-written (params,
+        # optimizer moments): XLA updates them in place in HBM.  Read-only
+        # state (eval-program params) must NOT be donated or the scope's
+        # live buffers would be invalidated.
+        donatable = [n for n in state_in if n in set(state_out)]
+        readonly = [n for n in state_in if n not in set(state_out)]
+
+        def fn(mut_vals: Dict[str, Any], ro_vals: Dict[str, Any],
+               feed_vals: Dict[str, Any]):
+            env: Dict[str, Any] = dict(ro_vals)
+            env.update(mut_vals)
+            env.update(feed_vals)
+            for op_ in ops:
+                registry.run_op(op_, env, block)
+            fetched = tuple(env[n] for n in fetch)
+            new_state = {n: env[n] for n in souts if n in env}
+            return fetched, new_state
+
+        jitted = jax.jit(fn, donate_argnums=(0,))
+        compiled = _Compiled(jitted, state_in, state_out, fetch)
+        compiled_donatable = set(donatable)
+
+        def call(feed_vals, state_vals):
+            mut = {n: v for n, v in state_vals.items() if n in compiled_donatable}
+            ro = {n: v for n, v in state_vals.items() if n not in compiled_donatable}
+            return jitted(mut, ro, feed_vals)
+
+        compiled.fn = call
+        self._cache[key] = compiled
+        return compiled
+
+    # ------------------------------------------------------------------
+    def _execute(self, compiled, feed, fetch_names, scope, return_numpy, program):
+        device = self.place.jax_device()
+        block = program.global_block()
+
+        feed_vals = {}
+        for k, v in feed.items():
+            arr = as_numpy(v) if isinstance(v, LoDTensor) else np.asarray(v)
+            var = block._find_var_recursive(k)
+            if var is not None and var.dtype is not None:
+                want = to_numpy_dtype(var.dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            feed_vals[k] = jax.device_put(arr, device)
+
+        state_vals = {}
+        for name in compiled.state_in:
+            if name == RNG_VAR:
+                val = scope.get(RNG_VAR)
+                if val is None:
+                    seed = program.random_seed or 0
+                    val = jax.random.key(seed)
+                state_vals[name] = val
+                continue
+            val = scope.get(name)
+            if val is None:
+                raise RuntimeError(
+                    f"Variable {name!r} is read by the program but has no "
+                    f"value in scope — run the startup program first or feed it"
+                )
+            if isinstance(val, LoDTensor):
+                val = val.numpy()
+            if isinstance(val, np.ndarray):
+                val = jax.device_put(val, device)
+            state_vals[name] = val
+
+        fetched, new_state = compiled.fn(feed_vals, state_vals)
+        for name, val in new_state.items():
+            scope.set(name, val)
+
+        if fetch_names:
+            if return_numpy:
+                return [as_numpy(v) for v in fetched]
+            out = []
+            for v in fetched:
+                t = LoDTensor(np.asarray(v))
+                out.append(t)
+            return out
+        return None
+
+    # ------------------------------------------------------------------
+    def close(self):
+        self._closed = True
+        self._cache.clear()
+
+    # dataset-driven training (reference: executor.py:1448) — phase 8
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from .reader import _train_from_dataset
+
+        return _train_from_dataset(self, program, dataset, scope, fetch_list,
+                                   fetch_info, print_period)
+
+    def infer_from_dataset(self, *args, **kwargs):
+        return self.train_from_dataset(*args, **kwargs)
+
+
+def scope_var_to_numpy(scope: Scope, name: str) -> np.ndarray:
+    return as_numpy(scope.get(name))

@@ -1,0 +1,29 @@
+from .dtype import VarType, convert_dtype, to_numpy_dtype, dtype_name, is_float
+from .place import (
+    Place,
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    TPUPinnedPlace,
+    CUDAPinnedPlace,
+    is_compiled_with_tpu,
+    is_compiled_with_cuda,
+    _get_paddle_place,
+)
+from .core import (
+    Variable,
+    Parameter,
+    Operator,
+    Block,
+    Program,
+    default_main_program,
+    default_startup_program,
+    switch_main_program,
+    switch_startup_program,
+    program_guard,
+    name_scope,
+    in_dygraph_mode,
+    GRAD_SUFFIX,
+)
+from .scope import Scope, LoDTensor, global_scope, scope_guard
+from . import unique_name

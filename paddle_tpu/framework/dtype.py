@@ -1,0 +1,122 @@
+"""Dtype / VarType model.
+
+Mirrors the capability of the reference's ``VarType`` proto enum
+(reference: paddle/fluid/framework/framework.proto:103-136) but is a plain
+Python enum with numpy/jax interop.  TPU-first: bfloat16 is a first-class
+dtype (the reference's fp16 AMP maps to bf16 here by default).
+"""
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+try:  # jax.numpy provides bfloat16 via ml_dtypes
+    import ml_dtypes
+
+    bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    import jax.numpy as jnp
+
+    bfloat16 = np.dtype(jnp.bfloat16)
+
+
+class VarType(enum.IntEnum):
+    # Tensor element dtypes (values follow the reference proto enum where
+    # they exist: framework.proto:107-125).
+    BOOL = 0
+    INT16 = 1
+    INT32 = 2
+    INT64 = 3
+    FP16 = 4
+    FP32 = 5
+    FP64 = 6
+    UINT8 = 20
+    INT8 = 21
+    BF16 = 22
+    COMPLEX64 = 23
+    COMPLEX128 = 24
+
+    # Variable container types (framework.proto:126-145).
+    LOD_TENSOR = 7
+    SELECTED_ROWS = 8
+    FEED_MINIBATCH = 9
+    FETCH_LIST = 10
+    STEP_SCOPES = 11
+    LOD_RANK_TABLE = 12
+    LOD_TENSOR_ARRAY = 13
+    PLACE_LIST = 14
+    READER = 15
+    RAW = 17
+    TUPLE = 18
+
+
+_NP_TO_VT = {
+    np.dtype(np.bool_): VarType.BOOL,
+    np.dtype(np.int16): VarType.INT16,
+    np.dtype(np.int32): VarType.INT32,
+    np.dtype(np.int64): VarType.INT64,
+    np.dtype(np.float16): VarType.FP16,
+    np.dtype(np.float32): VarType.FP32,
+    np.dtype(np.float64): VarType.FP64,
+    np.dtype(np.uint8): VarType.UINT8,
+    np.dtype(np.int8): VarType.INT8,
+    bfloat16: VarType.BF16,
+    np.dtype(np.complex64): VarType.COMPLEX64,
+    np.dtype(np.complex128): VarType.COMPLEX128,
+}
+_VT_TO_NP = {v: k for k, v in _NP_TO_VT.items()}
+
+_STR_TO_VT = {
+    "bool": VarType.BOOL,
+    "int16": VarType.INT16,
+    "int32": VarType.INT32,
+    "int64": VarType.INT64,
+    "float16": VarType.FP16,
+    "float32": VarType.FP32,
+    "float64": VarType.FP64,
+    "uint8": VarType.UINT8,
+    "int8": VarType.INT8,
+    "bfloat16": VarType.BF16,
+    "complex64": VarType.COMPLEX64,
+    "complex128": VarType.COMPLEX128,
+}
+_VT_TO_STR = {v: k for k, v in _STR_TO_VT.items()}
+
+FLOAT_TYPES = frozenset(
+    {VarType.FP16, VarType.FP32, VarType.FP64, VarType.BF16}
+)
+
+
+def convert_dtype(dtype) -> VarType:
+    """Accept VarType / numpy dtype / str / python type and return VarType."""
+    if isinstance(dtype, VarType):
+        return dtype
+    if isinstance(dtype, str):
+        try:
+            return _STR_TO_VT[dtype]
+        except KeyError:
+            raise ValueError(f"unsupported dtype string: {dtype!r}") from None
+    if dtype in (float,):
+        return VarType.FP32
+    if dtype in (int,):
+        return VarType.INT64
+    if dtype in (bool,):
+        return VarType.BOOL
+    npdt = np.dtype(dtype)
+    try:
+        return _NP_TO_VT[npdt]
+    except KeyError:
+        raise ValueError(f"unsupported dtype: {dtype!r}") from None
+
+
+def to_numpy_dtype(dtype) -> np.dtype:
+    return _VT_TO_NP[convert_dtype(dtype)]
+
+
+def dtype_name(dtype) -> str:
+    return _VT_TO_STR[convert_dtype(dtype)]
+
+
+def is_float(dtype) -> bool:
+    return convert_dtype(dtype) in FLOAT_TYPES

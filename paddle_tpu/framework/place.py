@@ -1,0 +1,106 @@
+"""Device placement model.
+
+Capability parity with the reference's ``platform::Place`` tagged union
+(reference: paddle/fluid/platform/place.h:1) — but TPU-first: the native
+accelerator place is :class:`TPUPlace`, and every place resolves to a JAX
+device.  ``CUDAPlace`` is kept as a compatibility alias that resolves to the
+accelerator if present (so reference-style scripts run with only a Place
+swap, per the north star).
+"""
+from __future__ import annotations
+
+import functools
+
+
+class Place:
+    device_id: int = 0
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def jax_device(self):
+        raise NotImplementedError
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        self.device_id = 0
+
+    def __repr__(self):
+        return "CPUPlace"
+
+    def jax_device(self):
+        import jax
+
+        return jax.devices("cpu")[0]
+
+
+class TPUPlace(Place):
+    """The accelerator place — `fluid.TPUPlace()` per the north star."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def jax_device(self):
+        import jax
+
+        devs = _accelerator_devices()
+        if not devs:
+            raise RuntimeError(
+                "TPUPlace requested but no accelerator device is available"
+            )
+        return devs[self.device_id % len(devs)]
+
+
+class CUDAPlace(TPUPlace):
+    """Compatibility alias: reference scripts using CUDAPlace(0) run on the
+    accelerator (or CPU if none) without modification."""
+
+
+class TPUPinnedPlace(CPUPlace):
+    """Host-staging place (reference: CUDAPinnedPlace). On TPU, host staging
+    is managed by jax.device_put; this is an API-compat shim."""
+
+
+CUDAPinnedPlace = TPUPinnedPlace
+
+
+@functools.lru_cache(maxsize=1)
+def _accelerator_devices():
+    import jax
+
+    devs = jax.devices()
+    if devs and devs[0].platform != "cpu":
+        return tuple(devs)
+    return ()
+
+
+def is_compiled_with_tpu() -> bool:
+    return bool(_accelerator_devices())
+
+
+# Reference API-compat name.
+def is_compiled_with_cuda() -> bool:
+    return bool(_accelerator_devices())
+
+
+def _get_paddle_place(place):
+    """Normalize str/None/Place to a Place (reference: framework.py helpers)."""
+    if place is None:
+        return TPUPlace(0) if is_compiled_with_tpu() else CPUPlace()
+    if isinstance(place, Place):
+        return place
+    if isinstance(place, str):
+        p = place.lower()
+        if p == "cpu":
+            return CPUPlace()
+        if p.startswith(("tpu", "gpu", "cuda", "xpu")):
+            idx = p.split(":")[1] if ":" in p else 0
+            return TPUPlace(int(idx))
+    raise ValueError(f"unknown place: {place!r}")

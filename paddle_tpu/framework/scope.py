@@ -1,0 +1,170 @@
+"""Scope: name -> runtime value map with parent chaining.
+
+Reference: paddle/fluid/framework/scope.h:46 (Scope) and variable.h:26
+(Variable as an any-typed slot).  Here a scope slot holds either a
+``jax.Array``, a numpy array, a LoDTensor wrapper, or arbitrary Python
+objects (reader handles, etc.).  TPU-first: values are device arrays managed
+by JAX; the executor moves them with ``jax.device_put`` as needed.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class LoDTensor:
+    """Tensor + level-of-detail ragged offsets (reference: lod_tensor.h:104).
+
+    On TPU, ragged sequence batches are represented padded+masked for XLA;
+    the LoD offsets ride along host-side so sequence ops can recover segment
+    boundaries (SURVEY.md §7 hard-part 1)."""
+
+    def __init__(self, value=None, lod: Optional[List[List[int]]] = None):
+        self._value = value
+        self._lod = lod or []
+
+    def set(self, array, place=None):
+        self._value = np.asarray(array)
+
+    def set_lod(self, lod):
+        self._lod = lod
+
+    def lod(self):
+        return self._lod
+
+    def recursive_sequence_lengths(self):
+        return [
+            [off[i + 1] - off[i] for i in range(len(off) - 1)] for off in self._lod
+        ]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lod = []
+        for lens in lengths:
+            off = [0]
+            for l in lens:
+                off.append(off[-1] + l)
+            self._lod.append(off)
+
+    def value(self):
+        return self._value
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.asarray(self._value).shape)
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Any] = {}
+        self._parent = parent
+        self._kids: List["Scope"] = []
+        self._lock = threading.RLock()
+
+    # reference API: Scope::Var / FindVar / LocalVar ------------------------
+    def var(self, name: str) -> "_ScopeSlot":
+        with self._lock:
+            if name not in self._vars:
+                self._vars[name] = None
+        return _ScopeSlot(self, name)
+
+    def find_var(self, name: str) -> Optional["_ScopeSlot"]:
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return _ScopeSlot(s, name)
+            s = s._parent
+        return None
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def local_var_names(self) -> List[str]:
+        return list(self._vars.keys())
+
+    # value-level convenience (the executor's fast path) --------------------
+    def get(self, name: str, default=None):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        return default
+
+    def has(self, name: str) -> bool:
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s._parent
+        return False
+
+    def set(self, name: str, value):
+        # write where the name already lives (parent-chain), else locally
+        s = self
+        while s is not None:
+            if name in s._vars:
+                s._vars[name] = value
+                return
+            s = s._parent
+        self._vars[name] = value
+
+    def erase(self, names):
+        for n in names:
+            self._vars.pop(n, None)
+
+    def items(self) -> Iterator:
+        return iter(self._vars.items())
+
+
+class _ScopeSlot:
+    """Handle mirroring the reference's Variable* returned by Scope::Var."""
+
+    def __init__(self, scope: Scope, name: str):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self) -> LoDTensor:
+        v = self._scope.get(self._name)
+        if not isinstance(v, LoDTensor):
+            v = LoDTensor(v)
+            self._scope._vars[self._name] = v
+        return v
+
+    def get(self):
+        return self._scope.get(self._name)
+
+    def set(self, value):
+        self._scope.set(self._name, value)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev

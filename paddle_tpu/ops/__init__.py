@@ -1,0 +1,22 @@
+"""Op registry + lowerings (the analog of paddle/fluid/operators/).
+
+Importing this package registers the full op corpus.
+"""
+from . import registry
+from .registry import (
+    op,
+    grad_maker,
+    infer_for,
+    get_op_def,
+    is_registered,
+    run_op,
+    make_grad_ops,
+    has_grad,
+    LowerCtx,
+)
+
+# registration side effects
+from . import math_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401

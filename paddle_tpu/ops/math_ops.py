@@ -1,0 +1,473 @@
+"""Math / elementwise / reduction / comparison / matmul op lowerings.
+
+Capability parity with the reference's core math operator corpus
+(reference: paddle/fluid/operators/elementwise/, activation_op.cc,
+reduce_ops/, matmul_op.cc, mul_op.cc) — but each op is a few lines of
+jax.numpy: XLA fuses elementwise chains into matmul epilogues on TPU, which
+replaces the reference's hand-written fused CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax, nn as jnn
+
+from .registry import op
+
+
+# --------------------------------------------------------------------------
+# paddle-style broadcast: align Y to X starting at `axis`
+# (reference: operators/elementwise/elementwise_op_function.h)
+# --------------------------------------------------------------------------
+def _align(x, y, axis):
+    xd, yd = jnp.ndim(x), jnp.ndim(y)
+    if yd > xd:  # symmetric case: align x to y
+        y2, x2 = _align(y, x, axis)
+        return x2, y2
+    if axis is None or axis == -1:
+        axis = xd - yd
+    if yd < xd:
+        y = jnp.reshape(y, (1,) * axis + jnp.shape(y) + (1,) * (xd - axis - yd))
+    return x, y
+
+
+def _ew(fn):
+    def lower(ctx):
+        x, y = ctx.in_("X"), ctx.in_("Y")
+        x, y = _align(x, y, ctx.attr("axis", -1))
+        ctx.set_out("Out", fn(x, y))
+
+    return lower
+
+
+for _name, _fn in [
+    ("elementwise_add", jnp.add),
+    ("elementwise_sub", jnp.subtract),
+    ("elementwise_mul", jnp.multiply),
+    ("elementwise_div", jnp.divide),
+    ("elementwise_max", jnp.maximum),
+    ("elementwise_min", jnp.minimum),
+    ("elementwise_pow", jnp.power),
+    ("elementwise_mod", jnp.mod),
+    ("elementwise_floordiv", jnp.floor_divide),
+]:
+    op(_name)(_ew(_fn))
+
+
+# --------------------------------------------------------------------------
+# unary activations / math (reference: operators/activation_op.cc)
+# --------------------------------------------------------------------------
+def _unary(type, fn, **kw):
+    @op(type, **kw)
+    def _l(ctx, fn=fn):
+        ctx.set_out("Out", fn(ctx.in_("X"), ctx))
+
+
+_unary("relu", lambda x, c: jnn.relu(x))
+_unary("relu6", lambda x, c: jnp.clip(x, 0.0, c.attr("threshold", 6.0)))
+_unary("sigmoid", lambda x, c: jnn.sigmoid(x))
+_unary("logsigmoid", lambda x, c: jnn.log_sigmoid(x))
+_unary("tanh", lambda x, c: jnp.tanh(x))
+_unary("tanh_shrink", lambda x, c: x - jnp.tanh(x))
+_unary("sqrt", lambda x, c: jnp.sqrt(x))
+_unary("rsqrt", lambda x, c: lax.rsqrt(x))
+_unary("abs", lambda x, c: jnp.abs(x))
+_unary("ceil", lambda x, c: jnp.ceil(x))
+_unary("floor", lambda x, c: jnp.floor(x))
+_unary("round", lambda x, c: jnp.round(x))
+_unary("cos", lambda x, c: jnp.cos(x))
+_unary("sin", lambda x, c: jnp.sin(x))
+_unary("tan", lambda x, c: jnp.tan(x))
+_unary("acos", lambda x, c: jnp.arccos(x))
+_unary("asin", lambda x, c: jnp.arcsin(x))
+_unary("atan", lambda x, c: jnp.arctan(x))
+_unary("cosh", lambda x, c: jnp.cosh(x))
+_unary("sinh", lambda x, c: jnp.sinh(x))
+_unary("exp", lambda x, c: jnp.exp(x))
+_unary("log", lambda x, c: jnp.log(x))
+_unary("log2", lambda x, c: jnp.log2(x))
+_unary("log10", lambda x, c: jnp.log10(x))
+_unary("log1p", lambda x, c: jnp.log1p(x))
+_unary("expm1", lambda x, c: jnp.expm1(x))
+_unary("square", lambda x, c: jnp.square(x))
+_unary("reciprocal", lambda x, c: jnp.reciprocal(x))
+_unary("softplus", lambda x, c: jnn.softplus(x))
+_unary("softsign", lambda x, c: x / (1.0 + jnp.abs(x)))
+_unary("sign", lambda x, c: jnp.sign(x))
+_unary("erf", lambda x, c: lax.erf(x))
+_unary(
+    "leaky_relu", lambda x, c: jnn.leaky_relu(x, c.attr("alpha", 0.02))
+)
+_unary("elu", lambda x, c: jnn.elu(x, c.attr("alpha", 1.0)))
+_unary(
+    "gelu",
+    lambda x, c: jnn.gelu(x, approximate=bool(c.attr("approximate", False))),
+)
+_unary("swish", lambda x, c: x * jnn.sigmoid(c.attr("beta", 1.0) * x))
+_unary("silu", lambda x, c: jnn.silu(x))
+_unary(
+    "hard_sigmoid",
+    lambda x, c: jnp.clip(
+        c.attr("slope", 0.2) * x + c.attr("offset", 0.5), 0.0, 1.0
+    ),
+)
+_unary(
+    "hard_swish",
+    lambda x, c: x
+    * jnp.clip(x + c.attr("offset", 3.0), 0.0, c.attr("threshold", 6.0))
+    / c.attr("scale", 6.0),
+)
+_unary(
+    "hard_shrink",
+    lambda x, c: jnp.where(jnp.abs(x) > c.attr("threshold", 0.5), x, 0.0),
+)
+_unary(
+    "soft_relu",
+    lambda x, c: jnp.log1p(
+        jnp.exp(jnp.clip(x, -c.attr("threshold", 40.0), c.attr("threshold", 40.0)))
+    ),
+)
+_unary(
+    "thresholded_relu",
+    lambda x, c: jnp.where(x > c.attr("threshold", 1.0), x, 0.0),
+)
+_unary(
+    "brelu",
+    lambda x, c: jnp.clip(x, c.attr("t_min", 0.0), c.attr("t_max", 24.0)),
+)
+_unary("stanh",
+       lambda x, c: c.attr("scale_b", 1.7159) * jnp.tanh(c.attr("scale_a", 0.67) * x))
+
+
+@op("pow")
+def _pow(ctx):
+    f = ctx.in_("FactorTensor") if ctx.has_input("FactorTensor") else ctx.attr("factor", 1.0)
+    ctx.set_out("Out", jnp.power(ctx.in_("X"), f))
+
+
+@op("scale")
+def _scale(ctx):
+    x = ctx.in_("X")
+    s = ctx.in_("ScaleTensor") if ctx.has_input("ScaleTensor") else ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        out = x * s + jnp.asarray(b, jnp.result_type(x))
+    else:
+        out = (x + jnp.asarray(b, jnp.result_type(x))) * s
+    ctx.set_out("Out", out)
+
+
+@op("clip")
+def _clip(ctx):
+    lo = ctx.in_("Min") if ctx.has_input("Min") else ctx.attr("min", 0.0)
+    hi = ctx.in_("Max") if ctx.has_input("Max") else ctx.attr("max", 0.0)
+    ctx.set_out("Out", jnp.clip(ctx.in_("X"), lo, hi))
+
+
+@op("clip_by_norm")
+def _clip_by_norm(ctx):
+    x = ctx.in_("X")
+    max_norm = ctx.attr("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    ctx.set_out("Out", jnp.where(norm > max_norm, x * (max_norm / norm), x))
+
+
+@op("sum")
+def _sum(ctx):
+    xs = [v for v in ctx.ins("X") if v is not None]
+    out = xs[0]
+    for v in xs[1:]:
+        out = out + v
+    ctx.set_out("Out", out)
+
+
+@op("mean")
+def _mean(ctx):
+    ctx.set_out("Out", jnp.mean(ctx.in_("X")))
+
+
+# --------------------------------------------------------------------------
+# reductions (reference: operators/reduce_ops/)
+# --------------------------------------------------------------------------
+def _reduce(fn):
+    def lower(ctx):
+        x = ctx.in_("X")
+        if ctx.attr("reduce_all", False):
+            dim = None
+        else:
+            dim = ctx.attr("dim", [0])
+            dim = tuple(d % jnp.ndim(x) for d in (dim if isinstance(dim, (list, tuple)) else [dim]))
+        ctx.set_out("Out", fn(x, axis=dim, keepdims=ctx.attr("keep_dim", False)))
+
+    return lower
+
+
+for _name, _fn in [
+    ("reduce_sum", jnp.sum),
+    ("reduce_mean", jnp.mean),
+    ("reduce_max", jnp.max),
+    ("reduce_min", jnp.min),
+    ("reduce_prod", jnp.prod),
+    ("reduce_any", jnp.any),
+    ("reduce_all", jnp.all),
+]:
+    op(_name)(_reduce(_fn))
+
+
+@op("frobenius_norm")
+def _frob(ctx):
+    x = ctx.in_("X")
+    dim = tuple(ctx.attr("dim", [0])) if not ctx.attr("reduce_all", False) else None
+    ctx.set_out(
+        "Out",
+        jnp.sqrt(jnp.sum(jnp.square(x), axis=dim, keepdims=ctx.attr("keep_dim", False))),
+    )
+
+
+@op("p_norm")
+def _p_norm(ctx):
+    x = ctx.in_("X")
+    p = ctx.attr("porder", 2.0)
+    axis = ctx.attr("axis", -1)
+    keep = ctx.attr("keepdim", False)
+    ctx.set_out("Out", jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keep))
+
+
+@op("logsumexp")
+def _logsumexp(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", [0])
+    if ctx.attr("reduce_all", False):
+        axis = None
+    else:
+        axis = tuple(axis)
+    ctx.set_out("Out", jax.scipy.special.logsumexp(x, axis=axis, keepdims=ctx.attr("keepdim", False)))
+
+
+# --------------------------------------------------------------------------
+# argmax/argmin/topk/argsort (no grads)
+# --------------------------------------------------------------------------
+@op("arg_max", no_grad=True)
+def _argmax(ctx):
+    x = ctx.in_("X")
+    ax = ctx.attr("axis", -1)
+    out = jnp.argmax(x, axis=None if ctx.attr("flatten", False) else ax)
+    if ctx.attr("keepdims", False):
+        out = jnp.expand_dims(out, ax)
+    ctx.set_out("Out", out.astype(jnp.int64))
+
+
+@op("arg_min", no_grad=True)
+def _argmin(ctx):
+    x = ctx.in_("X")
+    ax = ctx.attr("axis", -1)
+    out = jnp.argmin(x, axis=None if ctx.attr("flatten", False) else ax)
+    if ctx.attr("keepdims", False):
+        out = jnp.expand_dims(out, ax)
+    ctx.set_out("Out", out.astype(jnp.int64))
+
+
+@op("argsort", no_grad=True)
+def _argsort(ctx):
+    x = ctx.in_("X")
+    ax = ctx.attr("axis", -1)
+    desc = ctx.attr("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=ax)
+    ctx.set_out("Indices", idx.astype(jnp.int64))
+    ctx.set_out("Out", jnp.take_along_axis(x, idx, axis=ax))
+
+
+def _topk(ctx):
+    x = ctx.in_("X")
+    k = ctx.attr("k", 1)
+    if ctx.has_input("K"):
+        k = int(ctx.in_("K"))  # must be static under jit
+    axis = ctx.attr("axis", -1)
+    largest = ctx.attr("largest", True)
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idxs = lax.top_k(xm, k)
+    else:
+        vals, idxs = lax.top_k(-xm, k)
+        vals = -vals
+    ctx.set_out("Out", jnp.moveaxis(vals, -1, axis))
+    ctx.set_out("Indices", jnp.moveaxis(idxs, -1, axis).astype(jnp.int64))
+
+
+op("top_k", no_grad=True)(_topk)
+op("top_k_v2", no_grad=True)(_topk)
+
+
+# --------------------------------------------------------------------------
+# comparison / logical (reference: operators/controlflow/compare_op.cc)
+# --------------------------------------------------------------------------
+def _cmp(fn):
+    def lower(ctx):
+        x, y = _align(ctx.in_("X"), ctx.in_("Y"), ctx.attr("axis", -1))
+        ctx.set_out("Out", fn(x, y))
+
+    return lower
+
+
+for _name, _fn in [
+    ("equal", jnp.equal),
+    ("not_equal", jnp.not_equal),
+    ("less_than", jnp.less),
+    ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater),
+    ("greater_equal", jnp.greater_equal),
+    ("logical_and", jnp.logical_and),
+    ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    op(_name, no_grad=True)(_cmp(_fn))
+
+
+@op("logical_not", no_grad=True)
+def _lnot(ctx):
+    ctx.set_out("Out", jnp.logical_not(ctx.in_("X")))
+
+
+@op("isfinite", no_grad=True)
+def _isfinite(ctx):
+    ctx.set_out("Out", jnp.all(jnp.isfinite(ctx.in_("X"))))
+
+
+@op("isfinite_v2", no_grad=True)
+def _isfinite2(ctx):
+    ctx.set_out("Out", jnp.isfinite(ctx.in_("X")))
+
+
+@op("isnan_v2", no_grad=True)
+def _isnan(ctx):
+    ctx.set_out("Out", jnp.isnan(ctx.in_("X")))
+
+
+@op("isinf_v2", no_grad=True)
+def _isinf(ctx):
+    ctx.set_out("Out", jnp.isinf(ctx.in_("X")))
+
+
+# --------------------------------------------------------------------------
+# matmul family — the MXU path.  bf16-friendly; large batched matmuls map
+# straight onto the systolic array (reference: matmul_op.cc, mul_op.cc,
+# matmul_v2_op.cc — cublas dispatch in operators/math/blas.h).
+# --------------------------------------------------------------------------
+@op("matmul")
+def _matmul(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    tx, ty = ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False)
+    alpha = ctx.attr("alpha", 1.0)
+    if jnp.ndim(x) == 1:
+        x = x[None, :] if not tx else x[:, None]
+    if jnp.ndim(y) == 1:
+        y = y[:, None] if not ty else y[None, :]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.set_out("Out", out)
+
+
+@op("matmul_v2")
+def _matmul_v2(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    if ctx.attr("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if ctx.attr("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    ctx.set_out("Out", jnp.matmul(x, y))
+
+
+@op("mul")
+def _mul(ctx):
+    """Flattening matmul (reference: mul_op.cc — x_num_col_dims)."""
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    xnc = ctx.attr("x_num_col_dims", 1)
+    ync = ctx.attr("y_num_col_dims", 1)
+    import math
+
+    xs, ys = jnp.shape(x), jnp.shape(y)
+    xm = jnp.reshape(x, (math.prod(xs[:xnc]), -1))
+    ym = jnp.reshape(y, (math.prod(ys[:ync]), -1))
+    out = jnp.matmul(xm, ym)
+    ctx.set_out("Out", jnp.reshape(out, xs[:xnc] + ys[ync:]))
+
+
+@op("bmm")
+def _bmm(ctx):
+    ctx.set_out("Out", jnp.matmul(ctx.in_("X"), ctx.in_("Y")))
+
+
+@op("dot")
+def _dot(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    ctx.set_out("Out", jnp.sum(x * y, axis=-1))
+
+
+@op("addmm")
+def _addmm(ctx):
+    i, x, y = ctx.in_("Input"), ctx.in_("X"), ctx.in_("Y")
+    ctx.set_out(
+        "Out",
+        ctx.attr("Beta", 1.0) * i + ctx.attr("Alpha", 1.0) * jnp.matmul(x, y),
+    )
+
+
+@op("cumsum")
+def _cumsum(ctx):
+    x = ctx.in_("X")
+    ax = ctx.attr("axis", -1)
+    if ctx.attr("flatten", False):
+        x, ax = jnp.ravel(x), 0
+    out = jnp.cumsum(x, axis=ax)
+    if ctx.attr("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, ax), axis=ax), ax)
+    if ctx.attr("exclusive", False):
+        pad = [(0, 0)] * jnp.ndim(out)
+        pad[ax] = (1, 0)
+        out = jnp.pad(out, pad)[
+            tuple(slice(0, -1) if i == ax else slice(None) for i in range(jnp.ndim(out)))
+        ]
+    ctx.set_out("Out", out)
+
+
+@op("increment")
+def _increment(ctx):
+    ctx.set_out("Out", ctx.in_("X") + ctx.attr("step", 1.0))
+
+
+@op("maximum")
+def _maximum(ctx):
+    ctx.set_out("Out", jnp.maximum(ctx.in_("X"), ctx.in_("Y")))
+
+
+@op("minimum")
+def _minimum(ctx):
+    ctx.set_out("Out", jnp.minimum(ctx.in_("X"), ctx.in_("Y")))
+
+
+@op("kron")
+def _kron(ctx):
+    ctx.set_out("Out", jnp.kron(ctx.in_("X"), ctx.in_("Y")))
+
+
+@op("trace")
+def _trace(ctx):
+    ctx.set_out(
+        "Out",
+        jnp.trace(
+            ctx.in_("Input"),
+            offset=ctx.attr("offset", 0),
+            axis1=ctx.attr("axis1", 0),
+            axis2=ctx.attr("axis2", 1),
+        ),
+    )
+
+
+@op("matmul_with_flatten")
+def _matmul_with_flatten(ctx):
+    _mul(ctx)

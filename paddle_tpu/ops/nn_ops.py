@@ -1,0 +1,721 @@
+"""NN op lowerings: conv / pool / norm / softmax / losses / embedding.
+
+Capability parity with the reference's cudnn-backed NN kernels
+(reference: paddle/fluid/operators/conv_op.cc, conv_cudnn_op.cu,
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, softmax_op.cc,
+softmax_with_cross_entropy_op.cc, lookup_table_v2_op.cc, dropout_op.cc).
+TPU-first: convs lower to ``lax.conv_general_dilated`` (MXU), norms and
+softmaxes to fusable jnp graphs; there is no cudnn/workspace machinery —
+XLA picks conv algorithms.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, nn as jnn
+
+from .registry import op, grad_maker, default_grad_maker
+from ..framework.core import GRAD_SUFFIX, EMPTY_VAR_NAME
+
+
+# --------------------------------------------------------------------------
+# conv2d / depthwise_conv2d / conv2d_transpose / conv3d
+# --------------------------------------------------------------------------
+def _conv_padding(paddings, algo, ndim, in_shape, k_shape, strides, dilations):
+    """Resolve paddle padding attrs -> lax padding list [(lo,hi)]*spatial."""
+    if algo == "VALID":
+        return [(0, 0)] * ndim
+    if algo == "SAME":
+        pads = []
+        for i in range(ndim):
+            eff_k = (k_shape[i] - 1) * dilations[i] + 1
+            out = -(-in_shape[i] // strides[i])
+            total = max(0, (out - 1) * strides[i] + eff_k - in_shape[i])
+            pads.append((total // 2, total - total // 2))
+        return pads
+    if len(paddings) == ndim:
+        return [(p, p) for p in paddings]
+    if len(paddings) == 2 * ndim:
+        return [(paddings[2 * i], paddings[2 * i + 1]) for i in range(ndim)]
+    return [(0, 0)] * ndim
+
+
+def _conv_lower(ctx, transpose=False):
+    x = ctx.in_("Input")
+    w = ctx.in_("Filter")
+    strides = list(ctx.attr("strides", [1, 1]))
+    paddings = list(ctx.attr("paddings", [0, 0]))
+    dilations = list(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    data_format = ctx.attr("data_format", "NCHW")
+    algo = ctx.attr("padding_algorithm", "EXPLICIT")
+    nd = jnp.ndim(x) - 2
+
+    if data_format in ("NCHW", "NCDHW", "AnyLayout"):
+        lhs_spec = "NCHW" if nd == 2 else "NCDHW"
+    else:
+        lhs_spec = "NHWC" if nd == 2 else "NDHWC"
+    rhs_spec = "OIHW" if nd == 2 else "OIDHW"
+    out_spec = lhs_spec
+    dn = lax.conv_dimension_numbers(jnp.shape(x), jnp.shape(w), (lhs_spec, rhs_spec, out_spec))
+
+    spatial_in = [jnp.shape(x)[i] for i in dn.lhs_spec[2:]]
+    k_spatial = [jnp.shape(w)[i] for i in dn.rhs_spec[2:]]
+    pads = _conv_padding(paddings, algo, nd, spatial_in, k_spatial, strides, dilations)
+
+    if not transpose:
+        if ctx.op is not None and ctx.op.type == "depthwise_conv2d":
+            groups = jnp.shape(x)[1 if lhs_spec.startswith("NC") else -1]
+        out = lax.conv_general_dilated(
+            x, w,
+            window_strides=strides,
+            padding=pads,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+    else:
+        # conv_transpose: filter layout is (C_in, C_out/groups, *k)
+        output_padding = ctx.attr("output_padding", []) or [0] * nd
+        k_spatial = [jnp.shape(w)[i] for i in dn.rhs_spec[2:]]
+        pads_t = []
+        for i in range(nd):
+            eff_k = (k_spatial[i] - 1) * dilations[i] + 1
+            lo = eff_k - 1 - pads[i][0]
+            hi = eff_k - 1 - pads[i][1] + (output_padding[i] if output_padding else 0)
+            pads_t.append((lo, hi))
+        # transpose conv = lhs-dilated conv with flipped, transposed kernel
+        w_t = jnp.swapaxes(w, 0, 1)  # (C_out/g, C_in, *k) -> per-group handled below
+        w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
+        if groups > 1:
+            ci = jnp.shape(w)[0]
+            co_g = jnp.shape(w)[1]
+            wg = jnp.reshape(w, (groups, ci // groups) + jnp.shape(w)[1:])
+            wg = jnp.swapaxes(wg, 1, 2)  # (g, co_g, ci_g, *k)
+            w_t = jnp.reshape(wg, (groups * co_g, ci // groups) + jnp.shape(w)[2:])
+            w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
+        out = lax.conv_general_dilated(
+            x, w_t,
+            window_strides=[1] * nd,
+            padding=pads_t,
+            lhs_dilation=strides,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+    ctx.set_out("Output", out)
+
+
+op("conv2d")(lambda ctx: _conv_lower(ctx))
+op("depthwise_conv2d")(lambda ctx: _conv_lower(ctx))
+op("conv3d")(lambda ctx: _conv_lower(ctx))
+op("conv2d_transpose")(lambda ctx: _conv_lower(ctx, transpose=True))
+op("depthwise_conv2d_transpose")(lambda ctx: _conv_lower(ctx, transpose=True))
+
+
+# --------------------------------------------------------------------------
+# pool2d (reference: pool_op.cc)
+# --------------------------------------------------------------------------
+@op("pool2d")
+def _pool2d(ctx):
+    x = ctx.in_("X")
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = list(ctx.attr("ksize", [2, 2]))
+    strides = list(ctx.attr("strides", [2, 2]))
+    paddings = list(ctx.attr("paddings", [0, 0]))
+    global_pool = ctx.attr("global_pooling", False)
+    adaptive = ctx.attr("adaptive", False)
+    exclusive = ctx.attr("exclusive", True)
+    ceil_mode = ctx.attr("ceil_mode", False)
+    data_format = ctx.attr("data_format", "NCHW")
+    nchw = data_format in ("NCHW", "AnyLayout")
+    sp = (2, 3) if nchw else (1, 2)
+    in_sp = [jnp.shape(x)[sp[0]], jnp.shape(x)[sp[1]]]
+
+    if global_pool or (adaptive and ksize == [1, 1]):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        ctx.set_out("Out", fn(x, axis=sp, keepdims=True))
+        return
+    if adaptive:
+        # divisible adaptive pooling via reshape
+        oh, ow = ksize
+        h, w = in_sp
+        if h % oh == 0 and w % ow == 0:
+            if nchw:
+                r = jnp.reshape(x, jnp.shape(x)[:2] + (oh, h // oh, ow, w // ow))
+                fn = jnp.max if ptype == "max" else jnp.mean
+                ctx.set_out("Out", fn(r, axis=(3, 5)))
+                return
+        raise NotImplementedError("non-divisible adaptive pool2d")
+
+    algo = ctx.attr("padding_algorithm", "EXPLICIT")
+    if algo == "SAME":
+        pads = []
+        for i in range(2):
+            out = -(-in_sp[i] // strides[i])
+            total = max(0, (out - 1) * strides[i] + ksize[i] - in_sp[i])
+            pads.append((total // 2, total - total // 2))
+    elif algo == "VALID":
+        pads = [(0, 0), (0, 0)]
+    elif len(paddings) == 4:
+        pads = [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    else:
+        pads = [(p, p) for p in paddings]
+    if ceil_mode:
+        pads = [
+            (lo, hi + strides[i] - 1) for i, (lo, hi) in enumerate(pads)
+        ]
+
+    if nchw:
+        window = (1, 1, ksize[0], ksize[1])
+        strides_full = (1, 1, strides[0], strides[1])
+        pads_full = [(0, 0), (0, 0)] + pads
+    else:
+        window = (1, ksize[0], ksize[1], 1)
+        strides_full = (1, strides[0], strides[1], 1)
+        pads_full = [(0, 0)] + pads + [(0, 0)]
+
+    if ptype == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max, window, strides_full, pads_full)
+    else:
+        s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add, window, strides_full, pads_full)
+        if exclusive:
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), lax.add, window, strides_full, pads_full)
+            out = s / cnt
+        else:
+            out = s / (ksize[0] * ksize[1])
+    ctx.set_out("Out", out)
+
+
+@op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx):
+    _pool2d(ctx)  # Mask output unsupported; Out computed identically
+
+
+# --------------------------------------------------------------------------
+# batch_norm (reference: batch_norm_op.cc) — running stats thread through
+# the functional env as extra outputs aliased to the stat var names.
+# --------------------------------------------------------------------------
+@op("batch_norm")
+def _batch_norm(ctx):
+    x = ctx.in_("X")
+    scale = ctx.in_("Scale")
+    bias = ctx.in_("Bias")
+    mean_rt = ctx.in_("Mean")
+    var_rt = ctx.in_("Variance")
+    momentum = ctx.attr("momentum", 0.9)
+    eps = ctx.attr("epsilon", 1e-5)
+    is_test = ctx.attr("is_test", False) or ctx.attr("use_global_stats", False)
+    layout = ctx.attr("data_layout", "NCHW")
+    nd = jnp.ndim(x)
+    c_axis = 1 if layout in ("NCHW", "AnyLayout") and nd > 1 else nd - 1
+    red_axes = tuple(i for i in range(nd) if i != c_axis)
+    bshape = [1] * nd
+    bshape[c_axis] = jnp.shape(x)[c_axis]
+
+    if is_test:
+        mean, var = mean_rt, var_rt
+        ctx.set_out("MeanOut", mean_rt)
+        ctx.set_out("VarianceOut", var_rt)
+    else:
+        mean = jnp.mean(x, axis=red_axes)
+        var = jnp.var(x, axis=red_axes)
+        ctx.set_out("MeanOut", momentum * mean_rt + (1.0 - momentum) * mean)
+        ctx.set_out("VarianceOut", momentum * var_rt + (1.0 - momentum) * var)
+    inv = lax.rsqrt(var + eps)
+    y = (x - jnp.reshape(mean, bshape)) * jnp.reshape(inv * scale, bshape) + jnp.reshape(bias, bshape)
+    ctx.set_out("Y", y)
+    ctx.set_out("SavedMean", mean)
+    ctx.set_out("SavedVariance", inv)  # reference saves inv-std here
+
+
+@grad_maker("batch_norm")
+def _bn_grad_maker(op_, no_grad_names=frozenset()):
+    # default maker, but never produce grads for the running-stat inputs
+    descs = default_grad_maker(op_, no_grad_names)
+    for d in descs:
+        for slot in ("Mean" + GRAD_SUFFIX, "Variance" + GRAD_SUFFIX):
+            if slot in d["outputs"]:
+                d["outputs"][slot] = [EMPTY_VAR_NAME] * len(d["outputs"][slot])
+    return descs
+
+
+# --------------------------------------------------------------------------
+# layer_norm (reference: layer_norm_op.cc)
+# --------------------------------------------------------------------------
+@op("layer_norm")
+def _layer_norm(ctx):
+    import math
+
+    x = ctx.in_("X")
+    begin = ctx.attr("begin_norm_axis", 1)
+    eps = ctx.attr("epsilon", 1e-5)
+    shape = jnp.shape(x)
+    axes = tuple(range(begin, len(shape)))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    y = (x - mean) * inv
+    norm_shape = shape[begin:]
+    if ctx.has_input("Scale"):
+        y = y * jnp.reshape(ctx.in_("Scale"), norm_shape)
+    if ctx.has_input("Bias"):
+        y = y + jnp.reshape(ctx.in_("Bias"), norm_shape)
+    ctx.set_out("Y", y)
+    ctx.set_out("Mean", jnp.reshape(mean, shape[:begin]))
+    ctx.set_out("Variance", jnp.reshape(var, shape[:begin]))
+
+
+@op("instance_norm")
+def _instance_norm(ctx):
+    x = ctx.in_("X")
+    eps = ctx.attr("epsilon", 1e-5)
+    nd = jnp.ndim(x)
+    axes = tuple(range(2, nd))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    y = (x - mean) * inv
+    bshape = (1, -1) + (1,) * (nd - 2)
+    if ctx.has_input("Scale"):
+        y = y * jnp.reshape(ctx.in_("Scale"), bshape)
+    if ctx.has_input("Bias"):
+        y = y + jnp.reshape(ctx.in_("Bias"), bshape)
+    ctx.set_out("Y", y)
+    ctx.set_out("SavedMean", jnp.squeeze(mean, axes))
+    ctx.set_out("SavedVariance", jnp.squeeze(inv, axes))
+
+
+@op("group_norm")
+def _group_norm(ctx):
+    x = ctx.in_("X")
+    groups = ctx.attr("groups", 1)
+    eps = ctx.attr("epsilon", 1e-5)
+    n, c = jnp.shape(x)[0], jnp.shape(x)[1]
+    rest = jnp.shape(x)[2:]
+    xg = jnp.reshape(x, (n, groups, c // groups) + rest)
+    axes = tuple(range(2, jnp.ndim(xg)))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = jnp.reshape((xg - mean) * lax.rsqrt(var + eps), jnp.shape(x))
+    bshape = (1, c) + (1,) * len(rest)
+    if ctx.has_input("Scale"):
+        y = y * jnp.reshape(ctx.in_("Scale"), bshape)
+    if ctx.has_input("Bias"):
+        y = y + jnp.reshape(ctx.in_("Bias"), bshape)
+    ctx.set_out("Y", y)
+    ctx.set_out("Mean", jnp.reshape(mean, (n, groups)))
+    ctx.set_out("Variance", jnp.reshape(var, (n, groups)))
+
+
+# --------------------------------------------------------------------------
+# softmax & losses
+# --------------------------------------------------------------------------
+@op("softmax")
+def _softmax(ctx):
+    ctx.set_out("Out", jnn.softmax(ctx.in_("X"), axis=ctx.attr("axis", -1)))
+
+
+@op("log_softmax")
+def _log_softmax(ctx):
+    ctx.set_out("Out", jnn.log_softmax(ctx.in_("X"), axis=ctx.attr("axis", -1)))
+
+
+@op("softmax_with_cross_entropy")
+def _softmax_ce(ctx):
+    logits = ctx.in_("Logits")
+    label = ctx.in_("Label")
+    axis = ctx.attr("axis", -1)
+    soft_label = ctx.attr("soft_label", False)
+    ignore_index = ctx.attr("ignore_index", -100)
+    log_p = jnn.log_softmax(logits, axis=axis)
+    ctx.set_out("Softmax", jnp.exp(log_p))
+    if soft_label:
+        loss = -jnp.sum(label * log_p, axis=axis, keepdims=True)
+    else:
+        lbl = jnp.squeeze(label, axis) if jnp.ndim(label) == jnp.ndim(logits) else label
+        lbl = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(log_p, jnp.expand_dims(lbl, axis), axis=axis)
+        loss = -picked
+        if ignore_index >= 0:
+            mask = (jnp.expand_dims(lbl, axis) != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+    ctx.set_out("Loss", loss)
+
+
+@op("cross_entropy")
+def _cross_entropy(ctx):
+    x = ctx.in_("X")  # probabilities
+    label = ctx.in_("Label")
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.clip(x, 1e-20, None)), axis=-1, keepdims=True)
+    else:
+        lbl = label.astype(jnp.int32)
+        if jnp.ndim(lbl) == jnp.ndim(x):
+            lbl = jnp.squeeze(lbl, -1)
+        picked = jnp.take_along_axis(x, jnp.expand_dims(lbl, -1), axis=-1)
+        loss = -jnp.log(jnp.clip(picked, 1e-20, None))
+    ctx.set_out("Y", loss)
+
+
+@op("cross_entropy2")
+def _cross_entropy2(ctx):
+    x = ctx.in_("X")
+    label = ctx.in_("Label").astype(jnp.int32)
+    if jnp.ndim(label) == jnp.ndim(x):
+        label = jnp.squeeze(label, -1)
+    picked = jnp.take_along_axis(x, jnp.expand_dims(label, -1), axis=-1)
+    y = -jnp.log(jnp.clip(picked, 1e-20, None))
+    ctx.set_out("Y", y)
+    ctx.set_out("XShape", jnp.zeros((0,), x.dtype))
+    ctx.set_out("MatchX", picked)
+
+
+@op("sigmoid_cross_entropy_with_logits")
+def _sce(ctx):
+    x = ctx.in_("X")
+    label = ctx.in_("Label")
+    ignore_index = ctx.attr("ignore_index", -100)
+    loss = jnp.maximum(x, 0) - x * label + jnn.softplus(-jnp.abs(x))
+    mask = label != ignore_index
+    loss = jnp.where(mask, loss, 0.0)
+    if ctx.attr("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+    ctx.set_out("Out", loss)
+
+
+@op("squared_l2_norm")
+def _squared_l2_norm(ctx):
+    ctx.set_out("Out", jnp.sum(jnp.square(ctx.in_("X"))).reshape((1,)))
+
+
+@op("squared_l2_distance")
+def _squared_l2_distance(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    d = x - y
+    ctx.set_out("sub_result", d)
+    ctx.set_out("Out", jnp.sum(jnp.square(d), axis=-1, keepdims=True))
+
+
+@op("smooth_l1_loss")
+def _smooth_l1(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if ctx.has_input("InsideWeight"):
+        d = d * ctx.in_("InsideWeight")
+    ad = jnp.abs(d)
+    l = jnp.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+    if ctx.has_input("OutsideWeight"):
+        l = l * ctx.in_("OutsideWeight")
+    ctx.set_out("Diff", d)
+    ctx.set_out("Out", jnp.sum(l, axis=tuple(range(1, jnp.ndim(l))), keepdims=False).reshape((-1, 1)))
+
+
+@op("huber_loss")
+def _huber(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    l = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    ctx.set_out("Residual", r)
+    ctx.set_out("Out", l)
+
+
+@op("mse_loss")
+def _mse(ctx):
+    ctx.set_out("Out", jnp.square(ctx.in_("X") - ctx.in_("Y")))
+
+
+@op("kldiv_loss")
+def _kldiv(ctx):
+    x, t = ctx.in_("X"), ctx.in_("Target")
+    loss = t * (jnp.log(jnp.clip(t, 1e-20, None)) - x)
+    loss = jnp.where(t > 0, loss, 0.0)
+    red = ctx.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / jnp.shape(x)[0]
+    ctx.set_out("Loss", loss)
+
+
+@op("bce_loss")
+def _bce(ctx):
+    x, label = ctx.in_("X"), ctx.in_("Label")
+    out = -(label * jnp.log(jnp.clip(x, 1e-12, None))
+            + (1 - label) * jnp.log(jnp.clip(1 - x, 1e-12, None)))
+    ctx.set_out("Out", out)
+
+
+@op("rank_loss")
+def _rank_loss(ctx):
+    label, left, right = ctx.in_("Label"), ctx.in_("Left"), ctx.in_("Right")
+    d = left - right
+    ctx.set_out("Out", jnn.softplus(d) - label * d)
+
+
+@op("log_loss")
+def _log_loss(ctx):
+    p, label = ctx.in_("Predicted"), ctx.in_("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    ctx.set_out(
+        "Loss",
+        -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps),
+    )
+
+
+@op("hinge_loss")
+def _hinge_loss(ctx):
+    logits, labels = ctx.in_("Logits"), ctx.in_("Labels")
+    ctx.set_out("Loss", jnn.relu(1.0 - (2.0 * labels - 1.0) * logits))
+
+
+# --------------------------------------------------------------------------
+# embedding (reference: lookup_table_v2_op.cc; sparse grad -> dense
+# scatter-add on TPU, the SelectedRows path is handled by the PS layer)
+# --------------------------------------------------------------------------
+def _lookup(ctx, squeeze_last):
+    w = ctx.in_("W")
+    ids = ctx.in_("Ids")
+    padding_idx = ctx.attr("padding_idx", -1)
+    ids_i = ids.astype(jnp.int32)
+    if squeeze_last and jnp.ndim(ids_i) > 1 and jnp.shape(ids_i)[-1] == 1:
+        ids_i = jnp.squeeze(ids_i, -1)
+    out = jnp.take(w, jnp.clip(ids_i, 0, jnp.shape(w)[0] - 1), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids_i != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0.0)
+    ctx.set_out("Out", out)
+
+
+op("lookup_table")(lambda ctx: _lookup(ctx, squeeze_last=True))
+op("lookup_table_v2")(lambda ctx: _lookup(ctx, squeeze_last=False))
+op("embedding")(lambda ctx: _lookup(ctx, squeeze_last=False))
+
+
+@op("one_hot", no_grad=True)
+def _one_hot(ctx):
+    x = ctx.in_("X").astype(jnp.int32)
+    depth = ctx.attr("depth", 1)
+    if jnp.ndim(x) > 1 and jnp.shape(x)[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    ctx.set_out("Out", jnn.one_hot(x, depth, dtype=jnp.float32))
+
+
+@op("one_hot_v2", no_grad=True)
+def _one_hot_v2(ctx):
+    x = ctx.in_("X").astype(jnp.int32)
+    depth = ctx.attr("depth", 1)
+    ctx.set_out("Out", jnn.one_hot(x, depth, dtype=jnp.float32))
+
+
+# --------------------------------------------------------------------------
+# dropout — stateful forward, mask-based custom grad
+# (reference: dropout_op.cc / dropout_op.cu)
+# --------------------------------------------------------------------------
+@op("dropout", stateful=True)
+def _dropout(ctx):
+    x = ctx.in_("X")
+    p = ctx.attr("dropout_prob", 0.5)
+    is_test = ctx.attr("is_test", False)
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        ctx.set_out("Out", out)
+        if ctx.has_output("Mask"):
+            ctx.set_out("Mask", jnp.ones_like(x))
+        return
+    seed = ctx.attr("seed", 0)
+    key = jax.random.key(seed) if ctx.attr("fix_seed", False) else ctx.rng()
+    keep = jax.random.bernoulli(key, 1.0 - p, jnp.shape(x))
+    if impl == "upscale_in_train":
+        scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
+        mask = keep.astype(x.dtype) * scale
+    else:
+        mask = keep.astype(x.dtype)
+    ctx.set_out("Out", x * mask)
+    ctx.set_out("Mask", mask)
+
+
+@grad_maker("dropout")
+def _dropout_grad_maker(op_, no_grad_names=frozenset()):
+    return [
+        dict(
+            type="dropout_grad",
+            inputs={
+                "Mask": op_.output("Mask"),
+                "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in op_.output("Out")],
+            },
+            outputs={
+                "X" + GRAD_SUFFIX: [
+                    (n + GRAD_SUFFIX) if n not in no_grad_names else EMPTY_VAR_NAME
+                    for n in op_.input("X")
+                ]
+            },
+            attrs=dict(op_.attrs),
+        )
+    ]
+
+
+@op("dropout_grad", no_grad=True)
+def _dropout_grad(ctx):
+    dout = ctx.in_("Out" + GRAD_SUFFIX)
+    mask = ctx.in_("Mask")
+    ctx.set_out("X" + GRAD_SUFFIX, dout * mask)
+
+
+# --------------------------------------------------------------------------
+# metrics (reference: operators/metrics/accuracy_op.cc)
+# --------------------------------------------------------------------------
+@op("accuracy", no_grad=True)
+def _accuracy(ctx):
+    indices = ctx.in_("Indices")
+    label = ctx.in_("Label")
+    if jnp.ndim(label) == 1:
+        label = label[:, None]
+    correct = jnp.any(indices == label.astype(indices.dtype), axis=-1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(jnp.shape(indices)[0], jnp.float32)
+    ctx.set_out("Accuracy", (num_correct / total).astype(jnp.float32))
+    ctx.set_out("Correct", num_correct.astype(jnp.int32))
+    ctx.set_out("Total", total.astype(jnp.int64))
+
+
+@op("mean_iou", no_grad=True)
+def _mean_iou(ctx):
+    pred = ctx.in_("Predictions").astype(jnp.int32).ravel()
+    label = ctx.in_("Labels").astype(jnp.int32).ravel()
+    n = ctx.attr("num_classes", 2)
+    cm = jnp.zeros((n, n), jnp.float32).at[label, pred].add(1.0)
+    inter = jnp.diag(cm)
+    union = jnp.sum(cm, 0) + jnp.sum(cm, 1) - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-9), 0.0)
+    valid = jnp.sum((union > 0).astype(jnp.float32))
+    ctx.set_out("OutMeanIou", jnp.sum(iou) / jnp.maximum(valid, 1.0))
+    ctx.set_out("OutWrong", jnp.sum(cm, 1) - inter)
+    ctx.set_out("OutCorrect", inter)
+
+
+# --------------------------------------------------------------------------
+# interpolate / pad
+# --------------------------------------------------------------------------
+@op("pad")
+def _pad(ctx):
+    x = ctx.in_("X")
+    p = ctx.attr("paddings", [])
+    nd = jnp.ndim(x)
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+    ctx.set_out("Out", jnp.pad(x, pads, constant_values=ctx.attr("pad_value", 0.0)))
+
+
+@op("pad2d")
+def _pad2d(ctx):
+    x = ctx.in_("X")
+    p = ctx.attr("paddings", [0, 0, 0, 0])
+    mode = ctx.attr("mode", "constant")
+    fmt = ctx.attr("data_format", "NCHW")
+    if fmt == "NCHW":
+        pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pads = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == "constant":
+        out = jnp.pad(x, pads, constant_values=ctx.attr("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pads, mode="reflect")
+    else:
+        out = jnp.pad(x, pads, mode="edge")
+    ctx.set_out("Out", out)
+
+
+@op("pad3d")
+def _pad3d(ctx):
+    x = ctx.in_("X")
+    p = ctx.attr("paddings", [0] * 6)
+    fmt = ctx.attr("data_format", "NCDHW")
+    if fmt == "NCDHW":
+        pads = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    else:
+        pads = [(0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+    mode = ctx.attr("mode", "constant")
+    if mode == "constant":
+        out = jnp.pad(x, pads, constant_values=ctx.attr("value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pads, mode="reflect")
+    else:
+        out = jnp.pad(x, pads, mode="edge")
+    ctx.set_out("Out", out)
+
+
+def _interp(ctx, method):
+    x = ctx.in_("X")  # NCHW
+    out_h = ctx.attr("out_h", -1)
+    out_w = ctx.attr("out_w", -1)
+    scale = ctx.attr("scale", 0.0)
+    n, c, h, w = jnp.shape(x)
+    if ctx.has_input("OutSize"):
+        raise NotImplementedError("dynamic OutSize not supported under jit")
+    if scale and scale > 0:
+        out_h, out_w = int(h * scale), int(w * scale)
+    align_corners = ctx.attr("align_corners", True)
+    xt = jnp.transpose(x, (0, 2, 3, 1))
+    out = jax.image.resize(xt, (n, out_h, out_w, c), method=method)
+    ctx.set_out("Out", jnp.transpose(out, (0, 3, 1, 2)))
+
+
+op("bilinear_interp")(lambda ctx: _interp(ctx, "bilinear"))
+op("nearest_interp")(lambda ctx: _interp(ctx, "nearest"))
+op("bicubic_interp")(lambda ctx: _interp(ctx, "bicubic"))
+
+
+@op("grid_sampler")
+def _grid_sampler(ctx):
+    raise NotImplementedError("grid_sampler: planned detection-suite op")
+
+
+@op("prelu")
+def _prelu(ctx):
+    x = ctx.in_("X")
+    alpha = ctx.in_("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "all":
+        a = jnp.reshape(alpha, ())
+    elif mode == "channel":
+        a = jnp.reshape(alpha, (1, -1) + (1,) * (jnp.ndim(x) - 2))
+    else:
+        a = jnp.reshape(alpha, (1,) + jnp.shape(x)[1:])
+    ctx.set_out("Out", jnp.where(x > 0, x, a * x))
+
+
+@op("label_smooth")
+def _label_smooth(ctx):
+    x = ctx.in_("X")
+    eps = ctx.attr("epsilon", 0.0)
+    if ctx.has_input("PriorDist"):
+        prior = ctx.in_("PriorDist")
+        ctx.set_out("Out", (1 - eps) * x + eps * prior)
+    else:
+        ctx.set_out("Out", (1 - eps) * x + eps / jnp.shape(x)[-1])
+
+
+@op("temporal_shift")
+def _temporal_shift(ctx):
+    x = ctx.in_("X")
+    seg = ctx.attr("seg_num", 1)
+    ratio = ctx.attr("shift_ratio", 0.25)
+    nt, c, h, w = jnp.shape(x)
+    n = nt // seg
+    xr = jnp.reshape(x, (n, seg, c, h, w))
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    pre = jnp.pad(xr[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    post = jnp.pad(xr[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    rest = xr[:, :, c2:]
+    ctx.set_out("Out", jnp.reshape(jnp.concatenate([pre, post, rest], axis=2), (nt, c, h, w)))

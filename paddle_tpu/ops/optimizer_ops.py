@@ -1,0 +1,250 @@
+"""Optimizer update-op lowerings.
+
+Capability parity with reference: paddle/fluid/operators/optimizers/
+(sgd_op.cc, momentum_op.cc, adam_op.cc, adagrad_op.cc, rmsprop_op.cc,
+adamax_op.cc, lamb_op.cc, lars_momentum_op.cc, ftrl_op.cc, adadelta_op.cc,
+dpsgd_op.cc, dgc_momentum_op.cc).  In-place param updates become functional
+env rebinding: the ParamOut output carries the Param's var name, so the
+executor's state-threading writes the new value back (SURVEY.md §7
+hard-part 2).  All ops are no_grad.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+def _opt(type):
+    return op(type, no_grad=True)
+
+
+@_opt("sgd")
+def _sgd(ctx):
+    p, g, lr = ctx.in_("Param"), ctx.in_("Grad"), ctx.in_("LearningRate")
+    ctx.set_out("ParamOut", p - lr.reshape(()).astype(p.dtype) * g.astype(p.dtype))
+
+
+@_opt("momentum")
+def _momentum(ctx):
+    p, g, v = ctx.in_("Param"), ctx.in_("Grad"), ctx.in_("Velocity")
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    mu = ctx.attr("mu", 0.9)
+    use_nesterov = ctx.attr("use_nesterov", False)
+    g = g.astype(p.dtype)
+    v_new = mu * v + g
+    if use_nesterov:
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    ctx.set_out("ParamOut", p_new)
+    ctx.set_out("VelocityOut", v_new)
+
+
+@_opt("lars_momentum")
+def _lars_momentum(ctx):
+    p, g, v = ctx.in_("Param"), ctx.in_("Grad"), ctx.in_("Velocity")
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    mu = ctx.attr("mu", 0.9)
+    coeff = ctx.attr("lars_coeff", 0.001)
+    wd = ctx.attr("lars_weight_decay", 0.0005)
+    eps = ctx.attr("epsilon", 0.0)
+    g = g.astype(p.dtype)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps),
+        lr,
+    )
+    v_new = mu * v + local_lr * (g + wd * p)
+    ctx.set_out("ParamOut", p - v_new)
+    ctx.set_out("VelocityOut", v_new)
+
+
+@_opt("adam")
+def _adam(ctx):
+    p, g = ctx.in_("Param"), ctx.in_("Grad").astype(ctx.in_("Param").dtype)
+    m1, m2 = ctx.in_("Moment1"), ctx.in_("Moment2")
+    b1p, b2p = ctx.in_("Beta1Pow"), ctx.in_("Beta2Pow")
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m1_new = b1 * m1 + (1 - b1) * g
+    m2_new = b2 * m2 + (1 - b2) * jnp.square(g)
+    b1p_ = b1p.reshape(()).astype(p.dtype)
+    b2p_ = b2p.reshape(()).astype(p.dtype)
+    lr_t = lr * jnp.sqrt(1 - b2p_ * b2) / (1 - b1p_ * b1)
+    p_new = p - lr_t * m1_new / (jnp.sqrt(m2_new) + eps)
+    ctx.set_out("ParamOut", p_new)
+    ctx.set_out("Moment1Out", m1_new)
+    ctx.set_out("Moment2Out", m2_new)
+    ctx.set_out("Beta1PowOut", b1p * b1)
+    ctx.set_out("Beta2PowOut", b2p * b2)
+
+
+@_opt("adamw")
+def _adamw(ctx):
+    p = ctx.in_("Param")
+    coeff = ctx.attr("coeff", 0.01)
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    with_decay = ctx.attr("with_decay", True)
+    if with_decay:
+        p = p * (1.0 - lr * coeff)
+    # reuse adam math on the decayed param
+    g = ctx.in_("Grad").astype(p.dtype)
+    m1, m2 = ctx.in_("Moment1"), ctx.in_("Moment2")
+    b1p, b2p = ctx.in_("Beta1Pow"), ctx.in_("Beta2Pow")
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m1_new = b1 * m1 + (1 - b1) * g
+    m2_new = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(()) * b2) / (1 - b1p.reshape(()) * b1)
+    ctx.set_out("ParamOut", p - lr_t * m1_new / (jnp.sqrt(m2_new) + eps))
+    ctx.set_out("Moment1Out", m1_new)
+    ctx.set_out("Moment2Out", m2_new)
+    ctx.set_out("Beta1PowOut", b1p * b1)
+    ctx.set_out("Beta2PowOut", b2p * b2)
+
+
+@_opt("adamax")
+def _adamax(ctx):
+    p, g = ctx.in_("Param"), ctx.in_("Grad").astype(ctx.in_("Param").dtype)
+    m, inf = ctx.in_("Moment"), ctx.in_("InfNorm")
+    b1p = ctx.in_("Beta1Pow").reshape(())
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr_t = lr / (1 - b1p)
+    ctx.set_out("ParamOut", p - lr_t * m_new / (inf_new + eps))
+    ctx.set_out("MomentOut", m_new)
+    ctx.set_out("InfNormOut", inf_new)
+
+
+@_opt("adagrad")
+def _adagrad(ctx):
+    p, g, m = ctx.in_("Param"), ctx.in_("Grad"), ctx.in_("Moment")
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    eps = ctx.attr("epsilon", 1e-6)
+    g = g.astype(p.dtype)
+    m_new = m + jnp.square(g)
+    ctx.set_out("ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
+    ctx.set_out("MomentOut", m_new)
+
+
+@_opt("decayed_adagrad")
+def _decayed_adagrad(ctx):
+    p, g, m = ctx.in_("Param"), ctx.in_("Grad"), ctx.in_("Moment")
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    g = g.astype(p.dtype)
+    m_new = decay * m + (1 - decay) * jnp.square(g)
+    ctx.set_out("ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
+    ctx.set_out("MomentOut", m_new)
+
+
+@_opt("adadelta")
+def _adadelta(ctx):
+    p, g = ctx.in_("Param"), ctx.in_("Grad").astype(ctx.in_("Param").dtype)
+    avg_sq_g, avg_sq_u = ctx.in_("AvgSquaredGrad"), ctx.in_("AvgSquaredUpdate")
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    avg_sq_g_new = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (avg_sq_g_new + eps)) * g
+    avg_sq_u_new = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    ctx.set_out("ParamOut", p + update)
+    ctx.set_out("AvgSquaredGradOut", avg_sq_g_new)
+    ctx.set_out("AvgSquaredUpdateOut", avg_sq_u_new)
+
+
+@_opt("rmsprop")
+def _rmsprop(ctx):
+    p, g = ctx.in_("Param"), ctx.in_("Grad").astype(ctx.in_("Param").dtype)
+    ms, mom = ctx.in_("MeanSquare"), ctx.in_("Moment")
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    eps = ctx.attr("epsilon", 1e-10)
+    decay = ctx.attr("decay", 0.9)
+    momentum = ctx.attr("momentum", 0.0)
+    centered = ctx.attr("centered", False)
+    ms_new = decay * ms + (1 - decay) * jnp.square(g)
+    if centered:
+        mg = ctx.in_("MeanGrad")
+        mg_new = decay * mg + (1 - decay) * g
+        mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+        ctx.set_out("MeanGradOut", mg_new)
+    else:
+        mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new + eps)
+    ctx.set_out("ParamOut", p - mom_new)
+    ctx.set_out("MeanSquareOut", ms_new)
+    ctx.set_out("MomentOut", mom_new)
+
+
+@_opt("ftrl")
+def _ftrl(ctx):
+    p, g = ctx.in_("Param"), ctx.in_("Grad").astype(ctx.in_("Param").dtype)
+    sq, lin = ctx.in_("SquaredAccumulator"), ctx.in_("LinearAccumulator")
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    pre_shrink = (jnp.sign(new_lin) * l1 - new_lin) / (
+        jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    )
+    ctx.set_out("ParamOut", jnp.where(jnp.abs(new_lin) > l1, pre_shrink, jnp.zeros_like(p)))
+    ctx.set_out("SquaredAccumOut", new_sq)
+    ctx.set_out("LinearAccumOut", new_lin)
+
+
+@_opt("lamb")
+def _lamb(ctx):
+    p, g = ctx.in_("Param"), ctx.in_("Grad").astype(ctx.in_("Param").dtype)
+    m1, m2 = ctx.in_("Moment1"), ctx.in_("Moment2")
+    b1p, b2p = ctx.in_("Beta1Pow"), ctx.in_("Beta2Pow")
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-6)
+    wd = ctx.attr("weight_decay", 0.01)
+    m1_new = b1 * m1 + (1 - b1) * g
+    m2_new = b2 * m2 + (1 - b2) * jnp.square(g)
+    m1_hat = m1_new / (1 - b1p.reshape(()))
+    m2_hat = m2_new / (1 - b2p.reshape(()))
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    ctx.set_out("ParamOut", p - lr * ratio * r)
+    ctx.set_out("Moment1Out", m1_new)
+    ctx.set_out("Moment2Out", m2_new)
+    ctx.set_out("Beta1PowOut", b1p * b1)
+    ctx.set_out("Beta2PowOut", b2p * b2)
+
+
+@_opt("dpsgd")
+def _dpsgd(ctx):
+    # differentially-private SGD (reference: dpsgd_op.cc) — clip + noise
+    import jax
+
+    p, g = ctx.in_("Param"), ctx.in_("Grad").astype(ctx.in_("Param").dtype)
+    lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
+    clip = ctx.attr("clip", 10.0)
+    batch_size = ctx.attr("batch_size", 16.0)
+    sigma = ctx.attr("sigma", 1.0)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = jnp.where(g_norm > clip, g * (clip / g_norm), g)
+    noise = sigma * clip * jax.random.normal(ctx.rng(), jnp.shape(g), dtype=g.dtype)
+    ctx.set_out("ParamOut", p - lr * (g + noise) / batch_size)
+
+
+@_opt("global_step_counter")
+def _global_step_counter(ctx):
+    x = ctx.in_("X")
+    ctx.set_out("Out", x + 1)

@@ -1,0 +1,464 @@
+"""Op registry: op type -> (lower-to-jax, infer_shape, grad maker, grad lower).
+
+Replaces the reference's kernel registry + dispatch
+(reference: paddle/fluid/framework/op_registry.h:68,
+operator.cc:908 OperatorWithKernel::RunImpl, grad_op_desc_maker.h) with a
+TPU-first design:
+
+* **lower**: emits jax/lax ops into the executor's trace instead of
+  launching a device kernel.  One lowering serves every place (CPU/TPU) —
+  XLA does the per-backend codegen, so there is no OpKernelType
+  {place,dtype,layout,library} dimension at all.
+* **infer_shape**: defaults to ``jax.eval_shape`` over the lowering itself,
+  so compile-time shape inference is exactly XLA's — no hand-written
+  per-op InferShape except for ops whose output shape depends on attrs in
+  non-traceable ways (fill_constant, reshape2, ...).
+* **grad**: program-level grad-op descs like the reference's GradOpMaker
+  (so distribution transpilers can rewrite the backward program), but the
+  grad *kernels* default to ``jax.vjp`` replay of the forward lowering.
+  The replayed primal computation is deduplicated by XLA CSE inside the
+  single jitted program, so this costs nothing at run time.  Ops with
+  stateful forward (dropout) register custom grads.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import GRAD_SUFFIX, EMPTY_VAR_NAME, Operator, Block
+from ..framework.dtype import VarType, to_numpy_dtype, convert_dtype
+
+_SENTINEL_DIM = 97  # stands in for -1 (dynamic batch) during eval_shape
+
+OPS: Dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    __slots__ = (
+        "type",
+        "lower",
+        "infer_shape",
+        "grad_maker",
+        "no_grad",
+        "stateful",
+    )
+
+    def __init__(self, type):
+        self.type = type
+        self.lower: Optional[Callable] = None
+        self.infer_shape: Optional[Callable] = None
+        self.grad_maker: Optional[Callable] = None
+        self.no_grad = False
+        self.stateful = False  # uses rng; grad must not replay
+
+
+def op(type: str, *, infer=None, no_grad: bool = False, stateful: bool = False):
+    """Decorator registering a forward lowering for ``type``."""
+
+    def deco(fn):
+        d = OPS.setdefault(type, OpDef(type))
+        d.lower = fn
+        d.infer_shape = infer
+        d.no_grad = no_grad
+        d.stateful = stateful
+        return fn
+
+    return deco
+
+
+def grad_maker(type: str):
+    """Decorator registering a custom grad-desc maker for ``type``."""
+
+    def deco(fn):
+        OPS.setdefault(type, OpDef(type)).grad_maker = fn
+        return fn
+
+    return deco
+
+
+def infer_for(type: str):
+    def deco(fn):
+        OPS.setdefault(type, OpDef(type)).infer_shape = fn
+        return fn
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    try:
+        return OPS[type]
+    except KeyError:
+        raise NotImplementedError(f"op {type!r} is not registered") from None
+
+
+def is_registered(type: str) -> bool:
+    return type in OPS
+
+
+# --------------------------------------------------------------------------
+# Lowering context
+# --------------------------------------------------------------------------
+class LowerCtx:
+    """What a lowering sees: slot values, attrs, rng, output binding."""
+
+    def __init__(self, op: Operator, env: Dict[str, Any], block=None):
+        self.op = op
+        self.env = env
+        self.block = block
+
+    # inputs ---------------------------------------------------------------
+    def ins(self, slot: str) -> List[Any]:
+        out = []
+        for n in self.op.inputs.get(slot, []):
+            if n == EMPTY_VAR_NAME:
+                out.append(None)
+            else:
+                v = self.env.get(n)
+                if v is None and n not in self.env:
+                    raise KeyError(
+                        f"op {self.op.type}: input var {n!r} (slot {slot}) "
+                        f"has no value — not initialized or not fed"
+                    )
+                out.append(v)
+        return out
+
+    def in_(self, slot: str):
+        vals = self.ins(slot)
+        return vals[0] if vals else None
+
+    def has_input(self, slot: str) -> bool:
+        ns = self.op.inputs.get(slot, [])
+        return bool(ns) and ns[0] != EMPTY_VAR_NAME
+
+    # outputs --------------------------------------------------------------
+    def out_names(self, slot: str) -> List[str]:
+        return self.op.outputs.get(slot, [])
+
+    def set_out(self, slot: str, *vals):
+        names = self.op.outputs.get(slot, [])
+        if len(vals) == 1 and isinstance(vals[0], (list, tuple)):
+            vals = tuple(vals[0])
+        for n, v in zip(names, vals):
+            if n != EMPTY_VAR_NAME:
+                self.env[n] = v
+
+    def has_output(self, slot: str) -> bool:
+        ns = self.op.outputs.get(slot, [])
+        return bool(ns) and ns[0] != EMPTY_VAR_NAME
+
+    # attrs ----------------------------------------------------------------
+    def attr(self, name: str, default=None):
+        return self.op.attrs.get(name, default)
+
+    # rng ------------------------------------------------------------------
+    RNG_VAR = "@RNG_KEY@"
+
+    def rng(self):
+        """Split a fresh key off the threaded program rng state."""
+        key = self.env.get(self.RNG_VAR)
+        if key is None:
+            key = jax.random.key(0)
+        key, sub = jax.random.split(key)
+        self.env[self.RNG_VAR] = key
+        return sub
+
+
+class _ReplayCtx:
+    """LowerCtx stand-in used for vjp replay / eval_shape: takes explicit
+    slot->values and captures outputs."""
+
+    def __init__(self, ins_vals: Dict[str, List[Any]], attrs: Dict[str, Any],
+                 out_arity: Dict[str, int], rng_key=None):
+        self._ins = ins_vals
+        self.attrs = attrs
+        self._out_arity = out_arity
+        self.outs: Dict[str, List[Any]] = {}
+        self._rng_key = rng_key
+        self.op = None
+        self.env = {}
+
+    def ins(self, slot):
+        return list(self._ins.get(slot, []))
+
+    def in_(self, slot):
+        vals = self._ins.get(slot, [])
+        return vals[0] if vals else None
+
+    def has_input(self, slot):
+        vals = self._ins.get(slot, [])
+        return bool(vals) and vals[0] is not None
+
+    def out_names(self, slot):
+        return ["_"] * self._out_arity.get(slot, 1)
+
+    def set_out(self, slot, *vals):
+        if len(vals) == 1 and isinstance(vals[0], (list, tuple)):
+            vals = tuple(vals[0])
+        self.outs[slot] = list(vals)
+
+    def has_output(self, slot):
+        return self._out_arity.get(slot, 0) > 0
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def rng(self):
+        if self._rng_key is None:
+            self._rng_key = jax.random.key(0)
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+
+# --------------------------------------------------------------------------
+# Shape inference
+# --------------------------------------------------------------------------
+def infer_shape(op: Operator, block: Block):
+    """Compile-time shape/dtype inference for ``op``'s outputs, run at
+    append_op time (the analog of OpDesc-level InferShape in the
+    reference, operator.h:442)."""
+    d = OPS.get(op.type)
+    if d is None:
+        return  # unknown ops (feed/fetch/custom) carry no inference
+    if op.type.endswith("_grad"):
+        _infer_grad_shapes(op, block)
+        return
+    if d.infer_shape is not None:
+        d.infer_shape(op, block)
+        return
+    if d.lower is None:
+        return
+    _generic_infer(op, block, d)
+
+
+def _var_struct(var):
+    shape = tuple(_SENTINEL_DIM if s == -1 else s for s in var.shape)
+    return jax.ShapeDtypeStruct(shape, to_numpy_dtype(var.dtype))
+
+
+def _generic_infer(op: Operator, block: Block, d: OpDef):
+    ins_structs = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n == EMPTY_VAR_NAME:
+                vals.append(None)
+            else:
+                v = block._find_var_recursive(n)
+                if v is None:
+                    return  # can't infer
+                vals.append(_var_struct(v))
+        ins_structs[slot] = vals
+    out_arity = {s: len(ns) for s, ns in op.outputs.items()}
+
+    def f(ins):
+        ctx = _ReplayCtx(ins, op.attrs, out_arity, rng_key=jax.random.key(0))
+        d.lower(ctx)
+        return ctx.outs
+
+    try:
+        outs = jax.eval_shape(f, ins_structs)
+    except Exception:
+        return  # leave output shapes as declared; executor re-traces anyway
+    for slot, vals in outs.items():
+        for n, v in zip(op.outputs.get(slot, []), vals):
+            if n == EMPTY_VAR_NAME or v is None:
+                continue
+            var = block._find_var_recursive(n)
+            if var is None:
+                continue
+            shape = tuple(-1 if s == _SENTINEL_DIM else s for s in v.shape)
+            var.shape = shape
+            var.dtype = convert_dtype(v.dtype)
+
+
+def _infer_grad_shapes(op: Operator, block: Block):
+    """Grad var shape == forward var shape; cheap, no tracing."""
+    for slot, names in op.outputs.items():
+        for n in names:
+            if n == EMPTY_VAR_NAME or not n.endswith(GRAD_SUFFIX):
+                continue
+            gvar = block._find_var_recursive(n)
+            fvar = block._find_var_recursive(n[: -len(GRAD_SUFFIX)])
+            if gvar is not None and fvar is not None:
+                gvar.shape = fvar.shape
+                gvar.dtype = fvar.dtype
+
+
+# --------------------------------------------------------------------------
+# Execution of one op against an env (used by executor trace & dygraph)
+# --------------------------------------------------------------------------
+def run_op(op: Operator, env: Dict[str, Any], block=None):
+    d = get_op_def(op.type)
+    if d.lower is None:
+        raise NotImplementedError(f"op {op.type!r} has no lowering")
+    ctx = LowerCtx(op, env, block)
+    d.lower(ctx)
+    return ctx
+
+
+# --------------------------------------------------------------------------
+# Grad machinery
+# --------------------------------------------------------------------------
+def has_grad(type: str) -> bool:
+    d = OPS.get(type)
+    if d is None:
+        return False
+    if d.no_grad:
+        return False
+    return True
+
+
+def make_grad_ops(op: Operator, no_grad_names=frozenset()) -> List[dict]:
+    """Return grad op descs (list of dicts with type/inputs/outputs/attrs).
+
+    Mirrors the reference's per-op GradOpMaker contract
+    (grad_op_desc_maker.h) so ``append_backward`` stays a program rewrite.
+    """
+    d = OPS.get(op.type)
+    if d is None or d.no_grad:
+        return []
+    if d.grad_maker is not None:
+        return d.grad_maker(op, no_grad_names)
+    return default_grad_maker(op, no_grad_names)
+
+
+def default_grad_maker(op: Operator, no_grad_names=frozenset()) -> List[dict]:
+    inputs: Dict[str, List[str]] = {s: list(ns) for s, ns in op.inputs.items()}
+    for slot, names in op.outputs.items():
+        inputs[slot] = list(names)  # forward outputs available to custom grads
+        inputs[slot + GRAD_SUFFIX] = [
+            n + GRAD_SUFFIX if n != EMPTY_VAR_NAME else EMPTY_VAR_NAME
+            for n in names
+        ]
+    outputs = {}
+    for slot, names in op.inputs.items():
+        outputs[slot + GRAD_SUFFIX] = [
+            (n + GRAD_SUFFIX) if n not in no_grad_names and n != EMPTY_VAR_NAME
+            else EMPTY_VAR_NAME
+            for n in names
+        ]
+    attrs = dict(op.attrs)
+    attrs["__fwd_out_slots__"] = {s: len(ns) for s, ns in op.outputs.items()}
+    attrs["__fwd_type__"] = op.type
+    return [
+        dict(type=op.type + "_grad", inputs=inputs, outputs=outputs, attrs=attrs)
+    ]
+
+
+def _is_diff_value(v) -> bool:
+    if v is None:
+        return False
+    try:
+        return jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+    except Exception:
+        return False
+
+
+def generic_grad_lower(ctx: LowerCtx):
+    """vjp-replay grad kernel shared by every ``*_grad`` op that has no
+    custom lowering (see module docstring)."""
+    gop = ctx.op
+    fwd_type = gop.attr("__fwd_type__") or gop.type[: -len("_grad")]
+    fdef = get_op_def(fwd_type)
+    out_arity: Dict[str, int] = dict(gop.attr("__fwd_out_slots__") or {})
+
+    # Collect forward input values (slots not ending in @GRAD and not a
+    # forward output slot).
+    fwd_in_slots = [
+        s
+        for s in gop.inputs
+        if not s.endswith(GRAD_SUFFIX) and s not in out_arity
+    ]
+    ins_vals = {s: ctx.ins(s) for s in fwd_in_slots}
+
+    # Partition into differentiable leaves and closed-over values.
+    spec = []
+    flat = []
+    for s in fwd_in_slots:
+        for i, v in enumerate(ins_vals[s]):
+            if _is_diff_value(v):
+                spec.append((s, i))
+                flat.append(v)
+
+    fwd_attrs = {k: v for k, v in gop.attrs.items() if not k.startswith("__")}
+    out_slot_order = sorted(out_arity)
+
+    def f(flat_vals):
+        merged = {s: list(vs) for s, vs in ins_vals.items()}
+        for (s, i), v in zip(spec, flat_vals):
+            merged[s][i] = v
+        rctx = _ReplayCtx(merged, fwd_attrs, out_arity)
+        fdef.lower(rctx)
+        outs = []
+        for slot in out_slot_order:
+            vals = rctx.outs.get(slot, [])
+            vals = list(vals) + [None] * (out_arity[slot] - len(vals))
+            outs.extend(vals)
+        return tuple(outs)
+
+    primal_outs, vjp_fn = jax.vjp(f, flat)
+
+    # Cotangents: grad-op inputs named "<slot>@GRAD"; missing -> zeros.
+    cots = []
+    k = 0
+    for slot in out_slot_order:
+        gvals = ctx.ins(slot + GRAD_SUFFIX) if (slot + GRAD_SUFFIX) in gop.inputs else []
+        for i in range(out_arity[slot]):
+            primal = primal_outs[k]
+            g = gvals[i] if i < len(gvals) else None
+            if g is None:
+                if primal is None:
+                    cots.append(None)
+                else:
+                    cots.append(jnp.zeros(jnp.shape(primal), jnp.result_type(primal)))
+            else:
+                g = jnp.asarray(g)
+                if primal is not None and g.dtype != jnp.result_type(primal):
+                    g = g.astype(jnp.result_type(primal))
+                cots.append(g)
+            k += 1
+    (grads,) = (vjp_fn(tuple(cots)),)
+    grads = grads[0]
+
+    # Bind grads to "<slot>@GRAD" outputs, aligned by spec.
+    by_slot: Dict[str, Dict[int, Any]] = {}
+    for (s, i), g in zip(spec, grads):
+        by_slot.setdefault(s, {})[i] = g
+    for s in fwd_in_slots:
+        gslot = s + GRAD_SUFFIX
+        names = gop.outputs.get(gslot, [])
+        if not names:
+            continue
+        vals = []
+        for i, n in enumerate(names):
+            vals.append(by_slot.get(s, {}).get(i))
+        for n, v in zip(names, vals):
+            if n != EMPTY_VAR_NAME and v is not None:
+                ctx.env[n] = v
+
+
+class _GenericGradDispatch:
+    """Every unregistered ``*_grad`` type resolves to the generic vjp grad."""
+
+
+def resolve(type: str) -> OpDef:
+    d = OPS.get(type)
+    if d is not None and d.lower is not None:
+        return d
+    if type.endswith("_grad"):
+        fwd = type[: -len("_grad")]
+        if fwd in OPS and OPS[fwd].lower is not None:
+            gd = OPS.setdefault(type, OpDef(type))
+            if gd.lower is None:
+                gd.lower = generic_grad_lower
+                gd.no_grad = True
+            return gd
+    raise NotImplementedError(f"op {type!r} is not registered")
+
+
+# make run_op/get_op_def use resolve so *_grad lazily materializes
+def get_op_def(type: str) -> OpDef:  # noqa: F811
+    return resolve(type)

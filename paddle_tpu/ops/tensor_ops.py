@@ -1,0 +1,568 @@
+"""Tensor creation / manipulation / random op lowerings.
+
+Capability parity with the reference's tensor ops (reference:
+paddle/fluid/operators/fill_constant_op.cc, gaussian_random_op.cc,
+uniform_random_op.cc, reshape_op.cc, transpose_op.cc, concat_op.cc,
+split_op.cc, gather_op.cc, slice_op.cc, cast_op.cc, assign_op.cc, ...).
+Random ops draw from the program-threaded JAX PRNG key (functional,
+reproducible under jit) instead of cuRAND generators.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import op, infer_for
+from ..framework.dtype import VarType, to_numpy_dtype, convert_dtype
+
+
+def _attr_dtype(ctx, default=VarType.FP32):
+    d = ctx.attr("dtype", int(default))
+    if isinstance(d, str):
+        return to_numpy_dtype(d)
+    return to_numpy_dtype(VarType(int(d)))
+
+
+def _shape_attr(ctx):
+    if ctx.has_input("ShapeTensor"):
+        raise NotImplementedError("dynamic ShapeTensor under jit")
+    return [int(s) for s in ctx.attr("shape", [])]
+
+
+# -- creation --------------------------------------------------------------
+@op("fill_constant", no_grad=True)
+def _fill_constant(ctx):
+    dt = _attr_dtype(ctx)
+    val = ctx.attr("value", 0.0)
+    if ctx.has_input("ValueTensor"):
+        val = ctx.in_("ValueTensor")
+    shape = _shape_attr(ctx)
+    ctx.set_out("Out", jnp.full(shape, val, dtype=dt))
+
+
+@op("fill_any_like", no_grad=True)
+def _fill_any_like(ctx):
+    x = ctx.in_("X")
+    d = ctx.attr("dtype", -1)
+    dt = jnp.result_type(x) if d in (-1, None) else to_numpy_dtype(VarType(int(d)))
+    ctx.set_out("Out", jnp.full(jnp.shape(x), ctx.attr("value", 0.0), dtype=dt))
+
+
+@op("fill_zeros_like", no_grad=True)
+def _fill_zeros_like(ctx):
+    x = ctx.in_("X")
+    ctx.set_out("Out", jnp.zeros_like(x))
+
+
+@op("fill_constant_batch_size_like", no_grad=True)
+def _fill_cbsl(ctx):
+    x = ctx.in_("Input")
+    shape = list(ctx.attr("shape", []))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = jnp.shape(x)[in_idx]
+    ctx.set_out("Out", jnp.full(shape, ctx.attr("value", 0.0), dtype=_attr_dtype(ctx)))
+
+
+@op("gaussian_random", no_grad=True, stateful=True)
+def _gaussian_random(ctx):
+    dt = _attr_dtype(ctx)
+    seed = ctx.attr("seed", 0)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    out = ctx.attr("mean", 0.0) + ctx.attr("std", 1.0) * jax.random.normal(
+        key, _shape_attr(ctx), dtype=jnp.float32
+    )
+    ctx.set_out("Out", out.astype(dt))
+
+
+@op("uniform_random", no_grad=True, stateful=True)
+def _uniform_random(ctx):
+    dt = _attr_dtype(ctx)
+    seed = ctx.attr("seed", 0)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    out = jax.random.uniform(
+        key,
+        _shape_attr(ctx),
+        dtype=jnp.float32,
+        minval=ctx.attr("min", -1.0),
+        maxval=ctx.attr("max", 1.0),
+    )
+    ctx.set_out("Out", out.astype(dt))
+
+
+@op("uniform_random_batch_size_like", no_grad=True, stateful=True)
+def _uniform_random_bsl(ctx):
+    x = ctx.in_("Input")
+    shape = list(ctx.attr("shape", []))
+    shape[ctx.attr("output_dim_idx", 0)] = jnp.shape(x)[ctx.attr("input_dim_idx", 0)]
+    seed = ctx.attr("seed", 0)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    out = jax.random.uniform(
+        key, shape, dtype=jnp.float32,
+        minval=ctx.attr("min", -1.0), maxval=ctx.attr("max", 1.0),
+    )
+    ctx.set_out("Out", out.astype(_attr_dtype(ctx)))
+
+
+@op("truncated_gaussian_random", no_grad=True, stateful=True)
+def _truncated_gaussian_random(ctx):
+    dt = _attr_dtype(ctx)
+    seed = ctx.attr("seed", 0)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    out = ctx.attr("mean", 0.0) + ctx.attr("std", 1.0) * jax.random.truncated_normal(
+        key, -2.0, 2.0, _shape_attr(ctx), dtype=jnp.float32
+    )
+    ctx.set_out("Out", out.astype(dt))
+
+
+@op("randint", no_grad=True, stateful=True)
+def _randint(ctx):
+    seed = ctx.attr("seed", 0)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    out = jax.random.randint(
+        key, _shape_attr(ctx), ctx.attr("low", 0), ctx.attr("high", 100)
+    )
+    ctx.set_out("Out", out.astype(_attr_dtype(ctx, VarType.INT64)))
+
+
+@op("randperm", no_grad=True, stateful=True)
+def _randperm(ctx):
+    n = ctx.attr("n", 1)
+    seed = ctx.attr("seed", 0)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    ctx.set_out("Out", jax.random.permutation(key, n).astype(_attr_dtype(ctx, VarType.INT64)))
+
+
+@op("range", no_grad=True)
+def _range(ctx):
+    start, end, step = ctx.in_("Start"), ctx.in_("End"), ctx.in_("Step")
+    start = float(np.asarray(start)) if not isinstance(start, (int, float)) else start
+    end = float(np.asarray(end)) if not isinstance(end, (int, float)) else end
+    step = float(np.asarray(step)) if not isinstance(step, (int, float)) else step
+    ctx.set_out("Out", jnp.arange(start, end, step))
+
+
+@op("linspace", no_grad=True)
+def _linspace(ctx):
+    s = float(np.asarray(ctx.in_("Start")))
+    e = float(np.asarray(ctx.in_("Stop")))
+    n = int(np.asarray(ctx.in_("Num")))
+    ctx.set_out("Out", jnp.linspace(s, e, n, dtype=_attr_dtype(ctx)))
+
+
+@op("eye", no_grad=True)
+def _eye(ctx):
+    ctx.set_out(
+        "Out",
+        jnp.eye(ctx.attr("num_rows", 1), ctx.attr("num_columns", None), dtype=_attr_dtype(ctx)),
+    )
+
+
+@op("assign")
+def _assign(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
+@op("assign_value", no_grad=True)
+def _assign_value(ctx):
+    shape = ctx.attr("shape", [])
+    dt = _attr_dtype(ctx)
+    for key in ("fp32_values", "int32_values", "int64_values", "bool_values"):
+        vals = ctx.attr(key)
+        if vals:
+            ctx.set_out("Out", jnp.asarray(np.array(vals).reshape(shape), dtype=dt))
+            return
+    ctx.set_out("Out", jnp.zeros(shape, dt))
+
+
+@op("shape", no_grad=True)
+def _shape(ctx):
+    x = ctx.in_("Input")
+    ctx.set_out("Out", jnp.asarray(jnp.shape(x), dtype=jnp.int32))
+
+
+@op("size", no_grad=True)
+def _size(ctx):
+    ctx.set_out("Out", jnp.asarray(jnp.size(ctx.in_("Input")), dtype=jnp.int64))
+
+
+@op("cast")
+def _cast(ctx):
+    dt = to_numpy_dtype(VarType(int(ctx.attr("out_dtype", int(VarType.FP32)))))
+    ctx.set_out("Out", ctx.in_("X").astype(dt))
+
+
+# -- shape manipulation ----------------------------------------------------
+def _resolve_shape(target, in_shape):
+    """Paddle reshape semantics: 0 copies input dim, one -1 inferred."""
+    import math
+
+    target = list(target)
+    for i, s in enumerate(target):
+        if s == 0:
+            target[i] = in_shape[i]
+    if -1 in target:
+        known = math.prod(s for s in target if s != -1)
+        total = math.prod(in_shape)
+        target[target.index(-1)] = total // known if known else -1
+    return target
+
+
+@op("reshape2")
+def _reshape2(ctx):
+    x = ctx.in_("X")
+    if ctx.has_input("Shape"):
+        raise NotImplementedError("reshape2 with Shape tensor input under jit")
+    shape = _resolve_shape(ctx.attr("shape", []), jnp.shape(x))
+    ctx.set_out("Out", jnp.reshape(x, shape))
+    if ctx.has_output("XShape"):
+        ctx.set_out("XShape", jnp.zeros((0,), jnp.result_type(x)))
+
+
+op("reshape")(lambda ctx: _reshape2(ctx))
+
+
+@infer_for("reshape2")
+def _reshape2_infer(op_, block):
+    x = block._find_var_recursive(op_.input("X")[0])
+    target = list(op_.attr("shape", []))
+    out_shape = []
+    for i, s in enumerate(target):
+        if s == 0:
+            out_shape.append(x.shape[i] if i < len(x.shape) else -1)
+        else:
+            out_shape.append(s)
+    if -1 in out_shape and -1 not in x.shape:
+        import math
+
+        known = math.prod(s for s in out_shape if s != -1)
+        total = math.prod(x.shape) if x.shape else 0
+        if known > 0 and total > 0:
+            out_shape[out_shape.index(-1)] = total // known
+    out = block._find_var_recursive(op_.output("Out")[0])
+    out.shape = tuple(out_shape)
+    out.dtype = x.dtype
+
+
+OPS_INFER_RESHAPE = _reshape2_infer
+infer_for("reshape")(_reshape2_infer)
+
+
+@op("transpose2")
+def _transpose2(ctx):
+    x = ctx.in_("X")
+    ctx.set_out("Out", jnp.transpose(x, ctx.attr("axis", None)))
+    if ctx.has_output("XShape"):
+        ctx.set_out("XShape", jnp.zeros((0,), jnp.result_type(x)))
+
+
+op("transpose")(lambda ctx: _transpose2(ctx))
+
+
+def _sq_axes(ctx, x):
+    axes = ctx.attr("axes", [])
+    if not axes:
+        return tuple(i for i, s in enumerate(jnp.shape(x)) if s == 1)
+    return tuple(a % jnp.ndim(x) for a in axes)
+
+
+@op("squeeze2")
+def _squeeze2(ctx):
+    x = ctx.in_("X")
+    axes = tuple(a for a in _sq_axes(ctx, x) if jnp.shape(x)[a] == 1)
+    ctx.set_out("Out", jnp.squeeze(x, axes))
+    if ctx.has_output("XShape"):
+        ctx.set_out("XShape", jnp.zeros((0,), jnp.result_type(x)))
+
+
+op("squeeze")(lambda ctx: _squeeze2(ctx))
+
+
+@op("unsqueeze2")
+def _unsqueeze2(ctx):
+    x = ctx.in_("X")
+    out = x
+    for a in sorted(ctx.attr("axes", [])):
+        out = jnp.expand_dims(out, a)
+    ctx.set_out("Out", out)
+    if ctx.has_output("XShape"):
+        ctx.set_out("XShape", jnp.zeros((0,), jnp.result_type(x)))
+
+
+op("unsqueeze")(lambda ctx: _unsqueeze2(ctx))
+
+
+@op("flatten2")
+def _flatten2(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", 1)
+    shape = jnp.shape(x)
+    import math
+
+    ctx.set_out(
+        "Out",
+        jnp.reshape(x, (math.prod(shape[:axis]) if axis else 1, -1)),
+    )
+    if ctx.has_output("XShape"):
+        ctx.set_out("XShape", jnp.zeros((0,), jnp.result_type(x)))
+
+
+op("flatten")(lambda ctx: _flatten2(ctx))
+
+
+@op("flatten_contiguous_range")
+def _flatten_range(ctx):
+    x = ctx.in_("X")
+    start = ctx.attr("start_axis", 1)
+    stop = ctx.attr("stop_axis", -1)
+    shape = list(jnp.shape(x))
+    nd = len(shape)
+    start, stop = start % nd, stop % nd
+    import math
+
+    new_shape = shape[:start] + [math.prod(shape[start : stop + 1])] + shape[stop + 1 :]
+    ctx.set_out("Out", jnp.reshape(x, new_shape))
+    if ctx.has_output("XShape"):
+        ctx.set_out("XShape", jnp.zeros((0,), jnp.result_type(x)))
+
+
+@op("concat")
+def _concat(ctx):
+    xs = [v for v in ctx.ins("X") if v is not None]
+    axis = ctx.attr("axis", 0)
+    if ctx.has_input("AxisTensor"):
+        axis = int(np.asarray(ctx.in_("AxisTensor")))
+    ctx.set_out("Out", jnp.concatenate(xs, axis=axis))
+
+
+@op("split")
+def _split(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", 0)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    ctx.set_out("Out", outs)
+
+
+@op("stack")
+def _stack(ctx):
+    xs = [v for v in ctx.ins("X") if v is not None]
+    ctx.set_out("Y", jnp.stack(xs, axis=ctx.attr("axis", 0)))
+
+
+@op("unstack")
+def _unstack(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", 0)
+    n = jnp.shape(x)[axis]
+    outs = [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]
+    ctx.set_out("Y", outs)
+
+
+@op("slice")
+def _slice(ctx):
+    x = ctx.in_("Input")
+    axes = ctx.attr("axes", [])
+    starts = ctx.attr("starts", [])
+    ends = ctx.attr("ends", [])
+    decrease = ctx.attr("decrease_axis", [])
+    idx = [slice(None)] * jnp.ndim(x)
+    for a, s, e in zip(axes, starts, ends):
+        dim = jnp.shape(x)[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    if decrease:
+        out = jnp.squeeze(out, tuple(decrease))
+    ctx.set_out("Out", out)
+
+
+@op("strided_slice")
+def _strided_slice(ctx):
+    x = ctx.in_("Input")
+    axes = ctx.attr("axes", [])
+    starts = ctx.attr("starts", [])
+    ends = ctx.attr("ends", [])
+    strides = ctx.attr("strides", [])
+    idx = [slice(None)] * jnp.ndim(x)
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    ctx.set_out("Out", x[tuple(idx)])
+
+
+@op("gather")
+def _gather(ctx):
+    x, index = ctx.in_("X"), ctx.in_("Index")
+    axis = ctx.attr("axis", 0)
+    if ctx.has_input("Axis"):
+        axis = int(np.asarray(ctx.in_("Axis")))
+    ctx.set_out("Out", jnp.take(x, index.astype(jnp.int32), axis=axis))
+
+
+@op("gather_nd")
+def _gather_nd(ctx):
+    x, index = ctx.in_("X"), ctx.in_("Index")
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    ctx.set_out("Out", x[idx])
+
+
+@op("scatter")
+def _scatter(ctx):
+    x, ids, updates = ctx.in_("X"), ctx.in_("Ids"), ctx.in_("Updates")
+    ids = ids.astype(jnp.int32)
+    if jnp.ndim(ids) == 2 and jnp.shape(ids)[1] == 1:
+        ids = jnp.squeeze(ids, 1)
+    if ctx.attr("overwrite", True):
+        out = x.at[ids].set(updates)
+    else:
+        out = x.at[ids].add(updates)
+    ctx.set_out("Out", out)
+
+
+@op("scatter_nd_add")
+def _scatter_nd_add(ctx):
+    x, index, updates = ctx.in_("X"), ctx.in_("Index"), ctx.in_("Updates")
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    ctx.set_out("Out", x.at[idx].add(updates))
+
+
+@op("index_select")
+def _index_select(ctx):
+    x, index = ctx.in_("X"), ctx.in_("Index")
+    ctx.set_out("Out", jnp.take(x, index.astype(jnp.int32), axis=ctx.attr("dim", 0)))
+
+
+@op("index_sample")
+def _index_sample(ctx):
+    x, index = ctx.in_("X"), ctx.in_("Index")
+    ctx.set_out("Out", jnp.take_along_axis(x, index.astype(jnp.int32), axis=1))
+
+
+@op("expand")
+def _expand(ctx):
+    x = ctx.in_("X")
+    times = ctx.attr("expand_times", [])
+    ctx.set_out("Out", jnp.tile(x, times))
+
+
+@op("expand_as")
+def _expand_as(ctx):
+    x, y = ctx.in_("X"), ctx.in_("target_tensor") or ctx.in_("Y")
+    reps = [t // s for s, t in zip(jnp.shape(x), jnp.shape(y))]
+    ctx.set_out("Out", jnp.tile(x, reps))
+
+
+@op("expand_v2")
+def _expand_v2(ctx):
+    x = ctx.in_("X")
+    shape = list(ctx.attr("shape", []))
+    xs = jnp.shape(x)
+    offset = len(shape) - len(xs)
+    final = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            final.append(xs[i - offset] if i >= offset else 1)
+        else:
+            final.append(s)
+    ctx.set_out("Out", jnp.broadcast_to(x, final))
+
+
+@op("tile")
+def _tile(ctx):
+    ctx.set_out("Out", jnp.tile(ctx.in_("X"), ctx.attr("repeat_times", [1])))
+
+
+@op("flip")
+def _flip(ctx):
+    ctx.set_out("Out", jnp.flip(ctx.in_("X"), tuple(ctx.attr("axis", [0]))))
+
+
+@op("roll")
+def _roll(ctx):
+    shifts = ctx.attr("shifts", [0])
+    axis = ctx.attr("axis", None)
+    ctx.set_out(
+        "Out",
+        jnp.roll(ctx.in_("X"), shifts if len(shifts) > 1 else shifts[0],
+                 axis=tuple(axis) if axis else None),
+    )
+
+
+@op("where")
+def _where(ctx):
+    ctx.set_out("Out", jnp.where(ctx.in_("Condition"), ctx.in_("X"), ctx.in_("Y")))
+
+
+@op("where_index", no_grad=True)
+def _where_index(ctx):
+    raise NotImplementedError("where_index has data-dependent shape; use masks under jit")
+
+
+@op("masked_select", no_grad=True)
+def _masked_select(ctx):
+    raise NotImplementedError("masked_select has data-dependent shape; use masks under jit")
+
+
+@op("tril_triu")
+def _tril_triu(ctx):
+    x = ctx.in_("X")
+    diag = ctx.attr("diagonal", 0)
+    if ctx.attr("lower", True):
+        ctx.set_out("Out", jnp.tril(x, diag))
+    else:
+        ctx.set_out("Out", jnp.triu(x, diag))
+
+
+@op("diag_v2", no_grad=True)
+def _diag_v2(ctx):
+    x = ctx.in_("X")
+    ctx.set_out("Out", jnp.diag(x, ctx.attr("offset", 0)))
+
+
+@op("unique", no_grad=True)
+def _unique(ctx):
+    raise NotImplementedError("unique has data-dependent shape under jit")
+
+
+@op("meshgrid")
+def _meshgrid(ctx):
+    xs = ctx.ins("X")
+    outs = jnp.meshgrid(*xs, indexing="ij")
+    ctx.set_out("Out", outs)
+
+
+@op("broadcast_tensors")
+def _broadcast_tensors(ctx):
+    xs = ctx.ins("X")
+    shape = jnp.broadcast_shapes(*[jnp.shape(x) for x in xs])
+    ctx.set_out("Out", [jnp.broadcast_to(x, shape) for x in xs])
+
+
+@op("lod_reset")
+def _lod_reset(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
+@op("share_data")
+def _share_data(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
+@op("memcpy")
+def _memcpy(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
+@op("print", no_grad=True)
+def _print(ctx):
+    x = ctx.in_("In")
+    jax.debug.print(ctx.attr("message", "") + " {}", x)
+    ctx.set_out("Out", x)

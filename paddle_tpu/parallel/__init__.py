@@ -1,0 +1,2 @@
+from . import compiled_program
+from .compiled_program import CompiledProgram, BuildStrategy, ExecutionStrategy

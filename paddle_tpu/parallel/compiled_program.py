@@ -1,0 +1,89 @@
+"""CompiledProgram: data-parallel execution over a device mesh.
+
+Replaces the reference's ParallelExecutor + multi-devices SSA graph
+(reference: paddle/fluid/framework/parallel_executor.cc:443,
+python/paddle/fluid/compiler.py:87 CompiledProgram) with pjit-style SPMD:
+instead of cloning the graph per device and inserting NCCL allreduce op
+handles, the same traced program is compiled once with batch-sharded
+inputs and replicated parameters over a ``jax.sharding.Mesh``; XLA inserts
+the ICI collectives (the `psum` that replaces AllReduceOpHandle).
+
+Full implementation lands with the SPMD phase; this module defines the
+API surface so the Executor can dispatch on it.
+"""
+from __future__ import annotations
+
+
+class BuildStrategy:
+    """reference: framework/details/build_strategy.h:37 — strategy knobs.
+    Most are no-ops under XLA (fusion is automatic); kept for API parity."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = None
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """reference: pybind.cc:1821 ExecutionStrategy."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """reference: compiler.py:87."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = None
+        self._data_parallel = False
+        self._loss_name = None
+        self._share_vars_from = None
+        self._places = None
+
+    def with_data_parallel(
+        self,
+        loss_name=None,
+        build_strategy=None,
+        exec_strategy=None,
+        share_vars_from=None,
+        places=None,
+    ):
+        self._data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._share_vars_from = share_vars_from
+        self._places = places
+        return self
+
+    # Executor dispatches here (executor.py Executor.run)
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        from .data_parallel import run_data_parallel
+
+        return run_data_parallel(
+            self, executor, feed, fetch_list, scope, return_numpy
+        )
