@@ -1,0 +1,71 @@
+"""Benchmark entry: prints ONE JSON line for the driver.
+
+Current flagship benchmark: static-graph LeNet MNIST training throughput
+(BASELINE.json config #1).  Upgrades to ResNet-50 / ERNIE as those model
+phases land.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_lenet(batch=256, steps=30, warmup=5):
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        conv1 = fluid.layers.conv2d(img, 6, 5, padding=2, act="relu")
+        pool1 = fluid.layers.pool2d(conv1, 2, pool_stride=2)
+        conv2 = fluid.layers.conv2d(pool1, 16, 5, act="relu")
+        pool2 = fluid.layers.pool2d(conv2, 2, pool_stride=2)
+        fc1 = fluid.layers.fc(pool2, 120, act="relu")
+        fc2 = fluid.layers.fc(fc1, 84, act="relu")
+        logits = fluid.layers.fc(fc2, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label)
+        )
+        opt = fluid.optimizer.MomentumOptimizer(0.01, 0.9)
+        opt.minimize(loss)
+
+    place = pt.TPUPlace(0) if pt.is_compiled_with_tpu() else pt.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": rng.rand(batch, 1, 28, 28).astype(np.float32),
+        "label": rng.randint(0, 10, (batch, 1)).astype(np.int64),
+    }
+    for _ in range(warmup):
+        exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(steps):
+        # return_numpy=False keeps dispatch async (no per-step host sync)
+        out = exe.run(main, feed=feed, fetch_list=[loss.name],
+                      return_numpy=False)
+    np.asarray(out[0].value())  # sync once at the end
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    ips = bench_lenet()
+    print(json.dumps({
+        "metric": "lenet_mnist_train_throughput",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,22 @@ Two API surfaces, mirroring the reference:
   (reference: python/paddle/fluid/).
 * top-level 2.0-preview style aliases (reference: python/paddle/).
 """
+import os as _os
+
+# Persistent XLA compilation cache: compiles through the TPU tunnel are
+# expensive (~30s+ per conv-grad subgraph); cache them across processes.
+try:  # pragma: no cover
+    import jax as _jax
+
+    _cache_dir = _os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", "/root/.cache/paddle_tpu_xla"
+    )
+    _os.makedirs(_cache_dir, exist_ok=True)
+    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 from . import framework
 from .framework import (
     CPUPlace,

@@ -1,0 +1,7 @@
+"""Dygraph (imperative) mode — reference: paddle/fluid/imperative + fluid/dygraph.
+
+Full implementation lands with the dygraph phase; base hooks are defined so
+static-mode modules can import unconditionally.
+"""
+from . import base
+from .base import guard, enabled, to_variable, no_grad

@@ -1,0 +1,85 @@
+"""Dygraph base: tracer hooks used across the framework.
+
+Reference: paddle/fluid/imperative/tracer.cc:45 + fluid/dygraph/base.py.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework.core import _current_tracer, _set_dygraph_tracer, in_dygraph_mode
+
+
+def enabled():
+    return in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    from .tracer import Tracer
+
+    tracer = Tracer(place)
+    _set_dygraph_tracer(tracer)
+    try:
+        yield
+    finally:
+        _set_dygraph_tracer(None)
+
+
+def enable_dygraph(place=None):
+    from .tracer import Tracer
+
+    _set_dygraph_tracer(Tracer(place))
+
+
+def disable_dygraph():
+    _set_dygraph_tracer(None)
+
+
+def to_variable(value, name=None, zero_copy=None):
+    from .varbase import VarBase
+
+    return VarBase(value, name=name)
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    tracer = _current_tracer()
+    if tracer is None:
+        yield
+        return
+    prev = tracer._has_grad
+    tracer._has_grad = False
+    try:
+        yield
+    finally:
+        tracer._has_grad = prev
+
+
+def no_grad(fn=None):
+    if fn is None:
+        return no_grad_ctx()
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with no_grad_ctx():
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def _dygraph_minimize(optimizer, loss, parameter_list=None):
+    """Apply optimizer update eagerly to traced parameters."""
+    from .varbase import VarBase
+
+    params = parameter_list or optimizer._parameter_list or []
+    params_grads = [(p, p._grad_value) for p in params
+                    if getattr(p, "_grad_value", None) is not None]
+    optimizer._dygraph_apply(params_grads)
+    return None, params_grads
+
+
+def _clear_grads(params):
+    for p in params or []:
+        if hasattr(p, "clear_gradient"):
+            p.clear_gradient()

@@ -39,13 +39,16 @@ RNG_VAR = registry.LowerCtx.RNG_VAR
 
 
 class _Compiled:
-    __slots__ = ("fn", "state_in", "state_out", "fetch_names")
+    __slots__ = ("fn", "raw_fn", "state_in", "state_out", "fetch_names",
+                 "donatable")
 
     def __init__(self, fn, state_in, state_out, fetch_names):
         self.fn = fn
+        self.raw_fn = None
         self.state_in = state_in
         self.state_out = state_out
         self.fetch_names = fetch_names
+        self.donatable = ()
 
 
 def _fetch_name(f) -> str:
@@ -166,6 +169,8 @@ class Executor:
 
         jitted = jax.jit(fn, donate_argnums=(0,))
         compiled = _Compiled(jitted, state_in, state_out, fetch)
+        compiled.raw_fn = fn
+        compiled.donatable = tuple(donatable)
         compiled_donatable = set(donatable)
 
         def call(feed_vals, state_vals):
@@ -220,11 +225,8 @@ class Executor:
         if fetch_names:
             if return_numpy:
                 return [as_numpy(v) for v in fetched]
-            out = []
-            for v in fetched:
-                t = LoDTensor(np.asarray(v))
-                out.append(t)
-            return out
+            # keep device arrays lazy — no host sync until .numpy()
+            return [LoDTensor(v) for v in fetched]
         return None
 
     # ------------------------------------------------------------------

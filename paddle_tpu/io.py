@@ -1,0 +1,202 @@
+"""Checkpoint / model save-load.
+
+Reference: python/paddle/fluid/io.py (save/load_vars:224/373,
+save/load_params:598, save/load_persistables, save/load_inference_model
+:1093/:1303, unified save/load :1598/:1662).  Storage is
+host-side numpy (.npz per group or one file per var) + the Program's JSON
+desc for inference models; sharded orbax-style checkpoints come with the
+distributed phase.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .executor import as_numpy
+from .framework.core import Parameter, Program, Variable, default_main_program
+from .framework.dtype import to_numpy_dtype
+from .framework.scope import global_scope
+
+__all__ = [
+    "save_vars", "load_vars", "save_params", "load_params",
+    "save_persistables", "load_persistables", "save_inference_model",
+    "load_inference_model", "save", "load", "get_program_persistable_vars",
+]
+
+
+def _is_persistable(var: Variable) -> bool:
+    return bool(var.persistable) and var.type not in ()
+
+
+def _is_parameter(var: Variable) -> bool:
+    return isinstance(var, Parameter)
+
+
+def get_program_persistable_vars(program: Program) -> List[Variable]:
+    return [v for v in program.list_vars() if _is_persistable(v)]
+
+
+def _gather(executor, program, predicate, vars=None):
+    if vars is None:
+        vars = [v for v in program.list_vars() if predicate(v)]
+    scope = global_scope()
+    out = {}
+    for v in vars:
+        val = scope.get(v.name)
+        if val is None:
+            raise RuntimeError(f"var {v.name!r} has no value in scope")
+        out[v.name] = as_numpy(val)
+    return out
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    """reference: io.py:224."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        predicate = predicate or _is_persistable
+        vars = [v for v in main_program.list_vars() if predicate(v)]
+    data = _gather(executor, main_program, lambda v: True, vars)
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename), **data)
+    else:
+        for name, arr in data.items():
+            np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"), arr)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    """reference: io.py:373."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        predicate = predicate or _is_persistable
+        vars = [v for v in main_program.list_vars() if predicate(v)]
+    scope = global_scope()
+    if filename is not None:
+        path = os.path.join(dirname, filename)
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        with np.load(path, allow_pickle=False) as z:
+            for v in vars:
+                if v.name in z:
+                    scope.set(v.name, np.asarray(z[v.name]))
+    else:
+        for v in vars:
+            path = os.path.join(dirname, v.name.replace("/", "__") + ".npy")
+            if os.path.exists(path):
+                scope.set(v.name, np.load(path))
+            else:
+                raise RuntimeError(f"checkpoint file missing for var {v.name!r}: {path}")
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program, predicate=_is_parameter,
+                     filename=filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, predicate=_is_parameter,
+                     filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program, predicate=_is_persistable,
+                     filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, predicate=_is_persistable,
+                     filename=filename)
+
+
+# -- unified fluid.save / fluid.load (reference: io.py:1598/:1662) ---------
+def save(program: Program, model_path: str):
+    params = {v.name: as_numpy(global_scope().get(v.name))
+              for v in program.list_vars()
+              if _is_parameter(v) and global_scope().has(v.name)}
+    others = {v.name: as_numpy(global_scope().get(v.name))
+              for v in program.list_vars()
+              if _is_persistable(v) and not _is_parameter(v)
+              and global_scope().has(v.name)}
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    np.savez(model_path + ".pdparams.npz", **params)
+    np.savez(model_path + ".pdopt.npz", **others)
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(program.serialize_to_string())
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    scope = global_scope()
+    for suffix in (".pdparams.npz", ".pdopt.npz"):
+        path = model_path + suffix
+        if os.path.exists(path):
+            with np.load(path, allow_pickle=False) as z:
+                for name in z.files:
+                    scope.set(name, np.asarray(z[name]))
+
+
+# -- inference model export (reference: io.py:1093/:1303) ------------------
+def _prune_for_inference(program: Program, feed_names, fetch_names) -> Program:
+    """Backward DCE from fetches; drops optimizer/backward/feed-unrelated ops."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op_ in reversed(block.ops):
+        if any(n in needed for n in op_.output_arg_names):
+            keep.append(op_)
+            needed.update(n for n in op_.input_arg_names if n != "@EMPTY@")
+    keep.reverse()
+    block.ops = keep
+    return pruned
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+    export_for_deployment=True,
+    program_only=False,
+):
+    """reference: io.py:1093."""
+    main_program = main_program or default_main_program()
+    fetch_names = [v.name if isinstance(v, Variable) else v for v in target_vars]
+    pruned = _prune_for_inference(main_program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    model_filename = model_filename or "__model__"
+    meta = {
+        "program": json.loads(pruned.serialize_to_string().decode("utf-8")),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": fetch_names,
+    }
+    with open(os.path.join(dirname, model_filename), "w") as f:
+        json.dump(meta, f)
+    if not program_only:
+        save_params(executor, dirname, main_program, filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(
+    dirname,
+    executor,
+    model_filename=None,
+    params_filename=None,
+):
+    """reference: io.py:1303 — returns (program, feed_names, fetch_vars)."""
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename)) as f:
+        meta = json.load(f)
+    program = Program.from_desc_dict(meta["program"])
+    load_vars(executor, dirname, program, predicate=_is_parameter,
+              filename=params_filename)
+    block = program.global_block()
+    fetch_vars = [block.var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars

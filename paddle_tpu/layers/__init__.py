@@ -1,0 +1,57 @@
+"""fluid.layers namespace (reference: python/paddle/fluid/layers/)."""
+from __future__ import annotations
+
+from ..framework.core import (
+    Variable,
+    default_main_program,
+    in_dygraph_mode,
+)
+from ..framework.dtype import VarType, convert_dtype
+from . import nn
+from . import tensor
+from .nn import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401
+    assign,
+    create_global_var,
+    create_parameter,
+    create_tensor,
+    diag,
+    eye,
+    fill_constant,
+    fill_constant_batch_size_like,
+    linspace,
+    ones,
+    ones_like,
+    reverse,
+    sums,
+    zeros,
+    zeros_like,
+)
+from .tensor import range as range_  # 'range' shadows builtin; both exported
+from .math_op_patch import monkey_patch_variable
+
+monkey_patch_variable()
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         type=VarType.LOD_TENSOR, stop_gradient=True):
+    """reference: python/paddle/fluid/data_feeder / layers/io.py data.
+
+    With append_batch_size=True (fluid.layers.data behavior) a leading -1
+    batch dim is prepended; fluid.data passes shape verbatim.
+    """
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().global_block()
+    if block.has_var(name):
+        return block.var(name)
+    return block.create_var(
+        name=name,
+        shape=shape,
+        dtype=convert_dtype(dtype),
+        lod_level=lod_level,
+        is_data=True,
+        stop_gradient=stop_gradient,
+        need_check_feed=True,
+    )

@@ -1,0 +1,87 @@
+"""Monkey-patch Variable with python operators.
+
+Reference: python/paddle/fluid/layers/math_op_patch.py:58 monkey_patch_variable.
+"""
+from __future__ import annotations
+
+from ..framework.core import Variable
+from ..framework.dtype import VarType, is_float
+from ..layer_helper import LayerHelper
+
+
+def _create_op(op_type, x, y=None, axis=-1, reverse=False):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if y is None:
+        helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+    else:
+        a, b = (y, x) if reverse else (x, y)
+        helper.append_op(op_type, inputs={"X": [a], "Y": [b]},
+                         outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def _scalar_op(x, scale, bias):
+    helper = LayerHelper("scale")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"scale": float(scale), "bias": float(bias)})
+    return out
+
+
+def _to_var(x, ref: Variable):
+    """Promote python scalar to a filled-constant var broadcastable to ref."""
+    from . import tensor as tensor_layers
+
+    return tensor_layers.fill_constant([1], ref.dtype, float(x))
+
+
+def _binary(op_type, reverse=False, scalar_fn=None):
+    def impl(self, other):
+        if isinstance(other, (int, float)):
+            if scalar_fn is not None:
+                return scalar_fn(self, other)
+            other = _to_var(other, self)
+        elif not isinstance(other, Variable):
+            return NotImplemented
+        return _create_op(op_type, self, other, reverse=reverse)
+
+    return impl
+
+
+def monkey_patch_variable():
+    Variable.__add__ = _binary("elementwise_add",
+                               scalar_fn=lambda x, s: _scalar_op(x, 1.0, s))
+    Variable.__radd__ = Variable.__add__
+    Variable.__sub__ = _binary("elementwise_sub",
+                               scalar_fn=lambda x, s: _scalar_op(x, 1.0, -s))
+    Variable.__rsub__ = _binary("elementwise_sub", reverse=True,
+                                scalar_fn=lambda x, s: _scalar_op(x, -1.0, s))
+    Variable.__mul__ = _binary("elementwise_mul",
+                               scalar_fn=lambda x, s: _scalar_op(x, s, 0.0))
+    Variable.__rmul__ = Variable.__mul__
+    Variable.__truediv__ = _binary(
+        "elementwise_div", scalar_fn=lambda x, s: _scalar_op(x, 1.0 / s, 0.0)
+    )
+    Variable.__rtruediv__ = _binary("elementwise_div", reverse=True)
+    Variable.__pow__ = _binary("elementwise_pow")
+    Variable.__rpow__ = _binary("elementwise_pow", reverse=True)
+    Variable.__mod__ = _binary("elementwise_mod")
+    Variable.__floordiv__ = _binary("elementwise_floordiv")
+    Variable.__neg__ = lambda self: _scalar_op(self, -1.0, 0.0)
+
+    for name, op_type in [
+        ("__eq__", "equal"), ("__ne__", "not_equal"), ("__lt__", "less_than"),
+        ("__le__", "less_equal"), ("__gt__", "greater_than"),
+        ("__ge__", "greater_equal"),
+    ]:
+        def cmp_impl(self, other, _op=op_type):
+            if not isinstance(other, Variable):
+                if isinstance(other, (int, float)):
+                    other = _to_var(other, self)
+                else:
+                    return NotImplemented
+            return _create_op(_op, self, other)
+
+        setattr(Variable, name, cmp_impl)
+    Variable.__hash__ = lambda self: id(self)

@@ -1,0 +1,210 @@
+"""fluid.layers tensor-creation functions (reference: layers/tensor.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Variable
+from ..framework.dtype import VarType, convert_dtype
+from ..layer_helper import LayerHelper
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(
+        name=helper.name, dtype=convert_dtype(dtype), persistable=persistable
+    )
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("create_parameter")
+    attr = ParamAttr._to_attr(attr)
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, shape, convert_dtype(dtype), is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        dtype=convert_dtype(dtype), shape=tuple(shape), persistable=persistable,
+        name=name or helper.name, stop_gradient=True,
+    )
+    helper.startup_program.global_block().create_var(
+        name=var.name, shape=tuple(shape), dtype=convert_dtype(dtype),
+        persistable=persistable,
+    )
+    helper.startup_program.global_block().append_op(
+        "fill_constant",
+        outputs={"Out": [var.name]},
+        attrs={"shape": list(shape), "value": float(value), "dtype": int(var.dtype)},
+    )
+    return var
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    dtype = convert_dtype(dtype)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(
+        "fill_constant", outputs={"Out": [out]},
+        attrs={"shape": list(shape), "value": float(value), "dtype": int(dtype)},
+    )
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(
+        "fill_constant_batch_size_like",
+        inputs={"Input": [input]}, outputs={"Out": [out]},
+        attrs={"shape": list(shape), "value": float(value), "dtype": int(dtype),
+               "input_dim_idx": input_dim_idx, "output_dim_idx": output_dim_idx},
+    )
+    return out
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("fill_zeros_like", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("ones_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("fill_any_like", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"value": 1.0, "dtype": -1})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, np.ndarray):
+        from ..initializer import NumpyArrayInitializer
+
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                convert_dtype(input.dtype)
+            )
+        dtype_key = {
+            np.dtype(np.float32): "fp32_values",
+            np.dtype(np.int32): "int32_values",
+            np.dtype(np.int64): "int64_values",
+        }.get(input.dtype)
+        if dtype_key is None:
+            input = input.astype(np.float32)
+            dtype_key = "fp32_values"
+        vals = (input.astype(np.float32) if dtype_key == "fp32_values" else input).ravel().tolist()
+        helper.append_op(
+            "assign_value", outputs={"Out": [output]},
+            attrs={"shape": list(input.shape), "dtype": int(output.dtype),
+                   dtype_key: vals},
+        )
+        return output
+    if output is None:
+        output = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("assign", inputs={"X": [input]}, outputs={"Out": [output]})
+    return output
+
+
+def cast(x, dtype):
+    from . import nn
+
+    return nn.cast(x, dtype)
+
+
+def concat(input, axis=0, name=None):
+    from . import nn
+
+    return nn.concat(input, axis, name)
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    if out is None:
+        out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op("sum", inputs={"X": xs}, outputs={"Out": [out]})
+    return out
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    s = start if isinstance(start, Variable) else fill_constant([1], dtype, start)
+    e = stop if isinstance(stop, Variable) else fill_constant([1], dtype, stop)
+    n = num if isinstance(num, Variable) else fill_constant([1], "int32", num)
+    out = helper.create_variable_for_type_inference(convert_dtype(dtype))
+    helper.append_op("linspace", inputs={"Start": [s], "Stop": [e], "Num": [n]},
+                     outputs={"Out": [out]}, attrs={"dtype": int(convert_dtype(dtype))})
+    return out
+
+
+def range(start, end, step, dtype="float32"):
+    helper = LayerHelper("range")
+    s = start if isinstance(start, Variable) else fill_constant([1], dtype, start)
+    e = end if isinstance(end, Variable) else fill_constant([1], dtype, end)
+    st = step if isinstance(step, Variable) else fill_constant([1], dtype, step)
+    out = helper.create_variable_for_type_inference(convert_dtype(dtype), stop_gradient=True)
+    helper.append_op("range", inputs={"Start": [s], "End": [e], "Step": [st]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    helper.append_op("flip", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": list(axes)})
+    return out
+
+
+def diag(diagonal):
+    helper = LayerHelper("diag")
+    out = helper.create_variable_for_type_inference(diagonal.dtype)
+    helper.append_op("diag_v2", inputs={"X": [diagonal]}, outputs={"Out": [out]},
+                     attrs={"offset": 0})
+    return out
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("eye", outputs={"Out": [out]},
+                     attrs={"num_rows": num_rows,
+                            "num_columns": num_columns or num_rows,
+                            "dtype": int(dtype)})
+    return out
+
+
+def argmax(x, axis=0):
+    from . import nn
+
+    return nn.argmax(x, axis)
+
+
+def argmin(x, axis=0):
+    from . import nn
+
+    return nn.argmin(x, axis)

@@ -176,14 +176,15 @@ def _pool2d(ctx):
         strides_full = (1, strides[0], strides[1], 1)
         pads_full = [(0, 0)] + pads + [(0, 0)]
 
+    # NOTE: init values must be python scalars — a traced jnp constant
+    # defeats reduce_window's monoid detection and loses autodiff.
     if ptype == "max":
-        init = -jnp.inf
-        out = lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max, window, strides_full, pads_full)
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides_full, pads_full)
     else:
-        s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add, window, strides_full, pads_full)
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides_full, pads_full)
         if exclusive:
             ones = jnp.ones_like(x)
-            cnt = lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), lax.add, window, strides_full, pads_full)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides_full, pads_full)
             out = s / cnt
         else:
             out = s / (ksize[0] * ksize[1])

@@ -1,0 +1,699 @@
+"""Optimizer classes appending update ops to the program.
+
+Reference: python/paddle/fluid/optimizer.py (17 classes, :461 Optimizer
+base, minimize flow = append_backward + _create_optimization_pass).
+The update ops lower to jax in ops/optimizer_ops.py; update math runs
+fused inside the same XLA program as forward/backward, which subsumes the
+reference's fuse_all_optimizer_ops pass.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from .backward import OP_ROLE_KEY, OP_ROLE_VAR_KEY, OpRole, append_backward
+from .framework import unique_name
+from .framework.core import (
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    in_dygraph_mode,
+    program_guard,
+)
+from .framework.dtype import VarType
+from .layer_helper import LayerHelper
+
+
+class Optimizer:
+    def __init__(self, learning_rate, parameter_list=None, regularization=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self.type = getattr(self, "type", "sgd")
+        self._accumulators: Dict[str, Dict[str, Variable]] = defaultdict(dict)
+        self._learning_rate_map: Dict[int, Variable] = {}
+        self._global_step_var = None
+        # dygraph support
+        self._param_state: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------------
+    def _create_global_learning_rate(self):
+        program = default_main_program()
+        if id(program) in self._learning_rate_map:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[id(program)] = self._learning_rate
+            return
+        from .layers import tensor as tensor_layers
+
+        lr = tensor_layers.create_global_var(
+            shape=[1], value=float(self._learning_rate), dtype="float32",
+            persistable=True, name=unique_name.generate("learning_rate"),
+        )
+        self._learning_rate_map[id(program)] = lr
+
+    def _global_learning_rate(self):
+        return self._learning_rate_map.get(id(default_main_program()))
+
+    def _create_param_lr(self, param):
+        lr = self._global_learning_rate()
+        plr = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0)
+        if plr == 1.0:
+            return lr
+        helper = LayerHelper("param_lr")
+        out = helper.create_variable_for_type_inference(lr.dtype, stop_gradient=True)
+        helper.append_op("scale", inputs={"X": [lr]}, outputs={"Out": [out]},
+                        attrs={"scale": float(plr), OP_ROLE_KEY: OpRole.Optimize})
+        return out
+
+    # ------------------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None):
+        if param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        shape = list(shape if shape is not None else param.shape)
+        dtype = dtype or param.dtype
+        var_name = unique_name.generate(f"{param.name}_{name}")
+        main_block = default_main_program().global_block()
+        var = main_block.create_var(
+            name=var_name, shape=shape, dtype=dtype, persistable=True,
+            stop_gradient=True,
+        )
+        startup_block = default_startup_program().global_block()
+        startup_block.create_var(name=var_name, shape=shape, dtype=dtype,
+                                 persistable=True)
+        startup_block.append_op(
+            "fill_constant", outputs={"Out": [var_name]},
+            attrs={"shape": shape, "value": float(fill_value), "dtype": int(dtype)},
+        )
+        self._accumulators[name][param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # per-optimizer hooks ----------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    # ------------------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        parameter_list = parameter_list or self._parameter_list
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        params_grads = sorted(params_grads, key=lambda x: x[0].name)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip._process(params_grads)
+        else:
+            from .clip import _global_clip
+
+            if _global_clip[0] is not None:
+                params_grads = _global_clip[0]._process(params_grads)
+        params_grads = self._append_regularization_ops(params_grads)
+        return self._create_optimization_pass(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        with program_guard(default_main_program(), startup_program):
+            return self.apply_gradients(params_grads)
+
+    def _append_regularization_ops(self, params_grads):
+        out = []
+        block = default_main_program().global_block()
+        for p, g in params_grads:
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if g is None or reg is None:
+                out.append((p, g))
+            else:
+                out.append((p, reg(p, g, block)))
+        return out
+
+    def _create_optimization_pass(self, params_grads):
+        main_block = default_main_program().global_block()
+        self._create_global_learning_rate()
+        self._create_accumulators(main_block, [p for p, g in params_grads if g is not None])
+        optimize_ops = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            op = self._append_optimize_op(main_block, (p, g))
+            if op is not None:
+                op.attrs[OP_ROLE_KEY] = OpRole.Optimize
+                op.attrs[OP_ROLE_VAR_KEY] = [p.name, g.name]
+                optimize_ops.append(op)
+        self._finish_update(main_block, params_grads)
+        return optimize_ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        if in_dygraph_mode():
+            from .dygraph.base import _dygraph_minimize
+
+            return _dygraph_minimize(self, loss, parameter_list)
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def clear_gradients(self):
+        """dygraph API — grads are recomputed per step, nothing to clear."""
+        from .dygraph import base as dy_base
+
+        dy_base._clear_grads(self._parameter_list)
+
+    @property
+    def current_step_lr(self):
+        lr = self._learning_rate
+        return lr() if callable(lr) else lr
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def state_dict(self):
+        out = {}
+        for name, accs in self._accumulators.items():
+            for pname, var in accs.items():
+                out[var.name] = var
+        return out
+
+
+class SGDOptimizer(Optimizer):
+    """reference: optimizer.py SGDOptimizer."""
+
+    type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    type = "momentum"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=1.0, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=1.0, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            "adam",
+            inputs={"Param": [p], "Grad": [g], "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+
+class AdamWOptimizer(AdamOptimizer):
+    type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._coeff = weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            "adamw",
+            inputs={"Param": [p], "Grad": [g], "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "coeff": self._coeff,
+                   "with_decay": True},
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = "adadelta"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        asg = self._get_accumulator("avg_squared_grad", p)
+        asu = self._get_accumulator("avg_squared_update", p)
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": [p], "Grad": [g], "AvgSquaredGrad": [asg],
+                    "AvgSquaredUpdate": [asu]},
+            outputs={"ParamOut": [p], "AvgSquaredGradOut": [asg],
+                     "AvgSquaredUpdateOut": [asu]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        inf = self._get_accumulator("inf_norm", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        op = block.append_op(
+            "adamax",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m], "InfNorm": [inf],
+                    "Beta1Pow": [b1p],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [m], "InfNormOut": [inf]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+        # beta1_pow update (reference does this in _finish_update)
+        block.append_op("scale", inputs={"X": [b1p]}, outputs={"Out": [b1p]},
+                        attrs={"scale": self._beta1, OP_ROLE_KEY: OpRole.Optimize})
+        return op
+
+
+class RMSPropOptimizer(Optimizer):
+    type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        mom = self._get_accumulator("momentum", p)
+        ms = self._get_accumulator("mean_square", p)
+        mg = self._get_accumulator("mean_grad", p)
+        return block.append_op(
+            "rmsprop",
+            inputs={"Param": [p], "Grad": [g], "Moment": [mom],
+                    "MeanSquare": [ms], "MeanGrad": [mg],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [mom],
+                     "MeanSquareOut": [ms], "MeanGradOut": [mg]},
+            attrs={"epsilon": self._epsilon, "decay": self._rho,
+                   "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": [p], "Grad": [g], "SquaredAccumulator": [sq],
+                    "LinearAccumulator": [lin],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, exclude_from_weight_decay_fn=None,
+                 **kwargs):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kwargs)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        return block.append_op(
+            "lamb",
+            inputs={"Param": [p], "Grad": [g], "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "weight_decay": wd},
+        )
+
+
+class DpsgdOptimizer(Optimizer):
+    type = "dpsgd"
+
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "dpsgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._create_param_lr(p)]},
+            outputs={"ParamOut": [p]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma},
+        )
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation recompute (reference: optimizer.py:3858).
+
+    TPU-native: instead of rewriting the backward program to re-emit
+    forward ops between checkpoints, grad-op vjp replay already recomputes
+    the forward inside the grad ops; marking checkpoints wraps segments in
+    jax.checkpoint at executor trace time (planned hook).  Until that
+    hook lands, the vjp-replay + XLA rematerialization default already
+    provides recompute-like memory behavior.
+    """
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program, parameter_list,
+                                        no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(loss, startup_program, parameter_list,
+                                        no_grad_set)
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+class LookaheadOptimizer:
+    """reference: optimizer.py:4150 — slow/fast weight interpolation."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        mini_out = self.inner_optimizer.minimize(loss, startup_program)
+        # slow-weight update every k steps is approximated by EMA toward
+        # fast weights each step with rate alpha/k (program-rewrite-free).
+        helper = LayerHelper("lookahead")
+        block = default_main_program().global_block()
+        rate = self.alpha / float(self.k)
+        for p in default_main_program().all_parameters():
+            slow = self._slow_var(p)
+            mixed = helper.create_variable_for_type_inference(p.dtype)
+            block.append_op("scale", inputs={"X": [slow]}, outputs={"Out": [slow]},
+                            attrs={"scale": 1.0 - rate, OP_ROLE_KEY: OpRole.Optimize})
+            block.append_op("scale", inputs={"X": [p]}, outputs={"Out": [mixed]},
+                            attrs={"scale": rate, OP_ROLE_KEY: OpRole.Optimize})
+            block.append_op("sum", inputs={"X": [slow, mixed]},
+                            outputs={"Out": [slow]},
+                            attrs={OP_ROLE_KEY: OpRole.Optimize})
+        return mini_out
+
+    def _slow_var(self, p):
+        name = p.name + "@SLOW"
+        block = default_main_program().global_block()
+        if block.has_var(name):
+            return block.var(name)
+        var = block.create_var(name=name, shape=p.shape, dtype=p.dtype,
+                               persistable=True, stop_gradient=True)
+        sblock = default_startup_program().global_block()
+        sblock.create_var(name=name, shape=p.shape, dtype=p.dtype, persistable=True)
+        sblock.append_op("assign", inputs={"X": [p.name]}, outputs={"Out": [name]})
+        return var
+
+
+class ExponentialMovingAverage:
+    """reference: optimizer.py ExponentialMovingAverage."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._ema_vars = {}
+
+    def update(self):
+        block = default_main_program().global_block()
+        helper = LayerHelper("ema")
+        for p in default_main_program().all_parameters():
+            ema = self._create_ema_var(p)
+            tmp = helper.create_variable_for_type_inference(p.dtype)
+            block.append_op("scale", inputs={"X": [ema]}, outputs={"Out": [ema]},
+                            attrs={"scale": self._decay})
+            block.append_op("scale", inputs={"X": [p]}, outputs={"Out": [tmp]},
+                            attrs={"scale": 1.0 - self._decay})
+            block.append_op("sum", inputs={"X": [ema, tmp]}, outputs={"Out": [ema]})
+
+    def _create_ema_var(self, p):
+        name = p.name + "@EMA" + self._name
+        if name in self._ema_vars:
+            return self._ema_vars[name]
+        block = default_main_program().global_block()
+        var = block.create_var(name=name, shape=p.shape, dtype=p.dtype,
+                               persistable=True, stop_gradient=True)
+        sblock = default_startup_program().global_block()
+        sblock.create_var(name=name, shape=p.shape, dtype=p.dtype, persistable=True)
+        sblock.append_op("assign", inputs={"X": [p.name]}, outputs={"Out": [name]})
+        self._ema_vars[name] = var
+        return var
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            from .framework.scope import global_scope
+            import numpy as np
+
+            saved = {}
+            scope = global_scope()
+            for p in default_main_program().all_parameters():
+                ema_name = p.name + "@EMA" + self._name
+                if scope.has(ema_name):
+                    saved[p.name] = scope.get(p.name)
+                    scope.set(p.name, scope.get(ema_name))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for name, val in saved.items():
+                        scope.set(name, val)
+
+        return _guard()
+
+    def restore(self, executor=None):
+        pass
+
+
+class ModelAverage(Optimizer):
+    """reference: optimizer.py ModelAverage — simplified EMA-style variant."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kwargs):
+        super().__init__(0.0, **kwargs)
+        self._ema = ExponentialMovingAverage(decay=1.0 - average_window_rate)
+
+    def apply(self, executor=None, need_restore=True):
+        return self._ema.apply(executor, need_restore)
+
+    def restore(self, executor=None):
+        pass
+
+
+# 2.0-style short aliases (reference: paddle.optimizer namespace)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adagrad = AdagradOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+Dpsgd = DpsgdOptimizer
+LarsMomentum = LarsMomentumOptimizer

@@ -1,0 +1,16 @@
+"""SPMD data-parallel execution of a CompiledProgram (pjit path).
+
+Replaces the reference's FastThreadedSSAGraphExecutor + AllReduceOpHandle
+pipeline (reference: framework/details/fast_threaded_ssa_graph_executor.cc,
+all_reduce_op_handle.cc).  Full mesh implementation lands with the SPMD
+phase; the placeholder executes single-device so CompiledProgram is usable
+before then.
+"""
+from __future__ import annotations
+
+
+def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy):
+    return executor.run(
+        compiled._program, feed=feed, fetch_list=fetch_list, scope=scope,
+        return_numpy=return_numpy,
+    )

@@ -1,0 +1,174 @@
+"""Data pipeline: DataFeeder, DataLoader, reader decorators.
+
+Reference: python/paddle/fluid/data_feeder.py:212 DataFeeder,
+fluid/reader.py:101 DataLoader.from_generator / :953 GeneratorLoader,
+python/paddle/reader/decorator.py (shuffle/batch/buffered).  TPU-first:
+instead of a C++ LoDTensorBlockingQueue feeding a create_py_reader_op in
+the graph, the loader is a host-side prefetching iterator that yields feed
+dicts; jax.device_put overlaps H2D with compute via async dispatch, and
+the double-buffer decorator mirrors buffered_reader (reference:
+operators/reader/buffered_reader.cc).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .framework.core import Variable
+from .framework.dtype import to_numpy_dtype
+from .framework.scope import LoDTensor
+
+
+class DataFeeder:
+    """reference: data_feeder.py:212 — converts sample lists to feed dicts."""
+
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.feed_list = feed_list
+        self.place = place
+
+    def feed(self, iterable) -> dict:
+        slots: List[List] = [[] for _ in self.feed_list]
+        for sample in iterable:
+            for i, val in enumerate(sample):
+                slots[i].append(np.asarray(val))
+        out = {}
+        for var, vals in zip(self.feed_list, slots):
+            name = var.name if isinstance(var, Variable) else str(var)
+            arr = np.stack(vals) if vals and vals[0].shape else np.asarray(vals)
+            if isinstance(var, Variable) and var.dtype is not None:
+                want = to_numpy_dtype(var.dtype)
+                # honor declared non-batch dims (e.g. label shape [-1, 1])
+                want_rank = len(var.shape)
+                while arr.ndim < want_rank:
+                    arr = arr[..., None]
+                arr = arr.astype(want)
+            out[name] = arr
+        return out
+
+
+class DataLoader:
+    """reference: fluid/reader.py:101.
+
+    from_generator returns a loader whose set_sample_generator /
+    set_sample_list_generator / set_batch_generator feed a background
+    prefetch queue (the py_reader blocking-queue analog).
+    """
+
+    def __init__(self, feed_list=None, capacity=64, iterable=True,
+                 return_list=False, use_double_buffer=True):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self.iterable = iterable
+        self.return_list = return_list
+        self.use_double_buffer = use_double_buffer
+        self._batch_fn: Optional[Callable[[], Iterable]] = None
+        self._places = None
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False, use_multiprocess=False,
+                       drop_last=True):
+        return DataLoader(feed_list, capacity, iterable, return_list,
+                          use_double_buffer)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        loader = DataLoader()
+        loader._batch_fn = lambda: iter(dataset)
+        return loader
+
+    # ------------------------------------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        from .reader_decorator import batch as batch_dec
+
+        return self.set_sample_list_generator(
+            batch_dec(reader, batch_size, drop_last), places
+        )
+
+    def set_sample_list_generator(self, reader, places=None):
+        feeder = DataFeeder(self.feed_list)
+
+        def gen():
+            for samples in reader():
+                yield feeder.feed(samples)
+
+        self._batch_fn = gen
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        def gen():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    out = {}
+                    for var, val in zip(self.feed_list, batch):
+                        name = var.name if isinstance(var, Variable) else str(var)
+                        out[name] = np.asarray(val)
+                    yield out
+
+        self._batch_fn = gen
+        self._places = places
+        return self
+
+    # ------------------------------------------------------------------
+    def __iter__(self):
+        if self._batch_fn is None:
+            raise RuntimeError("DataLoader has no generator set")
+        if not self.use_double_buffer:
+            yield from self._batch_fn()
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=max(2, self.capacity))
+        sentinel = object()
+        err: list = []
+
+        def worker():
+            try:
+                for item in self._batch_fn():
+                    q.put(item)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    # legacy py_reader-style start/reset are no-ops for iterable loaders
+    def start(self):
+        pass
+
+    def reset(self):
+        pass
+
+
+def _train_from_dataset(executor, program, dataset, scope, fetch_list,
+                        fetch_info, print_period):
+    """Dataset-driven training loop (reference: executor.py:1448
+    train_from_dataset -> MultiTrainer/HogwildWorker).  The TPU analog is a
+    host ingestion loop feeding the jitted program."""
+    if dataset is None:
+        raise ValueError("dataset is required")
+    step = 0
+    for feed in dataset._iter_batches():
+        out = executor.run(program, feed=feed,
+                           fetch_list=fetch_list, scope=scope)
+        if fetch_list and step % print_period == 0:
+            infos = fetch_info or [getattr(f, "name", str(f)) for f in fetch_list]
+            msg = ", ".join(f"{i}={np.asarray(v).mean():.6f}"
+                            for i, v in zip(infos, out))
+            print(f"[train_from_dataset] step {step}: {msg}")
+        step += 1
+    return None

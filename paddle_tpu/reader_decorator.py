@@ -1,0 +1,138 @@
+"""Sample-level reader decorators (reference: python/paddle/reader/decorator.py)."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+
+
+def shuffle(reader, buf_size):
+    def impl():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return impl
+
+
+def batch(reader, batch_size, drop_last=False):
+    def impl():
+        b = []
+        for e in reader():
+            b.append(e)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return impl
+
+
+def compose(*readers):
+    def impl():
+        for outputs in zip(*[r() for r in readers]):
+            yield sum([list(o) if isinstance(o, (list, tuple)) else [o]
+                       for o in outputs], [])
+
+    return impl
+
+
+def chain(*readers):
+    def impl():
+        for r in readers:
+            yield from r()
+
+    return impl
+
+
+def map_readers(func, *readers):
+    def impl():
+        for args in zip(*[r() for r in readers]):
+            yield func(*args)
+
+    return impl
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    def impl():
+        in_q: Queue = Queue(buffer_size)
+        out_q: Queue = Queue(buffer_size)
+        end = object()
+
+        def feed():
+            for s in reader():
+                in_q.put(s)
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                s = in_q.get()
+                if s is end:
+                    out_q.put(end)
+                    return
+                out_q.put(mapper(s))
+
+        Thread(target=feed, daemon=True).start()
+        workers = [Thread(target=work, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+            else:
+                yield item
+
+    return impl
+
+
+def buffered(reader, size):
+    def impl():
+        q: Queue = Queue(size)
+        end = object()
+
+        def feed():
+            for s in reader():
+                q.put(s)
+            q.put(end)
+
+        Thread(target=feed, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is end:
+                return
+            yield item
+
+    return impl
+
+
+def firstn(reader, n):
+    def impl():
+        return itertools.islice(reader(), n)
+
+    return impl
+
+
+def cache(reader):
+    memory = []
+
+    def impl():
+        if memory:
+            yield from memory
+            return
+        for e in reader():
+            memory.append(e)
+            yield e
+
+    return impl

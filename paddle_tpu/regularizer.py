@@ -1,0 +1,43 @@
+"""Weight-decay regularizers (reference: python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        decay = block.create_var(dtype=param.dtype, shape=param.shape)
+        block.append_op(
+            "scale", inputs={"X": [param]}, outputs={"Out": [decay]},
+            attrs={"scale": self.regularization_coeff},
+        )
+        new_grad = block.create_var(dtype=param.dtype, shape=param.shape)
+        block.append_op("sum", inputs={"X": [grad, decay]}, outputs={"Out": [new_grad]})
+        return new_grad
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        sign = block.create_var(dtype=param.dtype, shape=param.shape)
+        block.append_op("sign", inputs={"X": [param]}, outputs={"Out": [sign]})
+        decay = block.create_var(dtype=param.dtype, shape=param.shape)
+        block.append_op("scale", inputs={"X": [sign]}, outputs={"Out": [decay]},
+                        attrs={"scale": self.regularization_coeff})
+        new_grad = block.create_var(dtype=param.dtype, shape=param.shape)
+        block.append_op("sum", inputs={"X": [grad, decay]}, outputs={"Out": [new_grad]})
+        return new_grad
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

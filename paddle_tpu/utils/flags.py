@@ -1,0 +1,72 @@
+"""Global flag system.
+
+Reference: paddle/fluid/platform/flags.cc (26+ gflags, read from FLAGS_*
+env vars, exposed to Python via fluid.set_flags/get_flags,
+pybind/global_value_getter_setter.cc).  Same three-tier shape: env-seeded
+defaults, runtime set_flags, strategy dataclasses elsewhere.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_DEFAULTS: Dict[str, Any] = {
+    "FLAGS_check_nan_inf": False,          # flags.cc:44
+    "FLAGS_benchmark": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,   # GC threshold — XLA-managed, stat only
+    "FLAGS_allocator_strategy": "xla_bfc",  # allocator is XLA's; exposed for parity
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_enable_parallel_graph": False,
+    "FLAGS_sync_nccl_allreduce": True,
+    "FLAGS_communicator_max_merge_var_num": 20,
+    "FLAGS_communicator_send_queue_size": 20,
+    "FLAGS_communicator_independent_recv_thread": True,
+    "FLAGS_communicator_send_wait_times": 5,
+    "FLAGS_rpc_deadline": 180000,
+    "FLAGS_rpc_retry_times": 3,
+    "FLAGS_use_pinned_memory": True,
+    "FLAGS_seed": 0,
+    "FLAGS_enable_unused_var_check": False,
+    "FLAGS_tpu_matmul_precision": "default",  # TPU-native: bf16 matmul control
+    "FLAGS_tpu_donate_buffers": True,
+}
+
+
+def _coerce(cur, val):
+    if isinstance(cur, bool):
+        return str(val).lower() in ("1", "true", "yes", "on")
+    if isinstance(cur, int):
+        return int(val)
+    if isinstance(cur, float):
+        return float(val)
+    return val
+
+
+_flags: Dict[str, Any] = {}
+for k, v in _DEFAULTS.items():
+    env = os.environ.get(k)
+    _flags[k] = _coerce(v, env) if env is not None else v
+
+
+def set_flags(d: Dict[str, Any]):
+    for k, v in d.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        cur = _flags.get(k)
+        _flags[k] = _coerce(cur, v) if cur is not None else v
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    out = {}
+    for k in keys:
+        kk = k if k.startswith("FLAGS_") else "FLAGS_" + k
+        out[k] = _flags.get(kk)
+    return out
+
+
+def flag(name, default=None):
+    kk = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _flags.get(kk, default)

@@ -1,0 +1,39 @@
+"""Test config: force an 8-device virtual CPU mesh so multi-chip sharding
+tests run without TPU hardware (SURVEY.md §4 implication (c))."""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + scope + name generator."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework import core, unique_name
+    from paddle_tpu.framework.scope import Scope
+
+    prev_main = core.switch_main_program(core.Program())
+    prev_startup = core.switch_startup_program(core.Program())
+    prev_gen = unique_name.switch()
+    scope = Scope()
+    from paddle_tpu.framework import scope as scope_mod
+
+    prev_scope = scope_mod._global_scope
+    scope_mod._global_scope = scope
+    yield
+    core.switch_main_program(prev_main)
+    core.switch_startup_program(prev_startup)
+    unique_name.switch(prev_gen)
+    scope_mod._global_scope = prev_scope

@@ -1,0 +1,169 @@
+"""OpTest base: NumPy-reference per-op testing.
+
+Mirrors the reference's workhorse pattern
+(reference: python/paddle/fluid/tests/unittests/op_test.py:170):
+declare op_type/inputs/outputs/attrs; check_output builds a one-op program
+and compares against the NumPy reference on every available place;
+check_grad compares analytic grads (via append_backward) against numeric
+finite differences (reference: op_test.py get_numeric_gradient:57).
+"""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.dtype import convert_dtype
+from paddle_tpu.framework.scope import Scope
+from paddle_tpu.framework import scope as scope_mod
+
+
+class OpTest(unittest.TestCase):
+    op_type: str = ""
+
+    def setUp(self):
+        self.inputs = {}
+        self.outputs = {}
+        self.attrs = {}
+
+    # ------------------------------------------------------------------
+    def _build_program(self):
+        prog = Program()
+        block = prog.global_block()
+        in_map = {}
+        feed = {}
+        for slot, val in self.inputs.items():
+            if isinstance(val, list):  # multi-var slot: [(name, array), ...]
+                names = []
+                for name, arr in val:
+                    arr = np.asarray(arr)
+                    block.create_var(name=name, shape=arr.shape,
+                                     dtype=convert_dtype(arr.dtype),
+                                     is_data=True, stop_gradient=False)
+                    feed[name] = arr
+                    names.append(name)
+                in_map[slot] = names
+            else:
+                arr = np.asarray(val)
+                name = f"in_{slot}"
+                block.create_var(name=name, shape=arr.shape,
+                                 dtype=convert_dtype(arr.dtype),
+                                 is_data=True, stop_gradient=False)
+                feed[name] = arr
+                in_map[slot] = [name]
+        out_map = {}
+        for slot, val in self.outputs.items():
+            if isinstance(val, list):
+                names = []
+                for name, arr in val:
+                    block.create_var(name=name, dtype=convert_dtype(np.asarray(arr).dtype))
+                    names.append(name)
+                out_map[slot] = names
+            else:
+                name = f"out_{slot}"
+                block.create_var(name=name, dtype=convert_dtype(np.asarray(val).dtype))
+                out_map[slot] = [name]
+        block.append_op(self.op_type, inputs=in_map, outputs=out_map,
+                        attrs=dict(self.attrs))
+        return prog, feed, in_map, out_map
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=None):
+        prog, feed, _, out_map = self._build_program()
+        fetch = []
+        expect = []
+        for slot, val in self.outputs.items():
+            if no_check_set and slot in no_check_set:
+                continue
+            if isinstance(val, list):
+                for name, arr in val:
+                    fetch.append(name)
+                    expect.append(np.asarray(arr))
+            else:
+                fetch.append(out_map[slot][0])
+                expect.append(np.asarray(val))
+        scope = Scope()
+        prev = scope_mod._global_scope
+        scope_mod._global_scope = scope
+        try:
+            exe = pt.Executor(pt.CPUPlace())
+            got = exe.run(prog, feed=feed, fetch_list=fetch)
+        finally:
+            scope_mod._global_scope = prev
+        for g, e, name in zip(got, expect, fetch):
+            np.testing.assert_allclose(
+                np.asarray(g, dtype=np.float64) if e.dtype.kind == "f" else np.asarray(g),
+                e.astype(np.float64) if e.dtype.kind == "f" else e,
+                atol=atol, rtol=rtol,
+                err_msg=f"output {name} mismatch for op {self.op_type}",
+            )
+
+    # ------------------------------------------------------------------
+    def check_grad(self, inputs_to_check, output_name, max_relative_error=0.005,
+                   numeric_grad_delta=1e-3, no_grad_set=None):
+        prog, feed, in_map, out_map = self._build_program()
+        block = prog.global_block()
+        # loss = mean of the checked output so the grad is scalar-rooted
+        out_var_name = None
+        for slot, names in out_map.items():
+            for n in names:
+                if n == output_name or n == f"out_{output_name}" or slot == output_name:
+                    out_var_name = n
+                    break
+        assert out_var_name is not None, f"output {output_name} not found"
+        loss = block.create_var(name="loss__", dtype=pt.framework.VarType.FP32)
+        block.append_op("mean", inputs={"X": [out_var_name]}, outputs={"Out": [loss]})
+        pt.append_backward(block.var("loss__"), no_grad_set=no_grad_set)
+
+        grad_fetch = [f"in_{n}@GRAD" if not n.startswith("in_") else n + "@GRAD"
+                      for n in inputs_to_check]
+        # tolerate custom-named inputs
+        grad_fetch = []
+        for n in inputs_to_check:
+            cand = f"in_{n}@GRAD"
+            if block._find_var_recursive(cand) is None:
+                cand = n + "@GRAD"
+            grad_fetch.append(cand)
+
+        scope = Scope()
+        prev = scope_mod._global_scope
+        scope_mod._global_scope = scope
+        try:
+            exe = pt.Executor(pt.CPUPlace())
+            analytic = exe.run(prog, feed=feed, fetch_list=grad_fetch)
+
+            # numeric gradients by central differences through a fresh run
+            def run_loss(feed_d):
+                return float(exe.run(prog, feed=feed_d, fetch_list=["loss__"])[0])
+
+            for gi, name in enumerate(inputs_to_check):
+                fname = f"in_{name}" if f"in_{name}" in feed else name
+                base = feed[fname].astype(np.float64)
+                num = np.zeros_like(base)
+                flat = base.ravel()
+                nflat = num.ravel()
+                for i in range(flat.size):
+                    orig = flat[i]
+                    flat[i] = orig + numeric_grad_delta
+                    f2 = dict(feed)
+                    f2[fname] = base.reshape(feed[fname].shape).astype(feed[fname].dtype)
+                    lp = run_loss(f2)
+                    flat[i] = orig - numeric_grad_delta
+                    f2 = dict(feed)
+                    f2[fname] = base.reshape(feed[fname].shape).astype(feed[fname].dtype)
+                    lm = run_loss(f2)
+                    flat[i] = orig
+                    nflat[i] = (lp - lm) / (2 * numeric_grad_delta)
+                a = np.asarray(analytic[gi], dtype=np.float64)
+                abs_a = np.abs(a).max()
+                denom = max(abs_a, np.abs(num).max(), 1e-3)
+                diff = np.abs(a - num).max() / denom
+                self.assertLessEqual(
+                    diff, max_relative_error,
+                    msg=f"grad mismatch for {name} in op {self.op_type}: "
+                        f"max rel err {diff}",
+                )
+        finally:
+            scope_mod._global_scope = prev

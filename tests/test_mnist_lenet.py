@@ -1,0 +1,97 @@
+"""End-to-end static MNIST LeNet — the minimum slice from SURVEY.md §7
+phase 2 and BASELINE.json config #1 (reference analog:
+python/paddle/fluid/tests/book/test_recognize_digits.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+def lenet(img, label):
+    conv1 = fluid.layers.conv2d(img, num_filters=6, filter_size=5,
+                                padding=2, act="relu")
+    pool1 = fluid.layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = fluid.layers.conv2d(pool1, num_filters=16, filter_size=5, act="relu")
+    pool2 = fluid.layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    fc1 = fluid.layers.fc(pool2, size=120, act="relu")
+    fc2 = fluid.layers.fc(fc1, size=84, act="relu")
+    logits = fluid.layers.fc(fc2, size=10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(logits, label)
+    return avg_loss, acc
+
+
+def _fake_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    # 10 well-separated class templates + noise -> learnable quickly
+    templates = rng.rand(10, 1, 28, 28).astype("float32")
+    labels = rng.randint(0, 10, n).astype("int64")
+    imgs = templates[labels] + 0.1 * rng.randn(n, 1, 28, 28).astype("float32")
+    return imgs, labels[:, None]
+
+
+def test_mnist_lenet_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 42
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        avg_loss, acc = lenet(img, label)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.05)
+        opt.minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    imgs, labels = _fake_mnist(256)
+    bs = 32
+    first_loss = last_loss = None
+    last_acc = 0.0
+    for epoch in range(4):
+        for i in range(0, len(imgs), bs):
+            feed = {"img": imgs[i:i + bs], "label": labels[i:i + bs]}
+            loss_v, acc_v = exe.run(main, feed=feed,
+                                    fetch_list=[avg_loss, acc])
+            if first_loss is None:
+                first_loss = float(loss_v)
+            last_loss = float(loss_v)
+            last_acc = float(acc_v)
+    assert last_loss < first_loss * 0.5, (first_loss, last_loss)
+    assert last_acc > 0.8, last_acc
+
+
+def test_mnist_save_load_inference(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        avg_loss, acc = lenet(img, label)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.05)
+        opt.minimize(avg_loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    imgs, labels = _fake_mnist(64)
+    exe.run(main, feed={"img": imgs, "label": labels}, fetch_list=[avg_loss])
+
+    # find the logits var (input of softmax_with_cross_entropy)
+    logits_name = None
+    for op in main.global_block().ops:
+        if op.type == "softmax_with_cross_entropy":
+            logits_name = op.input("Logits")[0]
+            break
+    logits = main.global_block().var(logits_name)
+
+    d = str(tmp_path / "model")
+    fluid.save_inference_model(d, ["img"], [logits], exe, main_program=main)
+
+    ref = exe.run(main, feed={"img": imgs[:8], "label": labels[:8]},
+                  fetch_list=[logits_name])[0]
+
+    infer_prog, feed_names, fetch_vars = fluid.load_inference_model(d, exe)
+    got = exe.run(infer_prog, feed={feed_names[0]: imgs[:8]},
+                  fetch_list=[v.name for v in fetch_vars])[0]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)

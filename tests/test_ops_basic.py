@@ -1,0 +1,327 @@
+"""Per-op NumPy parity tests (OpTest pattern, reference: test_*_op.py files)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def test_output(self):
+        self.inputs = {"X": np.random.rand(3, 4).astype("float32"),
+                       "Y": np.random.rand(3, 4).astype("float32")}
+        self.outputs = {"Out": self.inputs["X"] + self.inputs["Y"]}
+        self.check_output()
+
+    def test_broadcast_axis(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y[None, :, None]}
+        self.check_output()
+
+    def test_grad(self):
+        self.inputs = {"X": np.random.rand(3, 4).astype("float32"),
+                       "Y": np.random.rand(3, 4).astype("float32")}
+        self.outputs = {"Out": self.inputs["X"] + self.inputs["Y"]}
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def test_output(self):
+        x = np.random.rand(4, 5).astype("float32")
+        y = np.random.rand(5, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+        self.check_output()
+
+    def test_transpose(self):
+        x = np.random.rand(5, 4).astype("float32")
+        y = np.random.rand(3, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+        self.check_output()
+
+    def test_grad(self):
+        x = np.random.rand(4, 5).astype("float32")
+        y = np.random.rand(5, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def test_output(self):
+        x = np.random.rand(4, 2, 3).astype("float32")
+        y = np.random.rand(6, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x.reshape(4, 6) @ y}
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def test_output(self):
+        x = np.random.rand(3, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+        self.check_output()
+
+    def test_grad(self):
+        x = np.random.rand(3, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+        self.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+class TestRelu(OpTest):
+    op_type = "relu"
+
+    def test_output_and_grad(self):
+        x = np.random.randn(4, 5).astype("float32")
+        x[np.abs(x) < 0.1] = 0.5  # keep away from kink for numeric grad
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.maximum(x, 0)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def test_dim(self):
+        x = np.random.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+        self.outputs = {"Out": x.sum(1)}
+        self.check_output()
+
+    def test_all(self):
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.asarray(x.sum(), dtype=np.float32)}
+        self.check_output()
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def _ref_conv(self, x, w, stride, pad):
+        n, c, h, wd = x.shape
+        oc, ic, kh, kw = w.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        oh = (h + 2 * pad - kh) // stride + 1
+        ow = (wd + 2 * pad - kw) // stride + 1
+        out = np.zeros((n, oc, oh, ow), dtype=np.float32)
+        for i in range(oh):
+            for j in range(ow):
+                patch = xp[:, :, i * stride:i * stride + kh, j * stride:j * stride + kw]
+                out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+        return out
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 8, 8).astype("float32")
+        w = np.random.rand(4, 3, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": self._ref_conv(x, w, 2, 1)}
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        x = np.random.rand(2, 2, 5, 5).astype("float32")
+        w = np.random.rand(3, 2, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": self._ref_conv(x, w, 1, 0)}
+        self.check_grad(["Input", "Filter"], "Output", max_relative_error=0.02)
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0]}
+        ref = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.outputs = {"Out": ref}
+        self.check_output()
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+
+    def test_output(self):
+        np.random.seed(0)
+        x = np.random.rand(4, 3, 5, 5).astype("float32")
+        scale = np.random.rand(3).astype("float32")
+        bias = np.random.rand(3).astype("float32")
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        eps, mom = 1e-5, 0.9
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = (x - bm[None, :, None, None]) / np.sqrt(bv + eps)[None, :, None, None]
+        y = y * scale[None, :, None, None] + bias[None, :, None, None]
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"momentum": mom, "epsilon": eps, "is_test": False}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": mom * mean + (1 - mom) * bm,
+            "VarianceOut": mom * var + (1 - mom) * bv,
+            "SavedMean": bm,
+            "SavedVariance": 1.0 / np.sqrt(bv + eps),
+        }
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestSoftmaxWithCE(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test_output(self):
+        logits = np.random.rand(5, 7).astype("float32")
+        label = np.random.randint(0, 7, (5, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label.ravel()])[:, None]
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.check_output(atol=1e-5)
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table_v2"
+
+    def test_output(self):
+        w = np.random.rand(10, 4).astype("float32")
+        ids = np.random.randint(0, 10, (3, 5)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids]}
+        self.check_output()
+
+
+class TestAdamOp(OpTest):
+    op_type = "adam"
+
+    def test_output(self):
+        p = np.random.rand(4, 3).astype("float32")
+        g = np.random.rand(4, 3).astype("float32")
+        m1 = np.random.rand(4, 3).astype("float32")
+        m2 = np.random.rand(4, 3).astype("float32")
+        lr = np.array([0.01], np.float32)
+        b1p = np.array([0.9], np.float32)
+        b2p = np.array([0.999], np.float32)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m1n = b1 * m1 + (1 - b1) * g
+        m2n = b2 * m2 + (1 - b2) * g * g
+        lrt = lr * np.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+        pn = p - lrt * m1n / (np.sqrt(m2n) + eps)
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                       "LearningRate": lr, "Beta1Pow": b1p, "Beta2Pow": b2p}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": pn, "Moment1Out": m1n, "Moment2Out": m2n,
+                        "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+        self.check_output(atol=1e-5)
+
+
+class TestReshape(OpTest):
+    op_type = "reshape2"
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [0, -1]}
+        self.outputs = {"Out": x.reshape(2, 12)}
+        self.check_output(no_check_set={"XShape"})
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose2"
+
+    def test_output_and_grad(self):
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 0, 2]}
+        self.outputs = {"Out": x.transpose(1, 0, 2)}
+        self.check_output(no_check_set={"XShape"})
+        self.check_grad(["X"], "Out")
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def test_output(self):
+        a = np.random.rand(2, 3).astype("float32")
+        b = np.random.rand(2, 5).astype("float32")
+        self.inputs = {"X": [("xa", a), ("xb", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+        self.check_output()
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test_output(self):
+        x = np.random.rand(3, 8).astype("float32")
+        scale = np.random.rand(8).astype("float32")
+        bias = np.random.rand(8).astype("float32")
+        eps = 1e-5
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": eps}
+        self.outputs = {"Y": y, "Mean": mean.ravel(), "Variance": var.ravel()}
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def test_output(self):
+        x = np.array([[1.0, 3.0, 2.0], [5.0, 4.0, 6.0]], np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"k": 2}
+        self.outputs = {"Out": np.array([[3.0, 2.0], [6.0, 5.0]], np.float32),
+                        "Indices": np.array([[1, 2], [2, 0]], np.int64)}
+        self.check_output()
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def test_output(self):
+        from paddle_tpu.framework.dtype import VarType
+
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"in_dtype": int(VarType.FP32), "out_dtype": int(VarType.INT32)}
+        self.outputs = {"Out": x.astype(np.int32)}
+        self.check_output()
+
+
+class TestSigmoidGrad(OpTest):
+    op_type = "sigmoid"
+
+    def test_grad(self):
+        x = np.random.randn(4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 1 / (1 + np.exp(-x))}
+        self.check_output()
+        self.check_grad(["X"], "Out")
