@@ -1,7 +1,27 @@
-"""Dygraph (imperative) mode — reference: paddle/fluid/imperative + fluid/dygraph.
-
-Full implementation lands with the dygraph phase; base hooks are defined so
-static-mode modules can import unconditionally.
-"""
+"""Dygraph (imperative) mode — reference: paddle/fluid/imperative + fluid/dygraph."""
 from . import base
-from .base import guard, enabled, to_variable, no_grad
+from .base import (
+    guard,
+    enabled,
+    to_variable,
+    no_grad,
+    enable_dygraph,
+    disable_dygraph,
+)
+from .varbase import VarBase, ParamBase
+from .tracer import Tracer
+from .layers import Layer, Sequential, LayerList, ParameterList
+from .nn import (
+    Linear,
+    Conv2D,
+    Conv2DTranspose,
+    Pool2D,
+    BatchNorm,
+    Embedding,
+    LayerNorm,
+    Dropout,
+    PRelu,
+    GroupNorm,
+    InstanceNorm,
+)
+from .checkpoint import save_dygraph, load_dygraph

@@ -1,0 +1,29 @@
+"""save_dygraph / load_dygraph (reference: fluid/dygraph/checkpoint.py:33/:98)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .varbase import VarBase
+
+
+def save_dygraph(state_dict, model_path):
+    arrays = {}
+    for k, v in state_dict.items():
+        arrays[k] = v.numpy() if isinstance(v, VarBase) else np.asarray(v)
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    np.savez(model_path + ".pdparams.npz", **arrays)
+
+
+def load_dygraph(model_path, keep_name_table=False):
+    path = model_path + ".pdparams.npz"
+    if not os.path.exists(path):
+        path = model_path  # allow direct file path
+    out = {}
+    with np.load(path, allow_pickle=False) as z:
+        for k in z.files:
+            out[k] = np.asarray(z[k])
+    return out, None  # (param_dict, optimizer_dict)

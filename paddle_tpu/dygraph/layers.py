@@ -1,0 +1,222 @@
+"""Layer base class + containers.
+
+Reference: python/paddle/fluid/dygraph/layers.py (Layer) and
+container.py (Sequential/LayerList/ParameterList).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework import unique_name
+from ..framework.core import _current_tracer
+from ..framework.dtype import VarType, convert_dtype
+from ..param_attr import ParamAttr
+from .varbase import ParamBase, VarBase
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=VarType.FP32):
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower()
+        )
+        self._dtype = dtype
+        self._parameters: "OrderedDict[str, ParamBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
+        self.training = True
+
+    # -- hierarchy ---------------------------------------------------------
+    def full_name(self):
+        return self._full_name
+
+    def __setattr__(self, name, value):
+        if isinstance(value, ParamBase):
+            self.__dict__.setdefault("_parameters", OrderedDict())[name] = value
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", OrderedDict())[name] = value
+            object.__setattr__(self, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        object.__setattr__(self, name, tensor)
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from ..layer_helper import LayerHelper
+
+        helper = LayerHelper(self._full_name)
+        return helper.create_parameter(
+            ParamAttr._to_attr(attr), shape, dtype or self._dtype, is_bias,
+            default_initializer,
+        )
+
+    # -- traversal ---------------------------------------------------------
+    def parameters(self, include_sublayers=True) -> List[ParamBase]:
+        return [p for _, p in self.named_parameters()]
+
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, ParamBase]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        for lname, layer in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{lname}" if prefix else lname
+            yield from layer.named_parameters(sub_prefix)
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        out = [self] if include_self else []
+        for layer in self._sub_layers.values():
+            out.append(layer)
+            out.extend(layer.sublayers())
+        return out
+
+    def named_sublayers(self, prefix=""):
+        for name, layer in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, layer
+            yield from layer.named_sublayers(p)
+
+    def buffers(self):
+        out = list(self._buffers.values())
+        for layer in self._sub_layers.values():
+            out.extend(layer.buffers())
+        return out
+
+    # -- modes -------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- state -------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   prefix="") -> Dict[str, np.ndarray]:
+        out = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix):
+            out[name] = p
+        # buffers (e.g. BN running stats) ride along
+        for bname, b in self._buffers.items():
+            out[(f"{prefix}.{bname}" if prefix else bname)] = b
+        for lname, layer in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{lname}" if prefix else lname
+            for bname, b in layer._collect_buffers(sub_prefix).items():
+                out[bname] = b
+        return out
+
+    def _collect_buffers(self, prefix=""):
+        out = OrderedDict()
+        for bname, b in self._buffers.items():
+            out[f"{prefix}.{bname}" if prefix else bname] = b
+        for lname, layer in self._sub_layers.items():
+            sub = f"{prefix}.{lname}" if prefix else lname
+            out.update(layer._collect_buffers(sub))
+        return out
+
+    def set_dict(self, state_dict, include_sublayers=True):
+        own = self.state_dict()
+        for name, var in own.items():
+            if name in state_dict:
+                val = state_dict[name]
+                if isinstance(val, VarBase):
+                    val = val.numpy()
+                var.set_value(np.asarray(val))
+        return self
+
+    load_dict = set_dict
+    set_state_dict = set_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- forward -----------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if layers and isinstance(layers[0], (list, tuple)) and not isinstance(layers[0], Layer):
+            layers = layers[0]
+        for i, l in enumerate(layers):
+            if isinstance(l, (list, tuple)):
+                name, l = l
+            else:
+                name = str(i)
+            self.add_sublayer(name, l)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, i):
+        return list(self._sub_layers.values())[i]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, sublayer):
+        self.add_sublayer(str(len(self._sub_layers)), sublayer)
+        return self
+
+    def __getitem__(self, i):
+        return list(self._sub_layers.values())[i]
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, i):
+        return list(self._parameters.values())[i]
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __len__(self):
+        return len(self._parameters)

@@ -1,0 +1,321 @@
+"""Prebuilt dygraph layers.
+
+Reference: python/paddle/fluid/dygraph/nn.py (Conv2D, Linear, BatchNorm,
+Embedding, Pool2D, LayerNorm, Dropout, ...).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import _current_tracer
+from ..framework.dtype import VarType, convert_dtype
+from ..initializer import ConstantInitializer, NormalInitializer, XavierInitializer
+from ..param_attr import ParamAttr
+from .layers import Layer
+from .varbase import VarBase
+
+
+def _tracer():
+    t = _current_tracer()
+    if t is None:
+        raise RuntimeError("dygraph layers require fluid.dygraph.guard()")
+    return t
+
+
+def _trace(type, ins, n_out, attrs=None):
+    return _tracer().trace_op(type, ins, n_out, attrs or {})
+
+
+def _act(x, act):
+    if act is None:
+        return x
+    return _trace(act, {"X": [x]}, 1)[0]
+
+
+def _make_param(layer, attr, shape, dtype, is_bias=False, default_init=None):
+    attr = ParamAttr._to_attr(attr)
+    if attr is None:
+        return None
+    init = attr.initializer or default_init or (
+        ConstantInitializer(0.0) if is_bias else XavierInitializer()
+    )
+    name = attr.name or (layer.full_name() + ("_b" if is_bias else "_w"))
+    from ..framework import unique_name
+
+    if attr.name is None:
+        name = unique_name.generate(name)
+    p = _tracer().create_parameter(
+        name=name, shape=shape, dtype=dtype, initializer=init,
+        trainable=attr.trainable, regularizer=attr.regularizer,
+        optimize_attr={"learning_rate": attr.learning_rate},
+    )
+    return p
+
+
+class Linear(Layer):
+    """reference: dygraph/nn.py Linear."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        dtype = convert_dtype(dtype)
+        self.weight = _make_param(self, param_attr, [input_dim, output_dim], dtype)
+        self.bias = _make_param(self, bias_attr, [output_dim], dtype, is_bias=True)
+
+    def forward(self, input):
+        out = _trace("matmul", {"X": [input], "Y": [self.weight]}, 1,
+                     {"transpose_X": False, "transpose_Y": False, "alpha": 1.0})[0]
+        if self.bias is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self.bias]}, 1,
+                         {"axis": -1})[0]
+        return _act(out, self._act)
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        fsize = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+        self._attrs = {
+            "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
+            "groups": groups or 1,
+            "data_format": "NCHW",
+        }
+        dtype = convert_dtype(dtype)
+        g = groups or 1
+        fan_in = (num_channels // g) * fsize[0] * fsize[1]
+        self.weight = _make_param(
+            self, param_attr, [num_filters, num_channels // g] + fsize, dtype,
+            default_init=NormalInitializer(0.0, (2.0 / fan_in) ** 0.5),
+        )
+        self.bias = _make_param(self, bias_attr, [num_filters], dtype, is_bias=True)
+
+    def forward(self, input):
+        out = _trace("conv2d", {"Input": [input], "Filter": [self.weight]},
+                     {"Output": 1}, self._attrs)[0]
+        if self.bias is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self.bias]}, 1,
+                         {"axis": 1})[0]
+        return _act(out, self._act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, output_size=None,
+                 padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        fsize = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+        self._attrs = {
+            "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
+            "groups": groups or 1,
+            "data_format": "NCHW",
+        }
+        dtype = convert_dtype(dtype)
+        self.weight = _make_param(
+            self, param_attr, [num_channels, num_filters // (groups or 1)] + fsize,
+            dtype,
+        )
+        self.bias = _make_param(self, bias_attr, [num_filters], dtype, is_bias=True)
+
+    def forward(self, input):
+        out = _trace("conv2d_transpose",
+                     {"Input": [input], "Filter": [self.weight]},
+                     {"Output": 1}, self._attrs)[0]
+        if self.bias is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self.bias]}, 1,
+                         {"axis": 1})[0]
+        return _act(out, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size),
+            "strides": [pool_stride] * 2 if isinstance(pool_stride, int) else list(pool_stride),
+            "paddings": [pool_padding] * 2 if isinstance(pool_padding, int) else list(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, input):
+        return _trace("pool2d", {"X": [input]}, 1, self._attrs)[0]
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True, use_global_stats=False,
+                 trainable_statistics=False):
+        super().__init__()
+        self._act = act
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        dtype = convert_dtype(dtype)
+        self.weight = _make_param(self, param_attr, [num_channels], dtype,
+                                  default_init=ConstantInitializer(1.0))
+        self.bias = _make_param(self, bias_attr, [num_channels], dtype,
+                                is_bias=True)
+        self._mean = _tracer().create_parameter(
+            name=(moving_mean_name or self.full_name() + "_mean"),
+            shape=[num_channels], dtype=dtype,
+            initializer=ConstantInitializer(0.0), trainable=False)
+        self._variance = _tracer().create_parameter(
+            name=(moving_variance_name or self.full_name() + "_variance"),
+            shape=[num_channels], dtype=dtype,
+            initializer=ConstantInitializer(1.0), trainable=False)
+        self._mean.stop_gradient = True
+        self._variance.stop_gradient = True
+        self.register_buffer("_mean_buf", self._mean)
+        self.register_buffer("_variance_buf", self._variance)
+
+    def forward(self, input):
+        outs = _trace(
+            "batch_norm",
+            {"X": [input], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            {"Y": 1, "MeanOut": [self._mean], "VarianceOut": [self._variance],
+             "SavedMean": 1, "SavedVariance": 1},
+            {"momentum": self._momentum, "epsilon": self._epsilon,
+             "is_test": not self.training, "data_layout": self._data_layout,
+             "use_global_stats": self._use_global_stats},
+        )
+        y = outs[0]
+        return _act(y, self._act)
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__()
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        self.weight = _make_param(self, param_attr, list(size),
+                                  convert_dtype(dtype))
+
+    def forward(self, input):
+        return _trace("lookup_table_v2",
+                      {"W": [self.weight], "Ids": [input]}, 1,
+                      {"padding_idx": self._padding_idx})[0]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self._act = act
+        n = int(np.prod(self._shape))
+        dtype = convert_dtype(dtype)
+        self.weight = (_make_param(self, param_attr, [n], dtype,
+                                   default_init=ConstantInitializer(1.0))
+                       if scale else None)
+        self.bias = (_make_param(self, bias_attr, [n], dtype, is_bias=True)
+                     if shift else None)
+
+    def forward(self, input):
+        ins = {"X": [input]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        begin = len(input.shape) - len(self._shape)
+        outs = _trace("layer_norm", ins, {"Y": 1, "Mean": 1, "Variance": 1},
+                      {"begin_norm_axis": begin, "epsilon": self._epsilon})
+        return _act(outs[0], self._act)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, seed=None, dropout_implementation="downgrade_in_infer",
+                 is_test=False):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+        self._seed = seed
+
+    def forward(self, input):
+        outs = _trace("dropout", {"X": [input]}, {"Out": 1, "Mask": 1},
+                      {"dropout_prob": self._p, "is_test": not self.training,
+                       "fix_seed": self._seed is not None,
+                       "seed": self._seed or 0,
+                       "dropout_implementation": self._impl})
+        return outs[0]
+
+
+class PRelu(Layer):
+    def __init__(self, mode, channel=None, input_shape=None, param_attr=None,
+                 dtype="float32"):
+        super().__init__()
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [1, channel, 1, 1]
+        else:
+            shape = [1] + list(input_shape[1:])
+        self.weight = _make_param(self, param_attr, shape, convert_dtype(dtype),
+                                  default_init=ConstantInitializer(0.25))
+
+    def forward(self, input):
+        return _trace("prelu", {"X": [input], "Alpha": [self.weight]}, 1,
+                      {"mode": self._mode})[0]
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._groups = groups
+        self._epsilon = epsilon
+        self._act = act
+        dtype = convert_dtype(dtype)
+        self.weight = _make_param(self, param_attr, [channels], dtype,
+                                  default_init=ConstantInitializer(1.0))
+        self.bias = _make_param(self, bias_attr, [channels], dtype, is_bias=True)
+
+    def forward(self, input):
+        ins = {"X": [input]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        outs = _trace("group_norm", ins, {"Y": 1, "Mean": 1, "Variance": 1},
+                      {"groups": self._groups, "epsilon": self._epsilon})
+        return _act(outs[0], self._act)
+
+
+class InstanceNorm(Layer):
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__()
+        self._epsilon = epsilon
+        dtype = convert_dtype(dtype)
+        self.scale = _make_param(self, param_attr, [num_channels], dtype,
+                                 default_init=ConstantInitializer(1.0))
+        self.bias = _make_param(self, bias_attr, [num_channels], dtype,
+                                is_bias=True)
+
+    def forward(self, input):
+        outs = _trace("instance_norm",
+                      {"X": [input], "Scale": [self.scale], "Bias": [self.bias]},
+                      {"Y": 1, "SavedMean": 1, "SavedVariance": 1},
+                      {"epsilon": self._epsilon})
+        return outs[0]

@@ -1,0 +1,229 @@
+"""Dygraph Tracer: eager op execution + autograd tape.
+
+Reference: paddle/fluid/imperative/tracer.cc:45 Tracer::TraceOp (eager
+kernel dispatch + grad-node recording) and basic_engine.cc:159
+BasicEngine::Execute (queue-driven reverse walk with gradient
+accumulators).  Here TraceOp runs the op's jax lowering immediately on
+VarBase values; the tape stores the op desc + input/output value refs, and
+run_backward replays grad ops (the same program-level grad makers + vjp
+kernels as static mode) in reverse with dict-based accumulation.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import unique_name
+from ..framework.core import GRAD_SUFFIX, EMPTY_VAR_NAME, Operator
+from ..framework.dtype import VarType, to_numpy_dtype, convert_dtype
+from ..framework.place import _get_paddle_place
+from ..ops import registry
+from .varbase import ParamBase, VarBase
+
+
+class _TapeRecord:
+    __slots__ = ("op", "in_refs", "out_refs")
+
+    def __init__(self, op, in_refs, out_refs):
+        self.op = op            # Operator (block=None)
+        self.in_refs = in_refs  # {name: VarBase}
+        self.out_refs = out_refs
+
+
+class Tracer:
+    def __init__(self, place=None):
+        self.place = _get_paddle_place(place)
+        self._has_grad = True
+        self._tape: List[_TapeRecord] = []
+        self._train_mode = True
+        self._rng_key = jax.random.key(0)
+        self._params: Dict[str, ParamBase] = {}
+
+    # ------------------------------------------------------------------
+    def _next_rng(self):
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    def trace_op(self, type: str, inputs, outputs, attrs=None):
+        """Run op eagerly.  `outputs` is either an int (number of Out vars
+        to create), a dict slot->[VarBase], or a dict slot->int."""
+        attrs = dict(attrs or {})
+        in_map: Dict[str, List[str]] = {}
+        in_refs: Dict[str, VarBase] = {}
+        env: Dict[str, Any] = {}
+        requires_grad = False
+        for slot, vars_ in (inputs or {}).items():
+            if vars_ is None:
+                continue
+            if isinstance(vars_, VarBase):
+                vars_ = [vars_]
+            names = []
+            for v in vars_:
+                if v is None:
+                    names.append(EMPTY_VAR_NAME)
+                    continue
+                if not isinstance(v, VarBase):
+                    v = VarBase(v)
+                names.append(v.name)
+                in_refs[v.name] = v
+                env[v.name] = v._value
+                if not v.stop_gradient:
+                    requires_grad = True
+            in_map[slot] = names
+
+        out_map: Dict[str, List[str]] = {}
+        out_refs: Dict[str, VarBase] = {}
+        out_vars: List[VarBase] = []
+        if isinstance(outputs, int):
+            outputs = {"Out": outputs}
+        for slot, spec in (outputs or {}).items():
+            if isinstance(spec, int):
+                vs = [VarBase(None, stop_gradient=True) for _ in range(spec)]
+            else:
+                vs = [v if isinstance(v, VarBase) else VarBase(v)
+                      for v in (spec if isinstance(spec, (list, tuple)) else [spec])]
+            out_map[slot] = [v.name for v in vs]
+            for v in vs:
+                out_refs[v.name] = v
+            out_vars.extend(vs)
+
+        op = Operator.__new__(Operator)
+        op.block = None
+        op.type = type
+        op.inputs = in_map
+        op.outputs = out_map
+        op.attrs = attrs
+
+        env[registry.LowerCtx.RNG_VAR] = self._rng_key
+        registry.run_op(op, env)
+        self._rng_key = env[registry.LowerCtx.RNG_VAR]
+
+        for v in out_vars:
+            if v.name in env:
+                v._value = env[v.name]
+
+        track = (self._has_grad and requires_grad
+                 and registry.has_grad(type))
+        if track:
+            for v in out_vars:
+                v.stop_gradient = False
+            self._tape.append(_TapeRecord(op, in_refs, out_refs))
+        return out_vars
+
+    # ------------------------------------------------------------------
+    def run_backward(self, loss: VarBase, retain_graph=False):
+        """BasicEngine analog: reverse tape walk with grad accumulation."""
+        grads: Dict[str, Any] = {
+            loss.name: jnp.ones(loss.shape, to_numpy_dtype(loss.dtype))
+        }
+        for rec in reversed(self._tape):
+            op = rec.op
+            out_grad_names = [n for ns in op.outputs.values() for n in ns]
+            if not any(n in grads for n in out_grad_names):
+                continue
+            gdescs = registry.make_grad_ops(op)
+            for desc in gdescs:
+                env: Dict[str, Any] = {}
+                # forward inputs & outputs by name
+                for name, v in rec.in_refs.items():
+                    env[name] = v._value
+                for name, v in rec.out_refs.items():
+                    env[name] = v._value
+                # output grads (missing -> @EMPTY@)
+                for slot, names in list(desc["inputs"].items()):
+                    if not slot.endswith(GRAD_SUFFIX):
+                        continue
+                    new_names = []
+                    for n in names:
+                        if n.endswith(GRAD_SUFFIX) and n[: -len(GRAD_SUFFIX)] in grads:
+                            env[n] = grads[n[: -len(GRAD_SUFFIX)]]
+                            new_names.append(n)
+                        else:
+                            new_names.append(EMPTY_VAR_NAME)
+                    desc["inputs"][slot] = new_names
+                gop = Operator.__new__(Operator)
+                gop.block = None
+                gop.type = desc["type"]
+                gop.inputs = desc["inputs"]
+                gop.outputs = desc["outputs"]
+                gop.attrs = desc.get("attrs") or {}
+                registry.run_op(gop, env)
+                # accumulate produced grads
+                for slot, names in desc["outputs"].items():
+                    for n in names:
+                        if n == EMPTY_VAR_NAME or n not in env:
+                            continue
+                        if not n.endswith(GRAD_SUFFIX):
+                            continue
+                        base = n[: -len(GRAD_SUFFIX)]
+                        g = env[n]
+                        if base in grads:
+                            grads[base] = grads[base] + g
+                        else:
+                            grads[base] = g
+        # bind grads to leaf VarBases (params & non-stop-grad leaves)
+        seen: Dict[str, VarBase] = {}
+        for rec in self._tape:
+            seen.update(rec.in_refs)
+            seen.update(rec.out_refs)
+        seen[loss.name] = loss
+        for name, v in seen.items():
+            if v.stop_gradient or name not in grads:
+                continue
+            g = grads[name]
+            v._grad_value = g if v._grad_value is None else v._grad_value + g
+        if not retain_graph:
+            self._tape.clear()
+
+    # ------------------------------------------------------------------
+    # LayerHelper integration
+    def create_var(self, dtype=None, stop_gradient=False):
+        return VarBase(None, stop_gradient=stop_gradient)
+
+    def create_parameter(self, name, shape, dtype, initializer, trainable=True,
+                         regularizer=None, optimize_attr=None):
+        if name in self._params:
+            return self._params[name]
+        p = ParamBase(None, name=name, trainable=trainable,
+                      optimize_attr=optimize_attr or {"learning_rate": 1.0},
+                      regularizer=regularizer)
+        blk = _EagerBlock(self)
+        var = _FakeVar(name, tuple(shape), convert_dtype(dtype))
+        initializer(var, blk)
+        p._value = blk.env[name]
+        self._params[name] = p
+        return p
+
+
+class _FakeVar:
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+
+class _EagerBlock:
+    """Captures initializer append_op calls and runs them eagerly."""
+
+    def __init__(self, tracer: Tracer):
+        self.tracer = tracer
+        self.env: Dict[str, Any] = {}
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        op = Operator.__new__(Operator)
+        op.block = None
+        op.type = type
+        op.inputs = {k: [v if isinstance(v, str) else v.name for v in
+                         (vs if isinstance(vs, (list, tuple)) else [vs])]
+                     for k, vs in (inputs or {}).items()}
+        op.outputs = {k: [v if isinstance(v, str) else v.name for v in
+                          (vs if isinstance(vs, (list, tuple)) else [vs])]
+                      for k, vs in (outputs or {}).items()}
+        op.attrs = dict(attrs or {})
+        self.env[registry.LowerCtx.RNG_VAR] = self.tracer._rng_key
+        registry.run_op(op, self.env)
+        self.tracer._rng_key = self.env[registry.LowerCtx.RNG_VAR]
+        return op

@@ -1,0 +1,192 @@
+"""VarBase: the eager tensor.
+
+Reference: paddle/fluid/imperative/layer.cc VarBase + pybind
+imperative.cc.  Wraps a jax.Array; ops execute immediately through the
+same lowering registry as static mode (static/eager parity by
+construction, the property the reference enforces per-op in
+op_test.py:1056-1072).  Autograd is a tape of recorded ops replayed in
+reverse by the BasicEngine analog (tracer.py), reusing the program-level
+grad makers + vjp grad kernels.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import unique_name
+from ..framework.core import _current_tracer
+from ..framework.dtype import VarType, convert_dtype, to_numpy_dtype
+
+
+class VarBase:
+    def __init__(self, value=None, name: Optional[str] = None,
+                 stop_gradient: bool = True, persistable: bool = False):
+        if value is not None and not isinstance(value, jax.Array):
+            value = jnp.asarray(np.asarray(value))
+        self._value = value
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self._grad_value = None  # accumulated gradient (jax array)
+
+    # -- data access -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._value.shape) if self._value is not None else ()
+
+    @property
+    def dtype(self):
+        return convert_dtype(np.dtype(self._value.dtype)) if self._value is not None else None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        from ..framework.scope import LoDTensor
+
+        return LoDTensor(np.asarray(self._value))
+
+    def set_value(self, value):
+        if isinstance(value, VarBase):
+            value = value._value
+        self._value = jnp.asarray(np.asarray(value) if not isinstance(value, jax.Array) else value)
+
+    def detach(self):
+        return VarBase(self._value, stop_gradient=True)
+
+    def clone(self):
+        return VarBase(self._value, stop_gradient=self.stop_gradient)
+
+    def astype(self, dtype):
+        return VarBase(self._value.astype(to_numpy_dtype(dtype)),
+                       stop_gradient=self.stop_gradient)
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, retain_graph=False):
+        tracer = _current_tracer()
+        if tracer is None:
+            raise RuntimeError("backward() requires dygraph mode")
+        tracer.run_backward(self, retain_graph=retain_graph)
+
+    @property
+    def grad(self):
+        return None if self._grad_value is None else np.asarray(self._grad_value)
+
+    def gradient(self):
+        return self.grad
+
+    def clear_gradient(self):
+        self._grad_value = None
+
+    def _register_grad_hook(self, hook):
+        raise NotImplementedError("grad hooks land with a later phase")
+
+    # -- misc --------------------------------------------------------------
+    def __repr__(self):
+        return (f"VarBase(name={self.name}, shape={self.shape}, "
+                f"stop_gradient={self.stop_gradient})\n{self._value}")
+
+    def __len__(self):
+        return self.shape[0] if self.shape else 0
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __getitem__(self, idx):
+        out = VarBase(self._value[idx], stop_gradient=self.stop_gradient)
+        return out
+
+    # math ops installed by _install_math_ops below
+
+
+class ParamBase(VarBase):
+    """reference: framework.py:5064 ParamBase (dygraph parameter)."""
+
+    def __init__(self, value=None, name=None, trainable=True, **kwargs):
+        super().__init__(value, name=name, stop_gradient=not trainable,
+                         persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = kwargs.get("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kwargs.get("regularizer")
+        self.is_distributed = False
+
+    @property
+    def trainable_(self):
+        return not self.stop_gradient
+
+
+def _eager_binary(op_type, scalar_as=None):
+    def impl(self, other):
+        from ..framework.core import _current_tracer
+
+        tracer = _current_tracer()
+        if tracer is None:
+            raise RuntimeError("VarBase math requires dygraph mode")
+        if isinstance(other, (int, float)):
+            if scalar_as == "scale_mul":
+                return tracer.trace_op("scale", {"X": [self]}, 1,
+                                       {"scale": float(other), "bias": 0.0})[0]
+            if scalar_as == "scale_add":
+                return tracer.trace_op("scale", {"X": [self]}, 1,
+                                       {"scale": 1.0, "bias": float(other)})[0]
+            other = VarBase(jnp.asarray(other, to_numpy_dtype(self.dtype)))
+        elif isinstance(other, np.ndarray):
+            other = VarBase(other)
+        if not isinstance(other, VarBase):
+            return NotImplemented
+        return tracer.trace_op(op_type, {"X": [self], "Y": [other]}, 1,
+                               {"axis": -1})[0]
+
+    return impl
+
+
+def _install_math_ops():
+    VarBase.__add__ = _eager_binary("elementwise_add", scalar_as="scale_add")
+    VarBase.__radd__ = VarBase.__add__
+    VarBase.__sub__ = _eager_binary("elementwise_sub")
+    VarBase.__mul__ = _eager_binary("elementwise_mul", scalar_as="scale_mul")
+    VarBase.__rmul__ = VarBase.__mul__
+    VarBase.__truediv__ = _eager_binary("elementwise_div")
+    VarBase.__pow__ = _eager_binary("elementwise_pow")
+    VarBase.__matmul__ = _eager_binary("matmul")
+
+    def _neg(self):
+        from ..framework.core import _current_tracer
+
+        return _current_tracer().trace_op(
+            "scale", {"X": [self]}, 1, {"scale": -1.0, "bias": 0.0})[0]
+
+    VarBase.__neg__ = _neg
+
+    def _rsub(self, other):
+        if isinstance(other, (int, float)):
+            from ..framework.core import _current_tracer
+
+            return _current_tracer().trace_op(
+                "scale", {"X": [self]}, 1, {"scale": -1.0, "bias": float(other)})[0]
+        return NotImplemented
+
+    VarBase.__rsub__ = _rsub
+
+
+_install_math_ops()

@@ -462,3 +462,12 @@ def resolve(type: str) -> OpDef:
 # make run_op/get_op_def use resolve so *_grad lazily materializes
 def get_op_def(type: str) -> OpDef:  # noqa: F811
     return resolve(type)
+
+
+def eager_call(type: str, ins_vals: Dict[str, List[Any]], attrs: Dict[str, Any],
+               out_arity: Dict[str, int], rng_key=None) -> Dict[str, List[Any]]:
+    """Run one op's lowering directly on values (dygraph optimizer path)."""
+    d = get_op_def(type)
+    rctx = _ReplayCtx(ins_vals, attrs, out_arity, rng_key=rng_key)
+    d.lower(rctx)
+    return rctx.outs

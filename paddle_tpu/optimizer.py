@@ -173,6 +173,42 @@ class Optimizer:
 
         dy_base._clear_grads(self._parameter_list)
 
+    # -- dygraph eager updates ------------------------------------------
+    # (reference: dygraph mode runs the same optimizer kernels eagerly via
+    # the imperative tracer; here via registry.eager_call)
+    def _eager_lr(self):
+        import jax.numpy as jnp
+
+        lr = self._learning_rate
+        if callable(lr):
+            lr = lr()
+        return jnp.asarray([float(lr)], jnp.float32)
+
+    def _eager_regularize(self, p, g):
+        from .regularizer import L1DecayRegularizer, L2DecayRegularizer
+        import jax.numpy as jnp
+
+        reg = getattr(p, "regularizer", None) or self.regularization
+        if isinstance(reg, L2DecayRegularizer):
+            return g + reg.regularization_coeff * p._value
+        if isinstance(reg, L1DecayRegularizer):
+            return g + reg.regularization_coeff * jnp.sign(p._value)
+        return g
+
+    def _dygraph_apply(self, params_grads):
+        lr = self._eager_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            g = self._eager_regularize(p, g)
+            state = self._param_state.setdefault(p.name, {})
+            self._eager_update(p, g, state, lr)
+
+    def _eager_update(self, p, g, state, lr):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no dygraph update path yet"
+        )
+
     @property
     def current_step_lr(self):
         lr = self._learning_rate
@@ -203,6 +239,14 @@ class SGDOptimizer(Optimizer):
             outputs={"ParamOut": [p]},
         )
 
+    def _eager_update(self, p, g, state, lr):
+        from .ops.registry import eager_call
+
+        outs = eager_call("sgd",
+                          {"Param": [p._value], "Grad": [g], "LearningRate": [lr]},
+                          {}, {"ParamOut": 1})
+        p._value = outs["ParamOut"][0]
+
 
 class MomentumOptimizer(Optimizer):
     type = "momentum"
@@ -226,6 +270,23 @@ class MomentumOptimizer(Optimizer):
             outputs={"ParamOut": [p], "VelocityOut": [v]},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
         )
+
+    def _eager_update(self, p, g, state, lr):
+        import jax.numpy as jnp
+
+        from .ops.registry import eager_call
+
+        if "velocity" not in state:
+            state["velocity"] = jnp.zeros_like(p._value)
+        outs = eager_call(
+            "momentum",
+            {"Param": [p._value], "Grad": [g], "Velocity": [state["velocity"]],
+             "LearningRate": [lr]},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+            {"ParamOut": 1, "VelocityOut": 1},
+        )
+        p._value = outs["ParamOut"][0]
+        state["velocity"] = outs["VelocityOut"][0]
 
 
 class LarsMomentumOptimizer(Optimizer):
@@ -287,6 +348,36 @@ class AdamOptimizer(Optimizer):
                    "epsilon": self._epsilon},
         )
 
+    def _eager_update(self, p, g, state, lr):
+        import jax.numpy as jnp
+
+        from .ops.registry import eager_call
+
+        if "m1" not in state:
+            state["m1"] = jnp.zeros_like(p._value)
+            state["m2"] = jnp.zeros_like(p._value)
+            state["b1p"] = jnp.ones((1,), jnp.float32)
+            state["b2p"] = jnp.ones((1,), jnp.float32)
+        outs = eager_call(
+            self.type,
+            {"Param": [p._value], "Grad": [g], "Moment1": [state["m1"]],
+             "Moment2": [state["m2"]], "Beta1Pow": [state["b1p"]],
+             "Beta2Pow": [state["b2p"]], "LearningRate": [lr]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon,
+             **({"coeff": getattr(self, "_coeff", 0.0), "with_decay": True}
+                if self.type == "adamw" else {}),
+             **({"weight_decay": getattr(self, "_weight_decay", 0.0)}
+                if self.type == "lamb" else {})},
+            {"ParamOut": 1, "Moment1Out": 1, "Moment2Out": 1,
+             "Beta1PowOut": 1, "Beta2PowOut": 1},
+        )
+        p._value = outs["ParamOut"][0]
+        state["m1"] = outs["Moment1Out"][0]
+        state["m2"] = outs["Moment2Out"][0]
+        state["b1p"] = outs["Beta1PowOut"][0]
+        state["b2p"] = outs["Beta2PowOut"][0]
+
 
 class AdamWOptimizer(AdamOptimizer):
     type = "adamw"
@@ -337,6 +428,23 @@ class AdagradOptimizer(Optimizer):
             outputs={"ParamOut": [p], "MomentOut": [m]},
             attrs={"epsilon": self._epsilon},
         )
+
+    def _eager_update(self, p, g, state, lr):
+        import jax.numpy as jnp
+
+        from .ops.registry import eager_call
+
+        if "moment" not in state:
+            state["moment"] = jnp.full_like(p._value, self._initial)
+        outs = eager_call(
+            "adagrad",
+            {"Param": [p._value], "Grad": [g], "Moment": [state["moment"]],
+             "LearningRate": [lr]},
+            {"epsilon": self._epsilon},
+            {"ParamOut": 1, "MomentOut": 1},
+        )
+        p._value = outs["ParamOut"][0]
+        state["moment"] = outs["MomentOut"][0]
 
 
 class DecayedAdagradOptimizer(Optimizer):

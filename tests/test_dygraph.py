@@ -1,0 +1,149 @@
+"""Dygraph mode tests (reference analogs: test_imperative_basic.py,
+test_imperative_mnist.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+
+
+def test_varbase_math_and_backward():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        x.stop_gradient = False
+        y = x * x + 2.0
+        loss_list = fluid.layers.reduce_sum(y)
+        loss_list.backward()
+        np.testing.assert_allclose(x.grad, 2 * x.numpy(), rtol=1e-6)
+
+
+def test_linear_regression_dygraph():
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(4, 1).astype(np.float32)
+    xs = rng.randn(128, 4).astype(np.float32)
+    ys = xs @ true_w + 0.5
+
+    with dygraph.guard():
+        model = dygraph.Linear(4, 1)
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, parameter_list=model.parameters())
+        losses = []
+        for i in range(60):
+            x = dygraph.to_variable(xs)
+            y = dygraph.to_variable(ys)
+            pred = model(x)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.01, losses[-1]
+        np.testing.assert_allclose(model.weight.numpy(), true_w, atol=0.1)
+
+
+def test_dygraph_mnist_conv():
+    class SimpleConvNet(dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = dygraph.Conv2D(1, 8, 3, act="relu")
+            self.pool = dygraph.Pool2D(2, "max", 2)
+            self.fc = dygraph.Linear(8 * 5 * 5, 10)
+
+        def forward(self, x):
+            x = self.conv(x)
+            x = self.pool(x)
+            x = fluid.layers.reshape(x, [-1, 8 * 5 * 5])
+            return self.fc(x)
+
+    rng = np.random.RandomState(1)
+    templates = rng.rand(10, 1, 12, 12).astype("float32")
+    labels = rng.randint(0, 10, 128).astype("int64")
+    imgs = templates[labels] + 0.05 * rng.randn(128, 1, 12, 12).astype("float32")
+
+    with dygraph.guard():
+        model = SimpleConvNet()
+        opt = fluid.optimizer.AdamOptimizer(
+            0.01, parameter_list=model.parameters())
+        first = last = None
+        for step in range(30):
+            x = dygraph.to_variable(imgs)
+            y = dygraph.to_variable(labels[:, None])
+            logits = model(x)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            if first is None:
+                first = float(loss.numpy())
+            last = float(loss.numpy())
+        assert last < first * 0.5, (first, last)
+
+
+def test_dygraph_batchnorm_dropout_modes():
+    with dygraph.guard():
+        bn = dygraph.BatchNorm(3)
+        drop = dygraph.Dropout(0.5)
+        x = dygraph.to_variable(np.random.rand(4, 3, 5, 5).astype("float32"))
+        bn.train(); drop.train()
+        y_train = bn(x)
+        d_train = drop(x)
+        bn.eval(); drop.eval()
+        y_eval = bn(x)
+        d_eval = drop(x)
+        # eval dropout (downgrade_in_infer) = x * (1-p)
+        np.testing.assert_allclose(d_eval.numpy(), x.numpy() * 0.5, rtol=1e-6)
+        # train-mode BN uses batch stats, eval uses running -> different
+        assert not np.allclose(y_train.numpy(), y_eval.numpy())
+
+
+def test_dygraph_save_load(tmp_path):
+    with dygraph.guard():
+        model = dygraph.Linear(3, 2)
+        sd = model.state_dict()
+        dygraph.save_dygraph(sd, str(tmp_path / "m"))
+        model2 = dygraph.Linear(3, 2)
+        loaded, _ = dygraph.load_dygraph(str(tmp_path / "m"))
+        model2.set_dict(loaded)
+        np.testing.assert_allclose(model.weight.numpy(), model2.weight.numpy())
+
+
+def test_static_dygraph_parity():
+    """Same model + init + data => same loss in static and dygraph
+    (the reference's op-level parity oracle, op_test.py:1056)."""
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(6, 4).astype(np.float32)
+    b0 = np.zeros(4, np.float32)
+    x = rng.randn(8, 6).astype(np.float32)
+    y = rng.randn(8, 4).astype(np.float32)
+
+    # static
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [6])
+        yv = fluid.layers.data("y", [4])
+        from paddle_tpu.initializer import NumpyArrayInitializer
+        from paddle_tpu.param_attr import ParamAttr
+
+        pred = fluid.layers.fc(
+            xv, 4,
+            param_attr=ParamAttr(initializer=NumpyArrayInitializer(w0)),
+            bias_attr=ParamAttr(initializer=NumpyArrayInitializer(b0)))
+        loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(pred, yv))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    static_loss = float(exe.run(main, feed={"x": x, "y": y},
+                                fetch_list=[loss])[0])
+
+    # dygraph
+    with dygraph.guard():
+        model = dygraph.Linear(6, 4)
+        model.weight.set_value(w0)
+        model.bias.set_value(b0)
+        pred = model(dygraph.to_variable(x))
+        dloss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, dygraph.to_variable(y)))
+        dy_loss = float(dloss.numpy())
+    np.testing.assert_allclose(static_loss, dy_loss, rtol=1e-5)
