@@ -1,0 +1,120 @@
+"""paddle.distributed namespace: launcher + env + collective helpers.
+
+Reference: python/paddle/distributed/ (launch.py:193 multi-proc spawner,
+parallel env).  TPU-native: one process per HOST (not per device) —
+jax.distributed.initialize is the rendezvous (replaces the
+PADDLE_TRAINER_ENDPOINTS env-cluster + gen_nccl_id TCP exchange), and
+in-process devices are covered by the SPMD mesh.
+"""
+from __future__ import annotations
+
+import os
+
+from ..parallel import mesh as mesh_mod
+
+
+class ParallelEnv:
+    """reference: dygraph/parallel.py ParallelEnv (Env over PADDLE_* vars)."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world
+
+    @property
+    def world_size(self):
+        return self._world
+
+    @property
+    def dev_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus",
+                                  os.environ.get("FLAGS_selected_gpus", "0")))
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._endpoints
+
+
+Env = ParallelEnv
+
+
+def get_rank() -> int:
+    import jax
+
+    try:
+        return jax.process_index()
+    except Exception:
+        return ParallelEnv().rank
+
+
+def get_world_size() -> int:
+    import jax
+
+    try:
+        return jax.process_count()
+    except Exception:
+        return ParallelEnv().nranks
+
+
+def init_parallel_env():
+    """reference: paddle.distributed.init_parallel_env — sets up the
+    collective context.  Multi-host: jax.distributed.initialize from env;
+    always registers the default dp mesh."""
+    import jax
+
+    coord = os.environ.get("PADDLE_COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("PADDLE_NUM_PROCESSES", "1"))
+    pid = int(os.environ.get("PADDLE_PROCESS_ID", "0"))
+    if coord and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    return mesh_mod.default_dp_mesh()
+
+
+prepare_context = init_parallel_env
+
+
+def all_reduce(tensor, op="sum", group=0):
+    """Host-level collective on eager values (dygraph DP path)."""
+    import jax
+    import numpy as np
+
+    if get_world_size() <= 1:
+        return tensor
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray(tensor))
+    if op == "sum":
+        return gathered.sum(axis=0)
+    if op == "max":
+        return gathered.max(axis=0)
+    if op == "min":
+        return gathered.min(axis=0)
+    raise ValueError(op)
+
+
+def barrier(group=0):
+    import jax
+
+    if get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")

@@ -1,0 +1,79 @@
+"""Dygraph DataParallel.
+
+Reference: python/paddle/fluid/dygraph/parallel.py:225 DataParallel
+(scale_loss:292 + apply_collective_grads:384 — coalesced NCCL allreduce
+via imperative/all_reduce.cc) and imperative/nccl_context.cc
+NCCLParallelContext (TCP ncclUniqueId rendezvous).  TPU-native: the
+rendezvous is jax.distributed; grads allreduce across processes via the
+host collective (distributed.all_reduce); with a single process the mesh
+covers local chips and DataParallel is a transparent wrapper.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import distributed as dist
+from .layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._nranks = dist.get_world_size()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """reference: parallel.py:292 — scale by 1/nranks so the summed
+        allreduce of grads averages."""
+        if self._nranks <= 1:
+            return loss
+        return loss * (1.0 / self._nranks)
+
+    def apply_collective_grads(self):
+        """reference: parallel.py:384 — allreduce-sum every param grad."""
+        if self._nranks <= 1:
+            return
+        import jax.numpy as jnp
+
+        for p in self._layers.parameters():
+            if p._grad_value is not None:
+                summed = dist.all_reduce(np.asarray(p._grad_value), op="sum")
+                p._grad_value = jnp.asarray(summed)
+
+    # delegate the Layer surface to the wrapped module
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix=""):
+        return self._layers.named_parameters(prefix)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_dict(self, *a, **kw):
+        return self._layers.set_dict(*a, **kw)
+
+    load_dict = set_dict
+
+    def clear_gradients(self):
+        self._layers.clear_gradients()
+
+
+def prepare_context(strategy=None):
+    return dist.init_parallel_env()
+
+
+class ParallelStrategy:
+    """reference: imperative ParallelStrategy — kept for API parity."""
+
+    def __init__(self):
+        self.nranks = dist.get_world_size()
+        self.local_rank = dist.get_rank()
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+Env = dist.ParallelEnv

@@ -1,0 +1,10 @@
+from . import role_maker
+from . import fleet_base
+from .role_maker import (
+    Role,
+    RoleMakerBase,
+    PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
+    UserDefinedCollectiveRoleMaker,
+    TPURoleMaker,
+)

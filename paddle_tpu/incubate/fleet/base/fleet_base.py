@@ -1,0 +1,114 @@
+"""Fleet base: the unified distributed-training façade.
+
+Reference: python/paddle/fluid/incubate/fleet/base/fleet_base.py — Fleet
+abstract base (init/init_worker/init_server/distributed_optimizer/
+minimize/save_*) + DistributedOptimizer base.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+from .role_maker import RoleMakerBase
+
+
+class Mode:
+    TRANSPILER = 1
+    PSLIB = 2
+    COLLECTIVE = 3
+
+
+class Fleet(abc.ABC):
+    def __init__(self, mode):
+        self._is_initialized = False
+        self._mode = mode
+        self._optimizer = None
+        self._role_maker: Optional[RoleMakerBase] = None
+
+    # -- role facts ------------------------------------------------------
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def server_index(self):
+        return self._role_maker.server_index()
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    # -- lifecycle -------------------------------------------------------
+    def init(self, role_maker: Optional[RoleMakerBase] = None):
+        from .role_maker import PaddleCloudRoleMaker
+
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker(
+                is_collective=(self._mode == Mode.COLLECTIVE)
+            )
+        self._role_maker = role_maker
+        role_maker.generate_role()
+        self._is_initialized = True
+
+    @abc.abstractmethod
+    def init_worker(self):
+        ...
+
+    @abc.abstractmethod
+    def init_server(self, model_dir=None):
+        ...
+
+    @abc.abstractmethod
+    def run_server(self):
+        ...
+
+    @abc.abstractmethod
+    def stop_worker(self):
+        ...
+
+    @abc.abstractmethod
+    def distributed_optimizer(self, optimizer, strategy=None):
+        ...
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(loss, startup_program, parameter_list,
+                                        no_grad_set)
+
+
+class DistributedOptimizer(abc.ABC):
+    """reference: fleet_base.py DistributedOptimizer."""
+
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program, parameter_list,
+                                        no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    @abc.abstractmethod
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ...

@@ -1,0 +1,165 @@
+"""Role makers: cluster topology discovery.
+
+Capability parity with reference: python/paddle/fluid/incubate/fleet/base/
+role_maker.py (RoleMakerBase:68, MPIRoleMaker:186, PaddleCloudRoleMaker
+:477, UserDefinedRoleMaker:988, UserDefinedCollectiveRoleMaker:1064,
+GeneralRoleMaker:578 with Gloo/HTTP rendezvous).  TPU-native: the
+rendezvous mechanism is the JAX coordination service
+(jax.distributed.initialize) instead of MPI/Gloo/HTTP; env-variable role
+discovery (PADDLE_TRAINER_ID & co) is kept verbatim so PaddleCloud-style
+launchers keep working.
+"""
+from __future__ import annotations
+
+import os
+from enum import IntEnum
+from typing import List, Optional
+
+
+class Role(IntEnum):
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints: List[str] = []
+        self._server_endpoints: List[str] = []
+        self._role: Optional[Role] = None
+        self._current_id = -1
+        self._generate = False
+
+    def generate_role(self):
+        raise NotImplementedError
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self) -> int:
+        return self._current_id
+
+    def server_index(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return len(self._worker_endpoints) or 1
+
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def role_id(self):
+        return self._current_id
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """reference: role_maker.py:477 — roles from PaddleCloud env vars."""
+
+    def __init__(self, is_collective: bool = False):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        if self._generate:
+            return
+        if self._is_collective:
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = eps.split(",") if eps else ["127.0.0.1:6170"]
+            self._role = Role.WORKER
+        else:
+            role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+            eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = eps.split(",") if eps else []
+            weps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = weps.split(",") if weps else []
+            if role == "TRAINER":
+                self._role = Role.WORKER
+                self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            else:
+                self._role = Role.SERVER
+                cur = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+                self._current_id = (
+                    self._server_endpoints.index(cur)
+                    if cur in self._server_endpoints else 0
+                )
+        self._generate = True
+
+
+class TPURoleMaker(RoleMakerBase):
+    """TPU-native role maker: one process per host over the JAX
+    coordination service (replaces gen_nccl_id TCP rendezvous,
+    reference imperative/nccl_context.cc:21-113)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def generate_role(self):
+        if self._generate:
+            return
+        import jax
+
+        coord = os.environ.get("PADDLE_COORDINATOR_ADDRESS")
+        nproc = int(os.environ.get("PADDLE_NUM_PROCESSES", "1"))
+        pid = int(os.environ.get("PADDLE_PROCESS_ID", "0"))
+        if coord and nproc > 1:
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=nproc, process_id=pid
+            )
+        self._current_id = pid
+        self._worker_endpoints = [f"proc:{i}" for i in range(nproc)]
+        self._role = Role.WORKER
+        self._generate = True
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """reference: role_maker.py:988."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = Role(role)
+        self._worker_num = worker_num
+        self._server_endpoints = server_endpoints or []
+
+    def generate_role(self):
+        self._generate = True
+
+    def worker_num(self):
+        return self._worker_num
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    """reference: role_maker.py:1064."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._worker_endpoints = worker_endpoints or ["127.0.0.1:6170"]
+        self._role = Role.WORKER
+
+    def generate_role(self):
+        self._generate = True
+
+
+class MPIRoleMaker(RoleMakerBase):
+    """reference: role_maker.py:186 — MPI discovery.  MPI is not part of
+    the TPU stack; use TPURoleMaker (coordination service) instead."""
+
+    def __init__(self):
+        raise NotImplementedError(
+            "MPI role discovery is replaced by TPURoleMaker over the JAX "
+            "coordination service"
+        )

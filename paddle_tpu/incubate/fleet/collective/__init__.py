@@ -1,0 +1,137 @@
+"""Fleet collective mode: SPMD data-parallel training over the mesh.
+
+Reference: python/paddle/fluid/incubate/fleet/collective/__init__.py
+(Collective fleet:64, CollectiveOptimizer:384, DistributedStrategy:334,
+_try_to_compile:516-540 with hierarchical-allreduce setup).  TPU-native:
+minimize() runs the user optimizer then applies the GradAllReduce
+transpile; the rewritten program executes as one SPMD program under
+shard_map (the c_allreduce_sum ops lower to psum on ICI), so
+hierarchical allreduce / multi-ring / nccl_comm_num knobs become mesh
+shape choices (ICI×DCN axes) rather than comm objects.
+"""
+from __future__ import annotations
+
+import os
+
+from ....framework.core import default_main_program, default_startup_program
+from ....parallel.compiled_program import BuildStrategy, ExecutionStrategy
+from ....parallel import mesh as mesh_mod
+from ....transpiler.collective import GradAllReduce, LocalSGD
+from ..base.fleet_base import DistributedOptimizer, Fleet, Mode
+
+
+class DistributedStrategy:
+    """reference: fleet/collective/__init__.py:334."""
+
+    def __init__(self):
+        self.use_local_sgd = False
+        self.local_sgd_k_steps = 1
+        self.use_dgc = False
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 8
+        self.fuse_all_reduce_ops = True
+        self.exec_strategy = ExecutionStrategy()
+        self.build_strategy = BuildStrategy()
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+
+
+class Collective(Fleet):
+    """reference: fleet/collective/__init__.py:64."""
+
+    def __init__(self):
+        super().__init__(Mode.COLLECTIVE)
+        self._local_ip = 0
+        self.startup_program = None
+        self.main_program = None
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, model_dir=None):
+        raise NotImplementedError("Collective mode has no servers")
+
+    def run_server(self):
+        raise NotImplementedError("Collective mode has no servers")
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy, fleet=self)
+        return self._optimizer
+
+    def compiled_program(self, loss_name=None):
+        """The ParallelExecutor-compat execution handle for the transpiled
+        program (reference runs fleet.main_program in N processes; here one
+        SPMD program over the mesh)."""
+        from ....parallel.compiled_program import CompiledProgram
+
+        return CompiledProgram(self.main_program).with_data_parallel(
+            loss_name=loss_name
+        )
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+
+        return io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program or self.main_program,
+        )
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          filename=None):
+        from .... import io
+
+        return io.save_persistables(executor, dirname,
+                                    main_program or self.main_program, filename)
+
+
+fleet = Collective()
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """reference: fleet/collective/__init__.py:384."""
+
+    def __init__(self, optimizer, strategy=None, fleet=None):
+        super().__init__(optimizer, strategy or DistributedStrategy())
+        self._fleet = fleet
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        f = self._fleet
+        main_program = loss.block.program
+        startup_program = startup_program or default_startup_program()
+
+        optimize_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+
+        nranks = f.worker_num() if f is not None and f._is_initialized else 1
+        rank = f.worker_index() if f is not None and f._is_initialized else 0
+        # SPMD: ranks in one process == devices on the mesh
+        mesh = mesh_mod.default_dp_mesh()
+        nranks = max(nranks, mesh.size)
+
+        strategy = self._strategy
+        if strategy.use_local_sgd:
+            t = LocalSGD(nrings=strategy.nccl_comm_num,
+                         k_steps=strategy.local_sgd_k_steps)
+        else:
+            t = GradAllReduce(nrings=strategy.nccl_comm_num)
+        t.transpile(
+            startup_program=startup_program,
+            main_program=main_program,
+            rank=rank,
+            endpoints=f.worker_endpoints() if f and f._is_initialized else None,
+            nranks=nranks,
+        )
+        if f is not None:
+            f.main_program = main_program
+            f.startup_program = startup_program
+        return optimize_ops, params_grads

@@ -20,3 +20,4 @@ from . import math_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import collective_ops  # noqa: F401

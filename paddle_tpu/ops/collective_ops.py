@@ -1,0 +1,194 @@
+"""Collective op lowerings: `c_*` ops retargeted from NCCL rings to XLA
+collectives over mesh axes.
+
+Capability parity with reference: paddle/fluid/operators/collective/
+(c_allreduce_op.h:58-106, c_broadcast_op, c_allgather_op,
+c_reducescatter_op, c_comm_init_op, c_gen_nccl_id_op,
+c_sync_calc_stream_op, c_sync_comm_stream_op) — the north star's "Fleet
+collective mode retargets from NCCL rings to ICI allreduce".
+
+Semantics: inside a shard_map region (the executor's SPMD path), each op
+lowers to the matching lax collective over the axis its ring_id maps to
+(parallel/mesh.py registry).  Outside any mesh (single-device执行) they are
+identity — a 1-rank world, matching the reference's behavior when
+nranks==1.  Stream-sync ops are no-ops: XLA's dataflow order subsumes
+cudaStreamSynchronize.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+def _axis(ctx):
+    from ..parallel.mesh import registry
+
+    ring_id = ctx.attr("ring_id", 0)
+    axis = registry().axis_for_ring(ring_id)
+    return axis
+
+
+def _in_shard_map(axis):
+    """True if `axis` is a bound axis name in the current trace (i.e. we
+    are inside shard_map/pmap and the collective is meaningful)."""
+    if axis is None:
+        return False
+    try:
+        lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def _allreduce(reduce_fn):
+    def lower(ctx):
+        x = ctx.in_("X")
+        axis = _axis(ctx)
+        if _in_shard_map(axis):
+            x = reduce_fn(x, axis)
+        ctx.set_out("Out", x)
+
+    return lower
+
+
+op("c_allreduce_sum", no_grad=True)(_allreduce(lambda x, a: lax.psum(x, a)))
+op("c_allreduce_max", no_grad=True)(_allreduce(lambda x, a: lax.pmax(x, a)))
+op("c_allreduce_min", no_grad=True)(_allreduce(lambda x, a: lax.pmin(x, a)))
+op("c_allreduce_prod", no_grad=True)(
+    _allreduce(lambda x, a: jnp.exp(lax.psum(jnp.log(x), a)))
+)
+op("allreduce", no_grad=True)(_allreduce(lambda x, a: lax.psum(x, a)))
+
+
+@op("c_broadcast", no_grad=True)
+def _c_broadcast(ctx):
+    x = ctx.in_("X")
+    axis = _axis(ctx)
+    root = ctx.attr("root", 0)
+    if _in_shard_map(axis):
+        # take root's value on every shard
+        gathered = lax.all_gather(x, axis)
+        x = gathered[root]
+    ctx.set_out("Out", x)
+
+
+op("broadcast", no_grad=True)(lambda ctx: _c_broadcast(ctx))
+
+
+@op("c_allgather", no_grad=True)
+def _c_allgather(ctx):
+    x = ctx.in_("X")
+    axis = _axis(ctx)
+    if _in_shard_map(axis):
+        x = lax.all_gather(x, axis, axis=0, tiled=True)
+    ctx.set_out("Out", x)
+
+
+@op("c_reducescatter", no_grad=True)
+def _c_reducescatter(ctx):
+    x = ctx.in_("X")
+    axis = _axis(ctx)
+    if _in_shard_map(axis):
+        x = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    ctx.set_out("Out", x)
+
+
+@op("c_concat", no_grad=True)
+def _c_concat(ctx):
+    x = ctx.in_("X")
+    axis = _axis(ctx)
+    if _in_shard_map(axis):
+        x = lax.all_gather(x, axis, axis=-1, tiled=True)
+    ctx.set_out("Out", x)
+
+
+@op("c_split", no_grad=True)
+def _c_split(ctx):
+    x = ctx.in_("X")
+    axis = _axis(ctx)
+    if _in_shard_map(axis):
+        from ..parallel.mesh import current_mesh
+
+        idx = lax.axis_index(axis)
+        nranks = lax.axis_size(axis)
+        d = jnp.shape(x)[-1] // nranks
+        x = lax.dynamic_slice_in_dim(x, idx * d, d, axis=-1)
+    ctx.set_out("Out", x)
+
+
+@op("c_identity")
+def _c_identity(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
+@op("alltoall", no_grad=True)
+def _alltoall(ctx):
+    x = ctx.in_("X")
+    axis = _axis(ctx)
+    if _in_shard_map(axis):
+        n = lax.axis_size(axis)
+        xs = jnp.reshape(x, (n, jnp.shape(x)[0] // n) + jnp.shape(x)[1:])
+        xs = lax.all_to_all(xs, axis, split_axis=0, concat_axis=0, tiled=False)
+        x = jnp.reshape(xs, (-1,) + jnp.shape(x)[1:])
+    ctx.set_out("Out", x)
+
+
+# -- bootstrap / sync ops: no-ops under XLA ordering (kept for program
+#    compatibility; reference inserts them around every collective) --------
+@op("c_sync_calc_stream", no_grad=True)
+def _c_sync_calc(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
+@op("c_sync_comm_stream", no_grad=True)
+def _c_sync_comm(ctx):
+    xs = ctx.ins("X")
+    ctx.set_out("Out", xs)
+
+
+@op("c_comm_init", no_grad=True)
+def _c_comm_init(ctx):
+    """reference: c_comm_init_op.cc — creates a NCCL comm for a ring.
+    Here: registers ring->axis in the mesh registry (host-side effect)."""
+    from ..parallel.mesh import registry, current_mesh
+
+    ring_id = ctx.attr("ring_id", 0)
+    mesh = current_mesh()
+    if mesh is not None:
+        registry().register_ring(ring_id, mesh.axis_names[0])
+
+
+@op("c_comm_init_all", no_grad=True)
+def _c_comm_init_all(ctx):
+    _c_comm_init(ctx)
+
+
+@op("c_gen_nccl_id", no_grad=True)
+def _c_gen_nccl_id(ctx):
+    """reference: c_gen_nccl_id_op.cc — ncclUniqueId rendezvous over TCP.
+    The JAX coordination service (jax.distributed.initialize) already
+    performed rendezvous; nothing to do."""
+
+
+@op("c_wait_calc_stream", no_grad=True)
+def _c_wait_calc(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
+@op("c_wait_comm_stream", no_grad=True)
+def _c_wait_comm(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
+@op("barrier", no_grad=True)
+def _barrier(ctx):
+    x = ctx.in_("X") if ctx.has_input("X") else None
+    axis = _axis(ctx)
+    if x is not None and _in_shard_map(axis):
+        # data-dependent barrier: psum of zeros ties all shards
+        x = x + jnp.zeros_like(x) * lax.psum(jnp.zeros((), jnp.float32), axis)
+    if x is not None:
+        ctx.set_out("Out", x)

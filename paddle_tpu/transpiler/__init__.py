@@ -1,0 +1,5 @@
+from .collective import GradAllReduce, LocalSGD, Collective
+from .distribute_transpiler import (
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)

