@@ -25,3 +25,6 @@ from .nn import (
     InstanceNorm,
 )
 from .checkpoint import save_dygraph, load_dygraph
+from . import jit
+from .jit import TracedLayer, jit_train_step, compiled_forward
+from .parallel import DataParallel, prepare_context

@@ -1,0 +1,216 @@
+"""dygraph jit: whole-step compilation + program tracing.
+
+Reference: python/paddle/fluid/dygraph/jit.py (TracedLayer over
+imperative/jit/program_desc_tracer.cc) and dygraph_to_static/
+program_translator.py (declarative/to_static).  Two TPU-native paths:
+
+* ``compiled_step`` / ``jit_train_step``: functionalize an eager train
+  step (params/optimizer-state as pytree inputs) and jax.jit the whole
+  thing — eager UX with static-graph speed.  This is the idiomatic TPU
+  replacement for the AST transpiler: instead of rewriting Python to
+  Program ops, the eager ops *are* jax ops, so the step function jits
+  directly.
+* ``TracedLayer.trace``: record the eager forward into a real Program
+  (the ProgramDescTracer analog) for save_inference_model export.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import numpy as np
+
+from ..framework import unique_name
+from ..framework.core import Program, _current_tracer
+from ..framework.dtype import convert_dtype
+from ..ops import registry
+from .varbase import VarBase
+
+
+def jit_train_step(model, optimizer, loss_fn: Callable):
+    """Compile an eager train step: loss_fn(model, *varbase_inputs) -> loss.
+
+    Returns step(*numpy_or_jax_inputs) -> loss VarBase; parameters and
+    optimizer state update in place, but all math runs inside ONE jitted
+    XLA program (forward + tape backward + optimizer update fused).
+    """
+    params = model.parameters()
+
+    def raw_step(param_vals, opt_state, rng, inputs):
+        tracer = _current_tracer()
+        old_vals = [p._value for p in params]
+        old_tape = tracer._tape
+        old_rng = tracer._rng_key
+        old_state = optimizer._param_state
+        try:
+            for p, v in zip(params, param_vals):
+                p._value = v
+            tracer._tape = []
+            tracer._rng_key = rng
+            optimizer._param_state = opt_state
+            in_vars = [VarBase(v) for v in inputs]
+            loss = loss_fn(model, *in_vars)
+            tracer.run_backward(loss)
+            pgs = [(p, p._grad_value) for p in params
+                   if p._grad_value is not None]
+            optimizer._dygraph_apply(pgs)
+            for p in params:
+                p._grad_value = None
+            new_param_vals = [p._value for p in params]
+            new_state = optimizer._param_state
+            new_rng = tracer._rng_key
+            return loss._value, new_param_vals, new_state, new_rng
+        finally:
+            for p, v in zip(params, old_vals):
+                p._value = v
+            tracer._tape = old_tape
+            tracer._rng_key = old_rng
+            optimizer._param_state = old_state
+
+    jitted = jax.jit(raw_step, donate_argnums=(0, 1))
+
+    def step(*inputs):
+        tracer = _current_tracer()
+        if tracer is None:
+            raise RuntimeError("jit_train_step requires dygraph mode")
+        param_vals = [p._value for p in params]
+        inputs = [np.asarray(x) if not isinstance(x, jax.Array) else x
+                  for x in (i._value if isinstance(i, VarBase) else i
+                            for i in inputs)]
+        loss_val, new_params, new_state, new_rng = jitted(
+            param_vals, optimizer._param_state, tracer._rng_key, list(inputs)
+        )
+        for p, v in zip(params, new_params):
+            p._value = v
+        optimizer._param_state = new_state
+        tracer._rng_key = new_rng
+        return VarBase(loss_val, stop_gradient=True)
+
+    return step
+
+
+def compiled_forward(model_or_fn):
+    """jit an eager forward (inference) function/layer."""
+    layer = model_or_fn
+    params = layer.parameters() if hasattr(layer, "parameters") else []
+
+    def raw(param_vals, rng, inputs):
+        tracer = _current_tracer()
+        old_vals = [p._value for p in params]
+        old_rng = tracer._rng_key
+        try:
+            for p, v in zip(params, param_vals):
+                p._value = v
+            tracer._rng_key = rng
+            outs = layer(*[VarBase(v) for v in inputs])
+            single = not isinstance(outs, (list, tuple))
+            outs_t = [outs] if single else list(outs)
+            return [o._value for o in outs_t], single
+        finally:
+            for p, v in zip(params, old_vals):
+                p._value = v
+            tracer._rng_key = old_rng
+
+    jitted = jax.jit(raw, static_argnums=())
+
+    def fwd(*inputs):
+        tracer = _current_tracer()
+        inputs = [i._value if isinstance(i, VarBase) else np.asarray(i)
+                  for i in inputs]
+        outs, single = jitted([p._value for p in params], tracer._rng_key,
+                              list(inputs))
+        outs = [VarBase(o, stop_gradient=True) for o in outs]
+        return outs[0] if single else outs
+
+    return fwd
+
+
+class TracedLayer:
+    """reference: dygraph/jit.py TracedLayer — record eager forward into a
+    Program, runnable standalone and exportable via save_inference_model."""
+
+    def __init__(self, program: Program, feed_names, fetch_names, param_values):
+        self.program = program
+        self._feed_names = feed_names
+        self._fetch_names = fetch_names
+        self._param_values = param_values  # name -> np array
+
+    @staticmethod
+    def trace(layer, inputs: Sequence[VarBase]):
+        tracer = _current_tracer()
+        if tracer is None:
+            raise RuntimeError("TracedLayer.trace requires dygraph mode")
+        capture: List = []
+        tracer._program_capture = capture
+        try:
+            outs = layer(*inputs)
+        finally:
+            tracer._program_capture = None
+        single = not isinstance(outs, (list, tuple))
+        out_list = [outs] if single else list(outs)
+
+        prog = Program()
+        block = prog.global_block()
+        param_values = {}
+        known = set()
+
+        def ensure_var(name, vb, persistable=False):
+            if name in known or name == "@EMPTY@":
+                return
+            known.add(name)
+            from .varbase import ParamBase
+
+            is_param = isinstance(vb, ParamBase)
+            block.create_var(
+                name=name,
+                shape=vb.shape if vb is not None else (),
+                dtype=vb.dtype if vb is not None and vb._value is not None
+                else convert_dtype("float32"),
+                persistable=persistable or is_param,
+            )
+            if is_param:
+                param_values[name] = vb.numpy()
+
+        for in_v in inputs:
+            ensure_var(in_v.name, in_v)
+            block.vars[in_v.name].is_data = True
+        for rec in capture:
+            for name, vb in rec.in_refs.items():
+                ensure_var(name, vb)
+            for name, vb in rec.out_refs.items():
+                ensure_var(name, vb)
+            block.append_op(rec.op.type, inputs=rec.op.inputs,
+                            outputs=rec.op.outputs, attrs=rec.op.attrs)
+
+        traced = TracedLayer(prog, [v.name for v in inputs],
+                             [o.name for o in out_list], param_values)
+        return (outs, traced)
+
+    def __call__(self, inputs):
+        import paddle_tpu as pt
+        from ..framework.scope import Scope
+
+        scope = Scope()
+        for name, val in self._param_values.items():
+            scope.set(name, val)
+        exe = pt.Executor(pt.CPUPlace())
+        feed = {n: (v.numpy() if isinstance(v, VarBase) else np.asarray(v))
+                for n, v in zip(self._feed_names, inputs)}
+        return exe.run(self.program, feed=feed, fetch_list=self._fetch_names,
+                       scope=scope)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        import paddle_tpu as pt
+        from .. import io
+        from ..framework.scope import Scope, scope_guard
+
+        scope = Scope()
+        for name, val in self._param_values.items():
+            scope.set(name, val)
+        with scope_guard(scope):
+            exe = pt.Executor(pt.CPUPlace())
+            io.save_inference_model(
+                dirname, self._feed_names,
+                [self.program.global_block().var(n) for n in self._fetch_names],
+                exe, main_program=self.program,
+            )

@@ -41,6 +41,10 @@ class Tracer:
         self._train_mode = True
         self._rng_key = jax.random.key(0)
         self._params: Dict[str, ParamBase] = {}
+        # program capture hook (ProgramDescTracer analog,
+        # reference: imperative/jit/program_desc_tracer.cc): when set,
+        # every traced op is appended regardless of grad requirements.
+        self._program_capture: Optional[List[_TapeRecord]] = None
 
     # ------------------------------------------------------------------
     def _next_rng(self):
@@ -111,6 +115,8 @@ class Tracer:
             for v in out_vars:
                 v.stop_gradient = False
             self._tape.append(_TapeRecord(op, in_refs, out_refs))
+        if self._program_capture is not None:
+            self._program_capture.append(_TapeRecord(op, in_refs, out_refs))
         return out_vars
 
     # ------------------------------------------------------------------

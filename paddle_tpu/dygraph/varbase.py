@@ -113,8 +113,37 @@ class VarBase:
         return a.astype(dtype) if dtype is not None else a
 
     def __getitem__(self, idx):
-        out = VarBase(self._value[idx], stop_gradient=self.stop_gradient)
-        return out
+        tracer = _current_tracer()
+        if tracer is not None and not self.stop_gradient:
+            # lower to traced slice(+squeeze) ops so gradients flow
+            if not isinstance(idx, tuple):
+                idx = (idx,)
+            axes, starts, ends, decrease = [], [], [], []
+            ok = True
+            for ax, ix in enumerate(idx):
+                if isinstance(ix, int):
+                    axes.append(ax)
+                    starts.append(ix)
+                    ends.append(ix + 1 if ix != -1 else 2 ** 31 - 1)
+                    decrease.append(ax)
+                elif isinstance(ix, slice):
+                    if ix.step not in (None, 1):
+                        ok = False
+                        break
+                    if ix.start is None and ix.stop is None:
+                        continue
+                    axes.append(ax)
+                    starts.append(ix.start or 0)
+                    ends.append(ix.stop if ix.stop is not None else 2 ** 31 - 1)
+                else:
+                    ok = False
+                    break
+            if ok:
+                return tracer.trace_op(
+                    "slice", {"Input": [self]}, 1,
+                    {"axes": axes, "starts": starts, "ends": ends,
+                     "decrease_axis": decrease})[0]
+        return VarBase(self._value[idx], stop_gradient=self.stop_gradient)
 
     # math ops installed by _install_math_ops below
 

@@ -152,6 +152,15 @@ def _prune_for_inference(program: Program, feed_names, fetch_names) -> Program:
             needed.update(n for n in op_.input_arg_names if n != "@EMPTY@")
     keep.reverse()
     block.ops = keep
+    # drop vars no longer referenced (keeps the exported desc minimal and
+    # makes load_inference_model's persistable scan exact)
+    referenced = set(feed_names) | set(fetch_names)
+    for op_ in keep:
+        referenced.update(op_.input_arg_names)
+        referenced.update(op_.output_arg_names)
+    for name in list(block.vars):
+        if name not in referenced:
+            del block.vars[name]
     return pruned
 
 
@@ -180,7 +189,14 @@ def save_inference_model(
     with open(os.path.join(dirname, model_filename), "w") as f:
         json.dump(meta, f)
     if not program_only:
-        save_params(executor, dirname, main_program, filename=params_filename)
+        # persistables referenced by the pruned program (reference saves
+        # persistables, not only Parameter instances — io.py:1093)
+        needed = {n for op_ in pruned.global_block().ops
+                  for n in op_.input_arg_names}
+        vars_ = [v for v in main_program.list_vars()
+                 if _is_persistable(v) and v.name in needed]
+        save_vars(executor, dirname, main_program, vars=vars_,
+                  filename=params_filename)
     return fetch_names
 
 
@@ -195,7 +211,7 @@ def load_inference_model(
     with open(os.path.join(dirname, model_filename)) as f:
         meta = json.load(f)
     program = Program.from_desc_dict(meta["program"])
-    load_vars(executor, dirname, program, predicate=_is_parameter,
+    load_vars(executor, dirname, program, predicate=_is_persistable,
               filename=params_filename)
     block = program.global_block()
     fetch_vars = [block.var(n) for n in meta["fetch_names"]]

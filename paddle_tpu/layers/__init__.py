@@ -32,6 +32,18 @@ from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()
 
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import (  # noqa: F401
+    noam_decay,
+    exponential_decay,
+    natural_exp_decay,
+    inverse_time_decay,
+    polynomial_decay,
+    piecewise_decay,
+    cosine_decay,
+    linear_lr_warmup,
+)
+
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
          type=VarType.LOD_TENSOR, stop_gradient=True):

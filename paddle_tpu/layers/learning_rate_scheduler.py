@@ -1,0 +1,133 @@
+"""LR schedulers as in-program ops.
+
+Reference: python/paddle/fluid/layers/learning_rate_scheduler.py — each
+scheduler builds ops computing the LR from a global step counter so the
+schedule is part of the (jitted) program, exactly like the reference.
+"""
+from __future__ import annotations
+
+import math
+
+from ..backward import OP_ROLE_KEY, OpRole
+from ..framework import unique_name
+from ..framework.core import default_main_program, default_startup_program
+from ..framework.dtype import VarType
+from ..layer_helper import LayerHelper
+from . import tensor as tensor_layers
+from . import nn as nn_layers
+
+
+def _global_step_counter():
+    """Autoincrementing float step counter (reference:
+    layers/tensor.py autoincreased_step_counter)."""
+    helper = LayerHelper("global_step_counter")
+    name = "@LR_DECAY_COUNTER@"
+    main_block = default_main_program().global_block()
+    if main_block.has_var(name):
+        return main_block.var(name)
+    var = main_block.create_var(name=name, shape=(1,), dtype=VarType.FP32,
+                                persistable=True, stop_gradient=True)
+    startup = default_startup_program().global_block()
+    startup.create_var(name=name, shape=(1,), dtype=VarType.FP32,
+                       persistable=True)
+    startup.append_op("fill_constant", outputs={"Out": [name]},
+                      attrs={"shape": [1], "value": 0.0,
+                             "dtype": int(VarType.FP32)})
+    main_block._prepend_op(
+        "increment", inputs={"X": [name]}, outputs={"Out": [name]},
+        attrs={"step": 1.0, OP_ROLE_KEY: OpRole.LRSched})
+    return var
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """reference: learning_rate_scheduler.py noam_decay."""
+    step = _global_step_counter()
+    a = step ** -0.5
+    b = step * (warmup_steps ** -1.5)
+    lr = learning_rate * (d_model ** -0.5) * nn_layers.elementwise_min(a, b)
+    return lr
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn_layers.floor(div)
+    return learning_rate * (float(decay_rate) ** div)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn_layers.floor(div)
+    return learning_rate * nn_layers.exp(-1.0 * decay_rate * div)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn_layers.floor(div)
+    return learning_rate / (1.0 + decay_rate * div)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _global_step_counter()
+    if cycle:
+        div = nn_layers.ceil(step / float(decay_steps))
+        one = tensor_layers.fill_constant([1], "float32", 1.0)
+        div = nn_layers.elementwise_max(div, one)
+        decay_steps_var = div * float(decay_steps)
+    else:
+        decay_steps_var = tensor_layers.fill_constant(
+            [1], "float32", float(decay_steps))
+        step = nn_layers.elementwise_min(
+            step, tensor_layers.fill_constant([1], "float32", float(decay_steps)))
+    frac = step / decay_steps_var
+    return ((learning_rate - end_learning_rate) *
+            ((1.0 - frac) ** power)) + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    """reference: piecewise_decay — nested selects over step boundaries."""
+    assert len(values) == len(boundaries) + 1
+    step = _global_step_counter()
+    lr = tensor_layers.fill_constant([1], "float32", values[-1])
+    # build from the last boundary backwards: step < b -> v
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        bvar = tensor_layers.fill_constant([1], "float32", float(b))
+        cond = nn_layers.elementwise_sub(step, bvar)  # <0 if step<b
+        helper = LayerHelper("piecewise_decay")
+        is_lt = helper.create_variable_for_type_inference(VarType.BOOL)
+        helper.append_op("less_than", inputs={"X": [step], "Y": [bvar]},
+                         outputs={"Out": [is_lt]}, attrs={"axis": -1})
+        vvar = tensor_layers.fill_constant([1], "float32", float(v))
+        lr = nn_layers.where(is_lt, vvar, lr)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _global_step_counter()
+    epoch = nn_layers.floor(step / float(step_each_epoch))
+    return learning_rate * 0.5 * (
+        nn_layers.cos(epoch * (math.pi / float(epochs))) + 1.0
+    )
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """reference: linear_lr_warmup — linear ramp, then the wrapped lr."""
+    step = _global_step_counter()
+    wvar = tensor_layers.fill_constant([1], "float32", float(warmup_steps))
+    helper = LayerHelper("lr_warmup")
+    in_warmup = helper.create_variable_for_type_inference(VarType.BOOL)
+    helper.append_op("less_than", inputs={"X": [step], "Y": [wvar]},
+                     outputs={"Out": [in_warmup]}, attrs={"axis": -1})
+    warm = start_lr + (end_lr - start_lr) * (step / float(warmup_steps))
+    from ..framework.core import Variable
+
+    if not isinstance(learning_rate, Variable):
+        learning_rate = tensor_layers.fill_constant(
+            [1], "float32", float(learning_rate))
+    return nn_layers.where(in_warmup, warm, learning_rate)

@@ -1,0 +1,3 @@
+from . import resnet
+from . import bert
+from . import lenet

@@ -1,0 +1,178 @@
+"""BERT / ERNIE-base encoder — dygraph (BASELINE.json config #3:
+PaddleNLP ERNIE-base / BERT-base, Dygraph mode).
+
+Standard transformer encoder with pre-softmax scaled dot-product
+attention; built from dygraph Layers so every op traces through the same
+lowering registry.  On TPU the attention matmuls map to the MXU; the
+fused-attention Pallas kernel (ops/pallas_kernels.py) replaces the naive
+composition when enabled.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import layers as F
+from ..dygraph import Dropout, Embedding, Layer, LayerList, LayerNorm, Linear
+from ..initializer import TruncatedNormalInitializer
+from ..param_attr import ParamAttr
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+
+
+def base_config(**kw):
+    return BertConfig(**kw)
+
+
+def _init(cfg):
+    return ParamAttr(initializer=TruncatedNormalInitializer(0.0, cfg.initializer_range))
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.n_head = cfg.num_attention_heads
+        self.d_head = h // self.n_head
+        self.q = Linear(h, h, param_attr=_init(cfg))
+        self.k = Linear(h, h, param_attr=_init(cfg))
+        self.v = Linear(h, h, param_attr=_init(cfg))
+        self.out = Linear(h, h, param_attr=_init(cfg))
+        self.drop = Dropout(cfg.attention_probs_dropout_prob,
+                            dropout_implementation="upscale_in_train")
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+
+        def split_heads(t):
+            t = F.reshape(t, [b, s, self.n_head, self.d_head])
+            return F.transpose(t, [0, 2, 1, 3])
+
+        q = split_heads(self.q(x))
+        k = split_heads(self.k(x))
+        v = split_heads(self.v(x))
+        scores = F.matmul(q, k, transpose_y=True,
+                          alpha=1.0 / math.sqrt(self.d_head))
+        if attn_mask is not None:
+            scores = scores + attn_mask
+        probs = F.softmax(scores, axis=-1)
+        probs = self.drop(probs)
+        ctx = F.matmul(probs, v)
+        ctx = F.transpose(ctx, [0, 2, 1, 3])
+        ctx = F.reshape(ctx, [b, s, h])
+        return self.out(ctx)
+
+
+class TransformerLayer(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attn = MultiHeadAttention(cfg)
+        self.ln1 = LayerNorm(cfg.hidden_size)
+        self.fc1 = Linear(cfg.hidden_size, cfg.intermediate_size,
+                          param_attr=_init(cfg), act="gelu")
+        self.fc2 = Linear(cfg.intermediate_size, cfg.hidden_size,
+                          param_attr=_init(cfg))
+        self.ln2 = LayerNorm(cfg.hidden_size)
+        self.drop = Dropout(cfg.hidden_dropout_prob,
+                            dropout_implementation="upscale_in_train")
+
+    def forward(self, x, attn_mask=None):
+        a = self.attn(x, attn_mask)
+        x = self.ln1(x + self.drop(a))
+        f = self.fc2(self.fc1(x))
+        x = self.ln2(x + self.drop(f))
+        return x
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_emb = Embedding([cfg.vocab_size, cfg.hidden_size],
+                                  param_attr=_init(cfg))
+        self.pos_emb = Embedding([cfg.max_position_embeddings, cfg.hidden_size],
+                                 param_attr=_init(cfg))
+        self.type_emb = Embedding([cfg.type_vocab_size, cfg.hidden_size],
+                                  param_attr=_init(cfg))
+        self.emb_ln = LayerNorm(cfg.hidden_size)
+        self.emb_drop = Dropout(cfg.hidden_dropout_prob,
+                                dropout_implementation="upscale_in_train")
+        self.encoder = LayerList([TransformerLayer(cfg)
+                                  for _ in range(cfg.num_hidden_layers)])
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size,
+                             param_attr=_init(cfg), act="tanh")
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        from ..dygraph import to_variable
+
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = to_variable(
+                np.tile(np.arange(s, dtype=np.int64)[None, :], (b, 1)))
+        if token_type_ids is None:
+            token_type_ids = to_variable(np.zeros((b, s), np.int64))
+        emb = (self.word_emb(input_ids) + self.pos_emb(position_ids)
+               + self.type_emb(token_type_ids))
+        x = self.emb_drop(self.emb_ln(emb))
+        mask = None
+        if attention_mask is not None:
+            # [b, s] 1/0 -> additive [b, 1, 1, s]
+            mask = (1.0 - attention_mask) * -10000.0
+            mask = F.unsqueeze(F.unsqueeze(mask, [1]), [1])
+        for layer in self.encoder:
+            x = layer(x, mask)
+        pooled = self.pooler(x[:, 0])
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (the pretraining objective the throughput config
+    measures)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                    param_attr=_init(cfg), act="gelu")
+        self.mlm_ln = LayerNorm(cfg.hidden_size)
+        self.nsp = Linear(cfg.hidden_size, 2, param_attr=_init(cfg))
+
+    def forward(self, input_ids, labels, token_type_ids=None,
+                attention_mask=None, nsp_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        h = self.mlm_ln(self.mlm_transform(seq))
+        # tied decoder: logits = h @ word_emb^T
+        logits = F.matmul(h, self.bert.word_emb.weight, transpose_y=True)
+        mlm_loss = F.mean(F.softmax_with_cross_entropy(
+            logits, F.unsqueeze(labels, [2])))
+        loss = mlm_loss
+        if nsp_labels is not None:
+            nsp_loss = F.mean(F.softmax_with_cross_entropy(
+                self.nsp(pooled), nsp_labels))
+            loss = loss + nsp_loss
+        return loss
+
+
+# ERNIE-base shares the BERT-base architecture (different pretraining
+# data/masking); the throughput config is identical.
+ErnieModel = BertModel
+ErnieConfig = BertConfig

@@ -1,0 +1,137 @@
+"""Model-family tests: ResNet, BERT, TracedLayer, jit_train_step
+(reference analogs: tests/book/ + test_imperative_resnet/transformer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+
+
+def test_resnet18_static_trains():
+    from paddle_tpu.models.resnet import build_resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 32, 32])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        loss, acc1, acc5, logits = build_resnet(img, label, depth=18,
+                                                class_num=10)
+        opt = fluid.optimizer.MomentumOptimizer(0.01, 0.9)
+        opt.minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(8, 3, 32, 32).astype("float32"),
+            "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_tiny_dygraph_trains():
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    cfg = BertConfig(vocab_size=50, hidden_size=16, num_hidden_layers=1,
+                     num_attention_heads=2, intermediate_size=32,
+                     max_position_embeddings=32,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50, (2, 8)).astype("int64")
+    labels = rng.randint(0, 50, (2, 8)).astype("int64")
+    with dygraph.guard():
+        model = BertForPretraining(cfg)
+        opt = fluid.optimizer.AdamOptimizer(
+            1e-2, parameter_list=model.parameters())
+        first = last = None
+        for _ in range(8):
+            loss = model(dygraph.to_variable(ids), dygraph.to_variable(labels))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            first = first if first is not None else float(loss.numpy())
+            last = float(loss.numpy())
+        assert last < first, (first, last)
+
+
+def test_jit_train_step_matches_eager():
+    """jit_train_step must produce the same losses as plain eager."""
+    from paddle_tpu.models.bert import BertConfig, BertModel
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 4).astype("float32")
+    ys = (xs[:, :1] * 3.0).astype("float32")
+
+    def build():
+        m = dygraph.Linear(4, 1)
+        o = fluid.optimizer.SGDOptimizer(0.1, parameter_list=m.parameters())
+        return m, o
+
+    def loss_fn(model, x, y):
+        pred = model(x)
+        return fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+
+    with dygraph.guard():
+        m1, o1 = build()
+        w0 = m1.weight.numpy().copy()
+        b0 = m1.bias.numpy().copy()
+        eager_losses = []
+        for _ in range(5):
+            loss = loss_fn(m1, dygraph.to_variable(xs), dygraph.to_variable(ys))
+            loss.backward()
+            o1.minimize(loss)
+            m1.clear_gradients()
+            eager_losses.append(float(loss.numpy()))
+
+        m2, o2 = build()
+        m2.weight.set_value(w0)
+        m2.bias.set_value(b0)
+        step = dygraph.jit_train_step(m2, o2, loss_fn)
+        jit_losses = [float(step(xs, ys).numpy()) for _ in range(5)]
+
+    np.testing.assert_allclose(eager_losses, jit_losses, rtol=1e-5, atol=1e-6)
+
+
+def test_traced_layer_roundtrip(tmp_path):
+    with dygraph.guard():
+        model = dygraph.Sequential(
+            dygraph.Linear(6, 8, act="relu"),
+            dygraph.Linear(8, 3),
+        )
+        x = dygraph.to_variable(np.random.rand(4, 6).astype("float32"))
+        out, traced = dygraph.TracedLayer.trace(model, [x])
+        got = traced([x.numpy()])[0]
+        np.testing.assert_allclose(out.numpy(), got, rtol=1e-5)
+
+        d = str(tmp_path / "traced")
+        traced.save_inference_model(d)
+        exe = pt.Executor(pt.CPUPlace())
+        prog, feeds, fetches = fluid.load_inference_model(d, exe)
+        got2 = exe.run(prog, feed={feeds[0]: x.numpy()},
+                       fetch_list=[v.name for v in fetches])[0]
+        np.testing.assert_allclose(out.numpy(), got2, rtol=1e-5)
+
+
+def test_lr_schedulers():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(y)
+        lr = fluid.layers.piecewise_decay([2, 4], [0.1, 0.01, 0.001])
+        opt = fluid.optimizer.SGDOptimizer(lr)
+        opt.minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xs = np.random.rand(4, 4).astype("float32")
+    lrs = []
+    for i in range(6):
+        lrs.append(float(exe.run(main, feed={"x": xs},
+                                 fetch_list=[lr.name])[0]))
+    # steps 1..6 -> lr 0.1,0.1(step<2? step counts from 1: step1<2 -> .1),
+    # then 0.01 for 2<=step<4, then 0.001
+    assert lrs[0] == pytest.approx(0.1)
+    assert lrs[2] == pytest.approx(0.01)
+    assert lrs[5] == pytest.approx(0.001)
