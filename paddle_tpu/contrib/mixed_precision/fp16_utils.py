@@ -1,0 +1,92 @@
+"""AMP program rewrite: insert casts around white/black-list ops.
+
+Reference: fluid/contrib/mixed_precision/fp16_utils.py:190
+rewrite_program — walks the forward program, casting inputs of white-list
+ops to the low dtype and inputs of black-list ops back to fp32.  Backward
+needs no separate handling here: grad ops vjp-replay the forward
+lowerings *including the inserted casts*, so parameter gradients come out
+fp32 (master weights) automatically.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ...framework import unique_name
+from ...framework.core import Block, Program
+from ...framework.dtype import VarType
+
+
+def _insert_cast(block: Block, idx: int, in_name: str, dst_dtype: VarType,
+                 cache: Dict) -> str:
+    key = (in_name, int(dst_dtype))
+    if key in cache:
+        return cache[key][0]
+    src_var = block._find_var_recursive(in_name)
+    out_name = unique_name.generate(f"{in_name}.cast_{'bf16' if dst_dtype == VarType.BF16 else dst_dtype}")
+    block.create_var(name=out_name, shape=src_var.shape, dtype=dst_dtype)
+    block._insert_op(
+        idx, "cast",
+        inputs={"X": [in_name]}, outputs={"Out": [out_name]},
+        attrs={"in_dtype": int(src_var.dtype), "out_dtype": int(dst_dtype)},
+    )
+    cache[key] = (out_name, idx)
+    return out_name
+
+
+def rewrite_program(main_program: Program, amp_lists, dest_dtype=VarType.BF16):
+    """Cast-insertion pass over the (forward) program."""
+    block = main_program.global_block()
+    i = 0
+    cache: Dict = {}
+    low_vars = set()  # vars known to be in low precision
+    while i < len(block.ops):
+        op_ = block.ops[i]
+        if op_.type == "cast":
+            i += 1
+            continue
+        if op_.type in amp_lists.white_list:
+            num_inserted = 0
+            for slot, names in list(op_.inputs.items()):
+                new_names = []
+                for n in names:
+                    var = block._find_var_recursive(n)
+                    if (var is not None and var.dtype == VarType.FP32
+                            and n not in amp_lists.black_varnames):
+                        casted = _insert_cast(block, i, n, dest_dtype, cache)
+                        new_names.append(casted)
+                        num_inserted += 1 if casted != n else 0
+                    else:
+                        new_names.append(n)
+                op_.inputs[slot] = new_names
+            # re-locate op after insertions
+            i = block.ops.index(op_)
+            for names in op_.outputs.values():
+                for n in names:
+                    var = block._find_var_recursive(n)
+                    if var is not None and var.dtype == VarType.FP32:
+                        var.dtype = dest_dtype
+                        low_vars.add(n)
+        elif op_.type in amp_lists.black_list:
+            for slot, names in list(op_.inputs.items()):
+                new_names = []
+                for n in names:
+                    var = block._find_var_recursive(n)
+                    if var is not None and var.dtype == dest_dtype:
+                        casted = _insert_cast(block, i, n, VarType.FP32, cache)
+                        new_names.append(casted)
+                    else:
+                        new_names.append(n)
+                op_.inputs[slot] = new_names
+            i = block.ops.index(op_)
+        i += 1
+    main_program._bump_version()
+    return main_program
+
+
+def cast_model_to_fp16(program, amp_lists=None, dest_dtype=VarType.BF16):
+    """Pure-low-precision conversion (reference: fp16_utils.py
+    cast_model_to_fp16) — used by inference export."""
+    from .fp16_lists import AutoMixedPrecisionLists
+
+    return rewrite_program(program, amp_lists or AutoMixedPrecisionLists(),
+                           dest_dtype)

@@ -104,7 +104,11 @@ class Executor:
     # ------------------------------------------------------------------
     def _compile(self, program: Program, feed, fetch_names, scope) -> _Compiled:
         feed_spec = tuple(
-            sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype)) for k, v in feed.items())
+            sorted(
+                (k, tuple(np.shape(v)),
+                 str(v.dtype) if hasattr(v, "dtype") else str(np.asarray(v).dtype))
+                for k, v in feed.items()
+            )
         )
         key = (id(program), program._version, feed_spec, tuple(fetch_names))
         hit = self._cache.get(key)
@@ -189,8 +193,15 @@ class Executor:
 
         feed_vals = {}
         for k, v in feed.items():
-            arr = as_numpy(v) if isinstance(v, LoDTensor) else np.asarray(v)
+            if isinstance(v, LoDTensor):
+                v = v.value()
             var = block._find_var_recursive(k)
+            if isinstance(v, jax.Array):
+                # already on device: no host round-trip, device_put is a
+                # no-op when placement matches
+                feed_vals[k] = jax.device_put(v, device)
+                continue
+            arr = np.asarray(v)
             if var is not None and var.dtype is not None:
                 want = to_numpy_dtype(var.dtype)
                 if arr.dtype != want:

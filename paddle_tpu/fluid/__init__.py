@@ -65,6 +65,7 @@ from .. import reader
 
 # framework module alias (scripts do fluid.framework.xxx)
 from .. import framework
+from .. import contrib
 
 # data layers at fluid level (fluid.data = shape-verbatim variant)
 def data(name, shape, dtype="float32", lod_level=0):

@@ -77,7 +77,9 @@ def _analyze(program, feed_names, scope):
 
 def _compile_dp(compiled_program, program, feed, fetch_names, scope, mesh):
     feed_spec = tuple(sorted(
-        (k, tuple(np.shape(v)), str(np.asarray(v).dtype)) for k, v in feed.items()
+        (k, tuple(np.shape(v)),
+         str(v.dtype) if hasattr(v, "dtype") else str(np.asarray(v).dtype))
+        for k, v in feed.items()
     ))
     key = (program._version, feed_spec, tuple(fetch_names), id(mesh))
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})

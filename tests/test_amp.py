@@ -1,0 +1,94 @@
+"""AMP (bf16 mixed precision) tests (reference analog:
+contrib/tests/test_image_classification_fp16.py + test_fp16_utils)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.dtype import VarType
+
+
+def _build(img_dim=16):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, img_dim, img_dim])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        conv = fluid.layers.conv2d(img, 8, 3, act="relu")
+        pool = fluid.layers.pool2d(conv, 2, pool_stride=2)
+        logits = fluid.layers.fc(pool, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        opt = fluid.optimizer.MomentumOptimizer(0.01, 0.9)
+        amp_opt = fluid.contrib.mixed_precision.decorate(opt)
+        amp_opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_amp_rewrite_inserts_casts():
+    main, startup, loss = _build()
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types
+    # conv2d inputs must be bf16-casted
+    for op in main.global_block().ops:
+        if op.type == "conv2d":
+            for slot, names in op.inputs.items():
+                for n in names:
+                    v = main.global_block()._find_var_recursive(n)
+                    assert v.dtype == VarType.BF16, (slot, n, v.dtype)
+            break
+
+
+def test_amp_trains_and_master_weights_stay_fp32():
+    main, startup, loss = _build()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(16, 3, 16, 16).astype("float32"),
+            "label": rng.randint(0, 10, (16, 1)).astype("int64")}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(10)]
+    assert losses[-1] < losses[0]
+    # params stay fp32 in scope (master weights)
+    from paddle_tpu.framework.scope import global_scope
+
+    for p in main.all_parameters():
+        val = global_scope().get(p.name)
+        assert np.asarray(val).dtype == np.float32, p.name
+
+
+def test_amp_loss_close_to_fp32():
+    # fp32 run
+    main32, startup32 = fluid.Program(), fluid.Program()
+    main32.random_seed = 11
+    with fluid.program_guard(main32, startup32):
+        img = fluid.layers.data("img", [3, 16, 16])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        conv = fluid.layers.conv2d(img, 8, 3, act="relu")
+        pool = fluid.layers.pool2d(conv, 2, pool_stride=2)
+        logits = fluid.layers.fc(pool, 10)
+        loss32 = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+    exe = pt.Executor(pt.CPUPlace())
+    from paddle_tpu.framework.scope import Scope
+
+    s1, s2 = Scope(), Scope()
+    exe.run(startup32, scope=s1)
+    init = {k: np.asarray(v) for k, v in s1.items() if not k.startswith("@")}
+
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(8, 3, 16, 16).astype("float32"),
+            "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+    l32 = float(exe.run(main32, feed=feed, fetch_list=[loss32], scope=s1)[0])
+
+    # amp forward on the same program clone + same params
+    from paddle_tpu.contrib.mixed_precision import (
+        AutoMixedPrecisionLists, rewrite_program)
+
+    amp_prog = main32.clone()
+    rewrite_program(amp_prog, AutoMixedPrecisionLists())
+    for k, v in init.items():
+        s2.set(k, v.copy())
+    lbf = float(exe.run(amp_prog, feed=feed, fetch_list=[loss32.name],
+                        scope=s2)[0])
+    assert abs(l32 - lbf) / max(abs(l32), 1e-6) < 0.05, (l32, lbf)
