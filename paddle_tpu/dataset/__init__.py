@@ -1,0 +1,11 @@
+"""Built-in datasets (reference: python/paddle/dataset/ — mnist, cifar,
+uci_housing, imdb, ...).
+
+The reference downloads from paddle-dataset URLs.  This environment has no
+egress, so each reader (1) uses a local cache under ~/.cache/paddle_tpu/
+dataset if files exist, else (2) generates a deterministic synthetic
+stand-in with the same shapes/types, so book-style tests run offline.
+"""
+from . import mnist
+from . import uci_housing
+from . import cifar

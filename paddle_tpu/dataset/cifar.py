@@ -1,0 +1,67 @@
+"""CIFAR-10/100 reader (reference: python/paddle/dataset/cifar.py).
+Yields (image[3072] float32, label) samples; synthetic stand-in offline."""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle_tpu/dataset/cifar")
+
+
+def _synthetic(n, classes, seed):
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(classes, 3072).astype(np.float32)
+    labels = rng.randint(0, classes, n).astype(np.int64)
+    images = np.clip(templates[labels] + 0.1 * rng.randn(n, 3072), 0, 1)
+    return images.astype(np.float32), labels
+
+
+def _reader(images, labels):
+    def reader():
+        for img, lbl in zip(images, labels):
+            yield img, int(lbl)
+
+    return reader
+
+
+def _load_tar(path, prefix, classes):
+    imgs, lbls = [], []
+    key = b"labels" if classes == 10 else b"fine_labels"
+    with tarfile.open(path) as tf:
+        for m in tf.getmembers():
+            if prefix in m.name:
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                imgs.append(np.asarray(d[b"data"], np.float32) / 255.0)
+                lbls.extend(d[key])
+    return np.concatenate(imgs), np.asarray(lbls, np.int64)
+
+
+def train10(n_synthetic=5000):
+    path = os.path.join(CACHE, "cifar-10-python.tar.gz")
+    if os.path.exists(path):
+        return _reader(*_load_tar(path, "data_batch", 10))
+    return _reader(*_synthetic(n_synthetic, 10, 0))
+
+
+def test10(n_synthetic=1000):
+    path = os.path.join(CACHE, "cifar-10-python.tar.gz")
+    if os.path.exists(path):
+        return _reader(*_load_tar(path, "test_batch", 10))
+    return _reader(*_synthetic(n_synthetic, 10, 1))
+
+
+def train100(n_synthetic=5000):
+    path = os.path.join(CACHE, "cifar-100-python.tar.gz")
+    if os.path.exists(path):
+        return _reader(*_load_tar(path, "train", 100))
+    return _reader(*_synthetic(n_synthetic, 100, 0))
+
+
+def test100(n_synthetic=1000):
+    path = os.path.join(CACHE, "cifar-100-python.tar.gz")
+    if os.path.exists(path):
+        return _reader(*_load_tar(path, "test", 100))
+    return _reader(*_synthetic(n_synthetic, 100, 1))

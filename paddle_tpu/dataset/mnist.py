@@ -1,0 +1,58 @@
+"""MNIST reader (reference: python/paddle/dataset/mnist.py).
+
+Yields (image[784] float32 in [-1,1], label int64) samples.  Falls back to
+a deterministic synthetic set (class-template + noise) when the real IDX
+files aren't cached locally.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle_tpu/dataset/mnist")
+
+
+def _load_idx(img_path, lbl_path):
+    with gzip.open(lbl_path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8)
+    with gzip.open(img_path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    return images, labels
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(10, 784).astype(np.float32)
+    labels = rng.randint(0, 10, n).astype(np.int64)
+    images = templates[labels] + 0.1 * rng.randn(n, 784).astype(np.float32)
+    images = np.clip(images, 0.0, 1.0)
+    return (images * 255).astype(np.uint8), labels
+
+
+def _reader(images, labels):
+    def reader():
+        for img, lbl in zip(images, labels):
+            yield (img.astype(np.float32) / 127.5 - 1.0), int(lbl)
+
+    return reader
+
+
+def train(n_synthetic=6000):
+    img = os.path.join(CACHE, "train-images-idx3-ubyte.gz")
+    lbl = os.path.join(CACHE, "train-labels-idx1-ubyte.gz")
+    if os.path.exists(img) and os.path.exists(lbl):
+        return _reader(*_load_idx(img, lbl))
+    return _reader(*_synthetic(n_synthetic, seed=0))
+
+
+def test(n_synthetic=1000):
+    img = os.path.join(CACHE, "t10k-images-idx3-ubyte.gz")
+    lbl = os.path.join(CACHE, "t10k-labels-idx1-ubyte.gz")
+    if os.path.exists(img) and os.path.exists(lbl):
+        return _reader(*_load_idx(img, lbl))
+    return _reader(*_synthetic(n_synthetic, seed=1))

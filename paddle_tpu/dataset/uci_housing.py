@@ -1,0 +1,47 @@
+"""UCI housing reader (reference: python/paddle/dataset/uci_housing.py).
+13 features -> 1 price; synthetic linear stand-in when uncached."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle_tpu/dataset/uci_housing")
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(13).astype(np.float32)
+    x = rng.randn(n, 13).astype(np.float32)
+    y = (x @ w + 0.1 * rng.randn(n)).astype(np.float32)
+    return x, y[:, None]
+
+
+def _reader(x, y):
+    def reader():
+        for xi, yi in zip(x, y):
+            yield xi, yi
+
+    return reader
+
+
+def train(n=404):
+    path = os.path.join(CACHE, "housing.data")
+    if os.path.exists(path):
+        data = np.loadtxt(path).astype(np.float32)
+        x, y = data[:, :-1], data[:, -1:]
+        x = (x - x.mean(0)) / (x.std(0) + 1e-6)
+        split = int(len(x) * 0.8)
+        return _reader(x[:split], y[:split])
+    return _reader(*_synthetic(n, 0))
+
+
+def test(n=102):
+    path = os.path.join(CACHE, "housing.data")
+    if os.path.exists(path):
+        data = np.loadtxt(path).astype(np.float32)
+        x, y = data[:, :-1], data[:, -1:]
+        x = (x - x.mean(0)) / (x.std(0) + 1e-6)
+        split = int(len(x) * 0.8)
+        return _reader(x[split:], y[split:])
+    return _reader(*_synthetic(n, 1))

@@ -1,0 +1,3 @@
+from .model import Model, Input
+from . import callbacks
+from . import metrics

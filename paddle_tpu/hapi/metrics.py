@@ -1,0 +1,50 @@
+"""hapi metrics (reference: incubate/hapi/metrics.py — Metric base +
+Accuracy for Model.fit/evaluate)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return getattr(self, "_name", self.__class__.__name__)
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk
+        self.maxk = max(topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels)
+        if labels.ndim == 2 and labels.shape[1] == 1:
+            labels = labels[:, 0]
+        idx = np.argsort(-preds, axis=-1)[:, : self.maxk]
+        correct = idx == labels[:, None]
+        res = []
+        for i, k in enumerate(self.topk):
+            acc = correct[:, :k].any(axis=1).mean()
+            self.total[i] += acc * len(labels)
+            self.count[i] += len(labels)
+            res.append(acc)
+        return res[0] if len(res) == 1 else res
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res

