@@ -32,6 +32,16 @@ from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()
 
+from . import control_flow
+from .control_flow import (  # noqa: F401
+    While,
+    case,
+    cond,
+    equal,
+    less_than,
+    switch_case,
+    while_loop,
+)
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay,

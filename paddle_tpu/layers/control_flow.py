@@ -1,0 +1,236 @@
+"""Control-flow layers: cond / while_loop / While / case / switch_case.
+
+Reference: python/paddle/fluid/layers/control_flow.py (While:1024,
+cond:2150, case, switch_case, increment, less_than...).  The sub-blocks
+are real Blocks in the Program (serializable, transpiler-visible); the
+ops lower to lax.cond/lax.while_loop (ops/control_ops.py).
+
+Known scope cut (documented): LoDTensorArray-based dynamic RNN
+(array_write/array_read + While) needs dynamic-length arrays that XLA
+cannot express; use while_loop with fixed-shape carries or lax.scan-style
+rnn layers instead.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+from ..framework.core import Variable, default_main_program
+from ..framework.dtype import VarType
+from ..layer_helper import LayerHelper
+from . import nn as nn_layers
+from . import tensor as tensor_layers
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _free_vars(blocks, parent):
+    """Outer vars read by `blocks` (incl. nested sub-blocks): the explicit
+    Input list for control-flow ops, so executor read-set analysis and
+    grad replay see through the block boundary."""
+    from ..framework.core import Block as _Block
+
+    free = []
+    seen = set()
+
+    def visit(blk, produced):
+        produced = set(produced)
+        for op_ in blk.ops:
+            for n in op_.input_arg_names:
+                if n in produced or n in seen or n == "@EMPTY@":
+                    continue
+                if parent._find_var_recursive(n) is not None and not blk.has_var(n):
+                    seen.add(n)
+                    free.append(n)
+            for k, v in op_.attrs.items():
+                if isinstance(v, _Block):
+                    visit(v, produced)
+                elif isinstance(v, int) and k.endswith("_block"):
+                    visit(parent.program.blocks[v], produced)
+            produced.update(op_.output_arg_names)
+
+    for blk in blocks:
+        visit(blk, set())
+    return free
+
+
+def cond(pred: Variable, true_fn: Callable = None, false_fn: Callable = None,
+         name=None):
+    """reference: control_flow.py:2150."""
+    helper = LayerHelper("cond", name=name)
+    prog = default_main_program()
+    parent = prog.current_block()
+
+    tb = prog._create_block()
+    t_out = _to_list(true_fn() if true_fn is not None else None)
+    prog._rollback()
+    fb = prog._create_block()
+    f_out = _to_list(false_fn() if false_fn is not None else None)
+    prog._rollback()
+
+    if len(t_out) != len(f_out):
+        raise ValueError(
+            f"true_fn returns {len(t_out)} outputs, false_fn {len(f_out)} — "
+            f"branches must match")
+    outs = []
+    for tv in t_out:
+        outs.append(parent.create_var(
+            name=helper.name + f"_out_{len(outs)}",
+            shape=tv.shape, dtype=tv.dtype))
+    free = _free_vars([tb, fb], parent)
+    parent.append_op(
+        "cond",
+        inputs={"Cond": [pred], "Input": free},
+        outputs={"Out": outs},
+        attrs={
+            "true_block": tb,
+            "false_block": fb,
+            "true_out_names": [v.name for v in t_out],
+            "false_out_names": [v.name for v in f_out],
+            "input_names": free,
+        },
+    )
+    if not outs:
+        return None
+    return outs[0] if len(outs) == 1 else outs
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """reference: control_flow.py while_loop (2.0 API)."""
+    helper = LayerHelper("while_loop", name=name)
+    prog = default_main_program()
+    parent = prog.current_block()
+    loop_vars = _to_list(loop_vars)
+
+    cb = prog._create_block()
+    c = cond_fn(*loop_vars)
+    prog._rollback()
+    bb = prog._create_block()
+    body_out = _to_list(body_fn(*loop_vars))
+    prog._rollback()
+    if len(body_out) != len(loop_vars):
+        raise ValueError("body must return as many values as loop_vars")
+
+    outs = [parent.create_var(name=helper.name + f"_out_{i}",
+                              shape=v.shape, dtype=v.dtype)
+            for i, v in enumerate(loop_vars)]
+    carry_names = [v.name for v in loop_vars]
+    free = [n for n in _free_vars([cb, bb], parent) if n not in carry_names]
+    parent.append_op(
+        "while_loop",
+        inputs={"X": loop_vars, "Input": free},
+        outputs={"Out": outs},
+        attrs={
+            "cond_block": cb,
+            "body_block": bb,
+            "carry_names": carry_names,
+            "cond_out_name": c.name,
+            "body_out_names": [v.name for v in body_out],
+            "input_names": free,
+        },
+    )
+    return outs[0] if len(outs) == 1 else outs
+
+
+class While:
+    """Old-style While block (reference: control_flow.py:1024).
+
+    with While(cond_var).block(): ... ops ...; the block must reassign
+    cond_var.  Vars written inside that pre-exist outside are carried."""
+
+    def __init__(self, cond: Variable, is_test=False, name=None):
+        self._cond = cond
+        self.helper = LayerHelper("while", name=name)
+
+    def block(self):
+        import contextlib
+
+        prog = default_main_program()
+        parent = prog.current_block()
+        outer_vars = set()
+        blk = prog.blocks
+        b = parent
+        while b is not None:
+            outer_vars |= set(b.vars.keys())
+            b = b.parent_block
+
+        @contextlib.contextmanager
+        def _ctx():
+            sub = prog._create_block()
+            yield
+            prog._rollback()
+            written = set()
+            for op_ in sub.ops:
+                written.update(op_.output_arg_names)
+            carry = sorted((written & outer_vars) - {self._cond.name})
+            free = [n for n in _free_vars([sub], parent)
+                    if n not in carry and n != self._cond.name]
+            parent.append_op(
+                "while",
+                inputs={"Cond": [self._cond], "X": carry, "Input": free},
+                outputs={"XOut": carry, "CondOut": [self._cond]},
+                attrs={
+                    "sub_block": sub,
+                    "cond_name": self._cond.name,
+                    "carry_names": carry,
+                    "input_names": free,
+                },
+            )
+
+        return _ctx()
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: control_flow.py case — chained conds."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default))
+    if default is not None:
+        return cond(pred, fn, default)
+    return cond(pred, fn, fn)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: control_flow.py switch_case."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = list(enumerate(branch_fns))
+    helper = LayerHelper("switch_case", name=name)
+
+    def make_pred(i):
+        iv = tensor_layers.fill_constant([1], branch_index.dtype, float(i))
+        eq = helper.create_variable_for_type_inference(VarType.BOOL)
+        helper.append_op("equal", inputs={"X": [branch_index], "Y": [iv]},
+                         outputs={"Out": [eq]}, attrs={"axis": -1})
+        return eq
+
+    pred_fn_pairs = [(make_pred(i), fn) for i, fn in pairs]
+    return case(pred_fn_pairs, default)
+
+
+# re-exports used by reference-era scripts
+increment = nn_layers.increment
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    helper = LayerHelper("less_than")
+    out = cond or helper.create_variable_for_type_inference(VarType.BOOL)
+    helper.append_op("less_than", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": -1})
+    return out
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper("equal")
+    out = cond or helper.create_variable_for_type_inference(VarType.BOOL)
+    helper.append_op("equal", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": -1})
+    return out

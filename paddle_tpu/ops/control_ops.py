@@ -1,0 +1,161 @@
+"""Control-flow op lowerings: sub-block ops -> lax.cond / lax.while_loop.
+
+Capability parity with reference: paddle/fluid/operators/controlflow/
+(conditional_block_op.cc, while_op.cc — ops holding BLOCK attrs executed
+by an inner Executor over sub-scopes).  TPU-native (SURVEY.md §7 hard-part
+4): the sub-block is traced as a pure function of its carried values and
+handed to XLA's structured control flow.  Every outer var a sub-block
+reads is an explicit "Input" of the op (computed at build time by
+layers/control_flow.py:_free_vars), so the executor's read-set analysis
+and the vjp grad replay both see them — no hidden closure state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op, infer_for
+from ..framework.core import Block
+
+
+def _resolve_block(ctx, attr_name) -> Block:
+    blk = ctx.attr(attr_name)
+    if isinstance(blk, Block):
+        return blk
+    return ctx.block.program.blocks[int(blk)]
+
+
+def _run_block(blk: Block, env: dict):
+    from . import registry
+
+    for op_ in blk.ops:
+        registry.run_op(op_, env, blk)
+    return env
+
+
+def _outer_env(ctx):
+    names = ctx.attr("input_names", [])
+    vals = ctx.ins("Input")
+    return dict(zip(names, vals))
+
+
+@op("cond")
+def _cond(ctx):
+    """layers.cond: two sub-blocks, same output structure."""
+    pred = jnp.reshape(ctx.in_("Cond"), ()).astype(bool)
+    tb = _resolve_block(ctx, "true_block")
+    fb = _resolve_block(ctx, "false_block")
+    t_outs = ctx.attr("true_out_names", [])
+    f_outs = ctx.attr("false_out_names", [])
+    base_env = _outer_env(ctx)
+
+    def true_fn():
+        local = dict(base_env)
+        _run_block(tb, local)
+        return tuple(local[n] for n in t_outs)
+
+    def false_fn():
+        local = dict(base_env)
+        _run_block(fb, local)
+        return tuple(local[n] for n in f_outs)
+
+    outs = lax.cond(pred, true_fn, false_fn)
+    ctx.set_out("Out", list(outs))
+
+
+@infer_for("cond")
+def _cond_infer(op_, block):
+    t_outs = op_.attr("true_out_names", [])
+    tb = op_.attr("true_block")
+    tb = tb if isinstance(tb, Block) else block.program.blocks[int(tb)]
+    for out_name, t_name in zip(op_.output("Out"), t_outs):
+        src = tb._find_var_recursive(t_name)
+        dst = block._find_var_recursive(out_name)
+        if src is not None and dst is not None:
+            dst.shape = src.shape
+            dst.dtype = src.dtype
+
+
+@op("while_loop", no_grad=True)
+def _while_loop(ctx):
+    """layers.while_loop: functional carry over cond/body sub-blocks.
+    (lax.while_loop is not reverse-differentiable; use lax.scan-style
+    fixed-length loops for differentiable recurrence.)"""
+    cb = _resolve_block(ctx, "cond_block")
+    bb = _resolve_block(ctx, "body_block")
+    carry_names = ctx.attr("carry_names", [])
+    cond_out = ctx.attr("cond_out_name")
+    body_out_names = ctx.attr("body_out_names", [])
+    base_env = _outer_env(ctx)
+
+    carry_vals = ctx.ins("X")
+    init = tuple(carry_vals)
+
+    def cond_fun(carry):
+        local = dict(base_env)
+        local.update(zip(carry_names, carry))
+        _run_block(cb, local)
+        return jnp.reshape(local[cond_out], ()).astype(bool)
+
+    def body_fun(carry):
+        local = dict(base_env)
+        local.update(zip(carry_names, carry))
+        _run_block(bb, local)
+        return tuple(local[n] for n in body_out_names)
+
+    outs = lax.while_loop(cond_fun, body_fun, init)
+    ctx.set_out("Out", list(outs))
+
+
+@infer_for("while_loop")
+def _while_infer(op_, block):
+    for out_name, in_name in zip(op_.output("Out"),
+                                 op_.attr("carry_names", [])):
+        src = block._find_var_recursive(in_name)
+        dst = block._find_var_recursive(out_name)
+        if src is not None and dst is not None:
+            dst.shape = src.shape
+            dst.dtype = src.dtype
+
+
+@op("while", no_grad=True)
+def _while(ctx):
+    """Old-style fluid While op: block updates the condition var itself.
+    Carry = (cond, *carried vars); reference: controlflow/while_op.cc."""
+    bb = _resolve_block(ctx, "sub_block")
+    cond_name = ctx.attr("cond_name")
+    carry_names = list(ctx.attr("carry_names", []))
+    base_env = _outer_env(ctx)
+
+    init = (ctx.in_("Cond"),) + tuple(ctx.ins("X"))
+
+    def cond_fun(carry):
+        return jnp.reshape(carry[0], ()).astype(bool)
+
+    def body_fun(carry):
+        local = dict(base_env)
+        local[cond_name] = carry[0]
+        local.update(zip(carry_names, carry[1:]))
+        _run_block(bb, local)
+        return (local[cond_name],) + tuple(local[n] for n in carry_names)
+
+    outs = lax.while_loop(cond_fun, body_fun, init)
+    # carried vars keep their own names (reference While mutates in place)
+    ctx.set_out("CondOut", outs[0])
+    ctx.set_out("XOut", list(outs[1:]))
+
+
+@infer_for("while")
+def _while_op_infer(op_, block):
+    pass  # carried vars keep their declared specs
+
+
+@op("select_input")
+def _select_input(ctx):
+    xs = ctx.ins("X")
+    mask = jnp.reshape(ctx.in_("Mask"), ()).astype(jnp.int32)
+    out = xs[0]
+    for i in range(1, len(xs)):
+        out = lax.cond(mask == i, lambda a=xs[i]: a, lambda b=out: b)
+    ctx.set_out("Out", out)

@@ -1,0 +1,109 @@
+"""Control-flow tests (reference analogs: test_cond.py, test_while_loop_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+def test_cond_basic():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], append_batch_size=False)
+        pred = fluid.layers.reduce_sum(x)
+        zero = fluid.layers.fill_constant([1], "float32", 0.0)
+        is_pos = fluid.layers.less_than(zero, pred)
+        out = fluid.layers.cond(
+            is_pos,
+            lambda: fluid.layers.scale(x, 2.0),
+            lambda: fluid.layers.scale(x, -1.0),
+        )
+    exe = pt.Executor(pt.CPUPlace())
+    pos = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    neg = -pos
+    got_pos = exe.run(main, feed={"x": pos}, fetch_list=[out])[0]
+    got_neg = exe.run(main, feed={"x": neg}, fetch_list=[out])[0]
+    np.testing.assert_allclose(got_pos, pos * 2)
+    np.testing.assert_allclose(got_neg, pos)
+
+
+def test_cond_grad():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], append_batch_size=False,
+                              stop_gradient=False)
+        one = fluid.layers.fill_constant([1], "float32", 1.0)
+        zero = fluid.layers.fill_constant([1], "float32", 0.0)
+        flag = fluid.layers.less_than(zero, one)  # always true
+        out = fluid.layers.cond(
+            flag,
+            lambda: fluid.layers.scale(x, 3.0),
+            lambda: fluid.layers.scale(x, -1.0),
+        )
+        loss = fluid.layers.reduce_sum(out)
+        pt.append_backward(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    g = exe.run(main, feed={"x": np.ones(4, np.float32)},
+                fetch_list=["x@GRAD"])[0]
+    np.testing.assert_allclose(g, 3.0 * np.ones(4), rtol=1e-6)
+
+
+def test_while_loop():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        acc = fluid.layers.fill_constant([1], "float32", 0.0)
+        ten = fluid.layers.fill_constant([1], "float32", 10.0)
+
+        def cond_fn(i, acc):
+            return fluid.layers.less_than(i, ten)
+
+        def body_fn(i, acc):
+            return [i + 1.0, acc + i]
+
+        i_out, acc_out = fluid.layers.while_loop(cond_fn, body_fn, [i, acc])
+    exe = pt.Executor(pt.CPUPlace())
+    got_i, got_acc = exe.run(main, fetch_list=[i_out, acc_out])
+    assert float(got_i) == 10.0
+    assert float(got_acc) == sum(range(10))
+
+
+def test_old_style_while():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        limit = fluid.layers.fill_constant([1], "float32", 5.0)
+        total = fluid.layers.fill_constant([1], "float32", 0.0)
+        cond_var = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond_var)
+        with w.block():
+            fluid.layers.assign(i + 1.0, i)
+            fluid.layers.assign(total + 2.0, total)
+            fluid.layers.less_than(i, limit, cond=cond_var)
+    exe = pt.Executor(pt.CPUPlace())
+    got = exe.run(main, fetch_list=[total.name, i.name])
+    assert float(got[0]) == 10.0
+    assert float(got[1]) == 5.0
+
+
+def test_switch_case():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        idx = fluid.layers.data("idx", [1], dtype="float32",
+                                append_batch_size=False)
+        out = fluid.layers.switch_case(
+            idx,
+            {0: lambda: fluid.layers.fill_constant([2], "float32", 10.0),
+             1: lambda: fluid.layers.fill_constant([2], "float32", 20.0)},
+            default=lambda: fluid.layers.fill_constant([2], "float32", -1.0),
+        )
+    exe = pt.Executor(pt.CPUPlace())
+    np.testing.assert_allclose(
+        exe.run(main, feed={"idx": np.array([0.0], np.float32)},
+                fetch_list=[out])[0], [10.0, 10.0])
+    np.testing.assert_allclose(
+        exe.run(main, feed={"idx": np.array([1.0], np.float32)},
+                fetch_list=[out])[0], [20.0, 20.0])
+    np.testing.assert_allclose(
+        exe.run(main, feed={"idx": np.array([7.0], np.float32)},
+                fetch_list=[out])[0], [-1.0, -1.0])
