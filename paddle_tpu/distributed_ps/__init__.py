@@ -1,0 +1,3 @@
+from .table import DenseTable, SparseTable, reset_all_tables
+from .service import PSClient, PSServer
+from . import runtime

@@ -1,0 +1,53 @@
+"""Trainer-side PS runtime context: the client the ps ops talk to.
+
+Reference analog: the Communicator + RPCClient singletons
+(operators/distributed/communicator.h:237, grpc_client.cc).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+_ctx = {"client": None, "trainer_id": 0, "heartbeat_thread": None,
+        "heartbeat_stop": None}
+
+
+def set_client(client, trainer_id: int = 0, heartbeat_interval: float = 0.0):
+    _ctx["client"] = client
+    _ctx["trainer_id"] = trainer_id
+    if heartbeat_interval > 0:
+        stop = threading.Event()
+
+        def beat():
+            while not stop.wait(heartbeat_interval):
+                try:
+                    client.heartbeat(trainer_id)
+                except Exception:
+                    return
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        _ctx["heartbeat_thread"] = t
+        _ctx["heartbeat_stop"] = stop
+
+
+def client():
+    c = _ctx["client"]
+    if c is None:
+        raise RuntimeError(
+            "PS client not initialized — call fleet.init_worker() or "
+            "distributed_ps.runtime.set_client() first")
+    return c
+
+
+def trainer_id() -> int:
+    return _ctx["trainer_id"]
+
+
+def clear():
+    if _ctx.get("heartbeat_stop") is not None:
+        _ctx["heartbeat_stop"].set()
+    _ctx["client"] = None
+    _ctx["heartbeat_thread"] = None
+    _ctx["heartbeat_stop"] = None

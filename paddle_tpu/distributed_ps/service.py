@@ -1,0 +1,307 @@
+"""PS service: TCP transport over the native table store.
+
+Capability parity with the reference RPC PS runtime
+(reference: paddle/fluid/operators/distributed/ — RPCServer + request
+handlers SendVar/GetVar/PrefetchVar in request_handler_impl.cc,
+grpc/brpc transports; listen_and_serv_op.cc server loop; HeartBeatMonitor
+heart_beat_monitor.h:54; BarrierMonitor :106).  Storage + server-side
+optimize live in C++ (native/ps_table.cpp); the wire protocol is a
+length-prefixed JSON header + raw ndarray payload over TCP sockets.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .table import DenseTable, SparseTable
+
+
+# --------------------------------------------------------------------------
+# wire format: [u32 header_len][header json][payload bytes]
+# header: {"op": str, "name": str, "meta": {...}, "arrays": [[dtype, shape,
+#          nbytes], ...]}
+# --------------------------------------------------------------------------
+def _send_msg(sock, op: str, name: str = "", meta: dict = None, arrays=()):
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    header = json.dumps({
+        "op": op, "name": name, "meta": meta or {},
+        "arrays": [[str(a.dtype), list(a.shape), a.nbytes] for a in arrays],
+    }).encode()
+    payload = b"".join(a.tobytes() for a in arrays)
+    sock.sendall(struct.pack("<I", len(header)) + header + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen).decode())
+    arrays = []
+    for dtype, shape, nbytes in header["arrays"]:
+        raw = _recv_exact(sock, nbytes)
+        arrays.append(np.frombuffer(raw, dtype=dtype).reshape(shape).copy())
+    return header["op"], header["name"], header["meta"], arrays
+
+
+class PSServer:
+    """One PS shard: owns a set of named dense/sparse tables."""
+
+    def __init__(self, endpoint: str, n_trainers: int = 1):
+        host, port = endpoint.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.n_trainers = n_trainers
+        self.dense: Dict[str, DenseTable] = {}
+        self.sparse: Dict[str, SparseTable] = {}
+        self._barrier = threading.Barrier(max(n_trainers, 1))
+        self._heartbeats: Dict[int, float] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def _handle(self, op, name, meta, arrays, sock):
+        if op == "create_dense":
+            with self._lock:
+                if name not in self.dense:
+                    self.dense[name] = DenseTable(
+                        meta["size"], meta.get("optimizer", "sgd"),
+                        meta.get("lr", 0.01), meta.get("mu", 0.9),
+                        meta.get("beta1", 0.9), meta.get("beta2", 0.999),
+                        meta.get("eps", 1e-8))
+            _send_msg(sock, "ok")
+        elif op == "create_sparse":
+            with self._lock:
+                if name not in self.sparse:
+                    self.sparse[name] = SparseTable(
+                        meta["dim"], meta.get("init_range", 0.01),
+                        meta.get("optimizer", "sgd"), meta.get("lr", 0.01),
+                        meta.get("eps", 1e-8), meta.get("seed", 2026))
+            _send_msg(sock, "ok")
+        elif op == "init_dense":
+            self.dense[name].init(arrays[0])
+            _send_msg(sock, "ok")
+        elif op == "pull_dense":
+            _send_msg(sock, "ok", arrays=[self.dense[name].pull()])
+        elif op == "push_dense":
+            self.dense[name].push_grad(arrays[0])
+            _send_msg(sock, "ok" if meta.get("sync", True) else "ok")
+        elif op == "pull_sparse":
+            _send_msg(sock, "ok", arrays=[self.sparse[name].pull(arrays[0])])
+        elif op == "push_sparse":
+            self.sparse[name].push_grad(arrays[0], arrays[1])
+            _send_msg(sock, "ok")
+        elif op == "barrier":
+            # reference: send_barrier/fetch_barrier ops + BarrierMonitor
+            try:
+                self._barrier.wait(timeout=meta.get("timeout", 120.0))
+            except threading.BrokenBarrierError:
+                _send_msg(sock, "error", meta={"what": "barrier broken"})
+                return
+            _send_msg(sock, "ok")
+        elif op == "heartbeat":
+            # reference: HeartBeatMonitor worker liveness
+            with self._lock:
+                self._heartbeats[meta["trainer_id"]] = time.time()
+            _send_msg(sock, "ok")
+        elif op == "worker_status":
+            now = time.time()
+            with self._lock:
+                status = {str(t): now - ts for t, ts in self._heartbeats.items()}
+            _send_msg(sock, "ok", meta={"ages": status})
+        elif op == "save":
+            self._save(meta["path"])
+            _send_msg(sock, "ok")
+        elif op == "load":
+            self._load(meta["path"])
+            _send_msg(sock, "ok")
+        elif op == "shrink":
+            dropped = {n: t.shrink(meta.get("days", 0))
+                       for n, t in self.sparse.items()}
+            _send_msg(sock, "ok", meta={"dropped": dropped})
+        elif op == "stop":
+            _send_msg(sock, "ok")
+            threading.Thread(target=self.stop, daemon=True).start()
+        else:
+            _send_msg(sock, "error", meta={"what": f"unknown op {op}"})
+
+    def _save(self, path: str):
+        """Checkpoint tables (reference: CheckpointNotify handler)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        dense = {n: t.pull() for n, t in self.dense.items()}
+        np.savez(os.path.join(path, "dense.npz"), **dense)
+        for n, t in self.sparse.items():
+            ids, ws = t.export_rows()
+            np.savez(os.path.join(path, f"sparse_{n}.npz"), ids=ids, ws=ws)
+
+    def _load(self, path: str):
+        import os
+
+        dpath = os.path.join(path, "dense.npz")
+        if os.path.exists(dpath):
+            with np.load(dpath) as z:
+                for n in z.files:
+                    if n in self.dense:
+                        self.dense[n].init(z[n])
+        for n, t in self.sparse.items():
+            spath = os.path.join(path, f"sparse_{n}.npz")
+            if os.path.exists(spath):
+                with np.load(spath) as z:
+                    t.import_rows(z["ids"], z["ws"])
+
+    # ------------------------------------------------------------------
+    def start(self, block: bool = False):
+        handle = self._handle
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        op, name, meta, arrays = _recv_msg(self.request)
+                        handle(op, name, meta, arrays, self.request)
+                        if op == "stop":
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._server = socketserver.ThreadingTCPServer(
+            (self.host, self.port), Handler)
+        if self.port == 0:
+            self.port = self._server.server_address[1]
+        if block:
+            self._server.serve_forever()
+        else:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+
+class PSClient:
+    """Trainer-side client (reference: GrpcClient / parameter_send/recv)."""
+
+    def __init__(self, endpoints):
+        if isinstance(endpoints, str):
+            endpoints = endpoints.split(",")
+        self.endpoints = list(endpoints)
+        self._socks: Dict[str, socket.socket] = {}
+        self._lock = threading.Lock()
+
+    def _sock(self, ep: str) -> socket.socket:
+        with self._lock:
+            s = self._socks.get(ep)
+            if s is None:
+                host, port = ep.rsplit(":", 1)
+                s = socket.create_connection((host, int(port)), timeout=120)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._socks[ep] = s
+            return s
+
+    def _call(self, ep, op, name="", meta=None, arrays=()):
+        s = self._sock(ep)
+        with self._lock:
+            _send_msg(s, op, name, meta, arrays)
+            rop, _, rmeta, rarrays = _recv_msg(s)
+        if rop == "error":
+            raise RuntimeError(f"PS error from {ep}: {rmeta}")
+        return rmeta, rarrays
+
+    def _ep_for(self, name: str) -> str:
+        return self.endpoints[hash(name) % len(self.endpoints)]
+
+    # ------------------------------------------------------------------
+    def create_dense(self, name, size, **cfg):
+        self._call(self._ep_for(name), "create_dense", name,
+                   {"size": int(size), **cfg})
+
+    def create_sparse(self, name, dim, **cfg):
+        self._call(self._ep_for(name), "create_sparse", name,
+                   {"dim": int(dim), **cfg})
+
+    def init_dense(self, name, values):
+        self._call(self._ep_for(name), "init_dense", name,
+                   arrays=[np.asarray(values, np.float32)])
+
+    def pull_dense(self, name):
+        _, arrays = self._call(self._ep_for(name), "pull_dense", name)
+        return arrays[0]
+
+    def push_dense(self, name, grad, sync=True):
+        self._call(self._ep_for(name), "push_dense", name, {"sync": sync},
+                   [np.asarray(grad, np.float32)])
+
+    def pull_sparse(self, name, ids):
+        _, arrays = self._call(self._ep_for(name), "pull_sparse", name,
+                               arrays=[np.asarray(ids, np.int64)])
+        return arrays[0]
+
+    def push_sparse(self, name, ids, grads):
+        self._call(self._ep_for(name), "push_sparse", name,
+                   arrays=[np.asarray(ids, np.int64),
+                           np.asarray(grads, np.float32)])
+
+    def barrier(self, timeout=120.0):
+        for ep in self.endpoints:
+            self._call(ep, "barrier", meta={"timeout": timeout})
+
+    def heartbeat(self, trainer_id):
+        for ep in self.endpoints:
+            self._call(ep, "heartbeat", meta={"trainer_id": trainer_id})
+
+    def worker_status(self):
+        meta, _ = self._call(self.endpoints[0], "worker_status")
+        return meta["ages"]
+
+    def save(self, path):
+        for ep in self.endpoints:
+            self._call(ep, "save", meta={"path": path})
+
+    def load(self, path):
+        for ep in self.endpoints:
+            self._call(ep, "load", meta={"path": path})
+
+    def shrink(self, days=0):
+        for ep in self.endpoints:
+            self._call(ep, "shrink", meta={"days": days})
+
+    def stop_server(self):
+        for ep in self.endpoints:
+            try:
+                self._call(ep, "stop")
+            except Exception:
+                pass
+
+    def close(self):
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()

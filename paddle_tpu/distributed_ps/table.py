@@ -1,0 +1,111 @@
+"""Python binding over the native table store (ps_table.cpp)."""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..native import load_library
+
+OPT_TYPES = {"sgd": 0, "adagrad": 1, "adam": 2, "momentum": 3}
+
+
+class _Lib:
+    _lib = None
+
+    @classmethod
+    def get(cls):
+        if cls._lib is None:
+            lib = load_library("ps_table")
+            lib.ps_create_dense.restype = ctypes.c_int32
+            lib.ps_create_dense.argtypes = [
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float]
+            lib.ps_create_sparse.restype = ctypes.c_int32
+            lib.ps_create_sparse.argtypes = [
+                ctypes.c_int64, ctypes.c_float, ctypes.c_int32,
+                ctypes.c_float, ctypes.c_float, ctypes.c_uint64]
+            lib.ps_dense_size.restype = ctypes.c_int64
+            lib.ps_sparse_size.restype = ctypes.c_int64
+            lib.ps_sparse_shrink.restype = ctypes.c_int64
+            lib.ps_sparse_export.restype = ctypes.c_int64
+            cls._lib = lib
+        return cls._lib
+
+
+def _fp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _ip(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+class DenseTable:
+    def __init__(self, size: int, optimizer="sgd", lr=0.01, mu=0.9,
+                 beta1=0.9, beta2=0.999, eps=1e-8):
+        self.size = int(size)
+        self._lib = _Lib.get()
+        self.tid = self._lib.ps_create_dense(
+            self.size, OPT_TYPES[optimizer], lr, mu, beta1, beta2, eps)
+
+    def init(self, values: np.ndarray):
+        v = np.ascontiguousarray(values, np.float32).ravel()
+        assert v.size == self.size
+        self._lib.ps_init_dense(self.tid, _fp(v), v.size)
+
+    def pull(self) -> np.ndarray:
+        out = np.empty(self.size, np.float32)
+        self._lib.ps_pull_dense(self.tid, _fp(out))
+        return out
+
+    def push_grad(self, grad: np.ndarray):
+        g = np.ascontiguousarray(grad, np.float32).ravel()
+        assert g.size == self.size
+        self._lib.ps_push_dense_grad(self.tid, _fp(g), g.size)
+
+    def set_lr(self, lr: float):
+        self._lib.ps_set_lr(self.tid, ctypes.c_float(lr))
+
+
+class SparseTable:
+    def __init__(self, dim: int, init_range=0.01, optimizer="sgd", lr=0.01,
+                 eps=1e-8, seed=2026):
+        self.dim = int(dim)
+        self._lib = _Lib.get()
+        self.tid = self._lib.ps_create_sparse(
+            self.dim, init_range, OPT_TYPES[optimizer], lr, eps, seed)
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        out = np.empty((ids.size, self.dim), np.float32)
+        self._lib.ps_pull_sparse(self.tid, _ip(ids), ids.size, _fp(out))
+        return out
+
+    def push_grad(self, ids: np.ndarray, grads: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        g = np.ascontiguousarray(grads, np.float32).reshape(ids.size, self.dim)
+        self._lib.ps_push_sparse_grad(self.tid, _ip(ids), ids.size, _fp(g))
+
+    def __len__(self):
+        return int(self._lib.ps_sparse_size(self.tid))
+
+    def shrink(self, days: int) -> int:
+        return int(self._lib.ps_sparse_shrink(self.tid, days))
+
+    def export_rows(self):
+        n = len(self)
+        ids = np.empty(n, np.int64)
+        ws = np.empty((n, self.dim), np.float32)
+        k = self._lib.ps_sparse_export(self.tid, _ip(ids), _fp(ws), n)
+        return ids[:k], ws[:k]
+
+    def import_rows(self, ids, ws):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        ws = np.ascontiguousarray(ws, np.float32).reshape(ids.size, self.dim)
+        self._lib.ps_sparse_import(self.tid, _ip(ids), _fp(ws), ids.size)
+
+
+def reset_all_tables():
+    _Lib.get().ps_reset_all()

@@ -120,10 +120,13 @@ class Executor:
         written: set = set()
         state_in: List[str] = []
         uses_rng = False
+        has_host_ops = False
         for op_ in block.ops:
             d = registry.OPS.get(op_.type)
             if d is not None and d.stateful:
                 uses_rng = True
+            if d is not None and d.host:
+                has_host_ops = True
             if op_.type.endswith("_grad"):
                 uses_rng = uses_rng  # replay may use rng only for stateful fwd
             for name in op_.input_arg_names:
@@ -152,6 +155,25 @@ class Executor:
         ops = list(block.ops)
         fetch = list(fetch_names)
         souts = list(state_out)
+
+        if has_host_ops:
+            # Hybrid path (PS programs): ops run one-by-one eagerly — XLA
+            # ops dispatch individually, host (RPC) ops do their IO between
+            # them.  (The analog of the reference's op-by-op Executor loop,
+            # executor.cc:469-476, which PS programs inherently need.)
+            def hybrid_call(feed_vals, state_vals):
+                env: Dict[str, Any] = dict(state_vals)
+                env.update(feed_vals)
+                for op_ in ops:
+                    registry.run_op(op_, env, block)
+                fetched = tuple(env[n] for n in fetch)
+                new_state = {n: env[n] for n in souts if n in env}
+                return fetched, new_state
+
+            compiled = _Compiled(hybrid_call, state_in, state_out, fetch)
+            compiled.raw_fn = hybrid_call
+            self._cache[key] = compiled
+            return compiled
 
         # Donate only buffers that are both read and re-written (params,
         # optimizer moments): XLA updates them in place in HBM.  Read-only

@@ -1,2 +1,3 @@
 from . import base
 from . import collective
+from . import parameter_server

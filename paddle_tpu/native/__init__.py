@@ -1,0 +1,4 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain (g++) and loaded via ctypes — the TPU-native counterpart of the
+reference's C++ runtime libraries (SURVEY.md §2.9)."""
+from .build import load_library

@@ -1,0 +1,38 @@
+"""On-demand native builds: g++ -O3 -shared -fPIC, cached by source hash.
+
+The reference ships its C++ prebuilt via cmake (reference: cmake/);
+here native components compile on first use and cache under
+~/.cache/paddle_tpu/native/.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_CACHE_DIR = os.path.expanduser("~/.cache/paddle_tpu/native")
+_LOCK = threading.Lock()
+_LOADED = {}
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    """Compile (if needed) and dlopen native/<name>.cpp."""
+    with _LOCK:
+        if name in _LOADED:
+            return _LOADED[name]
+        src = os.path.join(os.path.dirname(__file__), name + ".cpp")
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        so_path = os.path.join(_CACHE_DIR, f"{name}-{digest}.so")
+        if not os.path.exists(so_path):
+            tmp = so_path + ".tmp"
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                   "-o", tmp, src, "-lpthread"]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, so_path)
+        lib = ctypes.CDLL(so_path)
+        _LOADED[name] = lib
+        return lib

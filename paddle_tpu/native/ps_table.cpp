@@ -1,0 +1,306 @@
+// Native parameter-server table store.
+//
+// Capability parity with the reference's C++ PS runtime: the dense/sparse
+// table storage + server-side optimize step of
+// paddle/fluid/operators/distributed/ (request_handler_impl.cc SendVar/
+// GetVar handlers running optimize blocks) and the pslib downpour table
+// shapes (framework/fleet/fleet_wrapper.h PullSparseVarsSync/
+// PushSparseVarsWithLabelAsync).  TPU-native split: XLA owns device math;
+// this C++ store owns the host-side trillion-parameter sparse state —
+// sharded hash tables with per-shard locks, lazily-initialized embedding
+// rows, and fused server-side SGD/Adagrad/Adam appliers.  Transport is
+// pluggable (Python TCP service in distributed_ps/service.py; C ABI here).
+//
+// Build: g++ -O3 -shared -fPIC (see native/build.py).
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum OptType : int32_t { OPT_SGD = 0, OPT_ADAGRAD = 1, OPT_ADAM = 2,
+                         OPT_MOMENTUM = 3 };
+
+struct Optimizer {
+  int32_t type = OPT_SGD;
+  float lr = 0.01f;
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f, mu = 0.9f;
+};
+
+struct DenseTable {
+  std::vector<float> data;
+  std::vector<float> m1, m2, vel;  // optimizer state
+  double beta1_pow = 1.0, beta2_pow = 1.0;
+  Optimizer opt;
+  std::mutex mu_;
+
+  void init(const float* src, int64_t n) {
+    std::lock_guard<std::mutex> g(mu_);
+    data.assign(src, src + n);
+    m1.assign(n, 0.f);
+    m2.assign(n, 0.f);
+    vel.assign(n, 0.f);
+    beta1_pow = beta2_pow = 1.0;
+  }
+
+  void pull(float* dst) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::memcpy(dst, data.data(), data.size() * sizeof(float));
+  }
+
+  void push_grad(const float* grad, int64_t n) {
+    std::lock_guard<std::mutex> g(mu_);
+    apply(data.data(), grad, n);
+  }
+
+  void apply(float* w, const float* g, int64_t n) {
+    switch (opt.type) {
+      case OPT_SGD:
+        for (int64_t i = 0; i < n; ++i) w[i] -= opt.lr * g[i];
+        break;
+      case OPT_MOMENTUM:
+        for (int64_t i = 0; i < n; ++i) {
+          vel[i] = opt.mu * vel[i] + g[i];
+          w[i] -= opt.lr * vel[i];
+        }
+        break;
+      case OPT_ADAGRAD:
+        for (int64_t i = 0; i < n; ++i) {
+          m2[i] += g[i] * g[i];
+          w[i] -= opt.lr * g[i] / (std::sqrt(m2[i]) + opt.eps);
+        }
+        break;
+      case OPT_ADAM: {
+        beta1_pow *= opt.beta1;
+        beta2_pow *= opt.beta2;
+        float lr_t = opt.lr * std::sqrt(1.0 - beta2_pow) / (1.0 - beta1_pow);
+        for (int64_t i = 0; i < n; ++i) {
+          m1[i] = opt.beta1 * m1[i] + (1.f - opt.beta1) * g[i];
+          m2[i] = opt.beta2 * m2[i] + (1.f - opt.beta2) * g[i] * g[i];
+          w[i] -= lr_t * m1[i] / (std::sqrt(m2[i]) + opt.eps);
+        }
+        break;
+      }
+    }
+  }
+};
+
+constexpr int kShards = 32;
+
+struct SparseRow {
+  std::vector<float> w;
+  std::vector<float> m2;  // adagrad accumulator
+  uint32_t unseen_days = 0;
+};
+
+struct SparseShard {
+  std::unordered_map<int64_t, SparseRow> rows;
+  std::mutex mu_;
+};
+
+struct SparseTable {
+  int64_t dim;
+  float init_range = 0.01f;
+  Optimizer opt;
+  SparseShard shards[kShards];
+  uint64_t seed = 0x9e3779b97f4a7c15ull;
+
+  SparseRow& row(int64_t id, SparseShard& sh) {
+    auto it = sh.rows.find(id);
+    if (it == sh.rows.end()) {
+      SparseRow r;
+      r.w.resize(dim);
+      r.m2.assign(dim, 0.f);
+      // deterministic per-id init (splitmix64 -> uniform)
+      uint64_t x = (uint64_t)id * 0x9e3779b97f4a7c15ull + seed;
+      for (int64_t d = 0; d < dim; ++d) {
+        x += 0x9e3779b97f4a7c15ull;
+        uint64_t z = x;
+        z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+        z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+        z = z ^ (z >> 31);
+        float u = (float)(z >> 11) * (1.0f / 9007199254740992.0f);  // [0,1)
+        r.w[d] = (2.f * u - 1.f) * init_range;
+      }
+      it = sh.rows.emplace(id, std::move(r)).first;
+    }
+    return it->second;
+  }
+
+  void pull(const int64_t* ids, int64_t n, float* out) {
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t id = ids[i];
+      SparseShard& sh = shards[((uint64_t)id) % kShards];
+      std::lock_guard<std::mutex> g(sh.mu_);
+      SparseRow& r = row(id, sh);
+      std::memcpy(out + i * dim, r.w.data(), dim * sizeof(float));
+    }
+  }
+
+  void push_grad(const int64_t* ids, int64_t n, const float* grads) {
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t id = ids[i];
+      SparseShard& sh = shards[((uint64_t)id) % kShards];
+      std::lock_guard<std::mutex> g(sh.mu_);
+      SparseRow& r = row(id, sh);
+      const float* gr = grads + i * dim;
+      switch (opt.type) {
+        case OPT_ADAGRAD:
+          for (int64_t d = 0; d < dim; ++d) {
+            r.m2[d] += gr[d] * gr[d];
+            r.w[d] -= opt.lr * gr[d] / (std::sqrt(r.m2[d]) + opt.eps);
+          }
+          break;
+        default:
+          for (int64_t d = 0; d < dim; ++d) r.w[d] -= opt.lr * gr[d];
+      }
+    }
+  }
+
+  int64_t size() {
+    int64_t total = 0;
+    for (auto& sh : shards) {
+      std::lock_guard<std::mutex> g(sh.mu_);
+      total += (int64_t)sh.rows.size();
+    }
+    return total;
+  }
+
+  // shrink: drop rows unseen for `days` (reference:
+  // fleet_wrapper.h:232-259 SaveModel/Shrink capability)
+  int64_t shrink(uint32_t days) {
+    int64_t dropped = 0;
+    for (auto& sh : shards) {
+      std::lock_guard<std::mutex> g(sh.mu_);
+      for (auto it = sh.rows.begin(); it != sh.rows.end();) {
+        if (it->second.unseen_days >= days) {
+          it = sh.rows.erase(it);
+          ++dropped;
+        } else {
+          ++it->second.unseen_days;
+          ++it;
+        }
+      }
+    }
+    return dropped;
+  }
+
+  int64_t export_rows(int64_t* ids_out, float* w_out, int64_t cap) {
+    int64_t k = 0;
+    for (auto& sh : shards) {
+      std::lock_guard<std::mutex> g(sh.mu_);
+      for (auto& kv : sh.rows) {
+        if (k >= cap) return k;
+        ids_out[k] = kv.first;
+        std::memcpy(w_out + k * dim, kv.second.w.data(), dim * sizeof(float));
+        ++k;
+      }
+    }
+    return k;
+  }
+
+  void import_rows(const int64_t* ids, const float* ws, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t id = ids[i];
+      SparseShard& sh = shards[((uint64_t)id) % kShards];
+      std::lock_guard<std::mutex> g(sh.mu_);
+      SparseRow r;
+      r.w.assign(ws + i * dim, ws + (i + 1) * dim);
+      r.m2.assign(dim, 0.f);
+      sh.rows[id] = std::move(r);
+    }
+  }
+};
+
+std::vector<DenseTable*> g_dense;
+std::vector<SparseTable*> g_sparse;
+std::mutex g_mu;
+
+}  // namespace
+
+extern "C" {
+
+int32_t ps_create_dense(int64_t size, int32_t opt_type, float lr, float mu,
+                        float beta1, float beta2, float eps) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto* t = new DenseTable();
+  t->data.assign(size, 0.f);
+  t->m1.assign(size, 0.f);
+  t->m2.assign(size, 0.f);
+  t->vel.assign(size, 0.f);
+  t->opt = {opt_type, lr, beta1, beta2, eps, mu};
+  g_dense.push_back(t);
+  return (int32_t)g_dense.size() - 1;
+}
+
+void ps_init_dense(int32_t tid, const float* src, int64_t n) {
+  g_dense[tid]->init(src, n);
+}
+
+void ps_pull_dense(int32_t tid, float* dst) { g_dense[tid]->pull(dst); }
+
+void ps_push_dense_grad(int32_t tid, const float* grad, int64_t n) {
+  g_dense[tid]->push_grad(grad, n);
+}
+
+int64_t ps_dense_size(int32_t tid) {
+  return (int64_t)g_dense[tid]->data.size();
+}
+
+int32_t ps_create_sparse(int64_t dim, float init_range, int32_t opt_type,
+                         float lr, float eps, uint64_t seed) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto* t = new SparseTable();
+  t->dim = dim;
+  t->init_range = init_range;
+  t->opt.type = opt_type;
+  t->opt.lr = lr;
+  t->opt.eps = eps;
+  t->seed = seed;
+  g_sparse.push_back(t);
+  return (int32_t)g_sparse.size() - 1;
+}
+
+void ps_pull_sparse(int32_t tid, const int64_t* ids, int64_t n, float* out) {
+  g_sparse[tid]->pull(ids, n, out);
+}
+
+void ps_push_sparse_grad(int32_t tid, const int64_t* ids, int64_t n,
+                         const float* grads) {
+  g_sparse[tid]->push_grad(ids, n, grads);
+}
+
+int64_t ps_sparse_size(int32_t tid) { return g_sparse[tid]->size(); }
+
+int64_t ps_sparse_shrink(int32_t tid, uint32_t days) {
+  return g_sparse[tid]->shrink(days);
+}
+
+int64_t ps_sparse_export(int32_t tid, int64_t* ids, float* ws, int64_t cap) {
+  return g_sparse[tid]->export_rows(ids, ws, cap);
+}
+
+void ps_sparse_import(int32_t tid, const int64_t* ids, const float* ws,
+                      int64_t n) {
+  g_sparse[tid]->import_rows(ids, ws, n);
+}
+
+void ps_set_lr(int32_t dense_tid, float lr) {
+  if (dense_tid >= 0 && dense_tid < (int32_t)g_dense.size())
+    g_dense[dense_tid]->opt.lr = lr;
+}
+
+void ps_reset_all() {
+  std::lock_guard<std::mutex> g(g_mu);
+  for (auto* t : g_dense) delete t;
+  for (auto* t : g_sparse) delete t;
+  g_dense.clear();
+  g_sparse.clear();
+}
+
+}  // extern "C"

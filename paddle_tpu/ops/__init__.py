@@ -22,3 +22,4 @@ from . import tensor_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import control_ops  # noqa: F401
+from . import ps_ops  # noqa: F401

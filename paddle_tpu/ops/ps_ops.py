@@ -1,0 +1,123 @@
+"""Parameter-server op lowerings — HOST ops (run outside XLA).
+
+Capability parity with reference: paddle/fluid/operators/distributed_ops/
+(send_op.cc, recv_op.cc, send_barrier_op, fetch_barrier_op,
+distributed_lookup_table_op.cc, checkpoint_notify_op.cc) and
+operators/distributed/parameter_prefetch.cc.  These ops move values
+between the TPU program and the host-side C++ table service over DCN;
+programs containing them run on the executor's hybrid (op-by-op) path
+(SURVEY.md §7 hard-part 5: PS semantics have no XLA analog).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import EMPTY_VAR_NAME, GRAD_SUFFIX
+from .registry import op, grad_maker
+
+
+def _client():
+    from ..distributed_ps import runtime
+
+    return runtime.client()
+
+
+def _host(type, **kw):
+    return op(type, host=True, **kw)
+
+
+@_host("send", no_grad=True)
+def _send(ctx):
+    """Push grads to the pserver table (reference: send_op.cc)."""
+    client = _client()
+    names = ctx.op.inputs.get("X", [])
+    vals = ctx.ins("X")
+    table = ctx.attr("table_name")
+    for name, val in zip(names, vals):
+        tname = table or name
+        client.push_dense(tname, np.asarray(val),
+                          sync=ctx.attr("sync_mode", True))
+
+
+@_host("recv", no_grad=True)
+def _recv(ctx):
+    """Pull params from the pserver table (reference: recv_op.cc)."""
+    client = _client()
+    for slot_name in ctx.out_names("Out"):
+        table = ctx.attr("table_name") or slot_name
+        val = client.pull_dense(table)
+        var = ctx.block._find_var_recursive(slot_name) if ctx.block else None
+        if var is not None and var.shape:
+            val = val.reshape([s for s in var.shape])
+        ctx.env[slot_name] = val
+
+
+@_host("send_barrier", no_grad=True)
+def _send_barrier(ctx):
+    _client().barrier()
+
+
+@_host("fetch_barrier", no_grad=True)
+def _fetch_barrier(ctx):
+    _client().barrier()
+
+
+@_host("checkpoint_notify", no_grad=True)
+def _checkpoint_notify(ctx):
+    """reference: checkpoint_notify_op.cc — tell pservers to snapshot."""
+    _client().save(ctx.attr("dirname", "./ps_checkpoint"))
+
+
+@_host("distributed_lookup_table")
+def _distributed_lookup_table(ctx):
+    """Remote sparse embedding pull (reference:
+    distributed_lookup_table_op.cc + parameter_prefetch.cc)."""
+    client = _client()
+    table = ctx.attr("table_name")
+    dim = ctx.attr("emb_dim")
+    ids_vals = ctx.ins("Ids")
+    outs = []
+    for ids in ids_vals:
+        ids_np = np.asarray(ids).astype(np.int64)
+        flat = ids_np.ravel()
+        rows = client.pull_sparse(table, flat)
+        outs.append(rows.reshape(ids_np.shape + (dim,)))
+    ctx.set_out("Outputs", outs)
+
+
+@grad_maker("distributed_lookup_table")
+def _dlt_grad_maker(op_, no_grad_names=frozenset()):
+    return [dict(
+        type="distributed_lookup_table_grad",
+        inputs={
+            "Ids": op_.input("Ids"),
+            "Outputs" + GRAD_SUFFIX: [
+                n + GRAD_SUFFIX for n in op_.output("Outputs")],
+        },
+        outputs={},
+        attrs=dict(op_.attrs),
+    )]
+
+
+@_host("distributed_lookup_table_grad", no_grad=True)
+def _distributed_lookup_table_grad(ctx):
+    """Push sparse grads (reference: PushSparseVarsWithLabelAsync shape)."""
+    client = _client()
+    table = ctx.attr("table_name")
+    dim = ctx.attr("emb_dim")
+    for ids, g in zip(ctx.ins("Ids"), ctx.ins("Outputs" + GRAD_SUFFIX)):
+        ids_np = np.asarray(ids).astype(np.int64).ravel()
+        g_np = np.asarray(g).reshape(ids_np.size, dim)
+        client.push_sparse(table, ids_np, g_np)
+
+
+@_host("listen_and_serv", no_grad=True)
+def _listen_and_serv(ctx):
+    """reference: listen_and_serv_op.cc — blocking server loop.  The fleet
+    PS runtime starts PSServer directly (fleet.run_server()); executing
+    this op does the same for reference-style pserver programs."""
+    from ..distributed_ps.service import PSServer
+
+    ep = ctx.attr("endpoint", "127.0.0.1:0")
+    server = PSServer(ep, n_trainers=ctx.attr("Fanin", 1))
+    server.start(block=True)

@@ -44,6 +44,7 @@ class OpDef:
         "grad_maker",
         "no_grad",
         "stateful",
+        "host",
     )
 
     def __init__(self, type):
@@ -53,9 +54,11 @@ class OpDef:
         self.grad_maker: Optional[Callable] = None
         self.no_grad = False
         self.stateful = False  # uses rng; grad must not replay
+        self.host = False      # runs on host (RPC/IO) — cannot be jitted
 
 
-def op(type: str, *, infer=None, no_grad: bool = False, stateful: bool = False):
+def op(type: str, *, infer=None, no_grad: bool = False, stateful: bool = False,
+       host: bool = False):
     """Decorator registering a forward lowering for ``type``."""
 
     def deco(fn):
@@ -64,9 +67,15 @@ def op(type: str, *, infer=None, no_grad: bool = False, stateful: bool = False):
         d.infer_shape = infer
         d.no_grad = no_grad
         d.stateful = stateful
+        d.host = host
         return fn
 
     return deco
+
+
+def is_host_op(type: str) -> bool:
+    d = OPS.get(type)
+    return bool(d is not None and d.host)
 
 
 def grad_maker(type: str):

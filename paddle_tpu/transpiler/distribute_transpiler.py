@@ -142,6 +142,7 @@ class DistributeTranspiler:
                 "send",
                 inputs={"X": [g]},
                 attrs={"epmap": [ep], "send_varnames": [g],
+                       "table_name": p,
                        "sync_mode": sync_mode, OP_ROLE_KEY: OpRole.RPC},
             )
         if sync_mode:
@@ -156,6 +157,7 @@ class DistributeTranspiler:
                 "recv",
                 outputs={"Out": [p]},
                 attrs={"epmap": [ep], "recv_varnames": [p],
+                       "table_name": p,
                        "sync_mode": sync_mode, OP_ROLE_KEY: OpRole.RPC},
             )
         if sync_mode:

@@ -1,0 +1,200 @@
+"""Parameter-server path tests: native table store, TCP service, and the
+end-to-end PS training loop matching local training
+(reference analog: test_dist_base.py's local-vs-cluster loss comparison,
+test_dist_mnist family — here in-process server threads instead of
+subprocesses, same oracle)."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope
+
+
+def test_native_dense_table():
+    from paddle_tpu.distributed_ps import DenseTable
+
+    t = DenseTable(8, optimizer="sgd", lr=0.1)
+    t.init(np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(t.pull(), np.arange(8))
+    t.push_grad(np.ones(8, np.float32))
+    np.testing.assert_allclose(t.pull(), np.arange(8) - 0.1)
+
+
+def test_native_sparse_table():
+    from paddle_tpu.distributed_ps import SparseTable
+
+    t = SparseTable(4, init_range=0.05, optimizer="sgd", lr=1.0)
+    ids = np.array([5, 9, 5], np.int64)
+    rows = t.pull(ids)
+    assert rows.shape == (3, 4)
+    np.testing.assert_allclose(rows[0], rows[2])  # same id, same init
+    assert np.abs(rows).max() <= 0.05
+    before = t.pull(np.array([5], np.int64))[0].copy()
+    t.push_grad(np.array([5], np.int64), np.ones((1, 4), np.float32))
+    after = t.pull(np.array([5], np.int64))[0]
+    np.testing.assert_allclose(after, before - 1.0, rtol=1e-6)
+    assert len(t) == 2
+
+
+def test_ps_service_roundtrip(tmp_path):
+    from paddle_tpu.distributed_ps import PSClient, PSServer
+
+    server = PSServer("127.0.0.1:0", n_trainers=1).start()
+    try:
+        client = PSClient([server.endpoint])
+        client.create_dense("w", 4, optimizer="sgd", lr=0.5)
+        client.init_dense("w", np.array([1, 2, 3, 4], np.float32))
+        client.push_dense("w", np.ones(4, np.float32))
+        np.testing.assert_allclose(client.pull_dense("w"),
+                                   [0.5, 1.5, 2.5, 3.5])
+        client.create_sparse("emb", 3, optimizer="sgd", lr=1.0)
+        rows = client.pull_sparse("emb", np.array([1, 2], np.int64))
+        assert rows.shape == (2, 3)
+        client.push_sparse("emb", np.array([1], np.int64),
+                           np.ones((1, 3), np.float32))
+        rows2 = client.pull_sparse("emb", np.array([1], np.int64))
+        np.testing.assert_allclose(rows2[0], rows[0] - 1.0, rtol=1e-5)
+        # heartbeat + checkpoint
+        client.heartbeat(0)
+        assert "0" in client.worker_status()
+        client.save(str(tmp_path / "ckpt"))
+        client.push_dense("w", np.ones(4, np.float32))
+        client.load(str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(client.pull_dense("w"),
+                                   [0.5, 1.5, 2.5, 3.5])
+        client.close()
+    finally:
+        server.stop()
+
+
+def _build_model(seed=21):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGDOptimizer(0.1)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_ps_training_matches_local():
+    """Sync PS with 1 trainer must exactly match local training —
+    the reference's check_with_place oracle (test_dist_base.py:933)."""
+    from paddle_tpu.incubate.fleet.parameter_server import (
+        FleetTranspiler, ParameterServerOptimizer)
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        UserDefinedRoleMaker, Role)
+    from paddle_tpu.distributed_ps import runtime
+    from paddle_tpu.distributed_ps.service import PSServer
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = (xs[:, :1] * 1.5 - 0.5).astype(np.float32)
+
+    # --- local reference run
+    main_l, startup_l, loss_l = _build_model()
+    scope_l = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup_l, scope=scope_l)
+    init = {k: np.asarray(v) for k, v in scope_l.items()
+            if not k.startswith("@")}
+    local_losses = [
+        float(exe.run(main_l, feed={"x": xs, "y": ys},
+                      fetch_list=[loss_l], scope=scope_l)[0])
+        for _ in range(5)
+    ]
+
+    # --- PS run (1 trainer, 1 in-process server)
+    server = PSServer("127.0.0.1:0", n_trainers=1).start()
+    try:
+        fleet = FleetTranspiler()
+        fleet.init(UserDefinedRoleMaker(
+            current_id=0, role=Role.WORKER, worker_num=1,
+            server_endpoints=[server.endpoint]))
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        main_p.random_seed = 21
+        with fluid.program_guard(main_p, startup_p):
+            x = fluid.layers.data("x", [8])
+            y = fluid.layers.data("y", [1])
+            h = fluid.layers.fc(x, 16, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            opt = fluid.optimizer.SGDOptimizer(0.1)
+            dist_opt = fleet.distributed_optimizer(opt)
+            dist_opt.minimize(loss)
+
+        types = [op.type for op in main_p.global_block().ops]
+        assert "send" in types and "recv" in types
+        assert "sgd" not in types  # optimize moved to the server
+
+        scope_p = Scope()
+        from paddle_tpu.framework.scope import scope_guard
+
+        with scope_guard(scope_p):
+            exe.run(startup_p, scope=scope_p)
+            # identical init as local run
+            for k, v in init.items():
+                if scope_p.has(k):
+                    scope_p.set(k, v.copy())
+            fleet.init_worker()
+            ps_losses = [
+                float(exe.run(main_p, feed={"x": xs, "y": ys},
+                              fetch_list=[loss], scope=scope_p)[0])
+                for _ in range(5)
+            ]
+            fleet.stop_worker()
+        np.testing.assert_allclose(local_losses, ps_losses, rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        server.stop()
+        runtime.clear()
+
+
+def test_distributed_lookup_table():
+    """Remote sparse embedding forward + backward push."""
+    from paddle_tpu.distributed_ps import runtime
+    from paddle_tpu.distributed_ps.service import PSClient, PSServer
+
+    server = PSServer("127.0.0.1:0", n_trainers=1).start()
+    try:
+        client = PSClient([server.endpoint])
+        client.create_sparse("emb_table", 4, optimizer="sgd", lr=0.5,
+                             init_range=0.1)
+        runtime.set_client(client)
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [5], dtype="int64")
+            out = main.global_block().create_var(name="emb_out",
+                                                 dtype="float32")
+            main.global_block().append_op(
+                "distributed_lookup_table",
+                inputs={"Ids": [ids]},
+                outputs={"Outputs": [out]},
+                attrs={"table_name": "emb_table", "emb_dim": 4})
+            out.shape = (-1, 5, 4)
+            out.stop_gradient = False
+            loss = fluid.layers.reduce_sum(out)
+            pt.append_backward(loss)
+
+        exe = pt.Executor(pt.CPUPlace())
+        ids_np = np.array([[1, 2, 3, 4, 5]], np.int64)
+        before = client.pull_sparse("emb_table", ids_np.ravel()).copy()
+        got = exe.run(main, feed={"ids": ids_np}, fetch_list=[out.name])[0]
+        np.testing.assert_allclose(got.reshape(5, 4), before, rtol=1e-5)
+        after = client.pull_sparse("emb_table", ids_np.ravel())
+        # backward pushed grad=1 -> rows decreased by lr*1
+        np.testing.assert_allclose(after, before - 0.5, rtol=1e-5)
+        client.close()
+    finally:
+        server.stop()
+        runtime.clear()
