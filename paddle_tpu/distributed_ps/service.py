@@ -75,6 +75,16 @@ class PSServer:
 
     # ------------------------------------------------------------------
     def _handle(self, op, name, meta, arrays, sock):
+        try:
+            self._handle_inner(op, name, meta, arrays, sock)
+        except (ConnectionError, OSError):
+            raise
+        except Exception as e:  # reply instead of killing the connection
+            _send_msg(sock, "error",
+                      meta={"what": f"{type(e).__name__}: {e}", "op": op,
+                            "table": name})
+
+    def _handle_inner(self, op, name, meta, arrays, sock):
         if op == "create_dense":
             with self._lock:
                 if name not in self.dense:
@@ -99,7 +109,7 @@ class PSServer:
             _send_msg(sock, "ok", arrays=[self.dense[name].pull()])
         elif op == "push_dense":
             self.dense[name].push_grad(arrays[0])
-            _send_msg(sock, "ok" if meta.get("sync", True) else "ok")
+            _send_msg(sock, "ok")
         elif op == "pull_sparse":
             _send_msg(sock, "ok", arrays=[self.sparse[name].pull(arrays[0])])
         elif op == "push_sparse":
@@ -110,6 +120,8 @@ class PSServer:
             try:
                 self._barrier.wait(timeout=meta.get("timeout", 120.0))
             except threading.BrokenBarrierError:
+                # recover for subsequent rounds instead of staying broken
+                self._barrier.reset()
                 _send_msg(sock, "error", meta={"what": "barrier broken"})
                 return
             _send_msg(sock, "ok")
@@ -226,15 +238,31 @@ class PSClient:
 
     def _call(self, ep, op, name="", meta=None, arrays=()):
         s = self._sock(ep)
-        with self._lock:
-            _send_msg(s, op, name, meta, arrays)
-            rop, _, rmeta, rarrays = _recv_msg(s)
+        try:
+            with self._lock:
+                _send_msg(s, op, name, meta, arrays)
+                rop, _, rmeta, rarrays = _recv_msg(s)
+        except (ConnectionError, OSError):
+            # evict the dead socket so the next call reconnects
+            with self._lock:
+                if self._socks.get(ep) is s:
+                    del self._socks[ep]
+            try:
+                s.close()
+            except OSError:
+                pass
+            raise
         if rop == "error":
             raise RuntimeError(f"PS error from {ep}: {rmeta}")
         return rmeta, rarrays
 
     def _ep_for(self, name: str) -> str:
-        return self.endpoints[hash(name) % len(self.endpoints)]
+        # deterministic across processes (built-in hash() is salted per
+        # process, which would route the same table to different servers
+        # on different trainers)
+        import zlib
+
+        return self.endpoints[zlib.crc32(name.encode()) % len(self.endpoints)]
 
     # ------------------------------------------------------------------
     def create_dense(self, name, size, **cfg):

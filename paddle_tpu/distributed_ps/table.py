@@ -26,10 +26,35 @@ class _Lib:
             lib.ps_create_sparse.argtypes = [
                 ctypes.c_int64, ctypes.c_float, ctypes.c_int32,
                 ctypes.c_float, ctypes.c_float, ctypes.c_uint64]
-            lib.ps_dense_size.restype = ctypes.c_int64
-            lib.ps_sparse_size.restype = ctypes.c_int64
-            lib.ps_sparse_shrink.restype = ctypes.c_int64
-            lib.ps_sparse_export.restype = ctypes.c_int64
+            # every int64 length must be declared or ctypes marshals
+            # Python ints as 32-bit C ints (silent truncation past 2^31)
+            i32, i64 = ctypes.c_int32, ctypes.c_int64
+            fp = ctypes.POINTER(ctypes.c_float)
+            ip = ctypes.POINTER(ctypes.c_int64)
+            lib.ps_init_dense.argtypes = [i32, fp, i64]
+            lib.ps_init_dense.restype = None
+            lib.ps_pull_dense.argtypes = [i32, fp]
+            lib.ps_pull_dense.restype = None
+            lib.ps_push_dense_grad.argtypes = [i32, fp, i64]
+            lib.ps_push_dense_grad.restype = None
+            lib.ps_dense_size.argtypes = [i32]
+            lib.ps_dense_size.restype = i64
+            lib.ps_pull_sparse.argtypes = [i32, ip, i64, fp]
+            lib.ps_pull_sparse.restype = None
+            lib.ps_push_sparse_grad.argtypes = [i32, ip, i64, fp]
+            lib.ps_push_sparse_grad.restype = None
+            lib.ps_sparse_size.argtypes = [i32]
+            lib.ps_sparse_size.restype = i64
+            lib.ps_sparse_shrink.argtypes = [i32, i64]
+            lib.ps_sparse_shrink.restype = i64
+            lib.ps_sparse_export.argtypes = [i32, ip, fp, i64]
+            lib.ps_sparse_export.restype = i64
+            lib.ps_sparse_import.argtypes = [i32, ip, fp, i64]
+            lib.ps_sparse_import.restype = None
+            lib.ps_set_lr.argtypes = [i32, ctypes.c_float]
+            lib.ps_set_lr.restype = None
+            lib.ps_reset_all.argtypes = []
+            lib.ps_reset_all.restype = None
             cls._lib = lib
         return cls._lib
 

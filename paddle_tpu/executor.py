@@ -94,6 +94,11 @@ class Executor:
             return program._run(self, feed, fetch_list, scope, return_numpy)
         if program is None:
             program = default_main_program()
+        if getattr(program, "_pipeline_opt", None):
+            from .parallel.pipeline import run_pipeline
+
+            return run_pipeline(self, program, feed, fetch_list, scope,
+                                return_numpy)
         scope = scope or global_scope()
         feed = dict(feed or {})
         fetch_names = [_fetch_name(f) for f in (fetch_list or [])]

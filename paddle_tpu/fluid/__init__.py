@@ -116,14 +116,7 @@ def cpu_places(device_count=None):
     return [CPUPlace() for _ in range(device_count)]
 
 
-def device_guard(device=None):
-    import contextlib
-
-    @contextlib.contextmanager
-    def _guard():
-        yield
-
-    return _guard()
+from ..framework.core import device_guard  # noqa: F401
 
 
 _flags = {}

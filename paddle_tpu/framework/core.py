@@ -365,6 +365,9 @@ class Block:
         self, type: str, inputs=None, outputs=None, attrs=None, index=None
     ) -> Operator:
         op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        dev = self.program._current_device
+        if dev is not None and "op_device" not in op.attrs:
+            op.attrs["op_device"] = dev
         from ..ops import registry  # local import to avoid cycles
 
         registry.infer_shape(op, self)
@@ -424,6 +427,11 @@ class Program:
         # distillation of reference's Program attributes used by transpilers
         self._parameters_on_pservers = None
         self._sharding_spec = None  # TPU-native: program-level default sharding
+        # fluid.device_guard state (reference: framework.py:5420): ops
+        # appended inside the guard carry an `op_device` attr; the pipeline
+        # splitter groups contiguous annotations into stages.
+        self._current_device = None
+        self._pipeline_opt = None
 
     # -- blocks ------------------------------------------------------------
     def global_block(self) -> Block:
@@ -566,6 +574,20 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
         switch_main_program(prev_main)
         if prev_startup is not None:
             switch_startup_program(prev_startup)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference: framework.py:5420 fluid.device_guard.  Ops appended
+    inside the guard are annotated with ``op_device``; PipelineOptimizer
+    uses contiguous annotations as stage boundaries."""
+    prog = _main_program
+    prev = prog._current_device
+    prog._current_device = device
+    try:
+        yield
+    finally:
+        prog._current_device = prev
 
 
 @contextlib.contextmanager

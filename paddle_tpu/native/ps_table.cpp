@@ -138,6 +138,7 @@ struct SparseTable {
       SparseShard& sh = shards[((uint64_t)id) % kShards];
       std::lock_guard<std::mutex> g(sh.mu_);
       SparseRow& r = row(id, sh);
+      r.unseen_days = 0;
       std::memcpy(out + i * dim, r.w.data(), dim * sizeof(float));
     }
   }
@@ -148,6 +149,7 @@ struct SparseTable {
       SparseShard& sh = shards[((uint64_t)id) % kShards];
       std::lock_guard<std::mutex> g(sh.mu_);
       SparseRow& r = row(id, sh);
+      r.unseen_days = 0;
       const float* gr = grads + i * dim;
       switch (opt.type) {
         case OPT_ADAGRAD:
@@ -171,18 +173,20 @@ struct SparseTable {
     return total;
   }
 
-  // shrink: drop rows unseen for `days` (reference:
-  // fleet_wrapper.h:232-259 SaveModel/Shrink capability)
-  int64_t shrink(uint32_t days) {
+  // shrink: age all rows one tick, then drop rows whose age reached
+  // `days` ticks without a pull/push touching them (accesses reset the
+  // age).  days <= 0 is a no-op so a default shrink() can never wipe the
+  // table.  (reference: fleet_wrapper.h:232-259 SaveModel/Shrink)
+  int64_t shrink(int64_t days) {
+    if (days <= 0) return 0;
     int64_t dropped = 0;
     for (auto& sh : shards) {
       std::lock_guard<std::mutex> g(sh.mu_);
       for (auto it = sh.rows.begin(); it != sh.rows.end();) {
-        if (it->second.unseen_days >= days) {
+        if (++it->second.unseen_days >= (uint32_t)days) {
           it = sh.rows.erase(it);
           ++dropped;
         } else {
-          ++it->second.unseen_days;
           ++it;
         }
       }
@@ -221,40 +225,56 @@ std::vector<DenseTable*> g_dense;
 std::vector<SparseTable*> g_sparse;
 std::mutex g_mu;
 
+// copy the table pointer under g_mu: a concurrent create's push_back may
+// reallocate the vector while another connection thread is reading it
+DenseTable* dense_at(int32_t tid) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (tid < 0 || tid >= (int32_t)g_dense.size()) return nullptr;
+  return g_dense[tid];
+}
+
+SparseTable* sparse_at(int32_t tid) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (tid < 0 || tid >= (int32_t)g_sparse.size()) return nullptr;
+  return g_sparse[tid];
+}
+
 }  // namespace
 
 extern "C" {
 
 int32_t ps_create_dense(int64_t size, int32_t opt_type, float lr, float mu,
                         float beta1, float beta2, float eps) {
-  std::lock_guard<std::mutex> g(g_mu);
   auto* t = new DenseTable();
   t->data.assign(size, 0.f);
   t->m1.assign(size, 0.f);
   t->m2.assign(size, 0.f);
   t->vel.assign(size, 0.f);
   t->opt = {opt_type, lr, beta1, beta2, eps, mu};
+  std::lock_guard<std::mutex> g(g_mu);
   g_dense.push_back(t);
   return (int32_t)g_dense.size() - 1;
 }
 
 void ps_init_dense(int32_t tid, const float* src, int64_t n) {
-  g_dense[tid]->init(src, n);
+  if (auto* t = dense_at(tid)) t->init(src, n);
 }
 
-void ps_pull_dense(int32_t tid, float* dst) { g_dense[tid]->pull(dst); }
+void ps_pull_dense(int32_t tid, float* dst) {
+  if (auto* t = dense_at(tid)) t->pull(dst);
+}
 
 void ps_push_dense_grad(int32_t tid, const float* grad, int64_t n) {
-  g_dense[tid]->push_grad(grad, n);
+  if (auto* t = dense_at(tid)) t->push_grad(grad, n);
 }
 
 int64_t ps_dense_size(int32_t tid) {
-  return (int64_t)g_dense[tid]->data.size();
+  auto* t = dense_at(tid);
+  return t ? (int64_t)t->data.size() : -1;
 }
 
 int32_t ps_create_sparse(int64_t dim, float init_range, int32_t opt_type,
                          float lr, float eps, uint64_t seed) {
-  std::lock_guard<std::mutex> g(g_mu);
   auto* t = new SparseTable();
   t->dim = dim;
   t->init_range = init_range;
@@ -262,37 +282,42 @@ int32_t ps_create_sparse(int64_t dim, float init_range, int32_t opt_type,
   t->opt.lr = lr;
   t->opt.eps = eps;
   t->seed = seed;
+  std::lock_guard<std::mutex> g(g_mu);
   g_sparse.push_back(t);
   return (int32_t)g_sparse.size() - 1;
 }
 
 void ps_pull_sparse(int32_t tid, const int64_t* ids, int64_t n, float* out) {
-  g_sparse[tid]->pull(ids, n, out);
+  if (auto* t = sparse_at(tid)) t->pull(ids, n, out);
 }
 
 void ps_push_sparse_grad(int32_t tid, const int64_t* ids, int64_t n,
                          const float* grads) {
-  g_sparse[tid]->push_grad(ids, n, grads);
+  if (auto* t = sparse_at(tid)) t->push_grad(ids, n, grads);
 }
 
-int64_t ps_sparse_size(int32_t tid) { return g_sparse[tid]->size(); }
+int64_t ps_sparse_size(int32_t tid) {
+  auto* t = sparse_at(tid);
+  return t ? t->size() : -1;
+}
 
-int64_t ps_sparse_shrink(int32_t tid, uint32_t days) {
-  return g_sparse[tid]->shrink(days);
+int64_t ps_sparse_shrink(int32_t tid, int64_t days) {
+  auto* t = sparse_at(tid);
+  return t ? t->shrink(days) : 0;
 }
 
 int64_t ps_sparse_export(int32_t tid, int64_t* ids, float* ws, int64_t cap) {
-  return g_sparse[tid]->export_rows(ids, ws, cap);
+  auto* t = sparse_at(tid);
+  return t ? t->export_rows(ids, ws, cap) : 0;
 }
 
 void ps_sparse_import(int32_t tid, const int64_t* ids, const float* ws,
                       int64_t n) {
-  g_sparse[tid]->import_rows(ids, ws, n);
+  if (auto* t = sparse_at(tid)) t->import_rows(ids, ws, n);
 }
 
 void ps_set_lr(int32_t dense_tid, float lr) {
-  if (dense_tid >= 0 && dense_tid < (int32_t)g_dense.size())
-    g_dense[dense_tid]->opt.lr = lr;
+  if (auto* t = dense_at(dense_tid)) t->opt.lr = lr;
 }
 
 void ps_reset_all() {

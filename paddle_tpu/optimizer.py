@@ -676,6 +676,57 @@ class RecomputeOptimizer(Optimizer):
         return getattr(self._optimizer, item)
 
 
+class PipelineOptimizer:
+    """Pipeline-parallel wrapper (reference: optimizer.py:3556-3640 —
+    splits the program by cut-vars into sections across heterogeneous
+    places, run by PipelineTrainer/SectionWorker threads+queues).
+
+    TPU-native: ``minimize`` runs the inner optimizer as usual, then
+    attaches ``program._pipeline_opt`` metadata (loss, microbatch count,
+    cut vars, param/grad pairs).  ``Executor.run`` detects the metadata
+    and executes via ``parallel.pipeline.run_pipeline``: forward sections
+    traced into one jit, ``lax.scan`` over microbatches accumulating
+    grads, program's own optimizer ops applying the update.  Homogeneous
+    stages can instead use ``parallel.pipeline.spmd_pipeline`` (ppermute
+    over a `pp` mesh axis).
+    """
+
+    def __init__(self, optimizer, num_microbatches=1, cut_list=None,
+                 place_list=None, concurrency_list=None, queue_size=30,
+                 sync_steps=1, start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._num_microbatches = int(num_microbatches)
+        self._cut_list = cut_list
+        # place/concurrency/queue knobs are accepted for API parity; the
+        # TPU schedule has no host threads or queues to configure.
+        self._place_list = place_list
+        self._concurrency_list = concurrency_list
+        self._queue_size = queue_size
+        self._sync_steps = sync_steps
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        program = loss.block.program
+        cut_names = []
+        for group in (self._cut_list or []):
+            vars_ = group if isinstance(group, (list, tuple)) else [group]
+            for v in vars_:
+                cut_names.append(v if isinstance(v, str) else v.name)
+        program._pipeline_opt = {
+            "loss_name": loss.name,
+            "num_microbatches": self._num_microbatches,
+            "cut_vars": cut_names,
+            "params_grads": [(p.name, g.name) for p, g in params_grads],
+        }
+        return opt_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
 class LookaheadOptimizer:
     """reference: optimizer.py:4150 — slow/fast weight interpolation."""
 

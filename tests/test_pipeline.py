@@ -1,0 +1,150 @@
+"""Pipeline parallelism tests (reference analogs:
+python/paddle/fluid/tests/unittests/test_pipeline.py and the
+PipelineOptimizer section-splitting contract, optimizer.py:3556)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+def _mlp(x, label, hidden=16):
+    with fluid.device_guard("tpu:0"):
+        h1 = fluid.layers.fc(x, size=hidden, act="relu")
+    with fluid.device_guard("tpu:1"):
+        h2 = fluid.layers.fc(h1, size=hidden, act="relu")
+        pred = fluid.layers.fc(h2, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, label))
+    return loss
+
+
+def _build(seed, use_pipeline, num_microbatches=4):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        label = fluid.layers.data("label", [1])
+        loss = _mlp(x, label)
+        inner = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        if use_pipeline:
+            opt = fluid.optimizer.PipelineOptimizer(
+                inner, num_microbatches=num_microbatches
+            )
+        else:
+            opt = inner
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_section_splitting():
+    from paddle_tpu.parallel.pipeline import split_forward_sections
+
+    main, startup, loss = _build(3, use_pipeline=True)
+    secs = split_forward_sections(main, (), {"x", "label"})
+    assert len(secs) == 2
+    assert secs[0].device == "tpu:0"
+    assert secs[1].device == "tpu:1"
+    # stage 0's output activation feeds stage 1
+    assert secs[0].out_names, "first section must export activations"
+    for n in secs[0].out_names:
+        assert n in secs[1].in_names
+    # each section reads its own fc params
+    assert secs[0].param_names and secs[1].param_names
+    assert not set(secs[0].param_names) & set(secs[1].param_names)
+
+
+def test_pipeline_matches_plain_training():
+    """Microbatched pipeline == plain single-batch training (grads are
+    averaged over microbatches, so trajectories must coincide)."""
+    rng = np.random.RandomState(0)
+    xs = rng.rand(64, 8).astype("float32")
+    w = rng.rand(8, 1).astype("float32")
+    ys = (xs @ w + 0.1 * rng.randn(64, 1)).astype("float32")
+
+    losses = {}
+    for mode in ("plain", "pipeline"):
+        from paddle_tpu.framework.scope import Scope
+        from paddle_tpu.framework import scope as scope_mod
+
+        main, startup, loss = _build(7, use_pipeline=(mode == "pipeline"))
+        scope = Scope()
+        prev = scope_mod._global_scope
+        scope_mod._global_scope = scope
+        try:
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            out = []
+            for step in range(6):
+                lo = exe.run(main, feed={"x": xs, "label": ys},
+                             fetch_list=[loss])
+                out.append(float(np.asarray(lo[0]).squeeze()))
+        finally:
+            scope_mod._global_scope = prev
+        losses[mode] = out
+
+    np.testing.assert_allclose(losses["plain"], losses["pipeline"],
+                               rtol=2e-4, atol=2e-5)
+    assert losses["pipeline"][-1] < losses["pipeline"][0]
+
+
+def test_spmd_pipeline_matches_sequential():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from paddle_tpu.parallel.pipeline import spmd_pipeline
+
+    S, M, D, F = 4, 8, 4, 16
+    rng = np.random.RandomState(1)
+    Ws = rng.randn(S, F, F).astype("float32") * 0.1
+    bs = rng.randn(S, F).astype("float32") * 0.1
+    x = rng.randn(M, D, F).astype("float32")
+
+    def stage_fn(params, h):
+        W, b = params
+        return jnp.tanh(h @ W + b)
+
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    out = spmd_pipeline(stage_fn, (Ws, bs), x, mesh, axis="pp")
+
+    ref = x
+    for k in range(S):
+        ref = np.tanh(ref @ Ws[k] + bs[k])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_spmd_pipeline_grads():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from paddle_tpu.parallel.pipeline import spmd_pipeline
+
+    S, M, D, F = 2, 4, 3, 8
+    rng = np.random.RandomState(2)
+    Ws = rng.randn(S, F, F).astype("float32") * 0.2
+    bs = rng.randn(S, F).astype("float32") * 0.2
+    x = rng.randn(M, D, F).astype("float32")
+
+    def stage_fn(params, h):
+        W, b = params
+        return jnp.tanh(h @ W + b)
+
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+
+    def pipe_loss(params):
+        out = spmd_pipeline(stage_fn, params, x, mesh, axis="pp")
+        return jnp.sum(out ** 2)
+
+    def seq_loss(params):
+        Ws_, bs_ = params
+        h = x
+        for k in range(S):
+            h = jnp.tanh(h @ Ws_[k] + bs_[k])
+        return jnp.sum(h ** 2)
+
+    gp = jax.grad(pipe_loss)((Ws, bs))
+    gs = jax.grad(seq_loss)((Ws, bs))
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
