@@ -120,8 +120,11 @@ class PSServer:
             try:
                 self._barrier.wait(timeout=meta.get("timeout", 120.0))
             except threading.BrokenBarrierError:
-                # recover for subsequent rounds instead of staying broken
-                self._barrier.reset()
+                # recover for subsequent rounds; exactly one waiter resets
+                # (a second reset() would break waiters of the next round)
+                with self._lock:
+                    if self._barrier.broken:
+                        self._barrier.reset()
                 _send_msg(sock, "error", meta={"what": "barrier broken"})
                 return
             _send_msg(sock, "ok")

@@ -65,6 +65,44 @@ def as_numpy(value):
     return np.asarray(value)
 
 
+def analyze_state(ops, block, feed_names, scope, skip_suffixes=()):
+    """Shared read/write analysis: which vars the op list reads before
+    writing (``state_in``), which persistable/scope-resident vars it
+    writes (``state_out``), whether any op consumes the RNG key, and
+    whether any host (non-jittable) op is present.  Used by the
+    single-device executor, the data-parallel runner, and the pipeline
+    runner so the rules can't drift apart."""
+    feed_names = set(feed_names)
+    written: set = set()
+    state_in: List[str] = []
+    uses_rng = False
+    has_host_ops = False
+    for op_ in ops:
+        d = registry.OPS.get(op_.type)
+        if d is not None and d.stateful:
+            uses_rng = True
+        if d is not None and d.host:
+            has_host_ops = True
+        for name in op_.input_arg_names:
+            if (name not in written and name not in feed_names
+                    and name != "@EMPTY@" and name not in state_in
+                    and not any(name.endswith(s) for s in skip_suffixes)):
+                state_in.append(name)
+        written.update(op_.output_arg_names)
+    written.discard("@EMPTY@")
+    state_out = sorted(
+        n for n in written
+        if ((v := block._find_var_recursive(n)) is not None and v.persistable)
+        or scope.has(n)
+    )
+    if uses_rng:
+        if RNG_VAR not in state_in:
+            state_in.append(RNG_VAR)
+        if RNG_VAR not in state_out:
+            state_out.append(RNG_VAR)
+    return state_in, state_out, uses_rng, has_host_ops
+
+
 class Executor:
     """reference: python/paddle/fluid/executor.py:461 Executor."""
 
@@ -121,41 +159,9 @@ class Executor:
             return hit
 
         block = program.global_block()
-        feed_names = set(feed)
-        written: set = set()
-        state_in: List[str] = []
-        uses_rng = False
-        has_host_ops = False
-        for op_ in block.ops:
-            d = registry.OPS.get(op_.type)
-            if d is not None and d.stateful:
-                uses_rng = True
-            if d is not None and d.host:
-                has_host_ops = True
-            if op_.type.endswith("_grad"):
-                uses_rng = uses_rng  # replay may use rng only for stateful fwd
-            for name in op_.input_arg_names:
-                if (
-                    name not in written
-                    and name not in feed_names
-                    and name != "@EMPTY@"
-                    and name not in state_in
-                ):
-                    state_in.append(name)
-            written.update(op_.output_arg_names)
-        written.discard("@EMPTY@")
-
-        state_out: List[str] = []
-        for name in written:
-            var = block._find_var_recursive(name)
-            if (var is not None and var.persistable) or scope.has(name):
-                state_out.append(name)
-        state_out.sort()
-        if uses_rng:
-            if RNG_VAR not in state_in:
-                state_in.append(RNG_VAR)
-            if RNG_VAR not in state_out:
-                state_out.append(RNG_VAR)
+        state_in, state_out, uses_rng, has_host_ops = analyze_state(
+            block.ops, block, feed, scope
+        )
 
         ops = list(block.ops)
         fetch = list(fetch_names)

@@ -321,9 +321,16 @@ void ps_set_lr(int32_t dense_tid, float lr) {
 }
 
 void ps_reset_all() {
+  // Tables are parked, not deleted: a server handler thread may still be
+  // inside a pull/push through a pointer copied by dense_at/sparse_at,
+  // so freeing here would be a use-after-free.  reset is a test/teardown
+  // API; the parked tables' memory is reclaimed at process exit.
+  static std::vector<DenseTable*> dense_graveyard;
+  static std::vector<SparseTable*> sparse_graveyard;
   std::lock_guard<std::mutex> g(g_mu);
-  for (auto* t : g_dense) delete t;
-  for (auto* t : g_sparse) delete t;
+  dense_graveyard.insert(dense_graveyard.end(), g_dense.begin(), g_dense.end());
+  sparse_graveyard.insert(sparse_graveyard.end(), g_sparse.begin(),
+                          g_sparse.end());
   g_dense.clear();
   g_sparse.clear();
 }

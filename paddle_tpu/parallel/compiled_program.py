@@ -80,6 +80,13 @@ class CompiledProgram:
         self._places = places
         return self
 
+    def with_mesh(self, mesh):
+        """TPU-native extension: pin an explicit device mesh (e.g. a 2-D
+        ('dp','mp') mesh for tensor parallelism).  Batch shards on 'dp';
+        parameters follow their shard_parameter annotations."""
+        self.__dict__["_mesh"] = mesh
+        return self
+
     # Executor dispatches here (executor.py Executor.run)
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
         from .data_parallel import run_data_parallel

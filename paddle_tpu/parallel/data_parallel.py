@@ -47,31 +47,13 @@ def _program_has_collectives(program) -> bool:
 
 
 def _analyze(program, feed_names, scope):
-    """Same read/write analysis as Executor._compile."""
+    """Shared read/write analysis (executor.analyze_state)."""
+    from ..executor import analyze_state
+
     block = program.global_block()
-    written: set = set()
-    state_in: List[str] = []
-    uses_rng = False
-    for op_ in block.ops:
-        d = registry.OPS.get(op_.type)
-        if d is not None and d.stateful:
-            uses_rng = True
-        for name in op_.input_arg_names:
-            if (name not in written and name not in feed_names
-                    and name != "@EMPTY@" and name not in state_in):
-                state_in.append(name)
-        written.update(op_.output_arg_names)
-    written.discard("@EMPTY@")
-    state_out = sorted(
-        n for n in written
-        if ((v := block._find_var_recursive(n)) is not None and v.persistable)
-        or scope.has(n)
+    state_in, state_out, uses_rng, _ = analyze_state(
+        block.ops, block, feed_names, scope
     )
-    if uses_rng:
-        if RNG_VAR not in state_in:
-            state_in.append(RNG_VAR)
-        if RNG_VAR not in state_out:
-            state_out.append(RNG_VAR)
     return block, state_in, state_out, uses_rng
 
 
@@ -81,7 +63,14 @@ def _compile_dp(compiled_program, program, feed, fetch_names, scope, mesh):
          str(v.dtype) if hasattr(v, "dtype") else str(np.asarray(v).dtype))
         for k, v in feed.items()
     ))
-    key = (program._version, feed_spec, tuple(fetch_names), id(mesh))
+    # sharding annotations participate in the key: apply_tensor_parallel
+    # after a first run must not silently reuse the replicated-layout jit
+    shard_sig = tuple(sorted(
+        (v.name, getattr(v, "_sharding", None))
+        for blk in program.blocks for v in blk.vars.values()
+        if getattr(v, "_sharding", None)
+    ))
+    key = (program._version, feed_spec, tuple(fetch_names), id(mesh), shard_sig)
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})
     if key in cache:
         return cache[key]
@@ -89,7 +78,16 @@ def _compile_dp(compiled_program, program, feed, fetch_names, scope, mesh):
     block, state_in, state_out, uses_rng = _analyze(program, set(feed), scope)
     use_shard_map = _program_has_collectives(program)
     ops = list(block.ops)
-    axis = mesh.axis_names[0]
+    # batch shards on the 'dp' axis when present (TP meshes are e.g.
+    # ('dp','mp')); otherwise the first axis
+    axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+
+    def param_sharding(name):
+        """Tensor-parallel annotation (parallel.tensor_parallel
+        .shard_parameter) or replicated."""
+        var = block._find_var_recursive(name)
+        spec = getattr(var, "_sharding", None) if var is not None else None
+        return NamedSharding(mesh, P(*spec)) if spec else NamedSharding(mesh, P())
 
     def body(state_vals, feed_vals, per_shard: bool):
         env: Dict[str, Any] = dict(state_vals)
@@ -127,14 +125,14 @@ def _compile_dp(compiled_program, program, feed, fetch_names, scope, mesh):
         def global_fn(state_vals, feed_vals):
             return body(state_vals, feed_vals, per_shard=False)
 
-        state_shardings = {n: NamedSharding(mesh, P()) for n in state_in}
+        state_shardings = {n: param_sharding(n) for n in state_in}
         feed_shardings = {k: NamedSharding(mesh, P(axis)) for k in feed}
         jitted = jax.jit(
             global_fn,
             in_shardings=(state_shardings, feed_shardings),
         )
 
-    entry = (jitted, state_in, state_out, use_shard_map)
+    entry = (jitted, state_in, state_out, use_shard_map, param_sharding, axis)
     cache[key] = entry
     return entry
 
@@ -159,11 +157,9 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
         mesh = default_dp_mesh(ndev)
         compiled.__dict__["_mesh"] = mesh
 
-    jitted, state_in, state_out, use_shard_map = _compile_dp(
-        compiled, program, feed, fetch_names, scope, mesh
-    )
+    jitted, state_in, state_out, use_shard_map, param_sharding, axis = \
+        _compile_dp(compiled, program, feed, fetch_names, scope, mesh)
 
-    axis = mesh.axis_names[0]
     batch_sharding = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
     block = program.global_block()
@@ -201,7 +197,8 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
             )
         if isinstance(val, LoDTensor):
             val = val.numpy()
-        state_vals[name] = jax.device_put(val, repl)
+        sharding = repl if use_shard_map else param_sharding(name)
+        state_vals[name] = jax.device_put(val, sharding)
 
     fetched, new_state = jitted(state_vals, feed_vals)
     for name, val in new_state.items():

@@ -198,76 +198,76 @@ def run_pipeline(executor, program, feed, fetch_list, scope, return_numpy):
         grad_of = {p: g for p, g in meta["params_grads"]}
         loss_name = meta["loss_name"]
 
-        # state analysis over fwd + opt ops (same rules as Executor._compile)
-        feed_names_set = set(feed)
-        written: set = set()
-        state_in: List[str] = []
-        uses_rng = False
-        for op_ in fwd_ops + opt_ops:
-            d = registry.OPS.get(op_.type)
-            if d is not None and d.stateful:
-                uses_rng = True
-            for name in op_.input_arg_names:
-                if (name not in written and name not in feed_names_set
-                        and name != "@EMPTY@" and name not in state_in
-                        and not name.endswith("@GRAD")):
-                    state_in.append(name)
-            written.update(op_.output_arg_names)
-        written.discard("@EMPTY@")
-        state_out = sorted(
-            n for n in written
-            if ((v := block._find_var_recursive(n)) is not None
-                and v.persistable) or scope.has(n)
+        # shared read/write analysis (grad vars bound from accumulation,
+        # not scope, hence the @GRAD exclusion)
+        from ..executor import analyze_state
+
+        state_in, state_out, uses_rng, _ = analyze_state(
+            fwd_ops + opt_ops, block, set(feed), scope,
+            skip_suffixes=("@GRAD",)
         )
-        if uses_rng:
-            if RNG_VAR not in state_in:
-                state_in.append(RNG_VAR)
-            if RNG_VAR not in state_out:
-                state_out.append(RNG_VAR)
 
         trainable_names = [n for n in param_names if n in state_in]
+        # persistable state written by *forward* ops (batch_norm running
+        # stats): threaded sequentially through the microbatch scan so the
+        # updates chain exactly like the plain-executor path
+        fwd_written = set()
+        for op_ in fwd_ops:
+            fwd_written.update(op_.output_arg_names)
+        fwd_mut_names = [n for n in state_out
+                         if n in fwd_written and n not in set(trainable_names)
+                         and n != RNG_VAR]
 
-        def forward_env(params_env, mb_feed):
-            env = dict(params_env)
+        def loss_fn(trainable, fwd_mut, static, mb_feed):
+            env = dict(static)
+            env.update(fwd_mut)
+            env.update(trainable)
             env.update(mb_feed)
             for sec in sections:
                 for op_ in sec.ops:
                     registry.run_op(op_, env, block)
-            return env
-
-        def loss_fn(trainable, frozen, mb_feed):
-            env = forward_env({**frozen, **trainable}, mb_feed)
             fetched = tuple(env[n] for n in fetch_names)
-            return env[loss_name], fetched
+            new_fwd_mut = {n: env[n] for n in fwd_mut_names}
+            return env[loss_name], (fetched, new_fwd_mut)
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
         def step(state_vals, feed_vals):
+            # non-batched (0-d) feeds broadcast to every microbatch
             mb_feeds = {
                 k: v.reshape((M, v.shape[0] // M) + v.shape[1:])
-                for k, v in feed_vals.items()
+                for k, v in feed_vals.items() if np.ndim(v) >= 1
             }
+            static_feeds = {k: v for k, v in feed_vals.items()
+                            if np.ndim(v) == 0}
             trainable = {n: state_vals[n] for n in trainable_names}
-            frozen = {n: v for n, v in state_vals.items()
-                      if n not in set(trainable_names)}
+            fwd_mut0 = {n: state_vals[n] for n in fwd_mut_names}
+            static = {n: v for n, v in state_vals.items()
+                      if n not in set(trainable_names)
+                      and n not in set(fwd_mut_names)}
+            static.update(static_feeds)
 
-            def scan_body(acc, xs):
+            def scan_body(carry, xs):
+                acc, fwd_mut = carry
                 i, mb = xs
-                fr = dict(frozen)
+                st = dict(static)
                 if uses_rng:
-                    fr[RNG_VAR] = jax.random.fold_in(frozen[RNG_VAR], i)
-                (loss, fetched), grads = grad_fn(trainable, fr, mb)
+                    st[RNG_VAR] = jax.random.fold_in(state_vals[RNG_VAR], i)
+                (loss, (fetched, fwd_mut)), grads = grad_fn(
+                    trainable, fwd_mut, st, mb
+                )
                 acc = jax.tree.map(jnp.add, acc, grads)
-                return acc, (loss, fetched)
+                return (acc, fwd_mut), (loss, fetched)
 
             zeros = jax.tree.map(jnp.zeros_like, trainable)
             idx = jnp.arange(M)
-            acc, (_, fetched_stack) = jax.lax.scan(
-                scan_body, zeros, (idx, mb_feeds)
+            (acc, fwd_mut_fin), (_, fetched_stack) = jax.lax.scan(
+                scan_body, (zeros, fwd_mut0), (idx, mb_feeds)
             )
             grads_avg = jax.tree.map(lambda g: g / M, acc)
 
             env = dict(state_vals)
+            env.update(fwd_mut_fin)
             if uses_rng:
                 env[RNG_VAR] = jax.random.fold_in(state_vals[RNG_VAR], M)
             for p in trainable_names:

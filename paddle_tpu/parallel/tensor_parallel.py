@@ -1,0 +1,56 @@
+"""Tensor (model) parallelism via parameter sharding annotations.
+
+The reference has only a DistFCConfig stub (SURVEY.md §2.6: tensor
+parallel ❌ absent; fleet/collective/__init__.py:44).  TPU-native TP is a
+beyond-parity layer (SURVEY.md §7 phase 9) and needs no graph surgery at
+all: parameters carry a ``PartitionSpec`` annotation, the data-parallel
+runner hands those shardings to ``jax.jit``, and GSPMD partitions the
+matmuls and inserts the activation collectives (the Megatron
+column/row-parallel pattern falls out of annotating W1 on the output dim
+and W2 on the input dim over the same mesh axis).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+
+def shard_parameter(var, spec: Sequence[Optional[str]]):
+    """Annotate a Variable/Parameter with a mesh PartitionSpec, e.g.
+    ``shard_parameter(w1, (None, "mp"))`` (column parallel) or
+    ``shard_parameter(w2, ("mp", None))`` (row parallel)."""
+    var._sharding = tuple(spec)
+    return var
+
+
+def get_sharding(var) -> Optional[Tuple[Optional[str], ...]]:
+    return getattr(var, "_sharding", None)
+
+
+def apply_tensor_parallel(program, rules: Dict[str, Sequence[Optional[str]]]):
+    """Annotate every parameter whose name matches a rule (exact name or
+    regex).  Returns the list of (name, spec) applied."""
+    applied = []
+    params = {p.name: p for p in program.all_parameters()}
+    for pat, spec in rules.items():
+        if pat in params:
+            shard_parameter(params[pat], spec)
+            applied.append((pat, tuple(spec)))
+            continue
+        rx = re.compile(pat)
+        for name, p in params.items():
+            if rx.fullmatch(name):
+                shard_parameter(p, spec)
+                applied.append((name, tuple(spec)))
+    return applied
+
+
+def megatron_mlp_rules(fc_names: Sequence[str], axis: str = "mp"
+                       ) -> Dict[str, Sequence[Optional[str]]]:
+    """Alternating column/row-parallel specs for a stack of fc weights:
+    even layers shard the output dim, odd layers the input dim, so
+    activations only need one collective per pair."""
+    rules: Dict[str, Sequence[Optional[str]]] = {}
+    for i, name in enumerate(fc_names):
+        rules[name] = (None, axis) if i % 2 == 0 else (axis, None)
+    return rules

@@ -87,6 +87,51 @@ def test_pipeline_matches_plain_training():
     assert losses["pipeline"][-1] < losses["pipeline"][0]
 
 
+def test_pipeline_updates_bn_stats_and_accepts_scalar_feed():
+    """Forward-written persistable state (batch_norm running stats) must
+    update through the microbatch scan, and 0-d feeds must broadcast."""
+    from paddle_tpu.framework import scope as scope_mod
+    from paddle_tpu.framework.scope import Scope
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6])
+        y = fluid.layers.data("y", [1])
+        coef = fluid.layers.data("coef", [], dtype="float32")
+        h = fluid.layers.fc(x, size=8)
+        h = fluid.layers.batch_norm(h)
+        pred = fluid.layers.fc(h, size=1)
+        pred = fluid.layers.elementwise_mul(
+            pred, fluid.layers.reshape(coef, [1, 1]))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGDOptimizer(0.05), num_microbatches=4
+        ).minimize(loss)
+
+    bn_means = [op.outputs["MeanOut"][0] for op in main.global_block().ops
+                if op.type == "batch_norm"]
+    assert bn_means, "expected a batch_norm running-mean var"
+
+    scope = Scope()
+    prev = scope_mod._global_scope
+    scope_mod._global_scope = scope
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        before = np.array(scope.get(bn_means[0]))
+        rng = np.random.RandomState(4)
+        xs = 2.0 + rng.rand(32, 6).astype("float32")
+        ys = rng.rand(32, 1).astype("float32")
+        exe.run(main, feed={"x": xs, "y": ys,
+                            "coef": np.float32(1.0)}, fetch_list=[loss])
+        after = np.array(scope.get(bn_means[0]))
+    finally:
+        scope_mod._global_scope = prev
+    assert not np.allclose(before, after), \
+        "batch_norm running mean did not update under pipeline execution"
+
+
 def test_spmd_pipeline_matches_sequential():
     import jax
     import jax.numpy as jnp

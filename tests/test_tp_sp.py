@@ -1,0 +1,127 @@
+"""Tensor-parallel and sequence-parallel tests (beyond-parity layer,
+SURVEY.md §7 phase 9; the reference has neither — §2.6)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+def _mesh(shape, names):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+# ---------------------------------------------------------------- ring/SP
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.sequence_parallel import (
+        reference_attention, ring_attention)
+
+    b, s, h, d = 2, 32, 4, 8
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(b, s, h, d).astype("float32") for _ in range(3))
+    mesh = _mesh((4,), ("sp",))
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = reference_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.sequence_parallel import (
+        reference_attention, ulysses_attention)
+
+    b, s, h, d = 2, 16, 8, 4
+    rng = np.random.RandomState(1)
+    q, k, v = (rng.randn(b, s, h, d).astype("float32") for _ in range(3))
+    mesh = _mesh((4,), ("sp",))
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    ref = reference_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.sequence_parallel import (
+        reference_attention, ring_attention)
+
+    b, s, h, d = 1, 16, 2, 4
+    rng = np.random.RandomState(2)
+    q, k, v = (rng.randn(b, s, h, d).astype("float32") for _ in range(3))
+    mesh = _mesh((4,), ("sp",))
+
+    gr = jax.grad(lambda q_, k_, v_: jnp.sum(
+        ring_attention(q_, k_, v_, mesh, causal=True) ** 2), argnums=(0, 1, 2))
+    gd = jax.grad(lambda q_, k_, v_: jnp.sum(
+        reference_attention(q_, k_, v_, causal=True) ** 2), argnums=(0, 1, 2))
+    for a, b_ in zip(gr(q, k, v), gd(q, k, v)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------- TP
+def test_tensor_parallel_fc_matches_single_device():
+    """2-layer MLP with Megatron column/row sharding over a ('dp','mp')
+    mesh must match the unsharded single-device loss trajectory."""
+    from paddle_tpu.framework import scope as scope_mod
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.parallel.tensor_parallel import (
+        apply_tensor_parallel, megatron_mlp_rules)
+
+    rng = np.random.RandomState(3)
+    xs = rng.rand(16, 8).astype("float32")
+    ys = (xs @ rng.rand(8, 1)).astype("float32")
+
+    losses = {}
+    for mode in ("single", "tp"):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [8])
+            y = fluid.layers.data("y", [1])
+            h = fluid.layers.fc(x, size=32, act="relu")
+            pred = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+        scope = Scope()
+        prev = scope_mod._global_scope
+        scope_mod._global_scope = scope
+        try:
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            if mode == "tp":
+                w_names = [p.name for p in main.all_parameters()
+                           if len(p.shape) == 2]
+                applied = apply_tensor_parallel(
+                    main, megatron_mlp_rules(sorted(w_names)))
+                assert len(applied) == 2
+                mesh = _mesh((2, 4), ("dp", "mp"))
+                prog = fluid.CompiledProgram(main).with_data_parallel(
+                    loss_name=loss.name).with_mesh(mesh)
+            else:
+                prog = main
+            out = []
+            for _ in range(5):
+                lo = exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss])
+                out.append(float(np.asarray(lo[0]).squeeze()))
+        finally:
+            scope_mod._global_scope = prev
+        losses[mode] = out
+
+    np.testing.assert_allclose(losses["single"], losses["tp"],
+                               rtol=1e-4, atol=1e-5)
