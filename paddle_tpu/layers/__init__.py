@@ -42,6 +42,42 @@ from .control_flow import (  # noqa: F401
     switch_case,
     while_loop,
 )
+from . import sequence_lod
+from .sequence_lod import (  # noqa: F401
+    im2sequence,
+    row_conv,
+    sequence_concat,
+    sequence_conv,
+    sequence_enumerate,
+    sequence_erase,
+    sequence_expand,
+    sequence_expand_as,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_mask,
+    sequence_pad,
+    sequence_pool,
+    sequence_reshape,
+    sequence_reverse,
+    sequence_slice,
+    sequence_softmax,
+    sequence_unpad,
+)
+from . import rnn as rnn_module
+from .rnn import (  # noqa: F401
+    GRUCell,
+    LSTMCell,
+    RNNCell,
+    StaticRNN,
+    beam_search,
+    beam_search_decode,
+    birnn,
+    dynamic_gru,
+    dynamic_lstm,
+    gru,
+    lstm,
+)
+from .rnn import rnn  # noqa: F401  (function wins, as in the reference)
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay,

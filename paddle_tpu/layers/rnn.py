@@ -1,0 +1,460 @@
+"""fluid.layers RNN API: cells, rnn()/birnn(), fused lstm/gru, StaticRNN,
+beam search.
+
+Reference: python/paddle/fluid/layers/rnn.py (RNNCell/LSTMCell/GRUCell,
+rnn, birnn, beam search helpers), layers/nn.py dynamic_lstm/dynamic_gru,
+layers/control_flow.py StaticRNN.
+
+TPU-first: generic cells unroll over the (static) padded time axis at
+graph-build time — XLA re-rolls/fuses the unrolled steps; the fused
+``lstm``/``gru`` ops lower to ``lax.scan`` (one compiled while loop whose
+body is MXU matmuls), which is the path to use for speed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Variable, in_dygraph_mode
+from ..layer_helper import LayerHelper
+from . import nn as _nn
+from . import tensor as _tensor
+
+
+class RNNCell:
+    """reference: layers/rnn.py RNNCell — step interface."""
+
+    def call(self, inputs, states):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states):
+        return self.call(inputs, states)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0):
+        raise NotImplementedError
+
+
+class LSTMCell(RNNCell):
+    """reference: layers/rnn.py LSTMCell — one step of a basic LSTM built
+    from fc ops, so it is usable inside rnn()/StaticRNN unrolling."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, forget_bias=1.0,
+                 dtype="float32", name="LSTMCell"):
+        self.hidden_size = hidden_size
+        self.param_attr = param_attr
+        self.bias_attr = bias_attr
+        self.forget_bias = forget_bias
+        self.dtype = dtype
+        self.name = name
+
+    def call(self, inputs, states):
+        pre_hidden, pre_cell = states
+        concat = _tensor.concat([inputs, pre_hidden], axis=1)
+        gates = _nn.fc(concat, 4 * self.hidden_size,
+                       param_attr=self.param_attr, bias_attr=self.bias_attr)
+        helper = LayerHelper("lstm_unit", input=gates)
+        c = helper.create_variable_for_type_inference(self.dtype)
+        h = helper.create_variable_for_type_inference(self.dtype)
+        helper.append_op("lstm_unit",
+                         inputs={"X": [gates], "C_prev": [pre_cell]},
+                         outputs={"C": [c], "H": [h]},
+                         attrs={"forget_bias": self.forget_bias})
+        return h, [h, c]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+class GRUCell(RNNCell):
+    """reference: layers/rnn.py GRUCell."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype="float32",
+                 name="GRUCell"):
+        self.hidden_size = hidden_size
+        self.param_attr = param_attr
+        self.bias_attr = bias_attr
+        self.dtype = dtype
+        self.name = name
+        self._helper = LayerHelper(name)
+        self._weight = None
+
+    def call(self, inputs, states):
+        pre_hidden = states[0] if isinstance(states, (list, tuple)) else states
+        xproj = _nn.fc(inputs, 3 * self.hidden_size,
+                       param_attr=self.param_attr, bias_attr=self.bias_attr)
+        helper = LayerHelper("gru_unit", input=xproj)
+        if self._weight is None:
+            self._weight = helper.create_parameter(
+                None, shape=[self.hidden_size, 3 * self.hidden_size],
+                dtype=self.dtype)
+        gate = helper.create_variable_for_type_inference(self.dtype)
+        rhp = helper.create_variable_for_type_inference(self.dtype)
+        hidden = helper.create_variable_for_type_inference(self.dtype)
+        helper.append_op("gru_unit",
+                         inputs={"Input": [xproj], "HiddenPrev": [pre_hidden],
+                                 "Weight": [self._weight]},
+                         outputs={"Gate": [gate], "ResetHiddenPrev": [rhp],
+                                  "Hidden": [hidden]})
+        return hidden, [hidden]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size]]
+
+
+def _zeros_like_state(batch_ref, size, dtype):
+    """[N, size] zeros matching batch_ref's leading dim."""
+    return _tensor.fill_constant_batch_size_like(
+        batch_ref, shape=[-1, size], dtype=dtype, value=0.0)
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """reference: layers/rnn.py rnn — run a cell over the time axis.
+
+    Build-time unroll over the static T; per-step masking replicates the
+    reference's sequence_length semantics (state freezes past the end).
+    """
+    T_axis = 0 if time_major else 1
+    T = inputs.shape[T_axis]
+    if T < 0:
+        raise ValueError("rnn() needs a static time dimension on TPU")
+    hidden = getattr(cell, "hidden_size", None)
+    if initial_states is None:
+        shapes = cell.state_shape
+        initial_states = [
+            _zeros_like_state(inputs, s[-1], "float32") for s in shapes
+        ]
+    states = list(initial_states) if isinstance(initial_states, (list, tuple)) \
+        else [initial_states]
+    if sequence_length is not None:
+        from .sequence_lod import sequence_mask
+        mask_all = sequence_mask(sequence_length, maxlen=T, dtype="float32")
+    step_outs = []
+    order = range(T - 1, -1, -1) if is_reverse else range(T)
+    for t in order:
+        xt = _nn.squeeze(
+            _nn.slice(inputs, axes=[T_axis], starts=[t], ends=[t + 1]),
+            axes=[T_axis])
+        out, new_states = cell(xt, states if len(states) > 1 else states)
+        if sequence_length is not None:
+            mt = _nn.slice(mask_all, axes=[1], starts=[t], ends=[t + 1])
+            new_states = [
+                _nn.elementwise_add(
+                    _nn.elementwise_mul(ns, mt),
+                    _nn.elementwise_mul(s, _nn.scale(mt, -1.0, 1.0)))
+                for ns, s in zip(new_states, states)
+            ]
+            out = _nn.elementwise_mul(out, mt)
+        states = new_states
+        step_outs.append(out)
+    if is_reverse:
+        step_outs.reverse()
+    outs = _nn.stack(step_outs, axis=T_axis)
+    final = states if len(states) > 1 else states[0]
+    return outs, final
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None, sequence_length=None,
+          time_major=False, **kwargs):
+    """reference: layers/rnn.py birnn."""
+    states_fw, states_bw = (initial_states if initial_states is not None
+                            else (None, None))
+    out_fw, st_fw = rnn(cell_fw, inputs, states_fw, sequence_length,
+                        time_major=time_major)
+    out_bw, st_bw = rnn(cell_bw, inputs, states_bw, sequence_length,
+                        time_major=time_major, is_reverse=True)
+    out = _tensor.concat([out_fw, out_bw], axis=-1)
+    return out, (st_fw, st_bw)
+
+
+def lstm(input, init_h=None, init_c=None, max_len=None, hidden_size=None,
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, is_test=False,
+         name=None, default_initializer=None, seed=-1, sequence_length=None):
+    """reference: layers/nn.py lstm (cudnn LSTM) — fused scan-based op."""
+    helper = LayerHelper("lstm", input=input, name=name)
+    dtype = input.dtype
+    D = input.shape[-1]
+    dirs = 2 if is_bidirec else 1
+    wis, whs, bs = [], [], []
+    for l in range(num_layers):
+        in_dim = D if l == 0 else hidden_size * dirs
+        for d in range(dirs):
+            wis.append(helper.create_parameter(
+                None, shape=[in_dim, 4 * hidden_size], dtype=dtype))
+            whs.append(helper.create_parameter(
+                None, shape=[hidden_size, 4 * hidden_size], dtype=dtype))
+            bs.append(helper.create_parameter(
+                None, shape=[4 * hidden_size], dtype=dtype, is_bias=True))
+    out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "WeightIh": wis, "WeightHh": whs, "Bias": bs}
+    if init_h is not None:
+        ins["InitH"] = [init_h]
+    if init_c is not None:
+        ins["InitC"] = [init_c]
+    if sequence_length is not None:
+        ins["SequenceLength"] = [sequence_length]
+    helper.append_op("lstm", inputs=ins,
+                     outputs={"Out": [out], "LastH": [last_h], "LastC": [last_c]},
+                     attrs={"is_bidirec": is_bidirec, "num_layers": num_layers,
+                            "hidden_size": hidden_size,
+                            "dropout_prob": dropout_prob})
+    return out, last_h, last_c
+
+
+def gru(input, hidden_size, num_layers=1, is_bidirec=False, init_h=None,
+        name=None, sequence_length=None):
+    """Fused multi-layer GRU (scan-based; the reference reaches this
+    capability by stacking dynamic_gru — gru_op.cc)."""
+    helper = LayerHelper("gru", input=input, name=name)
+    dtype = input.dtype
+    D = input.shape[-1]
+    dirs = 2 if is_bidirec else 1
+    wis, whs, bs = [], [], []
+    for l in range(num_layers):
+        in_dim = D if l == 0 else hidden_size * dirs
+        for d in range(dirs):
+            wis.append(helper.create_parameter(
+                None, shape=[in_dim, 3 * hidden_size], dtype=dtype))
+            whs.append(helper.create_parameter(
+                None, shape=[hidden_size, 3 * hidden_size], dtype=dtype))
+            bs.append(helper.create_parameter(
+                None, shape=[3 * hidden_size], dtype=dtype, is_bias=True))
+    out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "WeightIh": wis, "WeightHh": whs, "Bias": bs}
+    if init_h is not None:
+        ins["InitH"] = [init_h]
+    if sequence_length is not None:
+        ins["SequenceLength"] = [sequence_length]
+    helper.append_op("gru", inputs=ins,
+                     outputs={"Out": [out], "LastH": [last_h]},
+                     attrs={"is_bidirec": is_bidirec, "num_layers": num_layers,
+                            "hidden_size": hidden_size})
+    return out, last_h
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 sequence_length=None):
+    """reference: layers/nn.py dynamic_lstm — input is the [N, T, 4H]
+    x-projection (size = 4H)."""
+    hidden = size // 4
+    helper = LayerHelper("dynamic_lstm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    w = helper.create_parameter(param_attr, shape=[hidden, 4 * hidden], dtype=dtype)
+    b = helper.create_parameter(bias_attr, shape=[1, 4 * hidden], dtype=dtype,
+                                is_bias=True)
+    hid = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    lh = helper.create_variable_for_type_inference(dtype)
+    lc = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    if sequence_length is not None:
+        ins["SequenceLength"] = [sequence_length]
+    helper.append_op("dynamic_lstm", inputs=ins,
+                     outputs={"Hidden": [hid], "Cell": [cell],
+                              "LastH": [lh], "LastC": [lc]},
+                     attrs={"is_reverse": is_reverse,
+                            "use_peepholes": use_peepholes})
+    return hid, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, dtype="float32",
+                sequence_length=None):
+    """reference: layers/nn.py dynamic_gru — input is the [N, T, 3H]
+    x-projection (size = H)."""
+    helper = LayerHelper("dynamic_gru", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    w = helper.create_parameter(param_attr, shape=[size, 3 * size], dtype=dtype)
+    b = helper.create_parameter(bias_attr, shape=[1, 3 * size], dtype=dtype,
+                                is_bias=True)
+    hid = helper.create_variable_for_type_inference(dtype)
+    lh = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if sequence_length is not None:
+        ins["SequenceLength"] = [sequence_length]
+    helper.append_op("dynamic_gru", inputs=ins,
+                     outputs={"Hidden": [hid], "LastH": [lh]},
+                     attrs={"is_reverse": is_reverse})
+    return hid
+
+
+class StaticRNN:
+    """reference: layers/control_flow.py StaticRNN — step-program builder
+    unrolled over the (static) time axis.
+
+    The reference records the step body into a sub-block executed by
+    recurrent_op; here the body's ops are recorded in the main block for
+    t=0 and then **replayed with renamed vars for t=1..T-1** (XLA re-rolls
+    and fuses the unrolled steps).  Inputs are batch-major padded
+    [N, T, ...]."""
+
+    def __init__(self, name=None):
+        from ..framework.core import default_main_program
+        self._block = default_main_program().current_block()
+        self._start_idx = None
+        self._step_input_ops = {}   # op id -> input Variable ([N,T,...])
+        self._memories = {}         # init var name -> update var name
+        self._outputs = []
+        self._T = None
+
+    def step(self):
+        rnn_self = self
+
+        class _Guard:
+            def __enter__(self):
+                rnn_self._start_idx = len(rnn_self._block.ops)
+                return rnn_self
+
+            def __exit__(self, exc_type, *a):
+                if exc_type is None:
+                    rnn_self._unroll()
+                return False
+
+        return _Guard()
+
+    def step_input(self, x):
+        if self._T is None:
+            self._T = x.shape[1]
+        elif x.shape[1] != self._T:
+            raise ValueError("StaticRNN step inputs disagree on T")
+        sliced = _nn.slice(x, axes=[1], starts=[0], ends=[1])
+        sq = _nn.squeeze(sliced, axes=[1])
+        # the two ops just appended are the per-step extraction; remember
+        # them so the replay can re-target the slice at t
+        self._step_input_ops[id(self._block.ops[-2])] = x
+        return sq
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               dtype="float32"):
+        if init is None:
+            init = _tensor.fill_constant_batch_size_like(
+                batch_ref, shape=[-1] + list(shape), dtype=dtype,
+                value=init_value)
+        self._memories[init.name] = None
+        return init
+
+    def update_memory(self, mem, new_val):
+        if mem.name not in self._memories:
+            raise ValueError(f"{mem.name} is not a StaticRNN memory")
+        self._memories[mem.name] = new_val.name
+
+    def step_output(self, out):
+        self._outputs.append(out)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _unroll(self):
+        block = self._block
+        T = self._T
+        if T is None or T < 0:
+            raise ValueError("StaticRNN needs a static time dimension")
+        recorded = list(block.ops[self._start_idx:])
+        out_names_t = {v.name: [v.name] for v in self._outputs}
+        prev_step_name = {init: (upd or init)
+                          for init, upd in self._memories.items()}
+        for t in range(1, T):
+            rename = {}
+            # memory reads resolve to last step's update vars
+            for init, upd in self._memories.items():
+                rename[init] = prev_step_name[init]
+            new_update = {}
+            for rec in recorded:
+                attrs = dict(rec.attrs)
+                if id(rec) in self._step_input_ops:
+                    attrs["starts"] = [t]
+                    attrs["ends"] = [t + 1]
+                ins = {s: [rename.get(n, n) for n in ns]
+                       for s, ns in rec.inputs.items()}
+                outs = {}
+                for s, ns in rec.outputs.items():
+                    new_ns = []
+                    for n in ns:
+                        src = block._find_var_recursive(n)
+                        nn_name = f"{n}@rnn_t{t}"
+                        if src is not None:
+                            block.create_var(name=nn_name, shape=src.shape,
+                                             dtype=src.dtype,
+                                             stop_gradient=src.stop_gradient)
+                        rename[n] = nn_name
+                        new_ns.append(nn_name)
+                        for init, upd in self._memories.items():
+                            if upd == n:
+                                new_update[init] = nn_name
+                    outs[s] = new_ns
+                block.append_op(rec.type, inputs=ins, outputs=outs, attrs=attrs)
+            for init in self._memories:
+                prev_step_name[init] = new_update.get(
+                    init, prev_step_name[init])
+            for name in out_names_t:
+                out_names_t[name].append(rename.get(name, name))
+        # stack per-step outputs into [N, T, ...]
+        self._stacked = []
+        for v in self._outputs:
+            steps = [block.var(n) if n != v.name else v
+                     for n in out_names_t[v.name]]
+            self._stacked.append(_nn.stack(steps, axis=1))
+
+    def __call__(self):
+        if len(self._stacked) == 1:
+            return self._stacked[0]
+        return self._stacked
+
+
+# --------------------------------------------------------------------------
+# beam search wrappers
+# --------------------------------------------------------------------------
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None, return_parent_idx=True):
+    """reference: layers/rnn.py beam_search (beam_search_op.cc)."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    sel_scores = helper.create_variable_for_type_inference(scores.dtype,
+                                                           stop_gradient=True)
+    parent = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    helper.append_op("beam_search",
+                     inputs={"PreIds": [pre_ids], "PreScores": [pre_scores],
+                             "Scores": [scores]},
+                     outputs={"SelectedIds": [sel_ids],
+                              "SelectedScores": [sel_scores],
+                              "ParentIdx": [parent]},
+                     attrs={"beam_size": beam_size, "end_id": end_id})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, parent_idx, beam_size, end_id, name=None):
+    """reference: layers/rnn.py beam_search_decode.  ``ids``/``scores``/
+    ``parent_idx`` are lists of per-step vars."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    out_ids = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    out_scores = helper.create_variable_for_type_inference("float32",
+                                                           stop_gradient=True)
+    out_len = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("beam_search_decode",
+                     inputs={"Ids": list(ids), "Scores": list(scores),
+                             "ParentIdx": list(parent_idx)},
+                     outputs={"SentenceIds": [out_ids],
+                              "SentenceScores": [out_scores],
+                              "SentenceLength": [out_len]},
+                     attrs={"beam_size": beam_size, "end_id": end_id})
+    return out_ids, out_scores

@@ -1,0 +1,216 @@
+"""fluid.layers sequence (LoD) graph-builder functions.
+
+Reference: python/paddle/fluid/layers/sequence_lod.py — sequence_pool,
+sequence_softmax, sequence_conv, sequence_pad/unpad, sequence_mask, ...
+
+TPU-first deviation: implicit LoD metadata cannot ride a static-shape
+XLA tensor, so every wrapper takes an explicit ``length`` variable
+([N] ints) where the reference read lod from the input tensor.  Passing
+``length=None`` means "all rows are full length".
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Variable
+from ..layer_helper import LayerHelper
+
+
+def _seq_op(helper, op_type, inputs, outputs, attrs=None):
+    helper.append_op(op_type, inputs=inputs, outputs=outputs, attrs=attrs or {})
+
+
+def _maybe_len(inputs, length, slot="Length"):
+    if length is not None:
+        inputs[slot] = [length]
+    return inputs
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0, length=None):
+    """reference: layers/sequence_lod.py sequence_pool"""
+    helper = LayerHelper("sequence_pool", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    max_index = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    _seq_op(helper, "sequence_pool",
+            _maybe_len({"X": [input]}, length),
+            {"Out": [out], "MaxIndex": [max_index]},
+            {"pooltype": pool_type.upper(), "pad_value": pad_value})
+    return out
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):
+    helper = LayerHelper("sequence_softmax", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    _seq_op(helper, "sequence_softmax",
+            _maybe_len({"X": [input]}, length), {"Out": [out]})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None, length=None):
+    """reference: layers/sequence_lod.py sequence_conv"""
+    helper = LayerHelper("sequence_conv", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    D = input.shape[-1]
+    filter_shape = [filter_size * D, num_filters]
+    w = helper.create_parameter(param_attr, shape=filter_shape, dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if padding_start is None:
+        padding_start = -((filter_size - 1) // 2)
+    _seq_op(helper, "sequence_conv",
+            _maybe_len({"X": [input], "Filter": [w]}, length),
+            {"Out": [out]},
+            {"contextLength": filter_size, "contextStart": padding_start,
+             "contextStride": filter_stride})
+    pre_act = helper.append_bias_op(out, dim_start=2, bias_attr=bias_attr)
+    return helper.append_activation(pre_act, act)
+
+
+def sequence_reverse(x, name=None, length=None):
+    helper = LayerHelper("sequence_reverse", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    _seq_op(helper, "sequence_reverse",
+            _maybe_len({"X": [x]}, length), {"Y": [out]})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None, length=None):
+    """reference: layers/sequence_lod.py sequence_expand — y carries the
+    per-sequence repeat counts ([N] ints) in this build."""
+    helper = LayerHelper("sequence_expand", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    _seq_op(helper, "sequence_expand",
+            _maybe_len({"X": [x], "Y": [y]}, length),
+            {"Out": [out], "OutLength": [out_len]},
+            {"ref_level": ref_level})
+    return out
+
+
+def sequence_expand_as(x, y, name=None, length=None):
+    helper = LayerHelper("sequence_expand_as", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    _seq_op(helper, "sequence_expand_as",
+            _maybe_len({"X": [x], "Y": [y]}, length), {"Out": [out]})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """reference: layers/sequence_lod.py sequence_pad.  x is the flat
+    [total, ...] values tensor; ``length`` ([N]) is required."""
+    helper = LayerHelper("sequence_pad", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    _seq_op(helper, "sequence_pad",
+            _maybe_len({"X": [x], "PadValue": [pad_value]}, length),
+            {"Out": [out], "Length": [out_len]},
+            {"padded_length": -1 if maxlen is None else maxlen})
+    return out, out_len
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    _seq_op(helper, "sequence_unpad",
+            {"X": [x], "Length": [length]}, {"Out": [out]})
+    return out
+
+
+def sequence_concat(input, name=None, lengths=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    out_len = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    ins = {"X": list(xs)}
+    if lengths is not None:
+        ins["Length"] = list(lengths)
+    _seq_op(helper, "sequence_concat", ins,
+            {"Out": [out], "OutLength": [out_len]})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    _seq_op(helper, "sequence_slice",
+            {"X": [input], "Offset": [offset], "Length": [length]},
+            {"Out": [out]})
+    return out
+
+
+def sequence_erase(input, tokens, name=None, length=None):
+    helper = LayerHelper("sequence_erase", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    _seq_op(helper, "sequence_erase",
+            _maybe_len({"X": [input]}, length),
+            {"Out": [out], "OutLength": [out_len]},
+            {"tokens": list(tokens)})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None, length=None):
+    helper = LayerHelper("sequence_enumerate", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    _seq_op(helper, "sequence_enumerate",
+            _maybe_len({"X": [input]}, length), {"Out": [out]},
+            {"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", input=x, name=name)
+    out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    inputs = {"X": [x]}
+    attrs = {"out_dtype": dtype}
+    if isinstance(maxlen, Variable):
+        inputs["MaxLenTensor"] = [maxlen]
+        attrs["maxlen"] = -1
+    else:
+        attrs["maxlen"] = -1 if maxlen is None else int(maxlen)
+    _seq_op(helper, "sequence_mask", inputs, {"Y": [out]}, attrs)
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    """reference: layers/sequence_lod.py sequence_reshape — on the padded
+    representation this is a plain reshape of the trailing dims."""
+    helper = LayerHelper("sequence_reshape", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("reshape2", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": [0, -1, new_dim]})
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    helper = LayerHelper("im2sequence", input=input, name=name)
+    ks = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+    ss = [stride] * 2 if isinstance(stride, int) else list(stride)
+    ps = [padding] * 4 if isinstance(padding, int) else list(padding)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    _seq_op(helper, "im2sequence", {"X": [input]}, {"Out": [out]},
+            {"kernels": ks, "strides": ss, "paddings": ps})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None, length=None):
+    """reference: layers/nn.py row_conv"""
+    helper = LayerHelper("row_conv", input=input, param_attr=param_attr, act=act)
+    D = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[future_context_size + 1, D],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    _seq_op(helper, "row_conv",
+            _maybe_len({"X": [input], "Filter": [w]}, length), {"Out": [out]})
+    return helper.append_activation(out, act)

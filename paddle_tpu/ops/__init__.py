@@ -23,3 +23,4 @@ from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import control_ops  # noqa: F401
 from . import ps_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401

@@ -122,23 +122,16 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp",
         qg = lax.all_to_all(ql, axis, split_axis=2, concat_axis=1, tiled=True)
         kg = lax.all_to_all(kl, axis, split_axis=2, concat_axis=1, tiled=True)
         vg = lax.all_to_all(vl, axis, split_axis=2, concat_axis=1, tiled=True)
-        s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg) * scale
-        if causal:
-            pos = jnp.arange(seq)
-            s = jnp.where(pos[None, None, None, :] <= pos[None, None, :, None],
-                          s, _NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, vg)
+        o = _dense_attn(qg, kg, vg, scale, causal)
         # [b, seq, h/s, d] -> [b, seq/s, h, d]
         return lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
 
     return run(q, k, v)
 
 
-def reference_attention(q, k, v, causal: bool = False, scale: float = None):
-    """Dense single-device oracle for tests/benchmarks."""
-    b, seq, h, d = q.shape
-    scale = (1.0 / d ** 0.5) if scale is None else scale
+def _dense_attn(q, k, v, scale, causal):
+    """Shared dense attention core (scale → causal mask → softmax → pv)."""
+    seq = q.shape[1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         pos = jnp.arange(seq)
@@ -146,3 +139,10 @@ def reference_attention(q, k, v, causal: bool = False, scale: float = None):
                       s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def reference_attention(q, k, v, causal: bool = False, scale: float = None):
+    """Dense single-device oracle for tests/benchmarks."""
+    d = q.shape[-1]
+    scale = (1.0 / d ** 0.5) if scale is None else scale
+    return _dense_attn(q, k, v, scale, causal)

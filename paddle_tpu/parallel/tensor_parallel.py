@@ -37,11 +37,22 @@ def apply_tensor_parallel(program, rules: Dict[str, Sequence[Optional[str]]]):
             shard_parameter(params[pat], spec)
             applied.append((pat, tuple(spec)))
             continue
-        rx = re.compile(pat)
+        try:
+            rx = re.compile(pat)
+        except re.error as e:
+            raise ValueError(
+                f"TP rule {pat!r} matches no parameter by name and is not a "
+                f"valid regex: {e}") from None
+        matched = False
         for name, p in params.items():
             if rx.fullmatch(name):
                 shard_parameter(p, spec)
                 applied.append((name, tuple(spec)))
+                matched = True
+        if not matched:
+            raise ValueError(
+                f"TP rule {pat!r} matched no parameter (params: "
+                f"{sorted(params)[:8]}...)")
     return applied
 
 
