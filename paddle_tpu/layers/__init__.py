@@ -78,6 +78,24 @@ from .rnn import (  # noqa: F401
     lstm,
 )
 from .rnn import rnn  # noqa: F401  (function wins, as in the reference)
+from . import detection
+from .detection import (  # noqa: F401
+    anchor_generator,
+    bipartite_match,
+    box_clip,
+    box_coder,
+    density_prior_box,
+    detection_output,
+    iou_similarity,
+    multiclass_nms,
+    prior_box,
+    roi_align,
+    roi_pool,
+    ssd_loss,
+    target_assign,
+    yolo_box,
+    yolov3_loss,
+)
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay,

@@ -1,0 +1,273 @@
+"""fluid.layers detection graph-builder functions.
+
+Reference: python/paddle/fluid/layers/detection.py (prior_box,
+multi_box_head, anchor_generator, box_coder, iou_similarity, yolo_box,
+yolov3_loss, multiclass_nms, roi_align, roi_pool, bipartite_match,
+target_assign, ssd_loss, detection_output, box_clip).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", input=input, name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype,
+                                                      stop_gradient=True)
+    var = helper.create_variable_for_type_inference(input.dtype,
+                                                    stop_gradient=True)
+    helper.append_op(
+        "prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios), "variances": list(variance),
+               "flip": flip, "clip": clip, "step_w": steps[0],
+               "step_h": steps[1], "offset": offset,
+               "min_max_aspect_ratios_order": min_max_aspect_ratios_order})
+    return boxes, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", input=input, name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype,
+                                                      stop_gradient=True)
+    var = helper.create_variable_for_type_inference(input.dtype,
+                                                    stop_gradient=True)
+    helper.append_op(
+        "density_prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"densities": list(densities), "fixed_sizes": list(fixed_sizes),
+               "fixed_ratios": list(fixed_ratios), "variances": list(variance),
+               "clip": clip, "step_w": steps[0], "step_h": steps[1],
+               "offset": offset})
+    if flatten_to_2d:
+        from . import nn as _nn
+        boxes = _nn.reshape(boxes, [-1, 4])
+        var = _nn.reshape(var, [-1, 4])
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", input=input, name=name)
+    anchors = helper.create_variable_for_type_inference(input.dtype,
+                                                        stop_gradient=True)
+    var = helper.create_variable_for_type_inference(input.dtype,
+                                                    stop_gradient=True)
+    helper.append_op(
+        "anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={"anchor_sizes": list(anchor_sizes or [64.0]),
+               "aspect_ratios": list(aspect_ratios or [1.0]),
+               "variances": list(variance),
+               "stride": list(stride or [16.0, 16.0]), "offset": offset})
+    return anchors, var
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    helper = LayerHelper("box_coder", input=prior_box, name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if prior_box_var is not None:
+        if isinstance(prior_box_var, (list, tuple)):
+            attrs["variance"] = list(prior_box_var)
+        else:
+            ins["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_coder", inputs=ins, outputs={"OutputBox": [out]},
+                     attrs=attrs)
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("box_clip", inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None):
+    helper = LayerHelper("yolo_box", input=x, name=name)
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "yolo_box", inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={"anchors": list(anchors), "class_num": class_num,
+               "conf_thresh": conf_thresh,
+               "downsample_ratio": downsample_ratio, "clip_bbox": clip_bbox})
+    return boxes, scores
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=False, name=None):
+    helper = LayerHelper("yolov3_loss", input=x, name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "yolov3_loss",
+        inputs={"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]},
+        outputs={"Loss": [loss]},
+        attrs={"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+               "class_num": class_num, "ignore_thresh": ignore_thresh,
+               "downsample_ratio": downsample_ratio})
+    return loss
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    helper = LayerHelper("multiclass_nms", input=bboxes, name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype,
+                                                    stop_gradient=True)
+    nums = helper.create_variable_for_type_inference("int64",
+                                                     stop_gradient=True)
+    helper.append_op(
+        "multiclass_nms", inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "NmsRoisNum": [nums]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "background_label": background_label})
+    return out
+
+
+detection_output = multiclass_nms  # reference aliases via box_coder+nms
+
+
+def _rois_batch_id(helper, rois_num, rois_batch_id):
+    """Resolve the per-roi image index.  rois_num as a static python list
+    is expanded to batch ids here; a Variable rois_num would need a
+    data-dependent repeat (not expressible under XLA static shapes) —
+    pass rois_batch_id directly in that case."""
+    if rois_batch_id is not None:
+        return rois_batch_id
+    if rois_num is None:
+        return None
+    if hasattr(rois_num, "name"):  # a Variable
+        raise ValueError(
+            "rois_num as a tensor needs a data-dependent repeat; pass "
+            "rois_batch_id ([R] image index per roi) instead")
+    from . import tensor as _tensor
+    ids = np.repeat(np.arange(len(rois_num)),
+                    np.asarray(rois_num, np.int64)).astype(np.int32)
+    return _tensor.assign(ids)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+              sampling_ratio=-1, rois_num=None, rois_batch_id=None, name=None):
+    helper = LayerHelper("roi_align", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    batch_id = _rois_batch_id(helper, rois_num, rois_batch_id)
+    if batch_id is not None:
+        ins["RoisBatchId"] = [batch_id]
+    helper.append_op("roi_align", inputs=ins, outputs={"Out": [out]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale,
+                            "sampling_ratio": sampling_ratio})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_num=None, rois_batch_id=None, name=None):
+    helper = LayerHelper("roi_pool", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    batch_id = _rois_batch_id(helper, rois_num, rois_batch_id)
+    if batch_id is not None:
+        ins["RoisBatchId"] = [batch_id]
+    helper.append_op("roi_pool", inputs=ins, outputs={"Out": [out]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    helper = LayerHelper("bipartite_match", input=dist_matrix, name=name)
+    idx = helper.create_variable_for_type_inference("int32",
+                                                    stop_gradient=True)
+    dist = helper.create_variable_for_type_inference(dist_matrix.dtype,
+                                                     stop_gradient=True)
+    helper.append_op("bipartite_match", inputs={"DistMat": [dist_matrix]},
+                     outputs={"ColToRowMatchIndices": [idx],
+                              "ColToRowMatchDist": [dist]},
+                     attrs={"match_type": match_type,
+                            "dist_threshold": dist_threshold})
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    wt = helper.create_variable_for_type_inference("float32")
+    helper.append_op("target_assign",
+                     inputs={"X": [input], "MatchIndices": [matched_indices]},
+                     outputs={"Out": [out], "OutWeight": [wt]},
+                     attrs={"mismatch_value": mismatch_value})
+    return out, wt
+
+
+def batched_iou(gt_box, prior_box, name=None):
+    """[N, M, 4] x [P, 4] -> [N, M, P] IoU (vmapped iou_similarity)."""
+    helper = LayerHelper("batched_iou", input=gt_box, name=name)
+    out = helper.create_variable_for_type_inference(gt_box.dtype)
+    helper.append_op("batched_iou", inputs={"X": [gt_box], "Y": [prior_box]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """reference: layers/detection.py ssd_loss.
+
+    Composed as in the reference: IoU -> bipartite match (host) ->
+    encode + smooth_l1 + softmax CE + hard-negative mining (one
+    differentiable ssd_loss_core op).  gt_box [N, M, 4] / gt_label
+    [N, M] are padded (invalid rows have zero width/height).
+    Returns per-image loss [N]."""
+    iou = batched_iou(gt_box, prior_box)
+    matched, _ = bipartite_match(iou, match_type, neg_overlap)
+    helper = LayerHelper("ssd_loss", input=location)
+    loss = helper.create_variable_for_type_inference(location.dtype)
+    ins = {"Location": [location], "Confidence": [confidence],
+           "GTBox": [gt_box], "GTLabel": [gt_label],
+           "PriorBox": [prior_box], "MatchIndices": [matched]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("ssd_loss_core", inputs=ins, outputs={"Loss": [loss]},
+                     attrs={"background_label": background_label,
+                            "neg_pos_ratio": neg_pos_ratio,
+                            "loc_loss_weight": loc_loss_weight,
+                            "conf_loss_weight": conf_loss_weight})
+    return loss

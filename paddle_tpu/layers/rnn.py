@@ -139,7 +139,10 @@ def rnn(cell, inputs, initial_states=None, sequence_length=None,
         xt = _nn.squeeze(
             _nn.slice(inputs, axes=[T_axis], starts=[t], ends=[t + 1]),
             axes=[T_axis])
-        out, new_states = cell(xt, states if len(states) > 1 else states)
+        out, new_states = cell(xt, states if len(states) > 1 else states[0])
+        if not isinstance(new_states, (list, tuple)):
+            new_states = [new_states]
+        new_states = list(new_states)
         if sequence_length is not None:
             mt = _nn.slice(mask_all, axes=[1], starts=[t], ends=[t + 1])
             new_states = [
@@ -311,6 +314,7 @@ class StaticRNN:
         self._start_idx = None
         self._step_input_ops = {}   # op id -> input Variable ([N,T,...])
         self._memories = {}         # init var name -> update var name
+        self._init_op_ids = set()   # memory-init ops: run once, not per-step
         self._outputs = []
         self._T = None
 
@@ -344,9 +348,12 @@ class StaticRNN:
     def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
                dtype="float32"):
         if init is None:
+            before = len(self._block.ops)
             init = _tensor.fill_constant_batch_size_like(
                 batch_ref, shape=[-1] + list(shape), dtype=dtype,
                 value=init_value)
+            for o in self._block.ops[before:]:
+                self._init_op_ids.add(id(o))
         self._memories[init.name] = None
         return init
 
@@ -367,7 +374,8 @@ class StaticRNN:
         T = self._T
         if T is None or T < 0:
             raise ValueError("StaticRNN needs a static time dimension")
-        recorded = list(block.ops[self._start_idx:])
+        recorded = [o for o in block.ops[self._start_idx:]
+                    if id(o) not in self._init_op_ids]
         out_names_t = {v.name: [v.name] for v in self._outputs}
         prev_step_name = {init: (upd or init)
                           for init, upd in self._memories.items()}
@@ -457,4 +465,6 @@ def beam_search_decode(ids, scores, parent_idx, beam_size, end_id, name=None):
                               "SentenceScores": [out_scores],
                               "SentenceLength": [out_len]},
                      attrs={"beam_size": beam_size, "end_id": end_id})
-    return out_ids, out_scores
+    # the reference conveys hypothesis lengths via LoD; on the padded
+    # representation the explicit length vector is the only carrier
+    return out_ids, out_scores, out_len

@@ -24,3 +24,4 @@ from . import collective_ops  # noqa: F401
 from . import control_ops  # noqa: F401
 from . import ps_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
+from . import detection_ops  # noqa: F401

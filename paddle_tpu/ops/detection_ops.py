@@ -1,0 +1,752 @@
+"""Detection op lowerings (CV model support).
+
+Capability parity with the reference's detection suite
+(reference: paddle/fluid/operators/detection/ — prior_box_op.cc,
+density_prior_box_op.cc, anchor_generator_op.cc, box_coder_op.cc,
+iou_similarity_op.cc, yolo_box_op.cc, yolov3_loss_op.cc,
+multiclass_nms_op.cc, roi_align_op.cc, roi_pool_op.cc, box_clip_op.cc,
+bipartite_match_op.cc, target_assign_op.cc).
+
+TPU-first: geometry generators and box transforms are pure jnp (fusable);
+NMS and bipartite matching have data-dependent control flow and output
+sizes, so they run as host ops (the reference's kernels are CPU-only for
+those too).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import op
+
+
+# --------------------------------------------------------------------------
+# prior boxes / anchors
+# --------------------------------------------------------------------------
+@op("prior_box", no_grad=True)
+def _prior_box(ctx):
+    """reference: detection/prior_box_op.cc"""
+    feat = ctx.in_("Input")    # [N, C, H, W]
+    image = ctx.in_("Image")   # [N, C, IH, IW]
+    min_sizes = [float(v) for v in ctx.attr("min_sizes", [])]
+    max_sizes = [float(v) for v in ctx.attr("max_sizes", []) or []]
+    ars = [float(v) for v in ctx.attr("aspect_ratios", [1.0])]
+    flip = bool(ctx.attr("flip", False))
+    clip = bool(ctx.attr("clip", False))
+    variances = [float(v) for v in ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(ctx.attr("step_w", 0.0) or 0.0)
+    step_h = float(ctx.attr("step_h", 0.0) or 0.0)
+    offset = float(ctx.attr("offset", 0.5))
+    min_max_ar_order = bool(ctx.attr("min_max_aspect_ratios_order", False))
+
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    sw = step_w or IW / W
+    sh = step_h or IH / H
+
+    full_ars = [1.0]
+    for ar in ars:
+        if abs(ar - 1.0) < 1e-6:
+            continue
+        full_ars.append(ar)
+        if flip:
+            full_ars.append(1.0 / ar)
+
+    whs = []  # (w, h) per prior, in pixels
+    for k, ms in enumerate(min_sizes):
+        if min_max_ar_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                big = float(np.sqrt(ms * max_sizes[k]))
+                whs.append((big, big))
+            for ar in full_ars[1:]:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in full_ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                big = float(np.sqrt(ms * max_sizes[k]))
+                whs.append((big, big))
+    P = len(whs)
+    wh = jnp.asarray(whs, jnp.float32)  # [P, 2]
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)                 # [H, W]
+    centers = jnp.stack([cxg, cyg], -1)[:, :, None, :]  # [H, W, 1, 2]
+    half = wh[None, None, :, :] / 2.0
+    mins = (centers - half) / jnp.asarray([IW, IH], jnp.float32)
+    maxs = (centers + half) / jnp.asarray([IW, IH], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], axis=-1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, P, 4))
+    ctx.set_out("Boxes", boxes)
+    ctx.set_out("Variances", var)
+
+
+@op("density_prior_box", no_grad=True)
+def _density_prior_box(ctx):
+    """reference: detection/density_prior_box_op.cc"""
+    feat = ctx.in_("Input")
+    image = ctx.in_("Image")
+    fixed_sizes = [float(v) for v in ctx.attr("fixed_sizes", [])]
+    fixed_ratios = [float(v) for v in ctx.attr("fixed_ratios", [])]
+    densities = [int(v) for v in ctx.attr("densities", [])]
+    variances = [float(v) for v in ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(ctx.attr("clip", False))
+    step_w = float(ctx.attr("step_w", 0.0) or 0.0)
+    step_h = float(ctx.attr("step_h", 0.0) or 0.0)
+    offset = float(ctx.attr("offset", 0.5))
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    sw = step_w or IW / W
+    sh = step_h or IH / H
+
+    prior = []  # (dx, dy, w, h) offsets within a cell, pixels
+    for size, dens in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            shift = size / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    cx_off = -size / 2.0 + shift / 2.0 + dj * shift
+                    cy_off = -size / 2.0 + shift / 2.0 + di * shift
+                    prior.append((cx_off, cy_off, bw, bh))
+    P = len(prior)
+    pr = jnp.asarray(prior, jnp.float32)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    centers = jnp.stack([cxg, cyg], -1)[:, :, None, :]          # [H,W,1,2]
+    ctr = centers + pr[None, None, :, :2]
+    half = pr[None, None, :, 2:] / 2.0
+    mins = (ctr - half) / jnp.asarray([IW, IH], jnp.float32)
+    maxs = (ctr + half) / jnp.asarray([IW, IH], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), (H, W, P, 4))
+    ctx.set_out("Boxes", boxes)
+    ctx.set_out("Variances", var)
+
+
+@op("anchor_generator", no_grad=True)
+def _anchor_generator(ctx):
+    """reference: detection/anchor_generator_op.cc"""
+    feat = ctx.in_("Input")  # [N, C, H, W]
+    anchor_sizes = [float(v) for v in ctx.attr("anchor_sizes", [64.0])]
+    ars = [float(v) for v in ctx.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(v) for v in ctx.attr("stride", [16.0, 16.0])]
+    offset = float(ctx.attr("offset", 0.5))
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    whs = []
+    for ar in ars:
+        for s in anchor_sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / ar
+            base_w = np.round(np.sqrt(area_ratios))
+            base_h = np.round(base_w * ar)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            whs.append((scale_w * base_w, scale_h * base_h))
+    P = len(whs)
+    wh = jnp.asarray(whs, jnp.float32)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    centers = jnp.stack([cxg, cyg], -1)[:, :, None, :]
+    half = wh[None, None, :, :] / 2.0
+    anchors = jnp.concatenate([centers - half, centers + half], -1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), (H, W, P, 4))
+    ctx.set_out("Anchors", anchors)
+    ctx.set_out("Variances", var)
+
+
+# --------------------------------------------------------------------------
+# box transforms
+# --------------------------------------------------------------------------
+def _iou_matrix(a, b):
+    """a [M,4], b [N,4] xyxy -> [M,N] IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@op("iou_similarity", no_grad=True)
+def _iou_similarity(ctx):
+    """reference: detection/iou_similarity_op.cc"""
+    x = ctx.in_("X")
+    y = ctx.in_("Y")
+    ctx.set_out("Out", _iou_matrix(x.reshape(-1, 4), y.reshape(-1, 4)))
+
+
+@op("batched_iou", no_grad=True)
+def _batched_iou(ctx):
+    """[N, M, 4] x [P, 4] -> [N, M, P] (vmapped IoU; ssd_loss helper)."""
+    x = ctx.in_("X")
+    y = ctx.in_("Y").reshape(-1, 4)
+    ctx.set_out("Out", jax.vmap(lambda a: _iou_matrix(a, y))(x))
+
+
+@op("box_coder", no_grad=True)
+def _box_coder(ctx):
+    """reference: detection/box_coder_op.cc — encode_center_size /
+    decode_center_size."""
+    prior = ctx.in_("PriorBox").reshape(-1, 4)  # [M, 4] xyxy
+    pvar = ctx.in_("PriorBoxVar") if ctx.has_input("PriorBoxVar") else None
+    target = ctx.in_("TargetBox")
+    code_type = (ctx.attr("code_type", "encode_center_size") or "").lower()
+    normalized = bool(ctx.attr("box_normalized", True))
+    axis = int(ctx.attr("axis", 0))
+    one = 0.0 if normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is not None:
+        pvar = pvar.reshape(-1, 4)
+    if "encode" in code_type:
+        tb = target.reshape(-1, 4)  # [N, 4]
+        tw = tb[:, 2] - tb[:, 0] + one
+        th = tb[:, 3] - tb[:, 1] + one
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        # out[i, j] = encode target i against prior j
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        oh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)  # [N, M, 4]
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        ctx.set_out("OutputBox", out)
+    else:
+        # decode: target [N, M, 4] deltas (axis=0: priors along dim 1)
+        t = target
+        if t.ndim == 2:
+            t = t[:, None, :] if axis == 0 else t[None, :, :]
+        if pvar is not None:
+            t = t * (pvar[None, :, :] if axis == 0 else pvar[:, None, :])
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                    pcx[None, :], pcy[None, :])
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                    pcx[:, None], pcy[:, None])
+        ocx = t[..., 0] * pw_ + pcx_
+        ocy = t[..., 1] * ph_ + pcy_
+        ow = jnp.exp(t[..., 2]) * pw_
+        oh = jnp.exp(t[..., 3]) * ph_
+        out = jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                         ocx + ow * 0.5 - one, ocy + oh * 0.5 - one], -1)
+        ctx.set_out("OutputBox", out)
+
+
+@op("box_clip", no_grad=True)
+def _box_clip(ctx):
+    """reference: detection/box_clip_op.cc — clip boxes to image."""
+    boxes = ctx.in_("Input")          # [..., 4]
+    im_info = ctx.in_("ImInfo")       # [N, 3] (h, w, scale)
+    h = im_info[:, 0] - 1.0
+    w = im_info[:, 1] - 1.0
+    shape = (-1,) + (1,) * (boxes.ndim - 2)
+    x1 = jnp.clip(boxes[..., 0], 0, w.reshape(shape))
+    y1 = jnp.clip(boxes[..., 1], 0, h.reshape(shape))
+    x2 = jnp.clip(boxes[..., 2], 0, w.reshape(shape))
+    y2 = jnp.clip(boxes[..., 3], 0, h.reshape(shape))
+    ctx.set_out("Output", jnp.stack([x1, y1, x2, y2], -1))
+
+
+# --------------------------------------------------------------------------
+# YOLO
+# --------------------------------------------------------------------------
+@op("yolo_box", no_grad=True)
+def _yolo_box(ctx):
+    """reference: detection/yolo_box_op.cc"""
+    x = ctx.in_("X")               # [N, P*(5+C), H, W]
+    img_size = ctx.in_("ImgSize")  # [N, 2] (h, w)
+    anchors = [int(v) for v in ctx.attr("anchors", [])]
+    class_num = int(ctx.attr("class_num", 1))
+    conf_thresh = float(ctx.attr("conf_thresh", 0.01))
+    downsample = int(ctx.attr("downsample_ratio", 32))
+    clip_bbox = bool(ctx.attr("clip_bbox", True))
+    N, _, H, W = x.shape
+    P = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(P, 2)
+    x = x.reshape(N, P, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    input_h = downsample * H
+    input_w = downsample * W
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / W            # [N,P,H,W]
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy) / H
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    keep = conf > conf_thresh
+    boxes = jnp.stack([x1, y1, x2, y2], -1)               # [N,P,H,W,4]
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    scores = jnp.where(keep[..., None],
+                       jnp.moveaxis(probs, 2, -1), 0.0)   # [N,P,H,W,C]
+    ctx.set_out("Boxes", boxes.reshape(N, -1, 4))
+    ctx.set_out("Scores", scores.reshape(N, -1, class_num))
+
+
+# --------------------------------------------------------------------------
+# ROI ops
+# --------------------------------------------------------------------------
+@op("roi_align")
+def _roi_align(ctx):
+    """reference: detection/roi_align_op.cc — bilinear-sampled ROI pooling.
+    RoisNum/batch mapping: RoisBatchId input [R] gives each roi's image."""
+    x = ctx.in_("X")        # [N, C, H, W]
+    rois = ctx.in_("ROIs")  # [R, 4] xyxy in input-image coords
+    batch_ids = (ctx.in_("RoisBatchId").astype(jnp.int32)
+                 if ctx.has_input("RoisBatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    spatial_scale = float(ctx.attr("spatial_scale", 1.0))
+    sampling = int(ctx.attr("sampling_ratio", -1))
+    n_samp = sampling if sampling > 0 else 2
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    roi = rois * spatial_scale
+    rw = jnp.maximum(roi[:, 2] - roi[:, 0], 1.0)   # [R]
+    rh = jnp.maximum(roi[:, 3] - roi[:, 1], 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+
+    # sample grid [R, ph, pw, n, n, 2] -> bilinear gather
+    iy = (jnp.arange(n_samp, dtype=jnp.float32) + 0.5) / n_samp
+    ix = (jnp.arange(n_samp, dtype=jnp.float32) + 0.5) / n_samp
+    py = jnp.arange(ph, dtype=jnp.float32)
+    px = jnp.arange(pw, dtype=jnp.float32)
+    yy = roi[:, 1, None, None] + (py[None, :, None] + iy[None, None, :]) * bin_h[:, None, None]
+    xx = roi[:, 0, None, None] + (px[None, :, None] + ix[None, None, :]) * bin_w[:, None, None]
+    # yy: [R, ph, n]; xx: [R, pw, n]
+
+    def bilinear(img, ys, xs):
+        """img [C,H,W]; ys [ph,n]; xs [pw,n] -> [C, ph, pw] averaged."""
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy1 = ys - y0
+        wx1 = xs - x0
+        y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+        y1i = jnp.clip(y0i + 1, 0, H - 1)
+        x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+        x1i = jnp.clip(x0i + 1, 0, W - 1)
+        # gather along H for each ph,n then along W for each pw,n
+        g00 = img[:, y0i[:, :, None, None], x0i[None, None, :, :]]
+        g01 = img[:, y0i[:, :, None, None], x1i[None, None, :, :]]
+        g10 = img[:, y1i[:, :, None, None], x0i[None, None, :, :]]
+        g11 = img[:, y1i[:, :, None, None], x1i[None, None, :, :]]
+        wy1b = wy1[None, :, :, None, None]
+        wx1b = wx1[None, None, None, :, :]
+        val = (g00 * (1 - wy1b) * (1 - wx1b) + g01 * (1 - wy1b) * wx1b +
+               g10 * wy1b * (1 - wx1b) + g11 * wy1b * wx1b)
+        # val [C, ph, n, pw, n] -> mean over sample dims
+        return val.mean(axis=(2, 4))
+
+    imgs = x[batch_ids]  # [R, C, H, W]
+    out = jax.vmap(bilinear)(imgs, yy, xx)  # [R, C, ph, pw]
+    ctx.set_out("Out", out)
+
+
+@op("roi_pool")
+def _roi_pool(ctx):
+    """reference: roi_pool_op.cc — max pooling over quantized bins."""
+    x = ctx.in_("X")
+    rois = ctx.in_("ROIs")
+    batch_ids = (ctx.in_("RoisBatchId").astype(jnp.int32)
+                 if ctx.has_input("RoisBatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    spatial_scale = float(ctx.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    roi = jnp.round(rois * spatial_scale)
+    x1, y1 = roi[:, 0], roi[:, 1]
+    rw = jnp.maximum(roi[:, 2] - x1 + 1, 1.0)
+    rh = jnp.maximum(roi[:, 3] - y1 + 1, 1.0)
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one(img, x1_, y1_, rw_, rh_):
+        bin_h = rh_ / ph
+        bin_w = rw_ / pw
+        # bin index of each pixel, -1 if outside roi
+        by = jnp.floor((ys - y1_) / bin_h)
+        bx = jnp.floor((xs - x1_) / bin_w)
+        by = jnp.where((ys >= y1_) & (ys < y1_ + rh_), by, -1)
+        bx = jnp.where((xs >= x1_) & (xs < x1_ + rw_), bx, -1)
+        oy = jax.nn.one_hot(by.astype(jnp.int32), ph, axis=0)   # [ph, H]
+        ox = jax.nn.one_hot(bx.astype(jnp.int32), pw, axis=0)   # [pw, W]
+        neg = jnp.finfo(img.dtype).min
+        m = (oy[:, None, :, None] > 0) & (ox[None, :, None, :] > 0)  # [ph,pw,H,W]
+        vals = jnp.where(m[None], img[:, None, None, :, :], neg)
+        return vals.max(axis=(-1, -2))
+
+    imgs = x[batch_ids]
+    out = jax.vmap(one)(imgs, x1, y1, rw, rh)
+    ctx.set_out("Out", out)
+
+
+# --------------------------------------------------------------------------
+# matching / NMS (host)
+# --------------------------------------------------------------------------
+def _greedy_match(dist, mtype, thr):
+    """One image: dist [M, P] -> (match_idx [P], match_dist [P])."""
+    M, P = dist.shape
+    match_idx = np.full((P,), -1, np.int32)
+    match_dist = np.zeros((P,), np.float32)
+    used_rows, used_cols = set(), set()
+    while len(used_rows) < M and len(used_cols) < P:
+        d = dist.copy()
+        if used_rows:
+            d[list(used_rows), :] = -1
+        if used_cols:
+            d[:, list(used_cols)] = -1
+        r, c = np.unravel_index(np.argmax(d), d.shape)
+        if d[r, c] <= 0:
+            break
+        match_idx[c] = r
+        match_dist[c] = dist[r, c]
+        used_rows.add(r)
+        used_cols.add(c)
+    if mtype == "per_prediction":
+        for c in range(P):
+            if match_idx[c] == -1:
+                r = int(np.argmax(dist[:, c]))
+                if dist[r, c] >= thr:
+                    match_idx[c] = r
+                    match_dist[c] = dist[r, c]
+    return match_idx, match_dist
+
+
+@op("bipartite_match", no_grad=True, host=True)
+def _bipartite_match(ctx):
+    """reference: detection/bipartite_match_op.cc — greedy max matching.
+    DistMat [M, P] (one image, reference LoD layout) or batched
+    [N, M, P]."""
+    dist = np.asarray(jax.device_get(ctx.in_("DistMat")))
+    mtype = ctx.attr("match_type", "bipartite")
+    thr = float(ctx.attr("dist_threshold", 0.5))
+    if dist.ndim == 2:
+        dist = dist[None]
+    N = dist.shape[0]
+    idxs, dists = [], []
+    for n in range(N):
+        mi, md = _greedy_match(dist[n], mtype, thr)
+        idxs.append(mi)
+        dists.append(md)
+    ctx.set_out("ColToRowMatchIndices", jnp.asarray(np.stack(idxs)))
+    ctx.set_out("ColToRowMatchDist", jnp.asarray(np.stack(dists)))
+
+
+def _nms_single(boxes, scores, thresh, top_k):
+    """numpy greedy NMS; returns kept indices."""
+    order = scores.argsort()[::-1]
+    if top_k > 0:
+        order = order[:top_k]
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        rest = order[1:]
+        xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        w = np.maximum(xx2 - xx1, 0)
+        h = np.maximum(yy2 - yy1, 0)
+        inter = w * h
+        a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a_r = ((boxes[rest, 2] - boxes[rest, 0]) *
+               (boxes[rest, 3] - boxes[rest, 1]))
+        union = a_i + a_r - inter
+        iou = np.where(union > 0, inter / union, 0)
+        order = rest[iou <= thresh]
+    return keep
+
+
+@op("multiclass_nms", no_grad=True, host=True)
+def _multiclass_nms(ctx):
+    """reference: detection/multiclass_nms_op.cc.  Output rows are
+    [label, score, x1, y1, x2, y2]; padded out to keep_top_k rows per
+    image with label=-1 (the reference emits ragged LoD rows)."""
+    boxes = np.asarray(jax.device_get(ctx.in_("BBoxes")))   # [N, M, 4]
+    scores = np.asarray(jax.device_get(ctx.in_("Scores")))  # [N, C, M]
+    score_thresh = float(ctx.attr("score_threshold", 0.0))
+    nms_thresh = float(ctx.attr("nms_threshold", 0.3))
+    nms_top_k = int(ctx.attr("nms_top_k", -1))
+    keep_top_k = int(ctx.attr("keep_top_k", 100))
+    background = int(ctx.attr("background_label", 0))
+    N, C, M = scores.shape
+    K = keep_top_k if keep_top_k > 0 else M
+    out = np.full((N, K, 6), -1.0, np.float32)
+    counts = np.zeros((N,), np.int64)
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == background:
+                continue
+            mask = scores[n, c] > score_thresh
+            idxs = np.where(mask)[0]
+            if idxs.size == 0:
+                continue
+            keep = _nms_single(boxes[n, idxs], scores[n, c, idxs],
+                               nms_thresh, nms_top_k)
+            for k in keep:
+                i = idxs[k]
+                dets.append((scores[n, c, i], c, i))
+        dets.sort(reverse=True)
+        dets = dets[:K]
+        counts[n] = len(dets)
+        for j, (s, c, i) in enumerate(dets):
+            out[n, j, 0] = c
+            out[n, j, 1] = s
+            out[n, j, 2:] = boxes[n, i]
+    ctx.set_out("Out", jnp.asarray(out))
+    ctx.set_out("NmsRoisNum", jnp.asarray(counts))
+
+
+@op("target_assign", no_grad=True)
+def _target_assign(ctx):
+    """reference: detection/target_assign_op.cc — gather per-prior
+    targets from matched row indices.  X is [M, D] (shared gt across the
+    batch, reference LoD layout) or [N, M, D] (batched)."""
+    x = ctx.in_("X")
+    match = ctx.in_("MatchIndices")  # [N, P] row index or -1
+    mismatch_value = ctx.attr("mismatch_value", 0)
+    mi = match.astype(jnp.int32)
+    if x.ndim == 2:
+        safe = jnp.clip(mi, 0, x.shape[0] - 1)
+        gathered = x[safe]                        # [N, P, D]
+    else:
+        safe = jnp.clip(mi, 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(x, safe[..., None], axis=1)
+    neg = mi < 0
+    out = jnp.where(neg[..., None], jnp.asarray(mismatch_value, x.dtype),
+                    gathered)
+    wt = jnp.where(neg, 0.0, 1.0)
+    ctx.set_out("Out", out)
+    ctx.set_out("OutWeight", wt[..., None])
+
+
+@op("ssd_loss_core")
+def _ssd_loss_core(ctx):
+    """Differentiable tail of SSD loss given host-computed matching
+    (reference: python/paddle/fluid/layers/detection.py ssd_loss —
+    encode targets, smooth_l1 loc loss, softmax CE conf loss, hard
+    negative mining; the mining's dynamic sample count becomes a
+    rank-based weight so everything stays jittable)."""
+    loc = ctx.in_("Location")       # [N, P, 4]
+    conf = ctx.in_("Confidence")    # [N, P, C]
+    gt_box = ctx.in_("GTBox")       # [N, M, 4]
+    gt_label = ctx.in_("GTLabel")   # [N, M]
+    prior = ctx.in_("PriorBox")     # [P, 4]
+    pvar = ctx.in_("PriorBoxVar") if ctx.has_input("PriorBoxVar") else None
+    match = ctx.in_("MatchIndices").astype(jnp.int32)  # [N, P]
+    background = int(ctx.attr("background_label", 0))
+    neg_pos_ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    loc_w = float(ctx.attr("loc_loss_weight", 1.0))
+    conf_w = float(ctx.attr("conf_loss_weight", 1.0))
+    N, P = match.shape
+    M = gt_box.shape[1]
+    pos = match >= 0                               # [N, P]
+    safe = jnp.clip(match, 0, M - 1)
+    tgt_box = jnp.take_along_axis(gt_box, safe[..., None], axis=1)  # [N,P,4]
+    tgt_lbl = jnp.take_along_axis(gt_label.astype(jnp.int32), safe, axis=1)
+    tgt_lbl = jnp.where(pos, tgt_lbl, background)
+
+    # encode matched gt against priors (center-size, reference formulas)
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    tw = tgt_box[..., 2] - tgt_box[..., 0]
+    th = tgt_box[..., 3] - tgt_box[..., 1]
+    tcx = tgt_box[..., 0] + tw * 0.5
+    tcy = tgt_box[..., 1] + th * 0.5
+    ex = (tcx - pcx[None]) / pw[None]
+    ey = (tcy - pcy[None]) / ph[None]
+    ew = jnp.log(jnp.maximum(tw / pw[None], 1e-10))
+    eh = jnp.log(jnp.maximum(th / ph[None], 1e-10))
+    enc = jnp.stack([ex, ey, ew, eh], -1)          # [N, P, 4]
+    if pvar is not None:
+        enc = enc / pvar.reshape(1, -1, 4)
+
+    d = loc - enc
+    ad = jnp.abs(d)
+    loc_loss = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(-1)
+    loc_loss = (loc_loss * pos).sum(-1)            # [N]
+
+    logp = jax.nn.log_softmax(conf, -1)
+    ce = -jnp.take_along_axis(logp, tgt_lbl[..., None], axis=-1)[..., 0]
+
+    # hard negative mining: keep top (neg_pos_ratio * npos) negatives by ce
+    npos = pos.sum(-1)                             # [N]
+    neg_ce = jnp.where(pos, -jnp.inf, ce)
+    order = jnp.argsort(-neg_ce, axis=-1)
+    rank = jnp.argsort(order, axis=-1)             # rank of each prior
+    keep_neg = (~pos) & (rank < (neg_pos_ratio * npos)[:, None])
+    conf_loss = (ce * (pos | keep_neg)).sum(-1)    # [N]
+
+    denom = jnp.maximum(npos.astype(loc.dtype), 1.0)
+    total = (loc_w * loc_loss + conf_w * conf_loss) / denom
+    ctx.set_out("Loss", total)
+
+
+@op("polygon_box_transform", no_grad=True)
+def _polygon_box_transform(ctx):
+    """reference: detection/polygon_box_transform_op.cc (OCR EAST)."""
+    x = ctx.in_("Input")  # [N, geo, H, W]
+    N, G, H, W = x.shape
+    gx = jnp.tile(jnp.arange(W, dtype=x.dtype)[None, :], (H, 1)) * 4.0
+    gy = jnp.tile(jnp.arange(H, dtype=x.dtype)[:, None], (1, W)) * 4.0
+    idx = jnp.arange(G)
+    grid = jnp.where((idx % 2 == 0)[:, None, None], gx[None], gy[None])
+    ctx.set_out("Output", grid[None] - x)
+
+
+# --------------------------------------------------------------------------
+# losses
+# --------------------------------------------------------------------------
+@op("yolov3_loss")
+def _yolov3_loss(ctx):
+    """reference: detection/yolov3_loss_op.cc — composed jnp version:
+    objectness BCE + box regression + class BCE against assigned gt."""
+    x = ctx.in_("X")            # [N, P*(5+C), H, W]
+    gt_box = ctx.in_("GTBox")   # [N, B, 4] (cx, cy, w, h) normalized
+    gt_label = ctx.in_("GTLabel")  # [N, B]
+    anchors = [int(v) for v in ctx.attr("anchors", [])]
+    anchor_mask = [int(v) for v in ctx.attr("anchor_mask", [])]
+    class_num = int(ctx.attr("class_num", 1))
+    ignore_thresh = float(ctx.attr("ignore_thresh", 0.7))
+    downsample = int(ctx.attr("downsample_ratio", 32))
+    N, _, H, W = x.shape
+    P = len(anchor_mask)
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an = jnp.asarray(an_all[anchor_mask], jnp.float32)   # [P, 2]
+    input_h = downsample * H
+    input_w = downsample * W
+    x = x.reshape(N, P, 5 + class_num, H, W)
+    B = gt_box.shape[1]
+
+    # predicted boxes (normalized)
+    gxs = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gys = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    px = (jax.nn.sigmoid(x[:, :, 0]) + gxs) / W
+    py = (jax.nn.sigmoid(x[:, :, 1]) + gys) / H
+    pw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    ph = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+
+    # gt grid assignment: which cell & which anchor (best IoU by wh)
+    gt_w = gt_box[..., 2]
+    gt_h = gt_box[..., 3]
+    valid = (gt_w > 0) & (gt_h > 0)                     # [N, B]
+    # anchor match on shape only (as reference): iou of (w,h) vs anchors
+    aw = an_all[:, 0][None, None, :] / input_w
+    ah = an_all[:, 1][None, None, :] / input_h
+    inter = (jnp.minimum(gt_w[..., None], aw) *
+             jnp.minimum(gt_h[..., None], ah))
+    union = gt_w[..., None] * gt_h[..., None] + aw * ah - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)  # [N, B]
+    gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+    # build objectness target + ignore mask
+    obj = jax.nn.sigmoid(x[:, :, 4])                    # [N, P, H, W]
+    # iou of every predicted box vs every gt -> ignore high-iou non-matched
+    pb = jnp.stack([px - pw / 2, py - ph / 2, px + pw / 2, py + ph / 2], -1)
+    gb = jnp.stack([gt_box[..., 0] - gt_w / 2, gt_box[..., 1] - gt_h / 2,
+                    gt_box[..., 0] + gt_w / 2, gt_box[..., 1] + gt_h / 2], -1)
+
+    pbf = pb.reshape(N, -1, 4)
+    lt = jnp.maximum(pbf[:, :, None, :2], gb[:, None, :, :2])
+    rb = jnp.minimum(pbf[:, :, None, 2:], gb[:, None, :, 2:])
+    whs = jnp.maximum(rb - lt, 0)
+    inter2 = whs[..., 0] * whs[..., 1]
+    pa = ((pbf[:, :, 2] - pbf[:, :, 0]) * (pbf[:, :, 3] - pbf[:, :, 1]))
+    ga = (gt_w * gt_h)
+    union2 = pa[:, :, None] + ga[:, None, :] - inter2
+    iou = jnp.where(union2 > 0, inter2 / union2, 0)
+    iou = jnp.where(valid[:, None, :], iou, 0)
+    best_iou = iou.max(-1).reshape(N, P, H, W)
+    ignore = best_iou > ignore_thresh
+
+    # scatter positives
+    batch_idx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+    mask_sel = jnp.zeros((N, P, H, W))
+    tx = jnp.zeros((N, P, H, W))
+    ty = jnp.zeros((N, P, H, W))
+    tw = jnp.zeros((N, P, H, W))
+    th = jnp.zeros((N, P, H, W))
+    tcls = jnp.zeros((N, P, H, W, class_num))
+    # only gts whose best anchor is in this level's mask
+    mask_arr = jnp.asarray(anchor_mask)
+    in_level = (best_anchor[..., None] == mask_arr[None, None, :])
+    level_pos = jnp.argmax(in_level, -1)                 # [N, B]
+    is_here = in_level.any(-1) & valid
+    an_w = an[level_pos][..., 0]
+    an_h = an[level_pos][..., 1]
+    sx = gt_box[..., 0] * W - gi
+    sy = gt_box[..., 1] * H - gj
+    sw = jnp.log(jnp.maximum(gt_w * input_w / an_w, 1e-9))
+    sh = jnp.log(jnp.maximum(gt_h * input_h / an_h, 1e-9))
+    bflat = (batch_idx, level_pos, gj, gi)
+    w_here = jnp.where(is_here, 1.0, 0.0)
+    mask_sel = mask_sel.at[bflat].max(w_here)
+    tx = tx.at[bflat].add(sx * w_here)
+    ty = ty.at[bflat].add(sy * w_here)
+    tw = tw.at[bflat].add(sw * w_here)
+    th = th.at[bflat].add(sh * w_here)
+    onehot = jax.nn.one_hot(gt_label, class_num) * w_here[..., None]
+    tcls = tcls.at[bflat].add(onehot)
+
+    def bce(p, t):
+        p = jnp.clip(p, 1e-7, 1 - 1e-7)
+        return -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+
+    scale = 2.0 - gt_w * gt_h  # box loss weight (reference semantics)
+    scale_map = jnp.ones((N, P, H, W)).at[bflat].add(
+        (scale - 1.0) * w_here)
+    sxp = jax.nn.sigmoid(x[:, :, 0])
+    syp = jax.nn.sigmoid(x[:, :, 1])
+    loss_xy = (bce(sxp, tx) + bce(syp, ty)) * mask_sel * scale_map
+    loss_wh = (jnp.abs(x[:, :, 2] - tw) + jnp.abs(x[:, :, 3] - th)) \
+        * mask_sel * scale_map
+    loss_obj = bce(obj, mask_sel) * jnp.where(
+        (~ignore) | (mask_sel > 0), 1.0, 0.0)
+    probs = jax.nn.sigmoid(x[:, :, 5:])                  # [N,P,C,H,W]
+    probs = jnp.moveaxis(probs, 2, -1)
+    loss_cls = bce(probs, tcls) * mask_sel[..., None]
+    total = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3)) +
+             loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+    ctx.set_out("Loss", total)

@@ -53,7 +53,13 @@ def _sequence_mask(ctx):
     if ctx.has_input("MaxLenTensor"):
         maxlen = int(np.asarray(ctx.in_("MaxLenTensor")).ravel()[0])
     if maxlen is None or maxlen < 0:
-        maxlen = int(np.asarray(jax.device_get(jnp.max(x))))
+        try:
+            maxlen = int(np.asarray(jax.device_get(jnp.max(x))))
+        except jax.errors.TracerArrayConversionError:
+            raise ValueError(
+                "sequence_mask: maxlen=None needs the data-dependent "
+                "max(lengths), which XLA's static shapes cannot express "
+                "inside a jitted program — pass an explicit maxlen") from None
     dt = ctx.attr("out_dtype", "int64") or "int64"
     from ..framework.dtype import to_numpy_dtype
     try:
@@ -229,7 +235,13 @@ def _sequence_pad(ctx):
     N = jnp.shape(length)[0]
     padded_len = int(ctx.attr("padded_length", -1))
     if padded_len <= 0:
-        padded_len = int(np.asarray(jax.device_get(jnp.max(length))))
+        try:
+            padded_len = int(np.asarray(jax.device_get(jnp.max(length))))
+        except jax.errors.TracerArrayConversionError:
+            raise ValueError(
+                "sequence_pad: padded_length=-1 needs the data-dependent "
+                "max(lengths), which XLA's static shapes cannot express "
+                "inside a jitted program — pass maxlen explicitly") from None
     starts = jnp.concatenate([jnp.zeros((1,), length.dtype),
                               jnp.cumsum(length)[:-1]])
     t = jnp.arange(padded_len)[None, :]
@@ -362,7 +374,7 @@ def _sequence_enumerate(ctx):
 # --------------------------------------------------------------------------
 def _lstm_cell_step(carry, xt, wi, wh, b):
     h, c = carry
-    gates = xt @ wi + h @ wh + b
+    gates = (xt if wi is None else xt @ wi) + h @ wh + b
     i, f, g, o = jnp.split(gates, 4, axis=-1)
     i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
     g = jnp.tanh(g)
@@ -372,13 +384,15 @@ def _lstm_cell_step(carry, xt, wi, wh, b):
 
 
 def _gru_cell_step(carry, xt, wi, wh, b):
+    """Paddle convention (gru_op.cc / gru_unit): candidate uses
+    (r∘h) @ W_c, matching this repo's gru_unit op."""
     (h,) = carry
-    D = jnp.shape(wh)[1] // 3
-    gi = xt @ wi + b
-    gh = h @ wh
-    r = jax.nn.sigmoid(gi[..., :D] + gh[..., :D])
-    z = jax.nn.sigmoid(gi[..., D : 2 * D] + gh[..., D : 2 * D])
-    n = jnp.tanh(gi[..., 2 * D :] + r * gh[..., 2 * D :])
+    D = jnp.shape(wh)[0]
+    gi = (xt if wi is None else xt @ wi) + b
+    gh_rz = h @ wh[:, : 2 * D]
+    r = jax.nn.sigmoid(gi[..., :D] + gh_rz[..., :D])
+    z = jax.nn.sigmoid(gi[..., D : 2 * D] + gh_rz[..., D : 2 * D])
+    n = jnp.tanh(gi[..., 2 * D :] + (r * h) @ wh[:, 2 * D :])
     h = (1 - z) * n + z * h
     return (h,), h
 
@@ -555,8 +569,7 @@ def _dynamic_gru(ctx):
     h0 = ctx.in_("H0") if ctx.has_input("H0") else jnp.zeros((N, H), x.dtype)
     bb = jnp.reshape(b, (-1,))[: 3 * H] if b is not None else jnp.zeros((3 * H,), x.dtype)
     is_reverse = bool(ctx.attr("is_reverse", False))
-    eye = jnp.eye(jnp.shape(x)[-1], dtype=x.dtype)
-    out, (hT,) = _run_rnn(x, length, h0, None, eye, w, bb,
+    out, (hT,) = _run_rnn(x, length, h0, None, None, w, bb,
                           _gru_cell_step, reverse=is_reverse)
     ctx.set_out("Hidden", out)
     ctx.set_out("LastH", hT)

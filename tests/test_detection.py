@@ -1,0 +1,326 @@
+"""Detection op tests.
+
+Mirrors the reference's detection OpTest family
+(reference: python/paddle/fluid/tests/unittests/test_prior_box_op.py,
+test_anchor_generator_op.py, test_box_coder_op.py, test_iou_similarity_op.py,
+test_yolo_box_op.py, test_multiclass_nms_op.py, test_roi_align_op.py,
+test_bipartite_match_op.py, test_target_assign_op.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from op_test import OpTest
+
+rng = np.random.RandomState(11)
+
+
+def _np_iou(a, b):
+    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / union, 0)
+
+
+class TestIouSimilarity(OpTest):
+    op_type = "iou_similarity"
+
+    def test_output(self):
+        self.setUp()
+        x = np.abs(rng.rand(4, 4)).astype(np.float32)
+        y = np.abs(rng.rand(6, 4)).astype(np.float32)
+        x[:, 2:] += x[:, :2]  # ensure x2>x1, y2>y1
+        y[:, 2:] += y[:, :2]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": _np_iou(x, y)}
+        self.check_output()
+
+
+class TestPriorBox(OpTest):
+    op_type = "prior_box"
+
+    def test_output_shape_and_range(self):
+        self.setUp()
+        feat = rng.rand(1, 8, 4, 4).astype(np.float32)
+        img = rng.rand(1, 3, 32, 32).astype(np.float32)
+        self.inputs = {"Input": feat, "Image": img}
+        self.attrs = {"min_sizes": [8.0], "max_sizes": [16.0],
+                      "aspect_ratios": [1.0, 2.0], "flip": True,
+                      "clip": True, "variances": [0.1, 0.1, 0.2, 0.2]}
+        self.outputs = {"Boxes": np.zeros((1,), np.float32),
+                        "Variances": np.zeros((1,), np.float32)}
+        prog, feed, _, out_map = self._build_program()
+        exe = pt.Executor(pt.CPUPlace())
+        boxes, var = exe.run(prog, feed=feed,
+                             fetch_list=[out_map["Boxes"][0],
+                                         out_map["Variances"][0]])
+        boxes = np.asarray(boxes)
+        # min, max, ar=2, ar=0.5 -> 4 priors per cell
+        assert boxes.shape == (4, 4, 4, 4)
+        assert boxes.min() >= 0.0 and boxes.max() <= 1.0
+        assert np.asarray(var).shape == (4, 4, 4, 4)
+        # center prior of cell (0,0) is centered at offset*step/img = 4/32
+        c = (boxes[0, 0, 0, :2] + boxes[0, 0, 0, 2:]) / 2
+        np.testing.assert_allclose(c, [4 / 32, 4 / 32], atol=1e-5)
+
+
+class TestAnchorGenerator(OpTest):
+    op_type = "anchor_generator"
+
+    def test_output(self):
+        self.setUp()
+        feat = rng.rand(1, 8, 2, 2).astype(np.float32)
+        self.inputs = {"Input": feat}
+        self.attrs = {"anchor_sizes": [32.0, 64.0],
+                      "aspect_ratios": [1.0], "stride": [16.0, 16.0]}
+        self.outputs = {"Anchors": np.zeros((1,), np.float32),
+                        "Variances": np.zeros((1,), np.float32)}
+        prog, feed, _, out_map = self._build_program()
+        exe = pt.Executor(pt.CPUPlace())
+        (anchors,) = exe.run(prog, feed=feed,
+                             fetch_list=[out_map["Anchors"][0]])
+        anchors = np.asarray(anchors)
+        assert anchors.shape == (2, 2, 2, 4)
+        # widths of the two anchors at cell(0,0): 32 and 64 (ratio 1)
+        w = anchors[0, 0, :, 2] - anchors[0, 0, :, 0]
+        np.testing.assert_allclose(w, [32.0, 64.0], rtol=1e-5)
+
+
+class TestBoxCoderDecode(OpTest):
+    op_type = "box_coder"
+
+    def test_encode_decode_roundtrip(self):
+        self.setUp()
+        P = 5
+        prior = np.abs(rng.rand(P, 4)).astype(np.float32)
+        prior[:, 2:] = prior[:, :2] + 0.5 + prior[:, 2:]
+        tgt = np.abs(rng.rand(3, 4)).astype(np.float32)
+        tgt[:, 2:] = tgt[:, :2] + 0.4 + tgt[:, 2:]
+        # encode
+        self.inputs = {"PriorBox": prior, "TargetBox": tgt}
+        self.attrs = {"code_type": "encode_center_size",
+                      "box_normalized": True}
+        self.outputs = {"OutputBox": np.zeros((1,), np.float32)}
+        prog, feed, _, out_map = self._build_program()
+        exe = pt.Executor(pt.CPUPlace())
+        (enc,) = exe.run(prog, feed=feed, fetch_list=[out_map["OutputBox"][0]])
+        enc = np.asarray(enc)  # [3, P, 4]
+        assert enc.shape == (3, P, 4)
+        # decode back: deltas [N, P, 4] with axis=0
+        self.setUp()
+        self.op_type = "box_coder"
+        self.inputs = {"PriorBox": prior, "TargetBox": enc}
+        self.attrs = {"code_type": "decode_center_size",
+                      "box_normalized": True, "axis": 0}
+        self.outputs = {"OutputBox": np.zeros((1,), np.float32)}
+        prog, feed, _, out_map = self._build_program()
+        (dec,) = exe.run(prog, feed=feed, fetch_list=[out_map["OutputBox"][0]])
+        dec = np.asarray(dec)
+        for i in range(3):
+            for j in range(P):
+                np.testing.assert_allclose(dec[i, j], tgt[i], rtol=1e-4,
+                                           atol=1e-5)
+
+
+class TestYoloBox(OpTest):
+    op_type = "yolo_box"
+
+    def test_output(self):
+        self.setUp()
+        N, H, W, C = 1, 3, 3, 2
+        anchors = [10, 13, 16, 30]
+        P = 2
+        x = rng.randn(N, P * (5 + C), H, W).astype(np.float32)
+        img = np.array([[96, 96]], np.int32)
+        self.inputs = {"X": x, "ImgSize": img}
+        self.attrs = {"anchors": anchors, "class_num": C,
+                      "conf_thresh": 0.005, "downsample_ratio": 32}
+        self.outputs = {"Boxes": np.zeros((1,), np.float32),
+                        "Scores": np.zeros((1,), np.float32)}
+        prog, feed, _, out_map = self._build_program()
+        exe = pt.Executor(pt.CPUPlace())
+        boxes, scores = exe.run(prog, feed=feed,
+                                fetch_list=[out_map["Boxes"][0],
+                                            out_map["Scores"][0]])
+        assert np.asarray(boxes).shape == (N, P * H * W, 4)
+        assert np.asarray(scores).shape == (N, P * H * W, C)
+        b = np.asarray(boxes)
+        assert b.min() >= 0 and b.max() <= 95.0 + 1e-5
+
+
+class TestMulticlassNMS(OpTest):
+    op_type = "multiclass_nms"
+
+    def test_suppresses_overlaps(self):
+        self.setUp()
+        # two heavily overlapping boxes + one distinct, one class
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]  # class 1 (class 0 = background)
+        self.inputs = {"BBoxes": boxes, "Scores": scores}
+        self.attrs = {"score_threshold": 0.1, "nms_threshold": 0.5,
+                      "nms_top_k": -1, "keep_top_k": 5,
+                      "background_label": 0}
+        self.outputs = {"Out": np.zeros((1,), np.float32),
+                        "NmsRoisNum": np.zeros((1,), np.int64)}
+        prog, feed, _, out_map = self._build_program()
+        exe = pt.Executor(pt.CPUPlace())
+        out, nums = exe.run(prog, feed=feed,
+                            fetch_list=[out_map["Out"][0],
+                                        out_map["NmsRoisNum"][0]])
+        out = np.asarray(out)
+        assert int(np.asarray(nums)[0]) == 2  # overlap suppressed
+        kept_scores = sorted(out[0, :2, 1].tolist(), reverse=True)
+        np.testing.assert_allclose(kept_scores, [0.9, 0.7], atol=1e-6)
+
+
+class TestRoiAlign(OpTest):
+    op_type = "roi_align"
+
+    def test_constant_map(self):
+        self.setUp()
+        # constant feature map -> every pooled value equals the constant
+        x = np.full((1, 2, 8, 8), 3.5, np.float32)
+        rois = np.array([[0, 0, 7, 7], [2, 2, 6, 6]], np.float32)
+        self.inputs = {"X": x, "ROIs": rois,
+                       "RoisBatchId": np.zeros(2, np.int32)}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0, "sampling_ratio": 2}
+        self.outputs = {"Out": np.full((2, 2, 2, 2), 3.5, np.float32)}
+        self.check_output()
+
+    def test_grad(self):
+        self.setUp()
+        x = rng.rand(1, 1, 6, 6).astype(np.float32)
+        rois = np.array([[1, 1, 4, 4]], np.float32)
+        self.inputs = {"X": x, "ROIs": rois,
+                       "RoisBatchId": np.zeros(1, np.int32)}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0, "sampling_ratio": 2}
+        self.outputs = {"Out": np.zeros((1, 1, 2, 2), np.float32)}
+        self.check_grad(["in_X"], "out_Out", max_relative_error=0.02)
+
+
+class TestRoiPool(OpTest):
+    op_type = "roi_pool"
+
+    def test_max_in_bins(self):
+        self.setUp()
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        self.inputs = {"X": x, "ROIs": rois,
+                       "RoisBatchId": np.zeros(1, np.int32)}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0}
+        # bins: rows {0,1}x cols{0,1} -> max 5; etc.
+        ref = np.array([[[[5, 7], [13, 15]]]], np.float32)
+        self.outputs = {"Out": ref}
+        self.check_output()
+
+
+class TestBipartiteMatch(OpTest):
+    op_type = "bipartite_match"
+
+    def test_greedy(self):
+        self.setUp()
+        dist = np.array([[0.9, 0.1, 0.3],
+                         [0.8, 0.7, 0.2]], np.float32)
+        self.inputs = {"DistMat": dist}
+        self.attrs = {"match_type": "bipartite"}
+        # greedy: (0,0)=0.9 then (1,1)=0.7; col 2 unmatched
+        self.outputs = {"ColToRowMatchIndices": np.array([[0, 1, -1]], np.int32),
+                        "ColToRowMatchDist": np.array([[0.9, 0.7, 0.0]],
+                                                      np.float32)}
+        self.check_output()
+
+
+class TestTargetAssign(OpTest):
+    op_type = "target_assign"
+
+    def test_output(self):
+        self.setUp()
+        x = np.array([[1, 2], [3, 4], [5, 6]], np.float32)
+        match = np.array([[2, -1, 0]], np.int32)
+        self.inputs = {"X": x, "MatchIndices": match}
+        self.attrs = {"mismatch_value": 0}
+        self.outputs = {"Out": np.array([[[5, 6], [0, 0], [1, 2]]], np.float32),
+                        "OutWeight": np.array([[[1.0], [0.0], [1.0]]],
+                                              np.float32)}
+        self.check_output()
+
+
+def test_ssd_loss_trains():
+    """ssd head loss decreases when trained on a fixed scene."""
+    P, C, M = 8, 3, 2
+    prior = np.zeros((P, 4), np.float32)
+    g = np.linspace(0.1, 0.9, P)
+    prior[:, 0] = g - 0.05
+    prior[:, 1] = 0.4
+    prior[:, 2] = g + 0.05
+    prior[:, 3] = 0.6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data("feat", [16])
+        gtb = fluid.layers.data("gtb", [M, 4])
+        gtl = fluid.layers.data("gtl", [M], dtype="int64")
+        pb = fluid.layers.assign(prior)
+        loc = fluid.layers.fc(feat, P * 4)
+        loc = fluid.layers.reshape(loc, [-1, P, 4])
+        conf = fluid.layers.fc(feat, P * C)
+        conf = fluid.layers.reshape(conf, [-1, P, C])
+        loss = fluid.layers.ssd_loss(loc, conf, gtb, gtl, pb,
+                                     background_label=0)
+        avg = fluid.layers.mean(loss)
+        fluid.optimizer.AdamOptimizer(1e-2).minimize(avg)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    N = 4
+    feat_v = rng.rand(N, 16).astype(np.float32)
+    gtb_v = np.tile(np.array([[[0.1, 0.4, 0.3, 0.6],
+                               [0.6, 0.4, 0.85, 0.6]]], np.float32),
+                    (N, 1, 1))
+    gtl_v = np.tile(np.array([[1, 2]], np.int64), (N, 1))
+    losses = []
+    for _ in range(15):
+        (lv,) = exe.run(main, feed={"feat": feat_v, "gtb": gtb_v,
+                                    "gtl": gtl_v}, fetch_list=[avg.name])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_yolov3_loss_decreases():
+    N, C, H, W = 2, 3, 4, 4
+    anchors = [10, 14, 23, 27, 37, 58]
+    mask = [0, 1, 2]
+    P = len(mask)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data("feat", [8])
+        gtb = fluid.layers.data("gtb", [2, 4])
+        gtl = fluid.layers.data("gtl", [2], dtype="int64")
+        x = fluid.layers.fc(feat, P * (5 + C) * H * W)
+        x = fluid.layers.reshape(x, [-1, P * (5 + C), H, W])
+        loss = fluid.layers.yolov3_loss(x, gtb, gtl, anchors, mask, C,
+                                        ignore_thresh=0.7,
+                                        downsample_ratio=32)
+        avg = fluid.layers.mean(loss)
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(avg)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    feat_v = rng.rand(N, 8).astype(np.float32)
+    gtb_v = np.tile(np.array([[[0.3, 0.3, 0.2, 0.2],
+                               [0.7, 0.7, 0.3, 0.3]]], np.float32), (N, 1, 1))
+    gtl_v = np.tile(np.array([[0, 2]], np.int64), (N, 1))
+    losses = []
+    for _ in range(10):
+        (lv,) = exe.run(main, feed={"feat": feat_v, "gtb": gtb_v,
+                                    "gtl": gtl_v}, fetch_list=[avg.name])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0]

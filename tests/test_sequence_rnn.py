@@ -300,10 +300,10 @@ class TestFusedGRU(OpTest):
         ref = np.zeros((N, T, H), np.float32)
         for t in range(T):
             gi = x[:, t] @ wi + b
-            gh = h @ wh
+            gh = h @ wh[:, : 2 * H]
             r = sig(gi[:, :H] + gh[:, :H])
             z = sig(gi[:, H : 2 * H] + gh[:, H : 2 * H])
-            n_ = np.tanh(gi[:, 2 * H :] + r * gh[:, 2 * H :])
+            n_ = np.tanh(gi[:, 2 * H :] + (r * h) @ wh[:, 2 * H :])
             hn = (1 - z) * n_ + z * h
             m = (t < lens).astype(np.float32)[:, None]
             h = m * hn + (1 - m) * h
@@ -388,6 +388,27 @@ def test_static_rnn_unroll():
     xs = rng.rand(2, 4, 3).astype(np.float32)
     (ov,) = exe.run(main, feed={"x": xs}, fetch_list=[out.name])
     assert np.asarray(ov).shape == (2, 4, 6)
+
+
+def test_static_rnn_memory_propagates():
+    """memory + add == running cumsum over time."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4, 3])
+        srnn = fluid.layers.StaticRNN()
+        with srnn.step():
+            xt = srnn.step_input(x)
+            acc = srnn.memory(batch_ref=x, shape=[3])
+            new_acc = fluid.layers.elementwise_add(acc, xt)
+            srnn.update_memory(acc, new_acc)
+            srnn.step_output(new_acc)
+        out = srnn()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xs = rng.rand(2, 4, 3).astype(np.float32)
+    (ov,) = exe.run(main, feed={"x": xs}, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(ov), np.cumsum(xs, axis=1),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_beam_search_step_and_decode():
