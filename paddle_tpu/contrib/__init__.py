@@ -1,1 +1,2 @@
 from . import mixed_precision
+from . import slim

@@ -1,0 +1,13 @@
+"""Slim: quantization-aware training + post-training quantization.
+
+Reference: python/paddle/fluid/contrib/slim/ (quantization passes over
+IrGraph; here the passes rewrite the Program directly — the TPU build's
+program IR is already the mutable graph).
+"""
+from . import quantization  # noqa: F401
+from .quantization import (  # noqa: F401
+    OutScaleForTrainingPass,
+    PostTrainingQuantization,
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+)

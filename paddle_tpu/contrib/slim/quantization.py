@@ -1,0 +1,371 @@
+"""Quantization program-rewrite passes.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py — QuantizationTransformPass:152 (insert fake
+quant/dequant on the inputs of quantizable ops), OutScaleForTrainingPass,
+QuantizationFreezePass; post_training_quantization.py.
+
+The reference operates on IrGraph (C++ ir::Graph binding); here the
+Program IR is Python-native, so the passes edit blocks in place.
+bf16 stays the training compute dtype — fake quant ops simulate int8
+on the MXU-friendly path and real int8 materialization happens at
+freeze time.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...framework.core import Program
+from ...framework import unique_name
+
+QUANTIZABLE_DEFAULT = ["conv2d", "depthwise_conv2d", "mul", "matmul",
+                       "matmul_v2"]
+# input slots that carry weights for each quantizable type
+_WEIGHT_SLOTS = {
+    "conv2d": "Filter",
+    "depthwise_conv2d": "Filter",
+    "mul": "Y",
+    "matmul": "Y",
+    "matmul_v2": "Y",
+}
+_ACT_SLOTS = {
+    "conv2d": "Input",
+    "depthwise_conv2d": "Input",
+    "mul": "X",
+    "matmul": "X",
+    "matmul_v2": "X",
+}
+
+
+def _is_param(block, name):
+    v = block._find_var_recursive(name)
+    return v is not None and getattr(v, "persistable", False) and \
+        type(v).__name__ == "Parameter"
+
+
+class QuantizationTransformPass:
+    """Insert fake quant-dequant before quantizable ops (QAT).
+
+    reference: quantization_pass.py:152 QuantizationTransformPass."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 moving_rate=0.9, skip_pattern="skip_quant",
+                 quantizable_op_type=None, is_test=False):
+        self._weight_bits = weight_bits
+        self._act_bits = activation_bits
+        self._act_type = activation_quantize_type
+        self._weight_type = weight_quantize_type
+        self._moving_rate = moving_rate
+        self._skip_pattern = skip_pattern
+        self._types = list(quantizable_op_type or QUANTIZABLE_DEFAULT)
+        self._is_test = is_test
+        self.quanted_activations: Dict[str, str] = {}  # var -> scale var
+        self._qmap: Dict[str, str] = {}   # raw var -> quantized var
+        self._qdq_op_ids = set()
+
+    def apply(self, program: Program, startup_program: Optional[Program] = None):
+        block = program.global_block()
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in self._types or \
+                    op.attrs.get(self._skip_pattern, False):
+                i += 1
+                continue
+            inserted = 0
+            wslot = _WEIGHT_SLOTS.get(op.type)
+            aslot = _ACT_SLOTS.get(op.type)
+            for slot in list(op.inputs):
+                for k, name in enumerate(op.inputs[slot]):
+                    is_w = slot == wslot and _is_param(block, name)
+                    if not (is_w or slot == aslot):
+                        continue
+                    if name in self._qmap:  # shared var: reuse one qdq op
+                        op.inputs[slot][k] = self._qmap[name]
+                        continue
+                    qname, n_ops = self._insert_qdq(
+                        block, i, name, is_weight=is_w,
+                        startup_program=startup_program)
+                    self._qmap[name] = qname
+                    op.inputs[slot][k] = qname
+                    inserted += n_ops
+            op._set_attr("quantization_type", "qat_with_weight")
+            i += 1 + inserted
+        self._rewire_other_consumers(block)
+        return program
+
+    def _rewire_other_consumers(self, block):
+        """Point every other reader of a quantized var (grad ops above
+        all — the STE path must reach the backward) at the quantized
+        tensor.  Ops that *write* the raw var (optimizer updates of the
+        fp master weight) and the fake-quant ops themselves keep the raw
+        name — mirrors the reference IrGraph pass rewiring all uses
+        (quantization_pass.py dequantized_vars)."""
+        for op in block.ops:
+            if id(op) in self._qdq_op_ids:
+                continue
+            writes = {n for ns in op.outputs.values() for n in ns}
+            for slot, names in op.inputs.items():
+                for k, name in enumerate(names):
+                    qname = self._qmap.get(name)
+                    if qname is None or name in writes or \
+                            names[k] == qname:
+                        continue
+                    op.inputs[slot][k] = qname
+        block.program._bump_version()
+
+    def _insert_qdq(self, block, index, name, is_weight, startup_program):
+        src = block._find_var_recursive(name)
+        qvar = block.create_var(
+            name=unique_name.generate(f"{name}.quantized"),
+            shape=src.shape, dtype=src.dtype, stop_gradient=False)
+        if is_weight:
+            if self._weight_type == "channel_wise_abs_max":
+                op_type = "fake_channel_wise_quantize_dequantize_abs_max"
+                axis = 1 if len(src.shape) == 2 else 0
+                n_scales = src.shape[axis]
+            else:
+                op_type = "fake_quantize_dequantize_abs_max"
+                axis, n_scales = -1, 1
+            scale = block.create_var(
+                name=unique_name.generate(f"{name}.scale"),
+                shape=[n_scales], dtype="float32", stop_gradient=True)
+            qop = block._insert_op(
+                index, op_type, inputs={"X": [name]},
+                outputs={"Out": [qvar.name], "OutScale": [scale.name]},
+                attrs={"bit_length": self._weight_bits, "quant_axis": axis})
+            self._qdq_op_ids.add(id(qop))
+            return qvar.name, 1
+        # activation: EMA scale threading through a persistable state var
+        scale = block.create_var(
+            name=unique_name.generate(f"{name}.quant_scale"),
+            shape=[1], dtype="float32", persistable=True, stop_gradient=True)
+        if startup_program is not None:
+            sb = startup_program.global_block()
+            sb.create_var(name=scale.name, shape=[1], dtype="float32",
+                          persistable=True, stop_gradient=True)
+            sb.append_op("fill_constant", outputs={"Out": [scale.name]},
+                         attrs={"shape": [1], "dtype": "float32",
+                                "value": 0.0})
+        qop = block._insert_op(
+            index, "fake_quantize_moving_average_abs_max",
+            inputs={"X": [name], "InScale": [scale.name]},
+            outputs={"Out": [qvar.name], "OutScale": [scale.name]},
+            attrs={"bit_length": self._act_bits,
+                   "moving_rate": self._moving_rate,
+                   "is_test": self._is_test})
+        self._qdq_op_ids.add(id(qop))
+        self.quanted_activations[name] = scale.name
+        return qvar.name, 1
+
+
+class OutScaleForTrainingPass:
+    """Track output scales of quantizable-adjacent ops for later export.
+
+    reference: quantization_pass.py OutScaleForTrainingPass."""
+
+    _OUT_SLOT = {"conv2d": "Output", "depthwise_conv2d": "Output",
+                 "mul": "Out", "matmul": "Out", "matmul_v2": "Out",
+                 "relu": "Out", "batch_norm": "Y"}
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9,
+                 op_types=None):
+        self._moving_rate = moving_rate
+        self._types = list(op_types or self._OUT_SLOT)
+        self.scales: Dict[str, str] = {}
+
+    def apply(self, program: Program, startup_program: Optional[Program] = None):
+        block = program.global_block()
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            slot = self._OUT_SLOT.get(op.type)
+            if op.type not in self._types or slot is None or \
+                    not op.outputs.get(slot):
+                i += 1
+                continue
+            out_name = op.outputs[slot][0]
+            if out_name in self.scales:
+                i += 1
+                continue
+            scale = block.create_var(
+                name=unique_name.generate(f"{out_name}.out_scale"),
+                shape=[1], dtype="float32", persistable=True,
+                stop_gradient=True)
+            if startup_program is not None:
+                sb = startup_program.global_block()
+                sb.create_var(name=scale.name, shape=[1], dtype="float32",
+                              persistable=True, stop_gradient=True)
+                sb.append_op("fill_constant", outputs={"Out": [scale.name]},
+                             attrs={"shape": [1], "dtype": "float32",
+                                    "value": 0.0})
+            block._insert_op(
+                i + 1, "moving_average_abs_max_scale",
+                inputs={"X": [out_name], "InScale": [scale.name]},
+                outputs={"OutScale": [scale.name]},
+                attrs={"moving_rate": self._moving_rate})
+            self.scales[out_name] = scale.name
+            i += 2
+        return program
+
+
+class QuantizationFreezePass:
+    """Freeze a QAT program for deployment: weights are round-tripped
+    through int8 once on host (so deploy numerics == int8 numerics while
+    XLA still computes in bf16/f32), and the activation fake-quant ops
+    switch to is_test (fixed EMA scales).  Real int8 storage for export
+    uses the quantize_linear/dequantize_linear ops.
+
+    reference: quantization_pass.py QuantizationFreezePass."""
+
+    def __init__(self, scope, place=None, weight_bits=8, activation_bits=8):
+        self._scope = scope
+        self._weight_bits = weight_bits
+
+    def apply(self, program: Program):
+        block = program.global_block()
+        qmax = float(2 ** (self._weight_bits - 1) - 1)
+        for op in list(block.ops):
+            if op.type in ("fake_quantize_dequantize_abs_max",
+                           "fake_channel_wise_quantize_dequantize_abs_max"):
+                wname = op.inputs["X"][0]
+                w = self._scope.find_var(wname)
+                if w is None or w.get() is None:
+                    continue
+                val = np.asarray(w.get())
+                axis = int(op.attrs.get("quant_axis", -1))
+                if op.type.startswith("fake_channel"):
+                    red = tuple(i for i in range(val.ndim) if i != axis)
+                    scale = np.abs(val).max(axis=red, keepdims=True)
+                else:
+                    scale = np.asarray(np.abs(val).max()).reshape(1)
+                scale = np.maximum(scale, 1e-9)
+                q = np.clip(np.round(val / scale * qmax), -qmax - 1, qmax)
+                # store the dequantized-from-int8 weights back: deploy
+                # numerics == int8 numerics while XLA still sees bf16/f32
+                w.set((q * scale / qmax).astype(val.dtype))
+                op._set_attr("__frozen__", True)
+            elif op.type == "fake_quantize_moving_average_abs_max":
+                op._set_attr("is_test", True)
+        return program
+
+
+class PostTrainingQuantization:
+    """Calibrate activation scales on sample batches, then emit a program
+    with fixed-scale quant-dequant (abs_max algo; 'hist' keeps a
+    percentile of the abs distribution).
+
+    reference: post_training_quantization.py PostTrainingQuantization."""
+
+    def __init__(self, executor, program, feed_list: Sequence[str],
+                 data_loader, batch_nums=4, algo="abs_max",
+                 hist_percent=0.9999, quantizable_op_type=None,
+                 weight_bits=8, activation_bits=8, scope=None):
+        self._exe = executor
+        self._program = program
+        self._feeds = list(feed_list)
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+        self._algo = algo
+        self._hist_percent = hist_percent
+        self._types = list(quantizable_op_type or QUANTIZABLE_DEFAULT)
+        self._weight_bits = weight_bits
+        self._act_bits = activation_bits
+        self._scope = scope
+
+    def quantize(self) -> Program:
+        block = self._program.global_block()
+        # vars to observe: activation inputs of quantizable ops
+        observe = []
+        for op in block.ops:
+            if op.type in self._types:
+                aslot = _ACT_SLOTS.get(op.type)
+                if aslot and op.inputs.get(aslot):
+                    name = op.inputs[aslot][0]
+                    if not _is_param(block, name) and name not in observe:
+                        observe.append(name)
+        # calibration runs
+        samples: Dict[str, List[float]] = {n: [] for n in observe}
+        for bi, feed in enumerate(self._loader()):
+            if bi >= self._batch_nums:
+                break
+            missing = set(self._feeds) - set(feed)
+            if missing:
+                raise ValueError(
+                    f"calibration batch {bi} is missing feeds {missing} "
+                    f"declared in feed_list")
+            vals = self._exe.run(self._program, feed=feed,
+                                 fetch_list=observe, scope=self._scope)
+            for name, v in zip(observe, vals):
+                arr = np.abs(np.asarray(v)).ravel()
+                if self._algo == "hist":
+                    samples[name].append(
+                        float(np.quantile(arr, self._hist_percent)))
+                else:
+                    samples[name].append(float(arr.max()))
+        scales = {n: max(v) if v else 1.0 for n, v in samples.items()}
+
+        # rewrite: insert fixed-scale qdq on activations + weight qdq
+        quant_prog = self._program.clone()
+        qblock = quant_prog.global_block()
+        i = 0
+        while i < len(qblock.ops):
+            op = qblock.ops[i]
+            if op.type not in self._types:
+                i += 1
+                continue
+            inserted = 0
+            aslot = _ACT_SLOTS.get(op.type)
+            wslot = _WEIGHT_SLOTS.get(op.type)
+            if aslot and op.inputs.get(aslot):
+                name = op.inputs[aslot][0]
+                if name in scales:
+                    src = qblock._find_var_recursive(name)
+                    qv = qblock.create_var(
+                        name=unique_name.generate(f"{name}.ptq"),
+                        shape=src.shape, dtype=src.dtype)
+                    sv = qblock.create_var(
+                        name=unique_name.generate(f"{name}.ptq_scale"),
+                        shape=[1], dtype="float32", stop_gradient=True)
+                    qblock._insert_op(
+                        i, "assign_value", outputs={"Out": [sv.name]},
+                        attrs={"shape": [1], "dtype": "float32",
+                               "fp32_values": [scales[name]]})
+                    qblock._insert_op(
+                        i + 1, "fake_quantize_moving_average_abs_max",
+                        inputs={"X": [name], "InScale": [sv.name]},
+                        outputs={"Out": [qv.name]},
+                        attrs={"bit_length": self._act_bits,
+                               "is_test": True})
+                    op.inputs[aslot][0] = qv.name
+                    inserted += 2
+            if wslot and op.inputs.get(wslot):
+                name = op.inputs[wslot][0]
+                if _is_param(qblock, name):
+                    src = qblock._find_var_recursive(name)
+                    qv = qblock.create_var(
+                        name=unique_name.generate(f"{name}.ptq"),
+                        shape=src.shape, dtype=src.dtype)
+                    sv = qblock.create_var(
+                        name=unique_name.generate(f"{name}.ptq_scale"),
+                        shape=[src.shape[1] if len(src.shape) == 2
+                               else src.shape[0]],
+                        dtype="float32", stop_gradient=True)
+                    axis = 1 if len(src.shape) == 2 else 0
+                    qblock._insert_op(
+                        i + inserted,
+                        "fake_channel_wise_quantize_dequantize_abs_max",
+                        inputs={"X": [name]},
+                        outputs={"Out": [qv.name], "OutScale": [sv.name]},
+                        attrs={"bit_length": self._weight_bits,
+                               "quant_axis": axis})
+                    op.inputs[wslot][0] = qv.name
+                    inserted += 1
+            op.attrs["quantization_type"] = "post_training"
+            i += 1 + inserted
+        return quant_prog

@@ -25,3 +25,4 @@ from . import control_ops  # noqa: F401
 from . import ps_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import quant_ops  # noqa: F401

@@ -1,0 +1,129 @@
+"""Fake-quantization op lowerings (QAT + PTQ support).
+
+Capability parity with the reference's quantization kernels
+(reference: paddle/fluid/operators/fake_quantize_op.cc —
+fake_quantize_abs_max, fake_quantize_moving_average_abs_max,
+fake_quantize_dequantize_moving_average_abs_max,
+fake_channel_wise_quantize_abs_max, moving_average_abs_max_scale,
+fake_quantize_range_abs_max).
+
+TPU-first: every quant-dequant lowering is written as
+``x + stop_gradient(qdq(x) - x)`` so the generic vjp-replay grad
+machinery yields the straight-through estimator automatically — no
+custom grad kernels (the reference implements STE as dedicated grad
+kernels).  bf16/fp32 stay the compute dtype; int8 materialization only
+happens at freeze/export time.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+def _qdq(x, scale, bits):
+    """Quantize-dequantize with straight-through gradient."""
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(scale, 1e-9)
+    xc = jnp.clip(x, -scale, scale)
+    q = jnp.round(xc / scale * qmax) * scale / qmax
+    return xc + lax.stop_gradient(q - xc)
+
+
+@op("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx):
+    x = ctx.in_("X")
+    bits = int(ctx.attr("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    ctx.set_out("Out", _qdq(x, lax.stop_gradient(scale), bits))
+    ctx.set_out("OutScale", scale.reshape(1))
+
+
+@op("fake_quantize_dequantize_abs_max")
+def _fake_qdq_abs_max(ctx):
+    _fake_quantize_abs_max(ctx)
+
+
+@op("fake_channel_wise_quantize_dequantize_abs_max")
+def _fake_channel_qdq(ctx):
+    """Per-output-channel weight quantization (axis 0 for conv filters,
+    axis 1 for mul weights — quant_axis attr)."""
+    x = ctx.in_("X")
+    bits = int(ctx.attr("bit_length", 8))
+    axis = int(ctx.attr("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    out = _qdq(x, lax.stop_gradient(scale), bits)
+    ctx.set_out("Out", out)
+    ctx.set_out("OutScale", scale.reshape(-1))
+
+
+@op("fake_quantize_moving_average_abs_max")
+def _fake_quantize_moving_avg(ctx):
+    """Activation quantization with EMA scale (training state threads
+    through InScale -> OutScale on the same persistable var)."""
+    x = ctx.in_("X")
+    in_scale = ctx.in_("InScale")
+    bits = int(ctx.attr("bit_length", 8))
+    rate = float(ctx.attr("moving_rate", 0.9))
+    is_test = bool(ctx.attr("is_test", False))
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        # never-calibrated state (scale 0) falls back to the batch
+        # abs-max instead of clipping everything to ~0
+        prev = in_scale.reshape(())
+        scale = jnp.where(prev > 0, prev, cur)
+    else:
+        prev = in_scale.reshape(())
+        # first step: prev==0 -> adopt current scale outright
+        scale = jnp.where(prev > 0, rate * prev + (1 - rate) * cur, cur)
+        ctx.set_out("OutScale", scale.reshape(1))
+    ctx.set_out("Out", _qdq(x, lax.stop_gradient(scale), bits))
+
+
+@op("moving_average_abs_max_scale", no_grad=True)
+def _moving_avg_scale(ctx):
+    """Observe-only scale tracker (OutScaleForTraining pass)."""
+    x = ctx.in_("X")
+    in_state = ctx.in_("InScale")
+    rate = float(ctx.attr("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x))
+    prev = in_state.reshape(())
+    scale = jnp.where(prev > 0, rate * prev + (1 - rate) * cur, cur)
+    ctx.set_out("OutScale", scale.reshape(1))
+    if ctx.has_output("Out"):
+        ctx.set_out("Out", x)
+
+
+@op("dequantize_linear", no_grad=True)
+def _dequantize_linear(ctx):
+    """int8 weight -> float (freeze/deploy path)."""
+    x = ctx.in_("X")
+    scale = ctx.in_("Scale")
+    bits = int(ctx.attr("bit_length", 8))
+    qmax = float(2 ** (bits - 1) - 1)
+    axis = int(ctx.attr("quant_axis", -1))
+    s = scale
+    if axis >= 0 and s.ndim == 1 and s.shape[0] > 1:
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        s = s.reshape(shape)
+    ctx.set_out("Y", x.astype(jnp.float32) * s / qmax)
+
+
+@op("quantize_linear", no_grad=True)
+def _quantize_linear(ctx):
+    """float -> int8 storage (freeze/deploy path)."""
+    x = ctx.in_("X")
+    scale = ctx.in_("Scale")
+    bits = int(ctx.attr("bit_length", 8))
+    qmax = float(2 ** (bits - 1) - 1)
+    axis = int(ctx.attr("quant_axis", -1))
+    s = jnp.maximum(scale, 1e-9)
+    if axis >= 0 and s.ndim == 1 and s.shape[0] > 1:
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        s = s.reshape(shape)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax)
+    ctx.set_out("Y", q.astype(jnp.int8))
