@@ -106,15 +106,18 @@ class QuantizationTransformPass:
         fp master weight) and the fake-quant ops themselves keep the raw
         name — mirrors the reference IrGraph pass rewiring all uses
         (quantization_pass.py dequantized_vars)."""
+        available = set()  # quantized vars defined so far in op order
         for op in block.ops:
             if id(op) in self._qdq_op_ids:
+                for ns in op.outputs.values():
+                    available.update(ns)
                 continue
             writes = {n for ns in op.outputs.values() for n in ns}
             for slot, names in op.inputs.items():
                 for k, name in enumerate(names):
                     qname = self._qmap.get(name)
-                    if qname is None or name in writes or \
-                            names[k] == qname:
+                    if qname is None or qname not in available or \
+                            name in writes or names[k] == qname:
                         continue
                     op.inputs[slot][k] = qname
         block.program._bump_version()

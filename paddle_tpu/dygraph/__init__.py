@@ -27,4 +27,10 @@ from .nn import (
 from .checkpoint import save_dygraph, load_dygraph
 from . import jit
 from .jit import TracedLayer, jit_train_step, compiled_forward
+from . import dygraph_to_static
+from .dygraph_to_static import (
+    ProgramTranslator,
+    declarative,
+    to_static,
+)
 from .parallel import DataParallel, prepare_context

@@ -1,0 +1,12 @@
+"""dygraph_to_static: AST transpiler + ProgramTranslator.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/.
+"""
+from .ast_transformer import DygraphToStaticAst  # noqa: F401
+from .program_translator import (  # noqa: F401
+    ProgramTranslator,
+    StaticFunction,
+    declarative,
+    to_static,
+)
+from . import convert_operators  # noqa: F401

@@ -1,0 +1,245 @@
+"""AST rewriting: python control flow -> convert_* dispatch calls.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ — the 18
+transformer files (ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py, ast_transformer.py DygraphToStaticAst).  This
+build implements the load-bearing subset: if/else, while, and/or/not in
+test positions, and `len`.  For-range loops stay plain Python (the range
+is static under XLA anyway and unrolling is XLA-friendly); tensor-driven
+`for` loops must be written as while loops.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List, Set
+
+_JST = "_jst"  # module alias injected into the transformed function's globals
+
+
+def _store_names(nodes) -> List[str]:
+    """Names bound by simple assignments inside a statement list."""
+    found: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store):
+                found.add(node.id)
+
+        def visit_FunctionDef(self, node):
+            pass  # don't descend into nested defs
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return sorted(found)
+
+
+def _load_names(node) -> List[str]:
+    found: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, ast.Load):
+                found.add(n.id)
+
+    V().visit(node)
+    return sorted(found)
+
+
+def _has_return(nodes) -> bool:
+    """Return statements at this function's level only — nested defs
+    (user helpers or synthetic branch functions from an inner converted
+    if) have their own returns and must not count."""
+    stack = list(nodes)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Return):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+        self._fn_assigned: Set[str] = set()
+
+    def _uid(self):
+        self._counter += 1
+        return self._counter
+
+    # ---------------- if ----------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        if _has_return(node.body) or _has_return(node.orelse):
+            return node  # early-return branches stay python-level
+        uid = self._uid()
+        targets = sorted(n for n in (set(_store_names(node.body)) |
+                                     set(_store_names(node.orelse)))
+                         if not n.startswith("__d2s_"))
+        if not targets:
+            targets = ["__d2s_dummy__"]
+            node.body = node.body + [
+                ast.parse("__d2s_dummy__ = 0").body[0]]
+            node.orelse = (node.orelse or []) + [
+                ast.parse("__d2s_dummy__ = 0").body[0]]
+        ret = ast.parse(f"return ({', '.join(targets)},)").body[0]
+        # capture current bindings as default args so branch bodies that
+        # read-then-write a name see the pre-if value (a bare closure
+        # read would hit UnboundLocalError once the name is assigned)
+        captures = []
+        for t in targets:
+            captures.append(ast.parse(
+                f"try:\n    __d2s_cap_{uid}_{t} = {t}\n"
+                f"except NameError:\n"
+                f"    __d2s_cap_{uid}_{t} = {_JST}.UNDEFINED").body[0])
+        fn_args = _args_with_defaults(
+            targets, [f"__d2s_cap_{uid}_{t}" for t in targets])
+        true_fn = ast.FunctionDef(
+            name=f"__d2s_true_{uid}", args=fn_args,
+            body=node.body + [ret], decorator_list=[], returns=None)
+        false_body = (node.orelse or [ast.Pass()]) + [ret]
+        false_fn = ast.FunctionDef(
+            name=f"__d2s_false_{uid}", args=_args_with_defaults(
+                targets, [f"__d2s_cap_{uid}_{t}" for t in targets]),
+            body=false_body, decorator_list=[], returns=None)
+        assign = ast.parse(
+            f"({', '.join(targets)},) = {_JST}.convert_ifelse("
+            f"__d2s_pred_{uid}, __d2s_true_{uid}, __d2s_false_{uid})"
+        ).body[0]
+        pred_assign = ast.Assign(
+            targets=[ast.Name(id=f"__d2s_pred_{uid}", ctx=ast.Store())],
+            value=node.test)
+        out = [pred_assign] + captures + [true_fn, false_fn, assign]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+    # ---------------- while ----------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if _has_return([node]) or node.orelse:
+            return node
+        uid = self._uid()
+        body_stores = [n for n in _store_names(node.body)
+                       if not n.startswith("__d2s_")]
+        cond_loads = _load_names(node.test)
+        loop_vars = sorted(set(body_stores) |
+                           (set(cond_loads) & self._fn_assigned))
+        if not loop_vars:
+            return node
+        args = ", ".join(loop_vars)
+        cond_fn = ast.FunctionDef(
+            name=f"__d2s_cond_{uid}", args=_args_of(loop_vars),
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        ret = ast.parse(f"return ({args},)").body[0]
+        body_fn = ast.FunctionDef(
+            name=f"__d2s_body_{uid}", args=_args_of(loop_vars),
+            body=node.body + [ret], decorator_list=[], returns=None)
+        assign = ast.parse(
+            f"({args},) = {_JST}.convert_while_loop("
+            f"__d2s_cond_{uid}, __d2s_body_{uid}, ({args},))").body[0]
+        out = [cond_fn, body_fn, assign]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+    # ---------------- bool ops in any expression ----------------
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        conv = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        expr = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            lam_x = ast.Lambda(args=_no_args(), body=v)
+            lam_y = ast.Lambda(args=_no_args(), body=expr)
+            expr = ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                                   attr=conv, ctx=ast.Load()),
+                args=[lam_x, lam_y], keywords=[])
+        ast.copy_location(expr, node)
+        ast.fix_missing_locations(expr)
+        return expr
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            call = ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                                   attr="convert_logical_not",
+                                   ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+            ast.copy_location(call, node)
+            ast.fix_missing_locations(call)
+            return call
+        return node
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                         kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _args_of(names):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n) for n in names], vararg=None,
+        kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _args_with_defaults(names, default_names):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n) for n in names], vararg=None,
+        kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[ast.Name(id=d, ctx=ast.Load()) for d in default_names])
+
+
+class DygraphToStaticAst:
+    """Transform a function's AST; returns (new_code_object_fn_factory)."""
+
+    def get_static_ast(self, fn):
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        # drop the @declarative decorator itself
+        fdef.decorator_list = []
+        tr = _ControlFlowTransformer()
+        tr._fn_assigned = set(_store_names(fdef.body)) | {
+            a.arg for a in fdef.args.args}
+        new_tree = tr.visit(tree)
+        ast.fix_missing_locations(new_tree)
+        return new_tree, fdef.name
+
+    def transform(self, fn):
+        """Return the transformed function object (closure-aware)."""
+        new_tree, name = self.get_static_ast(fn)
+        code = compile(new_tree, filename=f"<d2s {fn.__qualname__}>",
+                       mode="exec")
+        from . import convert_operators
+        glb = dict(fn.__globals__)
+        glb[_JST] = convert_operators
+        # rebind closure freevars as globals (nested helper fns)
+        if fn.__closure__:
+            for nm, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+                try:
+                    glb.setdefault(nm, cell.cell_contents)
+                except ValueError:
+                    pass
+        ns = {}
+        exec(code, glb, ns)
+        out = ns[name]
+        out.__globals__.update(glb)
+        return out
+
+    def get_code(self, fn) -> str:
+        new_tree, _ = self.get_static_ast(fn)
+        return ast.unparse(new_tree)

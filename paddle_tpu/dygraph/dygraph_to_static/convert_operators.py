@@ -1,0 +1,123 @@
+"""Runtime dispatch helpers emitted by the AST transformer.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+convert_operators.py — convert_ifelse, convert_while_loop,
+convert_logical_and/or/not, convert_len.  Each helper checks whether the
+value is a graph Variable (symbolic under the static build) and emits
+cond/while_loop ops, or falls back to plain Python for concrete values.
+"""
+from __future__ import annotations
+
+from ...framework.core import Variable
+
+
+class _Undefined:
+    """Placeholder for names unbound before a converted branch (the
+    reference's UndefinedVar)."""
+
+    def __repr__(self):
+        return "<d2s undefined>"
+
+
+UNDEFINED = _Undefined()
+
+
+def _is_tensor(x) -> bool:
+    return isinstance(x, Variable)
+
+
+def _to_bool_pred(pred):
+    """Reduce a tensor predicate to a scalar bool var for lax.cond."""
+    from ... import layers
+    if tuple(getattr(pred, "shape", ())) not in ((), (1,)):
+        pred = layers.reduce_all(layers.cast(pred, "bool"))
+    return layers.cast(pred, "bool")
+
+
+def convert_ifelse(pred, true_fn, false_fn):
+    """if-statement: both branch closures return the tuple of names the
+    branches (re)bind; symbolic pred lowers to layers.cond."""
+    if _is_tensor(pred):
+        from ... import layers
+
+        def checked(fn, branch):
+            def w():
+                out = fn()
+                vals = out if isinstance(out, (list, tuple)) else [out]
+                if any(v is UNDEFINED for v in vals):
+                    raise ValueError(
+                        f"a variable assigned only in the {branch} branch "
+                        "of a tensor-condition `if` is used after it; both "
+                        "branches must bind every name that escapes the if")
+                return out
+            return w
+
+        out = layers.cond(_to_bool_pred(pred), checked(true_fn, "other"),
+                          checked(false_fn, "true"))
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return tuple(out)
+    return true_fn() if pred else false_fn()
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars):
+    """while-statement: symbolic test lowers to layers.while_loop."""
+    test = cond_fn(*loop_vars)
+    if _is_tensor(test):
+        from ... import layers
+
+        def cond_wrap(*vs):
+            return _to_bool_pred(cond_fn(*vs))
+
+        out = layers.while_loop(cond_wrap, lambda *vs: list(body_fn(*vs)),
+                                list(loop_vars))
+        return tuple(out)
+    while test:
+        loop_vars = body_fn(*loop_vars)
+        test = cond_fn(*loop_vars)
+    return tuple(loop_vars)
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    if _is_tensor(x):
+        return _logical(x, y_fn(), "logical_and")
+    return x and y_fn()
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if _is_tensor(x):
+        y = y_fn()
+        return _logical(x, y, "logical_or")
+    return x or y_fn()
+
+
+def convert_logical_not(x):
+    if _is_tensor(x):
+        return _logical(x, None, "logical_not")
+    return not x
+
+
+def _logical(x, y, op_type):
+    from ...layer_helper import LayerHelper
+    from ... import layers
+    helper = LayerHelper(op_type)
+    x = layers.cast(x, "bool")
+    out = helper.create_variable_for_type_inference("bool")
+    if y is None:
+        helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+    else:
+        y = layers.cast(y, "bool")
+        helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [out]})
+    return out
+
+
+def convert_len(x):
+    if _is_tensor(x):
+        if x.shape and x.shape[0] >= 0:
+            return x.shape[0]
+        from ... import layers
+        return layers.shape(x)[0]
+    return len(x)

@@ -1,0 +1,311 @@
+"""StaticFunction / ProgramTranslator: run dygraph code as a static
+Program.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py — ProgramTranslator (singleton, enable()),
+StaticFunction caching by input signature, @declarative decorator.
+
+TPU-first: the built Program executes through the whole-program jit
+executor, so a converted Layer runs as ONE fused XLA computation per
+input signature — the conversion is where dygraph UX meets compiled
+performance.  Parameters stay owned by the dygraph ParamBase objects;
+each call syncs their current values into the execution scope (zero-copy
+for jax arrays) and training writes flow back.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...framework import unique_name
+from ...framework.core import (
+    Program,
+    Variable,
+    _current_tracer,
+    _set_dygraph_tracer,
+    program_guard,
+)
+from ...framework.dtype import convert_dtype
+from ...framework.scope import Scope
+from ..varbase import ParamBase, VarBase
+from .ast_transformer import DygraphToStaticAst
+
+_capture_tls = threading.local()
+
+
+class _CaptureCtx:
+    """Active static-build context: maps eager ParamBase/VarBase objects
+    to program vars and remembers them for value sync at run time."""
+
+    def __init__(self, program: Program, startup: Program):
+        self.program = program
+        self.startup = startup
+        self.value_sources: Dict[str, Any] = {}  # var name -> VarBase
+
+    def var_for(self, vb) -> Variable:
+        block = self.program.global_block()
+        if block.has_var(vb.name):
+            return block.var(vb.name)
+        shape = list(vb.shape)
+        v = block.create_var(
+            name=vb.name, shape=shape, dtype=vb.dtype, persistable=True,
+            stop_gradient=vb.stop_gradient)
+        self.value_sources[vb.name] = vb
+        return v
+
+
+def current_capture() -> Optional[_CaptureCtx]:
+    return getattr(_capture_tls, "ctx", None)
+
+
+def static_trace(type: str, inputs, outputs, attrs) -> List[Variable]:
+    """Static-mode twin of Tracer.trace_op: append the op to the program
+    under construction (dygraph layers become graph builders)."""
+    ctx = current_capture()
+    if ctx is None:
+        raise RuntimeError(
+            "dygraph layer called outside dygraph mode and outside a "
+            "to_static build — wrap the call in @declarative or "
+            "dygraph.guard()")
+    block = ctx.program.global_block()
+    in_map: Dict[str, List[str]] = {}
+    for slot, vars_ in (inputs or {}).items():
+        if vars_ is None:
+            continue
+        if not isinstance(vars_, (list, tuple)):
+            vars_ = [vars_]
+        names = []
+        for v in vars_:
+            if v is None:
+                continue
+            if isinstance(v, Variable):
+                names.append(v.name)
+            elif isinstance(v, (ParamBase, VarBase)):
+                names.append(ctx.var_for(v).name)
+            else:
+                raise TypeError(f"static_trace: bad input {v.__class__!r}")
+        in_map[slot] = names
+    if isinstance(outputs, int):
+        outputs = {"Out": outputs}
+    out_map: Dict[str, List[str]] = {}
+    out_vars: List[Variable] = []
+    ref_dtype = None
+    for names in in_map.values():
+        if names:
+            v = block._find_var_recursive(names[0])
+            if v is not None:
+                ref_dtype = v.dtype
+                break
+    for slot, spec in (outputs or {}).items():
+        n = spec if isinstance(spec, int) else len(spec)
+        vs = [block.create_var(
+            name=unique_name.generate(f"d2s_{type}_{slot.lower()}"),
+            dtype=ref_dtype or "float32", stop_gradient=False)
+            for _ in range(n)]
+        out_map[slot] = [v.name for v in vs]
+        out_vars.extend(vs)
+    block.append_op(type, inputs=in_map, outputs=out_map, attrs=dict(attrs))
+    return out_vars
+
+
+class StaticFunction:
+    """A dygraph function/method compiled per input signature.
+
+    reference: program_translator.py StaticFunction (partial_program +
+    ConcreteProgram cache)."""
+
+    def __init__(self, fn, owner=None):
+        self._fn = fn
+        self._owner = owner  # bound Layer instance for methods
+        self._ast = DygraphToStaticAst()
+        self._converted = None
+        self._cache: Dict[Tuple, dict] = {}
+        self._scope = Scope()
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return StaticFunctionBound(self, instance)
+
+    @property
+    def code(self) -> str:
+        return self._ast.get_code(self._fn)
+
+    def _get_converted(self):
+        if self._converted is None:
+            self._converted = self._ast.transform(self._fn)
+        return self._converted
+
+    def _spec(self, args) -> Tuple:
+        key = []
+        for a in args:
+            if isinstance(a, (VarBase, ParamBase)):
+                key.append(("vb", tuple(a.shape), a.dtype))
+            elif isinstance(a, np.ndarray):
+                key.append(("np", a.shape, str(a.dtype)))
+            elif isinstance(a, (int, float, bool, str, type(None))):
+                key.append(("py", a))
+            else:
+                key.append(("obj", id(a)))
+        return tuple(key)
+
+    def concrete_program(self, *args):
+        """Build (or fetch cached) the Program for this input signature."""
+        from paddle_tpu import Executor, CPUPlace
+        key = self._spec(args)
+        if key in self._cache:
+            return self._cache[key]
+        translator = ProgramTranslator()
+        main, startup = Program(), Program()
+        ctx = _CaptureCtx(main, startup)
+        old_tracer = _current_tracer()
+        feeds: List[str] = []
+        sym_args = []
+        prev_gen = unique_name.switch()
+        try:
+            _set_dygraph_tracer(None)   # static mode
+            _capture_tls.ctx = ctx
+            with program_guard(main, startup):
+                for i, a in enumerate(args):
+                    if isinstance(a, (VarBase, ParamBase, np.ndarray)):
+                        arr = np.asarray(a.numpy() if hasattr(a, "numpy")
+                                         else a)
+                        name = f"d2s_feed_{i}"
+                        main.global_block().create_var(
+                            name=name, shape=list(arr.shape),
+                            dtype=convert_dtype(arr.dtype), is_data=True,
+                            stop_gradient=True)
+                        feeds.append(name)
+                        sym_args.append(main.global_block().var(name))
+                    else:
+                        sym_args.append(a)
+                fn = self._get_converted() if translator.enabled else self._fn
+                if self._owner is not None:
+                    outs = fn(self._owner, *sym_args)
+                else:
+                    outs = fn(*sym_args)
+            out_list = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+            fetch = [o.name for o in out_list]
+        finally:
+            _capture_tls.ctx = None
+            _set_dygraph_tracer(old_tracer)
+            unique_name.switch(prev_gen)
+        entry = {"program": main, "feeds": feeds, "fetch": fetch,
+                 "ctx": ctx, "single": not isinstance(outs, (list, tuple)),
+                 "exe": Executor(CPUPlace())}
+        self._cache[key] = entry
+        return entry
+
+    def __call__(self, *args):
+        translator = ProgramTranslator()
+        if not translator.enabled:
+            if self._owner is not None:
+                return self._fn(self._owner, *args)
+            return self._fn(*args)
+        entry = self.concrete_program(*args)
+        # sync current eager param values into the scope
+        for name, vb in entry["ctx"].value_sources.items():
+            self._scope.set(name, vb._value)
+        feed = {}
+        for name, a in zip(entry["feeds"],
+                           [a for a in args
+                            if isinstance(a, (VarBase, ParamBase, np.ndarray))]):
+            feed[name] = np.asarray(a.numpy() if hasattr(a, "numpy") else a)
+        vals = entry["exe"].run(entry["program"], feed=feed,
+                                fetch_list=entry["fetch"],
+                                scope=self._scope)
+        outs = [VarBase(np.asarray(v)) for v in vals]
+        return outs[0] if entry["single"] else outs
+
+    # export ------------------------------------------------------------
+    def save_inference_model(self, dirname, *args):
+        """Build for the given example inputs and export."""
+        from ... import io as fluid_io
+        from paddle_tpu import Executor, CPUPlace
+        from ...framework import scope as scope_mod
+        entry = self.concrete_program(*args)
+        for name, vb in entry["ctx"].value_sources.items():
+            self._scope.set(name, vb._value)
+        exe = Executor(CPUPlace())
+        prev = scope_mod._global_scope
+        scope_mod._global_scope = self._scope
+        try:
+            fluid_io.save_inference_model(
+                dirname, entry["feeds"],
+                [entry["program"].global_block().var(f)
+                 for f in entry["fetch"]],
+                exe, main_program=entry["program"])
+        finally:
+            scope_mod._global_scope = prev
+
+
+class StaticFunctionBound:
+    """Method binding wrapper so `layer.forward` works per-instance."""
+
+    def __init__(self, sf: StaticFunction, instance):
+        self._sf = sf
+        self._instance = instance
+        key = f"__d2s_bound_{id(sf)}"
+        cached = getattr(instance, key, None)
+        if cached is None:
+            cached = StaticFunction(sf._fn, owner=instance)
+            setattr(instance, key, cached)
+        self._bound = cached
+
+    def __call__(self, *args):
+        return self._bound(*args)
+
+    @property
+    def code(self):
+        return self._bound.code
+
+
+def declarative(fn):
+    """@declarative / @to_static decorator.
+
+    reference: dygraph/jit.py declarative."""
+    return StaticFunction(fn)
+
+
+to_static = declarative
+
+
+class ProgramTranslator:
+    """Singleton switch + functional API.
+
+    reference: program_translator.py ProgramTranslator (get_output,
+    get_func, get_program, get_code, enable)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enabled = True
+            cls._instance._fn_cache = {}
+        return cls._instance
+
+    def enable(self, enable: bool):
+        self.enabled = bool(enable)
+
+    def _static_for(self, fn) -> StaticFunction:
+        sf = self._fn_cache.get(fn)
+        if sf is None:
+            sf = fn if isinstance(fn, StaticFunction) else StaticFunction(fn)
+            self._fn_cache[fn] = sf
+        return sf
+
+    def get_output(self, fn, *args):
+        return self._static_for(fn)(*args)
+
+    def get_func(self, fn):
+        return self._static_for(fn)
+
+    def get_program(self, fn, *args):
+        entry = self._static_for(fn).concrete_program(*args)
+        return entry["program"], entry["feeds"], entry["fetch"]
+
+    def get_code(self, fn):
+        return self._static_for(fn).code

@@ -23,6 +23,11 @@ def _tracer():
 
 
 def _trace(type, ins, n_out, attrs=None):
+    from ..framework.core import in_dygraph_mode
+    if not in_dygraph_mode():
+        # to_static build: dygraph layers become graph builders
+        from .dygraph_to_static.program_translator import static_trace
+        return static_trace(type, ins, n_out, attrs or {})
     return _tracer().trace_op(type, ins, n_out, attrs or {})
 
 

@@ -168,6 +168,8 @@ def _eager_binary(op_type, scalar_as=None):
     def impl(self, other):
         from ..framework.core import _current_tracer
 
+        if not isinstance(other, (int, float, np.ndarray, VarBase, jax.Array)):
+            return NotImplemented  # e.g. `vb == None` must not need a tracer
         tracer = _current_tracer()
         if tracer is None:
             raise RuntimeError("VarBase math requires dygraph mode")
@@ -206,6 +208,30 @@ def _install_math_ops():
             "scale", {"X": [self]}, 1, {"scale": -1.0, "bias": 0.0})[0]
 
     VarBase.__neg__ = _neg
+
+    def _cmp(op_type, jnp_fn):
+        traced = _eager_binary(op_type)
+
+        def impl(self, other):
+            if not isinstance(other,
+                              (int, float, np.ndarray, VarBase, jax.Array)):
+                return NotImplemented
+            from ..framework.core import _current_tracer
+            if _current_tracer() is None:
+                # comparisons work outside dygraph mode (no tape needed)
+                ov = other._value if isinstance(other, VarBase) else other
+                return VarBase(jnp_fn(self._value, jnp.asarray(ov)),
+                               stop_gradient=True)
+            return traced(self, other)
+        return impl
+
+    VarBase.__lt__ = _cmp("less_than", jnp.less)
+    VarBase.__le__ = _cmp("less_equal", jnp.less_equal)
+    VarBase.__gt__ = _cmp("greater_than", jnp.greater)
+    VarBase.__ge__ = _cmp("greater_equal", jnp.greater_equal)
+    VarBase.__eq__ = _cmp("equal", jnp.equal)
+    VarBase.__ne__ = _cmp("not_equal", jnp.not_equal)
+    VarBase.__hash__ = lambda self: id(self)  # __eq__ would reset it
 
     def _rsub(self, other):
         if isinstance(other, (int, float)):
