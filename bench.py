@@ -99,8 +99,48 @@ def bench_lenet(batch=256, steps=30, warmup=5):
     return batch * steps / (time.perf_counter() - t0)
 
 
+def bench_ernie(batch=16, seq=512, steps=10, warmup=3):
+    """ERNIE/BERT-base dygraph training throughput (BASELINE.json config
+    #3) — eager layers compiled into one XLA step via dygraph jit."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.dygraph import guard, jit_train_step
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    cfg = BertConfig(max_position_embeddings=max(512, seq))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    with guard():
+        model = BertForPretraining(cfg)
+        opt = fluid.optimizer.AdamOptimizer(1e-4,
+                                            parameter_list=model.parameters())
+        step = jit_train_step(model, opt,
+                              lambda m, i, l: m(i, l))
+        for _ in range(warmup):
+            loss = step(ids, labels)
+        float(np.asarray(loss.value()))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(ids, labels)
+        float(np.asarray(loss.value()))
+        dt = time.perf_counter() - t0
+    return batch * seq * steps / dt
+
+
 def main():
     model = os.environ.get("BENCH_MODEL", "resnet50")
+    if model == "ernie":
+        tps = bench_ernie(
+            batch=int(os.environ.get("BENCH_BATCH", "16")),
+            seq=int(os.environ.get("BENCH_SEQ", "512")),
+            steps=int(os.environ.get("BENCH_STEPS", "10")),
+        )
+        print(json.dumps({"metric": "ernie_base_train_tokens_per_sec_per_chip",
+                          "value": round(tps, 1), "unit": "tokens/sec",
+                          "vs_baseline": None}))
+        return
     if model == "lenet":
         ips = bench_lenet()
         print(json.dumps({"metric": "lenet_mnist_train_throughput",

@@ -1027,3 +1027,19 @@ def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
                      attrs={"shape": list(shape), "mean": float(mean),
                             "std": float(std), "seed": seed, "dtype": int(dtype)})
     return out
+
+
+def fused_multihead_attention(q, k, v, bias_qk=None, scale=0.0, causal=False,
+                              name=None):
+    """Fused scaled-dot-product attention over (b, heads, seq, head_dim)
+    tensors; lowers to the Pallas flash-attention kernel on TPU
+    (reference: operators/fused/multihead_matmul_op.cu)."""
+    helper = LayerHelper("fused_multihead_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias_qk is not None:
+        inputs["BiasQK"] = [bias_qk]
+    helper.append_op("fused_multihead_attention", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"scale": float(scale), "causal": bool(causal)})
+    return out

@@ -24,7 +24,7 @@ class BertConfig:
                  num_attention_heads=12, intermediate_size=3072,
                  max_position_embeddings=512, type_vocab_size=2,
                  hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
-                 initializer_range=0.02):
+                 initializer_range=0.02, fuse_attention=True):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -35,6 +35,10 @@ class BertConfig:
         self.hidden_dropout_prob = hidden_dropout_prob
         self.attention_probs_dropout_prob = attention_probs_dropout_prob
         self.initializer_range = initializer_range
+        # Use the fused attention op (Pallas flash kernel on TPU) when the
+        # probs-dropout is inactive; the naive composition is kept for
+        # prob-dropout training parity with the reference.
+        self.fuse_attention = fuse_attention
 
 
 def base_config(**kw):
@@ -57,8 +61,9 @@ class MultiHeadAttention(Layer):
         self.out = Linear(h, h, param_attr=_init(cfg))
         self.drop = Dropout(cfg.attention_probs_dropout_prob,
                             dropout_implementation="upscale_in_train")
+        self._fuse = cfg.fuse_attention
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, bias_qk=None):
         b, s, h = x.shape
 
         def split_heads(t):
@@ -68,13 +73,25 @@ class MultiHeadAttention(Layer):
         q = split_heads(self.q(x))
         k = split_heads(self.k(x))
         v = split_heads(self.v(x))
-        scores = F.matmul(q, k, transpose_y=True,
-                          alpha=1.0 / math.sqrt(self.d_head))
-        if attn_mask is not None:
-            scores = scores + attn_mask
-        probs = F.softmax(scores, axis=-1)
-        probs = self.drop(probs)
-        ctx = F.matmul(probs, v)
+        # Contract: bias_qk, when given, MUST be the (b, kv_seq) additive
+        # form of attn_mask (BertModel passes both derived from the same
+        # attention_mask).  The fused path substitutes bias_qk for
+        # attn_mask wholesale, so a 4D mask without its 2D form uses the
+        # naive composition.
+        drop_active = self.training and self.drop._p > 0.0
+        if (self._fuse and not drop_active
+                and (attn_mask is None or bias_qk is not None)):
+            ctx = F.fused_multihead_attention(
+                q, k, v, bias_qk=bias_qk,
+                scale=1.0 / math.sqrt(self.d_head))
+        else:
+            scores = F.matmul(q, k, transpose_y=True,
+                              alpha=1.0 / math.sqrt(self.d_head))
+            if attn_mask is not None:
+                scores = scores + attn_mask
+            probs = F.softmax(scores, axis=-1)
+            probs = self.drop(probs)
+            ctx = F.matmul(probs, v)
         ctx = F.transpose(ctx, [0, 2, 1, 3])
         ctx = F.reshape(ctx, [b, s, h])
         return self.out(ctx)
@@ -93,8 +110,8 @@ class TransformerLayer(Layer):
         self.drop = Dropout(cfg.hidden_dropout_prob,
                             dropout_implementation="upscale_in_train")
 
-    def forward(self, x, attn_mask=None):
-        a = self.attn(x, attn_mask)
+    def forward(self, x, attn_mask=None, bias_qk=None):
+        a = self.attn(x, attn_mask, bias_qk=bias_qk)
         x = self.ln1(x + self.drop(a))
         f = self.fc2(self.fc1(x))
         x = self.ln2(x + self.drop(f))
@@ -132,13 +149,14 @@ class BertModel(Layer):
         emb = (self.word_emb(input_ids) + self.pos_emb(position_ids)
                + self.type_emb(token_type_ids))
         x = self.emb_drop(self.emb_ln(emb))
-        mask = None
+        mask = bias2d = None
         if attention_mask is not None:
-            # [b, s] 1/0 -> additive [b, 1, 1, s]
-            mask = (1.0 - attention_mask) * -10000.0
-            mask = F.unsqueeze(F.unsqueeze(mask, [1]), [1])
+            # [b, s] 1/0 -> additive [b, 1, 1, s]; the 2D form feeds the
+            # fused attention op directly.
+            bias2d = (1.0 - attention_mask) * -10000.0
+            mask = F.unsqueeze(F.unsqueeze(bias2d, [1]), [1])
         for layer in self.encoder:
-            x = layer(x, mask)
+            x = layer(x, mask, bias_qk=bias2d)
         pooled = self.pooler(x[:, 0])
         return x, pooled
 

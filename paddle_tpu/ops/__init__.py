@@ -26,3 +26,4 @@ from . import ps_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import fused_ops  # noqa: F401

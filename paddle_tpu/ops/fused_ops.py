@@ -1,0 +1,29 @@
+"""Fused ops (the analog of paddle/fluid/operators/fused/).
+
+The reference fuses attention as `multihead_matmul`
+(operators/fused/multihead_matmul_op.cu) and ships fused
+bias+activation / bn+activation kernels; on TPU XLA already fuses the
+elementwise epilogues into the matmuls, so the only hand-written kernel
+we need is flash attention (ops/pallas_kernels.py).
+"""
+from __future__ import annotations
+
+from .registry import op
+from .pallas_kernels import flash_attention
+
+
+@op("fused_multihead_attention")
+def _fused_mha(ctx):
+    """Q/K/V: (batch, heads, seq, head_dim).  Optional BiasQK: additive
+    padding mask (b, kv) or (b,1,1,kv).  Attrs: scale (0 -> 1/sqrt(d)),
+    causal.  Reference: operators/fused/multihead_matmul_op.cu (fused
+    inference attention); here it serves training too via the Pallas
+    flash kernel's custom VJP."""
+    q = ctx.in_("Q")
+    k = ctx.in_("K")
+    v = ctx.in_("V")
+    bias = ctx.in_("BiasQK") if ctx.has_input("BiasQK") else None
+    scale = ctx.attr("scale", 0.0) or None
+    causal = ctx.attr("causal", False)
+    ctx.set_out("Out", flash_attention(q, k, v, bias=bias, causal=causal,
+                                       scale=scale))

@@ -1,0 +1,434 @@
+"""Hand-written Pallas TPU kernels for the hot fused ops.
+
+The reference ships hand-fused CUDA kernels for exactly these spots
+(reference: paddle/fluid/operators/fused/multihead_matmul_op.cu,
+fused/fused_bn_activation_op.cu, operators/math/bert_encoder_functor.cu);
+on TPU the only ones XLA does not already fuse well are the
+memory-bound attention inner loop, so we implement flash attention
+(forward + backward) as Pallas kernels and let XLA handle the rest.
+
+Kernel design (see /opt/skills/guides/pallas_guide.md):
+* Q/K/V laid out ``(batch, heads, seq, head_dim)``; grid is
+  ``(b, h, q_blocks, kv_blocks)`` with the kv axis innermost so the TPU's
+  sequential grid walk accumulates the online softmax in VMEM scratch.
+* Row statistics (running max / sum) are kept lane-broadcast at width
+  128 (the TPU lane count) so every store is tile-aligned.
+* head_dim is passed through un-padded: Mosaic accepts a block whose
+  last dim equals the full array dim (it pads lanes internally), and
+  measurement showed explicit zero-padding to 128 buys nothing.
+  head_dim must be a multiple of 8 (sublane) — anything else falls back.
+* The backward pass recomputes S = QK^T per block from the saved
+  log-sum-exp (the flash-attention trick), with separate kernels for
+  dQ (kv innermost) and dK/dV (q innermost).
+
+CPU fallback: a numerically identical jnp composition (used under
+``interpret``-less CPU execution and as the test oracle).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pragma: no cover - import guard for non-TPU-capable builds
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+LANES = 128
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _interpret() -> bool:
+    """Run kernels in interpreter mode (CPU testing of the real kernel)."""
+    return os.environ.get("PT_PALLAS_INTERPRET", "0") == "1"
+
+
+def _use_pallas() -> bool:
+    if pltpu is None:
+        return False
+    if _interpret():
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _pick_block(seq: int, candidates=(512, 256, 128)) -> int | None:
+    for c in candidates:
+        if seq % c == 0:
+            return c
+    return None
+
+
+# ==========================================================================
+# Reference (jnp) implementation — the oracle and the fallback
+# ==========================================================================
+def attention_reference(q, k, v, bias=None, causal=False, scale=1.0):
+    """bias: additive, shape (b, kv_seq) or broadcastable (b,1,1,kv)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        b2 = _normalize_bias(bias)
+        s = s + b2[:, None, None, :].astype(s.dtype)
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), bool))
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _normalize_bias(bias):
+    """Accept (b, kv), (b,1,1,kv) or (b,1,kv); return (b, kv)."""
+    if bias.ndim == 2:
+        return bias
+    if bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1:
+        return bias[:, 0, 0, :]
+    if bias.ndim == 3 and bias.shape[1] == 1:
+        return bias[:, 0, :]
+    raise ValueError(f"unsupported attention bias shape {bias.shape}")
+
+
+# ==========================================================================
+# Forward kernel
+# ==========================================================================
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                n_kv):
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    q = q_ref[0, 0]                                   # (bq, d)
+    k = k_ref[0, 0]                                   # (bk, d)
+    v = v_ref[0, 0]
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)       # (1, bk) broadcasts
+    if causal:
+        qi = pl.program_id(2)
+        rows = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+
+    m_prev = m_scr[...]                               # (bq, 128) lane-bcast
+    l_prev = l_scr[...]
+    m_cur = jnp.max(s, axis=1, keepdims=True)         # (bq, 1)
+    m_next = jnp.maximum(m_prev, m_cur)               # (bq, 128)
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - m_next[:, :1])                    # (bq, bk)
+    l_next = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha[:, :1] + lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[...] = m_next
+    l_scr[...] = l_next
+
+    @pl.when(ki == n_kv - 1)
+    def _done():
+        l_fin = l_scr[...]
+        l_safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        o_ref[0, 0] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nq, nk = sq // block_q, sk // block_k
+    grid = (b, h, nq, nk)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_k),
+                         lambda ib, ih, iq, ik: (ib, 0, ik)))
+        args.append(bias[:, None, :])
+    kernel = functools.partial(
+        _fwd_kernel if bias is not None else _fwd_kernel_nobias,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        n_kv=nk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return out, lse
+
+
+def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_scr, l_scr, acc_scr, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, **kw)
+
+
+# ==========================================================================
+# Backward kernels
+# ==========================================================================
+def _bwd_dq_kernel(q_ref, k_ref, do_ref, lse_ref, delta_ref, bias_ref,
+                   v_ref, dq_ref, dq_scr, *, scale, causal, block_q,
+                   block_k, n_kv):
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]                               # (bq, 128)
+    delta = delta_ref[0, 0]                           # (bq, 128)
+
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    if causal:
+        qi = pl.program_id(2)
+        rows = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+    p = jnp.exp(s - lse[:, :1])                       # (bq, bk)
+    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, :1]) * scale              # (bq, bk)
+    dq_scr[...] += lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kv - 1)
+    def _done():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dq_kernel_nobias(q_ref, k_ref, do_ref, lse_ref, delta_ref,
+                          v_ref, dq_ref, dq_scr, **kw):
+    _bwd_dq_kernel(q_ref, k_ref, do_ref, lse_ref, delta_ref, None,
+                   v_ref, dq_ref, dq_scr, **kw)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    bias_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
+                    causal, block_q, block_k, n_q):
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    q = q_ref[0, 0]                                   # (bq, d)
+    k = k_ref[0, 0]                                   # (bk, d)
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    if causal:
+        ik = pl.program_id(2)
+        rows = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = ik * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+    p = jnp.exp(s - lse[:, :1])                       # (bq, bk)
+    # dV += P^T dO   (contract over bq)
+    dv_scr[...] += lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, :1]) * scale
+    # dK += dS^T Q   (contract over bq)
+    dk_scr[...] += lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _done():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dkv_kernel_nobias(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_scr, dv_scr, **kw):
+    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
+                    dk_ref, dv_ref, dk_scr, dv_scr, **kw)
+
+
+def _flash_bwd(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nq, nk = sq // block_q, sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, LANES))
+
+    # --- dQ: grid (b, h, nq, nk), kv innermost ---------------------------
+    def _q_idx(ib, ih, iq, ik):
+        return (ib, ih, iq, 0)
+
+    def _kv_idx(ib, ih, iq, ik):
+        return (ib, ih, ik, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), _q_idx),       # q
+        pl.BlockSpec((1, 1, block_k, d), _kv_idx),      # k
+        pl.BlockSpec((1, 1, block_q, d), _q_idx),       # do
+        pl.BlockSpec((1, 1, block_q, LANES), _q_idx),   # lse
+        pl.BlockSpec((1, 1, block_q, LANES), _q_idx),   # delta
+    ]
+    args = [q, k, do, lse, delta]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda ib, ih, iq, ik: (ib, 0, ik)))
+        args.append(bias[:, None, :])
+    in_specs.append(pl.BlockSpec((1, 1, block_k, d), _kv_idx))  # v
+    args.append(v)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel if bias is not None else _bwd_dq_kernel_nobias,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            n_kv=nk),
+        grid=(b, h, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, d), _q_idx),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*args)
+
+    # --- dK/dV: grid (b, h, nk, nq), q innermost -------------------------
+    def _q_idx2(ib, ih, ik, iq):
+        return (ib, ih, iq, 0)
+
+    def _kv_idx2(ib, ih, ik, iq):
+        return (ib, ih, ik, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), _q_idx2),      # q
+        pl.BlockSpec((1, 1, block_k, d), _kv_idx2),     # k
+        pl.BlockSpec((1, 1, block_k, d), _kv_idx2),     # v
+        pl.BlockSpec((1, 1, block_q, d), _q_idx2),      # do
+        pl.BlockSpec((1, 1, block_q, LANES), _q_idx2),  # lse
+        pl.BlockSpec((1, 1, block_q, LANES), _q_idx2),  # delta
+    ]
+    args = [q, k, v, do, lse, delta]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda ib, ih, ik, iq: (ib, 0, ik)))
+        args.append(bias[:, None, :])
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel if bias is not None else _bwd_dkv_kernel_nobias,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            n_q=nq),
+        grid=(b, h, nk, nq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), _kv_idx2),
+            pl.BlockSpec((1, 1, block_k, d), _kv_idx2),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return dq, dk, dv
+
+
+# ==========================================================================
+# custom_vjp wrapper
+# ==========================================================================
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_core(q, k, v, bias, scale, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_core_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_core_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, bias, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, bias, out, lse, do, scale, causal,
+                            block_q, block_k)
+    # The bias is a padding mask, treated as a CONSTANT: computing its true
+    # gradient would require materializing dense (b,h,sq,sk) dS tensors,
+    # defeating the flash kernel's memory savings on every masked step.
+    # A trainable attention bias must use the unfused composition.
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+_flash_attention_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None):
+    """Fused scaled-dot-product attention.
+
+    q/k/v: (batch, heads, seq, head_dim); bias: additive padding mask,
+    shape (b, kv_seq) / (b,1,1,kv_seq), or None.  Uses the Pallas flash
+    kernel on TPU when the sequence is long enough for it to win
+    (measured crossover ~1024 on v5e; XLA's own fusion is better below
+    that); falls back to the jnp composition elsewhere.
+    PT_FLASH_ATTENTION=1 forces the kernel, =0 disables it.
+
+    On the kernel path the bias receives a zero gradient (it is a
+    padding mask, not a parameter); the fallback path differentiates it
+    normally.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if bias is not None:
+        bias = _normalize_bias(bias)
+    block_q = _pick_block(sq)
+    block_k = _pick_block(sk)
+    force = os.environ.get("PT_FLASH_ATTENTION")
+    worth_it = sq >= 1024 if force is None else force == "1"
+    if (not _use_pallas() or block_q is None or block_k is None
+            or not worth_it or d % 8 != 0):
+        return attention_reference(q, k, v, bias, causal, scale)
+    return _flash_attention_core(q, k, v, bias, scale, causal,
+                                 block_q, block_k)

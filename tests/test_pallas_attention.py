@@ -1,0 +1,163 @@
+"""Flash-attention Pallas kernel + fused_multihead_attention op tests.
+
+The real kernel is exercised in Pallas interpreter mode on the CPU
+backend (PT_PALLAS_INTERPRET=1) against the jnp composition oracle —
+the OpTest multi-backend pattern applied to a hand-written kernel
+(reference test analog: test_fused_multihead_matmul_op.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.layers as L
+from paddle_tpu.ops.pallas_kernels import attention_reference, flash_attention
+
+
+def _rand_qkv(b=2, h=3, s=128, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, h, s, d).astype(np.float32)
+    bias = np.where(rng.rand(b, s) > 0.25, 0.0, -10000.0).astype(np.float32)
+    return mk(), mk(), mk(), bias
+
+
+@pytest.fixture
+def interpret_kernel(monkeypatch):
+    monkeypatch.setenv("PT_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("PT_FLASH_ATTENTION", "1")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_kernel_forward_parity(interpret_kernel, causal, with_bias):
+    import jax.numpy as jnp
+
+    q, k, v, bias = _rand_qkv()
+    bi = bias if with_bias else None
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          bias=None if bi is None else jnp.asarray(bi),
+                          causal=causal)
+    ref = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              None if bi is None else jnp.asarray(bi),
+                              causal, 1.0 / np.sqrt(q.shape[-1]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_kernel_grad_parity(interpret_kernel):
+    import jax
+    import jax.numpy as jnp
+
+    q, k, v, bias = _rand_qkv(seed=3)
+    q, k, v, bias = map(jnp.asarray, (q, k, v, bias))
+    ct = jnp.asarray(np.random.RandomState(9).randn(*q.shape).astype(np.float32))
+
+    def loss(f):
+        return lambda q, k, v, b: jnp.sum(f(q, k, v, b) * ct)
+
+    fa = loss(lambda q, k, v, b: flash_attention(q, k, v, bias=b, causal=True))
+    rf = loss(lambda q, k, v, b: attention_reference(
+        q, k, v, b, True, 1.0 / np.sqrt(q.shape[-1])))
+    g1 = jax.grad(fa, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g2 = jax.grad(rf, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for name, a, b in zip("qkv", g1[:3], g2[:3]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-2, atol=5e-4, err_msg=name)
+    # kernel path treats the padding mask as constant: zero gradient
+    assert float(jnp.max(jnp.abs(g1[3]))) == 0.0
+
+
+def test_fused_op_static_graph_matches_naive_composition():
+    """fused_multihead_attention == matmul/softmax/matmul composition,
+    forward and backward, through the static-graph executor."""
+    import paddle_tpu.fluid as fluid
+
+    b, h, s, d = 2, 2, 64, 16
+    rng = np.random.RandomState(1)
+    qv = rng.randn(b, h, s, d).astype(np.float32)
+    kv = rng.randn(b, h, s, d).astype(np.float32)
+    vv = rng.randn(b, h, s, d).astype(np.float32)
+    bias = np.where(rng.rand(b, s) > 0.3, 0.0, -10000.0).astype(np.float32)
+
+    def run(fused):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            q = L.data("q", [h, s, d])
+            k = L.data("k", [h, s, d])
+            v = L.data("v", [h, s, d])
+            m = L.data("m", [s])
+            q.stop_gradient = False
+            k.stop_gradient = False
+            v.stop_gradient = False
+            if fused:
+                out = L.fused_multihead_attention(q, k, v, bias_qk=m,
+                                                  scale=1.0 / np.sqrt(d))
+            else:
+                sc = L.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(d))
+                sc = sc + L.reshape(m, [b, 1, 1, s])
+                p = L.softmax(sc, axis=-1)
+                out = L.matmul(p, v)
+            loss = L.reduce_mean(out)
+            grads = pt.gradients([loss], [q, k, v])
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        fetches = exe.run(main, feed={"q": qv, "k": kv, "v": vv, "m": bias},
+                          fetch_list=[out.name] + [g.name for g in grads])
+        return fetches
+
+    fused = run(True)
+    naive = run(False)
+    for f, n in zip(fused, naive):
+        np.testing.assert_allclose(f, n, rtol=1e-4, atol=1e-5)
+
+
+def test_bert_uses_fused_attention():
+    """BertModel with fuse_attention traces a fused_multihead_attention op
+    and matches the unfused model's loss (dropout off)."""
+    from paddle_tpu.dygraph import guard
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 100, (2, 64)).astype(np.int64)
+    labels = rng.randint(0, 100, (2, 64)).astype(np.int64)
+    mask = (rng.rand(2, 64) > 0.2).astype(np.float32)
+
+    from paddle_tpu.ops.registry import OPS
+
+    fused_calls = {True: 0, False: 0}
+    orig_lower = OPS["fused_multihead_attention"].lower
+
+    losses = {}
+    for fuse in (True, False):
+        cfg = BertConfig(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                         num_attention_heads=2, intermediate_size=64,
+                         max_position_embeddings=64,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0,
+                         fuse_attention=fuse)
+        def counting_lower(ctx, _fuse=fuse):
+            fused_calls[_fuse] += 1
+            return orig_lower(ctx)
+
+        OPS["fused_multihead_attention"].lower = counting_lower
+        try:
+            with guard():
+                np.random.seed(7)
+                from paddle_tpu.dygraph import to_variable
+
+                model = BertForPretraining(cfg)
+                sd = model.state_dict()
+                if "ref" not in losses:
+                    losses["ref"] = {k: np.asarray(v.value())
+                                     for k, v in sd.items()}
+                else:
+                    model.set_dict({k: losses["ref"][k] for k in sd})
+                loss = model(to_variable(ids), to_variable(labels),
+                             attention_mask=to_variable(mask))
+                losses[fuse] = float(np.asarray(loss.value()))
+        finally:
+            OPS["fused_multihead_attention"].lower = orig_lower
+    assert fused_calls[True] == cfg.num_hidden_layers, fused_calls
+    assert fused_calls[False] == 0, fused_calls
+    assert np.isclose(losses[True], losses[False], rtol=1e-4), losses
