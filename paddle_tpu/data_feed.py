@@ -1,0 +1,547 @@
+"""Industrial dataset ingestion: DatasetFactory / InMemoryDataset /
+QueueDataset over the multi-slot text format.
+
+Capability parity with the reference's Dataset stack
+(reference: python/paddle/fluid/dataset.py DatasetFactory/InMemoryDataset/
+QueueDataset; paddle/fluid/framework/data_feed.cc MultiSlotDataFeed,
+data_set.cc DatasetImpl LoadIntoMemory/LocalShuffle/GlobalShuffle —
+GlobalShuffle redistributes instances across trainers via FleetWrapper
+RPC, data_set.h:157-205).  TPU-first redesign: parsing stays on the host
+CPU in native C++ (native/data_feed.cpp), batches come out as static-shape
+padded arrays (sparse slots pad to a power-of-two bucket so XLA compiles a
+handful of shapes, not one per batch), and global shuffle rides the PS
+service's blob channel instead of a bespoke RPC stack.
+
+Feed convention per slot (var passed to set_use_var):
+* dense slot  (float dtype): feeds ``name`` as float32 [B, dim].
+* sparse slot (int dtype):   feeds ``name`` as int64 [B, T] padded with 0
+  and ``name + ".lens"`` as int64 [B] true lengths (the padded+length
+  LoD representation used across the framework, SURVEY.md §7 hard-part 1).
+"""
+from __future__ import annotations
+
+import ctypes
+import io as _io
+import random
+import subprocess
+import threading
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .framework.core import Variable
+from .framework.dtype import to_numpy_dtype
+
+
+# --------------------------------------------------------------------------
+# slot spec + native parser binding
+# --------------------------------------------------------------------------
+class SlotDesc:
+    __slots__ = ("name", "is_sparse", "dim", "dtype", "ragged")
+
+    def __init__(self, name, is_sparse, dim, dtype, ragged=False):
+        self.name = name
+        self.is_sparse = is_sparse
+        self.dim = dim
+        self.dtype = dtype
+        # ragged (lod_level>0) sparse slots pad to a bucketed per-batch
+        # max; fixed sparse slots pad to the declared dim
+        self.ragged = ragged
+
+
+def _slot_from_var(var) -> SlotDesc:
+    np_dtype = to_numpy_dtype(var.dtype) if var.dtype is not None else np.float32
+    sparse = np.issubdtype(np_dtype, np.integer)
+    dims = [d for d in var.shape if d not in (-1, None)]
+    dim = int(np.prod(dims)) if dims else 1
+    ragged = getattr(var, "lod_level", 0) > 0
+    return SlotDesc(var.name, sparse, dim, np_dtype, ragged)
+
+
+class _Native:
+    _lib = None
+    _failed = False
+
+    @classmethod
+    def get(cls):
+        if cls._lib is None and not cls._failed:
+            try:
+                from .native.build import load_library
+
+                lib = load_library("data_feed")
+                i64p = ctypes.POINTER(ctypes.c_int64)
+                lib.msf_count.restype = ctypes.c_int64
+                lib.msf_count.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, i64p]
+                lib.msf_fill.restype = ctypes.c_int64
+                lib.msf_fill.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.POINTER(ctypes.c_int8),
+                    ctypes.POINTER(i64p), ctypes.POINTER(i64p),
+                    ctypes.POINTER(ctypes.POINTER(ctypes.c_float))]
+                cls._lib = lib
+            except Exception:
+                cls._failed = True
+        return cls._lib
+
+
+def parse_multislot(data: bytes, slots: Sequence[SlotDesc]):
+    """bytes -> per-slot (lens int64[N], flat values).
+
+    Native fast path; pure-Python fallback keeps the subsystem alive on
+    hosts without a toolchain."""
+    lib = _Native.get()
+    n = len(slots)
+    if lib is not None:
+        totals = np.zeros(n, np.int64)
+        nrec = lib.msf_count(data, len(data), n,
+                             totals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if nrec < 0:
+            raise ValueError("malformed multi-slot record")
+        lens = [np.zeros(nrec, np.int64) for _ in range(n)]
+        ivals = [np.zeros(totals[i] if slots[i].is_sparse else 0, np.int64)
+                 for i in range(n)]
+        fvals = [np.zeros(0 if slots[i].is_sparse else totals[i], np.float32)
+                 for i in range(n)]
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lens_arr = (i64p * n)(*[a.ctypes.data_as(i64p) for a in lens])
+        ival_arr = (i64p * n)(*[a.ctypes.data_as(i64p) for a in ivals])
+        fval_arr = (f32p * n)(*[a.ctypes.data_as(f32p) for a in fvals])
+        sparse_flags = (ctypes.c_int8 * n)(*[1 if s.is_sparse else 0
+                                             for s in slots])
+        got = lib.msf_fill(data, len(data), n, sparse_flags, lens_arr,
+                           ival_arr, fval_arr)
+        if got != nrec:
+            raise ValueError("malformed multi-slot record")
+        vals = [ivals[i] if slots[i].is_sparse else fvals[i] for i in range(n)]
+        return nrec, lens, vals
+    # fallback — same malformed-line contract as the native parser
+    lens = [[] for _ in range(n)]
+    vals = [[] for _ in range(n)]
+    nrec = 0
+    for line in data.splitlines():
+        toks = line.split()
+        if not toks:
+            continue
+        pos = 0
+        try:
+            for i, s in enumerate(slots):
+                cnt = int(toks[pos]); pos += 1
+                if cnt < 0 or pos + cnt > len(toks):
+                    raise ValueError
+                conv = int if s.is_sparse else float
+                vals[i].extend(conv(t) for t in toks[pos:pos + cnt])
+                pos += cnt
+                lens[i].append(cnt)
+        except (ValueError, IndexError):
+            raise ValueError("malformed multi-slot record") from None
+        nrec += 1
+    return (nrec,
+            [np.asarray(l, np.int64) for l in lens],
+            [np.asarray(v, np.int64 if s.is_sparse else np.float32)
+             for v, s in zip(vals, slots)])
+
+
+def _next_pow2(x: int) -> int:
+    p = 1
+    while p < x:
+        p *= 2
+    return p
+
+
+def _split_records(nrec: int, lens, vals):
+    """Columnar (per-slot lens + flat values) -> list of per-record
+    tuples of small arrays."""
+    records = []
+    offs = [0] * len(lens)
+    for r in range(nrec):
+        rec = []
+        for i in range(len(lens)):
+            l = int(lens[i][r])
+            rec.append(vals[i][offs[i]:offs[i] + l])
+            offs[i] += l
+        records.append(tuple(rec))
+    return records
+
+
+# --------------------------------------------------------------------------
+# DataFeedDesc — textual config (reference: data_feed.proto + DataFeedDesc
+# python/paddle/fluid/data_feed_desc.py)
+# --------------------------------------------------------------------------
+class DataFeedDesc:
+    def __init__(self, proto_file: Optional[str] = None):
+        self.batch_size = 32
+        self.slots: List[SlotDesc] = []
+        self.pipe_command = "cat"
+        self._used: Optional[set] = None
+        if proto_file:
+            self._parse_proto(proto_file)
+
+    def _parse_proto(self, proto_file: str):
+        """Minimal textual-proto reader for the reference's
+        data_feed.proto slot fields (name/type/is_dense)."""
+        cur = None
+        with open(proto_file) as f:
+            for raw in f:
+                line = raw.strip()
+                if line.startswith("batch_size:"):
+                    self.batch_size = int(line.split(":")[1])
+                elif line.startswith("slots {"):
+                    cur = {}
+                elif cur is not None and line.startswith("name:"):
+                    cur["name"] = line.split('"')[1]
+                elif cur is not None and line.startswith("type:"):
+                    cur["type"] = line.split('"')[1]
+                elif cur is not None and line.startswith("is_dense:"):
+                    cur["dense"] = "true" in line
+                elif cur is not None and line.startswith("}"):
+                    sparse = not cur.get("dense", False) or \
+                        "int" in cur.get("type", "")
+                    self.slots.append(SlotDesc(
+                        cur.get("name", f"slot_{len(self.slots)}"), sparse, 1,
+                        np.int64 if sparse else np.float32, ragged=sparse))
+                    cur = None
+
+    def set_batch_size(self, bs):
+        self.batch_size = bs
+
+    def set_use_slots(self, use_slots: Sequence[str]):
+        self._used = set(use_slots)
+
+    def set_dense_slots(self, names: Sequence[str]):
+        for s in self.slots:
+            if s.name in names:
+                s.is_sparse = False
+                s.dtype = np.float32
+                s.ragged = False
+
+    def used_slots(self) -> List[SlotDesc]:
+        if self._used is None:
+            return self.slots
+        return [s for s in self.slots if s.name in self._used]
+
+    def desc(self) -> str:
+        lines = ["name: \"MultiSlotDataFeed\"",
+                 f"batch_size: {self.batch_size}", "multi_slot_desc {"]
+        for s in self.slots:
+            used = self._used is None or s.name in self._used
+            lines += ["  slots {", f"    name: \"{s.name}\"",
+                      f"    type: \"{'uint64' if s.is_sparse else 'float'}\"",
+                      f"    is_dense: {'false' if s.is_sparse else 'true'}",
+                      f"    is_used: {'true' if used else 'false'}", "  }"]
+        lines.append("}")
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# Datasets
+# --------------------------------------------------------------------------
+class DatasetBase:
+    """reference: fluid/dataset.py DatasetBase."""
+
+    def __init__(self):
+        self.proto_desc = DataFeedDesc()
+        self.filelist: List[str] = []
+        self.thread_num = 1
+        self.use_vars: List[Variable] = []
+        self.slots: List[SlotDesc] = []
+        self.pad_seq_len: Optional[int] = None
+        self._hdfs_config = None
+        self.drop_last = False
+
+    # -- reference setter surface ---------------------------------------
+    def set_batch_size(self, batch_size):
+        self.proto_desc.set_batch_size(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+        self.slots = [_slot_from_var(v) for v in var_list]
+        self.proto_desc.slots = self.slots
+
+    def set_pipe_command(self, pipe_command):
+        self.proto_desc.pipe_command = pipe_command
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self._hdfs_config = (fs_name, fs_ugi)
+
+    def set_pad_seq_len(self, pad_seq_len):
+        """TPU extension: fixed pad length for sparse slots (otherwise the
+        per-batch max bucketed to a power of two — bounded recompiles)."""
+        self.pad_seq_len = pad_seq_len
+
+    def desc(self):
+        return self.proto_desc.desc()
+
+    # -- ingestion ------------------------------------------------------
+    def _read_file(self, fname: str) -> bytes:
+        cmd = self.proto_desc.pipe_command
+        if cmd and cmd != "cat":
+            with open(fname, "rb") as f:
+                out = subprocess.run(cmd, shell=True, stdin=f,
+                                     capture_output=True, check=True)
+            return out.stdout
+        with open(fname, "rb") as f:
+            return f.read()
+
+    def _parse_file(self, fname: str):
+        """file -> list of records; record = tuple of per-slot value
+        arrays kept small for shuffling."""
+        nrec, lens, vals = parse_multislot(self._read_file(fname), self.slots)
+        return _split_records(nrec, lens, vals)
+
+    def _records_to_feed(self, records) -> Dict[str, np.ndarray]:
+        feed: Dict[str, np.ndarray] = {}
+        B = len(records)
+        for i, s in enumerate(self.slots):
+            if s.is_sparse:
+                lens = np.asarray([len(r[i]) for r in records], np.int64)
+                pad = self.pad_seq_len
+                if isinstance(pad, dict):
+                    pad = pad.get(s.name)
+                if pad:
+                    T = int(pad)
+                elif not s.ragged:
+                    T = s.dim
+                else:
+                    T = _next_pow2(max(1, int(lens.max())))
+                ids = np.zeros((B, T), np.int64)
+                for b, r in enumerate(records):
+                    k = min(len(r[i]), T)
+                    ids[b, :k] = r[i][:k]
+                feed[s.name] = ids
+                feed[s.name + ".lens"] = np.minimum(lens, T)
+            else:
+                arr = np.zeros((B, s.dim), np.float32)
+                for b, r in enumerate(records):
+                    k = min(len(r[i]), s.dim)
+                    arr[b, :k] = r[i][:k]
+                feed[s.name] = arr
+        return feed
+
+    def _batched(self, records):
+        bs = self.proto_desc.batch_size
+        for i in range(0, len(records), bs):
+            chunk = records[i:i + bs]
+            if self.drop_last and len(chunk) < bs:
+                return
+            yield self._records_to_feed(chunk)
+
+    def _iter_batches(self):
+        raise NotImplementedError
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: parse files on the fly (reference:
+    fluid/dataset.py QueueDataset; C++ MultiSlotDataFeed channel path)."""
+
+    def _iter_batches(self):
+        if not self.slots:
+            raise RuntimeError("call set_use_var before iterating")
+        with ThreadPoolExecutor(self.thread_num) as pool:
+            for records in pool.map(self._parse_file, self.filelist):
+                yield from self._batched(records)
+
+    def local_shuffle(self):
+        raise RuntimeError(
+            "QueueDataset does not support shuffle — use InMemoryDataset")
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        raise RuntimeError(
+            "QueueDataset does not support shuffle — use InMemoryDataset")
+
+
+class InMemoryDataset(DatasetBase):
+    """reference: fluid/dataset.py InMemoryDataset; C++ InMemoryDataFeed +
+    DatasetImpl (data_set.h:157-205)."""
+
+    def __init__(self):
+        super().__init__()
+        self.memory: List[tuple] = []
+        self._preload: Optional[threading.Thread] = None
+        self._rng = random.Random(0)
+        self.fleet_send_batch_size = 1024
+        self.merge_by_lineid = False
+
+    def set_fleet_send_batch_size(self, n=1024):
+        self.fleet_send_batch_size = n
+
+    def set_queue_num(self, n):  # channel tuning knob — no-op here
+        pass
+
+    def set_merge_by_lineid(self, merge_size=2):
+        self.merge_by_lineid = True
+
+    # -- load -----------------------------------------------------------
+    def load_into_memory(self):
+        if not self.slots:
+            raise RuntimeError("call set_use_var before load_into_memory")
+        self.memory = []
+        with ThreadPoolExecutor(self.thread_num) as pool:
+            for recs in pool.map(self._parse_file, self.filelist):
+                self.memory.extend(recs)
+
+    def preload_into_memory(self, thread_num=None):
+        if thread_num:
+            self.set_thread(thread_num)
+        self._preload = threading.Thread(target=self.load_into_memory,
+                                         daemon=True)
+        self._preload.start()
+
+    def wait_preload_done(self):
+        if self._preload is not None:
+            self._preload.join()
+            self._preload = None
+
+    def release_memory(self):
+        self.memory = []
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        n = len(self.memory)
+        if fleet is not None:
+            return int(_fleet_allreduce_sum(fleet, n))
+        return n
+
+    get_shuffle_data_size = get_memory_data_size
+
+    # -- shuffles -------------------------------------------------------
+    def local_shuffle(self):
+        self._rng.shuffle(self.memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Redistribute instances across trainers, then shuffle locally.
+
+        reference: data_set.cc DatasetImpl::GlobalShuffle — each instance
+        is routed to trainer hash(instance) % n and shipped via
+        FleetWrapper RPC.  Here the shards ride the PS service blob
+        channel (distributed_ps/service.py) and a PS-side barrier
+        delimits the exchange."""
+        if fleet is None:
+            self.local_shuffle()
+            return
+        client, my_id, n_trainers = _fleet_channel(fleet)
+        if n_trainers <= 1 or client is None:
+            self.local_shuffle()
+            return
+        shards: List[List[tuple]] = [[] for _ in range(n_trainers)]
+        for rec in self.memory:
+            key = zlib.crc32(rec[0].tobytes() if len(rec) else b"")
+            shards[key % n_trainers].append(rec)
+        for dst in range(n_trainers):
+            blob = _pack_records(shards[dst], self.slots)
+            client.blob_put(f"__shuffle__.{dst}", blob)
+        client.barrier()
+        mine = client.blob_take(f"__shuffle__.{my_id}")
+        self.memory = []
+        for blob in mine:
+            self.memory.extend(_unpack_records(blob, self.slots))
+        client.barrier()
+        self._rng.shuffle(self.memory)
+
+    # -- iterate --------------------------------------------------------
+    def _iter_batches(self):
+        self.wait_preload_done()
+        yield from self._batched(self.memory)
+
+
+class FileInstantDataset(QueueDataset):
+    """reference: fluid/dataset.py FileInstantDataset — streaming variant."""
+
+
+class BoxPSDataset(InMemoryDataset):
+    """API shell for the BoxPS path (reference: fluid/dataset.py
+    BoxPSDataset; framework/fleet/box_wrapper.h — external BoxPS dep is
+    out of scope per SURVEY.md §2.5)."""
+
+    def begin_pass(self):
+        pass
+
+    def end_pass(self):
+        pass
+
+
+class DatasetFactory:
+    """reference: fluid/dataset.py DatasetFactory.create_dataset."""
+
+    _registry = {
+        "InMemoryDataset": InMemoryDataset,
+        "QueueDataset": QueueDataset,
+        "FileInstantDataset": FileInstantDataset,
+        "BoxPSDataset": BoxPSDataset,
+    }
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        try:
+            return self._registry[datafeed_class]()
+        except KeyError:
+            raise ValueError(f"unknown dataset type {datafeed_class!r}")
+
+
+# --------------------------------------------------------------------------
+# fleet plumbing for global shuffle
+# --------------------------------------------------------------------------
+def _fleet_channel(fleet):
+    """(ps_client, trainer_id, n_trainers) from a Fleet instance or the
+    ambient PS runtime."""
+    client = getattr(fleet, "_ps_client", None)
+    tid = getattr(fleet, "_trainer_id", None)
+    if client is None or tid is None:
+        from .distributed_ps import runtime
+
+        client = client or runtime.client()
+        tid = runtime.trainer_id() if tid is None else tid
+    n = getattr(fleet, "worker_num", None)
+    n_trainers = n() if callable(n) else (n or 1)
+    return client, tid, int(n_trainers)
+
+
+def _fleet_allreduce_sum(fleet, value: int):
+    client, my_id, n = _fleet_channel(fleet)
+    if client is None or n <= 1:
+        return value
+    # round-unique key: a trainer ahead in round k+1 must not blob_put into
+    # the key a slow trainer is still blob_take-ing from round k (all
+    # trainers call collectives in the same order, so rounds agree)
+    rnd = getattr(fleet, "_pt_allreduce_round", 0)
+    try:
+        fleet._pt_allreduce_round = rnd + 1
+    except AttributeError:  # fleet object without settable attrs
+        pass
+    key = f"__size_sum__.{rnd}"
+    client.blob_put(key, np.int64(value).tobytes())
+    client.barrier()
+    total = sum(np.frombuffer(b, np.int64)[0]
+                for b in client.blob_peek(key))
+    client.barrier()  # all peeks done before anyone pops the key
+    client.blob_take(key)
+    return total
+
+
+def _pack_records(records, slots) -> bytes:
+    """np.savez-based serde (no pickle on the wire)."""
+    buf = _io.BytesIO()
+    arrays = {}
+    for i in range(len(slots)):
+        lens = np.asarray([len(r[i]) for r in records], np.int64)
+        flat = (np.concatenate([r[i] for r in records])
+                if records else np.zeros(0, np.int64 if slots[i].is_sparse
+                                         else np.float32))
+        arrays[f"l{i}"] = lens
+        arrays[f"v{i}"] = flat
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _unpack_records(blob: bytes, slots):
+    with np.load(_io.BytesIO(blob)) as z:
+        lens = [z[f"l{i}"] for i in range(len(slots))]
+        vals = [z[f"v{i}"] for i in range(len(slots))]
+    return _split_records(len(lens[0]) if lens else 0, lens, vals)

@@ -68,6 +68,7 @@ class PSServer:
         self.dense: Dict[str, DenseTable] = {}
         self.sparse: Dict[str, SparseTable] = {}
         self._barrier = threading.Barrier(max(n_trainers, 1))
+        self._blobs: Dict[str, list] = {}
         self._heartbeats: Dict[int, float] = {}
         self._lock = threading.Lock()
         self._server: Optional[socketserver.ThreadingTCPServer] = None
@@ -138,6 +139,23 @@ class PSServer:
             with self._lock:
                 status = {str(t): now - ts for t, ts in self._heartbeats.items()}
             _send_msg(sock, "ok", meta={"ages": status})
+        elif op == "blob_put":
+            # generic byte channel: dataset global-shuffle shards, size
+            # allreduces (reference analog: FleetWrapper RPC instance
+            # exchange in data_set.cc GlobalShuffle)
+            with self._lock:
+                self._blobs.setdefault(name, []).append(arrays[0].tobytes())
+            _send_msg(sock, "ok")
+        elif op == "blob_peek":
+            with self._lock:
+                blobs = list(self._blobs.get(name, []))
+            _send_msg(sock, "ok",
+                      arrays=[np.frombuffer(b, np.uint8) for b in blobs])
+        elif op == "blob_take":
+            with self._lock:
+                blobs = self._blobs.pop(name, [])
+            _send_msg(sock, "ok",
+                      arrays=[np.frombuffer(b, np.uint8) for b in blobs])
         elif op == "save":
             self._save(meta["path"])
             _send_msg(sock, "ok")
@@ -297,6 +315,18 @@ class PSClient:
         self._call(self._ep_for(name), "push_sparse", name,
                    arrays=[np.asarray(ids, np.int64),
                            np.asarray(grads, np.float32)])
+
+    def blob_put(self, name: str, blob: bytes):
+        self._call(self._ep_for(name), "blob_put", name,
+                   arrays=[np.frombuffer(blob, np.uint8)])
+
+    def blob_peek(self, name: str):
+        _, arrays = self._call(self._ep_for(name), "blob_peek", name)
+        return [a.tobytes() for a in arrays]
+
+    def blob_take(self, name: str):
+        _, arrays = self._call(self._ep_for(name), "blob_take", name)
+        return [a.tobytes() for a in arrays]
 
     def barrier(self, timeout=120.0):
         for ep in self.endpoints:

@@ -62,6 +62,13 @@ from ..io import (
 from .. import backward
 from ..reader import DataFeeder
 from .. import reader
+from .. import data_feed as dataset
+from ..data_feed import (
+    DatasetFactory,
+    InMemoryDataset,
+    QueueDataset,
+    DataFeedDesc,
+)
 
 # framework module alias (scripts do fluid.framework.xxx)
 from .. import framework

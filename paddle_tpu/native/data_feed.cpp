@@ -1,0 +1,117 @@
+// Native multi-slot data-feed parser.
+//
+// Capability parity with the reference's C++ DataFeed
+// (reference: paddle/fluid/framework/data_feed.cc MultiSlotDataFeed /
+// InMemoryDataFeed — 6k LoC of hot-loop text parsing feeding trainer
+// threads).  The TPU build keeps ingestion on the host CPU; this parser
+// turns multi-slot text ("<n> v1..vn" per slot, slots concatenated per
+// line) into flat columnar buffers the Python Dataset batches from.
+//
+// Text format per record (one line):
+//   for each slot in order: <count> <value>*count
+// Sparse slots hold int64 feasigns, dense slots hold floats.
+//
+// Two-phase C ABI (no allocation handoff across the boundary for data —
+// caller allocates from the counts returned by phase 1):
+//   msf_count(buf, len, nslot) -> n_records, fills per-slot value totals
+//   msf_fill(...)              -> writes per-record lengths + flat values
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Phase 1: count records and per-slot total value counts.
+// Returns number of records (lines with at least one token), or -1 on a
+// malformed line (truncated slot). slot_totals must hold nslot entries.
+int64_t msf_count(const char* buf, int64_t len, int32_t nslot,
+                  int64_t* slot_totals) {
+  for (int32_t s = 0; s < nslot; ++s) slot_totals[s] = 0;
+  int64_t nrec = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    // skip blank lines
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!line_end) line_end = end;
+    bool ok = true;
+    const char* q = p;
+    for (int32_t s = 0; s < nslot && ok; ++s) {
+      while (q < line_end && (*q == ' ' || *q == '\t')) ++q;
+      if (q >= line_end) { ok = false; break; }  // missing trailing slots
+      char* next = nullptr;
+      long long cnt = strtoll(q, &next, 10);
+      if (next == q || next > line_end || cnt < 0) { ok = false; break; }
+      q = next;
+      for (long long i = 0; i < cnt; ++i) {
+        // values may be ints or floats; strtod consumes both
+        double v = strtod(q, &next);
+        (void)v;
+        if (next == q || next > line_end) { ok = false; break; }
+        q = next;
+      }
+      if (ok) slot_totals[s] += cnt;
+    }
+    if (!ok) return -1;
+    ++nrec;
+    p = line_end < end ? line_end + 1 : end;
+  }
+  return nrec;
+}
+
+// Phase 2: fill caller-allocated buffers.
+//   lens[s]  : int64[n_records]   per-record value count of slot s
+//   ivals[s] : int64[totals[s]]   flat values if is_sparse[s]
+//   fvals[s] : float[totals[s]]   flat values if !is_sparse[s]
+// (only the matching one of ivals/fvals is consulted per slot; the other
+// entry may be null.)  Returns n_records or -1 on malformed input.
+int64_t msf_fill(const char* buf, int64_t len, int32_t nslot,
+                 const int8_t* is_sparse, int64_t** lens, int64_t** ivals,
+                 float** fvals) {
+  int64_t* pos = static_cast<int64_t*>(calloc(nslot, sizeof(int64_t)));
+  int64_t nrec = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!line_end) line_end = end;
+    const char* q = p;
+    for (int32_t s = 0; s < nslot; ++s) {
+      while (q < line_end && (*q == ' ' || *q == '\t')) ++q;
+      if (q >= line_end) { free(pos); return -1; }
+      char* next = nullptr;
+      long long cnt = strtoll(q, &next, 10);
+      if (next == q || next > line_end || cnt < 0) { free(pos); return -1; }
+      q = next;
+      lens[s][nrec] = cnt;
+      if (is_sparse[s]) {
+        for (long long i = 0; i < cnt; ++i) {
+          long long v = strtoll(q, &next, 10);
+          if (next == q || next > line_end) { free(pos); return -1; }
+          ivals[s][pos[s] + i] = v;
+          q = next;
+        }
+      } else {
+        for (long long i = 0; i < cnt; ++i) {
+          float v = strtof(q, &next);
+          if (next == q || next > line_end) { free(pos); return -1; }
+          fvals[s][pos[s] + i] = v;
+          q = next;
+        }
+      }
+      pos[s] += cnt;
+    }
+    ++nrec;
+    p = line_end < end ? line_end + 1 : end;
+  }
+  free(pos);
+  return nrec;
+}
+
+}  // extern "C"

@@ -162,7 +162,12 @@ def _train_from_dataset(executor, program, dataset, scope, fetch_list,
     if dataset is None:
         raise ValueError("dataset is required")
     step = 0
+    block = program.global_block() if program is not None else None
     for feed in dataset._iter_batches():
+        if block is not None:
+            # datasets emit companion "<slot>.lens" entries; feed only what
+            # the program declares (reference: DataFeed binds use_slots)
+            feed = {k: v for k, v in feed.items() if block.has_var(k)}
         out = executor.run(program, feed=feed,
                            fetch_list=fetch_list, scope=scope)
         if fetch_list and step % print_period == 0:
