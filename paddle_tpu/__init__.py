@@ -38,6 +38,7 @@ from .framework import (
     is_compiled_with_tpu,
 )
 from . import ops
+from . import inference
 from .executor import Executor
 from .backward import append_backward, gradients
 from .framework.scope import global_scope, scope_guard, LoDTensor, Scope

@@ -140,3 +140,12 @@ def get_flags(keys):
     from ..utils import flags as flag_mod
 
     return flag_mod.get_flags(keys)
+
+
+from .. import inference  # noqa: F401  (reference: fluid.core inference api)
+from ..inference import (  # noqa: F401
+    AnalysisConfig,
+    AnalysisPredictor,
+    PaddleTensor,
+    create_paddle_predictor,
+)

@@ -265,6 +265,10 @@ def _attrs_to_json(attrs: dict) -> dict:
             out[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
         elif isinstance(v, (list, tuple)):
             out[k] = [int(x) if isinstance(x, np.integer) else x for x in v]
+        elif isinstance(v, dict):
+            # plain dict attr (e.g. grad ops' __fwd_out_slots__); wrapped
+            # so _attrs_from_json can tell it apart from the typed markers
+            out[k] = {"__dict__": _attrs_to_json(v)}
         elif isinstance(v, np.integer):
             out[k] = int(v)
         elif isinstance(v, np.floating):
@@ -285,6 +289,8 @@ def _attrs_from_json(attrs: dict) -> dict:
             out[k] = ("__block__", v["__block__"])  # resolved by Program loader
         elif isinstance(v, dict) and "__ndarray__" in v:
             out[k] = np.array(v["__ndarray__"], dtype=v["dtype"])
+        elif isinstance(v, dict) and "__dict__" in v:
+            out[k] = _attrs_from_json(v["__dict__"])
         else:
             out[k] = v
     return out

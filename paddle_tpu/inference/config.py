@@ -1,0 +1,203 @@
+"""Inference configuration.
+
+Reference: paddle/fluid/inference/api/paddle_analysis_config.h
+(AnalysisConfig) and api/analysis_config.cc.  The TPU build keeps the
+same switch surface; device switches map onto TPU/CPU places and the
+"IR optimization" pipeline maps onto XLA compilation (XLA *is* the
+engine — SURVEY.md §2.7), so several knobs are accepted-and-recorded
+no-ops kept for API compatibility.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class AnalysisConfig:
+    """reference: inference/api/paddle_analysis_config.h AnalysisConfig."""
+
+    class Precision:
+        Float32 = "float32"
+        Bfloat16 = "bfloat16"
+        Half = "float16"
+        Int8 = "int8"
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._model_dir = None
+        self._prog_file = None
+        self._params_file = None
+        if model_dir is not None and prog_file is not None \
+                and params_file is None:
+            # reference two-arg form: AnalysisConfig(prog_file, params_file)
+            self._prog_file = model_dir
+            self._params_file = prog_file
+        elif model_dir is not None and prog_file is None:
+            if os.path.isdir(model_dir):
+                self._model_dir = model_dir
+            else:
+                self._prog_file = model_dir
+        else:
+            self._model_dir = model_dir
+            self._prog_file = prog_file
+            self._params_file = params_file
+        # device (reference: enable_use_gpu/disable_gpu); TPU-first here
+        self._use_tpu = False
+        self._tpu_id = 0
+        self._memory_pool_init_size_mb = 100
+        # graph/compiler switches
+        self._ir_optim = True
+        self._use_feed_fetch_ops = True
+        self._specify_input_names = False
+        self._memory_optim = True
+        self._precision = AnalysisConfig.Precision.Float32
+        self._cpu_math_library_num_threads = 1
+        self._deleted_passes = set()
+        self._profile = False
+        self._glog_info = True
+
+    # -- model paths (reference: analysis_config.cc SetModel) -----------
+    def set_model(self, model_dir_or_prog, params_file=None):
+        if params_file is None:
+            self._model_dir = model_dir_or_prog
+            self._prog_file = None
+            self._params_file = None
+        else:
+            self._model_dir = None
+            self._prog_file = model_dir_or_prog
+            self._params_file = params_file
+
+    def set_prog_file(self, x):
+        self._prog_file = x
+
+    def set_params_file(self, x):
+        self._params_file = x
+
+    def model_dir(self):
+        return self._model_dir
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    # -- device selection ----------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # GPU knob from the reference API: on this framework the
+        # accelerator is the TPU; route accordingly.
+        self.enable_tpu(device_id)
+        self._memory_pool_init_size_mb = memory_pool_init_size_mb
+
+    def enable_tpu(self, device_id: int = 0):
+        self._use_tpu = True
+        self._tpu_id = device_id
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def use_gpu(self):
+        return self._use_tpu
+
+    def use_tpu(self):
+        return self._use_tpu
+
+    def gpu_device_id(self):
+        return self._tpu_id
+
+    def tpu_device_id(self):
+        return self._tpu_id
+
+    # -- compiler switches ----------------------------------------------
+    def switch_ir_optim(self, x: bool = True):
+        self._ir_optim = bool(x)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def switch_use_feed_fetch_ops(self, x: bool = True):
+        self._use_feed_fetch_ops = bool(x)
+
+    def use_feed_fetch_ops_enabled(self):
+        return self._use_feed_fetch_ops
+
+    def switch_specify_input_names(self, x: bool = True):
+        self._specify_input_names = bool(x)
+
+    def specify_input_name(self):
+        return self._specify_input_names
+
+    def enable_memory_optim(self, x: bool = True):
+        # maps to XLA buffer donation of weights between runs: safe only
+        # in the jit path, always on there; recorded for parity.
+        self._memory_optim = bool(x)
+
+    def enable_memory_optim_enabled(self):
+        return self._memory_optim
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._cpu_math_library_num_threads = int(n)
+
+    def cpu_math_library_num_threads(self):
+        return self._cpu_math_library_num_threads
+
+    # TensorRT analog: on TPU the whole program compiles through XLA, so
+    # "enable the engine for a subgraph" is a precision request.
+    def enable_tensorrt_engine(self, workspace_size=1 << 30, max_batch_size=1,
+                               min_subgraph_size=3, precision_mode=None,
+                               use_static=False, use_calib_mode=False):
+        if precision_mode is not None:
+            self._precision = precision_mode
+
+    def tensorrt_engine_enabled(self):
+        return False
+
+    def set_precision(self, precision: str):
+        self._precision = precision
+
+    def precision(self):
+        return self._precision
+
+    def delete_pass(self, name: str):
+        self._deleted_passes.add(name)
+
+    def enable_profile(self):
+        self._profile = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def glog_info_disabled(self):
+        return not self._glog_info
+
+    # -- summary ---------------------------------------------------------
+    def summary(self) -> str:
+        rows = [
+            ("model_dir", self._model_dir),
+            ("prog_file", self._prog_file),
+            ("params_file", self._params_file),
+            ("use_tpu", self._use_tpu),
+            ("tpu_device_id", self._tpu_id),
+            ("ir_optim", self._ir_optim),
+            ("memory_optim", self._memory_optim),
+            ("precision", self._precision),
+        ]
+        return "\n".join(f"{k}: {v}" for k, v in rows)
+
+
+# 2.0-style name (reference: paddle_inference_api.h `Config` alias era)
+Config = AnalysisConfig
+
+
+class NativeConfig:
+    """reference: inference/api/paddle_api.h NativeConfig — the legacy
+    no-analysis config; kept as a thin data holder."""
+
+    def __init__(self):
+        self.model_dir = None
+        self.prog_file = None
+        self.param_file = None
+        self.use_gpu = False
+        self.device = 0
+        self.fraction_of_gpu_memory = -1.0

@@ -1,0 +1,191 @@
+"""StableHLO export — the deployment artifact for the native serving
+runtime.
+
+Reference analog: the reference serializes a pruned ProgramDesc +
+weights (`io.py:1093 save_inference_model`) which AnalysisPredictor /
+the C API / the Go client consume.  The TPU-native deployment artifact
+is instead the *compiler IR*: the pruned program lowered through jax to
+a StableHLO module, plus the weights in a flat binary container.  The
+native C++ predictor (native/predictor_capi.cpp) loads both and runs
+them through the PJRT C API (libtpu) with no Python in the loop.
+
+Export layout (``<dir>/``):
+  model.stablehlo.mlir   StableHLO text module; main(weights..., inputs...)
+  weights.ptw            PTW1 container (below)
+  meta.json              input/output names, shapes, dtypes, weight order
+
+PTW1 container: magic "PTW1", u32 n; per tensor: u16 name_len, name,
+u8 dtype code, u8 ndim, u32 dims[ndim], u64 nbytes, raw little-endian
+bytes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+__all__ = ["export_stablehlo", "save_ptw", "load_ptw", "DTYPE_CODES"]
+
+DTYPE_CODES = {
+    "float32": 0, "float64": 1, "int32": 2, "int64": 3,
+    "bfloat16": 4, "float16": 5, "uint8": 6, "int8": 7, "bool": 8,
+}
+_CODE_TO_DTYPE = {v: k for k, v in DTYPE_CODES.items()}
+
+
+def _np_for_save(arr) -> np.ndarray:
+    import jax.numpy as jnp
+
+    a = np.asarray(arr)
+    if a.dtype == jnp.bfloat16:
+        # store bf16 payload bits; dtype code keeps the semantic type
+        return a.view(np.uint16)
+    return a
+
+
+def save_ptw(path: str, tensors: Dict[str, np.ndarray],
+             order: Sequence[str]):
+    with open(path, "wb") as f:
+        f.write(b"PTW1")
+        f.write(struct.pack("<I", len(order)))
+        for name in order:
+            arr = tensors[name]
+            dtype_name = str(np.asarray(arr).dtype)
+            if dtype_name == "bfloat16":
+                code = DTYPE_CODES["bfloat16"]
+            else:
+                code = DTYPE_CODES[dtype_name]
+            raw = _np_for_save(arr)
+            raw = np.ascontiguousarray(raw)
+            nb = raw.nbytes
+            name_b = name.encode("utf-8")
+            f.write(struct.pack("<H", len(name_b)))
+            f.write(name_b)
+            f.write(struct.pack("<BB", code, raw.ndim))
+            f.write(struct.pack(f"<{raw.ndim}I", *raw.shape))
+            f.write(struct.pack("<Q", nb))
+            f.write(raw.tobytes())
+
+
+def load_ptw(path: str) -> Dict[str, np.ndarray]:
+    import jax.numpy as jnp
+
+    out = {}
+    with open(path, "rb") as f:
+        assert f.read(4) == b"PTW1", "bad PTW magic"
+        (n,) = struct.unpack("<I", f.read(4))
+        for _ in range(n):
+            (nl,) = struct.unpack("<H", f.read(2))
+            name = f.read(nl).decode("utf-8")
+            code, ndim = struct.unpack("<BB", f.read(2))
+            dims = struct.unpack(f"<{ndim}I", f.read(4 * ndim))
+            (nb,) = struct.unpack("<Q", f.read(8))
+            buf = f.read(nb)
+            dtype = _CODE_TO_DTYPE[code]
+            if dtype == "bfloat16":
+                arr = np.frombuffer(buf, np.uint16).reshape(dims)
+                arr = arr.view(jnp.bfloat16)
+            else:
+                arr = np.frombuffer(buf, dtype).reshape(dims)
+            out[name] = arr
+    return out
+
+
+def export_stablehlo(dirname: str, inference_model_dir: str,
+                     input_shapes: Dict[str, Sequence[int]],
+                     input_dtypes: Dict[str, str] | None = None,
+                     use_tpu: bool = False) -> str:
+    """Lower a saved inference model to a StableHLO deployment dir.
+
+    ``inference_model_dir`` is a `save_inference_model` directory;
+    ``input_shapes`` fixes the static shapes (XLA semantics: one module
+    per shape signature — export one dir per served signature, as the
+    reference exports one TRT engine per profile)."""
+    import jax
+
+    from ..framework.place import CPUPlace, TPUPlace
+    from ..framework.scope import Scope
+    from ..framework import scope as scope_mod
+    from ..executor import Executor, analyze_state
+    from ..ops import registry
+    from ..io import load_inference_model
+
+    place = TPUPlace(0) if use_tpu else CPUPlace()
+    scope = Scope()
+    exe = Executor(place)
+    prev = scope_mod._global_scope
+    scope_mod._global_scope = scope
+    try:
+        program, feed_names, fetch_vars = load_inference_model(
+            inference_model_dir, exe)
+    finally:
+        scope_mod._global_scope = prev
+    fetch_names = [v.name for v in fetch_vars]
+    block = program.global_block()
+
+    input_dtypes = input_dtypes or {}
+    feed = {}
+    for name in feed_names:
+        var = block.var(name)
+        from ..framework.dtype import to_numpy_dtype
+
+        dt = input_dtypes.get(
+            name,
+            str(np.dtype(to_numpy_dtype(var.dtype)))
+            if var.dtype is not None else "float32")
+        feed[name] = np.zeros(tuple(input_shapes[name]), dtype=dt)
+
+    ops = list(block.ops)
+    state_in, state_out, uses_rng, has_host_ops = analyze_state(
+        ops, block, feed, scope)
+    if has_host_ops:
+        raise ValueError("program contains host-side ops; not exportable")
+    if uses_rng:
+        raise ValueError(
+            "program draws random numbers at inference time (dropout without "
+            "is_test, sampling ops); re-export from a for_test program")
+
+    weight_order = [n for n in state_in if n != "@RNG_KEY@"]
+    weights = {n: np.asarray(scope.get(n)) for n in weight_order}
+
+    def infer_fn(*flat):
+        env = dict(zip(weight_order, flat[:len(weight_order)]))
+        env.update(zip(feed_names, flat[len(weight_order):]))
+        for op_ in ops:
+            registry.run_op(op_, env, block)
+        return tuple(env[n] for n in fetch_names)
+
+    example = [weights[n] for n in weight_order] + \
+              [feed[n] for n in feed_names]
+    lowered = jax.jit(infer_fn).lower(*example)
+    stablehlo_text = lowered.as_text(dialect="stablehlo")
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "model.stablehlo.mlir"), "w") as f:
+        f.write(stablehlo_text)
+    save_ptw(os.path.join(dirname, "weights.ptw"), weights, weight_order)
+    meta = {
+        "weight_order": weight_order,
+        "input_names": list(feed_names),
+        "input_shapes": {n: list(np.shape(feed[n])) for n in feed_names},
+        "input_dtypes": {n: str(feed[n].dtype) for n in feed_names},
+        "output_names": fetch_names,
+    }
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    # native-friendly twin of meta.json consumed by predictor_capi.cpp
+    with open(os.path.join(dirname, "meta.txt"), "w") as f:
+        f.write("PTMETA1\n")
+        f.write(f"inputs {len(feed_names)}\n")
+        for n in feed_names:
+            shape = list(np.shape(feed[n]))
+            code = DTYPE_CODES[str(feed[n].dtype)]
+            dims = " ".join(str(d) for d in shape)
+            f.write(f"{n} {code} {len(shape)} {dims}\n".rstrip() + "\n")
+        f.write(f"outputs {len(fetch_names)}\n")
+        for n in fetch_names:
+            f.write(n + "\n")
+    return stablehlo_text

@@ -1,0 +1,256 @@
+"""AnalysisPredictor — the serving-path program runner.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.cc
+(`AnalysisPredictor::Init` :130, `PrepareProgram` :184, `Run` :289,
+`ZeroCopyRun` :711, `CreatePaddlePredictor` :993) and api/api_impl.cc.
+
+TPU-native design: "analysis + NaiveExecutor" becomes "prune to the
+fetch set + whole-program XLA compile".  The pass pipeline the reference
+runs (fusions, TRT subgraphs) is XLA's job here; what remains of
+"analysis" is the inference pruning done at export time
+(io.save_inference_model) plus shape-specialised jit caching at run
+time.  Zero-copy IO maps onto device-resident `jax.Array`s: input
+handles stage host buffers to HBM once, output handles fetch lazily.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.place import CPUPlace, TPUPlace
+from ..framework.scope import LoDTensor, Scope
+from ..framework import scope as scope_mod
+from ..executor import Executor, as_numpy
+from .config import AnalysisConfig
+
+__all__ = [
+    "PaddleTensor", "ZeroCopyTensor", "AnalysisPredictor", "PaddlePredictor",
+    "create_paddle_predictor", "create_predictor",
+]
+
+
+class PaddleTensor:
+    """Legacy value-copy IO tensor (reference: paddle_api.h PaddleTensor)."""
+
+    def __init__(self, data=None, name: str = "", lod=None, dtype=None):
+        if data is not None:
+            data = np.asarray(data, dtype=dtype)
+        self.data = data
+        self.name = name
+        self.lod = lod or []
+        self.shape = list(data.shape) if data is not None else []
+
+    def as_ndarray(self) -> np.ndarray:
+        return self.data
+
+
+class ZeroCopyTensor:
+    """Input/output handle bound to a predictor variable
+    (reference: paddle_api.h ZeroCopyTensor, analysis_predictor.cc:498).
+
+    ``copy_from_cpu`` stages the host array onto the predictor's device;
+    ``copy_to_cpu`` syncs the fetch back.  Between runs the value stays
+    device-resident (jax.Array) — the zero-copy analog.
+    """
+
+    def __init__(self, name: str, predictor: "AnalysisPredictor",
+                 is_input: bool):
+        self.name = name
+        self._pred = predictor
+        self._is_input = is_input
+        self._lod = []
+
+    def reshape(self, shape: Sequence[int]):
+        # shapes are taken from the staged array at run time; recorded
+        # for API parity with the reference's reshape-then-copy protocol
+        self._shape = list(shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError(f"{self.name} is an output handle")
+        import jax
+
+        arr = np.ascontiguousarray(arr)
+        self._pred._inputs[self.name] = jax.device_put(
+            arr, self._pred._device)
+
+    def share_external_data(self, arr):
+        # an already-device-resident jax.Array is used as-is
+        self._pred._inputs[self.name] = arr
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            val = self._pred._inputs.get(self.name)
+        else:
+            val = self._pred._outputs.get(self.name)
+        if val is None:
+            raise RuntimeError(f"no value for {self.name}; run() first")
+        return as_numpy(val)
+
+    def shape(self) -> List[int]:
+        src = self._pred._inputs if self._is_input else self._pred._outputs
+        val = src.get(self.name)
+        return list(np.shape(val)) if val is not None else []
+
+    def set_lod(self, lod):
+        self._lod = lod
+
+    def lod(self):
+        return self._lod
+
+    # numpy-style sugar
+    def numpy(self):
+        return self.copy_to_cpu()
+
+
+class AnalysisPredictor:
+    """reference: analysis_predictor.cc:130 AnalysisPredictor."""
+
+    def __init__(self, config: AnalysisConfig):
+        self._config = config
+        self._place = (TPUPlace(config.tpu_device_id())
+                       if config.use_tpu() else CPUPlace())
+        self._device = self._place.jax_device()
+        self._scope = Scope()
+        self._exe = Executor(self._place)
+        self._inputs: Dict[str, object] = {}
+        self._outputs: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._load_program()
+
+    # -- init (reference: PrepareProgram analysis_predictor.cc:184) ------
+    def _load_program(self):
+        from ..io import load_inference_model
+
+        cfg = self._config
+        dirname = cfg.model_dir()
+        prev = scope_mod._global_scope
+        scope_mod._global_scope = self._scope
+        try:
+            if dirname is not None:
+                program, feed_names, fetch_vars = load_inference_model(
+                    dirname, self._exe)
+            else:
+                import os
+
+                prog_file = cfg.prog_file()
+                program, feed_names, fetch_vars = load_inference_model(
+                    os.path.dirname(prog_file) or ".", self._exe,
+                    model_filename=os.path.basename(prog_file),
+                    params_filename=cfg.params_file())
+        finally:
+            scope_mod._global_scope = prev
+        self._program = program
+        self._feed_names = list(feed_names)
+        self._fetch_names = [v.name for v in fetch_vars]
+        if cfg.precision() == AnalysisConfig.Precision.Bfloat16:
+            from ..contrib.mixed_precision.fp16_utils import cast_model_to_fp16
+
+            try:
+                cast_model_to_fp16(self._program)
+            except Exception:
+                pass  # precision rewrite is best-effort on odd programs
+
+    # -- IO surface ------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name: str) -> ZeroCopyTensor:
+        if name not in self._feed_names:
+            raise KeyError(f"{name!r} is not an input; inputs: "
+                           f"{self._feed_names}")
+        return ZeroCopyTensor(name, self, is_input=True)
+
+    def get_output_handle(self, name: str) -> ZeroCopyTensor:
+        if name not in self._fetch_names:
+            raise KeyError(f"{name!r} is not an output; outputs: "
+                           f"{self._fetch_names}")
+        return ZeroCopyTensor(name, self, is_input=False)
+
+    # reference ZeroCopy spelling
+    get_input_tensor = get_input_handle
+    get_output_tensor = get_output_handle
+
+    # -- execution -------------------------------------------------------
+    def run(self, inputs: Optional[List[PaddleTensor]] = None):
+        """Two modes, as in the reference:
+        * ``run([PaddleTensor...])`` — value-copy path
+          (analysis_predictor.cc:289), returns List[PaddleTensor].
+        * ``run()`` — zero-copy path (:711) over handles staged with
+          ``copy_from_cpu``; fetch through ``get_output_handle``.
+        """
+        with self._lock:
+            if inputs is not None:
+                for i, t in enumerate(inputs):
+                    name = t.name or self._feed_names[i]
+                    import jax
+
+                    self._inputs[name] = jax.device_put(
+                        np.ascontiguousarray(t.data), self._device)
+            missing = [n for n in self._feed_names if n not in self._inputs]
+            if missing:
+                raise RuntimeError(f"inputs not set: {missing}")
+            feed = {n: self._inputs[n] for n in self._feed_names}
+            fetched = self._exe.run(
+                self._program, feed=feed, fetch_list=self._fetch_names,
+                scope=self._scope, return_numpy=False)
+            self._outputs = {n: v for n, v in zip(self._fetch_names, fetched)}
+            if inputs is not None:
+                return [
+                    PaddleTensor(as_numpy(v), name=n)
+                    for n, v in self._outputs.items()
+                ]
+            return True
+
+    def zero_copy_run(self):
+        return self.run()
+
+    # -- management ------------------------------------------------------
+    def clone(self) -> "AnalysisPredictor":
+        """Per-thread clone sharing weights (reference:
+        analysis_predictor.cc Clone — shares the scope, new executor
+        state).  The compiled XLA executable is shared via jit's global
+        compilation cache, so a clone costs no recompile."""
+        twin = AnalysisPredictor.__new__(AnalysisPredictor)
+        twin._config = self._config
+        twin._place = self._place
+        twin._device = self._device
+        twin._scope = self._scope  # weights shared
+        twin._exe = Executor(self._place)
+        twin._inputs = {}
+        twin._outputs = {}
+        twin._lock = threading.Lock()
+        twin._program = self._program
+        twin._feed_names = list(self._feed_names)
+        twin._fetch_names = list(self._fetch_names)
+        return twin
+
+    def program(self):
+        return self._program
+
+    def scope(self):
+        return self._scope
+
+    def clear_intermediate_tensor(self):
+        self._inputs.clear()
+        self._outputs.clear()
+
+
+# Legacy name used by api_impl.cc-era clients
+PaddlePredictor = AnalysisPredictor
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    """reference: CreatePaddlePredictor<AnalysisConfig>
+    (analysis_predictor.cc:993)."""
+    return AnalysisPredictor(config)
+
+
+def create_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    """2.0-style factory (paddle_inference_api.h CreatePredictor)."""
+    return AnalysisPredictor(config)

@@ -144,6 +144,17 @@ def _prune_for_inference(program: Program, feed_names, fetch_names) -> Program:
     """Backward DCE from fetches; drops optimizer/backward/feed-unrelated ops."""
     pruned = program.clone(for_test=True)
     block = pruned.global_block()
+    # drop backward/optimize/lr-sched ops first (reference prunes by
+    # op_role before DCE — io.py:1093 via Program._prune_with_input);
+    # without this, in-place optimizer updates alias param names and the
+    # reverse DCE below would drag the whole training graph back in.
+    from .backward import OP_ROLE_KEY, OpRole
+
+    fwd_mask = OpRole.Backward | OpRole.Optimize | OpRole.LRSched
+    block.ops = [
+        op_ for op_ in block.ops
+        if not (int(op_.attrs.get(OP_ROLE_KEY, 0)) & fwd_mask)
+    ]
     needed = set(fetch_names)
     keep = []
     for op_ in reversed(block.ops):

@@ -17,21 +17,58 @@ _LOCK = threading.Lock()
 _LOADED = {}
 
 
+def _tf_include_dir():
+    """The PJRT C API headers ship with the installed tensorflow wheel
+    (xla/pjrt/c/pjrt_c_api.h) — public vendored headers, not reference
+    code."""
+    import importlib.util
+
+    spec = importlib.util.find_spec("tensorflow")
+    if spec is None or not spec.submodule_search_locations:
+        return None
+    return os.path.join(spec.submodule_search_locations[0], "include")
+
+
+# per-library extra compile/link flags
+EXTRA_FLAGS = {
+    "predictor_capi": lambda: (
+        [f"-I{_tf_include_dir()}"] if _tf_include_dir() else []
+    ) + ["-ldl"],
+}
+
+
 def load_library(name: str) -> ctypes.CDLL:
     """Compile (if needed) and dlopen native/<name>.cpp."""
     with _LOCK:
         if name in _LOADED:
             return _LOADED[name]
-        src = os.path.join(os.path.dirname(__file__), name + ".cpp")
+        here = os.path.dirname(__file__)
+        src = os.path.join(here, name + ".cpp")
+        h = hashlib.sha256()
         with open(src, "rb") as f:
-            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            h.update(f.read())
+        # locally included headers + extra flags are part of the ABI:
+        # hash them too so edits rebuild instead of loading a stale .so
+        for hdr in sorted(os.listdir(here)):
+            if hdr.endswith(".h"):
+                with open(os.path.join(here, hdr), "rb") as f:
+                    h.update(f.read())
+        extra0 = EXTRA_FLAGS.get(name)
+        h.update(repr(extra0() if callable(extra0) else extra0).encode())
+        digest = h.hexdigest()[:16]
         os.makedirs(_CACHE_DIR, exist_ok=True)
         so_path = os.path.join(_CACHE_DIR, f"{name}-{digest}.so")
         if not os.path.exists(so_path):
             tmp = so_path + ".tmp"
+            extra = EXTRA_FLAGS.get(name)
             cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                   "-o", tmp, src, "-lpthread"]
-            subprocess.run(cmd, check=True, capture_output=True)
+                   "-o", tmp, src, "-lpthread"] + \
+                  (extra() if callable(extra) else list(extra or []))
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build of {name} failed:\n$ {' '.join(cmd)}\n"
+                    f"{proc.stderr[-4000:]}")
             os.replace(tmp, so_path)
         lib = ctypes.CDLL(so_path)
         _LOADED[name] = lib

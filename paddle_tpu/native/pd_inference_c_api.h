@@ -1,0 +1,85 @@
+/* C inference API over the PJRT runtime.
+ *
+ * Reference analog: paddle/fluid/inference/capi/ (PD_Predictor,
+ * PD_NewAnalysisConfig, PD_PredictorRun, c_api.cc) — a stable C surface
+ * over the native predictor so C/Go/R clients can serve models without
+ * Python.  TPU-native shape: the artifact is a StableHLO module +
+ * weights container exported by paddle_tpu.inference.export_stablehlo;
+ * the engine is any PJRT C-API plugin (libtpu.so on TPU hosts).  No
+ * Python, no framework runtime in the serving path — dlopen(plugin),
+ * compile, execute.
+ */
+#ifndef PD_INFERENCE_C_API_H_
+#define PD_INFERENCE_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* dtype codes shared with the PTW1 weights container
+ * (paddle_tpu/inference/export.py DTYPE_CODES) */
+enum PD_DType {
+  PD_FLOAT32 = 0,
+  PD_FLOAT64 = 1,
+  PD_INT32 = 2,
+  PD_INT64 = 3,
+  PD_BFLOAT16 = 4,
+  PD_FLOAT16 = 5,
+  PD_UINT8 = 6,
+  PD_INT8 = 7,
+  PD_BOOL = 8,
+};
+
+#define PD_MAX_RANK 8
+
+typedef struct PD_NativeTensor {
+  int32_t dtype; /* PD_DType */
+  int32_t ndim;
+  int64_t dims[PD_MAX_RANK];
+  void* data;     /* inputs: caller-owned; outputs: free with
+                     PD_NativeTensorFree */
+  size_t nbytes;
+} PD_NativeTensor;
+
+typedef struct PD_NativePredictor PD_NativePredictor;
+
+/* Load <export_dir>/{model.stablehlo.mlir, weights.ptw, meta.txt},
+ * create a PJRT client from `plugin_path` (a PJRT C-API plugin .so,
+ * e.g. libtpu.so), compile, and stage the weights on device 0.
+ *
+ * `options` are plugin create-options (PJRT_NamedValue), newline-
+ * separated lines of the form "<name> int <value>" or
+ * "<name> str <value>".  Pass NULL/"" for plugins that need none
+ * (libtpu on a TPU VM).
+ *
+ * Returns NULL on failure — see PD_NativeLastError(). */
+PD_NativePredictor* PD_NativePredictorCreate(const char* export_dir,
+                                             const char* plugin_path,
+                                             const char* options);
+
+int PD_NativePredictorNumInputs(PD_NativePredictor*);
+int PD_NativePredictorNumOutputs(PD_NativePredictor*);
+/* Returned strings are owned by the predictor. */
+const char* PD_NativePredictorInputName(PD_NativePredictor*, int i);
+const char* PD_NativePredictorOutputName(PD_NativePredictor*, int i);
+
+/* Run one inference.  `ins` are given in meta input order.  Fills up to
+ * `max_out` entries of `outs` (data malloc'd by the library).  Returns
+ * the number of outputs, or -1 on error. */
+int PD_NativePredictorRun(PD_NativePredictor*, const PD_NativeTensor* ins,
+                          int n_in, PD_NativeTensor* outs, int max_out);
+
+void PD_NativeTensorFree(PD_NativeTensor*);
+void PD_NativePredictorDestroy(PD_NativePredictor*);
+
+/* Thread-local message for the last failed call. */
+const char* PD_NativeLastError(void);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* PD_INFERENCE_C_API_H_ */

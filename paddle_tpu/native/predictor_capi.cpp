@@ -1,0 +1,747 @@
+// Native serving runtime: StableHLO + PTW weights -> PJRT C API.
+//
+// Reference analog: paddle/fluid/inference/capi/c_api.cc +
+// api/analysis_predictor.cc — the native no-Python serving path.  On
+// TPU the "engine" is the PJRT plugin (libtpu.so): we dlopen it, build
+// a client, compile the exported StableHLO module once, stage weights
+// on device, and per Run() stage inputs, execute, and read back
+// outputs.  The PJRT C API is ABI-stable (struct_size-versioned), so
+// this binary keeps working across plugin updates.
+//
+// Artifact layout: see paddle_tpu/inference/export.py.
+
+#include "pd_inference_c_api.h"
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+std::string pjrt_error_message(const PJRT_Api* api, PJRT_Error* err) {
+  PJRT_Error_Message_Args margs;
+  std::memset(&margs, 0, sizeof(margs));
+  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  margs.error = err;
+  api->PJRT_Error_Message(&margs);
+  std::string msg(margs.message, margs.message_size);
+  PJRT_Error_Destroy_Args dargs;
+  std::memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dargs.error = err;
+  api->PJRT_Error_Destroy(&dargs);
+  return msg;
+}
+
+// RETURN_IF_PJRT_ERROR: capture + free the error, set g_last_error.
+#define PD_CHECK_PJRT(api, expr, cleanup)                       \
+  do {                                                          \
+    PJRT_Error* _err = (expr);                                  \
+    if (_err != nullptr) {                                      \
+      set_error(std::string(#expr) + ": " +                     \
+                pjrt_error_message((api), _err));               \
+      cleanup;                                                  \
+    }                                                           \
+  } while (0)
+
+int64_t dtype_size(int32_t code) {
+  switch (code) {
+    case PD_FLOAT64:
+      return 8;
+    case PD_INT64:
+      return 8;
+    case PD_FLOAT32:
+      return 4;
+    case PD_INT32:
+      return 4;
+    case PD_BFLOAT16:
+      return 2;
+    case PD_FLOAT16:
+      return 2;
+    default:
+      return 1;
+  }
+}
+
+bool dtype_to_pjrt(int32_t code, PJRT_Buffer_Type* out) {
+  switch (code) {
+    case PD_FLOAT32:
+      *out = PJRT_Buffer_Type_F32;
+      return true;
+    case PD_FLOAT64:
+      *out = PJRT_Buffer_Type_F64;
+      return true;
+    case PD_INT32:
+      *out = PJRT_Buffer_Type_S32;
+      return true;
+    case PD_INT64:
+      *out = PJRT_Buffer_Type_S64;
+      return true;
+    case PD_BFLOAT16:
+      *out = PJRT_Buffer_Type_BF16;
+      return true;
+    case PD_FLOAT16:
+      *out = PJRT_Buffer_Type_F16;
+      return true;
+    case PD_UINT8:
+      *out = PJRT_Buffer_Type_U8;
+      return true;
+    case PD_INT8:
+      *out = PJRT_Buffer_Type_S8;
+      return true;
+    case PD_BOOL:
+      *out = PJRT_Buffer_Type_PRED;
+      return true;
+    default:
+      return false;
+  }
+}
+
+bool pjrt_to_dtype(PJRT_Buffer_Type t, int32_t* out) {
+  switch (t) {
+    case PJRT_Buffer_Type_F32:
+      *out = PD_FLOAT32;
+      return true;
+    case PJRT_Buffer_Type_F64:
+      *out = PD_FLOAT64;
+      return true;
+    case PJRT_Buffer_Type_S32:
+      *out = PD_INT32;
+      return true;
+    case PJRT_Buffer_Type_S64:
+      *out = PD_INT64;
+      return true;
+    case PJRT_Buffer_Type_BF16:
+      *out = PD_BFLOAT16;
+      return true;
+    case PJRT_Buffer_Type_F16:
+      *out = PD_FLOAT16;
+      return true;
+    case PJRT_Buffer_Type_U8:
+      *out = PD_UINT8;
+      return true;
+    case PJRT_Buffer_Type_S8:
+      *out = PD_INT8;
+      return true;
+    case PJRT_Buffer_Type_PRED:
+      *out = PD_BOOL;
+      return true;
+    default:
+      return false;
+  }
+}
+
+struct HostTensor {
+  std::string name;
+  int32_t dtype = PD_FLOAT32;
+  std::vector<int64_t> dims;
+  std::vector<char> data;
+};
+
+// PTW1 weights container reader (export.py save_ptw).
+bool read_ptw(const std::string& path, std::vector<HostTensor>* out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    set_error("cannot open " + path);
+    return false;
+  }
+  char magic[4];
+  f.read(magic, 4);
+  if (std::memcmp(magic, "PTW1", 4) != 0) {
+    set_error("bad PTW magic in " + path);
+    return false;
+  }
+  uint32_t n = 0;
+  f.read(reinterpret_cast<char*>(&n), 4);
+  for (uint32_t i = 0; i < n; ++i) {
+    HostTensor t;
+    uint16_t name_len = 0;
+    f.read(reinterpret_cast<char*>(&name_len), 2);
+    t.name.resize(name_len);
+    f.read(&t.name[0], name_len);
+    uint8_t code = 0, ndim = 0;
+    f.read(reinterpret_cast<char*>(&code), 1);
+    f.read(reinterpret_cast<char*>(&ndim), 1);
+    t.dtype = code;
+    t.dims.resize(ndim);
+    for (int d = 0; d < ndim; ++d) {
+      uint32_t dim = 0;
+      f.read(reinterpret_cast<char*>(&dim), 4);
+      t.dims[d] = dim;
+    }
+    uint64_t nbytes = 0;
+    f.read(reinterpret_cast<char*>(&nbytes), 8);
+    if (nbytes > (1ull << 38)) {  // 256 GiB: clearly corrupt metadata
+      set_error("implausible tensor size in " + path + " (corrupt file?)");
+      return false;
+    }
+    t.data.resize(nbytes);
+    f.read(t.data.data(), static_cast<std::streamsize>(nbytes));
+    if (!f) {
+      set_error("truncated PTW file " + path);
+      return false;
+    }
+    out->push_back(std::move(t));
+  }
+  return true;
+}
+
+struct MetaInput {
+  std::string name;
+  int32_t dtype;
+  std::vector<int64_t> dims;
+};
+
+// meta.txt (export.py): line-oriented, native-friendly.
+bool read_meta(const std::string& path, std::vector<MetaInput>* inputs,
+               std::vector<std::string>* outputs) {
+  std::ifstream f(path);
+  if (!f) {
+    set_error("cannot open " + path);
+    return false;
+  }
+  std::string tag;
+  f >> tag;
+  if (tag != "PTMETA1") {
+    set_error("bad meta header in " + path);
+    return false;
+  }
+  size_t n = 0;
+  f >> tag >> n;  // "inputs N"
+  for (size_t i = 0; i < n; ++i) {
+    MetaInput mi;
+    int ndim = 0;
+    f >> mi.name >> mi.dtype >> ndim;
+    mi.dims.resize(ndim);
+    for (int d = 0; d < ndim; ++d) f >> mi.dims[d];
+    inputs->push_back(std::move(mi));
+  }
+  f >> tag >> n;  // "outputs N"
+  for (size_t i = 0; i < n; ++i) {
+    std::string name;
+    f >> name;
+    outputs->push_back(name);
+  }
+  return static_cast<bool>(f);
+}
+
+}  // namespace
+
+struct PD_NativePredictor {
+  void* plugin_handle = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_LoadedExecutable* executable = nullptr;
+  PJRT_Device* device = nullptr;
+  size_t num_outputs = 0;
+  std::vector<PJRT_Buffer*> weight_buffers;
+  std::vector<MetaInput> inputs;
+  std::vector<std::string> output_names;
+
+  ~PD_NativePredictor() {
+    if (api != nullptr) {
+      for (PJRT_Buffer* b : weight_buffers) {
+        if (b == nullptr) continue;
+        PJRT_Buffer_Destroy_Args args;
+        std::memset(&args, 0, sizeof(args));
+        args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        args.buffer = b;
+        PJRT_Error* err = api->PJRT_Buffer_Destroy(&args);
+        if (err != nullptr) pjrt_error_message(api, err);
+      }
+      if (executable != nullptr) {
+        PJRT_LoadedExecutable_Destroy_Args args;
+        std::memset(&args, 0, sizeof(args));
+        args.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+        args.executable = executable;
+        PJRT_Error* err = api->PJRT_LoadedExecutable_Destroy(&args);
+        if (err != nullptr) pjrt_error_message(api, err);
+      }
+      if (client != nullptr) {
+        PJRT_Client_Destroy_Args args;
+        std::memset(&args, 0, sizeof(args));
+        args.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+        args.client = client;
+        PJRT_Error* err = api->PJRT_Client_Destroy(&args);
+        if (err != nullptr) pjrt_error_message(api, err);
+      }
+    }
+    // plugin_handle deliberately not dlclose'd: TPU plugins don't
+    // support unload/reload in one process.
+  }
+};
+
+namespace {
+
+bool await_and_destroy_event(const PJRT_Api* api, PJRT_Event* event) {
+  if (event == nullptr) return true;
+  PJRT_Event_Await_Args aargs;
+  std::memset(&aargs, 0, sizeof(aargs));
+  aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aargs.event = event;
+  PJRT_Error* err = api->PJRT_Event_Await(&aargs);
+  bool ok = true;
+  if (err != nullptr) {
+    set_error("event: " + pjrt_error_message(api, err));
+    ok = false;
+  }
+  PJRT_Event_Destroy_Args dargs;
+  std::memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  dargs.event = event;
+  err = api->PJRT_Event_Destroy(&dargs);
+  if (err != nullptr) pjrt_error_message(api, err);
+  return ok;
+}
+
+PJRT_Buffer* host_to_device(const PJRT_Api* api, PJRT_Client* client,
+                            PJRT_Device* device, const void* data,
+                            int32_t dtype, const int64_t* dims, int ndim) {
+  PJRT_Buffer_Type type;
+  if (!dtype_to_pjrt(dtype, &type)) {
+    set_error("unsupported dtype code " + std::to_string(dtype));
+    return nullptr;
+  }
+  PJRT_Client_BufferFromHostBuffer_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  args.client = client;
+  args.data = data;
+  args.type = type;
+  args.dims = dims;
+  args.num_dims = static_cast<size_t>(ndim);
+  args.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  args.device = device;
+  PD_CHECK_PJRT(api, api->PJRT_Client_BufferFromHostBuffer(&args),
+                return nullptr);
+  if (!await_and_destroy_event(api, args.done_with_host_buffer)) {
+    return nullptr;
+  }
+  return args.buffer;
+}
+
+void destroy_buffer(const PJRT_Api* api, PJRT_Buffer* b) {
+  if (b == nullptr) return;
+  PJRT_Buffer_Destroy_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  args.buffer = b;
+  PJRT_Error* err = api->PJRT_Buffer_Destroy(&args);
+  if (err != nullptr) pjrt_error_message(api, err);
+}
+
+}  // namespace
+
+extern "C" {
+
+namespace {
+
+struct NamedOption {
+  std::string name;
+  bool is_int;
+  std::string str_value;
+  int64_t int_value;
+};
+
+// "<name> int <v>" / "<name> str <v>" lines -> PJRT_NamedValue inputs.
+std::vector<NamedOption> parse_options(const char* options) {
+  std::vector<NamedOption> out;
+  if (options == nullptr) return out;
+  std::stringstream ss(options);
+  std::string line;
+  while (std::getline(ss, line)) {
+    if (line.empty()) continue;
+    std::stringstream ls(line);
+    NamedOption opt;
+    std::string type;
+    ls >> opt.name >> type;
+    if (type == "int") {
+      ls >> opt.int_value;
+      opt.is_int = true;
+    } else {
+      std::getline(ls, opt.str_value);
+      // strip the single separating space
+      if (!opt.str_value.empty() && opt.str_value[0] == ' ') {
+        opt.str_value.erase(0, 1);
+      }
+      opt.is_int = false;
+    }
+    out.push_back(std::move(opt));
+  }
+  return out;
+}
+
+}  // namespace
+
+namespace {
+PD_NativePredictor* create_impl(const char* export_dir,
+                                const char* plugin_path,
+                                const char* options);
+}
+
+PD_NativePredictor* PD_NativePredictorCreate(const char* export_dir,
+                                             const char* plugin_path,
+                                             const char* options) {
+  // no exception may cross the C boundary (ctypes/Go callers)
+  try {
+    return create_impl(export_dir, plugin_path, options);
+  } catch (const std::exception& e) {
+    set_error(std::string("internal error: ") + e.what());
+    return nullptr;
+  } catch (...) {
+    set_error("internal error (unknown exception)");
+    return nullptr;
+  }
+}
+
+namespace {
+PD_NativePredictor* create_impl(const char* export_dir,
+                                const char* plugin_path,
+                                const char* options) {
+  auto pred = std::make_unique<PD_NativePredictor>();
+  std::string dir(export_dir);
+
+  // 1. plugin
+  pred->plugin_handle = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
+  if (pred->plugin_handle == nullptr) {
+    set_error(std::string("dlopen failed: ") + dlerror());
+    return nullptr;
+  }
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetPjrtApiFn>(
+      dlsym(pred->plugin_handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    set_error(std::string(plugin_path) + " exports no GetPjrtApi symbol");
+    return nullptr;
+  }
+  pred->api = get_api();
+  const PJRT_Api* api = pred->api;
+  if (api->pjrt_api_version.major_version != PJRT_API_MAJOR) {
+    set_error("PJRT ABI major mismatch: plugin " +
+              std::to_string(api->pjrt_api_version.major_version) +
+              " vs built-against " + std::to_string(PJRT_API_MAJOR));
+    return nullptr;
+  }
+
+  {
+    PJRT_Plugin_Initialize_Args args;
+    std::memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+    PD_CHECK_PJRT(api, api->PJRT_Plugin_Initialize(&args), return nullptr);
+  }
+
+  // 2. client + device
+  {
+    std::vector<NamedOption> opts = parse_options(options);
+    std::vector<PJRT_NamedValue> named(opts.size());
+    for (size_t i = 0; i < opts.size(); ++i) {
+      std::memset(&named[i], 0, sizeof(PJRT_NamedValue));
+      named[i].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      named[i].name = opts[i].name.c_str();
+      named[i].name_size = opts[i].name.size();
+      if (opts[i].is_int) {
+        named[i].type = PJRT_NamedValue_kInt64;
+        named[i].int64_value = opts[i].int_value;
+        named[i].value_size = 1;
+      } else {
+        named[i].type = PJRT_NamedValue_kString;
+        named[i].string_value = opts[i].str_value.c_str();
+        named[i].value_size = opts[i].str_value.size();
+      }
+    }
+    PJRT_Client_Create_Args args;
+    std::memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+    args.create_options = named.empty() ? nullptr : named.data();
+    args.num_options = named.size();
+    PD_CHECK_PJRT(api, api->PJRT_Client_Create(&args), return nullptr);
+    pred->client = args.client;
+  }
+  {
+    PJRT_Client_AddressableDevices_Args args;
+    std::memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+    args.client = pred->client;
+    PD_CHECK_PJRT(api, api->PJRT_Client_AddressableDevices(&args),
+                  return nullptr);
+    if (args.num_addressable_devices == 0) {
+      set_error("no addressable devices");
+      return nullptr;
+    }
+    pred->device = args.addressable_devices[0];
+  }
+
+  // 3. compile the StableHLO module
+  {
+    std::ifstream f(dir + "/model.stablehlo.mlir");
+    if (!f) {
+      set_error("cannot open " + dir + "/model.stablehlo.mlir");
+      return nullptr;
+    }
+    std::stringstream ss;
+    ss << f.rdbuf();
+    std::string code = ss.str();
+
+    PJRT_Program program;
+    std::memset(&program, 0, sizeof(program));
+    program.struct_size = PJRT_Program_STRUCT_SIZE;
+    program.code = code.data();
+    program.code_size = code.size();
+    static const char kFormat[] = "mlir";
+    program.format = kFormat;
+    program.format_size = sizeof(kFormat) - 1;
+
+    // Minimal serialized xla CompileOptionsProto:
+    // executable_build_options { num_replicas: 1  num_partitions: 1 }
+    // (field 3 LEN { field 4 varint 1, field 5 varint 1 })
+    static const char kCompileOptions[] = {0x1A, 0x04, 0x20, 0x01,
+                                           0x28, 0x01};
+
+    PJRT_Client_Compile_Args args;
+    std::memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+    args.client = pred->client;
+    args.program = &program;
+    args.compile_options = kCompileOptions;
+    args.compile_options_size = sizeof(kCompileOptions);
+    PD_CHECK_PJRT(api, api->PJRT_Client_Compile(&args), return nullptr);
+    pred->executable = args.executable;
+  }
+
+  // number of outputs (via the underlying PJRT_Executable)
+  {
+    PJRT_LoadedExecutable_GetExecutable_Args gargs;
+    std::memset(&gargs, 0, sizeof(gargs));
+    gargs.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    gargs.loaded_executable = pred->executable;
+    PD_CHECK_PJRT(api, api->PJRT_LoadedExecutable_GetExecutable(&gargs),
+                  return nullptr);
+    PJRT_Executable_NumOutputs_Args nargs;
+    std::memset(&nargs, 0, sizeof(nargs));
+    nargs.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    nargs.executable = gargs.executable;
+    PD_CHECK_PJRT(api, api->PJRT_Executable_NumOutputs(&nargs),
+                  return nullptr);
+    pred->num_outputs = nargs.num_outputs;
+  }
+
+  // 4. meta + weights staged to device once
+  if (!read_meta(dir + "/meta.txt", &pred->inputs, &pred->output_names)) {
+    return nullptr;
+  }
+  std::vector<HostTensor> weights;
+  if (!read_ptw(dir + "/weights.ptw", &weights)) return nullptr;
+  for (const HostTensor& w : weights) {
+    PJRT_Buffer* buf =
+        host_to_device(api, pred->client, pred->device, w.data.data(),
+                       w.dtype, w.dims.data(), static_cast<int>(w.dims.size()));
+    if (buf == nullptr) return nullptr;
+    pred->weight_buffers.push_back(buf);
+  }
+  return pred.release();
+}
+}  // namespace
+
+int PD_NativePredictorNumInputs(PD_NativePredictor* p) {
+  return static_cast<int>(p->inputs.size());
+}
+
+int PD_NativePredictorNumOutputs(PD_NativePredictor* p) {
+  return static_cast<int>(p->output_names.size());
+}
+
+const char* PD_NativePredictorInputName(PD_NativePredictor* p, int i) {
+  if (i < 0 || i >= static_cast<int>(p->inputs.size())) return nullptr;
+  return p->inputs[static_cast<size_t>(i)].name.c_str();
+}
+
+const char* PD_NativePredictorOutputName(PD_NativePredictor* p, int i) {
+  if (i < 0 || i >= static_cast<int>(p->output_names.size())) return nullptr;
+  return p->output_names[static_cast<size_t>(i)].c_str();
+}
+
+namespace {
+int run_impl(PD_NativePredictor* p, const PD_NativeTensor* ins, int n_in,
+             PD_NativeTensor* outs, int max_out);
+}
+
+int PD_NativePredictorRun(PD_NativePredictor* p, const PD_NativeTensor* ins,
+                          int n_in, PD_NativeTensor* outs, int max_out) {
+  try {
+    return run_impl(p, ins, n_in, outs, max_out);
+  } catch (const std::exception& e) {
+    set_error(std::string("internal error: ") + e.what());
+    return -1;
+  } catch (...) {
+    set_error("internal error (unknown exception)");
+    return -1;
+  }
+}
+
+namespace {
+int run_impl(PD_NativePredictor* p, const PD_NativeTensor* ins, int n_in,
+             PD_NativeTensor* outs, int max_out) {
+  const PJRT_Api* api = p->api;
+  if (n_in != static_cast<int>(p->inputs.size())) {
+    set_error("expected " + std::to_string(p->inputs.size()) + " inputs, got " +
+              std::to_string(n_in));
+    return -1;
+  }
+
+  // stage inputs
+  std::vector<PJRT_Buffer*> input_buffers;
+  auto cleanup_inputs = [&]() {
+    for (PJRT_Buffer* b : input_buffers) destroy_buffer(api, b);
+  };
+  for (int i = 0; i < n_in; ++i) {
+    const PD_NativeTensor& t = ins[i];
+    PJRT_Buffer* buf = host_to_device(api, p->client, p->device, t.data,
+                                      t.dtype, t.dims, t.ndim);
+    if (buf == nullptr) {
+      cleanup_inputs();
+      return -1;
+    }
+    input_buffers.push_back(buf);
+  }
+
+  // argument list: weights then inputs (export.py call convention)
+  std::vector<PJRT_Buffer*> args_row;
+  args_row.reserve(p->weight_buffers.size() + input_buffers.size());
+  for (PJRT_Buffer* b : p->weight_buffers) args_row.push_back(b);
+  for (PJRT_Buffer* b : input_buffers) args_row.push_back(b);
+  PJRT_Buffer* const* arg_lists[1] = {args_row.data()};
+
+  std::vector<PJRT_Buffer*> out_row(p->num_outputs, nullptr);
+  PJRT_Buffer** out_lists[1] = {out_row.data()};
+  PJRT_Event* device_complete = nullptr;
+
+  PJRT_ExecuteOptions options;
+  std::memset(&options, 0, sizeof(options));
+  options.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  PJRT_LoadedExecutable_Execute_Args eargs;
+  std::memset(&eargs, 0, sizeof(eargs));
+  eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  eargs.executable = p->executable;
+  eargs.options = &options;
+  eargs.argument_lists = arg_lists;
+  eargs.num_devices = 1;
+  eargs.num_args = args_row.size();
+  eargs.output_lists = out_lists;
+  eargs.device_complete_events = &device_complete;
+  PD_CHECK_PJRT(api, api->PJRT_LoadedExecutable_Execute(&eargs), {
+    cleanup_inputs();
+    return -1;
+  });
+  if (!await_and_destroy_event(api, device_complete)) {
+    cleanup_inputs();
+    for (PJRT_Buffer* b : out_row) destroy_buffer(api, b);
+    return -1;
+  }
+  cleanup_inputs();
+
+  // read outputs back.  NOTE: PD_CHECK_PJRT's cleanup runs inside the
+  // macro's do-while, so `continue`/`break` must not be used there —
+  // this helper uses real returns and does NOT destroy `b` (the caller
+  // owns it on every path).
+  auto read_output = [api](PJRT_Buffer* b, PD_NativeTensor* t) -> bool {
+    std::memset(t, 0, sizeof(*t));
+
+    PJRT_Buffer_ElementType_Args targs;
+    std::memset(&targs, 0, sizeof(targs));
+    targs.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+    targs.buffer = b;
+    PD_CHECK_PJRT(api, api->PJRT_Buffer_ElementType(&targs), return false);
+    if (!pjrt_to_dtype(targs.type, &t->dtype)) {
+      set_error("unsupported output element type");
+      return false;
+    }
+
+    PJRT_Buffer_Dimensions_Args dargs;
+    std::memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    dargs.buffer = b;
+    PD_CHECK_PJRT(api, api->PJRT_Buffer_Dimensions(&dargs), return false);
+    t->ndim = static_cast<int32_t>(dargs.num_dims);
+    if (t->ndim > PD_MAX_RANK) {
+      set_error("output rank > PD_MAX_RANK");
+      return false;
+    }
+    for (int d = 0; d < t->ndim; ++d) t->dims[d] = dargs.dims[d];
+
+    PJRT_Buffer_ToHostBuffer_Args hargs;
+    std::memset(&hargs, 0, sizeof(hargs));
+    hargs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    hargs.src = b;
+    PD_CHECK_PJRT(api, api->PJRT_Buffer_ToHostBuffer(&hargs), return false);
+    t->nbytes = hargs.dst_size;
+    t->data = std::malloc(t->nbytes);
+    if (t->data == nullptr) {
+      set_error("out of host memory for output buffer");
+      return false;
+    }
+    hargs.dst = t->data;
+    bool ok = true;
+    PJRT_Error* err = api->PJRT_Buffer_ToHostBuffer(&hargs);
+    if (err != nullptr) {
+      set_error("PJRT_Buffer_ToHostBuffer: " + pjrt_error_message(api, err));
+      ok = false;
+    } else if (!await_and_destroy_event(api, hargs.event)) {
+      ok = false;
+    }
+    if (!ok) {
+      std::free(t->data);
+      t->data = nullptr;
+    }
+    return ok;
+  };
+
+  int n_out = static_cast<int>(p->num_outputs);
+  int filled = 0;
+  bool failed = false;
+  for (int i = 0; i < n_out; ++i) {
+    PJRT_Buffer* b = out_row[static_cast<size_t>(i)];
+    if (i < max_out && !failed) {
+      if (read_output(b, &outs[i])) {
+        ++filled;
+      } else {
+        failed = true;
+      }
+    }
+    destroy_buffer(api, b);
+  }
+  if (failed) {
+    for (int i = 0; i < filled; ++i) PD_NativeTensorFree(&outs[i]);
+    return -1;
+  }
+  return filled;
+}
+}  // namespace
+
+void PD_NativeTensorFree(PD_NativeTensor* t) {
+  if (t != nullptr && t->data != nullptr) {
+    std::free(t->data);
+    t->data = nullptr;
+    t->nbytes = 0;
+  }
+}
+
+void PD_NativePredictorDestroy(PD_NativePredictor* p) { delete p; }
+
+const char* PD_NativeLastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"
