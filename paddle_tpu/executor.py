@@ -103,6 +103,58 @@ def analyze_state(ops, block, feed_names, scope, skip_suffixes=()):
     return state_in, state_out, uses_rng, has_host_ops
 
 
+def _float_outputs(op_, env):
+    import jax.numpy as jnp
+
+    for name in op_.output_arg_names:
+        v = env.get(name)
+        if v is None or name == "@EMPTY@":
+            continue
+        try:
+            if jnp.issubdtype(jnp.result_type(v), jnp.inexact):
+                yield name, v
+        except Exception:
+            continue
+
+
+def _eager_nan_check(op_, env):
+    """FLAGS_check_nan_inf on the op-by-op (host-op) path — reference:
+    framework/details/nan_inf_utils_detail.cc."""
+    for name, v in _float_outputs(op_, env):
+        arr = np.asarray(v)
+        if not np.isfinite(arr).all():
+            raise RuntimeError(
+                f"Operator {op_.type!r} output {name!r} contains Inf/Nan")
+
+
+def _traced_nan_check(op_, env):
+    """Same check inside a jit trace, via checkify user checks."""
+    import jax.numpy as jnp
+    from jax.experimental import checkify
+
+    for name, v in _float_outputs(op_, env):
+        checkify.check(
+            jnp.isfinite(v).all(),
+            f"Operator {op_.type!r} output {name!r} contains Inf/Nan")
+
+
+def _report_unused_vars(ops, fetch_names, state_out):
+    """FLAGS_enable_unused_var_check — reference:
+    framework/unused_var_check.cc: flags op results nothing ever reads."""
+    import warnings
+
+    read = set(fetch_names) | set(state_out)
+    for op_ in ops:
+        read.update(op_.input_arg_names)
+    for op_ in ops:
+        dead = [n for n in op_.output_arg_names
+                if n not in read and n != "@EMPTY@"]
+        if dead:
+            warnings.warn(
+                f"operator {op_.type!r} produces unused outputs {dead} "
+                f"(FLAGS_enable_unused_var_check)", stacklevel=3)
+
+
 class Executor:
     """reference: python/paddle/fluid/executor.py:461 Executor."""
 
@@ -146,6 +198,9 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _compile(self, program: Program, feed, fetch_names, scope) -> _Compiled:
+        from .utils.flags import flag
+
+        check_nan_inf = bool(flag("check_nan_inf"))
         feed_spec = tuple(
             sorted(
                 (k, tuple(np.shape(v)),
@@ -153,7 +208,8 @@ class Executor:
                 for k, v in feed.items()
             )
         )
-        key = (id(program), program._version, feed_spec, tuple(fetch_names))
+        key = (id(program), program._version, feed_spec, tuple(fetch_names),
+               check_nan_inf)
         hit = self._cache.get(key)
         if hit is not None:
             return hit
@@ -164,6 +220,8 @@ class Executor:
         )
 
         ops = list(block.ops)
+        if flag("enable_unused_var_check"):
+            _report_unused_vars(ops, fetch_names, state_out)
         fetch = list(fetch_names)
         souts = list(state_out)
 
@@ -173,10 +231,15 @@ class Executor:
             # them.  (The analog of the reference's op-by-op Executor loop,
             # executor.cc:469-476, which PS programs inherently need.)
             def hybrid_call(feed_vals, state_vals):
+                from .profiler import RecordEvent
+
                 env: Dict[str, Any] = dict(state_vals)
                 env.update(feed_vals)
                 for op_ in ops:
-                    registry.run_op(op_, env, block)
+                    with RecordEvent(op_.type):
+                        registry.run_op(op_, env, block)
+                    if check_nan_inf:
+                        _eager_nan_check(op_, env)
                 fetched = tuple(env[n] for n in fetch)
                 new_state = {n: env[n] for n in souts if n in env}
                 return fetched, new_state
@@ -200,11 +263,27 @@ class Executor:
             env.update(feed_vals)
             for op_ in ops:
                 registry.run_op(op_, env, block)
+                if check_nan_inf:
+                    _traced_nan_check(op_, env)
             fetched = tuple(env[n] for n in fetch)
             new_state = {n: env[n] for n in souts if n in env}
             return fetched, new_state
 
-        jitted = jax.jit(fn, donate_argnums=(0,))
+        if check_nan_inf:
+            # FLAGS_check_nan_inf (reference: operator.cc:1020
+            # CheckOpHasNanOrInf) — functionalize the per-op checks with
+            # checkify so they survive jit, then re-raise on host.
+            from jax.experimental import checkify
+
+            checked = checkify.checkify(fn, errors=checkify.user_checks)
+            jitted_inner = jax.jit(checked, donate_argnums=(0,))
+
+            def jitted(mut_vals, ro_vals, feed_vals):
+                err, out = jitted_inner(mut_vals, ro_vals, feed_vals)
+                checkify.check_error(err)
+                return out
+        else:
+            jitted = jax.jit(fn, donate_argnums=(0,))
         compiled = _Compiled(jitted, state_in, state_out, fetch)
         compiled.raw_fn = fn
         compiled.donatable = tuple(donatable)
@@ -262,7 +341,10 @@ class Executor:
                 val = jax.device_put(val, device)
             state_vals[name] = val
 
-        fetched, new_state = compiled.fn(feed_vals, state_vals)
+        from .profiler import RecordEvent
+
+        with RecordEvent("executor_run"):
+            fetched, new_state = compiled.fn(feed_vals, state_vals)
         for name, val in new_state.items():
             scope.set(name, val)
 

@@ -142,6 +142,7 @@ def get_flags(keys):
     return flag_mod.get_flags(keys)
 
 
+from .. import profiler  # noqa: F401  (reference: fluid/profiler.py)
 from .. import inference  # noqa: F401  (reference: fluid.core inference api)
 from ..inference import (  # noqa: F401
     AnalysisConfig,

@@ -21,7 +21,7 @@ import numpy as np
 
 from ..framework.place import CPUPlace, TPUPlace
 from ..framework.scope import LoDTensor, Scope
-from ..framework import scope as scope_mod
+from ..framework.dtype import VarType
 from ..executor import Executor, as_numpy
 from .config import AnalysisConfig
 
@@ -123,12 +123,15 @@ class AnalysisPredictor:
     # -- init (reference: PrepareProgram analysis_predictor.cc:184) ------
     def _load_program(self):
         from ..io import load_inference_model
+        from ..framework.scope import scope_guard
 
         cfg = self._config
         dirname = cfg.model_dir()
-        prev = scope_mod._global_scope
-        scope_mod._global_scope = self._scope
-        try:
+        if dirname is None and cfg.prog_file() is None:
+            raise ValueError(
+                "AnalysisConfig has no model: pass a model dir to the "
+                "constructor or call set_model()")
+        with scope_guard(self._scope):
             if dirname is not None:
                 program, feed_names, fetch_vars = load_inference_model(
                     dirname, self._exe)
@@ -140,18 +143,23 @@ class AnalysisPredictor:
                     os.path.dirname(prog_file) or ".", self._exe,
                     model_filename=os.path.basename(prog_file),
                     params_filename=cfg.params_file())
-        finally:
-            scope_mod._global_scope = prev
         self._program = program
         self._feed_names = list(feed_names)
         self._fetch_names = [v.name for v in fetch_vars]
-        if cfg.precision() == AnalysisConfig.Precision.Bfloat16:
+        low = {AnalysisConfig.Precision.Bfloat16: VarType.BF16,
+               AnalysisConfig.Precision.Half: VarType.FP16}
+        if cfg.precision() in low:
             from ..contrib.mixed_precision.fp16_utils import cast_model_to_fp16
 
             try:
-                cast_model_to_fp16(self._program)
-            except Exception:
-                pass  # precision rewrite is best-effort on odd programs
+                cast_model_to_fp16(self._program,
+                                   dest_dtype=low[cfg.precision()])
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"requested precision {cfg.precision()} could not be "
+                    f"applied ({e}); serving in float32")
 
     # -- IO surface ------------------------------------------------------
     def get_input_names(self) -> List[str]:

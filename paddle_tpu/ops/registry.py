@@ -304,7 +304,12 @@ def run_op(op: Operator, env: Dict[str, Any], block=None):
     if d.lower is None:
         raise NotImplementedError(f"op {op.type!r} has no lowering")
     ctx = LowerCtx(op, env, block)
-    d.lower(ctx)
+    # named_scope stamps the op type into the HLO metadata, so device
+    # profiles (jax.profiler / TensorBoard) attribute kernels back to
+    # framework ops — the annotation-correlation analog of the
+    # reference's CUPTI DeviceTracer (platform/device_tracer.cc).
+    with jax.named_scope(op.type):
+        d.lower(ctx)
     return ctx
 
 

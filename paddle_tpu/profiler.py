@@ -1,0 +1,224 @@
+"""Profiler — host event tracing + device (XLA) profiler bridge.
+
+Reference: paddle/fluid/platform/profiler.h:208 (EnableProfiler/
+DisableProfiler/ResetProfiler), platform/profiler.cc (RecordEvent RAII,
+event tree, summary table, chrome-trace protobuf), python surface
+python/paddle/fluid/profiler.py (profiler/start_profiler/stop_profiler
+context managers), and the CUPTI DeviceTracer (device_tracer.h:41).
+
+TPU-native shape:
+* host events — same RecordEvent nesting/summary/chrome-trace design,
+  pure Python (host-side op dispatch is Python here; there is no C++
+  executor loop to instrument).
+* device events — XLA owns the device timeline.  The CUPTI analog is
+  the JAX/XLA profiler: ``start_profiler`` with a trace dir starts
+  ``jax.profiler`` (TensorBoard trace with per-HLO timing); op→kernel
+  correlation comes from ``jax.named_scope`` annotations emitted by the
+  executor during tracing (the annotation-correlation trick
+  device_tracer.cc uses with CUPTI correlation ids).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "RecordEvent", "record_event", "enable_profiler", "disable_profiler",
+    "reset_profiler", "start_profiler", "stop_profiler", "profiler",
+    "is_profiler_enabled", "npu_profiler", "cuda_profiler",
+]
+
+_state = threading.local()
+_GLOBAL_LOCK = threading.Lock()
+_ENABLED = False
+_TRACE_DIR: Optional[str] = None
+_EVENTS: List[dict] = []  # completed events: name, ts, dur, tid, depth
+
+
+def _stack() -> List[dict]:
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+def is_profiler_enabled() -> bool:
+    return _ENABLED
+
+
+class RecordEvent:
+    """RAII host-event marker (reference: platform/profiler.h RecordEvent;
+    used as ``with profiler.RecordEvent("fwd"): ...``).  Nested events
+    form a tree via depth; no-op when the profiler is off."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._begin = None
+
+    def __enter__(self):
+        if _ENABLED:
+            self._begin = time.perf_counter()
+            _stack().append({"name": self.name})
+        return self
+
+    def __exit__(self, *exc):
+        if self._begin is None:
+            return False
+        end = time.perf_counter()
+        stack = _stack()
+        stack.pop()
+        with _GLOBAL_LOCK:
+            _EVENTS.append({
+                "name": self.name,
+                "ts": self._begin,
+                "dur": end - self._begin,
+                "tid": threading.get_ident(),
+                "depth": len(stack),
+            })
+        return False
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """Functional spelling of RecordEvent."""
+    with RecordEvent(name):
+        yield
+
+
+def enable_profiler(state: str = "All", trace_dir: Optional[str] = None):
+    """reference: profiler.h:208 EnableProfiler.  ``state`` is kept for
+    API parity ('CPU'/'GPU'/'All'); device tracing starts whenever a
+    ``trace_dir`` is given (jax.profiler TensorBoard trace)."""
+    global _ENABLED, _TRACE_DIR
+    if state not in ("CPU", "GPU", "TPU", "All"):
+        raise ValueError("state must be 'CPU', 'GPU', 'TPU' or 'All'")
+    reset_profiler()
+    _ENABLED = True
+    if trace_dir is not None:
+        import jax
+
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        _TRACE_DIR = trace_dir
+
+
+start_profiler = enable_profiler
+
+
+def reset_profiler():
+    """reference: profiler.py reset_profiler."""
+    with _GLOBAL_LOCK:
+        _EVENTS.clear()
+
+
+def disable_profiler(sorted_key: Optional[str] = None,
+                     profile_path: Optional[str] = None):
+    """reference: profiler.h:209 DisableProfiler — stops collection,
+    prints the summary table, optionally writes a chrome-trace JSON
+    (the profiler.proto analog; load via chrome://tracing / perfetto)."""
+    global _ENABLED, _TRACE_DIR
+    _ENABLED = False
+    if _TRACE_DIR is not None:
+        import jax
+
+        jax.profiler.stop_trace()
+        _TRACE_DIR = None
+    with _GLOBAL_LOCK:
+        events = list(_EVENTS)
+    if profile_path:
+        _write_chrome_trace(events, profile_path)
+    summary = summarize(events, sorted_key or "default")
+    if summary:
+        print(_format_summary(summary))
+    return summary
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: Optional[str] = None):
+    return disable_profiler(sorted_key, profile_path)
+
+
+def summarize(events: List[dict], sorted_key: str = "default") -> List[dict]:
+    rows: Dict[str, dict] = {}
+    for e in events:
+        r = rows.setdefault(e["name"], {
+            "name": e["name"], "calls": 0, "total": 0.0,
+            "max": 0.0, "min": float("inf"),
+        })
+        r["calls"] += 1
+        r["total"] += e["dur"]
+        r["max"] = max(r["max"], e["dur"])
+        r["min"] = min(r["min"], e["dur"])
+    out = list(rows.values())
+    for r in out:
+        r["ave"] = r["total"] / r["calls"]
+        if r["min"] == float("inf"):
+            r["min"] = 0.0
+    keymap = {
+        "default": lambda r: 0,          # insertion order
+        "calls": lambda r: -r["calls"],
+        "total": lambda r: -r["total"],
+        "max": lambda r: -r["max"],
+        "min": lambda r: -r["min"],
+        "ave": lambda r: -r["ave"],
+    }
+    if sorted_key not in keymap:
+        raise ValueError(f"sorted_key must be one of {sorted(keymap)}")
+    if sorted_key != "default":
+        out.sort(key=keymap[sorted_key])
+    return out
+
+
+def _format_summary(rows: List[dict]) -> str:
+    hdr = (f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} {'Ave(ms)':>10} "
+           f"{'Max(ms)':>10} {'Min(ms)':>10}")
+    lines = ["------------------------->  Profiling Report  "
+             "<-------------------------", hdr]
+    for r in rows:
+        lines.append(
+            f"{r['name'][:40]:<40} {r['calls']:>8} {r['total']*1e3:>12.3f} "
+            f"{r['ave']*1e3:>10.3f} {r['max']*1e3:>10.3f} "
+            f"{r['min']*1e3:>10.3f}")
+    return "\n".join(lines)
+
+
+def _write_chrome_trace(events: List[dict], path: str):
+    trace = {"traceEvents": [
+        {
+            "name": e["name"], "ph": "X", "cat": "host",
+            "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
+            "pid": 0, "tid": e["tid"],
+        }
+        for e in events
+    ]}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """reference: fluid/profiler.py profiler context manager."""
+    enable_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        disable_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*args, **kwargs):
+    """Legacy API shape (reference: profiler.py cuda_profiler) — on TPU
+    the device profiler is the jax trace; kept as an alias context."""
+    with profiler():
+        yield
+
+
+npu_profiler = cuda_profiler

@@ -1,0 +1,91 @@
+"""Profiler + debug-aid tests (SURVEY.md §5 tracing / race-detection
+rows).  Reference analogs: fluid/tests/unittests/test_profiler.py and
+the FLAGS_check_nan_inf path of operator.cc:1020."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu import profiler
+
+
+def _build_mlp():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        h = fluid.layers.fc(x, 8, act="relu")
+        out = fluid.layers.mean(h)
+    return main, startup, out
+
+
+def test_profiler_summary_and_chrome_trace(tmp_path):
+    main, startup, out = _build_mlp()
+    exe = fluid.Executor(pt.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / "trace.json")
+    with profiler.profiler(state="CPU", sorted_key="total",
+                           profile_path=path):
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[out.name])
+    assert os.path.exists(path)
+    with open(path) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "executor_run" in names
+    ev = [e for e in trace["traceEvents"] if e["name"] == "executor_run"]
+    assert len(ev) == 3 and all(e["dur"] > 0 for e in ev)
+
+
+def test_record_event_nesting_and_reset():
+    profiler.enable_profiler("All")
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            pass
+    rows = profiler.disable_profiler()
+    byname = {r["name"]: r for r in rows}
+    assert byname["outer"]["calls"] == 1 and byname["inner"]["calls"] == 1
+    profiler.reset_profiler()
+    assert profiler.disable_profiler() == []
+
+
+def test_check_nan_inf_jit_path():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.log(x)  # log(negative) -> nan
+        out = fluid.layers.mean(y)
+    exe = fluid.Executor(pt.CPUPlace())
+    exe.run(startup)
+    bad = -np.ones((2, 4), np.float32)
+
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(Exception, match="Inf/Nan"):
+            exe.run(main, feed={"x": bad}, fetch_list=[out.name])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+    # with the flag off the same program runs (result is nan, no error)
+    r, = exe.run(main, feed={"x": bad}, fetch_list=[out.name])
+    assert np.isnan(np.asarray(r)).all()
+
+
+def test_unused_var_check_warns():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        dead = fluid.layers.relu(x)  # never fetched or consumed
+        out = fluid.layers.mean(x)
+    exe = fluid.Executor(pt.CPUPlace())
+    exe.run(startup)
+    fluid.set_flags({"FLAGS_enable_unused_var_check": True})
+    try:
+        with pytest.warns(UserWarning, match="unused outputs"):
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[out.name])
+    finally:
+        fluid.set_flags({"FLAGS_enable_unused_var_check": False})
