@@ -39,6 +39,19 @@ from .framework import (
 )
 from . import ops
 from . import inference
+from . import tensor
+from . import nn
+from . import metric
+from . import distribution
+from . import static
+from .tensor import (
+    to_tensor, full, full_like, zeros, ones, zeros_like, ones_like,
+    arange, linspace, matmul, concat, reshape, transpose, stack, split,
+    squeeze, unsqueeze, flatten, cast, add, subtract, multiply, divide,
+    maximum, minimum, clip, rand, randn, randint, uniform, normal,
+    argmax, argmin, topk, where, tile, expand, flip, roll, gather,
+    allclose, equal_all, bmm, dot, norm, tril, triu, numel,
+)
 from .executor import Executor
 from .backward import append_backward, gradients
 from .framework.scope import global_scope, scope_guard, LoDTensor, Scope

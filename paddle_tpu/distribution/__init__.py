@@ -1,0 +1,153 @@
+"""2.0-preview ``paddle.distribution`` namespace.
+
+Reference: python/paddle/fluid/layers/distributions.py (Distribution,
+Uniform, Normal, Categorical, MultivariateNormalDiag) — probability
+distributions built from tensor ops, usable in both dygraph and static
+mode (everything routes through the LayerHelper dispatch in
+paddle_tpu.tensor / paddle_tpu.layers).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import tensor as T
+from .. import layers as L
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _wrap(value, like=None, dtype="float32"):
+    """Lift python scalars / numpy arrays into graph values."""
+    from ..framework.core import Variable, in_dygraph_mode
+    from ..dygraph.varbase import VarBase
+
+    if isinstance(value, (Variable, VarBase)):
+        return value
+    arr = np.asarray(value, dtype=dtype)
+    return T.to_tensor(arr)
+
+
+class Distribution:
+    """reference: distributions.py Distribution base."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return T.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference: distributions.py Uniform)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _wrap(low)
+        self.high = _wrap(high)
+
+    def sample(self, shape=(), seed=0):
+        u = L.uniform_random(list(shape), "float32", 0.0, 1.0, seed)
+        width = T.subtract(self.high, self.low)
+        return T.add(self.low, T.multiply(u, width))
+
+    def log_prob(self, value):
+        width = T.subtract(self.high, self.low)
+        lb = T.cast(T.less_than(self.low, value), "float32")
+        ub = T.cast(T.less_equal(value, self.high), "float32")
+        return T.log(T.divide(T.multiply(lb, ub), width))
+
+    def entropy(self):
+        return T.log(T.subtract(self.high, self.low))
+
+    def kl_divergence(self, other):
+        # KL(U(a,b) || U(c,d)) = log((d-c)/(b-a)) when [a,b] ⊆ [c,d]
+        w_self = T.subtract(self.high, self.low)
+        w_other = T.subtract(other.high, other.low)
+        return T.log(T.divide(w_other, w_self))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference: distributions.py Normal)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _wrap(loc)
+        self.scale = _wrap(scale)
+
+    def sample(self, shape=(), seed=0):
+        eps = L.gaussian_random(list(shape), 0.0, 1.0, seed=seed)
+        return T.add(self.loc, T.multiply(eps, self.scale))
+
+    def log_prob(self, value):
+        var = T.square(self.scale)
+        diff = T.subtract(value, self.loc)
+        return T.subtract(
+            T.divide(T.multiply(T.square(diff),
+                                T.full([1], -0.5, "float32")), var),
+            T.add(T.log(self.scale),
+                  T.full([1], 0.5 * math.log(2.0 * math.pi), "float32")))
+
+    def entropy(self):
+        return T.add(T.log(self.scale),
+                     T.full([1], 0.5 + 0.5 * math.log(2.0 * math.pi),
+                            "float32"))
+
+    def kl_divergence(self, other):
+        """KL(N0||N1) = log(s1/s0) + (s0^2 + (m0-m1)^2)/(2 s1^2) - 1/2."""
+        var0 = T.square(self.scale)
+        var1 = T.square(other.scale)
+        d2 = T.square(T.subtract(self.loc, other.loc))
+        t1 = T.log(T.divide(other.scale, self.scale))
+        t2 = T.divide(T.add(var0, d2),
+                      T.multiply(var1, T.full([1], 2.0, "float32")))
+        return T.subtract(T.add(t1, t2), T.full([1], 0.5, "float32"))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized ``logits``
+    (reference: distributions.py Categorical)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _wrap(logits)
+
+    def _log_p(self):
+        lse = T.logsumexp(self.logits, axis=-1, keepdim=True)
+        return T.subtract(self.logits, lse)
+
+    def log_prob(self, value):
+        logp = self._log_p()
+        idx = T.cast(value, "int64")
+        if len(idx.shape) == len(logp.shape) - 1:
+            idx = T.unsqueeze(idx, len(idx.shape))
+        return T.squeeze(T.index_sample(logp, idx), [-1])
+
+    def entropy(self):
+        logp = self._log_p()
+        p = T.exp(logp)
+        return T.multiply(T.sum(T.multiply(p, logp), axis=-1),
+                          T.full([1], -1.0, "float32"))
+
+    def kl_divergence(self, other):
+        logp = self._log_p()
+        logq = other._log_p()
+        p = T.exp(logp)
+        return T.sum(T.multiply(p, T.subtract(logp, logq)), axis=-1)
+
+    def sample(self, shape=(), seed=0):
+        """Gumbel-max sampling — XLA-friendly (no host RNG)."""
+        sample_shape = list(shape) + list(self.logits.shape)
+        u = L.uniform_random(sample_shape, "float32", 1e-6, 1.0 - 1e-6,
+                             seed)
+        g = T.multiply(T.log(T.multiply(T.log(u),
+                                        T.full([1], -1.0, "float32"))),
+                       T.full([1], -1.0, "float32"))
+        return T.argmax(T.add(self.logits, g), axis=-1)

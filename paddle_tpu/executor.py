@@ -201,6 +201,7 @@ class Executor:
         from .utils.flags import flag
 
         check_nan_inf = bool(flag("check_nan_inf"))
+        unused_check = bool(flag("enable_unused_var_check"))
         feed_spec = tuple(
             sorted(
                 (k, tuple(np.shape(v)),
@@ -209,7 +210,7 @@ class Executor:
             )
         )
         key = (id(program), program._version, feed_spec, tuple(fetch_names),
-               check_nan_inf)
+               check_nan_inf, unused_check)
         hit = self._cache.get(key)
         if hit is not None:
             return hit
@@ -220,7 +221,7 @@ class Executor:
         )
 
         ops = list(block.ops)
-        if flag("enable_unused_var_check"):
+        if unused_check:
             _report_unused_vars(ops, fetch_names, state_out)
         fetch = list(fetch_names)
         souts = list(state_out)
@@ -276,7 +277,11 @@ class Executor:
             from jax.experimental import checkify
 
             checked = checkify.checkify(fn, errors=checkify.user_checks)
-            jitted_inner = jax.jit(checked, donate_argnums=(0,))
+            # no donation here: when the check raises, the scope still
+            # points at the input buffers — donating them would brick the
+            # session on backends that honor donation, defeating the
+            # debug flag's purpose (inspecting state after the NaN).
+            jitted_inner = jax.jit(checked)
 
             def jitted(mut_vals, ro_vals, feed_vals):
                 err, out = jitted_inner(mut_vals, ro_vals, feed_vals)

@@ -1,0 +1,22 @@
+"""2.0-preview ``paddle.metric`` namespace.
+
+Reference: python/paddle/metric/metrics.py — Metric base + Accuracy /
+Precision / Recall / Auc, aliased over the fluid metrics classes
+(paddle_tpu/metrics.py) plus the hapi variants.
+"""
+from ..metrics import (
+    MetricBase as Metric,
+    Accuracy,
+    Precision,
+    Recall,
+    Auc,
+    CompositeMetric,
+    EditDistance,
+    ChunkEvaluator,
+)
+from ..layers import accuracy, auc
+
+__all__ = [
+    "Metric", "Accuracy", "Precision", "Recall", "Auc", "CompositeMetric",
+    "EditDistance", "ChunkEvaluator", "accuracy", "auc",
+]

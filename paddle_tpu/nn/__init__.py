@@ -1,0 +1,201 @@
+"""2.0-preview ``paddle.nn`` namespace.
+
+Reference: python/paddle/nn/ — Layer classes + functional.  The Layer
+system is the dygraph one (dygraph/layers.py Layer, reference
+dygraph/layers.py); prebuilt layers alias dygraph/nn.py plus thin
+activation/loss Layer wrappers defined here.
+"""
+from __future__ import annotations
+
+from ..dygraph.layers import Layer, Sequential, LayerList, ParameterList
+from ..dygraph.nn import (
+    Linear,
+    Conv2D,
+    Conv2DTranspose,
+    Pool2D,
+    BatchNorm,
+    Embedding,
+    LayerNorm,
+    Dropout,
+    PRelu,
+    GroupNorm,
+    InstanceNorm,
+)
+from . import functional
+from . import functional as F
+
+__all__ = [
+    "Layer", "Sequential", "LayerList", "ParameterList", "Linear",
+    "Conv2D", "Conv2DTranspose", "Pool2D", "BatchNorm", "Embedding",
+    "LayerNorm", "Dropout", "PRelu", "GroupNorm", "InstanceNorm",
+    "functional", "ReLU", "ReLU6", "Sigmoid", "Tanh", "Softmax",
+    "LogSoftmax", "LeakyReLU", "GELU", "Hardswish", "Hardsigmoid", "SiLU",
+    "ELU", "Softplus", "CrossEntropyLoss", "MSELoss", "L1Loss",
+    "NLLLoss", "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss", "Flatten",
+    "AvgPool2D", "MaxPool2D", "AdaptiveAvgPool2D",
+]
+
+
+class _Activation(Layer):
+    _fn = None
+    _kwargs: dict = {}
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._call_kwargs = {**self._kwargs, **kwargs}
+
+    def forward(self, x):
+        return type(self)._fn(x, **self._call_kwargs)
+
+
+def _act_layer(name, fn, **defaults):
+    cls = type(name, (_Activation,), {"_fn": staticmethod(fn),
+                                      "_kwargs": defaults})
+    return cls
+
+
+ReLU = _act_layer("ReLU", functional.relu)
+ReLU6 = _act_layer("ReLU6", functional.relu6)
+Sigmoid = _act_layer("Sigmoid", functional.sigmoid)
+Tanh = _act_layer("Tanh", functional.tanh)
+Softmax = _act_layer("Softmax", functional.softmax)
+LogSoftmax = _act_layer("LogSoftmax", functional.log_softmax)
+LeakyReLU = _act_layer("LeakyReLU", functional.leaky_relu)
+GELU = _act_layer("GELU", functional.gelu)
+Hardswish = _act_layer("Hardswish", functional.hardswish)
+Hardsigmoid = _act_layer("Hardsigmoid", functional.hardsigmoid)
+SiLU = _act_layer("SiLU", functional.silu)
+ELU = _act_layer("ELU", functional.elu)
+Softplus = _act_layer("Softplus", functional.softplus)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        from .. import tensor as _T
+
+        return _T.flatten(x, self.start_axis, self.stop_axis)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        return functional.avg_pool2d(x, *self._args)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        return functional.max_pool2d(x, *self._args)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return functional.adaptive_avg_pool2d(x, self.output_size)
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, soft_label=False, axis=-1, reduction="mean"):
+        super().__init__()
+        self.soft_label = soft_label
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        from .. import tensor as _T
+
+        loss = functional.cross_entropy(input, label,
+                                        soft_label=self.soft_label)
+        if self.reduction == "mean":
+            return _T.mean(loss)
+        if self.reduction == "sum":
+            return _T.sum(loss)
+        return loss
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        from .. import tensor as _T
+
+        loss = functional.square_error_cost(input, label)
+        if self.reduction == "mean":
+            return _T.mean(loss)
+        if self.reduction == "sum":
+            return _T.sum(loss)
+        return loss
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return functional.l1_loss(input, label, self.reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return functional.nll_loss(input, label, reduction=self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, logit, label):
+        from .. import tensor as _T
+
+        loss = functional.binary_cross_entropy_with_logits(logit, label)
+        if self.reduction == "mean":
+            return _T.mean(loss)
+        if self.reduction == "sum":
+            return _T.sum(loss)
+        return loss
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        from .. import tensor as _T
+
+        loss = functional.smooth_l1_loss(input, label)
+        if self.reduction == "mean":
+            return _T.mean(loss)
+        if self.reduction == "sum":
+            return _T.sum(loss)
+        return loss
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return functional.kl_div(input, label, reduction=self.reduction)

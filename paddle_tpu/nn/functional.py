@@ -1,0 +1,121 @@
+"""2.0-preview ``paddle.nn.functional``.
+
+Reference: python/paddle/nn/functional/ — functional aliases over the
+layers/op registry, dygraph+static via LayerHelper dispatch.
+"""
+from __future__ import annotations
+
+from .. import layers as _L
+from ..layer_helper import LayerHelper
+from ..framework.dtype import VarType
+
+# activations
+relu = _L.relu
+relu6 = _L.relu6
+sigmoid = _L.sigmoid
+tanh = _L.tanh
+softmax = _L.softmax
+log_softmax = _L.log_softmax
+leaky_relu = _L.leaky_relu
+gelu = _L.gelu
+swish = _L.swish
+hardswish = _L.hard_swish
+prelu = _L.prelu
+softplus = _L.softplus
+softsign = _L.softsign
+
+
+def _act(op_type, x, attrs=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs=attrs or {})
+    return out
+
+
+def elu(x, alpha=1.0, name=None):
+    return _act("elu", x, {"alpha": float(alpha)})
+
+
+def silu(x, name=None):
+    return _act("silu", x)
+
+
+def hardsigmoid(x, slope=0.1667, offset=0.5, name=None):
+    return _act("hard_sigmoid", x, {"slope": float(slope),
+                                    "offset": float(offset)})
+
+
+# nn building blocks
+linear = _L.fc
+conv2d = _L.conv2d
+conv2d_transpose = _L.conv2d_transpose
+embedding = _L.embedding
+dropout = _L.dropout
+batch_norm = _L.batch_norm
+layer_norm = _L.layer_norm
+one_hot = _L.one_hot
+pad = _L.pad
+interpolate = _L.resize_bilinear
+upsample = _L.resize_bilinear
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, name=None):
+    return _L.pool2d(x, pool_size=kernel_size, pool_type="avg",
+                     pool_stride=stride or kernel_size,
+                     pool_padding=padding)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, name=None):
+    return _L.pool2d(x, pool_size=kernel_size, pool_type="max",
+                     pool_stride=stride or kernel_size,
+                     pool_padding=padding)
+
+
+def adaptive_avg_pool2d(x, output_size, name=None):
+    return _L.adaptive_pool2d(x, output_size, pool_type="avg")
+
+
+def adaptive_max_pool2d(x, output_size, name=None):
+    return _L.adaptive_pool2d(x, output_size, pool_type="max")
+
+
+# losses
+cross_entropy = _L.softmax_with_cross_entropy
+square_error_cost = _L.square_error_cost
+mse_loss = _L.mse_loss
+kl_div = _L.kldiv_loss
+log_loss = _L.log_loss
+smooth_l1_loss = _L.smooth_l1
+binary_cross_entropy_with_logits = _L.sigmoid_cross_entropy_with_logits
+label_smooth = _L.label_smooth
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _L.l2_normalize(x, axis, epsilon)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    from .. import tensor as _T
+
+    diff = _T.abs(_T.subtract(input, label))
+    if reduction == "mean":
+        return _T.mean(diff)
+    if reduction == "sum":
+        return _T.sum(diff)
+    return diff
+
+
+def nll_loss(input, label, weight=None, reduction="mean", name=None):
+    """input: log-probabilities [N, C]; label: [N] or [N, 1]."""
+    from .. import tensor as _T
+
+    if len(label.shape) == 1:
+        label = _L.unsqueeze(label, [1])
+    picked = _T.index_sample(input, label)
+    loss = _L.scale(picked, -1.0)
+    if reduction == "mean":
+        return _T.mean(loss)
+    if reduction == "sum":
+        return _T.sum(loss)
+    return loss

@@ -67,14 +67,15 @@ class RecordEvent:
     def __exit__(self, *exc):
         if self._begin is None:
             return False
+        begin, self._begin = self._begin, None
         end = time.perf_counter()
         stack = _stack()
         stack.pop()
         with _GLOBAL_LOCK:
             _EVENTS.append({
                 "name": self.name,
-                "ts": self._begin,
-                "dur": end - self._begin,
+                "ts": begin,
+                "dur": end - begin,
                 "tid": threading.get_ident(),
                 "depth": len(stack),
             })

@@ -1,0 +1,60 @@
+"""2.0-preview ``paddle.static`` namespace.
+
+Reference: the 2.0 split of the fluid static-graph API into
+paddle.static (python/paddle/ 2.0-preview layout) — aliases over the
+existing framework/executor/io machinery.
+"""
+from ..framework.core import (
+    Program,
+    program_guard,
+    default_main_program,
+    default_startup_program,
+    Variable,
+    device_guard,
+    name_scope,
+)
+from ..executor import Executor
+from ..parallel.compiled_program import CompiledProgram
+from ..backward import append_backward, gradients
+from ..framework.scope import global_scope, scope_guard
+from ..framework.place import CPUPlace, TPUPlace, CUDAPlace
+from ..layers import data
+from ..io import (
+    save,
+    load,
+    save_inference_model,
+    load_inference_model,
+    save_params,
+    load_params,
+    save_vars,
+    load_vars,
+)
+from .. import layers as nn
+
+InputSpec = None  # populated below
+
+
+class _InputSpec:
+    """reference: paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")
+
+
+InputSpec = _InputSpec
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "Variable", "device_guard", "name_scope",
+    "Executor", "CompiledProgram", "append_backward", "gradients",
+    "global_scope", "scope_guard", "CPUPlace", "TPUPlace", "CUDAPlace",
+    "data", "save", "load", "save_inference_model", "load_inference_model",
+    "save_params", "load_params", "save_vars", "load_vars", "nn",
+    "InputSpec",
+]

@@ -1,0 +1,559 @@
+"""2.0-preview ``paddle.tensor`` namespace.
+
+Reference: python/paddle/tensor/ (creation.py, math.py, manipulation.py,
+logic.py, random.py, search.py, stat.py, linalg.py) — thin functional
+layer over the op registry that works in both dygraph (traces eagerly)
+and static mode (appends ops), exactly like the reference's
+``in_dygraph_mode`` dispatch.  All functions here go through
+LayerHelper, which handles that dispatch.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import Variable, in_dygraph_mode
+from ..framework.dtype import VarType, convert_dtype
+from ..layer_helper import LayerHelper
+from .. import layers as _L
+
+__all__: list = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _op(op_type, inputs, attrs=None, n_out=1, out_dtype=None, x=None):
+    helper = LayerHelper(op_type)
+    ref = x if x is not None else next(
+        (v[0] for v in inputs.values() if v), None)
+    dtype = out_dtype if out_dtype is not None else (
+        ref.dtype if ref is not None else VarType.FP32)
+    outs = [helper.create_variable_for_type_inference(dtype)
+            for _ in range(n_out)]
+    helper.append_op(op_type, inputs=inputs, outputs={"Out": outs},
+                     attrs=attrs or {})
+    return outs[0] if n_out == 1 else outs
+
+
+def _unary(op_type, public=None):
+    def fn(x, name=None):
+        return _op(op_type, {"X": [x]})
+
+    fn.__name__ = public or op_type
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _binary(op_type, public=None, attrs=None):
+    def fn(x, y, name=None):
+        return _op(op_type, {"X": [x], "Y": [y]}, attrs=dict(attrs or {}))
+
+    fn.__name__ = public or op_type
+    __all__.append(fn.__name__)
+    return fn
+
+
+# -- creation (reference: paddle/tensor/creation.py) ----------------------
+@_export
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if in_dygraph_mode():
+        from ..dygraph.base import to_variable
+
+        arr = np.asarray(data)
+        if dtype is not None:
+            from ..framework.dtype import to_numpy_dtype
+
+            arr = arr.astype(to_numpy_dtype(convert_dtype(dtype)))
+        v = to_variable(arr)
+        v.stop_gradient = stop_gradient
+        return v
+    return _L.assign(np.asarray(data))
+
+
+@_export
+def full(shape, fill_value, dtype="float32", name=None):
+    return _L.fill_constant(shape=shape, dtype=dtype, value=fill_value)
+
+
+@_export
+def full_like(x, fill_value, dtype=None, name=None):
+    return _op("fill_any_like", {"X": [x]},
+               attrs={"value": float(fill_value),
+                      "dtype": int(convert_to_vartype(dtype))
+                      if dtype is not None else -1})
+
+
+def convert_to_vartype(dtype):
+    return convert_dtype(dtype)
+
+
+@_export
+def zeros(shape, dtype="float32", name=None):
+    return _L.zeros(shape, dtype)
+
+
+@_export
+def ones(shape, dtype="float32", name=None):
+    return _L.ones(shape, dtype)
+
+
+@_export
+def zeros_like(x, dtype=None, name=None):
+    return full_like(x, 0.0, dtype)
+
+
+@_export
+def ones_like(x, dtype=None, name=None):
+    return full_like(x, 1.0, dtype)
+
+
+@_export
+def arange(start=0, end=None, step=1, dtype="int64", name=None):
+    if end is None:
+        start, end = 0, start
+    return _L.range_(start, end, step, dtype)
+
+
+@_export
+def linspace(start, stop, num, dtype="float32", name=None):
+    return _L.linspace(start, stop, num, dtype)
+
+
+@_export
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return _L.eye(num_rows, num_columns, dtype=dtype)
+
+
+@_export
+def diag(x, offset=0, padding_value=0, name=None):
+    return _op("diag_v2", {"X": [x]},
+               attrs={"offset": offset, "padding_value": padding_value})
+
+
+@_export
+def tril(x, diagonal=0, name=None):
+    return _op("tril_triu", {"X": [x]},
+               attrs={"diagonal": diagonal, "lower": True})
+
+
+@_export
+def triu(x, diagonal=0, name=None):
+    return _op("tril_triu", {"X": [x]},
+               attrs={"diagonal": diagonal, "lower": False})
+
+
+@_export
+def meshgrid(*args, **kwargs):
+    xs = list(args[0]) if len(args) == 1 and isinstance(
+        args[0], (list, tuple)) else list(args)
+    helper = LayerHelper("meshgrid")
+    outs = [helper.create_variable_for_type_inference(xs[0].dtype)
+            for _ in xs]
+    helper.append_op("meshgrid", inputs={"X": xs}, outputs={"Out": outs})
+    return outs
+
+
+# -- math (reference: paddle/tensor/math.py) -------------------------------
+add = _binary("elementwise_add", "add")
+subtract = _binary("elementwise_sub", "subtract")
+multiply = _binary("elementwise_mul", "multiply")
+divide = _binary("elementwise_div", "divide")
+floor_divide = _binary("elementwise_floordiv", "floor_divide")
+remainder = _binary("elementwise_mod", "remainder")
+mod = remainder
+maximum = _binary("elementwise_max", "maximum")
+minimum = _binary("elementwise_min", "minimum")
+
+for _name in ("abs", "exp", "expm1", "sqrt", "rsqrt", "square", "sign",
+              "sin", "cos", "tan", "sinh", "cosh", "asin", "acos", "atan",
+              "tanh", "ceil", "floor", "round", "reciprocal", "erf",
+              "log", "log2", "log10", "log1p"):
+    globals()[_name] = _unary(_name)
+
+
+@_export
+def pow(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return _op("pow", {"X": [x]}, attrs={"factor": float(y)})
+    return _op("elementwise_pow", {"X": [x], "Y": [y]})
+
+
+def _reduce(op_type, public):
+    def fn(x, axis=None, keepdim=False, name=None):
+        attrs = {"dim": [axis] if isinstance(axis, int)
+                 else (list(axis) if axis is not None else []),
+                 "keep_dim": keepdim,
+                 "reduce_all": axis is None}
+        return _op(op_type, {"X": [x]}, attrs=attrs)
+
+    fn.__name__ = public
+    __all__.append(public)
+    return fn
+
+
+sum = _reduce("reduce_sum", "sum")
+mean = _reduce("reduce_mean", "mean")
+max = _reduce("reduce_max", "max")
+min = _reduce("reduce_min", "min")
+prod = _reduce("reduce_prod", "prod")
+all = _reduce("reduce_all", "all")
+any = _reduce("reduce_any", "any")
+
+
+@_export
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    attrs = {"axis": [axis] if isinstance(axis, int)
+             else (list(axis) if axis is not None else []),
+             "keepdim": keepdim, "reduce_all": axis is None}
+    return _op("logsumexp", {"X": [x]}, attrs=attrs)
+
+
+@_export
+def clip(x, min=None, max=None, name=None):
+    lo = float(min) if min is not None else float(np.finfo(np.float32).min)
+    hi = float(max) if max is not None else float(np.finfo(np.float32).max)
+    return _L.clip(x, lo, hi)
+
+
+@_export
+def cumsum(x, axis=None, dtype=None, name=None):
+    attrs = {"axis": axis if axis is not None else -1,
+             "flatten": axis is None}
+    return _op("cumsum", {"X": [x]}, attrs=attrs)
+
+
+@_export
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _op("addmm", {"Input": [input], "X": [x], "Y": [y]},
+               attrs={"Beta": float(beta), "Alpha": float(alpha)})
+
+
+@_export
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _op("trace", {"Input": [x]},
+               attrs={"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+@_export
+def kron(x, y, name=None):
+    return _op("kron", {"X": [x], "Y": [y]})
+
+
+@_export
+def isnan(x, name=None):
+    return _op("isnan_v2", {"X": [x]}, out_dtype=VarType.BOOL)
+
+
+@_export
+def isinf(x, name=None):
+    return _op("isinf_v2", {"X": [x]}, out_dtype=VarType.BOOL)
+
+
+@_export
+def isfinite(x, name=None):
+    return _op("isfinite_v2", {"X": [x]}, out_dtype=VarType.BOOL)
+
+
+@_export
+def increment(x, value=1.0, name=None):
+    return _L.increment(x, value)
+
+
+# -- linalg (reference: paddle/tensor/linalg.py) ---------------------------
+@_export
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _op("matmul_v2", {"X": [x], "Y": [y]},
+               attrs={"trans_x": transpose_x, "trans_y": transpose_y})
+
+
+mm = matmul
+__all__.append("mm")
+
+
+@_export
+def dot(x, y, name=None):
+    return _op("dot", {"X": [x], "Y": [y]})
+
+
+@_export
+def bmm(x, y, name=None):
+    return _op("bmm", {"X": [x], "Y": [y]})
+
+
+@_export
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro" and axis is None:
+        return _op("frobenius_norm", {"X": [x]},
+                   attrs={"dim": [], "keep_dim": keepdim,
+                          "reduce_all": True})
+    axis_ = axis if isinstance(axis, int) else -1
+    return _op("p_norm", {"X": [x]},
+               attrs={"porder": float(p), "axis": axis_,
+                      "keepdim": keepdim, "asvector": axis is None})
+
+
+@_export
+def t(x, name=None):
+    if len(x.shape) <= 1:
+        return x
+    return _L.transpose(x, [1, 0])
+
+
+@_export
+def transpose(x, perm, name=None):
+    return _L.transpose(x, perm)
+
+
+@_export
+def dist(x, y, p=2, name=None):
+    return norm(subtract(x, y), p=float(p))
+
+
+# -- manipulation (reference: paddle/tensor/manipulation.py) ---------------
+for _name, _impl in (
+    ("reshape", lambda x, shape, name=None: _L.reshape(x, shape)),
+    ("concat", lambda x, axis=0, name=None: _L.concat(x, axis)),
+    ("split", lambda x, num_or_sections, axis=0, name=None:
+        _L.split(x, num_or_sections, dim=axis)),
+    ("stack", lambda x, axis=0, name=None: _L.stack(x, axis)),
+    ("unstack", lambda x, axis=0, num=None, name=None:
+        _L.unstack(x, axis, num)),
+    ("squeeze", lambda x, axis=None, name=None: _L.squeeze(
+        x, [axis] if isinstance(axis, int) else (axis or []))),
+    ("unsqueeze", lambda x, axis, name=None: _L.unsqueeze(
+        x, [axis] if isinstance(axis, int) else list(axis))),
+    ("flatten", lambda x, start_axis=0, stop_axis=-1, name=None:
+        _op("flatten_contiguous_range", {"X": [x]},
+            attrs={"start_axis": start_axis, "stop_axis": stop_axis})),
+    ("gather", lambda x, index, axis=0, name=None:
+        _op("gather", {"X": [x], "Index": [index]}, attrs={"axis": axis})),
+    ("gather_nd", lambda x, index, name=None:
+        _L.gather_nd(x, index)),
+    ("scatter", lambda x, index, updates, overwrite=True, name=None:
+        _op("scatter", {"X": [x], "Ids": [index], "Updates": [updates]},
+            attrs={"overwrite": overwrite})),
+    ("cast", lambda x, dtype: _L.cast(x, dtype)),
+):
+    _impl.__name__ = _name
+    globals()[_name] = _impl
+    __all__.append(_name)
+
+
+@_export
+def tile(x, repeat_times, name=None):
+    return _op("tile", {"X": [x]},
+               attrs={"repeat_times": list(repeat_times)})
+
+
+@_export
+def expand(x, shape, name=None):
+    return _op("expand_v2", {"X": [x]}, attrs={"shape": list(shape)})
+
+
+@_export
+def expand_as(x, y, name=None):
+    return _op("expand_as", {"X": [x], "Y": [y]})
+
+
+@_export
+def flip(x, axis, name=None):
+    return _op("flip", {"X": [x]},
+               attrs={"axis": [axis] if isinstance(axis, int)
+                      else list(axis)})
+
+
+@_export
+def roll(x, shifts, axis=None, name=None):
+    shifts = [shifts] if isinstance(shifts, int) else list(shifts)
+    axis_ = ([axis] if isinstance(axis, int) else list(axis or []))
+    return _op("roll", {"X": [x]}, attrs={"shifts": shifts, "axis": axis_})
+
+
+@_export
+def unique(x, return_index=False, return_inverse=False,
+           return_counts=False, axis=None, dtype="int64", name=None):
+    return _op("unique", {"X": [x]}, attrs={"dtype": 3})
+
+
+@_export
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+# -- logic (reference: paddle/tensor/logic.py) -----------------------------
+equal = _binary("equal", "equal")
+not_equal = _binary("not_equal", "not_equal")
+less_than = _binary("less_than", "less_than")
+less_equal = _binary("less_equal", "less_equal")
+greater_than = _binary("greater_than", "greater_than")
+greater_equal = _binary("greater_equal", "greater_equal")
+logical_and = _binary("logical_and", "logical_and")
+logical_or = _binary("logical_or", "logical_or")
+logical_xor = _binary("logical_xor", "logical_xor")
+logical_not = _unary("logical_not", "logical_not")
+
+
+@_export
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    diff = abs(subtract(x, y))
+    tol = add(full([1], atol, "float32"),
+              multiply(full([1], rtol, "float32"), abs(y)))
+    return all(less_equal(diff, tol))
+
+
+@_export
+def equal_all(x, y, name=None):
+    return all(equal(x, y))
+
+
+@_export
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return _op("where", {"Condition": [condition], "X": [x], "Y": [y]},
+               x=x)
+
+
+# -- search (reference: paddle/tensor/search.py) ---------------------------
+@_export
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _L.argmax(x, axis if axis is not None else -1)
+
+
+@_export
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _L.argmin(x, axis if axis is not None else -1)
+
+
+@_export
+def argsort(x, axis=-1, descending=False, name=None):
+    return _L.argsort(x, axis, descending)[1]
+
+
+@_export
+def sort(x, axis=-1, descending=False, name=None):
+    return _L.argsort(x, axis, descending)[0]
+
+
+@_export
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    helper = LayerHelper("top_k_v2")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    indices = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op("top_k_v2", inputs={"X": [x]},
+                     outputs={"Out": [out], "Indices": [indices]},
+                     attrs={"k": k, "axis": axis if axis is not None else -1,
+                            "largest": largest, "sorted": sorted})
+    return out, indices
+
+
+@_export
+def index_select(x, index, axis=0, name=None):
+    return _op("index_select", {"X": [x], "Index": [index]},
+               attrs={"dim": axis})
+
+
+@_export
+def index_sample(x, index, name=None):
+    return _op("index_sample", {"X": [x], "Index": [index]})
+
+
+@_export
+def nonzero(x, as_tuple=False, name=None):
+    return _op("where_index", {"Condition": [x]}, out_dtype=VarType.INT64)
+
+
+@_export
+def masked_select(x, mask, name=None):
+    return _op("masked_select", {"X": [x], "Mask": [mask]})
+
+
+# -- random (reference: paddle/tensor/random.py) ---------------------------
+@_export
+def rand(shape, dtype="float32", name=None):
+    return _L.uniform_random(shape, dtype, 0.0, 1.0)
+
+
+@_export
+def randn(shape, dtype="float32", name=None):
+    return _L.gaussian_random(shape, 0.0, 1.0, dtype=dtype)
+
+
+@_export
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    return _L.uniform_random(shape, dtype, min, max, seed)
+
+
+@_export
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    return _L.gaussian_random(shape, mean, std)
+
+
+@_export
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return _op("randint", {}, attrs={"low": low, "high": high,
+                                     "shape": list(shape), "dtype": 3},
+               out_dtype=VarType.INT64)
+
+
+@_export
+def randperm(n, dtype="int64", name=None):
+    return _op("randperm", {}, attrs={"n": n, "dtype": 3},
+               out_dtype=VarType.INT64)
+
+
+# -- stat (reference: paddle/tensor/stat.py) -------------------------------
+@_export
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return sqrt(var(x, axis, unbiased, keepdim))
+
+
+@_export
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    m = mean(x, axis, True)
+    sq = square(subtract(x, m))
+    out = mean(sq, axis, keepdim)
+    if unbiased:
+        import numpy as _np
+
+        n = 1
+        shape = x.shape
+        if axis is None:
+            for d in shape:
+                n *= int(d)
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            for a in axes:
+                n *= int(shape[a])
+        if n > 1:
+            out = _L.scale(out, float(n) / (n - 1))
+    return out
+
+
+@_export
+def numel(x, name=None):
+    return _op("size", {"Input": [x]}, out_dtype=VarType.INT64)
+
+
+@_export
+def median(x, axis=None, keepdim=False, name=None):
+    sorted_x = sort(x, axis=axis if axis is not None else -1)
+    # middle element along the axis (upper median for even n)
+    ax = axis if axis is not None else -1
+    n = int(x.shape[ax])
+    lo = (n - 1) // 2
+    hi = n // 2
+    a = _L.slice(sorted_x, axes=[ax if ax >= 0 else len(x.shape) + ax],
+                 starts=[lo], ends=[lo + 1])
+    b = _L.slice(sorted_x, axes=[ax if ax >= 0 else len(x.shape) + ax],
+                 starts=[hi], ends=[hi + 1])
+    out = _L.scale(add(a, b), 0.5)
+    if not keepdim:
+        out = _L.squeeze(out, [ax])
+    return out

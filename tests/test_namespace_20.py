@@ -1,0 +1,148 @@
+"""2.0-preview namespace tests (SURVEY.md §2.8 "2.0-preview API" row).
+
+Reference analog: test files under python/paddle/fluid/tests/unittests
+for paddle.tensor/paddle.nn (e.g. test_zeros_op, test_arange,
+test_normal) — numpy-parity in dygraph mode and static-mode execution.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu import nn
+from paddle_tpu.dygraph import guard as dygraph_guard
+
+
+def test_tensor_math_dygraph_numpy_parity():
+    with dygraph_guard():
+        a = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        b = pt.to_tensor(np.ones((3, 4), np.float32) * 2)
+        np.testing.assert_allclose((pt.add(a, b)).numpy(),
+                                   np.arange(12).reshape(3, 4) + 2)
+        np.testing.assert_allclose(pt.tensor.sum(a, axis=1).numpy(),
+                                   np.arange(12).reshape(3, 4).sum(1))
+        np.testing.assert_allclose(
+            pt.matmul(a, pt.transpose(b, [1, 0])).numpy(),
+            np.arange(12, dtype=np.float32).reshape(3, 4) @
+            (np.ones((4, 3), np.float32) * 2))
+        np.testing.assert_allclose(pt.tensor.std(a).numpy(),
+                                   np.arange(12, dtype=np.float32).std(ddof=1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.tile(pt.to_tensor(np.array([1., 2.], np.float32)),
+                    [2]).numpy(),
+            np.tile([1., 2.], 2))
+        got = pt.tril(a).numpy()
+        np.testing.assert_allclose(got, np.tril(
+            np.arange(12, dtype=np.float32).reshape(3, 4)))
+
+
+def test_tensor_creation_and_search_dygraph():
+    with dygraph_guard():
+        z = pt.zeros([2, 3])
+        assert z.numpy().shape == (2, 3) and (z.numpy() == 0).all()
+        r = pt.arange(5)
+        np.testing.assert_array_equal(r.numpy(), np.arange(5))
+        x = pt.to_tensor(np.array([[3., 1., 2.]], np.float32))
+        v, i = pt.topk(x, 2)
+        np.testing.assert_allclose(v.numpy(), [[3., 2.]])
+        np.testing.assert_array_equal(i.numpy(), [[0, 2]])
+        assert bool(pt.allclose(x, x).numpy())
+        np.testing.assert_array_equal(
+            pt.flip(x, 1).numpy(), [[2., 1., 3.]])
+
+
+def test_tensor_namespace_static_mode():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = pt.tensor.mean(pt.multiply(x, x))
+    exe = fluid.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+    r, = exe.run(main, feed={"x": xv}, fetch_list=[y.name])
+    np.testing.assert_allclose(np.asarray(r), (xv * xv).mean(), rtol=1e-6)
+
+
+def test_nn_layers_and_losses_dygraph():
+    with dygraph_guard():
+        model = nn.Sequential(
+            nn.Linear(8, 16),
+            nn.ReLU(),
+            nn.Linear(16, 4),
+        )
+        x = pt.to_tensor(np.random.RandomState(0).rand(2, 8).astype("f4"))
+        out = model(x)
+        assert tuple(out.shape) == (2, 4)
+
+        label = pt.to_tensor(np.array([[1], [3]], np.int64))
+        loss = nn.CrossEntropyLoss()(out, label)
+        assert loss.numpy().size == 1 and np.isfinite(loss.numpy()).all()
+
+        mse = nn.MSELoss()(out, pt.zeros_like(out))
+        np.testing.assert_allclose(mse.numpy(), (out.numpy() ** 2).mean(),
+                                   rtol=1e-5)
+
+        l1 = nn.L1Loss()(out, pt.zeros_like(out))
+        np.testing.assert_allclose(l1.numpy(),
+                                   np.abs(out.numpy()).mean(), rtol=1e-5)
+
+
+def test_metric_namespace():
+    m = pt.metric.Accuracy()
+    assert m is not None
+    assert "Precision" in pt.metric.__all__
+
+
+def test_distribution_normal_uniform():
+    with dygraph_guard():
+        n = pt.distribution.Normal(0.0, 1.0)
+        lp = n.log_prob(pt.to_tensor(np.array([0.0], np.float32)))
+        np.testing.assert_allclose(lp.numpy(),
+                                   -0.5 * np.log(2 * np.pi), rtol=1e-5)
+        ent = n.entropy()
+        np.testing.assert_allclose(ent.numpy(),
+                                   0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-5)
+        n2 = pt.distribution.Normal(1.0, 2.0)
+        kl = n.kl_divergence(n2)
+        want = np.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5
+        np.testing.assert_allclose(kl.numpy(), want, rtol=1e-5)
+
+        u = pt.distribution.Uniform(0.0, 2.0)
+        np.testing.assert_allclose(u.entropy().numpy(), np.log(2.0),
+                                   rtol=1e-6)
+        s = u.sample([100])
+        arr = s.numpy()
+        assert (arr >= 0).all() and (arr <= 2).all()
+
+
+def test_distribution_categorical():
+    with dygraph_guard():
+        logits = pt.to_tensor(np.log(np.array([[0.2, 0.3, 0.5]], "f4")))
+        c = pt.distribution.Categorical(logits)
+        ent = c.entropy()
+        want = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+        np.testing.assert_allclose(ent.numpy(), [want], rtol=1e-5)
+        lp = c.log_prob(pt.to_tensor(np.array([2], np.int64)))
+        np.testing.assert_allclose(lp.numpy(), [np.log(0.5)], rtol=1e-5)
+        c2 = pt.distribution.Categorical(
+            pt.to_tensor(np.log(np.array([[1 / 3, 1 / 3, 1 / 3]], "f4"))))
+        kl = c.kl_divergence(c2)
+        p = np.array([0.2, 0.3, 0.5])
+        want_kl = (p * np.log(p * 3)).sum()
+        np.testing.assert_allclose(kl.numpy(), [want_kl], rtol=1e-5)
+
+
+def test_static_namespace():
+    main = pt.static.Program()
+    startup = pt.static.Program()
+    with pt.static.program_guard(main, startup):
+        x = pt.static.data("x", [4])
+        y = pt.tensor.sum(x)
+    exe = pt.static.Executor(pt.CPUPlace())
+    exe.run(startup)
+    r, = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                 fetch_list=[y.name])
+    np.testing.assert_allclose(np.asarray(r), 8.0)
+    spec = pt.static.InputSpec([None, 8], "float32", "x")
+    assert spec.shape == (None, 8)
