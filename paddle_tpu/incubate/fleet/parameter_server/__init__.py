@@ -80,6 +80,11 @@ class FleetTranspiler(Fleet):
             size = int(np.prod([abs(s) for s in var.shape]))
             cfg = _optimizer_cfg_from_ops(t._opt_ops, p, self._origin_lr)
             self._client.create_dense(p, size, **cfg)
+        # sparse embedding tables (downpour-style: rows materialize on
+        # first pull server-side; no trainer init push)
+        for tname, dim in getattr(t, "_sparse_tables", {}).items():
+            cfg = _optimizer_cfg_from_ops(t._opt_ops, tname, self._origin_lr)
+            self._client.create_sparse(tname, dim, **cfg)
         if self.worker_index() == 0:
             # push locally-initialized params (reference: trainer0 bcast)
             from ....framework.scope import global_scope

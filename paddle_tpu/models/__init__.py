@@ -1,3 +1,6 @@
 from . import resnet
 from . import bert
 from . import lenet
+from . import mobilenet
+from . import rec
+from . import word2vec

@@ -1,0 +1,100 @@
+"""Recommendation models: wide_deep and DeepFM (PaddleRec-style).
+
+Capability target: BASELINE.json config #5 (PaddleRec wide_deep /
+DeepFM on the parameter-server sparse embedding path).  Input
+convention matches PaddleRec's criteo reader: ``sparse_inputs`` is a
+list of int64 slot tensors [N, 1] (one per categorical feature slot),
+``dense_input`` is [N, dense_dim].  Pass ``is_distributed=True`` to
+route embeddings through the PS sparse table
+(distributed_lookup_table — ops/ps_ops.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def _slot_embeddings(sparse_inputs, vocab_size, dim, prefix,
+                     is_distributed=False, shared_table=True):
+    """One embedding per slot id, all slots sharing one table (the
+    PaddleRec criteo convention: ids are pre-hashed into one space)."""
+    outs = []
+    param = ParamAttr(name=f"{prefix}_emb") if shared_table else None
+    for i, ids in enumerate(sparse_inputs):
+        attr = param if shared_table else ParamAttr(name=f"{prefix}_emb_{i}")
+        outs.append(layers.embedding(
+            ids, size=[vocab_size, dim], is_sparse=True,
+            is_distributed=is_distributed, param_attr=attr))
+    return outs
+
+
+def build_wide_deep(sparse_inputs, dense_input, label=None,
+                    vocab_size=100_000, embed_dim=8,
+                    hidden_units=(400, 400, 400), is_distributed=False):
+    """wide&deep CTR model.  Returns (loss, auc_like, prob) with label,
+    else prob."""
+    # wide part: first-order weights per id (dim-1 embedding) + dense fc
+    wide_embs = _slot_embeddings(sparse_inputs, vocab_size, 1, "wide",
+                                 is_distributed)
+    wide = layers.elementwise_add(
+        layers.sums([layers.reshape(e, [-1, 1]) for e in wide_embs]),
+        layers.fc(dense_input, 1))
+
+    # deep part: concat slot embeddings + dense, MLP
+    deep_embs = _slot_embeddings(sparse_inputs, vocab_size, embed_dim,
+                                 "deep", is_distributed)
+    deep = layers.concat([layers.reshape(e, [-1, embed_dim])
+                          for e in deep_embs] + [dense_input], axis=1)
+    for h in hidden_units:
+        deep = layers.fc(deep, h, act="relu")
+    deep = layers.fc(deep, 1)
+
+    logit = layers.elementwise_add(wide, deep)
+    prob = layers.sigmoid(logit)
+    if label is None:
+        return prob
+    label_f = layers.cast(label, "float32")
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label_f))
+    return loss, prob
+
+
+def build_deepfm(sparse_inputs, dense_input, label=None,
+                 vocab_size=100_000, embed_dim=8,
+                 hidden_units=(128, 128), is_distributed=False):
+    """DeepFM: first-order + pairwise FM interactions + DNN.
+    Returns (loss, prob) with label, else prob."""
+    # first order
+    fo_embs = _slot_embeddings(sparse_inputs, vocab_size, 1, "fm_fo",
+                               is_distributed)
+    first_order = layers.elementwise_add(
+        layers.sums([layers.reshape(e, [-1, 1]) for e in fo_embs]),
+        layers.fc(dense_input, 1))
+
+    # second order: 0.5 * ((sum v)^2 - sum v^2), summed over dims
+    embs = _slot_embeddings(sparse_inputs, vocab_size, embed_dim, "fm",
+                            is_distributed)
+    vs = [layers.reshape(e, [-1, embed_dim]) for e in embs]
+    sum_v = layers.sums(vs)
+    sum_v_sq = layers.elementwise_mul(sum_v, sum_v)
+    sq_sum_v = layers.sums([layers.elementwise_mul(v, v) for v in vs])
+    second_order = layers.reduce_sum(
+        layers.scale(layers.elementwise_sub(sum_v_sq, sq_sum_v), 0.5),
+        dim=[1], keep_dim=True)
+
+    # deep part over the same embeddings
+    deep = layers.concat(vs + [dense_input], axis=1)
+    for h in hidden_units:
+        deep = layers.fc(deep, h, act="relu")
+    deep = layers.fc(deep, 1)
+
+    logit = layers.sums([first_order, second_order, deep])
+    prob = layers.sigmoid(logit)
+    if label is None:
+        return prob
+    label_f = layers.cast(label, "float32")
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label_f))
+    return loss, prob

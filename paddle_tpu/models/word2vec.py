@@ -1,0 +1,28 @@
+"""word2vec (N-gram language model) — the tests/book word2vec chapter.
+
+Reference analog: python/paddle/fluid/tests/book/test_word2vec.py —
+4-context-word N-gram with a shared embedding table, concat, hidden
+layer, softmax over the vocabulary.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def build_word2vec(context_words, target_word, dict_size,
+                   embed_dim=32, hidden_size=256):
+    """``context_words``: list of int64 [N, 1] tensors; ``target_word``
+    int64 [N, 1].  Returns (avg_loss, predict_probs)."""
+    shared = ParamAttr(name="shared_w")
+    embeds = [
+        layers.embedding(w, size=[dict_size, embed_dim], param_attr=shared)
+        for w in context_words
+    ]
+    concat = layers.concat(
+        [layers.reshape(e, [-1, embed_dim]) for e in embeds], axis=1)
+    hidden = layers.fc(concat, hidden_size, act="sigmoid")
+    logits = layers.fc(hidden, dict_size)
+    predict = layers.softmax(logits)
+    loss = layers.softmax_with_cross_entropy(logits, target_word)
+    return layers.mean(loss), predict

@@ -112,13 +112,15 @@ class CrossEntropyLoss(Layer):
     def __init__(self, soft_label=False, axis=-1, reduction="mean"):
         super().__init__()
         self.soft_label = soft_label
+        self.axis = axis
         self.reduction = reduction
 
     def forward(self, input, label):
         from .. import tensor as _T
 
         loss = functional.cross_entropy(input, label,
-                                        soft_label=self.soft_label)
+                                        soft_label=self.soft_label,
+                                        axis=self.axis)
         if self.reduction == "mean":
             return _T.mean(loss)
         if self.reduction == "sum":
@@ -180,11 +182,13 @@ class SmoothL1Loss(Layer):
     def __init__(self, reduction="mean", delta=1.0):
         super().__init__()
         self.reduction = reduction
+        self.delta = delta
 
     def forward(self, input, label):
         from .. import tensor as _T
+        from ..layers import huber_loss
 
-        loss = functional.smooth_l1_loss(input, label)
+        loss = huber_loss(input, label, self.delta)
         if self.reduction == "mean":
             return _T.mean(loss)
         if self.reduction == "sum":

@@ -79,9 +79,14 @@ def _distributed_lookup_table(ctx):
     outs = []
     for ids in ids_vals:
         ids_np = np.asarray(ids).astype(np.int64)
+        # match lookup_table's shape rule (nn_ops._lookup): a trailing
+        # ids dim of 1 is squeezed, so local and PS runs agree
+        shape = ids_np.shape
+        if len(shape) > 1 and shape[-1] == 1:
+            shape = shape[:-1]
         flat = ids_np.ravel()
         rows = client.pull_sparse(table, flat)
-        outs.append(rows.reshape(ids_np.shape + (dim,)))
+        outs.append(rows.reshape(shape + (dim,)))
     ctx.set_out("Outputs", outs)
 
 

@@ -13,6 +13,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+import builtins
+
 from ..framework.core import Variable, in_dygraph_mode
 from ..framework.dtype import VarType, convert_dtype
 from ..layer_helper import LayerHelper
@@ -290,9 +292,10 @@ def norm(x, p="fro", axis=None, keepdim=False, name=None):
         return _op("frobenius_norm", {"X": [x]},
                    attrs={"dim": [], "keep_dim": keepdim,
                           "reduce_all": True})
+    porder = 2.0 if p == "fro" else float(p)  # fro over an axis == 2-norm
     axis_ = axis if isinstance(axis, int) else -1
     return _op("p_norm", {"X": [x]},
-               attrs={"porder": float(p), "axis": axis_,
+               attrs={"porder": porder, "axis": axis_,
                       "keepdim": keepdim, "asvector": axis is None})
 
 
@@ -376,6 +379,10 @@ def roll(x, shifts, axis=None, name=None):
 @_export
 def unique(x, return_index=False, return_inverse=False,
            return_counts=False, axis=None, dtype="int64", name=None):
+    if return_index or return_inverse or return_counts:
+        raise NotImplementedError(
+            "unique(return_index/return_inverse/return_counts) is not "
+            "supported yet; only the unique values are returned")
     return _op("unique", {"X": [x]}, attrs={"dtype": 3})
 
 
@@ -402,7 +409,11 @@ def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     diff = abs(subtract(x, y))
     tol = add(full([1], atol, "float32"),
               multiply(full([1], rtol, "float32"), abs(y)))
-    return all(less_equal(diff, tol))
+    ok = less_equal(diff, tol)
+    if equal_nan:
+        both_nan = logical_and(isnan(x), isnan(y))
+        ok = logical_or(ok, both_nan)
+    return all(ok)
 
 
 @_export
@@ -421,12 +432,18 @@ def where(condition, x=None, y=None, name=None):
 # -- search (reference: paddle/tensor/search.py) ---------------------------
 @_export
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
-    return _L.argmax(x, axis if axis is not None else -1)
+    return _op("arg_max", {"X": [x]},
+               attrs={"axis": axis if axis is not None else -1,
+                      "keepdims": keepdim, "flatten": axis is None},
+               out_dtype=VarType.INT64)
 
 
 @_export
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
-    return _L.argmin(x, axis if axis is not None else -1)
+    return _op("arg_min", {"X": [x]},
+               attrs={"axis": axis if axis is not None else -1,
+                      "keepdims": keepdim, "flatten": axis is None},
+               out_dtype=VarType.INT64)
 
 
 @_export
@@ -490,7 +507,8 @@ def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
 
 @_export
 def normal(mean=0.0, std=1.0, shape=None, name=None):
-    return _L.gaussian_random(shape, mean, std)
+    return _L.gaussian_random(list(shape) if shape is not None else [1],
+                              mean, std)
 
 
 @_export
@@ -520,19 +538,28 @@ def var(x, axis=None, unbiased=True, keepdim=False, name=None):
     sq = square(subtract(x, m))
     out = mean(sq, axis, keepdim)
     if unbiased:
-        import numpy as _np
-
-        n = 1
-        shape = x.shape
-        if axis is None:
-            for d in shape:
-                n *= int(d)
+        shape = list(x.shape)
+        rank = len(shape)
+        axes = (list(range(rank)) if axis is None
+                else [axis] if isinstance(axis, int) else list(axis))
+        axes = [a % rank for a in axes]
+        dims = [int(shape[a]) for a in axes]
+        if builtins.all(d >= 0 for d in dims):
+            n = 1
+            for d in dims:
+                n *= d
+            if n > 1:
+                out = _L.scale(out, float(n) / (n - 1))
         else:
-            axes = [axis] if isinstance(axis, int) else list(axis)
-            for a in axes:
-                n *= int(shape[a])
-        if n > 1:
-            out = _L.scale(out, float(n) / (n - 1))
+            # symbolic (-1) dim in the reduced axes: compute the n/(n-1)
+            # correction from the runtime shape
+            shp = _L.shape(x)
+            picked = index_select(
+                shp, to_tensor(np.asarray(axes, np.int64)), axis=0)
+            n = cast(prod(picked), "float32")
+            one = full([1], 1.0, "float32")
+            corr = divide(n, maximum(subtract(n, one), one))
+            out = multiply(out, corr)
     return out
 
 

@@ -125,6 +125,79 @@ class DistributeTranspiler:
         for i in reversed(opt_op_idxs):
             block._remove_op(i)
 
+        # -- distributed sparse embeddings (reference: distribute_
+        # transpiler.py:1761 _replace_lookup_table_op_with_prefetch):
+        # lookup_table ops whose table was built with is_distributed=True
+        # become remote pulls against the PS sparse table, their grad ops
+        # become sparse pushes, and the table leaves the dense param set.
+        self._sparse_tables: Dict[str, int] = {}
+        for op_ in block.ops:
+            if op_.type == "lookup_table" and op_.input("W"):
+                wname = op_.input("W")[0]
+                wvar = block._find_var_recursive(wname)
+                if wvar is not None and (
+                        getattr(wvar, "is_distributed", False)
+                        or op_.attr("is_distributed", False)):
+                    self._sparse_tables[wname] = int(wvar.shape[-1])
+        if self._sparse_tables:
+            from collections import OrderedDict
+
+            grad_suffix = "@GRAD"
+            for op_ in block.ops:
+                if op_.type == "lookup_table" and \
+                        op_.input("W")[0] in self._sparse_tables:
+                    wname = op_.input("W")[0]
+                    op_.type = "distributed_lookup_table"
+                    op_.inputs = OrderedDict({"Ids": list(op_.input("Ids"))})
+                    op_.outputs = OrderedDict(
+                        {"Outputs": list(op_.output("Out"))})
+                    op_.attrs = {"table_name": wname,
+                                 "emb_dim": self._sparse_tables[wname],
+                                 OP_ROLE_KEY: OpRole.Forward}
+                elif op_.type == "lookup_table_grad" and \
+                        op_.input("W") and \
+                        op_.input("W")[0] in self._sparse_tables:
+                    wname = op_.input("W")[0]
+                    out_grads = []
+                    for slot, names in op_.inputs.items():
+                        if slot.endswith(grad_suffix):
+                            out_grads = list(names)
+                    op_.type = "distributed_lookup_table_grad"
+                    op_.inputs = OrderedDict({
+                        "Ids": list(op_.input("Ids")),
+                        "Outputs" + grad_suffix: out_grads,
+                    })
+                    op_.outputs = OrderedDict()
+                    op_.attrs = {"table_name": wname,
+                                 "emb_dim": self._sparse_tables[wname],
+                                 OP_ROLE_KEY: OpRole.Backward}
+            # drop the grad accumulators for sparse tables (the backward
+            # pass sums multi-consumer W@GRAD contributions — remote
+            # pushes made them dead, and their @RENAME inputs are gone)
+            dead_prefixes = tuple(f"{t}@GRAD" for t in self._sparse_tables)
+            for i in reversed(range(len(block.ops))):
+                outs = block.ops[i].output_arg_names
+                if outs and all(o.startswith(dead_prefixes) for o in outs):
+                    block._remove_op(i)
+            # the table itself lives only on the pservers now: drop its
+            # local init (the reference deletes the var from trainer
+            # programs so a 1e8-row table never materializes host-side)
+            sparse_and_grads = set(self._sparse_tables) | {
+                n for n in block.vars
+                if n.startswith(dead_prefixes)}
+            sblock = self.startup_program.global_block()
+            for i in reversed(range(len(sblock.ops))):
+                outs = sblock.ops[i].output_arg_names
+                if outs and all(o in self._sparse_tables for o in outs):
+                    sblock._remove_op(i)
+            for name in self._sparse_tables:
+                sblock.vars.pop(name, None)
+            for name in sparse_and_grads:
+                block.vars.pop(name, None)
+            param_grads = [(p, g) for (p, g) in param_grads
+                           if p not in self._sparse_tables]
+            self._param_grads = param_grads
+
         # round-robin assign params to pservers (reference uses RoundRobin)
         eps = self.pserver_endpoints
         self._ep_params: Dict[str, List[str]] = {ep: [] for ep in eps}

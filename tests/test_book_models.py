@@ -1,0 +1,174 @@
+"""Book-style end-to-end model tests (SURVEY.md §4 item 3: tests/book
+train real programs to a loss threshold).
+
+Covers the BASELINE model families not yet under test: MobileNetV3
+(config #4), wide_deep / DeepFM (config #5), and the word2vec book
+chapter.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models.mobilenet import build_mobilenet_v3
+from paddle_tpu.models.rec import build_deepfm, build_wide_deep
+from paddle_tpu.models.word2vec import build_word2vec
+
+
+def _train(main, startup, feeder, loss_name, steps, lr=0.05, opt=None):
+    exe = fluid.Executor(pt.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for i in range(steps):
+        feed = feeder(i)
+        l, = exe.run(main, feed=feed, fetch_list=[loss_name])
+        losses.append(float(np.asarray(l).ravel()[0]))
+    return losses
+
+
+def test_mobilenet_v3_small_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 32, 32])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        loss, acc1, logits = build_mobilenet_v3(img, label, class_num=10,
+                                                scale="small")
+        fluid.optimizer.MomentumOptimizer(0.02, 0.9).minimize(loss)
+    rng = np.random.RandomState(0)
+    # tiny fixed dataset: loss must fall (memorization)
+    xs = rng.rand(8, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 10, (8, 1)).astype(np.int64)
+    losses = _train(main, startup,
+                    lambda i: {"img": xs, "label": ys},
+                    loss.name, steps=12)
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.parametrize("builder", [build_wide_deep, build_deepfm])
+def test_ctr_models_train(builder):
+    n_slots, vocab, batch = 5, 1000, 32
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        sparse = [fluid.layers.data(f"s{i}", [1], dtype="int64")
+                  for i in range(n_slots)]
+        dense = fluid.layers.data("dense", [4])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        out = builder(sparse, dense, label, vocab_size=vocab, embed_dim=8)
+        loss = out[0]
+        fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, vocab, (batch, n_slots)).astype(np.int64)
+    dense_x = rng.rand(batch, 4).astype(np.float32)
+    # learnable rule: label depends on slot0 parity
+    y = (ids[:, 0] % 2).reshape(-1, 1).astype(np.int64)
+
+    def feeder(i):
+        feed = {f"s{k}": ids[:, k:k + 1] for k in range(n_slots)}
+        feed["dense"] = dense_x
+        feed["label"] = y
+        return feed
+
+    losses = _train(main, startup, feeder, loss.name, steps=60)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_word2vec_ngram_trains_and_roundtrips(tmp_path):
+    dict_size, ctx = 50, 4
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        words = [fluid.layers.data(f"w{i}", [1], dtype="int64")
+                 for i in range(ctx)]
+        target = fluid.layers.data("target", [1], dtype="int64")
+        loss, predict = build_word2vec(words, target, dict_size,
+                                       embed_dim=16, hidden_size=32)
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    seq = rng.randint(0, dict_size, 400)
+
+    def feeder(i):
+        starts = rng.randint(0, len(seq) - ctx - 1, 64)
+        feed = {f"w{k}": seq[starts + k].reshape(-1, 1).astype(np.int64)
+                for k in range(ctx)}
+        feed["target"] = seq[starts + ctx].reshape(-1, 1).astype(np.int64)
+        return feed
+
+    losses = _train(main, startup, feeder, loss.name, steps=30)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    # book-style save/load_inference_model round trip
+    exe = fluid.Executor(pt.CPUPlace())
+    model_dir = str(tmp_path / "w2v")
+    fluid.io.save_inference_model(model_dir, [f"w{i}" for i in range(ctx)],
+                                  [predict], exe, main_program=main)
+    prog, feeds, fetches = fluid.io.load_inference_model(model_dir, exe)
+    assert feeds == [f"w{i}" for i in range(ctx)]
+    feed = {f"w{k}": np.zeros((2, 1), np.int64) for k in range(ctx)}
+    p, = exe.run(prog, feed=feed, fetch_list=[fetches[0].name])
+    assert np.asarray(p).shape == (2, dict_size)
+    np.testing.assert_allclose(np.asarray(p).sum(1), 1.0, rtol=1e-4)
+
+
+def test_wide_deep_on_parameter_server():
+    """BASELINE config #5: CTR model with distributed sparse embeddings
+    training through the PS path (reference analog: test_dist_fleet_ctr)."""
+    from paddle_tpu.incubate.fleet.parameter_server import (
+        FleetTranspiler, _optimizer_cfg_from_ops)
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        UserDefinedRoleMaker, Role)
+    from paddle_tpu.distributed_ps.service import PSServer
+    from paddle_tpu.distributed_ps import runtime
+
+    n_slots, vocab, batch = 3, 500, 16
+    server = PSServer("127.0.0.1:0", n_trainers=1).start()
+    fleet = FleetTranspiler()
+    try:
+        fleet.init(UserDefinedRoleMaker(
+            current_id=0, role=Role.WORKER, worker_num=1,
+            server_endpoints=[server.endpoint]))
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 11
+        with fluid.program_guard(main, startup):
+            sparse = [fluid.layers.data(f"s{i}", [1], dtype="int64")
+                      for i in range(n_slots)]
+            dense = fluid.layers.data("dense", [4])
+            label = fluid.layers.data("label", [1], dtype="int64")
+            loss, prob = build_wide_deep(
+                sparse, dense, label, vocab_size=vocab, embed_dim=4,
+                hidden_units=(32,), is_distributed=True)
+            opt = fluid.optimizer.SGDOptimizer(0.05)
+            fleet.distributed_optimizer(opt).minimize(loss)
+
+        types = [op.type for op in main.global_block().ops]
+        assert "distributed_lookup_table" in types
+        assert "distributed_lookup_table_grad" in types
+        assert "lookup_table" not in types
+        assert "send" in types and "recv" in types
+
+        exe = fluid.Executor(pt.CPUPlace())
+        exe.run(startup)
+        fleet.init_worker()
+        try:
+            rng = np.random.RandomState(2)
+            ids = rng.randint(0, vocab, (batch, n_slots)).astype(np.int64)
+            dense_x = rng.rand(batch, 4).astype(np.float32)
+            y = (ids[:, 0] % 2).reshape(-1, 1).astype(np.int64)
+            losses = []
+            for _ in range(30):
+                feed = {f"s{k}": ids[:, k:k + 1] for k in range(n_slots)}
+                feed["dense"] = dense_x
+                feed["label"] = y
+                l, = exe.run(main, feed=feed, fetch_list=[loss.name])
+                losses.append(float(np.asarray(l).ravel()[0]))
+            assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+            assert np.isfinite(losses).all()
+        finally:
+            fleet.stop_worker()
+    finally:
+        server.stop()
+        runtime.clear()
