@@ -50,12 +50,14 @@ OP_ROLE_VAR_KEY = "op_role_var"
 def _ensure_grad_var(block: Block, grad_name: str):
     if grad_name == EMPTY_VAR_NAME or block.has_var(grad_name):
         return
-    fwd_name = grad_name[: -len(GRAD_SUFFIX)] if grad_name.endswith(GRAD_SUFFIX) else None
     base = grad_name
-    # handle renamed accumulation slots: X@GRAD@RENAME@0
-    if "@RENAME" in grad_name:
-        base = grad_name.split("@RENAME")[0]
-        fwd_name = base[: -len(GRAD_SUFFIX)] if base.endswith(GRAD_SUFFIX) else None
+    # renamed accumulation slots (X@GRAD@RENAME_0) and higher-order
+    # collision renames (X@GRAD@GRADX_0) both reduce to their base name
+    if "@RENAME" in base:
+        base = base.split("@RENAME")[0]
+    if "@GRADX" in base:
+        base = base.split("@GRADX")[0]
+    fwd_name = base[: -len(GRAD_SUFFIX)] if base.endswith(GRAD_SUFFIX) else None
     fvar = block._find_var_recursive(fwd_name) if fwd_name else None
     if fvar is not None:
         block.create_var(
@@ -110,6 +112,23 @@ def append_backward(
 
     known_grads: Set[str] = {loss_grad_name}
     produced: Set[str] = {loss_grad_name}
+    # Higher-order support: when a grad var name collides with one that
+    # already exists in the block from an earlier append_backward (e.g.
+    # "x@GRAD" while computing grad-of-grad), this pass's grad gets a
+    # fresh name; the map tracks original->actual for this pass.
+    rename: Dict[str, str] = {}
+    created: Set[str] = {loss_grad_name}
+
+    def _actual_out(n: str) -> str:
+        if n == EMPTY_VAR_NAME or not n.endswith(GRAD_SUFFIX):
+            return n
+        if n in rename:
+            return rename[n]
+        if block.has_var(n) and n not in created:
+            fresh = unique_name.generate(n + "@GRADX")
+            rename[n] = fresh
+            return fresh
+        return n
 
     for op_ in reversed(block.ops[: loss_idx + 1]):
         if not registry.has_grad(op_.type):
@@ -119,14 +138,27 @@ def append_backward(
             continue
         grad_descs = registry.make_grad_ops(op_, no_grad_names)
         for desc in grad_descs:
-            # rewrite unavailable input grads to @EMPTY@ (treated as zeros)
+            # cotangent slots: the ones the maker added for the fwd op's
+            # outputs (an endswith test would also catch @GRAD-named DATA
+            # inputs of grad-of-grad ops)
+            fwd_outs = desc.get("attrs", {}).get("__fwd_out_slots__")
+            if fwd_outs is not None:
+                cot_slots = {s + GRAD_SUFFIX for s in fwd_outs}
+            else:
+                cot_slots = {s for s in desc["inputs"]
+                             if s.endswith(GRAD_SUFFIX)}
+            # rewrite unavailable input grads to @EMPTY@ (treated as
+            # zeros), mapping through this pass's renames
             for slot, names in desc["inputs"].items():
-                if slot.endswith(GRAD_SUFFIX):
+                if slot in cot_slots:
                     desc["inputs"][slot] = [
-                        n if n in known_grads or not n.endswith(GRAD_SUFFIX) else EMPTY_VAR_NAME
+                        (rename.get(n, n)
+                         if n in known_grads or not n.endswith(GRAD_SUFFIX)
+                         else EMPTY_VAR_NAME)
                         for n in names
                     ]
-            # online accumulation of repeated grads
+            # online accumulation of repeated grads (names first mapped
+            # through the higher-order rename)
             accum_pairs = []
             for slot, names in desc["outputs"].items():
                 new_names = []
@@ -134,12 +166,16 @@ def append_backward(
                     if n == EMPTY_VAR_NAME or not n.endswith(GRAD_SUFFIX):
                         new_names.append(n)
                         continue
+                    actual = _actual_out(n)
                     if n in produced:
-                        renamed = unique_name.generate(n + "@RENAME")
-                        accum_pairs.append((n, renamed))
+                        renamed = unique_name.generate(actual + "@RENAME")
+                        accum_pairs.append((actual, renamed))
                         new_names.append(renamed)
                     else:
-                        new_names.append(n)
+                        new_names.append(actual)
+                        created.add(actual)
+                    produced.add(n)
+                    known_grads.add(n)
                 desc["outputs"][slot] = new_names
 
             for slot, names in {**desc["inputs"], **desc["outputs"]}.items():
@@ -157,14 +193,7 @@ def append_backward(
                     outputs={"Out": [target]},
                     attrs={OP_ROLE_KEY: OpRole.Backward},
                 )
-            for slot, names in desc["outputs"].items():
-                for n in names:
-                    if n == EMPTY_VAR_NAME:
-                        continue
-                    base = n.split("@RENAME")[0]
-                    if base.endswith(GRAD_SUFFIX):
-                        known_grads.add(base)
-                        produced.add(base)
+    block._last_grad_rename = dict(rename)
 
     # collect (param, grad) pairs
     params: List[Parameter]
@@ -180,7 +209,7 @@ def append_backward(
             continue
         gname = p.name + GRAD_SUFFIX
         if gname in known_grads:
-            gvar = block.var_recursive(gname)
+            gvar = block.var_recursive(rename.get(gname, gname))
             result.append((p, gvar))
     return result
 
@@ -198,7 +227,9 @@ def gradients(
     append_backward(targets[0], no_grad_set=no_grad_set)
     block = targets[0].block
     outs = []
+    rename = getattr(block, "_last_grad_rename", {})
     for v in inputs:
         gname = v.name + GRAD_SUFFIX
+        gname = rename.get(gname, gname)
         outs.append(block.var_recursive(gname) if block._find_var_recursive(gname) else None)
     return outs

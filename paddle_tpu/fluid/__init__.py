@@ -150,3 +150,6 @@ from ..inference import (  # noqa: F401
     PaddleTensor,
     create_paddle_predictor,
 )
+
+
+from ..utils.custom_op import load_op_library, register_op  # noqa: F401

@@ -108,6 +108,29 @@ class CollectiveOptimizer(DistributedOptimizer):
         main_program = loss.block.program
         startup_program = startup_program or default_startup_program()
 
+        strategy = self._strategy
+        if getattr(strategy, "use_dgc", False):
+            # reference: fleet swaps Momentum for DGCMomentum when
+            # use_dgc is set; DGC inserts its own (sparse) exchange, so
+            # no GradAllReduce transpile on top
+            from ....optimizer import DGCMomentumOptimizer, MomentumOptimizer
+
+            opt = self._optimizer
+            if not isinstance(opt, MomentumOptimizer):
+                raise ValueError(
+                    "use_dgc requires a Momentum optimizer (reference "
+                    "fleet asserts the same); got "
+                    f"{type(opt).__name__}")
+            if not isinstance(opt, DGCMomentumOptimizer):
+                self._optimizer = DGCMomentumOptimizer(
+                    opt._learning_rate, opt._momentum,
+                    use_nesterov=opt._use_nesterov,
+                    rampup_begin_step=getattr(
+                        strategy, "dgc_rampup_begin_step", 0),
+                    sparsity=getattr(strategy, "dgc_sparsity", (0.999,)),
+                    regularization=opt.regularization,
+                    grad_clip=getattr(opt, "_grad_clip", None))
+
         optimize_ops, params_grads = self._optimizer.minimize(
             loss, startup_program, parameter_list, no_grad_set
         )
@@ -118,7 +141,11 @@ class CollectiveOptimizer(DistributedOptimizer):
         mesh = mesh_mod.default_dp_mesh()
         nranks = max(nranks, mesh.size)
 
-        strategy = self._strategy
+        if getattr(strategy, "use_dgc", False):
+            if f is not None:
+                f.main_program = main_program
+                f.startup_program = startup_program
+            return optimize_ops, params_grads
         if strategy.use_local_sgd:
             t = LocalSGD(nrings=strategy.nccl_comm_num,
                          k_steps=strategy.local_sgd_k_steps)

@@ -21,6 +21,7 @@ from . import nn_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
+from . import dgc_ops  # noqa: F401
 from . import control_ops  # noqa: F401
 from . import ps_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401

@@ -54,7 +54,17 @@ def _allreduce(reduce_fn):
     return lower
 
 
-op("c_allreduce_sum", no_grad=True)(_allreduce(lambda x, a: lax.psum(x, a)))
+@op("c_allreduce_sum", no_grad=True)
+def _c_allreduce_sum(ctx):
+    x = ctx.in_("X")
+    axis = _axis(ctx)
+    if _in_shard_map(axis):
+        x = lax.psum(x, axis)
+        if ctx.attr("use_mean", False):
+            # mean without knowing nranks at graph-build time (the DGC
+            # optimizer's dense path)
+            x = x / lax.axis_size(axis)
+    ctx.set_out("Out", x)
 op("c_allreduce_max", no_grad=True)(_allreduce(lambda x, a: lax.pmax(x, a)))
 op("c_allreduce_min", no_grad=True)(_allreduce(lambda x, a: lax.pmin(x, a)))
 op("c_allreduce_prod", no_grad=True)(

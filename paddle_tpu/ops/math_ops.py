@@ -437,7 +437,9 @@ def _cumsum(ctx):
 
 @op("increment")
 def _increment(ctx):
-    ctx.set_out("Out", ctx.in_("X") + ctx.attr("step", 1.0))
+    x = ctx.in_("X")
+    step = jnp.asarray(ctx.attr("step", 1.0)).astype(jnp.result_type(x))
+    ctx.set_out("Out", x + step)
 
 
 @op("maximum")

@@ -45,6 +45,7 @@ class OpDef:
         "no_grad",
         "stateful",
         "host",
+        "_generic_grad",
     )
 
     def __init__(self, type):
@@ -287,10 +288,19 @@ def _infer_grad_shapes(op: Operator, block: Block):
     """Grad var shape == forward var shape; cheap, no tracing."""
     for slot, names in op.outputs.items():
         for n in names:
-            if n == EMPTY_VAR_NAME or not n.endswith(GRAD_SUFFIX):
+            if n == EMPTY_VAR_NAME:
+                continue
+            # strip higher-order/accumulation rename segments
+            # (X@GRAD@GRADX_0, X@GRAD@RENAME_1) down to X@GRAD
+            base = n
+            if "@RENAME" in base:
+                base = base.split("@RENAME")[0]
+            if "@GRADX" in base:
+                base = base.split("@GRADX")[0]
+            if not base.endswith(GRAD_SUFFIX):
                 continue
             gvar = block._find_var_recursive(n)
-            fvar = block._find_var_recursive(n[: -len(GRAD_SUFFIX)])
+            fvar = block._find_var_recursive(base[: -len(GRAD_SUFFIX)])
             if gvar is not None and fvar is not None:
                 gvar.shape = fvar.shape
                 gvar.dtype = fvar.dtype
@@ -319,8 +329,17 @@ def run_op(op: Operator, env: Dict[str, Any], block=None):
 def has_grad(type: str) -> bool:
     d = OPS.get(type)
     if d is None:
+        # lazily-materialized generic grads (vjp replay) are themselves
+        # differentiable -> higher-order autodiff (double/triple grad)
+        if type.endswith("_grad"):
+            fwd = type[: -len("_grad")]
+            return fwd in OPS and OPS[fwd].lower is not None
         return False
     if d.no_grad:
+        # generic grads were registered with no_grad as a bookkeeping
+        # default; they replay a differentiable lowering, so they grad
+        if getattr(d, "_generic_grad", False):
+            return True
         return False
     return True
 
@@ -332,7 +351,14 @@ def make_grad_ops(op: Operator, no_grad_names=frozenset()) -> List[dict]:
     (grad_op_desc_maker.h) so ``append_backward`` stays a program rewrite.
     """
     d = OPS.get(op.type)
-    if d is None or d.no_grad:
+    if d is None and op.type.endswith("_grad"):
+        try:
+            d = resolve(op.type)  # materialize the generic grad def
+        except NotImplementedError:
+            return []
+    if d is None:
+        return []
+    if d.no_grad and not getattr(d, "_generic_grad", False):
         return []
     if d.grad_maker is not None:
         return d.grad_maker(op, no_grad_names)
@@ -355,6 +381,10 @@ def default_grad_maker(op: Operator, no_grad_names=frozenset()) -> List[dict]:
             for n in names
         ]
     attrs = dict(op.attrs)
+    # full attr snapshot of the fwd op, including its own "__" keys —
+    # needed when the fwd op is itself a grad op (double backward), whose
+    # replay depends on its __fwd_type__/__fwd_out_slots__
+    attrs["__fwd_attrs__"] = dict(op.attrs)
     attrs["__fwd_out_slots__"] = {s: len(ns) for s, ns in op.outputs.items()}
     attrs["__fwd_type__"] = op.type
     return [
@@ -371,20 +401,31 @@ def _is_diff_value(v) -> bool:
         return False
 
 
-def generic_grad_lower(ctx: LowerCtx):
+def generic_grad_lower(ctx):
     """vjp-replay grad kernel shared by every ``*_grad`` op that has no
-    custom lowering (see module docstring)."""
+    custom lowering (see module docstring).  Works from a real LowerCtx
+    or from a _ReplayCtx (grad-of-grad replays a grad op as the
+    "forward" — double/triple backward)."""
     gop = ctx.op
-    fwd_type = gop.attr("__fwd_type__") or gop.type[: -len("_grad")]
+    if gop is not None:
+        attrs_all = gop.attrs
+        in_slot_names = list(gop.inputs)
+        op_type = gop.type
+    else:  # replay context
+        attrs_all = ctx.attrs
+        in_slot_names = list(ctx._ins)
+        op_type = attrs_all.get("__replay_type__", "")
+    fwd_type = attrs_all.get("__fwd_type__") or op_type[: -len("_grad")]
     fdef = get_op_def(fwd_type)
-    out_arity: Dict[str, int] = dict(gop.attr("__fwd_out_slots__") or {})
+    out_arity: Dict[str, int] = dict(attrs_all.get("__fwd_out_slots__") or {})
 
-    # Collect forward input values (slots not ending in @GRAD and not a
-    # forward output slot).
+    # Forward input slots: everything except the fwd-output slots and
+    # the cotangent slots the grad maker added.  (An endswith-@GRAD test
+    # would be wrong for grad-of-grad, where the replayed fwd op itself
+    # has legitimate @GRAD-named data inputs.)
+    cot_slots = {s + GRAD_SUFFIX for s in out_arity}
     fwd_in_slots = [
-        s
-        for s in gop.inputs
-        if not s.endswith(GRAD_SUFFIX) and s not in out_arity
+        s for s in in_slot_names if s not in out_arity and s not in cot_slots
     ]
     ins_vals = {s: ctx.ins(s) for s in fwd_in_slots}
 
@@ -397,7 +438,14 @@ def generic_grad_lower(ctx: LowerCtx):
                 spec.append((s, i))
                 flat.append(v)
 
-    fwd_attrs = {k: v for k, v in gop.attrs.items() if not k.startswith("__")}
+    fwd_attrs = attrs_all.get("__fwd_attrs__")
+    if fwd_attrs is None:
+        fwd_attrs = {k: v for k, v in attrs_all.items()
+                     if not k.startswith("__")}
+    else:
+        fwd_attrs = dict(fwd_attrs)
+    # the replayed op needs to know its own type if IT is a grad op
+    fwd_attrs["__replay_type__"] = fwd_type
     out_slot_order = sorted(out_arity)
 
     def f(flat_vals):
@@ -419,7 +467,8 @@ def generic_grad_lower(ctx: LowerCtx):
     cots = []
     k = 0
     for slot in out_slot_order:
-        gvals = ctx.ins(slot + GRAD_SUFFIX) if (slot + GRAD_SUFFIX) in gop.inputs else []
+        gvals = (ctx.ins(slot + GRAD_SUFFIX)
+                 if (slot + GRAD_SUFFIX) in in_slot_names else [])
         for i in range(out_arity[slot]):
             primal = primal_outs[k]
             g = gvals[i] if i < len(gvals) else None
@@ -443,15 +492,19 @@ def generic_grad_lower(ctx: LowerCtx):
         by_slot.setdefault(s, {})[i] = g
     for s in fwd_in_slots:
         gslot = s + GRAD_SUFFIX
-        names = gop.outputs.get(gslot, [])
-        if not names:
-            continue
-        vals = []
-        for i, n in enumerate(names):
-            vals.append(by_slot.get(s, {}).get(i))
-        for n, v in zip(names, vals):
-            if n != EMPTY_VAR_NAME and v is not None:
-                ctx.env[n] = v
+        if gop is not None:
+            names = gop.outputs.get(gslot, [])
+            if not names:
+                continue
+            for i, n in enumerate(names):
+                v = by_slot.get(s, {}).get(i)
+                if n != EMPTY_VAR_NAME and v is not None:
+                    ctx.env[n] = v
+        else:
+            # replay (grad-of-grad): capture through the replay ctx
+            vals = [by_slot.get(s, {}).get(i)
+                    for i in range(len(ins_vals[s]))]
+            ctx.set_out(gslot, vals)
 
 
 class _GenericGradDispatch:
@@ -469,6 +522,7 @@ def resolve(type: str) -> OpDef:
             if gd.lower is None:
                 gd.lower = generic_grad_lower
                 gd.no_grad = True
+                gd._generic_grad = True
             return gd
     raise NotImplementedError(f"op {type!r} is not registered")
 

@@ -289,6 +289,122 @@ class MomentumOptimizer(Optimizer):
         state["velocity"] = outs["VelocityOut"][0]
 
 
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Deep Gradient Compression momentum (reference: optimizer.py:1071
+    DGCMomentumOptimizer + dgc_op.cc + sparse_all_reduce_op_handle.cc).
+
+    For parameters with >= ``dgc_size_threshold`` elements, gradients are
+    exchanged sparsely through the fused ``dgc`` op (top-k + momentum
+    correction + residual accumulation — ops/dgc_ops.py); smaller
+    parameters use dense allreduce + classic momentum, and every
+    parameter uses dense exchange before ``rampup_begin_step``
+    (dgc_momentum op switches momentum→sgd at the same boundary).
+    Self-contained for data-parallel programs: inserts its own
+    ``c_allreduce_sum`` for the dense path, so no GradAllReduce
+    transpile should be applied on top (fleet collective skips it when
+    ``use_dgc`` is set).
+    """
+
+    DGC_SIZE_THRESHOLD = 16384  # reference: same cutoff
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 num_trainers=None, **kwargs):
+        super().__init__(learning_rate, momentum,
+                         use_nesterov=use_nesterov, **kwargs)
+        self.type = "dgc_momentum"
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._rampup_step = int(rampup_step)
+        self._sparsity = [float(s) for s in sparsity]
+        self._num_trainers = num_trainers
+        self._step_var = None
+
+    def _is_dgc_param(self, param) -> bool:
+        import numpy as _np
+
+        return int(_np.prod([abs(s) for s in param.shape])) >= \
+            self.DGC_SIZE_THRESHOLD
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+            if self._is_dgc_param(p):
+                self._add_accumulator("dgc_u", p)
+                self._add_accumulator("dgc_v", p)
+
+    def _get_step_var(self, block):
+        if self._step_var is None:
+            from .framework import unique_name as _un
+
+            name = _un.generate("dgc_global_step")
+            self._step_var = block.create_var(
+                name=name, shape=[1], dtype=VarType.INT32, persistable=True,
+                stop_gradient=True)
+            startup = default_startup_program().global_block()
+            startup.create_var(name=name, shape=[1], dtype=VarType.INT32,
+                               persistable=True)
+            startup.append_op(
+                "fill_constant", outputs={"Out": [name]},
+                attrs={"shape": [1], "value": 0.0,
+                       "dtype": int(VarType.INT32)})
+        return self._step_var
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        vel = self._get_accumulator("velocity", p)
+        lr = self._create_param_lr(p)
+        step = self._get_step_var(block)
+
+        if not self._is_dgc_param(p):
+            # dense path: allreduce-mean + momentum
+            block.append_op(
+                "c_allreduce_sum", inputs={"X": [g]}, outputs={"Out": [g]},
+                attrs={"ring_id": 0, "use_mean": True})
+            return block.append_op(
+                "momentum",
+                inputs={"Param": [p], "Grad": [g], "Velocity": [vel],
+                        "LearningRate": [lr]},
+                outputs={"ParamOut": [p], "VelocityOut": [vel]},
+                attrs={"mu": self._momentum,
+                       "use_nesterov": self._use_nesterov})
+
+        u = self._get_accumulator("dgc_u", p)
+        v = self._get_accumulator("dgc_v", p)
+        encoded = block.create_var(
+            name=unique_name.generate(f"{p.name}_dgc_encoded"),
+            dtype=p.dtype, stop_gradient=True)
+        gathered = block.create_var(
+            name=unique_name.generate(f"{p.name}_dgc_idx"),
+            dtype=VarType.INT32, stop_gradient=True)
+        agg = block.create_var(
+            name=unique_name.generate(f"{p.name}_dgc_agg"),
+            dtype=p.dtype, stop_gradient=True)
+        block.append_op(
+            "dgc",
+            inputs={"U": [u], "V": [v], "Grad": [g],
+                    "current_step": [step]},
+            outputs={"U_out": [u], "V_out": [v], "Grad_out": [agg],
+                     "EncodeGrad": [encoded], "GatherBuff": [gathered]},
+            attrs={"m": self._momentum, "use_nesterov": self._use_nesterov,
+                   "sparsity": self._sparsity,
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "rampup_step": self._rampup_step, "ring_id": 0})
+        return block.append_op(
+            "dgc_momentum",
+            inputs={"Param": [p], "Grad": [agg], "Velocity": [vel],
+                    "LearningRate": [lr], "current_step": [step]},
+            outputs={"ParamOut": [p], "VelocityOut": [vel]},
+            attrs={"mu": self._momentum,
+                   "use_nesterov": self._use_nesterov,
+                   "rampup_begin_step": self._rampup_begin_step})
+
+    def _finish_update(self, block, params_grads):
+        if self._step_var is not None:
+            block.append_op(
+                "increment", inputs={"X": [self._step_var]},
+                outputs={"Out": [self._step_var]}, attrs={"step": 1.0})
+
+
 class LarsMomentumOptimizer(Optimizer):
     type = "lars_momentum"
 

@@ -1,0 +1,115 @@
+"""Encrypted model save/load — AES-CTR cipher.
+
+Reference: paddle/fluid/framework/io/crypto/ (AESCipher, CipherFactory,
+CipherUtils — pybind/crypto.cc exposes CipherUtils.gen_key /
+Cipher.encrypt/decrypt(+_to_file/_from_file)).  The block cipher itself
+is native C++ (native/crypto.cpp, FIPS-197), bound here via ctypes; key
+material stays host-side.
+
+Ciphertext layout: 16-byte random IV || CTR stream.  An HMAC-less CTR
+matches the reference's AES cipher shape (confidentiality, not
+authentication).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+__all__ = ["CipherUtils", "CipherFactory", "AESCipher"]
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        from ..native.build import load_library
+
+        _lib = load_library("crypto")
+        _lib.PD_AesCtrCrypt.restype = ctypes.c_int
+        _lib.PD_AesCtrCrypt.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.c_uint64]
+        _lib.PD_AesEncryptBlock.restype = ctypes.c_int
+        _lib.PD_AesEncryptBlock.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_ubyte)]
+    return _lib
+
+
+class CipherUtils:
+    """reference: io/crypto/cipher_utils.h CipherUtils."""
+
+    @staticmethod
+    def gen_key(length_bits: int = 128) -> bytes:
+        if length_bits not in (128, 192, 256):
+            raise ValueError("key length must be 128/192/256 bits")
+        return os.urandom(length_bits // 8)
+
+    @staticmethod
+    def gen_key_to_file(length_bits: int, path: str) -> bytes:
+        key = CipherUtils.gen_key(length_bits)
+        with open(path, "wb") as f:
+            f.write(key)
+        return key
+
+    @staticmethod
+    def read_key_from_file(path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class AESCipher:
+    """reference: io/crypto/aes_cipher.h AESCipher (CTR mode)."""
+
+    def __init__(self, key: Optional[bytes] = None):
+        self._key = key
+
+    def _crypt(self, data: bytes, key: bytes, iv: bytes) -> bytes:
+        lib = _load()
+        out = (ctypes.c_ubyte * len(data))()
+        rc = lib.PD_AesCtrCrypt(key, len(key), iv, data, out, len(data))
+        if rc != 0:
+            raise ValueError(f"bad AES key length: {len(key)} bytes")
+        return bytes(out)
+
+    def encrypt(self, plaintext: bytes, key: Optional[bytes] = None) -> bytes:
+        key = key or self._key
+        iv = os.urandom(16)
+        return iv + self._crypt(plaintext, key, iv)
+
+    def decrypt(self, ciphertext: bytes,
+                key: Optional[bytes] = None) -> bytes:
+        key = key or self._key
+        if len(ciphertext) < 16:
+            raise ValueError("ciphertext too short (missing IV)")
+        iv, body = ciphertext[:16], ciphertext[16:]
+        return self._crypt(body, key, iv)
+
+    def encrypt_to_file(self, plaintext: bytes, key: bytes, path: str):
+        with open(path, "wb") as f:
+            f.write(self.encrypt(plaintext, key))
+
+    def decrypt_from_file(self, key: bytes, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return self.decrypt(f.read(), key)
+
+
+class CipherFactory:
+    """reference: io/crypto/cipher.h CipherFactory::CreateCipher."""
+
+    @staticmethod
+    def create_cipher(config_file: Optional[str] = None) -> AESCipher:
+        return AESCipher()
+
+
+def _aes_encrypt_block(key: bytes, block: bytes) -> bytes:
+    """Single-block forward cipher (test hook for FIPS-197 vectors)."""
+    lib = _load()
+    out = (ctypes.c_ubyte * 16)()
+    rc = lib.PD_AesEncryptBlock(key, len(key), block, out)
+    if rc != 0:
+        raise ValueError("bad key length")
+    return bytes(out)

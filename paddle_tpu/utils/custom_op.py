@@ -1,0 +1,149 @@
+"""Custom operator extension mechanism.
+
+Reference: fluid.load_op_library (framework.py:5388 +
+framework/load_op_lib.h) and the example custom op build
+(python/paddle/fluid/tests/custom_op/relu_op.cc) — users compile ops
+out-of-tree into a shared library and register them at runtime.
+
+Two tiers here, mirroring how the capability splits on TPU:
+* ``register_op`` — pure-Python/JAX custom op: supply a lowering (any
+  jax-traceable function) and optionally a grad lowering; this is the
+  idiomatic TPU path (the kernel JIT-compiles through XLA/Pallas).
+* ``load_op_library`` — native C/C++ kernels via a small stable C ABI
+  (below); kernels run host-side through ``jax.pure_callback`` with a
+  ``custom_vjp`` bridging the backward.  This matches the reference's
+  dlopen contract for ops whose kernels are plain CPU code.
+
+Native library ABI (all symbols optional except the first three):
+  int         PD_OpCount(void);
+  const char* PD_OpName(int i);
+  void        PD_OpForward(int i, const float* x, float* y, int64_t n);
+  void        PD_OpBackward(int i, const float* x, const float* dy,
+                            float* dx, int64_t n);   // optional
+Kernels are elementwise float32 (n = element count).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["register_op", "load_op_library"]
+
+
+def register_op(op_type: str, lower: Callable, grad_lower: Callable = None,
+                n_outputs: int = 1, no_grad: bool = False):
+    """Register a Python custom op usable from layers/programs.
+
+    ``lower(ctx)`` receives the LowerCtx (``ctx.in_("X")``,
+    ``ctx.attr``, ``ctx.set_out``).  If ``grad_lower`` is given it is
+    registered for ``<op_type>_grad``; otherwise the generic vjp-replay
+    grad covers differentiable lowerings automatically.
+    """
+    from ..ops.registry import op as _op_dec
+
+    _op_dec(op_type, no_grad=no_grad)(lower)
+    if grad_lower is not None:
+        _op_dec(op_type + "_grad", no_grad=True)(grad_lower)
+    return op_type
+
+
+class _NativeOpLib:
+    def __init__(self, path: str):
+        self.lib = ctypes.CDLL(path)
+        self.lib.PD_OpCount.restype = ctypes.c_int
+        self.lib.PD_OpName.restype = ctypes.c_char_p
+        self.lib.PD_OpName.argtypes = [ctypes.c_int]
+        self.lib.PD_OpForward.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        self.has_backward = hasattr(self.lib, "PD_OpBackward")
+        if self.has_backward:
+            self.lib.PD_OpBackward.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    def forward(self, i: int, x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        y = np.empty_like(x)
+        self.lib.PD_OpForward(
+            i, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+        return y
+
+    def backward(self, i: int, x: np.ndarray, dy: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        dy = np.ascontiguousarray(dy, np.float32)
+        dx = np.empty_like(x)
+        self.lib.PD_OpBackward(
+            i, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            dy.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            dx.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+        return dx
+
+
+def load_op_library(path: str) -> List[str]:
+    """reference: fluid.load_op_library (framework.py:5388).  Returns the
+    list of op types registered from the library."""
+    lib = _NativeOpLib(path)
+    names = []
+    for i in range(lib.lib.PD_OpCount()):
+        name = lib.lib.PD_OpName(i).decode()
+        names.append(name)
+        _register_native(lib, i, name)
+    return names
+
+
+def _register_native(lib: _NativeOpLib, index: int, name: str):
+    from ..ops.registry import op as _op_dec
+
+    def host_fwd(x):
+        return lib.forward(index, np.asarray(x))
+
+    if lib.has_backward:
+        @jax.custom_vjp
+        def fwd_fn(x):
+            return jax.pure_callback(
+                host_fwd, jax.ShapeDtypeStruct(jnp.shape(x), jnp.float32), x)
+
+        def fwd_rule(x):
+            y = fwd_fn(x)
+            return y, x
+
+        def bwd_rule(x, dy):
+            dx = jax.pure_callback(
+                lambda xx, dd: lib.backward(index, np.asarray(xx),
+                                            np.asarray(dd)),
+                jax.ShapeDtypeStruct(jnp.shape(x), jnp.float32), x, dy)
+            return (dx,)
+
+        fwd_fn.defvjp(fwd_rule, bwd_rule)
+    else:
+        def fwd_fn(x):
+            return jax.pure_callback(
+                host_fwd, jax.ShapeDtypeStruct(jnp.shape(x), jnp.float32), x)
+
+    def lower(ctx):
+        ctx.set_out("Out", fwd_fn(jnp.asarray(ctx.in_("X"),
+                                              dtype=jnp.float32)))
+
+    _op_dec(name, no_grad=not lib.has_backward)(lower)
+
+
+def custom_layer(op_type: str):
+    """Layers-style helper for a registered custom op:
+    ``y = custom_layer("relu2")(x)``."""
+    from ..layer_helper import LayerHelper
+
+    def fn(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        return out
+
+    return fn

@@ -1,0 +1,117 @@
+"""Gradient checker utilities — higher-order grad verification.
+
+Reference: python/paddle/fluid/tests/unittests/gradient_checker.py
+(grad_check, double_grad_check) — compares analytic gradients from
+``fluid.gradients`` against numeric central differences, and checks
+second-order grads by differentiating through the first backward.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope
+
+
+def _run(program, feed, fetch, scope):
+    exe = pt.Executor(pt.CPUPlace())
+    return [np.asarray(v) for v in
+            exe.run(program, feed=feed, fetch_list=fetch, scope=scope)]
+
+
+def numeric_grad(build_fn, feed: dict, wrt: str, out_name: str,
+                 delta: float = 1e-3) -> np.ndarray:
+    """Central-difference d(sum(out))/d(feed[wrt]) rebuilt per probe
+    (reference: op_test.get_numeric_gradient)."""
+    base = np.asarray(feed[wrt], np.float64)
+    grad = np.zeros_like(base)
+    it = np.nditer(base, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        for sign in (+1, -1):
+            probe = {k: np.array(v) for k, v in feed.items()}
+            probe[wrt] = probe[wrt].copy()
+            probe[wrt][idx] += sign * delta
+            main, startup, out = build_fn()
+            scope = Scope()
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup, scope=scope)
+            val = _run(main, probe, [out], scope)[0].astype(np.float64).sum()
+            grad[idx] += sign * val
+        grad[idx] /= 2 * delta
+        it.iternext()
+    return grad
+
+
+def grad_check(build_fn, feed: dict, wrt: Sequence[str],
+               delta: float = 1e-3, rtol: float = 5e-3,
+               atol: float = 1e-4) -> bool:
+    """Analytic-vs-numeric first-order gradient check.
+
+    ``build_fn() -> (main, startup, out_var_name)`` rebuilds the graph
+    (fresh programs) so numeric probes don't see grad ops."""
+    main, startup, out = build_fn()
+    block = main.global_block()
+    with fluid.program_guard(main, startup):
+        loss = fluid.layers.reduce_sum(block.var(out))
+        grads = pt.gradients(loss, [block.var(n) for n in wrt])
+    scope = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    analytic = _run(main, feed, [g.name for g in grads], scope)
+    for name, a in zip(wrt, analytic):
+        n = numeric_grad(build_fn, feed, name, out, delta)
+        np.testing.assert_allclose(a, n, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for {name}")
+    return True
+
+
+def double_grad_check(build_fn, feed: dict, wrt: str,
+                      delta: float = 1e-3, rtol: float = 5e-3,
+                      atol: float = 1e-4) -> bool:
+    """Second-order check: d/dx [sum(dy/dx)] against numeric
+    differences of the analytic first grad
+    (reference: gradient_checker.double_grad_check)."""
+    # analytic second grad
+    main, startup, out = build_fn()
+    block = main.global_block()
+    with fluid.program_guard(main, startup):
+        loss = fluid.layers.reduce_sum(block.var(out))
+        (g1,) = pt.gradients(loss, [block.var(wrt)])
+        gsum = fluid.layers.reduce_sum(g1)
+        (g2,) = pt.gradients(gsum, [block.var(wrt)])
+    scope = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    analytic2 = _run(main, feed, [g2.name], scope)[0]
+
+    # numeric second grad: central differences of the analytic first grad
+    def first_grad(probe_feed):
+        m, s, o = build_fn()
+        blk = m.global_block()
+        with fluid.program_guard(m, s):
+            l = fluid.layers.reduce_sum(blk.var(o))
+            (g,) = pt.gradients(l, [blk.var(wrt)])
+        sc = Scope()
+        exe2 = pt.Executor(pt.CPUPlace())
+        exe2.run(s, scope=sc)
+        return _run(m, probe_feed, [g.name], sc)[0].astype(np.float64)
+
+    base = np.asarray(feed[wrt], np.float64)
+    numeric2 = np.zeros_like(base)
+    it = np.nditer(base, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        acc = 0.0
+        for sign in (+1, -1):
+            probe = {k: np.array(v) for k, v in feed.items()}
+            probe[wrt] = probe[wrt].copy()
+            probe[wrt][idx] += sign * delta
+            acc += sign * first_grad(probe).sum()
+        numeric2[idx] = acc / (2 * delta)
+        it.iternext()
+    np.testing.assert_allclose(analytic2, numeric2, rtol=rtol, atol=atol)
+    return True

@@ -1,0 +1,125 @@
+"""Custom-op extension + gradient-checker tests.
+
+Reference analogs: tests/custom_op/test_custom_op.py (build a relu2
+shared lib, load_op_library, use in a program, check grads) and
+unittests/gradient_checker.py self-tests.
+"""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+RELU2_SRC = r"""
+// Example out-of-tree op library (reference analog:
+// tests/custom_op/relu_op.cc) using the PD custom-op C ABI.
+#include <stdint.h>
+#include <string.h>
+
+extern "C" {
+int PD_OpCount(void) { return 1; }
+const char* PD_OpName(int i) { return "relu2"; }
+void PD_OpForward(int i, const float* x, float* y, int64_t n) {
+  for (int64_t j = 0; j < n; ++j) y[j] = x[j] > 0.f ? x[j] : 0.f;
+}
+void PD_OpBackward(int i, const float* x, const float* dy, float* dx,
+                   int64_t n) {
+  for (int64_t j = 0; j < n; ++j) dx[j] = x[j] > 0.f ? dy[j] : 0.f;
+}
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def relu2_lib(tmp_path_factory):
+    d = tmp_path_factory.mktemp("customop")
+    src = d / "relu2_op.cc"
+    src.write_text(RELU2_SRC)
+    so = d / "librelu2.so"
+    try:
+        subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-o", str(so),
+                        str(src)], check=True, capture_output=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        pytest.skip(f"no native toolchain: {e}")
+    return str(so)
+
+
+def test_load_op_library_forward_and_grad(relu2_lib):
+    names = fluid.load_op_library(relu2_lib)
+    assert names == ["relu2"]
+
+    from paddle_tpu.utils.custom_op import custom_layer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        x.stop_gradient = False
+        y = custom_layer("relu2")(x)
+        loss = fluid.layers.reduce_sum(y)
+        (gx,) = pt.gradients(loss, [x])
+    exe = fluid.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xv = np.array([[-1.0, 2.0, -3.0, 4.0]], np.float32)
+    yv, gv = exe.run(main, feed={"x": xv}, fetch_list=[y.name, gx.name])
+    np.testing.assert_allclose(np.asarray(yv), [[0.0, 2.0, 0.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(gv), [[0.0, 1.0, 0.0, 1.0]])
+
+
+def test_register_python_custom_op():
+    from paddle_tpu.utils.custom_op import register_op, custom_layer
+    import jax.numpy as jnp
+
+    def lower(ctx):
+        ctx.set_out("Out", jnp.asarray(ctx.in_("X")) ** 3)
+
+    register_op("cube_custom", lower)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3])
+        x.stop_gradient = False
+        y = custom_layer("cube_custom")(x)
+        loss = fluid.layers.reduce_sum(y)
+        (gx,) = pt.gradients(loss, [x])
+    exe = fluid.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xv = np.array([[1.0, 2.0, 3.0]], np.float32)
+    yv, gv = exe.run(main, feed={"x": xv}, fetch_list=[y.name, gx.name])
+    np.testing.assert_allclose(np.asarray(yv), xv ** 3)
+    np.testing.assert_allclose(np.asarray(gv), 3 * xv ** 2)  # generic vjp
+
+
+def _build_tanh_fc():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3])
+        x.stop_gradient = False
+        y = fluid.layers.tanh(x)
+    return main, startup, y.name
+
+
+def test_grad_check_first_order():
+    from gradient_checker import grad_check
+
+    feed = {"x": np.array([[0.1, -0.4, 0.7]], np.float32)}
+    assert grad_check(_build_tanh_fc, feed, wrt=["x"])
+
+
+def test_double_grad_check():
+    from gradient_checker import double_grad_check
+
+    feed = {"x": np.array([[0.3, -0.2]], np.float32)}
+    assert double_grad_check(
+        lambda: _build_tanh_sq(), feed, wrt="x")
+
+
+def _build_tanh_sq():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2])
+        x.stop_gradient = False
+        y = fluid.layers.square(fluid.layers.tanh(x))
+    return main, startup, y.name

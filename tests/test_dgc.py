@@ -1,0 +1,176 @@
+"""DGC (deep gradient compression) tests — SURVEY.md §2.5 DGC row.
+
+Reference analogs: test_dgc_op.py (op math), test_dist_mnist with DGC
+(convergence under compression).  Oracles here:
+* op math single-device: momentum correction, top-k selection,
+  residual accumulation.
+* ratio=1.0 (k = numel): DGC must match dense momentum exactly.
+* sparse ratio on an 8-device mesh: converges.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope
+from paddle_tpu.parallel import mesh as mesh_mod
+
+
+def test_dgc_op_math_single_device():
+    from paddle_tpu.ops.registry import eager_call
+
+    g = np.array([3.0, -0.1, 0.2, -4.0], np.float32)
+    u = np.zeros(4, np.float32)
+    v = np.zeros(4, np.float32)
+    outs = eager_call(
+        "dgc",
+        {"U": [u], "V": [v], "Grad": [g],
+         "current_step": [np.array([0], np.int32)]},
+        {"m": 0.9, "sparsity": [0.5], "rampup_begin_step": 0,
+         "rampup_step": 0, "ring_id": 0},
+        {"U_out": 1, "V_out": 1, "Grad_out": 1, "EncodeGrad": 1,
+         "GatherBuff": 1},
+    )
+    # step 1: u = g, v = g; k = numel*(1-0.5) = 2 -> |3.0|, |-4.0| kept
+    agg = np.asarray(outs["Grad_out"][0])
+    np.testing.assert_allclose(agg, [3.0, 0.0, 0.0, -4.0], atol=1e-6)
+    # residual: selected entries cleared, others accumulate
+    v_out = np.asarray(outs["V_out"][0])
+    np.testing.assert_allclose(v_out, [0.0, -0.1, 0.2, 0.0], atol=1e-6)
+    u_out = np.asarray(outs["U_out"][0])
+    np.testing.assert_allclose(u_out, [0.0, -0.1, 0.2, 0.0], atol=1e-6)
+    # next step: unsent entries keep accumulating with momentum
+    outs2 = eager_call(
+        "dgc",
+        {"U": [u_out], "V": [v_out], "Grad": [np.zeros(4, np.float32)],
+         "current_step": [np.array([1], np.int32)]},
+        {"m": 0.9, "sparsity": [0.5], "rampup_begin_step": 0,
+         "rampup_step": 0, "ring_id": 0},
+        {"U_out": 1, "V_out": 1, "Grad_out": 1, "EncodeGrad": 1,
+         "GatherBuff": 1},
+    )
+    agg2 = np.asarray(outs2["Grad_out"][0])
+    # v = v + 0.9*u = [0, -0.19, 0.38, 0]; top2 -> entries 1 and 2 sent
+    np.testing.assert_allclose(agg2, [0.0, -0.19, 0.38, 0.0], atol=1e-6)
+
+
+def _build(seed=13):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [64])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 256, act="relu")   # 64*256 = 16384 -> DGC
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return main, startup, x, y, loss
+
+
+def test_dgc_dense_ratio_matches_sgd():
+    """sparsity=0.0 => k = numel: every v entry is sent and the U/V
+    buffers clear each step, so u_t = g_t and the update degenerates to
+    exact SGD — the analytic full-density limit of DGC (momentum only
+    accumulates across steps for UNSENT entries)."""
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 64).astype(np.float32)
+    ys = (xs[:, :1] * 0.5).astype(np.float32)
+
+    main_a, startup_a, *_ , loss_a = _build()
+    with fluid.program_guard(main_a, startup_a):
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss_a)
+    scope_a = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup_a, scope=scope_a)
+    init = {k: np.asarray(v) for k, v in scope_a.items()
+            if not k.startswith("@")}
+    ref = [float(exe.run(main_a, feed={"x": xs, "y": ys},
+                         fetch_list=[loss_a], scope=scope_a)[0])
+           for _ in range(5)]
+
+    main_b, startup_b, *_, loss_b = _build()
+    with fluid.program_guard(main_b, startup_b):
+        opt_b = fluid.optimizer.DGCMomentumOptimizer(
+            0.1, 0.9, sparsity=[0.0])
+        opt_b.DGC_SIZE_THRESHOLD = 0  # route every param through DGC
+        opt_b.minimize(loss_b)
+    scope_b = Scope()
+    exe.run(startup_b, scope=scope_b)
+    for k, v in init.items():
+        if scope_b.has(k):
+            scope_b.set(k, v.copy())
+    got = [float(exe.run(main_b, feed={"x": xs, "y": ys},
+                         fetch_list=[loss_b], scope=scope_b)[0])
+           for _ in range(5)]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_dgc_sparse_converges_on_mesh():
+    """Compressed exchange on the 8-device mesh still trains."""
+    from paddle_tpu.incubate.fleet.collective import (
+        Collective, CollectiveOptimizer, DistributedStrategy)
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        UserDefinedCollectiveRoleMaker)
+
+    mesh_mod.init_mesh()
+    rng = np.random.RandomState(1)
+    xs = rng.randn(32, 64).astype(np.float32)
+    ys = (xs[:, :1] * 0.5).astype(np.float32)
+
+    main, startup, *_, loss = _build(seed=7)
+    fleet = Collective()
+    fleet.init(UserDefinedCollectiveRoleMaker(0, ["127.0.0.1:6170"]))
+    strategy = DistributedStrategy()
+    strategy.use_dgc = True
+    with fluid.program_guard(main, startup):
+        opt = fluid.optimizer.MomentumOptimizer(0.05, 0.9)
+        CollectiveOptimizer(opt, strategy, fleet).minimize(loss)
+
+    types = [op.type for op in main.global_block().ops]
+    assert "dgc" in types and "dgc_momentum" in types
+
+    from paddle_tpu.parallel.compiled_program import CompiledProgram
+
+    compiled = CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+    scope = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    losses = [float(np.asarray(exe.run(compiled, feed={"x": xs, "y": ys},
+                                       fetch_list=[loss], scope=scope)[0]
+                               ).mean())
+              for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    assert np.isfinite(losses).all()
+
+
+def test_dgc_pre_rampup_dense_passthrough():
+    """Before rampup_begin_step the dgc op passes the dense grad through
+    untouched and leaves U/V alone (reference dgc_op.cc behavior)."""
+    from paddle_tpu.ops.registry import eager_call
+
+    g = np.array([1.0, -2.0, 3.0, -4.0], np.float32)
+    u0 = np.full(4, 0.5, np.float32)
+    v0 = np.full(4, 0.25, np.float32)
+    outs = eager_call(
+        "dgc",
+        {"U": [u0], "V": [v0], "Grad": [g],
+         "current_step": [np.array([3], np.int32)]},
+        {"m": 0.9, "sparsity": [0.5], "rampup_begin_step": 10,
+         "rampup_step": 0, "ring_id": 0},
+        {"U_out": 1, "V_out": 1, "Grad_out": 1, "EncodeGrad": 1,
+         "GatherBuff": 1},
+    )
+    np.testing.assert_allclose(np.asarray(outs["Grad_out"][0]), g)
+    np.testing.assert_allclose(np.asarray(outs["U_out"][0]), u0)
+    np.testing.assert_allclose(np.asarray(outs["V_out"][0]), v0)
+    # after rampup begins, sparse exchange kicks in
+    outs2 = eager_call(
+        "dgc",
+        {"U": [u0], "V": [v0], "Grad": [g],
+         "current_step": [np.array([10], np.int32)]},
+        {"m": 0.9, "sparsity": [0.5], "rampup_begin_step": 10,
+         "rampup_step": 0, "ring_id": 0},
+        {"U_out": 1, "V_out": 1, "Grad_out": 1, "EncodeGrad": 1,
+         "GatherBuff": 1},
+    )
+    agg = np.asarray(outs2["Grad_out"][0])
+    assert (agg == 0).sum() == 2  # half the entries compressed away
