@@ -39,8 +39,15 @@ RNG_VAR = registry.LowerCtx.RNG_VAR
 
 
 class _Compiled:
+    """Compiled program handle.
+
+    ``hybrid`` programs (host ops present) expose ``fn(feed, state)``;
+    pure-XLA programs expose ``fn(mut, ro, feed)`` where the mut/ro
+    partition is precomputed in ``donatable``/``readonly`` so the hot
+    run path never re-partitions per step."""
+
     __slots__ = ("fn", "raw_fn", "state_in", "state_out", "fetch_names",
-                 "donatable")
+                 "donatable", "readonly", "hybrid")
 
     def __init__(self, fn, state_in, state_out, fetch_names):
         self.fn = fn
@@ -49,6 +56,8 @@ class _Compiled:
         self.state_out = state_out
         self.fetch_names = fetch_names
         self.donatable = ()
+        self.readonly = ()
+        self.hybrid = False
 
 
 def _fetch_name(f) -> str:
@@ -247,6 +256,7 @@ class Executor:
 
             compiled = _Compiled(hybrid_call, state_in, state_out, fetch)
             compiled.raw_fn = hybrid_call
+            compiled.hybrid = True
             self._cache[key] = compiled
             return compiled
 
@@ -292,14 +302,7 @@ class Executor:
         compiled = _Compiled(jitted, state_in, state_out, fetch)
         compiled.raw_fn = fn
         compiled.donatable = tuple(donatable)
-        compiled_donatable = set(donatable)
-
-        def call(feed_vals, state_vals):
-            mut = {n: v for n, v in state_vals.items() if n in compiled_donatable}
-            ro = {n: v for n, v in state_vals.items() if n not in compiled_donatable}
-            return jitted(mut, ro, feed_vals)
-
-        compiled.fn = call
+        compiled.readonly = tuple(readonly)
         self._cache[key] = compiled
         return compiled
 
@@ -325,33 +328,41 @@ class Executor:
                     arr = arr.astype(want)
             feed_vals[k] = jax.device_put(arr, device)
 
-        state_vals = {}
-        for name in compiled.state_in:
+        def state_val(name):
             if name == RNG_VAR:
                 val = scope.get(RNG_VAR)
                 if val is None:
                     seed = program.random_seed or 0
                     val = jax.random.key(seed)
-                state_vals[name] = val
-                continue
+                return val
             val = scope.get(name)
             if val is None:
                 raise RuntimeError(
                     f"Variable {name!r} is read by the program but has no "
                     f"value in scope — run the startup program first or feed it"
                 )
+            if isinstance(val, jax.Array):
+                return val
             if isinstance(val, LoDTensor):
                 val = val.numpy()
             if isinstance(val, np.ndarray):
                 val = jax.device_put(val, device)
-            state_vals[name] = val
+            return val
 
         from .profiler import RecordEvent
 
         with RecordEvent("executor_run"):
-            fetched, new_state = compiled.fn(feed_vals, state_vals)
+            if compiled.hybrid:
+                state_vals = {n: state_val(n) for n in compiled.state_in}
+                fetched, new_state = compiled.fn(feed_vals, state_vals)
+            else:
+                # hot path: mut/ro partition precomputed at compile time
+                mut = {n: state_val(n) for n in compiled.donatable}
+                ro = {n: state_val(n) for n in compiled.readonly}
+                fetched, new_state = compiled.fn(mut, ro, feed_vals)
+        scope_set = scope.set
         for name, val in new_state.items():
-            scope.set(name, val)
+            scope_set(name, val)
 
         if fetch_names:
             if return_numpy:
