@@ -1,0 +1,309 @@
+"""Communicator: async / half-async / GEO-SGD send-recv engines.
+
+Capability parity with the reference Communicator family
+(reference: paddle/fluid/operators/distributed/communicator.h —
+AsyncCommunicator :237, HalfAsyncCommunicator :299, SyncCommunicator
+:365, GeoSgdCommunicator :383; tuning flags platform/flags.cc:200-231),
+redesigned for the TPU build's host-op PS path: the trainer's jitted
+step produces grads on device, the ``send`` host op hands them to the
+communicator, and background threads own all PS traffic so the device
+step never blocks on the network.
+
+Semantics per mode:
+
+- SYNC: no communicator — ``send`` pushes inline, barriers synchronize
+  every step (the transpiler's send_barrier/fetch_barrier path).
+- ASYNC: ``send`` enqueues and returns; a send thread merges up to
+  FLAGS_communicator_max_merge_var_num queued grads per table (averaged,
+  the reference's MergeVars) and pushes; ``recv`` returns a cached param
+  refreshed by an independent recv thread
+  (FLAGS_communicator_independent_recv_thread).  Staleness is bounded by
+  queue depth + recv period.
+- HALF_ASYNC: like ASYNC, but ``flush()`` drains every queue and the
+  recv that follows pulls fresh values — the per-round barrier of the
+  reference's HalfAsyncCommunicator::Barrier without blocking the step
+  itself.
+- GEO: trainers optimize LOCALLY (optimizer ops stay in the trainer
+  program); every ``geo_sgd_need_push_nums`` steps the communicator
+  pushes the param delta since the last round to the server (plain +=,
+  no server optimizer) and pulls the global value back — the delta-based
+  GEO-SGD protocol of GeoSgdCommunicator.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.flags import flag
+
+
+class AsyncCommunicator:
+    """reference: communicator.h:237 AsyncCommunicator."""
+
+    mode = "async"
+
+    def __init__(self, client, merge_num: Optional[int] = None,
+                 queue_size: Optional[int] = None,
+                 independent_recv: Optional[bool] = None,
+                 recv_interval: Optional[float] = None,
+                 send_wait_times: Optional[int] = None):
+        self._client = client
+        self._merge_num = int(merge_num if merge_num is not None
+                              else flag("communicator_max_merge_var_num"))
+        self._queue_size = int(queue_size if queue_size is not None
+                               else flag("communicator_send_queue_size"))
+        self._independent_recv = bool(
+            independent_recv if independent_recv is not None
+            else flag("communicator_independent_recv_thread"))
+        self._send_wait_times = int(
+            send_wait_times if send_wait_times is not None
+            else flag("communicator_send_wait_times"))
+        self._recv_interval = float(
+            recv_interval if recv_interval is not None
+            else flag("communicator_recv_wait_ms", 50) / 1000.0)
+        self._queues: Dict[str, queue.Queue] = {}
+        self._sparse_queues: Dict[str, queue.Queue] = {}
+        self._param_cache: Dict[str, np.ndarray] = {}
+        self._cache_lock = threading.Lock()
+        self._recv_tables: List[str] = []
+        self._stop = threading.Event()
+        self._send_thread: Optional[threading.Thread] = None
+        self._recv_thread: Optional[threading.Thread] = None
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._inflight_zero = threading.Condition(self._inflight_lock)
+
+    # -- trainer-facing API ------------------------------------------------
+    def start(self):
+        self._send_thread = threading.Thread(target=self._send_loop,
+                                             daemon=True)
+        self._send_thread.start()
+        if self._independent_recv:
+            self._recv_thread = threading.Thread(target=self._recv_loop,
+                                                 daemon=True)
+            self._recv_thread.start()
+        return self
+
+    def send(self, table: str, grad: np.ndarray):
+        """Non-blocking grad push (blocks only when the queue is full —
+        the reference's bounded send queue backpressure)."""
+        q = self._queues.get(table)
+        if q is None:
+            q = self._queues.setdefault(
+                table, queue.Queue(maxsize=self._queue_size))
+        with self._inflight_lock:
+            self._inflight += 1
+        q.put(np.asarray(grad, np.float32).ravel())
+
+    def send_sparse(self, table: str, ids: np.ndarray, grads: np.ndarray):
+        q = self._sparse_queues.get(table)
+        if q is None:
+            q = self._sparse_queues.setdefault(
+                table, queue.Queue(maxsize=self._queue_size))
+        with self._inflight_lock:
+            self._inflight += 1
+        q.put((np.asarray(ids, np.int64).ravel(),
+               np.asarray(grads, np.float32)))
+
+    def recv(self, table: str) -> np.ndarray:
+        """Cached param read; falls through to a direct pull the first
+        time (and always, without the independent recv thread)."""
+        if table not in self._recv_tables:
+            self._recv_tables.append(table)
+        if self._independent_recv:
+            with self._cache_lock:
+                v = self._param_cache.get(table)
+            if v is not None:
+                return v
+        v = self._client.pull_dense(table)
+        with self._cache_lock:
+            self._param_cache[table] = v
+        return v
+
+    def flush(self, timeout: float = 120.0):
+        """Drain every queue and wait for in-flight pushes to land."""
+        deadline = time.time() + timeout
+        with self._inflight_zero:
+            while self._inflight > 0:
+                if not self._inflight_zero.wait(
+                        timeout=max(0.01, deadline - time.time())):
+                    raise TimeoutError(
+                        f"communicator flush timed out with "
+                        f"{self._inflight} pushes in flight")
+                if time.time() > deadline and self._inflight > 0:
+                    raise TimeoutError("communicator flush timed out")
+        # invalidate the cache so the next recv observes the new params
+        with self._cache_lock:
+            self._param_cache.clear()
+
+    def stop(self):
+        try:
+            self.flush()
+        finally:
+            self._stop.set()
+            if self._send_thread is not None:
+                self._send_thread.join(timeout=10)
+            if self._recv_thread is not None:
+                self._recv_thread.join(timeout=10)
+
+    # -- background threads ------------------------------------------------
+    def _dec_inflight(self, n):
+        with self._inflight_zero:
+            self._inflight -= n
+            if self._inflight <= 0:
+                self._inflight_zero.notify_all()
+
+    def _push_retrying(self, push):
+        """Run one push with bounded retries (FLAGS_rpc_retry_times); a
+        push that still fails is dropped with a warning — the send
+        thread must survive transient RPC errors or the bounded queue
+        would wedge the trainer forever."""
+        retries = int(flag("rpc_retry_times", 3))
+        for attempt in range(retries + 1):
+            try:
+                push()
+                return
+            except Exception as e:  # noqa: BLE001 — thread must not die
+                if attempt == retries or self._stop.is_set():
+                    import warnings
+
+                    warnings.warn(
+                        f"communicator dropped a push after "
+                        f"{attempt + 1} attempts: {type(e).__name__}: {e}")
+                    return
+                self._stop.wait(0.01 * (attempt + 1))
+
+    def _send_loop(self):
+        while not self._stop.is_set():
+            worked = False
+            for table, q in list(self._queues.items()):
+                merged: List[np.ndarray] = []
+                while len(merged) < self._merge_num:
+                    try:
+                        merged.append(q.get_nowait())
+                    except queue.Empty:
+                        break
+                if merged:
+                    worked = True
+                    # MergeVars: average so the effective lr does not
+                    # scale with merge depth (communicator.cc MergeVars)
+                    g = merged[0] if len(merged) == 1 else (
+                        np.sum(merged, axis=0) / float(len(merged)))
+                    try:
+                        self._push_retrying(
+                            lambda: self._client.push_dense(
+                                table, g, sync=False))
+                    finally:
+                        self._dec_inflight(len(merged))
+            for table, q in list(self._sparse_queues.items()):
+                batch = []
+                while len(batch) < self._merge_num:
+                    try:
+                        batch.append(q.get_nowait())
+                    except queue.Empty:
+                        break
+                if batch:
+                    worked = True
+                    try:
+                        ids = np.concatenate([b[0] for b in batch])
+                        grads = np.concatenate(
+                            [b[1].reshape(b[0].size, -1) for b in batch])
+                        self._push_retrying(
+                            lambda: self._client.push_sparse(
+                                table, ids, grads))
+                    finally:
+                        self._dec_inflight(len(batch))
+            if not worked:
+                # send_wait_times: poll backoff (flags.cc
+                # communicator_send_wait_times)
+                self._stop.wait(0.002 * max(1, self._send_wait_times))
+
+    def _recv_loop(self):
+        while not self._stop.wait(self._recv_interval):
+            for table in list(self._recv_tables):
+                try:
+                    v = self._client.pull_dense(table)
+                except Exception:
+                    continue
+                with self._cache_lock:
+                    self._param_cache[table] = v
+
+
+class HalfAsyncCommunicator(AsyncCommunicator):
+    """reference: communicator.h:299 — async queues + a round barrier:
+    ``barrier()`` drains this trainer's queues then joins the server-side
+    barrier with the other trainers, so every round starts from params
+    that have absorbed every trainer's round-k grads."""
+
+    mode = "half_async"
+
+    def barrier(self, timeout: float = 120.0):
+        self.flush(timeout)
+        self._client.barrier(timeout)
+
+
+class GeoSgdCommunicator:
+    """reference: communicator.h:383 GeoSgdCommunicator — delta-based
+    GEO-SGD.  The trainer optimizes locally; every ``push_nums`` steps
+    ``geo_step`` pushes (local - snapshot) deltas and pulls the global
+    params, which absorb other trainers' deltas.
+
+    Limitation: deltas cover DENSE params only.  ``is_distributed``
+    sparse embedding tables keep their remote pull/push path with the
+    server-side optimizer (the reference's GEO sparse-id recording,
+    geo_sgd_communicator SendUpdateSparseVars, is not yet replicated);
+    ``sparse_tables`` is accepted for that future wiring."""
+
+    mode = "geo"
+
+    def __init__(self, client, params: List[str],
+                 push_nums: Optional[int] = None,
+                 sparse_tables: Optional[Dict[str, int]] = None):
+        self._client = client
+        self._params = list(params)
+        self._push_nums = int(push_nums or 100)
+        self._sparse_tables = dict(sparse_tables or {})
+        self._snapshots: Dict[str, np.ndarray] = {}
+        self._step = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        return self
+
+    def init_snapshots(self, scope):
+        for p in self._params:
+            v = scope.get(p)
+            if v is not None:
+                self._snapshots[p] = np.asarray(v, np.float32).copy()
+
+    def geo_step(self, scope) -> bool:
+        """Called once per train step (the geo_sgd host op).  Returns
+        True when this step triggered a push/pull round."""
+        with self._lock:
+            self._step += 1
+            if self._step % self._push_nums:
+                return False
+            for p in self._params:
+                local = np.asarray(scope.get(p), np.float32)
+                snap = self._snapshots.get(p)
+                if snap is None:
+                    # baseline = last value synced with the server; if
+                    # init_snapshots was not called, that is the server's
+                    # current global (trainer-0 pushed init params)
+                    snap = self._client.pull_dense(p)
+                delta = (local - snap.reshape(local.shape)).ravel()
+                self._client.push_delta(p, delta)
+                fresh = self._client.pull_dense(p).reshape(local.shape)
+                scope.set(p, fresh)
+                self._snapshots[p] = fresh.copy()
+            return True
+
+    def flush(self, timeout: float = 120.0):
+        pass
+
+    def stop(self):
+        pass

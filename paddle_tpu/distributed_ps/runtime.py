@@ -10,7 +10,7 @@ import time
 from typing import Optional
 
 _ctx = {"client": None, "trainer_id": 0, "heartbeat_thread": None,
-        "heartbeat_stop": None}
+        "heartbeat_stop": None, "communicator": None}
 
 
 def set_client(client, trainer_id: int = 0, heartbeat_interval: float = 0.0):
@@ -45,9 +45,34 @@ def trainer_id() -> int:
     return _ctx["trainer_id"]
 
 
+def set_communicator(comm):
+    """Install the async/half-async/GEO communicator the send/recv host
+    ops route through (reference: Communicator::InitInstance).  Stops a
+    previously installed instance so its background threads don't leak
+    and keep pushing through a stale client."""
+    prev = _ctx.get("communicator")
+    if prev is not None and prev is not comm:
+        try:
+            prev.stop()
+        except Exception:
+            pass
+    _ctx["communicator"] = comm
+
+
+def communicator():
+    return _ctx["communicator"]
+
+
 def clear():
     if _ctx.get("heartbeat_stop") is not None:
         _ctx["heartbeat_stop"].set()
+    comm = _ctx.get("communicator")
+    if comm is not None:
+        try:
+            comm.stop()
+        except Exception:
+            pass
+    _ctx["communicator"] = None
     _ctx["client"] = None
     _ctx["heartbeat_thread"] = None
     _ctx["heartbeat_stop"] = None

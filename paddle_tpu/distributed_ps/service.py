@@ -111,6 +111,13 @@ class PSServer:
         elif op == "push_dense":
             self.dense[name].push_grad(arrays[0])
             _send_msg(sock, "ok")
+        elif op == "push_delta":
+            # GEO-SGD delta apply: param += delta, no server optimizer
+            # (reference: GeoSgdCommunicator's SendUpdateDenseVars)
+            t = self.dense[name]
+            with self._lock:
+                t.init(t.pull() + arrays[0])
+            _send_msg(sock, "ok")
         elif op == "pull_sparse":
             _send_msg(sock, "ok", arrays=[self.sparse[name].pull(arrays[0])])
         elif op == "push_sparse":
@@ -305,6 +312,10 @@ class PSClient:
     def push_dense(self, name, grad, sync=True):
         self._call(self._ep_for(name), "push_dense", name, {"sync": sync},
                    [np.asarray(grad, np.float32)])
+
+    def push_delta(self, name, delta):
+        self._call(self._ep_for(name), "push_delta", name,
+                   arrays=[np.asarray(delta, np.float32)])
 
     def pull_sparse(self, name, ids):
         _, arrays = self._call(self._ep_for(name), "pull_sparse", name,

@@ -95,6 +95,30 @@ class FleetTranspiler(Fleet):
                 if val is not None:
                     self._client.init_dense(p, np.asarray(val).ravel())
 
+        # install the communicator for async / half-async / GEO modes
+        # (reference: Communicator::InitInstance + fleet init_worker)
+        from ....transpiler.distribute_transpiler import DistributedMode
+        from ....distributed_ps.communicator import (
+            AsyncCommunicator, GeoSgdCommunicator, HalfAsyncCommunicator)
+
+        mode = getattr(t, "mode", DistributedMode.SYNC)
+        if mode == DistributedMode.ASYNC:
+            runtime.set_communicator(
+                AsyncCommunicator(self._client).start())
+        elif mode == DistributedMode.HALF_ASYNC:
+            runtime.set_communicator(
+                HalfAsyncCommunicator(self._client).start())
+        elif mode == DistributedMode.GEO:
+            comm = GeoSgdCommunicator(
+                self._client, [p for p, _ in t._param_grads],
+                push_nums=getattr(t.config, "geo_sgd_need_push_nums", 100),
+                sparse_tables=getattr(t, "_sparse_tables", {}))
+            # baseline snapshots = the just-initialized params (what the
+            # server holds after trainer-0's init push)
+            from ....framework.scope import global_scope
+            comm.init_snapshots(global_scope())
+            runtime.set_communicator(comm)
+
     def init_server(self, model_dir=None, endpoint=None):
         from ....distributed_ps.service import PSServer
 
@@ -150,6 +174,7 @@ class ParameterServerOptimizer(DistributedOptimizer):
             else "127.0.0.1:6174",
             trainers=f.worker_num() if f._is_initialized else 1,
             sync_mode=sync,
+            mode=config.distributed_mode,
         )
         f._transpiler = t
         f.main_program = t.origin_program

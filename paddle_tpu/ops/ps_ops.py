@@ -26,13 +26,28 @@ def _host(type, **kw):
     return op(type, host=True, **kw)
 
 
+def _communicator():
+    from ..distributed_ps import runtime
+
+    return runtime.communicator()
+
+
 @_host("send", no_grad=True)
 def _send(ctx):
-    """Push grads to the pserver table (reference: send_op.cc)."""
-    client = _client()
+    """Push grads to the pserver table (reference: send_op.cc).  With an
+    async/half-async communicator installed, the push is enqueued to the
+    background send thread instead of blocking the step
+    (communicator.h:237 Send)."""
     names = ctx.op.inputs.get("X", [])
     vals = ctx.ins("X")
     table = ctx.attr("table_name")
+    comm = _communicator()
+    if comm is not None and not ctx.attr("sync_mode", True) \
+            and hasattr(comm, "send"):
+        for name, val in zip(names, vals):
+            comm.send(table or name, np.asarray(val))
+        return
+    client = _client()
     for name, val in zip(names, vals):
         tname = table or name
         client.push_dense(tname, np.asarray(val),
@@ -41,15 +56,50 @@ def _send(ctx):
 
 @_host("recv", no_grad=True)
 def _recv(ctx):
-    """Pull params from the pserver table (reference: recv_op.cc)."""
-    client = _client()
+    """Pull params from the pserver table (reference: recv_op.cc).  With
+    an async communicator the read comes from the param cache kept warm
+    by the independent recv thread (communicator.h RecvThread); in
+    half-async mode the per-round barrier drains the queues first."""
+    comm = _communicator()
+    if comm is not None and getattr(comm, "mode", "") == "half_async" \
+            and ctx.attr("half_async_barrier", False):
+        comm.barrier()
     for slot_name in ctx.out_names("Out"):
         table = ctx.attr("table_name") or slot_name
-        val = client.pull_dense(table)
+        if comm is not None and not ctx.attr("sync_mode", True) \
+                and hasattr(comm, "recv"):
+            val = comm.recv(table)
+        else:
+            val = _client().pull_dense(table)
         var = ctx.block._find_var_recursive(slot_name) if ctx.block else None
         if var is not None and var.shape:
             val = val.reshape([s for s in var.shape])
         ctx.env[slot_name] = val
+
+
+@_host("geo_sgd", no_grad=True)
+def _geo_sgd(ctx):
+    """GEO-SGD round hook (reference: GeoSgdCommunicator::Send) — counts
+    steps; every geo_sgd_need_push_nums steps pushes local param deltas
+    and pulls the merged global params back into the trainer scope via
+    the executor env."""
+    comm = _communicator()
+    if comm is None or getattr(comm, "mode", "") != "geo":
+        return
+    # the hybrid executor env IS the live state for this step: read
+    # params from it, and write refreshed globals back into it so the
+    # post-step state_out capture persists them to the scope
+    class _EnvScope:
+        def __init__(self, env):
+            self.env = env
+
+        def get(self, name):
+            return self.env.get(name)
+
+        def set(self, name, value):
+            self.env[name] = value
+
+    comm.geo_step(_EnvScope(ctx.env))
 
 
 @_host("send_barrier", no_grad=True)
@@ -106,14 +156,21 @@ def _dlt_grad_maker(op_, no_grad_names=frozenset()):
 
 @_host("distributed_lookup_table_grad", no_grad=True)
 def _distributed_lookup_table_grad(ctx):
-    """Push sparse grads (reference: PushSparseVarsWithLabelAsync shape)."""
-    client = _client()
+    """Push sparse grads (reference: PushSparseVarsWithLabelAsync shape).
+    With an async/half-async communicator installed, the push is
+    enqueued to its background sparse queue instead of blocking."""
     table = ctx.attr("table_name")
     dim = ctx.attr("emb_dim")
+    comm = _communicator()
+    use_comm = comm is not None and hasattr(comm, "send_sparse")
+    client = None if use_comm else _client()
     for ids, g in zip(ctx.ins("Ids"), ctx.ins("Outputs" + GRAD_SUFFIX)):
         ids_np = np.asarray(ids).astype(np.int64).ravel()
         g_np = np.asarray(g).reshape(ids_np.size, dim)
-        client.push_sparse(table, ids_np, g_np)
+        if use_comm:
+            comm.send_sparse(table, ids_np, g_np)
+        else:
+            client.push_sparse(table, ids_np, g_np)
 
 
 @_host("listen_and_serv", no_grad=True)

@@ -40,8 +40,21 @@ class DistributeTranspilerConfig:
     wait_port = True
     runtime_split_send_recv = False
     sync_mode = True
+    half_async = False
     geo_sgd_mode = False
     geo_sgd_need_push_nums = 100
+
+    @property
+    def distributed_mode(self) -> int:
+        """Map the config flags to a DistributedMode (reference:
+        distribute_transpiler.py:68 + fleet DistributedStrategy modes)."""
+        if self.geo_sgd_mode:
+            return DistributedMode.GEO
+        if self.half_async:
+            return DistributedMode.HALF_ASYNC
+        if not self.sync_mode:
+            return DistributedMode.ASYNC
+        return DistributedMode.SYNC
 
     def __init__(self):
         pass
@@ -99,9 +112,24 @@ class DistributeTranspiler:
         sync_mode: bool = True,
         startup_program: Optional[Program] = None,
         current_endpoint: str = "127.0.0.1:6174",
+        mode: Optional[int] = None,
     ):
         from ..framework.core import default_main_program, default_startup_program
 
+        if mode is None:
+            # the sync_mode kwarg is the public API's mode switch and
+            # must keep working on a default config: sync_mode=False
+            # means ASYNC unless the config asks for half-async/GEO
+            if self.config.geo_sgd_mode:
+                mode = DistributedMode.GEO
+            elif self.config.half_async:
+                mode = DistributedMode.HALF_ASYNC
+            elif not sync_mode or not self.config.sync_mode:
+                mode = DistributedMode.ASYNC
+            else:
+                mode = DistributedMode.SYNC
+        self.mode = mode
+        sync_mode = mode == DistributedMode.SYNC
         self.trainer_id = trainer_id
         self.trainer_num = trainers
         self.sync_mode = sync_mode
@@ -110,8 +138,10 @@ class DistributeTranspiler:
         self.pserver_endpoints = pservers.split(",")
 
         block = self.origin_program.global_block()
-        # collect (param, grad) via op_role_var on optimize ops, then drop
-        # the optimizer ops from the trainer program (they run on pservers)
+        # collect (param, grad) via op_role_var on optimize ops.  For
+        # SYNC/ASYNC/HALF_ASYNC the optimizer ops move to the pservers;
+        # for GEO they STAY — the trainer optimizes locally and the
+        # communicator ships param deltas (communicator.h:383).
         param_grads = []
         opt_op_idxs = []
         for i, op_ in enumerate(block.ops):
@@ -122,8 +152,9 @@ class DistributeTranspiler:
                 opt_op_idxs.append(i)
         self._param_grads = param_grads
         self._opt_ops = [block.ops[i] for i in opt_op_idxs]
-        for i in reversed(opt_op_idxs):
-            block._remove_op(i)
+        if mode != DistributedMode.GEO:
+            for i in reversed(opt_op_idxs):
+                block._remove_op(i)
 
         # -- distributed sparse embeddings (reference: distribute_
         # transpiler.py:1761 _replace_lookup_table_op_with_prefetch):
@@ -208,6 +239,24 @@ class DistributeTranspiler:
             self._ep_params[ep].append(p)
             self._ep_grads[ep].append(g)
 
+        if mode == DistributedMode.GEO:
+            # GEO: the trainer program keeps its optimizer ops; a single
+            # geo_sgd host op per step counts rounds and, every
+            # geo_sgd_need_push_nums steps, pushes param deltas + pulls
+            # the merged globals (communicator.h:383 GeoSgdCommunicator).
+            # Params are listed as inputs AND outputs so the executor's
+            # state analysis threads the refreshed values back to scope.
+            ps = [p for p, g in param_grads]
+            block.append_op(
+                "geo_sgd",
+                inputs={"X": ps},
+                outputs={"Out": ps},
+                attrs={"endpoints": eps,
+                       "push_nums": self.config.geo_sgd_need_push_nums,
+                       OP_ROLE_KEY: OpRole.RPC},
+            )
+            return
+
         # rewrite trainer program: send grads, recv params
         for i, (p, g) in enumerate(param_grads):
             ep = self._param_to_pserver[p]
@@ -224,15 +273,16 @@ class DistributeTranspiler:
                 attrs={"endpoints": eps, "trainer_id": trainer_id,
                        OP_ROLE_KEY: OpRole.RPC},
             )
-        for p, g in param_grads:
+        for j, (p, g) in enumerate(param_grads):
             ep = self._param_to_pserver[p]
-            block.append_op(
-                "recv",
-                outputs={"Out": [p]},
-                attrs={"epmap": [ep], "recv_varnames": [p],
-                       "table_name": p,
-                       "sync_mode": sync_mode, OP_ROLE_KEY: OpRole.RPC},
-            )
+            attrs = {"epmap": [ep], "recv_varnames": [p],
+                     "table_name": p,
+                     "sync_mode": sync_mode, OP_ROLE_KEY: OpRole.RPC}
+            if j == 0 and mode == DistributedMode.HALF_ASYNC:
+                # per-round barrier before the first pull of the next
+                # round (HalfAsyncCommunicator::Barrier)
+                attrs["half_async_barrier"] = True
+            block.append_op("recv", outputs={"Out": [p]}, attrs=attrs)
         if sync_mode:
             block.append_op(
                 "fetch_barrier",

@@ -23,6 +23,7 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_communicator_send_queue_size": 20,
     "FLAGS_communicator_independent_recv_thread": True,
     "FLAGS_communicator_send_wait_times": 5,
+    "FLAGS_communicator_recv_wait_ms": 50,
     "FLAGS_rpc_deadline": 180000,
     "FLAGS_rpc_retry_times": 3,
     "FLAGS_use_pinned_memory": True,
