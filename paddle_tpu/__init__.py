@@ -56,4 +56,25 @@ from .executor import Executor
 from .backward import append_backward, gradients
 from .framework.scope import global_scope, scope_guard, LoDTensor, Scope
 
+
+def grad(*args, **kwargs):
+    """``paddle.grad`` — eager partial grad (PartialGradEngine analog);
+    see dygraph.base.grad."""
+    from .dygraph.base import grad as _g
+
+    return _g(*args, **kwargs)
+
+
+def enable_dygraph(place=None):
+    from .dygraph.base import enable_dygraph as _e
+
+    return _e(place)
+
+
+def disable_dygraph():
+    from .dygraph.base import disable_dygraph as _d
+
+    return _d()
+
+
 __version__ = "0.1.0"

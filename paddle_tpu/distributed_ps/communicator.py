@@ -189,14 +189,18 @@ class AsyncCommunicator:
                         break
                 if merged:
                     worked = True
-                    # MergeVars: average so the effective lr does not
-                    # scale with merge depth (communicator.cc MergeVars)
-                    g = merged[0] if len(merged) == 1 else (
-                        np.sum(merged, axis=0) / float(len(merged)))
+
+                    def _dense_push(table=table, merged=merged):
+                        # MergeVars: average so the effective lr does not
+                        # scale with merge depth (communicator.cc
+                        # MergeVars).  Merge is inside the guarded call:
+                        # a shape mismatch must not kill the send thread.
+                        g = merged[0] if len(merged) == 1 else (
+                            np.sum(merged, axis=0) / float(len(merged)))
+                        self._client.push_dense(table, g, sync=False)
+
                     try:
-                        self._push_retrying(
-                            lambda: self._client.push_dense(
-                                table, g, sync=False))
+                        self._push_retrying(_dense_push)
                     finally:
                         self._dec_inflight(len(merged))
             for table, q in list(self._sparse_queues.items()):
@@ -208,13 +212,15 @@ class AsyncCommunicator:
                         break
                 if batch:
                     worked = True
-                    try:
+
+                    def _sparse_push(table=table, batch=batch):
                         ids = np.concatenate([b[0] for b in batch])
                         grads = np.concatenate(
                             [b[1].reshape(b[0].size, -1) for b in batch])
-                        self._push_retrying(
-                            lambda: self._client.push_sparse(
-                                table, ids, grads))
+                        self._client.push_sparse(table, ids, grads)
+
+                    try:
+                        self._push_retrying(_sparse_push)
                     finally:
                         self._dec_inflight(len(batch))
             if not worked:
@@ -244,6 +250,11 @@ class HalfAsyncCommunicator(AsyncCommunicator):
     def barrier(self, timeout: float = 120.0):
         self.flush(timeout)
         self._client.barrier(timeout)
+        # invalidate AFTER the server barrier: while this trainer waited,
+        # the recv thread may have cached params missing the other
+        # trainers' round-k grads — the next recv must pull fresh
+        with self._cache_lock:
+            self._param_cache.clear()
 
 
 class GeoSgdCommunicator:
@@ -272,6 +283,15 @@ class GeoSgdCommunicator:
         self._lock = threading.Lock()
 
     def start(self):
+        # baseline every param now: a snapshot taken lazily at push time
+        # would be `current_global` (already containing other trainers'
+        # deltas) and the first delta would destructively overwrite them
+        for p in self._params:
+            if p not in self._snapshots:
+                try:
+                    self._snapshots[p] = self._client.pull_dense(p)
+                except Exception:
+                    pass
         return self
 
     def init_snapshots(self, scope):
@@ -291,10 +311,15 @@ class GeoSgdCommunicator:
                 local = np.asarray(scope.get(p), np.float32)
                 snap = self._snapshots.get(p)
                 if snap is None:
-                    # baseline = last value synced with the server; if
-                    # init_snapshots was not called, that is the server's
-                    # current global (trainer-0 pushed init params)
-                    snap = self._client.pull_dense(p)
+                    # no baseline recorded at start (param appeared after
+                    # init): pushing `local - current_global` here would
+                    # overwrite other trainers' accumulated deltas, so
+                    # push nothing and adopt the global as the new
+                    # local + baseline instead
+                    fresh = self._client.pull_dense(p).reshape(local.shape)
+                    scope.set(p, fresh)
+                    self._snapshots[p] = fresh.copy()
+                    continue
                 delta = (local - snap.reshape(local.shape)).ravel()
                 self._client.push_delta(p, delta)
                 fresh = self._client.pull_dense(p).reshape(local.shape)

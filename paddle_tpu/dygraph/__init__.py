@@ -5,6 +5,7 @@ from .base import (
     enabled,
     to_variable,
     no_grad,
+    grad,
     enable_dygraph,
     disable_dygraph,
 )

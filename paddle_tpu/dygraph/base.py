@@ -68,6 +68,25 @@ def no_grad(fn=None):
     return wrapper
 
 
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """``paddle.grad`` for dygraph (reference: fluid/dygraph/base.py grad
+    -> imperative/partial_grad_engine.h:30 PartialGradEngine): gradients
+    of ``outputs`` w.r.t. ``inputs`` without accumulating into leaf
+    ``.grad``; ``create_graph=True`` makes the result differentiable for
+    double/triple grad."""
+    tracer = _current_tracer()
+    if tracer is None:
+        raise RuntimeError("paddle.grad() requires dygraph mode — use "
+                           "dygraph.guard() or enable_dygraph()")
+    return tracer.partial_grad(
+        outputs, inputs, grad_outputs=grad_outputs,
+        retain_graph=retain_graph, create_graph=create_graph,
+        only_inputs=only_inputs, allow_unused=allow_unused,
+        no_grad_vars=no_grad_vars)
+
+
 def _dygraph_minimize(optimizer, loss, parameter_list=None):
     """Apply optimizer update eagerly to traced parameters."""
     from .varbase import VarBase

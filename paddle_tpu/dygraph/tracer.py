@@ -138,13 +138,20 @@ class Tracer:
                     env[name] = v._value
                 for name, v in rec.out_refs.items():
                     env[name] = v._value
-                # output grads (missing -> @EMPTY@)
+                # output grads (missing -> @EMPTY@).  NOTE: when the
+                # recorded op is itself a grad op (double backward), its
+                # own forward-input slots can end with @GRAD (e.g.
+                # "Out@GRAD") while holding plain forward refs — those
+                # are identified by the NAME (eager names never carry
+                # the suffix) and kept as forward values.
                 for slot, names in list(desc["inputs"].items()):
                     if not slot.endswith(GRAD_SUFFIX):
                         continue
                     new_names = []
                     for n in names:
-                        if n.endswith(GRAD_SUFFIX) and n[: -len(GRAD_SUFFIX)] in grads:
+                        if not n.endswith(GRAD_SUFFIX):
+                            new_names.append(n)  # forward ref from refs
+                        elif n[: -len(GRAD_SUFFIX)] in grads:
                             env[n] = grads[n[: -len(GRAD_SUFFIX)]]
                             new_names.append(n)
                         else:
@@ -183,6 +190,102 @@ class Tracer:
             v._grad_value = g if v._grad_value is None else v._grad_value + g
         if not retain_graph:
             self._tape.clear()
+
+    # ------------------------------------------------------------------
+    def partial_grad(self, outputs, inputs, grad_outputs=None,
+                     retain_graph=None, create_graph=False,
+                     only_inputs=True, allow_unused=False,
+                     no_grad_vars=None):
+        """PartialGradEngine analog (reference:
+        imperative/partial_grad_engine.h:30 + dygraph/base.py grad):
+        grads of ``outputs`` w.r.t. ``inputs`` WITHOUT touching leaf
+        ``.grad`` buffers.  With ``create_graph=True`` every grad op is
+        re-recorded through ``trace_op`` (the *_grad types replay a
+        differentiable vjp), so the returned grads support another
+        ``backward()``/``grad()`` — double and triple grad."""
+        if not only_inputs:
+            raise NotImplementedError(
+                "only_inputs=False is deprecated in the reference and "
+                "unsupported here")
+        outputs = [outputs] if isinstance(outputs, VarBase) else list(outputs)
+        inputs = [inputs] if isinstance(inputs, VarBase) else list(inputs)
+        if grad_outputs is None:
+            grad_outputs = [None] * len(outputs)
+        grad_outputs = ([grad_outputs] if isinstance(grad_outputs, VarBase)
+                        else list(grad_outputs))
+        if len(grad_outputs) != len(outputs):
+            raise ValueError("grad_outputs must match outputs length")
+        no_grad_names = {v.name for v in (no_grad_vars or [])}
+        retain = create_graph if retain_graph is None else retain_graph
+
+        grads: Dict[str, VarBase] = {}
+        for o, go in zip(outputs, grad_outputs):
+            if go is None:
+                go = VarBase(jnp.ones(o.shape, to_numpy_dtype(o.dtype)),
+                             stop_gradient=True)
+            elif not isinstance(go, VarBase):
+                go = VarBase(go, stop_gradient=True)
+            grads[o.name] = go if o.name not in grads else grads[o.name] + go
+
+        tape_snapshot = list(self._tape)
+        prev_has_grad = self._has_grad
+        self._has_grad = create_graph
+        try:
+            for rec in reversed(tape_snapshot):
+                op = rec.op
+                out_names = [n for ns in op.outputs.values() for n in ns]
+                if not any(n in grads for n in out_names):
+                    continue
+                for desc in registry.make_grad_ops(op, no_grad_names):
+                    in_spec: Dict[str, List[Optional[VarBase]]] = {}
+                    for slot, names in desc["inputs"].items():
+                        vs: List[Optional[VarBase]] = []
+                        for n in names:
+                            if slot.endswith(GRAD_SUFFIX) and \
+                                    n.endswith(GRAD_SUFFIX):
+                                vs.append(grads.get(n[: -len(GRAD_SUFFIX)]))
+                            elif n in rec.in_refs:
+                                vs.append(rec.in_refs[n])
+                            elif n in rec.out_refs:
+                                vs.append(rec.out_refs[n])
+                            else:
+                                vs.append(None)
+                        in_spec[slot] = vs
+                    out_spec: Dict[str, List[VarBase]] = {}
+                    out_names_by_slot: Dict[str, List[str]] = {}
+                    for slot, names in desc["outputs"].items():
+                        out_spec[slot] = [VarBase(None, stop_gradient=True)
+                                          for _ in names]
+                        out_names_by_slot[slot] = list(names)
+                    self.trace_op(desc["type"], in_spec, out_spec,
+                                  desc.get("attrs") or {})
+                    for slot, names in out_names_by_slot.items():
+                        for n, v in zip(names, out_spec[slot]):
+                            if (n == EMPTY_VAR_NAME
+                                    or not n.endswith(GRAD_SUFFIX)
+                                    or v._value is None):
+                                continue
+                            base = n[: -len(GRAD_SUFFIX)]
+                            if base in no_grad_names:
+                                continue
+                            prev = grads.get(base)
+                            grads[base] = v if prev is None else prev + v
+        finally:
+            self._has_grad = prev_has_grad
+
+        results = []
+        for i, v in enumerate(inputs):
+            g = grads.get(v.name)
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    f"input {i} ({v.name}) is unreachable from outputs; "
+                    f"pass allow_unused=True to get None instead")
+            results.append(g)
+        # clear only after results assembled: a raising call (e.g.
+        # unreachable input without allow_unused) leaves the graph intact
+        if not retain:
+            self._tape.clear()
+        return results
 
     # ------------------------------------------------------------------
     # LayerHelper integration

@@ -114,10 +114,11 @@ class FleetTranspiler(Fleet):
                 push_nums=getattr(t.config, "geo_sgd_need_push_nums", 100),
                 sparse_tables=getattr(t, "_sparse_tables", {}))
             # baseline snapshots = the just-initialized params (what the
-            # server holds after trainer-0's init push)
+            # server holds after trainer-0's init push); start() then
+            # pulls baselines for any param missing from the scope
             from ....framework.scope import global_scope
             comm.init_snapshots(global_scope())
-            runtime.set_communicator(comm)
+            runtime.set_communicator(comm.start())
 
     def init_server(self, model_dir=None, endpoint=None):
         from ....distributed_ps.service import PSServer

@@ -117,17 +117,11 @@ class DistributeTranspiler:
         from ..framework.core import default_main_program, default_startup_program
 
         if mode is None:
+            mode = self.config.distributed_mode
             # the sync_mode kwarg is the public API's mode switch and
-            # must keep working on a default config: sync_mode=False
-            # means ASYNC unless the config asks for half-async/GEO
-            if self.config.geo_sgd_mode:
-                mode = DistributedMode.GEO
-            elif self.config.half_async:
-                mode = DistributedMode.HALF_ASYNC
-            elif not sync_mode or not self.config.sync_mode:
+            # must keep working on a default config
+            if mode == DistributedMode.SYNC and not sync_mode:
                 mode = DistributedMode.ASYNC
-            else:
-                mode = DistributedMode.SYNC
         self.mode = mode
         sync_mode = mode == DistributedMode.SYNC
         self.trainer_id = trainer_id

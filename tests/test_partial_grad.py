@@ -1,0 +1,114 @@
+"""paddle.grad() / PartialGradEngine tests.
+
+Reference semantics: python/paddle/fluid/dygraph/base.py grad() +
+imperative/partial_grad_engine.h:30 (tests:
+test_imperative_double_grad.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.dygraph import guard, to_variable
+
+
+def test_basic_partial_grad():
+    with guard():
+        x = to_variable(np.array([1.0, 2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        (gx,) = pt.grad(y, x)
+        np.testing.assert_allclose(np.asarray(gx.value()),
+                                   [2.0, 4.0, 6.0], rtol=1e-6)
+        # leaf .grad untouched (unlike backward())
+        assert x.gradient() is None
+
+
+def test_grad_outputs_weighting():
+    with guard():
+        x = to_variable(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        w = to_variable(np.array([3.0, 0.5], np.float32))
+        (gx,) = pt.grad(y, x, grad_outputs=w)
+        np.testing.assert_allclose(np.asarray(gx.value()),
+                                   [6.0, 2.0], rtol=1e-6)
+
+
+def test_allow_unused():
+    with guard():
+        x = to_variable(np.array([1.0], np.float32))
+        x.stop_gradient = False
+        z = to_variable(np.array([2.0], np.float32))
+        z.stop_gradient = False
+        y = x * x
+        with pytest.raises(RuntimeError):
+            pt.grad(y, [x, z])
+        gx, gz = pt.grad(y, [x, z], allow_unused=True, retain_graph=True)
+        assert gz is None
+        np.testing.assert_allclose(np.asarray(gx.value()), [2.0], rtol=1e-6)
+
+
+def test_no_grad_vars():
+    with guard():
+        x = to_variable(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        w = to_variable(np.array([3.0], np.float32))
+        w.stop_gradient = False
+        y = x * w
+        (gx,) = pt.grad(y, x, no_grad_vars=[w], allow_unused=True)
+        np.testing.assert_allclose(np.asarray(gx.value()), [3.0], rtol=1e-6)
+
+
+def test_double_grad_create_graph():
+    """d2(x^3)/dx2 = 6x via grad-of-grad."""
+    with guard():
+        x = to_variable(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x * x
+        (gx,) = pt.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(np.asarray(gx.value()),
+                                   [3.0, 12.0], rtol=1e-5)
+        (ggx,) = pt.grad(gx, x)
+        np.testing.assert_allclose(np.asarray(ggx.value()),
+                                   [6.0, 12.0], rtol=1e-5)
+
+
+def test_double_grad_then_backward():
+    """GAN-gradient-penalty shape: grad(create_graph=True) feeds a loss
+    that then runs full backward into leaf .grad."""
+    with guard():
+        x = to_variable(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        (gx,) = pt.grad(y, x, create_graph=True)  # 2x
+        loss = gx * gx                            # 4x^2
+        loss.backward()
+        # dloss/dx = 8x = 16
+        np.testing.assert_allclose(np.asarray(x.gradient()), [16.0],
+                                   rtol=1e-5)
+
+
+def test_retain_graph_false_clears_tape():
+    from paddle_tpu.framework.core import _current_tracer
+
+    with guard():
+        x = to_variable(np.array([1.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        pt.grad(y, x)  # retain defaults to create_graph=False
+        assert len(_current_tracer()._tape) == 0
+
+
+def test_layer_param_partial_grad():
+    """grad w.r.t. a Layer parameter (matmul path)."""
+    from paddle_tpu.dygraph import Linear
+
+    with guard():
+        lin = Linear(4, 3)
+        x = to_variable(np.ones((2, 4), np.float32))
+        y = lin(x)
+        s = y * y
+        (gw,) = pt.grad(s, lin.weight, retain_graph=True)
+        assert tuple(np.asarray(gw.value()).shape) == (4, 3)
+        # oracle: d sum-ish via backward on a fresh pass gives same shape
+        assert np.isfinite(np.asarray(gw.value())).all()
