@@ -290,8 +290,14 @@ class GeoSgdCommunicator:
             if p not in self._snapshots:
                 try:
                     self._snapshots[p] = self._client.pull_dense(p)
-                except Exception:
-                    pass
+                except Exception as e:
+                    import warnings
+
+                    warnings.warn(
+                        f"GEO baseline pull failed for {p!r} "
+                        f"({type(e).__name__}: {e}); the first geo round "
+                        f"will adopt the server value and DROP local "
+                        f"progress on this param")
         return self
 
     def init_snapshots(self, scope):

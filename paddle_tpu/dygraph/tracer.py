@@ -228,15 +228,32 @@ class Tracer:
             grads[o.name] = go if o.name not in grads else grads[o.name] + go
 
         tape_snapshot = list(self._tape)
+        # prune to the inputs->outputs subgraph (PartialGradEngine's
+        # path pruning): a var is RELEVANT when it depends on one of
+        # ``inputs``; ops with no relevant input need no grad op at all,
+        # and non-relevant inputs of relevant ops get their grads
+        # blanked so no wasted compute/tape records accumulate
+        relevant = {v.name for v in inputs}
+        for rec in tape_snapshot:
+            in_names = [n for ns in rec.op.inputs.values() for n in ns]
+            if any(n in relevant for n in in_names):
+                relevant.update(
+                    n for ns in rec.op.outputs.values() for n in ns)
         prev_has_grad = self._has_grad
         self._has_grad = create_graph
         try:
             for rec in reversed(tape_snapshot):
                 op = rec.op
                 out_names = [n for ns in op.outputs.values() for n in ns]
+                in_names = [n for ns in op.inputs.values() for n in ns]
                 if not any(n in grads for n in out_names):
                     continue
-                for desc in registry.make_grad_ops(op, no_grad_names):
+                if not any(n in relevant for n in in_names):
+                    continue
+                rec_no_grad = no_grad_names | {
+                    n for n in in_names
+                    if n not in relevant and n != EMPTY_VAR_NAME}
+                for desc in registry.make_grad_ops(op, rec_no_grad):
                     in_spec: Dict[str, List[Optional[VarBase]]] = {}
                     for slot, names in desc["inputs"].items():
                         vs: List[Optional[VarBase]] = []

@@ -71,6 +71,10 @@ def _fetch_name(f) -> str:
 def as_numpy(value):
     if isinstance(value, LoDTensor):
         return value.numpy()
+    from .framework.selected_rows import SelectedRows
+
+    if isinstance(value, SelectedRows):
+        return value.numpy()  # densified view for fetch consumers
     return np.asarray(value)
 
 

@@ -1,0 +1,106 @@
+"""SelectedRows: sparse row-set gradient value.
+
+Capability parity with the reference SelectedRows runtime type
+(reference: paddle/fluid/framework/selected_rows.h:32 — a {rows, value,
+height} triple produced by sparse embedding backward and consumed by the
+optimizers' SelectedRows kernels, operators/optimizers/*).
+
+TPU-native design: SelectedRows is a jax pytree, so it flows through the
+whole-program jit like any other value.  The embedding grad emits
+(rows=flattened ids, values=out-grad rows) in O(batch) instead of a
+dense O(vocab) scatter; sparse-aware optimizer lowerings then update
+only the touched rows with ``param.at[rows].add`` (XLA scatter-add,
+duplicate ids accumulate correctly).  Ops that are not sparse-aware see
+a dense array via ``maybe_dense`` so correctness never depends on op
+coverage.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: (n,) int32 row indices (duplicates allowed);
+    values: (n, *dim) per-row values; height: static row count of the
+    dense equivalent (selected_rows.h height_)."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        return cls(rows, values, height)
+
+    # -- conversions -------------------------------------------------------
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(jnp.shape(self.values)[1:])
+
+    def to_dense(self):
+        """Densify: O(height) memory — the fallback for non-sparse-aware
+        consumers (reference: math::SelectedRowsToTensor)."""
+        dense = jnp.zeros(self.dense_shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def merge_rows(self):
+        """Deduplicate rows by summing their values (reference:
+        math::scatter::MergeAdd).  XLA needs static shapes, so the
+        result keeps length n: each distinct row appears once with the
+        summed value, and the leftover slots carry the sentinel row
+        ``height`` — consumers must scatter with mode='drop' so the
+        sentinel rows vanish.  Required before any read-modify-write
+        optimizer update (momentum/adam/adagrad), where duplicate rows
+        in a plain scatter would read stale state."""
+        n = self.rows.shape[0]
+        order = jnp.argsort(self.rows)
+        r_s = jnp.take(self.rows, order)
+        v_s = jnp.take(self.values, order, axis=0)
+        boundary = jnp.concatenate(
+            [jnp.ones((1,), jnp.int32),
+             (r_s[1:] != r_s[:-1]).astype(jnp.int32)])
+        seg = jnp.cumsum(boundary) - 1  # segment id per sorted position
+        merged = jax.ops.segment_sum(v_s, seg, num_segments=n)
+        rows_m = jnp.full((n,), self.height, r_s.dtype)
+        rows_m = rows_m.at[seg].min(r_s)
+        return SelectedRows(rows_m, merged, self.height)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.height,
+            )
+        # dense + sparse -> dense
+        return maybe_dense(other) + self.to_dense()
+
+    __radd__ = __add__
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+
+def maybe_dense(v):
+    """Densify SelectedRows, pass anything else through."""
+    return v.to_dense() if isinstance(v, SelectedRows) else v

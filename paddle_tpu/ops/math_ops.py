@@ -147,9 +147,18 @@ def _pow(ctx):
 
 @op("scale")
 def _scale(ctx):
+    from ..framework.selected_rows import SelectedRows
+
     x = ctx.in_("X")
     s = ctx.in_("ScaleTensor") if ctx.has_input("ScaleTensor") else ctx.attr("scale", 1.0)
     b = ctx.attr("bias", 0.0)
+    if isinstance(x, SelectedRows):
+        # sparse scale touches values only (reference: scale_op
+        # SelectedRows kernel); a nonzero bias forces densify
+        if b == 0.0:
+            ctx.set_out("Out", SelectedRows(x.rows, x.values * s, x.height))
+            return
+        x = x.to_dense()
     if ctx.attr("bias_after_scale", True):
         out = x * s + jnp.asarray(b, jnp.result_type(x))
     else:
@@ -166,8 +175,21 @@ def _clip(ctx):
 
 @op("clip_by_norm")
 def _clip_by_norm(ctx):
+    from ..framework.selected_rows import SelectedRows
+
     x = ctx.in_("X")
     max_norm = ctx.attr("max_norm", 1.0)
+    if isinstance(x, SelectedRows):
+        # reference: clip_by_norm SelectedRows kernel — MergeAdd first
+        # (selected_rows_functor), then norm over the merged rows:
+        # duplicate ids must be summed before norming or the clip scale
+        # is wrong
+        x = x.merge_rows()
+        norm = jnp.sqrt(jnp.sum(jnp.square(x.values)))
+        scaled = jnp.where(norm > max_norm, max_norm / norm, 1.0)
+        ctx.set_out("Out",
+                    SelectedRows(x.rows, x.values * scaled, x.height))
+        return
     norm = jnp.sqrt(jnp.sum(jnp.square(x)))
     ctx.set_out("Out", jnp.where(norm > max_norm, x * (max_norm / norm), x))
 

@@ -538,6 +538,59 @@ op("lookup_table_v2")(lambda ctx: _lookup(ctx, squeeze_last=False))
 op("embedding")(lambda ctx: _lookup(ctx, squeeze_last=False))
 
 
+def _lookup_sparse_grad_maker(fwd_type, squeeze_last):
+    """is_sparse=True embeddings produce a SelectedRows W@GRAD in
+    O(batch) (reference: lookup_table_op.cc LookupTableGradKernel's
+    SelectedRows branch, framework/selected_rows.h:32) instead of the
+    generic vjp's dense O(vocab) scatter.  Ids get no grad."""
+
+    @grad_maker(fwd_type)
+    def maker(op_, no_grad_names=frozenset()):
+        if not op_.attr("is_sparse", False):
+            return default_grad_maker(op_, no_grad_names)
+        w = op_.input("W")[0]
+        out = op_.output("Out")[0]
+        w_grad = (EMPTY_VAR_NAME if w in no_grad_names
+                  else w + GRAD_SUFFIX)
+        return [dict(
+            type="lookup_table_sparse_grad",
+            inputs={"W": list(op_.input("W")),
+                    "Ids": list(op_.input("Ids")),
+                    "Out" + GRAD_SUFFIX: [out + GRAD_SUFFIX]},
+            outputs={"W" + GRAD_SUFFIX: [w_grad]},
+            attrs={**dict(op_.attrs), "__squeeze_last__": squeeze_last},
+        )]
+    return maker
+
+
+_lookup_sparse_grad_maker("lookup_table", True)
+_lookup_sparse_grad_maker("lookup_table_v2", False)
+_lookup_sparse_grad_maker("embedding", False)
+
+
+@op("lookup_table_sparse_grad", no_grad=True)
+def _lookup_table_sparse_grad(ctx):
+    from ..framework.selected_rows import SelectedRows
+
+    w = ctx.in_("W")
+    ids = ctx.in_("Ids")
+    g = ctx.in_("Out" + GRAD_SUFFIX)
+    padding_idx = ctx.attr("padding_idx", -1)
+    squeeze_last = ctx.attr("__squeeze_last__", False)
+    ids_i = ids.astype(jnp.int32)
+    if squeeze_last and jnp.ndim(ids_i) > 1 and jnp.shape(ids_i)[-1] == 1:
+        ids_i = jnp.squeeze(ids_i, -1)
+    rows = ids_i.ravel()
+    dim = jnp.shape(w)[-1]
+    values = jnp.reshape(g, (rows.size, dim))
+    if padding_idx is not None and padding_idx >= 0:
+        values = jnp.where((rows != padding_idx)[:, None], values, 0.0)
+    # clip out-of-range ids the same way forward does
+    rows = jnp.clip(rows, 0, jnp.shape(w)[0] - 1)
+    ctx.set_out("W" + GRAD_SUFFIX,
+                SelectedRows(rows, values, jnp.shape(w)[0]))
+
+
 @op("one_hot", no_grad=True)
 def _one_hot(ctx):
     x = ctx.in_("X").astype(jnp.int32)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import op
+from ..framework.selected_rows import SelectedRows
 
 
 def _opt(type):
@@ -23,7 +24,15 @@ def _opt(type):
 @_opt("sgd")
 def _sgd(ctx):
     p, g, lr = ctx.in_("Param"), ctx.in_("Grad"), ctx.in_("LearningRate")
-    ctx.set_out("ParamOut", p - lr.reshape(()).astype(p.dtype) * g.astype(p.dtype))
+    lr = lr.reshape(()).astype(p.dtype)
+    if isinstance(g, SelectedRows):
+        # SelectedRows kernel (reference: sgd_op.h SparseSGDFunctor):
+        # touch only the selected rows; duplicate ids accumulate
+        # correctly because scatter-add is the only write
+        ctx.set_out("ParamOut",
+                    p.at[g.rows].add(-lr * g.values.astype(p.dtype)))
+        return
+    ctx.set_out("ParamOut", p - lr * g.astype(p.dtype))
 
 
 @_opt("momentum")
@@ -32,6 +41,21 @@ def _momentum(ctx):
     lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
     mu = ctx.attr("mu", 0.9)
     use_nesterov = ctx.attr("use_nesterov", False)
+    if isinstance(g, SelectedRows):
+        # lazy sparse momentum (reference: momentum_op.h
+        # SparseMomentumFunctor): untouched rows keep their velocity;
+        # duplicates are merged first (read-modify-write rows)
+        m = g.merge_rows()
+        rows, gv = m.rows, m.values.astype(p.dtype)
+        v_rows = v.at[rows].get(mode="fill", fill_value=0)
+        v_new_rows = mu * v_rows + gv
+        if use_nesterov:
+            upd = (gv + mu * v_new_rows) * lr
+        else:
+            upd = lr * v_new_rows
+        ctx.set_out("ParamOut", p.at[rows].add(-upd, mode="drop"))
+        ctx.set_out("VelocityOut", v.at[rows].set(v_new_rows, mode="drop"))
+        return
     g = g.astype(p.dtype)
     v_new = mu * v + g
     if use_nesterov:
@@ -65,18 +89,36 @@ def _lars_momentum(ctx):
 
 @_opt("adam")
 def _adam(ctx):
-    p, g = ctx.in_("Param"), ctx.in_("Grad").astype(ctx.in_("Param").dtype)
+    p = ctx.in_("Param")
+    g = ctx.in_("Grad")
     m1, m2 = ctx.in_("Moment1"), ctx.in_("Moment2")
     b1p, b2p = ctx.in_("Beta1Pow"), ctx.in_("Beta2Pow")
     lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
     b1 = ctx.attr("beta1", 0.9)
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
-    m1_new = b1 * m1 + (1 - b1) * g
-    m2_new = b2 * m2 + (1 - b2) * jnp.square(g)
     b1p_ = b1p.reshape(()).astype(p.dtype)
     b2p_ = b2p.reshape(()).astype(p.dtype)
     lr_t = lr * jnp.sqrt(1 - b2p_ * b2) / (1 - b1p_ * b1)
+    if isinstance(g, SelectedRows):
+        # lazy sparse adam (reference: adam_op.h SparseAdamFunctor with
+        # lazy_mode): moments and param update only on touched rows
+        mg = g.merge_rows()
+        rows, gv = mg.rows, mg.values.astype(p.dtype)
+        m1_r = m1.at[rows].get(mode="fill", fill_value=0)
+        m2_r = m2.at[rows].get(mode="fill", fill_value=0)
+        m1_new = b1 * m1_r + (1 - b1) * gv
+        m2_new = b2 * m2_r + (1 - b2) * jnp.square(gv)
+        upd = lr_t * m1_new / (jnp.sqrt(m2_new) + eps)
+        ctx.set_out("ParamOut", p.at[rows].add(-upd, mode="drop"))
+        ctx.set_out("Moment1Out", m1.at[rows].set(m1_new, mode="drop"))
+        ctx.set_out("Moment2Out", m2.at[rows].set(m2_new, mode="drop"))
+        ctx.set_out("Beta1PowOut", b1p * b1)
+        ctx.set_out("Beta2PowOut", b2p * b2)
+        return
+    g = g.astype(p.dtype)
+    m1_new = b1 * m1 + (1 - b1) * g
+    m2_new = b2 * m2 + (1 - b2) * jnp.square(g)
     p_new = p - lr_t * m1_new / (jnp.sqrt(m2_new) + eps)
     ctx.set_out("ParamOut", p_new)
     ctx.set_out("Moment1Out", m1_new)
@@ -93,8 +135,13 @@ def _adamw(ctx):
     with_decay = ctx.attr("with_decay", True)
     if with_decay:
         p = p * (1.0 - lr * coeff)
-    # reuse adam math on the decayed param
-    g = ctx.in_("Grad").astype(p.dtype)
+    # reuse adam math on the decayed param.  Decoupled weight decay
+    # touches EVERY row, so a sparse grad is densified here — there is
+    # no meaningful lazy adamw (reference has no SelectedRows adamw).
+    g = ctx.in_("Grad")
+    if isinstance(g, SelectedRows):
+        g = g.to_dense()
+    g = g.astype(p.dtype)
     m1, m2 = ctx.in_("Moment1"), ctx.in_("Moment2")
     b1p, b2p = ctx.in_("Beta1Pow"), ctx.in_("Beta2Pow")
     b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
@@ -130,6 +177,16 @@ def _adagrad(ctx):
     p, g, m = ctx.in_("Param"), ctx.in_("Grad"), ctx.in_("Moment")
     lr = ctx.in_("LearningRate").reshape(()).astype(p.dtype)
     eps = ctx.attr("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        # reference: adagrad_op.h SparseAdagradFunctor
+        mg = g.merge_rows()
+        rows, gv = mg.rows, mg.values.astype(p.dtype)
+        m_r = m.at[rows].get(mode="fill", fill_value=0)
+        m_new = m_r + jnp.square(gv)
+        ctx.set_out("ParamOut", p.at[rows].add(
+            -lr * gv / (jnp.sqrt(m_new) + eps), mode="drop"))
+        ctx.set_out("MomentOut", m.at[rows].set(m_new, mode="drop"))
+        return
     g = g.astype(p.dtype)
     m_new = m + jnp.square(g)
     ctx.set_out("ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))

@@ -119,8 +119,20 @@ class LowerCtx:
         self.env = env
         self.block = block
 
+    # ops that understand SelectedRows inputs natively (reference: the
+    # optimizers' SelectedRows kernels, operators/optimizers/*); every
+    # other op sees a densified array so correctness never depends on
+    # per-op sparse support
+    SPARSE_AWARE = frozenset({
+        "sgd", "momentum", "adam", "adamw", "adagrad", "sum", "scale",
+        "merge_selected_rows", "clip_by_norm",
+    })
+
     # inputs ---------------------------------------------------------------
     def ins(self, slot: str) -> List[Any]:
+        from ..framework.selected_rows import SelectedRows
+
+        sparse_ok = self.op.type in self.SPARSE_AWARE
         out = []
         for n in self.op.inputs.get(slot, []):
             if n == EMPTY_VAR_NAME:
@@ -132,6 +144,8 @@ class LowerCtx:
                         f"op {self.op.type}: input var {n!r} (slot {slot}) "
                         f"has no value — not initialized or not fed"
                     )
+                if isinstance(v, SelectedRows) and not sparse_ok:
+                    v = v.to_dense()
                 out.append(v)
         return out
 
@@ -279,6 +293,8 @@ def _generic_infer(op: Operator, block: Block, d: OpDef):
             var = block._find_var_recursive(n)
             if var is None:
                 continue
+            if not hasattr(v, "shape") or not hasattr(v, "dtype"):
+                continue  # structured value (e.g. SelectedRows pytree)
             shape = tuple(-1 if s == _SENTINEL_DIM else s for s in v.shape)
             var.shape = shape
             var.dtype = convert_dtype(v.dtype)

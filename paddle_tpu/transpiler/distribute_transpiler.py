@@ -179,7 +179,8 @@ class DistributeTranspiler:
                     op_.attrs = {"table_name": wname,
                                  "emb_dim": self._sparse_tables[wname],
                                  OP_ROLE_KEY: OpRole.Forward}
-                elif op_.type == "lookup_table_grad" and \
+                elif op_.type in ("lookup_table_grad",
+                                  "lookup_table_sparse_grad") and \
                         op_.input("W") and \
                         op_.input("W")[0] in self._sparse_tables:
                     wname = op_.input("W")[0]

