@@ -469,8 +469,13 @@ class Executor:
         if fetch_names:
             if return_numpy:
                 return [as_numpy(v) for v in fetched]
-            # keep device arrays lazy — no host sync until .numpy()
-            return [LoDTensor(v) for v in fetched]
+            # keep device arrays lazy — no host sync until .numpy().
+            # SelectedRows fetches densify (still lazy on device) so the
+            # LoDTensor surface stays array-like.
+            from .framework.selected_rows import SelectedRows
+
+            return [LoDTensor(v.to_dense() if isinstance(v, SelectedRows)
+                              else v) for v in fetched]
         return None
 
     # ------------------------------------------------------------------

@@ -100,6 +100,10 @@ def _adam(ctx):
     b1p_ = b1p.reshape(()).astype(p.dtype)
     b2p_ = b2p.reshape(()).astype(p.dtype)
     lr_t = lr * jnp.sqrt(1 - b2p_ * b2) / (1 - b1p_ * b1)
+    if isinstance(g, SelectedRows) and not ctx.attr("lazy_mode", False):
+        # reference adam default (lazy_mode=False) decays EVERY row's
+        # moments each step — that is dense math, so densify
+        g = g.to_dense()
     if isinstance(g, SelectedRows):
         # lazy sparse adam (reference: adam_op.h SparseAdamFunctor with
         # lazy_mode): moments and param update only on touched rows
@@ -136,12 +140,9 @@ def _adamw(ctx):
     if with_decay:
         p = p * (1.0 - lr * coeff)
     # reuse adam math on the decayed param.  Decoupled weight decay
-    # touches EVERY row, so a sparse grad is densified here — there is
-    # no meaningful lazy adamw (reference has no SelectedRows adamw).
-    g = ctx.in_("Grad")
-    if isinstance(g, SelectedRows):
-        g = g.to_dense()
-    g = g.astype(p.dtype)
+    # touches EVERY row, so adamw is not SPARSE_AWARE: LowerCtx densifies
+    # a sparse grad before it reaches this lowering.
+    g = ctx.in_("Grad").astype(p.dtype)
     m1, m2 = ctx.in_("Moment1"), ctx.in_("Moment2")
     b1p, b2p = ctx.in_("Beta1Pow"), ctx.in_("Beta2Pow")
     b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)

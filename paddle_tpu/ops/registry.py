@@ -124,8 +124,8 @@ class LowerCtx:
     # other op sees a densified array so correctness never depends on
     # per-op sparse support
     SPARSE_AWARE = frozenset({
-        "sgd", "momentum", "adam", "adamw", "adagrad", "sum", "scale",
-        "merge_selected_rows", "clip_by_norm",
+        "sgd", "momentum", "adam", "adagrad", "sum", "scale",
+        "clip_by_norm",
     })
 
     # inputs ---------------------------------------------------------------

@@ -439,6 +439,7 @@ class AdamOptimizer(Optimizer):
                  epsilon=1e-8, lazy_mode=False, **kwargs):
         super().__init__(learning_rate, **kwargs)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -461,7 +462,7 @@ class AdamOptimizer(Optimizer):
             outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
                      "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon},
+                   "epsilon": self._epsilon, "lazy_mode": self._lazy_mode},
         )
 
     def _eager_update(self, p, g, state, lr):

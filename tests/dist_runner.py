@@ -1,0 +1,189 @@
+"""Subprocess runner for real multi-process distributed tests.
+
+The reference forks actual pserver+trainer subprocesses
+(test_dist_base.py:506 TestDistBase) and compares per-step losses
+against a local single-process run.  Each rank of these tests runs this
+file: ``python dist_runner.py <mode>`` with the rendezvous configured
+through PADDLE_COORDINATOR_ADDRESS / PADDLE_NUM_PROCESSES /
+PADDLE_PROCESS_ID (the env contract of TPURoleMaker and
+distributed.init_parallel_env).  Results are printed as one
+``RESULT=<json>`` line on stdout.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# cross-process CPU collectives need the gloo backend
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+
+import numpy as np
+
+
+def _data(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, 8).astype(np.float32)
+    ys = (xs[:, :1] * 1.5 - 0.5).astype(np.float32)
+    return xs, ys
+
+
+def run_dygraph_dp(steps=6):
+    """Dygraph DataParallel across processes (reference:
+    parallel_dygraph_* runners under test_dist_base)."""
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.dygraph import DataParallel, Linear, guard, to_variable
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    nranks = dist.get_world_size()
+    xs, ys = _data()
+    # each rank trains on its contiguous shard
+    shard = len(xs) // nranks
+    xs_l = xs[rank * shard:(rank + 1) * shard]
+    ys_l = ys[rank * shard:(rank + 1) * shard]
+
+    with guard():
+        np.random.seed(7)  # identical init on every rank
+        lin = Linear(8, 1)
+        # deterministic identical init across ranks
+        lin.weight._value = jax.numpy.asarray(
+            np.linspace(-0.1, 0.1, 8, dtype=np.float32).reshape(8, 1))
+        lin.bias._value = jax.numpy.zeros((1,), np.float32)
+        model = DataParallel(lin)
+        opt = fluid.optimizer.SGDOptimizer(0.1,
+                                           parameter_list=lin.parameters())
+        losses = []
+        for _ in range(steps):
+            x = to_variable(xs_l)
+            y = to_variable(ys_l)
+            pred = model(x)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            scaled = model.scale_loss(loss)
+            scaled.backward()
+            model.apply_collective_grads()
+            opt.minimize(scaled)
+            lin.clear_gradients()
+            # global loss = mean over ranks of the local mean
+            from paddle_tpu.distributed import all_reduce
+
+            g = all_reduce(np.asarray(loss.value()), op="sum") / nranks
+            losses.append(float(np.asarray(g).ravel()[0]))
+    print("RESULT=" + json.dumps({"rank": rank, "losses": losses}),
+          flush=True)
+
+
+def run_fleet_collective(steps=6):
+    """Static-graph fleet collective DP across processes (reference:
+    dist_mnist.py under test_dist_base nccl2 mode)."""
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.incubate.fleet.collective import (
+        Collective, DistributedStrategy)
+    from paddle_tpu.incubate.fleet.base.role_maker import TPURoleMaker
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    role = TPURoleMaker()
+    fleet = Collective()
+    fleet.init(role)  # jax.distributed.initialize happens here
+    rank = dist.get_rank()
+    mesh_mod.init_mesh()  # global 2-device dp mesh
+
+    xs, ys = _data()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGDOptimizer(0.1)
+        fleet.distributed_optimizer(opt, DistributedStrategy()).minimize(loss)
+
+    exe = pt.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        compiled = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        losses = []
+        for _ in range(steps):
+            out = exe.run(compiled, feed={"x": xs, "y": ys},
+                          fetch_list=[loss], return_numpy=False)
+            v = out[0].value() if hasattr(out[0], "value") else out[0]
+            from jax.experimental import multihost_utils
+
+            g = multihost_utils.process_allgather(v, tiled=True)
+            losses.append(float(np.mean(g)))
+    print("RESULT=" + json.dumps({"rank": rank, "losses": losses}),
+          flush=True)
+
+
+def run_ps_server():
+    """PS server in its own process (reference: pserver subprocess of
+    test_dist_base)."""
+    from paddle_tpu.distributed_ps.service import PSServer
+
+    ep = os.environ["PADDLE_PSERVER_ENDPOINT"]
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    print("SERVER_READY", flush=True)
+    PSServer(ep, n_trainers=n).start(block=True)
+
+
+def run_ps_trainer(steps=6):
+    """PS trainer process against an external server."""
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.incubate.fleet.parameter_server import FleetTranspiler
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        UserDefinedRoleMaker, Role)
+
+    ep = os.environ["PADDLE_PSERVER_ENDPOINT"]
+    xs, ys = _data()
+    fleet = FleetTranspiler()
+    fleet.init(UserDefinedRoleMaker(
+        current_id=0, role=Role.WORKER, worker_num=1,
+        server_endpoints=[ep]))
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 13
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        fleet.distributed_optimizer(
+            fluid.optimizer.SGDOptimizer(0.1)).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        fleet.init_worker()
+        try:
+            losses = [float(exe.run(main, feed={"x": xs, "y": ys},
+                                    fetch_list=[loss])[0])
+                      for _ in range(steps)]
+        finally:
+            fleet.stop_worker()
+    print("RESULT=" + json.dumps({"losses": losses}), flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1]
+    {"dygraph_dp": run_dygraph_dp,
+     "fleet_collective": run_fleet_collective,
+     "ps_server": run_ps_server,
+     "ps_trainer": run_ps_trainer}[mode]()

@@ -65,16 +65,59 @@ def test_sparse_sgd_matches_dense():
     np.testing.assert_allclose(d_w, s_w, rtol=1e-5, atol=1e-6)
 
 
+def test_sparse_adam_default_matches_dense():
+    """lazy_mode=False (the reference default) must be exactly dense
+    adam — moments decay for every row each step."""
+    d_losses, d_w = _run_embedding_model(
+        False, lambda: fluid.optimizer.AdamOptimizer(0.01), steps=4)
+    s_losses, s_w = _run_embedding_model(
+        True, lambda: fluid.optimizer.AdamOptimizer(0.01), steps=4)
+    np.testing.assert_allclose(d_losses, s_losses, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(d_w, s_w, rtol=1e-5, atol=1e-6)
+
+
 def test_sparse_adam_lazy_touches_only_rows():
-    """Lazy adam updates only touched rows (untouched rows must stay at
-    init, unlike dense adam where beta-pow math moves every row once any
-    grad is nonzero... dense adam with zero grad still decays moments but
-    p update is 0 for zero grads; the observable contract: sparse run's
-    untouched rows equal dense run's untouched rows equal init)."""
-    losses, w = _run_embedding_model(
-        True, lambda: fluid.optimizer.AdamOptimizer(0.01), steps=3)
-    assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0]
+    """lazy_mode=True: untouched vocab rows stay exactly at init while
+    touched rows move — the observable lazy-adam contract (reference
+    SparseAdamFunctor lazy mode)."""
+    vocab, dim = 50, 4
+
+    def run():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 9
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [4], dtype="int64")
+            y = fluid.layers.data("y", [1])
+            emb = fluid.layers.embedding(ids, size=[vocab, dim],
+                                         is_sparse=True)
+            pred = fluid.layers.fc(fluid.layers.reduce_sum(emb, dim=1), 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.AdamOptimizer(0.01,
+                                          lazy_mode=True).minimize(loss)
+        ids_np = np.array([[0, 1, 2, 3]] * 8, np.int64)  # rows 0-3 only
+        y_np = np.linspace(0, 1, 8).astype(np.float32).reshape(8, 1)
+        exe = pt.Executor(pt.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            from paddle_tpu.framework.scope import global_scope
+
+            w0 = None
+            for n, val in global_scope().items():
+                if not n.startswith("@") and \
+                        np.asarray(val).shape == (vocab, dim):
+                    w_name, w0 = n, np.asarray(val).copy()
+                    break
+            for _ in range(3):
+                exe.run(main, feed={"ids": ids_np, "y": y_np},
+                        fetch_list=[loss])
+            w1 = np.asarray(global_scope().get(w_name))
+        return w0, w1
+
+    w0, w1 = run()
+    # untouched rows identical to init; touched rows moved
+    np.testing.assert_array_equal(w0[4:], w1[4:])
+    assert np.abs(w1[:4] - w0[:4]).max() > 0
 
 
 def test_sparse_momentum_and_adagrad_converge():
