@@ -87,8 +87,22 @@ class GRUCell(RNNCell):
                        param_attr=self.param_attr, bias_attr=self.bias_attr)
         helper = LayerHelper("gru_unit", input=xproj)
         if self._weight is None:
+            # when the cell's params carry an explicit name, derive a
+            # stable name for the hidden weight too so a separately
+            # built program (e.g. a decode graph) shares it by scope;
+            # all other ParamAttr fields (initializer, trainable, ...)
+            # carry over so both weights get the same treatment
+            from ..param_attr import ParamAttr
+
+            attr = ParamAttr._to_attr(self.param_attr)
+            w_attr = None
+            if attr is not None and attr.name:
+                import copy
+
+                w_attr = copy.copy(attr)
+                w_attr.name = attr.name + "_hidden_w"
             self._weight = helper.create_parameter(
-                None, shape=[self.hidden_size, 3 * self.hidden_size],
+                w_attr, shape=[self.hidden_size, 3 * self.hidden_size],
                 dtype=self.dtype)
         gate = helper.create_variable_for_type_inference(self.dtype)
         rhp = helper.create_variable_for_type_inference(self.dtype)

@@ -1,0 +1,158 @@
+"""Machine-translation seq2seq with attention + beam-search decode.
+
+Capability parity with the reference book model
+(reference: python/paddle/fluid/tests/book/test_machine_translation.py —
+LSTM encoder, per-step decoder with a learned state update, beam-search
+decode loop via While+LoDTensorArray; and the attention variant in
+tests/book/notest_understand_sentiment... / machine_translation.py's
+attention decoder).
+
+TPU-first redesign: the decoder is an RNNCell whose ``call`` computes
+Bahdanau-style additive attention over the encoder outputs — the whole
+train graph is one ``layers.rnn`` (lax.scan under jit), no per-step
+Python.  Decoding unrolls ``max_length`` beam_search steps statically
+(static shapes; XLA-friendly) instead of the reference's host-side While
+loop over LoD arrays.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def encoder(src_word_id, dict_size, word_dim=16, hidden_dim=32,
+            is_sparse=True):
+    """reference: test_machine_translation.py encoder() — embedding ->
+    fc(tanh, 4H) -> dynamic_lstm; returns (last_hidden, all_hidden)."""
+    src_embedding = layers.embedding(
+        src_word_id, size=[dict_size, word_dim], dtype="float32",
+        is_sparse=is_sparse, param_attr=ParamAttr(name="src_emb"))
+    # every parameter carries an explicit name so the decode program
+    # (built separately) resolves the same scope entries
+    fc1 = layers.fc(src_embedding, size=hidden_dim * 4, act="tanh",
+                    num_flatten_dims=2,
+                    param_attr=ParamAttr(name="enc_fc_w"),
+                    bias_attr=ParamAttr(name="enc_fc_b"))
+    lstm_hidden0, lstm_0 = layers.dynamic_lstm(
+        fc1, size=hidden_dim * 4,
+        param_attr=ParamAttr(name="enc_lstm_w"),
+        bias_attr=ParamAttr(name="enc_lstm_b"))
+    encoder_last = layers.sequence_last_step(lstm_hidden0)
+    return encoder_last, lstm_hidden0
+
+
+class AttentionDecoderCell(layers.RNNCell):
+    """GRU cell + additive attention over encoder outputs.
+
+    reference capability: machine_translation.py's
+    simple_attention(encoder_vec, encoder_proj, decoder_state) +
+    gru_step; redesigned as a scan cell so the train decoder is a single
+    fused XLA loop."""
+
+    def __init__(self, hidden_size, encoder_out, name="attn_dec"):
+        self.hidden_size = hidden_size
+        self.encoder_out = encoder_out  # [N, T, H]
+        self.name = name
+        self._gru = layers.GRUCell(
+            hidden_size,
+            param_attr=ParamAttr(name=f"{name}_gru"),
+            bias_attr=ParamAttr(name=f"{name}_gru_b"))
+
+    def _attend(self, state):
+        # score_t = v^T tanh(W_e e_t + W_s s)  (Bahdanau)
+        enc_proj = layers.fc(self.encoder_out, size=self.hidden_size,
+                             num_flatten_dims=2, bias_attr=False,
+                             param_attr=ParamAttr(name=f"{self.name}_We"))
+        s_proj = layers.fc(state, size=self.hidden_size, bias_attr=False,
+                           param_attr=ParamAttr(name=f"{self.name}_Ws"))
+        s_proj = layers.unsqueeze(s_proj, axes=[1])  # [N,1,H]
+        scores = layers.fc(
+            layers.tanh(layers.elementwise_add(enc_proj, s_proj)),
+            size=1, num_flatten_dims=2, bias_attr=False,
+            param_attr=ParamAttr(name=f"{self.name}_v"))  # [N,T,1]
+        weights = layers.softmax(scores, axis=1)
+        ctx = layers.reduce_sum(
+            layers.elementwise_mul(self.encoder_out, weights), dim=1)
+        return ctx  # [N, H]
+
+    def call(self, inputs, states):
+        state = states[0] if isinstance(states, (list, tuple)) else states
+        ctx = self._attend(state)
+        gru_in = layers.concat([inputs, ctx], axis=1)
+        out, new_states = self._gru.call(gru_in, state)
+        return out, new_states
+
+
+def build_train(src, trg, label, dict_size, word_dim=16, hidden_dim=32,
+                is_sparse=True):
+    """Training graph: returns (avg_cost, logits).
+
+    src/trg: [N, T] int64 token ids; label: [N, T, 1] next-token ids.
+    reference: test_machine_translation.py train_main's decoder_train."""
+    enc_last, enc_out = encoder(src, dict_size, word_dim, hidden_dim,
+                                is_sparse)
+    trg_embedding = layers.embedding(
+        trg, size=[dict_size, word_dim], dtype="float32",
+        is_sparse=is_sparse, param_attr=ParamAttr(name="trg_emb"))
+    init_state = layers.fc(enc_last, size=hidden_dim, act="tanh",
+                           param_attr=ParamAttr(name="dec_init"),
+                           bias_attr=ParamAttr(name="dec_init_b"))
+    cell = AttentionDecoderCell(hidden_dim, enc_out)
+    dec_out, _ = layers.rnn(cell, trg_embedding,
+                            initial_states=[init_state])
+    logits = layers.fc(dec_out, size=dict_size, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="dec_proj_w"),
+                       bias_attr=ParamAttr(name="dec_proj_b"))
+    cost = layers.softmax_with_cross_entropy(logits, label)
+    avg_cost = layers.mean(cost)
+    return avg_cost, logits
+
+
+def build_decode(src, init_ids, init_scores, dict_size, word_dim=16,
+                 hidden_dim=32, beam_size=2, max_length=8, eos_id=1,
+                 is_sparse=True):
+    """Beam-search decode graph sharing the train parameters (same
+    ParamAttr names).  Statically unrolled over max_length steps; each
+    step feeds the full-vocab log-probs [N*B, V] to the beam_search op
+    (flat-beam layout of ops/sequence_ops.py:_beam_search) and regathers
+    the decoder state by ParentIdx — the decode loop of
+    test_machine_translation.py decoder_decode without the host While.
+
+    ``src`` must be pre-tiled to [N*beam, T]; ``init_ids`` [N*B, 1] int64
+    (bos), ``init_scores`` [N*B, 1] (0 for beam 0 of each source, a
+    large negative for the rest — the reference's init_scores feed).
+
+    Returns (sentence_ids, sentence_scores, lengths)."""
+    enc_last, enc_out = encoder(src, dict_size, word_dim, hidden_dim,
+                                is_sparse)
+    state = layers.fc(enc_last, size=hidden_dim, act="tanh",
+                      param_attr=ParamAttr(name="dec_init"),
+                      bias_attr=ParamAttr(name="dec_init_b"))
+    cell = AttentionDecoderCell(hidden_dim, enc_out)
+
+    pre_ids, pre_scores = init_ids, init_scores
+    step_ids, step_scores, step_parents = [], [], []
+    for t in range(max_length):
+        word_emb = layers.embedding(
+            pre_ids, size=[dict_size, word_dim], dtype="float32",
+            is_sparse=is_sparse, param_attr=ParamAttr(name="trg_emb"))
+        word_emb = layers.reshape(word_emb, [-1, word_dim])
+        out, new_states = cell.call(word_emb, [state])
+        logits = layers.fc(out, size=dict_size,
+                           param_attr=ParamAttr(name="dec_proj_w"),
+                           bias_attr=ParamAttr(name="dec_proj_b"))
+        probs = layers.log_softmax(logits)  # [N*B, V]
+        sel_ids, sel_scores, parent = layers.beam_search(
+            pre_ids, pre_scores, None, probs, beam_size=beam_size,
+            end_id=eos_id)
+        step_ids.append(sel_ids)
+        step_scores.append(sel_scores)
+        step_parents.append(parent)
+        pre_ids, pre_scores = sel_ids, sel_scores
+        new_state = new_states[0] if isinstance(new_states, (list, tuple)) \
+            else new_states
+        # surviving hypotheses continue from their parent's state
+        state = layers.gather(new_state, parent)
+
+    return layers.beam_search_decode(step_ids, step_scores, step_parents,
+                                     beam_size=beam_size, end_id=eos_id)
