@@ -767,7 +767,64 @@ op("bicubic_interp")(lambda ctx: _interp(ctx, "bicubic"))
 
 @op("grid_sampler")
 def _grid_sampler(ctx):
-    raise NotImplementedError("grid_sampler: planned detection-suite op")
+    """Spatial-transformer sampling (reference: operators/grid_sampler_op.cc).
+
+    Input NCHW + grid N,Ho,Wo,2 in [-1,1] -> NCHW output.  Pure gather +
+    lerp, so the backward is XLA's scatter-add of the vjp — no custom grad.
+    """
+    x, grid = ctx.in_("X"), ctx.in_("Grid")
+    mode = ctx.attr("mode", "bilinear")
+    pad = ctx.attr("padding_mode", "zeros")
+    align = ctx.attr("align_corners", True)
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnorm(coord, size):
+        if align:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    fx, fy = unnorm(gx, w), unnorm(gy, h)
+
+    def reflect(v, lo, hi):
+        # reflect into [lo, hi] (continuous, PyTorch/Paddle semantics)
+        rng = hi - lo
+        if rng <= 0:
+            return jnp.zeros_like(v)
+        v = jnp.abs(v - lo) % (2 * rng)
+        return lo + jnp.where(v > rng, 2 * rng - v, v)
+
+    if pad == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif pad == "reflection":
+        fx = reflect(fx, 0.0, w - 1.0) if align else jnp.clip(
+            reflect(fx, -0.5, w - 0.5), 0, w - 1)
+        fy = reflect(fy, 0.0, h - 1.0) if align else jnp.clip(
+            reflect(fy, -0.5, h - 0.5), 0, h - 1)
+
+    def sample(ix, iy):
+        """Gather x[n, :, iy, ix] with zero padding outside."""
+        valid = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        batch = jnp.arange(n)[:, None, None]
+        vals = x[batch, :, iyc, ixc]          # N,Ho,Wo,C
+        vals = jnp.where(valid[..., None], vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = sample(jnp.round(fx), jnp.round(fy))
+    else:  # bilinear
+        x0, y0 = jnp.floor(fx), jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - fx) * (y1 - fy)
+        wb = (x1 - fx) * (fy - y0)
+        wc = (fx - x0) * (y1 - fy)
+        wd = (fx - x0) * (fy - y0)
+        out = (sample(x0, y0) * wa[..., None] + sample(x0, y1) * wb[..., None]
+               + sample(x1, y0) * wc[..., None] + sample(x1, y1) * wd[..., None])
+    ctx.set_out("Output", jnp.transpose(out, (0, 3, 1, 2)))
 
 
 @op("prelu")

@@ -455,7 +455,8 @@ def _expand(ctx):
 
 @op("expand_as")
 def _expand_as(ctx):
-    x, y = ctx.in_("X"), ctx.in_("target_tensor") or ctx.in_("Y")
+    x = ctx.in_("X")
+    y = ctx.in_("target_tensor") if ctx.has_input("target_tensor") else ctx.in_("Y")
     reps = [t // s for s, t in zip(jnp.shape(x), jnp.shape(y))]
     ctx.set_out("Out", jnp.tile(x, reps))
 
