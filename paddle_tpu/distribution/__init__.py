@@ -151,3 +151,48 @@ class Categorical(Distribution):
                                         T.full([1], -1.0, "float32"))),
                        T.full([1], -1.0, "float32"))
         return T.argmax(T.add(self.logits, g), axis=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Multivariate normal with diagonal covariance (reference:
+    python/paddle/fluid/layers/distributions.py MultivariateNormalDiag).
+    loc (..., k); scale is the diagonal as a (..., k, k) matrix like the
+    reference (off-diagonals ignored)."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc
+        self.scale = scale
+
+    def _diag(self):
+        # extract the diagonal of the scale matrix
+        import paddle_tpu.layers as L
+        k = self.scale.shape[-1]
+        return L.reduce_sum(
+            T.multiply(self.scale, L.eye(k, k, dtype="float32")), dim=-1)
+
+    def entropy(self):
+        """0.5 * (k * (log(2*pi) + 1) + log det(diag^2))."""
+        import math
+        import paddle_tpu.layers as L
+        k = self.scale.shape[-1]
+        diag = self._diag()
+        log_det = L.reduce_sum(T.log(T.multiply(diag, diag)), dim=-1)
+        const = T.full([1], 0.5 * k * (math.log(2 * math.pi) + 1.0), "float32")
+        return T.add(const, T.multiply(T.full([1], 0.5, "float32"), log_det))
+
+    def kl_divergence(self, other):
+        """KL between two diagonal MVNs."""
+        import paddle_tpu.layers as L
+        d0 = self._diag()
+        d1 = other._diag()
+        var0 = T.multiply(d0, d0)
+        var1 = T.multiply(d1, d1)
+        diff = T.subtract(self.loc, other.loc)
+        t1 = L.reduce_sum(T.divide(var0, var1), dim=-1)
+        t2 = L.reduce_sum(T.divide(T.multiply(diff, diff), var1), dim=-1)
+        log_det = L.reduce_sum(T.subtract(T.log(var1), T.log(var0)), dim=-1)
+        k = self.scale.shape[-1]
+        half = T.full([1], 0.5, "float32")
+        return T.multiply(half, T.add(T.add(t1, t2),
+                                      T.subtract(log_det,
+                                                 T.full([1], float(k), "float32"))))

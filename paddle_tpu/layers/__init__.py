@@ -28,6 +28,7 @@ from .tensor import (  # noqa: F401
     zeros_like,
 )
 from .tensor import range as range_  # 'range' shadows builtin; both exported
+range = range_  # fluid.layers.range (reference exports it despite the builtin)
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()
@@ -44,6 +45,10 @@ from .control_flow import (  # noqa: F401
 )
 from . import sequence_lod
 from .sequence_lod import (  # noqa: F401
+    lod_append,
+    lod_reset,
+    reorder_lod_tensor_by_rank,
+    sequence_scatter,
     im2sequence,
     row_conv,
     sequence_concat,
@@ -80,6 +85,7 @@ from .rnn import (  # noqa: F401
 from .rnn import rnn  # noqa: F401  (function wins, as in the reference)
 from . import detection
 from .detection import (  # noqa: F401
+    polygon_box_transform,
     anchor_generator,
     bipartite_match,
     box_clip,
@@ -95,6 +101,14 @@ from .detection import (  # noqa: F401
     target_assign,
     yolo_box,
     yolov3_loss,
+)
+from . import nn_tail
+from .nn_tail import *  # noqa: F401,F403  (layers long tail)
+from ..distribution import (  # noqa: F401  (reference: layers/distributions.py)
+    Categorical,
+    MultivariateNormalDiag,
+    Normal,
+    Uniform,
 )
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (  # noqa: F401

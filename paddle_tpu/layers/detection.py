@@ -271,3 +271,13 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                             "loc_loss_weight": loc_loss_weight,
                             "conf_loss_weight": conf_loss_weight})
     return loss
+
+
+def polygon_box_transform(input, name=None):
+    """reference: layers/detection.py polygon_box_transform (op in
+    ops/detection_ops.py)."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return out

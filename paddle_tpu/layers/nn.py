@@ -1043,3 +1043,12 @@ def fused_multihead_attention(q, k, v, bias_qk=None, scale=0.0, causal=False,
                      outputs={"Out": [out]},
                      attrs={"scale": float(scale), "causal": bool(causal)})
     return out
+
+
+# public surface for the star-import in layers/__init__.py (keeps np/
+# LayerHelper/Variable/initializers out of the fluid.layers namespace)
+__all__ = [
+    _n for _n, _v in list(globals().items())
+    if not _n.startswith("_") and callable(_v)
+    and getattr(_v, "__module__", None) == __name__
+]

@@ -1,0 +1,1470 @@
+"""fluid.layers long tail: vision ops, structured losses, misc utilities.
+
+Reference: python/paddle/fluid/layers/nn.py (the ~150 functions beyond the
+core set in layers/nn.py), layers/loss.py, layers/control_flow.py (Print/
+Assert), layers/io.py (double_buffer), layers/ops.py (activation wrappers).
+Each function builds vars + ops via LayerHelper; the lowerings live in
+ops/vision_ops.py, ops/loss_ops.py, ops/sequence_ops.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Variable, in_dygraph_mode
+from ..framework.dtype import VarType, convert_dtype
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer, NormalInitializer
+
+
+def _simple(op_type, out_slots=("Out",), **fixed):
+    """Build a LayerHelper wrapper for an op with X->Out shape."""
+
+    def fn(x, *, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        a = dict(fixed)
+        a.update(attrs)
+        outs = {s: [helper.create_variable_for_type_inference(x.dtype)]
+                for s in out_slots}
+        helper.append_op(op_type, inputs={"X": [x]}, outputs=outs, attrs=a)
+        ret = [outs[s][0] for s in out_slots]
+        return ret[0] if len(ret) == 1 else tuple(ret)
+
+    fn.__name__ = op_type
+    return fn
+
+
+# --------------------------------------------------------------------------
+# activation wrappers over existing ops (reference: layers/ops.py)
+# --------------------------------------------------------------------------
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _simple("brelu")(x, name=name, t_min=t_min, t_max=t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _simple("soft_relu")(x, name=name, threshold=threshold)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _simple("stanh")(x, name=name, scale_a=scale_a, scale_b=scale_b)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _simple("elu")(x, name=name, alpha=alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _simple("selu")(x, name=name, scale=scale, alpha=alpha)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _simple("hard_sigmoid")(x, name=name, slope=slope, offset=offset)
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if isinstance(factor, Variable):
+        inputs["FactorTensor"] = [factor]
+    else:
+        attrs["factor"] = float(factor)
+    helper.append_op("pow", inputs=inputs, outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+# --------------------------------------------------------------------------
+# logical / comparison wrappers (reference: layers/control_flow.py)
+# --------------------------------------------------------------------------
+def _binary(op_type):
+    def fn(x, y, out=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        if out is None:
+            out = helper.create_variable_for_type_inference(VarType.BOOL)
+        helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [out]})
+        return out
+
+    fn.__name__ = op_type
+    return fn
+
+
+logical_and = _binary("logical_and")
+logical_or = _binary("logical_or")
+logical_xor = _binary("logical_xor")
+not_equal = _binary("not_equal")
+less_equal = _binary("less_equal")
+greater_than = _binary("greater_than")
+greater_equal = _binary("greater_equal")
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(VarType.BOOL)
+    helper.append_op("logical_not", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def isfinite(x, name=None):
+    helper = LayerHelper("isfinite", name=name)
+    out = helper.create_variable_for_type_inference(VarType.BOOL)
+    helper.append_op("isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def has_inf(x, name=None):
+    helper = LayerHelper("isinf", name=name)
+    out = helper.create_variable_for_type_inference(VarType.BOOL)
+    helper.append_op("isinf", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def has_nan(x, name=None):
+    helper = LayerHelper("isnan", name=name)
+    out = helper.create_variable_for_type_inference(VarType.BOOL)
+    helper.append_op("isnan", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+# --------------------------------------------------------------------------
+# vision layers (reference: layers/nn.py)
+# --------------------------------------------------------------------------
+def pixel_shuffle(x, upscale_factor, name=None):
+    return _simple("pixel_shuffle")(x, name=name, upscale_factor=upscale_factor)
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _simple("space_to_depth")(x, name=name, blocksize=blocksize)
+
+
+def shuffle_channel(x, group, name=None):
+    return _simple("shuffle_channel")(x, name=name, group=group)
+
+
+def maxout(x, groups, name=None, axis=1):
+    return _simple("maxout")(x, name=name, groups=groups, axis=axis)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    helper = LayerHelper("affine_channel", name=name, act=act)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("affine_channel",
+                     inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout})
+    return helper.append_activation(out, act)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, Variable):
+        inputs["Y"] = [shape]
+    else:
+        attrs["shape"] = list(shape)
+    if offsets is not None:
+        if isinstance(offsets, Variable):
+            inputs["Offsets"] = [offsets]
+        else:
+            attrs["offsets"] = list(offsets)
+    helper.append_op("crop", inputs=inputs, outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop_tensor", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, Variable):
+        inputs["Shape"] = [shape]
+    elif shape is not None:
+        attrs["shape"] = list(shape)
+    if offsets is not None:
+        if isinstance(offsets, Variable):
+            inputs["Offsets"] = [offsets]
+        else:
+            attrs["offsets"] = list(offsets)
+    helper.append_op("crop_tensor", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op("pad_constant_like", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"pad_value": float(pad_value)})
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    helper = LayerHelper("unfold", name=name)
+    if isinstance(kernel_sizes, int):
+        kernel_sizes = [kernel_sizes, kernel_sizes]
+    if isinstance(strides, int):
+        strides = [strides, strides]
+    if isinstance(dilations, int):
+        dilations = [dilations, dilations]
+    if isinstance(paddings, int):
+        paddings = [paddings] * 4
+    elif len(paddings) == 2:
+        paddings = paddings * 2
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("unfold", inputs={"X": [x]}, outputs={"Y": [out]},
+                     attrs={"kernel_sizes": kernel_sizes, "strides": strides,
+                            "paddings": paddings, "dilations": dilations})
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=1, deformable_groups=1,
+                    im2col_step=1, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """reference: layers/nn.py deformable_conv (DCN v1/v2)."""
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    ksize = [filter_size, filter_size] if isinstance(filter_size, int) else list(filter_size)
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    dilation = [dilation, dilation] if isinstance(dilation, int) else list(dilation)
+    num_channels = input.shape[1]
+    filter_shape = [num_filters, num_channels // groups] + ksize
+    filt = helper.create_parameter(param_attr, shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    op_type = "deformable_conv" if modulated else "deformable_conv_v1"
+    inputs = {"Input": [input], "Offset": [offset], "Filter": [filt]}
+    if modulated:
+        inputs["Mask"] = [mask]
+    helper.append_op(op_type, inputs=inputs, outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups,
+                            "deformable_groups": deformable_groups,
+                            "im2col_step": im2col_step})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2,
+                                    bias_attr=bias_attr)
+    return pre_act
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=[1, 1],
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    helper = LayerHelper("deformable_roi_pooling", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    top = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("deformable_roi_pooling",
+                     inputs={"Input": [input], "ROIs": [rois], "Trans": [trans]},
+                     outputs={"Output": [out], "TopCount": [top]},
+                     attrs={"no_trans": no_trans, "spatial_scale": spatial_scale,
+                            "group_size": group_size, "pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "part_size": part_size or [pooled_height, pooled_width],
+                            "sample_per_part": sample_per_part,
+                            "trans_std": trans_std,
+                            "position_sensitive": position_sensitive})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: layers/nn.py spectral_norm; U/V persist across steps via
+    UOut/VOut rebinding onto the same vars."""
+    helper = LayerHelper("spectral_norm", name=name)
+    dtype = weight.dtype
+    h = weight.shape[dim]
+    w = int(np.prod([s for i, s in enumerate(weight.shape) if i != dim]))
+    u = helper.create_parameter(attr=None, shape=[h], dtype=dtype,
+                                default_initializer=NormalInitializer(0.0, 1.0))
+    v = helper.create_parameter(attr=None, shape=[w], dtype=dtype,
+                                default_initializer=NormalInitializer(0.0, 1.0))
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("spectral_norm",
+                     inputs={"Weight": [weight], "U": [u], "V": [v]},
+                     outputs={"Out": [out], "UOut": [u], "VOut": [v]},
+                     attrs={"dim": dim, "power_iters": power_iters, "eps": eps})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999):
+    """reference: layers/nn.py data_norm."""
+    helper = LayerHelper("data_norm", name=name)
+    dtype = input.dtype
+    c = input.shape[1]
+    batch_size = helper.create_parameter(
+        attr=None, shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1e4))
+    batch_sum = helper.create_parameter(
+        attr=None, shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(0.0))
+    batch_square_sum = helper.create_parameter(
+        attr=None, shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1e4))
+    means = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    scales = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("data_norm",
+                     inputs={"X": [input], "BatchSize": [batch_size],
+                             "BatchSum": [batch_sum],
+                             "BatchSquareSum": [batch_square_sum]},
+                     outputs={"Y": [out], "Means": [means], "Scales": [scales]},
+                     attrs={"epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def affine_grid(theta, out_shape, name=None, align_corners=True):
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    inputs = {"Theta": [theta]}
+    attrs = {"align_corners": align_corners}
+    if isinstance(out_shape, Variable):
+        inputs["OutputShape"] = [out_shape]
+    else:
+        attrs["output_shape"] = list(out_shape)
+    helper.append_op("affine_grid", inputs=inputs, outputs={"Output": [out]},
+                     attrs=attrs)
+    return out
+
+
+def grid_sampler(x, grid, name=None, mode="bilinear", padding_mode="zeros",
+                 align_corners=True):
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("grid_sampler", inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]},
+                     attrs={"mode": mode, "padding_mode": padding_mode,
+                            "align_corners": align_corners})
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _simple("temporal_shift")(x, name=name, seg_num=seg_num,
+                                     shift_ratio=shift_ratio)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False, name=None,
+           exclusive=True, data_format="NCDHW"):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ksize = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    strides = [pool_stride] * 3 if isinstance(pool_stride, int) else list(pool_stride)
+    pads = [pool_padding] * 3 if isinstance(pool_padding, int) else list(pool_padding)
+    helper.append_op("pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": ksize,
+                            "strides": strides, "paddings": pads,
+                            "global_pooling": global_pooling,
+                            "exclusive": exclusive, "ceil_mode": ceil_mode})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ksize = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    helper.append_op("adaptive_pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": ksize,
+                            "adaptive": True})
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    helper = LayerHelper("conv3d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    ksize = [filter_size] * 3 if isinstance(filter_size, int) else list(filter_size)
+    stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+    padding = [padding] * 3 if isinstance(padding, int) else list(padding)
+    dilation = [dilation] * 3 if isinstance(dilation, int) else list(dilation)
+    num_channels = input.shape[1]
+    filt = helper.create_parameter(
+        param_attr, shape=[num_filters, num_channels // groups] + ksize,
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("conv3d", inputs={"Input": [input], "Filter": [filt]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2,
+                                    bias_attr=bias_attr)
+    return helper.append_activation(pre_act, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                     name=None, data_format="NCDHW"):
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    ksize = [filter_size] * 3 if isinstance(filter_size, int) else list(filter_size)
+    stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+    padding = [padding] * 3 if isinstance(padding, int) else list(padding)
+    dilation = [dilation] * 3 if isinstance(dilation, int) else list(dilation)
+    num_channels = input.shape[1]
+    filt = helper.create_parameter(
+        param_attr, shape=[num_channels, num_filters // groups] + ksize,
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("conv3d_transpose",
+                     inputs={"Input": [input], "Filter": [filt]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2,
+                                    bias_attr=bias_attr)
+    return helper.append_activation(pre_act, act)
+
+
+def _interp_layer(op_type, input, out_shape, scale, align_corners, name,
+                  ndims):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"align_corners": align_corners}
+    keys = ["out_d", "out_h", "out_w"][-ndims:]
+    if out_shape is not None:
+        for k, v in zip(keys, out_shape):
+            attrs[k] = int(v)
+    elif scale is not None:
+        spatial = input.shape[-ndims:]
+        for k, s in zip(keys, spatial):
+            attrs[k] = int(s * scale)
+    helper.append_op(op_type, inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  align_corners=True, align_mode=1, data_format="NCW"):
+    return _interp_layer("linear_interp", input, out_shape, scale,
+                         align_corners, name, 1)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return _interp_layer("trilinear_interp", input, out_shape, scale,
+                         align_corners, name, 3)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    """reference: layers/nn.py image_resize dispatcher."""
+    op_map = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp",
+              "BICUBIC": "bicubic_interp", "TRILINEAR": "trilinear_interp",
+              "LINEAR": "linear_interp"}
+    op_type = op_map[resample.upper()]
+    nd = 3 if op_type == "trilinear_interp" else (1 if op_type == "linear_interp" else 2)
+    return _interp_layer(op_type, input, out_shape, scale, align_corners,
+                         name, nd)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len (reference:
+    layers/nn.py image_resize_short)."""
+    in_shape = input.shape
+    hw = in_shape[2:4]
+    short_idx = hw.index(min(hw))
+    out_shape = list(hw)
+    out_shape[short_idx] = out_short_len
+    out_shape[1 - short_idx] = int(
+        float(out_shape[1 - short_idx])
+        * (float(out_short_len) / float(hw[short_idx])) + 0.5)
+    return image_resize(input=input, out_shape=out_shape, resample=resample)
+
+
+def random_crop(x, shape, seed=None):
+    """reference: layers/nn.py random_crop — train-time random crop; the
+    offsets come from the threaded program rng (jit-safe dynamic_slice)."""
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("random_crop", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "seed": seed or 0})
+    return out
+
+
+# --------------------------------------------------------------------------
+# matrix / embedding-adjacent layers
+# --------------------------------------------------------------------------
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = x.dtype
+    w = helper.create_parameter(param_attr,
+                                shape=[size, x.shape[1], y.shape[1]],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        bias = helper.create_parameter(bias_attr, shape=[1, size], dtype=dtype,
+                                       is_bias=True)
+        inputs["Bias"] = [bias]
+    helper.append_op("bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out, act)
+
+
+def fsp_matrix(x, y):
+    helper = LayerHelper("fsp")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fsp", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]})
+    return out
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    return _simple("add_position_encoding")(input, name=name, alpha=alpha,
+                                            beta=beta)
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op("multiplex", inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def unbind(input, axis=0):
+    helper = LayerHelper("unbind")
+    n = input.shape[axis]
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(n)]
+    helper.append_op("unbind", inputs={"X": [input]}, outputs={"Out": outs},
+                     attrs={"axis": axis})
+    return outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _simple("shard_index")(input, index_num=index_num, nshards=nshards,
+                                  shard_id=shard_id, ignore_value=ignore_value)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op("hash", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"num_hash": num_hash, "mod_by": hash_size})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op("sampling_id", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"seed": seed})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(convert_dtype(dtype))
+    helper.append_op("gaussian_random_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx, "mean": mean,
+                            "std": std, "seed": seed,
+                            "dtype": int(convert_dtype(dtype))})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(convert_dtype(dtype))
+    helper.append_op("uniform_random_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx, "min": min,
+                            "max": max, "seed": seed,
+                            "dtype": int(convert_dtype(dtype))})
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _simple("similarity_focus")(input, name=name, axis=axis,
+                                       indexes=list(indexes))
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return _simple("get_tensor_from_selected_rows")(x, name=name)
+
+
+def merge_selected_rows(x, name=None):
+    return _simple("merge_selected_rows")(x, name=name)
+
+
+def unique(x, dtype="int32"):
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(convert_dtype(dtype))
+    helper.append_op("unique", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index]},
+                     attrs={"dtype": int(convert_dtype(dtype))})
+    return out, index
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(convert_dtype(dtype))
+    count = helper.create_variable_for_type_inference(convert_dtype(dtype))
+    helper.append_op("unique_with_counts", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index], "Count": [count]},
+                     attrs={"dtype": int(convert_dtype(dtype))})
+    return out, index, count
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    helper = LayerHelper("scatter_nd_add", name=name)
+    out = helper.create_variable_for_type_inference(ref.dtype)
+    helper.append_op("scatter_nd_add",
+                     inputs={"X": [ref], "Index": [index], "Updates": [updates]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """scatter_nd(i, u, s) == scatter_nd_add(zeros(s), i, u) (reference:
+    layers/nn.py scatter_nd)."""
+    from .tensor import fill_constant
+    zero = fill_constant(shape, updates.dtype, 0.0)
+    return scatter_nd_add(zero, index, updates, name)
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    helper = LayerHelper("strided_slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("strided_slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends), "strides": list(strides)})
+    return out
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand_as",
+                     inputs={"X": [x], "target_tensor": [target_tensor]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def size(input):
+    helper = LayerHelper("size")
+    out = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op("size", inputs={"Input": [input]}, outputs={"Out": [out]})
+    return out
+
+
+def rank(input):
+    """Static rank as a filled constant (reference: layers/nn.py rank)."""
+    from .tensor import fill_constant
+    return fill_constant(shape=[1], dtype="int32", value=len(input.shape))
+
+
+def sum(x):
+    helper = LayerHelper("sum")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op("sum", inputs={"X": list(xs)}, outputs={"Out": [out]})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference(VarType.FP32)
+    wrong = helper.create_variable_for_type_inference(VarType.INT32)
+    correct = helper.create_variable_for_type_inference(VarType.INT32)
+    helper.append_op("mean_iou",
+                     inputs={"Predictions": [input], "Labels": [label]},
+                     outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                              "OutCorrect": [correct]},
+                     attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    c = input.shape[1]
+    scale = helper.create_parameter(param_attr, shape=[c], dtype=dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype=dtype,
+                                   is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("group_norm",
+                     inputs={"X": [input], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+                     attrs={"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    c = input.shape[1]
+    scale = helper.create_parameter(param_attr, shape=[c], dtype=dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype=dtype,
+                                   is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    smean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    svar = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("instance_norm",
+                     inputs={"X": [input], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Y": [out], "SavedMean": [smean],
+                              "SavedVariance": [svar]},
+                     attrs={"epsilon": epsilon})
+    return out
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                param_attr=None, bias_attr=None, data_layout="NCHW", name=None,
+                moving_mean_name=None, moving_variance_name=None,
+                do_model_average_for_mean_and_var=True, use_global_stats=False,
+                act_alpha=1.0):
+    """In-place activated batch norm — functionally batch_norm + act
+    (in-place-ness is an XLA buffer-donation concern, not a graph one)."""
+    from .nn import batch_norm
+    return batch_norm(input, act=act, is_test=is_test, momentum=momentum,
+                      epsilon=epsilon, param_attr=param_attr,
+                      bias_attr=bias_attr, data_layout=data_layout, name=name,
+                      moving_mean_name=moving_mean_name,
+                      moving_variance_name=moving_variance_name,
+                      use_global_stats=use_global_stats)
+
+
+# --------------------------------------------------------------------------
+# structured losses
+# --------------------------------------------------------------------------
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """reference: layers/nn.py warpctc.  With input_length given, input is
+    padded time-major (Tmax, B, C); labels padded (B, Lmax)."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype,
+                                                     stop_gradient=True)
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    helper.append_op("warpctc", inputs=inputs,
+                     outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """argmax + merge-repeats + drop-blank (reference: layers/nn.py
+    ctc_greedy_decoder = topk + ctc_align)."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    # argmax over classes
+    idx = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op("arg_max", inputs={"X": [input]}, outputs={"Out": [idx]},
+                     attrs={"axis": -1})
+    out = helper.create_variable_for_type_inference(VarType.INT64)
+    out_len = helper.create_variable_for_type_inference(VarType.INT64)
+    inputs = {"Input": [idx]}
+    if input_length is not None:
+        inputs["InputLength"] = [input_length]
+    helper.append_op("ctc_align", inputs=inputs,
+                     outputs={"Output": [out], "OutputLength": [out_len]},
+                     attrs={"blank": blank, "padding_value": padding_value})
+    if input_length is None:
+        return out
+    return out, out_len
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """reference: layers/nn.py linear_chain_crf.  Padded emission
+    (B, T, D) + length (B,)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(param_attr, shape=[size + 2, size],
+                                         dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype,
+                                                      stop_gradient=True)
+    eexps = helper.create_variable_for_type_inference(input.dtype,
+                                                      stop_gradient=True)
+    texps = helper.create_variable_for_type_inference(input.dtype,
+                                                      stop_gradient=True)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("linear_chain_crf", inputs=inputs,
+                     outputs={"Alpha": [alpha], "EmissionExps": [eexps],
+                              "TransitionExps": [texps],
+                              "LogLikelihood": [ll]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    transition = helper.main_program.global_block()._find_var_recursive(
+        param_attr if isinstance(param_attr, str) else param_attr.name
+    ) if param_attr is not None and not isinstance(param_attr, Variable) else param_attr
+    if transition is None:
+        raise ValueError("crf_decoding needs the transition parameter "
+                         "created by linear_chain_crf (pass its ParamAttr)")
+    path = helper.create_variable_for_type_inference(VarType.INT64)
+    inputs = {"Emission": [input], "Transition": [transition]}
+    outputs = {"ViterbiPath": [path]}
+    if label is not None:
+        inputs["Label"] = [label]
+        correct = helper.create_variable_for_type_inference(VarType.INT64)
+        outputs["Correct"] = [correct]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("crf_decoding", inputs=inputs, outputs=outputs)
+    if label is not None:
+        return outputs["Correct"][0]
+    return path
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dtype = input.dtype
+    dim = input.shape[1]
+    num_neg_samples = num_neg_samples or 10
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
+                                dtype=dtype)
+    cost = helper.create_variable_for_type_inference(dtype)
+    slogits = helper.create_variable_for_type_inference(dtype,
+                                                        stop_gradient=True)
+    slabels = helper.create_variable_for_type_inference(VarType.INT64,
+                                                        stop_gradient=True)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_total_classes, 1],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    sampler_id = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}[sampler]
+    helper.append_op("nce", inputs=inputs,
+                     outputs={"Cost": [cost], "SampleLogits": [slogits],
+                              "SampleLabels": [slabels]},
+                     attrs={"num_total_classes": num_total_classes,
+                            "num_neg_samples": num_neg_samples,
+                            "sampler": sampler_id, "seed": seed})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    helper = LayerHelper("hierarchical_sigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    dim = input.shape[1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    pre_out = helper.create_variable_for_type_inference(dtype,
+                                                        stop_gradient=True)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if is_custom or path_table is not None:
+        if path_table is None or path_code is None:
+            raise ValueError("hsigmoid custom tree needs both path_table "
+                             "and path_code")
+        inputs["PathTable"] = [path_table]
+        inputs["PathCode"] = [path_code]
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_classes - 1, 1],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    helper.append_op("hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Out": [out], "PreOut": [pre_out]},
+                     attrs={"num_classes": num_classes})
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    helper = LayerHelper("bpr_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("bpr_loss", inputs={"X": [input], "Label": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    helper = LayerHelper("center_loss", param_attr=param_attr)
+    dtype = input.dtype
+    centers = helper.create_parameter(param_attr,
+                                      shape=[num_classes, input.shape[1]],
+                                      dtype=dtype)
+    centers.stop_gradient = True
+    from .tensor import fill_constant
+    if isinstance(alpha, Variable):
+        alpha_var = alpha
+    else:
+        alpha_var = fill_constant(shape=[1], dtype=dtype, value=float(alpha))
+    loss = helper.create_variable_for_type_inference(dtype)
+    diff = helper.create_variable_for_type_inference(dtype,
+                                                     stop_gradient=True)
+    helper.append_op("center_loss",
+                     inputs={"X": [input], "Label": [label],
+                             "Centers": [centers],
+                             "CenterUpdateRate": [alpha_var]},
+                     outputs={"Loss": [loss], "SampleCenterDiff": [diff],
+                              "CentersOut": [centers]},
+                     attrs={"need_update": update_center})
+    return loss
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype,
+                                                    stop_gradient=True)
+    helper.append_op("margin_rank_loss",
+                     inputs={"Label": [label], "X1": [left], "X2": [right]},
+                     outputs={"Out": [out], "Activated": [act]},
+                     attrs={"margin": margin})
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sigmoid_focal_loss",
+                     inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+                     outputs={"Out": [out]},
+                     attrs={"gamma": gamma, "alpha": alpha})
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("teacher_student_sigmoid_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_max_up_bound": soft_max_up_bound,
+                            "soft_max_lower_bound": soft_max_lower_bound})
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Composed like the reference layer (reference: layers/nn.py
+    dice_loss): 1 - 2*|X∩Y| / (|X|+|Y|)."""
+    from .nn import reduce_sum, reduce_mean, one_hot
+    label_oh = one_hot(label, input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(input * label_oh, dim=reduce_dims)
+    dice_denominator = reduce_sum(input, dim=reduce_dims) + reduce_sum(
+        label_oh, dim=reduce_dims)
+    dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
+    return reduce_mean(dice_score)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Composed (reference: layers/nn.py npair_loss)."""
+    from .nn import reduce_mean, reduce_sum, softmax_with_cross_entropy, transpose, matmul
+    from .tensor import cast
+    reg_anchor = reduce_mean(reduce_sum(anchor * anchor, dim=1))
+    reg_pos = reduce_mean(reduce_sum(positive * positive, dim=1))
+    l2loss = (reg_anchor + reg_pos) * 0.25 * l2_reg
+    labels = cast(labels, "float32")
+    from .nn import reshape
+    labels = reshape(labels, [labels.shape[0], 1])
+    eq = cast(equal_all_pairs(labels), "float32")
+    similarity = matmul(anchor, positive, transpose_y=True)
+    denom = reduce_sum(eq, dim=1, keep_dim=True)
+    target = eq / denom
+    ce = softmax_with_cross_entropy(similarity, target, soft_label=True)
+    return reduce_mean(ce) + l2loss
+
+
+def equal_all_pairs(labels):
+    """labels (B,1) -> (B,B) equality matrix, via broadcasting ops."""
+    helper = LayerHelper("equal_all_pairs")
+    from .nn import transpose
+    lt = transpose(labels, [1, 0])
+    out = helper.create_variable_for_type_inference(VarType.BOOL)
+    helper.append_op("equal", inputs={"X": [labels], "Y": [lt]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference(VarType.FP32)
+    seq_num = helper.create_variable_for_type_inference(VarType.INT64)
+    inputs = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        inputs["HypsLength"] = [input_length]
+    if label_length is not None:
+        inputs["RefsLength"] = [label_length]
+    helper.append_op("edit_distance", inputs=inputs,
+                     outputs={"Out": [out], "SequenceNum": [seq_num]},
+                     attrs={"normalized": normalized})
+    return out, seq_num
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference(VarType.FP32)
+    recall = helper.create_variable_for_type_inference(VarType.FP32)
+    f1 = helper.create_variable_for_type_inference(VarType.FP32)
+    n_infer = helper.create_variable_for_type_inference(VarType.INT64)
+    n_label = helper.create_variable_for_type_inference(VarType.INT64)
+    n_correct = helper.create_variable_for_type_inference(VarType.INT64)
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length]
+    helper.append_op("chunk_eval", inputs=inputs,
+                     outputs={"Precision": [precision], "Recall": [recall],
+                              "F1-Score": [f1], "NumInferChunks": [n_infer],
+                              "NumLabelChunks": [n_label],
+                              "NumCorrectChunks": [n_correct]},
+                     attrs={"num_chunk_types": num_chunk_types,
+                            "chunk_scheme": chunk_scheme,
+                            "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, n_infer, n_label, n_correct
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None, seed=0):
+    helper = LayerHelper("sampled_softmax_with_cross_entropy")
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op("sampled_softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"num_samples": num_samples, "seed": seed})
+    return loss
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op("rank_loss",
+                     inputs={"Label": [label], "Left": [left], "Right": [right]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  cell_clip=None, proj_clip=None, length=None):
+    """reference: layers/nn.py dynamic_lstmp (lstmp_op.cc).  Input is the
+    (B, T, 4H) x-projection like dynamic_lstm; returns (projection, cell)."""
+    helper = LayerHelper("dynamic_lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden = size // 4
+    w = helper.create_parameter(param_attr, shape=[proj_size, 4 * hidden],
+                                dtype=dtype)
+    wproj = helper.create_parameter(param_attr, shape=[hidden, proj_size],
+                                    dtype=dtype)
+    # 7H bias when peepholes are on: 4H gate bias + W_ic/W_fc/W_oc
+    # diagonals (reference: lstmp_op.cc bias layout)
+    bias_width = 7 * hidden if use_peepholes else 4 * hidden
+    bias = helper.create_parameter(bias_attr, shape=[1, bias_width],
+                                   dtype=dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    lh = helper.create_variable_for_type_inference(dtype)
+    lc = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [w], "ProjWeight": [wproj],
+              "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    if length is not None:
+        inputs["SequenceLength"] = [length]
+    helper.append_op("dynamic_lstmp", inputs=inputs,
+                     outputs={"Projection": [proj], "Cell": [cell],
+                              "LastH": [lh], "LastC": [lc]},
+                     attrs={"is_reverse": is_reverse,
+                            "use_peepholes": use_peepholes,
+                            "cell_clip": cell_clip or 0.0,
+                            "proj_clip": proj_clip or 0.0,
+                            "proj_activation": proj_activation})
+    return proj, cell
+
+
+def gather_tree(ids, parents):
+    helper = LayerHelper("gather_tree")
+    out = helper.create_variable_for_type_inference(ids.dtype)
+    helper.append_op("gather_tree", inputs={"Ids": [ids], "Parents": [parents]},
+                     outputs={"Out": [out]})
+    return out
+
+
+# --------------------------------------------------------------------------
+# debug / infra layers
+# --------------------------------------------------------------------------
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: layers/control_flow.py Print — forwards input and prints
+    host-side via the print op."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("print", inputs={"In": [input]}, outputs={"Out": [out]},
+                     attrs={"first_n": first_n, "message": message or "",
+                            "summarize": summarize,
+                            "print_tensor_name": print_tensor_name,
+                            "print_tensor_type": print_tensor_type,
+                            "print_tensor_shape": print_tensor_shape,
+                            "print_phase": print_phase.upper()})
+    return out
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """reference: layers/control_flow.py Assert — host-side check."""
+    helper = LayerHelper("assert", name=name)
+    helper.append_op("assert_op", inputs={"Cond": [cond],
+                                          "Data": list(data or [])},
+                     outputs={}, attrs={"summarize": summarize})
+    return None
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(VarType.BOOL)
+    helper.append_op("is_empty", inputs={"X": [x]}, outputs={"Out": [cond]})
+    return cond
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference: layers/nn.py autoincreased_step_counter — a persistable
+    int64 counter incremented once per run."""
+    helper = LayerHelper("global_step_counter")
+    counter = helper.create_global_variable(
+        name=counter_name or "@STEP_COUNTER@", dtype=VarType.INT64, shape=[1],
+        persistable=True)
+    helper.startup_program.global_block().create_var(
+        name=counter.name, dtype=VarType.INT64, shape=[1], persistable=True)
+    sb = helper.startup_program.global_block()
+    sb.append_op("fill_constant", inputs={},
+                 outputs={"Out": [counter.name]},
+                 attrs={"shape": [1], "value": float(begin - step),
+                        "dtype": int(VarType.INT64)})
+    helper.append_op("increment", inputs={"X": [counter]},
+                     outputs={"Out": [counter]}, attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+_PY_FUNC_COUNTER = [0]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: layers/nn.py py_func — run a host Python function inside
+    the program.  Registers a fresh host op per call; the executor's
+    hybrid segmentation runs it between jitted segments exactly like the
+    reference's CPU-pinned py_func op.  When backward_func is given it is
+    called as backward_func(*xs, *out_grads) -> x_grads (a simplified
+    contract vs the reference's skip-list plumbing)."""
+    from ..ops.registry import op as register, grad_maker
+    from ..framework.core import GRAD_SUFFIX, EMPTY_VAR_NAME
+
+    _PY_FUNC_COUNTER[0] += 1
+    op_type = f"py_func_{_PY_FUNC_COUNTER[0]}"
+
+    @register(op_type, no_grad=backward_func is None, host=True)
+    def _lower(ctx, _func=func):
+        import jax.numpy as jnp
+        vals = [np.asarray(v) for v in ctx.ins("X")]
+        res = _func(*vals)
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        ctx.set_out("Out", [jnp.asarray(np.asarray(r)) for r in res])
+
+    if backward_func is not None:
+        @register(op_type + "_grad", no_grad=True, host=True)
+        def _glower(ctx, _bfunc=backward_func):
+            import jax.numpy as jnp
+            xs_v = [np.asarray(v) for v in ctx.ins("X")]
+            dys = [np.asarray(v) for v in ctx.ins("Out" + GRAD_SUFFIX)]
+            res = _bfunc(*(xs_v + dys))
+            if not isinstance(res, (list, tuple)):
+                res = [res]
+            ctx.set_out("X" + GRAD_SUFFIX,
+                        [jnp.asarray(np.asarray(r)) for r in res])
+
+        @grad_maker(op_type)
+        def _gmaker(op_, no_grad_names, _t=op_type):
+            return [dict(
+                type=_t + "_grad",
+                inputs={"X": list(op_.inputs["X"]),
+                        "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX
+                                              for n in op_.outputs["Out"]]},
+                outputs={"X" + GRAD_SUFFIX: [
+                    n + GRAD_SUFFIX if n not in no_grad_names else EMPTY_VAR_NAME
+                    for n in op_.inputs["X"]]},
+                attrs={},
+            )]
+
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    helper.append_op(op_type, inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)})
+    return out
+
+
+# --------------------------------------------------------------------------
+# single-step RNN units (ops in ops/sequence_ops.py)
+# --------------------------------------------------------------------------
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference: layers/rnn original lstm_unit — fc([x, h]) then one
+    lstm_unit op step; returns (hidden, cell)."""
+    from .nn import fc, concat
+    helper = LayerHelper("lstm_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    size = cell_t_prev.shape[-1]
+    cat = concat([x_t, hidden_t_prev], axis=-1)
+    gates = fc(cat, 4 * size, param_attr=param_attr, bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op("lstm_unit",
+                     inputs={"X": [gates], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": forget_bias})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """reference: layers/nn.py gru_unit — one GRU step on the
+    pre-computed input projection (size = 3*hidden)."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    hidden_size = size // 3
+    w = helper.create_parameter(param_attr, shape=[hidden_size, 3 * hidden_size],
+                                dtype=dtype)
+    inputs = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[1, 3 * hidden_size],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_h = helper.create_variable_for_type_inference(dtype)
+    updated = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gru_unit", inputs=inputs,
+                     outputs={"Gate": [gate], "ResetHiddenPrev": [reset_h],
+                              "Hidden": [updated]},
+                     attrs={"origin_mode": origin_mode})
+    return updated, reset_h, gate
+
+
+# --------------------------------------------------------------------------
+# CTR / instance-filter utilities
+# --------------------------------------------------------------------------
+def continuous_value_model(input, cvm, use_cvm=True):
+    """reference: layers/nn.py continuous_value_model (cvm op)."""
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cvm", inputs={"X": [input], "CVM": [cvm]},
+                     outputs={"Y": [out]}, attrs={"use_cvm": use_cvm})
+    return out
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod, out_val_if_empty=0):
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(ins.dtype)
+    loss_weight = helper.create_variable_for_type_inference(VarType.FP32)
+    mmap = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op("filter_by_instag",
+                     inputs={"Ins": [ins], "Ins_tag": [ins_tag],
+                             "Filter_tag": [filter_tag]},
+                     outputs={"Out": [out], "LossWeight": [loss_weight],
+                              "IndexMap": [mmap]},
+                     attrs={"is_lod": is_lod,
+                            "out_val_if_empty": out_val_if_empty})
+    return out, loss_weight
+
+
+# --------------------------------------------------------------------------
+# reader / io conveniences (reference: layers/io.py)
+# --------------------------------------------------------------------------
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference: layers/io.py py_reader.  Returns a DataLoader-backed
+    reader object with decorate_paddle_reader/decorate_tensor_provider
+    plus data vars, matching the common usage pattern."""
+    from ..reader import PyReader
+    return PyReader(capacity=capacity, shapes=shapes, dtypes=dtypes,
+                    use_double_buffer=use_double_buffer, name=name)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    from ..reader import PyReader
+    return PyReader(capacity=capacity, feed_list=feed_list,
+                    use_double_buffer=use_double_buffer, name=name)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Double buffering is built into the DataLoader prefetch thread —
+    identity here (reference: layers/io.py double_buffer)."""
+    return reader
+
+
+def read_file(reader):
+    """reference: layers/io.py read_file — pop the next batch's vars."""
+    return reader.read_file() if hasattr(reader, "read_file") else reader
+
+
+def load(out, file_path, load_as_fp16=None):
+    """reference: layers/io.py load — load one saved variable into out."""
+    from .. import io as _io
+    helper = LayerHelper("load")
+
+    def _load_fn():
+        import pickle
+        with open(file_path, "rb") as f:
+            return pickle.load(f)
+
+    # host op: read at execution time, bind into the out var
+    from ..ops.registry import op as register
+    _PY_FUNC_COUNTER[0] += 1
+    op_type = f"load_{_PY_FUNC_COUNTER[0]}"
+
+    @register(op_type, no_grad=True, host=True)
+    def _lower(ctx):
+        import jax.numpy as jnp
+        ctx.set_out("Out", jnp.asarray(_load_fn()))
+
+    helper.append_op(op_type, inputs={}, outputs={"Out": [out]})
+    return out
+
+
+# --------------------------------------------------------------------------
+# doc/codegen decorators (reference: layers/layer_function_generator.py)
+# --------------------------------------------------------------------------
+def deprecated(since=None, instead=None, reason=""):
+    def deco(fn):
+        import functools, warnings
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            warnings.warn(f"{fn.__name__} is deprecated"
+                          + (f"; use {instead}" if instead else ""),
+                          DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def templatedoc(op_type=None):
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+def autodoc(comment=""):
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+def generate_layer_fn(op_type):
+    """Build a generic LayerHelper wrapper for a registered op type
+    (reference: layer_function_generator.py generate_layer_fn)."""
+
+    def fn(*args, **kwargs):
+        helper = LayerHelper(op_type, **kwargs)
+        inputs = {}
+        if args:
+            inputs["X"] = [args[0]] if not isinstance(args[0], (list, tuple)) \
+                else list(args[0])
+            if len(args) > 1:
+                inputs["Y"] = [args[1]]
+        dtype = None
+        for vs in inputs.values():
+            for v in vs:
+                if hasattr(v, "dtype"):
+                    dtype = v.dtype
+                    break
+        out = kwargs.pop("out", None) or helper.create_variable_for_type_inference(
+            dtype or VarType.FP32)
+        attrs = {k: v for k, v in kwargs.items()
+                 if k not in ("name", "param_attr", "bias_attr", "act")}
+        helper.append_op(op_type, inputs=inputs, outputs={"Out": [out]},
+                         attrs=attrs)
+        return out
+
+    fn.__name__ = op_type
+    return fn
+
+
+def generate_activation_fn(op_type):
+    return _simple(op_type)
+
+
+# public surface: every function defined in this module (keeps the
+# star-import in layers/__init__.py from leaking np/LayerHelper/etc.)
+__all__ = [
+    _n for _n, _v in list(globals().items())
+    if not _n.startswith("_") and callable(_v)
+    and getattr(_v, "__module__", None) == __name__
+]

@@ -214,3 +214,53 @@ def row_conv(input, future_context_size, param_attr=None, act=None, length=None)
     _seq_op(helper, "row_conv",
             _maybe_len({"X": [input], "Filter": [w]}, length), {"Out": [out]})
     return helper.append_activation(out, act)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference: layers/nn.py lod_reset.  Padded+length repr: the lod
+    lives in a Length companion var; this rebinds x's length metadata from
+    y (or target_lod) via the lod_reset op."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y]
+    elif target_lod is not None:
+        attrs["target_lod"] = list(target_lod)
+    helper.append_op("lod_reset", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def lod_append(x, level):
+    """reference: layers/nn.py lod_append — appends a lod level; on the
+    padded repr this is lod_reset with the new level."""
+    return lod_reset(x, y=level if hasattr(level, "dtype") else None,
+                     target_lod=None if hasattr(level, "dtype") else level)
+
+
+def sequence_scatter(input, index, updates, name=None, index_length=None):
+    """reference: layers/sequence_lod.py sequence_scatter (padded repr:
+    index/updates are (B, L) with optional index_length)."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if index_length is not None:
+        inputs["IdsLength"] = [index_length]
+    helper.append_op("sequence_scatter", inputs=inputs,
+                     outputs={"Out": [out]})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reference: layers/control_flow.py reorder_lod_tensor_by_rank.
+    rank_table here is the Length var of the reference sequence (the
+    lod_rank_table analog): rows of x are reordered by descending
+    reference length, stably — the exact order lod_rank_table produces."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     inputs={"X": [x], "RankTable": [rank_table]},
+                     outputs={"Out": [out]})
+    return out

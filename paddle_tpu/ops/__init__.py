@@ -28,3 +28,5 @@ from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
+from . import vision_ops  # noqa: F401
+from . import loss_ops  # noqa: F401

@@ -495,3 +495,15 @@ def _trace(ctx):
 @op("matmul_with_flatten")
 def _matmul_with_flatten(ctx):
     _mul(ctx)
+
+
+@op("isinf", no_grad=True)
+def _isinf_reduce(ctx):
+    """Scalar any-inf (reference: isfinite_op.cc OverflowOp 'isinf')."""
+    ctx.set_out("Out", jnp.any(jnp.isinf(ctx.in_("X"))))
+
+
+@op("isnan", no_grad=True)
+def _isnan_reduce(ctx):
+    """Scalar any-nan (reference: isfinite_op.cc OverflowOp 'isnan')."""
+    ctx.set_out("Out", jnp.any(jnp.isnan(ctx.in_("X"))))

@@ -116,6 +116,7 @@ op("depthwise_conv2d")(lambda ctx: _conv_lower(ctx))
 op("conv3d")(lambda ctx: _conv_lower(ctx))
 op("conv2d_transpose")(lambda ctx: _conv_lower(ctx, transpose=True))
 op("depthwise_conv2d_transpose")(lambda ctx: _conv_lower(ctx, transpose=True))
+op("conv3d_transpose")(lambda ctx: _conv_lower(ctx, transpose=True))
 
 
 # --------------------------------------------------------------------------
@@ -866,3 +867,44 @@ def _temporal_shift(ctx):
     post = jnp.pad(xr[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
     rest = xr[:, :, c2:]
     ctx.set_out("Out", jnp.reshape(jnp.concatenate([pre, post, rest], axis=2), (nt, c, h, w)))
+
+
+@op("cvm")
+def _cvm(ctx):
+    """Continuous-value model op for CTR features (reference: cvm_op.h):
+    first two columns are show/click; use_cvm keeps them log-transformed,
+    otherwise they are dropped."""
+    x = ctx.in_("X")
+    use_cvm = ctx.attr("use_cvm", True)
+    if use_cvm:
+        c0 = jnp.log(x[:, :1] + 1.0)
+        c1 = jnp.log(x[:, 1:2] + 1.0) - c0
+        ctx.set_out("Y", jnp.concatenate([c0, c1, x[:, 2:]], axis=1))
+    else:
+        ctx.set_out("Y", x[:, 2:])
+
+
+@grad_maker("cvm")
+def _cvm_grad_maker(op_, no_grad_names):
+    out = {"X" + GRAD_SUFFIX: [
+        n + GRAD_SUFFIX if n not in no_grad_names else EMPTY_VAR_NAME
+        for n in op_.inputs["X"]]}
+    return [dict(type="cvm_grad",
+                 inputs={"X": list(op_.inputs["X"]),
+                         "Y" + GRAD_SUFFIX: [n + GRAD_SUFFIX
+                                             for n in op_.outputs["Y"]]},
+                 outputs=out, attrs=dict(op_.attrs))]
+
+
+@op("cvm_grad", no_grad=True)
+def _cvm_grad(ctx):
+    """reference cvm_op.h CvmGradComputeKernel: dY is copied through to
+    dX for the show/click columns (NOT differentiated through the log),
+    and zero-padded into them when use_cvm=False dropped the columns."""
+    x = ctx.in_("X")
+    dy = ctx.in_("Y" + GRAD_SUFFIX)
+    if ctx.attr("use_cvm", True):
+        ctx.set_out("X" + GRAD_SUFFIX, dy)
+    else:
+        pad = jnp.zeros((x.shape[0], 2), x.dtype)
+        ctx.set_out("X" + GRAD_SUFFIX, jnp.concatenate([pad, dy], axis=1))

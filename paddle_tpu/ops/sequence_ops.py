@@ -698,3 +698,206 @@ def _im2sequence(ctx):
     ph, pw = jnp.shape(patches)[2], jnp.shape(patches)[3]
     out = jnp.transpose(patches.reshape(N, -1, ph * pw), (0, 2, 1))
     ctx.set_out("Out", out)
+
+
+# --------------------------------------------------------------------------
+# LSTMP / decode-tree utilities
+# --------------------------------------------------------------------------
+@op("dynamic_lstmp")
+def _dynamic_lstmp(ctx):
+    """LSTM with recurrent projection (reference: lstmp_op.cc).  Input
+    (N, T, 4H) x-projection; Weight (P, 4H) recurrent over the projected
+    state; ProjWeight (H, P).  Gate order i,f,g,o; proj_activation
+    applied to r_t (default tanh like the reference)."""
+    import jax.nn as jnn
+
+    x = ctx.in_("Input")
+    w = ctx.in_("Weight")            # P, 4H
+    wproj = ctx.in_("ProjWeight")    # H, P
+    b = ctx.in_("Bias") if ctx.has_input("Bias") else None
+    length = _get_len(ctx, x, "SequenceLength")
+    H = jnp.shape(wproj)[0]
+    P = jnp.shape(wproj)[1]
+    N = jnp.shape(x)[0]
+    T = jnp.shape(x)[1]
+    h0 = ctx.in_("H0") if ctx.has_input("H0") else jnp.zeros((N, P), x.dtype)
+    c0 = ctx.in_("C0") if ctx.has_input("C0") else jnp.zeros((N, H), x.dtype)
+    use_peepholes = bool(ctx.attr("use_peepholes", False))
+    if b is not None:
+        bflat = jnp.reshape(b, (-1,))
+        bb = bflat[: 4 * H]
+        # peephole weights ride in the bias tail (reference lstmp_op: a
+        # 7H bias = 4H gate bias + W_ic, W_fc, W_oc diagonals)
+        if use_peepholes and bflat.shape[0] >= 7 * H:
+            w_ic = bflat[4 * H: 5 * H]
+            w_fc = bflat[5 * H: 6 * H]
+            w_oc = bflat[6 * H: 7 * H]
+        else:
+            use_peepholes = False
+            w_ic = w_fc = w_oc = None
+    else:
+        bb = jnp.zeros((4 * H,), x.dtype)
+        use_peepholes = False
+        w_ic = w_fc = w_oc = None
+    cell_clip = ctx.attr("cell_clip", 0.0) or 0.0
+    proj_clip = ctx.attr("proj_clip", 0.0) or 0.0
+    proj_act = ctx.attr("proj_activation", "tanh")
+    is_reverse = bool(ctx.attr("is_reverse", False))
+    mask = _length_mask(length, T, x.dtype)
+    xin = x
+    if is_reverse:
+        # per-sequence reversal within each sample's valid length, exactly
+        # as dynamic_lstm does above
+        t = jnp.arange(T)[None, :]
+        L = length[:, None]
+        ridx = jnp.where(t < L, L - 1 - t, t).astype(jnp.int32)
+        xin = jnp.take_along_axis(x, ridx[:, :, None], axis=1)
+    xs = jnp.swapaxes(xin, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)[:, :, None]
+
+    def pact(v):
+        if proj_act == "tanh":
+            return jnp.tanh(v)
+        if proj_act == "sigmoid":
+            return jnn.sigmoid(v)
+        if proj_act == "relu":
+            return jnn.relu(v)
+        return v  # identity
+
+    def step(carry, inp):
+        r, c = carry
+        xt, mt = inp
+        gates = xt + r @ w + bb
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = i + w_ic * c
+            f = f + w_fc * c
+        i, f = jnn.sigmoid(i), jnn.sigmoid(f)
+        g = jnp.tanh(g)
+        cn = f * c + i * g
+        if cell_clip > 0:
+            cn = jnp.clip(cn, -cell_clip, cell_clip)
+        if use_peepholes:
+            o = o + w_oc * cn
+        o = jnn.sigmoid(o)
+        hn = o * jnp.tanh(cn)
+        rn = pact(hn @ wproj)
+        if proj_clip > 0:
+            rn = jnp.clip(rn, -proj_clip, proj_clip)
+        rn = mt * rn + (1 - mt) * r
+        cn = mt * cn + (1 - mt) * c
+        return (rn, cn), (rn * mt, cn * mt)
+
+    (rT, cT), (rs, cs) = lax.scan(step, (h0, c0), (xs, ms))
+    proj = jnp.swapaxes(rs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        proj = jnp.take_along_axis(proj, ridx[:, :, None], axis=1)
+        cell = jnp.take_along_axis(cell, ridx[:, :, None], axis=1)
+    ctx.set_out("Projection", proj)
+    ctx.set_out("Cell", cell)
+    ctx.set_out("LastH", rT)
+    ctx.set_out("LastC", cT)
+
+
+@op("gather_tree", no_grad=True)
+def _gather_tree(ctx):
+    """Backtrack beam-search parents into full sequences (reference:
+    gather_tree_op.cc).  Ids/Parents (T, B, W) -> (T, B, W)."""
+    ids, parents = ctx.in_("Ids"), ctx.in_("Parents").astype(jnp.int32)
+    t_max, b, w = ids.shape
+
+    def step(beam, xt):
+        id_t, par_t = xt  # B, W
+        out = jnp.take_along_axis(id_t, beam, axis=1)
+        nxt = jnp.take_along_axis(par_t, beam, axis=1)
+        return nxt, out
+
+    init = jnp.tile(jnp.arange(w)[None, :], (b, 1))
+    _, outs = lax.scan(step, init, (ids[::-1], parents[::-1]))
+    ctx.set_out("Out", outs[::-1])
+
+
+@op("ctc_align", no_grad=True)
+def _ctc_align(ctx):
+    """CTC greedy-decode alignment: merge repeats then drop blanks
+    (reference: ctc_align_op.cc, padding path).  Input (B, T) +
+    InputLength -> Output (B, T) padded with padding_value and
+    OutputLength."""
+    x = ctx.in_("Input").astype(jnp.int32)
+    blank = ctx.attr("blank", 0)
+    pad_val = ctx.attr("padding_value", 0)
+    b, t = x.shape
+    if ctx.has_input("InputLength"):
+        lens = ctx.in_("InputLength").reshape(-1).astype(jnp.int32)
+    else:
+        lens = jnp.full((b,), t, jnp.int32)
+    prev = jnp.concatenate([jnp.full((b, 1), -1, x.dtype), x[:, :-1]], 1)
+    tpos = jnp.arange(t)[None, :]
+    keep = (x != blank) & (x != prev) & (tpos < lens[:, None])
+    pos = jnp.cumsum(keep, axis=1) - 1
+    out = jnp.full((b, t), pad_val, x.dtype)
+    bidx = jnp.repeat(jnp.arange(b)[:, None], t, 1)
+    # scatter kept tokens to compacted positions; masked-out writes go to
+    # a dropped row via mode="drop"
+    out = out.at[jnp.where(keep, bidx, b), jnp.where(keep, pos, 0)].set(
+        x, mode="drop")
+    ctx.set_out("Output", out.astype(jnp.int64))
+    ctx.set_out("OutputLength", keep.sum(1).astype(jnp.int64)[:, None])
+
+
+@op("sequence_scatter")
+def _sequence_scatter(ctx):
+    """Scatter per-sequence updates into X (reference:
+    sequence_scatter_op.cc).  Padded repr: Ids (B, L) column indices with
+    IdsLength (B,) valid counts; Updates (B, L) values added at
+    X[b, ids[b, i]]."""
+    x = ctx.in_("X")
+    ids = ctx.in_("Ids").astype(jnp.int32)
+    upd = ctx.in_("Updates")
+    if ids.ndim == 3:
+        ids = ids[:, :, 0]
+    length = _get_len(ctx, ids, "IdsLength")
+    b, l = ids.shape
+    valid = jnp.arange(l)[None, :] < length[:, None]
+    bidx = jnp.repeat(jnp.arange(b)[:, None], l, 1)
+    # masked-out updates route to a dropped row
+    out = x.at[jnp.where(valid, bidx, b), jnp.where(valid, ids, 0)].add(
+        jnp.where(valid, upd, 0.0), mode="drop")
+    ctx.set_out("Out", out)
+
+
+@op("filter_by_instag", no_grad=True, host=True)
+def _filter_by_instag(ctx):
+    """Keep rows whose tag set intersects the filter tags (reference:
+    filter_by_instag_op.cc).  Host op: output row count is data-dependent."""
+    x = np.asarray(ctx.in_("Ins"))
+    tags = np.asarray(ctx.in_("Ins_tag"))   # (B, T) padded tag rows
+    filter_tags = set(np.asarray(ctx.in_("Filter_tag")).ravel().tolist())
+    if tags.ndim == 1:
+        tags = tags[:, None]
+    keep = [i for i in range(x.shape[0])
+            if filter_tags & set(tags[i].ravel().tolist())]
+    if not keep:
+        # reference emits one dummy zero row with ZERO loss weight so the
+        # empty-match batch contributes nothing to the loss
+        keep = [0]
+        out = jnp.zeros_like(jnp.asarray(x[:1]))
+        lw = jnp.zeros((1, 1), jnp.float32)
+    else:
+        out = jnp.asarray(x[keep])
+        lw = jnp.ones((len(keep), 1), jnp.float32)
+    ctx.set_out("Out", out)
+    ctx.set_out("LossWeight", lw)
+    ctx.set_out("IndexMap", jnp.asarray(
+        np.stack([np.array(keep), np.array(keep)], axis=1).astype(np.int64)))
+
+
+@op("reorder_lod_tensor_by_rank")
+def _reorder_lod_tensor_by_rank(ctx):
+    """Stable sort of batch rows by descending reference length
+    (reference: reorder_lod_tensor_by_rank_op.cc over lod_rank_table)."""
+    x = ctx.in_("X")
+    lengths = ctx.in_("RankTable").reshape(-1)
+    order = jnp.argsort(-lengths, stable=True)
+    ctx.set_out("Out", jnp.take(x, order, axis=0))

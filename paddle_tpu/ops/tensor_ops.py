@@ -567,3 +567,38 @@ def _print(ctx):
     x = ctx.in_("In")
     jax.debug.print(ctx.attr("message", "") + " {}", x)
     ctx.set_out("Out", x)
+
+
+@op("random_crop", no_grad=True, stateful=True)
+def _random_crop(ctx):
+    """Random crop of the trailing dims to `shape` (reference:
+    random_crop_op.h) via rng offsets + dynamic_slice."""
+    x = ctx.in_("X")
+    shape = list(ctx.attr("shape", []))
+    nd = x.ndim
+    fixed = nd - len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[fixed + i] - s + 1
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, max(limit, 1)))
+    start_idx = [jnp.zeros((), jnp.int32)] * fixed + [s.astype(jnp.int32) for s in starts]
+    sizes = list(x.shape[:fixed]) + shape
+    ctx.set_out("Out", jax.lax.dynamic_slice(x, start_idx, sizes))
+
+
+@op("is_empty", no_grad=True)
+def _is_empty(ctx):
+    ctx.set_out("Out", jnp.asarray(jnp.size(ctx.in_("X")) == 0))
+
+
+@op("assert_op", no_grad=True, host=True)
+def _assert_op(ctx):
+    """Host-side assertion (reference: controlflow/assert_op.cc)."""
+    cond = np.asarray(ctx.in_("Cond"))
+    if not bool(np.all(cond)):
+        data = [np.asarray(v) for v in ctx.ins("Data")]
+        summarize = ctx.attr("summarize", 20)
+        parts = [str(d.ravel()[:summarize]) for d in data]
+        raise AssertionError("Assert failed: " + "; ".join(parts))

@@ -1,0 +1,685 @@
+"""Vision / image-manipulation op lowerings.
+
+Capability parity with the reference's vision operator long tail
+(reference: paddle/fluid/operators/pixel_shuffle_op.cc, affine_channel_op.cc,
+shuffle_channel_op.cc, space_to_depth_op.cc, maxout_op.cc, lrn_op.cc,
+crop_op.cc, crop_tensor_op.cc, unfold_op.cc, deformable_conv_op.cc,
+spectral_norm_op.cc, affine_grid_op.cc, pool_op.cc (3d),
+conv_transpose_op.cc (3d), interpolate_op.cc (linear/trilinear),
+pad_constant_like_op.cc, data_norm_op.cc) — all are reshape/transpose/
+gather/matmul compositions that XLA fuses on TPU, so none needs a custom
+kernel; deformable_conv becomes batched bilinear gathers + one einsum on
+the MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+# --------------------------------------------------------------------------
+# channel rearrangement ops
+# --------------------------------------------------------------------------
+@op("pixel_shuffle")
+def _pixel_shuffle(ctx):
+    """(N, C*r^2, H, W) -> (N, C, H*r, W*r); out[n,c,h*r+i,w*r+j] =
+    in[n, c*r^2 + i*r + j, h, w] (reference: pixel_shuffle_op.cc)."""
+    x = ctx.in_("X")
+    r = ctx.attr("upscale_factor", 1)
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    out = x.reshape(n, oc, r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)  # n, oc, h, r, w, r
+    ctx.set_out("Out", out.reshape(n, oc, h * r, w * r))
+
+
+@op("affine_channel")
+def _affine_channel(ctx):
+    """out = x * scale[c] + bias[c] (reference: affine_channel_op.cc)."""
+    x, scale, bias = ctx.in_("X"), ctx.in_("Scale"), ctx.in_("Bias")
+    layout = ctx.attr("data_layout", "NCHW")
+    if layout == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    ctx.set_out("Out", x * scale.reshape(shape) + bias.reshape(shape))
+
+
+@op("shuffle_channel")
+def _shuffle_channel(ctx):
+    """ShuffleNet channel shuffle: regroup (g, C/g) -> (C/g, g)
+    (reference: shuffle_channel_op.cc)."""
+    x = ctx.in_("X")
+    g = ctx.attr("group", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+    ctx.set_out("Out", out.reshape(n, c, h, w))
+
+
+@op("space_to_depth")
+def _space_to_depth(ctx):
+    """(N, C, H, W) -> (N, C*b^2, H/b, W/b) with out channel
+    (dh*b + dw)*C + c (reference: space_to_depth_op.h index math)."""
+    x = ctx.in_("X")
+    b = ctx.attr("blocksize", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4)  # n, dh, dw, c, h/b, w/b
+    ctx.set_out("Out", out.reshape(n, c * b * b, h // b, w // b))
+
+
+@op("maxout")
+def _maxout(ctx):
+    """out[:, c] = max over x[:, c*groups:(c+1)*groups]
+    (reference: math/maxouting.cc)."""
+    x = ctx.in_("X")
+    groups = ctx.attr("groups", 1)
+    axis = ctx.attr("axis", 1)
+    if axis < 0:
+        axis += x.ndim
+    shape = list(x.shape)
+    oc = shape[axis] // groups
+    new_shape = shape[:axis] + [oc, groups] + shape[axis + 1:]
+    ctx.set_out("Out", jnp.max(x.reshape(new_shape), axis=axis + 1))
+
+
+@op("lrn")
+def _lrn(ctx):
+    """Local response normalization across channels; note paddle does NOT
+    divide alpha by n (reference: lrn_op.cc)."""
+    x = ctx.in_("X")
+    n_win = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = (n_win - 1) // 2
+    # sum over channel window [c-half, c-half+n) via padded cumsum-free conv
+    pad = [(0, 0)] * x.ndim
+    pad[1] = (half, n_win - 1 - half)
+    sqp = jnp.pad(sq, pad)
+    acc = sum(sqp[:, i:i + x.shape[1]] for i in range(n_win))
+    mid = k + alpha * acc
+    ctx.set_out("MidOut", mid)
+    ctx.set_out("Out", x * jnp.power(mid, -beta))
+
+
+@op("multiplex")
+def _multiplex(ctx):
+    """out[i] = X[ids[i]][i] (reference: multiplex_op.cc)."""
+    xs = jnp.stack([v for v in ctx.ins("X") if v is not None])
+    ids = ctx.in_("Ids").reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(ids.shape[0])
+    ctx.set_out("Out", xs[ids, rows])
+
+
+@op("unbind")
+def _unbind(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", 0)
+    n = x.shape[axis]
+    ctx.set_out("Out", [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis)])
+
+
+# --------------------------------------------------------------------------
+# crop / pad / unfold
+# --------------------------------------------------------------------------
+def _crop_common(ctx):
+    x = ctx.in_("X")
+    offsets = ctx.attr("offsets", [])
+    if ctx.has_input("Offsets"):
+        offsets = [int(v) for v in np.asarray(ctx.in_("Offsets"))]
+    shape = ctx.attr("shape", [])
+    if ctx.has_input("Y"):
+        shape = list(ctx.in_("Y").shape)
+    elif ctx.has_input("Shape"):
+        shape = [int(v) for v in np.asarray(ctx.in_("Shape"))]
+    if not offsets:
+        offsets = [0] * x.ndim
+    # -1 in shape means "to the end of that dim"
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set_out("Out", x[idx])
+
+
+@op("crop")
+def _crop(ctx):
+    _crop_common(ctx)
+
+
+@op("crop_tensor")
+def _crop_tensor(ctx):
+    _crop_common(ctx)
+
+
+@op("pad_constant_like")
+def _pad_constant_like(ctx):
+    """Pad Y up to X's shape with pad_value (reference:
+    pad_constant_like_op.cc)."""
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    val = ctx.attr("pad_value", 0.0)
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    ctx.set_out("Out", jnp.pad(y, pads, constant_values=val))
+
+
+@op("unfold")
+def _unfold(ctx):
+    """im2col: (N,C,H,W) -> (N, C*kh*kw, L) matching
+    torch.nn.functional.unfold / reference unfold_op.cc layout."""
+    x = ctx.in_("X")
+    k = ctx.attr("kernel_sizes", [3, 3])
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0, 0, 0])
+    d = ctx.attr("dilations", [1, 1])
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+    oh = (h + p[0] + p[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+    ow = (w + p[1] + p[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+    cols = []
+    for ki in range(k[0]):
+        for kj in range(k[1]):
+            patch = lax.slice(
+                xp,
+                (0, 0, ki * d[0], kj * d[1]),
+                (n, c, ki * d[0] + (oh - 1) * s[0] + 1, kj * d[1] + (ow - 1) * s[1] + 1),
+                (1, 1, s[0], s[1]),
+            )
+            cols.append(patch)  # N,C,OH,OW
+    out = jnp.stack(cols, axis=2)  # N, C, kh*kw, OH, OW
+    ctx.set_out("Y", out.reshape(n, c * k[0] * k[1], oh * ow))
+
+
+# --------------------------------------------------------------------------
+# deformable conv (DCN v1/v2)
+# --------------------------------------------------------------------------
+def _bilinear_sample_nchw(x, ys, xs):
+    """Sample x (N, G, Cg, H, W) at float coords ys/xs (N, G, K, Ho, Wo)
+    with zero padding outside; returns (N, G, Cg, K, Ho, Wo)."""
+    n, g, cg, h, w = x.shape
+
+    def gather(iy, ix):
+        valid = (iy >= 0) & (iy < h) & (ix >= 0) & (ix < w)
+        iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        bidx = jnp.arange(n)[:, None, None, None, None]
+        gidx = jnp.arange(g)[None, :, None, None, None]
+        vals = x[bidx, gidx, :, iyc, ixc]  # N,G,K,Ho,Wo,Cg
+        vals = jnp.where(valid[..., None], vals, 0.0)
+        return jnp.moveaxis(vals, -1, 2)  # N,G,Cg,K,Ho,Wo
+
+    y0, x0 = jnp.floor(ys), jnp.floor(xs)
+    wy1, wx1 = ys - y0, xs - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+    out = (gather(y0, x0) * (wy0 * wx0)[:, :, None]
+           + gather(y0, x0 + 1) * (wy0 * wx1)[:, :, None]
+           + gather(y0 + 1, x0) * (wy1 * wx0)[:, :, None]
+           + gather(y0 + 1, x0 + 1) * (wy1 * wx1)[:, :, None])
+    return out
+
+
+def _deform_conv(ctx, with_mask):
+    x, offset, filt = ctx.in_("Input"), ctx.in_("Offset"), ctx.in_("Filter")
+    strides = ctx.attr("strides", [1, 1])
+    pads = ctx.attr("paddings", [0, 0])
+    dil = ctx.attr("dilations", [1, 1])
+    groups = ctx.attr("groups", 1)
+    dg = ctx.attr("deformable_groups", 1)
+    n, c, h, w = x.shape
+    co, cig, kh, kw = filt.shape
+    k = kh * kw
+    ho = (h + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
+    wo = (w + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) // strides[1] + 1
+
+    # offset layout: (N, dg*k*2, Ho, Wo), per position [dy, dx]
+    off = offset.reshape(n, dg, k, 2, ho, wo)
+    base_y = (jnp.arange(ho) * strides[0] - pads[0])[None, None, None, :, None]
+    base_x = (jnp.arange(wo) * strides[1] - pads[1])[None, None, None, None, :]
+    ky = (jnp.arange(kh) * dil[0])[:, None].repeat(kw, 1).reshape(-1)
+    kx = (jnp.arange(kw) * dil[1])[None, :].repeat(kh, 0).reshape(-1)
+    ys = base_y + ky[None, None, :, None, None] + off[:, :, :, 0]
+    xs = base_x + kx[None, None, :, None, None] + off[:, :, :, 1]
+
+    xg = x.reshape(n, dg, c // dg, h, w)
+    samp = _bilinear_sample_nchw(xg, ys, xs)  # N,dg,C/dg,K,Ho,Wo
+    if with_mask and ctx.has_input("Mask"):
+        mask = ctx.in_("Mask").reshape(n, dg, 1, k, ho, wo)
+        samp = samp * mask
+    samp = samp.reshape(n, c, k, ho, wo)
+
+    # grouped conv contraction on the MXU
+    samp = samp.reshape(n, groups, c // groups, k, ho, wo)
+    fg = filt.reshape(groups, co // groups, cig, k)
+    out = jnp.einsum("ngckhw,gock->ngohw", samp, fg)
+    ctx.set_out("Output", out.reshape(n, co, ho, wo))
+
+
+@op("deformable_conv")
+def _deformable_conv(ctx):
+    """DCNv2: bilinear-sampled im2col modulated by Mask, then grouped
+    matmul (reference: deformable_conv_op.cc)."""
+    _deform_conv(ctx, with_mask=True)
+
+
+@op("deformable_conv_v1")
+def _deformable_conv_v1(ctx):
+    """DCNv1 — no modulation mask (reference: deformable_conv_v1_op.cc)."""
+    _deform_conv(ctx, with_mask=False)
+
+
+@op("deformable_roi_pooling")
+def _deformable_roi_pooling(ctx):
+    """Deformable (PS-)ROI pooling (reference:
+    deformable_psroi_pooling_op.cc).  Average-pools each bin at
+    offset-shifted sample positions.  Optional RoisBatchId [R] maps each
+    roi to its image (same convention as roi_align); position-sensitive
+    mode pools output channel c's bin (i, j) from input channel
+    c*ph*pw + i*pw + j."""
+    x, rois = ctx.in_("Input"), ctx.in_("ROIs")
+    trans = ctx.in_("Trans") if ctx.has_input("Trans") else None
+    batch_ids = (ctx.in_("RoisBatchId").astype(jnp.int32)
+                 if ctx.has_input("RoisBatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    no_trans = ctx.attr("no_trans", False)
+    spatial_scale = ctx.attr("spatial_scale", 1.0)
+    ph, pw = ctx.attr("pooled_height", 1), ctx.attr("pooled_width", 1)
+    part_size = ctx.attr("part_size", [ph, pw]) or [ph, pw]
+    sample_per_part = ctx.attr("sample_per_part", 1)
+    trans_std = ctx.attr("trans_std", 0.1)
+    pos_sensitive = ctx.attr("position_sensitive", False)
+    n, c, h, w = x.shape
+    nroi = rois.shape[0]
+    out_c = c // (ph * pw) if pos_sensitive else c
+    x0 = rois[:, 0] * spatial_scale - 0.5
+    y0 = rois[:, 1] * spatial_scale - 0.5
+    x1 = (rois[:, 2] + 1.0) * spatial_scale - 0.5
+    y1 = (rois[:, 3] + 1.0) * spatial_scale - 0.5
+    rw = jnp.maximum(x1 - x0, 0.1)
+    rh = jnp.maximum(y1 - y0, 0.1)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    sub_h = bin_h / sample_per_part
+    sub_w = bin_w / sample_per_part
+    iy = jnp.arange(ph)
+    ix = jnp.arange(pw)
+    if trans is not None and not no_trans:
+        # trans: (nroi, 2, part_h, part_w) offsets per part bin
+        pidx_y = (iy * part_size[0] // ph)
+        pidx_x = (ix * part_size[1] // pw)
+        off_y = trans[:, 0][:, pidx_y][:, :, pidx_x] * trans_std  # nroi,ph,pw
+        off_x = trans[:, 1][:, pidx_y][:, :, pidx_x] * trans_std
+    else:
+        off_y = jnp.zeros((nroi, ph, pw))
+        off_x = jnp.zeros((nroi, ph, pw))
+    # sample grid per bin
+    s = jnp.arange(sample_per_part) + 0.5
+    samp_y = (y0[:, None, None, None] + iy[None, :, None, None] * bin_h[:, None, None, None]
+              + off_y[:, :, :, None] * rh[:, None, None, None]
+              + s[None, None, None, :] * sub_h[:, None, None, None])  # nroi,ph,pw,s
+    samp_x = (x0[:, None, None, None] + ix[None, None, :, None] * bin_w[:, None, None, None]
+              + off_x[:, :, :, None] * rw[:, None, None, None]
+              + s[None, None, None, :] * sub_w[:, None, None, None])
+    ns = sample_per_part * sample_per_part
+    ys = samp_y[:, :, :, :, None].repeat(sample_per_part, 4).reshape(nroi, ph, pw, ns)
+    xs = samp_x[:, :, :, None, :].repeat(sample_per_part, 3).reshape(nroi, ph, pw, ns)
+
+    def gather(iyv, ixv):
+        valid = (iyv >= 0) & (iyv < h) & (ixv >= 0) & (ixv < w)
+        iyc = jnp.clip(iyv, 0, h - 1).astype(jnp.int32)
+        ixc = jnp.clip(ixv, 0, w - 1).astype(jnp.int32)
+        b = batch_ids[:, None, None, None]
+        vals = x[b, :, iyc, ixc]  # nroi,ph,pw,S,C
+        return jnp.where(valid[..., None], vals, 0.0)
+
+    fy, fx = jnp.floor(ys), jnp.floor(xs)
+    wy1, wx1 = ys - fy, xs - fx
+    v = (gather(fy, fx) * ((1 - wy1) * (1 - wx1))[..., None]
+         + gather(fy, fx + 1) * ((1 - wy1) * wx1)[..., None]
+         + gather(fy + 1, fx) * (wy1 * (1 - wx1))[..., None]
+         + gather(fy + 1, fx + 1) * (wy1 * wx1)[..., None])
+    v = v.mean(3)  # nroi, ph, pw, C
+    out = jnp.transpose(v, (0, 3, 1, 2))  # nroi, C, ph, pw
+    if pos_sensitive:
+        # output channel co at bin (i,j) reads input channel co*ph*pw+i*pw+j
+        co = jnp.arange(out_c)[:, None, None]
+        ii = jnp.arange(ph)[None, :, None]
+        jj = jnp.arange(pw)[None, None, :]
+        chan = co * ph * pw + ii * pw + jj  # out_c, ph, pw
+        out = out[jnp.arange(nroi)[:, None, None, None], chan[None],
+                  ii[None], jj[None]]
+    ctx.set_out("Output", out)
+    ctx.set_out("TopCount", jnp.ones_like(out))
+
+
+# --------------------------------------------------------------------------
+# spectral norm / data norm / affine grid
+# --------------------------------------------------------------------------
+@op("spectral_norm")
+def _spectral_norm(ctx):
+    """Weight / sigma_max via power iteration (reference:
+    spectral_norm_op.cc).  U/V are re-estimated from the stored vectors
+    each forward; the layer rebinds UOut/VOut onto the U/V vars so the
+    iteration persists across steps like the reference's mutable inputs."""
+    w, u, v = ctx.in_("Weight"), ctx.in_("U"), ctx.in_("V")
+    dim = ctx.attr("dim", 0)
+    iters = ctx.attr("power_iters", 1)
+    eps = ctx.attr("eps", 1e-12)
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+
+    def norm(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    for _ in range(max(iters, 0)):
+        v = norm(mat.T @ u)
+        u = norm(mat @ v)
+    sigma = u @ mat @ v
+    ctx.set_out("Out", w / sigma)
+    ctx.set_out("UOut", u)
+    ctx.set_out("VOut", v)
+
+
+@op("data_norm")
+def _data_norm(ctx):
+    """out = (x - mean) * scale where mean = BatchSum/BatchSize,
+    scale = sqrt(BatchSize/BatchSquareSum) (reference: data_norm_op.cc)."""
+    x = ctx.in_("X")
+    bsize = ctx.in_("BatchSize")
+    bsum = ctx.in_("BatchSum")
+    bsq = ctx.in_("BatchSquareSum")
+    mean = bsum / bsize
+    scale = jnp.sqrt(bsize / bsq)
+    ctx.set_out("Means", mean)
+    ctx.set_out("Scales", scale)
+    ctx.set_out("Y", (x - mean) * scale)
+
+
+@op("affine_grid")
+def _affine_grid(ctx):
+    """theta (N,2,3) -> sampling grid (N,H,W,2), align_corners semantics
+    (reference: affine_grid_op.cc == torch.nn.functional.affine_grid)."""
+    theta = ctx.in_("Theta")
+    if ctx.has_input("OutputShape"):
+        oshape = [int(s) for s in np.asarray(ctx.in_("OutputShape"))]
+    else:
+        oshape = list(ctx.attr("output_shape", []))
+    align = ctx.attr("align_corners", True)
+    n, _, hh, ww = oshape
+
+    def line(size):
+        if align:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = line(hh)
+    xs = line(ww)
+    gx, gy = jnp.meshgrid(xs, ys)  # H,W
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # H,W,3
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)  # N,H,W,2
+    ctx.set_out("Output", grid)
+
+
+# --------------------------------------------------------------------------
+# 3D pooling / conv-transpose / interpolation
+# --------------------------------------------------------------------------
+@op("pool3d")
+def _pool3d(ctx):
+    x = ctx.in_("X")
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = ctx.attr("ksize", [2, 2, 2])
+    strides = ctx.attr("strides", ksize)
+    pads = ctx.attr("paddings", [0, 0, 0])
+    global_pool = ctx.attr("global_pooling", False)
+    adaptive = ctx.attr("adaptive", False)
+    n, c, d, h, w = x.shape
+    if global_pool:
+        red = jnp.max if ptype == "max" else jnp.mean
+        ctx.set_out("Out", red(x, axis=(2, 3, 4), keepdims=True))
+        return
+    if adaptive:
+        od, oh, ow = ksize
+        assert d % od == 0 and h % oh == 0 and w % ow == 0, \
+            "adaptive pool3d needs divisible sizes under jit"
+        xr = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        ctx.set_out("Out", red(xr, axis=(3, 5, 7)))
+        return
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    spatial_pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, stride, spatial_pads)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, window, stride, spatial_pads)
+        if ctx.attr("exclusive", True):
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                    stride, spatial_pads)
+            out = s / cnt
+        else:
+            out = s / float(np.prod(ksize))
+    ctx.set_out("Out", out)
+
+
+@op("adaptive_pool3d")
+def _adaptive_pool3d(ctx):
+    if ctx.op is not None:
+        ctx.op.attrs["adaptive"] = True
+    else:  # replay ctx
+        ctx.attrs["adaptive"] = True
+    _pool3d(ctx)
+
+
+# conv3d_transpose reuses nn_ops._conv_lower(transpose=True) — the generic
+# n-d path already handles NCDHW/OIDHW (registered in nn_ops.py)
+
+
+def _interp_axis(x, out_size, axis, align_corners, mode):
+    """1-D linear/nearest resize along `axis` (align_corners semantics of
+    interpolate_op.cc)."""
+    in_size = x.shape[axis]
+    if mode == "nearest":
+        if align_corners:
+            idx = jnp.round(jnp.arange(out_size) * (in_size - 1) / max(out_size - 1, 1))
+        else:
+            idx = jnp.floor(jnp.arange(out_size) * in_size / out_size)
+        return jnp.take(x, idx.astype(jnp.int32), axis=axis)
+    if align_corners:
+        pos = jnp.arange(out_size) * (in_size - 1) / max(out_size - 1, 1)
+    else:
+        pos = (jnp.arange(out_size) + 0.5) * in_size / out_size - 0.5
+    pos = jnp.clip(pos, 0, in_size - 1)
+    i0 = jnp.floor(pos).astype(jnp.int32)
+    i1 = jnp.minimum(i0 + 1, in_size - 1)
+    frac = pos - i0
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    frac = frac.reshape(shape)
+    return (jnp.take(x, i0, axis=axis) * (1 - frac)
+            + jnp.take(x, i1, axis=axis) * frac)
+
+
+@op("linear_interp")
+def _linear_interp(ctx):
+    x = ctx.in_("X")  # N,C,W
+    ow = ctx.attr("out_w", x.shape[-1])
+    align = ctx.attr("align_corners", True)
+    ctx.set_out("Out", _interp_axis(x, ow, 2, align, "linear"))
+
+
+@op("trilinear_interp")
+def _trilinear_interp(ctx):
+    x = ctx.in_("X")  # N,C,D,H,W
+    od = ctx.attr("out_d", x.shape[2])
+    oh = ctx.attr("out_h", x.shape[3])
+    ow = ctx.attr("out_w", x.shape[4])
+    align = ctx.attr("align_corners", True)
+    out = _interp_axis(x, od, 2, align, "linear")
+    out = _interp_axis(out, oh, 3, align, "linear")
+    out = _interp_axis(out, ow, 4, align, "linear")
+    ctx.set_out("Out", out)
+
+
+# --------------------------------------------------------------------------
+# misc
+# --------------------------------------------------------------------------
+@op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx):
+    """out[:, i] = x @ W[i] @ y^T + b (reference:
+    bilinear_tensor_product_op.cc)."""
+    x, y, w = ctx.in_("X"), ctx.in_("Y"), ctx.in_("Weight")
+    out = jnp.einsum("bm,omn,bn->bo", x, w, y)
+    if ctx.has_input("Bias"):
+        out = out + ctx.in_("Bias")
+    ctx.set_out("Out", out)
+
+
+@op("fsp")
+def _fsp(ctx):
+    """Flow-of-solution-procedure matrix for distillation (reference:
+    fsp_op.cc): out[n,i,j] = mean_hw x[n,i,h,w]*y[n,j,h,w]."""
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    n, cx, h, w = x.shape
+    ctx.set_out("Out", jnp.einsum("nihw,njhw->nij", x, y) / (h * w))
+
+
+@op("add_position_encoding")
+def _add_position_encoding(ctx):
+    """out = alpha*x + beta*sinusoid_pos_enc (reference:
+    add_position_encoding_op.cc)."""
+    x = ctx.in_("X")
+    alpha = ctx.attr("alpha", 1.0)
+    beta = ctx.attr("beta", 1.0)
+    b, t, c = x.shape
+    half = c // 2
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=x.dtype) / (half - 1))
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    ctx.set_out("Out", alpha * x + beta * enc[None, :, :c])
+
+
+@op("selu")
+def _selu(ctx):
+    x = ctx.in_("X")
+    scale = ctx.attr("scale", 1.0507009873554805)
+    alpha = ctx.attr("alpha", 1.6732632423543772)
+    ctx.set_out("Out", scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+
+
+@op("shard_index")
+def _shard_index(ctx):
+    """Map global ids to shard-local ids (reference: shard_index_op.cc)."""
+    x = ctx.in_("X")
+    index_num = ctx.attr("index_num", 1)
+    nshards = ctx.attr("nshards", 1)
+    shard_id = ctx.attr("shard_id", 0)
+    ignore_value = ctx.attr("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    ctx.set_out("Out", jnp.where(in_shard, x % shard_size, ignore_value))
+
+
+@op("hash", no_grad=True)
+def _hash(ctx):
+    """Hash int ids into [0, mod_by) num_hash times (reference:
+    hash_op.cc uses xxHash; we use a multiplicative mix — same contract:
+    deterministic, well-spread; exact hash values are not part of the
+    public API)."""
+    num_hash = ctx.attr("num_hash", 1)
+    mod_by = ctx.attr("mod_by", 1)
+    xi = ctx.in_("X").astype(jnp.uint32)
+    outs = []
+    for i in range(num_hash):
+        h = (xi * jnp.uint32(2654435761) + jnp.uint32((0x9E3779B9 * (i + 1)) & 0xFFFFFFFF))
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    out = jnp.stack(outs, axis=-2)  # (..., num_hash, last_dim)
+    ctx.set_out("Out", out)
+
+
+@op("sampling_id", no_grad=True, stateful=True)
+def _sampling_id(ctx):
+    """Sample column index per row from probability rows (reference:
+    sampling_id_op.cc)."""
+    x = ctx.in_("X")
+    ctx.set_out("Out", jax.random.categorical(ctx.rng(), jnp.log(jnp.clip(x, 1e-20, None)), axis=-1))
+
+
+@op("gaussian_random_batch_size_like", no_grad=True, stateful=True)
+def _gaussian_random_batch_size_like(ctx):
+    ref = ctx.in_("Input")
+    shape = list(ctx.attr("shape", []))
+    bidx = ctx.attr("input_dim_idx", 0)
+    oidx = ctx.attr("output_dim_idx", 0)
+    shape[oidx] = ref.shape[bidx]
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    ctx.set_out("Out", mean + std * jax.random.normal(ctx.rng(), tuple(shape)))
+
+
+@op("similarity_focus", no_grad=True)
+def _similarity_focus(ctx):
+    """Focus mask by per-(channel-slice) argmax (reference:
+    similarity_focus_op.cc): for each indicated channel, mark the
+    row/column of each maximal element until every row and column of the
+    (H, W) plane is covered."""
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", 1)
+    indexes = ctx.attr("indexes", [0])
+    n, c, h, w = x.shape
+    mask = jnp.zeros_like(x)
+    for idx in indexes:
+        plane = x[:, idx] if axis == 1 else x[:, :, idx]
+        # rank positions by value; greedily cover rows/cols: vectorized
+        # approximation of the reference's greedy loop — mark cells that
+        # are the max of their row OR their column
+        row_max = plane == plane.max(axis=-1, keepdims=True)
+        col_max = plane == plane.max(axis=-2, keepdims=True)
+        m = (row_max | col_max).astype(x.dtype)
+        if axis == 1:
+            mask = mask.at[:, idx].set(m)
+        else:
+            mask = mask.at[:, :, idx].set(m)
+    ctx.set_out("Out", mask)
+
+
+@op("unique_with_counts", no_grad=True, host=True)
+def _unique_with_counts(ctx):
+    x = np.asarray(ctx.in_("X"))
+    uniq, idx, counts = np.unique(x, return_inverse=True, return_counts=True)
+    ctx.set_out("Out", jnp.asarray(uniq))
+    ctx.set_out("Index", jnp.asarray(idx.astype(np.int32)))
+    ctx.set_out("Count", jnp.asarray(counts.astype(np.int32)))
+
+
+@op("get_tensor_from_selected_rows")
+def _get_tensor_from_selected_rows(ctx):
+    from ..framework.selected_rows import SelectedRows
+
+    v = ctx.env.get(ctx.op.inputs["X"][0])
+    if isinstance(v, SelectedRows):
+        ctx.set_out("Out", v.values)
+    else:
+        ctx.set_out("Out", v)
+
+
+@op("merge_selected_rows")
+def _merge_selected_rows(ctx):
+    from ..framework.selected_rows import SelectedRows
+
+    v = ctx.env.get(ctx.op.inputs["X"][0])
+    if isinstance(v, SelectedRows):
+        m = v.merge_rows()
+        ctx.env[ctx.op.outputs["Out"][0]] = m
+    else:
+        ctx.set_out("Out", v)

@@ -177,3 +177,49 @@ def _train_from_dataset(executor, program, dataset, scope, fetch_list,
             print(f"[train_from_dataset] step {step}: {msg}")
         step += 1
     return None
+
+
+class PyReader(DataLoader):
+    """reference: fluid/reader.py PyReader (layers/io.py py_reader shim).
+
+    Either pass feed_list (create_py_reader_by_data) or shapes+dtypes
+    (py_reader) — in the latter case data vars are created on the current
+    main program and exposed via ``.data_vars`` / read_file().
+    """
+
+    def __init__(self, capacity=64, shapes=None, dtypes=None, feed_list=None,
+                 use_double_buffer=True, iterable=True, return_list=False,
+                 name=None):
+        if feed_list is None and shapes is not None:
+            from .layers import data as data_layer
+            feed_list = [
+                data_layer(f"{name or 'py_reader'}_slot_{i}", list(s)[1:],
+                           dtype=dt, append_batch_size=True)
+                for i, (s, dt) in enumerate(zip(shapes, dtypes))
+            ]
+        super().__init__(feed_list, capacity, iterable, return_list,
+                         use_double_buffer)
+
+    # py_reader API names
+    def decorate_paddle_reader(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)
+
+    def decorate_tensor_provider(self, reader, places=None):
+        return self.set_batch_generator(reader, places)
+
+    def read_file(self):
+        """The program-feed vars this reader fills (layers/io.py
+        read_file analog under feed-based execution)."""
+        return list(self.feed_list)
+
+    def start(self):
+        return None
+
+    def reset(self):
+        return None

@@ -1,0 +1,522 @@
+"""Long-tail layers: torch-oracle parity + brute-force oracles + e2e smoke.
+
+Covers the vision batch (pixel_shuffle/unfold/lrn/maxout/affine_grid/
+deformable_conv), structured losses (warpctc vs torch.ctc_loss including
+grads, linear_chain_crf + viterbi vs brute-force enumeration, hsigmoid
+bit-code consistency), and the misc utility layers — the analog of the
+reference's per-layer unittests (test_layers.py, test_warpctc_op.py,
+test_linear_chain_crf_op.py, test_crf_decoding_op.py)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.optimizer as optim
+import paddle_tpu.layers as L
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.ops.registry import eager_call
+
+import jax
+import jax.numpy as jnp
+
+
+def run_prog(build, feeds):
+    prog, sprog = Program(), Program()
+    with program_guard(prog, sprog):
+        outs = build()
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(sprog)
+    return exe.run(prog, feed=feeds, fetch_list=[o.name for o in outs])
+
+
+# --------------------------------------------------------------------------
+# vision: torch oracles
+# --------------------------------------------------------------------------
+def test_vision_layers_torch_parity():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    x = np.random.RandomState(0).rand(2, 8, 4, 4).astype("float32")
+
+    def build():
+        xv = L.data("x", [8, 4, 4])
+        return (L.pixel_shuffle(xv, 2), L.unfold(xv, 2, 2), L.lrn(xv),
+                L.maxout(xv, 2), L.space_to_depth(xv, 2),
+                L.shuffle_channel(xv, 2))
+
+    ps, uf, lrn_o, mo, s2d, shuf = [np.asarray(v) for v in
+                                    run_prog(build, {"x": x})]
+    t = torch.tensor(x)
+    np.testing.assert_allclose(ps, F.pixel_shuffle(t, 2).numpy(), atol=1e-6)
+    np.testing.assert_allclose(uf, F.unfold(t, 2, stride=2).numpy(), atol=1e-5)
+    np.testing.assert_allclose(
+        lrn_o, F.local_response_norm(t, 5, alpha=5e-4, beta=0.75, k=1.0).numpy(),
+        atol=1e-5)
+    np.testing.assert_allclose(mo, t.view(2, 4, 2, 4, 4).max(2).values.numpy(),
+                               atol=1e-6)
+    # channel shuffle: (g, C/g) -> (C/g, g)
+    ref_shuf = x.reshape(2, 2, 4, 4, 4).transpose(0, 2, 1, 3, 4).reshape(2, 8, 4, 4)
+    np.testing.assert_allclose(shuf, ref_shuf, atol=1e-6)
+    # space_to_depth inverse property: depth_to_space(space_to_depth(x)) == x
+    b = 2
+    inv = s2d.reshape(2, b, b, 8 // 1, 0 + 2, 2)  # n, dh, dw, c, h/b, w/b
+    inv = inv.transpose(0, 3, 4, 1, 5, 2).reshape(2, 8, 4, 4)
+    np.testing.assert_allclose(inv, x, atol=1e-6)
+
+
+def test_affine_grid_torch_parity():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    theta = np.random.RandomState(1).randn(2, 2, 3).astype("float32")
+    for align in (True, False):
+        out = eager_call("affine_grid", {"Theta": [jnp.asarray(theta)]},
+                         {"output_shape": [2, 3, 5, 6], "align_corners": align},
+                         {"Output": 1})["Output"][0]
+        ref = F.affine_grid(torch.tensor(theta), (2, 3, 5, 6),
+                            align_corners=align).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """With zero offsets and unit mask, DCN must equal plain conv2d."""
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 4, 6, 6).astype("float32")
+    w = rng.rand(5, 4, 3, 3).astype("float32")
+    off = np.zeros((2, 2 * 1 * 9, 6, 6), "float32")
+    mask = np.ones((2, 9, 6, 6), "float32")
+    out = eager_call("deformable_conv",
+                     {"Input": [jnp.asarray(x)], "Offset": [jnp.asarray(off)],
+                      "Mask": [jnp.asarray(mask)], "Filter": [jnp.asarray(w)]},
+                     {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+                      "groups": 1, "deformable_groups": 1},
+                     {"Output": 1})["Output"][0]
+    ref = eager_call("conv2d",
+                     {"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]},
+                     {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+                      "groups": 1}, {"Output": 1})["Output"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_deformable_conv_torchvision_parity():
+    torchvision = pytest.importorskip("torchvision")
+    import torch
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 4, 5, 5).astype("float32")
+    w = rng.rand(6, 4, 3, 3).astype("float32")
+    off = (rng.rand(2, 18, 5, 5).astype("float32") - 0.5) * 2
+    mask = rng.rand(2, 9, 5, 5).astype("float32")
+    out = eager_call("deformable_conv",
+                     {"Input": [jnp.asarray(x)], "Offset": [jnp.asarray(off)],
+                      "Mask": [jnp.asarray(mask)], "Filter": [jnp.asarray(w)]},
+                     {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+                      "groups": 1, "deformable_groups": 1},
+                     {"Output": 1})["Output"][0]
+    ref = torchvision.ops.deform_conv2d(
+        torch.tensor(x), torch.tensor(off), torch.tensor(w), padding=1,
+        mask=torch.tensor(mask)).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+def test_spectral_norm_property():
+    """After enough power iterations the output's largest singular value
+    is 1 (reference: spectral_norm_op.cc semantics)."""
+    w = np.random.RandomState(4).randn(6, 4).astype("float32")
+    u = np.random.RandomState(5).randn(6).astype("float32")
+    v = np.random.RandomState(6).randn(4).astype("float32")
+    out = eager_call("spectral_norm",
+                     {"Weight": [jnp.asarray(w)], "U": [jnp.asarray(u)],
+                      "V": [jnp.asarray(v)]},
+                     {"dim": 0, "power_iters": 50, "eps": 1e-12},
+                     {"Out": 1, "UOut": 1, "VOut": 1})["Out"][0]
+    s = np.linalg.svd(np.asarray(out), compute_uv=False)
+    assert abs(s[0] - 1.0) < 1e-4
+
+
+# --------------------------------------------------------------------------
+# CTC / CRF oracles
+# --------------------------------------------------------------------------
+def test_warpctc_torch_parity_and_grad():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    T, B, C, Lm = 12, 4, 6, 5
+    logits = rng.randn(T, B, C).astype("float32")
+    logit_lens = np.array([12, 9, 7, 12], np.int64)
+    label_lens = np.array([5, 3, 1, 4], np.int64)
+    labels = rng.randint(1, C, (B, Lm)).astype(np.int64)
+
+    def fwd(lg):
+        return eager_call("warpctc",
+                          {"Logits": [lg], "Label": [jnp.asarray(labels)],
+                           "LogitsLength": [jnp.asarray(logit_lens)],
+                           "LabelLength": [jnp.asarray(label_lens)]},
+                          {"blank": 0}, {"Loss": 1, "WarpCTCGrad": 1})
+
+    mine = np.asarray(fwd(jnp.asarray(logits))["Loss"][0]).ravel()
+    lp = F.log_softmax(torch.tensor(logits), dim=-1)
+    ref = F.ctc_loss(lp, torch.tensor(labels), torch.tensor(logit_lens),
+                     torch.tensor(label_lens), blank=0,
+                     reduction="none").numpy()
+    np.testing.assert_allclose(mine, ref, atol=1e-3, rtol=1e-4)
+
+    g = jax.grad(lambda lg: fwd(lg)["Loss"][0].sum())(jnp.asarray(logits))
+    lt = torch.tensor(logits, requires_grad=True)
+    F.ctc_loss(F.log_softmax(lt, -1), torch.tensor(labels),
+               torch.tensor(logit_lens), torch.tensor(label_lens), blank=0,
+               reduction="sum").backward()
+    np.testing.assert_allclose(np.asarray(g), lt.grad.numpy(), atol=1e-4)
+
+
+def test_linear_chain_crf_brute_force():
+    rng = np.random.RandomState(0)
+    B, T, D = 3, 4, 3
+    em = rng.randn(B, T, D).astype("float32")
+    trans = rng.randn(D + 2, D).astype("float32")
+    lens = np.array([4, 2, 3], np.int64)
+    lbl = rng.randint(0, D, (B, T)).astype(np.int64)
+    out = eager_call("linear_chain_crf",
+                     {"Emission": [jnp.asarray(em)],
+                      "Transition": [jnp.asarray(trans)],
+                      "Label": [jnp.asarray(lbl)], "Length": [jnp.asarray(lens)]},
+                     {}, {"LogLikelihood": 1, "Alpha": 1, "EmissionExps": 1,
+                          "TransitionExps": 1})
+    mine = np.asarray(out["LogLikelihood"][0]).ravel()
+
+    ws, we, tr = trans[0], trans[1], trans[2:]
+
+    def score(i, p, Ti):
+        s = ws[p[0]] + em[i, 0, p[0]] + we[p[-1]]
+        for k in range(1, Ti):
+            s += em[i, k, p[k]] + tr[p[k - 1], p[k]]
+        return s
+
+    for i in range(B):
+        Ti = int(lens[i])
+        logz = np.log(sum(np.exp(score(i, p, Ti))
+                          for p in itertools.product(range(D), repeat=Ti)))
+        ref = logz - score(i, tuple(lbl[i, :Ti]), Ti)
+        assert abs(mine[i] - ref) < 1e-4
+
+    # viterbi agrees with brute-force argmax
+    vp = np.asarray(eager_call(
+        "crf_decoding",
+        {"Emission": [jnp.asarray(em)], "Transition": [jnp.asarray(trans)],
+         "Length": [jnp.asarray(lens)]}, {}, {"ViterbiPath": 1})["ViterbiPath"][0])
+    for i in range(B):
+        Ti = int(lens[i])
+        best = max(itertools.product(range(D), repeat=Ti),
+                   key=lambda p: score(i, p, Ti))
+        assert vp[i, :Ti].tolist() == list(best)
+
+
+def test_ctc_align():
+    x = np.array([[1, 1, 0, 2, 2, 0, 3], [0, 0, 0, 1, 0, 1, 1]], np.int64)
+    lens = np.array([7, 7], np.int64)
+    out = eager_call("ctc_align",
+                     {"Input": [jnp.asarray(x)], "InputLength": [jnp.asarray(lens)]},
+                     {"blank": 0, "padding_value": 0},
+                     {"Output": 1, "OutputLength": 1})
+    o = np.asarray(out["Output"][0])
+    ol = np.asarray(out["OutputLength"][0]).ravel()
+    assert o[0, :3].tolist() == [1, 2, 3] and ol[0] == 3
+    assert o[1, :2].tolist() == [1, 1] and ol[1] == 2
+
+
+def test_gather_tree():
+    # torch.gather_tree-style backtrack oracle, tiny hand case
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)      # T=3,B=1,W=2
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+    out = np.asarray(eager_call("gather_tree",
+                                {"Ids": [jnp.asarray(ids)],
+                                 "Parents": [jnp.asarray(parents)]},
+                                {}, {"Out": 1})["Out"][0])
+    # beam 0 at t2: id 5, parent 0 -> t1 id from beam 0 = 3, its parent 1 -> t0 id 2
+    assert out[:, 0, 0].tolist() == [2, 3, 5]
+    # beam 1 at t2: id 6, parent 1 -> t1 id 4, parent 0 -> t0 id 1
+    assert out[:, 0, 1].tolist() == [1, 4, 6]
+
+
+# --------------------------------------------------------------------------
+# loss layers e2e through executor (shapes + gradients flow)
+# --------------------------------------------------------------------------
+def test_structured_loss_layers_train_step():
+    rng = np.random.RandomState(0)
+
+    def build():
+        x = L.data("xf", [6], stop_gradient=False)
+        lbl = L.data("lbl", [1], dtype="int64")
+        cost = L.bpr_loss(x, lbl)
+        h = L.hsigmoid(x, lbl, 8)
+        n = L.nce(x, lbl, 12, num_neg_samples=3)
+        loss = L.reduce_mean(cost) + L.reduce_mean(h) + L.reduce_mean(n)
+        opt = optim.SGDOptimizer(learning_rate=0.1)
+        opt.minimize(loss)
+        return loss
+
+    feeds = {"xf": rng.rand(5, 6).astype("float32"),
+             "lbl": rng.randint(0, 4, (5, 1)).astype("int64")}
+    prog, sprog = Program(), Program()
+    with program_guard(prog, sprog):
+        loss = build()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(sprog)
+    l0 = float(np.asarray(exe.run(prog, feed=feeds, fetch_list=[loss.name])[0]))
+    for _ in range(5):
+        l1 = float(np.asarray(exe.run(prog, feed=feeds, fetch_list=[loss.name])[0]))
+    assert np.isfinite(l0) and l1 < l0  # losses decrease under SGD
+
+
+def test_crf_layer_train_and_decode():
+    rng = np.random.RandomState(0)
+    B, T, D = 4, 5, 3
+
+    def build():
+        em = L.data("em", [T, D], stop_gradient=False)
+        lbl = L.data("lblc", [T], dtype="int64")
+        ln = L.data("ln", [], dtype="int64", append_batch_size=True)
+        ll = L.linear_chain_crf(em, lbl, param_attr=pt.param_attr.ParamAttr(name="crf_w"),
+                                length=ln)
+        loss = L.reduce_mean(ll)
+        optim.SGDOptimizer(learning_rate=0.05).minimize(loss)
+        return loss
+
+    feeds = {"em": rng.randn(B, T, D).astype("float32"),
+             "lblc": rng.randint(0, D, (B, T)).astype("int64"),
+             "ln": np.array([5, 3, 4, 5], "int64")}
+    prog, sprog = Program(), Program()
+    with program_guard(prog, sprog):
+        loss = build()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(sprog)
+    losses = [float(np.asarray(exe.run(prog, feed=feeds,
+                                       fetch_list=[loss.name])[0]))
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.9  # CRF NLL decreases
+
+
+def test_misc_utility_layers():
+    def build():
+        x = L.data("x", [4, 3])
+        m = L.multiplex([L.data("a", [3]), L.data("b", [3])],
+                        L.data("ids", [1], dtype="int32"))
+        parts = L.unbind(L.data("u", [2, 3], append_batch_size=False), axis=0)
+        sh = L.shard_index(L.data("si", [1], dtype="int64"), 20, 2, 0)
+        hs = L.hash(L.data("hi", [1], dtype="int64"), 100, num_hash=2)
+        r = L.rank(x)
+        s = L.size(x)
+        e = L.is_empty(x)
+        return m, parts[0], sh, hs, r, s, e
+
+    rng = np.random.RandomState(0)
+    r = run_prog(build, {
+        "x": rng.rand(2, 4, 3).astype("float32"),
+        "a": rng.rand(2, 3).astype("float32"),
+        "b": rng.rand(2, 3).astype("float32"),
+        "ids": np.array([[1], [0]], "int32"),
+        "u": rng.rand(2, 3).astype("float32"),
+        "si": np.array([[3], [13]], "int64"),
+        "hi": np.array([[7], [9]], "int64"),
+    })
+    assert np.asarray(r[0]).shape == (2, 3)
+    assert np.asarray(r[2]).ravel().tolist() == [3, -1]  # 13 is shard 1
+    assert np.asarray(r[4]).ravel()[0] == 3
+    assert np.asarray(r[5]).ravel()[0] == 24
+    assert not bool(np.asarray(r[6]).ravel()[0])
+
+
+def test_edit_distance_and_chunk_eval():
+    hyp = np.array([[1, 2, 3, 0], [1, 1, 1, 1]], np.int64)
+    ref = np.array([[1, 3, 3, 0], [2, 2, 2, 2]], np.int64)
+    out = eager_call("edit_distance",
+                     {"Hyps": [jnp.asarray(hyp)], "Refs": [jnp.asarray(ref)]},
+                     {"normalized": False}, {"Out": 1, "SequenceNum": 1})
+    assert np.asarray(out["Out"][0]).ravel().tolist() == [1.0, 4.0]
+
+    # IOB scheme, 1 chunk type: tags B=0, I=1, O=2
+    inf = np.array([[0, 1, 2, 0]], np.int64)
+    lbl = np.array([[0, 1, 2, 0]], np.int64)
+    ce = eager_call("chunk_eval",
+                    {"Inference": [jnp.asarray(inf)], "Label": [jnp.asarray(lbl)]},
+                    {"num_chunk_types": 1, "chunk_scheme": "IOB"},
+                    {"Precision": 1, "Recall": 1, "F1-Score": 1,
+                     "NumInferChunks": 1, "NumLabelChunks": 1,
+                     "NumCorrectChunks": 1})
+    assert float(np.asarray(ce["Precision"][0])) == 1.0
+    assert float(np.asarray(ce["F1-Score"][0])) == 1.0
+
+
+def test_dynamic_lstmp_shapes_and_masking():
+    rng = np.random.RandomState(0)
+    B, T, H, P = 3, 6, 4, 2
+
+    def build():
+        x = L.data("xl", [T, 4 * H], stop_gradient=False)
+        ln = L.data("lnl", [], dtype="int64")
+        proj, cell = L.dynamic_lstmp(x, 4 * H, P, length=ln)
+        return proj, cell
+
+    r = run_prog(build, {"xl": rng.randn(B, T, 4 * H).astype("float32"),
+                         "lnl": np.array([6, 3, 1], "int64")})
+    proj, cell = np.asarray(r[0]), np.asarray(r[1])
+    assert proj.shape == (B, T, P) and cell.shape == (B, T, H)
+    assert np.all(proj[1, 3:] == 0) and np.all(proj[2, 1:] == 0)  # masked
+
+
+def test_batch2_utility_ops():
+    """cvm / sequence_scatter / reorder_lod_tensor_by_rank / lstm_unit /
+    gru_unit layer coverage."""
+    rng = np.random.RandomState(0)
+
+    # cvm numpy oracle (reference: cvm_op.h)
+    x = rng.rand(4, 6).astype("float32") + 0.1
+    y = np.asarray(eager_call("cvm", {"X": [jnp.asarray(x)], "CVM": [jnp.asarray(x[:, :2])]},
+                              {"use_cvm": True}, {"Y": 1})["Y"][0])
+    c0 = np.log(x[:, :1] + 1)
+    np.testing.assert_allclose(y[:, :1], c0, atol=1e-5)
+    np.testing.assert_allclose(y[:, 1:2], np.log(x[:, 1:2] + 1) - c0, atol=1e-5)
+    np.testing.assert_allclose(y[:, 2:], x[:, 2:], atol=1e-6)
+    y2 = np.asarray(eager_call("cvm", {"X": [jnp.asarray(x)], "CVM": [jnp.asarray(x[:, :2])]},
+                               {"use_cvm": False}, {"Y": 1})["Y"][0])
+    assert y2.shape == (4, 4)
+
+    # sequence_scatter oracle
+    xs = np.zeros((2, 5), np.float32)
+    ids = np.array([[1, 3, 0], [2, 2, 4]], np.int64)
+    upd = np.ones((2, 3), np.float32)
+    lens = np.array([2, 3], np.int64)
+    out = np.asarray(eager_call("sequence_scatter",
+                                {"X": [jnp.asarray(xs)], "Ids": [jnp.asarray(ids)],
+                                 "Updates": [jnp.asarray(upd)],
+                                 "IdsLength": [jnp.asarray(lens)]},
+                                {}, {"Out": 1})["Out"][0])
+    assert out[0].tolist() == [0, 1, 0, 1, 0]       # only first 2 ids used
+    assert out[1].tolist() == [0, 0, 2, 0, 1]       # duplicate id accumulates
+
+    # reorder by rank: stable sort by descending length
+    x3 = np.arange(8, dtype=np.float32).reshape(4, 2)
+    lens3 = np.array([2, 5, 5, 1], np.int64)
+    out3 = np.asarray(eager_call("reorder_lod_tensor_by_rank",
+                                 {"X": [jnp.asarray(x3)], "RankTable": [jnp.asarray(lens3)]},
+                                 {}, {"Out": 1})["Out"][0])
+    assert out3[:, 0].tolist() == [2.0, 4.0, 0.0, 6.0]
+
+    # lstm_unit / gru_unit layers build + run
+    def build():
+        xv = L.data("xu", [4], stop_gradient=False)
+        h0 = L.data("h0", [3])
+        c0 = L.data("c0", [3])
+        h, c = L.lstm_unit(xv, h0, c0)
+        gh, _, _ = L.gru_unit(L.data("gx", [9]), L.data("gh0", [3]), 9)
+        return h, c, gh
+
+    r = run_prog(build, {"xu": rng.rand(2, 4).astype("float32"),
+                         "h0": rng.rand(2, 3).astype("float32"),
+                         "c0": rng.rand(2, 3).astype("float32"),
+                         "gx": rng.rand(2, 9).astype("float32"),
+                         "gh0": rng.rand(2, 3).astype("float32")})
+    assert np.asarray(r[0]).shape == (2, 3) and np.asarray(r[2]).shape == (2, 3)
+
+
+def test_py_func_and_print():
+    def my_fn(a):
+        return a * 2.0
+
+    def build():
+        x = L.data("xp", [3])
+        helper_out = pt.layers.create_tensor("float32") if False else None
+        from paddle_tpu.layer_helper import LayerHelper
+        h = LayerHelper("py_func_out")
+        out = h.create_variable_for_type_inference(x.dtype)
+        res = L.py_func(my_fn, x, out)
+        p = L.Print(res, message="dbg")
+        return p
+
+    x = np.random.rand(2, 3).astype("float32")
+    r = run_prog(build, {"xp": x})
+    np.testing.assert_allclose(np.asarray(r[0]), x * 2.0, atol=1e-6)
+
+
+def test_filter_by_instag_and_unique_with_counts():
+    # match case: rows 0 and 2 carry tag 7
+    ins = np.arange(12, dtype=np.float32).reshape(3, 4)
+    tags = np.array([[7, 0], [3, 0], [7, 3]], np.int64)
+    out = eager_call("filter_by_instag",
+                     {"Ins": [jnp.asarray(ins)], "Ins_tag": [jnp.asarray(tags)],
+                      "Filter_tag": [jnp.asarray(np.array([7], np.int64))]},
+                     {"is_lod": False},
+                     {"Out": 1, "LossWeight": 1, "IndexMap": 1})
+    assert np.asarray(out["Out"][0]).shape == (2, 4)
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), ins[[0, 2]])
+    assert np.asarray(out["LossWeight"][0]).ravel().tolist() == [1.0, 1.0]
+
+    # empty-match case: one dummy zero row with ZERO loss weight
+    out2 = eager_call("filter_by_instag",
+                      {"Ins": [jnp.asarray(ins)], "Ins_tag": [jnp.asarray(tags)],
+                       "Filter_tag": [jnp.asarray(np.array([99], np.int64))]},
+                      {"is_lod": False},
+                      {"Out": 1, "LossWeight": 1, "IndexMap": 1})
+    assert np.allclose(np.asarray(out2["Out"][0]), 0.0)
+    assert np.asarray(out2["LossWeight"][0]).ravel().tolist() == [0.0]
+
+    # unique_with_counts numpy oracle
+    x = np.array([5, 2, 5, 5, 2, 9], np.int64)
+    u = eager_call("unique_with_counts", {"X": [jnp.asarray(x)]}, {},
+                   {"Out": 1, "Index": 1, "Count": 1})
+    uniq = np.asarray(u["Out"][0])
+    idx = np.asarray(u["Index"][0])
+    cnt = np.asarray(u["Count"][0])
+    assert uniq.tolist() == [2, 5, 9]
+    assert cnt.tolist() == [2, 3, 1]
+    np.testing.assert_array_equal(uniq[idx], x)
+
+
+def test_cvm_grad_passthrough():
+    """Reference cvm_grad copies dY into dX (no log-chain rule) — verify
+    through append_backward."""
+    def build():
+        x = L.data("xc", [6], stop_gradient=False)
+        cvm_in = L.data("cv", [2])
+        y = L.continuous_value_model(x, cvm_in, use_cvm=True)
+        loss = L.reduce_sum(y, dim=[0, 1])
+        pt.append_backward(loss)
+        return loss
+
+    prog, sprog = Program(), Program()
+    with program_guard(prog, sprog):
+        build()
+    exe = pt.Executor(pt.CPUPlace())
+    xv = np.random.rand(3, 6).astype("float32") + 0.5
+    g = np.asarray(exe.run(prog, feed={"xc": xv, "cv": xv[:, :2]},
+                           fetch_list=["xc@GRAD"])[0])
+    # dY = ones -> dX must be all ones (pass-through), NOT 1/(x+1) scaled
+    np.testing.assert_allclose(g, np.ones_like(xv), atol=1e-6)
+
+
+def test_dynamic_lstmp_peepholes():
+    """Peephole LSTMP differs from peephole-free and respects clips."""
+    rng = np.random.RandomState(0)
+    B, T, H, P = 2, 4, 3, 2
+    x = rng.randn(B, T, 4 * H).astype("float32")
+    w = rng.randn(P, 4 * H).astype("float32")
+    wp = rng.randn(H, P).astype("float32")
+    b7 = rng.randn(1, 7 * H).astype("float32")
+
+    def run(use_peep, cell_clip=0.0):
+        return np.asarray(eager_call(
+            "dynamic_lstmp",
+            {"Input": [jnp.asarray(x)], "Weight": [jnp.asarray(w)],
+             "ProjWeight": [jnp.asarray(wp)], "Bias": [jnp.asarray(b7)]},
+            {"use_peepholes": use_peep, "cell_clip": cell_clip,
+             "proj_activation": "tanh"},
+            {"Projection": 1, "Cell": 1, "LastH": 1, "LastC": 1})["Cell"][0])
+
+    c_peep = run(True)
+    c_plain = run(False)
+    assert np.abs(c_peep - c_plain).max() > 1e-4  # peepholes change the math
+    c_clip = run(True, cell_clip=0.05)
+    assert np.abs(c_clip).max() <= 0.05 + 1e-6

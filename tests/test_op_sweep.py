@@ -594,6 +594,162 @@ SPECS["lamb"] = S({"Param": _p, "Grad": _g, "Moment1": _m1, "Moment2": _m2,
                   outs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"))
 
 
+# vision / misc long-tail ops (ops/vision_ops.py)
+SPECS["pixel_shuffle"] = S({"X": f32(2, 8, 3, 3)}, {"upscale_factor": 2},
+                           ref=lambda ins, a: {"Out": ins["X"].reshape(2, 2, 2, 2, 3, 3)
+                                               .transpose(0, 1, 4, 2, 5, 3).reshape(2, 2, 6, 6)},
+                           grad=["X"])
+SPECS["affine_channel"] = S({"X": f32(2, 3, 4, 4), "Scale": f32(3), "Bias": f32(3)},
+                            ref=lambda ins, a: {"Out": ins["X"] * ins["Scale"][None, :, None, None]
+                                                + ins["Bias"][None, :, None, None]},
+                            grad=["X"], atol=1e-5)
+SPECS["shuffle_channel"] = S({"X": f32(2, 6, 3, 3)}, {"group": 2},
+                             ref=lambda ins, a: {"Out": ins["X"].reshape(2, 2, 3, 3, 3)
+                                                 .transpose(0, 2, 1, 3, 4).reshape(2, 6, 3, 3)})
+SPECS["space_to_depth"] = S({"X": f32(2, 3, 4, 4)}, {"blocksize": 2},
+                            ref=lambda ins, a: {"Out": ins["X"].reshape(2, 3, 2, 2, 2, 2)
+                                                .transpose(0, 3, 5, 1, 2, 4).reshape(2, 12, 2, 2)})
+SPECS["maxout"] = S({"X": f32(2, 6, 3, 3)}, {"groups": 2, "axis": 1},
+                    ref=lambda ins, a: {"Out": ins["X"].reshape(2, 3, 2, 3, 3).max(2)})
+SPECS["selu"] = S({"X": fn32(3, 4)}, {},
+                  ref=lambda ins, a: {"Out": 1.0507009873554805 * np.where(
+                      ins["X"] > 0, ins["X"], 1.6732632423543772 * np.expm1(ins["X"]))},
+                  grad=["X"], atol=1e-4)
+SPECS["crop"] = S({"X": f32(4, 5)}, {"shape": [2, 3], "offsets": [1, 1]},
+                  ref=lambda ins, a: {"Out": ins["X"][1:3, 1:4]})
+SPECS["crop_tensor"] = S({"X": f32(4, 5)}, {"shape": [2, 3], "offsets": [1, 1]},
+                         ref=lambda ins, a: {"Out": ins["X"][1:3, 1:4]})
+SPECS["pad_constant_like"] = S({"X": f32(4, 5), "Y": f32(2, 3)}, {"pad_value": 1.5},
+                               ref=lambda ins, a: {"Out": np.pad(ins["Y"], ((0, 2), (0, 2)),
+                                                                 constant_values=1.5)})
+SPECS["multiplex"] = S({"X": [("mxa", f32(3, 4)), ("mxb", f32(3, 4))],
+                        "Ids": np.array([[1], [0], [1]], np.int32)},
+                       ref=lambda ins, a: {"Out": np.stack([ins["X"][1][0], ins["X"][0][1],
+                                                            ins["X"][1][2]])})
+SPECS["unbind"] = S({"X": f32(2, 3, 4)}, {"axis": 0}, outs=(("Out", 2),),
+                    ref=lambda ins, a: {"Out": [ins["X"][0], ins["X"][1]]})
+SPECS["shard_index"] = S({"X": np.array([[3], [13], [7]], np.int64)},
+                         {"index_num": 20, "nshards": 2, "shard_id": 0,
+                          "ignore_value": -1},
+                         ref=lambda ins, a: {"Out": np.array([[3], [-1], [7]], np.int64)})
+SPECS["bilinear_tensor_product"] = S({"X": f32(3, 4), "Y": f32(3, 5),
+                                      "Weight": f32(2, 4, 5)},
+                                     ref=lambda ins, a: {"Out": np.einsum(
+                                         "bm,omn,bn->bo", ins["X"], ins["Weight"], ins["Y"])},
+                                     atol=1e-4, rtol=1e-4)
+SPECS["fsp"] = S({"X": f32(2, 3, 4, 4), "Y": f32(2, 5, 4, 4)},
+                 ref=lambda ins, a: {"Out": np.einsum("nihw,njhw->nij", ins["X"],
+                                                      ins["Y"]) / 16.0},
+                 atol=1e-4, rtol=1e-4)
+SPECS["add_position_encoding"] = S({"X": f32(2, 5, 8)}, {"alpha": 1.0, "beta": 1.0},
+                                   atol=1e-4)
+SPECS["lrn"] = S({"X": f32(2, 6, 3, 3)}, {"n": 5, "k": 1.0, "alpha": 1e-4, "beta": 0.75},
+                 outs=("Out", "MidOut"), no_check=("MidOut",), atol=1e-4)
+SPECS["unfold"] = S({"X": f32(2, 3, 6, 6)},
+                    {"kernel_sizes": [2, 2], "strides": [2, 2],
+                     "paddings": [0, 0, 0, 0], "dilations": [1, 1]},
+                    outs=("Y",), atol=1e-5)
+SPECS["pool3d"] = S({"X": f32(1, 2, 4, 4, 4)},
+                    {"pooling_type": "avg", "ksize": [2, 2, 2], "strides": [2, 2, 2],
+                     "paddings": [0, 0, 0]},
+                    ref=lambda ins, a: {"Out": ins["X"].reshape(1, 2, 2, 2, 2, 2, 2, 2)
+                                        .mean(axis=(3, 5, 7))},
+                    atol=1e-5)
+SPECS["adaptive_pool3d"] = S({"X": f32(1, 2, 4, 4, 4)},
+                             {"pooling_type": "max", "ksize": [2, 2, 2]},
+                             ref=lambda ins, a: {"Out": ins["X"].reshape(1, 2, 2, 2, 2, 2, 2, 2)
+                                                 .max(axis=(3, 5, 7))})
+SPECS["conv3d_transpose"] = S({"Input": f32(1, 2, 3, 3, 3), "Filter": f32(2, 3, 2, 2, 2)},
+                              {"strides": [2, 2, 2], "paddings": [0, 0, 0],
+                               "dilations": [1, 1, 1], "groups": 1},
+                              outs=("Output",), atol=1e-4)
+SPECS["linear_interp"] = S({"X": f32(2, 3, 4)}, {"out_w": 8, "align_corners": True},
+                           atol=1e-5)
+SPECS["trilinear_interp"] = S({"X": f32(1, 2, 3, 3, 3)},
+                              {"out_d": 6, "out_h": 6, "out_w": 6, "align_corners": True},
+                              atol=1e-5)
+SPECS["is_empty"] = S({"X": f32(2, 3)}, ref=lambda ins, a: {"Out": np.asarray(False)})
+for _name, _f in [("isinf", lambda x: np.asarray(np.isinf(x).any())),
+                  ("isnan", lambda x: np.asarray(np.isnan(x).any()))]:
+    xx = fn32(3, 4)
+    xx[0, 0] = np.inf if _name == "isinf" else np.nan
+    SPECS[_name] = S({"X": xx}, ref=lambda ins, a, f=_f: {"Out": f(ins["X"])})
+
+# structured losses with closed-form numpy refs
+SPECS["bpr_loss"] = S({"X": fn32(4, 5), "Label": RNG.randint(0, 5, (4, 1)).astype(np.int64)},
+                      ref=lambda ins, a: {"Out": _bpr_ref(ins)}, grad=["X"], atol=1e-4)
+SPECS["margin_rank_loss"] = S({"X1": fn32(4, 1), "X2": fn32(4, 1),
+                               "Label": np.where(RNG.rand(4, 1) > 0.5, 1.0, -1.0).astype(np.float32)},
+                              {"margin": 0.1},
+                              outs=("Out", "Activated"), no_check=("Activated",),
+                              ref=lambda ins, a: {"Out": np.maximum(
+                                  0, -ins["Label"] * (ins["X1"] - ins["X2"]) + 0.1)})
+SPECS["teacher_student_sigmoid_loss"] = S(
+    {"X": fn32(4, 1), "Label": np.array([[-2.0], [-1.0], [0.3], [1.7]], np.float32)},
+    outs=("Y",),
+    ref=lambda ins, a: {"Y": _tss_ref(ins)}, atol=1e-5)
+SPECS["sigmoid_focal_loss"] = S(
+    {"X": fn32(4, 3), "Label": np.array([[1], [0], [3], [2]], np.int32),
+     "FgNum": np.array([3], np.int32)},
+    {"gamma": 2.0, "alpha": 0.25}, atol=1e-4)
+SPECS["center_loss"] = S(
+    {"X": f32(4, 3), "Label": RNG.randint(0, 5, (4, 1)).astype(np.int64),
+     "Centers": f32(5, 3), "CenterUpdateRate": np.array([0.1], np.float32)},
+    {"need_update": True},
+    outs=("Loss", "SampleCenterDiff", "CentersOut"),
+    no_check=("SampleCenterDiff", "CentersOut"),
+    ref=lambda ins, a: {"Loss": 0.5 * np.square(
+        ins["X"] - ins["Centers"][ins["Label"].ravel()]).sum(1, keepdims=True)},
+    atol=1e-4)
+SPECS["hierarchical_sigmoid"] = S(
+    {"X": f32(4, 3), "W": f32(7, 3), "Label": RNG.randint(0, 8, (4, 1)).astype(np.int64)},
+    {"num_classes": 8},
+    outs=("Out", "PreOut"), no_check=("PreOut",),
+    ref=lambda ins, a: {"Out": _hsig_ref(ins)}, grad=["X", "W"], atol=1e-4)
+
+
+def _bpr_ref(ins):
+    x, lbl = ins["X"], ins["Label"].ravel()
+    b, c = x.shape
+    out = np.zeros((b, 1), np.float32)
+    for i in range(b):
+        pos = x[i, lbl[i]]
+        s = 0.0
+        for j in range(c):
+            if j != lbl[i]:
+                s += np.log(1 / (1 + np.exp(-(pos - x[i, j]))))
+        out[i, 0] = -s / (c - 1)
+    return out
+
+
+def _tss_ref(ins):
+    x, lbl = ins["X"].ravel(), ins["Label"].ravel()
+    sp = np.logaddexp(0, x)
+    out = np.where(lbl < -1.0, sp,
+                   np.where(lbl < 0.0, sp - x,
+                            np.where(lbl < 1.0, sp + sp - x * lbl,
+                                     (sp - x) + sp - x * (lbl - 1.0))))
+    return out.reshape(ins["X"].shape)
+
+
+def _hsig_ref(ins):
+    """Bit-code hsigmoid oracle straight from matrix_bit_code.h SimpleCode."""
+    x, w, lbl = ins["X"], ins["W"], ins["Label"].ravel()
+    n_cls = 8
+    out = np.zeros((x.shape[0], 1), np.float32)
+    for i in range(x.shape[0]):
+        code = int(lbl[i]) + n_cls
+        length = code.bit_length() - 1
+        s = 0.0
+        for j in range(length):
+            node = (code >> (j + 1)) - 1
+            bit = (code >> j) & 1
+            pre = float(x[i] @ w[node])
+            s += np.logaddexp(0, pre) - bit * pre
+        out[i, 0] = s
+    return out
+
+
 # --------------------------------------------------------------------------
 # NumPy reference helpers
 # --------------------------------------------------------------------------
@@ -746,6 +902,30 @@ COVERED_ELSEWHERE = {
     # executor plumbing / host side-effects — tests/test_profiler_debug.py etc.
     "print": "test_profiler_debug", "memcpy": "test_inference",
     "share_data": "test_inference", "assign": "covered-in-sweep",
+    # long-tail ops with oracle tests — tests/test_layers_tail.py
+    "deformable_conv": "test_layers_tail", "deformable_conv_v1": "test_layers_tail",
+    "deformable_roi_pooling": "test_layers_tail(smoke via layer)",
+    "spectral_norm": "test_layers_tail", "affine_grid": "test_layers_tail",
+    "grid_sampler": "test_op_sweep(torch parity fn)",
+    "warpctc": "test_layers_tail", "linear_chain_crf": "test_layers_tail",
+    "crf_decoding": "test_layers_tail", "ctc_align": "test_layers_tail",
+    "gather_tree": "test_layers_tail", "edit_distance": "test_layers_tail",
+    "chunk_eval": "test_layers_tail", "dynamic_lstmp": "test_layers_tail",
+    "nce": "test_layers_tail(rng loss: train-step test)",
+    "sampled_softmax_with_cross_entropy": "test_layers_tail(rng loss)",
+    "data_norm": "test_layers_tail(layer smoke)",
+    "random_crop": "rng: shape-checked via layer",
+    "sampling_id": "rng", "gaussian_random_batch_size_like": "rng",
+    "similarity_focus": "vectorized-approx, layer smoke",
+    "hash": "deterministic-spread, layer smoke in test_layers_tail",
+    "unique_with_counts": "host dynamic shape, test_layers_tail",
+    "get_tensor_from_selected_rows": "test_selected_rows machinery",
+    "merge_selected_rows": "test_selected_rows machinery",
+    "is_empty": "covered-in-sweep", "assert_op": "host side-effect",
+    "py_func": "test_layers_tail",
+    "sequence_scatter": "test_layers_tail", "cvm": "test_layers_tail",
+    "filter_by_instag": "host dynamic shape, test_layers_tail",
+    "reorder_lod_tensor_by_rank": "test_layers_tail",
     # batch_norm: 5-output stateful train path — test_ops_basic + test_models
     "batch_norm": "test_ops_basic", "top_k": "test_ops_basic",
     "reshape2": "test_ops_basic", "transpose2": "test_ops_basic",
