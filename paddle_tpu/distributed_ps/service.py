@@ -23,6 +23,116 @@ import numpy as np
 from .table import DenseTable, SparseTable
 
 
+class BarrierMonitor:
+    """Worker-liveness barrier (reference: operators/distributed/
+    barrier_monitor.h:106).
+
+    Trainers announce themselves on every barrier entry; a monitor thread
+    watches partially-filled barriers and, when the oldest waiter has been
+    stuck longer than ``timeout``, releases everyone with the list of
+    missing trainer ids — the failure-detection signal the reference's
+    monitor thread swamp_in/valid loop produces.  ``decrease``/``increase``
+    adjust the expected worker count for elastic membership.
+    """
+
+    def __init__(self, n_trainers: int, timeout: float = 120.0):
+        self.n = max(int(n_trainers), 1)
+        self.timeout = timeout
+        self._cv = threading.Condition()
+        self._arrived: Dict[int, float] = {}
+        self._generation = 0
+        self._released_gen = -1
+        self._failed: list = []
+        self._valid = True
+        self._stop = False
+        self._monitor = threading.Thread(target=self._watch, daemon=True)
+        self._monitor.start()
+
+    # ------------------------------------------------------------------
+    def wait(self, trainer_id: int, timeout: Optional[float] = None):
+        """Block until all n trainers arrive.  Returns [] on success or
+        the sorted list of missing trainer ids when the monitor released
+        a broken round."""
+        timeout = timeout or self.timeout
+        with self._cv:
+            gen = self._generation
+            self._arrived[trainer_id] = time.time()
+            if len(self._arrived) >= self.n:
+                # last arrival completes the round
+                self._generation += 1
+                self._released_gen = gen
+                self._failed = []
+                self._arrived.clear()
+                self._cv.notify_all()
+                return []
+            deadline = time.time() + timeout
+            while self._released_gen < gen:
+                remaining = deadline - time.time()
+                if remaining <= 0 or not self._cv.wait(timeout=min(remaining, 1.0)):
+                    if self._released_gen >= gen:
+                        break
+                    if time.time() >= deadline:
+                        # caller-side timeout: abandon the round entirely —
+                        # leaving our arrival behind would let a late
+                        # trainer "complete" a barrier we already treated
+                        # as broken (split brain)
+                        missing = self._missing_locked()
+                        self._arrived.pop(trainer_id, None)
+                        self._failed = missing
+                        self._valid = False
+                        return missing
+            return list(self._failed)
+
+    def _missing_locked(self):
+        present = set(self._arrived)
+        return sorted(set(range(self.n)) - present)
+
+    def _watch(self):
+        while not self._stop:
+            time.sleep(min(self.timeout / 4, 1.0))
+            with self._cv:
+                if not self._arrived or len(self._arrived) >= self.n:
+                    continue
+                oldest = min(self._arrived.values())
+                if time.time() - oldest > self.timeout:
+                    # release the round as FAILED with the missing ids
+                    self._failed = self._missing_locked()
+                    self._valid = False
+                    self._released_gen = self._generation
+                    self._generation += 1
+                    self._arrived.clear()
+                    self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    def valid(self) -> bool:
+        with self._cv:
+            return self._valid
+
+    def reset_valid(self):
+        with self._cv:
+            self._valid = True
+            self._failed = []
+
+    def increase(self, k: int = 1):
+        with self._cv:
+            self.n += k
+
+    def decrease(self, k: int = 1):
+        with self._cv:
+            self.n = max(self.n - k, 1)
+            if len(self._arrived) >= self.n:
+                # stale failure info from a previous broken round must not
+                # leak into this successfully-completed one
+                self._failed = []
+                self._released_gen = self._generation
+                self._generation += 1
+                self._arrived.clear()
+                self._cv.notify_all()
+
+    def stop(self):
+        self._stop = True
+
+
 # --------------------------------------------------------------------------
 # wire format: [u32 header_len][header json][payload bytes]
 # header: {"op": str, "name": str, "meta": {...}, "arrays": [[dtype, shape,
@@ -68,6 +178,7 @@ class PSServer:
         self.dense: Dict[str, DenseTable] = {}
         self.sparse: Dict[str, SparseTable] = {}
         self._barrier = threading.Barrier(max(n_trainers, 1))
+        self._barrier_monitor = BarrierMonitor(n_trainers)
         self._blobs: Dict[str, list] = {}
         self._heartbeats: Dict[int, float] = {}
         self._lock = threading.Lock()
@@ -125,6 +236,18 @@ class PSServer:
             _send_msg(sock, "ok")
         elif op == "barrier":
             # reference: send_barrier/fetch_barrier ops + BarrierMonitor
+            trainer_id = meta.get("trainer_id", -1)
+            if trainer_id >= 0:
+                # monitored path: failure detection with missing-ids report
+                missing = self._barrier_monitor.wait(
+                    trainer_id, meta.get("timeout"))
+                if missing:
+                    _send_msg(sock, "error",
+                              meta={"what": "barrier broken",
+                                    "missing_trainers": missing})
+                    return
+                _send_msg(sock, "ok")
+                return
             try:
                 self._barrier.wait(timeout=meta.get("timeout", 120.0))
             except threading.BrokenBarrierError:
@@ -136,6 +259,22 @@ class PSServer:
                 _send_msg(sock, "error", meta={"what": "barrier broken"})
                 return
             _send_msg(sock, "ok")
+        elif op == "barrier_status":
+            _send_msg(sock, "ok", meta={
+                "valid": self._barrier_monitor.valid(),
+                "missing": list(self._barrier_monitor._failed),
+                "n_trainers": self._barrier_monitor.n,
+            })
+        elif op == "barrier_reset":
+            self._barrier_monitor.reset_valid()
+            _send_msg(sock, "ok")
+        elif op == "barrier_membership":
+            delta = int(meta.get("delta", 0))
+            if delta > 0:
+                self._barrier_monitor.increase(delta)
+            elif delta < 0:
+                self._barrier_monitor.decrease(-delta)
+            _send_msg(sock, "ok", meta={"n_trainers": self._barrier_monitor.n})
         elif op == "heartbeat":
             # reference: HeartBeatMonitor worker liveness
             with self._lock:
@@ -220,9 +359,14 @@ class PSServer:
                 except (ConnectionError, OSError):
                     return
 
-        socketserver.ThreadingTCPServer.allow_reuse_address = True
-        self._server = socketserver.ThreadingTCPServer(
-            (self.host, self.port), Handler)
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            # don't join handler threads on close: a handler blocked on a
+            # still-open client socket would deadlock server.stop()
+            daemon_threads = True
+            block_on_close = False
+
+        self._server = Server((self.host, self.port), Handler)
         if self.port == 0:
             self.port = self._server.server_address[1]
         if block:
@@ -234,6 +378,7 @@ class PSServer:
         return self
 
     def stop(self):
+        self._barrier_monitor.stop()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -339,9 +484,26 @@ class PSClient:
         _, arrays = self._call(self._ep_for(name), "blob_take", name)
         return [a.tobytes() for a in arrays]
 
-    def barrier(self, timeout=120.0):
+    def barrier(self, timeout=120.0, trainer_id=-1):
+        """Anonymous barrier (trainer_id=-1) keeps the legacy behavior;
+        a real trainer_id routes through the BarrierMonitor and raises
+        with the missing-trainer list on failure detection."""
         for ep in self.endpoints:
-            self._call(ep, "barrier", meta={"timeout": timeout})
+            self._call(ep, "barrier",
+                       meta={"timeout": timeout, "trainer_id": trainer_id})
+
+    def barrier_status(self):
+        meta, _ = self._call(self.endpoints[0], "barrier_status")
+        return meta
+
+    def barrier_reset(self):
+        for ep in self.endpoints:
+            self._call(ep, "barrier_reset")
+
+    def barrier_membership(self, delta):
+        metas = [self._call(ep, "barrier_membership", meta={"delta": delta})[0]
+                 for ep in self.endpoints]
+        return metas[0]["n_trainers"]
 
     def heartbeat(self, trainer_id):
         for ep in self.endpoints:

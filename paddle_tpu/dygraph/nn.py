@@ -22,6 +22,24 @@ def _tracer():
     return t
 
 
+_PARAM_TRACER = []
+
+
+def _param_tracer():
+    """Parameter creation works without an active dygraph guard so a
+    network can be CONSTRUCTED in static mode (the reference's hapi
+    StaticGraphAdapter constructs Layers outside dygraph too); a private
+    Tracer runs just the initializer ops eagerly."""
+    t = _current_tracer()
+    if t is not None:
+        return t
+    if not _PARAM_TRACER:
+        from .tracer import Tracer
+
+        _PARAM_TRACER.append(Tracer())
+    return _PARAM_TRACER[0]
+
+
 def _trace(type, ins, n_out, attrs=None):
     from ..framework.core import in_dygraph_mode
     if not in_dygraph_mode():
@@ -49,7 +67,7 @@ def _make_param(layer, attr, shape, dtype, is_bias=False, default_init=None):
 
     if attr.name is None:
         name = unique_name.generate(name)
-    p = _tracer().create_parameter(
+    p = _param_tracer().create_parameter(
         name=name, shape=shape, dtype=dtype, initializer=init,
         trainable=attr.trainable, regularizer=attr.regularizer,
         optimize_attr={"learning_rate": attr.learning_rate},

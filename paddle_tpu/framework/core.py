@@ -374,6 +374,25 @@ class Block:
         dev = self.program._current_device
         if dev is not None and "op_device" not in op.attrs:
             op.attrs["op_device"] = dev
+        if "op_callstack" not in op.attrs:
+            # build-site callstack for error attribution (reference:
+            # framework/op_call_stack.cc + op_proto_maker OpCreationCallstack);
+            # user frames only — paddle_tpu internals are noise.  Walk raw
+            # frames innermost-out and stop after 3 user frames so
+            # transpiler/optimizer-inserted ops (all internal frames) pay
+            # almost nothing and no source lines are read eagerly.
+            import sys
+
+            frames = []
+            f = sys._getframe(1)
+            while f is not None and len(frames) < 3:
+                fname = f.f_code.co_filename
+                if "paddle_tpu" not in fname:
+                    frames.append(f'File "{fname}", line {f.f_lineno}, '
+                                  f"in {f.f_code.co_name}")
+                f = f.f_back
+            if frames:
+                op.attrs["op_callstack"] = frames[::-1]  # outermost first
         from ..ops import registry  # local import to avoid cycles
 
         registry.infer_shape(op, self)

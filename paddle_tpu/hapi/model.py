@@ -2,9 +2,11 @@
 
 Reference: python/paddle/incubate/hapi/model.py (Model:652 with
 fit:1128/evaluate/predict/save/load, Input:81, dual static/dygraph
-adapters:463).  TPU-native: the dygraph adapter is the primary path and
-uses jit_train_step to compile the whole train step; a static adapter is
-unnecessary since that jit IS the static path.
+adapters:463 StaticGraphAdapter / DynamicGraphAdapter).  TPU-native: the
+dygraph path jits the train step; the StaticGraphAdapter captures the
+same dygraph-defined network into train/eval/test Programs (via the
+dygraph_to_static capture context) and drives them with the Executor —
+so one network definition serves both modes, exactly like the reference.
 """
 from __future__ import annotations
 
@@ -27,6 +29,183 @@ class Input:
         self.name = name
 
 
+class StaticGraphAdapter:
+    """Static-mode Model backend (reference: hapi/model.py:463).
+
+    Builds one Program per mode (train/eval/test) by running the
+    dygraph-defined network under the dygraph_to_static capture context
+    with data vars declared from the Model's Input specs; parameters are
+    captured into a private Scope once and updated in place by the
+    optimizer ops across train_batch calls."""
+
+    def __init__(self, model: "Model"):
+        from ..framework.scope import Scope
+
+        self.model = model
+        self._progs = {}
+        self._scope = Scope()
+        self._synced = False
+
+    # ------------------------------------------------------------------
+    def _data_vars(self, block, specs, kind):
+        from ..framework import unique_name
+
+        vars_ = []
+        for i, spec in enumerate(specs):
+            name = (spec.name if getattr(spec, "name", None)
+                    else f"hapi_{kind}_{i}")
+            shape = list(spec.shape if spec.shape else [-1])
+            if shape and shape[0] not in (-1, None):
+                shape = [-1] + shape[1:] if len(shape) > 1 else shape
+            shape = [-1 if s is None else s for s in shape]
+            v = block.create_var(name=name, shape=shape,
+                                 dtype=spec.dtype, is_data=True,
+                                 stop_gradient=(kind == "label"))
+            vars_.append(v)
+        return vars_
+
+    def _build(self, mode):
+        if mode in self._progs:
+            return self._progs[mode]
+        from ..framework.core import Program, program_guard
+        from ..framework import unique_name
+        from ..dygraph.dygraph_to_static import program_translator as pt_mod
+        from ..dygraph.base import _current_tracer, _set_dygraph_tracer
+        from .. import Executor, CPUPlace
+
+        model = self.model
+        if not model._inputs:
+            raise ValueError(
+                "static-mode hapi Model needs `inputs` (a list of "
+                "hapi.Input specs), like the reference StaticGraphAdapter")
+        if mode == "train":
+            model.network.train()
+        else:
+            model.network.eval()
+
+        main, startup = Program(), Program()
+        ctx = pt_mod._CaptureCtx(main, startup)
+        old_tracer = _current_tracer()
+        prev_gen = unique_name.switch()
+        try:
+            _set_dygraph_tracer(None)
+            pt_mod._capture_tls.ctx = ctx
+            with program_guard(main, startup):
+                block = main.global_block()
+                in_vars = self._data_vars(block, model._inputs, "input")
+                label_vars = (self._data_vars(block, model._labels, "label")
+                              if mode != "test" else [])
+                outputs = model.network(*in_vars)
+                out_list = (list(outputs) if isinstance(outputs, (list, tuple))
+                            else [outputs])
+                loss = None
+                if mode != "test":
+                    loss = model._compute_loss(outputs, label_vars)
+                if mode == "train":
+                    # captured params are plain block vars, not Parameter
+                    # objects, so all_parameters() can't find them — hand
+                    # the trainable ones to minimize explicitly
+                    param_vars = [
+                        block.var(name)
+                        for name, vb in ctx.value_sources.items()
+                        if not getattr(vb, "stop_gradient", False)
+                    ]
+                    model._optimizer.minimize(loss, startup_program=startup,
+                                              parameter_list=param_vars)
+        finally:
+            pt_mod._capture_tls.ctx = None
+            _set_dygraph_tracer(old_tracer)
+            unique_name.switch(prev_gen)
+
+        entry = {
+            "program": main,
+            "feeds": [v.name for v in in_vars]
+            + [v.name for v in (label_vars if mode != "test" else [])],
+            "fetch": ([loss.name] if loss is not None else [])
+            + [o.name for o in out_list],
+            "n_outs": len(out_list),
+            "ctx": ctx,
+            "exe": Executor(CPUPlace()),
+        }
+        # initialize optimizer state (LR vars, accumulators) into the scope
+        if len(startup.global_block().ops) > 0:
+            entry["exe"].run(startup, scope=self._scope)
+        if not self._synced:
+            # one-time param injection: after this the optimizer ops own
+            # the values in self._scope
+            for name, vb in ctx.value_sources.items():
+                self._scope.set(name, vb._value)
+            self._synced = True
+        else:
+            for name, vb in ctx.value_sources.items():
+                if self._scope.get(name) is None:
+                    self._scope.set(name, vb._value)
+        self._progs[mode] = entry
+        return entry
+
+    # ------------------------------------------------------------------
+    def _run(self, mode, inputs, labels):
+        entry = self._build(mode)
+        arrays = [np.asarray(a) for a in list(inputs) + list(labels or [])]
+        feed = dict(zip(entry["feeds"], arrays))
+        vals = entry["exe"].run(entry["program"], feed=feed,
+                                fetch_list=entry["fetch"], scope=self._scope)
+        return [np.asarray(v) for v in vals]
+
+    def _loss_and_metrics(self, mode, inputs, labels):
+        vals = self._run(mode, inputs, labels)
+        loss, outs = float(vals[0].ravel()[0]), vals[1:]
+        metrics = [m.update(outs[0], np.asarray(labels[0]) if labels else None)
+                   for m in self.model._metrics]
+        return ([loss], metrics) if metrics else [loss]
+
+    def train_batch(self, inputs, labels=None):
+        return self._loss_and_metrics("train", inputs, labels)
+
+    def eval_batch(self, inputs, labels=None):
+        return self._loss_and_metrics("eval", inputs, labels)
+
+    def test_batch(self, inputs):
+        return self._run("test", inputs, [])
+
+    # ------------------------------------------------------------------
+    def _sync_back(self):
+        """Scope (trained) values -> eager ParamBase objects, so the
+        network's structural state_dict reflects training."""
+        for entry in self._progs.values():
+            for name, vb in entry["ctx"].value_sources.items():
+                v = self._scope.get(name)
+                if v is not None:
+                    vb._value = v
+
+    def parameters(self):
+        self._sync_back()
+        return self.model.network.parameters()
+
+    def save(self, path):
+        """Structural-key save (like the reference's program-state save):
+        robust to per-instance unique param names."""
+        import pickle
+
+        self._sync_back()
+        state = {k: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+                 for k, v in self.model.network.state_dict().items()}
+        with open(path + ".pdparams", "wb") as f:
+            pickle.dump(state, f)
+
+    def load(self, path):
+        import pickle
+
+        with open(path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+        self.model.network.set_dict(state)
+        # push the restored values into the executor scope
+        for entry in self._progs.values():
+            for name, vb in entry["ctx"].value_sources.items():
+                self._scope.set(name, vb._value)
+        self._synced = False  # next _build re-injects from the network
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -36,6 +215,9 @@ class Model:
         self._loss_function = None
         self._metrics: List[Metric] = []
         self._jit_step = None
+        # dual adapters (reference hapi/model.py:652): static mode when
+        # constructed outside dygraph.guard()
+        self._adapter = None if in_dygraph_mode() else StaticGraphAdapter(self)
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss_function=None, metrics=None):
@@ -53,12 +235,20 @@ class Model:
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
         return self._loss_function(*(list(outs) + list(labels)))
 
+    def _static_adapter(self):
+        if self._adapter is None:
+            raise RuntimeError(
+                "hapi Model was constructed in dygraph mode but is being "
+                "used in static mode — keep usage inside "
+                "fluid.dygraph.guard(), or construct the Model outside the "
+                "guard to get the StaticGraphAdapter")
+        return self._adapter
+
     def train_batch(self, inputs, labels=None):
         from ..fluid import dygraph
 
         if not in_dygraph_mode():
-            raise RuntimeError("hapi Model requires dygraph mode "
-                               "(use fluid.dygraph.guard() or enable_dygraph)")
+            return self._static_adapter().train_batch(inputs, labels)
         labels = labels or []
         self.network.train()
         in_vars = [dygraph.to_variable(np.asarray(x)) for x in inputs]
@@ -78,6 +268,8 @@ class Model:
     def eval_batch(self, inputs, labels=None):
         from ..fluid import dygraph
 
+        if not in_dygraph_mode():
+            return self._static_adapter().eval_batch(inputs, labels)
         labels = labels or []
         self.network.eval()
         in_vars = [dygraph.to_variable(np.asarray(x)) for x in inputs]
@@ -94,6 +286,8 @@ class Model:
     def test_batch(self, inputs):
         from ..fluid import dygraph
 
+        if not in_dygraph_mode():
+            return self._static_adapter().test_batch(inputs)
         self.network.eval()
         in_vars = [dygraph.to_variable(np.asarray(x)) for x in inputs]
         outputs = self.network(*in_vars)
@@ -196,15 +390,21 @@ class Model:
 
     # ------------------------------------------------------------------
     def save(self, path):
+        if self._adapter is not None and not in_dygraph_mode():
+            return self._adapter.save(path)
         from ..dygraph.checkpoint import save_dygraph
 
         save_dygraph(self.network.state_dict(), path)
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        if self._adapter is not None and not in_dygraph_mode():
+            return self._adapter.load(path)
         from ..dygraph.checkpoint import load_dygraph
 
         state, _ = load_dygraph(path)
         self.network.set_dict(state)
 
     def parameters(self):
+        if self._adapter is not None and not in_dygraph_mode():
+            return self._adapter.parameters()
         return self.network.parameters()

@@ -91,6 +91,148 @@ class Collective(Fleet):
         return io.save_persistables(executor, dirname,
                                     main_program or self.main_program, filename)
 
+    # -- epoch checkpoints (reference: fleet/collective/__init__.py:206-287)
+    _checkpoint_prefix = "__paddle_fleet_checkpoint__"
+    _param_file_name = "_paddle_fleet_param__"
+
+    def _save_train_status(self, path, train_status):
+        import json
+        import os
+
+        with open(os.path.join(path, "fleet_train_status"), "w") as f:
+            json.dump({"epoch_no": train_status._epoch_no}, f)
+
+    def _load_train_status(self, path):
+        import json
+        import os
+
+        r = TrainStatus()
+        fname = os.path.join(path, "fleet_train_status")
+        if not os.path.isfile(fname):
+            return r
+        with open(fname) as f:
+            d = json.load(f)
+        assert "epoch_no" in d and d["epoch_no"] >= 0, \
+            f"invalid train_status file: {d}"
+        r._epoch_no = d["epoch_no"]
+        return r
+
+    def _get_last_checkpoint_no(self, root_path, fs):
+        max_no = -1
+        for d in fs.list_dirs(root_path):
+            g = d.split(".")
+            if len(g) != 2 or g[0] != self._checkpoint_prefix:
+                continue
+            try:
+                max_no = max(max_no, int(g[1]))
+            except ValueError:
+                continue
+        return max_no
+
+    def clean_redundant_check_points(self, root_path, fs=None,
+                                     checkpoint_num=1):
+        from ..utils.fs import LocalFS
+
+        fs = fs or LocalFS()
+        max_no = self._get_last_checkpoint_no(root_path, fs)
+        if max_no < 0:
+            return
+        checkpoint_num = max(checkpoint_num, 1)
+        for d in fs.list_dirs(root_path):
+            g = d.split(".")
+            if len(g) != 2 or g[0] != self._checkpoint_prefix:
+                continue
+            try:
+                n = int(g[1])
+            except ValueError:
+                continue
+            if n <= max_no - checkpoint_num:
+                fs.rmr(f"{root_path}/{self._checkpoint_prefix}.{n}")
+
+    def save_check_point(self, executor, path, train_status,
+                         main_program=None, fs=None,
+                         local_cache_path=".cache",
+                         remain_all_checkpoint=True):
+        """Save persistables + epoch number into path/<prefix>.<n>
+        atomically (tmp dir then mv), optionally rotating old epochs."""
+        from ..utils.fs import LocalFS
+
+        fs = fs or LocalFS()
+        main_program = main_program or self.main_program
+        if not fs.stat(path):
+            fs.mkdir(path)
+        max_no = self._get_last_checkpoint_no(path, fs=fs)
+        real_path = f"{path}/{self._checkpoint_prefix}.{max_no + 1}"
+        tmp_path = f"{real_path}.tmp"
+        local_fs = LocalFS()
+
+        saved_path = tmp_path
+        if fs.need_upload_download():
+            saved_path = (f"{local_cache_path}/{self._checkpoint_prefix}"
+                          f".{max_no + 1}.saved_cache")
+            local_fs.mkdir(saved_path)
+        else:
+            local_fs.mkdir(saved_path)
+
+        self.save_persistables(executor=executor, dirname=saved_path,
+                               main_program=main_program,
+                               filename=self._param_file_name)
+        self._save_train_status(path=saved_path, train_status=train_status)
+
+        if fs.need_upload_download():
+            fs.delete(tmp_path)
+            fs.upload(saved_path, tmp_path)
+        fs.mv(tmp_path, real_path)
+        if not remain_all_checkpoint:
+            self.clean_redundant_check_points(path, fs=fs)
+        return real_path
+
+    def load_check_point(self, executor, path, trainer_id=0,
+                         main_program=None, fs=None,
+                         local_cache_path=".cache", ignore_empty=True):
+        """Load the newest checkpoint; returns its TrainStatus (or None
+        when the directory has no checkpoints and ignore_empty)."""
+        from .... import io
+        from ..utils.fs import LocalFS
+
+        fs = fs or LocalFS()
+        max_no = self._get_last_checkpoint_no(path, fs)
+        if not ignore_empty:
+            assert max_no >= 0, "Can't find checkpoint"
+        if max_no < 0:
+            return None
+        real_path = f"{path}/{self._checkpoint_prefix}.{max_no}"
+        load_path = real_path
+        if fs.need_upload_download():
+            local_fs = LocalFS()
+            cache = (f"{local_cache_path}/{self._checkpoint_prefix}"
+                     f".{max_no}.load_cache.{trainer_id}")
+            local_fs.delete(cache)
+            fs.download(real_path, cache)
+            load_path = cache
+        io.load_persistables(executor=executor, dirname=load_path,
+                             main_program=main_program or self.main_program,
+                             filename=self._param_file_name)
+        return self._load_train_status(load_path)
+
+
+class TrainStatus:
+    """reference: fleet/collective/__init__.py TrainStatus — the epoch
+    counter persisted next to each checkpoint."""
+
+    def __init__(self, epoch_no=-1):
+        self._epoch_no = epoch_no
+
+    def next(self):
+        return self._epoch_no + 1
+
+    def __eq__(self, other):
+        return isinstance(other, TrainStatus) and \
+            self._epoch_no == other._epoch_no
+
+    def __ne__(self, other):
+        return not self == other
+
 
 fleet = Collective()
 
@@ -149,6 +291,18 @@ class CollectiveOptimizer(DistributedOptimizer):
         if strategy.use_local_sgd:
             t = LocalSGD(nrings=strategy.nccl_comm_num,
                          k_steps=strategy.local_sgd_k_steps)
+        elif strategy.use_hierarchical_allreduce:
+            # hybrid ICI x DCN mesh: (inter, intra) axes; the intra axis
+            # is the fast in-node/ICI ring of inter_nranks devices
+            intra = strategy.hierarchical_allreduce_inter_nranks
+            assert nranks % intra == 0, (
+                f"hierarchical allreduce: nranks {nranks} not divisible "
+                f"by inter_nranks {intra}")
+            mesh_mod.registry().create_mesh(
+                (nranks // intra, intra), ("inter", "intra"),
+                name="hierarchical")
+            t = GradAllReduce(nrings=strategy.nccl_comm_num,
+                              hierarchical=True, intra_nranks=intra)
         else:
             t = GradAllReduce(nrings=strategy.nccl_comm_num)
         t.transpile(

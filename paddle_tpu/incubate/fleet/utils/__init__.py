@@ -1,0 +1,1 @@
+"""fleet.utils (reference: incubate/fleet/utils/)."""

@@ -1,0 +1,159 @@
+"""Filesystem abstraction for fleet checkpointing.
+
+Reference: python/paddle/fluid/incubate/fleet/utils/fs.py (FS / LocalFS)
+and hdfs.py (HDFSClient).  The checkpoint logic is written against this
+interface so a remote FS (HDFS/GCS) slots in by implementing the same
+methods; LocalFS is the complete local implementation, HDFSClient is a
+config-carrying stub that shells out to ``hadoop fs`` when available.
+"""
+from __future__ import annotations
+
+import abc
+import os
+import shutil
+import subprocess
+
+
+class FS(abc.ABC):
+    @abc.abstractmethod
+    def list_dirs(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def ls_dir(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def stat(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def mkdir(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def delete(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def need_upload_download(self):
+        ...
+
+    def rmr(self, fs_path):
+        return self.delete(fs_path)
+
+
+class LocalFS(FS):
+    """reference: fleet/utils/fs.py LocalFS."""
+
+    def list_dirs(self, fs_path):
+        if not self.stat(fs_path):
+            return []
+        return [d for d in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, d))]
+
+    def ls_dir(self, fs_path):
+        return sorted(os.listdir(fs_path)) if self.stat(fs_path) else []
+
+    def stat(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def is_exist(self, fs_path):
+        return self.stat(fs_path)
+
+    def mkdir(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if not self.stat(fs_path):
+            return
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        else:
+            os.remove(fs_path)
+
+    def mv(self, src, dst):
+        self.delete(dst)
+        shutil.move(src, dst)
+
+    def touch(self, fs_path):
+        with open(fs_path, "a"):
+            pass
+
+    def upload(self, local_path, fs_path):
+        self.delete(fs_path)
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def need_upload_download(self):
+        return False
+
+
+class HDFSClient(FS):
+    """``hadoop fs`` shell-out client (reference: fleet/utils/hdfs.py).
+    Requires a hadoop binary; every method degrades to a clear error when
+    it is absent, so local runs never silently touch HDFS."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        self._hadoop = (os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else "hadoop")
+        self._configs = configs or {}
+
+    def _run(self, *args, check=False):
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=300)
+        except FileNotFoundError:
+            raise RuntimeError(
+                f"hadoop binary not found at {self._hadoop!r}; HDFSClient "
+                "needs a hadoop installation") from None
+        if check and r.returncode != 0:
+            raise RuntimeError(
+                f"hadoop fs {' '.join(args)} failed (rc={r.returncode}): "
+                f"{r.stderr.strip()[:500]}")
+        return r
+
+    def list_dirs(self, fs_path):
+        r = self._run("-ls", fs_path)
+        dirs = []
+        for line in r.stdout.splitlines():
+            parts = line.split()
+            if len(parts) >= 8 and parts[0].startswith("d"):
+                dirs.append(os.path.basename(parts[-1]))
+        return dirs
+
+    def ls_dir(self, fs_path):
+        r = self._run("-ls", fs_path)
+        return [os.path.basename(l.split()[-1])
+                for l in r.stdout.splitlines() if len(l.split()) >= 8]
+
+    def stat(self, fs_path):
+        return self._run("-test", "-e", fs_path).returncode == 0
+
+    def mkdir(self, fs_path):
+        self._run("-mkdir", "-p", fs_path, check=True)
+
+    def delete(self, fs_path):
+        # -f: deleting a missing path is not an error
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def mv(self, src, dst):
+        self._run("-mv", src, dst, check=True)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path, check=True)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path, check=True)
+
+    def need_upload_download(self):
+        return True

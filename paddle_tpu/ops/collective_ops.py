@@ -168,7 +168,10 @@ def _c_comm_init(ctx):
     ring_id = ctx.attr("ring_id", 0)
     mesh = current_mesh()
     if mesh is not None:
-        registry().register_ring(ring_id, mesh.axis_names[0])
+        # hierarchical rings name their axis explicitly (inter/intra);
+        # default rings bind to the first mesh axis
+        axis = ctx.attr("axis_name", None) or mesh.axis_names[0]
+        registry().register_ring(ring_id, axis)
 
 
 @op("c_comm_init_all", no_grad=True)

@@ -306,3 +306,47 @@ def _dpsgd(ctx):
 def _global_step_counter(ctx):
     x = ctx.in_("X")
     ctx.set_out("Out", x + 1)
+
+
+@_opt("average_accumulates")
+def _average_accumulates(ctx):
+    """Windowed parameter accumulation for ModelAverage (reference:
+    average_accumulates_op.h).  sum_1 accumulates params; every 16384
+    updates sum_1 spills into sum_2 (precision); when the window outgrows
+    min(max_average_window, num_updates*average_window) the old window
+    moves to sum_3 and restarts.  Counters are [1] int64 tensors threaded
+    functionally; the data-dependent branches lower to jnp.where."""
+    param = ctx.in_("param")
+    s1, s2, s3 = ctx.in_("in_sum_1"), ctx.in_("in_sum_2"), ctx.in_("in_sum_3")
+    num_acc = ctx.in_("in_num_accumulates").reshape(())
+    old_num = ctx.in_("in_old_num_accumulates").reshape(())
+    num_upd = ctx.in_("in_num_updates").reshape(())
+    avg_window = ctx.attr("average_window", 0.0)
+    max_w = ctx.attr("max_average_window", 10000)
+    min_w = ctx.attr("min_average_window", 10000)
+    k_max = 16384
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + param
+
+    spill = (num_upd % k_max) == 0
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+
+    window = jnp.minimum(
+        jnp.asarray(max_w, num_upd.dtype),
+        (num_upd.astype(jnp.float32) * avg_window).astype(num_upd.dtype))
+    roll = (num_acc >= min_w) & (num_acc >= window)
+    s3 = jnp.where(roll, s1 + s2, s3)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(roll, jnp.zeros_like(s2), s2)
+    old_num = jnp.where(roll, num_acc, old_num)
+    num_acc = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
+
+    ctx.set_out("out_sum_1", s1)
+    ctx.set_out("out_sum_2", s2)
+    ctx.set_out("out_sum_3", s3)
+    ctx.set_out("out_num_accumulates", num_acc.reshape(1))
+    ctx.set_out("out_old_num_accumulates", old_num.reshape(1))
+    ctx.set_out("out_num_updates", num_upd.reshape(1))

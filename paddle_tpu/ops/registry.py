@@ -334,9 +334,28 @@ def run_op(op: Operator, env: Dict[str, Any], block=None):
     # profiles (jax.profiler / TensorBoard) attribute kernels back to
     # framework ops — the annotation-correlation analog of the
     # reference's CUPTI DeviceTracer (platform/device_tracer.cc).
-    with jax.named_scope(op.type):
-        d.lower(ctx)
+    try:
+        with jax.named_scope(op.type):
+            d.lower(ctx)
+    except Exception as e:
+        _raise_with_callstack(op, e)
     return ctx
+
+
+def _raise_with_callstack(op: Operator, e: Exception):
+    """Attach the op's Python build-site callstack to the error
+    (reference: framework/op_call_stack.cc InsertCallStackInfo) —
+    with whole-block jit the C++-style 'which op failed and where was
+    it built' context is otherwise lost."""
+    stack = op.attrs.get("op_callstack")
+    where = ""
+    if stack:
+        where = "\n  op built at:\n    " + "\n    ".join(stack)
+    note = f"[operator {op.type!r} error]{where}"
+    if hasattr(e, "add_note"):  # py3.11+
+        e.add_note(note)
+        raise e
+    raise type(e)(f"{e}\n{note}") from e
 
 
 # --------------------------------------------------------------------------

@@ -946,18 +946,121 @@ class ExponentialMovingAverage:
 
 
 class ModelAverage(Optimizer):
-    """reference: optimizer.py ModelAverage — simplified EMA-style variant."""
+    """Windowed parameter averaging (reference: optimizer.py ModelAverage
+    + average_accumulates_op.h).  Appends an average_accumulates op per
+    trainable parameter to the CURRENT main program (call after
+    optimizer.minimize, like the reference); ``apply()`` swaps params for
+    (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates) and
+    ``restore()``/context-exit swaps back."""
 
     def __init__(self, average_window_rate, min_average_window=10000,
-                 max_average_window=10000, **kwargs):
-        super().__init__(0.0, **kwargs)
-        self._ema = ExponentialMovingAverage(decay=1.0 - average_window_rate)
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization=regularization, name=name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._accums = []  # (param_name, s1, s2, s3, na, ona, nu)
+        main = default_main_program()
+        startup = default_startup_program()
+        for p in main.all_parameters():
+            if not getattr(p, "trainable", True):
+                continue
+            self._append_average_accumulate_op(main, startup, p)
+        self._restore_vals = None
 
-    def apply(self, executor=None, need_restore=True):
-        return self._ema.apply(executor, need_restore)
+    def _append_average_accumulate_op(self, main, startup, param):
+        block = main.global_block()
+        sblock = startup.global_block()
 
-    def restore(self, executor=None):
-        pass
+        def acc(suffix, shape, dtype, value=0.0):
+            name = f"{param.name}_{suffix}{self._name or ''}"
+            block.create_var(name=name, shape=shape, dtype=dtype,
+                             persistable=True)
+            sblock.create_var(name=name, shape=shape, dtype=dtype,
+                              persistable=True)
+            sblock.append_op(
+                "fill_constant", inputs={},
+                outputs={"Out": [name]},
+                attrs={"shape": list(shape), "value": value,
+                       "dtype": int(VarType(dtype))})
+            return name
+
+        shape = [s for s in param.shape]
+        s1 = acc("sum_1", shape, param.dtype)
+        s2 = acc("sum_2", shape, param.dtype)
+        s3 = acc("sum_3", shape, param.dtype)
+        na = acc("num_accumulates", [1], VarType.INT64)
+        ona = acc("old_num_accumulates", [1], VarType.INT64)
+        nu = acc("num_updates", [1], VarType.INT64)
+        block.append_op(
+            "average_accumulates",
+            inputs={"param": [param.name], "in_sum_1": [s1], "in_sum_2": [s2],
+                    "in_sum_3": [s3], "in_num_accumulates": [na],
+                    "in_old_num_accumulates": [ona], "in_num_updates": [nu]},
+            outputs={"out_sum_1": [s1], "out_sum_2": [s2], "out_sum_3": [s3],
+                     "out_num_accumulates": [na],
+                     "out_old_num_accumulates": [ona],
+                     "out_num_updates": [nu]},
+            attrs={"average_window": self.average_window,
+                   "min_average_window": self.min_average_window,
+                   "max_average_window": self.max_average_window,
+                   OP_ROLE_KEY: OpRole.Optimize},
+        )
+        self._accums.append((param.name, s1, s2, s3, na, ona, nu))
+
+    # ------------------------------------------------------------------
+    def _averaged(self, scope, entry):
+        import numpy as np
+
+        _, s1, s2, s3, na, ona, _ = entry
+        total = (np.asarray(scope.get(s1)) + np.asarray(scope.get(s2))
+                 + np.asarray(scope.get(s3)))
+        count = float(np.asarray(scope.get(na)).ravel()[0]
+                      + np.asarray(scope.get(ona)).ravel()[0])
+        return total / max(count, 1.0)
+
+    def apply(self, executor=None, need_restore=True, scope=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            self._swap_in(scope)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor, scope=scope)
+
+        return _guard()
+
+    def _resolve_scope(self, scope):
+        if scope is not None:
+            return scope
+        from .framework.scope import global_scope
+
+        return global_scope()
+
+    def _swap_in(self, scope=None):
+        scope = self._resolve_scope(scope)
+        self._restore_vals = {}
+        for entry in self._accums:
+            pname = entry[0]
+            if scope.get(entry[1]) is None:
+                raise RuntimeError(
+                    f"ModelAverage accumulators for {pname!r} not found in "
+                    "the scope — pass the training scope via "
+                    "apply(..., scope=your_scope) when not using the "
+                    "global scope")
+            self._restore_vals[pname] = scope.get(pname)
+            scope.set(pname, self._averaged(scope, entry))
+
+    def restore(self, executor=None, scope=None):
+        if not self._restore_vals:
+            return
+        scope = self._resolve_scope(scope)
+        for name, val in self._restore_vals.items():
+            scope.set(name, val)
+        self._restore_vals = None
 
 
 # 2.0-style short aliases (reference: paddle.optimizer namespace)

@@ -51,10 +51,37 @@ class Collective:
 
 class GradAllReduce(Collective):
     """reference: transpiler/collective.py:178 — allreduce-sum every grad
-    between backward and optimize, scaled by 1/nranks."""
+    between backward and optimize, scaled by 1/nranks.
 
-    def __init__(self, nrings: int = 1):
+    Hierarchical mode (reference: fleet's use_hierarchical_allreduce +
+    multi_devices_graph_pass hierarchical rings) decomposes each flat
+    allreduce into intra-group reduce_scatter -> inter-group allreduce ->
+    intra-group allgather over a 2-D (inter, intra) mesh, so the heavy
+    traffic rides the fast intra axis (ICI) and only 1/intra_nranks of
+    the bytes cross the slow inter axis (DCN)."""
+
+    INTRA_RING = 0
+    INTER_RING = 1
+
+    def __init__(self, nrings: int = 1, hierarchical: bool = False,
+                 intra_nranks: int = 8):
         super().__init__(nrings)
+        self.hierarchical = hierarchical
+        self.intra_nranks = intra_nranks
+
+    def _transpile_startup_program(self):
+        if not self.hierarchical:
+            return super()._transpile_startup_program()
+        block = self.startup_program.global_block()
+        block.append_op(
+            "c_comm_init_all",
+            attrs={"ring_id": self.INTRA_RING, "axis_name": "intra",
+                   "nranks": self.intra_nranks, OP_ROLE_KEY: OpRole.Forward})
+        block.append_op(
+            "c_comm_init_all",
+            attrs={"ring_id": self.INTER_RING, "axis_name": "inter",
+                   "nranks": self.nranks // self.intra_nranks,
+                   OP_ROLE_KEY: OpRole.Forward})
 
     def _transpile_main_program(self):
         block = self.main_program.global_block()
@@ -79,12 +106,17 @@ class GradAllReduce(Collective):
                 inputs={"X": [g]}, outputs={"Out": [g]},
                 attrs={"scale": 1.0 / self.nranks, OP_ROLE_KEY: OpRole.Backward},
             )
-            block._insert_op(
-                insert_at + 1, "c_allreduce_sum",
-                inputs={"X": [g]}, outputs={"Out": [g]},
-                attrs={"ring_id": ring % self.nrings, OP_ROLE_KEY: OpRole.Backward},
-            )
-            insert_at += 2
+            insert_at += 1
+            if self.hierarchical:
+                insert_at = self._insert_hierarchical(block, insert_at, g)
+            else:
+                block._insert_op(
+                    insert_at, "c_allreduce_sum",
+                    inputs={"X": [g]}, outputs={"Out": [g]},
+                    attrs={"ring_id": ring % self.nrings,
+                           OP_ROLE_KEY: OpRole.Backward},
+                )
+                insert_at += 1
             ring += 1
         # c_sync_comm_stream before first optimizer op (API parity; no-op)
         block._insert_op(
@@ -92,6 +124,44 @@ class GradAllReduce(Collective):
             inputs={"X": grad_names}, outputs={"Out": grad_names},
             attrs={"ring_id": 0, OP_ROLE_KEY: OpRole.Backward},
         )
+
+    def _insert_hierarchical(self, block, at, g):
+        gvar = block._find_var_recursive(g)
+        shape = list(gvar.shape) if gvar is not None else []
+        divisible = bool(shape) and shape[0] > 0 and \
+            shape[0] % self.intra_nranks == 0
+        if divisible:
+            # bandwidth-optimal: RS(intra) -> AR(inter) -> AG(intra)
+            from ..framework import unique_name
+
+            shard = unique_name.generate(g + "@HIER_SHARD")
+            block.create_var(name=shard, dtype=gvar.dtype,
+                             shape=[shape[0] // self.intra_nranks] + shape[1:])
+            block._insert_op(
+                at, "c_reducescatter",
+                inputs={"X": [g]}, outputs={"Out": [shard]},
+                attrs={"ring_id": self.INTRA_RING, "nranks": self.intra_nranks,
+                       OP_ROLE_KEY: OpRole.Backward})
+            block._insert_op(
+                at + 1, "c_allreduce_sum",
+                inputs={"X": [shard]}, outputs={"Out": [shard]},
+                attrs={"ring_id": self.INTER_RING, OP_ROLE_KEY: OpRole.Backward})
+            block._insert_op(
+                at + 2, "c_allgather",
+                inputs={"X": [shard]}, outputs={"Out": [g]},
+                attrs={"ring_id": self.INTRA_RING, "nranks": self.intra_nranks,
+                       OP_ROLE_KEY: OpRole.Backward})
+            return at + 3
+        # fallback: two-stage allreduce (reduce intra then across groups)
+        block._insert_op(
+            at, "c_allreduce_sum",
+            inputs={"X": [g]}, outputs={"Out": [g]},
+            attrs={"ring_id": self.INTRA_RING, OP_ROLE_KEY: OpRole.Backward})
+        block._insert_op(
+            at + 1, "c_allreduce_sum",
+            inputs={"X": [g]}, outputs={"Out": [g]},
+            attrs={"ring_id": self.INTER_RING, OP_ROLE_KEY: OpRole.Backward})
+        return at + 2
 
 
 class LocalSGD(Collective):

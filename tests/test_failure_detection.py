@@ -1,0 +1,204 @@
+"""Failure detection + elastic membership + fleet epoch checkpoints.
+
+Reference: operators/distributed/barrier_monitor.h:106 (BarrierMonitor),
+heart_beat_monitor.h:54, fleet/collective/__init__.py:206-287
+(save_check_point / load_check_point / clean_redundant_check_points /
+TrainStatus)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.layers as L
+import paddle_tpu.optimizer as optim
+from paddle_tpu.distributed_ps.service import BarrierMonitor, PSServer, PSClient
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.incubate.fleet.collective import Collective, TrainStatus
+from paddle_tpu.incubate.fleet.utils.fs import LocalFS
+
+
+# --------------------------------------------------------------------------
+# BarrierMonitor unit behavior
+# --------------------------------------------------------------------------
+def test_barrier_monitor_success_and_failure():
+    mon = BarrierMonitor(2, timeout=1.0)
+
+    # both trainers arrive -> round completes with no missing ids
+    res = []
+    t = threading.Thread(target=lambda: res.append(mon.wait(0)))
+    t.start()
+    time.sleep(0.1)
+    assert mon.wait(1) == []
+    t.join(timeout=5)
+    assert res == [[]]
+    assert mon.valid()
+
+    # trainer 1 never arrives -> monitor releases trainer 0 with missing=[1]
+    missing = mon.wait(0, timeout=10.0)  # monitor's own 1s timeout fires first
+    assert missing == [1]
+    assert not mon.valid()
+    mon.reset_valid()
+    assert mon.valid()
+
+    # elastic: drop the dead worker; a single trainer now completes alone
+    mon.decrease(1)
+    assert mon.wait(0) == []
+    mon.stop()
+
+
+def test_barrier_monitor_over_ps_service():
+    server = PSServer("127.0.0.1:0", n_trainers=2).start()
+    server._barrier_monitor.timeout = 1.0
+    try:
+        c0 = PSClient(server.endpoint)
+        c1 = PSClient(server.endpoint)
+
+        ok = []
+        t = threading.Thread(target=lambda: ok.append(
+            c0.barrier(trainer_id=0, timeout=10.0)))
+        t.start()
+        time.sleep(0.1)
+        c1.barrier(trainer_id=1, timeout=10.0)
+        t.join(timeout=10)
+        assert len(ok) == 1  # both released cleanly
+        st = c0.barrier_status()
+        assert st["valid"] and st["n_trainers"] == 2
+
+        # now trainer 1 dies: trainer 0's barrier raises with missing ids
+        with pytest.raises(RuntimeError) as ei:
+            c0.barrier(trainer_id=0, timeout=10.0)
+        assert "missing_trainers" in str(ei.value) and "1" in str(ei.value)
+        st = c0.barrier_status()
+        assert not st["valid"] and st["missing"] == [1]
+
+        # elastic recovery: drop the dead trainer, reset, continue alone
+        assert c0.barrier_membership(-1) == 1
+        c0.barrier_reset()
+        c0.barrier(trainer_id=0, timeout=10.0)
+        assert c0.barrier_status()["valid"]
+    finally:
+        server.stop()
+
+
+def test_heartbeat_worker_status():
+    server = PSServer("127.0.0.1:0", n_trainers=2).start()
+    try:
+        c = PSClient(server.endpoint)
+        c.heartbeat(0)
+        time.sleep(0.05)
+        ages = c.worker_status()
+        assert "0" in ages and ages["0"] < 5.0
+        assert "1" not in ages  # trainer 1 never heartbeated
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------------------
+# fleet epoch checkpoints
+# --------------------------------------------------------------------------
+def _build_model():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = L.data("x", [4], stop_gradient=False)
+        y = L.fc(x, 3, param_attr=pt.param_attr.ParamAttr(name="ckpt_w"))
+        loss = L.reduce_mean(y)
+        optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_fleet_save_load_check_point(tmp_path):
+    root = str(tmp_path / "ckpts")
+    fleet = Collective()
+    main, startup, loss = _build_model()
+    fleet.main_program = main
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+
+    from paddle_tpu.framework import scope as scope_mod
+    w0 = np.asarray(scope_mod._global_scope.find_var("ckpt_w").get_tensor())
+
+    # save three epochs
+    for epoch in range(3):
+        fleet.save_check_point(exe, root, TrainStatus(epoch),
+                               main_program=main)
+    fs = LocalFS()
+    dirs = sorted(fs.list_dirs(root))
+    assert dirs == [f"__paddle_fleet_checkpoint__.{i}" for i in range(3)]
+
+    # rotation keeps only the newest
+    fleet.clean_redundant_check_points(root, checkpoint_num=1)
+    assert fs.list_dirs(root) == ["__paddle_fleet_checkpoint__.2"]
+
+    # clobber the weights, then restore from the newest checkpoint
+    scope_mod._global_scope.set("ckpt_w", np.zeros_like(w0))
+    status = fleet.load_check_point(exe, root, main_program=main)
+    assert status is not None and status._epoch_no == 2
+    w1 = np.asarray(scope_mod._global_scope.find_var("ckpt_w").get_tensor())
+    np.testing.assert_allclose(w1, w0)
+
+    # empty dir: ignore_empty=True -> None; False -> assert
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert fleet.load_check_point(exe, empty, main_program=main) is None
+    with pytest.raises(AssertionError):
+        fleet.load_check_point(exe, empty, main_program=main,
+                               ignore_empty=False)
+
+
+def test_train_status():
+    assert TrainStatus(3).next() == 4
+    assert TrainStatus(3) == TrainStatus(3)
+    assert TrainStatus(3) != TrainStatus(4)
+
+
+# --------------------------------------------------------------------------
+# ModelAverage windowed semantics (reference: average_accumulates_op.h)
+# --------------------------------------------------------------------------
+def test_model_average_windowed():
+    from paddle_tpu.framework import scope as scope_mod
+
+    rng = np.random.RandomState(0)
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = L.data("x", [4], stop_gradient=False)
+        y = L.fc(x, 1, param_attr=pt.param_attr.ParamAttr(name="ma_w"),
+                 bias_attr=False)
+        loss = L.reduce_mean(y)
+        optim.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        ma = optim.ModelAverage(0.5, min_average_window=2,
+                                max_average_window=100)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    feeds = {"x": rng.rand(8, 4).astype("float32")}
+
+    seen = []
+    for _ in range(6):
+        exe.run(main, feed=feeds, fetch_list=[loss.name])
+        seen.append(np.asarray(
+            scope_mod._global_scope.find_var("ma_w").get_tensor()).copy())
+
+    # window: num_accumulates resets whenever na >= max(min_w, nu*0.5);
+    # replicate the reference recurrence on the recorded params
+    s1 = np.zeros_like(seen[0]); s2 = np.zeros_like(seen[0])
+    s3 = np.zeros_like(seen[0]); na = ona = nu = 0
+    for p in seen:
+        nu += 1; na += 1; s1 = s1 + p
+        window = min(100, int(nu * 0.5))
+        if na >= 2 and na >= window:
+            s3 = s1 + s2; s1 = np.zeros_like(s1); s2 = np.zeros_like(s2)
+            ona = na; na = 0
+    expect = (s1 + s2 + s3) / max(na + ona, 1)
+
+    raw = np.asarray(scope_mod._global_scope.find_var("ma_w").get_tensor()).copy()
+    with ma.apply(exe):
+        applied = np.asarray(
+            scope_mod._global_scope.find_var("ma_w").get_tensor()).copy()
+    restored = np.asarray(
+        scope_mod._global_scope.find_var("ma_w").get_tensor()).copy()
+
+    np.testing.assert_allclose(applied, expect, atol=1e-5)
+    np.testing.assert_allclose(restored, raw, atol=1e-7)  # restore exact
+    assert np.abs(applied - raw).max() > 1e-6  # average != last value

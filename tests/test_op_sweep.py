@@ -924,6 +924,7 @@ COVERED_ELSEWHERE = {
     "is_empty": "covered-in-sweep", "assert_op": "host side-effect",
     "py_func": "test_layers_tail",
     "sequence_scatter": "test_layers_tail", "cvm": "test_layers_tail",
+    "average_accumulates": "test_failure_detection(ModelAverage oracle)",
     "filter_by_instag": "host dynamic shape, test_layers_tail",
     "reorder_lod_tensor_by_rank": "test_layers_tail",
     # batch_norm: 5-output stateful train path — test_ops_basic + test_models

@@ -196,3 +196,63 @@ def test_grad_allreduce_transpiler_graph():
     assert first_ar < first_sgd
     stypes = [op.type for op in startup.global_block().ops]
     assert "c_comm_init_all" in stypes
+
+
+def test_hierarchical_allreduce_parity():
+    """Hierarchical (2-D inter x intra mesh, RS->AR->AG) must match the
+    flat allreduce losses exactly — the multi_devices_graph_pass
+    hierarchical-ring analog on a 2x4 virtual mesh."""
+    from paddle_tpu.incubate.fleet.collective import (
+        Collective, DistributedStrategy)
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        UserDefinedCollectiveRoleMaker)
+
+    xs, ys = _data()
+
+    # single-device reference
+    main_s, startup_s, loss_s = _build_model()
+    scope_a = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    init = _init_params(startup_s, scope_a)
+    ref_losses = [
+        float(exe.run(main_s, feed={"x": xs, "y": ys},
+                      fetch_list=[loss_s], scope=scope_a)[0])
+        for _ in range(5)
+    ]
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    fleet = Collective()
+    fleet.init(UserDefinedCollectiveRoleMaker(0, ["127.0.0.1:6170"]))
+    strategy = DistributedStrategy()
+    strategy.use_hierarchical_allreduce = True
+    strategy.hierarchical_allreduce_inter_nranks = 4  # 2 groups x 4 devices
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 32, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGDOptimizer(0.1)
+        fleet.distributed_optimizer(opt, strategy).minimize(loss)
+
+    types = [op.type for op in main.global_block().ops]
+    assert "c_reducescatter" in types, types       # hierarchical stage 1
+    assert "c_allgather" in types, types           # hierarchical stage 3
+    mesh = mesh_mod.registry().get("hierarchical")
+    assert mesh is not None and mesh.axis_names == ("inter", "intra")
+
+    scope_b = Scope()
+    exe.run(startup, scope=scope_b)
+    for k, v in init.items():
+        if scope_b.has(k):
+            scope_b.set(k, v.copy())
+
+    compiled = fleet.compiled_program(loss_name=loss.name)
+    hier_losses = []
+    for _ in range(5):
+        out = exe.run(compiled, feed={"x": xs, "y": ys},
+                      fetch_list=[loss], scope=scope_b)[0]
+        hier_losses.append(float(np.mean(out)))
+    np.testing.assert_allclose(ref_losses, hier_losses, rtol=1e-4, atol=1e-5)

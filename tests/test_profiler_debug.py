@@ -89,3 +89,28 @@ def test_unused_var_check_warns():
                     fetch_list=[out.name])
     finally:
         fluid.set_flags({"FLAGS_enable_unused_var_check": False})
+
+
+def test_op_error_carries_build_callstack():
+    """Executor errors name the failing op and its Python build site
+    (reference: framework/op_call_stack.cc)."""
+    import paddle_tpu as pt
+    import paddle_tpu.layers as L
+    from paddle_tpu.framework.core import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = L.data("x", [4])
+        y = L.data("y", [5])
+        out = main.global_block().create_var(name="bad_out", dtype="float32")
+        main.global_block().append_op(
+            "matmul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]})
+    exe = pt.Executor(pt.CPUPlace())
+    with pytest.raises(Exception) as ei:
+        exe.run(main, feed={"x": np.ones((2, 4), "float32"),
+                            "y": np.ones((2, 5), "float32")},
+                fetch_list=["bad_out"])
+    msg = "".join(str(a) for a in ei.value.args) + "".join(
+        getattr(ei.value, "__notes__", []))
+    assert "matmul" in msg, msg
+    assert "test_profiler_debug" in msg, msg  # build-site file named
