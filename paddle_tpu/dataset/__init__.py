@@ -9,3 +9,7 @@ stand-in with the same shapes/types, so book-style tests run offline.
 from . import mnist
 from . import uci_housing
 from . import cifar
+from . import imdb
+from . import wmt16
+from . import conll05
+from . import movielens

@@ -1,0 +1,75 @@
+"""CoNLL-2005 SRL reader (reference: python/paddle/dataset/conll05.py).
+
+API parity: get_dict() -> (word_dict, verb_dict, label_dict), test()
+yielding the 9-slot SRL tuple (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1,
+ctx_p2, verb_ids, mark, label_ids) used by the label_semantic_roles book
+chapter.  Offline fallback: synthetic sentences whose BIO labels are a
+deterministic function of word ids and predicate position, so the CRF
+tagger book model can actually fit them.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_WORDS = 400
+_VERBS = 20
+# BIO labels over 3 roles + O (reference label set is larger; same shape)
+_LABELS = ["B-A0", "I-A0", "B-A1", "I-A1", "B-V", "I-V", "O"]
+_SYN_N = 800
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(_WORDS)}
+    verb_dict = {f"v{i}": i for i in range(_VERBS)}
+    label_dict = {l: i for i, l in enumerate(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Deterministic synthetic word embedding table (reference downloads
+    emb; shape contract (len(word_dict), 32))."""
+    rng = np.random.RandomState(3)
+    return rng.rand(_WORDS, 32).astype("float32")
+
+
+def _label_for(word_id, dist_to_verb):
+    if dist_to_verb == 0:
+        return _LABELS.index("B-V")
+    if dist_to_verb == -1:
+        return _LABELS.index("B-A0")
+    if dist_to_verb < -1:
+        return _LABELS.index("I-A0") if word_id % 2 else _LABELS.index("O")
+    if dist_to_verb == 1:
+        return _LABELS.index("B-A1")
+    return _LABELS.index("I-A1") if word_id % 2 else _LABELS.index("O")
+
+
+def _reader(seed, n_samples):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_samples):
+            n = int(rng.randint(5, 15))
+            words = rng.randint(0, _WORDS, n)
+            vpos = int(rng.randint(0, n))
+            verb = int(words[vpos]) % _VERBS
+
+            def ctx(off):
+                i = vpos + off
+                return int(words[i]) if 0 <= i < n else 0
+
+            word_ids = [int(w) for w in words]
+            labels = [_label_for(int(w), i - vpos)
+                      for i, w in enumerate(words)]
+            mark = [1 if i == vpos else 0 for i in range(n)]
+            yield (word_ids, [ctx(-2)] * n, [ctx(-1)] * n, [ctx(0)] * n,
+                   [ctx(1)] * n, [ctx(2)] * n, [verb] * n, mark, labels)
+
+    return reader
+
+
+def train():
+    return _reader(0, _SYN_N)
+
+
+def test():
+    return _reader(1, _SYN_N // 4)

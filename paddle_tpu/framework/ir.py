@@ -1,0 +1,414 @@
+"""Program rewrite toolkit: Pass registry + DAG pattern matcher.
+
+Reference: paddle/fluid/framework/ir/pass.h:38 (Pass / PassRegistry),
+ir/graph_pattern_detector.cc (PDNode / PDPattern / GraphPatternDetector),
+ir/fuse_pass_base.h.  The reference rewrites an SSA graph of C++ nodes;
+here the Program's op list IS the graph (vars link ops by name), so a
+pass is a Python function over Blocks and a pattern is a list of op
+templates with producer constraints — the same detector contract with
+two orders of magnitude less machinery.
+
+TPU-first note: XLA already fuses elementwise chains, so passes here are
+about *semantic* rewrites XLA cannot do — mapping subgraphs onto Pallas
+kernels (fused attention), deleting train-only ops for inference, dead
+code elimination to cut trace/compile time.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .core import Block, Operator, Program
+
+# --------------------------------------------------------------------------
+# pass registry (reference: pass.h REGISTER_PASS)
+# --------------------------------------------------------------------------
+PASS_REGISTRY: Dict[str, type] = {}
+
+
+class Pass:
+    """Base pass: override apply_impl(program) -> program."""
+
+    name: str = ""
+
+    def apply(self, program: Program) -> Program:
+        out = self.apply_impl(program)
+        return out if out is not None else program
+
+    def apply_impl(self, program: Program) -> Optional[Program]:
+        raise NotImplementedError
+
+    def set(self, **attrs):
+        """Attribute injection like the reference's Pass::Set."""
+        for k, v in attrs.items():
+            setattr(self, k, v)
+        return self
+
+
+def register_pass(name: str):
+    def deco(cls):
+        cls.name = name
+        PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def get_pass(name: str, **attrs) -> Pass:
+    try:
+        cls = PASS_REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"pass {name!r} is not registered; have {sorted(PASS_REGISTRY)}"
+        ) from None
+    return cls().set(**attrs)
+
+
+class PassManager:
+    """Ordered pass pipeline (reference: the analysis pass manager /
+    build-strategy pass application loop)."""
+
+    def __init__(self, passes: Sequence):
+        self.passes = [p if isinstance(p, Pass) else get_pass(p)
+                       for p in passes]
+
+    def apply(self, program: Program) -> Program:
+        for p in self.passes:
+            program = p.apply(program)
+        return program
+
+
+# --------------------------------------------------------------------------
+# graph utilities
+# --------------------------------------------------------------------------
+def producer_map(block: Block) -> Dict[str, Operator]:
+    """var name -> last op writing it (SSA-enough for matched subgraphs)."""
+    prod = {}
+    for op_ in block.ops:
+        for names in op_.outputs.values():
+            for n in names:
+                prod[n] = op_
+    return prod
+
+
+def consumer_count(block: Block) -> Dict[str, int]:
+    cnt: Dict[str, int] = {}
+    for op_ in block.ops:
+        for names in op_.inputs.values():
+            for n in names:
+                cnt[n] = cnt.get(n, 0) + 1
+    return cnt
+
+
+def remove_ops(block: Block, ops: Sequence[Operator]):
+    dead = set(id(o) for o in ops)
+    block.ops[:] = [o for o in block.ops if id(o) not in dead]
+    block.program._bump_version()
+
+
+# --------------------------------------------------------------------------
+# pattern matcher (reference: graph_pattern_detector.cc)
+# --------------------------------------------------------------------------
+class OpTemplate:
+    """One PDNode: an op of `type` whose given input slots are fed by the
+    named output of an earlier template ("producer.Slot")."""
+
+    def __init__(self, name: str, type: str,
+                 inputs: Optional[Dict[str, str]] = None,
+                 predicate: Optional[Callable[[Operator], bool]] = None):
+        self.name = name
+        self.type = type
+        self.inputs = inputs or {}
+        self.predicate = predicate
+
+
+def match_pattern(block: Block, templates: Sequence[OpTemplate],
+                  allow_shared_intermediates: bool = False
+                  ) -> List[Dict[str, Operator]]:
+    """Find non-overlapping matches of the template DAG.
+
+    Like GraphPatternDetector: templates are matched in order; each
+    non-root template's constrained input slots must be fed by the var a
+    previously-matched template produced.  Unless
+    allow_shared_intermediates, every intermediate var (produced and
+    consumed inside the match) must have no consumers outside the match —
+    the detector's IsIntermediate() safety rule, which keeps a rewrite
+    from deleting a value someone else reads.
+    """
+    prod = producer_map(block)
+    cons = consumer_count(block)
+    by_type: Dict[str, List[Operator]] = {}
+    for op_ in block.ops:
+        by_type.setdefault(op_.type, []).append(op_)
+
+    matches: List[Dict[str, Operator]] = []
+    used: set = set()
+
+    def backtrack(i: int, bound: Dict[str, Operator]):
+        if i == len(templates):
+            matches.append(dict(bound))
+            return True  # first match per root wins (greedy)
+        t = templates[i]
+        for cand in by_type.get(t.type, []):
+            if id(cand) in used or any(id(cand) == id(o) for o in bound.values()):
+                continue
+            if t.predicate is not None and not t.predicate(cand):
+                continue
+            ok = True
+            for slot, src in t.inputs.items():
+                src_name, src_slot = src.split(".")
+                src_op = bound.get(src_name)
+                if src_op is None:
+                    ok = False
+                    break
+                in_names = cand.inputs.get(slot, [])
+                out_names = src_op.outputs.get(src_slot, [])
+                if not in_names or not out_names or in_names[0] not in out_names:
+                    ok = False
+                    break
+                if prod.get(in_names[0]) is not src_op:
+                    ok = False  # someone overwrote the var in between
+                    break
+            if not ok:
+                continue
+            bound[t.name] = cand
+            if backtrack(i + 1, bound):
+                return True
+            del bound[t.name]
+        return False
+
+    # try every candidate root, greedily claiming matched ops
+    for root in list(by_type.get(templates[0].type, [])):
+        if id(root) in used:
+            continue
+        if templates[0].predicate is not None and not templates[0].predicate(root):
+            continue
+        bound = {templates[0].name: root}
+        if backtrack(1, bound):
+            m = matches[-1]
+            # intermediate-safety check
+            if not allow_shared_intermediates and not _intermediates_private(
+                    m, cons):
+                matches.pop()
+                continue
+            used.update(id(o) for o in m.values())
+
+    return matches
+
+
+def _intermediates_private(match: Dict[str, Operator],
+                           cons: Dict[str, int]) -> bool:
+    ops = list(match.values())
+    internal_inputs: Dict[str, int] = {}
+    produced: Dict[str, Operator] = {}
+    for o in ops:
+        for names in o.outputs.values():
+            for n in names:
+                produced[n] = o
+    for o in ops:
+        for names in o.inputs.values():
+            for n in names:
+                if n in produced:
+                    internal_inputs[n] = internal_inputs.get(n, 0) + 1
+    for n, k in internal_inputs.items():
+        if cons.get(n, 0) != k:
+            return False  # consumed outside the match too
+    return True
+
+
+# --------------------------------------------------------------------------
+# built-in passes
+# --------------------------------------------------------------------------
+@register_pass("remove_training_ops_pass")
+class RemoveTrainingOpsPass(Pass):
+    """Drop backward/optimize/lr-sched ops by op role (reference: the
+    op-role filter inside Program._prune_with_input, io.py:1093) —
+    always run before inference DCE, else in-place optimizer updates
+    alias param names and reverse DCE drags training back in."""
+
+    def apply_impl(self, program):
+        from ..backward import OP_ROLE_KEY, OpRole
+
+        mask = OpRole.Backward | OpRole.Optimize | OpRole.LRSched
+        block = program.global_block()
+        block.ops[:] = [
+            op_ for op_ in block.ops
+            if not (int(op_.attrs.get(OP_ROLE_KEY, 0)) & mask)
+        ]
+        program._bump_version()
+        return program
+
+
+@register_pass("dead_code_elimination_pass")
+class DeadCodeEliminationPass(Pass):
+    """Remove ops whose outputs are transitively unused (reference:
+    ir/graph_helper + the inference prune pass).  `targets` (names) are
+    kept alive; host/side-effect ops are always kept.  strict=True also
+    removes persistable-writing ops not needed by the targets (the
+    inference-prune behavior); the default keeps them (state updates are
+    external effects in a training program)."""
+
+    targets: Sequence[str] = ()
+    strict: bool = False
+
+    SIDE_EFFECT_OPS = frozenset({
+        "print", "assert_op", "send", "recv", "send_barrier",
+        "fetch_barrier", "checkpoint_notify", "listen_and_serv",
+        "c_sync_calc_stream", "c_sync_comm_stream", "barrier",
+    })
+
+    def apply_impl(self, program):
+        block = program.global_block()
+        live = set(self.targets)
+        keep: List[Operator] = []
+        EMPTY = "@EMPTY@"
+        for op_ in reversed(block.ops):
+            out_names = [n for ns in op_.outputs.values() for n in ns
+                         if n != EMPTY]
+            is_live = any(n in live for n in out_names)
+            if not is_live and not self.strict:
+                # training graphs keep host side-effects; the strict
+                # (inference) mode prunes them like the reference's
+                # fetch-rooted prune does
+                is_live = op_.type in self.SIDE_EFFECT_OPS
+            # state-carrying ops (optimizers etc.) write their inputs in
+            # place: output name == input name means external effect when
+            # that var is persistable
+            if not is_live and not self.strict:
+                for n in out_names:
+                    v = block._find_var_recursive(n)
+                    if v is not None and getattr(v, "persistable", False):
+                        is_live = True
+                        break
+            if is_live:
+                keep.append(op_)
+                for ns in op_.inputs.values():
+                    live.update(n for n in ns if n != EMPTY)
+        block.ops[:] = list(reversed(keep))
+        program._bump_version()
+        return program
+
+
+@register_pass("delete_dropout_pass")
+class DeleteDropoutPass(Pass):
+    """Inference cleanup (reference: ir/delete_dropout_op_pass.cc):
+    upscale_in_train dropout becomes identity (assign); downgrade_in_infer
+    becomes scale(1-p)."""
+
+    def apply_impl(self, program):
+        block = program.global_block()
+        for i, op_ in enumerate(list(block.ops)):
+            if op_.type != "dropout":
+                continue
+            impl = op_.attrs.get("dropout_implementation", "downgrade_in_infer")
+            p = op_.attrs.get("dropout_prob", 0.5)
+            x = op_.inputs["X"]
+            out = {"Out": op_.outputs["Out"]}
+            idx = block.ops.index(op_)
+            remove_ops(block, [op_])
+            if impl == "upscale_in_train":
+                block._insert_op(idx, "assign", inputs={"X": x}, outputs=out)
+            else:
+                block._insert_op(idx, "scale", inputs={"X": x}, outputs=out,
+                                 attrs={"scale": 1.0 - p, "bias": 0.0})
+        return program
+
+
+def _is_scale_like(op_):
+    return op_.type == "scale" and op_.attrs.get("bias", 0.0) in (0, 0.0)
+
+
+def _is_qk_matmul(op_):
+    """Q @ K^T with plain Q and no trailing alpha surprises beyond the
+    scalar the rewrite folds into `scale`."""
+    return (op_.attrs.get("transpose_Y", False)
+            and not op_.attrs.get("transpose_X", False))
+
+
+def _is_av_matmul(op_):
+    """softmax(probs) @ V, untransposed, unscaled — the fused kernel has
+    no epilogue scaling."""
+    return (not op_.attrs.get("transpose_Y", False)
+            and not op_.attrs.get("transpose_X", False)
+            and op_.attrs.get("alpha", 1.0) in (1, 1.0))
+
+
+def _is_last_axis_softmax(op_):
+    return op_.attrs.get("axis", -1) in (-1, 3)
+
+
+@register_pass("fuse_multihead_attention_pass")
+class FuseMultiheadAttentionPass(Pass):
+    """Map the naive attention subgraph onto the Pallas flash-attention
+    kernel (reference intent: ir/multihead_matmul_fuse_pass.cc — there it
+    targets the cuda fused kernel; here `fused_multihead_attention`
+    lowers to ops/pallas_kernels.py flash_attention).
+
+    Matches, for Q/K/V of layout (batch, heads, seq, head_dim):
+        qk = matmul(Q, K, transpose_Y=True)        [alpha = any]
+        s  = scale(qk)                             [optional]
+        m  = elementwise_add(s, mask)              [optional]
+        sm = softmax(m)
+        out = matmul(sm, V)
+    and replaces the chain with one fused_multihead_attention op.
+    """
+
+    def apply_impl(self, program):
+        block = program.global_block()
+        # longest variant first so optional nodes are claimed when present
+        variants = [
+            [OpTemplate("qk", "matmul", predicate=_is_qk_matmul),
+             OpTemplate("scale", "scale", {"X": "qk.Out"},
+                        predicate=_is_scale_like),
+             OpTemplate("mask", "elementwise_add", {"X": "scale.Out"}),
+             OpTemplate("softmax", "softmax", {"X": "mask.Out"},
+                        predicate=_is_last_axis_softmax),
+             OpTemplate("av", "matmul", {"X": "softmax.Out"},
+                        predicate=_is_av_matmul)],
+            [OpTemplate("qk", "matmul", predicate=_is_qk_matmul),
+             OpTemplate("scale", "scale", {"X": "qk.Out"},
+                        predicate=_is_scale_like),
+             OpTemplate("softmax", "softmax", {"X": "scale.Out"},
+                        predicate=_is_last_axis_softmax),
+             OpTemplate("av", "matmul", {"X": "softmax.Out"},
+                        predicate=_is_av_matmul)],
+            [OpTemplate("qk", "matmul", predicate=_is_qk_matmul),
+             OpTemplate("mask", "elementwise_add", {"X": "qk.Out"}),
+             OpTemplate("softmax", "softmax", {"X": "mask.Out"},
+                        predicate=_is_last_axis_softmax),
+             OpTemplate("av", "matmul", {"X": "softmax.Out"},
+                        predicate=_is_av_matmul)],
+            [OpTemplate("qk", "matmul", predicate=_is_qk_matmul),
+             OpTemplate("softmax", "softmax", {"X": "qk.Out"},
+                        predicate=_is_last_axis_softmax),
+             OpTemplate("av", "matmul", {"X": "softmax.Out"},
+                        predicate=_is_av_matmul)],
+        ]
+        fused = 0
+        for templates in variants:
+            for m in match_pattern(block, templates):
+                self._rewrite(block, m)
+                fused += 1
+        self.fused_count = fused
+        return program
+
+    def _rewrite(self, block, m):
+        qk, av = m["qk"], m["av"]
+        q_name = qk.inputs["X"][0]
+        k_name = qk.inputs["Y"][0]
+        v_name = av.inputs["Y"][0]
+        out = {"Out": av.outputs["Out"]}
+        scale = qk.attrs.get("alpha", 1.0)
+        if "scale" in m:
+            scale = scale * m["scale"].attrs.get("scale", 1.0)
+        inputs = {"Q": [q_name], "K": [k_name], "V": [v_name]}
+        if "mask" in m:
+            inputs["BiasQK"] = [m["mask"].inputs["Y"][0]]
+        # insert where the AV matmul was: every value the fused op
+        # reads (Q/K/V and the mask) is produced before av, which is not
+        # guaranteed for qk (the mask may be computed after it)
+        idx = block.ops.index(av)
+        idx -= sum(1 for o in m.values() if block.ops.index(o) < idx)
+        remove_ops(block, list(m.values()))
+        block._insert_op(idx, "fused_multihead_attention",
+                         inputs=inputs, outputs=out,
+                         attrs={"scale": float(scale), "causal": False})

@@ -141,32 +141,21 @@ def load(program: Program, model_path: str, executor=None, var_list=None):
 
 # -- inference model export (reference: io.py:1093/:1303) ------------------
 def _prune_for_inference(program: Program, feed_names, fetch_names) -> Program:
-    """Backward DCE from fetches; drops optimizer/backward/feed-unrelated ops."""
-    pruned = program.clone(for_test=True)
-    block = pruned.global_block()
-    # drop backward/optimize/lr-sched ops first (reference prunes by
-    # op_role before DCE — io.py:1093 via Program._prune_with_input);
-    # without this, in-place optimizer updates alias param names and the
-    # reverse DCE below would drag the whole training graph back in.
-    from .backward import OP_ROLE_KEY, OpRole
+    """Backward DCE from fetches via the shared pass infra
+    (framework/ir.py: remove_training_ops_pass + strict DCE)."""
+    from .framework.ir import PassManager, get_pass
 
-    fwd_mask = OpRole.Backward | OpRole.Optimize | OpRole.LRSched
-    block.ops = [
-        op_ for op_ in block.ops
-        if not (int(op_.attrs.get(OP_ROLE_KEY, 0)) & fwd_mask)
-    ]
-    needed = set(fetch_names)
-    keep = []
-    for op_ in reversed(block.ops):
-        if any(n in needed for n in op_.output_arg_names):
-            keep.append(op_)
-            needed.update(n for n in op_.input_arg_names if n != "@EMPTY@")
-    keep.reverse()
-    block.ops = keep
+    pruned = program.clone(for_test=True)
+    PassManager([
+        "remove_training_ops_pass",
+        get_pass("dead_code_elimination_pass", targets=list(fetch_names),
+                 strict=True),
+    ]).apply(pruned)
+    block = pruned.global_block()
     # drop vars no longer referenced (keeps the exported desc minimal and
     # makes load_inference_model's persistable scan exact)
     referenced = set(feed_names) | set(fetch_names)
-    for op_ in keep:
+    for op_ in block.ops:
         referenced.update(op_.input_arg_names)
         referenced.update(op_.output_arg_names)
     for name in list(block.vars):

@@ -8,22 +8,38 @@ we need is flash attention (ops/pallas_kernels.py).
 """
 from __future__ import annotations
 
+import math
+
 from .registry import op
-from .pallas_kernels import flash_attention
+from .pallas_kernels import (
+    attention_reference,
+    flash_attention,
+    is_padding_bias,
+)
 
 
 @op("fused_multihead_attention")
 def _fused_mha(ctx):
     """Q/K/V: (batch, heads, seq, head_dim).  Optional BiasQK: additive
-    padding mask (b, kv) or (b,1,1,kv).  Attrs: scale (0 -> 1/sqrt(d)),
-    causal.  Reference: operators/fused/multihead_matmul_op.cu (fused
-    inference attention); here it serves training too via the Pallas
-    flash kernel's custom VJP."""
+    mask — padding shapes ((b,kv), (b,1,kv), (b,1,1,kv)) take the Pallas
+    flash kernel; full attention-matrix biases ((b,1,q,kv), (b,h,q,kv),
+    e.g. from the fuse_multihead_attention_pass on arbitrary masked
+    graphs) take the dense attention_reference path — still one XLA
+    fusion cluster on TPU.  Attrs: scale (0 -> 1/sqrt(d)), causal.
+    Reference: operators/fused/multihead_matmul_op.cu (fused inference
+    attention); here it serves training too via the flash kernel's
+    custom VJP."""
     q = ctx.in_("Q")
     k = ctx.in_("K")
     v = ctx.in_("V")
     bias = ctx.in_("BiasQK") if ctx.has_input("BiasQK") else None
     scale = ctx.attr("scale", 0.0) or None
     causal = ctx.attr("causal", False)
+    if bias is not None and not is_padding_bias(bias):
+        ctx.set_out("Out", attention_reference(
+            q, k, v, bias=bias, causal=causal,
+            scale=scale if scale is not None
+            else 1.0 / math.sqrt(q.shape[-1])))
+        return
     ctx.set_out("Out", flash_attention(q, k, v, bias=bias, causal=causal,
                                        scale=scale))

@@ -67,18 +67,35 @@ def _pick_block(seq: int, candidates=(512, 256, 128)) -> int | None:
 # Reference (jnp) implementation — the oracle and the fallback
 # ==========================================================================
 def attention_reference(q, k, v, bias=None, causal=False, scale=1.0):
-    """bias: additive, shape (b, kv_seq) or broadcastable (b,1,1,kv)."""
+    """Dense attention: the flash kernel's oracle AND the general-bias
+    fallback.  bias: additive — padding shapes ((b,kv), (b,1,kv),
+    (b,1,1,kv)) or a full attention matrix broadcastable to
+    (b, h, q, kv)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
-        b2 = _normalize_bias(bias)
-        s = s + b2[:, None, None, :].astype(s.dtype)
+        if is_padding_bias(bias):
+            b2 = _normalize_bias(bias)
+            s = s + b2[:, None, None, :].astype(s.dtype)
+        else:
+            s = s + bias.astype(s.dtype)  # (b,1,q,kv) / (b,h,q,kv)
     if causal:
         qlen, klen = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((qlen, klen), bool))
         s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def is_padding_bias(bias) -> bool:
+    """True for the per-key padding shapes the flash kernel handles."""
+    if bias.ndim == 2:
+        return True
+    if bias.ndim == 3 and bias.shape[1] == 1:
+        return True
+    if bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1:
+        return True
+    return False
 
 
 def _normalize_bias(bias):

@@ -243,3 +243,83 @@ def test_train_from_dataset_end_to_end(tmp_path):
     exe = fluid.Executor(pt.CPUPlace())
     exe.run(startup)
     exe.train_from_dataset(main, ds, fetch_list=[loss], print_period=100)
+
+
+def test_new_dataset_readers():
+    """imdb/wmt16/conll05/movielens readers: shapes, dtypes, determinism
+    (reference: python/paddle/dataset/{imdb,wmt16,conll05,movielens}.py)."""
+    from paddle_tpu.dataset import imdb, wmt16, conll05, movielens
+
+    wd = imdb.word_dict()
+    assert "<unk>" in wd
+    sample = next(imdb.train(wd)())
+    ids, label = sample
+    assert all(isinstance(i, int) and 0 <= i < len(wd) for i in ids)
+    assert label in (0, 1)
+    # determinism
+    assert next(imdb.train(wd)())[0] == ids
+
+    src, trg, trg_next = next(wmt16.train(100, 120)())
+    assert trg[0] == 0 and trg_next[-1] == 1            # <s> ... <e>
+    assert len(trg) == len(trg_next)
+    assert max(src) < 100 and max(trg_next) < 120
+    d = wmt16.get_dict("en", 100)
+    assert d["<s>"] == 0 and d["<e>"] == 1 and len(d) == 100
+
+    word_d, verb_d, label_d = conll05.get_dict()
+    row = next(conll05.test()())
+    assert len(row) == 9
+    n = len(row[0])
+    assert all(len(col) == n for col in row)            # aligned slots
+    assert sum(row[7]) == 1                             # exactly one predicate
+    assert all(0 <= l < len(label_d) for l in row[8])
+    emb = conll05.get_embedding()
+    assert emb.shape == (len(word_d), 32)
+
+    r = next(movielens.train()())
+    u, gender, age, job, m, cats, title, rating = r
+    assert 1 <= u <= movielens.max_user_id()
+    assert 1 <= m <= movielens.max_movie_id()
+    assert 0 <= job <= movielens.max_job_id()
+    assert 1.0 <= rating <= 5.0
+    assert all(0 <= t < len(movielens.get_movie_title_dict()) for t in title)
+
+
+def test_check_api_compat_tool(tmp_path):
+    """tools/check_api_compat.py dump+diff (reference:
+    tools/check_op_desc.py semantics)."""
+    import copy
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import check_api_compat as tool
+    finally:
+        sys.path.pop(0)
+
+    spec = tool.dump_specs()
+    assert "conv2d" in spec["ops"] and spec["ops"]["conv2d"]["has_grad"]
+    assert "fluid.layers.fc" in spec["apis"]
+
+    # identical specs: no changes
+    bad, ok = tool.diff_specs(spec, copy.deepcopy(spec))
+    assert not bad
+
+    # simulate breaking changes
+    newer = copy.deepcopy(spec)
+    del newer["ops"]["conv2d"]
+    newer["ops"]["relu"]["has_grad"] = False
+    fc = newer["apis"]["fluid.layers.fc"]
+    fc[2]["default"] = "'changed'"  # num_flatten_dims=1 -> changed
+    bad, ok = tool.diff_specs(spec, newer)
+    joined = "\n".join(bad)
+    assert "conv2d" in joined and "REMOVED" in joined
+    assert "lost its gradient" in joined
+    assert any("fluid.layers.fc" in b for b in bad)
+
+    # additions are compatible
+    newer2 = copy.deepcopy(spec)
+    newer2["ops"]["brand_new_op"] = {"has_grad": True, "stateful": False,
+                                     "host": False, "custom_infer": False,
+                                     "custom_grad_maker": False}
+    bad, ok = tool.diff_specs(spec, newer2)
+    assert not bad and any("brand_new_op" in o for o in ok)

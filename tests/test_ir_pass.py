@@ -1,0 +1,187 @@
+"""Pass/pattern-rewrite framework (reference: ir/pass.h:38,
+graph_pattern_detector.cc).  Covers the registry/PassManager, the DAG
+matcher's intermediate-safety rule, DCE, dropout deletion, and the
+flash-attention fusion pass with numeric parity."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.layers as L
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.ir import (
+    OpTemplate,
+    PassManager,
+    get_pass,
+    match_pattern,
+    register_pass,
+    Pass,
+)
+from paddle_tpu.framework.scope import Scope
+from paddle_tpu.framework import scope as scope_mod
+
+
+def _run(prog, feed, fetch):
+    scope = Scope()
+    prev = scope_mod._global_scope
+    scope_mod._global_scope = scope
+    try:
+        exe = pt.Executor(pt.CPUPlace())
+        return [np.asarray(v) for v in exe.run(prog, feed=feed,
+                                               fetch_list=fetch)]
+    finally:
+        scope_mod._global_scope = prev
+
+
+def _naive_attention(with_scale=True, with_mask=True):
+    prog = Program()
+    with program_guard(prog, Program()):
+        q = L.data("q", [2, 8, 16], append_batch_size=True)  # b,h,s,d
+        k = L.data("k", [2, 8, 16], append_batch_size=True)
+        v = L.data("v", [2, 8, 16], append_batch_size=True)
+        block = prog.global_block()
+
+        def mk(name):
+            return block.create_var(name=name, dtype="float32")
+
+        qk = mk("qk")
+        block.append_op("matmul", inputs={"X": [q], "Y": [k]},
+                        outputs={"Out": [qk]}, attrs={"transpose_Y": True})
+        cur = qk
+        if with_scale:
+            sc = mk("sc")
+            block.append_op("scale", inputs={"X": [cur]}, outputs={"Out": [sc]},
+                            attrs={"scale": 0.25})
+            cur = sc
+        if with_mask:
+            mask = L.data("mask", [1, 8, 8], append_batch_size=True)
+            mk_out = mk("masked")
+            block.append_op("elementwise_add", inputs={"X": [cur], "Y": [mask]},
+                            outputs={"Out": [mk_out]})
+            cur = mk_out
+        sm = mk("sm")
+        block.append_op("softmax", inputs={"X": [cur]}, outputs={"Out": [sm]})
+        out = mk("att_out")
+        block.append_op("matmul", inputs={"X": [sm], "Y": [v]},
+                        outputs={"Out": [out]})
+    return prog
+
+
+@pytest.mark.parametrize("with_scale,with_mask",
+                         [(True, True), (True, False),
+                          (False, True), (False, False)])
+def test_fuse_multihead_attention_numeric_parity(with_scale, with_mask):
+    rng = np.random.RandomState(0)
+    feed = {"q": rng.rand(1, 2, 8, 16).astype("float32"),
+            "k": rng.rand(1, 2, 8, 16).astype("float32"),
+            "v": rng.rand(1, 2, 8, 16).astype("float32")}
+    if with_mask:
+        feed["mask"] = np.where(rng.rand(1, 1, 8, 8) > 0.2, 0.0,
+                                -1e9).astype("float32")
+
+    prog = _naive_attention(with_scale, with_mask)
+    before = _run(prog, feed, ["att_out"])[0]
+
+    p = get_pass("fuse_multihead_attention_pass")
+    p.apply(prog)
+    types = [o.type for o in prog.global_block().ops]
+    assert p.fused_count == 1, types
+    assert "fused_multihead_attention" in types
+    assert "softmax" not in types  # chain consumed
+
+    after = _run(prog, feed, ["att_out"])[0]
+    np.testing.assert_allclose(after, before, atol=2e-3, rtol=2e-3)
+
+
+def test_fusion_blocked_by_shared_intermediate():
+    """The detector's IsIntermediate safety rule: if the softmax output is
+    consumed outside the chain, fusing would delete a live value — the
+    pass must not fire."""
+    prog = _naive_attention(False, False)
+    block = prog.global_block()
+    probe = block.create_var(name="probe", dtype="float32")
+    block.append_op("scale", inputs={"X": ["sm"]}, outputs={"Out": [probe]},
+                    attrs={"scale": 2.0})
+    p = get_pass("fuse_multihead_attention_pass")
+    p.apply(prog)
+    assert p.fused_count == 0
+    assert "fused_multihead_attention" not in [
+        o.type for o in block.ops]
+
+
+def test_match_pattern_chain():
+    prog = Program()
+    with program_guard(prog, Program()):
+        x = L.data("x", [4])
+        h = L.relu(x)
+        y = L.tanh(h)
+    block = prog.global_block()
+    m = match_pattern(block, [
+        OpTemplate("r", "relu"),
+        OpTemplate("t", "tanh", {"X": "r.Out"}),
+    ], allow_shared_intermediates=True)
+    assert len(m) == 1 and m[0]["r"].type == "relu"
+
+
+def test_dce_pass():
+    prog = Program()
+    with program_guard(prog, Program()):
+        x = L.data("x", [4])
+        used = L.relu(x)
+        _dead = L.tanh(x)          # unused branch
+        out = L.reduce_mean(used)
+    dce = get_pass("dead_code_elimination_pass", targets=[out.name])
+    dce.apply(prog)
+    types = [o.type for o in prog.global_block().ops]
+    assert "tanh" not in types and "relu" in types
+
+
+def test_delete_dropout_pass_parity():
+    rng = np.random.RandomState(0)
+    xs = rng.rand(4, 8).astype("float32")
+
+    prog = Program()
+    with program_guard(prog, Program()):
+        x = L.data("x", [8])
+        d = L.dropout(x, dropout_prob=0.3,
+                      dropout_implementation="upscale_in_train", is_test=True)
+        out = L.reduce_mean(d, dim=[1])
+    before = _run(prog, {"x": xs}, [out.name])[0]
+    get_pass("delete_dropout_pass").apply(prog)
+    types = [o.type for o in prog.global_block().ops]
+    assert "dropout" not in types
+    after = _run(prog, {"x": xs}, [out.name])[0]
+    np.testing.assert_allclose(after, before, atol=1e-6)
+
+
+def test_pass_registry_and_manager():
+    @register_pass("tmp_noop_pass_for_test")
+    class _Noop(Pass):
+        def apply_impl(self, program):
+            self.ran = True
+            return program
+
+    prog = Program()
+    pm = PassManager(["tmp_noop_pass_for_test"])
+    pm.apply(prog)
+    assert pm.passes[0].ran
+
+    with pytest.raises(KeyError):
+        get_pass("no_such_pass")
+
+
+def test_inference_prune_uses_pass_infra():
+    """save_inference_model's prune path now runs on the shared passes;
+    behavior check: training ops dropped, fetch-path kept."""
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.io import _prune_for_inference
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = L.data("x", [4], stop_gradient=False)
+        h = L.fc(x, 3)
+        loss = L.reduce_mean(h)
+        optim.SGDOptimizer(0.1).minimize(loss)
+    pruned = _prune_for_inference(prog, ["x"], [h.name])
+    types = [o.type for o in pruned.global_block().ops]
+    assert "sgd" not in types and not any(t.endswith("_grad") for t in types)
+    assert "mul" in types  # fc forward retained

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Op/API compatibility checker (reference: tools/check_op_desc.py +
+tools/check_api_compatible.py).
+
+The reference diffs serialized OpProto descs between two branches and
+flags incompatible changes (removed op, removed input/attr, attr default
+change).  Here the op registry has no static proto, so the spec of record
+is (a) every registered op type + its flags + grad availability, and
+(b) every public fluid.layers / paddle_tpu.tensor function signature.
+
+Usage:
+    python tools/check_api_compat.py dump SPEC.json
+    python tools/check_api_compat.py diff OLD.json NEW.json
+
+`diff` exits 1 when an incompatible change is found:
+  * removed op type / layer function
+  * op losing its gradient, or becoming host/stateful when it wasn't
+  * removed or reordered positional parameter; changed default value
+New ops / new functions / new params with defaults are compatible.
+"""
+from __future__ import annotations
+
+import inspect
+import json
+import sys
+
+
+def dump_specs():
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu  # noqa: F401  (registers everything)
+    import paddle_tpu.layers as layers_mod
+    import paddle_tpu.tensor as tensor_mod
+    from paddle_tpu.ops.registry import OPS, has_grad
+
+    ops = {}
+    for name, d in sorted(OPS.items()):
+        if name.endswith("_grad") or name.startswith(("py_func_", "load_")):
+            continue  # lazily materialized / per-call-registered
+        ops[name] = {
+            "has_grad": bool(has_grad(name)),
+            "stateful": bool(d.stateful),
+            "host": bool(d.host),
+            "custom_infer": d.infer_shape is not None,
+            "custom_grad_maker": d.grad_maker is not None,
+        }
+
+    def api_of(mod, prefix):
+        out = {}
+        for n in dir(mod):
+            if n.startswith("_"):
+                continue
+            fn = getattr(mod, n)
+            if not callable(fn) or inspect.isclass(fn) or inspect.ismodule(fn):
+                continue
+            try:
+                sig = inspect.signature(fn)
+            except (TypeError, ValueError):
+                continue
+            params = []
+            for p in sig.parameters.values():
+                params.append({
+                    "name": p.name,
+                    "kind": str(p.kind),
+                    "default": (None if p.default is inspect.Parameter.empty
+                                else repr(p.default)),
+                    "required": p.default is inspect.Parameter.empty
+                    and p.kind in (p.POSITIONAL_ONLY,
+                                   p.POSITIONAL_OR_KEYWORD),
+                })
+            out[f"{prefix}.{n}"] = params
+        return out
+
+    apis = {}
+    apis.update(api_of(layers_mod, "fluid.layers"))
+    apis.update(api_of(tensor_mod, "paddle.tensor"))
+    return {"version": 1, "ops": ops, "apis": apis}
+
+
+def diff_specs(old, new):
+    """Return (incompatible, compatible) human-readable change lists."""
+    bad, ok = [], []
+
+    for name, spec in old["ops"].items():
+        if name not in new["ops"]:
+            bad.append(f"op {name!r} was REMOVED")
+            continue
+        n = new["ops"][name]
+        if spec["has_grad"] and not n["has_grad"]:
+            bad.append(f"op {name!r} lost its gradient")
+        for flag in ("stateful", "host"):
+            if n[flag] and not spec[flag]:
+                bad.append(f"op {name!r} became {flag} (semantic change)")
+    for name in new["ops"]:
+        if name not in old["ops"]:
+            ok.append(f"op {name!r} added")
+
+    for fname, params in old["apis"].items():
+        if fname not in new["apis"]:
+            bad.append(f"function {fname} was REMOVED")
+            continue
+        nparams = new["apis"][fname]
+        nmap = {p["name"]: (i, p) for i, p in enumerate(nparams)}
+        for i, p in enumerate(params):
+            if p["name"] not in nmap:
+                bad.append(f"{fname}: parameter {p['name']!r} removed")
+                continue
+            j, np_ = nmap[p["name"]]
+            if p["required"] and j != i:
+                bad.append(f"{fname}: positional parameter {p['name']!r} "
+                           f"moved {i}->{j}")
+            if p["default"] is not None and np_["default"] != p["default"]:
+                bad.append(f"{fname}: default of {p['name']!r} changed "
+                           f"{p['default']} -> {np_['default']}")
+            if not p["required"] and np_["required"]:
+                bad.append(f"{fname}: parameter {p['name']!r} became required")
+        for np_ in nparams:
+            if np_["name"] not in {p["name"] for p in params}:
+                if np_["required"]:
+                    bad.append(f"{fname}: new REQUIRED parameter "
+                               f"{np_['name']!r}")
+                else:
+                    ok.append(f"{fname}: optional parameter "
+                              f"{np_['name']!r} added")
+    for fname in new["apis"]:
+        if fname not in old["apis"]:
+            ok.append(f"function {fname} added")
+    return bad, ok
+
+
+def main(argv):
+    if len(argv) >= 2 and argv[0] == "dump":
+        spec = dump_specs()
+        with open(argv[1], "w") as f:
+            json.dump(spec, f, indent=1, sort_keys=True)
+        print(f"wrote {len(spec['ops'])} ops, {len(spec['apis'])} api fns "
+              f"to {argv[1]}")
+        return 0
+    if len(argv) >= 3 and argv[0] == "diff":
+        with open(argv[1]) as f:
+            old = json.load(f)
+        with open(argv[2]) as f:
+            new = json.load(f)
+        bad, ok = diff_specs(old, new)
+        for line in ok:
+            print(f"[compatible]   {line}")
+        for line in bad:
+            print(f"[INCOMPATIBLE] {line}")
+        print(f"\n{len(bad)} incompatible, {len(ok)} compatible changes")
+        return 1 if bad else 0
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
