@@ -35,12 +35,19 @@ monkey_patch_variable()
 
 from . import control_flow
 from .control_flow import (  # noqa: F401
+    IfElse,
+    Switch,
     While,
+    array_length,
+    array_read,
+    array_write,
     case,
     cond,
+    create_array,
     equal,
     less_than,
     switch_case,
+    tensor_array_to_tensor,
     while_loop,
 )
 from . import sequence_lod
@@ -83,8 +90,34 @@ from .rnn import (  # noqa: F401
     lstm,
 )
 from .rnn import rnn  # noqa: F401  (function wins, as in the reference)
+from . import decoder as decoder_module
+from .decoder import (  # noqa: F401
+    BasicDecoder,
+    BeamSearchDecoder,
+    DecodeHelper,
+    Decoder,
+    DynamicRNN,
+    GreedyEmbeddingHelper,
+    SampleEmbeddingHelper,
+    TrainingHelper,
+    dynamic_decode,
+)
 from . import detection
 from .detection import (  # noqa: F401
+    box_decoder_and_assign,
+    collect_fpn_proposals,
+    distribute_fpn_proposals,
+    generate_mask_labels,
+    generate_proposal_labels,
+    generate_proposals,
+    locality_aware_nms,
+    multi_box_head,
+    prroi_pool,
+    psroi_pool,
+    retinanet_detection_output,
+    retinanet_target_assign,
+    roi_perspective_transform,
+    rpn_target_assign,
     polygon_box_transform,
     anchor_generator,
     bipartite_match,

@@ -234,3 +234,246 @@ def equal(x, y, cond=None):
     helper.append_op("equal", inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]}, attrs={"axis": -1})
     return out
+
+
+# --------------------------------------------------------------------------
+# LoDTensorArray layers (reference: control_flow.py array_write:1485,
+# array_read:1595, array_length, create_array, tensor.py
+# tensor_array_to_tensor).  Host-side arrays; see ops/control_ops.py for
+# the scope note on use inside While bodies.
+# --------------------------------------------------------------------------
+def create_array(dtype, initialized_list=None):
+    helper = LayerHelper("create_array")
+    out = helper.create_variable_for_type_inference(dtype)
+    out.type = VarType.LOD_TENSOR_ARRAY
+    helper.append_op("create_array", inputs={}, outputs={"Out": [out]})
+    if initialized_list:
+        for i, x in enumerate(initialized_list):
+            array_write(x, tensor_layers.fill_constant([1], "int64", i), out)
+    return out
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op("write_to_array",
+                     inputs={"X": [x], "I": [i], "Array": [array]},
+                     outputs={"Out": [array]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("read_from_array", inputs={"X": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op("lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=0, name=None, use_stack=False):
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference(VarType.INT32)
+    helper.append_op("tensor_array_to_tensor", inputs={"X": [input]},
+                     outputs={"Out": [out], "OutIndex": [idx]},
+                     attrs={"axis": axis, "use_stack": use_stack})
+    return out, idx
+
+
+# --------------------------------------------------------------------------
+# IfElse / Switch (reference: control_flow.py IfElse:3086, Switch:3375)
+# --------------------------------------------------------------------------
+class IfElse:
+    """Row-partitioned conditional (reference semantics: split rows by a
+    bool condition, run each branch on its partition, merge).
+
+    TPU-native realization: both branches run on the FULL batch and the
+    merge selects rows by the condition — identical results for the
+    row-wise computations IfElse supports, with static shapes for XLA
+    (the reference's gather/scatter by condition index has data-dependent
+    shapes)."""
+
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.cond = cond  # (N, 1) bool
+        self._in_true = None
+        self._true_out = None
+        self._false_out = None
+        self._inputs = []
+
+    class _Branch:
+        def __init__(self, owner, is_true):
+            self.owner = owner
+            self.is_true = is_true
+
+        def __enter__(self):
+            self.owner._in_true = self.is_true
+            return self
+
+        def __exit__(self, *a):
+            self.owner._in_true = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x):
+        """Inside a branch: the branch's view of x (full batch here; the
+        merge applies the row condition)."""
+        if self._in_true is None:
+            raise RuntimeError("IfElse.input() must be called inside "
+                               "true_block()/false_block()")
+        return x
+
+    def output(self, *outs):
+        if self._in_true is True:
+            self._true_out = list(outs)
+        elif self._in_true is False:
+            self._false_out = list(outs)
+        else:
+            raise RuntimeError("IfElse.output() must be called inside "
+                               "true_block()/false_block()")
+
+    def __call__(self):
+        if self._true_out is None or self._false_out is None:
+            raise RuntimeError("both branches must set output()")
+        if len(self._true_out) != len(self._false_out):
+            raise ValueError("branch outputs must pair up")
+        helper = LayerHelper("ifelse_merge")
+        merged = []
+        for t, f in zip(self._true_out, self._false_out):
+            out = helper.create_variable_for_type_inference(t.dtype)
+            helper.append_op("where",
+                             inputs={"Condition": [self.cond], "X": [t],
+                                     "Y": [f]},
+                             outputs={"Out": [out]})
+            merged.append(out)
+        return merged
+
+
+class Switch:
+    """Scoped case builder (reference: control_flow.py Switch:3375),
+    used mainly by LR schedulers:
+
+        with fluid.layers.Switch() as switch:
+            with switch.case(cond1):  assign(a, out)
+            with switch.default():    assign(b, out)
+
+    First matching case wins.  TPU-native lowering: each case body is
+    captured, its writes are redirected to per-case temporaries, and the
+    final value of every written var is a where-chain over the case
+    conditions (compute-all + select — static shapes; the bodies are
+    tiny scalar LR math in practice)."""
+
+    def __init__(self, name=None):
+        self._cases = []       # (cond_var or None, captured ops)
+        self._start = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is not None:
+            return False
+        self._materialize()
+        return False
+
+    class _Case:
+        def __init__(self, owner, cond):
+            self.owner = owner
+            self.cond = cond
+
+        def __enter__(self):
+            blk = default_main_program().current_block()
+            self.owner._start = len(blk.ops)
+            return self
+
+        def __exit__(self, exc_type, *a):
+            if exc_type is not None:
+                return False
+            blk = default_main_program().current_block()
+            captured = blk.ops[self.owner._start:]
+            del blk.ops[self.owner._start:]
+            self.owner._cases.append((self.cond, captured))
+            self.owner._start = None
+            return False
+
+    def case(self, condition):
+        return Switch._Case(self, condition)
+
+    def default(self):
+        return Switch._Case(self, None)
+
+    def _materialize(self):
+        from ..framework import unique_name
+
+        blk = default_main_program().current_block()
+        # re-emit each case with writes renamed to temporaries
+        case_vals = []  # (cond, {orig_name: temp_name})
+        for ci, (cond, ops) in enumerate(self._cases):
+            rename = {}
+            for op_ in ops:
+                new_inputs = {s_: [rename.get(n, n) for n in ns]
+                              for s_, ns in op_.inputs.items()}
+                new_outputs = {}
+                for s_, ns in op_.outputs.items():
+                    outs = []
+                    for n in ns:
+                        if n == "@EMPTY@":
+                            outs.append(n)
+                            continue
+                        tmp = rename.get(n)
+                        if tmp is None:
+                            tmp = unique_name.generate(f"{n}@SWITCH{ci}")
+                            v = blk._find_var_recursive(n)
+                            blk.create_var(
+                                name=tmp,
+                                dtype=v.dtype if v is not None else "float32")
+                            rename[n] = tmp
+                        outs.append(tmp)
+                    new_outputs[s_] = outs
+                blk.append_op(op_.type, inputs=new_inputs,
+                              outputs=new_outputs, attrs=dict(op_.attrs))
+            case_vals.append((cond, rename))
+
+        # merge per written var: first matching case wins, fallback = the
+        # var's pre-switch value
+        written = []
+        for _, rename in case_vals:
+            for n in rename:
+                if n not in written:
+                    written.append(n)
+        helper = LayerHelper("switch_merge")
+        for name in written:
+            current = name  # pre-switch value as the final fallback
+            for cond, rename in reversed(case_vals):
+                if name not in rename:
+                    continue
+                if cond is None:
+                    current = rename[name]
+                    continue
+                out = unique_name.generate(f"{name}@SWITCH_SEL")
+                v = blk._find_var_recursive(name)
+                blk.create_var(name=out,
+                               dtype=v.dtype if v is not None else "float32")
+                blk.append_op("where",
+                              inputs={"Condition": [cond],
+                                      "X": [rename[name]], "Y": [current]},
+                              outputs={"Out": [out]})
+                current = out
+            blk.append_op("assign", inputs={"X": [current]},
+                          outputs={"Out": [name]})
+

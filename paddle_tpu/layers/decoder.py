@@ -1,0 +1,582 @@
+"""Seq2seq decode API: Decoder / BasicDecoder / BeamSearchDecoder /
+decode helpers / dynamic_decode / DynamicRNN.
+
+Reference: python/paddle/fluid/layers/rnn.py (Decoder:1233,
+BeamSearchDecoder:1318, dynamic_decode:1741, DecodeHelper ff.) and
+control_flow.py DynamicRNN:3478.
+
+TPU-first design: the reference drives decoding with a While op over
+LoDTensorArrays (dynamic lengths).  XLA wants static shapes, so
+``dynamic_decode`` unrolls up to ``max_step_num`` steps at build time
+with a `finished` mask carried across steps — every step's ops are real
+program ops (works in static graph AND dygraph), outputs are stacked
+along time, and early finish is realized by masking rather than early
+exit (on TPU the masked steps cost nothing once batch rows are done
+being useful — same trick the rnn()/StaticRNN layers here already use).
+DynamicRNN likewise becomes a masked unroll over the padded+length
+representation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.dtype import VarType
+from ..layer_helper import LayerHelper
+from . import nn as nn_layers
+from . import tensor as tensor_layers
+from .nn_tail import gather_tree
+
+
+class Decoder:
+    """Abstract decode contract (reference: rnn.py Decoder:1233)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+# --------------------------------------------------------------------------
+# decode helpers (teacher forcing / greedy / sampling)
+# --------------------------------------------------------------------------
+class DecodeHelper:
+    """reference: rnn.py DecodeHelper — supplies initial inputs, sampling
+    rule, and next-step inputs for BasicDecoder."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing from padded (batch, T, ...) inputs + lengths
+    (reference: rnn.py TrainingHelper)."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = inputs
+        self.sequence_length = sequence_length
+        self.time_major = time_major
+
+    def _slice(self, t):
+        if self.time_major:
+            sl = nn_layers.slice(self.inputs, axes=[0], starts=[t],
+                                 ends=[t + 1])
+            return nn_layers.squeeze(sl, axes=[0])
+        sl = nn_layers.slice(self.inputs, axes=[1], starts=[t], ends=[t + 1])
+        return nn_layers.squeeze(sl, axes=[1])
+
+    def initialize(self):
+        self._max_t = (self.inputs.shape[0] if self.time_major
+                       else self.inputs.shape[1])
+        init_inputs = self._slice(0)
+        # finished_0[b] = (seq_len[b] <= 0)
+        from .nn_tail import less_equal
+        zero = tensor_layers.fill_constant([1], "int64", 0)
+        fin = less_equal(self.sequence_length, zero)
+        return init_inputs, fin
+
+    def sample(self, time, outputs, states):
+        return tensor_layers.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        t1 = min(time + 1, self._max_t - 1)
+        from .nn_tail import less_equal
+        bound = tensor_layers.fill_constant([1], "int64", time + 1)
+        finished = less_equal(self.sequence_length, bound)
+        return finished, self._slice(t1), states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Feed back argmax ids through an embedding fn (reference: rnn.py
+    GreedyEmbeddingHelper)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = start_tokens  # (batch,) int64 var
+        self.end_token = end_token
+
+    def initialize(self):
+        from .nn_tail import not_equal
+        init_inputs = self.embedding_fn(self.start_tokens)
+        same = not_equal(self.start_tokens, self.start_tokens)  # all False
+        return init_inputs, same
+
+    def sample(self, time, outputs, states):
+        return tensor_layers.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        from .nn_tail import logical_or
+        from .control_flow import equal
+        end = tensor_layers.fill_constant([1], sample_ids.dtype
+                                          if hasattr(sample_ids, "dtype")
+                                          else "int64", self.end_token)
+        finished = equal(sample_ids, end)
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Multinomial sampling instead of argmax (reference: rnn.py
+    SampleEmbeddingHelper)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self.seed = seed
+
+    def sample(self, time, outputs, states):
+        logits = outputs
+        if self.temperature is not None:
+            logits = nn_layers.scale(logits, scale=1.0 / self.temperature) \
+                if hasattr(nn_layers, "scale") else logits / self.temperature
+        probs = nn_layers.softmax(logits)
+        helper = LayerHelper("sampling_id")
+        out = helper.create_variable_for_type_inference(VarType.INT64)
+        helper.append_op("sampling_id", inputs={"X": [probs]},
+                         outputs={"Out": [out]},
+                         attrs={"seed": self.seed or 0})
+        return out
+
+
+class BasicDecoder(Decoder):
+    """cell + helper + optional output layer (reference: rnn.py
+    BasicDecoder).  step returns ((cell_outputs, sample_ids), states,
+    next_inputs, finished)."""
+
+    class OutputWrapper:
+        def __init__(self, cell_outputs, sample_ids):
+            self.cell_outputs = cell_outputs
+            self.sample_ids = sample_ids
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        init_inputs, init_finished = self.helper.initialize()
+        return init_inputs, initial_cell_states, init_finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, cell_states = self.cell(inputs, states)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, cell_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, cell_outputs, cell_states, sample_ids)
+        return (BasicDecoder.OutputWrapper(cell_outputs, sample_ids),
+                next_states, next_inputs, finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+# --------------------------------------------------------------------------
+# beam search decoder
+# --------------------------------------------------------------------------
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNNCell (reference: rnn.py
+    BeamSearchDecoder:1318).
+
+    States/values carry a beam dim merged into batch: (batch*beam, ...).
+    step() expands to (batch, beam*vocab) scores, takes top-k beams,
+    gathers cell states by parent beam, and records parent ids;
+    finalize() backtracks with gather_tree."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """(batch, ...) -> (batch*beam, ...) (reference: rnn.py
+        tile_beam_merge_with_batch)."""
+        x = nn_layers.unsqueeze(x, axes=[1])
+        tiles = [1, beam_size] + [1] * (len(x.shape) - 2)
+        x = nn_layers.expand(x, expand_times=tiles)
+        shape = [-1] + [int(s) for s in x.shape[2:]]
+        return nn_layers.reshape(x, shape)
+
+    def _split_batch_beams(self, x):
+        return nn_layers.reshape(x, [-1, self.beam_size]
+                                 + [int(s) for s in x.shape[1:]])
+
+    def _merge_batch_beams(self, x):
+        return nn_layers.reshape(x, [-1] + [int(s) for s in x.shape[2:]])
+
+    def initialize(self, initial_cell_states):
+        """initial_cell_states: (batch, ...) per leaf — tiled to beams."""
+        import paddle_tpu.layers as L
+
+        states = _map_structure(
+            lambda s: self.tile_beam_merge_with_batch(s, self.beam_size),
+            initial_cell_states)
+        # start ids: (batch, beam) filled with start_token
+        ref = _first_leaf(initial_cell_states)
+        start = L.fill_constant_batch_size_like(
+            ref, [-1, self.beam_size], "int64", self.start_token)
+        init_inputs = self.embedding_fn(
+            self._merge_batch_beams_int(start)) if self.embedding_fn \
+            else self._merge_batch_beams_int(start)
+        # beam log probs: first beam 0, others -inf so step 1 picks beam 0
+        probs_row = np.zeros((1, self.beam_size), np.float32)
+        probs_row[0, 1:] = -1e9
+        log_probs = _bcast_rows(ref, probs_row, self.beam_size)
+        finished = L.fill_constant_batch_size_like(
+            ref, [-1, self.beam_size], "bool", False)
+        beam_state = {"cell_states": states, "log_probs": log_probs,
+                      "finished": finished}
+        return init_inputs, beam_state, finished
+
+    def _merge_batch_beams_int(self, x):
+        return nn_layers.reshape(x, [-1])
+
+    def step(self, time, inputs, states, **kwargs):
+        import paddle_tpu.layers as L
+
+        cell_states = states["cell_states"]
+        cell_out, next_cell_states = self.cell(inputs, cell_states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)          # (b*beam, vocab)
+        vocab = int(cell_out.shape[-1])
+        logp = nn_layers.log_softmax(cell_out)
+        logp = nn_layers.reshape(logp, [-1, self.beam_size, vocab])
+
+        # finished beams only extend with end_token at zero cost
+        fin = states["finished"]                          # (b, beam) bool
+        fin_f = tensor_layers.cast(fin, "float32")
+        mask = _end_token_mask(vocab, self.end_token)     # (vocab,) 0/-1e9
+        # cost for finished rows: 0 for end_token, -1e9 otherwise
+        logp = logp * nn_layers.reshape(1.0 - fin_f, [-1, self.beam_size, 1]) \
+            + nn_layers.reshape(fin_f, [-1, self.beam_size, 1]) * mask
+
+        total = nn_layers.reshape(states["log_probs"],
+                                  [-1, self.beam_size, 1]) + logp
+        flat = nn_layers.reshape(total, [-1, self.beam_size * vocab])
+        topk_probs, topk_idx = nn_layers.topk(flat, k=self.beam_size)
+        parent = _floordiv(topk_idx, vocab)               # (b, beam)
+        token = _mod(topk_idx, vocab)                     # (b, beam)
+
+        next_cell_states = _map_structure(
+            lambda s: _gather_beams(s, parent, self.beam_size),
+            next_cell_states)
+        from .nn_tail import logical_or
+        from .control_flow import equal
+        end = tensor_layers.fill_constant([1], "int64", self.end_token)
+        prev_fin = _gather_beams_2d(fin, parent, self.beam_size)
+        now_fin = logical_or(prev_fin, equal(token, end))
+
+        beam_state = {"cell_states": next_cell_states,
+                      "log_probs": topk_probs, "finished": now_fin}
+        next_inputs = (self.embedding_fn(nn_layers.reshape(token, [-1]))
+                       if self.embedding_fn
+                       else nn_layers.reshape(token, [-1]))
+        outputs = {"scores": topk_probs, "predicted_ids": token,
+                   "parent_ids": parent}
+        return outputs, beam_state, next_inputs, now_fin
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """outputs: dict of stacked (T, b, beam) tensors -> backtracked
+        predicted ids (T, b, beam) via gather_tree."""
+        preds = gather_tree(outputs["predicted_ids"], outputs["parent_ids"])
+        return preds, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+# --------------------------------------------------------------------------
+# functional pieces built on existing layers (kept op-level for jit)
+# --------------------------------------------------------------------------
+def _bcast_rows(ref, row, beam_size):
+    """(1, beam) numpy row -> (batch, beam) var matching ref's batch."""
+    import paddle_tpu.layers as L
+
+    base = L.fill_constant_batch_size_like(ref, [-1, beam_size], "float32",
+                                           0.0)
+    helper = LayerHelper("switch_add_row")
+    const = tensor_layers.assign(row.astype("float32"))
+    out = helper.create_variable_for_type_inference(base.dtype)
+    helper.append_op("elementwise_add", inputs={"X": [base], "Y": [const]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _end_token_mask(vocab, end_token):
+    m = np.full((vocab,), -1e9, np.float32)
+    m[end_token] = 0.0
+    return tensor_layers.assign(m)
+
+
+def _floordiv(x, v):
+    helper = LayerHelper("floordiv")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    const = tensor_layers.fill_constant([1], x.dtype, v)
+    helper.append_op("elementwise_floordiv", inputs={"X": [x], "Y": [const]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _mod(x, v):
+    helper = LayerHelper("mod")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    const = tensor_layers.fill_constant([1], x.dtype, v)
+    helper.append_op("elementwise_mod", inputs={"X": [x], "Y": [const]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _gather_beams(s, parent, beam_size):
+    """s: (b*beam, ...) gather by parent (b, beam) -> (b*beam, ...)."""
+    helper = LayerHelper("beam_gather")
+    sb = nn_layers.reshape(s, [-1, beam_size] + [int(d) for d in s.shape[1:]])
+    out = helper.create_variable_for_type_inference(s.dtype)
+    helper.append_op("beam_gather_states",
+                     inputs={"X": [sb], "Ids": [parent]},
+                     outputs={"Out": [out]})
+    return nn_layers.reshape(out, [-1] + [int(d) for d in s.shape[1:]])
+
+
+def _gather_beams_2d(s, parent, beam_size):
+    helper = LayerHelper("beam_gather")
+    out = helper.create_variable_for_type_inference(s.dtype)
+    helper.append_op("beam_gather_states", inputs={"X": [s], "Ids": [parent]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _map_structure(fn, states):
+    if isinstance(states, (list, tuple)):
+        return type(states)(_map_structure(fn, s) for s in states)
+    if isinstance(states, dict):
+        return {k: _map_structure(fn, v) for k, v in states.items()}
+    return fn(states)
+
+
+def _first_leaf(states):
+    if isinstance(states, (list, tuple)):
+        return _first_leaf(states[0])
+    if isinstance(states, dict):
+        return _first_leaf(next(iter(states.values())))
+    return states
+
+
+# --------------------------------------------------------------------------
+# dynamic_decode
+# --------------------------------------------------------------------------
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major
+                   =False, is_test=False, return_length=False, **kwargs):
+    """reference: rnn.py dynamic_decode:1741 — drive decoder.step until
+    every sequence is finished or max_step_num; here a build-time unroll
+    with a carried finished mask (see module docstring)."""
+    import paddle_tpu.layers as L
+    from .nn_tail import logical_or, logical_not
+
+    assert max_step_num is not None, (
+        "dynamic_decode on TPU needs max_step_num (static unroll bound)")
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    lengths = None
+    for t in range(int(max_step_num)):
+        # a step COUNTS when the sequence was unfinished before it (so the
+        # EOS-emitting step is included, like the reference's While loop)
+        alive_before = logical_not(finished)
+        outputs, states, inputs, step_fin = decoder.step(t, inputs, states,
+                                                         **kwargs)
+        step_outputs.append(outputs)
+        finished = step_fin if decoder.tracks_own_finished else \
+            logical_or(finished, step_fin)
+        step_count = tensor_layers.cast(alive_before, "int64")
+        lengths = step_count if lengths is None else lengths + step_count
+
+    # stack along time (T, ...) per structure leaf
+    def stack_leaves(leaves):
+        helper = LayerHelper("decode_stack")
+        out = helper.create_variable_for_type_inference(leaves[0].dtype)
+        helper.append_op("stack", inputs={"X": list(leaves)},
+                         outputs={"Y": [out]}, attrs={"axis": 0})
+        return out
+
+    if isinstance(step_outputs[0], dict):
+        stacked = {k: stack_leaves([o[k] for o in step_outputs])
+                   for k in step_outputs[0]}
+    elif isinstance(step_outputs[0], BasicDecoder.OutputWrapper):
+        stacked = BasicDecoder.OutputWrapper(
+            stack_leaves([o.cell_outputs for o in step_outputs]),
+            stack_leaves([o.sample_ids for o in step_outputs]))
+    else:
+        stacked = stack_leaves(step_outputs)
+
+    final_outputs, final_states = decoder.finalize(stacked, states, lengths)
+    if not output_time_major:
+        final_outputs = _map_structure(_time_to_batch_major, final_outputs) \
+            if not isinstance(final_outputs, BasicDecoder.OutputWrapper) else \
+            BasicDecoder.OutputWrapper(
+                _time_to_batch_major(final_outputs.cell_outputs),
+                _time_to_batch_major(final_outputs.sample_ids))
+    if return_length:
+        return final_outputs, final_states, lengths
+    return final_outputs, final_states
+
+
+def _time_to_batch_major(x):
+    perm = [1, 0] + list(range(2, len(x.shape)))
+    return nn_layers.transpose(x, perm)
+
+
+# --------------------------------------------------------------------------
+# DynamicRNN: masked unroll over padded+length batches
+# --------------------------------------------------------------------------
+class DynamicRNN:
+    """reference: control_flow.py DynamicRNN:3478 — step-wise RNN builder
+    over ragged sequences.  The reference shrinks the batch as sequences
+    end; on the padded+length repr we keep the full batch and mask state
+    updates past each row's length (numerically identical outputs)."""
+
+    def __init__(self, name=None):
+        self._inputs = []       # (var, lengths)
+        self._memories = []     # [dict(var=current, init=...)]
+        self._outputs = []
+        self._in_rnn = False
+        self._max_len = None
+        self._step = None
+        self._step_outputs = []
+
+    def step_input(self, x, level=0, lengths=None):
+        """x: (batch, T, ...) padded; lengths: (batch,) int64."""
+        self._inputs.append((x, lengths))
+        self._max_len = int(x.shape[1])
+        return _StepSlice(self, len(self._inputs) - 1)
+
+    def static_input(self, x):
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32",
+               batch_ref=None):
+        import paddle_tpu.layers as L
+
+        if init is None:
+            ref = batch_ref if batch_ref is not None else self._inputs[0][0]
+            init = L.fill_constant_batch_size_like(
+                ref, [-1] + list(shape), dtype, value)
+        slot = {"cur": init}
+        self._memories.append(slot)
+        return _MemRef(self, len(self._memories) - 1)
+
+    def update_memory(self, mem, new_val):
+        assert isinstance(mem, _MemRef)
+        self._pending_updates.append((mem.idx, new_val))
+
+    def output(self, *outputs):
+        self._cur_outputs = list(outputs)
+
+    def block(self):
+        return _DynRNNBlock(self)
+
+    def __call__(self):
+        """Stacked per-step outputs: (batch, T, ...) per output slot."""
+        outs = []
+        for slot in zip(*self._step_outputs):
+            helper = LayerHelper("drnn_stack")
+            out = helper.create_variable_for_type_inference(slot[0].dtype)
+            helper.append_op("stack", inputs={"X": list(slot)},
+                             outputs={"Y": [out]}, attrs={"axis": 1})
+            outs.append(out)
+        return outs[0] if len(outs) == 1 else outs
+
+
+class _StepSlice:
+    def __init__(self, drnn, idx):
+        self.drnn = drnn
+        self.idx = idx
+
+    def at(self, t):
+        x, _ = self.drnn._inputs[self.idx]
+        sl = nn_layers.slice(x, axes=[1], starts=[t], ends=[t + 1])
+        return nn_layers.squeeze(sl, axes=[1])
+
+
+class _MemRef:
+    def __init__(self, drnn, idx):
+        self.drnn = drnn
+        self.idx = idx
+
+    def value(self):
+        return self.drnn._memories[self.idx]["cur"]
+
+
+class _DynRNNBlock:
+    """with drnn.block(): body(t, slices, mems) — the body is a callable
+    registered via drnn.step_fn instead of a with-scope re-trace; see
+    DynamicRNN.run_steps."""
+
+    def __init__(self, drnn):
+        self.drnn = drnn
+
+    def __enter__(self):
+        raise NotImplementedError(
+            "DynamicRNN here uses run_steps(body_fn) instead of the "
+            "with-block builder: the reference re-executes the block per "
+            "step through the While machinery, which the static unroll "
+            "replaces — pass a body function, e.g.\n"
+            "  out = drnn.run_steps(lambda t, xs, mems: ...)")
+
+    def __exit__(self, *a):
+        return False
+
+
+def _drnn_masked(cur, new, lengths, t):
+    """new where t < len else cur (row mask)."""
+    import paddle_tpu.layers as L
+    from .nn_tail import greater_than
+
+    bound = tensor_layers.fill_constant([1], "int64", t)
+    active = greater_than(lengths, bound)          # (batch,) bool: len > t
+    # align the row mask to the value rank for elementwise select
+    for _ in range(len(new.shape) - 1):
+        active = nn_layers.unsqueeze(active, axes=[-1])
+    helper = LayerHelper("drnn_mask")
+    out = helper.create_variable_for_type_inference(new.dtype)
+    helper.append_op("where", inputs={"Condition": [active], "X": [new],
+                                      "Y": [cur]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _run_dynamic_rnn(drnn, body_fn):
+    for t in range(drnn._max_len):
+        drnn._pending_updates = []
+        xs = [_StepSlice(drnn, i).at(t) for i in range(len(drnn._inputs))]
+        mems = [_MemRef(drnn, i) for i in range(len(drnn._memories))]
+        drnn._cur_outputs = []
+        body_fn(t, xs, mems)
+        lengths = drnn._inputs[0][1]
+        for mi, new_val in drnn._pending_updates:
+            cur = drnn._memories[mi]["cur"]
+            drnn._memories[mi]["cur"] = (
+                _drnn_masked(cur, new_val, lengths, t)
+                if lengths is not None else new_val)
+        drnn._step_outputs.append(list(drnn._cur_outputs))
+    return drnn()
+
+
+DynamicRNN.run_steps = lambda self, body_fn: _run_dynamic_rnn(self, body_fn)

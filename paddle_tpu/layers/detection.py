@@ -281,3 +281,298 @@ def polygon_box_transform(input, name=None):
     helper.append_op("polygon_box_transform", inputs={"Input": [input]},
                      outputs={"Output": [out]})
     return out
+
+
+# --------------------------------------------------------------------------
+# RPN / proposal pipeline layers (ops in ops/detection_extra_ops.py)
+# --------------------------------------------------------------------------
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    """reference: layers/detection.py generate_proposals."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference("float32")
+    probs = helper.create_variable_for_type_inference("float32")
+    nums = helper.create_variable_for_type_inference("int32")
+    bid = helper.create_variable_for_type_inference("int32")
+    helper.append_op("generate_proposals",
+                     inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                             "ImInfo": [im_info], "Anchors": [anchors],
+                             "Variances": [variances]},
+                     outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                              "RpnRoisNum": [nums], "RoisBatchId": [bid]},
+                     attrs={"pre_nms_topN": pre_nms_top_n,
+                            "post_nms_topN": post_nms_top_n,
+                            "nms_thresh": nms_thresh, "min_size": min_size,
+                            "eta": eta})
+    if return_rois_num:
+        return rois, probs, nums
+    return rois, probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    helper = LayerHelper("rpn_target_assign")
+    outs = {k: helper.create_variable_for_type_inference(t) for k, t in
+            [("LocationIndex", "int32"), ("ScoreIndex", "int32"),
+             ("TargetBBox", "float32"), ("TargetLabel", "int32"),
+             ("BBoxInsideWeight", "float32")]}
+    helper.append_op("rpn_target_assign",
+                     inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes]},
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                            "rpn_fg_fraction": rpn_fg_fraction,
+                            "rpn_positive_overlap": rpn_positive_overlap,
+                            "rpn_negative_overlap": rpn_negative_overlap})
+    # reference returns pred/label gathers; expose the index form
+    return (outs["LocationIndex"], outs["ScoreIndex"], outs["TargetBBox"],
+            outs["TargetLabel"], outs["BBoxInsideWeight"])
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None, im_info=None,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    helper = LayerHelper("retinanet_target_assign")
+    outs = {k: helper.create_variable_for_type_inference(t) for k, t in
+            [("LocationIndex", "int32"), ("ScoreIndex", "int32"),
+             ("TargetBBox", "float32"), ("TargetLabel", "int32"),
+             ("BBoxInsideWeight", "float32"), ("ForegroundNumber", "int32")]}
+    helper.append_op("retinanet_target_assign",
+                     inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                             "GtLabels": [gt_labels]},
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs={"positive_overlap": positive_overlap,
+                            "negative_overlap": negative_overlap})
+    return (outs["LocationIndex"], outs["ScoreIndex"], outs["TargetBBox"],
+            outs["TargetLabel"], outs["BBoxInsideWeight"],
+            outs["ForegroundNumber"])
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    helper = LayerHelper("generate_proposal_labels")
+    outs = {k: helper.create_variable_for_type_inference(t) for k, t in
+            [("Rois", "float32"), ("LabelsInt32", "int32"),
+             ("BboxTargets", "float32"), ("BboxInsideWeights", "float32"),
+             ("BboxOutsideWeights", "float32")]}
+    helper.append_op("generate_proposal_labels",
+                     inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                             "GtBoxes": [gt_boxes]},
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs={"batch_size_per_im": batch_size_per_im,
+                            "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+                            "bg_thresh_hi": bg_thresh_hi,
+                            "bg_thresh_lo": bg_thresh_lo,
+                            "class_nums": class_nums})
+    return (outs["Rois"], outs["LabelsInt32"], outs["BboxTargets"],
+            outs["BboxInsideWeights"], outs["BboxOutsideWeights"])
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = helper.create_variable_for_type_inference("float32")
+    has_mask = helper.create_variable_for_type_inference("int32")
+    mask_int32 = helper.create_variable_for_type_inference("float32")
+    helper.append_op("generate_mask_labels",
+                     inputs={"Rois": [rois], "LabelsInt32": [labels_int32],
+                             "GtSegms": [gt_segms]},
+                     outputs={"MaskRois": [mask_rois],
+                              "RoiHasMaskInt32": [has_mask],
+                              "MaskInt32": [mask_int32]},
+                     attrs={"num_classes": num_classes,
+                            "resolution": resolution})
+    return mask_rois, has_mask, mask_int32
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    rois = helper.create_variable_for_type_inference("float32")
+    nums = helper.create_variable_for_type_inference("int32")
+    helper.append_op("collect_fpn_proposals",
+                     inputs={"MultiLevelRois": list(multi_rois),
+                             "MultiLevelScores": list(multi_scores)},
+                     outputs={"FpnRois": [rois], "RoisNum": [nums]},
+                     attrs={"post_nms_topN": post_nms_top_n})
+    return rois
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_levels = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference("float32")
+            for _ in range(n_levels)]
+    restore = helper.create_variable_for_type_inference("int32")
+    helper.append_op("distribute_fpn_proposals",
+                     inputs={"FpnRois": [fpn_rois]},
+                     outputs={"MultiFpnRois": outs,
+                              "RestoreIndex": [restore]},
+                     attrs={"min_level": min_level, "max_level": max_level,
+                            "refer_level": refer_level,
+                            "refer_scale": refer_scale})
+    return outs, restore
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None, rois_batch_id=None):
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    helper.append_op("psroi_pool", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"output_channels": output_channels,
+                            "spatial_scale": spatial_scale,
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width})
+    return out
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None,
+               rois_batch_id=None):
+    helper = LayerHelper("prroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    helper.append_op("prroi_pool", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"spatial_scale": spatial_scale,
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width})
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_batch_id=None):
+    helper = LayerHelper("roi_perspective_transform")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32")
+    mat = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    helper.append_op("roi_perspective_transform", inputs=inputs,
+                     outputs={"Out": [out], "Mask": [mask],
+                              "TransformMatrix": [mat]},
+                     attrs={"transformed_height": transformed_height,
+                            "transformed_width": transformed_width,
+                            "spatial_scale": spatial_scale})
+    return out, mask, mat
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                       nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                       background_label=-1, name=None):
+    helper = LayerHelper("locality_aware_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    helper.append_op("locality_aware_nms",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out]},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_threshold": nms_threshold,
+                            "keep_top_k": keep_top_k})
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    helper = LayerHelper("retinanet_detection_output")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("retinanet_detection_output",
+                     inputs={"BBoxes": list(bboxes), "Scores": list(scores),
+                             "Anchors": list(anchors)},
+                     outputs={"Out": [out]},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                            "nms_threshold": nms_threshold})
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    decoded = helper.create_variable_for_type_inference(target_box.dtype)
+    assigned = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box],
+              "BoxScore": [box_score]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_decoder_and_assign",
+                     inputs=inputs,
+                     outputs={"DecodeBox": [decoded],
+                              "OutputAssignBox": [assigned]},
+                     attrs={"box_clip": box_clip})
+    return decoded, assigned
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head over multiple feature maps (reference:
+    layers/detection.py multi_box_head): per-level prior boxes + conv
+    predictions for locations and confidences, concatenated."""
+    from . import nn as nn_layers
+
+    n_levels = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule (detection.py multi_box_head)
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_levels - 2))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[0], (list, tuple)) \
+            else aspect_ratios
+        box, var = prior_box(feat, image, [mins] if not isinstance(
+            mins, (list, tuple)) else mins,
+            [maxs] if maxs and not isinstance(maxs, (list, tuple)) else maxs,
+            list(ar), variance, flip=flip, clip=clip,
+            steps=[steps[i], steps[i]] if steps else [0.0, 0.0],
+            offset=offset)
+        num_priors = int(np.prod(box.shape[:-1])) // (
+            int(feat.shape[2]) * int(feat.shape[3]))
+        loc = nn_layers.conv2d(feat, num_priors * 4, kernel_size,
+                               padding=pad, stride=stride)
+        loc = nn_layers.transpose(loc, [0, 2, 3, 1])
+        loc = nn_layers.reshape(loc, [0, -1, 4])
+        conf = nn_layers.conv2d(feat, num_priors * num_classes, kernel_size,
+                                padding=pad, stride=stride)
+        conf = nn_layers.transpose(conf, [0, 2, 3, 1])
+        conf = nn_layers.reshape(conf, [0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(nn_layers.reshape(box, [-1, 4]))
+        vars_all.append(nn_layers.reshape(var, [-1, 4]))
+
+    from .tensor import concat
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    boxes = concat(boxes_all, axis=0)
+    variances = concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances

@@ -26,6 +26,7 @@ from . import control_ops  # noqa: F401
 from . import ps_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import detection_extra_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import vision_ops  # noqa: F401

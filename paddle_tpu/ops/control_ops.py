@@ -159,3 +159,75 @@ def _select_input(ctx):
     for i in range(1, len(xs)):
         out = lax.cond(mask == i, lambda a=xs[i]: a, lambda b=out: b)
     ctx.set_out("Out", out)
+
+
+# --------------------------------------------------------------------------
+# LoDTensorArray ops (reference: controlflow/lod_array_length_op.cc,
+# tensor_array_read_write_op.cc, tensor_array_to_tensor_op.cc).
+# TPU-native scope: arrays are host-side python lists in the executor env
+# (the executor's hybrid segmentation runs these between jit segments),
+# which covers the linear create->write->read/stack usage; inside a While
+# body XLA needs fixed shapes — use while_loop carries or the rnn/
+# dynamic_decode layers there (documented cut, layers/control_flow.py).
+# --------------------------------------------------------------------------
+class TensorArrayValue(list):
+    """Marker type for LOD_TENSOR_ARRAY values living in the env."""
+
+
+@op("create_array", no_grad=True, host=True)
+def _create_array(ctx):
+    ctx.set_out("Out", TensorArrayValue())
+
+
+@op("write_to_array", no_grad=True, host=True)
+def _write_to_array(ctx):
+    import numpy as _np
+
+    arr = ctx.env.get(ctx.op.inputs["Array"][0])
+    if not isinstance(arr, TensorArrayValue):
+        arr = TensorArrayValue() if arr is None else TensorArrayValue(arr)
+    x = ctx.in_("X")
+    i = int(_np.asarray(ctx.in_("I")).ravel()[0])
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = x
+    # output binds the SAME array name (reference mutates in place)
+    ctx.env[ctx.op.outputs["Out"][0]] = arr
+
+
+@op("read_from_array", no_grad=True, host=True)
+def _read_from_array(ctx):
+    import numpy as _np
+
+    arr = ctx.env.get(ctx.op.inputs["X"][0])
+    i = int(_np.asarray(ctx.in_("I")).ravel()[0])
+    if not isinstance(arr, (list, TensorArrayValue)) or i >= len(arr) \
+            or arr[i] is None:
+        raise IndexError(
+            f"read_from_array: index {i} not written "
+            f"(len={len(arr) if isinstance(arr, list) else 'n/a'})")
+    ctx.set_out("Out", arr[i])
+
+
+@op("lod_array_length", no_grad=True, host=True)
+def _lod_array_length(ctx):
+    arr = ctx.env.get(ctx.op.inputs["X"][0])
+    n = len(arr) if isinstance(arr, (list, TensorArrayValue)) else 0
+    ctx.set_out("Out", jnp.asarray([n], jnp.int64))
+
+
+@op("tensor_array_to_tensor", no_grad=True, host=True)
+def _tensor_array_to_tensor(ctx):
+    arr = ctx.env.get(ctx.op.inputs["X"][0])
+    axis = ctx.attr("axis", 0)
+    use_stack = ctx.attr("use_stack", False)
+    vals = [v for v in (arr or []) if v is not None]
+    if not vals:
+        raise ValueError("tensor_array_to_tensor: empty array")
+    if use_stack:
+        out = jnp.stack(vals, axis=axis)
+    else:
+        out = jnp.concatenate(vals, axis=axis)
+    ctx.set_out("Out", out)
+    ctx.set_out("OutIndex", jnp.asarray(
+        [jnp.shape(v)[axis] for v in vals], jnp.int32))

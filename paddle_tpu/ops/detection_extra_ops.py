@@ -1,0 +1,617 @@
+"""Detection long tail: RPN/proposal pipeline, FPN routing, PS/precise
+ROI pooling, RetinaNet heads, text-detection utilities.
+
+Capability parity with reference: paddle/fluid/operators/detection/
+generate_proposals_op.cc, rpn_target_assign_op.cc,
+generate_proposal_labels_op.cc, generate_mask_labels_op.cc,
+collect_fpn_proposals_op.cc, distribute_fpn_proposals_op.cc,
+prroi_pool_op.cc, psroi_pool_op.cc, retinanet_detection_output_op.cc,
+(retinanet_)target_assign, roi_perspective_transform_op.cc,
+locality_aware_nms_op.cc, box_decoder_and_assign_op.cc.
+
+TPU-first split: ops with data-dependent output sizes (proposal
+generation, sampling-based target assign, NMS variants, FPN routing)
+are host ops — the reference's kernels for these are CPU-only too; the
+dense pooling/warping ops (psroi/prroi/perspective) are pure jnp
+gather+lerp graphs that fuse on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+from .detection_ops import _iou_matrix, _nms_single
+
+
+# --------------------------------------------------------------------------
+# proposal generation (reference: generate_proposals_op.cc)
+# --------------------------------------------------------------------------
+def _decode_anchor_deltas(anchors, deltas, variances=None):
+    """anchor (R,4 xyxy) + delta (R,4 dx,dy,dw,dh) -> boxes xyxy."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + aw * 0.5
+    ay = anchors[:, 1] + ah * 0.5
+    if variances is None:
+        variances = np.ones_like(deltas)
+    dx, dy, dw, dh = (deltas[:, 0] * variances[:, 0],
+                      deltas[:, 1] * variances[:, 1],
+                      deltas[:, 2] * variances[:, 2],
+                      deltas[:, 3] * variances[:, 3])
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = np.exp(np.minimum(dw, 10.0)) * aw
+    h = np.exp(np.minimum(dh, 10.0)) * ah
+    return np.stack([cx - 0.5 * w, cy - 0.5 * h,
+                     cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=1)
+
+
+@op("generate_proposals", no_grad=True, host=True)
+def _generate_proposals(ctx):
+    """Scores (N,A,H,W), BboxDeltas (N,4A,H,W), ImInfo (N,3),
+    Anchors (H,W,A,4), Variances -> RpnRois (R,4), RpnRoiProbs (R,1),
+    RpnRoisNum (N,) + RoisBatchId for downstream pooling."""
+    scores = np.asarray(ctx.in_("Scores"))
+    deltas = np.asarray(ctx.in_("BboxDeltas"))
+    im_info = np.asarray(ctx.in_("ImInfo"))
+    anchors = np.asarray(ctx.in_("Anchors")).reshape(-1, 4)
+    variances = (np.asarray(ctx.in_("Variances")).reshape(-1, 4)
+                 if ctx.has_input("Variances") else None)
+    pre_n = ctx.attr("pre_nms_topN", 6000)
+    post_n = ctx.attr("post_nms_topN", 1000)
+    thresh = ctx.attr("nms_thresh", 0.5)
+    min_size = ctx.attr("min_size", 0.1)
+    n, a, h, w = scores.shape
+
+    all_rois, all_probs, nums, batch_ids = [], [], [], []
+    for i in range(n):
+        sc = scores[i].transpose(1, 2, 0).ravel()          # HWA
+        dl = deltas[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = sc.argsort()[::-1][:pre_n]
+        boxes = _decode_anchor_deltas(anchors[order], dl[order],
+                                      variances[order] if variances is not None
+                                      else None)
+        ih, iw = im_info[i, 0], im_info[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - 1)
+        ms = min_size * im_info[i, 2]
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                   & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        boxes, sc_i = boxes[keep_sz], sc[order][keep_sz]
+        keep = _nms_single(boxes, sc_i, thresh, -1)[:post_n]
+        all_rois.append(boxes[keep])
+        all_probs.append(sc_i[keep])
+        nums.append(len(keep))
+        batch_ids.extend([i] * len(keep))
+    rois = (np.concatenate(all_rois) if all_rois
+            else np.zeros((0, 4), np.float32))
+    probs = (np.concatenate(all_probs) if all_probs
+             else np.zeros((0,), np.float32))
+    ctx.set_out("RpnRois", jnp.asarray(rois.astype(np.float32)))
+    ctx.set_out("RpnRoiProbs", jnp.asarray(probs.astype(np.float32)[:, None]))
+    ctx.set_out("RpnRoisNum", jnp.asarray(np.asarray(nums, np.int32)))
+    ctx.set_out("RoisBatchId", jnp.asarray(np.asarray(batch_ids, np.int32)))
+
+
+@op("rpn_target_assign", no_grad=True, host=True)
+def _rpn_target_assign(ctx):
+    """Sample anchors for RPN training (reference:
+    rpn_target_assign_op.cc): positives = best-per-gt + iou>pos_thr,
+    negatives = iou<neg_thr, subsampled to batch_size_per_im with
+    fg_fraction.  Outputs index lists + regression targets."""
+    anchors = np.asarray(ctx.in_("Anchor")).reshape(-1, 4)
+    gt = np.asarray(ctx.in_("GtBoxes")).reshape(-1, 4)
+    batch_size = ctx.attr("rpn_batch_size_per_im", 256)
+    fg_frac = ctx.attr("rpn_fg_fraction", 0.5)
+    pos_thr = ctx.attr("rpn_positive_overlap", 0.7)
+    neg_thr = ctx.attr("rpn_negative_overlap", 0.3)
+    rng = np.random.RandomState(ctx.attr("seed", 0) or 0)
+
+    iou = _iou_matrix(anchors, gt) if len(gt) else np.zeros((len(anchors), 1))
+    best_gt = iou.argmax(1)
+    best_iou = iou.max(1) if iou.size else np.zeros(len(anchors))
+    labels = np.full(len(anchors), -1, np.int32)
+    labels[best_iou < neg_thr] = 0
+    if iou.size:
+        labels[iou.argmax(0)] = 1          # best anchor per gt
+    labels[best_iou >= pos_thr] = 1
+
+    fg = np.where(labels == 1)[0]
+    n_fg = int(batch_size * fg_frac)
+    if len(fg) > n_fg:
+        labels[rng.choice(fg, len(fg) - n_fg, replace=False)] = -1
+        fg = np.where(labels == 1)[0]
+    bg = np.where(labels == 0)[0]
+    n_bg = batch_size - len(fg)
+    if len(bg) > n_bg:
+        labels[rng.choice(bg, len(bg) - n_bg, replace=False)] = -1
+        bg = np.where(labels == 0)[0]
+
+    loc_idx = fg
+    score_idx = np.concatenate([fg, bg]).astype(np.int64)
+    tgt = np.zeros((len(fg), 4), np.float32)
+    if len(gt) and len(fg):
+        g = gt[best_gt[fg]]
+        aw = anchors[fg, 2] - anchors[fg, 0] + 1.0
+        ah = anchors[fg, 3] - anchors[fg, 1] + 1.0
+        ax = anchors[fg, 0] + 0.5 * aw
+        ay = anchors[fg, 1] + 0.5 * ah
+        gw = g[:, 2] - g[:, 0] + 1.0
+        gh = g[:, 3] - g[:, 1] + 1.0
+        gx = g[:, 0] + 0.5 * gw
+        gy = g[:, 1] + 0.5 * gh
+        tgt = np.stack([(gx - ax) / aw, (gy - ay) / ah,
+                        np.log(gw / aw), np.log(gh / ah)], 1).astype(np.float32)
+    score_tgt = (labels[score_idx] == 1).astype(np.int32)
+    ctx.set_out("LocationIndex", jnp.asarray(loc_idx.astype(np.int32)))
+    ctx.set_out("ScoreIndex", jnp.asarray(score_idx.astype(np.int32)))
+    ctx.set_out("TargetBBox", jnp.asarray(tgt))
+    ctx.set_out("TargetLabel", jnp.asarray(score_tgt[:, None]))
+    ctx.set_out("BBoxInsideWeight", jnp.asarray(np.ones_like(tgt)))
+
+
+@op("retinanet_target_assign", no_grad=True, host=True)
+def _retinanet_target_assign(ctx):
+    """Focal-loss target assign (reference: retinanet variant of
+    rpn_target_assign): every anchor labeled fg/bg by iou thresholds,
+    no subsampling; also emits the fg count for loss normalization."""
+    anchors = np.asarray(ctx.in_("Anchor")).reshape(-1, 4)
+    gt = np.asarray(ctx.in_("GtBoxes")).reshape(-1, 4)
+    gt_labels = (np.asarray(ctx.in_("GtLabels")).reshape(-1)
+                 if ctx.has_input("GtLabels")
+                 else np.ones(len(gt), np.int32))
+    pos_thr = ctx.attr("positive_overlap", 0.5)
+    neg_thr = ctx.attr("negative_overlap", 0.4)
+
+    iou = _iou_matrix(anchors, gt) if len(gt) else np.zeros((len(anchors), 1))
+    best_gt = iou.argmax(1)
+    best_iou = iou.max(1) if iou.size else np.zeros(len(anchors))
+    labels = np.zeros(len(anchors), np.int32)       # 0 = background
+    fg_mask = best_iou >= pos_thr
+    labels[fg_mask] = gt_labels[best_gt[fg_mask]] if len(gt) else 0
+    ignore = (best_iou >= neg_thr) & (best_iou < pos_thr)
+
+    fg = np.where(fg_mask)[0]
+    score_idx = np.where(~ignore)[0]
+    tgt = np.zeros((len(fg), 4), np.float32)
+    if len(gt) and len(fg):
+        g = gt[best_gt[fg]]
+        aw = anchors[fg, 2] - anchors[fg, 0] + 1.0
+        ah = anchors[fg, 3] - anchors[fg, 1] + 1.0
+        ax = anchors[fg, 0] + 0.5 * aw
+        ay = anchors[fg, 1] + 0.5 * ah
+        gw = g[:, 2] - g[:, 0] + 1.0
+        gh = g[:, 3] - g[:, 1] + 1.0
+        gx = g[:, 0] + 0.5 * gw
+        gy = g[:, 1] + 0.5 * gh
+        tgt = np.stack([(gx - ax) / aw, (gy - ay) / ah,
+                        np.log(gw / aw), np.log(gh / ah)], 1).astype(np.float32)
+    ctx.set_out("LocationIndex", jnp.asarray(fg.astype(np.int32)))
+    ctx.set_out("ScoreIndex", jnp.asarray(score_idx.astype(np.int32)))
+    ctx.set_out("TargetBBox", jnp.asarray(tgt))
+    ctx.set_out("TargetLabel", jnp.asarray(labels[score_idx][:, None]))
+    ctx.set_out("BBoxInsideWeight", jnp.asarray(np.ones_like(tgt)))
+    ctx.set_out("ForegroundNumber",
+                jnp.asarray(np.asarray([max(len(fg), 1)], np.int32)))
+
+
+@op("generate_proposal_labels", no_grad=True, host=True)
+def _generate_proposal_labels(ctx):
+    """Sample fg/bg rois vs gt for the detection head (reference:
+    generate_proposal_labels_op.cc)."""
+    rois = np.asarray(ctx.in_("RpnRois")).reshape(-1, 4)
+    gt_classes = np.asarray(ctx.in_("GtClasses")).reshape(-1)
+    gt_boxes = np.asarray(ctx.in_("GtBoxes")).reshape(-1, 4)
+    batch_size = ctx.attr("batch_size_per_im", 256)
+    fg_frac = ctx.attr("fg_fraction", 0.25)
+    fg_thr = ctx.attr("fg_thresh", 0.5)
+    bg_hi = ctx.attr("bg_thresh_hi", 0.5)
+    bg_lo = ctx.attr("bg_thresh_lo", 0.0)
+    class_nums = ctx.attr("class_nums", 81)
+    rng = np.random.RandomState(ctx.attr("seed", 0) or 0)
+
+    cand = np.concatenate([rois, gt_boxes]) if len(gt_boxes) else rois
+    iou = (_iou_matrix(cand, gt_boxes) if len(gt_boxes)
+           else np.zeros((len(cand), 1)))
+    best = iou.max(1) if iou.size else np.zeros(len(cand))
+    best_gt = iou.argmax(1)
+    fg = np.where(best >= fg_thr)[0]
+    bg = np.where((best < bg_hi) & (best >= bg_lo))[0]
+    n_fg = min(int(batch_size * fg_frac), len(fg))
+    if len(fg) > n_fg:
+        fg = rng.choice(fg, n_fg, replace=False)
+    n_bg = min(batch_size - len(fg), len(bg))
+    if len(bg) > n_bg:
+        bg = rng.choice(bg, n_bg, replace=False)
+    keep = np.concatenate([fg, bg]).astype(np.int64)
+    out_rois = cand[keep].astype(np.float32)
+    labels = np.zeros(len(keep), np.int32)
+    labels[:len(fg)] = (gt_classes[best_gt[fg]] if len(gt_boxes)
+                        else 0)
+    # per-class bbox regression targets
+    tgts = np.zeros((len(keep), 4 * class_nums), np.float32)
+    inw = np.zeros_like(tgts)
+    if len(gt_boxes):
+        for j, ri in enumerate(fg):
+            g = gt_boxes[best_gt[ri]]
+            r = cand[ri]
+            rw, rh = r[2] - r[0] + 1, r[3] - r[1] + 1
+            rx, ry = r[0] + rw / 2, r[1] + rh / 2
+            gw, gh = g[2] - g[0] + 1, g[3] - g[1] + 1
+            gx, gy = g[0] + gw / 2, g[1] + gh / 2
+            t = [(gx - rx) / rw, (gy - ry) / rh,
+                 np.log(gw / rw), np.log(gh / rh)]
+            c = int(labels[j])
+            tgts[j, 4 * c:4 * c + 4] = t
+            inw[j, 4 * c:4 * c + 4] = 1.0
+    ctx.set_out("Rois", jnp.asarray(out_rois))
+    ctx.set_out("LabelsInt32", jnp.asarray(labels[:, None]))
+    ctx.set_out("BboxTargets", jnp.asarray(tgts))
+    ctx.set_out("BboxInsideWeights", jnp.asarray(inw))
+    ctx.set_out("BboxOutsideWeights", jnp.asarray((inw > 0).astype(np.float32)))
+
+
+@op("generate_mask_labels", no_grad=True, host=True)
+def _generate_mask_labels(ctx):
+    """Mask targets for Mask R-CNN (reference: generate_mask_labels_op.cc).
+    GtSegms here is a rasterized (G, H, W) 0/1 mask per gt (the reference
+    takes polygon LoD; rasterized input carries the same information on
+    the padded representation)."""
+    rois = np.asarray(ctx.in_("Rois")).reshape(-1, 4)
+    labels = np.asarray(ctx.in_("LabelsInt32")).reshape(-1)
+    segms = np.asarray(ctx.in_("GtSegms"))
+    m = ctx.attr("resolution", 14)
+    num_classes = ctx.attr("num_classes", 81)
+    # per-gt tight bbox from the rasterized mask (the reference derives
+    # it from the polygon); used to match rois to gt instances
+    gt_boxes = np.zeros((segms.shape[0] if segms.ndim == 3 else 0, 4),
+                        np.float32)
+    for gi in range(len(gt_boxes)):
+        ys_nz, xs_nz = np.nonzero(segms[gi])
+        if len(ys_nz):
+            gt_boxes[gi] = [xs_nz.min(), ys_nz.min(), xs_nz.max(), ys_nz.max()]
+
+    fg = np.where(labels > 0)[0]
+    iou = (_iou_matrix(rois[fg], gt_boxes) if len(fg) and len(gt_boxes)
+           else np.zeros((len(fg), 1)))
+    mask_rois = rois[fg].astype(np.float32)
+    targets = -np.ones((len(fg), num_classes * m * m), np.float32)
+    for j, ri in enumerate(fg):
+        gi = int(iou[j].argmax()) if iou.size else 0
+        x1, y1, x2, y2 = rois[ri]
+        gh, gw = segms.shape[1:] if segms.ndim == 3 else (1, 1)
+        ys = np.clip(np.linspace(y1, y2, m).round().astype(int), 0, gh - 1)
+        xs = np.clip(np.linspace(x1, x2, m).round().astype(int), 0, gw - 1)
+        crop = segms[gi][np.ix_(ys, xs)] if segms.ndim == 3 else \
+            np.zeros((m, m))
+        c = int(labels[ri])
+        targets[j, c * m * m:(c + 1) * m * m] = crop.ravel()
+    ctx.set_out("MaskRois", jnp.asarray(mask_rois))
+    ctx.set_out("RoiHasMaskInt32", jnp.asarray(fg.astype(np.int32)[:, None]))
+    ctx.set_out("MaskInt32", jnp.asarray(targets))
+
+
+# --------------------------------------------------------------------------
+# FPN routing (reference: collect/distribute_fpn_proposals_op.cc)
+# --------------------------------------------------------------------------
+@op("collect_fpn_proposals", no_grad=True, host=True)
+def _collect_fpn_proposals(ctx):
+    rois_list = [np.asarray(v).reshape(-1, 4) for v in ctx.ins("MultiLevelRois")]
+    score_list = [np.asarray(v).reshape(-1) for v in ctx.ins("MultiLevelScores")]
+    post_n = ctx.attr("post_nms_topN", 100)
+    rois = np.concatenate(rois_list) if rois_list else np.zeros((0, 4))
+    scores = np.concatenate(score_list) if score_list else np.zeros((0,))
+    order = scores.argsort()[::-1][:post_n]
+    ctx.set_out("FpnRois", jnp.asarray(rois[order].astype(np.float32)))
+    ctx.set_out("RoisNum", jnp.asarray(np.asarray([len(order)], np.int32)))
+
+
+@op("distribute_fpn_proposals", no_grad=True, host=True)
+def _distribute_fpn_proposals(ctx):
+    """Route each roi to its pyramid level by sqrt(area) (reference:
+    distribute_fpn_proposals_op.cc FPN eq.1)."""
+    rois = np.asarray(ctx.in_("FpnRois")).reshape(-1, 4)
+    min_level = ctx.attr("min_level", 2)
+    max_level = ctx.attr("max_level", 5)
+    refer_level = ctx.attr("refer_level", 4)
+    refer_scale = ctx.attr("refer_scale", 224)
+    n_levels = max_level - min_level + 1
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0] + 1) * (rois[:, 3] - rois[:, 1] + 1), 1.0))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-6))
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    order = []
+    per_level = []
+    for level in range(min_level, max_level + 1):
+        idx = np.where(lvl == level)[0]
+        order.extend(idx.tolist())
+        per_level.append(jnp.asarray(rois[idx].astype(np.float32)))
+    restore = np.empty(len(rois), np.int32)
+    restore[np.asarray(order, int)] = np.arange(len(rois))
+    ctx.set_out("MultiFpnRois", per_level)
+    ctx.set_out("RestoreIndex", jnp.asarray(restore[:, None]))
+
+
+# --------------------------------------------------------------------------
+# pooling variants (dense jnp — fuse on TPU)
+# --------------------------------------------------------------------------
+def _bilinear_at(x, ys, xs):
+    """x (C,H,W); ys/xs float arrays -> (C,) + broadcast gather."""
+    h, w = x.shape[1:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+
+    def g(iy, ix):
+        valid = (iy >= 0) & (iy < h) & (ix >= 0) & (ix < w)
+        v = x[:, jnp.clip(iy, 0, h - 1).astype(jnp.int32),
+              jnp.clip(ix, 0, w - 1).astype(jnp.int32)]
+        return jnp.where(valid[None], v, 0.0)
+
+    return (g(y0, x0) * ((1 - wy1) * (1 - wx1))[None]
+            + g(y0, x0 + 1) * ((1 - wy1) * wx1)[None]
+            + g(y0 + 1, x0) * (wy1 * (1 - wx1))[None]
+            + g(y0 + 1, x0 + 1) * (wy1 * wx1)[None])
+
+
+@op("psroi_pool")
+def _psroi_pool(ctx):
+    """Position-sensitive ROI average pooling (reference:
+    psroi_pool_op.cc): out channel c's bin (i,j) pools input channel
+    c*ph*pw + i*pw + j over the bin's area."""
+    x = ctx.in_("X")                        # N,C,H,W
+    rois = ctx.in_("ROIs")                  # R,4
+    batch_ids = (ctx.in_("RoisBatchId").astype(jnp.int32)
+                 if ctx.has_input("RoisBatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    out_c = ctx.attr("output_channels", 1)
+    ph, pw = ctx.attr("pooled_height", 1), ctx.attr("pooled_width", 1)
+    ss = ctx.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    x1 = jnp.round(rois[:, 0]) * ss
+    y1 = jnp.round(rois[:, 1]) * ss
+    x2 = (jnp.round(rois[:, 2]) + 1.0) * ss
+    y2 = (jnp.round(rois[:, 3]) + 1.0) * ss
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    # 2x2 quadrature per bin over the cell-constant map (the reference
+    # averages the integral of the step-function feature map)
+    s = (jnp.arange(2) + 0.5) / 2.0
+    ys_b = y1[:, None, None, None, None] + (
+        jnp.arange(ph)[None, :, None, None, None]
+        + s[None, None, None, :, None]) * bin_h[:, None, None, None, None]
+    xs_b = x1[:, None, None, None, None] + (
+        jnp.arange(pw)[None, None, :, None, None]
+        + s[None, None, None, None, :]) * bin_w[:, None, None, None, None]
+    ys_full = jnp.broadcast_to(ys_b, (r, ph, pw, 2, 2))
+    xs_full = jnp.broadcast_to(xs_b, (r, ph, pw, 2, 2))
+    iy_idx = jnp.clip(jnp.floor(ys_full), 0, h - 1).astype(jnp.int32)
+    ix_idx = jnp.clip(jnp.floor(xs_full), 0, w - 1).astype(jnp.int32)
+    # position-sensitive channel per (out_c, bin)
+    chan = (jnp.arange(out_c)[:, None, None] * ph * pw
+            + jnp.arange(ph)[None, :, None] * pw
+            + jnp.arange(pw)[None, None, :])          # out_c,ph,pw
+    # gather (R, out_c, ph, pw, 2, 2) and average the quadrature points
+    bidx = jnp.broadcast_to(batch_ids[:, None, None, None, None, None],
+                            (r, out_c, ph, pw, 2, 2))
+    cidx = jnp.broadcast_to(chan[None, :, :, :, None, None],
+                            (r, out_c, ph, pw, 2, 2))
+    yidx = jnp.broadcast_to(iy_idx[:, None], (r, out_c, ph, pw, 2, 2))
+    xidx = jnp.broadcast_to(ix_idx[:, None], (r, out_c, ph, pw, 2, 2))
+    vals = x[bidx, cidx, yidx, xidx]
+    ctx.set_out("Out", vals.mean(axis=(4, 5)))
+
+
+@op("prroi_pool")
+def _prroi_pool(ctx):
+    """Precise ROI pooling (reference: prroi_pool_op.cc): continuous
+    integral of the bilinear interpolant over each bin, realized by an
+    N-point Gauss-style quadrature (sample grid dense enough that the
+    piecewise-bilinear integral is numerically tight)."""
+    x = ctx.in_("X")
+    rois = ctx.in_("ROIs")
+    batch_ids = (ctx.in_("RoisBatchId").astype(jnp.int32)
+                 if ctx.has_input("RoisBatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph, pw = ctx.attr("pooled_height", 1), ctx.attr("pooled_width", 1)
+    ss = ctx.attr("spatial_scale", 1.0)
+    n_samp = 4
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    x1 = rois[:, 0] * ss
+    y1 = rois[:, 1] * ss
+    x2 = rois[:, 2] * ss
+    y2 = rois[:, 3] * ss
+    rw = jnp.maximum(x2 - x1, 1e-3)
+    rh = jnp.maximum(y2 - y1, 1e-3)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    s = (jnp.arange(n_samp) + 0.5) / n_samp
+    ys = y1[:, None, None, None, None] + (
+        jnp.arange(ph)[None, :, None, None, None]
+        + s[None, None, None, :, None]) * bin_h[:, None, None, None, None] - 0.5
+    xs = x1[:, None, None, None, None] + (
+        jnp.arange(pw)[None, None, :, None, None]
+        + s[None, None, None, None, :]) * bin_w[:, None, None, None, None] - 0.5
+    ys = jnp.broadcast_to(ys, (r, ph, pw, n_samp, n_samp))
+    xs = jnp.broadcast_to(xs, (r, ph, pw, n_samp, n_samp))
+
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def g(iy, ix):
+        valid = (iy >= 0) & (iy < h) & (ix >= 0) & (ix < w)
+        b = batch_ids[:, None, None, None, None]
+        v = x[b, :, jnp.clip(iy, 0, h - 1).astype(jnp.int32),
+              jnp.clip(ix, 0, w - 1).astype(jnp.int32)]    # R,ph,pw,s,s,C
+        return jnp.where(valid[..., None], v, 0.0)
+
+    vals = (g(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+            + g(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
+            + g(y0 + 1, x0) * (wy * (1 - wx))[..., None]
+            + g(y0 + 1, x0 + 1) * (wy * wx)[..., None])
+    out = vals.mean(axis=(3, 4))                          # R,ph,pw,C
+    ctx.set_out("Out", jnp.transpose(out, (0, 3, 1, 2)))
+
+
+@op("roi_perspective_transform")
+def _roi_perspective_transform(ctx):
+    """Warp quadrilateral rois to (H, W) patches (reference:
+    roi_perspective_transform_op.cc): solve the homography mapping the
+    output rectangle to the roi quad, then bilinear-sample."""
+    x = ctx.in_("X")                        # N,C,H,W
+    rois = ctx.in_("ROIs")                  # R,8 (4 corners x1y1..x4y4)
+    batch_ids = (ctx.in_("RoisBatchId").astype(jnp.int32)
+                 if ctx.has_input("RoisBatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    th = ctx.attr("transformed_height", 8)
+    tw = ctx.attr("transformed_width", 8)
+    ss = ctx.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    quad = rois.reshape(r, 4, 2) * ss       # tl, tr, br, bl
+
+    # homography H mapping unit rect corners -> quad (per roi), via the
+    # standard 8x8 linear system solved in closed batch form
+    src = jnp.asarray([[0.0, 0.0], [tw - 1.0, 0.0],
+                       [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+
+    def solve_h(q):
+        sx, sy = src[:, 0], src[:, 1]
+        dx, dy = q[:, 0], q[:, 1]
+        zeros = jnp.zeros(4)
+        ones = jnp.ones(4)
+        a_top = jnp.stack([sx, sy, ones, zeros, zeros, zeros,
+                           -sx * dx, -sy * dx], axis=1)
+        a_bot = jnp.stack([zeros, zeros, zeros, sx, sy, ones,
+                           -sx * dy, -sy * dy], axis=1)
+        a = jnp.concatenate([a_top, a_bot], axis=0)      # 8x8
+        bb = jnp.concatenate([dx, dy])
+        sol = jnp.linalg.solve(a, bb)
+        return jnp.concatenate([sol, jnp.ones(1)]).reshape(3, 3)
+
+    hs = jax.vmap(solve_h)(quad)            # R,3,3
+    gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                          jnp.arange(tw, dtype=jnp.float32), indexing="ij")
+    pts = jnp.stack([gx.ravel(), gy.ravel(), jnp.ones(th * tw)], axis=0)
+    mapped = jnp.einsum("rij,jk->rik", hs, pts)          # R,3,T
+    mx = mapped[:, 0] / jnp.maximum(mapped[:, 2], 1e-8)
+    my = mapped[:, 1] / jnp.maximum(mapped[:, 2], 1e-8)
+
+    def sample_one(b, ys, xs):
+        return _bilinear_at(x[b], ys, xs)               # C,T
+
+    vals = jax.vmap(sample_one)(batch_ids, my, mx)      # R,C,T
+    ctx.set_out("Out", vals.reshape(r, c, th, tw))
+    ctx.set_out("Mask", jnp.ones((r, 1, th, tw), jnp.int32))
+    ctx.set_out("TransformMatrix", hs.reshape(r, 9))
+
+
+# --------------------------------------------------------------------------
+# NMS variants / decode-assign
+# --------------------------------------------------------------------------
+@op("locality_aware_nms", no_grad=True, host=True)
+def _locality_aware_nms(ctx):
+    """EAST text NMS (reference: locality_aware_nms_op.cc): first merge
+    consecutive overlapping boxes score-weighted, then standard NMS."""
+    bboxes = np.asarray(ctx.in_("BBoxes")).reshape(-1, 4)
+    scores = np.asarray(ctx.in_("Scores")).reshape(-1)
+    thresh = ctx.attr("nms_threshold", 0.3)
+    score_thresh = ctx.attr("score_threshold", 0.0)
+    keep_top_k = ctx.attr("keep_top_k", 100)
+
+    keep_mask = scores >= score_thresh
+    bboxes, scores = bboxes[keep_mask], scores[keep_mask]
+    merged_b, merged_s = [], []
+    for b, s in zip(bboxes, scores):
+        if merged_b:
+            lb, ls = merged_b[-1], merged_s[-1]
+            iou = _iou_matrix(b[None], lb[None])[0, 0]
+            if iou > thresh:
+                wsum = ls + s
+                merged_b[-1] = (lb * ls + b * s) / wsum
+                merged_s[-1] = wsum / 2.0
+                continue
+        merged_b.append(b.astype(np.float64))
+        merged_s.append(float(s))
+    mb = np.asarray(merged_b) if merged_b else np.zeros((0, 4))
+    ms = np.asarray(merged_s) if merged_s else np.zeros((0,))
+    keep = _nms_single(mb, ms, thresh, keep_top_k)
+    out = np.concatenate([ms[keep][:, None], mb[keep]], axis=1)
+    ctx.set_out("Out", jnp.asarray(out.astype(np.float32)))
+
+
+@op("retinanet_detection_output", no_grad=True, host=True)
+def _retinanet_detection_output(ctx):
+    """Multi-level decode + NMS (reference:
+    retinanet_detection_output_op.cc)."""
+    bboxes = [np.asarray(v).reshape(-1, 4) for v in ctx.ins("BBoxes")]
+    scores = [np.asarray(v) for v in ctx.ins("Scores")]   # (A_l, C) each
+    anchors = [np.asarray(v).reshape(-1, 4) for v in ctx.ins("Anchors")]
+    score_thresh = ctx.attr("score_threshold", 0.05)
+    nms_top_k = ctx.attr("nms_top_k", 1000)
+    keep_top_k = ctx.attr("keep_top_k", 100)
+    nms_thresh = ctx.attr("nms_threshold", 0.3)
+
+    dets = []
+    for lvl_delta, lvl_score, lvl_anchor in zip(bboxes, scores, anchors):
+        n_cls = lvl_score.shape[-1]
+        lvl_score = lvl_score.reshape(-1, n_cls)
+        boxes = _decode_anchor_deltas(lvl_anchor, lvl_delta)
+        for cidx in range(n_cls):
+            sc = lvl_score[:, cidx]
+            sel = np.where(sc >= score_thresh)[0][:nms_top_k]
+            for i in sel:
+                dets.append([cidx + 1, sc[i], *boxes[i]])
+    if not dets:
+        ctx.set_out("Out", jnp.zeros((0, 6), jnp.float32))
+        return
+    dets = np.asarray(dets, np.float32)
+    out = []
+    for cls in np.unique(dets[:, 0]):
+        d = dets[dets[:, 0] == cls]
+        keep = _nms_single(d[:, 2:], d[:, 1], nms_thresh, -1)
+        out.append(d[keep])
+    out = np.concatenate(out)
+    out = out[out[:, 1].argsort()[::-1][:keep_top_k]]
+    ctx.set_out("Out", jnp.asarray(out))
+
+
+@op("box_decoder_and_assign", no_grad=True)
+def _box_decoder_and_assign(ctx):
+    """Decode per-class deltas and pick each roi's best-class box
+    (reference: box_decoder_and_assign_op.cc)."""
+    prior = ctx.in_("PriorBox")             # R,4
+    deltas = ctx.in_("TargetBox")           # R,4*C
+    scores = ctx.in_("BoxScore")            # R,C
+    var = ctx.attr("box_clip", 4.135166556742356)
+    r = prior.shape[0]
+    ncls = scores.shape[1]
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    phh = prior[:, 3] - prior[:, 1] + 1.0
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + phh * 0.5
+    d = deltas.reshape(r, ncls, 4)
+    if ctx.has_input("PriorBoxVar"):
+        # reference scales deltas by the per-box variances before decode
+        d = d * ctx.in_("PriorBoxVar").reshape(r, 1, 4)
+    cx = d[:, :, 0] * pw[:, None] + px[:, None]
+    cy = d[:, :, 1] * phh[:, None] + py[:, None]
+    wI = jnp.exp(jnp.minimum(d[:, :, 2], var)) * pw[:, None]
+    hI = jnp.exp(jnp.minimum(d[:, :, 3], var)) * phh[:, None]
+    all_boxes = jnp.stack([cx - wI / 2, cy - hI / 2,
+                           cx + wI / 2 - 1, cy + hI / 2 - 1], axis=2)
+    ctx.set_out("DecodeBox", all_boxes.reshape(r, ncls * 4))
+    best = jnp.argmax(scores[:, 1:], axis=1) + 1 if ncls > 1 else \
+        jnp.zeros((r,), jnp.int32)
+    bidx = jnp.arange(r)
+    ctx.set_out("OutputAssignBox", all_boxes[bidx, best])

@@ -129,7 +129,7 @@ class LowerCtx:
     })
 
     # inputs ---------------------------------------------------------------
-    def ins(self, slot: str) -> List[Any]:
+    def ins(self, slot: str, missing_ok: bool = False) -> List[Any]:
         from ..framework.selected_rows import SelectedRows
 
         sparse_ok = self.op.type in self.SPARSE_AWARE
@@ -140,6 +140,9 @@ class LowerCtx:
             else:
                 v = self.env.get(n)
                 if v is None and n not in self.env:
+                    if missing_ok:
+                        out.append(None)
+                        continue
                     raise KeyError(
                         f"op {self.op.type}: input var {n!r} (slot {slot}) "
                         f"has no value — not initialized or not fed"
@@ -499,11 +502,20 @@ def generic_grad_lower(ctx):
     primal_outs, vjp_fn = jax.vjp(f, flat)
 
     # Cotangents: grad-op inputs named "<slot>@GRAD"; missing -> zeros.
+    # A cotangent VAR may be declared but never produced when the
+    # downstream grad kernel doesn't emit it (e.g. Label@GRAD of a loss:
+    # the label path ends in stop_gradient data) — treat that as zeros
+    # too (missing_ok).
     cots = []
     k = 0
     for slot in out_slot_order:
-        gvals = (ctx.ins(slot + GRAD_SUFFIX)
-                 if (slot + GRAD_SUFFIX) in in_slot_names else [])
+        if (slot + GRAD_SUFFIX) in in_slot_names:
+            if gop is not None:
+                gvals = ctx.ins(slot + GRAD_SUFFIX, missing_ok=True)
+            else:
+                gvals = ctx.ins(slot + GRAD_SUFFIX)
+        else:
+            gvals = []
         for i in range(out_arity[slot]):
             primal = primal_outs[k]
             g = gvals[i] if i < len(gvals) else None

@@ -901,3 +901,14 @@ def _reorder_lod_tensor_by_rank(ctx):
     lengths = ctx.in_("RankTable").reshape(-1)
     order = jnp.argsort(-lengths, stable=True)
     ctx.set_out("Out", jnp.take(x, order, axis=0))
+
+
+@op("beam_gather_states")
+def _beam_gather_states(ctx):
+    """Gather along the beam axis: X (b, beam, ...) + Ids (b, beam) ->
+    out[b, j] = X[b, ids[b, j]] (the BeamSearchDecoder's parent-beam
+    state reorder; reference: rnn.py _gather in BeamSearchDecoder)."""
+    x = ctx.in_("X")
+    ids = ctx.in_("Ids").astype(jnp.int32)
+    b = jnp.arange(x.shape[0])[:, None]
+    ctx.set_out("Out", x[b, ids])

@@ -1,0 +1,208 @@
+"""Detection long-tail ops (reference: generate_proposals_op.cc,
+rpn_target_assign_op.cc, generate_proposal_labels_op.cc, fpn routing,
+psroi/prroi pooling, retinanet, locality-aware NMS, perspective ROI).
+
+Oracles: hand-constructed geometry where the correct answer is computable
+by inspection (identity deltas -> anchors; separated boxes -> NMS keeps
+all; perfect-overlap rois -> fg labels; uniform features -> pooling means).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import eager_call
+
+
+def _ec(op, ins, attrs, outs):
+    return eager_call(op, {k: [jnp.asarray(v)] for k, v in ins.items()},
+                      attrs, outs)
+
+
+def test_generate_proposals_identity_deltas():
+    """Zero deltas -> proposals are the anchors (clipped), ranked by score,
+    far-apart so NMS keeps both."""
+    h = w = 2
+    a = 1
+    anchors = np.array([[[ [0, 0, 10, 10] ], [ [40, 0, 50, 10] ]],
+                        [[ [0, 40, 10, 50] ], [ [40, 40, 50, 50] ]]],
+                       np.float32)  # H,W,A,4
+    scores = np.array([[[[0.9, 0.2], [0.8, 0.1]]]], np.float32).reshape(1, a, h, w)
+    deltas = np.zeros((1, 4 * a, h, w), np.float32)
+    im_info = np.array([[60, 60, 1.0]], np.float32)
+    out = _ec("generate_proposals",
+              {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+               "Anchors": anchors},
+              {"pre_nms_topN": 10, "post_nms_topN": 4, "nms_thresh": 0.5,
+               "min_size": 1.0},
+              {"RpnRois": 1, "RpnRoiProbs": 1, "RpnRoisNum": 1,
+               "RoisBatchId": 1})
+    rois = np.asarray(out["RpnRois"][0])
+    probs = np.asarray(out["RpnRoiProbs"][0]).ravel()
+    assert len(rois) == 4
+    assert probs[0] == pytest.approx(0.9)      # score-ordered
+    np.testing.assert_allclose(rois[0], [0, 0, 10, 10], atol=1e-4)
+    assert int(np.asarray(out["RpnRoisNum"][0])[0]) == 4
+
+
+def test_rpn_target_assign_simple():
+    anchors = np.array([[0, 0, 10, 10], [100, 100, 110, 110],
+                        [0, 0, 9, 9], [50, 50, 60, 60]], np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    out = _ec("rpn_target_assign",
+              {"Anchor": anchors, "GtBoxes": gt},
+              {"rpn_batch_size_per_im": 4, "rpn_fg_fraction": 0.5,
+               "rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3},
+              {"LocationIndex": 1, "ScoreIndex": 1, "TargetBBox": 1,
+               "TargetLabel": 1, "BBoxInsideWeight": 1})
+    loc = np.asarray(out["LocationIndex"][0]).ravel()
+    assert 0 in loc                      # exact-overlap anchor is fg
+    tgt = np.asarray(out["TargetBBox"][0])
+    i0 = list(loc).index(0)
+    np.testing.assert_allclose(tgt[i0], np.zeros(4), atol=1e-5)  # identity
+
+
+def test_generate_proposal_labels_and_masks():
+    rois = np.array([[0, 0, 10, 10], [100, 100, 110, 110]], np.float32)
+    gt_boxes = np.array([[0, 0, 10, 10]], np.float32)
+    gt_classes = np.array([3], np.int32)
+    out = _ec("generate_proposal_labels",
+              {"RpnRois": rois, "GtClasses": gt_classes, "GtBoxes": gt_boxes},
+              {"batch_size_per_im": 8, "fg_fraction": 0.5, "fg_thresh": 0.5,
+               "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": 5},
+              {"Rois": 1, "LabelsInt32": 1, "BboxTargets": 1,
+               "BboxInsideWeights": 1, "BboxOutsideWeights": 1})
+    labels = np.asarray(out["LabelsInt32"][0]).ravel()
+    assert 3 in labels and 0 in labels   # one fg (class 3), one bg
+    tg = np.asarray(out["BboxTargets"][0])
+    fg_row = list(labels).index(3)
+    np.testing.assert_allclose(tg[fg_row, 12:16], np.zeros(4), atol=1e-5)
+
+    # mask labels: rasterized gt segm crop
+    segm = np.zeros((1, 20, 20), np.float32)
+    segm[0, :11, :11] = 1.0
+    mout = _ec("generate_mask_labels",
+               {"Rois": np.asarray(out["Rois"][0]),
+                "LabelsInt32": np.asarray(out["LabelsInt32"][0]),
+                "GtSegms": segm, "GtBoxes": gt_boxes},
+               {"num_classes": 5, "resolution": 4},
+               {"MaskRois": 1, "RoiHasMaskInt32": 1, "MaskInt32": 1})
+    m = np.asarray(mout["MaskInt32"][0])
+    # two fg rows: the matching roi AND the gt box itself (the reference
+    # also appends gt boxes to the candidate set)
+    assert m.shape == (2, 5 * 16)
+    for row in range(2):
+        np.testing.assert_allclose(m[row, 3 * 16:4 * 16], np.ones(16),
+                                   atol=1e-5)
+
+
+def test_fpn_collect_and_distribute():
+    rois_l0 = np.array([[0, 0, 10, 10]], np.float32)        # small -> low lvl
+    rois_l1 = np.array([[0, 0, 300, 300]], np.float32)      # big -> high lvl
+    s0 = np.array([0.3], np.float32)
+    s1 = np.array([0.9], np.float32)
+    out = eager_call("collect_fpn_proposals",
+                     {"MultiLevelRois": [jnp.asarray(rois_l0),
+                                         jnp.asarray(rois_l1)],
+                      "MultiLevelScores": [jnp.asarray(s0), jnp.asarray(s1)]},
+                     {"post_nms_topN": 2}, {"FpnRois": 1, "RoisNum": 1})
+    fpn = np.asarray(out["FpnRois"][0])
+    np.testing.assert_allclose(fpn[0], rois_l1[0])          # higher score first
+
+    d = eager_call("distribute_fpn_proposals",
+                   {"FpnRois": [jnp.asarray(fpn)]},
+                   {"min_level": 2, "max_level": 5, "refer_level": 4,
+                    "refer_scale": 224},
+                   {"MultiFpnRois": 4, "RestoreIndex": 1})
+    lvls = [np.asarray(v) for v in d["MultiFpnRois"]]
+    assert sum(len(l) for l in lvls) == 2
+    # small box -> lowest level; 300px box -> level 4 (index 2)
+    assert len(lvls[0]) == 1 and len(lvls[2]) == 1
+    restore = np.asarray(d["RestoreIndex"][0]).ravel()
+    cat = np.concatenate([l for l in lvls if len(l)])
+    np.testing.assert_allclose(cat[restore], fpn)            # restore order
+
+
+def test_psroi_and_prroi_pool():
+    # position-sensitive: channel value = its channel index; pooled bin
+    # (i,j) of out channel c must equal channel c*4 + i*2 + j
+    ph = pw = 2
+    out_c = 3
+    x = np.zeros((1, out_c * ph * pw, 8, 8), np.float32)
+    for c in range(out_c * ph * pw):
+        x[0, c] = c
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    out = _ec("psroi_pool", {"X": x, "ROIs": rois},
+              {"output_channels": out_c, "pooled_height": ph,
+               "pooled_width": pw, "spatial_scale": 1.0}, {"Out": 1})
+    o = np.asarray(out["Out"][0])
+    for c in range(out_c):
+        for i in range(ph):
+            for j in range(pw):
+                assert o[0, c, i, j] == pytest.approx(c * 4 + i * 2 + j)
+
+    # prroi on a constant map pools the constant (interior roi: the
+    # integral zero-extends outside the feature map like the reference)
+    x2 = np.full((1, 2, 8, 8), 5.0, np.float32)
+    rois = np.array([[1, 1, 6, 6]], np.float32)
+    out2 = _ec("prroi_pool", {"X": x2, "ROIs": rois},
+               {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+               {"Out": 1})
+    np.testing.assert_allclose(np.asarray(out2["Out"][0]), 5.0, atol=1e-4)
+
+
+def test_roi_perspective_transform_axis_aligned():
+    """An axis-aligned quad must reproduce a (scaled) crop."""
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    # quad = the rectangle rows 2..5, cols 1..6 (tl,tr,br,bl)
+    rois = np.array([[1, 2, 6, 2, 6, 5, 1, 5]], np.float32)
+    out = _ec("roi_perspective_transform", {"X": x, "ROIs": rois},
+              {"transformed_height": 4, "transformed_width": 6,
+               "spatial_scale": 1.0},
+              {"Out": 1, "Mask": 1, "TransformMatrix": 1})
+    o = np.asarray(out["Out"][0])[0, 0]
+    assert o.shape == (4, 6)
+    # corners map exactly onto the quad's corner pixels
+    assert o[0, 0] == pytest.approx(x[0, 0, 2, 1], abs=1e-3)
+    assert o[-1, -1] == pytest.approx(x[0, 0, 5, 6], abs=1e-3)
+
+
+def test_locality_aware_nms_merges():
+    boxes = np.array([[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                      [50, 50, 60, 60]], np.float32)
+    scores = np.array([0.8, 0.6, 0.9], np.float32)
+    out = _ec("locality_aware_nms", {"BBoxes": boxes, "Scores": scores},
+              {"nms_threshold": 0.5, "score_threshold": 0.1,
+               "keep_top_k": 10}, {"Out": 1})
+    o = np.asarray(out["Out"][0])
+    assert len(o) == 2                       # overlapping pair merged
+    merged = o[o[:, 0].argsort()][-1] if o[0, 0] < o[1, 0] else o[0]
+    # merged box is the score-weighted average of the pair
+    expect = (boxes[0] * 0.8 + boxes[1] * 0.6) / 1.4
+    row = o[np.abs(o[:, 1] - expect[0]).argmin()]
+    np.testing.assert_allclose(row[1:], expect, atol=1e-4)
+
+
+def test_retinanet_output_and_box_decoder():
+    anchors = np.array([[0, 0, 10, 10], [40, 40, 50, 50]], np.float32)
+    deltas = np.zeros((2, 4), np.float32)
+    scores = np.array([[0.9, 0.1], [0.2, 0.7]], np.float32)
+    out = eager_call("retinanet_detection_output",
+                     {"BBoxes": [jnp.asarray(deltas)],
+                      "Scores": [jnp.asarray(scores)],
+                      "Anchors": [jnp.asarray(anchors)]},
+                     {"score_threshold": 0.5, "nms_top_k": 10,
+                      "keep_top_k": 5, "nms_threshold": 0.3}, {"Out": 1})
+    o = np.asarray(out["Out"][0])
+    assert len(o) == 2
+    assert set(o[:, 0].astype(int)) == {1, 2}   # one det per class
+
+    # box_decoder_and_assign: zero deltas -> anchors; best class argmax
+    prior = anchors
+    tb = np.zeros((2, 12), np.float32)   # 3 classes x 4 (incl. background)
+    bs = np.array([[0.1, 0.8, 0.1], [0.1, 0.2, 0.7]], np.float32)
+    d = _ec("box_decoder_and_assign",
+            {"PriorBox": prior, "TargetBox": tb, "BoxScore": bs},
+            {"box_clip": 4.0}, {"DecodeBox": 1, "OutputAssignBox": 1})
+    assign = np.asarray(d["OutputAssignBox"][0])
+    np.testing.assert_allclose(assign, prior, atol=1e-4)

@@ -925,6 +925,23 @@ COVERED_ELSEWHERE = {
     "py_func": "test_layers_tail",
     "sequence_scatter": "test_layers_tail", "cvm": "test_layers_tail",
     "average_accumulates": "test_failure_detection(ModelAverage oracle)",
+    "create_array": "test_decoder_api", "write_to_array": "test_decoder_api",
+    "read_from_array": "test_decoder_api",
+    "lod_array_length": "test_decoder_api",
+    "tensor_array_to_tensor": "test_decoder_api",
+    "beam_gather_states": "test_decoder_api(beam search oracle)",
+    "generate_proposals": "test_detection_extra",
+    "rpn_target_assign": "test_detection_extra",
+    "retinanet_target_assign": "test_detection_extra",
+    "generate_proposal_labels": "test_detection_extra",
+    "generate_mask_labels": "test_detection_extra",
+    "collect_fpn_proposals": "test_detection_extra",
+    "distribute_fpn_proposals": "test_detection_extra",
+    "psroi_pool": "test_detection_extra", "prroi_pool": "test_detection_extra",
+    "roi_perspective_transform": "test_detection_extra",
+    "locality_aware_nms": "test_detection_extra",
+    "retinanet_detection_output": "test_detection_extra",
+    "box_decoder_and_assign": "test_detection_extra",
     "filter_by_instag": "host dynamic shape, test_layers_tail",
     "reorder_lod_tensor_by_rank": "test_layers_tail",
     # batch_norm: 5-output stateful train path — test_ops_basic + test_models
