@@ -272,8 +272,9 @@ class BeamSearchDecoder(Decoder):
                                   [-1, self.beam_size, 1]) + logp
         flat = nn_layers.reshape(total, [-1, self.beam_size * vocab])
         topk_probs, topk_idx = nn_layers.topk(flat, k=self.beam_size)
-        parent = _floordiv(topk_idx, vocab)               # (b, beam)
-        token = _mod(topk_idx, vocab)                     # (b, beam)
+        vconst = tensor_layers.fill_constant([1], topk_idx.dtype, vocab)
+        parent = nn_layers.elementwise_floordiv(topk_idx, vconst)  # (b, beam)
+        token = nn_layers.elementwise_mod(topk_idx, vconst)        # (b, beam)
 
         next_cell_states = _map_structure(
             lambda s: _gather_beams(s, parent, self.beam_size),
@@ -325,24 +326,6 @@ def _end_token_mask(vocab, end_token):
     m = np.full((vocab,), -1e9, np.float32)
     m[end_token] = 0.0
     return tensor_layers.assign(m)
-
-
-def _floordiv(x, v):
-    helper = LayerHelper("floordiv")
-    out = helper.create_variable_for_type_inference(x.dtype)
-    const = tensor_layers.fill_constant([1], x.dtype, v)
-    helper.append_op("elementwise_floordiv", inputs={"X": [x], "Y": [const]},
-                     outputs={"Out": [out]})
-    return out
-
-
-def _mod(x, v):
-    helper = LayerHelper("mod")
-    out = helper.create_variable_for_type_inference(x.dtype)
-    const = tensor_layers.fill_constant([1], x.dtype, v)
-    helper.append_op("elementwise_mod", inputs={"X": [x], "Y": [const]},
-                     outputs={"Out": [out]})
-    return out
 
 
 def _gather_beams(s, parent, beam_size):

@@ -537,16 +537,20 @@ def _locality_aware_nms(ctx):
             lb, ls = merged_b[-1], merged_s[-1]
             iou = _iou_matrix(b[None], lb[None])[0, 0]
             if iou > thresh:
+                # score-weighted merge; the ACCUMULATED weight carries into
+                # further chained merges (reference locality_aware_nms.cc)
                 wsum = ls + s
                 merged_b[-1] = (lb * ls + b * s) / wsum
-                merged_s[-1] = wsum / 2.0
+                merged_s[-1] = wsum
                 continue
         merged_b.append(b.astype(np.float64))
         merged_s.append(float(s))
     mb = np.asarray(merged_b) if merged_b else np.zeros((0, 4))
     ms = np.asarray(merged_s) if merged_s else np.zeros((0,))
     keep = _nms_single(mb, ms, thresh, keep_top_k)
-    out = np.concatenate([ms[keep][:, None], mb[keep]], axis=1)
+    # multiclass-nms-style 6 columns: [label, score, x1, y1, x2, y2]
+    out = np.concatenate([np.zeros((len(keep), 1)), ms[keep][:, None],
+                          mb[keep]], axis=1)
     ctx.set_out("Out", jnp.asarray(out.astype(np.float32)))
 
 
@@ -569,7 +573,11 @@ def _retinanet_detection_output(ctx):
         boxes = _decode_anchor_deltas(lvl_anchor, lvl_delta)
         for cidx in range(n_cls):
             sc = lvl_score[:, cidx]
-            sel = np.where(sc >= score_thresh)[0][:nms_top_k]
+            sel = np.where(sc >= score_thresh)[0]
+            if len(sel) > nms_top_k:
+                # keep the HIGHEST-scoring nms_top_k (reference sorts by
+                # score before truncating)
+                sel = sel[np.argsort(-sc[sel])[:nms_top_k]]
             for i in sel:
                 dets.append([cidx + 1, sc[i], *boxes[i]])
     if not dets:

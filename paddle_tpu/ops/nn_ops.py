@@ -198,7 +198,42 @@ def _pool2d(ctx):
 
 @op("max_pool2d_with_index")
 def _max_pool2d_with_index(ctx):
-    _pool2d(ctx)  # Mask output unsupported; Out computed identically
+    """Max pool that also returns the flat argmax index per window
+    (reference: pool_with_index_op.cc) — the Mask feeds unpool.  Indices
+    are offsets into the UNPADDED input plane; -inf padding guarantees
+    the max never lands on a pad cell."""
+    x = ctx.in_("X")
+    ksize = list(ctx.attr("ksize", [2, 2]))
+    strides = list(ctx.attr("strides", ksize))
+    pads = list(ctx.attr("paddings", [0, 0]))
+    n, c, h, w = x.shape
+    if ctx.attr("global_pooling", False) or ctx.attr("adaptive", False) and \
+            ksize == [1, 1]:
+        ksize, strides, pads = [h, w], [h, w], [0, 0]
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])),
+                 constant_values=neg)
+    hp, wp = xp.shape[2:]
+    oh = (hp - ksize[0]) // strides[0] + 1
+    ow = (wp - ksize[1]) // strides[1] + 1
+    patches = []
+    for kh in range(ksize[0]):
+        for kw in range(ksize[1]):
+            patches.append(lax.slice(
+                xp, (0, 0, kh, kw),
+                (n, c, kh + (oh - 1) * strides[0] + 1,
+                 kw + (ow - 1) * strides[1] + 1),
+                (1, 1, strides[0], strides[1])))
+    stacked = jnp.stack(patches, axis=-1)       # N,C,oh,ow,K
+    ctx.set_out("Out", jnp.max(stacked, -1))
+    if ctx.has_output("Mask"):
+        k_arg = jnp.argmax(stacked, -1)
+        kh = k_arg // ksize[1]
+        kw = k_arg % ksize[1]
+        # padded coords -> unpadded plane offsets
+        hi = jnp.arange(oh)[None, None, :, None] * strides[0] + kh - pads[0]
+        wi = jnp.arange(ow)[None, None, None, :] * strides[1] + kw - pads[1]
+        ctx.set_out("Mask", (hi * w + wi).astype(jnp.int32))
 
 
 # --------------------------------------------------------------------------

@@ -125,7 +125,8 @@ class LowerCtx:
     # per-op sparse support
     SPARSE_AWARE = frozenset({
         "sgd", "momentum", "adam", "adagrad", "sum", "scale",
-        "clip_by_norm",
+        "clip_by_norm", "split_selected_rows", "merge_selected_rows",
+        "get_tensor_from_selected_rows",
     })
 
     # inputs ---------------------------------------------------------------

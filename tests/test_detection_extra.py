@@ -175,12 +175,13 @@ def test_locality_aware_nms_merges():
               {"nms_threshold": 0.5, "score_threshold": 0.1,
                "keep_top_k": 10}, {"Out": 1})
     o = np.asarray(out["Out"][0])
-    assert len(o) == 2                       # overlapping pair merged
-    merged = o[o[:, 0].argsort()][-1] if o[0, 0] < o[1, 0] else o[0]
-    # merged box is the score-weighted average of the pair
+    assert o.shape == (2, 6)                 # [label, score, x1..y2]
+    # merged box is the score-weighted average of the pair; merged score
+    # is the ACCUMULATED weight 1.4 (chained-merge contract)
     expect = (boxes[0] * 0.8 + boxes[1] * 0.6) / 1.4
-    row = o[np.abs(o[:, 1] - expect[0]).argmin()]
-    np.testing.assert_allclose(row[1:], expect, atol=1e-4)
+    row = o[np.abs(o[:, 2] - expect[0]).argmin()]
+    np.testing.assert_allclose(row[2:], expect, atol=1e-4)
+    assert row[1] == pytest.approx(1.4)
 
 
 def test_retinanet_output_and_box_decoder():

@@ -1,0 +1,154 @@
+"""Host/stateful misc ops: id sharding, io save/load, unpooling round
+trip, shuffle_batch, select_output, SelectedRows splitting
+(reference: split_ids_op.cc, merge_ids_op.cc, save/load_op.cc,
+unpool_op.cc, shuffle_batch_op.cc, split_selected_rows_op.cc)."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import eager_call
+from paddle_tpu.framework.selected_rows import SelectedRows
+
+
+def test_split_merge_ids_round_trip():
+    ids = np.array([4, 1, 7, 2, 9, 6], np.int64)
+    out = eager_call("split_ids", {"Ids": [jnp.asarray(ids)]}, {}, {"Out": 3})
+    shards = [np.asarray(v) for v in out["Out"]]
+    assert sorted(np.concatenate(shards).tolist()) == sorted(ids.tolist())
+    for i, s in enumerate(shards):
+        assert all(v % 3 == i for v in s)
+
+    # merge per-shard rows back into id order
+    rows = [s.astype(np.float32)[:, None] * 10 for s in shards]
+    merged = np.asarray(eager_call(
+        "merge_ids",
+        {"Ids": [jnp.asarray(ids)], "X": [jnp.asarray(r) for r in rows]},
+        {}, {"Out": 1})["Out"][0])
+    np.testing.assert_allclose(merged.ravel(), ids * 10.0)
+
+
+def test_save_load_round_trip(tmp_path):
+    x = np.random.rand(3, 4).astype("float32")
+    p = str(tmp_path / "var.pkl")
+    eager_call("save", {"X": [jnp.asarray(x)]}, {"file_path": p}, {})
+    back = np.asarray(eager_call("load", {}, {"file_path": p},
+                                 {"Out": 1})["Out"][0])
+    np.testing.assert_allclose(back, x)
+
+    ys = [np.random.rand(2, 2).astype("float32"),
+          np.random.rand(5).astype("float32")]
+    p2 = str(tmp_path / "combined.pkl")
+    eager_call("save_combine", {"X": [jnp.asarray(y) for y in ys]},
+               {"file_path": p2}, {})
+    outs = eager_call("load_combine", {}, {"file_path": p2}, {"Out": 2})["Out"]
+    for got, want in zip(outs, ys):
+        np.testing.assert_allclose(np.asarray(got), want)
+
+
+def test_unpool_inverts_maxpool():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 4, 4).astype("float32")
+    pooled = eager_call("max_pool2d_with_index", {"X": [jnp.asarray(x)]},
+                        {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+                        {"Out": 1, "Mask": 1})
+    up = np.asarray(eager_call(
+        "unpool",
+        {"X": [pooled["Out"][0]], "Indices": [pooled["Mask"][0]]},
+        {"ksize": [2, 2], "strides": [2, 2],
+         "unpooled_height": 4, "unpooled_width": 4},
+        {"Out": 1})["Out"][0])
+    # unpooled map holds each max at its original position, zeros elsewhere
+    pm = np.asarray(pooled["Out"][0])
+    assert np.isclose(up.sum(), pm.sum())
+    # every nonzero equals the pooled max of its 2x2 block
+    for n in range(2):
+        for c in range(3):
+            for i in range(2):
+                for j in range(2):
+                    blk = up[n, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                    assert blk.max() == pm[n, c, i, j]
+                    assert (blk > 0).sum() == 1
+
+
+def test_shuffle_batch_is_permutation():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    out = eager_call("shuffle_batch", {"X": [jnp.asarray(x)]}, {},
+                     {"Out": 1, "ShuffleIdx": 1})
+    got = np.asarray(out["Out"][0])
+    idx = np.asarray(out["ShuffleIdx"][0])
+    assert sorted(got[:, 0].tolist()) == sorted(x[:, 0].tolist())
+    np.testing.assert_allclose(got, x[idx])
+
+
+def test_split_selected_rows():
+    sr = SelectedRows(jnp.asarray(np.array([1, 7, 3], np.int32)),
+                      jnp.asarray(np.arange(6, dtype=np.float32).reshape(3, 2)),
+                      10)
+    out = eager_call("split_selected_rows", {"X": [sr]},
+                     {"height_sections": [5, 5]}, {"Out": 2})["Out"]
+    a, b = out
+    assert np.asarray(a.rows).tolist() == [1, 3]
+    assert np.asarray(b.rows).tolist() == [2]      # 7 - 5
+    np.testing.assert_allclose(np.asarray(b.values), [[2.0, 3.0]])
+
+
+def test_select_output_routes():
+    x = np.ones((2, 3), np.float32)
+    out = eager_call("select_output",
+                     {"X": [jnp.asarray(x)],
+                      "Mask": [jnp.asarray(np.array([1], np.int32))]},
+                     {}, {"Out": 2})["Out"]
+    assert np.allclose(np.asarray(out[0]), 0.0)
+    assert np.allclose(np.asarray(out[1]), 1.0)
+
+
+def test_sample_logits_contains_truth():
+    logits = np.random.rand(4, 9).astype("float32")
+    labels = np.array([[2], [5], [0], [8]], np.int64)
+    out = eager_call("sample_logits",
+                     {"Logits": [jnp.asarray(logits)],
+                      "Labels": [jnp.asarray(labels)]},
+                     {"num_samples": 3},
+                     {"SampledLogits": 1, "Samples": 1, "SampledLabels": 1,
+                      "Probabilities": 1})
+    samples = np.asarray(out["Samples"][0])
+    picked = np.asarray(out["SampledLogits"][0])
+    assert samples.shape == (4, 4)            # 1 true + 3 sampled
+    np.testing.assert_array_equal(samples[:, 0], labels[:, 0])
+    np.testing.assert_allclose(
+        picked, np.take_along_axis(logits, samples, axis=1), atol=1e-6)
+
+
+def test_pool_with_index_padded_and_global():
+    """Padded and global pool-with-index: shapes match the reference
+    formula and Mask offsets stay in the unpadded plane."""
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 1, 4, 4).astype("float32")
+    out = eager_call("max_pool2d_with_index", {"X": [jnp.asarray(x)]},
+                     {"ksize": [2, 2], "strides": [2, 2], "paddings": [1, 1]},
+                     {"Out": 1, "Mask": 1})
+    o = np.asarray(out["Out"][0])
+    m = np.asarray(out["Mask"][0])
+    assert o.shape == (1, 1, 3, 3)          # (4+2-2)//2+1
+    # every mask offset indexes the unpadded 4x4 plane and points at the max
+    flat = x[0, 0].ravel()
+    np.testing.assert_allclose(flat[m[0, 0].ravel()], o[0, 0].ravel())
+
+    g = eager_call("max_pool2d_with_index", {"X": [jnp.asarray(x)]},
+                   {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+                    "global_pooling": True},
+                   {"Out": 1, "Mask": 1})
+    assert np.asarray(g["Out"][0]).shape == (1, 1, 1, 1)
+    assert float(np.asarray(g["Out"][0]).ravel()[0]) == x.max()
+
+    x3 = rng.rand(1, 2, 5, 5, 5).astype("float32")
+    p3 = eager_call("max_pool3d_with_index", {"X": [jnp.asarray(x3)]},
+                    {"ksize": [3, 3, 3], "strides": [2, 2, 2],
+                     "paddings": [1, 1, 1]},
+                    {"Out": 1, "Mask": 1})
+    assert np.asarray(p3["Out"][0]).shape == (1, 2, 3, 3, 3)
+    m3 = np.asarray(p3["Mask"][0])
+    flat3 = x3.reshape(1, 2, -1)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat3, m3.reshape(1, 2, -1), axis=2),
+        np.asarray(p3["Out"][0]).reshape(1, 2, -1))

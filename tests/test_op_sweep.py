@@ -708,6 +708,80 @@ SPECS["hierarchical_sigmoid"] = S(
     ref=lambda ins, a: {"Out": _hsig_ref(ins)}, grad=["X", "W"], atol=1e-4)
 
 
+# misc ops (ops/misc_ops.py)
+SPECS["cos_sim"] = S({"X": f32(4, 6), "Y": f32(4, 6)},
+                     outs=("Out", "XNorm", "YNorm"),
+                     no_check=("XNorm", "YNorm"),
+                     ref=lambda ins, a: {"Out": (np.sum(ins["X"] * ins["Y"], -1)
+                                                 / (np.linalg.norm(ins["X"], axis=-1)
+                                                    * np.linalg.norm(ins["Y"], axis=-1)))[:, None]},
+                     atol=1e-5)
+SPECS["cross"] = S({"X": f32(4, 3), "Y": f32(4, 3)}, {"dim": 1},
+                   ref=lambda ins, a: {"Out": np.cross(ins["X"], ins["Y"])},
+                   atol=1e-5)
+SPECS["dist"] = S({"X": f32(3, 4), "Y": f32(3, 4)}, {"p": 2.0},
+                  ref=lambda ins, a: {"Out": np.asarray(
+                      np.linalg.norm((ins["X"] - ins["Y"]).ravel()))},
+                  atol=1e-5)
+SPECS["l1_norm"] = S({"X": fn32(3, 4)},
+                     ref=lambda ins, a: {"Out": np.asarray(np.abs(ins["X"]).sum())},
+                     grad=["X"], atol=1e-5)
+SPECS["minus"] = S({"X": f32(3, 4), "Y": f32(3, 4)},
+                   ref=lambda ins, a: {"Out": ins["X"] - ins["Y"]}, grad=["X", "Y"])
+SPECS["inverse"] = S({"Input": np.eye(4, dtype=np.float32) * 2.0 + f32(4, 4) * 0.1},
+                     outs=("Output",), atol=1e-4)
+SPECS["cholesky"] = S({"X": (lambda m: (m @ m.T + 4 * np.eye(4)).astype(np.float32))(f32(4, 4))},
+                      {"upper": False},
+                      ref=lambda ins, a: {"Out": np.linalg.cholesky(ins["X"])},
+                      atol=1e-4)
+SPECS["norm"] = S({"X": f32(3, 5) + 0.1}, {"axis": 1, "epsilon": 1e-10},
+                  outs=("Out", "Norm"), no_check=("Norm",),
+                  ref=lambda ins, a: {"Out": ins["X"] / np.sqrt(
+                      np.square(ins["X"]).sum(1, keepdims=True) + 1e-10)},
+                  grad=["X"], atol=1e-5)
+_nll_raw = fn32(5, 4)
+_nll_x = (_nll_raw - np.log(np.exp(_nll_raw).sum(-1, keepdims=True)))
+SPECS["nll_loss"] = S({"X": _nll_x.astype(np.float32),
+                       "Label": RNG.randint(0, 4, (5,)).astype(np.int64)},
+                      {"reduction": "mean", "ignore_index": -100},
+                      outs=("Out", "Total_weight"), no_check=("Total_weight",),
+                      ref=lambda ins, a: {"Out": np.asarray(np.mean(
+                          [-ins["X"][i, l] for i, l in enumerate(ins["Label"])],
+                          dtype=np.float32))},
+                      atol=1e-5)
+SPECS["partial_concat"] = S({"X": [("pca", f32(3, 6)), ("pcb", f32(3, 6))]},
+                            {"start_index": 1, "length": 2},
+                            ref=lambda ins, a: {"Out": np.concatenate(
+                                [ins["X"][0][:, 1:3], ins["X"][1][:, 1:3]], 1)})
+SPECS["partial_sum"] = S({"X": [("psa", f32(3, 6)), ("psb", f32(3, 6))]},
+                         {"start_index": 1, "length": 2},
+                         ref=lambda ins, a: {"Out": ins["X"][0][:, 1:3]
+                                             + ins["X"][1][:, 1:3]})
+SPECS["reverse"] = S({"X": f32(3, 4)}, {"axis": [1]},
+                     ref=lambda ins, a: {"Out": ins["X"][:, ::-1]})
+SPECS["conv_shift"] = S({"X": f32(2, 8), "Y": f32(2, 3)}, atol=1e-5)
+SPECS["max_pool3d_with_index"] = S(
+    {"X": f32(1, 2, 4, 4, 4)}, {"ksize": [2, 2, 2], "strides": [2, 2, 2]},
+    outs=("Out", "Mask"), no_check=("Mask",),
+    ref=lambda ins, a: {"Out": ins["X"].reshape(1, 2, 2, 2, 2, 2, 2, 2)
+                        .max(axis=(3, 5, 7))})
+SPECS["shrink_rnn_memory"] = S({"X": f32(5, 3), "I": f32(2, 3)},
+                               ref=lambda ins, a: {"Out": ins["X"][:2]})
+SPECS["sync_batch_norm"] = S(
+    {"X": f32(4, 3, 2, 2), "Scale": f32(3), "Bias": f32(3),
+     "Mean": np.zeros(3, np.float32), "Variance": np.ones(3, np.float32)},
+    {"momentum": 0.9, "epsilon": 1e-5, "is_test": False},
+    outs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+    no_check=("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+    atol=1e-4)
+SPECS["coalesce_tensor"] = S(
+    {"Input": [("cta", f32(2, 3)), ("ctb", f32(4))]},
+    outs=(("Output", 2), "FusedOutput"),
+    ref=lambda ins, a: {"Output": [ins["Input"][0], ins["Input"][1]],
+                        "FusedOutput": np.concatenate(
+                            [ins["Input"][0].ravel(), ins["Input"][1].ravel()])})
+
+
 def _bpr_ref(ins):
     x, lbl = ins["X"], ins["Label"].ravel()
     b, c = x.shape
@@ -942,6 +1016,20 @@ COVERED_ELSEWHERE = {
     "locality_aware_nms": "test_detection_extra",
     "retinanet_detection_output": "test_detection_extra",
     "box_decoder_and_assign": "test_detection_extra",
+    # misc_ops: host/stateful/io variants with dedicated coverage
+    "shuffle_batch": "rng: permutation property in test_misc_ops",
+    "split_ids": "test_misc_ops", "merge_ids": "test_misc_ops",
+    "split_selected_rows": "test_misc_ops",
+    "sample_logits": "rng sampling, test_misc_ops",
+    "save": "test_misc_ops", "load": "test_misc_ops",
+    "save_combine": "test_misc_ops", "load_combine": "test_misc_ops",
+    "unpool": "test_misc_ops(max_pool2d_with_index round trip)",
+    "select_output": "test_misc_ops",
+    # engine aliases of kernels tested under their canonical types
+    "cudnn_lstm": "alias of lstm (test_sequence_rnn)",
+    "lstmp": "alias of dynamic_lstmp (test_layers_tail)",
+    "inplace_abn": "alias of batch_norm (test_ops_basic)",
+    "gen_nccl_id": "alias of c_gen_nccl_id (test_parallel)",
     "filter_by_instag": "host dynamic shape, test_layers_tail",
     "reorder_lod_tensor_by_rank": "test_layers_tail",
     # batch_norm: 5-output stateful train path — test_ops_basic + test_models
