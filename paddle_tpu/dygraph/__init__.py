@@ -8,6 +8,8 @@ from .base import (
     grad,
     enable_dygraph,
     disable_dygraph,
+    amp_guard,
+    auto_cast,
 )
 from .varbase import VarBase, ParamBase
 from .tracer import Tracer

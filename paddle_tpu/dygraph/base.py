@@ -55,6 +55,45 @@ def no_grad_ctx():
         tracer._has_grad = prev
 
 
+@contextlib.contextmanager
+def amp_guard(enable=True, custom_white_list=None, custom_black_list=None,
+              dtype="bfloat16"):
+    """Dygraph auto-mixed-precision context (the imperative counterpart
+    of contrib.mixed_precision.decorate; TPU-first: bf16 needs no loss
+    scaling, fp16 accepted for parity).  White-list ops (matmul/conv/
+    fused attention) consume low-precision casts of their f32 inputs;
+    black-list ops are forced back to f32; everything else runs in the
+    dtype it receives.  The casts are traced onto the tape, so the
+    backward matmuls run in the same precision as the forward."""
+    tracer = _current_tracer()
+    if tracer is None:
+        yield
+        return
+    prev = (tracer._amp_enabled, tracer._amp_dtype, tracer._amp_white,
+            tracer._amp_black)
+    # enable=False must actively TURN OFF an enclosing amp_guard — the
+    # standard idiom for opting a numerically sensitive block out of AMP
+    tracer._amp_enabled = bool(enable)
+    tracer._amp_dtype = dtype
+    if custom_white_list or custom_black_list:
+        # same merge semantics as static-graph AMP (single source of truth)
+        from ..contrib.mixed_precision.fp16_lists import (
+            AutoMixedPrecisionLists)
+
+        lists = AutoMixedPrecisionLists(custom_white_list, custom_black_list)
+        tracer._amp_white = lists.white_list | {"fused_multihead_attention"}
+        tracer._amp_black = lists.black_list
+    try:
+        yield
+    finally:
+        (tracer._amp_enabled, tracer._amp_dtype, tracer._amp_white,
+         tracer._amp_black) = prev
+
+
+# paddle 2.0 name
+auto_cast = amp_guard
+
+
 def no_grad(fn=None):
     if fn is None:
         return no_grad_ctx()

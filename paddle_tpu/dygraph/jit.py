@@ -27,16 +27,22 @@ from ..ops import registry
 from .varbase import VarBase
 
 
-def jit_train_step(model, optimizer, loss_fn: Callable):
+def jit_train_step(model, optimizer, loss_fn: Callable, amp=False,
+                   amp_dtype="bfloat16"):
     """Compile an eager train step: loss_fn(model, *varbase_inputs) -> loss.
 
     Returns step(*numpy_or_jax_inputs) -> loss VarBase; parameters and
     optimizer state update in place, but all math runs inside ONE jitted
     XLA program (forward + tape backward + optimizer update fused).
+    With ``amp=True`` the forward traces under ``amp_guard`` — white-list
+    matmuls/convs run in ``amp_dtype`` (and, since the casts are taped,
+    so do their backward ops); params/optimizer state stay f32.
     """
     params = model.parameters()
 
     def raw_step(param_vals, opt_state, rng, inputs):
+        from .base import amp_guard
+
         tracer = _current_tracer()
         old_vals = [p._value for p in params]
         old_tape = tracer._tape
@@ -46,10 +52,12 @@ def jit_train_step(model, optimizer, loss_fn: Callable):
             for p, v in zip(params, param_vals):
                 p._value = v
             tracer._tape = []
+            tracer._tape_epoch += 1
             tracer._rng_key = rng
             optimizer._param_state = opt_state
             in_vars = [VarBase(v) for v in inputs]
-            loss = loss_fn(model, *in_vars)
+            with amp_guard(enable=amp, dtype=amp_dtype):
+                loss = loss_fn(model, *in_vars)
             tracer.run_backward(loss)
             pgs = [(p, p._grad_value) for p in params
                    if p._grad_value is not None]

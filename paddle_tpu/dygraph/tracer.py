@@ -45,16 +45,98 @@ class Tracer:
         # reference: imperative/jit/program_desc_tracer.cc): when set,
         # every traced op is appended regardless of grad requirements.
         self._program_capture: Optional[List[_TapeRecord]] = None
+        # dygraph AMP (reference: the imperative AmpOperators /
+        # auto_cast machinery; TPU-first: bf16, no loss scaling needed):
+        # when enabled, trace_op inserts cast ops around white/black-list
+        # ops, so the casts are themselves taped and the backward runs in
+        # the same precision as the forward.
+        self._amp_enabled = False
+        self._amp_dtype = "bfloat16"
+        self._amp_white: Optional[set] = None
+        self._amp_black: Optional[set] = None
+        # bumped whenever the tape is cleared/replaced: AMP cast-cache
+        # entries from an earlier tape would otherwise be reused without
+        # their producing cast record, silently dropping gradients
+        self._tape_epoch = 0
 
     # ------------------------------------------------------------------
     def _next_rng(self):
         self._rng_key, sub = jax.random.split(self._rng_key)
         return sub
 
+    # ------------------------------------------------------------------
+    def _amp_lists(self):
+        if self._amp_white is None:
+            from ..contrib.mixed_precision.fp16_lists import (
+                black_list, white_list)
+
+            self._amp_white = set(white_list) | {"fused_multihead_attention"}
+            self._amp_black = set(black_list)
+        return self._amp_white, self._amp_black
+
+    def _amp_cast_inputs(self, type: str, inputs):
+        """Insert taped cast ops so a white-list op consumes low-precision
+        inputs (and a black-list op consumes f32)."""
+        import numpy as np
+
+        from ..framework.dtype import VarType, convert_dtype
+
+        white, black = self._amp_lists()
+        if type in white:
+            want = self._amp_dtype
+            src_kinds = ("float32",)
+        elif type in black:
+            want = "float32"
+            src_kinds = ("bfloat16", "float16")
+        else:
+            return inputs
+        want_vt = {"bfloat16": VarType.BF16, "float16": VarType.FP16,
+                   "float32": VarType.FP32}[want]
+        new_inputs = {}
+        for slot, vars_ in (inputs or {}).items():
+            if vars_ is None:
+                new_inputs[slot] = vars_
+                continue
+            single = isinstance(vars_, VarBase)
+            vs = [vars_] if single else list(vars_)
+            casted = []
+            for v in vs:
+                if (isinstance(v, VarBase) and v._value is not None
+                        and str(np.asarray(v._value).dtype
+                                if not hasattr(v._value, "dtype")
+                                else v._value.dtype) in src_kinds):
+                    # per-value cast cache (the reference AMP caches casts
+                    # per var too): a shared f32 param consumed by k
+                    # white-list ops in one step is cast once, not k times
+                    cached = getattr(v, "_amp_cast", None)
+                    if (cached is not None and cached[0] is v._value
+                            and cached[1] == want
+                            and cached[3] == self._tape_epoch):
+                        casted.append(cached[2])
+                        continue
+                    self._amp_enabled = False
+                    try:
+                        (cv,) = self.trace_op(
+                            "cast", {"X": v}, 1,
+                            {"in_dtype": int(convert_dtype(
+                                str(v._value.dtype))),
+                             "out_dtype": int(want_vt)})
+                    finally:
+                        self._amp_enabled = True
+                    cv.stop_gradient = v.stop_gradient
+                    v._amp_cast = (v._value, want, cv, self._tape_epoch)
+                    casted.append(cv)
+                else:
+                    casted.append(v)
+            new_inputs[slot] = casted[0] if single else casted
+        return new_inputs
+
     def trace_op(self, type: str, inputs, outputs, attrs=None):
         """Run op eagerly.  `outputs` is either an int (number of Out vars
         to create), a dict slot->[VarBase], or a dict slot->int."""
         attrs = dict(attrs or {})
+        if self._amp_enabled and type != "cast":
+            inputs = self._amp_cast_inputs(type, inputs)
         in_map: Dict[str, List[str]] = {}
         in_refs: Dict[str, VarBase] = {}
         env: Dict[str, Any] = {}
@@ -190,6 +272,7 @@ class Tracer:
             v._grad_value = g if v._grad_value is None else v._grad_value + g
         if not retain_graph:
             self._tape.clear()
+            self._tape_epoch += 1
 
     # ------------------------------------------------------------------
     def partial_grad(self, outputs, inputs, grad_outputs=None,
@@ -302,6 +385,7 @@ class Tracer:
         # unreachable input without allow_unused) leaves the graph intact
         if not retain:
             self._tape.clear()
+            self._tape_epoch += 1
         return results
 
     # ------------------------------------------------------------------

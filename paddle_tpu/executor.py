@@ -215,6 +215,7 @@ class Executor:
 
         check_nan_inf = bool(flag("check_nan_inf"))
         unused_check = bool(flag("enable_unused_var_check"))
+        ir_passes = bool(flag("apply_ir_passes"))
         feed_spec = tuple(
             sorted(
                 (k, tuple(np.shape(v)),
@@ -222,12 +223,13 @@ class Executor:
                 for k, v in feed.items()
             )
         )
-        key = (id(program), program._version, feed_spec, tuple(fetch_names),
-               check_nan_inf, unused_check)
+        key = (program._uid, program._version, feed_spec, tuple(fetch_names),
+               check_nan_inf, unused_check, ir_passes)
         hit = self._cache.get(key)
         if hit is not None:
             return hit
 
+        program = self._apply_ir_passes(program, fetch_names)
         block = program.global_block()
         state_in, state_out, uses_rng, has_host_ops = analyze_state(
             block.ops, block, feed, scope
@@ -403,6 +405,31 @@ class Executor:
         compiled.readonly = tuple(readonly)
         self._cache[key] = compiled
         return compiled
+
+    # ------------------------------------------------------------------
+    def _apply_ir_passes(self, program: Program, fetch_names):
+        """Training-time fusion pipeline (reference: BuildStrategy
+        fuse_bn_act_ops / fuse_bn_add_act_ops applied in
+        parallel_executor.cc:581).  Runs on a clone so the user's program
+        stays introspectable; the compile cache is keyed on the original
+        program, so the clone+rewrite happens once per compilation."""
+        from .utils.flags import flag
+
+        if not flag("apply_ir_passes"):
+            return program
+        types = {o.type for b in program.blocks for o in b.ops}
+        if "batch_norm" not in types:
+            return program
+        from .framework.ir import PassManager, get_pass
+
+        clone = Program.from_desc_dict(program.desc_dict())
+        clone.random_seed = program.random_seed
+        protected = tuple(fetch_names)
+        PassManager([
+            get_pass("fuse_bn_add_act_pass", protected=protected),
+            get_pass("fuse_bn_act_pass", protected=protected),
+        ]).apply(clone)
+        return clone
 
     # ------------------------------------------------------------------
     def _execute(self, compiled, feed, fetch_names, scope, return_numpy, program):

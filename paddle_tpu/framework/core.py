@@ -441,11 +441,18 @@ class Block:
 class Program:
     """Reference: framework.py:3852 / proto ProgramDesc."""
 
+    _uid_counter = 0
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
         self._version = 0
+        # monotonic program identity for executor caches: id() is reused
+        # after GC, so a long-lived Executor serving short-lived Programs
+        # could hit a stale compiled entry keyed on id(program)
+        Program._uid_counter += 1
+        self._uid = Program._uid_counter
         self._op_role = 0  # OpRole.Forward
         self._is_distributed = False
         self._seed_counter = 0

@@ -336,6 +336,14 @@ def _is_last_axis_softmax(op_):
     return op_.attrs.get("axis", -1) in (-1, 3)
 
 
+def _is_default_axis_add(op_):
+    """The fused attention kernel applies BiasQK under plain numpy
+    broadcasting; an elementwise_add with an explicit non-default axis
+    broadcast would be silently reinterpreted, so only fuse the default
+    (trailing-aligned) form."""
+    return op_.attrs.get("axis", -1) == -1
+
+
 @register_pass("fuse_multihead_attention_pass")
 class FuseMultiheadAttentionPass(Pass):
     """Map the naive attention subgraph onto the Pallas flash-attention
@@ -359,7 +367,8 @@ class FuseMultiheadAttentionPass(Pass):
             [OpTemplate("qk", "matmul", predicate=_is_qk_matmul),
              OpTemplate("scale", "scale", {"X": "qk.Out"},
                         predicate=_is_scale_like),
-             OpTemplate("mask", "elementwise_add", {"X": "scale.Out"}),
+             OpTemplate("mask", "elementwise_add", {"X": "scale.Out"},
+                        predicate=_is_default_axis_add),
              OpTemplate("softmax", "softmax", {"X": "mask.Out"},
                         predicate=_is_last_axis_softmax),
              OpTemplate("av", "matmul", {"X": "softmax.Out"},
@@ -372,7 +381,8 @@ class FuseMultiheadAttentionPass(Pass):
              OpTemplate("av", "matmul", {"X": "softmax.Out"},
                         predicate=_is_av_matmul)],
             [OpTemplate("qk", "matmul", predicate=_is_qk_matmul),
-             OpTemplate("mask", "elementwise_add", {"X": "qk.Out"}),
+             OpTemplate("mask", "elementwise_add", {"X": "qk.Out"},
+                        predicate=_is_default_axis_add),
              OpTemplate("softmax", "softmax", {"X": "mask.Out"},
                         predicate=_is_last_axis_softmax),
              OpTemplate("av", "matmul", {"X": "softmax.Out"},
@@ -412,3 +422,258 @@ class FuseMultiheadAttentionPass(Pass):
         block._insert_op(idx, "fused_multihead_attention",
                          inputs=inputs, outputs=out,
                          attrs={"scale": float(scale), "causal": False})
+
+
+# --------------------------------------------------------------------------
+# fused BN(+add)+activation passes (reference: ir/fuse_bn_act_pass.cc,
+# ir/fuse_bn_add_act_pass.cc — the cudnn fused-BN rewrite; here the
+# targets are ops/fused_ops.py fused_batch_norm_act /
+# fused_bn_add_activation, whose closed-form backward avoids the
+# vjp-replay residuals).  Unlike the attention pass these rewrite the
+# forward AND its backward chain together, because by the time the
+# executor sees a training program append_backward has already emitted
+# relu_grad/elementwise_add_grad/batch_norm_grad ops that reference the
+# unfused intermediates.
+# --------------------------------------------------------------------------
+def _consumers(block):
+    cons: Dict[str, List[Operator]] = {}
+    for op_ in block.ops:
+        for names in op_.inputs.values():
+            for n in names:
+                cons.setdefault(n, []).append(op_)
+    return cons
+
+
+class _FuseBNActBase(Pass):
+    #: vars the rewrite must not make unavailable (fetch targets)
+    protected: Sequence[str] = ()
+
+    def apply_impl(self, program):
+        fused = 0
+        for block in program.blocks:
+            # vars referenced from ANY other block (while/cond carries,
+            # sub-block free vars) are invisible to this block's consumer
+            # map — never fuse away their producers
+            external = set()
+            for other in program.blocks:
+                if other is block:
+                    continue
+                for op_ in other.ops:
+                    for names in op_.inputs.values():
+                        external.update(names)
+                    for names in op_.outputs.values():
+                        external.update(names)
+            fused += self._apply_block(block, external)
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program
+
+
+@register_pass("fuse_bn_act_pass")
+class FuseBNActPass(_FuseBNActBase):
+    """batch_norm -> relu  (and its grad chain)  ==> fused_batch_norm_act."""
+
+    def _apply_block(self, block, external=()):
+        protected = set(self.protected) | set(external)
+        fused = 0
+        changed = True
+        while changed:
+            changed = False
+            cons = _consumers(block)
+            for bn in list(block.ops):
+                if bn.type != "batch_norm":
+                    continue
+                y0 = bn.outputs.get("Y", [None])[0]
+                if not y0 or y0 in protected:
+                    continue
+                users = cons.get(y0, [])
+                relu = next((o for o in users if o.type == "relu"
+                             and o.inputs.get("X", [None])[0] == y0), None)
+                if relu is None:
+                    continue
+                bn_grad = next((o for o in users if o.type == "batch_norm_grad"
+                                and o.inputs.get("Y", [None])[0] == y0), None)
+                relu_grad = next(
+                    (o for o in users if o.type == "relu_grad"
+                     and o.inputs.get("X", [None])[0] == y0), None)
+                allowed = {id(relu), id(bn_grad), id(relu_grad)}
+                if any(id(o) not in allowed for o in users):
+                    continue
+                y1 = relu.outputs["Out"][0]
+                if (bn_grad is None) != (relu_grad is None):
+                    continue  # half a backward: leave it alone
+                if bn_grad is not None:
+                    # relu_grad must feed exactly bn_grad's dY, and the
+                    # rewrite stops producing dy0 — so it must not be a
+                    # fetch target either
+                    dy0 = relu_grad.outputs.get("X@GRAD", [None])[0]
+                    if (dy0 in protected
+                            or bn_grad.inputs.get("Y@GRAD", [None])[0] != dy0
+                            or any(id(o) != id(bn_grad)
+                                   for o in cons.get(dy0, []))):
+                        continue
+                    if relu_grad.inputs.get("Out", [None])[0] != y1:
+                        continue
+                # ---- rewrite forward
+                idx = block.ops.index(bn)
+                attrs = dict(bn.attrs)
+                attrs["act_type"] = "relu"
+                inputs = {k: list(v) for k, v in bn.inputs.items()}
+                outputs = {k: list(v) for k, v in bn.outputs.items()}
+                outputs["Y"] = [y1]
+                remove_ops(block, [bn, relu])
+                block._insert_op(idx, "fused_batch_norm_act",
+                                 inputs=inputs, outputs=outputs, attrs=attrs)
+                # ---- rewrite backward
+                if bn_grad is not None:
+                    gidx = block.ops.index(relu_grad)
+                    ginputs = {
+                        "X": list(bn.inputs["X"]),
+                        "Y": [y1],
+                        "Scale": list(bn.inputs["Scale"]),
+                        "SavedMean": list(bn.outputs["SavedMean"]),
+                        "SavedVariance": list(bn.outputs["SavedVariance"]),
+                        "Y@GRAD": list(relu_grad.inputs["Out@GRAD"]),
+                    }
+                    goutputs = {
+                        "X@GRAD": list(bn_grad.outputs.get("X@GRAD", [])),
+                        "Scale@GRAD": list(bn_grad.outputs.get("Scale@GRAD", [])),
+                        "Bias@GRAD": list(bn_grad.outputs.get("Bias@GRAD", [])),
+                    }
+                    remove_ops(block, [relu_grad, bn_grad])
+                    block._insert_op(gidx, "fused_batch_norm_act_grad",
+                                     inputs=ginputs, outputs=goutputs,
+                                     attrs=dict(attrs))
+                fused += 1
+                changed = True
+                break
+        return fused
+
+
+@register_pass("fuse_bn_add_act_pass")
+class FuseBNAddActPass(_FuseBNActBase):
+    """batch_norm -> elementwise_add -> relu (and grads) ==>
+    fused_bn_add_activation.  Only same-shape adds with the default axis
+    are fused (a broadcasting add is not the cudnn pattern and the fused
+    kernel would reinterpret it)."""
+
+    def _apply_block(self, block, external=()):
+        protected = set(self.protected) | set(external)
+        fused = 0
+        changed = True
+        while changed:
+            changed = False
+            cons = _consumers(block)
+            for bn in list(block.ops):
+                if bn.type != "batch_norm":
+                    continue
+                y0 = bn.outputs.get("Y", [None])[0]
+                if not y0 or y0 in protected:
+                    continue
+                users = cons.get(y0, [])
+                add = next((o for o in users if o.type == "elementwise_add"
+                            and o.attrs.get("axis", -1) == -1
+                            and y0 in (o.inputs.get("X", [None])[0],
+                                       o.inputs.get("Y", [None])[0])), None)
+                if add is None:
+                    continue
+                bn_grad = next((o for o in users if o.type == "batch_norm_grad"
+                                and o.inputs.get("Y", [None])[0] == y0), None)
+                # the replayed elementwise_add_grad desc re-reads the
+                # forward's X/Y, so it legitimately appears among y0's
+                # (and ya's) consumers
+                add_grad = next(
+                    (o for o in users if o.type == "elementwise_add_grad"
+                     and o.inputs.get("X", [None]) == add.inputs.get("X")
+                     and o.inputs.get("Y", [None]) == add.inputs.get("Y")),
+                    None)
+                if any(id(o) not in {id(add), id(bn_grad), id(add_grad)}
+                       for o in users):
+                    continue
+                # z = the other operand; shapes must match exactly
+                xn, yn = add.inputs["X"][0], add.inputs["Y"][0]
+                z = xn if yn == y0 else yn
+                bn_slot_is_y = yn == y0
+                vy, vz = block._find_var_recursive(y0), \
+                    block._find_var_recursive(z)
+                if (vy is None or vz is None or vy.shape is None
+                        or list(vy.shape) != list(vz.shape)):
+                    continue
+                ya = add.outputs["Out"][0]
+                if ya in protected:
+                    continue
+                ya_users = cons.get(ya, [])
+                relu = next((o for o in ya_users if o.type == "relu"
+                             and o.inputs.get("X", [None])[0] == ya), None)
+                if relu is None:
+                    continue
+                relu_grad = next(
+                    (o for o in ya_users if o.type == "relu_grad"
+                     and o.inputs.get("X", [None])[0] == ya), None)
+                if any(id(o) not in {id(relu), id(relu_grad), id(add_grad)}
+                       for o in ya_users):
+                    continue
+                if bn_grad is not None or relu_grad is not None \
+                        or add_grad is not None:
+                    if bn_grad is None or relu_grad is None \
+                            or add_grad is None:
+                        continue  # half a backward: leave it alone
+                    dya = relu_grad.outputs.get("X@GRAD", [None])[0]
+                    if (dya in protected
+                            or add_grad.inputs.get("Out@GRAD", [None])[0] != dya
+                            or any(id(o) != id(add_grad)
+                                   for o in cons.get(dya, []))):
+                        continue
+                    # add_grad's bn-side output must feed exactly bn_grad
+                    bn_side = "Y@GRAD" if bn_slot_is_y else "X@GRAD"
+                    z_side = "X@GRAD" if bn_slot_is_y else "Y@GRAD"
+                    dy0 = add_grad.outputs.get(bn_side, [None])[0]
+                    if (dy0 is None or dy0 in protected
+                            or bn_grad.inputs.get("Y@GRAD", [None])[0] != dy0
+                            or any(id(o) != id(bn_grad)
+                                   for o in cons.get(dy0, []))):
+                        continue
+                    dz = add_grad.outputs.get(z_side, [None])[0]
+                    if relu_grad.inputs.get("Out", [None])[0] != \
+                            relu.outputs["Out"][0]:
+                        continue
+                y1 = relu.outputs["Out"][0]
+                # ---- rewrite forward
+                idx = block.ops.index(relu)
+                idx -= sum(1 for o in (bn, add)
+                           if block.ops.index(o) < idx)
+                attrs = dict(bn.attrs)
+                attrs["act_type"] = "relu"
+                inputs = {k: list(v) for k, v in bn.inputs.items()}
+                inputs["Z"] = [z]
+                outputs = {k: list(v) for k, v in bn.outputs.items()}
+                outputs["Y"] = [y1]
+                remove_ops(block, [bn, add, relu])
+                block._insert_op(idx, "fused_bn_add_activation",
+                                 inputs=inputs, outputs=outputs, attrs=attrs)
+                # ---- rewrite backward
+                if bn_grad is not None:
+                    gidx = block.ops.index(relu_grad)
+                    ginputs = {
+                        "X": list(bn.inputs["X"]),
+                        "Y": [y1],
+                        "Scale": list(bn.inputs["Scale"]),
+                        "SavedMean": list(bn.outputs["SavedMean"]),
+                        "SavedVariance": list(bn.outputs["SavedVariance"]),
+                        "Y@GRAD": list(relu_grad.inputs["Out@GRAD"]),
+                    }
+                    goutputs = {
+                        "X@GRAD": list(bn_grad.outputs.get("X@GRAD", [])),
+                        "Scale@GRAD": list(bn_grad.outputs.get("Scale@GRAD", [])),
+                        "Bias@GRAD": list(bn_grad.outputs.get("Bias@GRAD", [])),
+                        "Z@GRAD": [dz] if dz else [],
+                    }
+                    remove_ops(block, [relu_grad, add_grad, bn_grad])
+                    block._insert_op(gidx, "fused_bn_add_activation_grad",
+                                     inputs=ginputs, outputs=goutputs,
+                                     attrs=dict(attrs))
+                fused += 1
+                changed = True
+                break
+        return fused

@@ -95,6 +95,11 @@ def _warpctc(ctx):
     labels = ctx.in_("Label")
     blank = ctx.attr("blank", 0)
     norm_by_times = ctx.attr("norm_by_times", False)
+    # transpose to time-major FIRST: the default LogitsLength below reads
+    # (Tmax, B) off logits.shape, which would be reversed for a
+    # batch-first caller that omits LogitsLength
+    if ctx.attr("batch_first", False):
+        logits = jnp.transpose(logits, (1, 0, 2))
     if ctx.has_input("LogitsLength"):
         logit_lens = ctx.in_("LogitsLength").astype(jnp.int32)
     else:
@@ -103,8 +108,6 @@ def _warpctc(ctx):
         label_lens = ctx.in_("LabelLength").astype(jnp.int32)
     else:
         label_lens = jnp.full((labels.shape[0],), labels.shape[1], jnp.int32)
-    if ctx.attr("batch_first", False):
-        logits = jnp.transpose(logits, (1, 0, 2))
     loss = _ctc_loss_padded(logits, logit_lens, labels.astype(jnp.int32),
                             label_lens, blank)
     if norm_by_times:

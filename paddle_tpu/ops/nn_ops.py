@@ -207,9 +207,22 @@ def _max_pool2d_with_index(ctx):
     strides = list(ctx.attr("strides", ksize))
     pads = list(ctx.attr("paddings", [0, 0]))
     n, c, h, w = x.shape
-    if ctx.attr("global_pooling", False) or ctx.attr("adaptive", False) and \
-            ksize == [1, 1]:
+    if ctx.attr("global_pooling", False):
         ksize, strides, pads = [h, w], [h, w], [0, 0]
+    elif ctx.attr("adaptive", False):
+        # adaptive: ksize IS the output size.  [1,1] -> global; otherwise
+        # the divisible-reshape path (like pool3d): each output cell owns
+        # an (h/oh, w/ow) window
+        oh_t, ow_t = ksize
+        if (oh_t, ow_t) == (1, 1):
+            ksize, strides, pads = [h, w], [h, w], [0, 0]
+        elif h % oh_t == 0 and w % ow_t == 0:
+            ksize = [h // oh_t, w // ow_t]
+            strides, pads = list(ksize), [0, 0]
+        else:
+            raise NotImplementedError(
+                f"max_pool2d_with_index adaptive output {ksize} does not "
+                f"divide input plane ({h}, {w})")
     neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
     xp = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])),
                  constant_values=neg)
@@ -240,6 +253,51 @@ def _max_pool2d_with_index(ctx):
 # batch_norm (reference: batch_norm_op.cc) — running stats thread through
 # the functional env as extra outputs aliased to the stat var names.
 # --------------------------------------------------------------------------
+def bn_shapes(x, layout):
+    """(c_axis, reduction axes, broadcast shape, element count) for a BN
+    over `layout` — shared by batch_norm and the fused_bn_* ops."""
+    nd = jnp.ndim(x)
+    c_axis = 1 if layout in ("NCHW", "AnyLayout") and nd > 1 else nd - 1
+    red_axes = tuple(i for i in range(nd) if i != c_axis)
+    bshape = [1] * nd
+    bshape[c_axis] = jnp.shape(x)[c_axis]
+    n = 1
+    for i in red_axes:
+        n *= jnp.shape(x)[i]
+    return c_axis, red_axes, bshape, n
+
+
+def bn_train_stats(x, red_axes, bshape, n, c_axis):
+    """One-pass f32 batch mean/var (sum + centered sum-of-squares fused
+    into ONE read of x): under AMP the activations are bf16 and the f32
+    mean-then-var two-pass form both re-reads x and materializes an f32
+    copy — on TPU that made batch_norm, not the convs, the step
+    bottleneck (measured ~40% of a ResNet-50 train step on v5e).  Raw
+    E[x^2]-m^2 cancels catastrophically when |mean| >> std, so first
+    estimate the mean from a small batch subsample (error ~
+    std/sqrt(n_sub), plenty for a shift) and accumulate moments of
+    (x - shift): variance is shift-invariant, so the vjp through
+    stop_gradient(shift) stays exact.  Shared by batch_norm and the
+    fused_bn_*_activation ops so the two paths stay numerically
+    identical."""
+    if jnp.ndim(x) > 1 and c_axis != 0 and jnp.shape(x)[0] > 8:
+        # a 1/8 batch subsample estimates the per-channel mean far more
+        # precisely than the shift needs (anything within a few hundred
+        # std of the true mean kills the cancellation); measured fastest
+        # among the robust variants on v5e
+        sub = lax.slice_in_dim(x, 0, jnp.shape(x)[0] // 8, axis=0)
+        shift = jnp.mean(sub.astype(jnp.float32), axis=red_axes)
+    else:
+        shift = jnp.mean(x.astype(jnp.float32), axis=red_axes)
+    shift = lax.stop_gradient(shift)
+    xs = x.astype(jnp.float32) - jnp.reshape(shift, bshape)
+    s1 = jnp.sum(xs, axis=red_axes)
+    s2 = jnp.sum(lax.square(xs), axis=red_axes)
+    mean = shift + s1 / n
+    var = jnp.maximum(s2 / n - lax.square(s1 / n), 0.0)
+    return mean, var
+
+
 @op("batch_norm")
 def _batch_norm(ctx):
     x = ctx.in_("X")
@@ -251,46 +309,14 @@ def _batch_norm(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     is_test = ctx.attr("is_test", False) or ctx.attr("use_global_stats", False)
     layout = ctx.attr("data_layout", "NCHW")
-    nd = jnp.ndim(x)
-    c_axis = 1 if layout in ("NCHW", "AnyLayout") and nd > 1 else nd - 1
-    red_axes = tuple(i for i in range(nd) if i != c_axis)
-    bshape = [1] * nd
-    bshape[c_axis] = jnp.shape(x)[c_axis]
+    c_axis, red_axes, bshape, n = bn_shapes(x, layout)
 
     if is_test:
         mean, var = mean_rt, var_rt
         ctx.set_out("MeanOut", mean_rt)
         ctx.set_out("VarianceOut", var_rt)
     else:
-        # One-pass stats (sum + centered sum-of-squares fused into ONE
-        # read of x, accumulated f32): under AMP the activations are
-        # bf16 and the f32 mean-then-var two-pass form both re-reads x
-        # and materializes an f32 copy — on TPU that made batch_norm,
-        # not the convs, the step bottleneck (measured ~40% of a
-        # ResNet-50 train step on v5e).  Raw E[x^2]-m^2 cancels
-        # catastrophically when |mean| >> std, so first estimate the
-        # mean from a small batch subsample (error ~ std/sqrt(n_sub),
-        # plenty for a shift) and accumulate moments of (x - shift):
-        # variance is shift-invariant, so the vjp through
-        # stop_gradient(shift) stays exact.
-        n = 1
-        for i in red_axes:
-            n *= jnp.shape(x)[i]
-        if nd > 1 and c_axis != 0 and jnp.shape(x)[0] > 8:
-            # a 1/8 batch subsample estimates the per-channel mean far
-            # more precisely than the shift needs (anything within a few
-            # hundred std of the true mean kills the cancellation);
-            # measured fastest among the robust variants on v5e
-            sub = lax.slice_in_dim(x, 0, jnp.shape(x)[0] // 8, axis=0)
-            shift = jnp.mean(sub.astype(jnp.float32), axis=red_axes)
-        else:
-            shift = jnp.mean(x.astype(jnp.float32), axis=red_axes)
-        shift = lax.stop_gradient(shift)
-        xs = x.astype(jnp.float32) - jnp.reshape(shift, bshape)
-        s1 = jnp.sum(xs, axis=red_axes)
-        s2 = jnp.sum(lax.square(xs), axis=red_axes)
-        mean = shift + s1 / n
-        var = jnp.maximum(s2 / n - lax.square(s1 / n), 0.0)
+        mean, var = bn_train_stats(x, red_axes, bshape, n, c_axis)
         ctx.set_out("MeanOut", momentum * mean_rt + (1.0 - momentum) * mean)
         ctx.set_out("VarianceOut", momentum * var_rt + (1.0 - momentum) * var)
     inv = lax.rsqrt(var + eps)
@@ -328,15 +354,19 @@ def _layer_norm(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     shape = jnp.shape(x)
     axes = tuple(range(begin, len(shape)))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
+    # statistics always in f32: under (dygraph) AMP x is bf16 and bf16
+    # mean/var accumulation loses ~3 digits; the upcast fuses into the
+    # reduction so x is still read once in its own precision
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
     inv = lax.rsqrt(var + eps)
-    y = (x - mean) * inv
+    y = ((x32 - mean) * inv).astype(x.dtype)
     norm_shape = shape[begin:]
     if ctx.has_input("Scale"):
-        y = y * jnp.reshape(ctx.in_("Scale"), norm_shape)
+        y = y * jnp.reshape(ctx.in_("Scale"), norm_shape).astype(x.dtype)
     if ctx.has_input("Bias"):
-        y = y + jnp.reshape(ctx.in_("Bias"), norm_shape)
+        y = y + jnp.reshape(ctx.in_("Bias"), norm_shape).astype(x.dtype)
     ctx.set_out("Y", y)
     ctx.set_out("Mean", jnp.reshape(mean, shape[:begin]))
     ctx.set_out("Variance", jnp.reshape(var, shape[:begin]))
@@ -404,6 +434,10 @@ def _softmax_ce(ctx):
     axis = ctx.attr("axis", -1)
     soft_label = ctx.attr("soft_label", False)
     ignore_index = ctx.attr("ignore_index", -100)
+    # log-softmax in f32 even for bf16 (AMP) logits: the upcast fuses
+    # into the logsumexp reduction, and bf16 log-probs would cost ~2
+    # digits on the loss
+    logits = logits.astype(jnp.float32)
     log_p = jnn.log_softmax(logits, axis=axis)
     ctx.set_out("Softmax", jnp.exp(log_p))
     if soft_label:

@@ -44,10 +44,10 @@ class Optimizer:
     # ------------------------------------------------------------------
     def _create_global_learning_rate(self):
         program = default_main_program()
-        if id(program) in self._learning_rate_map:
+        if program._uid in self._learning_rate_map:
             return
         if isinstance(self._learning_rate, Variable):
-            self._learning_rate_map[id(program)] = self._learning_rate
+            self._learning_rate_map[program._uid] = self._learning_rate
             return
         from .layers import tensor as tensor_layers
 
@@ -55,10 +55,10 @@ class Optimizer:
             shape=[1], value=float(self._learning_rate), dtype="float32",
             persistable=True, name=unique_name.generate("learning_rate"),
         )
-        self._learning_rate_map[id(program)] = lr
+        self._learning_rate_map[program._uid] = lr
 
     def _global_learning_rate(self):
-        return self._learning_rate_map.get(id(default_main_program()))
+        return self._learning_rate_map.get(default_main_program()._uid)
 
     def _create_param_lr(self, param):
         lr = self._global_learning_rate()

@@ -31,6 +31,10 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_enable_unused_var_check": False,
     "FLAGS_tpu_matmul_precision": "default",  # TPU-native: bf16 matmul control
     "FLAGS_tpu_donate_buffers": True,
+    # training-time IR fusion pipeline (reference: build_strategy
+    # fuse_bn_act_ops / fuse_bn_add_act_ops); applied by the Executor at
+    # compile time on a program clone
+    "FLAGS_apply_ir_passes": True,
 }
 
 

@@ -92,3 +92,29 @@ def test_amp_loss_close_to_fp32():
     lbf = float(exe.run(amp_prog, feed=feed, fetch_list=[loss32.name],
                         scope=s2)[0])
     assert abs(l32 - lbf) / max(abs(l32), 1e-6) < 0.05, (l32, lbf)
+
+
+def test_dygraph_amp_grad_accumulation_across_backwards():
+    """The AMP cast cache must not survive a tape clear: two
+    forward+backward passes before the optimizer step must accumulate
+    BOTH contributions into the param grad (code-review r3 regression)."""
+    import numpy as np
+
+    from paddle_tpu.dygraph import amp_guard, guard, to_variable
+    from paddle_tpu.dygraph.nn import Linear
+
+    with guard():
+        lin = Linear(4, 4)
+        x = to_variable(np.ones((2, 4), np.float32))
+        import paddle_tpu.layers as F
+
+        with amp_guard():
+            loss1 = F.reduce_sum(lin(x))
+        loss1.backward()
+        g1 = np.asarray(lin.weight.gradient()).copy()
+        with amp_guard():
+            loss2 = F.reduce_sum(lin(x))
+        loss2.backward()
+        g2 = np.asarray(lin.weight.gradient())
+        np.testing.assert_allclose(g2, 2 * g1, rtol=1e-6)
+        assert np.abs(g1).sum() > 0

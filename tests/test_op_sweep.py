@@ -965,6 +965,9 @@ COVERED_ELSEWHERE = {
     "dgc": "test_dgc", "dgc_momentum": "test_dgc",
     # fused / pallas — tests/test_pallas_attention.py
     "fused_multihead_attention": "test_pallas_attention",
+    # fused BN(+add)+act — tests/test_fused_bn.py
+    "fused_batch_norm_act": "test_fused_bn",
+    "fused_bn_add_activation": "test_fused_bn",
     # sparse path — tests/test_selected_rows.py
     "lookup_table_sparse_grad": "test_selected_rows",
     # stateful-forward grad pair — tests/test_dygraph.py dropout tests

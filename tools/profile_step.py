@@ -1,0 +1,163 @@
+"""Profile one model's train step on the attached chip and print a
+per-fusion device-time table (the r2 BENCHMARKS.md breakdown, scripted).
+
+Usage: python tools/profile_step.py [resnet50|ernie] [--steps N]
+Writes the raw trace under /tmp/pt_trace/ and prints the top device ops
+aggregated by fusion kind.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_resnet(steps=8, batch=128, image=224, amp=True):
+    import jax
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.resnet import build_resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, image, image])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        loss, acc1, acc5, logits = build_resnet(img, label, depth=50)
+        opt = fluid.optimizer.MomentumOptimizer(0.1, 0.9)
+        if amp:
+            opt = fluid.contrib.mixed_precision.decorate(opt)
+        opt.minimize(loss)
+    place = pt.TPUPlace(0) if pt.is_compiled_with_tpu() else pt.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    device = place.jax_device()
+    feed = {
+        "img": jax.device_put(
+            rng.rand(batch, 3, image, image).astype(np.float32), device),
+        "label": jax.device_put(
+            rng.randint(0, 1000, (batch, 1)).astype(np.int32), device),
+    }
+
+    def step():
+        return exe.run(main, feed=feed, fetch_list=[loss.name],
+                       return_numpy=False)
+
+    return step
+
+
+def run_ernie(steps=8, batch=16, seq=512, attn_dropout=True):
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.dygraph import guard, jit_train_step
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    cfg = BertConfig(
+        attention_probs_dropout_prob=0.1 if attn_dropout else 0.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    g = guard()
+    g.__enter__()
+    model = BertForPretraining(cfg)
+    opt = fluid.optimizer.AdamOptimizer(1e-4,
+                                        parameter_list=model.parameters())
+    fn = jit_train_step(model, opt, lambda m, i, l: m(i, l))
+
+    def step():
+        return fn(ids, labels)
+
+    return step
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    steps = 6
+    import jax
+    import numpy as np
+
+    step = run_ernie() if which == "ernie" else run_resnet()
+    # warmup/compile
+    for _ in range(3):
+        out = step()
+    jax.block_until_ready(getattr(out[0], "_jax", out))
+    trace_dir = f"/tmp/pt_trace/{which}"
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            out = step()
+        v = out[0]
+        arr = v.value() if hasattr(v, "value") else v
+        np.asarray(arr)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step()
+    v = out[0]
+    arr = v.value() if hasattr(v, "value") else v
+    np.asarray(arr)
+    wall = (time.perf_counter() - t0) / steps
+    print(f"wall per step (untraced): {wall * 1e3:.2f} ms")
+    summarize(trace_dir, steps)
+
+
+def summarize(trace_dir, steps):
+    """Aggregate device-side event durations from the xplane protobuf via
+    the tensorboard_plugin_profile-free path: parse trace.json.gz."""
+    files = glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz"))
+    if not files:
+        print("no trace.json.gz found under", trace_dir)
+        return
+    path = sorted(files)[-1]
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # device lanes: pid whose process name mentions TPU / device
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pid_names.items()
+                if "TPU" in n or "/device" in n.lower()}
+    agg = {}
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "")
+        dur = e.get("dur", 0) / 1e3  # us -> ms
+        # bucket by op kind
+        key = name
+        for tag in ("fusion", "convolution", "copy", "dynamic-update-slice",
+                    "custom-call", "reduce", "transpose", "dot",
+                    "all-reduce", "select-and-scatter", "scatter", "rng"):
+            if tag in name:
+                key = tag
+                break
+        agg[key] = agg.get(key, 0.0) + dur
+        total += dur
+    print(f"\ndevice total: {total / steps:.2f} ms/step  ({path})")
+    for k, v in sorted(agg.items(), key=lambda kv: -kv[1])[:25]:
+        print(f"  {v / steps:8.3f} ms  {k}")
+    # also top individual events
+    per_ev = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        per_ev[e["name"]] = per_ev.get(e["name"], 0.0) + e.get("dur", 0) / 1e3
+    print("\ntop 30 individual HLO ops:")
+    for k, v in sorted(per_ev.items(), key=lambda kv: -kv[1])[:30]:
+        print(f"  {v / steps:8.3f} ms  {k[:110]}")
+
+
+if __name__ == "__main__":
+    main()
