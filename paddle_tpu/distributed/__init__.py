@@ -92,11 +92,23 @@ def init_parallel_env():
 prepare_context = init_parallel_env
 
 
+# collective-call telemetry: lets tests/microbenches assert how many
+# collectives a step issued (e.g. DataParallel grad coalescing must do
+# O(1) per step, not O(n_params))
+_collective_calls = 0
+
+
+def collective_call_count() -> int:
+    return _collective_calls
+
+
 def all_reduce(tensor, op="sum", group=0):
     """Host-level collective on eager values (dygraph DP path)."""
     import jax
     import numpy as np
 
+    global _collective_calls
+    _collective_calls += 1
     if get_world_size() <= 1:
         return tensor
     from jax.experimental import multihost_utils

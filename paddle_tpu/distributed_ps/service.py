@@ -72,14 +72,19 @@ class BarrierMonitor:
                     if self._released_gen >= gen:
                         break
                     if time.time() >= deadline:
-                        # caller-side timeout: abandon the round entirely —
-                        # leaving our arrival behind would let a late
-                        # trainer "complete" a barrier we already treated
-                        # as broken (split brain)
+                        # caller-side timeout: release the WHOLE
+                        # generation, exactly like the monitor thread —
+                        # removing only our own arrival would leave the
+                        # other waiters blocked on a round that can no
+                        # longer complete, and they would later observe a
+                        # different missing-trainer list
                         missing = self._missing_locked()
-                        self._arrived.pop(trainer_id, None)
                         self._failed = missing
                         self._valid = False
+                        self._released_gen = self._generation
+                        self._generation += 1
+                        self._arrived.clear()
+                        self._cv.notify_all()
                         return missing
             return list(self._failed)
 

@@ -17,10 +17,20 @@ from .layers import Layer
 
 
 class DataParallel(Layer):
-    def __init__(self, layers: Layer, strategy=None):
+    """reference: dygraph/parallel.py:225.  comm_buffer_size /
+    last_comm_buffer_size are in MB, like the reference's coalescing
+    config (imperative/all_reduce.cc groups grads into fused buffers
+    before NCCL; here buckets concat on device and cross the host
+    boundary once per bucket instead of once per parameter)."""
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1):
         super().__init__()
         self._layers = layers
         self._nranks = dist.get_world_size()
+        self._comm_buffer_bytes = int(comm_buffer_size * 1024 * 1024)
+        self._last_comm_buffer_bytes = int(
+            last_comm_buffer_size * 1024 * 1024)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -32,16 +42,61 @@ class DataParallel(Layer):
             return loss
         return loss * (1.0 / self._nranks)
 
+    def _grad_buckets(self):
+        """Coalescing plan: reverse parameter order (grads of late layers
+        are ready first in the backward — the reference fuses in that
+        order too), grouped by dtype, cut at comm_buffer_size.  The
+        FIRST bucket is capped at last_comm_buffer_size so the earliest
+        collective can start before most of the backward has run — the
+        reference knob with the same purpose."""
+        import jax.numpy as jnp
+
+        pending = []
+        for p in reversed(self._layers.parameters()):
+            g = p._grad_value
+            if g is None:
+                continue
+            if hasattr(g, "to_dense"):  # SelectedRows sparse grad
+                g = g.to_dense()
+            pending.append((p, jnp.asarray(g)))
+        buckets = []
+        cur, cur_bytes, cur_dtype = [], 0, None
+        for p, g in pending:
+            cap = (self._last_comm_buffer_bytes if not buckets
+                   else self._comm_buffer_bytes)
+            nbytes = g.size * g.dtype.itemsize
+            if cur and (g.dtype != cur_dtype or cur_bytes + nbytes > cap):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append((p, g))
+            cur_bytes += nbytes
+            cur_dtype = g.dtype
+        if cur:
+            buckets.append(cur)
+        return buckets
+
     def apply_collective_grads(self):
-        """reference: parallel.py:384 — allreduce-sum every param grad."""
+        """reference: parallel.py:384 apply_collective_grads +
+        imperative/all_reduce.cc — coalesced allreduce-sum of all param
+        grads: one collective per bucket (~comm_buffer_size MB), not one
+        per parameter."""
         if self._nranks <= 1:
             return
         import jax.numpy as jnp
 
-        for p in self._layers.parameters():
-            if p._grad_value is not None:
-                summed = dist.all_reduce(np.asarray(p._grad_value), op="sum")
-                p._grad_value = jnp.asarray(summed)
+        for bucket in self._grad_buckets():
+            if len(bucket) == 1:
+                p, g = bucket[0]
+                summed = dist.all_reduce(np.asarray(g), op="sum")
+                p._grad_value = jnp.asarray(summed).reshape(g.shape)
+                continue
+            flat = jnp.concatenate([jnp.ravel(g) for _, g in bucket])
+            summed = jnp.asarray(dist.all_reduce(np.asarray(flat), op="sum"))
+            offset = 0
+            for p, g in bucket:
+                n = g.size
+                p._grad_value = summed[offset:offset + n].reshape(g.shape)
+                offset += n
 
     # delegate the Layer surface to the wrapped module
     def parameters(self, include_sublayers=True):

@@ -418,17 +418,20 @@ class Executor:
         if not flag("apply_ir_passes"):
             return program
         types = {o.type for b in program.blocks for o in b.ops}
-        if "batch_norm" not in types:
-            return program
-        from .framework.ir import PassManager, get_pass
+        from .framework.ir import _FUSABLE_OPT, PassManager, get_pass
 
+        protected = tuple(fetch_names)
+        passes = []
+        if "batch_norm" in types:
+            passes += [get_pass("fuse_bn_add_act_pass", protected=protected),
+                       get_pass("fuse_bn_act_pass", protected=protected)]
+        if types & set(_FUSABLE_OPT):
+            passes.append(get_pass("fuse_optimizer_ops_pass"))
+        if not passes:
+            return program
         clone = Program.from_desc_dict(program.desc_dict())
         clone.random_seed = program.random_seed
-        protected = tuple(fetch_names)
-        PassManager([
-            get_pass("fuse_bn_add_act_pass", protected=protected),
-            get_pass("fuse_bn_act_pass", protected=protected),
-        ]).apply(clone)
+        PassManager(passes).apply(clone)
         return clone
 
     # ------------------------------------------------------------------

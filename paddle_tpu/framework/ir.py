@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .core import Block, Operator, Program
+from .dtype import VarType
 
 # --------------------------------------------------------------------------
 # pass registry (reference: pass.h REGISTER_PASS)
@@ -677,3 +678,236 @@ class FuseBNAddActPass(_FuseBNActBase):
                 changed = True
                 break
         return fused
+
+
+# --------------------------------------------------------------------------
+# conv+BN inference fold (reference: ir/conv_bn_fuse_pass.cc) — needs the
+# scope: the fold rewrites the conv FILTER VALUES (W' = W * scale*inv_std
+# per output channel) and replaces the batch_norm with a per-channel bias
+# add.  Inference-only: the bn must be running in is_test /
+# use_global_stats mode.
+# --------------------------------------------------------------------------
+@register_pass("conv_bn_fuse_pass")
+class ConvBNFusePass(Pass):
+    scope = None
+    protected: Sequence[str] = ()
+
+    def apply_impl(self, program):
+        import numpy as np
+
+        fused = 0
+        scope = self.scope
+        if scope is None:
+            self.fused_count = 0
+            return program
+        protected = set(self.protected)
+        block = program.global_block()
+        changed = True
+        while changed:
+            changed = False
+            cons = _consumers(block)
+            prod = producer_map(block)
+            for bn in list(block.ops):
+                if bn.type != "batch_norm":
+                    continue
+                if not (bn.attrs.get("is_test")
+                        or bn.attrs.get("use_global_stats")):
+                    continue
+                if bn.attrs.get("data_layout", "NCHW") not in ("NCHW",
+                                                               "AnyLayout"):
+                    continue  # the folded bias add below is axis=1 (NCHW)
+                x0 = bn.inputs.get("X", [None])[0]
+                conv = prod.get(x0)
+                if conv is not None and conv.attrs.get(
+                        "data_format", "NCHW") != "NCHW":
+                    continue
+                if (conv is None or conv.type != "conv2d"
+                        or x0 in protected
+                        or any(id(o) != id(bn) for o in cons.get(x0, []))):
+                    continue
+                w_name = conv.inputs["Filter"][0]
+                vals = {}
+                ok = True
+                for slot in ("Scale", "Bias", "Mean", "Variance"):
+                    v = scope.get(bn.inputs[slot][0])
+                    if v is None:
+                        ok = False
+                        break
+                    vals[slot] = np.asarray(v, np.float64)
+                w = scope.get(w_name)
+                if not ok or w is None:
+                    continue
+                # the filter must not be shared with another conv: scaling
+                # it would silently change the other consumer
+                if sum(1 for o in block.ops
+                       if w_name in o.inputs.get("Filter", [])) > 1:
+                    continue
+                eps = bn.attrs.get("epsilon", 1e-5)
+                a = vals["Scale"] / np.sqrt(vals["Variance"] + eps)
+                b = vals["Bias"] - vals["Mean"] * a
+                w_np = np.asarray(w)
+                scope.set(w_name, (np.asarray(w_np, np.float64)
+                                   * a[:, None, None, None]
+                                   ).astype(w_np.dtype))
+                y_name = bn.outputs["Y"][0]
+                bias_name = y_name + "__bn_folded_bias"
+                block.create_var(name=bias_name, shape=[int(a.shape[0])],
+                                 dtype=VarType.FP32, persistable=True)
+                scope.set(bias_name, b.astype(np.float32))
+                idx = block.ops.index(bn)
+                remove_ops(block, [bn])
+                block._insert_op(idx, "elementwise_add",
+                                 inputs={"X": [x0], "Y": [bias_name]},
+                                 outputs={"Out": [y_name]},
+                                 attrs={"axis": 1})
+                fused += 1
+                changed = True
+                break
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program
+
+
+# --------------------------------------------------------------------------
+# embedding + eltwise-add + layer_norm fuse (reference:
+# ir/embedding_eltwise_layernorm_fuse_pass.cc -> the
+# fused_embedding_eltwise_layernorm op).  Matches k>=2 lookup_tables
+# whose outputs sum through private default-axis adds into a last-axis
+# layer_norm; inference-path only (the rewrite does not touch grads).
+# --------------------------------------------------------------------------
+@register_pass("embedding_eltwise_layernorm_fuse_pass")
+class EmbeddingEltwiseLayernormFusePass(Pass):
+    protected: Sequence[str] = ()
+
+    def apply_impl(self, program):
+        fused = 0
+        block = program.global_block()
+        protected = set(self.protected)
+        changed = True
+        while changed:
+            changed = False
+            cons = _consumers(block)
+            prod = producer_map(block)
+            for ln in list(block.ops):
+                if ln.type != "layer_norm":
+                    continue
+                if ln.attrs.get("begin_norm_axis", 1) != 2:
+                    continue  # the fused op normalizes (b, s, h) over h
+                # Mean/Variance side outputs must be dead
+                if any(cons.get(n, []) for slot in ("Mean", "Variance")
+                       for n in ln.outputs.get(slot, [])):
+                    continue
+                x0 = ln.inputs["X"][0]
+                if x0 in protected:
+                    continue
+                lookups, adds = [], []
+                ok = [True]
+
+                def collect(name):
+                    p = prod.get(name)
+                    if p is None:
+                        ok[0] = False
+                        return
+                    private = (len(cons.get(name, [])) == 1
+                               and name not in protected)
+                    if p.type == "elementwise_add" and \
+                            p.attrs.get("axis", -1) == -1 and private:
+                        adds.append(p)
+                        collect(p.inputs["X"][0])
+                        collect(p.inputs["Y"][0])
+                    elif p.type in ("lookup_table", "lookup_table_v2") \
+                            and private \
+                            and p.attrs.get("padding_idx", -1) in (-1,):
+                        lookups.append(p)
+                    else:
+                        ok[0] = False
+
+                collect(x0)
+                if not ok[0] or len(lookups) < 2 or not adds:
+                    continue
+                # the fused op applies the LN affine unconditionally, so
+                # only layer_norms that HAVE Scale and Bias are fused
+                if not ln.inputs.get("Scale") or not ln.inputs.get("Bias"):
+                    continue
+                ids = [lk.inputs["Ids"][0] for lk in lookups]
+                embs = [lk.inputs["W"][0] for lk in lookups]
+                inputs = {"Ids": ids, "Embs": embs,
+                          "Scale": list(ln.inputs["Scale"]),
+                          "Bias": list(ln.inputs["Bias"])}
+                dead = adds + lookups + [ln]
+                idx = block.ops.index(ln)
+                idx -= sum(1 for o in dead if block.ops.index(o) < idx)
+                remove_ops(block, dead)
+                block._insert_op(
+                    idx, "fused_embedding_eltwise_layernorm",
+                    inputs=inputs, outputs={"Out": list(ln.outputs["Y"])},
+                    attrs={"epsilon": ln.attrs.get("epsilon", 1e-5)})
+                fused += 1
+                changed = True
+                break
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program
+
+
+# --------------------------------------------------------------------------
+# fused optimizer shell (reference: ir/fuse_optimizer_ops_pass/ —
+# fuse_sgd_op_pass.cc, fuse_momentum_op_pass.cc, fuse_adam_op_pass.cc):
+# merge per-parameter update ops sharing one LR var and hyperparams into
+# a single multi-slot fused op.
+# --------------------------------------------------------------------------
+_FUSABLE_OPT = {
+    "sgd": (("Param", "Grad"), ("ParamOut",)),
+    "momentum": (("Param", "Grad", "Velocity"), ("ParamOut", "VelocityOut")),
+    "adam": (("Param", "Grad", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"),
+             ("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+              "Beta2PowOut")),
+}
+
+
+@register_pass("fuse_optimizer_ops_pass")
+class FuseOptimizerOpsPass(Pass):
+    def apply_impl(self, program):
+        fused = 0
+        block = program.global_block()
+        groups: Dict[tuple, List[Operator]] = {}
+        for op_ in block.ops:
+            if op_.type not in _FUSABLE_OPT:
+                continue
+            gname = op_.inputs.get("Grad", [None])[0]
+            gvar = block._find_var_recursive(gname) if gname else None
+            if gvar is not None and gvar.type == VarType.SELECTED_ROWS:
+                continue  # sparse updates keep their per-param kernels
+            attr_key = frozenset(
+                (k, tuple(v) if isinstance(v, list) else v)
+                for k, v in op_.attrs.items()
+                if k not in ("op_role", "op_namescope", "op_callstack",
+                             "op_role_var"))
+            key = (op_.type, op_.inputs["LearningRate"][0], attr_key)
+            groups.setdefault(key, []).append(op_)
+        for (otype, lr, _), ops_ in groups.items():
+            if len(ops_) < 2:
+                continue
+            in_slots, out_slots = _FUSABLE_OPT[otype]
+            inputs = {"LearningRate": [lr]}
+            outputs: Dict[str, List[str]] = {}
+            for s in in_slots:
+                inputs[s] = [o.inputs[s][0] for o in ops_]
+            for s in out_slots:
+                outputs[s] = [o.outputs[s][0] for o in ops_]
+            attrs = dict(ops_[0].attrs)
+            # insert where the LAST member was: every grad is produced by
+            # then; nothing between reads the updated params (updates are
+            # the program tail)
+            last = max(block.ops.index(o) for o in ops_)
+            last -= sum(1 for o in ops_ if block.ops.index(o) < last)
+            remove_ops(block, ops_)
+            block._insert_op(last, "fused_" + otype, inputs=inputs,
+                             outputs=outputs, attrs=attrs)
+            fused += 1
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program

@@ -162,6 +162,21 @@ class AnalysisConfig:
     def delete_pass(self, name: str):
         self._deleted_passes.add(name)
 
+    # -- pass builder (reference: paddle_pass_builder.cc ----------------
+    # CpuPassStrategy / GpuPassStrategy; here one TPU strategy: XLA does
+    # the backend codegen, the program-level passes do the semantic
+    # rewrites XLA cannot)
+    def pass_builder(self) -> "PassStrategy":
+        if getattr(self, "_pass_builder", None) is None:
+            self._pass_builder = PassStrategy(use_tpu=self._use_tpu)
+        return self._pass_builder
+
+    def applied_passes(self):
+        """The effective pass list the predictor will run (builder list
+        minus delete_pass() removals), in order."""
+        return [p for p in self.pass_builder().all_passes()
+                if p not in self._deleted_passes]
+
     def enable_profile(self):
         self._profile = True
 
@@ -201,3 +216,42 @@ class NativeConfig:
         self.use_gpu = False
         self.device = 0
         self.fraction_of_gpu_memory = -1.0
+
+
+class PassStrategy:
+    """Per-target inference pass list (reference:
+    inference/api/paddle_pass_builder.cc PaddlePassBuilder /
+    CpuPassStrategy / GpuPassStrategy).  The default TPU list folds
+    weights (conv+bn), maps attention onto the Pallas kernel, fuses the
+    embedding+eltwise+layernorm head, and DCEs — everything else is
+    XLA's job."""
+
+    TPU_PASSES = [
+        "conv_bn_fuse_pass",
+        "fuse_bn_act_pass",
+        "fuse_bn_add_act_pass",
+        "embedding_eltwise_layernorm_fuse_pass",
+        "fuse_multihead_attention_pass",
+        "delete_dropout_pass",
+    ]
+
+    def __init__(self, use_tpu: bool = False):
+        self._passes = list(self.TPU_PASSES)
+        self._use_tpu = use_tpu
+
+    def all_passes(self):
+        return list(self._passes)
+
+    passes = all_passes
+
+    def append_pass(self, name: str):
+        self._passes.append(name)
+
+    def insert_pass(self, idx: int, name: str):
+        self._passes.insert(idx, name)
+
+    def delete_pass(self, name: str):
+        self._passes = [p for p in self._passes if p != name]
+
+    def turn_on_memory_optim(self):
+        pass  # XLA buffer assignment handles it

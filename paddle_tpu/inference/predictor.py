@@ -146,6 +146,8 @@ class AnalysisPredictor:
         self._program = program
         self._feed_names = list(feed_names)
         self._fetch_names = [v.name for v in fetch_vars]
+        if cfg.ir_optim():
+            self._optimize_program()
         low = {AnalysisConfig.Precision.Bfloat16: VarType.BF16,
                AnalysisConfig.Precision.Half: VarType.FP16}
         if cfg.precision() in low:
@@ -160,6 +162,31 @@ class AnalysisPredictor:
                 warnings.warn(
                     f"requested precision {cfg.precision()} could not be "
                     f"applied ({e}); serving in float32")
+
+    def _optimize_program(self):
+        """Run the config's pass list over the loaded program
+        (reference: AnalysisPredictor::OptimizeInferenceProgram :498 —
+        the Analyzer walking paddle_pass_builder's per-target list).
+        Weight-folding passes get the predictor scope; every pass gets
+        the fetch set as protected vars."""
+        from ..framework.ir import PASS_REGISTRY, get_pass
+
+        applied = []
+        protected = tuple(self._fetch_names) + tuple(self._feed_names)
+        for name in self._config.applied_passes():
+            if name not in PASS_REGISTRY:
+                continue  # unknown names are tolerated like the reference
+            kwargs = {}
+            cls = PASS_REGISTRY[name]
+            if hasattr(cls, "scope"):
+                kwargs["scope"] = self._scope
+            if hasattr(cls, "protected"):
+                kwargs["protected"] = protected
+            p = get_pass(name, **kwargs)
+            self._program = p.apply(self._program)
+            if getattr(p, "fused_count", None):
+                applied.append((name, p.fused_count))
+        self._applied_passes = applied
 
     # -- IO surface ------------------------------------------------------
     def get_input_names(self) -> List[str]:

@@ -216,3 +216,7 @@ def load_inference_model(
     block = program.global_block()
     fetch_vars = [block.var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars
+
+
+# reference: fluid/io.py re-exports the data-loading surface
+from .reader import DataLoader, PyReader, DataFeeder  # noqa: E402

@@ -1030,18 +1030,26 @@ def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
 
 
 def fused_multihead_attention(q, k, v, bias_qk=None, scale=0.0, causal=False,
-                              name=None):
+                              dropout_rate=0.0, name=None):
     """Fused scaled-dot-product attention over (b, heads, seq, head_dim)
     tensors; lowers to the Pallas flash-attention kernel on TPU
-    (reference: operators/fused/multihead_matmul_op.cu)."""
+    (reference: operators/fused/multihead_matmul_op.cu).  With
+    dropout_rate > 0 the attention-probs dropout runs INSIDE the kernel
+    from a per-step seed saved as the Seed output (the backward
+    regenerates the masks from it — nothing mask-shaped is stored)."""
     helper = LayerHelper("fused_multihead_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias_qk is not None:
         inputs["BiasQK"] = [bias_qk]
+    outputs = {"Out": [out]}
+    if dropout_rate > 0.0:
+        outputs["Seed"] = [
+            helper.create_variable_for_type_inference("float32")]
     helper.append_op("fused_multihead_attention", inputs=inputs,
-                     outputs={"Out": [out]},
-                     attrs={"scale": float(scale), "causal": bool(causal)})
+                     outputs=outputs,
+                     attrs={"scale": float(scale), "causal": bool(causal),
+                            "dropout_rate": float(dropout_rate)})
     return out
 
 

@@ -77,13 +77,15 @@ class MultiHeadAttention(Layer):
         # form of attn_mask (BertModel passes both derived from the same
         # attention_mask).  The fused path substitutes bias_qk for
         # attn_mask wholesale, so a 4D mask without its 2D form uses the
-        # naive composition.
+        # naive composition.  Attention-probs dropout runs INSIDE the
+        # fused kernel (per-step seed, masks regenerated in backward).
         drop_active = self.training and self.drop._p > 0.0
-        if (self._fuse and not drop_active
+        if (self._fuse
                 and (attn_mask is None or bias_qk is not None)):
             ctx = F.fused_multihead_attention(
                 q, k, v, bias_qk=bias_qk,
-                scale=1.0 / math.sqrt(self.d_head))
+                scale=1.0 / math.sqrt(self.d_head),
+                dropout_rate=self.drop._p if drop_active else 0.0)
         else:
             scores = F.matmul(q, k, transpose_y=True,
                               alpha=1.0 / math.sqrt(self.d_head))

@@ -21,31 +21,88 @@ from .pallas_kernels import (
 )
 
 
-@op("fused_multihead_attention")
+def _mha_forward(q, k, v, bias, scale, causal, dropout_rate, seed):
+    """Shared forward core: fwd lowering AND the grad kernel's vjp
+    closure go through here, so both see the same path selection and the
+    same dropout seed."""
+    if bias is not None and not is_padding_bias(bias):
+        return attention_reference(
+            q, k, v, bias=bias, causal=causal,
+            scale=scale if scale is not None
+            else 1.0 / math.sqrt(q.shape[-1]),
+            dropout_rate=dropout_rate, dropout_seed=seed)
+    return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale,
+                           dropout_rate=dropout_rate, dropout_seed=seed)
+
+
+@op("fused_multihead_attention", stateful=True)
 def _fused_mha(ctx):
     """Q/K/V: (batch, heads, seq, head_dim).  Optional BiasQK: additive
     mask — padding shapes ((b,kv), (b,1,kv), (b,1,1,kv)) take the Pallas
     flash kernel; full attention-matrix biases ((b,1,q,kv), (b,h,q,kv),
     e.g. from the fuse_multihead_attention_pass on arbitrary masked
     graphs) take the dense attention_reference path — still one XLA
-    fusion cluster on TPU.  Attrs: scale (0 -> 1/sqrt(d)), causal.
-    Reference: operators/fused/multihead_matmul_op.cu (fused inference
-    attention); here it serves training too via the flash kernel's
-    custom VJP."""
+    fusion cluster on TPU.  Attrs: scale (0 -> 1/sqrt(d)), causal,
+    dropout_rate (attention-probs dropout INSIDE the flash kernel —
+    masks regenerate in the backward from the saved Seed output, the
+    reference fused_attention dropout capability without storing the
+    mask).  Reference: operators/fused/multihead_matmul_op.cu; here it
+    serves training too via the flash kernel's custom VJP."""
     q = ctx.in_("Q")
     k = ctx.in_("K")
     v = ctx.in_("V")
     bias = ctx.in_("BiasQK") if ctx.has_input("BiasQK") else None
     scale = ctx.attr("scale", 0.0) or None
     causal = ctx.attr("causal", False)
-    if bias is not None and not is_padding_bias(bias):
-        ctx.set_out("Out", attention_reference(
-            q, k, v, bias=bias, causal=causal,
-            scale=scale if scale is not None
-            else 1.0 / math.sqrt(q.shape[-1])))
-        return
-    ctx.set_out("Out", flash_attention(q, k, v, bias=bias, causal=causal,
-                                       scale=scale))
+    dropout_rate = float(ctx.attr("dropout_rate", 0.0) or 0.0)
+    seed = None
+    if dropout_rate > 0.0:
+        # per-step scalar seed off the threaded rng, SAVED as an output:
+        # the grad op replays the same masks from it
+        import jax
+
+        sub = ctx.rng()
+        seed = jax.random.randint(sub, (1,), 0, 1 << 23,
+                                  dtype=jnp.int32).astype(jnp.float32)
+        ctx.set_out("Seed", seed)
+    ctx.set_out("Out", _mha_forward(q, k, v, bias, scale, causal,
+                                    dropout_rate, seed))
+
+
+@op("fused_multihead_attention_grad", no_grad=True)
+def _fused_mha_grad(ctx):
+    import jax
+
+    q = ctx.in_("Q")
+    k = ctx.in_("K")
+    v = ctx.in_("V")
+    bias = ctx.in_("BiasQK") if ctx.has_input("BiasQK") else None
+    seed = ctx.in_("Seed") if ctx.has_input("Seed") else None
+    dout = ctx.in_("Out" + GRAD_SUFFIX)
+    scale = ctx.attr("scale", 0.0) or None
+    causal = ctx.attr("causal", False)
+    dropout_rate = float(ctx.attr("dropout_rate", 0.0) or 0.0)
+
+    if bias is None:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _mha_forward(q_, k_, v_, None, scale, causal,
+                                            dropout_rate, seed), q, k, v)
+        dq, dk, dv = vjp(dout)
+        dbias = None
+    else:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_, b_: _mha_forward(q_, k_, v_, b_, scale,
+                                                causal, dropout_rate, seed),
+            q, k, v, bias)
+        dq, dk, dv, dbias = vjp(dout)
+    ctx.set_out("Q" + GRAD_SUFFIX, dq)
+    ctx.set_out("K" + GRAD_SUFFIX, dk)
+    ctx.set_out("V" + GRAD_SUFFIX, dv)
+    if dbias is not None:
+        ctx.set_out("BiasQK" + GRAD_SUFFIX, dbias)
+
+
+
 
 
 # --------------------------------------------------------------------------
@@ -159,6 +216,35 @@ def _fused_bn_add_act_grad(ctx):
     _fused_bn_act_bwd(ctx, with_add=True)
 
 
+@op("fused_embedding_eltwise_layernorm")
+def _fused_emb_eltwise_ln(ctx):
+    """Sum of k embedding lookups + layer_norm in one op (reference:
+    operators/fused/fused_embedding_eltwise_layernorm_op.cu, produced by
+    ir/embedding_eltwise_layernorm_fuse_pass.cc).  Ids: k int tensors
+    (b, s) or (b, s, 1); Embs: k (vocab_i, h) tables; Scale/Bias: the
+    layer_norm affine over h.  LN statistics in f32 (as layer_norm)."""
+    ids_list = ctx.ins("Ids")
+    embs = ctx.ins("Embs")
+    scale = ctx.in_("Scale") if ctx.has_input("Scale") else None
+    bias = ctx.in_("Bias") if ctx.has_input("Bias") else None
+    eps = ctx.attr("epsilon", 1e-5)
+    acc = None
+    for ids, table in zip(ids_list, embs):
+        if jnp.ndim(ids) == 3:
+            ids = jnp.squeeze(ids, -1)
+        emb = jnp.take(table, ids.astype(jnp.int32), axis=0)
+        acc = emb if acc is None else acc + emb
+    x32 = acc.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = ((x32 - mean) * lax.rsqrt(var + eps)).astype(acc.dtype)
+    if scale is not None:
+        y = y * scale.astype(acc.dtype)
+    if bias is not None:
+        y = y + bias.astype(acc.dtype)
+    ctx.set_out("Out", y)
+
+
 def _make_fused_bn_grad_desc(op_, no_grad_names, with_add):
     from .registry import grad_maker, EMPTY_VAR_NAME
 
@@ -196,3 +282,32 @@ def _fused_bn_act_maker(op_, no_grad_names=frozenset()):
 @_grad_maker("fused_bn_add_activation")
 def _fused_bn_add_act_maker(op_, no_grad_names=frozenset()):
     return _make_fused_bn_grad_desc(op_, no_grad_names, with_add=True)
+
+
+@_grad_maker("fused_multihead_attention")
+def _fused_mha_grad_maker(op_, no_grad_names=frozenset()):
+    from .registry import EMPTY_VAR_NAME
+
+    def g(names):
+        return [(n + GRAD_SUFFIX) if n not in no_grad_names else EMPTY_VAR_NAME
+                for n in names]
+
+    inputs = {
+        "Q": op_.input("Q"),
+        "K": op_.input("K"),
+        "V": op_.input("V"),
+        "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in op_.output("Out")],
+    }
+    if op_.input("BiasQK"):
+        inputs["BiasQK"] = op_.input("BiasQK")
+    if op_.output("Seed"):
+        inputs["Seed"] = op_.output("Seed")
+    outputs = {
+        "Q" + GRAD_SUFFIX: g(op_.input("Q")),
+        "K" + GRAD_SUFFIX: g(op_.input("K")),
+        "V" + GRAD_SUFFIX: g(op_.input("V")),
+    }
+    if op_.input("BiasQK"):
+        outputs["BiasQK" + GRAD_SUFFIX] = g(op_.input("BiasQK"))
+    return [dict(type="fused_multihead_attention_grad", inputs=inputs,
+                 outputs=outputs, attrs=dict(op_.attrs))]

@@ -436,10 +436,14 @@ def _softmax_ce(ctx):
     ignore_index = ctx.attr("ignore_index", -100)
     # log-softmax in f32 even for bf16 (AMP) logits: the upcast fuses
     # into the logsumexp reduction, and bf16 log-probs would cost ~2
-    # digits on the loss
+    # digits on the loss.  The Softmax OUTPUT is stored back in the
+    # logits dtype: for a [b*s, 30k] MLM head an f32 softmax is a
+    # gigabyte-scale materialization read again by the backward, and
+    # probabilities in [0,1] lose nothing that matters in bf16.
+    in_dtype = logits.dtype
     logits = logits.astype(jnp.float32)
     log_p = jnn.log_softmax(logits, axis=axis)
-    ctx.set_out("Softmax", jnp.exp(log_p))
+    ctx.set_out("Softmax", jnp.exp(log_p).astype(in_dtype))
     if soft_label:
         loss = -jnp.sum(label * log_p, axis=axis, keepdims=True)
     else:

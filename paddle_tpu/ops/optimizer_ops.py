@@ -350,3 +350,74 @@ def _average_accumulates(ctx):
     ctx.set_out("out_num_accumulates", num_acc.reshape(1))
     ctx.set_out("out_old_num_accumulates", old_num.reshape(1))
     ctx.set_out("out_num_updates", num_upd.reshape(1))
+
+
+# --------------------------------------------------------------------------
+# fused multi-param optimizer ops (reference: the fuse_optimizer_ops_pass
+# family — ir/fuse_optimizer_ops_pass/fuse_sgd_op_pass.cc,
+# fuse_momentum_op_pass.cc, fuse_adam_op_pass.cc — which coalesce the
+# per-parameter update ops into one kernel over fused buffers).  On TPU
+# the win is graph-size/dispatch, not kernel count (XLA fuses the loop
+# bodies into a handful of kernels either way), so the fused ops take
+# parallel slot LISTS instead of one concatenated buffer.
+# --------------------------------------------------------------------------
+@_opt("fused_sgd")
+def _fused_sgd(ctx):
+    lr = ctx.in_("LearningRate")
+    outs = []
+    for p, g in zip(ctx.ins("Param"), ctx.ins("Grad")):
+        lr_ = lr.reshape(()).astype(p.dtype)
+        outs.append(p - lr_ * g.astype(p.dtype))
+    ctx.set_out("ParamOut", outs)
+
+
+@_opt("fused_momentum")
+def _fused_momentum(ctx):
+    lr = ctx.in_("LearningRate")
+    mu = ctx.attr("mu", 0.9)
+    use_nesterov = ctx.attr("use_nesterov", False)
+    pouts, vouts = [], []
+    for p, g, v in zip(ctx.ins("Param"), ctx.ins("Grad"),
+                       ctx.ins("Velocity")):
+        lr_ = lr.reshape(()).astype(p.dtype)
+        g = g.astype(p.dtype)
+        v_new = mu * v + g
+        if use_nesterov:
+            p_new = p - (g + mu * v_new) * lr_
+        else:
+            p_new = p - lr_ * v_new
+        pouts.append(p_new)
+        vouts.append(v_new)
+    ctx.set_out("ParamOut", pouts)
+    ctx.set_out("VelocityOut", vouts)
+
+
+@_opt("fused_adam")
+def _fused_adam(ctx):
+    lr = ctx.in_("LearningRate")
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    b1p_in = ctx.ins("Beta1Pow")
+    b2p_in = ctx.ins("Beta2Pow")
+    pouts, m1outs, m2outs, b1outs, b2outs = [], [], [], [], []
+    for p, g, m1, m2, b1p, b2p in zip(
+            ctx.ins("Param"), ctx.ins("Grad"), ctx.ins("Moment1"),
+            ctx.ins("Moment2"), b1p_in, b2p_in):
+        lr_ = lr.reshape(()).astype(p.dtype)
+        g = g.astype(p.dtype)
+        b1p_ = b1p.reshape(()).astype(p.dtype)
+        b2p_ = b2p.reshape(()).astype(p.dtype)
+        lr_t = lr_ * jnp.sqrt(1 - b2p_ * b2) / (1 - b1p_ * b1)
+        m1_new = b1 * m1 + (1 - b1) * g
+        m2_new = b2 * m2 + (1 - b2) * jnp.square(g)
+        pouts.append(p - lr_t * m1_new / (jnp.sqrt(m2_new) + eps))
+        m1outs.append(m1_new)
+        m2outs.append(m2_new)
+        b1outs.append(b1p * b1)
+        b2outs.append(b2p * b2)
+    ctx.set_out("ParamOut", pouts)
+    ctx.set_out("Moment1Out", m1outs)
+    ctx.set_out("Moment2Out", m2outs)
+    ctx.set_out("Beta1PowOut", b1outs)
+    ctx.set_out("Beta2PowOut", b2outs)

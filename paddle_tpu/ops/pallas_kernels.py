@@ -66,11 +66,14 @@ def _pick_block(seq: int, candidates=(512, 256, 128)) -> int | None:
 # ==========================================================================
 # Reference (jnp) implementation — the oracle and the fallback
 # ==========================================================================
-def attention_reference(q, k, v, bias=None, causal=False, scale=1.0):
+def attention_reference(q, k, v, bias=None, causal=False, scale=1.0,
+                        dropout_rate=0.0, dropout_seed=None):
     """Dense attention: the flash kernel's oracle AND the general-bias
     fallback.  bias: additive — padding shapes ((b,kv), (b,1,kv),
     (b,1,1,kv)) or a full attention matrix broadcastable to
-    (b, h, q, kv)."""
+    (b, h, q, kv).  dropout_rate applies upscale-in-train probs dropout
+    (note: the mask stream differs from the Pallas kernel's — dropout is
+    stochastic, only the distribution is contractual)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
@@ -84,6 +87,12 @@ def attention_reference(q, k, v, bias=None, causal=False, scale=1.0):
         mask = jnp.tril(jnp.ones((qlen, klen), bool))
         s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        key = jax.random.key(
+            jnp.asarray(dropout_seed, jnp.float32).reshape(()).astype(
+                jnp.int32))
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
@@ -112,9 +121,24 @@ def _normalize_bias(bias):
 # ==========================================================================
 # Forward kernel
 # ==========================================================================
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+def _dropout_keep(seed_ref, shape, rate, iq, ik, n_q, n_kv):
+    """Deterministic per-block keep mask: the PRNG is seeded from
+    (step seed, flattened (batch, head, q-block, kv-block) index), so
+    the backward kernels regenerate the exact forward mask from the same
+    coordinates — nothing is stored (the flash-attention treatment of
+    attention-probs dropout).  Mosaic supports at most two seed values,
+    hence the flat block index."""
+    flat = ((pl.program_id(0) * pl.num_programs(1) + pl.program_id(1))
+            * n_q + iq) * n_kv + ik
+    pltpu.prng_seed(seed_ref[0].astype(jnp.int32), flat)
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    thresh = jnp.uint32(min(int(rate * (2 ** 32)), 2 ** 32 - 1))
+    return bits >= thresh
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                n_kv):
+                n_kv, dropout_rate=0.0):
     ki = pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -142,9 +166,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     m_next = jnp.maximum(m_prev, m_cur)               # (bq, 128)
     alpha = jnp.exp(m_prev - m_next)
     p = jnp.exp(s - m_next[:, :1])                    # (bq, bk)
+    # softmax normalization uses the UNDROPPED p (dropout applies after
+    # softmax); only the value accumulation sees the mask
     l_next = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    if dropout_rate > 0.0:
+        keep = _dropout_keep(seed_ref, p.shape, dropout_rate,
+                             pl.program_id(2), ki, pl.num_programs(2), n_kv)
+        pd = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+    else:
+        pd = p
     acc_scr[...] = acc_scr[...] * alpha[:, :1] + lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        pd.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     m_scr[...] = m_next
     l_scr[...] = l_next
@@ -157,7 +189,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+def _wrap_optional(body, n_lead, has_bias, has_seed):
+    """Adapter: positional refs -> body(..., bias_ref/seed_ref or None).
+    Keeps the kernel bodies single-sourced across the 4 bias x dropout
+    variants."""
+
+    def kernel(*refs):
+        i = n_lead
+        lead = list(refs[:n_lead])
+        bias_ref = refs[i] if has_bias else None
+        i += 1 if has_bias else 0
+        seed_ref = refs[i] if has_seed else None
+        i += 1 if has_seed else 0
+        body(*lead, bias_ref, seed_ref, *refs[i:])
+
+    return kernel
+
+
+def _seed_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k,
+               dropout_rate=0.0, seed=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // block_q, sk // block_k
@@ -174,10 +228,14 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_k),
                          lambda ib, ih, iq, ik: (ib, 0, ik)))
         args.append(bias[:, None, :])
-    kernel = functools.partial(
-        _fwd_kernel if bias is not None else _fwd_kernel_nobias,
-        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        n_kv=nk)
+    if dropout_rate > 0.0:
+        in_specs.append(_seed_spec())
+        args.append(seed)
+    kernel = _wrap_optional(
+        functools.partial(_fwd_kernel_body, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_kv=nk,
+                          dropout_rate=dropout_rate),
+        3, bias is not None, dropout_rate > 0.0)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -202,9 +260,9 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
     return out, lse
 
 
-def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                       m_scr, l_scr, acc_scr, **kw):
-    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+def _fwd_kernel_body(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
+                     m_scr, l_scr, acc_scr, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, **kw)
 
 
@@ -212,8 +270,8 @@ def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, lse_ref,
 # Backward kernels
 # ==========================================================================
 def _bwd_dq_kernel(q_ref, k_ref, do_ref, lse_ref, delta_ref, bias_ref,
-                   v_ref, dq_ref, dq_scr, *, scale, causal, block_q,
-                   block_k, n_kv):
+                   seed_ref, v_ref, dq_ref, dq_scr, *, scale, causal,
+                   block_q, block_k, n_kv, dropout_rate=0.0):
     ki = pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -239,6 +297,12 @@ def _bwd_dq_kernel(q_ref, k_ref, do_ref, lse_ref, delta_ref, bias_ref,
     p = jnp.exp(s - lse[:, :1])                       # (bq, bk)
     dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32)
+    if dropout_rate > 0.0:
+        # dS = P*(M*dPD/keep - delta): delta = rowsum(dO*O) is already
+        # the dropped-path rowsum (O = PD@V), so only dp needs the mask
+        keep = _dropout_keep(seed_ref, p.shape, dropout_rate,
+                             pl.program_id(2), ki, pl.num_programs(2), n_kv)
+        dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
     ds = p * (dp - delta[:, :1]) * scale              # (bq, bk)
     dq_scr[...] += lax.dot_general(
         ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -249,15 +313,9 @@ def _bwd_dq_kernel(q_ref, k_ref, do_ref, lse_ref, delta_ref, bias_ref,
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dq_kernel_nobias(q_ref, k_ref, do_ref, lse_ref, delta_ref,
-                          v_ref, dq_ref, dq_scr, **kw):
-    _bwd_dq_kernel(q_ref, k_ref, do_ref, lse_ref, delta_ref, None,
-                   v_ref, dq_ref, dq_scr, **kw)
-
-
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    bias_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
-                    causal, block_q, block_k, n_q):
+                    bias_ref, seed_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    scale, causal, block_q, block_k, n_q, dropout_rate=0.0):
     qi = pl.program_id(3)
 
     @pl.when(qi == 0)
@@ -276,18 +334,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         preferred_element_type=jnp.float32) * scale
     if bias_ref is not None:
         s = s + bias_ref[0].astype(jnp.float32)
+    ik = pl.program_id(2)
     if causal:
-        ik = pl.program_id(2)
         rows = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = ik * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
     p = jnp.exp(s - lse[:, :1])                       # (bq, bk)
-    # dV += P^T dO   (contract over bq)
-    dv_scr[...] += lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
     dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32)
+    if dropout_rate > 0.0:
+        # seed coordinates MUST be (seed, b, h, q-block, kv-block) — the
+        # same order as the forward, though this grid iterates kv outer
+        keep = _dropout_keep(seed_ref, p.shape, dropout_rate, qi, ik,
+                             n_q, pl.num_programs(2))
+        inv = 1.0 / (1.0 - dropout_rate)
+        pd = jnp.where(keep, p, 0.0) * inv
+        dp = jnp.where(keep, dp, 0.0) * inv
+    else:
+        pd = p
+    # dV += PD^T dO   (contract over bq)
+    dv_scr[...] += lax.dot_general(
+        pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     ds = p * (dp - delta[:, :1]) * scale
     # dK += dS^T Q   (contract over bq)
     dk_scr[...] += lax.dot_general(
@@ -300,18 +368,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_dkv_kernel_nobias(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dk_ref, dv_ref, dk_scr, dv_scr, **kw):
-    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
-                    dk_ref, dv_ref, dk_scr, dv_scr, **kw)
-
-
-def _flash_bwd(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
+def _flash_bwd(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
+               dropout_rate=0.0, seed=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // block_q, sk // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (b, h, sq, LANES))
+    has_drop = dropout_rate > 0.0
 
     # --- dQ: grid (b, h, nq, nk), kv innermost ---------------------------
     def _q_idx(ib, ih, iq, ik):
@@ -332,13 +396,17 @@ def _flash_bwd(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
         in_specs.append(pl.BlockSpec((1, 1, block_k),
                                      lambda ib, ih, iq, ik: (ib, 0, ik)))
         args.append(bias[:, None, :])
+    if has_drop:
+        in_specs.append(_seed_spec())
+        args.append(seed)
     in_specs.append(pl.BlockSpec((1, 1, block_k, d), _kv_idx))  # v
     args.append(v)
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel if bias is not None else _bwd_dq_kernel_nobias,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            n_kv=nk),
+        _wrap_optional(
+            functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, n_kv=nk,
+                              dropout_rate=dropout_rate),
+            5, bias is not None, has_drop),
         grid=(b, h, nq, nk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d), _q_idx),
@@ -367,11 +435,15 @@ def _flash_bwd(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
         in_specs.append(pl.BlockSpec((1, 1, block_k),
                                      lambda ib, ih, ik, iq: (ib, 0, ik)))
         args.append(bias[:, None, :])
+    if has_drop:
+        in_specs.append(_seed_spec())
+        args.append(seed)
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel if bias is not None else _bwd_dkv_kernel_nobias,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            n_q=nq),
+        _wrap_optional(
+            functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, n_q=nq,
+                              dropout_rate=dropout_rate),
+            6, bias is not None, has_drop),
         grid=(b, h, nk, nq),
         in_specs=in_specs,
         out_specs=[
@@ -394,41 +466,53 @@ def _flash_bwd(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k):
 # ==========================================================================
 # custom_vjp wrapper
 # ==========================================================================
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_attention_core(q, k, v, bias, scale, causal, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention_core(q, k, v, bias, seed, scale, causal, block_q,
+                          block_k, dropout_rate):
+    out, _ = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k,
+                        dropout_rate, seed)
     return out
 
 
-def _flash_core_fwd(q, k, v, bias, scale, causal, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
-    return out, (q, k, v, bias, out, lse)
+def _flash_core_fwd(q, k, v, bias, seed, scale, causal, block_q, block_k,
+                    dropout_rate):
+    out, lse = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k,
+                          dropout_rate, seed)
+    return out, (q, k, v, bias, seed, out, lse)
 
 
-def _flash_core_bwd(scale, causal, block_q, block_k, res, do):
-    q, k, v, bias, out, lse = res
+def _flash_core_bwd(scale, causal, block_q, block_k, dropout_rate, res, do):
+    q, k, v, bias, seed, out, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, bias, out, lse, do, scale, causal,
-                            block_q, block_k)
+                            block_q, block_k, dropout_rate, seed)
     # The bias is a padding mask, treated as a CONSTANT: computing its true
     # gradient would require materializing dense (b,h,sq,sk) dS tensors,
     # defeating the flash kernel's memory savings on every masked step.
     # A trainable attention bias must use the unfused composition.
     dbias = None if bias is None else jnp.zeros_like(bias)
-    return dq, dk, dv, dbias
+    dseed = None if seed is None else jnp.zeros_like(seed)
+    return dq, dk, dv, dbias, dseed
 
 
 _flash_attention_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def flash_attention(q, k, v, bias=None, causal=False, scale=None):
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
+                    dropout_rate=0.0, dropout_seed=None):
     """Fused scaled-dot-product attention.
 
     q/k/v: (batch, heads, seq, head_dim); bias: additive padding mask,
     shape (b, kv_seq) / (b,1,1,kv_seq), or None.  Uses the Pallas flash
-    kernel on TPU when the sequence is long enough for it to win
-    (measured crossover ~1024 on v5e; XLA's own fusion is better below
-    that); falls back to the jnp composition elsewhere.
-    PT_FLASH_ATTENTION=1 forces the kernel, =0 disables it.
+    kernel on TPU when it wins (measured crossover ~1024 on v5e without
+    dropout; WITH attention-probs dropout the naive composition pays
+    extra full score-matrix passes, so the kernel engages from 512);
+    falls back to the jnp composition elsewhere.  PT_FLASH_ATTENTION=1
+    forces the kernel, =0 disables it.
+
+    dropout_rate > 0 applies upscale-in-train dropout to the attention
+    probabilities INSIDE the kernel: masks are regenerated in the
+    backward from (dropout_seed, block coordinates), nothing is stored.
+    dropout_seed: f32 scalar array (traced; one per step).
 
     On the kernel path the bias receives a zero gradient (it is a
     padding mask, not a parameter); the fallback path differentiates it
@@ -440,12 +524,24 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None):
         scale = 1.0 / (d ** 0.5)
     if bias is not None:
         bias = _normalize_bias(bias)
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("flash_attention dropout requires dropout_seed")
     block_q = _pick_block(sq)
     block_k = _pick_block(sk)
     force = os.environ.get("PT_FLASH_ATTENTION")
-    worth_it = sq >= 1024 if force is None else force == "1"
+    if force is not None:
+        worth_it = force == "1"
+    elif dropout_rate > 0.0:
+        worth_it = sq >= 512
+    else:
+        worth_it = sq >= 1024
     if (not _use_pallas() or block_q is None or block_k is None
             or not worth_it or d % 8 != 0):
-        return attention_reference(q, k, v, bias, causal, scale)
-    return _flash_attention_core(q, k, v, bias, scale, causal,
-                                 block_q, block_k)
+        return attention_reference(q, k, v, bias, causal, scale,
+                                   dropout_rate=dropout_rate,
+                                   dropout_seed=dropout_seed)
+    seed = None
+    if dropout_rate > 0.0:
+        seed = jnp.asarray(dropout_seed, jnp.float32).reshape((1,))
+    return _flash_attention_core(q, k, v, bias, seed, scale, causal,
+                                 block_q, block_k, float(dropout_rate))

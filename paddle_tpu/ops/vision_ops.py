@@ -627,30 +627,44 @@ def _gaussian_random_batch_size_like(ctx):
     ctx.set_out("Out", mean + std * jax.random.normal(ctx.rng(), tuple(shape)))
 
 
-@op("similarity_focus", no_grad=True)
+@op("similarity_focus", no_grad=True, host=True)
 def _similarity_focus(ctx):
-    """Focus mask by per-(channel-slice) argmax (reference:
-    similarity_focus_op.cc): for each indicated channel, mark the
-    row/column of each maximal element until every row and column of the
-    (H, W) plane is covered."""
-    x = ctx.in_("X")
+    """Focus mask by greedy row/column cover (reference:
+    similarity_focus_op.h SimilarityFocusKernel, implemented exactly):
+    for each batch and each indicated slice along `axis`, walk the
+    (d2, d3) cells in descending value order; a cell whose d2 AND d3 are
+    both uncovered claims them, and the FULL fiber along `axis` at that
+    position is set to 1; stop after min(d2, d3) picks.  Sequential
+    greedy order matters under ties, so this is a host op (like
+    edit_distance / chunk_eval) rather than a vectorized approximation."""
+    x = np.asarray(ctx.in_("X"))
     axis = ctx.attr("axis", 1)
     indexes = ctx.attr("indexes", [0])
-    n, c, h, w = x.shape
-    mask = jnp.zeros_like(x)
-    for idx in indexes:
-        plane = x[:, idx] if axis == 1 else x[:, :, idx]
-        # rank positions by value; greedily cover rows/cols: vectorized
-        # approximation of the reference's greedy loop — mark cells that
-        # are the max of their row OR their column
-        row_max = plane == plane.max(axis=-1, keepdims=True)
-        col_max = plane == plane.max(axis=-2, keepdims=True)
-        m = (row_max | col_max).astype(x.dtype)
-        if axis == 1:
-            mask = mask.at[:, idx].set(m)
-        else:
-            mask = mask.at[:, :, idx].set(m)
-    ctx.set_out("Out", mask)
+    if axis not in (1, 2, 3):
+        raise ValueError(f"similarity_focus: axis must be 1..3, got {axis}")
+    # move the indexed axis to position 1; (d2, d3) are the other two
+    perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    xt = np.transpose(x, perm)
+    n, c, d2, d3 = xt.shape
+    out = np.zeros_like(xt)
+    for i in range(n):
+        for index in indexes:
+            plane = xt[i, index]
+            order = np.argsort(-plane, axis=None, kind="stable")
+            tag2 = np.zeros(d2, bool)
+            tag3 = np.zeros(d3, bool)
+            picked = 0
+            for pos in order:
+                i2, i3 = divmod(int(pos), d3)
+                if tag2[i2] or tag3[i3]:
+                    continue
+                tag2[i2] = tag3[i3] = True
+                out[i, :, i2, i3] = 1
+                picked += 1
+                if picked == min(d2, d3):
+                    break
+    inv = np.argsort(perm)
+    ctx.set_out("Out", jnp.asarray(np.transpose(out, inv)))
 
 
 @op("unique_with_counts", no_grad=True, host=True)

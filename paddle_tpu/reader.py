@@ -55,36 +55,53 @@ class DataLoader:
     from_generator returns a loader whose set_sample_generator /
     set_sample_list_generator / set_batch_generator feed a background
     prefetch queue (the py_reader blocking-queue analog).
+
+    ``use_multiprocess=True`` moves the whole reader pipeline (user
+    generator + batching + ndarray conversion) into a forked worker
+    process streaming batches over a bounded queue — the
+    GeneratorLoader._start_process path (reference:
+    fluid/reader.py _reader_process_loop + imperative/data_loader.cc's
+    SIGCHLD handling); the parent polls worker liveness so a crashed
+    worker raises instead of hanging the training loop.  When places are
+    given, a second stage device_puts upcoming batches ahead of use (the
+    buffered_reader.cc double-buffer-to-device analog).
     """
 
     def __init__(self, feed_list=None, capacity=64, iterable=True,
-                 return_list=False, use_double_buffer=True):
+                 return_list=False, use_double_buffer=True,
+                 use_multiprocess=False, drop_last=True):
         self.feed_list = feed_list or []
         self.capacity = capacity
         self.iterable = iterable
         self.return_list = return_list
         self.use_double_buffer = use_double_buffer
+        self.use_multiprocess = use_multiprocess
+        self.drop_last = drop_last
         self._batch_fn: Optional[Callable[[], Iterable]] = None
         self._places = None
+        self._worker = None  # live worker process (for tests/debugging)
 
     @staticmethod
     def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
                        iterable=True, return_list=False, use_multiprocess=False,
                        drop_last=True):
         return DataLoader(feed_list, capacity, iterable, return_list,
-                          use_double_buffer)
+                          use_double_buffer, use_multiprocess, drop_last)
 
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
-        loader = DataLoader()
+        loader = DataLoader(drop_last=drop_last)
         loader._batch_fn = lambda: iter(dataset)
+        loader._places = places
         return loader
 
     # ------------------------------------------------------------------
-    def set_sample_generator(self, reader, batch_size, drop_last=True,
+    def set_sample_generator(self, reader, batch_size, drop_last=None,
                              places=None):
         from .reader_decorator import batch as batch_dec
 
+        if drop_last is None:
+            drop_last = self.drop_last
         return self.set_sample_list_generator(
             batch_dec(reader, batch_size, drop_last), places
         )
@@ -117,12 +134,8 @@ class DataLoader:
         return self
 
     # ------------------------------------------------------------------
-    def __iter__(self):
-        if self._batch_fn is None:
-            raise RuntimeError("DataLoader has no generator set")
-        if not self.use_double_buffer:
-            yield from self._batch_fn()
-            return
+    def _thread_iter(self):
+        """In-process background prefetch (the r2 path)."""
         q: "queue.Queue" = queue.Queue(maxsize=max(2, self.capacity))
         sentinel = object()
         err: list = []
@@ -145,6 +158,100 @@ class DataLoader:
                     raise err[0]
                 return
             yield item
+
+    def _mp_iter(self):
+        """Worker-process prefetch (reference:
+        fluid/reader.py GeneratorLoader._start_process /
+        _reader_process_loop): the reader runs in a forked child, batches
+        stream over a bounded queue, and the parent detects a dead worker
+        instead of blocking forever."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        q = ctx.Queue(maxsize=max(2, self.capacity))
+        DONE, ERR = "__pt_reader_done__", "__pt_reader_err__"
+        batch_fn = self._batch_fn
+
+        def worker_loop():
+            try:
+                for item in batch_fn():
+                    q.put(item)
+                q.put((DONE,))
+            except BaseException as e:
+                import traceback
+
+                q.put((ERR, repr(e), traceback.format_exc()))
+
+        proc = ctx.Process(target=worker_loop, daemon=True)
+        proc.start()
+        self._worker = proc
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=2.0)
+                except queue.Empty:
+                    if not proc.is_alive():
+                        raise RuntimeError(
+                            f"DataLoader worker process died unexpectedly "
+                            f"(exitcode={proc.exitcode}) — e.g. killed by "
+                            f"the OOM killer or a signal"
+                        )
+                    continue
+                if isinstance(item, tuple) and item and item[0] == DONE:
+                    return
+                if isinstance(item, tuple) and item and item[0] == ERR:
+                    raise RuntimeError(
+                        f"DataLoader worker raised: {item[1]}\n{item[2]}")
+                yield item
+        finally:
+            self._worker = None
+            if proc.is_alive():
+                proc.terminate()
+            proc.join(timeout=5)
+            q.close()
+
+    def _device_prefetch(self, it, depth=2):
+        """Stage upcoming batches on device ahead of use (reference:
+        operators/reader/buffered_reader.cc — double buffer to the
+        device): jax.device_put dispatches the H2D copy asynchronously,
+        so the copy of batch k+1 overlaps compute of batch k."""
+        import collections
+
+        import jax
+
+        device = None
+        places = self._places
+        if places:
+            p = places[0] if isinstance(places, (list, tuple)) else places
+            if hasattr(p, "jax_device"):
+                device = p.jax_device()
+        if device is None:
+            yield from it
+            return
+        buf = collections.deque()
+        for feed in it:
+            if isinstance(feed, dict):
+                feed = {k: jax.device_put(v, device)
+                        if isinstance(v, np.ndarray) else v
+                        for k, v in feed.items()}
+            buf.append(feed)
+            if len(buf) >= depth:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
+    def __iter__(self):
+        if self._batch_fn is None:
+            raise RuntimeError("DataLoader has no generator set")
+        if self.use_multiprocess:
+            it = self._mp_iter()
+        elif self.use_double_buffer:
+            it = self._thread_iter()
+        else:
+            it = self._batch_fn()
+        if self.use_double_buffer:
+            it = self._device_prefetch(it)
+        yield from it
 
     # legacy py_reader-style start/reset are no-ops for iterable loaders
     def start(self):

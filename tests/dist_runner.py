@@ -51,17 +51,23 @@ def run_dygraph_dp(steps=6):
     xs_l = xs[rank * shard:(rank + 1) * shard]
     ys_l = ys[rank * shard:(rank + 1) * shard]
 
+    from paddle_tpu.dygraph import Sequential
+
     with guard():
         np.random.seed(7)  # identical init on every rank
-        lin = Linear(8, 1)
+        net = Sequential(Linear(8, 16, act="relu"), Linear(16, 16,
+                                                          act="relu"),
+                         Linear(16, 1))
         # deterministic identical init across ranks
-        lin.weight._value = jax.numpy.asarray(
-            np.linspace(-0.1, 0.1, 8, dtype=np.float32).reshape(8, 1))
-        lin.bias._value = jax.numpy.zeros((1,), np.float32)
-        model = DataParallel(lin)
+        rs = np.random.RandomState(11)
+        for p in net.parameters():
+            p._value = jax.numpy.asarray(
+                (rs.rand(*p.shape).astype(np.float32) - 0.5) * 0.2)
+        model = DataParallel(net)
         opt = fluid.optimizer.SGDOptimizer(0.1,
-                                           parameter_list=lin.parameters())
+                                           parameter_list=net.parameters())
         losses = []
+        coll_per_step = []
         for _ in range(steps):
             x = to_variable(xs_l)
             y = to_variable(ys_l)
@@ -70,15 +76,18 @@ def run_dygraph_dp(steps=6):
                 fluid.layers.square_error_cost(pred, y))
             scaled = model.scale_loss(loss)
             scaled.backward()
+            before = dist.collective_call_count()
             model.apply_collective_grads()
+            coll_per_step.append(dist.collective_call_count() - before)
             opt.minimize(scaled)
-            lin.clear_gradients()
+            net.clear_gradients()
             # global loss = mean over ranks of the local mean
             from paddle_tpu.distributed import all_reduce
 
             g = all_reduce(np.asarray(loss.value()), op="sum") / nranks
             losses.append(float(np.asarray(g).ravel()[0]))
-    print("RESULT=" + json.dumps({"rank": rank, "losses": losses}),
+    print("RESULT=" + json.dumps({"rank": rank, "losses": losses,
+                                  "collectives_per_step": coll_per_step}),
           flush=True)
 
 

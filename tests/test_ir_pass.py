@@ -185,3 +185,192 @@ def test_inference_prune_uses_pass_infra():
     types = [o.type for o in pruned.global_block().ops]
     assert "sgd" not in types and not any(t.endswith("_grad") for t in types)
     assert "mul" in types  # fc forward retained
+
+
+# --------------------------------------------------------------------------
+# round-3 pass corpus: conv+bn fold, embedding+eltwise+layernorm fuse,
+# fused optimizer shell, AnalysisConfig-driven predictor pipeline
+# --------------------------------------------------------------------------
+def _conv_bn_program(is_test=True):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = Program(), Program()
+    main.random_seed = 2
+    with program_guard(main, startup):
+        img = L.data("img", [3, 8, 8])
+        conv = L.conv2d(img, num_filters=6, filter_size=3, padding=1,
+                        bias_attr=False)
+        bn = L.batch_norm(conv, is_test=is_test)
+        out = L.relu(bn)
+    return main, startup, out
+
+
+def test_conv_bn_fuse_pass_folds_weights():
+    import collections
+
+    import paddle_tpu.fluid as fluid
+
+    main, startup, out = _conv_bn_program()
+    rng = np.random.RandomState(0)
+    img = rng.rand(4, 3, 8, 8).astype(np.float32)
+
+    scope = Scope()
+    prev = scope_mod._global_scope
+    scope_mod._global_scope = scope
+    try:
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        # give the (frozen) bn stats non-trivial values
+        bn_op = next(o for o in main.global_block().ops
+                     if o.type == "batch_norm")
+        scope.set(bn_op.inputs["Mean"][0],
+                  rng.rand(6).astype(np.float32))
+        scope.set(bn_op.inputs["Variance"][0],
+                  (rng.rand(6) + 0.5).astype(np.float32))
+        scope.set(bn_op.inputs["Scale"][0],
+                  (rng.rand(6) + 0.5).astype(np.float32))
+        scope.set(bn_op.inputs["Bias"][0], rng.rand(6).astype(np.float32))
+        before = exe.run(main, feed={"img": img}, fetch_list=[out.name])[0]
+        p = get_pass("conv_bn_fuse_pass", scope=scope)
+        p.apply(main)
+        assert p.fused_count == 1
+        types = collections.Counter(o.type for o in main.global_block().ops)
+        assert types["batch_norm"] == 0
+        after = exe.run(main, feed={"img": img}, fetch_list=[out.name])[0]
+        np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                                   atol=2e-5)
+    finally:
+        scope_mod._global_scope = prev
+
+
+def test_embedding_eltwise_layernorm_fuse_pass():
+    import collections
+
+    main, startup = Program(), Program()
+    main.random_seed = 4
+    with program_guard(main, startup):
+        a = L.data("a", [16], dtype="int64")
+        b = L.data("b", [16], dtype="int64")
+        c = L.data("c", [16], dtype="int64")
+        ea = L.embedding(a, size=[50, 32])
+        eb = L.embedding(b, size=[50, 32])
+        ec = L.embedding(c, size=[50, 32])
+        s = L.elementwise_add(L.elementwise_add(ea, eb), ec)
+        out = L.layer_norm(s, begin_norm_axis=2)
+    rng = np.random.RandomState(1)
+    feed = {k: rng.randint(0, 50, (2, 16)).astype(np.int64)
+            for k in ("a", "b", "c")}
+
+    scope = Scope()
+    prev = scope_mod._global_scope
+    scope_mod._global_scope = scope
+    try:
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        before = exe.run(main, feed=feed, fetch_list=[out.name])[0]
+        p = get_pass("embedding_eltwise_layernorm_fuse_pass")
+        p.apply(main)
+        assert p.fused_count == 1
+        types = collections.Counter(o.type for o in main.global_block().ops)
+        assert types["lookup_table"] == 0 and types["layer_norm"] == 0
+        assert types["fused_embedding_eltwise_layernorm"] == 1
+        after = exe.run(main, feed=feed, fetch_list=[out.name])[0]
+        np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                                   atol=1e-5)
+    finally:
+        scope_mod._global_scope = prev
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "momentum", "adam"])
+def test_fuse_optimizer_ops_pass(opt_name):
+    import collections
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import scope_guard
+    from paddle_tpu.utils import flags
+
+    def build():
+        main, startup = Program(), Program()
+        main.random_seed = 9
+        with program_guard(main, startup):
+            x = L.data("x", [8])
+            y = L.data("y", [1])
+            h = L.fc(x, 16, act="relu")
+            h = L.fc(h, 16, act="relu")
+            pred = L.fc(h, 1)
+            loss = L.reduce_mean(L.square_error_cost(pred, y))
+            opt = {"sgd": fluid.optimizer.SGDOptimizer(0.1),
+                   "momentum": fluid.optimizer.MomentumOptimizer(0.1, 0.9),
+                   "adam": fluid.optimizer.AdamOptimizer(0.01)}[opt_name]
+            opt.minimize(loss)
+        return main, startup, loss
+
+    # graph-level: all 6 per-param ops merge into one fused op
+    main, _, _ = build()
+    p = get_pass("fuse_optimizer_ops_pass")
+    p.apply(main)
+    types = collections.Counter(o.type for o in main.global_block().ops)
+    assert p.fused_count == 1
+    assert types[opt_name] == 0 and types["fused_" + opt_name] == 1
+
+    # numeric: executor path with the training pipeline on vs off
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 8).astype(np.float32)
+    ys = rng.rand(16, 1).astype(np.float32)
+
+    def train(enabled):
+        flags._flags["FLAGS_apply_ir_passes"] = enabled
+        try:
+            main, startup, loss = build()
+            exe = pt.Executor(pt.CPUPlace())
+            with scope_guard(Scope()):
+                exe.run(startup)
+                return [float(np.asarray(exe.run(
+                    main, feed={"x": xs, "y": ys},
+                    fetch_list=[loss.name])[0]).ravel()[0])
+                    for _ in range(5)]
+        finally:
+            flags._flags["FLAGS_apply_ir_passes"] = True
+
+    np.testing.assert_allclose(train(False), train(True), rtol=1e-6)
+
+
+def test_predictor_applies_config_pass_list(tmp_path):
+    """AnalysisConfig's pass builder drives the predictor by default
+    (reference: paddle_pass_builder.cc + OptimizeInferenceProgram)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import scope_guard
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    main, startup = Program(), Program()
+    main.random_seed = 6
+    with program_guard(main, startup):
+        img = L.data("img", [3, 8, 8])
+        conv = L.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                        bias_attr=False)
+        bn = L.batch_norm(conv)
+        out = L.relu(bn)
+    rng = np.random.RandomState(3)
+    img_np = rng.rand(2, 3, 8, 8).astype(np.float32)
+    exe = pt.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        oracle = exe.run(main.clone(for_test=True), feed={"img": img_np},
+                         fetch_list=[out.name])[0]
+        fluid.io.save_inference_model(str(tmp_path), ["img"], [out], exe,
+                                      main_program=main)
+    cfg = AnalysisConfig(str(tmp_path))
+    pred = create_paddle_predictor(cfg)
+    assert pred._applied_passes, "default pass list applied nothing"
+    assert any(n == "conv_bn_fuse_pass" for n, _ in pred._applied_passes)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(img_np)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(np.asarray(oracle), got, atol=2e-5)
+
+    # switch_ir_optim(False) must skip the pipeline
+    cfg2 = AnalysisConfig(str(tmp_path))
+    cfg2.switch_ir_optim(False)
+    pred2 = create_paddle_predictor(cfg2)
+    assert not getattr(pred2, "_applied_passes", None)

@@ -152,3 +152,48 @@ def test_pool_with_index_padded_and_global():
     np.testing.assert_allclose(
         np.take_along_axis(flat3, m3.reshape(1, 2, -1), axis=2),
         np.asarray(p3["Out"][0]).reshape(1, 2, -1))
+
+
+def test_similarity_focus_matches_reference_greedy():
+    """Exact parity with the reference's sequential greedy cover
+    (similarity_focus_op.h): cells claimed in descending value order when
+    both their d2 and d3 are unclaimed; the whole fiber along `axis` is
+    marked; stops at min(d2, d3) picks."""
+    import numpy as np
+
+    from paddle_tpu.ops.registry import eager_call
+
+    def ref(x, axis, indexes):
+        perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+        xt = np.transpose(x, perm)
+        n, c, d2, d3 = xt.shape
+        out = np.zeros_like(xt)
+        for i in range(n):
+            for index in indexes:
+                plane = xt[i, index]
+                pairs = sorted(
+                    ((plane[a, b], a * d3 + b)
+                     for a in range(d2) for b in range(d3)),
+                    key=lambda p: (-p[0], p[1]))
+                t2, t3 = set(), set()
+                for _, pos in pairs:
+                    a, b = divmod(pos, d3)
+                    if a in t2 or b in t3:
+                        continue
+                    t2.add(a)
+                    t3.add(b)
+                    out[i, :, a, b] = 1
+                    if len(t2) == min(d2, d3):
+                        break
+        return np.transpose(out, np.argsort(perm))
+
+    rng = np.random.RandomState(0)
+    for axis in (1, 2, 3):
+        x = rng.rand(2, 3, 4, 5).astype(np.float32)
+        # inject ties so greedy order matters
+        x[0].flat[::7] = 0.5
+        indexes = [0, 2] if axis == 1 else [1]
+        outs = eager_call("similarity_focus", {"X": [x]},
+                          {"axis": axis, "indexes": indexes}, {"Out": 1})
+        np.testing.assert_array_equal(np.asarray(outs["Out"][0]),
+                                      ref(x, axis, indexes), err_msg=f"axis={axis}")

@@ -95,12 +95,16 @@ def _single_process_oracle(steps=6, seed=3, lr=0.1):
 def test_dygraph_dataparallel_two_processes():
     """2-process dygraph DataParallel: per-step global losses finite,
     equal across ranks (same allreduced grads ⇒ same params), and
-    decreasing."""
+    decreasing.  The 6-param model's grads must cross the wire in ONE
+    coalesced collective per step (imperative/all_reduce.cc analog), not
+    one per parameter."""
     results = _spawn_ranks("dygraph_dp", nproc=2)
     l0, l1 = results[0]["losses"], results[1]["losses"]
     np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-6)
     assert np.isfinite(l0).all()
     assert l0[-1] < l0[0], l0
+    for r in results.values():
+        assert max(r["collectives_per_step"]) <= 1, r["collectives_per_step"]
 
 
 def test_fleet_collective_two_processes_matches_local():

@@ -968,6 +968,10 @@ COVERED_ELSEWHERE = {
     # fused BN(+add)+act — tests/test_fused_bn.py
     "fused_batch_norm_act": "test_fused_bn",
     "fused_bn_add_activation": "test_fused_bn",
+    # pass-produced fused ops — tests/test_ir_pass.py
+    "fused_embedding_eltwise_layernorm": "test_ir_pass",
+    "fused_sgd": "test_ir_pass", "fused_momentum": "test_ir_pass",
+    "fused_adam": "test_ir_pass",
     # sparse path — tests/test_selected_rows.py
     "lookup_table_sparse_grad": "test_selected_rows",
     # stateful-forward grad pair — tests/test_dygraph.py dropout tests
@@ -993,7 +997,7 @@ COVERED_ELSEWHERE = {
     "data_norm": "test_layers_tail(layer smoke)",
     "random_crop": "rng: shape-checked via layer",
     "sampling_id": "rng", "gaussian_random_batch_size_like": "rng",
-    "similarity_focus": "vectorized-approx, layer smoke",
+    "similarity_focus": "test_misc_ops greedy-cover parity",
     "hash": "deterministic-spread, layer smoke in test_layers_tail",
     "unique_with_counts": "host dynamic shape, test_layers_tail",
     "get_tensor_from_selected_rows": "test_selected_rows machinery",

@@ -58,7 +58,7 @@ def run_ernie(steps=8, batch=16, seq=512, attn_dropout=True):
     import numpy as np
 
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.dygraph import guard, jit_train_step
+    from paddle_tpu.dygraph import jit_train_step
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
 
     cfg = BertConfig(
@@ -66,12 +66,14 @@ def run_ernie(steps=8, batch=16, seq=512, attn_dropout=True):
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
     labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
-    g = guard()
-    g.__enter__()
+    from paddle_tpu.dygraph import enable_dygraph
+
+    enable_dygraph()
     model = BertForPretraining(cfg)
     opt = fluid.optimizer.AdamOptimizer(1e-4,
                                         parameter_list=model.parameters())
-    fn = jit_train_step(model, opt, lambda m, i, l: m(i, l))
+    fn = jit_train_step(model, opt, lambda m, i, l: m(i, l),
+                        amp=os.environ.get("BENCH_AMP", "1") != "0")
 
     def step():
         return fn(ids, labels)
@@ -86,24 +88,26 @@ def main():
     import numpy as np
 
     step = run_ernie() if which == "ernie" else run_resnet()
+
+    def sync(out):
+        v = out[0] if isinstance(out, (list, tuple)) else out
+        arr = v.value() if hasattr(v, "value") else v
+        np.asarray(arr)
+
     # warmup/compile
     for _ in range(3):
         out = step()
-    jax.block_until_ready(getattr(out[0], "_jax", out))
-    trace_dir = f"/tmp/pt_trace/{which}"
+    sync(out)
+    trace_dir = f"/tmp/pt_trace/{which}" + ("_amp" if os.environ.get("BENCH_AMP", "1") != "0" else "")
     os.makedirs(trace_dir, exist_ok=True)
     with jax.profiler.trace(trace_dir):
         for _ in range(steps):
             out = step()
-        v = out[0]
-        arr = v.value() if hasattr(v, "value") else v
-        np.asarray(arr)
+        sync(out)
     t0 = time.perf_counter()
     for _ in range(steps):
         out = step()
-    v = out[0]
-    arr = v.value() if hasattr(v, "value") else v
-    np.asarray(arr)
+    sync(out)
     wall = (time.perf_counter() - t0) / steps
     print(f"wall per step (untraced): {wall * 1e3:.2f} ms")
     summarize(trace_dir, steps)
