@@ -1,0 +1,132 @@
+// Package paddle — Go client for the native inference runtime.
+//
+// Reference analog: go/paddle/predictor.go (810-LoC cgo wrapper over
+// the reference's C API).  Here the C surface is the TPU-native PJRT
+// runtime (paddle_tpu/native/pd_inference_c_api.h +
+// predictor_capi.cpp): load a StableHLO export dir, compile through a
+// PJRT plugin (libtpu.so on TPU VMs), run with zero Python.
+//
+// Build: compile the C runtime once, then go build:
+//
+//	g++ -O2 -std=c++17 -shared -fPIC \
+//	    paddle_tpu/native/predictor_capi.cpp \
+//	    -I$(python -c 'import tensorflow, os; print(os.path.join(os.path.dirname(tensorflow.__file__), "include"))') \
+//	    -ldl -o /usr/local/lib/libpd_native.so
+//	CGO_LDFLAGS="-L/usr/local/lib -lpd_native" go build ./go/paddle
+package paddle
+
+/*
+#cgo LDFLAGS: -lpd_native
+#include <stdlib.h>
+#include <string.h>
+#include "pd_inference_c_api.h"
+*/
+import "C"
+
+import (
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+// Predictor wraps PD_NativePredictor (reference: Predictor over
+// PD_Predictor in go/paddle/predictor.go:27).
+type Predictor struct {
+	c *C.PD_NativePredictor
+}
+
+// NewPredictor loads an export dir (model.stablehlo.mlir + weights.ptw
+// + meta.txt) and compiles it through the PJRT plugin at pluginPath.
+func NewPredictor(exportDir, pluginPath string) (*Predictor, error) {
+	cdir := C.CString(exportDir)
+	cplugin := C.CString(pluginPath)
+	copts := C.CString("")
+	defer C.free(unsafe.Pointer(cdir))
+	defer C.free(unsafe.Pointer(cplugin))
+	defer C.free(unsafe.Pointer(copts))
+	p := C.PD_NativePredictorCreate(cdir, cplugin, copts)
+	if p == nil {
+		return nil, fmt.Errorf("paddle: %s", C.GoString(C.PD_NativeLastError()))
+	}
+	pred := &Predictor{c: p}
+	runtime.SetFinalizer(pred, func(pr *Predictor) { pr.Destroy() })
+	return pred, nil
+}
+
+func (p *Predictor) Destroy() {
+	if p.c != nil {
+		C.PD_NativePredictorDestroy(p.c)
+		p.c = nil
+	}
+}
+
+func (p *Predictor) GetInputNum() int  { return int(C.PD_NativePredictorNumInputs(p.c)) }
+func (p *Predictor) GetOutputNum() int { return int(C.PD_NativePredictorNumOutputs(p.c)) }
+
+func (p *Predictor) GetInputName(i int) string {
+	return C.GoString(C.PD_NativePredictorInputName(p.c, C.int(i)))
+}
+
+func (p *Predictor) GetOutputName(i int) string {
+	return C.GoString(C.PD_NativePredictorOutputName(p.c, C.int(i)))
+}
+
+func (p *Predictor) GetInputNames() []string {
+	names := make([]string, p.GetInputNum())
+	for i := range names {
+		names[i] = p.GetInputName(i)
+	}
+	return names
+}
+
+func (p *Predictor) GetOutputNames() []string {
+	names := make([]string, p.GetOutputNum())
+	for i := range names {
+		names[i] = p.GetOutputName(i)
+	}
+	return names
+}
+
+// InputInfo returns (dtype, dims) for input i from the export metadata.
+func (p *Predictor) InputInfo(i int) (DType, []int64, error) {
+	var t C.PD_NativeTensor
+	if C.PD_NativePredictorInputInfo(p.c, C.int(i), &t) != 0 {
+		return 0, nil, fmt.Errorf("paddle: input %d out of range", i)
+	}
+	dims := make([]int64, int(t.ndim))
+	for d := range dims {
+		dims[d] = int64(t.dims[d])
+	}
+	return DType(t.dtype), dims, nil
+}
+
+// Run executes one inference over the given input tensors (in meta
+// order) and returns the outputs (reference: ZeroCopyRun).
+func (p *Predictor) Run(inputs []*Tensor) ([]*Tensor, error) {
+	nIn := len(inputs)
+	cin := make([]C.PD_NativeTensor, nIn)
+	pinned := make([][]byte, nIn)
+	for i, t := range inputs {
+		ct, buf, err := t.toC()
+		if err != nil {
+			return nil, err
+		}
+		cin[i] = ct
+		pinned[i] = buf
+	}
+	nOut := p.GetOutputNum()
+	cout := make([]C.PD_NativeTensor, nOut)
+	got := C.PD_NativePredictorRun(p.c,
+		(*C.PD_NativeTensor)(unsafe.Pointer(&cin[0])), C.int(nIn),
+		(*C.PD_NativeTensor)(unsafe.Pointer(&cout[0])), C.int(nOut))
+	runtime.KeepAlive(pinned)
+	if got < 0 {
+		return nil, fmt.Errorf("paddle: %s", C.GoString(C.PD_NativeLastError()))
+	}
+	outs := make([]*Tensor, int(got))
+	for i := 0; i < int(got); i++ {
+		outs[i] = fromC(&cout[i])
+		C.PD_NativeTensorFree(&cout[i])
+	}
+	return outs, nil
+}

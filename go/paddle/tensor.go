@@ -1,0 +1,107 @@
+package paddle
+
+/*
+#include <stdlib.h>
+#include <string.h>
+#include "pd_inference_c_api.h"
+*/
+import "C"
+
+import (
+	"encoding/binary"
+	"fmt"
+	"math"
+	"unsafe"
+)
+
+// DType mirrors PD_DType (reference: go/paddle/tensor.go PaddleDType).
+type DType int32
+
+const (
+	Float32 DType = C.PD_FLOAT32
+	Float64 DType = C.PD_FLOAT64
+	Int32   DType = C.PD_INT32
+	Int64   DType = C.PD_INT64
+	Uint8   DType = C.PD_UINT8
+	Int8    DType = C.PD_INT8
+	Bool    DType = C.PD_BOOL
+)
+
+func dtypeSize(d DType) int {
+	switch d {
+	case Float64, Int64:
+		return 8
+	case Uint8, Int8, Bool:
+		return 1
+	default:
+		return 4
+	}
+}
+
+// Tensor is the host-side value container (reference: ZeroCopyTensor).
+type Tensor struct {
+	Dtype DType
+	Shape []int64
+	Data  []byte // little-endian raw payload
+}
+
+func numel(shape []int64) int64 {
+	n := int64(1)
+	for _, d := range shape {
+		n *= d
+	}
+	return n
+}
+
+// NewFloat32Tensor packs a float32 slice.
+func NewFloat32Tensor(shape []int64, vals []float32) (*Tensor, error) {
+	if int64(len(vals)) != numel(shape) {
+		return nil, fmt.Errorf("paddle: %d values for shape %v", len(vals), shape)
+	}
+	data := make([]byte, len(vals)*4)
+	for i, v := range vals {
+		binary.LittleEndian.PutUint32(data[i*4:], math.Float32bits(v))
+	}
+	return &Tensor{Dtype: Float32, Shape: shape, Data: data}, nil
+}
+
+// Float32s unpacks a Float32 tensor's payload.
+func (t *Tensor) Float32s() ([]float32, error) {
+	if t.Dtype != Float32 {
+		return nil, fmt.Errorf("paddle: tensor is not float32")
+	}
+	out := make([]float32, len(t.Data)/4)
+	for i := range out {
+		out[i] = math.Float32frombits(binary.LittleEndian.Uint32(t.Data[i*4:]))
+	}
+	return out, nil
+}
+
+func (t *Tensor) toC() (C.PD_NativeTensor, []byte, error) {
+	var ct C.PD_NativeTensor
+	if len(t.Shape) > C.PD_MAX_RANK {
+		return ct, nil, fmt.Errorf("paddle: rank %d > max", len(t.Shape))
+	}
+	ct.dtype = C.int32_t(t.Dtype)
+	ct.ndim = C.int32_t(len(t.Shape))
+	for i, d := range t.Shape {
+		ct.dims[i] = C.int64_t(d)
+	}
+	ct.nbytes = C.size_t(len(t.Data))
+	if len(t.Data) > 0 {
+		ct.data = unsafe.Pointer(&t.Data[0])
+	}
+	return ct, t.Data, nil
+}
+
+func fromC(ct *C.PD_NativeTensor) *Tensor {
+	shape := make([]int64, int(ct.ndim))
+	for i := range shape {
+		shape[i] = int64(ct.dims[i])
+	}
+	data := make([]byte, int(ct.nbytes))
+	if ct.data != nil && ct.nbytes > 0 {
+		copy(data, unsafe.Slice((*byte)(ct.data), int(ct.nbytes)))
+	}
+	return &Tensor{Dtype: DType(ct.dtype), Shape: shape, Data: data}
+}

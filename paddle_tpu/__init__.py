@@ -53,6 +53,7 @@ from .tensor import (
     allclose, equal_all, bmm, dot, norm, tril, triu, numel,
 )
 from .executor import Executor
+from .utils.memory import memory_stats, memory_summary
 from .backward import append_backward, gradients
 from .framework.scope import global_scope, scope_guard, LoDTensor, Scope
 

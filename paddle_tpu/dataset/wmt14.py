@@ -1,0 +1,39 @@
+"""WMT14 en->fr reader (reference: python/paddle/dataset/wmt14.py).
+
+train(dict_size)/test(dict_size) yield (src_ids, trg_ids, trg_ids_next)
+with <s>/<e>/<unk> reserved as 0/1/2, like the reference.  Deterministic
+synthetic parallel corpus fallback.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+START, END, UNK = 0, 1, 2
+
+
+def _reader(n, seed, dict_size):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            slen = rng.randint(3, 12)
+            src = rng.randint(3, dict_size, slen).tolist()
+            trg = [(w * 7 + 3) % dict_size or 3 for w in src]
+            trg_in = [START] + trg
+            trg_next = trg + [END]
+            yield src, trg_in, trg_next
+
+    return reader
+
+
+def train(dict_size):
+    return _reader(600, 0, dict_size)
+
+
+def test(dict_size):
+    return _reader(100, 1, dict_size)
+
+
+def get_dict(dict_size, reverse=False):
+    d = {i: f"w{i}" for i in range(dict_size)}
+    src = {v: k for k, v in d.items()} if not reverse else d
+    return (src, src)

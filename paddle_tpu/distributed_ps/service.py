@@ -189,6 +189,11 @@ class PSServer:
         self._lock = threading.Lock()
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # native binary-framed data plane (grpc_server.cc analog): the
+        # pull/push hot path served from C++ (native/ps_table.cpp
+        # ps_serve_start) with no Python/GIL involvement; the JSON
+        # control plane here keeps barriers/heartbeats/blobs/checkpoints
+        self.data_port = 0
 
     # ------------------------------------------------------------------
     def _handle(self, op, name, meta, arrays, sock):
@@ -205,20 +210,33 @@ class PSServer:
         if op == "create_dense":
             with self._lock:
                 if name not in self.dense:
-                    self.dense[name] = DenseTable(
+                    t = DenseTable(
                         meta["size"], meta.get("optimizer", "sgd"),
                         meta.get("lr", 0.01), meta.get("mu", 0.9),
                         meta.get("beta1", 0.9), meta.get("beta2", 0.999),
                         meta.get("eps", 1e-8))
+                    self.dense[name] = t
+                    if self.data_port > 0:
+                        from .table import bind_name
+
+                        bind_name(name, 0, t.tid)
             _send_msg(sock, "ok")
         elif op == "create_sparse":
             with self._lock:
                 if name not in self.sparse:
-                    self.sparse[name] = SparseTable(
+                    t = SparseTable(
                         meta["dim"], meta.get("init_range", 0.01),
                         meta.get("optimizer", "sgd"), meta.get("lr", 0.01),
                         meta.get("eps", 1e-8), meta.get("seed", 2026))
+                    self.sparse[name] = t
+                    if self.data_port > 0:
+                        from .table import bind_name
+
+                        bind_name(name, 1, t.tid)
             _send_msg(sock, "ok")
+        elif op == "data_port":
+            _send_msg(sock, "ok", meta={"port": self.data_port,
+                                        "host": self.host})
         elif op == "init_dense":
             self.dense[name].init(arrays[0])
             _send_msg(sock, "ok")
@@ -374,6 +392,15 @@ class PSServer:
         self._server = Server((self.host, self.port), Handler)
         if self.port == 0:
             self.port = self._server.server_address[1]
+        try:
+            from .table import serve_start
+
+            self.data_port = serve_start(
+                "0.0.0.0" if self.host in ("", "0.0.0.0") else self.host, 0)
+            if self.data_port < 0:
+                self.data_port = 0
+        except Exception:
+            self.data_port = 0  # no native lib: JSON path serves data too
         if block:
             self._server.serve_forever()
         else:
@@ -384,6 +411,14 @@ class PSServer:
 
     def stop(self):
         self._barrier_monitor.stop()
+        if self.data_port > 0:
+            try:
+                from .table import serve_stop
+
+                serve_stop(self.data_port)
+            except Exception:
+                pass
+            self.data_port = 0
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -392,6 +427,57 @@ class PSServer:
     @property
     def endpoint(self):
         return f"{self.host}:{self.port}"
+
+
+class _BinaryDataClient:
+    """Client for the native binary data plane (native/ps_table.cpp
+    ps_serve_*; reference: grpc_client.cc).  One socket per THREAD per
+    endpoint, so concurrent trainer threads do not serialize on a shared
+    connection the way the JSON control path does."""
+
+    def __init__(self):
+        self._tls = threading.local()
+
+    def _sock(self, host, port):
+        socks = getattr(self._tls, "socks", None)
+        if socks is None:
+            socks = self._tls.socks = {}
+        key = (host, port)
+        s = socks.get(key)
+        if s is None:
+            s = socket.create_connection((host, port), timeout=120)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            socks[key] = s
+        return s
+
+    def call(self, host, port, op, name, arr1=None, arr2=None):
+        s = self._sock(host, port)
+        nm = name.encode()
+        msg = [struct.pack("<BH", op, len(nm)), nm]
+        a1 = (np.ascontiguousarray(arr1) if arr1 is not None
+              else np.zeros(0, np.float32))
+        msg.append(struct.pack("<Q", a1.size))
+        msg.append(a1.tobytes())
+        if op == 4:
+            a2 = np.ascontiguousarray(arr2)
+            msg.append(struct.pack("<Q", a2.size))
+            msg.append(a2.tobytes())
+        try:
+            s.sendall(b"".join(msg))
+            status = _recv_exact(s, 1)[0]
+            (n,) = struct.unpack("<Q", _recv_exact(s, 8))
+            payload = _recv_exact(s, n * 4) if n else b""
+        except (ConnectionError, OSError):
+            self._tls.socks.pop((host, port), None)
+            try:
+                s.close()
+            except OSError:
+                pass
+            raise
+        if status != 0:
+            raise RuntimeError(
+                f"native PS error from {host}:{port} (op {op}, {name!r})")
+        return np.frombuffer(payload, np.float32).copy()
 
 
 class PSClient:
@@ -403,6 +489,21 @@ class PSClient:
         self.endpoints = list(endpoints)
         self._socks: Dict[str, socket.socket] = {}
         self._lock = threading.Lock()
+        self._data = _BinaryDataClient()
+        self._data_ports: Dict[str, tuple] = {}
+
+    def _data_ep(self, ep: str):
+        """(host, port) of the native data plane, or None (fallback to
+        the JSON path when the server has no native lib)."""
+        if ep not in self._data_ports:
+            try:
+                meta, _ = self._call(ep, "data_port")
+                port = int(meta.get("port", 0))
+            except Exception:
+                port = 0
+            host = ep.rsplit(":", 1)[0]
+            self._data_ports[ep] = (host, port) if port > 0 else None
+        return self._data_ports[ep]
 
     def _sock(self, ep: str) -> socket.socket:
         with self._lock:
@@ -452,30 +553,62 @@ class PSClient:
                    {"dim": int(dim), **cfg})
 
     def init_dense(self, name, values):
-        self._call(self._ep_for(name), "init_dense", name,
-                   arrays=[np.asarray(values, np.float32)])
+        ep = self._ep_for(name)
+        d = self._data_ep(ep)
+        v = np.asarray(values, np.float32).ravel()
+        if d is not None:
+            self._data.call(d[0], d[1], 5, name, v)
+            return
+        self._call(ep, "init_dense", name, arrays=[v])
 
     def pull_dense(self, name):
-        _, arrays = self._call(self._ep_for(name), "pull_dense", name)
+        ep = self._ep_for(name)
+        d = self._data_ep(ep)
+        if d is not None:
+            return self._data.call(d[0], d[1], 1, name)
+        _, arrays = self._call(ep, "pull_dense", name)
         return arrays[0]
 
     def push_dense(self, name, grad, sync=True):
-        self._call(self._ep_for(name), "push_dense", name, {"sync": sync},
-                   [np.asarray(grad, np.float32)])
+        ep = self._ep_for(name)
+        d = self._data_ep(ep)
+        g = np.asarray(grad, np.float32).ravel()
+        if d is not None:
+            self._data.call(d[0], d[1], 2, name, g)
+            return
+        self._call(ep, "push_dense", name, {"sync": sync}, [g])
 
     def push_delta(self, name, delta):
-        self._call(self._ep_for(name), "push_delta", name,
-                   arrays=[np.asarray(delta, np.float32)])
+        ep = self._ep_for(name)
+        d = self._data_ep(ep)
+        v = np.asarray(delta, np.float32).ravel()
+        if d is not None:
+            self._data.call(d[0], d[1], 6, name, v)
+            return
+        self._call(ep, "push_delta", name, arrays=[v])
 
     def pull_sparse(self, name, ids):
-        _, arrays = self._call(self._ep_for(name), "pull_sparse", name,
-                               arrays=[np.asarray(ids, np.int64)])
+        ep = self._ep_for(name)
+        d = self._data_ep(ep)
+        ids = np.asarray(ids, np.int64).ravel()
+        if d is not None and ids.size:
+            # empty pulls go through the JSON path: the binary reply has
+            # no dim info, and (0, 0) vs (0, dim) is a real shape
+            # divergence for downstream concat/matmul
+            flat = self._data.call(d[0], d[1], 3, name, ids)
+            return flat.reshape(ids.size, -1)
+        _, arrays = self._call(ep, "pull_sparse", name, arrays=[ids])
         return arrays[0]
 
     def push_sparse(self, name, ids, grads):
-        self._call(self._ep_for(name), "push_sparse", name,
-                   arrays=[np.asarray(ids, np.int64),
-                           np.asarray(grads, np.float32)])
+        ep = self._ep_for(name)
+        d = self._data_ep(ep)
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32)
+        if d is not None:
+            self._data.call(d[0], d[1], 4, name, ids, grads)
+            return
+        self._call(ep, "push_sparse", name, arrays=[ids, grads])
 
     def blob_put(self, name: str, blob: bytes):
         self._call(self._ep_for(name), "blob_put", name,

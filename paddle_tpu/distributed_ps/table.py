@@ -55,6 +55,14 @@ class _Lib:
             lib.ps_set_lr.restype = None
             lib.ps_reset_all.argtypes = []
             lib.ps_reset_all.restype = None
+            lib.ps_bind_name.argtypes = [ctypes.c_char_p, i32, i32]
+            lib.ps_bind_name.restype = None
+            lib.ps_serve_start.argtypes = [ctypes.c_char_p, i32]
+            lib.ps_serve_start.restype = i32
+            lib.ps_serve_stop.argtypes = []
+            lib.ps_serve_stop.restype = None
+            lib.ps_serve_stop_port.argtypes = [i32]
+            lib.ps_serve_stop_port.restype = None
             cls._lib = lib
         return cls._lib
 
@@ -130,6 +138,27 @@ class SparseTable:
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         ws = np.ascontiguousarray(ws, np.float32).reshape(ids.size, self.dim)
         self._lib.ps_sparse_import(self.tid, _ip(ids), _fp(ws), ids.size)
+
+
+def bind_name(name: str, kind: int, tid: int):
+    """Register a table name on the native data-plane server (kind
+    0=dense, 1=sparse)."""
+    _Lib.get().ps_bind_name(name.encode(), kind, tid)
+
+
+def serve_start(host: str = "0.0.0.0", port: int = 0) -> int:
+    """Start the native binary-framed transport; returns the bound
+    port (reference: grpc_server.cc — the C++ RPC server)."""
+    return int(_Lib.get().ps_serve_start(host.encode(), port))
+
+
+def serve_stop(port: int = 0):
+    """Stop the listener bound to `port` (0 = all listeners in this
+    process).  Each PSServer instance stops only its own."""
+    if port:
+        _Lib.get().ps_serve_stop_port(port)
+    else:
+        _Lib.get().ps_serve_stop()
 
 
 def reset_all_tables():

@@ -60,6 +60,7 @@ from ..io import (
     load_inference_model,
 )
 from .. import backward
+from .. import nets
 from ..reader import DataFeeder
 from .. import reader
 from .. import data_feed as dataset

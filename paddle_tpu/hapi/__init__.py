@@ -1,3 +1,5 @@
 from .model import Model, Input
 from . import callbacks
 from . import metrics
+from . import vision
+from . import text

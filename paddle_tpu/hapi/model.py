@@ -308,6 +308,21 @@ class Model:
                     vals = list(batch)
                 yield vals[:-1], vals[-1:]
             return
+        if hasattr(data, "__getitem__") and hasattr(data, "__len__") \
+                and not isinstance(data, (list, tuple)):
+            # map-style Dataset (hapi.vision.datasets): batch samples
+            n = len(data)
+            idx = np.arange(n)
+            if shuffle:
+                np.random.shuffle(idx)
+            for i in range(0, n, batch_size):
+                b = idx[i:i + batch_size]
+                samples = [data[int(j)] for j in b]
+                arrs = list(zip(*samples))
+                yield ([np.stack([np.asarray(v) for v in a])
+                        for a in arrs[:-1]],
+                       [np.stack([np.asarray(v) for v in arrs[-1]])])
+            return
         if callable(data):
             for samples in data():
                 arrs = list(zip(*samples))

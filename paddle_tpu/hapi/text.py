@@ -1,0 +1,159 @@
+"""hapi.text — reusable NLP building blocks.
+
+Reference: python/paddle/incubate/hapi/text/text.py (RNNCell:67,
+BasicLSTMCell:186, BasicGRUCell:321, RNN:476, Conv1dPoolLayer:1980,
+CNNEncoder:2109).  Transformer-scale pieces live in
+paddle_tpu.models.bert (same capability, flash-attention kernels); this
+module carries the cell/encoder surface hapi users compose directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers as F
+from ..dygraph import Layer, LayerList, Linear
+
+__all__ = ["RNNCell", "BasicLSTMCell", "BasicGRUCell", "RNN",
+           "Conv1dPoolLayer", "CNNEncoder"]
+
+
+class RNNCell(Layer):
+    """reference: text.py:67 — cell contract: call(inputs, states) ->
+    (outputs, new_states) + get_initial_states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32"):
+        from ..dygraph import to_variable
+
+        batch = batch_ref.shape[0]
+        shapes = shape if shape is not None else self.state_shape
+        if isinstance(shapes, (list, tuple)) and shapes and \
+                isinstance(shapes[0], (list, tuple)):
+            return [to_variable(np.zeros((batch,) + tuple(s), np.float32))
+                    for s in shapes]
+        return to_variable(
+            np.zeros((batch,) + tuple(shapes), np.float32))
+
+
+class BasicLSTMCell(RNNCell):
+    """reference: text.py:186 — the standard LSTM cell (i, c, f, o
+    gates with forget_bias)."""
+
+    def __init__(self, input_size, hidden_size, forget_bias=1.0):
+        super().__init__()
+        self._hidden = hidden_size
+        self._forget_bias = forget_bias
+        self._gates = Linear(input_size + hidden_size, 4 * hidden_size)
+
+    @property
+    def state_shape(self):
+        return [(self._hidden,), (self._hidden,)]
+
+    def forward(self, inputs, states):
+        h, c = states
+        g = self._gates(F.concat([inputs, h], axis=1))
+        i, j, f, o = F.split(g, 4, dim=1)
+        new_c = c * F.sigmoid(f + self._forget_bias) + F.sigmoid(i) * F.tanh(j)
+        new_h = F.tanh(new_c) * F.sigmoid(o)
+        return new_h, [new_h, new_c]
+
+
+class BasicGRUCell(RNNCell):
+    """reference: text.py:321."""
+
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        self._hidden = hidden_size
+        self._gate = Linear(input_size + hidden_size, 2 * hidden_size,
+                            act="sigmoid")
+        self._cand = Linear(input_size + hidden_size, hidden_size,
+                            act="tanh")
+
+    @property
+    def state_shape(self):
+        return (self._hidden,)
+
+    def forward(self, inputs, states):
+        h = states
+        g = self._gate(F.concat([inputs, h], axis=1))
+        u, r = F.split(g, 2, dim=1)
+        c = self._cand(F.concat([inputs, r * h], axis=1))
+        new_h = u * h + (1.0 - u) * c
+        return new_h, new_h
+
+
+class RNN(Layer):
+    """reference: text.py:476 — run a cell over the time axis of a
+    (batch, time, ...) input."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        if self.time_major:
+            inputs = F.transpose(inputs, [1, 0, 2])
+        T = inputs.shape[1]
+        states = (initial_states if initial_states is not None
+                  else self.cell.get_initial_states(inputs))
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = [None] * T
+        for t in steps:
+            out, states = self.cell(inputs[:, t], states)
+            outs[t] = out
+        stacked = F.stack(outs, axis=1)
+        if self.time_major:
+            stacked = F.transpose(stacked, [1, 0, 2])
+        return stacked, states
+
+
+class Conv1dPoolLayer(Layer):
+    """reference: text.py:1980 — Conv1D (as a width-1 Conv2D over the
+    time axis) followed by a pool."""
+
+    def __init__(self, num_channels, num_filters, filter_size, pool_size,
+                 conv_stride=1, pool_stride=1, act=None,
+                 pool_type="max", global_pooling=False):
+        super().__init__()
+        from ..dygraph import Conv2D
+
+        self._conv = Conv2D(num_channels, num_filters,
+                            (filter_size, 1), stride=(conv_stride, 1),
+                            padding=((filter_size - 1) // 2, 0), act=act)
+        self._pool_size = pool_size
+        self._pool_stride = pool_stride
+        self._pool_type = pool_type
+        self._global = global_pooling
+
+    def forward(self, x):
+        # x: (batch, channels, time) -> conv over a (time, 1) plane
+        y = self._conv(F.unsqueeze(x, [3]))
+        y = F.pool2d(y, pool_size=(self._pool_size, 1),
+                     pool_type=self._pool_type,
+                     pool_stride=(self._pool_stride, 1),
+                     global_pooling=self._global)
+        # global pooling collapses the time axis entirely -> (b, f)
+        return F.squeeze(y, [2, 3]) if self._global else F.squeeze(y, [3])
+
+
+class CNNEncoder(Layer):
+    """reference: text.py:2109 — parallel Conv1dPoolLayers over the same
+    input, concatenated (the TextCNN encoder)."""
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 pool_size=1, layer_num=1, act=None):
+        super().__init__()
+        sizes = (filter_size if isinstance(filter_size, (list, tuple))
+                 else [filter_size] * layer_num)
+        chans = (num_channels if isinstance(num_channels, (list, tuple))
+                 else [num_channels] * len(sizes))
+        filts = (num_filters if isinstance(num_filters, (list, tuple))
+                 else [num_filters] * len(sizes))
+        self.convs = LayerList([
+            Conv1dPoolLayer(c, f, k, pool_size, act=act,
+                            global_pooling=True)
+            for c, f, k in zip(chans, filts, sizes)])
+
+    def forward(self, x):
+        return F.concat([conv(x) for conv in self.convs], axis=1)

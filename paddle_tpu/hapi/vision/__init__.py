@@ -1,0 +1,3 @@
+"""hapi.vision (reference: python/paddle/incubate/hapi/vision/)."""
+from . import datasets, models, transforms
+from .models import *  # noqa: F401,F403

@@ -1,0 +1,102 @@
+"""hapi vision datasets — map-style Dataset classes.
+
+Reference: python/paddle/incubate/hapi/datasets/ (mnist.py, flowers.py,
+folder.py).  Each exposes __getitem__/__len__ over the paddle_tpu.dataset
+readers (cached real data when present, deterministic synthetic
+otherwise), with an optional transform applied to the image.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Dataset:
+    """Minimal map-style base (reference: hapi Dataset contract)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+class MNIST(Dataset):
+    """reference: hapi/datasets/mnist.py — images (1, 28, 28) float32,
+    labels int64."""
+
+    def __init__(self, mode="train", transform=None):
+        from ...dataset import mnist
+
+        reader = mnist.train() if mode == "train" else mnist.test()
+        self.samples = [(np.asarray(img, np.float32).reshape(1, 28, 28),
+                         np.asarray([lbl], np.int64))
+                        for img, lbl in reader()]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img, lbl = self.samples[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """reference: hapi/datasets/flowers.py — images (3, H, W) float32,
+    labels int64 in [0, 102)."""
+
+    def __init__(self, mode="train", transform=None):
+        from ...dataset import flowers
+
+        reader = {"train": flowers.train, "test": flowers.test,
+                  "valid": flowers.valid}[mode]()
+        self.samples = [(np.asarray(img, np.float32),
+                         np.asarray([lbl], np.int64))
+                        for img, lbl in reader()]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img, lbl = self.samples[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class DatasetFolder(Dataset):
+    """reference: hapi/datasets/folder.py — class-per-subdirectory image
+    folder; here over .npy files (no image codecs in this environment)."""
+
+    def __init__(self, root, transform=None):
+        import os
+
+        self.transform = transform
+        self.samples = []
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for f in sorted(os.listdir(cdir)):
+                if f.endswith(".npy"):
+                    self.samples.append((os.path.join(cdir, f),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = np.load(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label], np.int64)
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,313 @@
+"""hapi vision model zoo — dygraph Layers.
+
+Reference: python/paddle/incubate/hapi/vision/models/ (lenet.py:24,
+resnet.py, vgg.py, mobilenetv1.py, mobilenetv2.py).  Same architectures
+over the dygraph nn surface; wrap with hapi.Model for fit/evaluate.
+"""
+from __future__ import annotations
+
+from ... import layers as F
+from ...dygraph import (BatchNorm, Conv2D, Layer, LayerList, Linear, Pool2D,
+                        Sequential)
+
+__all__ = [
+    "LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
+]
+
+
+class LeNet(Layer):
+    """reference: hapi/vision/models/lenet.py:24."""
+
+    def __init__(self, num_classes=10, classifier_activation="softmax"):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1, act="relu"),
+            Pool2D(2, "max", 2),
+            Conv2D(6, 16, 5, stride=1, padding=0, act="relu"),
+            Pool2D(2, "max", 2),
+        )
+        if num_classes > 0:
+            self.fc = Sequential(
+                Linear(400, 120),
+                Linear(120, 84),
+                Linear(84, num_classes, act=classifier_activation),
+            )
+
+    def forward(self, inputs):
+        x = self.features(inputs)
+        if self.num_classes > 0:
+            x = F.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+class _ConvBNLayer(Layer):
+    def __init__(self, in_c, out_c, filter_size, stride=1, groups=1,
+                 act="relu"):
+        super().__init__()
+        self._conv = Conv2D(in_c, out_c, filter_size, stride=stride,
+                            padding=(filter_size - 1) // 2, groups=groups,
+                            bias_attr=False)
+        self._bn = BatchNorm(out_c, act=act)
+
+    def forward(self, x):
+        return self._bn(self._conv(x))
+
+
+class _BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, in_c, out_c, stride=1):
+        super().__init__()
+        self.conv0 = _ConvBNLayer(in_c, out_c, 3, stride)
+        self.conv1 = _ConvBNLayer(out_c, out_c, 3, act=None)
+        self.short = (None if in_c == out_c and stride == 1 else
+                      _ConvBNLayer(in_c, out_c, 1, stride, act=None))
+
+    def forward(self, x):
+        y = self.conv1(self.conv0(x))
+        s = x if self.short is None else self.short(x)
+        return F.relu(F.elementwise_add(s, y))
+
+
+class _BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, in_c, out_c, stride=1):
+        super().__init__()
+        self.conv0 = _ConvBNLayer(in_c, out_c, 1)
+        self.conv1 = _ConvBNLayer(out_c, out_c, 3, stride)
+        self.conv2 = _ConvBNLayer(out_c, out_c * 4, 1, act=None)
+        self.short = (None if in_c == out_c * 4 and stride == 1 else
+                      _ConvBNLayer(in_c, out_c * 4, 1, stride, act=None))
+
+    def forward(self, x):
+        y = self.conv2(self.conv1(self.conv0(x)))
+        s = x if self.short is None else self.short(x)
+        return F.relu(F.elementwise_add(s, y))
+
+
+_RESNET_CFG = {
+    18: (_BasicBlock, [2, 2, 2, 2]),
+    34: (_BasicBlock, [3, 4, 6, 3]),
+    50: (_BottleneckBlock, [3, 4, 6, 3]),
+    101: (_BottleneckBlock, [3, 4, 23, 3]),
+    152: (_BottleneckBlock, [3, 8, 36, 3]),
+}
+
+
+class ResNet(Layer):
+    """reference: hapi/vision/models/resnet.py."""
+
+    def __init__(self, depth=50, num_classes=1000,
+                 classifier_activation="softmax"):
+        super().__init__()
+        block, counts = _RESNET_CFG[depth]
+        self.stem = _ConvBNLayer(3, 64, 7, 2)
+        self.pool = Pool2D(3, "max", 2, pool_padding=1)
+        blocks = []
+        in_c = 64
+        for stage, count in enumerate(counts):
+            out_c = 64 * (2 ** stage)
+            for i in range(count):
+                stride = 2 if i == 0 and stage > 0 else 1
+                blocks.append(block(in_c, out_c, stride))
+                in_c = out_c * block.expansion
+        self.blocks = LayerList(blocks)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(in_c, num_classes, act=classifier_activation)
+
+    def forward(self, x):
+        x = self.pool(self.stem(x))
+        for b in self.blocks:
+            x = b(x)
+        x = F.pool2d(x, pool_type="avg", global_pooling=True)
+        if self.num_classes > 0:
+            x = self.fc(F.flatten(x, 1))
+        return x
+
+
+def resnet18(**kw):
+    return ResNet(18, **kw)
+
+
+def resnet34(**kw):
+    return ResNet(34, **kw)
+
+
+def resnet50(**kw):
+    return ResNet(50, **kw)
+
+
+def resnet101(**kw):
+    return ResNet(101, **kw)
+
+
+def resnet152(**kw):
+    return ResNet(152, **kw)
+
+
+_VGG_CFG = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    """reference: hapi/vision/models/vgg.py (batch-norm variant)."""
+
+    def __init__(self, depth=16, num_classes=1000,
+                 classifier_activation="softmax"):
+        super().__init__()
+        layers = []
+        in_c = 3
+        for v in _VGG_CFG[depth]:
+            if v == "M":
+                layers.append(Pool2D(2, "max", 2))
+            else:
+                layers.append(_ConvBNLayer(in_c, v, 3))
+                in_c = v
+        self.features = Sequential(*layers)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(512 * 7 * 7, 4096, act="relu"),
+                Linear(4096, 4096, act="relu"),
+                Linear(4096, num_classes, act=classifier_activation),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(F.flatten(x, 1))
+        return x
+
+
+def vgg11(**kw):
+    return VGG(11, **kw)
+
+
+def vgg13(**kw):
+    return VGG(13, **kw)
+
+
+def vgg16(**kw):
+    return VGG(16, **kw)
+
+
+def vgg19(**kw):
+    return VGG(19, **kw)
+
+
+class MobileNetV1(Layer):
+    """reference: hapi/vision/models/mobilenetv1.py — depthwise
+    separable stacks."""
+
+    def __init__(self, scale=1.0, num_classes=1000,
+                 classifier_activation="softmax"):
+        super().__init__()
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        self.stem = _ConvBNLayer(3, c(32), 3, 2)
+        blocks = []
+        for in_ch, out_ch, stride in cfg:
+            blocks.append(Sequential(
+                _ConvBNLayer(c(in_ch), c(in_ch), 3, stride,
+                             groups=c(in_ch)),
+                _ConvBNLayer(c(in_ch), c(out_ch), 1),
+            ))
+        self.blocks = LayerList(blocks)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(c(1024), num_classes,
+                             act=classifier_activation)
+
+    def forward(self, x):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        x = F.pool2d(x, pool_type="avg", global_pooling=True)
+        if self.num_classes > 0:
+            x = self.fc(F.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, in_c, out_c, stride, expand):
+        super().__init__()
+        hidden = in_c * expand
+        self.use_res = stride == 1 and in_c == out_c
+        seq = []
+        if expand != 1:
+            seq.append(_ConvBNLayer(in_c, hidden, 1, act="relu6"))
+        seq += [
+            _ConvBNLayer(hidden, hidden, 3, stride, groups=hidden,
+                         act="relu6"),
+            _ConvBNLayer(hidden, out_c, 1, act=None),
+        ]
+        self.body = Sequential(*seq)
+
+    def forward(self, x):
+        y = self.body(x)
+        return F.elementwise_add(x, y) if self.use_res else y
+
+
+class MobileNetV2(Layer):
+    """reference: hapi/vision/models/mobilenetv2.py — inverted
+    residuals."""
+
+    def __init__(self, scale=1.0, num_classes=1000,
+                 classifier_activation="softmax"):
+        super().__init__()
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        self.stem = _ConvBNLayer(3, c(32), 3, 2, act="relu6")
+        blocks = []
+        in_c = c(32)
+        for expand, ch, n, stride in cfg:
+            for i in range(n):
+                blocks.append(_InvertedResidual(
+                    in_c, c(ch), stride if i == 0 else 1, expand))
+                in_c = c(ch)
+        self.blocks = LayerList(blocks)
+        self.tail = _ConvBNLayer(in_c, c(1280), 1, act="relu6")
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(c(1280), num_classes,
+                             act=classifier_activation)
+
+    def forward(self, x):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        x = self.tail(x)
+        x = F.pool2d(x, pool_type="avg", global_pooling=True)
+        if self.num_classes > 0:
+            x = self.fc(F.flatten(x, 1))
+        return x
+
+
+def mobilenet_v2(scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)

@@ -1,0 +1,205 @@
+"""hapi vision transforms — numpy host-side preprocessing.
+
+Reference: python/paddle/incubate/hapi/vision/transforms/transforms.py
+(Compose:58, Resize:203, RandomResizedCrop:240, CenterCrop:366,
+RandomHorizontalFlip:408, RandomVerticalFlip:439, Normalize:470,
+Permute:512, GaussianNoise:553, Brightness/Contrast/Saturation/
+HueTransform, ColorJitter:754).  Images are HWC uint8/float numpy arrays
+(the reference's cv2 convention); Permute moves to the CHW float the
+models consume.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = [
+    "Compose", "Resize", "RandomResizedCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Normalize", "Permute",
+    "GaussianNoise", "BrightnessTransform", "ContrastTransform",
+    "SaturationTransform", "HueTransform", "ColorJitter",
+]
+
+
+def _resize(img, size):
+    """Nearest-neighbor resize (no cv2 in this environment)."""
+    if isinstance(size, numbers.Number):
+        h, w = img.shape[:2]
+        if h < w:
+            oh, ow = int(size), int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), int(size)
+    else:
+        oh, ow = size
+    ys = (np.arange(oh) * img.shape[0] / oh).astype(np.int64)
+    xs = (np.arange(ow) * img.shape[1] / ow).astype(np.int64)
+    return img[ys][:, xs]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, *data):
+        for t in self.transforms:
+            if isinstance(data, tuple) and len(data) > 1:
+                # transform the image, pass labels through
+                data = (t(data[0]),) + data[1:]
+            else:
+                data = (t(data[0] if isinstance(data, tuple) else data),)
+        return data if len(data) > 1 else data[0]
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return _resize(img, self.size)
+
+
+class RandomResizedCrop:
+    def __init__(self, output_size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (output_size, output_size) \
+            if isinstance(output_size, numbers.Number) else output_size
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                y = random.randint(0, h - ch)
+                x = random.randint(0, w - cw)
+                return _resize(img[y:y + ch, x:x + cw], self.size)
+        return _resize(img, self.size)
+
+
+class CenterCrop:
+    def __init__(self, output_size):
+        self.size = (output_size, output_size) \
+            if isinstance(output_size, numbers.Number) else output_size
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        ch, cw = self.size
+        y = max((h - ch) // 2, 0)
+        x = max((w - cw) // 2, 0)
+        return img[y:y + ch, x:x + cw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return img[:, ::-1] if random.random() < self.prob else img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return img[::-1] if random.random() < self.prob else img
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, img):
+        return (np.asarray(img, np.float32) - self.mean) / self.std
+
+
+class Permute:
+    """HWC -> CHW (+ optional to float), reference mode='CHW'."""
+
+    def __init__(self, mode="CHW", to_rgb=True):
+        self.mode = mode
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        return img.transpose(2, 0, 1) if self.mode == "CHW" else img
+
+
+class GaussianNoise:
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, img):
+        noise = np.random.normal(self.mean, self.std, img.shape)
+        return (np.asarray(img, np.float32) + noise).astype(np.float32)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(np.asarray(img, np.float32) * alpha, 0,
+                       255 if np.asarray(img).dtype == np.uint8 else None)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        f = np.asarray(img, np.float32)
+        return f * alpha + f.mean() * (1 - alpha)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        f = np.asarray(img, np.float32)
+        gray = f.mean(axis=-1, keepdims=True)
+        return f * alpha + gray * (1 - alpha)
+
+
+class HueTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        # cheap hue rotation: roll the channel axis fractionally
+        f = np.asarray(img, np.float32)
+        shift = np.random.uniform(-self.value, self.value)
+        return f * (1 - abs(shift)) + np.roll(f, 1, axis=-1) * abs(shift)
+
+
+class ColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self.ts[i](img)
+        return img

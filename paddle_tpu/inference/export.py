@@ -27,7 +27,8 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
-__all__ = ["export_stablehlo", "save_ptw", "load_ptw", "DTYPE_CODES"]
+__all__ = ["export_stablehlo", "export_train_step", "save_ptw", "load_ptw",
+           "DTYPE_CODES"]
 
 DTYPE_CODES = {
     "float32": 0, "float64": 1, "int32": 2, "int64": 3,
@@ -189,3 +190,93 @@ def export_stablehlo(dirname: str, inference_model_dir: str,
         for n in fetch_names:
             f.write(n + "\n")
     return stablehlo_text
+
+
+def export_train_step(dirname: str, program, feed_specs: Dict[str, tuple],
+                      fetch_list, scope=None) -> str:
+    """Export a TRAINING step as a self-contained StableHLO module for
+    the no-Python C++ trainer (native/train_demo.cpp; reference:
+    paddle/fluid/train/demo/demo_trainer.cc — train-from-desc without
+    Python).
+
+    The module's main is main(state..., feeds...) -> (fetches...,
+    new_state...): every optimizer/param/stat variable is an explicit
+    argument, so a C runtime can carry state across steps by feeding
+    each step's state outputs back into the matching inputs (matched by
+    name via meta.json's state_in/state_out lists).  Initial state goes
+    to state.ptw.  feed_specs: name -> (shape, dtype).
+    """
+    from ..executor import analyze_state
+    from ..framework import scope as scope_mod
+    from ..ops import registry
+    import jax
+
+    scope = scope or scope_mod._global_scope
+    block = program.global_block()
+    fetch_names = [getattr(f, "name", str(f)) for f in fetch_list]
+    feed = {n: np.zeros(tuple(shape), dtype=dt)
+            for n, (shape, dt) in feed_specs.items()}
+    ops = list(block.ops)
+    state_in, state_out, uses_rng, has_host_ops = analyze_state(
+        ops, block, feed, scope)
+    if has_host_ops:
+        raise ValueError("program contains host-side ops; not exportable")
+    if uses_rng:
+        raise ValueError(
+            "train program draws random numbers (dropout etc.); the C "
+            "trainer has no rng-state plumbing — export a dropout-free "
+            "program")
+    state_in = [n for n in state_in if n != "@RNG_KEY@"]
+    state_out = [n for n in state_out if n != "@RNG_KEY@"]
+    init_state = {n: np.asarray(scope.get(n)) for n in state_in}
+    feed_names = list(feed_specs)
+
+    def step_fn(*flat):
+        env = dict(zip(state_in, flat[:len(state_in)]))
+        env.update(zip(feed_names, flat[len(state_in):]))
+        for op_ in ops:
+            registry.run_op(op_, env, block)
+        fetched = tuple(env[n] for n in fetch_names)
+        new_state = tuple(env[n] for n in state_out)
+        return fetched + new_state
+
+    example = [init_state[n] for n in state_in] + \
+              [feed[n] for n in feed_names]
+    lowered = jax.jit(step_fn).lower(*example)
+    text = lowered.as_text(dialect="stablehlo")
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "model.stablehlo.mlir"), "w") as f:
+        f.write(text)
+    # no baked weights: ALL state is explicit module IO
+    save_ptw(os.path.join(dirname, "weights.ptw"), {}, [])
+    save_ptw(os.path.join(dirname, "state.ptw"), init_state, state_in)
+    all_inputs = state_in + feed_names
+    vals = dict(init_state)
+    vals.update(feed)
+    meta = {
+        "weight_order": [],
+        "input_names": all_inputs,
+        "input_shapes": {n: list(np.shape(vals[n])) for n in all_inputs},
+        "input_dtypes": {n: str(np.asarray(vals[n]).dtype)
+                         for n in all_inputs},
+        "output_names": fetch_names + state_out,
+        "state_in": state_in,
+        "state_out": state_out,
+        "feeds": feed_names,
+        "n_fetch": len(fetch_names),
+    }
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    with open(os.path.join(dirname, "meta.txt"), "w") as f:
+        f.write("PTMETA1\n")
+        f.write(f"inputs {len(all_inputs)}\n")
+        for n in all_inputs:
+            shape = list(np.shape(vals[n]))
+            code = DTYPE_CODES[str(np.asarray(vals[n]).dtype)]
+            dims = " ".join(str(d) for d in shape)
+            f.write(f"{n} {code} {len(shape)} {dims}\n".rstrip() + "\n")
+        f.write(f"outputs {len(fetch_names) + len(state_out)}\n")
+        for n in fetch_names + state_out:
+            f.write(n + "\n")
+    return text

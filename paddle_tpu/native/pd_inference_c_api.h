@@ -66,6 +66,11 @@ int PD_NativePredictorNumOutputs(PD_NativePredictor*);
 const char* PD_NativePredictorInputName(PD_NativePredictor*, int i);
 const char* PD_NativePredictorOutputName(PD_NativePredictor*, int i);
 
+/* Fill dtype/ndim/dims (data/nbytes untouched) for input i from the
+ * export metadata.  Returns 0, or -1 for an out-of-range index. */
+int PD_NativePredictorInputInfo(PD_NativePredictor*, int i,
+                                PD_NativeTensor* info);
+
 /* Run one inference.  `ins` are given in meta input order.  Fills up to
  * `max_out` entries of `outs` (data malloc'd by the library).  Returns
  * the number of outputs, or -1 on error. */

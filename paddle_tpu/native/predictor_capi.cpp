@@ -574,6 +574,17 @@ const char* PD_NativePredictorOutputName(PD_NativePredictor* p, int i) {
   return p->output_names[static_cast<size_t>(i)].c_str();
 }
 
+int PD_NativePredictorInputInfo(PD_NativePredictor* p, int i,
+                                PD_NativeTensor* info) {
+  if (i < 0 || i >= static_cast<int>(p->inputs.size())) return -1;
+  const MetaInput& mi = p->inputs[static_cast<size_t>(i)];
+  info->dtype = mi.dtype;
+  info->ndim = static_cast<int32_t>(mi.dims.size());
+  for (size_t d = 0; d < mi.dims.size() && d < PD_MAX_RANK; ++d)
+    info->dims[d] = mi.dims[d];
+  return 0;
+}
+
 namespace {
 int run_impl(PD_NativePredictor* p, const PD_NativeTensor* ins, int n_in,
              PD_NativeTensor* outs, int max_out);

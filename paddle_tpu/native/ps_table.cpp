@@ -16,10 +16,19 @@
 #include <cstdint>
 #include <cstring>
 #include <cmath>
+#include <atomic>
 #include <mutex>
 #include <random>
+#include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
 
 namespace {
 
@@ -319,6 +328,245 @@ void ps_sparse_import(int32_t tid, const int64_t* ids, const float* ws,
 void ps_set_lr(int32_t dense_tid, float lr) {
   if (auto* t = dense_at(dense_tid)) t->opt.lr = lr;
 }
+
+// ==========================================================================
+// Binary-framed data-plane server (reference:
+// operators/distributed/grpc/grpc_server.cc — the native RPC transport;
+// here: length-framed binary protocol, one handler thread per trainer
+// connection, no Python/GIL on the hot path).
+//
+// request : [u8 op][u16 name_len][name][u64 c1][payload1][u64 c2][payload2]
+//   op 1 PULL_DENSE  (c1=0)                      -> [u8 0][u64 n][floats]
+//   op 2 PUSH_DENSE  (c1 floats)                 -> [u8 0][u64 0]
+//   op 3 PULL_SPARSE (c1 int64 ids)              -> [u8 0][u64 n*dim][floats]
+//   op 4 PUSH_SPARSE (c1 int64 ids, c2 floats)   -> [u8 0][u64 0]
+//   op 5 INIT_DENSE  (c1 floats)                 -> [u8 0][u64 0]
+//   op 6 PUSH_DELTA  (c1 floats; param += delta) -> [u8 0][u64 0]
+// error reply: [u8 1][u64 0]
+// ==========================================================================
+namespace {
+
+struct NameEntry { int32_t kind; int32_t tid; };  // kind 0=dense 1=sparse
+std::unordered_map<std::string, NameEntry> g_names;
+std::mutex g_names_mu;
+
+// per-listener state: multiple PSServer instances in one process each
+// own their listener; stop() must only touch its own (a process-global
+// fd singleton would let instance A's stop kill instance B's server)
+struct Listener {
+  int fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+};
+std::mutex g_listeners_mu;
+std::vector<Listener*> g_listeners;  // parked forever once stopped
+
+// an adversarial/buggy client must not be able to make the server
+// allocate unbounded memory or abort: cap per-request element counts
+constexpr uint64_t kMaxElems = (1ull << 31);
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) return false;
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+
+bool reply(int fd, uint8_t status, const float* data, uint64_t n) {
+  if (!write_all(fd, &status, 1)) return false;
+  if (!write_all(fd, &n, 8)) return false;
+  if (n && !write_all(fd, data, n * sizeof(float))) return false;
+  return true;
+}
+
+void handle_conn_impl(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<int64_t> ids;
+  std::vector<float> floats, out;
+  for (;;) {
+    uint8_t op;
+    uint16_t name_len;
+    if (!read_exact(fd, &op, 1) || !read_exact(fd, &name_len, 2)) break;
+    std::string name(name_len, '\0');
+    if (name_len && !read_exact(fd, &name[0], name_len)) break;
+    uint64_t c1 = 0;
+    if (!read_exact(fd, &c1, 8)) break;
+    if (c1 > kMaxElems) break;  // malformed/hostile frame: drop the conn
+    bool want_ids = (op == 3 || op == 4);
+    bool ok_read = true;
+    if (want_ids) {
+      ids.resize(c1);
+      ok_read = !c1 || read_exact(fd, ids.data(), c1 * sizeof(int64_t));
+    } else {
+      floats.resize(c1);
+      ok_read = !c1 || read_exact(fd, floats.data(), c1 * sizeof(float));
+    }
+    if (!ok_read) break;
+    uint64_t c2 = 0;
+    if (op == 4) {
+      if (!read_exact(fd, &c2, 8)) break;
+      if (c2 > kMaxElems) break;
+      floats.resize(c2);
+      if (c2 && !read_exact(fd, floats.data(), c2 * sizeof(float))) break;
+    }
+    NameEntry ent{-1, -1};
+    {
+      std::lock_guard<std::mutex> g(g_names_mu);
+      auto it = g_names.find(name);
+      if (it != g_names.end()) ent = it->second;
+    }
+    bool ok = false;
+    switch (op) {
+      case 1: {  // PULL_DENSE
+        DenseTable* t = ent.kind == 0 ? dense_at(ent.tid) : nullptr;
+        if (t) {
+          out.resize(t->data.size());
+          t->pull(out.data());
+          ok = reply(fd, 0, out.data(), out.size());
+        }
+        break;
+      }
+      case 2: {  // PUSH_DENSE
+        DenseTable* t = ent.kind == 0 ? dense_at(ent.tid) : nullptr;
+        if (t && (uint64_t)t->data.size() == c1) {
+          t->push_grad(floats.data(), (int64_t)c1);
+          ok = reply(fd, 0, nullptr, 0);
+        }
+        break;
+      }
+      case 3: {  // PULL_SPARSE
+        SparseTable* t = ent.kind == 1 ? sparse_at(ent.tid) : nullptr;
+        if (t && c1 <= kMaxElems / (uint64_t)t->dim) {
+          out.resize(c1 * t->dim);
+          t->pull(ids.data(), (int64_t)c1, out.data());
+          ok = reply(fd, 0, out.data(), out.size());
+        }
+        break;
+      }
+      case 4: {  // PUSH_SPARSE
+        SparseTable* t = ent.kind == 1 ? sparse_at(ent.tid) : nullptr;
+        if (t && c2 == c1 * (uint64_t)t->dim) {
+          t->push_grad(ids.data(), (int64_t)c1, floats.data());
+          ok = reply(fd, 0, nullptr, 0);
+        }
+        break;
+      }
+      case 5: {  // INIT_DENSE
+        DenseTable* t = ent.kind == 0 ? dense_at(ent.tid) : nullptr;
+        if (t) {
+          t->init(floats.data(), (int64_t)c1);
+          ok = reply(fd, 0, nullptr, 0);
+        }
+        break;
+      }
+      case 6: {  // PUSH_DELTA (GEO-SGD: param += delta, no optimizer)
+        DenseTable* t = ent.kind == 0 ? dense_at(ent.tid) : nullptr;
+        if (t && (uint64_t)t->data.size() == c1) {
+          std::lock_guard<std::mutex> g(t->mu_);
+          for (uint64_t i = 0; i < c1; ++i) t->data[i] += floats[i];
+          ok = reply(fd, 0, nullptr, 0);
+        }
+        break;
+      }
+      default:
+        break;
+    }
+    if (!ok && !reply(fd, 1, nullptr, 0)) break;
+  }
+  ::close(fd);
+}
+
+void handle_conn(int fd) {
+  // a detached thread must never let an exception escape
+  // (std::terminate would abort the whole PS process)
+  try {
+    handle_conn_impl(fd);
+  } catch (...) {
+    ::close(fd);
+  }
+}
+
+void accept_loop(Listener* L) {
+  for (;;) {
+    int fd = ::accept(L->fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (L->stop.load() || errno == EBADF || errno == EINVAL) return;
+      ::usleep(10000);  // transient (EMFILE/EINTR): back off, no spin
+      continue;
+    }
+    if (L->stop.load()) {
+      ::close(fd);
+      return;
+    }
+    std::thread(handle_conn, fd).detach();
+  }
+}
+
+}  // namespace
+
+void ps_bind_name(const char* name, int32_t kind, int32_t tid) {
+  std::lock_guard<std::mutex> g(g_names_mu);
+  g_names[std::string(name)] = NameEntry{kind, tid};
+}
+
+int32_t ps_serve_start(const char* host, int32_t port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr = host && *host ? inet_addr(host) : htonl(INADDR_ANY);
+  if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) < 0 ||
+      ::listen(fd, 64) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, (sockaddr*)&addr, &len);
+  auto* L = new Listener();
+  L->fd = fd;
+  L->port = (int)ntohs(addr.sin_port);
+  {
+    std::lock_guard<std::mutex> g(g_listeners_mu);
+    g_listeners.push_back(L);
+  }
+  std::thread(accept_loop, L).detach();
+  return (int32_t)L->port;
+}
+
+// stop one listener by its bound port; port <= 0 stops them all.
+// Listener structs are parked (never freed): the detached accept thread
+// may still be reading its stop flag.
+void ps_serve_stop_port(int32_t port) {
+  std::lock_guard<std::mutex> g(g_listeners_mu);
+  for (Listener* L : g_listeners) {
+    if (L->stop.load()) continue;
+    if (port > 0 && L->port != port) continue;
+    L->stop.store(true);
+    ::shutdown(L->fd, SHUT_RDWR);
+    ::close(L->fd);
+  }
+}
+
+void ps_serve_stop() { ps_serve_stop_port(0); }
 
 void ps_reset_all() {
   // Tables are parked, not deleted: a server handler thread may still be

@@ -1,0 +1,226 @@
+// No-Python training demo (reference: paddle/fluid/train/demo/
+// demo_trainer.cc:1 — load a saved program desc and train it from C++
+// without Python).
+//
+// TPU-native equivalent: the TRAIN STEP is exported as a StableHLO
+// module (inference/export.py export_train_step) whose main is
+//   main(state..., feeds...) -> (fetches..., new_state...)
+// — every parameter / optimizer moment is explicit module IO.  This
+// binary loads the module through the same PJRT C-API runtime the
+// native predictor uses (predictor_capi.cpp), seeds the state from
+// state.ptw, and drives the training loop in pure C++: feed a batch,
+// run one step, carry the state outputs back into the state inputs.
+// No Python anywhere in the loop.
+//
+// Usage: train_demo <export_dir> <pjrt_plugin.so> [steps] [options_file]
+//   options_file (optional): newline-separated PJRT create-options
+//   ("name int N" / "name str S"), for plugins that need them.
+//   Feeds come from <export_dir>/data.ptw when present, else the demo
+//   synthesizes deterministic batches.
+//
+// Build (see tests/test_train_demo.py):
+//   g++ -O3 -std=c++17 train_demo.cpp predictor_capi.cpp -ldl \
+//       -I<tensorflow include> -o train_demo
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "pd_inference_c_api.h"
+
+namespace {
+
+struct PtwTensor {
+  int dtype = 0;
+  std::vector<int64_t> dims;
+  std::vector<char> data;
+};
+
+bool read_ptw_file(const std::string& path,
+                   std::map<std::string, PtwTensor>* out,
+                   std::vector<std::string>* order) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  char magic[4];
+  f.read(magic, 4);
+  if (std::memcmp(magic, "PTW1", 4) != 0) return false;
+  uint32_t n = 0;
+  f.read((char*)&n, 4);
+  for (uint32_t i = 0; i < n; ++i) {
+    uint16_t nl = 0;
+    f.read((char*)&nl, 2);
+    std::string name(nl, '\0');
+    f.read(&name[0], nl);
+    uint8_t code = 0, ndim = 0;
+    f.read((char*)&code, 1);
+    f.read((char*)&ndim, 1);
+    PtwTensor t;
+    t.dtype = code;
+    for (int d = 0; d < ndim; ++d) {
+      uint32_t dim = 0;
+      f.read((char*)&dim, 4);
+      t.dims.push_back((int64_t)dim);
+    }
+    uint64_t nb = 0;
+    f.read((char*)&nb, 8);
+    t.data.resize(nb);
+    f.read(t.data.data(), (std::streamsize)nb);
+    if (!f) return false;
+    (*out)[name] = std::move(t);
+    if (order) order->push_back(name);
+  }
+  return true;
+}
+
+size_t dtype_size(int code) {
+  switch (code) {
+    case PD_FLOAT64: case PD_INT64: return 8;
+    case PD_BFLOAT16: case PD_FLOAT16: return 2;
+    case PD_UINT8: case PD_INT8: case PD_BOOL: return 1;
+    default: return 4;
+  }
+}
+
+// deterministic synthetic batch: uniforms for float feeds, small ints
+// for integer feeds (labels)
+void fill_synthetic(PD_NativeTensor* t, uint64_t* rng_state) {
+  int64_t n = 1;
+  for (int i = 0; i < t->ndim; ++i) n *= t->dims[i];
+  auto next = [&]() {
+    uint64_t x = *rng_state += 0x9e3779b97f4a7c15ull;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+    return x ^ (x >> 31);
+  };
+  if (t->dtype == PD_FLOAT32) {
+    float* p = (float*)t->data;
+    for (int64_t i = 0; i < n; ++i)
+      p[i] = (float)((next() >> 11) * (1.0 / 9007199254740992.0));
+  } else if (t->dtype == PD_INT64) {
+    int64_t* p = (int64_t*)t->data;
+    for (int64_t i = 0; i < n; ++i) p[i] = (int64_t)(next() % 10);
+  } else if (t->dtype == PD_INT32) {
+    int32_t* p = (int32_t*)t->data;
+    for (int64_t i = 0; i < n; ++i) p[i] = (int32_t)(next() % 10);
+  } else {
+    std::memset(t->data, 0, n * dtype_size(t->dtype));
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <export_dir> <pjrt_plugin.so> [steps]\n",
+                 argv[0]);
+    return 2;
+  }
+  std::string dir = argv[1];
+  const char* plugin = argv[2];
+  int steps = argc > 3 ? std::atoi(argv[3]) : 10;
+  std::string options;
+  if (argc > 4) {
+    std::ifstream of(argv[4]);
+    std::stringstream ss;
+    ss << of.rdbuf();
+    options = ss.str();
+  }
+
+  PD_NativePredictor* pred =
+      PD_NativePredictorCreate(dir.c_str(), plugin, options.c_str());
+  if (!pred) {
+    std::fprintf(stderr, "create failed: %s\n", PD_NativeLastError());
+    return 1;
+  }
+  int n_in = PD_NativePredictorNumInputs(pred);
+  int n_out = PD_NativePredictorNumOutputs(pred);
+
+  // initial state
+  std::map<std::string, PtwTensor> state;
+  if (!read_ptw_file(dir + "/state.ptw", &state, nullptr)) {
+    std::fprintf(stderr, "missing %s/state.ptw (export with "
+                         "export_train_step)\n", dir.c_str());
+    return 1;
+  }
+  // optional real data
+  std::map<std::string, PtwTensor> data;
+  read_ptw_file(dir + "/data.ptw", &data, nullptr);
+
+  // input metadata from the predictor
+  std::vector<PD_NativeTensor> ins(n_in);
+  std::vector<std::vector<char>> in_bufs(n_in);
+  std::vector<std::string> in_names(n_in);
+  uint64_t rng = 0x1234567ull;
+  for (int i = 0; i < n_in; ++i) {
+    PD_NativeTensor t;
+    if (PD_NativePredictorInputInfo(pred, i, &t) != 0) {
+      std::fprintf(stderr, "input info %d failed\n", i);
+      return 1;
+    }
+    in_names[i] = PD_NativePredictorInputName(pred, i);
+    int64_t n = 1;
+    for (int d = 0; d < t.ndim; ++d) n *= t.dims[d];
+    in_bufs[i].resize((size_t)n * dtype_size(t.dtype));
+    t.data = in_bufs[i].data();
+    auto it = state.find(in_names[i]);
+    if (it != state.end()) {
+      std::memcpy(t.data, it->second.data.data(),
+                  std::min(in_bufs[i].size(), it->second.data.size()));
+    }
+    ins[i] = t;
+  }
+
+  std::vector<PD_NativeTensor> outs(n_out);
+  std::vector<std::string> out_names(n_out);
+  for (int i = 0; i < n_out; ++i)
+    out_names[i] = PD_NativePredictorOutputName(pred, i);
+
+  std::printf("train_demo: %d inputs, %d outputs, %d steps\n",
+              n_in, n_out, steps);
+  for (int step = 0; step < steps; ++step) {
+    // fill feed inputs (non-state): real data if provided, else synthetic
+    for (int i = 0; i < n_in; ++i) {
+      if (state.count(in_names[i])) continue;  // state slot: carried
+      auto it = data.find(in_names[i]);
+      if (it != data.end()) {
+        std::memcpy(ins[i].data, it->second.data.data(),
+                    std::min(in_bufs[i].size(), it->second.data.size()));
+      } else {
+        fill_synthetic(&ins[i], &rng);
+      }
+    }
+    int got = PD_NativePredictorRun(pred, ins.data(), n_in, outs.data(),
+                                    n_out);
+    if (got < 0) {
+      std::fprintf(stderr, "run failed at step %d: %s\n", step,
+                   PD_NativeLastError());
+      return 1;
+    }
+    // loss = first output (scalar-ish): print its first element
+    if (got > 0 && outs[0].dtype == PD_FLOAT32 && outs[0].data) {
+      std::printf("step %d loss %.6f\n", step, ((float*)outs[0].data)[0]);
+    }
+    // carry state: copy matching outputs back into state inputs
+    for (int o = 0; o < got; ++o) {
+      for (int i = 0; i < n_in; ++i) {
+        if (out_names[o] == in_names[i] && outs[o].data) {
+          int64_t n = 1;
+          for (int d = 0; d < outs[o].ndim; ++d) n *= outs[o].dims[d];
+          std::memcpy(ins[i].data, outs[o].data,
+                      std::min(in_bufs[i].size(),
+                               (size_t)n * dtype_size(outs[o].dtype)));
+        }
+      }
+    }
+    for (int o = 0; o < got; ++o) PD_NativeTensorFree(&outs[o]);
+  }
+  std::printf("train_demo: done\n");
+  PD_NativePredictorDestroy(pred);
+  return 0;
+}

@@ -1,0 +1,117 @@
+"""fluid.nets — prebuilt composite network pieces.
+
+Reference: python/paddle/fluid/nets.py:1 (simple_img_conv_pool:29,
+img_conv_group:141, sequence_conv_pool:253, glu:321,
+scaled_dot_product_attention:372).  Same five compositions over the
+fluid.layers surface; on TPU each composition still lowers into one XLA
+program through the executor, and scaled_dot_product_attention reshapes
+onto the head layout the fused flash-attention kernel expects.
+"""
+from __future__ import annotations
+
+from . import layers
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    """conv2d + pool2d (reference: nets.py:29)."""
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding, dilation=conv_dilation,
+        groups=conv_groups, param_attr=param_attr, bias_attr=bias_attr,
+        act=act)
+    return layers.pool2d(
+        input=conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """Chain of conv2d (+BN, +dropout) closed by a pool2d (reference:
+    nets.py:141 — the VGG building block)."""
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _expand(obj):
+        if isinstance(obj, (list, tuple)):
+            assert len(obj) == len(conv_num_filter)
+            return list(obj)
+        return [obj] * len(conv_num_filter)
+
+    conv_padding = _expand(conv_padding)
+    conv_filter_size = _expand(conv_filter_size)
+    param_attr = _expand(param_attr)
+    conv_with_batchnorm = _expand(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _expand(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None  # activation moves after the BN
+        tmp = layers.conv2d(
+            input=tmp, num_filters=conv_num_filter[i],
+            filter_size=conv_filter_size[i], padding=conv_padding[i],
+            param_attr=param_attr[i], act=local_conv_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+    return layers.pool2d(input=tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    """sequence_conv + sequence_pool over an LoD input (reference:
+    nets.py:253 — the text-CNN block)."""
+    conv_out = layers.sequence_conv(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, bias_attr=bias_attr, act=act)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    """Gated Linear Unit: split in two along dim, a * sigmoid(b)
+    (reference: nets.py:321)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head scaled dot-product attention over (batch, seq, hidden)
+    tensors (reference: nets.py:372).  Head split/merge are reshapes +
+    transposes; the inner attention is the fused_multihead_attention op,
+    i.e. the Pallas flash kernel on TPU (with in-kernel probs dropout
+    when dropout_rate > 0)."""
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError(
+            "the hidden size of queries and keys must match")
+    if keys.shape[-1] % num_heads != 0 or values.shape[-1] % num_heads != 0:
+        raise ValueError("hidden size must be divisible by num_heads")
+
+    def split_heads(x):
+        if num_heads == 1:
+            return layers.unsqueeze(x, [1])
+        b, s, h = x.shape
+        x = layers.reshape(x, [b, s, num_heads, h // num_heads])
+        return layers.transpose(x, [0, 2, 1, 3])
+
+    q = split_heads(queries)
+    k = split_heads(keys)
+    v = split_heads(values)
+    d_key = queries.shape[-1] // num_heads
+    ctx = layers.fused_multihead_attention(
+        q, k, v, scale=d_key ** -0.5, dropout_rate=dropout_rate)
+    if num_heads == 1:
+        return layers.squeeze(ctx, [1])
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    b, s = ctx.shape[0], ctx.shape[1]
+    return layers.reshape(ctx, [b, s, int(values.shape[-1])])

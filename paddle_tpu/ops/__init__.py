@@ -32,3 +32,4 @@ from . import fused_ops  # noqa: F401
 from . import vision_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
+from . import extra_ops  # noqa: F401

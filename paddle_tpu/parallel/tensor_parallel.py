@@ -65,3 +65,43 @@ def megatron_mlp_rules(fc_names: Sequence[str], axis: str = "mp"
     for i, name in enumerate(fc_names):
         rules[name] = (None, axis) if i % 2 == 0 else (axis, None)
     return rules
+
+
+def attention_head_rules(q_w, k_w, v_w, out_w, axis: str = "mp"
+                         ) -> Dict[str, Sequence[Optional[str]]]:
+    """Megatron attention sharding: the Q/K/V projections are
+    column-parallel (heads split across ``axis``), the output projection
+    is row-parallel — one allreduce per attention block, inserted by
+    GSPMD.  Pass the four weight parameter names (regexes allowed)."""
+    rules: Dict[str, Sequence[Optional[str]]] = {}
+    for name in (q_w, k_w, v_w):
+        rules[name] = (None, axis)
+    rules[out_w] = (axis, None)
+    return rules
+
+
+def embedding_rules(emb_w, axis: str = "mp", mode: str = "vocab"
+                    ) -> Dict[str, Sequence[Optional[str]]]:
+    """Embedding-table partition: ``mode='vocab'`` shards the vocabulary
+    dim (Megatron VocabParallelEmbedding — GSPMD masks and allreduces
+    the gather); ``mode='hidden'`` shards the hidden dim (activation
+    stays sharded into the first column-parallel matmul)."""
+    if mode == "vocab":
+        return {emb_w: (axis, None)}
+    if mode == "hidden":
+        return {emb_w: (None, axis)}
+    raise ValueError(f"mode must be 'vocab' or 'hidden', got {mode!r}")
+
+
+def transformer_block_rules(prefix: str, axis: str = "mp"
+                            ) -> Dict[str, Sequence[Optional[str]]]:
+    """Whole-block rule set for a standard transformer layer whose
+    parameters follow the ``{prefix}_{q,k,v,out,fc1,fc2}.w_0`` naming:
+    attention heads + MLP sharded over one mesh axis, two collectives
+    per layer total (the Megatron recipe)."""
+    rules = attention_head_rules(
+        f"{prefix}_q\\.w_0", f"{prefix}_k\\.w_0", f"{prefix}_v\\.w_0",
+        f"{prefix}_out\\.w_0", axis)
+    rules[f"{prefix}_fc1\\.w_0"] = (None, axis)
+    rules[f"{prefix}_fc2\\.w_0"] = (axis, None)
+    return rules

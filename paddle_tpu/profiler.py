@@ -134,6 +134,13 @@ def disable_profiler(sorted_key: Optional[str] = None,
     summary = summarize(events, sorted_key or "default")
     if summary:
         print(_format_summary(summary))
+    # allocator stats line (SURVEY §2.9 #9 — allocator_facade stat shim)
+    try:
+        from .utils.memory import memory_summary
+
+        print("[memory] " + memory_summary(0))
+    except Exception:
+        pass
     return summary
 
 

@@ -1,0 +1,65 @@
+"""Memory/allocator statistics shim (SURVEY §2.9 #9).
+
+Reference: paddle/fluid/memory/allocation/allocator_facade.h and the
+stat surface behind FLAGS_fraction_of_gpu_memory_to_use.  On TPU the
+allocator is XLA's BFC — we expose its PJRT per-device statistics when
+the backend reports them, and fall back to an exact census of this
+client's live device arrays otherwise (the tunnel/CPU backends do not
+export allocator counters).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+def memory_stats(device_id: int = 0) -> Dict[str, int]:
+    """Allocator statistics for one device.
+
+    Returns a dict with at least ``bytes_in_use`` and ``source``:
+    * source="pjrt": the backend's own allocator counters
+      (bytes_in_use, peak_bytes_in_use, bytes_limit, ... as reported).
+    * source="live_arrays": summed nbytes of this client's live
+      jax.Arrays on the device — exact for framework-held buffers, blind
+      to XLA scratch/temporaries.
+    """
+    import jax
+
+    devs = jax.devices()
+    if device_id >= len(devs):
+        raise ValueError(f"device {device_id} not present ({len(devs)} found)")
+    dev = devs[device_id]
+    stats: Optional[dict] = None
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        out = {k: int(v) for k, v in stats.items()}
+        out["source"] = "pjrt"
+        return out
+    total = 0
+    count = 0
+    for arr in jax.live_arrays():
+        try:
+            arr_devs = arr.devices() if callable(getattr(arr, "devices", None)) \
+                else {getattr(arr, "device", None)}
+        except Exception:
+            continue
+        if dev in arr_devs:
+            total += int(arr.nbytes)
+            count += 1
+    return {"bytes_in_use": total, "num_live_arrays": count,
+            "source": "live_arrays"}
+
+
+def memory_summary(device_id: int = 0) -> str:
+    """Human-readable one-liner for logs / the profiler report."""
+    s = memory_stats(device_id)
+    gb = s.get("bytes_in_use", 0) / (1 << 30)
+    if s["source"] == "pjrt":
+        peak = s.get("peak_bytes_in_use", 0) / (1 << 30)
+        limit = s.get("bytes_limit", 0) / (1 << 30)
+        return (f"device {device_id}: {gb:.3f} GiB in use "
+                f"(peak {peak:.3f}, limit {limit:.3f}) [pjrt]")
+    return (f"device {device_id}: {gb:.3f} GiB across "
+            f"{s.get('num_live_arrays', 0)} live arrays [live_arrays]")

@@ -172,3 +172,84 @@ def test_wide_deep_on_parameter_server():
     finally:
         server.stop()
         runtime.clear()
+
+
+def test_recognize_digits_conv_with_nets():
+    """The book's recognize_digits conv model built from
+    fluid.nets.simple_img_conv_pool (reference:
+    tests/book/test_recognize_digits.py convolutional_neural_network) —
+    trains to a falling loss and round-trips save/load_inference_model."""
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 8
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        conv1 = fluid.nets.simple_img_conv_pool(
+            img, num_filters=8, filter_size=5, pool_size=2, pool_stride=2,
+            act="relu")
+        conv2 = fluid.nets.simple_img_conv_pool(
+            conv1, num_filters=16, filter_size=5, pool_size=2, pool_stride=2,
+            act="relu")
+        logits = fluid.layers.fc(conv2, 10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(logits, label))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(64, 1, 28, 28).astype(np.float32)
+    ys = rng.randint(0, 10, (64, 1)).astype(np.int64)
+    exe = fluid.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(
+            main, feed={"img": xs, "label": ys},
+            fetch_list=[loss.name])[0]).ravel()[0]) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+        tmp = tempfile.mkdtemp()
+        fluid.io.save_inference_model(tmp, ["img"], [logits], exe,
+                                      main_program=main)
+        prog, feeds, fetches = fluid.io.load_inference_model(tmp, exe)
+        out = exe.run(prog, feed={feeds[0]: xs[:4]},
+                      fetch_list=[f.name for f in fetches])[0]
+        assert np.asarray(out).shape == (4, 10)
+
+
+def test_glu_and_img_conv_group():
+    """fluid.nets.glu halves the channel dim; img_conv_group stacks
+    conv(+bn) and pools (reference: nets.py:141,321)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8, 6, 6])
+        g = fluid.nets.glu(x, dim=1)
+        grp = fluid.nets.img_conv_group(
+            x, conv_num_filter=[8, 8], pool_size=2, pool_stride=2,
+            conv_act="relu", conv_with_batchnorm=[True, False])
+    rng = np.random.RandomState(0)
+    exe = fluid.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        go, gr = exe.run(main, feed={"x": rng.rand(2, 8, 6, 6).astype(np.float32)},
+                         fetch_list=[g.name, grp.name])
+    a = np.asarray(go)
+    assert a.shape == (2, 4, 6, 6)
+    # glu = a * sigmoid(b)
+    xs = rng.rand(2, 8, 6, 6)  # regenerate same stream
+    rng2 = np.random.RandomState(0)
+    xv = rng2.rand(2, 8, 6, 6).astype(np.float32)
+    ref = xv[:, :4] / (1 + np.exp(-xv[:, 4:]))
+    np.testing.assert_allclose(a, ref, atol=1e-5)
+    assert np.asarray(gr).shape == (2, 8, 3, 3)

@@ -323,3 +323,70 @@ def test_check_api_compat_tool(tmp_path):
                                      "custom_grad_maker": False}
     bad, ok = tool.diff_specs(spec, newer2)
     assert not bad and any("brand_new_op" in o for o in ok)
+
+
+def test_dataset_long_tail_shapes():
+    """flowers / wmt14 / imikolov / sentiment / voc2012 readers yield
+    reference-shaped samples (reference: python/paddle/dataset/)."""
+    import numpy as np
+
+    from paddle_tpu import dataset
+
+    img, lbl = next(dataset.flowers.train()())
+    assert img.shape == (3, 224, 224) and img.dtype == np.float32
+    assert 0 <= lbl < 102
+
+    src, trg_in, trg_next = next(dataset.wmt14.train(1000)())
+    assert trg_in[0] == 0 and trg_next[-1] == 1
+    assert len(trg_in) == len(trg_next)
+
+    word_idx = dataset.imikolov.build_dict()
+    gram = next(dataset.imikolov.train(word_idx, 5)())
+    assert len(gram) == 5
+    seqs = next(dataset.imikolov.train(
+        word_idx, 5, dataset.imikolov.DataType.SEQ)())
+    assert len(seqs) == 2 and len(seqs[0]) == len(seqs[1])
+
+    words, label = next(dataset.sentiment.train()())
+    assert label in (0, 1) and len(words) >= 8
+    assert len(dataset.sentiment.get_word_dict()) == 300
+
+    img, mask = next(dataset.voc2012.train()())
+    assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+    assert mask.max() <= 255 and (mask == 255).any()
+
+
+def test_sentiment_trainable():
+    """The synthetic sentiment set carries real signal: a bag-of-words
+    classifier reaches high train accuracy."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import dataset
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    vocab = len(dataset.sentiment.get_word_dict())
+    samples = list(dataset.sentiment.train()())[:200]
+    feats = np.zeros((len(samples), vocab), np.float32)
+    labels = np.zeros((len(samples), 1), np.int64)
+    for i, (ws, l) in enumerate(samples):
+        feats[i, ws] = 1.0
+        labels[i] = l
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [vocab])
+        y = fluid.layers.data("y", [1], dtype="int64")
+        logits = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), y)
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    exe = fluid.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for _ in range(30):
+            out = exe.run(main, feed={"x": feats, "y": labels},
+                          fetch_list=[loss.name, acc.name])
+        assert float(np.asarray(out[1])) > 0.9

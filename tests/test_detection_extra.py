@@ -207,3 +207,24 @@ def test_retinanet_output_and_box_decoder():
             {"box_clip": 4.0}, {"DecodeBox": 1, "OutputAssignBox": 1})
     assign = np.asarray(d["OutputAssignBox"][0])
     np.testing.assert_allclose(assign, prior, atol=1e-4)
+
+
+def test_mine_hard_examples_max_negative():
+    """Hard-negative mining keeps the highest-loss negatives up to
+    neg_pos_ratio * positives (reference: mine_hard_examples_op.cc)."""
+    import numpy as np
+
+    from paddle_tpu.ops.registry import eager_call
+
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.3]], np.float32)
+    match = np.array([[2, -1, -1, -1]], np.int32)  # one positive, 3 negs
+    dist = np.zeros((1, 4), np.float32)
+    outs = eager_call(
+        "mine_hard_examples",
+        {"ClsLoss": [cls_loss], "MatchIndices": [match], "MatchDist": [dist]},
+        {"neg_pos_ratio": 2.0, "neg_dist_threshold": 0.5,
+         "mining_type": "max_negative"},
+        {"NegIndices": 1, "NegIndices.lens": 1, "UpdatedMatchIndices": 1})
+    negs = np.asarray(outs["NegIndices"][0]).ravel()
+    # 1 positive * ratio 2 -> two hardest negatives: idx 1 (0.9), 2 (0.5)
+    assert sorted(negs.tolist()) == [1, 2]

@@ -1,0 +1,61 @@
+"""Go binding (go/paddle/ — reference: the upstream cgo client).
+
+With a Go toolchain: go vet + go build.  Without one (this build
+image): validate the cgo surface references only symbols the C header
+exports, so the package compiles the day a toolchain is present.
+"""
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GO_DIR = os.path.join(REPO, "go", "paddle")
+HEADER = os.path.join(REPO, "paddle_tpu", "native", "pd_inference_c_api.h")
+
+
+def _go_sources():
+    return [os.path.join(GO_DIR, f) for f in os.listdir(GO_DIR)
+            if f.endswith(".go")]
+
+
+def test_cgo_symbols_exist_in_header():
+    header = open(HEADER).read()
+    used = set()
+    for src in _go_sources():
+        for m in re.finditer(r"C\.(PD_\w+)", open(src).read()):
+            used.add(m.group(1))
+    assert used, "no cgo calls found"
+    missing = [s for s in used if s not in header]
+    assert not missing, f"cgo references missing from header: {missing}"
+
+
+def test_go_package_shape():
+    files = {os.path.basename(f) for f in _go_sources()}
+    assert {"predictor.go", "tensor.go"} <= files
+    for src in _go_sources():
+        assert open(src).read().startswith("// Package paddle") or \
+            "package paddle" in open(src).read()[:400]
+
+
+@pytest.mark.skipif(shutil.which("go") is None,
+                    reason="no Go toolchain in this image")
+def test_go_build(tmp_path):
+    from paddle_tpu.native.build import _tf_include_dir
+
+    inc = _tf_include_dir()
+    lib = str(tmp_path / "libpd_native.so")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+         os.path.join(REPO, "paddle_tpu", "native", "predictor_capi.cpp")]
+        + ([f"-I{inc}"] if inc else []) + ["-ldl", "-o", lib],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["CGO_CFLAGS"] = f"-I{os.path.join(REPO, 'paddle_tpu', 'native')}"
+    env["CGO_LDFLAGS"] = f"-L{tmp_path} -lpd_native"
+    env.setdefault("GOCACHE", str(tmp_path / "gocache"))
+    r = subprocess.run(["go", "build", "./..."], cwd=os.path.join(REPO, "go"),
+                       env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr

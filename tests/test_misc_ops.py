@@ -197,3 +197,53 @@ def test_similarity_focus_matches_reference_greedy():
                           {"axis": axis, "indexes": indexes}, {"Out": 1})
         np.testing.assert_array_equal(np.asarray(outs["Out"][0]),
                                       ref(x, axis, indexes), err_msg=f"axis={axis}")
+
+
+def test_precision_recall_op():
+    """Macro/micro P/R/F1 with state accumulation (reference:
+    metrics/precision_recall_op.h)."""
+    import numpy as np
+
+    from paddle_tpu.ops.registry import eager_call
+
+    idx = np.array([0, 1, 1, 2], np.int64)[:, None]
+    lbl = np.array([0, 1, 2, 2], np.int64)[:, None]
+    outs = eager_call(
+        "precision_recall",
+        {"Indices": [idx], "Labels": [lbl]},
+        {"class_number": 3},
+        {"BatchMetrics": 1, "AccumMetrics": 1, "AccumStatesInfo": 1})
+    bm = np.asarray(outs["BatchMetrics"][0])
+    # class0: tp=1 fp=0 fn=0 -> P=R=1; class1: tp=1 fp=1 fn=0 -> P=.5 R=1
+    # class2: tp=1 fp=0 fn=1 -> P=1 R=.5
+    np.testing.assert_allclose(bm[0], (1 + 0.5 + 1) / 3, atol=1e-6)  # macroP
+    np.testing.assert_allclose(bm[1], (1 + 1 + 0.5) / 3, atol=1e-6)  # macroR
+    np.testing.assert_allclose(bm[3], 3 / 4, atol=1e-6)  # microP
+    st = np.asarray(outs["AccumStatesInfo"][0])
+    assert st.shape == (3, 4) and st[:, 0].sum() == 3
+    # accumulation: feed states back in
+    outs2 = eager_call(
+        "precision_recall",
+        {"Indices": [idx], "Labels": [lbl], "StatesInfo": [st]},
+        {"class_number": 3},
+        {"BatchMetrics": 1, "AccumMetrics": 1, "AccumStatesInfo": 1})
+    st2 = np.asarray(outs2["AccumStatesInfo"][0])
+    np.testing.assert_allclose(st2, 2 * st)
+
+
+def test_positive_negative_pair_op():
+    import numpy as np
+
+    from paddle_tpu.ops.registry import eager_call
+
+    score = np.array([0.9, 0.2, 0.5, 0.6], np.float32)[:, None]
+    label = np.array([1.0, 0.0, 1.0, 0.0], np.float32)[:, None]
+    qid = np.array([0, 0, 1, 1], np.int64)[:, None]
+    outs = eager_call(
+        "positive_negative_pair",
+        {"Score": [score], "Label": [label], "QueryID": [qid]}, {},
+        {"PositivePair": 1, "NegativePair": 1, "NeutralPair": 1})
+    # q0: (0.9,1) vs (0.2,0): correct; q1: (0.5,1) vs (0.6,0): wrong
+    assert float(np.asarray(outs["PositivePair"][0])) == 1.0
+    assert float(np.asarray(outs["NegativePair"][0])) == 1.0
+    assert float(np.asarray(outs["NeutralPair"][0])) == 0.0

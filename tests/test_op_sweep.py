@@ -1094,6 +1094,234 @@ def _run_static(prog, feed, fetch):
         scope_mod._global_scope = prev
 
 
+# --------------------------------------------------------------------------
+# round-3 op long tail (ops/extra_ops.py)
+# --------------------------------------------------------------------------
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+_ax = fn32(3, 4)
+SPECS["allclose"] = S(
+    {"Input": _ax, "Other": _ax + 1e-7}, {"rtol": 1e-5, "atol": 1e-6},
+    ref=lambda ins, a: {"Out": np.asarray(
+        np.allclose(ins["Input"], ins["Other"], rtol=1e-5, atol=1e-6))})
+SPECS["diag"] = S({"Diagonal": fn32(5)},
+                  ref=lambda ins, a: {"Out": np.diag(ins["Diagonal"])})
+SPECS["diag_embed"] = S(
+    {"Input": fn32(2, 4)}, {"offset": 0, "dim1": -2, "dim2": -1},
+    ref=lambda ins, a: {"Out": np.stack([np.diag(r) for r in ins["Input"]])})
+SPECS["histogram"] = S(
+    {"X": f32(40) * 10}, {"bins": 5, "min": 0.0, "max": 10.0},
+    ref=lambda ins, a: {"Out": np.histogram(
+        ins["X"], bins=5, range=(0.0, 10.0))[0].astype(np.int64)})
+SPECS["fill"] = S(
+    {}, {"shape": [2, 3], "value": [1., 2., 3., 4., 5., 6.], "dtype": 5},
+    ref=lambda ins, a: {"Out": np.arange(1., 7., dtype=np.float32)
+                        .reshape(2, 3)})
+SPECS["fill_zeros_like2"] = S(
+    {"X": fn32(2, 3)}, {"dtype": 5},
+    ref=lambda ins, a: {"Out": np.zeros((2, 3), np.float32)})
+_mh_x, _mh_y = fn32(3, 4), (RNG.rand(3, 4) > 0.5).astype(np.float32)
+SPECS["modified_huber_loss"] = S(
+    {"X": _mh_x, "Y": _mh_y}, outs=("Out", "IntermediateVal"),
+    ref=lambda ins, a: (lambda v: {
+        "IntermediateVal": v,
+        "Out": np.where(v < -1, -4 * v,
+                        np.where(v < 1, (1 - v) ** 2, 0.0)).astype(np.float32)
+    })(ins["X"] * (2 * ins["Y"] - 1)),
+    grad=["X"], grad_tol=5e-2)
+SPECS["proximal_gd"] = S(
+    {"Param": fn32(4), "Grad": fn32(4),
+     "LearningRate": np.asarray([0.1], np.float32)},
+    {"l1": 0.01, "l2": 0.02}, outs=("ParamOut",),
+    ref=lambda ins, a: (lambda pp: {"ParamOut": (
+        np.sign(pp) * np.maximum(np.abs(pp) - 0.1 * 0.01, 0)
+        / (1 + 0.1 * 0.02)).astype(np.float32)})(
+        ins["Param"] - 0.1 * ins["Grad"]))
+SPECS["proximal_adagrad"] = S(
+    {"Param": fn32(4), "Grad": fn32(4), "Moment": f32(4),
+     "LearningRate": np.asarray([0.1], np.float32)},
+    {"l1": 0.0, "l2": 0.02}, outs=("ParamOut", "MomentOut"),
+    ref=lambda ins, a: (lambda m2: {
+        "MomentOut": m2.astype(np.float32),
+        "ParamOut": ((ins["Param"] - 0.1 * ins["Grad"] / np.sqrt(m2))
+                     / (1 + 0.1 * 0.02)).astype(np.float32)})(
+        ins["Moment"] + ins["Grad"] ** 2))
+SPECS["dgc_clip_by_norm"] = S(
+    {"X": fn32(4, 3), "current_step": np.asarray([10.0], np.float32)},
+    {"rampup_begin_step": 0.0, "max_norm": 1.0},
+    ref=lambda ins, a: {"Out": ins["X"] * min(
+        1.0, 1.0 / max(np.sqrt((ins["X"] ** 2).sum()), 1e-12))},
+    atol=1e-4)
+SPECS["amp_check_finite_and_scale"] = S(
+    {"X": [("acs_x0", fn32(3, 2)), ("acs_x1", fn32(4))],
+     "Scale": np.asarray([2.0], np.float32)},
+    outs=(("Out", 2), "FoundInfinite"),
+    ref=lambda ins, a: {
+        "Out": [ins["X"][0] * 2.0, ins["X"][1] * 2.0],
+        "FoundInfinite": np.zeros((1,), bool)})
+SPECS["sequence_reshape"] = S(
+    {"X": fn32(6, 4)}, {"new_dim": 8},
+    ref=lambda ins, a: {"Out": ins["X"].reshape(3, 8)}, grad=["X"])
+SPECS["spp"] = S(
+    {"X": fn32(2, 3, 4, 4)}, {"pyramid_height": 2, "pooling_type": "max"},
+    ref=lambda ins, a: {"Out": np.concatenate([
+        ins["X"].max(axis=(2, 3)).reshape(2, 3),
+        ins["X"].reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5)).reshape(2, 12),
+    ], axis=1)}, grad=["X"], grad_tol=5e-2)
+SPECS["fused_elemwise_activation"] = S(
+    {"X": fn32(3, 4), "Y": fn32(3, 4)},
+    {"functor_list": ["elementwise_add", "relu"]},
+    outs=("Out", "IntermediateOut"),
+    ref=lambda ins, a: {"IntermediateOut": ins["X"] + ins["Y"],
+                        "Out": np.maximum(ins["X"] + ins["Y"], 0)},
+    grad=["X", "Y"], grad_tol=5e-2)
+_fesp_w, _fesp_ids = fn32(20, 6), RNG.randint(0, 20, (3, 5)).astype(np.int64)
+SPECS["fused_embedding_seq_pool"] = S(
+    {"W": _fesp_w, "Ids": _fesp_ids}, {"combiner": "sum"},
+    ref=lambda ins, a: {"Out": ins["W"][ins["Ids"]].sum(axis=1)},
+    grad=["W"], grad_tol=5e-2)
+_ffel_x, _ffel_w = fn32(4, 6), fn32(6, 8)
+_ffel_y, _ffel_s, _ffel_b = fn32(4, 8), f32(8) + 0.5, fn32(8)
+def _ffel_ref(ins, a):
+    z = ins["X"] @ ins["W"] + ins["Y"]
+    mean = z.mean(-1, keepdims=True)
+    var = z.var(-1, keepdims=True)
+    o = (z - mean) / np.sqrt(var + 1e-5)
+    return {"Out": o * ins["Scale"] + ins["Bias1"]}
+SPECS["fused_fc_elementwise_layernorm"] = S(
+    {"X": _ffel_x, "W": _ffel_w, "Y": _ffel_y, "Scale": _ffel_s,
+     "Bias1": _ffel_b}, {"epsilon": 1e-5},
+    ref=_ffel_ref, atol=1e-4, rtol=1e-4)
+SPECS["fusion_repeated_fc_relu"] = S(
+    {"X": fn32(3, 4),
+     "W": [("frfr_w0", fn32(4, 5)), ("frfr_w1", fn32(5, 2))],
+     "Bias": [("frfr_b0", fn32(5)), ("frfr_b1", fn32(2))]},
+    ref=lambda ins, a: {"Out": np.maximum(
+        np.maximum(ins["X"] @ ins["W"][0] + ins["Bias"][0], 0)
+        @ ins["W"][1] + ins["Bias"][1], 0)}, atol=1e-4)
+SPECS["fusion_squared_mat_sub"] = S(
+    {"X": fn32(3, 4), "Y": fn32(4, 5)}, {"scalar": 0.5},
+    outs=("Out",), no_check=("SquaredX", "SquaredY", "SquaredXY"),
+    ref=lambda ins, a: {"Out": 0.5 * ((ins["X"] @ ins["Y"]) ** 2
+                                      - (ins["X"] ** 2) @ (ins["Y"] ** 2))},
+    atol=1e-3, rtol=1e-3)
+SPECS["fusion_seqpool_concat"] = S(
+    {"X": [("fspc_x0", fn32(3, 4, 5)), ("fspc_x1", fn32(3, 4, 2))]},
+    {"pooltype": "SUM"},
+    ref=lambda ins, a: {"Out": np.concatenate(
+        [ins["X"][0].sum(1), ins["X"][1].sum(1)], axis=1)})
+SPECS["fusion_seqpool_cvm_concat"] = S(
+    {"X": [("fscc_x0", fn32(3, 4, 5)), ("fscc_x1", fn32(3, 4, 4))]},
+    {"use_cvm": True},
+    ref=lambda ins, a: {"Out": np.concatenate(
+        [ins["X"][0].sum(1), ins["X"][1].sum(1)], axis=1)})
+SPECS["fusion_transpose_flatten_concat"] = S(
+    {"X": [("ftfc_x0", fn32(2, 3, 4)), ("ftfc_x1", fn32(2, 3, 4))]},
+    {"trans_axis": [0, 2, 1], "flatten_axis": 1, "concat_axis": 1},
+    ref=lambda ins, a: {"Out": np.concatenate(
+        [x.transpose(0, 2, 1).reshape(2, -1) for x in ins["X"]], axis=1)})
+_fg_x, _fg_wx = fn32(2, 5, 3), fn32(3, 12)
+_fg_wh, _fg_b = fn32(4, 12) * 0.3, fn32(12) * 0.1
+def _fusion_gru_ref(ins, a):
+    x, wx, wh, b = ins["X"], ins["WeightX"], ins["WeightH"], ins["Bias"]
+    H = wh.shape[0]
+    xw = x @ wx + b
+    hs = []
+    h = np.zeros((x.shape[0], H), np.float32)
+    for t in range(x.shape[1]):
+        ur = 1 / (1 + np.exp(-(xw[:, t, :2 * H] + h @ wh[:, :2 * H])))
+        u, r = ur[:, :H], ur[:, H:]
+        c = np.tanh(xw[:, t, 2 * H:] + (r * h) @ wh[:, 2 * H:])
+        h = (1 - u) * h + u * c
+        hs.append(h)
+    return {"Hidden": np.stack(hs, 1).astype(np.float32)}
+SPECS["fusion_gru"] = S(
+    {"X": _fg_x, "WeightX": _fg_wx, "WeightH": _fg_wh, "Bias": _fg_b},
+    outs=("Hidden",), no_check=("XX",), ref=_fusion_gru_ref,
+    atol=1e-4, rtol=1e-3)
+_fl_wx, _fl_wh = fn32(3, 16), fn32(4, 16) * 0.3
+def _fusion_lstm_ref(ins, a):
+    x, wx, wh, b = ins["X"], ins["WeightX"], ins["WeightH"], ins["Bias"]
+    H = wh.shape[0]
+    xw = x @ wx + b
+    h = np.zeros((x.shape[0], H), np.float32)
+    c = np.zeros_like(h)
+    hs, cs = [], []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(x.shape[1]):
+        g = xw[:, t] + h @ wh
+        i, cand = sig(g[:, :H]), np.tanh(g[:, H:2 * H])
+        f, o = sig(g[:, 2 * H:3 * H]), sig(g[:, 3 * H:])
+        c = f * c + i * cand
+        h = o * np.tanh(c)
+        hs.append(h); cs.append(c)
+    return {"Hidden": np.stack(hs, 1).astype(np.float32),
+            "Cell": np.stack(cs, 1).astype(np.float32)}
+SPECS["fusion_lstm"] = S(
+    {"X": _fg_x, "WeightX": _fl_wx, "WeightH": _fl_wh,
+     "Bias": fn32(16) * 0.1},
+    outs=("Hidden", "Cell"), no_check=("XX",), ref=_fusion_lstm_ref,
+    atol=1e-4, rtol=1e-3)
+SPECS["fake_dequantize_max_abs"] = S(
+    {"X": np.round(fn32(3, 4) * 100), "Scale": np.asarray([0.5], np.float32)},
+    {"max_range": 127.0},
+    ref=lambda ins, a: {"Out": ins["X"] * 0.5 / 127.0})
+SPECS["dequantize_abs_max"] = S(
+    {"X": np.round(fn32(3, 4) * 100), "Scale": np.asarray([0.5], np.float32)},
+    {"max_range": 127.0},
+    ref=lambda ins, a: {"Out": ins["X"] * 0.5 / 127.0})
+_cwq_x = fn32(4, 6)
+SPECS["fake_channel_wise_quantize_abs_max"] = S(
+    {"X": _cwq_x}, {"bit_length": 8}, outs=("Out", "OutScale"),
+    ref=lambda ins, a: (lambda s: {
+        "OutScale": s.astype(np.float32),
+        "Out": np.round(ins["X"] / np.maximum(s[:, None], 1e-12) * 127)})(
+        np.abs(ins["X"]).max(axis=1)))
+SPECS["fake_channel_wise_dequantize_max_abs"] = S(
+    {"X": np.round(fn32(4, 6) * 50),
+     "Scales": [("fcwd_s0", f32(4) + 0.5)]},
+    {"quant_bits": [8]},
+    ref=lambda ins, a: {"Out": ins["X"] * ins["Scales"][0][:, None] / 127.0})
+SPECS["dequantize_log"] = S(
+    {"X": RNG.randint(0, 256, (3, 4)).astype(np.uint8),
+     "Dict": f32(128) + 0.1},
+    ref=lambda ins, a: (lambda code: {"Out": np.where(
+        code >= 128, -ins["Dict"][np.clip(code - 128, 0, 127)],
+        ins["Dict"][np.clip(code, 0, 127)]).astype(np.float32)})(
+        ins["X"].astype(np.int64)))
+SPECS["quantize"] = S(
+    {"Input": fn32(3, 4)}, {"Scale": 10.0}, outs=("Output",),
+    ref=lambda ins, a: {"Output": np.round(ins["Input"] * 10.0)})
+SPECS["dequantize"] = S(
+    {"Input": np.round(fn32(3, 4) * 10)}, {"Scale": 10.0}, outs=("Output",),
+    ref=lambda ins, a: {"Output": ins["Input"] / 10.0})
+SPECS["requantize"] = S(
+    {"Input": np.round(fn32(3, 4) * 10)}, {"Scale_in": 10.0, "Scale_out": 5.0},
+    outs=("Output",),
+    ref=lambda ins, a: {"Output": np.round(ins["Input"] / 10.0 * 5.0)})
+SPECS["rnn_memory_helper"] = S(
+    {"X": fn32(3, 4)}, ref=lambda ins, a: {"Out": ins["X"]}, grad=["X"])
+SPECS["max_sequence_len"] = S(
+    {"RankTable": fn32(3, 7)},
+    ref=lambda ins, a: {"Out": np.asarray(7, np.int64)})
+
+COVERED_ELSEWHERE.update({
+    # host/metric/stateful extras — dedicated tests
+    "precision_recall": "test_misc_ops",
+    "positive_negative_pair": "test_misc_ops",
+    "mine_hard_examples": "test_detection_extra(family); host greedy",
+    "seed": "rng (stateful)",
+    "fake_quantize_range_abs_max": "test_quantization family",
+    "fake_quantize_dequantize_moving_average_abs_max": "test_quantization",
+    "multihead_matmul": "test_pallas_attention(fused core); composition",
+    "get_places": "host probe",
+    "delete_var": "host side-effect",
+})
+
+
 @pytest.mark.parametrize("op_type", sorted(SPECS))
 def test_op_spec(op_type):
     spec = SPECS[op_type]

@@ -125,3 +125,152 @@ def test_tensor_parallel_fc_matches_single_device():
 
     np.testing.assert_allclose(losses["single"], losses["tp"],
                                rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------- r3 TP depth
+def _train_parity(build_fn, rules_fn, mesh_shape, mesh_names, steps=4,
+                  atol=2e-4):
+    """Shared oracle: same program single-device vs TP-sharded over a
+    mesh; per-step losses must match."""
+    from paddle_tpu.framework import scope as scope_mod
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.parallel.tensor_parallel import apply_tensor_parallel
+
+    losses = {}
+    for mode in ("single", "tp"):
+        main, startup, loss, feed = build_fn()
+        scope = Scope()
+        prev = scope_mod._global_scope
+        scope_mod._global_scope = scope
+        try:
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            if mode == "tp":
+                applied = apply_tensor_parallel(main, rules_fn(main))
+                assert applied, "no TP rules applied"
+                mesh = _mesh(mesh_shape, mesh_names)
+                prog = fluid.CompiledProgram(main).with_data_parallel(
+                    loss_name=loss.name).with_mesh(mesh)
+            else:
+                prog = main
+            losses[mode] = [
+                float(np.asarray(exe.run(prog, feed=feed,
+                                         fetch_list=[loss])[0]).ravel()[0])
+                for _ in range(steps)]
+        finally:
+            scope_mod._global_scope = prev
+    np.testing.assert_allclose(losses["single"], losses["tp"], atol=atol,
+                               rtol=1e-4)
+    return losses
+
+
+def _attention_block_program(h=16, heads=4, seq=8, batch=8):
+    """A BERT-style block in static fluid layers with NAMED weights the
+    TP rules target."""
+    from paddle_tpu.param_attr import ParamAttr
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [seq, h])
+        y = fluid.layers.data("y", [1])
+
+        def fc(inp, size, name, act=None):
+            return fluid.layers.fc(
+                inp, size, num_flatten_dims=2, act=act,
+                param_attr=ParamAttr(name=f"blk_{name}.w_0"),
+                bias_attr=ParamAttr(name=f"blk_{name}.b_0"))
+
+        q = fc(x, h, "q")
+        k = fc(x, h, "k")
+        v = fc(x, h, "v")
+        d = h // heads
+
+        def split(t):
+            t = fluid.layers.reshape(t, [-1, seq, heads, d])
+            return fluid.layers.transpose(t, [0, 2, 1, 3])
+
+        qh, kh, vh = split(q), split(k), split(v)
+        scores = fluid.layers.matmul(qh, kh, transpose_y=True,
+                                     alpha=1.0 / np.sqrt(d))
+        probs = fluid.layers.softmax(scores)
+        ctx = fluid.layers.matmul(probs, vh)
+        ctx = fluid.layers.transpose(ctx, [0, 2, 1, 3])
+        ctx = fluid.layers.reshape(ctx, [-1, seq, h])
+        attn_out = fc(ctx, h, "out")
+        z = fluid.layers.elementwise_add(x, attn_out)
+        f1 = fc(z, 4 * h, "fc1", act="relu")
+        f2 = fc(f1, h, "fc2")
+        z2 = fluid.layers.elementwise_add(z, f2)
+        pooled = fluid.layers.reduce_mean(z2, dim=[1, 2], keep_dim=False)
+        pred = fluid.layers.reshape(pooled, [-1, 1])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    feed = {"x": rng.rand(8, 8, 16).astype("float32"),
+            "y": rng.rand(8, 1).astype("float32")}
+    return main, startup, loss, feed
+
+
+def test_attention_head_sharding_parity():
+    """BERT-block demo: heads column-parallel, out-proj row-parallel,
+    MLP Megatron-sharded — 1x8 pure-TP mesh matches single device."""
+    from paddle_tpu.parallel.tensor_parallel import transformer_block_rules
+
+    _train_parity(_attention_block_program,
+                  lambda main: transformer_block_rules("blk"),
+                  (1, 8), ("dp", "mp"))
+
+
+def test_attention_tp_dp_combined_mesh():
+    """Same block over a 2x4 dp-x-mp mesh (TP inside DP replicas)."""
+    from paddle_tpu.parallel.tensor_parallel import transformer_block_rules
+
+    _train_parity(_attention_block_program,
+                  lambda main: transformer_block_rules("blk"),
+                  (2, 4), ("dp", "mp"))
+
+
+@pytest.mark.parametrize("mode", ["vocab", "hidden"])
+def test_embedding_partition_parity(mode):
+    """lookup_table with the embedding table sharded on either dim."""
+    from paddle_tpu.param_attr import ParamAttr
+    from paddle_tpu.parallel.tensor_parallel import embedding_rules
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 9
+        rng = np.random.RandomState(1)
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [4], dtype="int64")
+            y = fluid.layers.data("y", [1])
+            emb = fluid.layers.embedding(
+                ids, size=[40, 16],
+                param_attr=ParamAttr(name="tok_emb.w_0"))
+            pooled = fluid.layers.reduce_sum(emb, dim=1)
+            pred = fluid.layers.fc(pooled, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        feed = {"ids": rng.randint(0, 40, (8, 4)).astype("int64"),
+                "y": rng.rand(8, 1).astype("float32")}
+        return main, startup, loss, feed
+
+    _train_parity(build,
+                  lambda main: embedding_rules("tok_emb\\.w_0", mode=mode),
+                  (2, 4), ("dp", "mp"))
+
+
+def test_rule_helpers_shapes():
+    from paddle_tpu.parallel.tensor_parallel import (
+        attention_head_rules, embedding_rules, transformer_block_rules)
+
+    r = attention_head_rules("q", "k", "v", "o", axis="mp")
+    assert r["q"] == (None, "mp") and r["o"] == ("mp", None)
+    assert embedding_rules("e", mode="vocab")["e"] == ("mp", None)
+    assert embedding_rules("e", mode="hidden")["e"] == (None, "mp")
+    blk = transformer_block_rules("p")
+    assert blk[r"p_fc1\.w_0"] == (None, "mp")
+    assert blk[r"p_fc2\.w_0"] == ("mp", None)
+    with pytest.raises(ValueError):
+        embedding_rules("e", mode="bogus")

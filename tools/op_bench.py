@@ -1,0 +1,215 @@
+"""Config-driven per-op micro-benchmark (the reference's
+paddle/fluid/operators/benchmark/op_tester.cc analog).
+
+Usage:
+    python tools/op_bench.py                      # built-in hot-op table
+    python tools/op_bench.py --config cfg.json    # custom op list
+    python tools/op_bench.py --op matmul --shape X=128,768 --shape Y=768,768
+
+A config entry mirrors op_tester's config format in JSON:
+    {"op": "matmul", "repeat": 50,
+     "inputs": {"X": {"shape": [128, 768]}, "Y": {"shape": [768, 768]}},
+     "attrs": {"transpose_Y": false}}
+
+Each op runs through the SAME lowering registry the executor uses
+(ops.registry.eager_call), jitted, so timings reflect the real kernel
+XLA emits for that op in isolation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+# the 20 hottest ops across the ResNet-50 / ERNIE / wide_deep benches
+# (per BENCHMARKS.md profiles), with representative shapes
+DEFAULT_CONFIG = [
+    {"op": "conv2d", "inputs": {"Input": {"shape": [32, 64, 56, 56]},
+                                "Filter": {"shape": [64, 64, 3, 3]}},
+     "attrs": {"paddings": [1, 1], "strides": [1, 1]}},
+    {"op": "conv2d", "inputs": {"Input": {"shape": [32, 256, 56, 56]},
+                                "Filter": {"shape": [64, 256, 1, 1]}}},
+    {"op": "batch_norm",
+     "inputs": {"X": {"shape": [32, 256, 56, 56]},
+                "Scale": {"shape": [256]}, "Bias": {"shape": [256]},
+                "Mean": {"shape": [256]}, "Variance": {"shape": [256]}},
+     "outs": ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"]},
+    {"op": "fused_batch_norm_act",
+     "inputs": {"X": {"shape": [32, 256, 56, 56]},
+                "Scale": {"shape": [256]}, "Bias": {"shape": [256]},
+                "Mean": {"shape": [256]}, "Variance": {"shape": [256]}},
+     "outs": ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"]},
+    {"op": "matmul", "inputs": {"X": {"shape": [8192, 768]},
+                                "Y": {"shape": [768, 768]}}},
+    {"op": "matmul", "inputs": {"X": {"shape": [8192, 768]},
+                                "Y": {"shape": [768, 3072]}}},
+    {"op": "matmul", "inputs": {"X": {"shape": [8192, 768],
+                                      "dtype": "bfloat16"},
+                                "Y": {"shape": [768, 3072],
+                                      "dtype": "bfloat16"}}},
+    {"op": "softmax", "inputs": {"X": {"shape": [16, 12, 512, 512]}}},
+    {"op": "layer_norm",
+     "inputs": {"X": {"shape": [16, 512, 768]}, "Scale": {"shape": [768]},
+                "Bias": {"shape": [768]}},
+     "attrs": {"begin_norm_axis": 2},
+     "outs": ["Y", "Mean", "Variance"]},
+    {"op": "softmax_with_cross_entropy",
+     "inputs": {"Logits": {"shape": [8192, 30522]},
+                "Label": {"shape": [8192, 1], "dtype": "int32", "max": 30000}},
+     "outs": ["Loss", "Softmax"]},
+    {"op": "gelu", "inputs": {"X": {"shape": [16, 512, 3072]}}},
+    {"op": "relu", "inputs": {"X": {"shape": [32, 256, 56, 56]}}},
+    {"op": "elementwise_add", "inputs": {"X": {"shape": [32, 256, 56, 56]},
+                                         "Y": {"shape": [32, 256, 56, 56]}}},
+    {"op": "pool2d", "inputs": {"X": {"shape": [32, 64, 112, 112]}},
+     "attrs": {"ksize": [3, 3], "strides": [2, 2], "paddings": [1, 1],
+               "pooling_type": "max"}},
+    {"op": "lookup_table",
+     "inputs": {"W": {"shape": [30522, 768]},
+                "Ids": {"shape": [8192, 1], "dtype": "int32", "max": 30000}}},
+    {"op": "dropout", "inputs": {"X": {"shape": [16, 512, 768]}},
+     "attrs": {"dropout_prob": 0.1,
+               "dropout_implementation": "upscale_in_train"},
+     "outs": ["Out", "Mask"]},
+    {"op": "adam",
+     "inputs": {"Param": {"shape": [768, 3072]},
+                "Grad": {"shape": [768, 3072]},
+                "Moment1": {"shape": [768, 3072]},
+                "Moment2": {"shape": [768, 3072]},
+                "Beta1Pow": {"shape": [1]}, "Beta2Pow": {"shape": [1]},
+                "LearningRate": {"shape": [1]}},
+     "outs": ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+              "Beta2PowOut"]},
+    {"op": "momentum",
+     "inputs": {"Param": {"shape": [256, 256, 3, 3]},
+                "Grad": {"shape": [256, 256, 3, 3]},
+                "Velocity": {"shape": [256, 256, 3, 3]},
+                "LearningRate": {"shape": [1]}},
+     "attrs": {"mu": 0.9}, "outs": ["ParamOut", "VelocityOut"]},
+    {"op": "fused_multihead_attention",
+     "inputs": {"Q": {"shape": [16, 12, 512, 64]},
+                "K": {"shape": [16, 12, 512, 64]},
+                "V": {"shape": [16, 12, 512, 64]}}},
+    {"op": "transpose2", "inputs": {"X": {"shape": [16, 512, 12, 64]}},
+     "attrs": {"axis": [0, 2, 1, 3]}, "outs": ["Out", "XShape"]},
+    {"op": "reduce_sum", "inputs": {"X": {"shape": [16, 512, 768]}},
+     "attrs": {"dim": [0, 1]}},
+]
+
+
+def _make_value(spec, rng):
+    shape = list(spec.get("shape", []))
+    dtype = spec.get("dtype", "float32")
+    if dtype in ("int32", "int64"):
+        hi = int(spec.get("max", 100))
+        return rng.randint(0, hi, shape).astype(dtype)
+    val = rng.rand(*shape).astype(np.float32)
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.asarray(val, jnp.bfloat16)
+    return val.astype(dtype)
+
+
+def bench_entry(entry, repeat=None, warmup=3):
+    import jax
+
+    from paddle_tpu.ops import registry
+
+    rng = np.random.RandomState(0)
+    op_type = entry["op"]
+    repeat = repeat or entry.get("repeat", 20)
+    ins, arg_vals = {}, []
+    for slot, spec in entry.get("inputs", {}).items():
+        v = jax.device_put(_make_value(spec, rng))
+        ins[slot] = v
+    attrs = dict(entry.get("attrs", {}))
+    outs = {o: 1 for o in entry.get("outs", ["Out"])}
+    slots = sorted(ins)
+
+    def run(*vals):
+        r = registry.eager_call(op_type, {s: [v] for s, v in zip(slots, vals)},
+                                attrs, outs,
+                                rng_key=jax.random.key(0))
+        return [x for vs in r.values() for x in vs if x is not None]
+
+    jitted = jax.jit(run)
+    vals = [ins[s] for s in slots]
+
+    def sync(o):
+        # a D2H of one element forces the producing execution to finish;
+        # block_until_ready is not reliable through the PJRT tunnel
+        np.asarray(jax.numpy.ravel(o[0])[0])
+
+    out = jitted(*vals)
+    sync(out)
+    for _ in range(warmup):
+        out = jitted(*vals)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = jitted(*vals)
+    sync(out)
+    # NOTE: through the PJRT *tunnel* each execution pays a fixed RPC
+    # latency; the printed `floor` row (a [8]-element scale op) measures
+    # it — subtract it to compare ops.  On directly-attached chips the
+    # floor is microseconds.
+    dt = (time.perf_counter() - t0) / repeat
+    nbytes = sum(int(np.prod(s.get("shape", [1]))) *
+                 (2 if s.get("dtype") == "bfloat16" else 4)
+                 for s in entry.get("inputs", {}).values())
+    return {"op": op_type, "ms": dt * 1e3,
+            "approx_in_GB": nbytes / 1e9,
+            "shapes": {k: v.get("shape") for k, v in
+                       entry.get("inputs", {}).items()}}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", help="JSON list of op entries")
+    ap.add_argument("--op")
+    ap.add_argument("--shape", action="append", default=[],
+                    help="SLOT=d0,d1,...")
+    ap.add_argument("--attr", action="append", default=[],
+                    help="name=json_value")
+    ap.add_argument("--repeat", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.op:
+        entry = {"op": args.op, "inputs": {}, "attrs": {}}
+        for s in args.shape:
+            slot, dims = s.split("=")
+            entry["inputs"][slot] = {
+                "shape": [int(d) for d in dims.split(",")]}
+        for a in args.attr:
+            k, v = a.split("=", 1)
+            entry["attrs"][k] = json.loads(v)
+        cfg = [entry]
+    elif args.config:
+        with open(args.config) as f:
+            cfg = json.load(f)
+    else:
+        cfg = DEFAULT_CONFIG
+        # measured per-execution floor first: tiny op, pure overhead
+        cfg = [{"op": "scale", "inputs": {"X": {"shape": [8]}},
+                "attrs": {"scale": 1.0}}] + cfg
+
+    print(f"{'op':34s} {'ms/call':>10s} {'~GB in':>8s}  shapes")
+    for entry in cfg:
+        try:
+            r = bench_entry(entry, repeat=args.repeat)
+            print(f"{r['op']:34s} {r['ms']:10.4f} {r['approx_in_GB']:8.3f}  "
+                  f"{r['shapes']}")
+        except Exception as e:  # keep the table going
+            print(f"{entry['op']:34s} {'FAILED':>10s}          {e}")
+
+
+if __name__ == "__main__":
+    main()
