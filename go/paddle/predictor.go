@@ -116,9 +116,14 @@ func (p *Predictor) Run(inputs []*Tensor) ([]*Tensor, error) {
 	}
 	nOut := p.GetOutputNum()
 	cout := make([]C.PD_NativeTensor, nOut)
-	got := C.PD_NativePredictorRun(p.c,
-		(*C.PD_NativeTensor)(unsafe.Pointer(&cin[0])), C.int(nIn),
-		(*C.PD_NativeTensor)(unsafe.Pointer(&cout[0])), C.int(nOut))
+	var cinPtr, coutPtr *C.PD_NativeTensor
+	if nIn > 0 {
+		cinPtr = (*C.PD_NativeTensor)(unsafe.Pointer(&cin[0]))
+	}
+	if nOut > 0 {
+		coutPtr = (*C.PD_NativeTensor)(unsafe.Pointer(&cout[0]))
+	}
+	got := C.PD_NativePredictorRun(p.c, cinPtr, C.int(nIn), coutPtr, C.int(nOut))
 	runtime.KeepAlive(pinned)
 	if got < 0 {
 		return nil, fmt.Errorf("paddle: %s", C.GoString(C.PD_NativeLastError()))

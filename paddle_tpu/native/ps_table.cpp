@@ -357,6 +357,8 @@ struct Listener {
   int fd = -1;
   int port = 0;
   std::atomic<bool> stop{false};
+  std::mutex fd_mu;     // serializes close (accept thread) vs shutdown (stop)
+  bool closed = false;  // guarded by fd_mu
 };
 std::mutex g_listeners_mu;
 std::vector<Listener*> g_listeners;  // parked forever once stopped
@@ -506,16 +508,21 @@ void accept_loop(Listener* L) {
   for (;;) {
     int fd = ::accept(L->fd, nullptr, nullptr);
     if (fd < 0) {
-      if (L->stop.load() || errno == EBADF || errno == EINVAL) return;
+      if (L->stop.load() || errno == EBADF || errno == EINVAL) break;
       ::usleep(10000);  // transient (EMFILE/EINTR): back off, no spin
       continue;
     }
     if (L->stop.load()) {
       ::close(fd);
-      return;
+      break;
     }
     std::thread(handle_conn, fd).detach();
   }
+  // the accept thread owns the close; fd_mu keeps the stop thread's
+  // shutdown() from landing on a reused fd number after this close
+  std::lock_guard<std::mutex> g(L->fd_mu);
+  ::close(L->fd);
+  L->closed = true;
 }
 
 }  // namespace
@@ -561,8 +568,12 @@ void ps_serve_stop_port(int32_t port) {
     if (L->stop.load()) continue;
     if (port > 0 && L->port != port) continue;
     L->stop.store(true);
-    ::shutdown(L->fd, SHUT_RDWR);
-    ::close(L->fd);
+    // shutdown() only — wakes the parked accept(); the accept thread
+    // owns the close().  fd_mu + closed make the two orderings safe:
+    // closing here (or shutting down after the accept thread already
+    // closed) would race kernel fd reuse and hit an unrelated socket.
+    std::lock_guard<std::mutex> fg(L->fd_mu);
+    if (!L->closed) ::shutdown(L->fd, SHUT_RDWR);
   }
 }
 

@@ -463,24 +463,39 @@ def _fusion_squared_mat_sub(ctx):
     ctx.set_out("Out", scalar * (jnp.square(xy) - x2y2))
 
 
+def _seqpool_each(ctx, ptype="SUM"):
+    """Pool each (N, T, D) input over valid timesteps.  Per-slot valid
+    lengths come from a parallel Length input list (a single shared
+    Length covers all slots); absent lengths mean every row is full."""
+    from .sequence_ops import _length_mask
+
+    xs = ctx.ins("X")
+    lens = ctx.ins("Length") if ctx.has_input("Length") else [None] * len(xs)
+    if len(lens) < len(xs):  # one shared Length for all slots
+        lens = list(lens) + [lens[-1]] * (len(xs) - len(lens))
+    for x, ln in zip(xs, lens):
+        N, T = jnp.shape(x)[0], jnp.shape(x)[1]
+        if ln is None:
+            length = jnp.full((N,), T, dtype=jnp.int32)
+        else:
+            length = jnp.asarray(ln).reshape(-1)
+        s = jnp.sum(x * _length_mask(length, T, x.dtype)[:, :, None], axis=1)
+        lf = jnp.maximum(length.astype(x.dtype), 1)[:, None]
+        if ptype == "SUM":
+            yield s
+        elif ptype == "AVERAGE":
+            yield s / lf
+        else:  # SQRT
+            yield s / jnp.sqrt(lf)
+
+
 @op("fusion_seqpool_concat")
 def _fusion_seqpool_concat(ctx):
     """reference: fused/fusion_seqpool_concat_op.cc — seq-pool each
     input then concat on axis 1."""
     ptype = (ctx.attr("pooltype", "SUM") or "SUM").upper()
-    outs = []
-    for x in ctx.ins("X"):
-        length = None
-        N, T = jnp.shape(x)[0], jnp.shape(x)[1]
-        mask = jnp.ones((N, T, 1), x.dtype)
-        if ptype == "SUM":
-            outs.append(jnp.sum(x, axis=1))
-        elif ptype == "AVERAGE":
-            outs.append(jnp.mean(x, axis=1))
-        else:  # SQRT
-            outs.append(jnp.sum(x, axis=1)
-                        / jnp.sqrt(jnp.asarray(T, x.dtype)))
-    ctx.set_out("Out", jnp.concatenate(outs, axis=1))
+    ctx.set_out("Out", jnp.concatenate(list(_seqpool_each(ctx, ptype)),
+                                       axis=1))
 
 
 @op("fusion_seqpool_cvm_concat")
@@ -489,8 +504,7 @@ def _fusion_seqpool_cvm_concat(ctx):
     (optional) CVM adjustment + concat."""
     use_cvm = bool(ctx.attr("use_cvm", True))
     outs = []
-    for x in ctx.ins("X"):
-        pooled = jnp.sum(x, axis=1)
+    for pooled in _seqpool_each(ctx, "SUM"):
         if not use_cvm:
             # no-cvm drops the two leading show/click columns
             pooled = pooled[:, 2:]
@@ -677,10 +691,32 @@ def _fake_quant_range_abs_max(ctx):
     bnt = (1 << (bit_length - 1)) - 1
     is_test = bool(ctx.attr("is_test", False))
     in_scale = ctx.in_("InScale").reshape(())
-    cur = jnp.max(jnp.abs(x))
-    scale = in_scale if is_test else jnp.maximum(cur, 1e-12)
+    cur = jnp.maximum(jnp.max(jnp.abs(x)), 1e-12)
+    if is_test:
+        scale = in_scale
+    elif ctx.has_input("InScales"):
+        # full window semantics: record cur at iter % window_size, scale
+        # is the max over the recorded history (fake_quantize_op.cc
+        # FindRangeAbsMaxFunctor)
+        window = jnp.asarray(ctx.in_("InScales")).reshape(-1)
+        it = (jnp.asarray(ctx.in_("Iter")).reshape(()).astype(jnp.int32)
+              if ctx.has_input("Iter") else jnp.int32(0))
+        idx = jnp.mod(it, jnp.int32(jnp.shape(window)[0]))
+        window = window.at[idx].set(cur)
+        scale = jnp.max(window)
+        ctx.set_out("OutScales", window)
+        if ctx.has_output("OutIter"):
+            ctx.set_out("OutIter", (it + 1).reshape((1,)))
+    else:
+        # no history buffer wired: track the running max so the scale
+        # can never collapse on a small batch
+        scale = jnp.maximum(in_scale, cur)
+        if ctx.has_output("OutScales"):
+            ctx.set_out("OutScales", scale.reshape((1,)))
     ctx.set_out("OutScale", scale.reshape((1,)))
-    ctx.set_out("Out", jnp.round(x / scale * bnt))
+    # ClipAndFakeQuant: clip to [-scale, scale] BEFORE scaling so out
+    # stays inside [-bnt, bnt] even when |x| > scale (is_test mode)
+    ctx.set_out("Out", jnp.round(jnp.clip(x, -scale, scale) / scale * bnt))
 
 
 @op("fake_quantize_dequantize_moving_average_abs_max", no_grad=False,

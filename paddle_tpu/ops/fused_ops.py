@@ -197,12 +197,18 @@ def _fused_bn_act_bwd(ctx, with_add):
     if ctx.has_output("X" + GRAD_SUFFIX):
         a = scale * inv                       # (C,) f32
         cg = a.astype(g.dtype)                # dx += cg * g
-        cx = (-a * inv * sgx / n).astype(x.dtype)   # dx += cx * (x - mean)
-        c0 = (-a * sg / n).astype(jnp.float32)
-        dx = (g * jnp.reshape(cg, bshape)
-              + (x - jnp.reshape(mean.astype(x.dtype), bshape))
-              * jnp.reshape(cx, bshape)
-              + jnp.reshape(c0, bshape).astype(g.dtype))
+        if ctx.attr("is_test", False) or ctx.attr("use_global_stats", False):
+            # frozen-BN: mean/var are constants w.r.t. x, so the
+            # batch-statistics correction terms vanish (matches the
+            # unfused batch_norm_grad in global-stats mode)
+            dx = g * jnp.reshape(cg, bshape)
+        else:
+            cx = (-a * inv * sgx / n).astype(x.dtype)  # dx += cx*(x-mean)
+            c0 = (-a * sg / n).astype(jnp.float32)
+            dx = (g * jnp.reshape(cg, bshape)
+                  + (x - jnp.reshape(mean.astype(x.dtype), bshape))
+                  * jnp.reshape(cx, bshape)
+                  + jnp.reshape(c0, bshape).astype(g.dtype))
         ctx.set_out("X" + GRAD_SUFFIX, dx.astype(x.dtype))
 
 

@@ -259,3 +259,33 @@ def test_pass_respects_fetched_intermediate_grad():
             assert np.asarray(out[1]).shape[1] == 8
     finally:
         flags._flags["FLAGS_apply_ir_passes"] = old
+
+
+def _frozen_bn_program():
+    """Training graph with a frozen BN (use_global_stats=True): mean/var
+    are constants w.r.t. x, so the correct dx has no batch-statistics
+    correction terms (advisor r3 medium finding)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [4, 8, 8])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        conv = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                   padding=1, bias_attr=False)
+        bn = fluid.layers.batch_norm(conv, use_global_stats=True)
+        y = fluid.layers.relu(bn)
+        pool = fluid.layers.pool2d(y, pool_type="avg", global_pooling=True)
+        logits = fluid.layers.fc(pool, 10, bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(loss)
+    return main, startup, loss, bn
+
+
+def test_frozen_bn_fusion_grad_parity():
+    """use_global_stats=True training: the fused backward must treat
+    mean/var as constants — fused vs unfused loss curves must match."""
+    a = _train(*_frozen_bn_program()[:3], steps=5, apply_passes=False)
+    b = _train(*_frozen_bn_program()[:3], steps=5, apply_passes=True)
+    np.testing.assert_allclose(a, b, atol=2e-5)
+    assert a[-1] < a[0]

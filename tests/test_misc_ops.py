@@ -247,3 +247,74 @@ def test_positive_negative_pair_op():
     assert float(np.asarray(outs["PositivePair"][0])) == 1.0
     assert float(np.asarray(outs["NegativePair"][0])) == 1.0
     assert float(np.asarray(outs["NeutralPair"][0])) == 0.0
+
+
+def test_fusion_seqpool_concat_masks_padding():
+    """advisor r3: SUM/AVERAGE/SQRT must respect per-row valid lengths,
+    not pool over pad rows (fused/fusion_seqpool_concat_op.cc LoD
+    semantics)."""
+    import numpy as np
+    from paddle_tpu.ops.registry import eager_call
+
+    rng = np.random.RandomState(0)
+    x0 = rng.randn(3, 4, 5).astype(np.float32)
+    x1 = rng.randn(3, 4, 2).astype(np.float32)
+    l0 = np.array([2, 4, 1], np.int64)
+    l1 = np.array([3, 1, 4], np.int64)
+
+    def ref(x, ln, ptype):
+        outs = []
+        for i in range(x.shape[0]):
+            v = x[i, :ln[i]]
+            if ptype == "SUM":
+                outs.append(v.sum(0))
+            elif ptype == "AVERAGE":
+                outs.append(v.mean(0))
+            else:
+                outs.append(v.sum(0) / np.sqrt(ln[i]))
+        return np.stack(outs).astype(np.float32)
+
+    for ptype in ("SUM", "AVERAGE", "SQRT"):
+        out = eager_call(
+            "fusion_seqpool_concat",
+            {"X": [x0, x1], "Length": [l0, l1]},
+            {"pooltype": ptype}, {"Out": 1})["Out"][0]
+        expect = np.concatenate([ref(x0, l0, ptype), ref(x1, l1, ptype)],
+                                axis=1)
+        np.testing.assert_allclose(np.asarray(out), expect, atol=1e-5,
+                                   err_msg=ptype)
+
+
+def test_fake_quantize_range_abs_max_window():
+    """advisor r3: training scale must track the running/windowed max,
+    never collapse to the current small batch."""
+    import numpy as np
+    from paddle_tpu.ops.registry import eager_call
+
+    big = np.array([[-8.0, 4.0]], np.float32)
+    small = np.array([[0.5, -0.25]], np.float32)
+    # running-max fallback (no history wired): scale keeps the prior max
+    out = eager_call("fake_quantize_range_abs_max",
+                     {"X": [small], "InScale": [np.array([8.0], np.float32)]},
+                     {"bit_length": 8}, {"Out": 1, "OutScale": 1})
+    assert float(np.asarray(out["OutScale"][0]).ravel()[0]) == 8.0
+    # full window semantics: scale = max over recorded history
+    window = np.array([8.0, 3.0, 0.0, 0.0], np.float32)
+    out = eager_call(
+        "fake_quantize_range_abs_max",
+        {"X": [small], "InScale": [np.array([8.0], np.float32)],
+         "InScales": [window], "Iter": [np.array([2], np.int64)]},
+        {"bit_length": 8, "window_size": 4},
+        {"Out": 1, "OutScale": 1, "OutScales": 1, "OutIter": 1})
+    assert float(np.asarray(out["OutScale"][0]).ravel()[0]) == 8.0
+    scales = np.asarray(out["OutScales"][0])
+    assert scales[2] == 0.5 and float(np.asarray(
+        out["OutIter"][0]).ravel()[0]) == 3
+    # is_test: frozen scale, and out-of-range inputs clip to [-bnt, bnt]
+    out = eager_call("fake_quantize_range_abs_max",
+                     {"X": [big], "InScale": [np.array([2.0], np.float32)]},
+                     {"bit_length": 8, "is_test": True},
+                     {"Out": 1, "OutScale": 1})
+    assert float(np.asarray(out["OutScale"][0]).ravel()[0]) == 2.0
+    np.testing.assert_array_equal(np.asarray(out["Out"][0]),
+                                  [[-127.0, 127.0]])
