@@ -57,14 +57,22 @@ def no_grad_ctx():
 
 @contextlib.contextmanager
 def amp_guard(enable=True, custom_white_list=None, custom_black_list=None,
-              dtype="bfloat16"):
+              dtype="bfloat16", level="O1"):
     """Dygraph auto-mixed-precision context (the imperative counterpart
     of contrib.mixed_precision.decorate; TPU-first: bf16 needs no loss
     scaling, fp16 accepted for parity).  White-list ops (matmul/conv/
     fused attention) consume low-precision casts of their f32 inputs;
     black-list ops are forced back to f32; everything else runs in the
     dtype it receives.  The casts are traced onto the tape, so the
-    backward matmuls run in the same precision as the forward."""
+    backward matmuls run in the same precision as the forward.
+
+    ``level="O2"`` (pure low-precision, the dygraph analog of static
+    ``decorate(use_pure_fp16=True)``): embedding lookups join the white
+    list so the whole activation stream — residuals, LayerNorm, dropout
+    — stays in ``dtype`` end to end instead of bouncing f32<->bf16 at
+    every matmul boundary.  Parameters and optimizer state remain f32
+    masters; reductions that need f32 (LN statistics, softmax-CE
+    logsumexp) still upcast inside their kernels."""
     tracer = _current_tracer()
     if tracer is None:
         yield
@@ -75,13 +83,15 @@ def amp_guard(enable=True, custom_white_list=None, custom_black_list=None,
     # standard idiom for opting a numerically sensitive block out of AMP
     tracer._amp_enabled = bool(enable)
     tracer._amp_dtype = dtype
-    if custom_white_list or custom_black_list:
+    if custom_white_list or custom_black_list or level == "O2":
         # same merge semantics as static-graph AMP (single source of truth)
         from ..contrib.mixed_precision.fp16_lists import (
             AutoMixedPrecisionLists)
 
         lists = AutoMixedPrecisionLists(custom_white_list, custom_black_list)
         tracer._amp_white = lists.white_list | {"fused_multihead_attention"}
+        if level == "O2":
+            tracer._amp_white |= {"lookup_table", "lookup_table_v2"}
         tracer._amp_black = lists.black_list
     try:
         yield

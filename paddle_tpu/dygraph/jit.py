@@ -28,7 +28,7 @@ from .varbase import VarBase
 
 
 def jit_train_step(model, optimizer, loss_fn: Callable, amp=False,
-                   amp_dtype="bfloat16"):
+                   amp_dtype="bfloat16", amp_level="O1"):
     """Compile an eager train step: loss_fn(model, *varbase_inputs) -> loss.
 
     Returns step(*numpy_or_jax_inputs) -> loss VarBase; parameters and
@@ -56,7 +56,7 @@ def jit_train_step(model, optimizer, loss_fn: Callable, amp=False,
             tracer._rng_key = rng
             optimizer._param_state = opt_state
             in_vars = [VarBase(v) for v in inputs]
-            with amp_guard(enable=amp, dtype=amp_dtype):
+            with amp_guard(enable=amp, dtype=amp_dtype, level=amp_level):
                 loss = loss_fn(model, *in_vars)
             tracer.run_backward(loss)
             pgs = [(p, p._grad_value) for p in params

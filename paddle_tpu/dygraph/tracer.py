@@ -39,7 +39,9 @@ class Tracer:
         self._has_grad = True
         self._tape: List[_TapeRecord] = []
         self._train_mode = True
-        self._rng_key = jax.random.key(0)
+        from ..utils.prng import prng_key
+
+        self._rng_key = prng_key(0)
         self._params: Dict[str, ParamBase] = {}
         # program capture hook (ProgramDescTracer analog,
         # reference: imperative/jit/program_desc_tracer.cc): when set,
@@ -86,6 +88,17 @@ class Tracer:
             want = self._amp_dtype
             src_kinds = ("float32",)
         elif type in black:
+            # Ops whose lowering already runs its reductions in f32
+            # internally (softmax_with_cross_entropy upcasts for the
+            # logsumexp and stores Softmax back in the input dtype —
+            # ops/nn_ops.py): under bf16 AMP the black-list upcast would
+            # only materialize a full f32 copy of a gigabyte-scale
+            # logits tensor that the kernel re-upcasts anyway.  bf16
+            # shares f32's exponent range, so the fp16 overflow
+            # rationale for the cast does not apply.
+            if (self._amp_dtype == "bfloat16"
+                    and type in ("softmax_with_cross_entropy",)):
+                return inputs
             want = "float32"
             src_kinds = ("bfloat16", "float16")
         else:

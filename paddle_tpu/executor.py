@@ -464,8 +464,10 @@ class Executor:
             if name == RNG_VAR:
                 val = scope.get(RNG_VAR)
                 if val is None:
+                    from .utils.prng import prng_key
+
                     seed = program.random_seed or 0
-                    val = jax.random.key(seed)
+                    val = prng_key(seed)
                 return val
             val = scope.get(name)
             if val is None:

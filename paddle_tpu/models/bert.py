@@ -24,7 +24,8 @@ class BertConfig:
                  num_attention_heads=12, intermediate_size=3072,
                  max_position_embeddings=512, type_vocab_size=2,
                  hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
-                 initializer_range=0.02, fuse_attention=True):
+                 initializer_range=0.02, fuse_attention=True,
+                 fuse_qkv=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -39,6 +40,11 @@ class BertConfig:
         # probs-dropout is inactive; the naive composition is kept for
         # prob-dropout training parity with the reference.
         self.fuse_attention = fuse_attention
+        # Single packed [h,3h] QKV projection (one MXU matmul instead of
+        # three).  Off by default: on v5e at base scale the packed
+        # projection's slice/concat traffic roughly cancels the matmul
+        # win (r4 A/B); the tradeoff flips on larger hidden sizes.
+        self.fuse_qkv = fuse_qkv
 
 
 def base_config(**kw):
@@ -55,9 +61,13 @@ class MultiHeadAttention(Layer):
         h = cfg.hidden_size
         self.n_head = cfg.num_attention_heads
         self.d_head = h // self.n_head
-        self.q = Linear(h, h, param_attr=_init(cfg))
-        self.k = Linear(h, h, param_attr=_init(cfg))
-        self.v = Linear(h, h, param_attr=_init(cfg))
+        self.fuse_qkv = getattr(cfg, "fuse_qkv", False)
+        if self.fuse_qkv:
+            self.qkv = Linear(h, 3 * h, param_attr=_init(cfg))
+        else:
+            self.q = Linear(h, h, param_attr=_init(cfg))
+            self.k = Linear(h, h, param_attr=_init(cfg))
+            self.v = Linear(h, h, param_attr=_init(cfg))
         self.out = Linear(h, h, param_attr=_init(cfg))
         self.drop = Dropout(cfg.attention_probs_dropout_prob,
                             dropout_implementation="upscale_in_train")
@@ -70,9 +80,15 @@ class MultiHeadAttention(Layer):
             t = F.reshape(t, [b, s, self.n_head, self.d_head])
             return F.transpose(t, [0, 2, 1, 3])
 
-        q = split_heads(self.q(x))
-        k = split_heads(self.k(x))
-        v = split_heads(self.v(x))
+        if self.fuse_qkv:
+            z = self.qkv(x)                   # [b, s, 3h]
+            q = split_heads(z[:, :, :h])
+            k = split_heads(z[:, :, h:2 * h])
+            v = split_heads(z[:, :, 2 * h:])
+        else:
+            q = split_heads(self.q(x))
+            k = split_heads(self.k(x))
+            v = split_heads(self.v(x))
         # Contract: bias_qk, when given, MUST be the (b, kv_seq) additive
         # form of attn_mask (BertModel passes both derived from the same
         # attention_mask).  The fused path substitutes bias_qk for

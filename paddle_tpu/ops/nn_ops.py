@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax, nn as jnn
 
+from ..utils.prng import prng_key as _prng_key
 from .registry import op, grad_maker, default_grad_maker
 from ..framework.core import GRAD_SUFFIX, EMPTY_VAR_NAME
 
@@ -457,6 +458,37 @@ def _softmax_ce(ctx):
     ctx.set_out("Loss", loss)
 
 
+@op("softmax_with_cross_entropy_grad", no_grad=True)
+def _softmax_ce_grad(ctx):
+    """Closed-form dLogits = (Softmax - onehot(Label)) * dLoss
+    (reference: softmax_with_cross_entropy_op.cu grad kernel).  Replaces
+    the vjp replay of the f32 log-softmax, which would scatter into and
+    re-read a gigabyte-scale f32 log-prob tensor for an MLM head; this
+    form is ONE fused pass reading the saved (input-dtype) Softmax."""
+    softmax = ctx.in_("Softmax")
+    label = ctx.in_("Label")
+    dloss = ctx.in_("Loss" + GRAD_SUFFIX)
+    axis = ctx.attr("axis", -1)
+    soft_label = ctx.attr("soft_label", False)
+    ignore_index = ctx.attr("ignore_index", -100)
+    p = softmax.astype(jnp.float32)
+    dl = dloss.astype(jnp.float32)                  # (..., 1) along axis
+    if soft_label:
+        y = label.astype(jnp.float32)
+        dx = (p * jnp.sum(y, axis=axis, keepdims=True) - y) * dl
+    else:
+        lbl = (jnp.squeeze(label, axis)
+               if jnp.ndim(label) == jnp.ndim(softmax) else label)
+        lbl = jnp.expand_dims(lbl.astype(jnp.int32), axis)
+        onehot = (lax.broadcasted_iota(
+            jnp.int32, jnp.shape(softmax),
+            axis % jnp.ndim(softmax)) == lbl)
+        dx = (p - onehot.astype(jnp.float32)) * dl
+        if ignore_index >= 0:
+            dx = jnp.where(lbl == ignore_index, 0.0, dx)
+    ctx.set_out("Logits" + GRAD_SUFFIX, dx.astype(softmax.dtype))
+
+
 @op("cross_entropy")
 def _cross_entropy(ctx):
     x = ctx.in_("X")  # probabilities
@@ -698,15 +730,20 @@ def _dropout(ctx):
             ctx.set_out("Mask", jnp.ones_like(x))
         return
     seed = ctx.attr("seed", 0)
-    key = jax.random.key(seed) if ctx.attr("fix_seed", False) else ctx.rng()
+    key = _prng_key(seed) if ctx.attr("fix_seed", False) else ctx.rng()
     keep = jax.random.bernoulli(key, 1.0 - p, jnp.shape(x))
     if impl == "upscale_in_train":
         scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
-        mask = keep.astype(x.dtype) * scale
+        out = jnp.where(keep, x * jnp.asarray(scale, x.dtype),
+                        jnp.zeros((), x.dtype))
     else:
-        mask = keep.astype(x.dtype)
-    ctx.set_out("Out", x * mask)
-    ctx.set_out("Mask", mask)
+        out = jnp.where(keep, x, jnp.zeros((), x.dtype))
+    ctx.set_out("Out", out)
+    # uint8 keep mask, matching the reference's mask tensor
+    # (dropout_op.cu stores uint8) — half the store/backward-read
+    # traffic of a value-dtype mask; the upscale factor is re-derived in
+    # dropout_grad from the attrs
+    ctx.set_out("Mask", keep.astype(jnp.uint8))
 
 
 @grad_maker("dropout")
@@ -733,7 +770,22 @@ def _dropout_grad_maker(op_, no_grad_names=frozenset()):
 def _dropout_grad(ctx):
     dout = ctx.in_("Out" + GRAD_SUFFIX)
     mask = ctx.in_("Mask")
-    ctx.set_out("X" + GRAD_SUFFIX, dout * mask)
+    p = ctx.attr("dropout_prob", 0.5)
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if ctx.attr("is_test", False):
+        # test-mode forward is identity (upscale) or a plain *(1-p)
+        # scale; the stored all-ones mask must NOT be re-scaled
+        ctx.set_out("X" + GRAD_SUFFIX,
+                    dout if impl == "upscale_in_train" else dout * (1.0 - p))
+        return
+    keep = mask.astype(jnp.bool_) if mask.dtype == jnp.uint8 else mask > 0
+    if impl == "upscale_in_train":
+        scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
+        dx = jnp.where(keep, dout * jnp.asarray(scale, dout.dtype),
+                       jnp.zeros((), dout.dtype))
+    else:
+        dx = jnp.where(keep, dout, jnp.zeros((), dout.dtype))
+    ctx.set_out("X" + GRAD_SUFFIX, dx)
 
 
 # --------------------------------------------------------------------------

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..utils.prng import prng_key as _prng_key
 from .registry import op, infer_for
 from ..framework.dtype import VarType, to_numpy_dtype, convert_dtype
 
@@ -70,7 +71,7 @@ def _fill_cbsl(ctx):
 def _gaussian_random(ctx):
     dt = _attr_dtype(ctx)
     seed = ctx.attr("seed", 0)
-    key = jax.random.key(seed) if seed else ctx.rng()
+    key = _prng_key(seed) if seed else ctx.rng()
     out = ctx.attr("mean", 0.0) + ctx.attr("std", 1.0) * jax.random.normal(
         key, _shape_attr(ctx), dtype=jnp.float32
     )
@@ -81,7 +82,7 @@ def _gaussian_random(ctx):
 def _uniform_random(ctx):
     dt = _attr_dtype(ctx)
     seed = ctx.attr("seed", 0)
-    key = jax.random.key(seed) if seed else ctx.rng()
+    key = _prng_key(seed) if seed else ctx.rng()
     out = jax.random.uniform(
         key,
         _shape_attr(ctx),
@@ -98,7 +99,7 @@ def _uniform_random_bsl(ctx):
     shape = list(ctx.attr("shape", []))
     shape[ctx.attr("output_dim_idx", 0)] = jnp.shape(x)[ctx.attr("input_dim_idx", 0)]
     seed = ctx.attr("seed", 0)
-    key = jax.random.key(seed) if seed else ctx.rng()
+    key = _prng_key(seed) if seed else ctx.rng()
     out = jax.random.uniform(
         key, shape, dtype=jnp.float32,
         minval=ctx.attr("min", -1.0), maxval=ctx.attr("max", 1.0),
@@ -110,7 +111,7 @@ def _uniform_random_bsl(ctx):
 def _truncated_gaussian_random(ctx):
     dt = _attr_dtype(ctx)
     seed = ctx.attr("seed", 0)
-    key = jax.random.key(seed) if seed else ctx.rng()
+    key = _prng_key(seed) if seed else ctx.rng()
     out = ctx.attr("mean", 0.0) + ctx.attr("std", 1.0) * jax.random.truncated_normal(
         key, -2.0, 2.0, _shape_attr(ctx), dtype=jnp.float32
     )
@@ -120,7 +121,7 @@ def _truncated_gaussian_random(ctx):
 @op("randint", no_grad=True, stateful=True)
 def _randint(ctx):
     seed = ctx.attr("seed", 0)
-    key = jax.random.key(seed) if seed else ctx.rng()
+    key = _prng_key(seed) if seed else ctx.rng()
     out = jax.random.randint(
         key, _shape_attr(ctx), ctx.attr("low", 0), ctx.attr("high", 100)
     )
@@ -131,7 +132,7 @@ def _randint(ctx):
 def _randperm(ctx):
     n = ctx.attr("n", 1)
     seed = ctx.attr("seed", 0)
-    key = jax.random.key(seed) if seed else ctx.rng()
+    key = _prng_key(seed) if seed else ctx.rng()
     ctx.set_out("Out", jax.random.permutation(key, n).astype(_attr_dtype(ctx, VarType.INT64)))
 
 

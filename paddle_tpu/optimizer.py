@@ -11,6 +11,8 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .backward import OP_ROLE_KEY, OP_ROLE_VAR_KEY, OpRole, append_backward
 from .framework import unique_name
 from .framework.core import (
@@ -464,6 +466,115 @@ class AdamOptimizer(Optimizer):
             attrs={"beta1": self._beta1, "beta2": self._beta2,
                    "epsilon": self._epsilon, "lazy_mode": self._lazy_mode},
         )
+
+    # -- multi-tensor fused path (dygraph) ------------------------------
+    # reference: ir/fuse_optimizer_ops_pass/fuse_adam_op_pass.cc fuses the
+    # per-parameter adam ops of a static graph into one op over coalesced
+    # buffers.  Here the same rewrite happens at trace time: all dense
+    # f32 params flatten into one buffer and ONE adam kernel updates
+    # them, collapsing ~4 tiny HLO kernels per parameter into a handful
+    # of large bandwidth-bound ones.  LAMB must NOT take this path (its
+    # trust ratio is a per-parameter norm), so it is gated on self.type.
+
+    def _dygraph_apply(self, params_grads):
+        import jax
+        import jax.numpy as jnp
+
+        from .utils import flags
+
+        if (self.type not in ("adam", "adamw")
+                or not flags._flags.get("FLAGS_fuse_optimizer_dygraph", True)):
+            return super()._dygraph_apply(params_grads)
+        lr = self._eager_lr()
+        fused, single = [], []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            g = self._eager_regularize(p, g)
+            if (isinstance(g, jax.Array) and g.dtype == jnp.float32
+                    and p._value.dtype == jnp.float32):
+                fused.append((p, g))
+            else:
+                single.append((p, g))
+        for p, g in single:
+            state = self._param_state.setdefault(p.name, {})
+            self._eager_update(p, g, state, lr)
+        if not fused:
+            return
+        layout = tuple((p.name, int(np.prod(p._value.shape) if p._value.shape
+                                    else 1)) for p, _ in fused)
+        state = self._param_state.setdefault("@fused", {})
+        if getattr(self, "_fused_layout", None) != layout or "m1" not in state:
+            self._migrate_fused_state(state, layout, fused)
+        flat_p = jnp.concatenate([jnp.ravel(p._value) for p, _ in fused])
+        flat_g = jnp.concatenate([jnp.ravel(g) for _, g in fused])
+        outs = self._fused_adam_call(flat_p, flat_g, state, lr)
+        new_flat = outs["ParamOut"][0]
+        state["m1"] = outs["Moment1Out"][0]
+        state["m2"] = outs["Moment2Out"][0]
+        state["b1p"] = outs["Beta1PowOut"][0]
+        state["b2p"] = outs["Beta2PowOut"][0]
+        off = 0
+        for p, _ in fused:
+            n = int(np.prod(p._value.shape) if p._value.shape else 1)
+            p._value = jnp.reshape(new_flat[off:off + n], p._value.shape)
+            off += n
+
+    def _fused_adam_call(self, flat_p, flat_g, state, lr):
+        from .ops.registry import eager_call
+
+        return eager_call(
+            self.type,
+            {"Param": [flat_p], "Grad": [flat_g], "Moment1": [state["m1"]],
+             "Moment2": [state["m2"]], "Beta1Pow": [state["b1p"]],
+             "Beta2Pow": [state["b2p"]], "LearningRate": [lr]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon,
+             **({"coeff": getattr(self, "_coeff", 0.0), "with_decay": True}
+                if self.type == "adamw" else {})},
+            {"ParamOut": 1, "Moment1Out": 1, "Moment2Out": 1,
+             "Beta1PowOut": 1, "Beta2PowOut": 1},
+        )
+
+    def _migrate_fused_state(self, state, layout, fused):
+        """(Re)build the flat moment buffers for a new parameter layout,
+        carrying over any existing per-parameter or flat state."""
+        import jax.numpy as jnp
+
+        old_layout = getattr(self, "_fused_layout", None)
+        per_param = {}
+        if old_layout and "m1" in state:
+            off = 0
+            for name, n in old_layout:
+                per_param[name] = (state["m1"][off:off + n],
+                                   state["m2"][off:off + n])
+                off += n
+        m1s, m2s = [], []
+        carried_pows = None
+        for p, _ in fused:
+            n = int(np.prod(p._value.shape) if p._value.shape else 1)
+            if p.name in per_param:
+                m1s.append(per_param[p.name][0])
+                m2s.append(per_param[p.name][1])
+            elif p.name in self._param_state and \
+                    "m1" in self._param_state[p.name]:
+                st = self._param_state[p.name]
+                m1s.append(jnp.ravel(st["m1"]))
+                m2s.append(jnp.ravel(st["m2"]))
+                carried_pows = (st["b1p"], st["b2p"])
+            else:
+                m1s.append(jnp.zeros((n,), jnp.float32))
+                m2s.append(jnp.zeros((n,), jnp.float32))
+        state["m1"] = jnp.concatenate(m1s)
+        state["m2"] = jnp.concatenate(m2s)
+        # migrating mid-run (per-param -> fused) must keep the beta-power
+        # accumulators: resetting them to 1 would restart bias correction
+        # and spike the effective LR by 1/(1-beta1) on the next step
+        if carried_pows is not None and "b1p" not in state:
+            state["b1p"], state["b2p"] = carried_pows
+        state.setdefault("b1p", jnp.ones((1,), jnp.float32))
+        state.setdefault("b2p", jnp.ones((1,), jnp.float32))
+        self._fused_layout = layout
 
     def _eager_update(self, p, g, state, lr):
         import jax.numpy as jnp

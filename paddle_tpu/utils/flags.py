@@ -35,6 +35,15 @@ _DEFAULTS: Dict[str, Any] = {
     # fuse_bn_act_ops / fuse_bn_add_act_ops); applied by the Executor at
     # compile time on a program clone
     "FLAGS_apply_ir_passes": True,
+    # dygraph multi-tensor Adam: flatten all dense f32 param updates
+    # into one fused kernel (reference: ir/fuse_optimizer_ops_pass/
+    # fuse_adam_op_pass.cc does the same rewrite on the static graph)
+    "FLAGS_fuse_optimizer_dygraph": True,
+    # PRNG implementation for dropout/random ops on the single-device
+    # paths: "rbg" uses the TPU hardware RNG (~10% of an ERNIE step
+    # cheaper than threefry mask generation); "threefry2x32" restores
+    # jax's default counter-based stream
+    "FLAGS_tpu_prng_impl": "rbg",
 }
 
 

@@ -1,0 +1,163 @@
+"""Round-4 performance-path regressions: multi-tensor fused Adam
+(reference: ir/fuse_optimizer_ops_pass/fuse_adam_op_pass.cc), the
+closed-form softmax_with_cross_entropy backward (reference:
+softmax_with_cross_entropy_op.cu grad kernel), uint8 dropout masks
+(reference: dropout_op.cu mask tensor), the rbg PRNG flag, and the
+bf16 black-list cast exemption."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.layers as F
+from paddle_tpu.dygraph import Linear, guard, jit_train_step, to_variable
+from paddle_tpu.ops.registry import eager_call
+from paddle_tpu.utils import flags
+
+
+@pytest.fixture
+def fuse_flag():
+    old = flags._flags.get("FLAGS_fuse_optimizer_dygraph")
+    yield
+    flags._flags["FLAGS_fuse_optimizer_dygraph"] = old
+
+
+def _train_bert_tiny(fuse, steps=5, fuse_qkv=False):
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    flags._flags["FLAGS_fuse_optimizer_dygraph"] = fuse
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, fuse_qkv=fuse_qkv)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (2, 16)).astype(np.int64)
+    labels = rng.randint(0, 64, (2, 16)).astype(np.int64)
+    with guard():
+        np.random.seed(0)
+        model = BertForPretraining(cfg)
+        opt = fluid.optimizer.AdamOptimizer(
+            1e-3, parameter_list=model.parameters())
+        step = jit_train_step(model, opt, lambda m, i, l: m(i, l))
+        return [float(np.asarray(step(ids, labels).value()))
+                for _ in range(steps)]
+
+
+def test_fused_adam_matches_per_param(fuse_flag):
+    a = _train_bert_tiny(False)
+    b = _train_bert_tiny(True)
+    np.testing.assert_allclose(a, b, atol=2e-5)
+    assert b[-1] < b[0]
+
+
+def test_fused_qkv_model_trains(fuse_flag):
+    c = _train_bert_tiny(True, fuse_qkv=True)
+    assert np.isfinite(c).all() and c[-1] < c[0]
+
+
+def test_fused_adam_migration_keeps_beta_pows(fuse_flag):
+    """per-param -> fused mid-run migration must carry the beta-power
+    accumulators (resetting them would spike the effective LR by
+    1/(1-beta1) on the migration step)."""
+    with guard():
+        flags._flags["FLAGS_fuse_optimizer_dygraph"] = False
+        lin = Linear(4, 4)
+        opt = fluid.optimizer.AdamOptimizer(
+            0.01, parameter_list=lin.parameters())
+        for _ in range(3):
+            loss = F.mean(lin(to_variable(np.ones((2, 4), np.float32))))
+            loss.backward()
+            opt.minimize(loss)
+            opt.clear_gradients()
+        flags._flags["FLAGS_fuse_optimizer_dygraph"] = True
+        loss = F.mean(lin(to_variable(np.ones((2, 4), np.float32))))
+        loss.backward()
+        opt.minimize(loss)
+        b1p = float(np.asarray(
+            opt._param_state["@fused"]["b1p"]).ravel()[0])
+        assert b1p == pytest.approx(0.9 ** 4, abs=1e-6)
+
+
+def test_softmax_ce_grad_closed_form_axes():
+    """Closed-form CE backward vs jax autodiff, incl. a negative
+    non-last axis (r4 code-review regression)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    for axis, lshape, xshape in ((-1, (2, 4, 1), (2, 4, 7)),
+                                 (-2, (2, 1, 3), (2, 5, 3))):
+        x = rng.randn(*xshape).astype(np.float32)
+        lbl = rng.randint(0, xshape[axis], lshape).astype(np.int64)
+
+        def ref_loss(xv):
+            lp = jax.nn.log_softmax(xv, axis=axis)
+            return -jnp.sum(jnp.take_along_axis(lp, jnp.asarray(lbl),
+                                                axis=axis))
+
+        gref = np.asarray(jax.grad(ref_loss)(jnp.asarray(x)))
+        fwd = eager_call("softmax_with_cross_entropy",
+                         {"Logits": [x], "Label": [lbl]}, {"axis": axis},
+                         {"Softmax": 1, "Loss": 1})
+        g = eager_call("softmax_with_cross_entropy_grad",
+                       {"Softmax": [fwd["Softmax"][0]], "Label": [lbl],
+                        "Loss@GRAD": [np.ones(lshape, np.float32)]},
+                       {"axis": axis}, {"Logits@GRAD": 1})
+        np.testing.assert_allclose(np.asarray(g["Logits@GRAD"][0]), gref,
+                                   atol=1e-4, err_msg=f"axis={axis}")
+
+
+def test_dropout_mask_uint8_and_test_mode_grad():
+    """Mask is stored uint8 (reference dropout_op.cu) and eval-mode
+    backward is identity for upscale_in_train (r4 code-review
+    regression: the all-ones mask must not be re-scaled)."""
+    x = np.ones((4, 8), np.float32)
+    outs = eager_call("dropout", {"X": [x]},
+                      {"dropout_prob": 0.5, "fix_seed": True, "seed": 3,
+                       "dropout_implementation": "upscale_in_train"},
+                      {"Out": 1, "Mask": 1})
+    mask = np.asarray(outs["Mask"][0])
+    assert mask.dtype == np.uint8 and set(np.unique(mask)) <= {0, 1}
+    out = np.asarray(outs["Out"][0])
+    np.testing.assert_allclose(out, mask * 2.0, atol=1e-6)
+    g = eager_call("dropout_grad",
+                   {"Out@GRAD": [np.ones((4, 8), np.float32)],
+                    "Mask": [np.ones((4, 8), np.float32)]},
+                   {"dropout_prob": 0.5, "is_test": True,
+                    "dropout_implementation": "upscale_in_train"},
+                   {"X@GRAD": 1})
+    np.testing.assert_allclose(np.asarray(g["X@GRAD"][0]), 1.0)
+
+
+def test_bf16_blacklist_exemption_keeps_logits_bf16():
+    """Under bf16 AMP the tracer must NOT upcast logits feeding
+    softmax_with_cross_entropy (its lowering does the f32 logsumexp
+    internally) — the cast would materialize an f32 copy of an
+    MLM-head-sized tensor."""
+    from paddle_tpu.dygraph.base import amp_guard
+
+    with guard():
+        x = to_variable(np.random.rand(4, 8).astype(np.float32))
+        w = to_variable(np.random.rand(8, 16).astype(np.float32))
+        lbl = to_variable(np.random.randint(0, 16, (4, 1)).astype(np.int64))
+        with amp_guard(enable=True, dtype="bfloat16"):
+            logits = F.matmul(x, w)
+            assert str(logits._value.dtype) == "bfloat16"
+            loss = F.softmax_with_cross_entropy(logits, lbl)
+        assert np.isfinite(float(np.asarray(F.mean(loss)._value)))
+
+
+def test_prng_impl_flag():
+    """FLAGS_tpu_prng_impl selects the PRNG implementation; both
+    streams must produce valid dropout masks."""
+    from paddle_tpu.utils.prng import prng_key
+
+    old = flags._flags.get("FLAGS_tpu_prng_impl")
+    try:
+        import jax
+
+        for impl in ("rbg", "threefry2x32"):
+            flags._flags["FLAGS_tpu_prng_impl"] = impl
+            key = prng_key(0)
+            bits = np.asarray(jax.random.bernoulli(key, 0.5, (1000,)))
+            assert 300 < bits.sum() < 700
+    finally:
+        flags._flags["FLAGS_tpu_prng_impl"] = old
