@@ -486,6 +486,11 @@ def _softmax_ce_grad(ctx):
         dx = (p - onehot.astype(jnp.float32)) * dl
         if ignore_index >= 0:
             dx = jnp.where(lbl == ignore_index, 0.0, dx)
+    if ctx.has_input("Softmax" + GRAD_SUFFIX):
+        # a consumer of the Softmax output (e.g. a distillation KL term)
+        # contributes through the softmax jacobian: p * (dS - <dS, p>)
+        ds = ctx.in_("Softmax" + GRAD_SUFFIX).astype(jnp.float32)
+        dx = dx + p * (ds - jnp.sum(ds * p, axis=axis, keepdims=True))
     ctx.set_out("Logits" + GRAD_SUFFIX, dx.astype(softmax.dtype))
 
 

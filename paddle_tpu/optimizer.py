@@ -549,6 +549,15 @@ class AdamOptimizer(Optimizer):
                 per_param[name] = (state["m1"][off:off + n],
                                    state["m2"][off:off + n])
                 off += n
+            # params leaving the fused set keep their moments in the
+            # per-param store (with the beta pows) so a later re-entry
+            # resumes instead of restarting bias correction at zero
+            new_names = {name for name, _ in layout}
+            for name, _ in old_layout:
+                if name not in new_names:
+                    self._param_state[name] = {
+                        "m1": per_param[name][0], "m2": per_param[name][1],
+                        "b1p": state["b1p"], "b2p": state["b2p"]}
         m1s, m2s = [], []
         carried_pows = None
         for p, _ in fused:
@@ -586,6 +595,10 @@ class AdamOptimizer(Optimizer):
             state["m2"] = jnp.zeros_like(p._value)
             state["b1p"] = jnp.ones((1,), jnp.float32)
             state["b2p"] = jnp.ones((1,), jnp.float32)
+        elif jnp.shape(state["m1"]) != jnp.shape(p._value):
+            # moments stashed flat by a fused-set migration
+            state["m1"] = jnp.reshape(state["m1"], jnp.shape(p._value))
+            state["m2"] = jnp.reshape(state["m2"], jnp.shape(p._value))
         outs = eager_call(
             self.type,
             {"Param": [p._value], "Grad": [g], "Moment1": [state["m1"]],

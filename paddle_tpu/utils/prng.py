@@ -24,9 +24,15 @@ import jax
 from . import flags
 
 
+_KNOWN_IMPLS = ("rbg", "unsafe_rbg", "threefry2x32")
+
+
 def prng_key(seed: int = 0):
     impl = flags._flags.get("FLAGS_tpu_prng_impl", "rbg")
+    if impl not in _KNOWN_IMPLS:
+        raise ValueError(
+            f"FLAGS_tpu_prng_impl={impl!r} is not one of {_KNOWN_IMPLS}")
     try:
         return jax.random.key(int(seed), impl=impl)
-    except Exception:  # unknown impl name / old jax: fall back to default
+    except TypeError:  # old jax without the impl kwarg
         return jax.random.key(int(seed))

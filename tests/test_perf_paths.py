@@ -161,3 +161,36 @@ def test_prng_impl_flag():
             assert 300 < bits.sum() < 700
     finally:
         flags._flags["FLAGS_tpu_prng_impl"] = old
+
+
+def test_softmax_ce_grad_softmax_cotangent():
+    """Distillation pattern: a consumer of the Softmax output must
+    contribute through the softmax jacobian in the closed-form grad
+    (r4 code-review regression)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 7).astype(np.float32)
+    lbl = rng.randint(0, 7, (4, 1)).astype(np.int64)
+    t = rng.rand(4, 7).astype(np.float32)
+    t /= t.sum(1, keepdims=True)
+
+    def full_loss(xv):
+        lp = jax.nn.log_softmax(xv)
+        ce = -jnp.mean(jnp.take_along_axis(lp, jnp.asarray(lbl), 1))
+        sm = jax.nn.softmax(xv)
+        return ce + jnp.mean((sm - jnp.asarray(t)) ** 2)
+
+    gref = np.asarray(jax.grad(full_loss)(jnp.asarray(x)))
+    with guard():
+        xv = to_variable(x)
+        xv.stop_gradient = False
+        loss_, sm = F.softmax_with_cross_entropy(
+            xv, to_variable(lbl), return_softmax=True)
+        total = F.elementwise_add(
+            F.mean(loss_),
+            F.mean(F.square(F.elementwise_sub(sm, to_variable(t)))))
+        total.backward()
+        np.testing.assert_allclose(np.asarray(xv._grad_value), gref,
+                                   atol=1e-4)
