@@ -1,0 +1,116 @@
+"""Double-buffered sparse prefetch (SURVEY §7 hard part 5).
+
+Reference analog: the background Communicator threads that keep pulls
+off the critical path (operators/distributed/communicator.h:237) and
+parameter_prefetch.cc.  Two mechanisms:
+
+* ``parallel_pull``: fan a multi-slot ``distributed_lookup_table`` out
+  over a thread pool — one RPC round-trip of latency instead of
+  n_slots.  Exact: same rows, same freshness (the data client keeps one
+  socket per thread, service.py _BinaryDataClient).
+* ``SparsePrefetcher``: overlap batch N+1's sparse pulls with batch N's
+  compute.  The pulled rows are one step stale by construction — the
+  async-communicator contract (ASYNC/GEO trainers read stale params by
+  design); it is therefore only engaged when an async-family
+  communicator is installed, or when FLAGS_ps_sparse_prefetch forces
+  it.  SYNC-mode runs keep their exact semantics.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_lock = threading.Lock()
+
+
+def _shared_pool() -> ThreadPoolExecutor:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(max_workers=8,
+                                       thread_name_prefix="ps-prefetch")
+        return _pool
+
+
+_pull_ema = {}  # id(client) -> EMA pull seconds (latency-adaptive gate)
+_PARALLEL_FLOOR_S = 5e-4
+
+
+def parallel_pull(client, table: str, flat_ids_list):
+    """Pull several id vectors from one table, fanning out over the
+    thread pool when a single pull's measured latency exceeds the
+    thread-handoff cost — real-network (DCN) pulls parallelize, loopback
+    microsecond pulls stay sequential.  The first pull of every batch is
+    timed to keep the EMA current."""
+    import time
+
+    if not flat_ids_list:
+        return []
+    t0 = time.perf_counter()
+    first = client.pull_sparse(table, flat_ids_list[0])
+    dt = time.perf_counter() - t0
+    key = id(client)
+    _pull_ema[key] = 0.5 * dt + 0.5 * _pull_ema.get(key, dt)
+    rest = flat_ids_list[1:]
+    if not rest:
+        return [first]
+    if _pull_ema[key] < _PARALLEL_FLOOR_S:
+        return [first] + [client.pull_sparse(table, ids) for ids in rest]
+    pool = _shared_pool()
+    futs = [pool.submit(client.pull_sparse, table, ids) for ids in rest]
+    return [first] + [f.result() for f in futs]
+
+
+class SparsePrefetcher:
+    """submit() batch N+1's ids while batch N computes; take() pops the
+    pre-pulled rows when the lookup op reaches that batch."""
+
+    def __init__(self, client):
+        self._client = client
+        self._futs = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(table, flat_ids):
+        return (table, hashlib.sha1(flat_ids.tobytes()).hexdigest(),
+                len(flat_ids))
+
+    def submit(self, table: str, flat_ids):
+        k = self._key(table, flat_ids)
+        with self._lock:
+            if k in self._futs:
+                return
+            self._futs[k] = _shared_pool().submit(
+                self._client.pull_sparse, table, flat_ids)
+
+    def take(self, table: str, flat_ids):
+        """Rows for (table, ids) if they were prefetched, else None."""
+        with self._lock:
+            fut = self._futs.pop(self._key(table, flat_ids), None)
+        return None if fut is None else fut.result()
+
+    def drain(self):
+        with self._lock:
+            futs, self._futs = list(self._futs.values()), {}
+        for f in futs:
+            try:
+                f.result()
+            except Exception:
+                pass
+
+
+def prefetch_enabled() -> bool:
+    """Auto policy: stale-tolerant modes only (async-family communicator
+    installed), unless the flag forces it either way."""
+    from ..utils import flags
+    from . import runtime
+
+    mode = str(flags._flags.get("FLAGS_ps_sparse_prefetch", "auto")).lower()
+    if mode in ("1", "true", "on"):
+        return True
+    if mode in ("0", "false", "off"):
+        return False
+    return runtime.communicator() is not None

@@ -10,7 +10,19 @@ import time
 from typing import Optional
 
 _ctx = {"client": None, "trainer_id": 0, "heartbeat_thread": None,
-        "heartbeat_stop": None, "communicator": None}
+        "heartbeat_stop": None, "communicator": None, "prefetcher": None}
+
+
+def prefetcher():
+    """Lazily-built SparsePrefetcher bound to the current client
+    (distributed_ps/prefetch.py)."""
+    p = _ctx.get("prefetcher")
+    if p is None or p._client is not _ctx["client"]:
+        from .prefetch import SparsePrefetcher
+
+        p = SparsePrefetcher(client())
+        _ctx["prefetcher"] = p
+    return p
 
 
 def set_client(client, trainer_id: int = 0, heartbeat_interval: float = 0.0):
@@ -76,3 +88,4 @@ def clear():
     _ctx["client"] = None
     _ctx["heartbeat_thread"] = None
     _ctx["heartbeat_stop"] = None
+    _ctx["prefetcher"] = None

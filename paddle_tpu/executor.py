@@ -216,6 +216,7 @@ class Executor:
         check_nan_inf = bool(flag("check_nan_inf"))
         unused_check = bool(flag("enable_unused_var_check"))
         ir_passes = bool(flag("apply_ir_passes"))
+        donate = bool(flag("tpu_donate_buffers"))
         feed_spec = tuple(
             sorted(
                 (k, tuple(np.shape(v)),
@@ -224,7 +225,7 @@ class Executor:
             )
         )
         key = (program._uid, program._version, feed_spec, tuple(fetch_names),
-               check_nan_inf, unused_check, ir_passes)
+               check_nan_inf, unused_check, ir_passes, donate)
         hit = self._cache.get(key)
         if hit is not None:
             return hit
@@ -398,7 +399,11 @@ class Executor:
                 checkify.check_error(err)
                 return out
         else:
-            jitted = jax.jit(fn, donate_argnums=(0,))
+            # donation is disabled under the multi-thread trainer: with N
+            # Hogwild workers sharing the parent scope's param buffers, a
+            # donated buffer consumed by worker A would be a deleted
+            # buffer in worker B's already-captured argument list
+            jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
         compiled = _Compiled(jitted, state_in, state_out, fetch)
         compiled.raw_fn = fn
         compiled.donatable = tuple(donatable)
@@ -522,7 +527,7 @@ class Executor:
         from .reader import _train_from_dataset
 
         return _train_from_dataset(self, program, dataset, scope, fetch_list,
-                                   fetch_info, print_period)
+                                   fetch_info, print_period, thread=thread)
 
     def infer_from_dataset(self, *args, **kwargs):
         return self.train_from_dataset(*args, **kwargs)

@@ -121,12 +121,19 @@ def _checkpoint_notify(ctx):
 @_host("distributed_lookup_table")
 def _distributed_lookup_table(ctx):
     """Remote sparse embedding pull (reference:
-    distributed_lookup_table_op.cc + parameter_prefetch.cc)."""
+    distributed_lookup_table_op.cc + parameter_prefetch.cc).  Multi-slot
+    pulls fan out over a thread pool (one RPC round-trip of latency
+    instead of n_slots), and rows pre-pulled by the SparsePrefetcher
+    (train_from_dataset's one-batch look-ahead, async modes) are taken
+    from its buffer instead of re-pulled."""
+    from ..distributed_ps import prefetch as _prefetch
+    from ..distributed_ps import runtime as _runtime
+
     client = _client()
     table = ctx.attr("table_name")
     dim = ctx.attr("emb_dim")
     ids_vals = ctx.ins("Ids")
-    outs = []
+    shapes, flats = [], []
     for ids in ids_vals:
         ids_np = np.asarray(ids).astype(np.int64)
         # match lookup_table's shape rule (nn_ops._lookup): a trailing
@@ -134,10 +141,24 @@ def _distributed_lookup_table(ctx):
         shape = ids_np.shape
         if len(shape) > 1 and shape[-1] == 1:
             shape = shape[:-1]
-        flat = ids_np.ravel()
-        rows = client.pull_sparse(table, flat)
-        outs.append(rows.reshape(shape + (dim,)))
-    ctx.set_out("Outputs", outs)
+        shapes.append(shape)
+        flats.append(ids_np.ravel())
+    pre = _runtime._ctx.get("prefetcher")
+    rows_list = [None] * len(flats)
+    missing = []
+    if pre is not None:
+        for i, flat in enumerate(flats):
+            rows_list[i] = pre.take(table, flat)
+    for i, r in enumerate(rows_list):
+        if r is None:
+            missing.append(i)
+    if missing:
+        pulled = _prefetch.parallel_pull(client, table,
+                                         [flats[i] for i in missing])
+        for i, rows in zip(missing, pulled):
+            rows_list[i] = rows
+    ctx.set_out("Outputs", [rows.reshape(shape + (dim,))
+                            for rows, shape in zip(rows_list, shapes)])
 
 
 @grad_maker("distributed_lookup_table")

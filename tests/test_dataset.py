@@ -390,3 +390,75 @@ def test_sentiment_trainable():
             out = exe.run(main, feed={"x": feats, "y": labels},
                           fetch_list=[loss.name, acc.name])
         assert float(np.asarray(out[1])) > 0.9
+
+
+def test_train_from_dataset_multithread(tmp_path):
+    """thread=4 runs the MultiTrainer/HogwildWorker analog: N workers
+    round-robin the batch stream with child scopes; the shared params
+    must end up trained (loss drops vs init) and every batch consumed
+    exactly once."""
+    files = []
+    for i in range(4):
+        f = str(tmp_path / f"{i}.txt")
+        _write_multislot(f, 16, seed=20 + i)
+        files.append(f)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [8], dtype="int64")
+        lens = fluid.layers.data("ids.lens", [-1], dtype="int64",
+                                 append_batch_size=False)
+        dense = fluid.layers.data("dense", [4])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[50, 8])
+        pooled = fluid.layers.sequence_pool(emb, "sum", length=lens)
+        feat = fluid.layers.concat([pooled, dense], axis=1)
+        fc = fluid.layers.fc(feat, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(fc, label))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_pad_seq_len({"ids": 8})
+    ds.set_filelist(files)
+    ds.set_use_var([ids, dense, label])
+    ds.load_into_memory()
+
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    import numpy as np
+
+    with scope_guard(Scope()):
+        exe = fluid.Executor(pt.CPUPlace())
+        exe.run(startup)
+        from paddle_tpu.framework.scope import global_scope
+
+        w0 = np.array(global_scope().get("embedding_0.w_0"))
+        probe = next(ds._iter_batches())
+        probe = {k: v for k, v in probe.items()
+                 if main.global_block().has_var(k)}
+        initial = float(np.asarray(exe.run(
+            main, feed=probe, fetch_list=[loss])[0]).ravel()[0])
+        # count executor.run calls: every batch must be consumed once
+        n_batches = sum(1 for _ in ds._iter_batches())
+        calls = [0]
+        orig_run = exe.run
+
+        def counting_run(*a, **kw):
+            calls[0] += 1
+            return orig_run(*a, **kw)
+
+        exe.run = counting_run
+        # run several epochs multi-threaded
+        for _ in range(4):
+            exe.train_from_dataset(main, ds, thread=4, fetch_list=[loss],
+                                   print_period=1000)
+        exe.run = orig_run
+        assert calls[0] == 4 * n_batches, (calls[0], n_batches)
+        w1 = np.array(global_scope().get("embedding_0.w_0"))
+        assert not np.allclose(w0, w1)  # Hogwild updates landed in parent
+        final = float(np.asarray(exe.run(
+            main, feed=probe, fetch_list=[loss])[0]).ravel()[0])
+        assert np.isfinite(final) and final < initial

@@ -198,3 +198,110 @@ def test_distributed_lookup_table():
     finally:
         server.stop()
         runtime.clear()
+
+
+def test_sparse_prefetcher_and_parallel_pull():
+    """r4: double-buffered sparse prefetch (SURVEY §7 hard part 5) —
+    submit/take round-trips the same rows a direct pull returns; take
+    without submit is a miss; parallel_pull preserves order/values."""
+    import numpy as np
+
+    from paddle_tpu.distributed_ps.prefetch import (SparsePrefetcher,
+                                                    parallel_pull)
+    from paddle_tpu.distributed_ps.service import PSClient, PSServer
+
+    server = PSServer("127.0.0.1:0", n_trainers=1).start()
+    try:
+        client = PSClient([server.endpoint])
+        client.create_sparse("emb", 4, optimizer="sgd", lr=0.5)
+        rng = np.random.RandomState(3)
+        flats = [rng.randint(0, 1000, 64).astype(np.int64)
+                 for _ in range(6)]
+        direct = [client.pull_sparse("emb", f) for f in flats]
+        par = parallel_pull(client, "emb", flats)
+        for a, b in zip(direct, par):
+            np.testing.assert_array_equal(a, b)
+
+        pre = SparsePrefetcher(client)
+        assert pre.take("emb", flats[0]) is None  # no submit -> miss
+        pre.submit("emb", flats[0])
+        got = pre.take("emb", flats[0])
+        np.testing.assert_array_equal(got, direct[0])
+        assert pre.take("emb", flats[0]) is None  # consumed exactly once
+    finally:
+        server.stop()
+
+
+def test_train_from_dataset_prefetch_overlap():
+    """r4: the one-batch look-ahead submits the next batch's ids while
+    the current batch runs; the lookup op consumes the prefetched rows
+    (FLAGS_ps_sparse_prefetch=1 forces the stale-tolerant mode on)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed_ps import runtime
+    from paddle_tpu.distributed_ps.service import PSServer
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.incubate.fleet.base.role_maker import (Role,
+                                                           UserDefinedRoleMaker)
+    from paddle_tpu.incubate.fleet.parameter_server import FleetTranspiler
+    from paddle_tpu.utils import flags
+
+    class SyntheticDataset:
+        thread_num = 1
+
+        def _iter_batches(self):
+            r = np.random.RandomState(7)
+            for _ in range(6):
+                yield {"ids": r.randint(0, 500, (16, 1)).astype(np.int64),
+                       "label": r.randint(0, 2, (16, 1)).astype(np.int64)}
+
+    server = PSServer("127.0.0.1:0", n_trainers=1).start()
+    fleet = FleetTranspiler()
+    old = flags._flags.get("FLAGS_ps_sparse_prefetch")
+    flags._flags["FLAGS_ps_sparse_prefetch"] = "1"
+    try:
+        fleet.init(UserDefinedRoleMaker(
+            current_id=0, role=Role.WORKER, worker_num=1,
+            server_endpoints=[server.endpoint]))
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [1], dtype="int64")
+            label = fluid.layers.data("label", [1], dtype="int64")
+            emb = fluid.layers.embedding(ids, size=[500, 8],
+                                         is_distributed=True,
+                                         param_attr=fluid.ParamAttr(
+                                             name="pf_emb"))
+            fc = fluid.layers.fc(emb, size=2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(fc, label))
+            fleet.distributed_optimizer(
+                fluid.optimizer.SGDOptimizer(0.1)).minimize(loss)
+        exe = fluid.Executor(pt.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            fleet.init_worker()
+            try:
+                takes = []
+                pre = runtime.prefetcher()
+                orig_take = pre.take
+
+                def spying_take(table, flat):
+                    r = orig_take(table, flat)
+                    takes.append(r is not None)
+                    return r
+
+                pre.take = spying_take
+                exe.train_from_dataset(main, SyntheticDataset(),
+                                       fetch_list=[loss],
+                                       print_period=1000)
+                # batches 2..6 were prefetched by the look-ahead
+                assert any(takes), takes
+            finally:
+                fleet.stop_worker()
+    finally:
+        flags._flags["FLAGS_ps_sparse_prefetch"] = old
+        server.stop()
+        runtime.clear()
