@@ -44,6 +44,8 @@ from . import nn
 from . import metric
 from . import distribution
 from . import static
+from . import incubate
+from .incubate import complex  # noqa: A004  (paddle.complex preview API)
 from .tensor import (
     to_tensor, full, full_like, zeros, ones, zeros_like, ones_like,
     arange, linspace, matmul, concat, reshape, transpose, stack, split,

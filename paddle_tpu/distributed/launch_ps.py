@@ -1,0 +1,113 @@
+"""python -m paddle_tpu.distributed.launch_ps — parameter-server launcher.
+
+Reference: python/paddle/distributed/launch_ps.py — spawns a pserver
+process set and a trainer process set for one training script, wiring
+the PADDLE_* env protocol the fleet role makers consume
+(incubate/fleet/base/role_maker.py PaddleCloudRoleMaker):
+
+* pserver i: TRAINING_ROLE=PSERVER, PADDLE_PORT=<its port>,
+  POD_IP=<its ip>, PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINERS_NUM
+* trainer i: TRAINING_ROLE=TRAINER, PADDLE_TRAINER_ID=i,
+  PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINERS_NUM
+
+The script itself decides its role from the env (fleet.init with
+PaddleCloudRoleMaker), exactly like reference PS entry scripts.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch_ps")
+    p.add_argument("--cluster_node_ips", type=str, default="127.0.0.1")
+    p.add_argument("--node_ip", type=str, default="127.0.0.1")
+    p.add_argument("--start_port", type=int, default=6170)
+    p.add_argument("--endpoints", type=str, default="",
+                   help="comma list of pserver ip:port (default: "
+                        "127.0.0.1:start_port..start_port+server_num)")
+    p.add_argument("--worker_num", type=int, default=2)
+    p.add_argument("--server_num", type=int, default=2)
+    p.add_argument("--log_dir", type=str, default="logs")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def start_procs(args, wait=True):
+    if args.endpoints:
+        endpoints = args.endpoints
+        # the endpoint list IS the server set: derive server_num from it
+        # (a mismatched --server_num would crash or leave trainers
+        # waiting on servers that were never spawned)
+        args.server_num = len(endpoints.split(","))
+    else:
+        endpoints = ",".join(
+            f"127.0.0.1:{port}"
+            for port in range(args.start_port,
+                              args.start_port + args.server_num))
+    ep_ips = [e.split(":")[0] for e in endpoints.split(",")]
+    ep_ports = [e.split(":")[1] for e in endpoints.split(",")]
+    base_env = dict(os.environ)
+    base_env.pop("http_proxy", None)
+    base_env.pop("https_proxy", None)
+    procs, logs = [], []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    def spawn(role_env, log_name):
+        env = dict(base_env)
+        env.update({
+            "PADDLE_PSERVERS_IP_PORT_LIST": endpoints,
+            "PADDLE_TRAINERS_NUM": str(args.worker_num),
+        })
+        env.update(role_env)
+        cmd = [sys.executable, "-u", args.training_script] \
+            + args.training_script_args
+        if args.log_dir:
+            fn = open(os.path.join(args.log_dir, log_name), "w")
+            logs.append(fn)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=fn,
+                                          stderr=fn))
+        else:
+            procs.append(subprocess.Popen(cmd, env=env))
+
+    for i in range(args.server_num):
+        spawn({"TRAINING_ROLE": "PSERVER", "PADDLE_PORT": ep_ports[i],
+               "POD_IP": ep_ips[i]}, f"serverlog.{i}")
+    for i in range(args.worker_num):
+        spawn({"TRAINING_ROLE": "TRAINER", "PADDLE_TRAINER_ID": str(i)},
+              f"workerlog.{i}")
+
+    if not wait:
+        return procs
+    try:
+        # trainers decide completion; servers are killed when the last
+        # trainer exits (reference launch_ps waits on all procs — but its
+        # pservers run forever; reaping on trainer completion is the
+        # usable behavior the reference's users script around)
+        rc = 0
+        for p in procs[args.server_num:]:
+            rc = p.wait() or rc
+        for p in procs[:args.server_num]:
+            p.terminate()
+        for p in procs[:args.server_num]:
+            p.wait()
+        return rc
+    finally:
+        for fn in logs:
+            fn.close()
+
+
+def launch():
+    args = _parse_args()
+    rc = start_procs(args)
+    if rc:
+        sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch()

@@ -184,6 +184,15 @@ class PSServer:
         self.sparse: Dict[str, SparseTable] = {}
         self._barrier = threading.Barrier(max(n_trainers, 1))
         self._barrier_monitor = BarrierMonitor(n_trainers)
+        from .update_recorder import AsyncSparseParamUpdateRecorder
+
+        # async/geo mode: per-trainer updated-rows tracking (reference:
+        # async_sparse_param_update_recorder.h — only instantiated when
+        # sync_mode=false there; here recording is off until an
+        # async-family mode enables it, so sync servers never accumulate
+        # per-trainer row sets)
+        self.update_recorder = AsyncSparseParamUpdateRecorder(n_trainers)
+        self.record_sparse_updates = False
         self._blobs: Dict[str, list] = {}
         self._heartbeats: Dict[int, float] = {}
         self._lock = threading.Lock()
@@ -256,7 +265,24 @@ class PSServer:
             _send_msg(sock, "ok", arrays=[self.sparse[name].pull(arrays[0])])
         elif op == "push_sparse":
             self.sparse[name].push_grad(arrays[0], arrays[1])
+            if self.record_sparse_updates:
+                self.update_recorder.update(name, arrays[0].tolist())
             _send_msg(sock, "ok")
+        elif op == "record_sparse_update":
+            # native-data-plane pushes notify the recorder via this
+            # control-plane message (also enables recording: only
+            # async-family clients send it)
+            self.record_sparse_updates = True
+            self.update_recorder.update(name, arrays[0].tolist())
+            _send_msg(sock, "ok")
+        elif op == "enable_update_recording":
+            self.record_sparse_updates = bool(meta.get("enable", True))
+            _send_msg(sock, "ok")
+        elif op == "pull_updated_rows":
+            rows = self.update_recorder.get_and_clear(
+                name, int(meta.get("trainer_id", 0)))
+            _send_msg(sock, "ok",
+                      arrays=[np.asarray(rows, np.int64)])
         elif op == "barrier":
             # reference: send_barrier/fetch_barrier ops + BarrierMonitor
             trainer_id = meta.get("trainer_id", -1)
@@ -569,6 +595,19 @@ class PSClient:
         _, arrays = self._call(ep, "pull_dense", name)
         return arrays[0]
 
+    def record_sparse_update(self, name, ids):
+        """Notify the shard's AsyncSparseParamUpdateRecorder of rows a
+        native-data-plane push touched."""
+        self._call(self._ep_for(name), "record_sparse_update", name,
+                   arrays=[np.asarray(ids, np.int64)])
+
+    def pull_updated_rows(self, name, trainer_id=0):
+        """Drain this trainer's pending updated-row set for a sparse
+        param (async_sparse_param_update_recorder.h GetAndClear)."""
+        _, arrays = self._call(self._ep_for(name), "pull_updated_rows",
+                               name, {"trainer_id": int(trainer_id)})
+        return arrays[0]
+
     def push_dense(self, name, grad, sync=True):
         ep = self._ep_for(name)
         d = self._data_ep(ep)
@@ -600,13 +639,18 @@ class PSClient:
         _, arrays = self._call(ep, "pull_sparse", name, arrays=[ids])
         return arrays[0]
 
-    def push_sparse(self, name, ids, grads):
+    def push_sparse(self, name, ids, grads, record=False):
+        """``record=True`` also notifies the shard's async sparse
+        update recorder (needed on the native data plane, which
+        bypasses the JSON handler that records automatically)."""
         ep = self._ep_for(name)
         d = self._data_ep(ep)
         ids = np.asarray(ids, np.int64).ravel()
         grads = np.asarray(grads, np.float32)
         if d is not None:
             self._data.call(d[0], d[1], 4, name, ids, grads)
+            if record:
+                self.record_sparse_update(name, ids)
             return
         self._call(ep, "push_sparse", name, arrays=[ids, grads])
 

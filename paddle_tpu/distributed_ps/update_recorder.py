@@ -1,0 +1,53 @@
+"""AsyncSparseParamUpdateRecorder — server-side tracking of which sparse
+rows each trainer's pushes touched, so async/geo trainers can pull only
+the rows OTHER trainers changed instead of re-pulling whole tables.
+
+Reference: operators/distributed/async_sparse_param_update_recorder.h —
+Update(grad_name, rows) adds the rows to EVERY trainer's pending set;
+GetAndClear(param_name, trainer_id) drains one trainer's set.  (The
+reference also adds the pushing trainer's own rows to its own set; that
+exact behavior is kept — the trainer-side cache dedupes.)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List
+
+
+class AsyncSparseParamUpdateRecorder:
+    def __init__(self, trainer_num: int,
+                 grad_to_param: Dict[str, str] | None = None):
+        self.trainer_num = int(trainer_num)
+        self.grad_to_param = dict(grad_to_param or {})
+        self._lock = threading.Lock()
+        self._pending: Dict[str, List[set]] = {}
+
+    def _rows_for(self, param_name: str) -> List[set]:
+        if param_name not in self._pending:
+            self._pending[param_name] = [set()
+                                         for _ in range(self.trainer_num)]
+        return self._pending[param_name]
+
+    def update(self, grad_name: str, update_rows: Iterable[int]) -> None:
+        param = self.grad_to_param.get(grad_name, grad_name)
+        rows = [int(r) for r in update_rows]
+        with self._lock:
+            for s in self._rows_for(param):
+                s.update(rows)
+
+    def get_and_clear(self, param_name: str, trainer_id: int) -> List[int]:
+        if trainer_id >= self.trainer_num:
+            raise IndexError(
+                f"trainer_id {trainer_id} >= trainer_num {self.trainer_num}")
+        with self._lock:
+            sets = self._rows_for(param_name)
+            out = sorted(sets[trainer_id])
+            sets[trainer_id] = set()
+        return out
+
+    def has_param(self, param_name: str) -> bool:
+        with self._lock:
+            return param_name in self._pending
+
+    def has_grad(self, grad_name: str) -> bool:
+        return grad_name in self.grad_to_param

@@ -1,1 +1,2 @@
 from . import fleet
+from . import complex  # noqa: A004

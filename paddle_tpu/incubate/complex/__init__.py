@@ -1,0 +1,25 @@
+"""paddle.complex — complex-tensor preview namespace.
+
+Reference: python/paddle/incubate/complex/ (helper.py is_complex +
+tensor/{math,linalg,manipulation}.py) over fluid.framework
+ComplexVariable (framework.py:1691) — a (real, imag) pair of Variables.
+
+TPU-first note: jax/XLA support complex dtypes natively, but the
+reference API contract is the (real, imag) pair with these ten
+functions, so the ops here are compositions of the package's real ops —
+they trace through the same registry in both dygraph and static mode
+(and therefore jit/grad like everything else).
+"""
+from __future__ import annotations
+
+from . import tensor
+from .tensor import (elementwise_add, elementwise_div, elementwise_mul,
+                     elementwise_sub, kron, matmul, reshape, sum, trace,
+                     transpose)
+from .helper import is_complex
+from .variable import ComplexVariable
+
+__all__ = ["ComplexVariable", "is_complex", "tensor",
+           "elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "kron", "trace", "sum", "matmul",
+           "reshape", "transpose"]

@@ -1,0 +1,19 @@
+"""Reference: python/paddle/incubate/complex/helper.py."""
+from __future__ import annotations
+
+from .variable import ComplexVariable
+
+
+def is_complex(x) -> bool:
+    return isinstance(x, ComplexVariable)
+
+
+def is_real(x) -> bool:
+    return not isinstance(x, ComplexVariable)
+
+
+def complex_variable_exists(inputs, layer_name):
+    if any(is_complex(x) for x in inputs):
+        return
+    raise ValueError(
+        f"{layer_name} expects at least one ComplexVariable input")

@@ -1,0 +1,149 @@
+"""Complex tensor ops — reference:
+python/paddle/incubate/complex/tensor/{math,linalg,manipulation}.py.
+
+Each op is the textbook complex decomposition over the package's REAL
+ops, so the whole family traces/differentiates through the standard
+registry (dygraph and static alike).  Real operands broadcast in as
+(x, 0i), matching the reference's mixed real/complex support.
+"""
+from __future__ import annotations
+
+from ... import layers as F
+from ... import tensor as pt_tensor
+from .helper import complex_variable_exists, is_complex
+from .variable import ComplexVariable
+
+__all__ = ["elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "kron", "trace", "sum", "matmul",
+           "reshape", "transpose"]
+
+
+def _parts(x):
+    if is_complex(x):
+        return x.real, x.imag
+    return x, None
+
+
+def _add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return F.elementwise_add(a, b)
+
+
+def _sub(a, b):
+    if b is None:
+        return a
+    if a is None:
+        return F.scale(b, -1.0)
+    return F.elementwise_sub(a, b)
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    complex_variable_exists([x, y], "elementwise_add")
+    xr, xi = _parts(x)
+    yr, yi = _parts(y)
+    return ComplexVariable(F.elementwise_add(xr, yr, axis=axis),
+                           _add(xi, yi))
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    complex_variable_exists([x, y], "elementwise_sub")
+    xr, xi = _parts(x)
+    yr, yi = _parts(y)
+    return ComplexVariable(F.elementwise_sub(xr, yr, axis=axis),
+                           _sub(xi, yi))
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    complex_variable_exists([x, y], "elementwise_mul")
+    xr, xi = _parts(x)
+    yr, yi = _parts(y)
+    # (a+bi)(c+di) = (ac-bd) + (ad+bc)i
+    real = F.elementwise_mul(xr, yr, axis=axis)
+    if xi is not None and yi is not None:
+        real = F.elementwise_sub(real, F.elementwise_mul(xi, yi, axis=axis))
+    imag = None
+    if yi is not None:
+        imag = F.elementwise_mul(xr, yi, axis=axis)
+    if xi is not None:
+        imag = _add(imag, F.elementwise_mul(xi, yr, axis=axis))
+    return ComplexVariable(real, imag)
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    complex_variable_exists([x, y], "elementwise_div")
+    yr, yi = _parts(y)
+    if yi is None:
+        xr, xi = _parts(x)
+        return ComplexVariable(F.elementwise_div(xr, yr, axis=axis),
+                               F.elementwise_div(xi, yr, axis=axis))
+    # x / y = x * conj(y) / |y|^2
+    denom = _add(F.elementwise_mul(yr, yr),
+                 F.elementwise_mul(yi, yi))
+    num = elementwise_mul(x, ComplexVariable(yr, F.scale(yi, -1.0)),
+                          axis=axis)
+    return ComplexVariable(F.elementwise_div(num.real, denom, axis=axis),
+                           F.elementwise_div(num.imag, denom, axis=axis))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    complex_variable_exists([x, y], "matmul")
+    xr, xi = _parts(x)
+    yr, yi = _parts(y)
+
+    def mm(a, b):
+        return F.matmul(a, b, transpose_x=transpose_x,
+                        transpose_y=transpose_y, alpha=alpha)
+
+    real = mm(xr, yr)
+    if xi is not None and yi is not None:
+        real = F.elementwise_sub(real, mm(xi, yi))
+    imag = None
+    if yi is not None:
+        imag = mm(xr, yi)
+    if xi is not None:
+        imag = _add(imag, mm(xi, yr))
+    return ComplexVariable(real, imag)
+
+
+def kron(x, y, name=None):
+    complex_variable_exists([x, y], "kron")
+    xr, xi = _parts(x)
+    yr, yi = _parts(y)
+    real = pt_tensor.kron(xr, yr)
+    if xi is not None and yi is not None:
+        real = F.elementwise_sub(real, pt_tensor.kron(xi, yi))
+    imag = None
+    if yi is not None:
+        imag = pt_tensor.kron(xr, yi)
+    if xi is not None:
+        imag = _add(imag, pt_tensor.kron(xi, yr))
+    return ComplexVariable(real, imag)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    complex_variable_exists([x], "trace")
+    return ComplexVariable(
+        pt_tensor.trace(x.real, offset=offset, axis1=axis1, axis2=axis2),
+        pt_tensor.trace(x.imag, offset=offset, axis1=axis1, axis2=axis2))
+
+
+def sum(input, dim=None, keep_dim=False, name=None):
+    complex_variable_exists([input], "sum")
+    return ComplexVariable(
+        F.reduce_sum(input.real, dim=dim, keep_dim=keep_dim),
+        F.reduce_sum(input.imag, dim=dim, keep_dim=keep_dim))
+
+
+def reshape(x, shape, inplace=False, name=None):
+    complex_variable_exists([x], "reshape")
+    return ComplexVariable(F.reshape(x.real, shape),
+                           F.reshape(x.imag, shape))
+
+
+def transpose(x, perm, name=None):
+    complex_variable_exists([x], "transpose")
+    return ComplexVariable(F.transpose(x.real, perm),
+                           F.transpose(x.imag, perm))

@@ -1,0 +1,30 @@
+"""ComplexVariable — a (real, imag) pair of framework variables.
+
+Reference: fluid/framework.py:1691 ComplexVariable.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ComplexVariable:
+    def __init__(self, real, imag):
+        self.real = real
+        self.imag = imag
+
+    @property
+    def shape(self):
+        return self.real.shape
+
+    @property
+    def dtype(self):
+        return self.real.dtype
+
+    def numpy(self):
+        return np.asarray(self.real.numpy()) + 1j * np.asarray(
+            self.imag.numpy())
+
+    def __repr__(self):
+        return f"ComplexVariable(real={self.real!r}, imag={self.imag!r})"
+
+    __str__ = __repr__

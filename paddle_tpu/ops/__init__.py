@@ -33,3 +33,4 @@ from . import vision_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import extra_ops  # noqa: F401
+from . import long_tail_ops  # noqa: F401

@@ -1,0 +1,482 @@
+"""Long-tail operator corpus (r4): the last non-subsumed reference op
+types — tree/variable-size convolutions, rank attention, batched FC,
+fused attention-LSTM family, sequence fusions, and pyramid hashing.
+
+Reference files: paddle/fluid/operators/tree_conv_op.cc (+math/tree2col),
+var_conv_2d_op.cc, rank_attention_op.cc (+rank_attention.cu.h),
+batch_fc_op.cc/.cu, attention_lstm_op.cc,
+fused/fused_embedding_fc_lstm_op.cc, fused/fusion_seqconv_eltadd_relu_op.cc,
+fused/fusion_seqexpand_concat_fc_op.cc, pyramid_hash_op.cc.
+
+LoD convention: like the rest of this package, ragged sequences arrive
+padded ``(N, T, ...)`` with an optional ``Length`` input; the reference's
+flattened-LoD layouts are reconstructed per sample where the math needs
+them.  Ops whose structure depends on input VALUES (tree edges,
+per-sample image sizes, n-gram hashes) lower eagerly — under jit they
+raise with the documented alternative, matching the package's stance on
+data-dependent shapes.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import GRAD_SUFFIX
+from .registry import op
+from .sequence_ops import _get_len
+
+
+def _concrete(x, what):
+    try:
+        return np.asarray(x)
+    except jax.errors.TracerArrayConversionError:
+        raise NotImplementedError(
+            f"{what} depends on input VALUES (data-dependent structure) "
+            "and must run eagerly / on the hybrid executor path, not "
+            "inside jit") from None
+
+
+# ==========================================================================
+# tree_conv — Tree-Based Convolution (TBCNN, arXiv:1409.5718)
+# ==========================================================================
+def _tree_patches(edges, max_depth):
+    """construct_tree + construct_patch (math/tree2col.cc): per root
+    node, the DFS patch of (node, eta_l, eta_r, eta_t) coefficients on
+    the continuous binary tree."""
+    node_count = 0
+    for u, v in edges:
+        if u != 0 and v != 0:
+            node_count += 1
+        else:
+            break
+    node_count += 1
+    tr = [[] for _ in range(node_count + 2)]
+    for u, v in edges:
+        if u != 0 and v != 0:
+            tr[int(u)].append(int(v))
+        else:
+            break
+
+    def eta(index, pclen, depth):
+        et = (max_depth - depth) / max_depth
+        el = (1.0 - et) * (0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0))
+        er = (1.0 - et) * (1.0 - (0.5 if pclen == 1
+                                  else (index - 1.0) / (pclen - 1.0)))
+        return el, er, et
+
+    patches = []
+    for root in range(1, node_count + 1):
+        stack = [(root, 1, 1, 0)]
+        patch = [(root, 1, 1, 0)]
+        visited = {root}
+        while stack:
+            node, idx, pclen, depth = stack[-1]
+            end = True
+            for i, v in enumerate(tr[node]):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, i, len(tr[node]), depth + 1))
+                    patch.append((v, i + 1, len(tr[node]), depth + 1))
+                    end = False
+            if end:
+                stack.pop()
+        patches.append([(n - 1,) + eta(i, p, d) for n, i, p, d in patch])
+    return patches, node_count
+
+
+@op("tree_conv")
+def _tree_conv(ctx):
+    """reference: tree_conv_op.cc.  NodesVector (N, n, fs) [or (n, fs)],
+    EdgeSet (N, e, 2) int, Filter (fs, 3, out, nf) ->
+    Out (N, n, out, nf), rows past each sample's node count zero."""
+    nodes = ctx.in_("NodesVector")
+    edges = _concrete(ctx.in_("EdgeSet"), "tree_conv")
+    filt = ctx.in_("Filter")
+    max_depth = int(ctx.attr("max_depth", 2))
+    squeeze = jnp.ndim(nodes) == 2
+    if squeeze:
+        nodes = nodes[None]
+        edges = edges[None]
+    N, n_nodes, fs = jnp.shape(nodes)
+    out_sz, nf = jnp.shape(filt)[2], jnp.shape(filt)[3]
+    # W laid out (fs, 3, out*nf) matching the patch's (feature, l/r/t)
+    # interleave in tree2col.cc
+    w = jnp.reshape(filt, (fs * 3, out_sz * nf))
+    outs = []
+    for b in range(N):
+        patches, node_count = _tree_patches(edges[b], max_depth)
+        # coefficient tensor C: (n_nodes, n_nodes, 3) — C[p, node, k]
+        coef = np.zeros((n_nodes, n_nodes, 3), np.float32)
+        for p, patch in enumerate(patches):
+            for nid, el, er, et in patch:
+                coef[p, nid, 0] += el
+                coef[p, nid, 1] += er
+                coef[p, nid, 2] += et
+        # patch matrix (n_nodes, fs*3) with column layout i*3+k
+        pm = jnp.einsum("pnk,nf->pfk", jnp.asarray(coef), nodes[b])
+        pm = jnp.reshape(pm, (n_nodes, fs * 3))
+        outs.append(jnp.reshape(jnp.matmul(pm, w), (n_nodes, out_sz, nf)))
+    out = jnp.stack(outs)
+    ctx.set_out("Out", out[0] if squeeze else out)
+
+
+# ==========================================================================
+# var_conv_2d — per-sample variable-size 2-D conv (match-matrix models)
+# ==========================================================================
+@op("var_conv_2d")
+def _var_conv_2d(ctx):
+    """reference: var_conv_2d_op.cc.  X padded (N, C_in, H, W) with
+    per-sample valid ROW/COLUMN sizes; Out = W_f * im2col(X) per sample,
+    valid region only (rows/cols past each sample's size zero)."""
+    x = ctx.in_("X")
+    w = ctx.in_("W")                # (out_ch, in_ch * kh * kw)
+    rows = _concrete(ctx.in_("ROW"), "var_conv_2d").reshape(-1)
+    cols = _concrete(ctx.in_("COLUMN"), "var_conv_2d").reshape(-1)
+    in_ch = int(ctx.attr("InputChannel", 1))
+    out_ch = int(ctx.attr("OutputChannel", 1))
+    kh, kw = int(ctx.attr("KernelH", 1)), int(ctx.attr("KernelW", 1))
+    sh, sw = int(ctx.attr("StrideH", 1)), int(ctx.attr("StrideW", 1))
+    if jnp.ndim(x) == 2:  # flattened LoD layout: (N, C*H*W)
+        raise NotImplementedError(
+            "var_conv_2d expects the padded (N, C, H, W) layout")
+    N, C, H, W = jnp.shape(x)
+    dn = lax.conv_dimension_numbers((1, C, H, W),
+                                    (out_ch, in_ch, kh, kw),
+                                    ("NCHW", "OIHW", "NCHW"))
+    wk = jnp.reshape(w, (out_ch, in_ch, kh, kw))
+    full = lax.conv_general_dilated(
+        x, wk, window_strides=(sh, sw),
+        padding=[((kh - 1) // 2, (kh - 1) // 2),
+                 ((kw - 1) // 2, (kw - 1) // 2)],
+        dimension_numbers=dn)
+    oh, ow = jnp.shape(full)[2], jnp.shape(full)[3]
+    # zero out positions beyond each sample's valid (ceil(row/sh),
+    # ceil(col/sw)) region — the reference computes only the valid region
+    oh_valid = np.maximum((rows + sh - 1) // sh, 0)
+    ow_valid = np.maximum((cols + sw - 1) // sw, 0)
+    rmask = (np.arange(oh)[None, :] < oh_valid[:, None])
+    cmask = (np.arange(ow)[None, :] < ow_valid[:, None])
+    mask = jnp.asarray((rmask[:, :, None] & cmask[:, None, :])
+                       .astype(np.float32))
+    ctx.set_out("Out", full * mask[:, None, :, :])
+    ctx.set_out("Col", jnp.zeros((0,), x.dtype))
+
+
+# ==========================================================================
+# rank_attention / batch_fc (PaddleBox CTR contrib ops)
+# ==========================================================================
+@op("rank_attention")
+def _rank_attention(ctx):
+    """reference: rank_attention_op.cc + rank_attention.cu.h.  X
+    (ins, x_dim); RankOffset (ins, 2*max_rank+1) int — col 0 the
+    instance's rank, cols (2k+1, 2k+2) the k-th crossed rank and the
+    index of the row in X to read; RankParam
+    (max_rank*max_rank*x_dim, para_col).  Out (ins, para_col) =
+    block-expanded input x block-selected parameters."""
+    x = ctx.in_("X")
+    rank_offset = ctx.in_("RankOffset").astype(jnp.int32)
+    param = ctx.in_("RankParam")
+    max_rank = int(ctx.attr("MaxRank", 3))
+    ins, x_dim = jnp.shape(x)
+    para_col = jnp.shape(param)[1]
+
+    lower = rank_offset[:, 0] - 1                       # (ins,)
+    faster = rank_offset[:, 1::2] - 1                   # (ins, max_rank)
+    index = rank_offset[:, 2::2]                        # (ins, max_rank)
+    ok = (lower[:, None] >= 0) & (faster >= 0)          # (ins, max_rank)
+
+    # input_help (ins, max_rank, x_dim): X rows gathered by index
+    gathered = jnp.take(x, jnp.clip(index, 0, ins - 1), axis=0)
+    input_help = jnp.where(ok[:, :, None], gathered,
+                           jnp.zeros((), x.dtype))
+    # param_help (ins, max_rank, x_dim, para_col): blocks of RankParam at
+    # start = lower*max_rank + faster
+    start = lower[:, None] * max_rank + faster          # (ins, max_rank)
+    start = jnp.clip(start, 0, max_rank * max_rank - 1)
+    pblocks = jnp.reshape(param, (max_rank * max_rank, x_dim, para_col))
+    psel = jnp.take(pblocks, start, axis=0)             # (ins, mr, xd, pc)
+    psel = jnp.where(ok[:, :, None, None], psel, jnp.zeros((), param.dtype))
+    out = jnp.einsum("imd,imdc->ic", input_help, psel)
+    ctx.set_out("Out", out)
+    ctx.set_out("InputHelp", jnp.reshape(input_help,
+                                         (ins, max_rank * x_dim)))
+    ctx.set_out("InsRank",
+                rank_offset[:, 0].astype(x.dtype).reshape(ins, 1))
+
+
+@op("batch_fc")
+def _batch_fc(ctx):
+    """reference: batch_fc_op.cu — per-slot batched FC:
+    Input (slots, ins, in_dim) x W (slots, in_dim, out_dim) + Bias
+    (slots, out_dim), relu."""
+    x = ctx.in_("Input")
+    w = ctx.in_("W")
+    b = ctx.in_("Bias")
+    out = jnp.einsum("sbi,sio->sbo", x, w) + b[:, None, :]
+    ctx.set_out("Out", jnp.maximum(out, jnp.zeros((), out.dtype)))
+
+
+# ==========================================================================
+# attention_lstm
+# ==========================================================================
+@op("attention_lstm")
+def _attention_lstm(ctx):
+    """reference: attention_lstm_op.cc — per step: attention weights
+    over the whole sequence conditioned on C_{t-1}, pooled into a single
+    lstm input, then one LSTM step.  X padded (N, T, M) + Length;
+    gates order (f, i, o, c~) per the reference's
+    'concat[forget, input, output, tilde]'."""
+    x = ctx.in_("X")
+    c0 = ctx.in_("C0")
+    h0 = ctx.in_("H0") if ctx.has_input("H0") else None
+    aw = ctx.in_("AttentionWeight")          # (M + D, 1)
+    ab = ctx.in_("AttentionBias") if ctx.has_input("AttentionBias") else None
+    a_scalar = (ctx.in_("AttentionScalar").reshape(())
+                if ctx.has_input("AttentionScalar") else None)
+    a_scalar_b = (ctx.in_("AttentionScalarBias").reshape(())
+                  if ctx.has_input("AttentionScalarBias") else None)
+    lw = ctx.in_("LSTMWeight")               # (D + M, 4D)
+    lb = ctx.in_("LSTMBias")                 # (1, 4D)
+    length = _get_len(ctx, x)
+    N, T, M = jnp.shape(x)
+    D4 = jnp.shape(lw)[1]
+    D = D4 // 4
+
+    gate = jax.nn.sigmoid
+    act = jnp.tanh
+    # attention projection of x: (N, T)
+    atted_x = jnp.einsum("ntm,m->nt", x, aw[:M, 0])
+    if ab is not None:
+        atted_x = atted_x + ab.reshape(())
+    w_c = aw[M:, 0]                          # (D,)
+    wx = lw[D:, :]                           # (M, 4D)
+    wh = lw[:D, :]                           # (D, 4D)
+    valid = jnp.arange(T)[None, :] < length[:, None]   # (N, T)
+    neg = jnp.asarray(-1e30, x.dtype)
+
+    h_init = h0 if h0 is not None else jnp.zeros((N, D), x.dtype)
+
+    def step(carry, t):
+        h_prev, c_prev = carry
+        cell_bias = jnp.einsum("nd,d->n", c_prev, w_c)   # (N,)
+        fc = jnp.maximum(atted_x + cell_bias[:, None],
+                         jnp.zeros((), x.dtype))
+        if a_scalar is not None:
+            fc = fc * a_scalar
+            if a_scalar_b is not None:
+                fc = jnp.maximum(fc + a_scalar_b, jnp.zeros((), x.dtype))
+            else:
+                fc = jnp.maximum(fc, jnp.zeros((), x.dtype))
+        probs = jax.nn.softmax(jnp.where(valid, fc, neg), axis=1)
+        lstm_x = jnp.einsum("nt,ntm->nm", probs, x)
+        g = jnp.matmul(lstm_x, wx) + jnp.matmul(h_prev, wh) + lb.reshape(D4)
+        f = gate(g[:, :D])
+        i = gate(g[:, D:2 * D])
+        o = gate(g[:, 2 * D:3 * D])
+        cand = act(g[:, 3 * D:])
+        c_new = f * c_prev + i * cand
+        h_new = o * act(c_new)
+        alive = (t < length)[:, None]
+        c_next = jnp.where(alive, c_new, c_prev)
+        h_next = jnp.where(alive, h_new, h_prev)
+        return (h_next, c_next), (h_next, c_next)
+
+    _, (hs, cs) = lax.scan(step, (h_init, c0), jnp.arange(T))
+    ctx.set_out("Hidden", jnp.transpose(hs, (1, 0, 2)))
+    ctx.set_out("Cell", jnp.transpose(cs, (1, 0, 2)))
+    ctx.set_out("AttentionedX", jnp.reshape(atted_x, (N * T, 1)))
+    ctx.set_out("AttentionFCOut", jnp.zeros((T, 1), x.dtype))
+    ctx.set_out("LSTMX", jnp.zeros((1, M), x.dtype))
+    ctx.set_out("LSTMOUT", jnp.zeros((1, D4), x.dtype))
+
+
+# ==========================================================================
+# fused_embedding_fc_lstm
+# ==========================================================================
+@op("fused_embedding_fc_lstm")
+def _fused_embedding_fc_lstm(ctx):
+    """reference: fused/fused_embedding_fc_lstm_op.cc — the input FC is
+    pre-folded into the embedding table (rows are already x·Wx + b), so
+    the kernel is lookup + LSTM recurrence with gates (c~, i, f, o)."""
+    ids = ctx.in_("Ids")
+    emb = ctx.in_("Embeddings")              # (vocab, 4D)
+    wh = ctx.in_("WeightH")                  # (D, 4D)
+    bias = ctx.in_("Bias")                   # (1, 4D [+3D peephole])
+    h0 = ctx.in_("H0") if ctx.has_input("H0") else None
+    c0 = ctx.in_("C0") if ctx.has_input("C0") else None
+    use_peepholes = bool(ctx.attr("use_peepholes", False))
+    if jnp.ndim(ids) == 3:
+        ids = jnp.squeeze(ids, -1)
+    length = _get_len(ctx, ids)
+    N, T = jnp.shape(ids)
+    D = jnp.shape(wh)[0]
+    D4 = 4 * D
+    bias = jnp.reshape(bias, (-1,))
+    xx = jnp.take(emb, ids.astype(jnp.int32), axis=0) + bias[:D4]
+    gate = jax.nn.sigmoid
+    h_init = h0 if h0 is not None else jnp.zeros((N, D), xx.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((N, D), xx.dtype)
+    wc = bias[D4:] if use_peepholes else None   # (3D,) w_ic, w_fc, w_oc
+
+    def step(carry, t):
+        h_prev, c_prev = carry
+        g = xx[:, t] + jnp.matmul(h_prev, wh)
+        gc, gi, gf, go = (g[:, :D], g[:, D:2 * D],
+                          g[:, 2 * D:3 * D], g[:, 3 * D:])
+        if wc is not None:
+            gi = gi + wc[:D] * c_prev
+            gf = gf + wc[D:2 * D] * c_prev
+        c_new = gate(gf) * c_prev + gate(gi) * jnp.tanh(gc)
+        if wc is not None:
+            go = go + wc[2 * D:] * c_new
+        h_new = gate(go) * jnp.tanh(c_new)
+        alive = (t < length)[:, None]
+        c_next = jnp.where(alive, c_new, c_prev)
+        h_next = jnp.where(alive, h_new, h_prev)
+        return (h_next, c_next), (h_next, c_next)
+
+    _, (hs, cs) = lax.scan(step, (h_init, c_init), jnp.arange(T))
+    ctx.set_out("Hidden", jnp.transpose(hs, (1, 0, 2)))
+    ctx.set_out("Cell", jnp.transpose(cs, (1, 0, 2)))
+    ctx.set_out("XX", jnp.reshape(xx, (N * T, D4)))
+
+
+# ==========================================================================
+# fusion_seqconv_eltadd_relu
+# ==========================================================================
+@op("fusion_seqconv_eltadd_relu")
+def _fusion_seqconv_eltadd_relu(ctx):
+    """reference: fused/fusion_seqconv_eltadd_relu_op.cc — per-sequence
+    context window im2col (contextLength rows from contextStart), then
+    relu(col @ Filter + Bias).  X padded (N, T, M) + Length."""
+    x = ctx.in_("X")
+    w = ctx.in_("Filter")                    # (ctx_len * M, out)
+    b = ctx.in_("Bias")                      # (out,)
+    ctx_len = int(ctx.attr("contextLength", 1))
+    ctx_start = int(ctx.attr("contextStart", 0))
+    length = _get_len(ctx, x)
+    N, T, M = jnp.shape(x)
+    valid = (jnp.arange(T)[None, :] < length[:, None]).astype(x.dtype)
+    xm = x * valid[:, :, None]
+    cols = []
+    for j in range(ctx_len):
+        off = ctx_start + j
+        shifted = jnp.roll(xm, -off, axis=1)
+        # positions whose source row t+off is outside [0, len) are zero
+        src = jnp.arange(T)[None, :] + off
+        okj = ((src >= 0) & (src < length[:, None])).astype(x.dtype)
+        cols.append(shifted * okj[:, :, None])
+    col = jnp.concatenate(cols, axis=2)       # (N, T, ctx_len*M)
+    out = jnp.maximum(jnp.einsum("ntk,ko->nto", col, w) + b,
+                      jnp.zeros((), x.dtype))
+    ctx.set_out("Out", out * valid[:, :, None])
+    ctx.set_out("ColMat", col)
+
+
+# ==========================================================================
+# fusion_seqexpand_concat_fc
+# ==========================================================================
+@op("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ctx):
+    """reference: fused/fusion_seqexpand_concat_fc_op.cc — X[0] is the
+    ragged reference sequence (N, T, D0); the other inputs are one row
+    per sequence (N, Di), broadcast (seq_expand) along T; concat on the
+    feature axis, FC, activation."""
+    xs = ctx.ins("X")
+    w = ctx.in_("FCWeight")
+    b = ctx.in_("FCBias") if ctx.has_input("FCBias") else None
+    act = ctx.attr("fc_activation", "identity")
+    ref = xs[0]
+    length = _get_len(ctx, ref)
+    N, T = jnp.shape(ref)[0], jnp.shape(ref)[1]
+    parts = [ref]
+    for other in xs[1:]:
+        parts.append(jnp.broadcast_to(other[:, None, :],
+                                      (N, T) + tuple(jnp.shape(other)[1:])))
+    cat = jnp.concatenate(parts, axis=2)
+    out = jnp.einsum("ntk,ko->nto", cat, w)
+    if b is not None:
+        out = out + jnp.reshape(b, (1, 1, -1))
+    if act == "relu":
+        out = jnp.maximum(out, jnp.zeros((), out.dtype))
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    elif act == "sigmoid":
+        out = jax.nn.sigmoid(out)
+    valid = (jnp.arange(T)[None, :] < length[:, None]).astype(out.dtype)
+    ctx.set_out("Out", out * valid[:, :, None])
+
+
+# ==========================================================================
+# pyramid_hash
+# ==========================================================================
+def _pyr_hash32(window: np.ndarray, seed: int) -> int:
+    """Deterministic 32-bit hash of an id window.  The reference uses
+    XXH32 over the raw bytes; the hash FAMILY (not the exact function)
+    is the contract — embeddings are random projections either way —
+    so a keyed blake2s digest stands in."""
+    h = hashlib.blake2s(window.tobytes(),
+                        salt=int(seed).to_bytes(8, "little"),
+                        digest_size=4)
+    return int.from_bytes(h.digest(), "little")
+
+
+@op("pyramid_hash", no_grad=True, stateful=True)
+def _pyramid_hash(ctx):
+    """reference: pyramid_hash_op.cc (PyramidDNN text hashing).  For
+    each sequence, every n-gram window of length 2..num_emb (the
+    pyramid), hashed into `rand_len`-wide chunks of W, concatenated to a
+    num_emb-dim embedding; windows of all lengths concatenate along the
+    output sequence.  X padded (N, T) int ids + Length; Out
+    (N, T*(max_len-1), num_emb) zero-padded + OutLength."""
+    x = _concrete(ctx.in_("X"), "pyramid_hash").astype(np.int32)
+    w = ctx.in_("W")
+    num_emb = int(ctx.attr("num_emb", 8))
+    space_len = int(jnp.shape(w)[0])
+    rand_len = int(ctx.attr("rand_len", 2))
+    max_len = max(2, int(ctx.attr("max_pyramid_layer",
+                                  ctx.attr("pyramid_layer", 2))))
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+    length = np.asarray(_get_len(ctx, x)).astype(np.int64)
+    N, T = x.shape
+    n_chunk = (num_emb + rand_len - 1) // rand_len
+    out_T = T * (max_len - 1)
+    rows = np.zeros((N, out_T, n_chunk), np.int64)
+    mask = np.zeros((N, out_T), np.float32)
+    out_len = np.zeros((N,), np.int64)
+    for b in range(N):
+        pos = 0
+        for ilayer in range(1, max_len):          # window length ilayer+1
+            wl = ilayer + 1
+            if length[b] < wl:
+                continue
+            for start in range(int(length[b]) - wl + 1):
+                window = x[b, start:start + wl]
+                p1 = _pyr_hash32(window, 0) % space_len
+                p2 = _pyr_hash32(window, rand_len) % space_len
+                chunk_rows = []
+                for j in range(n_chunk):
+                    chunk_rows.append(p1)
+                    p3 = _pyr_hash32(window,
+                                     (j + 1) * rand_len + rand_len) \
+                        % space_len
+                    p1, p2 = p2, p3
+                rows[b, pos, :] = chunk_rows
+                mask[b, pos] = 1.0
+                pos += 1
+        out_len[b] = pos
+    gathered = jnp.take(w, jnp.asarray(rows), axis=0)   # (N,oT,nc,rand)
+    emb = jnp.reshape(gathered, (N, out_T, n_chunk * jnp.shape(w)[1]))
+    emb = emb[:, :, :num_emb] * jnp.asarray(mask)[:, :, None]
+    drop = float(ctx.attr("drop_out_percent", 0.0))
+    if drop > 0 and not bool(ctx.attr("is_training", True)):
+        emb = emb * (1.0 - drop)
+    ctx.set_out("Out", emb)
+    ctx.set_out("OutLength", jnp.asarray(out_len.astype(np.int32)))
+    ctx.set_out("X_Temp_Out", jnp.zeros((0,), jnp.float32))
+    ctx.set_out("DropPos", jnp.zeros((0,), jnp.int32))

@@ -191,7 +191,58 @@ def _distributed_lookup_table_grad(ctx):
         if use_comm:
             comm.send_sparse(table, ids_np, g_np)
         else:
-            client.push_sparse(table, ids_np, g_np)
+            # record updated rows for the async recorder when an
+            # async-family mode is active (the communicator's presence IS
+            # the async signal; sync pushes skip recording)
+            client.push_sparse(table, ids_np, g_np,
+                               record=_communicator() is not None)
+
+
+@_host("recv_save", no_grad=True)
+def _recv_save(ctx):
+    """reference: distributed_ops/recv_save_op.cc — pull a (possibly
+    pserver-sharded) parameter straight from the tables and write it to
+    a checkpoint file, never materializing it in the scope.  Slices
+    arrive per ``slice_varnames`` and concatenate on axis 0 to
+    ``origin_shape``; saved in this package's .npy checkpoint format
+    (io.py save_vars)."""
+    import os
+
+    client = _client()
+    file_path = ctx.attr("file_path")
+    shape = [int(s) for s in ctx.attr("shape", [])]
+    slice_names = list(ctx.attr("slice_varnames", []) or [])
+    remote_names = list(ctx.attr("remote_varnames", []) or slice_names)
+    slice_shapes = list(ctx.attr("slice_shapes", []) or [])
+    is_sparse = bool(ctx.attr("is_sparse", False))
+    if not remote_names:
+        remote_names = [ctx.attr("varname")]
+    # per-slice heights: explicit slice_shapes ("h,w" strings like the
+    # reference), else an even row split of the origin height
+    n = len(remote_names)
+    if slice_shapes:
+        heights = [int(str(s).split(",")[0]) for s in slice_shapes]
+    elif shape:
+        per = shape[0] // n
+        heights = [per] * n
+        heights[-1] += shape[0] - per * n
+    else:
+        heights = [0] * n
+    parts = []
+    for rname, h in zip(remote_names, heights):
+        if is_sparse:
+            ids = np.arange(h, dtype=np.int64)
+            parts.append(np.asarray(client.pull_sparse(rname, ids)))
+        else:
+            parts.append(np.asarray(client.pull_dense(rname)))
+    full = parts[0] if len(parts) == 1 else np.concatenate(
+        [p.reshape(-1, *shape[1:]) if len(shape) > 1 else p.ravel()
+         for p in parts], axis=0)
+    if shape:
+        full = full.reshape(shape)
+    os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
+    np.save(file_path if file_path.endswith(".npy") else file_path + ".npy",
+            full)
 
 
 @_host("listen_and_serv", no_grad=True)

@@ -352,6 +352,25 @@ def _split(ctx):
     ctx.set_out("Out", outs)
 
 
+@op("split_byref", no_grad=True)
+def _split_byref(ctx):
+    """reference: distributed_ops/split_byref_op.cc — row-split without
+    copy (the transpiler's param-shard splitter).  XLA slices of a
+    buffer ARE views until a consumer materializes them, so this is the
+    plain height-section split."""
+    x = ctx.in_("X")
+    sections = list(ctx.attr("sections", []))
+    n_out = (len(ctx.op.outputs.get("Out", [])) if ctx.op is not None
+             else 0) or ctx.attr("num", 0)
+    if not sections:
+        h = jnp.shape(x)[0]
+        per = h // max(1, n_out)
+        sections = [per] * n_out
+        sections[-1] += h - per * n_out
+    idx = np.cumsum(sections[:-1]).tolist()
+    ctx.set_out("Out", jnp.split(x, idx, axis=0))
+
+
 @op("stack")
 def _stack(ctx):
     xs = [v for v in ctx.ins("X") if v is not None]

@@ -1,0 +1,65 @@
+"""Host CPU/device info helpers.
+
+Reference: paddle/fluid/platform/cpu_info.cc (CpuTotalPhysicalMemory,
+CpuMaxAllocSize, CpuMinChunkSize, CpuMaxChunkSize) and device info
+queries.  The host side here only feeds input pipelines and the PS
+runtime — XLA owns device memory — so these report host facts plus the
+attached accelerator inventory.
+"""
+from __future__ import annotations
+
+import os
+
+from . import flags
+
+
+def cpu_count() -> int:
+    return os.cpu_count() or 1
+
+
+def cpu_total_physical_memory() -> int:
+    try:
+        return (os.sysconf("SC_PHYS_PAGES")
+                * os.sysconf("SC_PAGE_SIZE"))
+    except (ValueError, OSError, AttributeError):
+        return 4 << 30
+
+
+def cpu_max_alloc_size() -> int:
+    """reference: cpu_info.cc:70 — total memory scaled by
+    FLAGS_fraction_of_cpu_memory_to_use."""
+    frac = float(flags._flags.get("FLAGS_fraction_of_cpu_memory_to_use",
+                                  1.0))
+    return int(frac * cpu_total_physical_memory())
+
+
+def cpu_min_chunk_size() -> int:
+    return 1 << 12  # 4 KiB, reference cpu_info.cc:76
+
+
+def cpu_max_chunk_size() -> int:
+    frac = float(flags._flags.get(
+        "FLAGS_initial_cpu_memory_in_mb", 500))
+    return min(int(frac) << 20, cpu_max_alloc_size())
+
+
+def device_count() -> int:
+    """Attached accelerator count (jax devices)."""
+    try:
+        import jax
+
+        return len(jax.devices())
+    except Exception:
+        return 0
+
+
+def device_info() -> list:
+    """Per-device kind/platform list (nvidia-smi/cudaGetDeviceProperties
+    analog for the TPU world)."""
+    try:
+        import jax
+
+        return [{"id": d.id, "kind": getattr(d, "device_kind", "unknown"),
+                 "platform": d.platform} for d in jax.devices()]
+    except Exception:
+        return []

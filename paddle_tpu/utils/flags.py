@@ -16,6 +16,8 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_eager_delete_tensor_gb": 0.0,   # GC threshold — XLA-managed, stat only
     "FLAGS_allocator_strategy": "xla_bfc",  # allocator is XLA's; exposed for parity
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_fraction_of_cpu_memory_to_use": 1.0,   # cpu_info.cc:70
+    "FLAGS_initial_cpu_memory_in_mb": 500,        # cpu_info.cc:81
     "FLAGS_cudnn_deterministic": False,
     "FLAGS_enable_parallel_graph": False,
     "FLAGS_sync_nccl_allreduce": True,

@@ -57,11 +57,17 @@ def test_machine_translation_train_and_beam_decode():
     with scope_guard(Scope()):
         exe.run(startup)
         losses = []
-        for step in range(120):
+        # 240 steps: the convergence point depends on the init draw, which
+        # depends on the PRNG stream (FLAGS_tpu_prng_impl) — train long
+        # enough that any stream lands well under the bar (r4: the rbg
+        # default reached 0.70 where threefry reached 0.45 at step 120)
+        for step in range(240):
             s, t_in, lab = _copy_task_batch(rng, 16)
             out = exe.run(main, feed={"src": s, "trg": t_in, "label": lab},
                           fetch_list=[avg_cost])
             losses.append(float(np.asarray(out[0]).ravel()[0]))
+            if losses[-1] < 0.35:
+                break
         # the reference trains to avg_cost < 3.5 in a couple of steps on
         # real data; this synthetic task should go much lower
         assert losses[-1] < 0.5, (losses[0], losses[-1])

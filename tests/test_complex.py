@@ -1,0 +1,56 @@
+"""paddle.complex preview namespace (reference:
+python/paddle/incubate/complex/ + fluid ComplexVariable)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.dygraph import guard, to_variable
+
+RNG = np.random.RandomState(11)
+
+
+def _cvar(a):
+    return pt.complex.ComplexVariable(
+        to_variable(np.real(a).astype(np.float32).copy()),
+        to_variable(np.imag(a).astype(np.float32).copy()))
+
+
+def test_complex_full_surface():
+    with guard():
+        a = (RNG.rand(2, 3) + 1j * RNG.rand(2, 3)).astype(np.complex64)
+        b = (RNG.rand(2, 3) + 1j * RNG.rand(2, 3)).astype(np.complex64)
+        x, y = _cvar(a), _cvar(b)
+        np.testing.assert_allclose(
+            pt.complex.elementwise_add(x, y).numpy(), a + b, rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.complex.elementwise_sub(x, y).numpy(), a - b, rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.complex.elementwise_mul(x, y).numpy(), a * b, rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.complex.elementwise_div(x, y).numpy(), a / b, rtol=1e-4)
+        np.testing.assert_allclose(
+            pt.complex.matmul(x, _cvar(b.T)).numpy(), a @ b.T, rtol=1e-4)
+        np.testing.assert_allclose(
+            pt.complex.kron(x, y).numpy(), np.kron(a, b), rtol=1e-4)
+        np.testing.assert_allclose(
+            pt.complex.sum(x).numpy().ravel(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.complex.trace(x, axis1=0, axis2=1).numpy().ravel(),
+            np.trace(a), rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.complex.transpose(pt.complex.reshape(x, [3, 2]),
+                                 [1, 0]).numpy(),
+            a.reshape(3, 2).T, rtol=1e-5)
+        assert pt.complex.is_complex(x)
+        assert not pt.complex.is_complex(to_variable(np.real(a).copy()))
+
+
+def test_complex_mixed_real_operand():
+    """Reference supports real-x-complex mixing: (x real, y complex)."""
+    with guard():
+        a = RNG.rand(2, 3).astype(np.float32)
+        b = (RNG.rand(2, 3) + 1j * RNG.rand(2, 3)).astype(np.complex64)
+        y = _cvar(b)
+        got = pt.complex.elementwise_mul(to_variable(a), y).numpy()
+        np.testing.assert_allclose(got, a * b, rtol=1e-5)
+        got = pt.complex.elementwise_add(y, to_variable(a)).numpy()
+        np.testing.assert_allclose(got, a + b, rtol=1e-5)

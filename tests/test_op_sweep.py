@@ -1309,6 +1309,16 @@ SPECS["max_sequence_len"] = S(
     ref=lambda ins, a: {"Out": np.asarray(7, np.int64)})
 
 COVERED_ELSEWHERE.update({
+    # r4 long-tail corpus — tests/test_long_tail_ops.py (NumPy oracles)
+    "tree_conv": "test_long_tail_ops", "var_conv_2d": "test_long_tail_ops",
+    "rank_attention": "test_long_tail_ops", "batch_fc": "test_long_tail_ops",
+    "attention_lstm": "test_long_tail_ops",
+    "fused_embedding_fc_lstm": "test_long_tail_ops",
+    "fusion_seqconv_eltadd_relu": "test_long_tail_ops",
+    "fusion_seqexpand_concat_fc": "test_long_tail_ops",
+    "pyramid_hash": "test_long_tail_ops",
+    "recv_save": "test_long_tail_ops", "split_byref": "test_long_tail_ops",
+
     # host/metric/stateful extras — dedicated tests
     "precision_recall": "test_misc_ops",
     "positive_negative_pair": "test_misc_ops",
