@@ -852,6 +852,162 @@ class EmbeddingEltwiseLayernormFusePass(Pass):
         return program
 
 
+@register_pass("fc_fuse_pass")
+class FcFusePass(Pass):
+    """mul + elementwise_add [+ relu]  ==>  fc  (reference:
+    ir/fc_fuse_pass.cc).  Inference-shape rewrite: only fires on forward
+    chains with no grad consumers (run it from the inference
+    PassStrategy, after remove_training_ops)."""
+
+    protected: Sequence[str] = ()
+
+    def apply_impl(self, program):
+        fused = 0
+        block = program.global_block()
+        protected = set(self.protected)
+        changed = True
+        while changed:
+            changed = False
+            cons = _consumers(block)
+            for mul in list(block.ops):
+                if mul.type != "mul" or \
+                        mul.attrs.get("y_num_col_dims", 1) != 1:
+                    continue
+                y0 = mul.outputs["Out"][0]
+                if y0 in protected:
+                    continue
+                # the fc kernel multiplies W as-is: only 2-D weights
+                # match (mul itself flattens higher-rank Y; fc must not)
+                wv = block._find_var_recursive(mul.inputs["Y"][0])
+                if wv is None or wv.shape is None or len(wv.shape) != 2:
+                    continue
+                users = cons.get(y0, [])
+                if len(users) != 1 or users[0].type != "elementwise_add":
+                    continue
+                add = users[0]
+                # bias must be the non-mul operand, added along axis 1 of
+                # a 2-D result (the fc bias shape), or the default axis
+                xn, yn = add.inputs["X"][0], add.inputs["Y"][0]
+                if xn != y0:
+                    continue  # fc bias rides the Y slot in the fc pattern
+                if add.attrs.get("axis", -1) not in (-1, 1):
+                    continue
+                # the Y operand must actually be a bias: a 1-D (or 1xN)
+                # var, not a batch-shaped activation (the fc op reshapes
+                # Bias to (1, n) — fusing an activation add would be a
+                # silent wrong-result rewrite)
+                bv = block._find_var_recursive(yn)
+                if bv is None or bv.shape is None:
+                    continue
+                bshape = [d for d in bv.shape]
+                if not (len(bshape) == 1
+                        or (len(bshape) == 2 and bshape[0] == 1)):
+                    continue
+                bias = yn
+                a1 = add.outputs["Out"][0]
+                out_name = a1
+                act = ""
+                dead = [mul, add]
+                a_users = cons.get(a1, [])
+                if a1 not in protected and len(a_users) == 1 \
+                        and a_users[0].type == "relu":
+                    act = "relu"
+                    out_name = a_users[0].outputs["Out"][0]
+                    dead.append(a_users[0])
+                idx = block.ops.index(mul)
+                inputs = {"Input": list(mul.inputs["X"]),
+                          "W": list(mul.inputs["Y"]),
+                          "Bias": [bias]}
+                attrs = {"in_num_col_dims":
+                         mul.attrs.get("x_num_col_dims", 1),
+                         "activation_type": act}
+                remove_ops(block, dead)
+                block._insert_op(idx, "fc", inputs=inputs,
+                                 outputs={"Out": [out_name]}, attrs=attrs)
+                fused += 1
+                changed = True
+                break
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program
+
+
+@register_pass("seqpool_concat_fuse_pass")
+class SeqpoolConcatFusePass(Pass):
+    """N x sequence_pool feeding ONE concat(axis=1)  ==>
+    fusion_seqpool_concat (reference: ir/seqpool_concat_fuse_pass.cc).
+    All pools must share the pooltype; per-slot Length inputs ride
+    along in order."""
+
+    protected: Sequence[str] = ()
+
+    def apply_impl(self, program):
+        fused = 0
+        block = program.global_block()
+        protected = set(self.protected)
+        changed = True
+        while changed:
+            changed = False
+            cons = _consumers(block)
+            prod = producer_map(block)
+            for cat in list(block.ops):
+                if cat.type != "concat" or cat.attrs.get("axis", 0) != 1:
+                    continue
+                srcs = cat.inputs.get("X", [])
+                pools = [prod.get(n) for n in srcs]
+                if len(pools) < 2 or any(
+                        p is None or p.type != "sequence_pool"
+                        for p in pools):
+                    continue
+                ptypes = {(p.attrs.get("pooltype") or "SUM").upper()
+                          for p in pools}
+                if len(ptypes) != 1 or \
+                        next(iter(ptypes)) not in ("SUM", "AVERAGE", "SQRT"):
+                    continue
+                # the fused kernel zero-fills empty sequences; a nonzero
+                # pad_value pool must stay unfused to keep its semantics
+                if any((p.attrs.get("pad_value") or 0.0) != 0.0
+                       for p in pools):
+                    continue
+                # every pooled intermediate is private to this concat,
+                # MaxIndex side outputs dead, names not protected
+                ok = True
+                for n, p in zip(srcs, pools):
+                    if n in protected or len(cons.get(n, [])) != 1:
+                        ok = False
+                        break
+                    for mi in p.outputs.get("MaxIndex", []):
+                        if cons.get(mi, []):
+                            ok = False
+                            break
+                if not ok:
+                    continue
+                xs, lens = [], []
+                for p in pools:
+                    xs.append(p.inputs["X"][0])
+                    lens.extend(p.inputs.get("Length", []))
+                if lens and len(lens) != len(pools):
+                    continue  # mixed explicit/implicit lengths: leave it
+                idx = block.ops.index(cat)
+                idx -= sum(1 for p in pools if block.ops.index(p) < idx)
+                inputs = {"X": xs}
+                if lens:
+                    inputs["Length"] = lens
+                remove_ops(block, pools + [cat])
+                block._insert_op(
+                    idx, "fusion_seqpool_concat", inputs=inputs,
+                    outputs={"Out": list(cat.outputs["Out"])},
+                    attrs={"pooltype": next(iter(ptypes))})
+                fused += 1
+                changed = True
+                break
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program
+
+
 # --------------------------------------------------------------------------
 # fused optimizer shell (reference: ir/fuse_optimizer_ops_pass/ —
 # fuse_sgd_op_pass.cc, fuse_momentum_op_pass.cc, fuse_adam_op_pass.cc):

@@ -232,6 +232,8 @@ class PassStrategy:
         "fuse_bn_add_act_pass",
         "embedding_eltwise_layernorm_fuse_pass",
         "fuse_multihead_attention_pass",
+        "fc_fuse_pass",
+        "seqpool_concat_fuse_pass",
         "delete_dropout_pass",
     ]
 

@@ -424,6 +424,24 @@ def _bmm(ctx):
     ctx.set_out("Out", jnp.matmul(ctx.in_("X"), ctx.in_("Y")))
 
 
+@op("fc")
+def _fc(ctx):
+    """Fused fully-connected (reference: operators/fc_op.cc, formed by
+    ir/fc_fuse_pass.cc from mul + elementwise_add [+ relu])."""
+    import math
+
+    x, w = ctx.in_("Input"), ctx.in_("W")
+    ncd = ctx.attr("in_num_col_dims", 1)
+    xs = jnp.shape(x)
+    xm = jnp.reshape(x, (math.prod(xs[:ncd]), -1))
+    out = jnp.matmul(xm, w)
+    if ctx.has_input("Bias"):
+        out = out + jnp.reshape(ctx.in_("Bias"), (1, -1))
+    if ctx.attr("activation_type", "") == "relu":
+        out = jnp.maximum(out, jnp.zeros((), out.dtype))
+    ctx.set_out("Out", jnp.reshape(out, xs[:ncd] + (jnp.shape(w)[1],)))
+
+
 @op("dot")
 def _dot(ctx):
     x, y = ctx.in_("X"), ctx.in_("Y")

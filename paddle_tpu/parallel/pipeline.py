@@ -344,7 +344,8 @@ def run_pipeline(executor, program, feed, fetch_list, scope, return_numpy):
 # --------------------------------------------------------------------------
 # SPMD collective-permute pipeline (homogeneous stages, `pp` mesh axis)
 # --------------------------------------------------------------------------
-def spmd_pipeline(stage_fn, stage_params, microbatches, mesh, axis: str = "pp"):
+def spmd_pipeline(stage_fn, stage_params, microbatches, mesh, axis: str = "pp",
+                  params_spec=None, mb_spec=None):
     """Run ``S`` homogeneous stages over a pipeline mesh axis.
 
     ``stage_params``: pytree whose leaves have leading dim ``S`` (stacked
@@ -359,6 +360,13 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, mesh, axis: str = "pp"):
     queues (section_worker.cc:142).  ``jax.grad`` through this function
     yields the reverse pipeline (synchronous schedule; the reference's
     pipeline is async-only).
+
+    Composition with other mesh axes (r4): ``params_spec`` /``mb_spec``
+    override the default shardings so PP composes with TP and DP on one
+    mesh — e.g. ``params_spec=P("pp", None, "mp")`` (stage-stacked,
+    column-TP weights) and ``mb_spec=P(None, "dp")`` (batch-sharded
+    microbatches); ``stage_fn`` then issues its own ``mp``/``dp``
+    collectives (all_gather/psum), exactly the Megatron recipe.
     """
     import jax
     import jax.numpy as jnp
@@ -370,6 +378,10 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, mesh, axis: str = "pp"):
     M = leaves[0].shape[0]
     T = M + S - 1
     perm = [(i, (i + 1) % S) for i in range(S)]
+    if params_spec is None:
+        params_spec = P(axis)
+    if mb_spec is None:
+        mb_spec = P()
 
     def _index(tree_, i):
         return jax.tree.map(
@@ -379,8 +391,8 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, mesh, axis: str = "pp"):
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(params_spec, mb_spec),
+        out_specs=mb_spec,
         check_vma=False,
     )
     def run(params_local, mbs):

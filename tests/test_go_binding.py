@@ -59,3 +59,43 @@ def test_go_build(tmp_path):
     r = subprocess.run(["go", "build", "./..."], cwd=os.path.join(REPO, "go"),
                        env=env, capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
+
+
+def test_go_sources_pass_syntax_check():
+    """r4: a real structural syntax check (tools/gocheck.py Go lexer) —
+    a typo'd brace, broken string, truncated file, or stray top-level
+    token in the binding now FAILS this test (the r3 symbol-regex check
+    could not see any of those)."""
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import gocheck
+
+    for src in _go_sources():
+        gocheck.check_file(src)  # raises GoSyntaxError on failure
+
+
+def test_gocheck_catches_injected_syntax_errors(tmp_path):
+    """Meta-test: the checker must actually reject broken Go — corrupt
+    the real binding source in representative ways and assert each
+    corruption is caught."""
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import gocheck
+
+    real = open(os.path.join(GO_DIR, "predictor.go")).read()
+    gocheck.check_source(real)  # sanity: the real file passes
+
+    corruptions = {
+        "missing_close_brace": real.rstrip()[:-1],
+        "stray_close_brace": real + "\n}\n",
+        "unterminated_string": real.replace(
+            '"paddle: %s"', '"paddle: %s', 1),
+        "unterminated_comment": real + "\n/* trailing",
+        "mismatched_bracket": real.replace("[]*Tensor", "[}*Tensor", 1),
+        "no_package_clause": "func main() {}\n",
+        "func_without_name": real + "\nfunc {\n}\n",
+    }
+    for name, bad in corruptions.items():
+        assert bad != real, name
+        with pytest.raises(gocheck.GoSyntaxError):
+            gocheck.check_source(bad, name)

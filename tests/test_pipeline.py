@@ -193,3 +193,69 @@ def test_spmd_pipeline_grads():
     for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_dp_tp_pp_composed_training_parity():
+    """r4 (verdict #9): DP x TP x PP composed on ONE (2,2,2) mesh — PP
+    via spmd_pipeline's ppermute rotation, TP via column-sharded stage
+    weights + all_gather, DP via batch-sharded microbatches + psum'd
+    loss — trained several SGD steps with per-step loss parity against
+    the plain single-device trajectory."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.parallel.pipeline import spmd_pipeline
+
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "pp", "mp"))
+    S, M, B, H = 2, 4, 8, 16   # stages, microbatches, per-mb batch, width
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(S, H, H).astype(np.float32) * 0.3
+    xs = rng.randn(M, B, H).astype(np.float32)
+    tgt = rng.randn(M, B, H).astype(np.float32)
+
+    # ---- composed: stage weights column-sharded over mp; microbatch
+    # batch dim sharded over dp; stages over pp
+    def stage_fn(w_local, x):
+        # x: (B/dp, H) replicated over mp; w_local: (H, H/mp)
+        part = jnp.tanh(jnp.matmul(x, w_local))          # local columns
+        return lax.all_gather(part, "mp", axis=1, tiled=True)
+
+    def loss_composed(w):
+        out = spmd_pipeline(stage_fn, w, xs_j, mesh,
+                            params_spec=P("pp", None, "mp"),
+                            mb_spec=P(None, "dp"))
+        return jnp.mean((out - tgt_j) ** 2)
+
+    # ---- oracle: plain sequential stages, full weights, one device
+    def loss_plain(w, x, t):
+        y = x
+        for k in range(S):
+            y = jnp.tanh(jnp.matmul(y, w[k]))
+        return jnp.mean((y - t) ** 2)
+
+    lr = 0.2
+    with mesh:
+        xs_j, tgt_j = jnp.asarray(xs), jnp.asarray(tgt)
+        w = jnp.asarray(w0)
+        composed = []
+        gfn = jax.jit(jax.value_and_grad(loss_composed))
+        for _ in range(4):
+            l, g = gfn(w)
+            composed.append(float(l))
+            w = w - lr * g
+    w = jnp.asarray(w0)
+    plain = []
+    gfn_p = jax.jit(jax.value_and_grad(
+        lambda w: loss_plain(w, jnp.asarray(xs), jnp.asarray(tgt))))
+    for _ in range(4):
+        l, g = gfn_p(w)
+        plain.append(float(l))
+        w = w - lr * g
+    np.testing.assert_allclose(composed, plain, rtol=1e-5, atol=1e-6)
+    assert composed[-1] < composed[0]

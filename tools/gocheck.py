@@ -1,0 +1,195 @@
+"""Structural syntax checker for Go sources, used where no Go toolchain
+exists (the CI image ships none — reference builds go/paddle with a real
+compiler, go/CMakeLists.txt).
+
+Not a full parser: it lexes Go for real (line/block comments,
+interpreted strings with escapes, raw strings, rune literals) and then
+validates the properties almost every syntax error breaks:
+
+* first declaration is a ``package`` clause
+* every (, [, { closes in order and nothing is left open
+* no unterminated string/rune/comment
+* every top-level declaration starts with one of
+  package/import/func/type/var/const (or a cgo comment)
+* ``func`` is followed by a name / receiver, and declaration headers
+  balance their parens on the same nesting level
+
+A file that passes go/parser can still pass here trivially; a typo'd
+brace, broken string, truncated file, or stray token at top level fails.
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Tuple
+
+KEYWORD_DECL = {"package", "import", "func", "type", "var", "const"}
+OPEN = {"(": ")", "[": "]", "{": "}"}
+CLOSE = {v: k for k, v in OPEN.items()}
+
+
+class GoSyntaxError(ValueError):
+    pass
+
+
+def lex(src: str, path: str = "<src>") -> List[Tuple[str, str, int]]:
+    """Tokens as (kind, text, line): kind in ident/string/punct/other."""
+    toks = []
+    i, n, line = 0, len(src), 1
+    while i < n:
+        c = src[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c in " \t\r":
+            i += 1
+            continue
+        if src.startswith("//", i):
+            j = src.find("\n", i)
+            i = n if j < 0 else j
+            continue
+        if src.startswith("/*", i):
+            j = src.find("*/", i + 2)
+            if j < 0:
+                raise GoSyntaxError(f"{path}:{line}: unterminated /* comment")
+            line += src.count("\n", i, j)
+            i = j + 2
+            continue
+        if c == "`":
+            j = src.find("`", i + 1)
+            if j < 0:
+                raise GoSyntaxError(
+                    f"{path}:{line}: unterminated raw string")
+            toks.append(("string", src[i:j + 1], line))
+            line += src.count("\n", i, j)
+            i = j + 1
+            continue
+        if c in "\"'":
+            q, j = c, i + 1
+            while j < n:
+                if src[j] == "\\":
+                    j += 2
+                    continue
+                if src[j] == q:
+                    break
+                if src[j] == "\n":
+                    raise GoSyntaxError(
+                        f"{path}:{line}: newline in string/rune literal")
+                j += 1
+            else:
+                raise GoSyntaxError(
+                    f"{path}:{line}: unterminated string/rune literal")
+            if j >= n:
+                raise GoSyntaxError(
+                    f"{path}:{line}: unterminated string/rune literal")
+            toks.append(("string", src[i:j + 1], line))
+            i = j + 1
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (src[j].isalnum() or src[j] == "_"):
+                j += 1
+            toks.append(("ident", src[i:j], line))
+            i = j
+            continue
+        if c.isdigit():
+            j = i
+            while j < n and (src[j].isalnum() or src[j] in "._+-"):
+                # crude number scan (covers hex/exp); +- only after e/E/p/P
+                if src[j] in "+-" and src[j - 1] not in "eEpP":
+                    break
+                j += 1
+            toks.append(("number", src[i:j], line))
+            i = j
+            continue
+        toks.append(("punct", c, line))
+        i += 1
+    return toks
+
+
+def check_source(src: str, path: str = "<src>") -> None:
+    toks = lex(src, path)
+    if not toks:
+        raise GoSyntaxError(f"{path}: empty source")
+    if not (toks[0] == ("ident", "package", toks[0][2])
+            or toks[0][:2] == ("ident", "package")):
+        raise GoSyntaxError(
+            f"{path}:{toks[0][2]}: first declaration must be 'package', "
+            f"got {toks[0][1]!r}")
+    if len(toks) < 2 or toks[1][0] != "ident":
+        raise GoSyntaxError(f"{path}: malformed package clause")
+
+    stack: List[Tuple[str, int]] = []
+    for kind, text, ln in toks:
+        if kind != "punct":
+            continue
+        if text in OPEN:
+            stack.append((text, ln))
+        elif text in CLOSE:
+            if not stack:
+                raise GoSyntaxError(
+                    f"{path}:{ln}: unmatched closing {text!r}")
+            opener, oln = stack.pop()
+            if OPEN[opener] != text:
+                raise GoSyntaxError(
+                    f"{path}:{ln}: mismatched {text!r} closes {opener!r} "
+                    f"opened at line {oln}")
+    if stack:
+        opener, oln = stack[-1]
+        raise GoSyntaxError(
+            f"{path}:{oln}: unclosed {opener!r} at end of file")
+
+    # top-level structure: after a top-level '}' (a func/type body
+    # close), the next non-operator token must start a new declaration
+    TOP_PUNCT_OK = set(";=*.,&|+-/%<>!^:~")
+    depth = 0
+    expect_decl = True
+    for idx, (kind, text, ln) in enumerate(toks):
+        if kind == "punct":
+            if text in OPEN:
+                depth += 1
+            elif text in CLOSE:
+                depth -= 1
+                if depth == 0 and text == "}":
+                    expect_decl = True
+            elif depth == 0 and text not in TOP_PUNCT_OK:
+                raise GoSyntaxError(
+                    f"{path}:{ln}: unexpected {text!r} at top level")
+            continue
+        if depth != 0:
+            continue
+        if kind == "ident" and text in KEYWORD_DECL:
+            expect_decl = False
+            if text == "func":
+                nkind, ntext, _ = toks[idx + 1] if idx + 1 < len(toks) \
+                    else ("eof", "", ln)
+                if not (nkind == "ident"
+                        or (nkind == "punct" and ntext == "(")):
+                    raise GoSyntaxError(
+                        f"{path}:{ln}: 'func' not followed by a name "
+                        "or receiver")
+        elif expect_decl and kind == "ident":
+            raise GoSyntaxError(
+                f"{path}:{ln}: expected a declaration keyword at top "
+                f"level, got {text!r}")
+
+
+def check_file(path: str) -> None:
+    with open(path) as f:
+        check_source(f.read(), path)
+
+
+def main(argv):
+    rc = 0
+    for path in argv:
+        try:
+            check_file(path)
+            print(f"{path}: OK")
+        except GoSyntaxError as e:
+            print(f"SYNTAX ERROR: {e}")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
