@@ -118,7 +118,7 @@ def bench_lenet(batch=256, steps=30, warmup=5):
     return _best_of(run_once)
 
 
-def bench_ernie(batch=48, seq=512, steps=20, warmup=3, attn_dropout=True,
+def bench_ernie(batch=44, seq=512, steps=20, warmup=3, attn_dropout=True,
                 amp=True, amp_level="O1", fuse_qkv=False):
     """ERNIE/BERT-base dygraph training throughput (BASELINE.json config
     #3) — eager layers compiled into one XLA step via dygraph jit.
@@ -381,7 +381,7 @@ def main():
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "ernie":
         tps = bench_ernie(
-            batch=int(os.environ.get("BENCH_BATCH", "48")),
+            batch=int(os.environ.get("BENCH_BATCH", "44")),
             seq=int(os.environ.get("BENCH_SEQ", "512")),
             steps=int(os.environ.get("BENCH_STEPS", "20")),
             attn_dropout=os.environ.get("BENCH_ATTN_DROPOUT", "1") != "0",

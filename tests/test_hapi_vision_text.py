@@ -30,12 +30,15 @@ def test_transforms_compose():
 
 def test_vision_models_forward_shapes():
     with guard():
-        x = to_variable(np.random.rand(2, 3, 64, 64).astype(np.float32))
+        # 32px, batch 1: the smallest inputs every stage survives —
+        # this is a shape/wiring test and eager dispatch on the 1-core
+        # CI box is the suite's single largest cost (36s at 64px b2)
+        x = to_variable(np.random.rand(1, 3, 32, 32).astype(np.float32))
         for net in (models.resnet18(num_classes=7),
                     models.mobilenet_v1(scale=0.25, num_classes=7),
                     models.mobilenet_v2(scale=0.25, num_classes=7)):
             out = net(x)
-            assert tuple(out.shape) == (2, 7), type(net).__name__
+            assert tuple(out.shape) == (1, 7), type(net).__name__
         lenet = models.LeNet()
         img = to_variable(np.random.rand(2, 1, 28, 28).astype(np.float32))
         assert tuple(lenet(img).shape) == (2, 10)
@@ -43,6 +46,7 @@ def test_vision_models_forward_shapes():
 
 def test_vgg_forward_shape():
     with guard():
+        # vgg's classifier flattens a fixed 7x7 feature map: 224 required
         x = to_variable(np.random.rand(1, 3, 224, 224).astype(np.float32))
         out = models.vgg11(num_classes=5)(x)
         assert tuple(out.shape) == (1, 5)

@@ -1202,6 +1202,12 @@ SPECS["fusion_repeated_fc_relu"] = S(
     ref=lambda ins, a: {"Out": np.maximum(
         np.maximum(ins["X"] @ ins["W"][0] + ins["Bias"][0], 0)
         @ ins["W"][1] + ins["Bias"][1], 0)}, atol=1e-4)
+SPECS["fc"] = S(
+    {"Input": fn32(4, 6), "W": fn32(6, 3), "Bias": fn32(3)},
+    {"in_num_col_dims": 1, "activation_type": "relu"},
+    ref=lambda ins, a: {"Out": np.maximum(
+        ins["Input"] @ ins["W"] + ins["Bias"], 0)},
+    grad=("Input", "W"), atol=1e-4)
 SPECS["fusion_squared_mat_sub"] = S(
     {"X": fn32(3, 4), "Y": fn32(4, 5)}, {"scalar": 0.5},
     outs=("Out",), no_check=("SquaredX", "SquaredY", "SquaredXY"),
