@@ -34,7 +34,7 @@ def _best_of(run_once, repeats=None):
     return max(run_once() for _ in range(n))
 
 
-def bench_resnet50(batch=128, steps=20, warmup=3, image=224, classes=1000,
+def bench_resnet50(batch=128, steps=240, warmup=3, image=224, classes=1000,
                    amp=True):
     import jax
 
@@ -118,7 +118,7 @@ def bench_lenet(batch=256, steps=30, warmup=5):
     return _best_of(run_once)
 
 
-def bench_ernie(batch=44, seq=512, steps=40, warmup=3, attn_dropout=True,
+def bench_ernie(batch=44, seq=512, steps=240, warmup=3, attn_dropout=True,
                 amp=True, amp_level="O1", fuse_qkv=False):
     """ERNIE/BERT-base dygraph training throughput (BASELINE.json config
     #3) — eager layers compiled into one XLA step via dygraph jit.
@@ -383,7 +383,7 @@ def main():
         tps = bench_ernie(
             batch=int(os.environ.get("BENCH_BATCH", "44")),
             seq=int(os.environ.get("BENCH_SEQ", "512")),
-            steps=int(os.environ.get("BENCH_STEPS", "40")),
+            steps=int(os.environ.get("BENCH_STEPS", "240")),
             attn_dropout=os.environ.get("BENCH_ATTN_DROPOUT", "1") != "0",
             amp=os.environ.get("BENCH_AMP", "1") != "0",
             amp_level=os.environ.get("BENCH_AMP_LEVEL", "O1"),
@@ -422,7 +422,7 @@ def main():
         return
     ips = bench_resnet50(
         batch=int(os.environ.get("BENCH_BATCH", "128")),
-        steps=int(os.environ.get("BENCH_STEPS", "40")),
+        steps=int(os.environ.get("BENCH_STEPS", "240")),
         image=int(os.environ.get("BENCH_IMAGE", "224")),
     )
     # vs_baseline: ratio over the round-1 recorded number (BENCH_r01.json,

@@ -57,6 +57,17 @@ def _use_pallas() -> bool:
 
 
 def _pick_block(seq: int, candidates=(512, 256, 128)) -> int | None:
+    env = os.environ.get("PT_FLASH_BLOCK")
+    if env:
+        # tuning knob: accept only a supported block (>=128, the kernel's
+        # lane-broadcast row-stat width); anything else falls through to
+        # the default ladder instead of handing Mosaic a bad BlockSpec
+        try:
+            b = int(env)
+        except ValueError:
+            b = 0
+        if b >= 128 and seq % b == 0:
+            return b
     for c in candidates:
         if seq % c == 0:
             return c
