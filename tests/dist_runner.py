@@ -152,7 +152,10 @@ def run_ps_server():
 
 
 def run_ps_trainer(steps=6):
-    """PS trainer process against an external server."""
+    """PS trainer process against an external server.  With
+    PADDLE_TRAINERS_NUM=N and PADDLE_TRAINER_ID=i, trains the i-th
+    interleaved shard of the batch as one of N sync workers (the
+    test_dist_base 2-trainer cluster layout)."""
     import paddle_tpu as pt
     import paddle_tpu.fluid as fluid
     from paddle_tpu.framework.scope import Scope, scope_guard
@@ -161,10 +164,14 @@ def run_ps_trainer(steps=6):
         UserDefinedRoleMaker, Role)
 
     ep = os.environ["PADDLE_PSERVER_ENDPOINT"]
+    n_trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    tid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     xs, ys = _data()
+    if n_trainers > 1:
+        xs, ys = xs[tid::n_trainers], ys[tid::n_trainers]
     fleet = FleetTranspiler()
     fleet.init(UserDefinedRoleMaker(
-        current_id=0, role=Role.WORKER, worker_num=1,
+        current_id=tid, role=Role.WORKER, worker_num=n_trainers,
         server_endpoints=[ep]))
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 13

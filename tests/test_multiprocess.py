@@ -156,3 +156,97 @@ def test_ps_server_in_separate_process():
     finally:
         server.kill()
         server.wait()
+
+
+def test_ps_two_trainers_sync_parity():
+    """The test_dist_base.py:933 check_with_place layout for real: a PS
+    server process + TWO trainer processes over localhost, sync mode.
+    Each round both trainers pull w_t, compute their half-shard mean
+    grads g0/g1, and push; barriers separate rounds, so the trajectory
+    is exactly w_{t+1} = w_t - lr*(g0 + g1).  The oracle replicates
+    that locally with a two-branch loss (sum of per-half means) and the
+    per-trainer loss curves must match."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["PADDLE_PSERVER_ENDPOINT"] = f"127.0.0.1:{port}"
+    env["PADDLE_TRAINERS_NUM"] = "2"
+    server = subprocess.Popen(
+        [sys.executable, RUNNER, "ps_server"], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=HERE)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection(("127.0.0.1", port), timeout=1)
+                s.close()
+                break
+            except OSError:
+                time.sleep(0.2)
+        else:
+            raise TimeoutError("PS server never opened its port")
+        trainers = []
+        for tid in range(2):
+            tenv = dict(env)
+            tenv["PADDLE_TRAINER_ID"] = str(tid)
+            trainers.append(subprocess.Popen(
+                [sys.executable, RUNNER, "ps_trainer"], env=tenv,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, cwd=HERE))
+        outs = []
+        for t in trainers:
+            out, err = t.communicate(timeout=240)
+            assert t.returncode == 0, err[-3000:]
+            line = [l for l in out.splitlines()
+                    if l.startswith("RESULT=")][0]
+            outs.append(json.loads(line[len("RESULT="):])["losses"])
+
+        # ---- local oracle: one process computing the same trajectory
+        import paddle_tpu as pt
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.framework.scope import Scope, scope_guard
+        from tests.dist_runner import _data
+
+        xs, ys = _data()
+        halves = [(xs[0::2], ys[0::2]), (xs[1::2], ys[1::2])]
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 13
+        with fluid.program_guard(main, startup):
+            x0 = fluid.layers.data("x0", [8])
+            y0 = fluid.layers.data("y0", [1])
+            x1 = fluid.layers.data("x1", [8])
+            y1 = fluid.layers.data("y1", [1])
+
+            def branch(xv, yv):
+                h = fluid.layers.fc(
+                    xv, 16, act="relu",
+                    param_attr=fluid.ParamAttr(name="o_fc0.w"),
+                    bias_attr=fluid.ParamAttr(name="o_fc0.b"))
+                pred = fluid.layers.fc(
+                    h, 1, param_attr=fluid.ParamAttr(name="o_fc1.w"),
+                    bias_attr=fluid.ParamAttr(name="o_fc1.b"))
+                return fluid.layers.reduce_mean(
+                    fluid.layers.square_error_cost(pred, yv))
+
+            l0 = branch(x0, y0)
+            l1 = branch(x1, y1)
+            total = fluid.layers.elementwise_add(l0, l1)
+            fluid.optimizer.SGDOptimizer(0.1).minimize(total)
+        exe = pt.Executor(pt.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            oracle0, oracle1 = [], []
+            for _ in range(6):
+                o = exe.run(main, feed={
+                    "x0": halves[0][0], "y0": halves[0][1],
+                    "x1": halves[1][0], "y1": halves[1][1]},
+                    fetch_list=[l0, l1])
+                oracle0.append(float(np.asarray(o[0]).ravel()[0]))
+                oracle1.append(float(np.asarray(o[1]).ravel()[0]))
+        np.testing.assert_allclose(outs[0], oracle0, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(outs[1], oracle1, rtol=1e-4, atol=1e-5)
+    finally:
+        server.kill()
+        server.wait()
