@@ -280,6 +280,39 @@ def _fwd_kernel_body(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
 # ==========================================================================
 # Backward kernels
 # ==========================================================================
+def _bwd_softmax_terms(q, k, v, do, lse, delta, bias_ref, seed_ref, *,
+                       scale, causal, row0, col0, drop_coords,
+                       dropout_rate):
+    """Shared backward math: recompute S from the saved lse, regenerate
+    the dropout mask, and return (pd, ds) — the two matrices every
+    backward kernel contracts from.  drop_coords = (iq, ik, n_q, n_kv)
+    in FORWARD block coordinates (the mask stream contract)."""
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    if causal:
+        rows = row0 + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = col0 + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+    p = jnp.exp(s - lse[:, :1])
+    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    if dropout_rate > 0.0:
+        # dS = P*(M*dPD/keep - delta): delta = rowsum(dO*O) is already
+        # the dropped-path rowsum (O = PD@V), so only dp needs the mask
+        iq, ik, n_q, n_kv = drop_coords
+        keep = _dropout_keep(seed_ref, p.shape, dropout_rate, iq, ik,
+                             n_q, n_kv)
+        inv = 1.0 / (1.0 - dropout_rate)
+        pd = jnp.where(keep, p, 0.0) * inv
+        dp = jnp.where(keep, dp, 0.0) * inv
+    else:
+        pd = p
+    ds = p * (dp - delta[:, :1]) * scale
+    return pd, ds
+
+
 def _bwd_dq_kernel(q_ref, k_ref, do_ref, lse_ref, delta_ref, bias_ref,
                    seed_ref, v_ref, dq_ref, dq_scr, *, scale, causal,
                    block_q, block_k, n_kv, dropout_rate=0.0):
@@ -291,30 +324,13 @@ def _bwd_dq_kernel(q_ref, k_ref, do_ref, lse_ref, delta_ref, bias_ref,
 
     q = q_ref[0, 0]
     k = k_ref[0, 0]
-    v = v_ref[0, 0]
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0]                               # (bq, 128)
-    delta = delta_ref[0, 0]                           # (bq, 128)
-
-    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32) * scale
-    if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
-    if causal:
-        qi = pl.program_id(2)
-        rows = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
-    p = jnp.exp(s - lse[:, :1])                       # (bq, bk)
-    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                         preferred_element_type=jnp.float32)
-    if dropout_rate > 0.0:
-        # dS = P*(M*dPD/keep - delta): delta = rowsum(dO*O) is already
-        # the dropped-path rowsum (O = PD@V), so only dp needs the mask
-        keep = _dropout_keep(seed_ref, p.shape, dropout_rate,
-                             pl.program_id(2), ki, pl.num_programs(2), n_kv)
-        dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
-    ds = p * (dp - delta[:, :1]) * scale              # (bq, bk)
+    qi = pl.program_id(2)
+    _, ds = _bwd_softmax_terms(
+        q, k, v_ref[0, 0], do_ref[0, 0], lse_ref[0, 0], delta_ref[0, 0],
+        bias_ref, seed_ref, scale=scale, causal=causal,
+        row0=qi * block_q, col0=ki * block_k,
+        drop_coords=(qi, ki, pl.num_programs(2), n_kv),
+        dropout_rate=dropout_rate)
     dq_scr[...] += lax.dot_general(
         ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -335,39 +351,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
 
     q = q_ref[0, 0]                                   # (bq, d)
-    k = k_ref[0, 0]                                   # (bk, d)
-    v = v_ref[0, 0]
     do = do_ref[0, 0]
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-
-    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32) * scale
-    if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
     ik = pl.program_id(2)
-    if causal:
-        rows = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = ik * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
-    p = jnp.exp(s - lse[:, :1])                       # (bq, bk)
-    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                         preferred_element_type=jnp.float32)
-    if dropout_rate > 0.0:
-        # seed coordinates MUST be (seed, b, h, q-block, kv-block) — the
-        # same order as the forward, though this grid iterates kv outer
-        keep = _dropout_keep(seed_ref, p.shape, dropout_rate, qi, ik,
-                             n_q, pl.num_programs(2))
-        inv = 1.0 / (1.0 - dropout_rate)
-        pd = jnp.where(keep, p, 0.0) * inv
-        dp = jnp.where(keep, dp, 0.0) * inv
-    else:
-        pd = p
+    # seed coordinates MUST be (seed, b, h, q-block, kv-block) — the
+    # same order as the forward, though this grid iterates kv outer
+    pd, ds = _bwd_softmax_terms(
+        q, k_ref[0, 0], v_ref[0, 0], do, lse_ref[0, 0], delta_ref[0, 0],
+        bias_ref, seed_ref, scale=scale, causal=causal,
+        row0=qi * block_q, col0=ik * block_k,
+        drop_coords=(qi, ik, n_q, pl.num_programs(2)),
+        dropout_rate=dropout_rate)
     # dV += PD^T dO   (contract over bq)
     dv_scr[...] += lax.dot_general(
         pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, :1]) * scale
     # dK += dS^T Q   (contract over bq)
     dk_scr[...] += lax.dot_general(
         ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -379,6 +376,80 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      bias_ref, seed_ref, dq_ref, dk_ref, dv_ref, *,
+                      scale, causal, dropout_rate=0.0):
+    """Single-block backward (nq == nk == 1): S is computed ONCE and all
+    three grads come out of the same invocation — the two-kernel split
+    exists only because multi-block dq wants kv-innermost accumulation
+    while dk/dv want q-innermost; with one block per axis there is
+    nothing to accumulate.  Saves 2 of the 7 backward matmuls and a
+    second read of q/k/v/do/lse/delta (measured on v5e: the dominant
+    seq-512 BERT shape)."""
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    do = do_ref[0, 0]
+    pd, ds = _bwd_softmax_terms(
+        q, k, v_ref[0, 0], do, lse_ref[0, 0], delta_ref[0, 0],
+        bias_ref, seed_ref, scale=scale, causal=causal, row0=0, col0=0,
+        drop_coords=(0, 0, 1, 1), dropout_rate=dropout_rate)
+    dq_ref[0, 0] = lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dv_ref[0, 0] = lax.dot_general(
+        pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dk_ref[0, 0] = lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
+def _flash_bwd_fused(q, k, v, bias, lse, do, delta, scale, causal,
+                     block_q, block_k, dropout_rate, seed):
+    b, h = q.shape[0], q.shape[1]
+    d = q.shape[3]
+    has_drop = dropout_rate > 0.0
+
+    def _q_idx(ib, ih):
+        return (ib, ih, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), _q_idx),       # q
+        pl.BlockSpec((1, 1, block_k, d), _q_idx),       # k
+        pl.BlockSpec((1, 1, block_k, d), _q_idx),       # v
+        pl.BlockSpec((1, 1, block_q, d), _q_idx),       # do
+        pl.BlockSpec((1, 1, block_q, LANES), _q_idx),   # lse
+        pl.BlockSpec((1, 1, block_q, LANES), _q_idx),   # delta
+    ]
+    args = [q, k, v, do, lse, delta]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda ib, ih: (ib, 0, 0)))
+        args.append(bias[:, None, :])
+    if has_drop:
+        in_specs.append(_seed_spec())
+        args.append(seed)
+    return pl.pallas_call(
+        _wrap_optional(
+            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                              dropout_rate=dropout_rate),
+            6, bias is not None, has_drop),
+        grid=(b, h),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), _q_idx),
+            pl.BlockSpec((1, 1, block_k, d), _q_idx),
+            pl.BlockSpec((1, 1, block_k, d), _q_idx),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_interpret(),
+    )(*args)
+
+
 def _flash_bwd(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
                dropout_rate=0.0, seed=None):
     b, h, sq, d = q.shape
@@ -387,6 +458,12 @@ def _flash_bwd(q, k, v, bias, o, lse, do, scale, causal, block_q, block_k,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (b, h, sq, LANES))
     has_drop = dropout_rate > 0.0
+
+    if nq == 1 and nk == 1 and os.environ.get("PT_FLASH_FUSED_BWD",
+                                              "1") != "0":
+        return _flash_bwd_fused(q, k, v, bias, lse, do, delta, scale,
+                                causal, block_q, block_k, dropout_rate,
+                                seed)
 
     # --- dQ: grid (b, h, nq, nk), kv innermost ---------------------------
     def _q_idx(ib, ih, iq, ik):

@@ -125,3 +125,26 @@ def test_kernel_dropout_determinism_and_stats():
     assert float(jnp.max(jnp.abs(o1 - o2))) == 0.0
     o3 = f(jnp.asarray([8.0], jnp.float32))
     assert float(jnp.max(jnp.abs(o1 - o3))) > 0
+
+
+def test_fused_vs_split_backward_same_grads(monkeypatch):
+    """The fused single-block backward and the split dq/dkv kernels must
+    regenerate the SAME dropout masks and produce identical grads (r4:
+    the fused path is auto-engaged at nq == nk == 1)."""
+    q, k, v = _qkv(s=256, d=32)
+    seed = jnp.asarray([11.0], jnp.float32)
+
+    def grads():
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, dropout_rate=0.1,
+                                dropout_seed=seed)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.setenv("PT_FLASH_FUSED_BWD", "1")
+    g_fused = grads()
+    monkeypatch.setenv("PT_FLASH_FUSED_BWD", "0")
+    g_split = grads()
+    for name, a, b in zip("qkv", g_fused, g_split):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)

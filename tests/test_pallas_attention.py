@@ -45,10 +45,16 @@ def test_kernel_forward_parity(interpret_kernel, causal, with_bias):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_kernel_grad_parity(interpret_kernel):
+@pytest.mark.parametrize("fused_bwd", ["1", "0"])
+def test_kernel_grad_parity(interpret_kernel, fused_bwd, monkeypatch):
+    """Covers BOTH backward paths: the fused single-block kernel (the
+    seq<=512 production path) and the split dq/dkv kernels (the
+    multi-block path, which single-block test shapes would otherwise
+    never exercise — r4 code-review finding)."""
     import jax
     import jax.numpy as jnp
 
+    monkeypatch.setenv("PT_FLASH_FUSED_BWD", fused_bwd)
     q, k, v, bias = _rand_qkv(seed=3)
     q, k, v, bias = map(jnp.asarray, (q, k, v, bias))
     ct = jnp.asarray(np.random.RandomState(9).randn(*q.shape).astype(np.float32))
