@@ -1,0 +1,22 @@
+"""The examples/ scripts must stay runnable (--tiny smoke on CPU)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("script", ["train_resnet_static.py",
+                                    "train_bert_dygraph.py",
+                                    "train_wide_deep_ps.py"])
+def test_example_tiny_smoke(script):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), "--tiny"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "step" in proc.stdout

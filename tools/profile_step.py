@@ -78,6 +78,7 @@ def run_ernie(steps=8, batch=16, seq=512, attn_dropout=True):
     def step():
         return fn(ids, labels)
 
+    step.fn = fn  # the raw (ids, labels) -> loss step (soak_ernie reuses it)
     return step
 
 

@@ -1,41 +1,56 @@
-"""Soak test: 2000 real ERNIE-base train steps on the chip with the full r4
-perf stack (rbg PRNG, fused Adam, flash fused-backward, AMP). Loss must
-descend smoothly on repeated data (memorization) with zero NaN/inf."""
+"""Soak test: 2000 real ERNIE-base train steps on the chip with the full
+r4 perf stack (rbg PRNG, fused Adam, flash fused-backward, AMP). Loss
+must descend smoothly on repeated data (memorization) with zero NaN/inf.
+
+Reuses tools/profile_step.py's harness so the soak always exercises the
+same stack the profiler measures.
+"""
 import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
-import jax
-import paddle_tpu.fluid as fluid
-from paddle_tpu.dygraph import enable_dygraph, jit_train_step
-from paddle_tpu.models.bert import BertConfig, BertForPretraining
 
-cfg = BertConfig(attention_probs_dropout_prob=0.1)
-rng = np.random.RandomState(0)
-# small repeated corpus: the model should memorize -> loss well below init
-batches = [
-    (jax.device_put(rng.randint(0, cfg.vocab_size, (16, 512)).astype(np.int32)),
-     jax.device_put(rng.randint(0, cfg.vocab_size, (16, 512)).astype(np.int32)))
-    for _ in range(4)
-]
-enable_dygraph()
-model = BertForPretraining(cfg)
-opt = fluid.optimizer.AdamOptimizer(5e-5, parameter_list=model.parameters())
-step = jit_train_step(model, opt, lambda m, i, l: m(i, l), amp=True)
-losses = []
-t0 = time.perf_counter()
-for i in range(2000):
-    ids, labels = batches[i % len(batches)]
-    loss = step(ids, labels)
-    if i % 100 == 0 or i == 1999:
-        lv = float(np.asarray(loss.value()))
-        assert np.isfinite(lv), (i, lv)
-        losses.append((i, lv))
-        print(f"step {i}: loss {lv:.4f}", flush=True)
-dt = time.perf_counter() - t0
-print(f"2000 steps in {dt:.0f}s ({2000*16*512/dt:.0f} tok/s sustained)")
-first, last = losses[0][1], losses[-1][1]
-assert last < first * 0.5, (first, last)
-print(f"SOAK OK: {first:.3f} -> {last:.3f}")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(steps=2000, batch=16, seq=512):
+    import jax
+
+    from profile_step import run_ernie
+
+    # run_ernie builds model/opt/jitted step with the bench defaults and
+    # a fixed batch; rebuild the feed per-cycle from a 4-batch corpus so
+    # the model can memorize
+    step = run_ernie(batch=batch, seq=seq)
+    rng = np.random.RandomState(0)
+    corpus = [
+        (jax.device_put(rng.randint(0, 30522, (batch, seq)).astype(np.int32)),
+         jax.device_put(rng.randint(0, 30522, (batch, seq)).astype(np.int32)))
+        for _ in range(4)
+    ]
+    # warmup/compile OUTSIDE the timed window
+    loss = step.fn(*corpus[0])
+    float(np.asarray(loss.value()))
+    losses = []
+    t0 = time.perf_counter()
+    for i in range(steps):
+        ids, labels = corpus[i % len(corpus)]
+        loss = step.fn(ids, labels)
+        if i % 100 == 0 or i == steps - 1:
+            lv = float(np.asarray(loss.value()))
+            assert np.isfinite(lv), (i, lv)
+            losses.append((i, lv))
+            print(f"step {i}: loss {lv:.4f}", flush=True)
+    dt = time.perf_counter() - t0
+    print(f"{steps} steps in {dt:.0f}s "
+          f"({steps * batch * seq / dt:.0f} tok/s sustained, post-compile)")
+    first, last = losses[0][1], losses[-1][1]
+    if steps >= 500:  # short smokes can't halve the loss; finite is enough
+        assert last < first * 0.5, (first, last)
+    print(f"SOAK OK: {first:.3f} -> {last:.3f}")
+
+
+if __name__ == "__main__":
+    main()
