@@ -200,6 +200,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
 
 
+def _fwd_single_block_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref,
+                             o_ref, lse_ref, *, scale, causal,
+                             dropout_rate=0.0):
+    """Single-block forward (nq == nk == 1): the whole softmax row is in
+    VMEM, so the online-softmax scratch accumulation (m/l/acc updates +
+    @pl.when epilogues) reduces to one direct softmax."""
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    if causal:
+        rows = lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+    m = jnp.max(s, axis=1, keepdims=True)              # (bq, 1)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=1, keepdims=True)
+    if dropout_rate > 0.0:
+        keep = _dropout_keep(seed_ref, p.shape, dropout_rate, 0, 0, 1, 1)
+        pd = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+    else:
+        pd = p
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    acc = lax.dot_general(pd.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l_safe), lse_ref.shape[2:])
+
+
 def _wrap_optional(body, n_lead, has_bias, has_seed):
     """Adapter: positional refs -> body(..., bias_ref/seed_ref or None).
     Keeps the kernel bodies single-sourced across the 4 bias x dropout
@@ -226,6 +258,44 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k,
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nq, nk = sq // block_q, sk // block_k
+    if nq == 1 and nk == 1 and os.environ.get("PT_FLASH_FUSED_BWD",
+                                              "1") != "0":
+        # single-block: direct softmax, no online-softmax scratch (the
+        # same gate as the fused backward so one env var A/Bs both)
+        def _blk(ib, ih):
+            return (ib, ih, 0, 0)
+
+        in_specs = [
+            pl.BlockSpec((1, 1, block_q, d), _blk),
+            pl.BlockSpec((1, 1, block_k, d), _blk),
+            pl.BlockSpec((1, 1, block_k, d), _blk),
+        ]
+        args = [q, k, v]
+        if bias is not None:
+            in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                         lambda ib, ih: (ib, 0, 0)))
+            args.append(bias[:, None, :])
+        if dropout_rate > 0.0:
+            in_specs.append(_seed_spec())
+            args.append(seed)
+        return pl.pallas_call(
+            _wrap_optional(
+                functools.partial(_fwd_single_block_kernel, scale=scale,
+                                  causal=causal,
+                                  dropout_rate=dropout_rate),
+                3, bias is not None, dropout_rate > 0.0),
+            grid=(b, h),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, d), _blk),
+                pl.BlockSpec((1, 1, block_q, LANES), _blk),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(*args)
     grid = (b, h, nq, nk)
 
     in_specs = [
