@@ -46,6 +46,11 @@ from . import distribution
 from . import static
 from . import incubate
 from .incubate import complex  # noqa: A004  (paddle.complex preview API)
+import sys as _sys
+
+# make `import paddle_tpu.complex` work as a module path too, not just
+# attribute access (users import it both ways)
+_sys.modules[__name__ + ".complex"] = complex
 from .tensor import (
     to_tensor, full, full_like, zeros, ones, zeros_like, ones_like,
     arange, linspace, matmul, concat, reshape, transpose, stack, split,
