@@ -1,0 +1,40 @@
+"""Export a small MobileNet to examples/r/data/ for mobilenet.r
+(reference: r/example uses a pre-exported __model__/__params__ pair)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models.mobilenet import build_mobilenet_v3
+
+
+def main(out_dir=None):
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_dir = out_dir or os.path.join(here, "data")
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 1
+    with fluid.program_guard(main_p, startup):
+        img = fluid.layers.data("img", [3, 64, 64])
+        logits = build_mobilenet_v3(img, class_num=10, scale="small",
+                                    is_test=True)
+        prob = fluid.layers.softmax(logits)
+    exe = fluid.Executor(pt.CPUPlace())
+    exe.run(startup)
+    fluid.io.save_inference_model(
+        os.path.join(out_dir, "model"), ["img"], [prob], exe,
+        main_program=main_p)
+    rng = np.random.RandomState(0)
+    data = rng.rand(1, 3, 64, 64).astype(np.float32)
+    result = exe.run(main_p, feed={"img": data}, fetch_list=[prob])[0]
+    np.save(os.path.join(out_dir, "data.npy"), data)
+    np.save(os.path.join(out_dir, "result.npy"), np.asarray(result))
+    print("exported to", out_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+#!/usr/bin/env Rscript
+# R inference client (reference: r/example/mobilenet.r) — drives the
+# paddle_tpu AnalysisPredictor through reticulate, the same bridge the
+# reference uses for paddle.fluid.core.  Run examples/r/export_model.py
+# first to create data/model, then `Rscript mobilenet.r`.
+
+library(reticulate)  # call Python library
+
+np <- import("numpy")
+paddle <- import("paddle_tpu.inference")
+
+set_config <- function() {
+    config <- paddle$AnalysisConfig("data/model")
+    config$switch_use_feed_fetch_ops(FALSE)
+    config$switch_specify_input_names(TRUE)
+    return(config)
+}
+
+zero_copy_run_mobilenet <- function() {
+    config <- set_config()
+    predictor <- paddle$create_paddle_predictor(config)
+
+    input_names <- predictor$get_input_names()
+    input_tensor <- predictor$get_input_handle(input_names[[1]])
+    data <- np$load("data/data.npy")
+    input_tensor$reshape(dim(data))
+    input_tensor$copy_from_cpu(data)
+
+    predictor$zero_copy_run()
+
+    output_names <- predictor$get_output_names()
+    output_tensor <- predictor$get_output_handle(output_names[[1]])
+    output_data <- output_tensor$copy_to_cpu()
+
+    expected <- np$load("data/result.npy")
+    stopifnot(all(abs(output_data - expected) < 1e-4))
+    cat("R inference OK: output shape", dim(output_data), "\n")
+}
+
+if (!interactive()) {
+    zero_copy_run_mobilenet()
+}

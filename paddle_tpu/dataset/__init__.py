@@ -18,3 +18,6 @@ from . import sentiment
 from . import wmt14
 from . import flowers
 from . import voc2012
+from . import common
+from . import image
+from . import mq2007

@@ -1,0 +1,89 @@
+"""Dataset download/cache helpers (reference:
+python/paddle/dataset/common.py — DATA_HOME, md5file, download, split,
+cluster_files_reader).
+
+This environment has no egress: ``download`` serves ONLY from the local
+cache (drop the file under DATA_HOME/<module>/ to use a real dataset)
+and raises a clear error otherwise; dataset modules keep their
+deterministic synthetic fallbacks for offline testing, as elsewhere in
+paddle_tpu.dataset.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum=None, save_name=None):
+    """Cache-only resolve of a dataset file (the reference fetches
+    ``url``; zero-egress here).  Returns the cached path; verifies the
+    md5 when one is given and the file exists."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    must_mkdirs(dirname)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1])
+    if os.path.exists(filename):
+        if md5sum and md5file(filename) != md5sum:
+            raise IOError(
+                f"{filename}: cached file md5 mismatch (expected {md5sum})")
+        return filename
+    raise IOError(
+        f"dataset file {filename!r} not cached and this environment has "
+        f"no network egress; place the file from {url} there manually")
+
+
+def fetch_all():
+    raise IOError("fetch_all needs network egress; cache files under "
+                  f"{DATA_HOME} instead")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+    """Split a reader's samples into multiple pickle files of
+    ``line_count`` samples each (reference: common.py split)."""
+    if not callable(reader):
+        raise TypeError("reader should be callable")
+    lines = []
+    indx_f = 0
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+                lines = []
+                indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """Read from shard files round-robin by trainer id (reference:
+    common.py cluster_files_reader)."""
+
+    def reader():
+        file_list = glob.glob(files_pattern)
+        file_list.sort()
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    for line in loader(f):
+                        yield line
+
+    return reader

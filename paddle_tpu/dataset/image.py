@@ -1,0 +1,112 @@
+"""Image preprocessing helpers (reference:
+python/paddle/dataset/image.py — resize_short / center_crop /
+random_crop / flip / to_chw / simple_transform).
+
+Pure-numpy implementations (the reference shells out to cv2; nothing
+here needs it — bilinear resize via index mapping), so the vision
+dataset pipelines work in this image without extra deps.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _resize(im, h, w):
+    """Bilinear resize HWC (or HW) uint8/float image with numpy."""
+    src_h, src_w = im.shape[:2]
+    if (src_h, src_w) == (h, w):
+        return im
+    ys = (np.arange(h) + 0.5) * src_h / h - 0.5
+    xs = (np.arange(w) + 0.5) * src_w / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, src_h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, src_w - 1)
+    y1 = np.clip(y0 + 1, 0, src_h - 1)
+    x1 = np.clip(x0 + 1, 0, src_w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :]
+    if im.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    a = im[y0][:, x0].astype(np.float64)
+    b = im[y0][:, x1].astype(np.float64)
+    c = im[y1][:, x0].astype(np.float64)
+    d = im[y1][:, x1].astype(np.float64)
+    out = (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+           + c * wy * (1 - wx) + d * wy * wx)
+    return out.astype(im.dtype)
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals ``size`` (aspect preserved)."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _resize(im, size, int(round(w * size / h)))
+    return _resize(im, int(round(h * size / w)), size)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0, w0 = (h - size) // 2, (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, h - size + 1)
+    w0 = np.random.randint(0, w - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short -> (random|center) crop (+random flip in train) ->
+    CHW float32 (reference: image.py simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    im = load_image(filename, is_color)
+    return simple_transform(im, resize_size, crop_size, is_train, is_color,
+                            mean)
+
+
+def load_image(file, is_color=True):
+    """Minimal loader: .npy arrays always; PNG/JPEG when pillow is
+    available (not baked into this image — arrays are the test path)."""
+    if str(file).endswith(".npy"):
+        return np.load(file)
+    try:
+        from PIL import Image  # noqa: WPS433
+
+        im = Image.open(file)
+        if is_color:
+            im = im.convert("RGB")
+        return np.asarray(im)
+    except ImportError as e:
+        raise IOError(
+            f"load_image({file!r}): only .npy supported without pillow"
+        ) from e

@@ -1,0 +1,194 @@
+"""MQ2007 learning-to-rank reader (reference:
+python/paddle/dataset/mq2007.py — LETOR 4.0 query/document pairs with
+pointwise/pairwise/listwise generators).
+
+Line format: ``<rel> qid:<id> 1:<f1> 2:<f2> ... 46:<f46> #<comment>``
+(48 space-separated fields before the comment).  Zero-egress: reads the
+extracted fold from the dataset cache when present, else a
+deterministic synthetic LETOR sample so the parsing/generator pipeline
+stays testable offline.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+
+URL = ("http://www.bigdatalab.ac.cn/benchmark/upload/download_source/"
+       "7b6dbbe2-842c-11e4-a536-bcaec51b9163_MQ2007.rar")
+MD5 = "7be1640ae95c6408dab0ae7207bdc706"
+
+N_FEATURES = 46
+
+
+class Query:
+    """One query/document pair: relevance score + 46-dim feature
+    vector (reference mq2007.py Query)."""
+
+    def __init__(self, query_id=-1, relevance_score=-1, feature_vector=None,
+                 description=""):
+        self.query_id = query_id
+        self.relevance_score = relevance_score
+        self.feature_vector = list(feature_vector or [])
+        self.description = description
+
+    def __str__(self):
+        return "%s %s %s" % (self.relevance_score, self.query_id,
+                             " ".join(str(f) for f in self.feature_vector))
+
+    def _parse_(self, text):
+        hash_pos = text.find("#")
+        if hash_pos >= 0:
+            self.description = text[hash_pos + 1:].strip()
+            text = text[:hash_pos]
+        parts = text.strip().split()
+        if len(parts) != N_FEATURES + 2:
+            return None
+        self.relevance_score = int(parts[0])
+        self.query_id = int(parts[1].split(":")[1])
+        self.feature_vector = [float(p.split(":")[1]) for p in parts[2:]]
+        return self
+
+
+class QueryList:
+    """All documents of one query (reference mq2007.py QueryList)."""
+
+    def __init__(self, querylist=None):
+        self.querylist = list(querylist or [])
+        self.query_id = self.querylist[0].query_id if self.querylist else -1
+
+    def __iter__(self):
+        return iter(self.querylist)
+
+    def __len__(self):
+        return len(self.querylist)
+
+    def __getitem__(self, i):
+        return self.querylist[i]
+
+    def _correct_ranking_(self):
+        self.querylist.sort(key=lambda q: -q.relevance_score)
+
+    def _add_query(self, query):
+        if self.query_id == -1:
+            self.query_id = query.query_id
+        elif self.query_id != query.query_id:
+            raise ValueError(
+                f"query {query.query_id} does not belong to list "
+                f"{self.query_id}")
+        self.querylist.append(query)
+
+
+def gen_plain_txt(querylist):
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    for q in querylist:
+        yield querylist.query_id, q.relevance_score, np.array(
+            q.feature_vector)
+
+
+def gen_point(querylist):
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    for q in querylist:
+        yield q.relevance_score, np.array(q.feature_vector)
+
+
+def gen_pair(querylist, partial_order="full"):
+    """All mis-ordered C(n,2) pairs as (label=1, better, worse)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    for i in range(len(querylist)):
+        left = querylist[i]
+        for j in range(i + 1, len(querylist)):
+            right = querylist[j]
+            if left.relevance_score > right.relevance_score:
+                yield (np.array([1]), np.array(left.feature_vector),
+                       np.array(right.feature_vector))
+            elif left.relevance_score < right.relevance_score:
+                yield (np.array([1]), np.array(right.feature_vector),
+                       np.array(left.feature_vector))
+
+
+def gen_list(querylist):
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    yield (np.array([[q.relevance_score] for q in querylist]),
+           np.array([q.feature_vector for q in querylist]))
+
+
+def query_filter(querylists):
+    """Drop queries whose documents are ALL irrelevant (sum of scores
+    is zero)."""
+    return [ql for ql in querylists
+            if sum(q.relevance_score for q in ql) != 0]
+
+
+def _synthetic_text(n_queries=8, docs_per_query=5, seed=0):
+    rng = np.random.RandomState(seed)
+    lines = []
+    for qid in range(1, n_queries + 1):
+        for d in range(docs_per_query):
+            rel = int(rng.randint(0, 3))
+            feats = rng.rand(N_FEATURES)
+            body = " ".join(f"{k + 1}:{feats[k]:.6f}"
+                            for k in range(N_FEATURES))
+            lines.append(f"{rel} qid:{qid} {body} #docid = SYN-{qid}-{d}")
+    return "\n".join(lines)
+
+
+def load_from_text(filepath, shuffle=False, fill_missing=-1):
+    full = os.path.join(DATA_HOME, "MQ2007", filepath)
+    if os.path.exists(full):
+        with open(full) as f:
+            text = f.read()
+    else:
+        text = _synthetic_text()
+    querylists = []
+    current = None
+    for line in text.splitlines():
+        q = Query()._parse_(line)
+        if q is None:
+            continue
+        if current is None or q.query_id != current.query_id:
+            if current is not None:
+                querylists.append(current)
+            current = QueryList()
+        current._add_query(q)
+    if current is not None:
+        querylists.append(current)
+    return querylists
+
+
+def __reader__(filepath, format="pairwise", shuffle=False, fill_missing=-1):
+    for querylist in query_filter(
+            load_from_text(filepath, shuffle=shuffle,
+                           fill_missing=fill_missing)):
+        if format == "plain_txt":
+            yield next(gen_plain_txt(querylist))
+        elif format == "pointwise":
+            yield next(gen_point(querylist))
+        elif format == "pairwise":
+            yield from gen_pair(querylist)
+        elif format == "listwise":
+            yield next(gen_list(querylist))
+        else:
+            raise ValueError(f"unknown format {format!r}")
+
+
+train = functools.partial(__reader__,
+                          filepath="MQ2007/MQ2007/Fold1/train.txt")
+test = functools.partial(__reader__, filepath="MQ2007/MQ2007/Fold1/test.txt")
+
+
+def fetch():
+    from .common import download
+
+    return download(URL, "MQ2007", MD5)

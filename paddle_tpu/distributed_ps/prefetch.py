@@ -64,6 +64,34 @@ def parallel_pull(client, table: str, flat_ids_list):
     return [first] + [f.result() for f in futs]
 
 
+def parallel_push(client, table: str, pairs, record=False):
+    """Push several (flat_ids, grad_rows) pairs to one table, fanning
+    out over the thread pool under the same latency-adaptive gate as
+    parallel_pull (row adds commute and the server serializes per-table
+    state, so concurrent pushes are exact)."""
+    import time
+
+    if not pairs:
+        return
+    t0 = time.perf_counter()
+    client.push_sparse(table, pairs[0][0], pairs[0][1], record=record)
+    dt = time.perf_counter() - t0
+    key = (id(client), "push")
+    _pull_ema[key] = 0.5 * dt + 0.5 * _pull_ema.get(key, dt)
+    rest = pairs[1:]
+    if not rest:
+        return
+    if _pull_ema[key] < _PARALLEL_FLOOR_S:
+        for ids, g in rest:
+            client.push_sparse(table, ids, g, record=record)
+        return
+    pool = _shared_pool()
+    futs = [pool.submit(client.push_sparse, table, ids, g, record=record)
+            for ids, g in rest]
+    for f in futs:
+        f.result()
+
+
 class SparsePrefetcher:
     """submit() batch N+1's ids while batch N computes; take() pops the
     pre-pulled rows when the lookup op reaches that batch."""

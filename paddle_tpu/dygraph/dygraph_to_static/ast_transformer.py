@@ -2,11 +2,25 @@
 
 Reference: python/paddle/fluid/dygraph/dygraph_to_static/ — the 18
 transformer files (ifelse_transformer.py, loop_transformer.py,
-logical_transformer.py, ast_transformer.py DygraphToStaticAst).  This
-build implements the load-bearing subset: if/else, while, and/or/not in
-test positions, and `len`.  For-range loops stay plain Python (the range
-is static under XLA anyway and unrolling is XLA-friendly); tensor-driven
-`for` loops must be written as while loops.
+break_continue_transformer.py, return_transformer.py,
+print_transformer.py, logical_transformer.py, ast_transformer.py
+DygraphToStaticAst).  Pass order mirrors the reference's
+DygraphToStaticAst.transfer_from_node_type:
+
+1. for -> while (loop_transformer.py): every ``for`` over ``range``/
+   ``enumerate``/an indexable becomes index-based ``while``; the
+   convert_* runtime keeps plain-Python semantics for concrete values
+   and lowers tensor-bound loops to while_loop.
+2. early returns (return_transformer.py): ``return`` inside control
+   flow becomes (ret_flag, ret_val) writes; an ``if`` whose body
+   definitely returns folds the remaining statements into its ``else``
+   (so tensor-pred branches both bind the return value), other sites
+   guard the remaining statements with ``if not ret_flag``.
+3. break/continue (break_continue_transformer.py): bool-guard rewrite —
+   flags + statement guards + ``and not flag`` in the loop test.
+4. print (print_transformer.py): ``print(x)`` -> convert_print.
+5. if/while/boolop -> convert_ifelse / convert_while_loop /
+   convert_logical_* (ifelse/loop/logical transformers).
 """
 from __future__ import annotations
 
@@ -16,6 +30,12 @@ import textwrap
 from typing import List, Set
 
 _JST = "_jst"  # module alias injected into the transformed function's globals
+# NOTE: generated names that must survive loop-var/branch-target analysis
+# (flags, return slots, loop indices) deliberately do NOT use the
+# "__d2s_" prefix — that prefix marks throwaway temps the if/while
+# converters exclude from carries.
+_RET_FLAG = "__ret_flag__"
+_RET_VAL = "__ret_val__"
 
 
 def _store_names(nodes) -> List[str]:
@@ -64,6 +84,245 @@ def _has_return(nodes) -> bool:
             continue
         stack.extend(ast.iter_child_nodes(n))
     return False
+
+
+def _stmt(src: str) -> ast.stmt:
+    return ast.parse(textwrap.dedent(src)).body[0]
+
+
+def _stmts(src: str) -> List[ast.stmt]:
+    return ast.parse(textwrap.dedent(src)).body
+
+
+class _ForToWhileTransformer(ast.NodeTransformer):
+    """reference: loop_transformer.py — rewrite ``for`` into index-based
+    ``while`` so tensor-bound iteration lowers through
+    convert_while_loop.  Handles ``range(...)``, ``enumerate(x)`` and
+    bare indexable iterables; other shapes (generators, zip, dict
+    views, for-else) stay plain Python.  The index advances BEFORE the
+    body so a later ``continue`` bool-guard rewrite cannot skip it."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        it = node.iter
+        is_range = (isinstance(it, ast.Call) and
+                    isinstance(it.func, ast.Name) and it.func.id == "range"
+                    and not it.keywords and 1 <= len(it.args) <= 3)
+        is_enum = (isinstance(it, ast.Call) and
+                   isinstance(it.func, ast.Name) and
+                   it.func.id == "enumerate" and not it.keywords
+                   and len(it.args) == 1)
+        indexable = isinstance(it, (ast.Name, ast.Attribute, ast.Subscript))
+        if not (is_range or is_enum or indexable):
+            return node
+        self._uid += 1
+        u = self._uid
+        # the iterable/length temps are read-only inside the loop (plain
+        # free vars); the INDEX is written each iteration and must be a
+        # loop carry, so it avoids the "__d2s_" excluded-temp prefix
+        itn, nn, ix = f"__d2s_for_it_{u}", f"__for_n_{u}__", f"__for_i_{u}__"
+        if is_range:
+            args = ", ".join(ast.unparse(a) for a in it.args)
+            setup = _stmts(f"{itn} = {_JST}.convert_range({args})")
+        elif is_enum:
+            setup = _stmts(
+                f"{itn} = {_JST}.convert_enumerate("
+                f"{ast.unparse(it.args[0])})")
+        else:
+            setup = _stmts(f"{itn} = {_JST}.convert_iter("
+                           f"{ast.unparse(it)})")
+        setup += _stmts(f"{nn} = {_JST}.convert_len({itn})\n{ix} = 0")
+        bind = ast.Assign(
+            targets=[node.target],
+            value=ast.parse(f"{_JST}.convert_index({itn}, {ix})",
+                            mode="eval").body)
+        step = _stmt(f"{ix} = {ix} + 1")
+        loop = ast.While(
+            test=ast.parse(f"{ix} < {nn}", mode="eval").body,
+            body=[bind, step] + node.body, orelse=[])
+        out = setup + [loop]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+
+class _ReturnTransformer:
+    """reference: return_transformer.py — rewrite early returns into
+    (ret_flag, ret_val) writes.  An ``if`` whose body definitely
+    returns folds the remaining statements into its ``else`` (both cond
+    branches then bind the value — required for tensor predicates);
+    everything else guards the tail with ``if not ret_flag``."""
+
+    def transform(self, fdef: ast.FunctionDef) -> None:
+        tops = [isinstance(s, ast.Return) for s in fdef.body]
+        early = _has_return(
+            [s for s in fdef.body if not isinstance(s, ast.Return)])
+        if not early and sum(tops) <= 1 and (not any(tops) or tops[-1]):
+            return  # returns only as the final statement: nothing to do
+        body, _may, _definite = self._process(list(fdef.body))
+        fdef.body = (
+            _stmts(f"{_RET_FLAG} = False\n{_RET_VAL} = None")
+            + body + _stmts(f"return {_RET_VAL}"))
+        ast.fix_missing_locations(fdef)
+
+    def _process(self, stmts):
+        """Returns (new_stmts, may_return, definitely_returns)."""
+        out: List[ast.stmt] = []
+        for i, s in enumerate(stmts):
+            rest = stmts[i + 1:]
+            if isinstance(s, ast.Return):
+                val = ast.unparse(s.value) if s.value is not None else "None"
+                out += _stmts(f"{_RET_VAL} = {val}\n{_RET_FLAG} = True")
+                return out, True, True  # rest is dead code
+            if isinstance(s, ast.If) and _has_return([s]):
+                s.body, b_may, b_def = self._process(s.body)
+                s.orelse, o_may, o_def = self._process(s.orelse)
+                ast.fix_missing_locations(s)
+                out.append(s)
+                if b_def and o_def:
+                    return out, True, True  # rest unreachable
+                if rest:
+                    new_rest, _r_may, r_def = self._process(rest)
+                    if b_def and not o_may:
+                        # fold the tail into else: both branches of the
+                        # (possibly tensor) cond then bind ret_val
+                        s.orelse = s.orelse + new_rest
+                        ast.fix_missing_locations(s)
+                        return out, True, r_def
+                    out.append(self._guard(new_rest))
+                    return out, True, False
+                return out, True, False
+            if isinstance(s, (ast.While, ast.For)) and _has_return([s]):
+                s.body, _, _ = self._process(s.body)
+                if isinstance(s, ast.While):
+                    s.test = ast.parse(
+                        f"({ast.unparse(s.test)}) and not {_RET_FLAG}",
+                        mode="eval").body
+                else:
+                    # python-level for that stayed unconverted: break out
+                    s.body = s.body + [_stmt(
+                        f"if {_RET_FLAG}:\n    break")]
+                ast.fix_missing_locations(s)
+                out.append(s)
+                if rest:
+                    new_rest, _, _ = self._process(rest)
+                    out.append(self._guard(new_rest))
+                return out, True, False
+            out.append(s)
+        return out, False, False
+
+    @staticmethod
+    def _guard(body):
+        g = _stmt(f"if not {_RET_FLAG}:\n    pass")
+        g.body = body if body else [ast.Pass()]
+        ast.fix_missing_locations(g)
+        return g
+
+
+class _BreakContinueTransformer(ast.NodeTransformer):
+    """reference: break_continue_transformer.py — bool-guard rewrite.
+    ``break`` -> flag set + ``and not flag`` in the loop test;
+    ``continue`` -> flag set; statements after a flag-set (at any depth
+    of nesting inside the loop body) are guarded by ``if not flag``.
+    Works for plain-Python loops unchanged and lets tensor-bound loops
+    lower through convert_while_loop (the flags become loop carries)."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)  # inner loops first
+        if node.orelse:
+            return node
+        has_brk = self._owns(node.body, ast.Break)
+        has_cont = self._owns(node.body, ast.Continue)
+        if not has_brk and not has_cont:
+            return node
+        self._uid += 1
+        brk = f"__brk_{self._uid}__" if has_brk else None
+        cont = f"__cont_{self._uid}__" if has_cont else None
+        body = self._rewrite(node.body, brk, cont)
+        if cont:
+            body = _stmts(f"{cont} = False") + body
+        node.body = body
+        if brk:
+            node.test = ast.parse(
+                f"({ast.unparse(node.test)}) and not {brk}",
+                mode="eval").body
+        pre = _stmts(f"{brk} = False") if brk else []
+        if cont:
+            pre += _stmts(f"{cont} = False")
+        out = pre + [node]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+    @staticmethod
+    def _owns(stmts, kind) -> bool:
+        """break/continue belonging to THIS loop (not nested loops)."""
+        stack = list(stmts)
+        while stack:
+            s = stack.pop()
+            if isinstance(s, kind):
+                return True
+            if isinstance(s, (ast.While, ast.For, ast.FunctionDef,
+                              ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(s))
+        return False
+
+    def _rewrite(self, stmts, brk, cont):
+        """Replace break/continue with flag sets; guard trailing
+        statements after any statement that may set a flag."""
+        flags = [f for f in (brk, cont) if f]
+        test = " and ".join(f"not {f}" for f in flags)
+        out: List[ast.stmt] = []
+        for i, s in enumerate(stmts):
+            rest = stmts[i + 1:]
+            if isinstance(s, ast.Break):
+                out += _stmts(f"{brk} = True")
+                return out  # tail is dead
+            if isinstance(s, ast.Continue):
+                out += _stmts(f"{cont} = True")
+                return out
+            sets_flag = False
+            if isinstance(s, ast.If) and (
+                    self._owns([s], ast.Break) or
+                    self._owns([s], ast.Continue)):
+                s.body = self._rewrite(s.body, brk, cont) or [ast.Pass()]
+                s.orelse = self._rewrite(s.orelse, brk, cont)
+                ast.fix_missing_locations(s)
+                sets_flag = True
+            out.append(s)
+            if sets_flag and rest:
+                g = _stmt(f"if {test}:\n    pass")
+                g.body = self._rewrite(rest, brk, cont) or [ast.Pass()]
+                ast.fix_missing_locations(g)
+                out.append(g)
+                return out
+        return out
+
+
+class _PrintTransformer(ast.NodeTransformer):
+    """reference: print_transformer.py — print(x) statements dispatch
+    through convert_print (layers.Print for tensors)."""
+
+    def visit_Expr(self, node: ast.Expr):
+        self.generic_visit(node)
+        v = node.value
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                and v.func.id == "print" and not v.keywords):
+            v.func = ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                                   attr="convert_print", ctx=ast.Load())
+            ast.fix_missing_locations(node)
+        return node
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -145,10 +404,21 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         body_fn = ast.FunctionDef(
             name=f"__d2s_body_{uid}", args=_args_of(loop_vars),
             body=node.body + [ret], decorator_list=[], returns=None)
+        # loop-local vars (e.g. a converted for's target) may be unbound
+        # before the loop: capture with an UNDEFINED fallback; the
+        # while_loop lowering seeds tensor-bound slots via the
+        # CarryInitMismatch retry (convert_operators.convert_while_loop)
+        captures = []
+        for t in loop_vars:
+            captures.append(ast.parse(
+                f"try:\n    __d2s_wcap_{uid}_{t} = {t}\n"
+                f"except NameError:\n"
+                f"    __d2s_wcap_{uid}_{t} = {_JST}.UNDEFINED").body[0])
+        cap_args = ", ".join(f"__d2s_wcap_{uid}_{t}" for t in loop_vars)
         assign = ast.parse(
             f"({args},) = {_JST}.convert_while_loop("
-            f"__d2s_cond_{uid}, __d2s_body_{uid}, ({args},))").body[0]
-        out = [cond_fn, body_fn, assign]
+            f"__d2s_cond_{uid}, __d2s_body_{uid}, ({cap_args},))").body[0]
+        out = captures + [cond_fn, body_fn, assign]
         for n in out:
             ast.copy_location(n, node)
             ast.fix_missing_locations(n)
@@ -212,6 +482,14 @@ class DygraphToStaticAst:
         fdef = tree.body[0]
         # drop the @declarative decorator itself
         fdef.decorator_list = []
+        # pass order matters (module docstring): for->while first so
+        # return/break/continue rewrites see a uniform while world, then
+        # print, then the convert_* dispatch rewrite
+        _ForToWhileTransformer().visit(tree)
+        _ReturnTransformer().transform(fdef)
+        _BreakContinueTransformer().visit(tree)
+        _PrintTransformer().visit(tree)
+        ast.fix_missing_locations(tree)
         tr = _ControlFlowTransformer()
         tr._fn_assigned = set(_store_names(fdef.body)) | {
             a.arg for a in fdef.args.args}

@@ -34,6 +34,23 @@ def _to_bool_pred(pred):
     return layers.cast(pred, "bool")
 
 
+def _materialize(v):
+    """Python bool/int/float escaping a tensor-mode branch or loop body
+    become [1]-shaped constant vars so cond/while_loop can carry them
+    (the reference's to_static_variable in convert_operators.py)."""
+    from ... import layers
+
+    if isinstance(v, Variable):
+        return v
+    if isinstance(v, bool):
+        return layers.fill_constant([1], "bool", v)
+    if isinstance(v, int):
+        return layers.fill_constant([1], "int64", v)
+    if isinstance(v, float):
+        return layers.fill_constant([1], "float32", v)
+    return v
+
+
 def convert_ifelse(pred, true_fn, false_fn):
     """if-statement: both branch closures return the tuple of names the
     branches (re)bind; symbolic pred lowers to layers.cond."""
@@ -44,12 +61,12 @@ def convert_ifelse(pred, true_fn, false_fn):
             def w():
                 out = fn()
                 vals = out if isinstance(out, (list, tuple)) else [out]
-                if any(v is UNDEFINED for v in vals):
-                    raise ValueError(
-                        f"a variable assigned only in the {branch} branch "
-                        "of a tensor-condition `if` is used after it; both "
-                        "branches must bind every name that escapes the if")
-                return out
+                # UNDEFINED (a name this branch leaves unbound) passes
+                # through: layers.cond._align_branch_outputs fills it
+                # with the RETURN_NO_VALUE magic constant when the other
+                # branch binds a tensor (the reference's UndefinedVar +
+                # magic-number scheme) and raises clearly otherwise
+                return [_materialize(v) for v in vals]
             return w
 
         out = layers.cond(_to_bool_pred(pred), checked(true_fn, "other"),
@@ -61,16 +78,37 @@ def convert_ifelse(pred, true_fn, false_fn):
 
 
 def convert_while_loop(cond_fn, body_fn, loop_vars):
-    """while-statement: symbolic test lowers to layers.while_loop."""
+    """while-statement: symbolic test lowers to layers.while_loop.
+    Python-scalar carries (loop counters, break/continue/return flags)
+    materialize as [1]-constant vars first."""
     test = cond_fn(*loop_vars)
     if _is_tensor(test):
         from ... import layers
 
+        loop_vars = [_materialize(v) for v in loop_vars]
+
         def cond_wrap(*vs):
             return _to_bool_pred(cond_fn(*vs))
 
-        out = layers.while_loop(cond_wrap, lambda *vs: list(body_fn(*vs)),
-                                list(loop_vars))
+        def body_wrap(*vs):
+            return [_materialize(v) for v in body_fn(*vs)]
+
+        try:
+            out = layers.while_loop(cond_wrap, body_wrap, list(loop_vars))
+        except layers.control_flow.CarryInitMismatch as e:
+            # a None-initialized slot (e.g. __ret_val__) becomes a
+            # tensor inside the loop: seed it with the reference's
+            # RETURN_NO_VALUE magic constant at the body's shape/dtype
+            # and retry (return_transformer.py's magic-number scheme)
+            lv = list(loop_vars)
+            for i, bo in e.slots:
+                seed = lv[i]
+                if seed is None or seed is UNDEFINED:
+                    seed = layers.control_flow.RETURN_NO_VALUE_MAGIC
+                lv[i] = layers.fill_constant(list(bo.shape), bo.dtype, seed)
+            out = layers.while_loop(cond_wrap, body_wrap, lv)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
         return tuple(out)
     while test:
         loop_vars = body_fn(*loop_vars)
@@ -103,6 +141,9 @@ def _logical(x, y, op_type):
     from ...layer_helper import LayerHelper
     from ... import layers
     helper = LayerHelper(op_type)
+    # mixed tensor/python operands (e.g. `cond and not flag` before the
+    # first iteration materializes the flag): python sides become consts
+    x, y = _materialize(x), _materialize(y)
     x = layers.cast(x, "bool")
     out = helper.create_variable_for_type_inference("bool")
     if y is None:
@@ -115,9 +156,123 @@ def _logical(x, y, op_type):
 
 
 def convert_len(x):
+    if isinstance(x, _RangeProxy):
+        return x._symbolic_len() if x.has_tensor else len(x)
+    if isinstance(x, _EnumProxy):
+        return convert_len(x.inner)
     if _is_tensor(x):
         if x.shape and x.shape[0] >= 0:
             return x.shape[0]
         from ... import layers
         return layers.shape(x)[0]
     return len(x)
+
+
+# -- for-loop iteration protocol (reference: loop_transformer.py's
+# for_loop_node analysis + convert_operators.py to_static_variable) ------
+class _RangeProxy:
+    """range(...) with possibly-tensor bounds: indexable + measurable."""
+
+    def __init__(self, start, stop=None, step=1):
+        if stop is None:
+            start, stop = 0, start
+        self.start, self.stop, self.step = start, stop, step
+
+    def __len__(self):
+        # concrete-only path (python fallback); tensor bounds go
+        # through convert_len below
+        return len(range(self.start, self.stop, self.step))
+
+    def _symbolic_len(self):
+        from ... import layers
+
+        span = self.stop - self.start
+        if not _is_tensor(span):
+            span = layers.fill_constant([1], "int64", span)
+        step = self.step
+        if isinstance(step, int) and step == 1:
+            n = layers.cast(span, "int64")
+        else:
+            # ceil-division that matches range() for either step sign
+            if not _is_tensor(step):
+                step = layers.fill_constant([1], "float32", float(step))
+            n = layers.cast(
+                layers.ceil(layers.cast(span, "float32") /
+                            layers.cast(step, "float32")), "int64")
+        n = layers.reshape(n, [1])
+        zero = layers.fill_constant([1], "int64", 0)
+        return layers.elementwise_max(n, zero)
+
+    def index(self, i):
+        return self.start + i * self.step
+
+    @property
+    def has_tensor(self):
+        return any(_is_tensor(v) for v in
+                   (self.start, self.stop, self.step))
+
+
+class _EnumProxy:
+    def __init__(self, inner):
+        self.inner = inner
+
+    def index(self, i):
+        return (i, convert_index(self.inner, i))
+
+
+def convert_range(*args):
+    if any(_is_tensor(a) for a in args):
+        return _RangeProxy(*args)
+    return range(*args)
+
+
+def convert_enumerate(x):
+    return _EnumProxy(convert_iter(x))
+
+
+def convert_iter(x):
+    """An indexable view of x whose POSITIONAL indexing matches
+    iteration order: tensors index by row; list/tuple/range/ndarray
+    pass through; everything else (dicts — iterated by KEY in python —
+    sets, generators) materializes via list(x) so `for k in d` keeps
+    plain-Python semantics after the index-based rewrite."""
+    import numpy as _np
+
+    if _is_tensor(x) or isinstance(x, (list, tuple, range, _np.ndarray)):
+        return x
+    return list(x)
+
+
+def convert_index(it, i):
+    if isinstance(it, (_RangeProxy, _EnumProxy)):
+        return it.index(i)
+    if isinstance(it, range):
+        return it[int(i)]
+    if _is_tensor(it):
+        from ... import layers
+
+        if _is_tensor(i):
+            row = layers.gather(it, layers.reshape(
+                layers.cast(i, "int64"), [1]))
+        else:
+            i = int(i)
+            row = layers.slice(it, axes=[0], starts=[i], ends=[i + 1])
+        shp = list(it.shape[1:])
+        return layers.reshape(row, shp) if shp else layers.reshape(row, [1])
+    return it[int(i)]
+
+
+def convert_print(*args, **kwargs):
+    """print(...) -> layers.Print for tensor args (runs inside the
+    graph), builtin print for the rest (reference:
+    print_transformer.py / convert_print)."""
+    from ... import layers
+
+    rest = []
+    for a in args:
+        if _is_tensor(a):
+            layers.Print(a, message="d2s print")
+        else:
+            rest.append(a)
+    if rest:
+        print(*rest, **kwargs)

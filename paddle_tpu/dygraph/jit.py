@@ -27,6 +27,27 @@ from ..ops import registry
 from .varbase import VarBase
 
 
+def _cast_params_resident(model, dtype):
+    """Store float32 parameters in ``dtype`` (bf16/fp16) in place, except
+    BatchNorm's — reference keeps BN f32 under pure fp16
+    (mixed_precision/fp16_lists.py).  The f32 master weights live in the
+    optimizer's fused state, not on the model."""
+    import jax.numpy as jnp
+
+    from .nn import BatchNorm
+
+    keep = set()
+    for lay in model.sublayers(include_self=True):
+        if isinstance(lay, BatchNorm):
+            keep.update(id(p) for p in lay.parameters(include_sublayers=False))
+    jd = jnp.float16 if dtype == "float16" else jnp.bfloat16
+    for p in model.parameters():
+        if id(p) in keep or p._value is None:
+            continue
+        if p._value.dtype == jnp.float32:
+            p._value = p._value.astype(jd)
+
+
 def jit_train_step(model, optimizer, loss_fn: Callable, amp=False,
                    amp_dtype="bfloat16", amp_level="O1"):
     """Compile an eager train step: loss_fn(model, *varbase_inputs) -> loss.
@@ -37,8 +58,18 @@ def jit_train_step(model, optimizer, loss_fn: Callable, amp=False,
     With ``amp=True`` the forward traces under ``amp_guard`` — white-list
     matmuls/convs run in ``amp_dtype`` (and, since the casts are taped,
     so do their backward ops); params/optimizer state stay f32.
+
+    ``amp_level="O2"`` additionally makes parameters *resident* in
+    ``amp_dtype`` (reference: mixed_precision/decorator.py
+    ``cast_model_to_fp16`` + ``multi_precision`` adam): the forward reads
+    low-precision params directly — no boundary casts at all — while the
+    fused Adam keeps the single f32 master copy inside its own state
+    (optimizer.py ``_apply_fused_mp``).  BatchNorm params stay f32, as
+    the reference's pure-fp16 list prescribes.
     """
     params = model.parameters()
+    if amp and amp_level == "O2":
+        _cast_params_resident(model, amp_dtype)
 
     def raw_step(param_vals, opt_state, rng, inputs):
         from .base import amp_guard

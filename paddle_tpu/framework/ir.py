@@ -1008,6 +1008,78 @@ class SeqpoolConcatFusePass(Pass):
         return program
 
 
+@register_pass("transpose_flatten_concat_fuse_pass")
+class TransposeFlattenConcatFusePass(Pass):
+    """N x (transpose2 -> flatten2) branches feeding ONE concat ==>
+    fusion_transpose_flatten_concat (reference:
+    ir/transpose_flatten_concat_fuse_pass.cc — the SSD/detection
+    multi-head collection pattern).  All branches must share the
+    transpose perm and flatten axis."""
+
+    protected: Sequence[str] = ()
+
+    def apply_impl(self, program):
+        fused = 0
+        block = program.global_block()
+        protected = set(self.protected)
+        changed = True
+        while changed:
+            changed = False
+            cons = _consumers(block)
+            prod = producer_map(block)
+            for cat in list(block.ops):
+                if cat.type != "concat":
+                    continue
+                srcs = cat.inputs.get("X", [])
+                flats = [prod.get(n) for n in srcs]
+                if len(flats) < 2 or any(
+                        f is None or f.type not in ("flatten2", "flatten")
+                        for f in flats):
+                    continue
+                transposes = [prod.get(f.inputs["X"][0]) for f in flats]
+                if any(t is None or t.type not in ("transpose2", "transpose")
+                       for t in transposes):
+                    continue
+                perms = {tuple(t.attrs.get("axis", ())) for t in transposes}
+                faxes = {int(f.attrs.get("axis", 1)) for f in flats}
+                if len(perms) != 1 or len(faxes) != 1:
+                    continue
+                ok = True
+                for f, t in zip(flats, transposes):
+                    mids = [f.inputs["X"][0], f.outputs["Out"][0]]
+                    if any(n in protected for n in mids):
+                        ok = False
+                    if len(cons.get(f.outputs["Out"][0], [])) != 1 or \
+                            len(cons.get(f.inputs["X"][0], [])) != 1:
+                        ok = False
+                    # XShape side outputs must be dead
+                    for side in (f.outputs.get("XShape", [])
+                                 + t.outputs.get("XShape", [])):
+                        if cons.get(side, []):
+                            ok = False
+                if not ok:
+                    continue
+                xs = [t.inputs["X"][0] for t in transposes]
+                idx = block.ops.index(cat)
+                dead = flats + transposes
+                idx -= sum(1 for d in dead if block.ops.index(d) < idx)
+                remove_ops(block, dead + [cat])
+                block._insert_op(
+                    idx, "fusion_transpose_flatten_concat",
+                    inputs={"X": xs},
+                    outputs={"Out": list(cat.outputs["Out"])},
+                    attrs={"trans_axis": list(next(iter(perms))),
+                           "flatten_axis": next(iter(faxes)),
+                           "concat_axis": int(cat.attrs.get("axis", 0))})
+                fused += 1
+                changed = True
+                break
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program
+
+
 # --------------------------------------------------------------------------
 # fused optimizer shell (reference: ir/fuse_optimizer_ops_pass/ —
 # fuse_sgd_op_pass.cc, fuse_momentum_op_pass.cc, fuse_adam_op_pass.cc):

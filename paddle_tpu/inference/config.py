@@ -234,6 +234,7 @@ class PassStrategy:
         "fuse_multihead_attention_pass",
         "fc_fuse_pass",
         "seqpool_concat_fuse_pass",
+        "transpose_flatten_concat_fuse_pass",
         "delete_dropout_pass",
     ]
 

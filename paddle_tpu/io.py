@@ -70,11 +70,19 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
               filename=None):
-    """reference: io.py:373."""
+    """reference: io.py:373.
+
+    Known cross-framework incompatibility: pyramid_hash embeddings.
+    This build hashes chunks with keyed blake2s where the reference
+    uses XXH32 (ops/long_tail_ops.py pyramid_hash), so row indices into
+    a pyramid-hash W differ — reference-trained pyramid_hash weights
+    load byte-fine but look up DIFFERENT rows.  A warning fires below
+    when such a param is loaded into a program containing the op."""
     main_program = main_program or default_main_program()
     if vars is None:
         predicate = predicate or _is_persistable
         vars = [v for v in main_program.list_vars() if predicate(v)]
+    _warn_pyramid_hash_load(main_program, vars)
     scope = global_scope()
     if filename is not None:
         path = os.path.join(dirname, filename)
@@ -91,6 +99,31 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
                 scope.set(v.name, np.load(path))
             else:
                 raise RuntimeError(f"checkpoint file missing for var {v.name!r}: {path}")
+
+
+def _warn_pyramid_hash_load(main_program, vars):
+    """r5 (advisor): loading weights into a pyramid_hash W is silently
+    incompatible with REFERENCE-trained checkpoints (blake2s vs XXH32
+    row hashing) — warn once per load so from-scratch training stays
+    quiet but checkpoint migration is flagged."""
+    try:
+        hash_ws = set()
+        for block in main_program.blocks:
+            for op_ in block.ops:
+                if op_.type == "pyramid_hash":
+                    hash_ws.update(op_.input("W") or [])
+        loaded = hash_ws & {v.name for v in vars}
+        if loaded:
+            import warnings
+
+            warnings.warn(
+                f"loading pyramid_hash weight(s) {sorted(loaded)}: this "
+                "build hashes with keyed blake2s, not the reference's "
+                "XXH32 — weights trained by the reference index different "
+                "rows here (fine for checkpoints produced by THIS "
+                "framework)", RuntimeWarning)
+    except Exception:
+        pass
 
 
 def save_params(executor, dirname, main_program=None, filename=None):

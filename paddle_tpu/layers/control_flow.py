@@ -57,6 +57,77 @@ def _free_vars(blocks, parent):
     return free
 
 
+# Sentinel value a branch yields for a name the other branch binds with a
+# real tensor but this one leaves unset (the dygraph_to_static early-return
+# machinery): matches the reference's RETURN_NO_VALUE_MAGIC_NUM
+# (dygraph_to_static/return_transformer.py).
+RETURN_NO_VALUE_MAGIC = 1.77113e27
+
+
+class CarryInitMismatch(TypeError):
+    """while_loop carry i entered as a python value but the body binds a
+    Variable; .slots is [(i, body_out_var)].  The first (abandoned)
+    trace's sub-blocks stay in the program as unreferenced dead blocks —
+    only blocks reachable through op attrs execute."""
+
+    def __init__(self, slots):
+        super().__init__(
+            f"while_loop carries {[i for i, _ in slots]} are python "
+            "values but their body outputs are Variables; seed them "
+            "with same-shaped tensors")
+        self.slots = slots
+
+
+def _align_branch_outputs(prog, tb, fb, t_out, f_out):
+    """Positions where exactly one branch returned a Variable and the
+    other a python scalar/None/UNDEFINED (a name the branch left
+    unbound — dygraph_to_static's UndefinedVar analog) get a constant
+    of the SAME shape/dtype appended inside the deficient branch block,
+    so the cond op's per-position contract holds (None/UNDEFINED become
+    the reference's RETURN_NO_VALUE magic number)."""
+    def is_undef(v):
+        return v is None or type(v).__name__ == "_Undefined"
+
+    def fix(blk, vals, others):
+        out = list(vals)
+        need = [i for i, (v, o) in enumerate(zip(vals, others))
+                if not isinstance(v, Variable) and isinstance(o, Variable)]
+        if not need:
+            return out
+        saved = prog.current_block_idx
+        prog.current_block_idx = blk.idx
+        try:
+            for i in need:
+                o = others[i]
+                v = out[i]
+                if is_undef(v):
+                    fill = RETURN_NO_VALUE_MAGIC
+                elif isinstance(v, bool):
+                    fill = bool(v)
+                elif isinstance(v, (int, float)):
+                    fill = float(v)
+                else:
+                    raise TypeError(
+                        f"cond branch output {i} is {type(v).__name__}, "
+                        "the other branch a tensor — branches must bind "
+                        "compatible values")
+                out[i] = tensor_layers.fill_constant(
+                    list(o.shape), o.dtype, fill)
+        finally:
+            prog.current_block_idx = saved
+        return out
+
+    t_out, f_out = fix(tb, t_out, f_out), fix(fb, f_out, t_out)
+    for i, (tv, fv) in enumerate(zip(t_out, f_out)):
+        if not isinstance(tv, Variable) and not isinstance(fv, Variable) \
+                and (is_undef(tv) or is_undef(fv)):
+            raise ValueError(
+                f"cond output {i}: a name assigned in neither branch (or "
+                "only as a python value in one) escapes a tensor-condition "
+                "`if` — bind it before the if or in both branches")
+    return t_out, f_out
+
+
 def cond(pred: Variable, true_fn: Callable = None, false_fn: Callable = None,
          name=None):
     """reference: control_flow.py:2150."""
@@ -75,12 +146,22 @@ def cond(pred: Variable, true_fn: Callable = None, false_fn: Callable = None,
         raise ValueError(
             f"true_fn returns {len(t_out)} outputs, false_fn {len(f_out)} — "
             f"branches must match")
+    t_out, f_out = _align_branch_outputs(prog, tb, fb, t_out, f_out)
     outs = []
     for tv in t_out:
         outs.append(parent.create_var(
             name=helper.name + f"_out_{len(outs)}",
             shape=tv.shape, dtype=tv.dtype))
     free = _free_vars([tb, fb], parent)
+    # a branch may RETURN an outer var it never touched (a capture
+    # default for a name only the other branch assigns): such names
+    # appear only in the out-name attrs, so the op-input scan above
+    # can't see them — add them to Input so the runtime env has them
+    for v in list(t_out) + list(f_out):
+        if (isinstance(v, Variable) and v.name not in free
+                and not tb.has_var(v.name) and not fb.has_var(v.name)
+                and parent._find_var_recursive(v.name) is not None):
+            free.append(v.name)
     parent.append_op(
         "cond",
         inputs={"Cond": [pred], "Input": free},
@@ -114,6 +195,14 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
     prog._rollback()
     if len(body_out) != len(loop_vars):
         raise ValueError("body must return as many values as loop_vars")
+    mism = [(i, bo) for i, (lv, bo) in enumerate(zip(loop_vars, body_out))
+            if not isinstance(lv, Variable) and isinstance(bo, Variable)]
+    if mism:
+        # a carry entered as python None/scalar but the body binds a
+        # tensor (dygraph_to_static early-return slots): the caller can
+        # catch this, seed the carry with a same-shaped constant and
+        # retry (convert_operators.convert_while_loop does)
+        raise CarryInitMismatch(mism)
 
     outs = [parent.create_var(name=helper.name + f"_out_{i}",
                               shape=v.shape, dtype=v.dtype)

@@ -1029,6 +1029,39 @@ def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
     return out
 
 
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None, name=None):
+    """Register a user Python callable as an op inside the static
+    program (reference: layers/nn.py py_func ->
+    operators/py_func_op.cc).  ``func(*x_arrays) -> out_arrays`` runs
+    host-side at execution time via ``jax.pure_callback`` — the rest of
+    the program stays one XLA computation.  ``out`` must be pre-created
+    with correct shape/dtype (the reference's contract too); pass
+    ``out=None`` for side-effect-only debug calls.  ``backward_func``
+    receives (x, out, out_grad) minus ``skip_vars_in_backward_input``
+    and returns the gradient arrays for ``x``."""
+    from ..ops import py_func_op as _pf
+
+    helper = LayerHelper("py_func", name=name)
+    xs = [x] if isinstance(x, Variable) else list(x or [])
+    single = isinstance(out, Variable)
+    outs = [out] if single else list(out or [])
+    skip = (skip_vars_in_backward_input if skip_vars_in_backward_input
+            is not None else [])
+    if isinstance(skip, Variable):
+        skip = [skip]
+    attrs = {"forward_callable_id": _pf.register_callable(func),
+             "backward_callable_id":
+                 (_pf.register_callable(backward_func)
+                  if backward_func is not None else -1),
+             "backward_skip_vars": [v.name for v in skip]}
+    helper.append_op("py_func", inputs={"X": xs},
+                     outputs={"Out": outs}, attrs=attrs)
+    if not outs:
+        return None
+    return outs[0] if single else outs
+
+
 def fused_multihead_attention(q, k, v, bias_qk=None, scale=0.0, causal=False,
                               dropout_rate=0.0, name=None):
     """Fused scaled-dot-product attention over (b, heads, seq, head_dim)
@@ -1046,6 +1079,9 @@ def fused_multihead_attention(q, k, v, bias_qk=None, scale=0.0, causal=False,
     if dropout_rate > 0.0:
         outputs["Seed"] = [
             helper.create_variable_for_type_inference("float32")]
+    # lse residual (f32): saved so the grad op can run the flash backward
+    # kernel without replaying the forward; a (1,)-sentinel on fallback
+    outputs["Lse"] = [helper.create_variable_for_type_inference("float32")]
     helper.append_op("fused_multihead_attention", inputs=inputs,
                      outputs=outputs,
                      attrs={"scale": float(scale), "causal": bool(causal),

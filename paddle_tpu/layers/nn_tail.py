@@ -1206,62 +1206,11 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     return counter
 
 
-_PY_FUNC_COUNTER = [0]
-
-
-def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    """reference: layers/nn.py py_func — run a host Python function inside
-    the program.  Registers a fresh host op per call; the executor's
-    hybrid segmentation runs it between jitted segments exactly like the
-    reference's CPU-pinned py_func op.  When backward_func is given it is
-    called as backward_func(*xs, *out_grads) -> x_grads (a simplified
-    contract vs the reference's skip-list plumbing)."""
-    from ..ops.registry import op as register, grad_maker
-    from ..framework.core import GRAD_SUFFIX, EMPTY_VAR_NAME
-
-    _PY_FUNC_COUNTER[0] += 1
-    op_type = f"py_func_{_PY_FUNC_COUNTER[0]}"
-
-    @register(op_type, no_grad=backward_func is None, host=True)
-    def _lower(ctx, _func=func):
-        import jax.numpy as jnp
-        vals = [np.asarray(v) for v in ctx.ins("X")]
-        res = _func(*vals)
-        if not isinstance(res, (list, tuple)):
-            res = [res]
-        ctx.set_out("Out", [jnp.asarray(np.asarray(r)) for r in res])
-
-    if backward_func is not None:
-        @register(op_type + "_grad", no_grad=True, host=True)
-        def _glower(ctx, _bfunc=backward_func):
-            import jax.numpy as jnp
-            xs_v = [np.asarray(v) for v in ctx.ins("X")]
-            dys = [np.asarray(v) for v in ctx.ins("Out" + GRAD_SUFFIX)]
-            res = _bfunc(*(xs_v + dys))
-            if not isinstance(res, (list, tuple)):
-                res = [res]
-            ctx.set_out("X" + GRAD_SUFFIX,
-                        [jnp.asarray(np.asarray(r)) for r in res])
-
-        @grad_maker(op_type)
-        def _gmaker(op_, no_grad_names, _t=op_type):
-            return [dict(
-                type=_t + "_grad",
-                inputs={"X": list(op_.inputs["X"]),
-                        "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX
-                                              for n in op_.outputs["Out"]]},
-                outputs={"X" + GRAD_SUFFIX: [
-                    n + GRAD_SUFFIX if n not in no_grad_names else EMPTY_VAR_NAME
-                    for n in op_.inputs["X"]]},
-                attrs={},
-            )]
-
-    helper = LayerHelper("py_func")
-    xs = x if isinstance(x, (list, tuple)) else [x]
-    outs = out if isinstance(out, (list, tuple)) else [out]
-    helper.append_op(op_type, inputs={"X": list(xs)},
-                     outputs={"Out": list(outs)})
-    return out
+# py_func moved to layers/nn.py (r5): ONE registered "py_func" op type
+# lowering to jax.pure_callback — the program stays a single jitted XLA
+# computation instead of splitting into hybrid segments per call, and
+# the backward follows the reference (x, out, out@grad)-minus-skip
+# contract (ops/py_func_op.py).
 
 
 # --------------------------------------------------------------------------

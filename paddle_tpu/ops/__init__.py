@@ -33,4 +33,6 @@ from . import vision_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import extra_ops  # noqa: F401
+from . import py_func_op  # noqa: F401
+from . import compat_ops  # noqa: F401
 from . import long_tail_ops  # noqa: F401

@@ -471,7 +471,10 @@ def _seqpool_each(ctx, ptype="SUM"):
 
     xs = ctx.ins("X")
     lens = ctx.ins("Length") if ctx.has_input("Length") else [None] * len(xs)
-    if len(lens) < len(xs):  # one shared Length for all slots
+    if not lens:
+        # declared-but-empty Length slot behaves like an absent one
+        lens = [None] * len(xs)
+    elif len(lens) < len(xs):  # one shared Length for all slots
         lens = list(lens) + [lens[-1]] * (len(xs) - len(lens))
     for x, ln in zip(xs, lens):
         N, T = jnp.shape(x)[0], jnp.shape(x)[1]
@@ -818,3 +821,23 @@ def _max_sequence_len(ctx):
     ctx.set_out("Out", jnp.asarray(jnp.shape(x)[1]
                                    if jnp.ndim(x) > 1 else jnp.shape(x)[0],
                                    jnp.int64))
+
+
+@op("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ctx):
+    """reference: fused/fusion_transpose_flatten_concat_op.cc — each X
+    is transposed by trans_axis, flattened to 2-D at flatten_axis, and
+    the results concatenate along concat_axis (the SSD detection-head
+    collection produced by transpose_flatten_concat_fuse_pass)."""
+    perm = [int(a) for a in ctx.attr("trans_axis", [])]
+    faxis = int(ctx.attr("flatten_axis", 1))
+    caxis = int(ctx.attr("concat_axis", 0))
+    outs = []
+    for x in ctx.ins("X"):
+        t = jnp.transpose(x, perm) if perm else x
+        shape = jnp.shape(t)
+        lead = 1
+        for s in shape[:faxis]:
+            lead *= int(s)
+        outs.append(jnp.reshape(t, (lead, -1)))
+    ctx.set_out("Out", jnp.concatenate(outs, axis=caxis))

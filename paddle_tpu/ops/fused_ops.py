@@ -17,6 +17,8 @@ from .registry import op, GRAD_SUFFIX
 from .pallas_kernels import (
     attention_reference,
     flash_attention,
+    flash_attention_bwd_res,
+    flash_attention_fwd_res,
     is_padding_bias,
 )
 
@@ -65,6 +67,22 @@ def _fused_mha(ctx):
         seed = jax.random.randint(sub, (1,), 0, 1 << 23,
                                   dtype=jnp.int32).astype(jnp.float32)
         ctx.set_out("Seed", seed)
+    if (bias is None or is_padding_bias(bias)) and ctx.has_output("Lse"):
+        # kernel-eligible bias: forward through the residual API so the
+        # grad op gets lse and can run the backward kernel WITHOUT
+        # replaying the forward (jax.vjp of a custom_vjp fn reruns the
+        # fwd kernel to rebuild residuals — a whole extra flash pass)
+        out, lse = flash_attention_fwd_res(
+            q, k, v, bias=bias, causal=causal, scale=scale,
+            dropout_rate=dropout_rate, dropout_seed=seed)
+        ctx.set_out("Out", out)
+        # (1,)-sentinel when the kernel didn't engage: the static shape
+        # tells the grad op to differentiate the fallback instead
+        ctx.set_out("Lse", lse if lse is not None
+                    else jnp.zeros((1,), jnp.float32))
+        return
+    if ctx.has_output("Lse"):
+        ctx.set_out("Lse", jnp.zeros((1,), jnp.float32))
     ctx.set_out("Out", _mha_forward(q, k, v, bias, scale, causal,
                                     dropout_rate, seed))
 
@@ -83,6 +101,20 @@ def _fused_mha_grad(ctx):
     causal = ctx.attr("causal", False)
     dropout_rate = float(ctx.attr("dropout_rate", 0.0) or 0.0)
 
+    lse = ctx.in_("Lse") if ctx.has_input("Lse") else None
+    out = ctx.in_("Out") if ctx.has_input("Out") else None
+    if lse is not None and out is not None and jnp.ndim(lse) == 4:
+        # residual path: the forward saved lse, so the backward kernel
+        # runs directly — no forward replay (see flash_attention_fwd_res)
+        dq, dk, dv = flash_attention_bwd_res(
+            q, k, v, out, lse, dout, bias=bias, causal=causal, scale=scale,
+            dropout_rate=dropout_rate, dropout_seed=seed)
+        ctx.set_out("Q" + GRAD_SUFFIX, dq)
+        ctx.set_out("K" + GRAD_SUFFIX, dk)
+        ctx.set_out("V" + GRAD_SUFFIX, dv)
+        if bias is not None:
+            ctx.set_out("BiasQK" + GRAD_SUFFIX, jnp.zeros_like(bias))
+        return
     if bias is None:
         _, vjp = jax.vjp(
             lambda q_, k_, v_: _mha_forward(q_, k_, v_, None, scale, causal,
@@ -308,6 +340,10 @@ def _fused_mha_grad_maker(op_, no_grad_names=frozenset()):
         inputs["BiasQK"] = op_.input("BiasQK")
     if op_.output("Seed"):
         inputs["Seed"] = op_.output("Seed")
+    if op_.output("Lse"):
+        # saved residuals let the grad op skip the forward flash replay
+        inputs["Lse"] = op_.output("Lse")
+        inputs["Out"] = op_.output("Out")
     outputs = {
         "Q" + GRAD_SUFFIX: g(op_.input("Q")),
         "K" + GRAD_SUFFIX: g(op_.input("K")),

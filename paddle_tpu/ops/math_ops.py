@@ -159,6 +159,16 @@ def _scale(ctx):
             ctx.set_out("Out", SelectedRows(x.rows, x.values * s, x.height))
             return
         x = x.to_dense()
+    # reference scale_op computes in the INPUT dtype (scale/bias cast to
+    # T): integer tensors stay integer for integer-valued scale/bias —
+    # `int_var + 1` (a scale op) must not float-promote a loop counter.
+    # Fractional scale/bias on integer x keeps the python-friendly f32
+    # promotion (existing layers rely on int_var * 0.5 being a float).
+    if (jnp.issubdtype(jnp.result_type(x), jnp.integer)
+            and not isinstance(s, jax.Array) and float(s).is_integer()
+            and float(b).is_integer()):
+        s = jnp.asarray(int(s), jnp.result_type(x))
+        b = jnp.asarray(int(b), jnp.result_type(x))
     if ctx.attr("bias_after_scale", True):
         out = x * s + jnp.asarray(b, jnp.result_type(x))
     else:

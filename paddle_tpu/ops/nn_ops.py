@@ -442,16 +442,24 @@ def _softmax_ce(ctx):
     # gigabyte-scale materialization read again by the backward, and
     # probabilities in [0,1] lose nothing that matters in bf16.
     in_dtype = logits.dtype
-    logits = logits.astype(jnp.float32)
-    log_p = jnn.log_softmax(logits, axis=axis)
-    ctx.set_out("Softmax", jnp.exp(log_p).astype(in_dtype))
+    x32 = logits.astype(jnp.float32)
+    # explicit (max, logsumexp) form instead of materializing log_softmax:
+    # for a [b*s, 30k] MLM head the f32 log-prob tensor is gigabyte-scale
+    # and jnn.log_softmax makes XLA store it (both exp() and the label
+    # gather consume it).  Phrased this way, the only full-size tensors
+    # are reduction INPUTS (read in logits dtype, upcast fused) and the
+    # in-dtype Softmax output — the hot loop reads bf16 and writes bf16.
+    m = jnp.max(x32, axis=axis, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x32 - m), axis=axis, keepdims=True))
+    ctx.set_out("Softmax", jnp.exp(x32 - lse).astype(in_dtype))
     if soft_label:
-        loss = -jnp.sum(label * log_p, axis=axis, keepdims=True)
+        loss = jnp.sum(label.astype(jnp.float32) * (lse - x32),
+                       axis=axis, keepdims=True)
     else:
         lbl = jnp.squeeze(label, axis) if jnp.ndim(label) == jnp.ndim(logits) else label
         lbl = lbl.astype(jnp.int32)
-        picked = jnp.take_along_axis(log_p, jnp.expand_dims(lbl, axis), axis=axis)
-        loss = -picked
+        picked = jnp.take_along_axis(x32, jnp.expand_dims(lbl, axis), axis=axis)
+        loss = lse - picked
         if ignore_index >= 0:
             mask = (jnp.expand_dims(lbl, axis) != ignore_index)
             loss = jnp.where(mask, loss, 0.0)

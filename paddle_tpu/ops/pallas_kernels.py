@@ -676,14 +676,19 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     padding mask, not a parameter); the fallback path differentiates it
     normally.
     """
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    if scale is None:
-        scale = 1.0 / (d ** 0.5)
-    if bias is not None:
-        bias = _normalize_bias(bias)
-    if dropout_rate > 0.0 and dropout_seed is None:
-        raise ValueError("flash_attention dropout requires dropout_seed")
+    scale, bias, seed, blocks = _flash_prologue(
+        q, k, bias, scale, dropout_rate, dropout_seed)
+    if blocks is None:
+        return attention_reference(q, k, v, bias, causal, scale,
+                                   dropout_rate=dropout_rate,
+                                   dropout_seed=dropout_seed)
+    return _flash_attention_core(q, k, v, bias, seed, scale, causal,
+                                 blocks[0], blocks[1], float(dropout_rate))
+
+
+def _flash_engage(sq, sk, d, dropout_rate):
+    """Path selection shared by flash_attention and the residual API:
+    (block_q, block_k) when the Pallas kernel engages, else None."""
     block_q = _pick_block(sq)
     block_k = _pick_block(sk)
     force = os.environ.get("PT_FLASH_ATTENTION")
@@ -695,11 +700,59 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
         worth_it = sq >= 1024
     if (not _use_pallas() or block_q is None or block_k is None
             or not worth_it or d % 8 != 0):
-        return attention_reference(q, k, v, bias, causal, scale,
-                                   dropout_rate=dropout_rate,
-                                   dropout_seed=dropout_seed)
+        return None
+    return block_q, block_k
+
+
+def _flash_prologue(q, k, bias, scale, dropout_rate, dropout_seed):
+    """The shared entry normalization for every flash front-end
+    (flash_attention / fwd_res / bwd_res): default scale, padding-bias
+    normalization, dropout-seed validation+reshape, engage decision.
+    Returns (scale, bias, seed, blocks-or-None)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if bias is not None:
+        bias = _normalize_bias(bias)
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("flash_attention dropout requires dropout_seed")
     seed = None
     if dropout_rate > 0.0:
         seed = jnp.asarray(dropout_seed, jnp.float32).reshape((1,))
-    return _flash_attention_core(q, k, v, bias, seed, scale, causal,
-                                 block_q, block_k, float(dropout_rate))
+    blocks = _flash_engage(q.shape[2], k.shape[2], d, dropout_rate)
+    return scale, bias, seed, blocks
+
+
+def flash_attention_fwd_res(q, k, v, bias=None, causal=False, scale=None,
+                            dropout_rate=0.0, dropout_seed=None):
+    """Forward that RETURNS the (out, lse) residual pair so a framework
+    tape can hand lse back to flash_attention_bwd_res and skip the
+    forward replay jax.vjp would do (the custom_vjp path reruns the fwd
+    kernel inside the backward to rebuild residuals — one whole extra
+    fwd flash pass per step).  Returns (out, None) when the kernel does
+    not engage; the caller must then differentiate the fallback
+    composition instead."""
+    scale, bias, seed, blocks = _flash_prologue(
+        q, k, bias, scale, dropout_rate, dropout_seed)
+    if blocks is None:
+        return attention_reference(q, k, v, bias, causal, scale,
+                                   dropout_rate=dropout_rate,
+                                   dropout_seed=dropout_seed), None
+    out, lse = _flash_fwd(q, k, v, bias, scale, causal, blocks[0], blocks[1],
+                          dropout_rate, seed)
+    return out, lse
+
+
+def flash_attention_bwd_res(q, k, v, out, lse, do, bias=None, causal=False,
+                            scale=None, dropout_rate=0.0, dropout_seed=None):
+    """Backward from saved residuals (see flash_attention_fwd_res).
+    Returns (dq, dk, dv); the padding bias is a constant, as in the
+    custom_vjp path."""
+    scale, bias, seed, blocks = _flash_prologue(
+        q, k, bias, scale, dropout_rate, dropout_seed)
+    if blocks is None:
+        raise ValueError("flash_attention_bwd_res: kernel path does not "
+                         "engage for these shapes — the forward cannot "
+                         "have produced an lse residual")
+    return _flash_bwd(q, k, v, bias, out, lse, do, scale, causal,
+                      blocks[0], blocks[1], dropout_rate, seed)

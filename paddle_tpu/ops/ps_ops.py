@@ -180,22 +180,28 @@ def _distributed_lookup_table_grad(ctx):
     """Push sparse grads (reference: PushSparseVarsWithLabelAsync shape).
     With an async/half-async communicator installed, the push is
     enqueued to its background sparse queue instead of blocking."""
+    from ..distributed_ps import prefetch as _prefetch
+
     table = ctx.attr("table_name")
     dim = ctx.attr("emb_dim")
     comm = _communicator()
     use_comm = comm is not None and hasattr(comm, "send_sparse")
     client = None if use_comm else _client()
+    pairs = []
     for ids, g in zip(ctx.ins("Ids"), ctx.ins("Outputs" + GRAD_SUFFIX)):
         ids_np = np.asarray(ids).astype(np.int64).ravel()
         g_np = np.asarray(g).reshape(ids_np.size, dim)
         if use_comm:
             comm.send_sparse(table, ids_np, g_np)
         else:
-            # record updated rows for the async recorder when an
-            # async-family mode is active (the communicator's presence IS
-            # the async signal; sync pushes skip recording)
-            client.push_sparse(table, ids_np, g_np,
-                               record=_communicator() is not None)
+            pairs.append((ids_np, g_np))
+    if pairs:
+        # record updated rows for the async recorder when an async-family
+        # mode is active (the communicator's presence IS the async
+        # signal; sync pushes skip recording).  Multi-slot pushes fan
+        # out like the pulls — one RPC round-trip of latency per table.
+        _prefetch.parallel_push(client, table, pairs,
+                                record=_communicator() is not None)
 
 
 @_host("recv_save", no_grad=True)

@@ -1,0 +1,126 @@
+"""py_func op — run arbitrary user Python inside a static program.
+
+Reference: paddle/fluid/operators/py_func_op.cc (PyFuncOp calls a
+registered python callable by ``forward_callable_id``; its grad op calls
+``backward_callable_id`` with (x, out, out@grad) and writes x@grad) and
+python/paddle/fluid/layers/nn.py ``py_func``.
+
+TPU-native lowering: ``jax.pure_callback`` — the callable runs host-side
+while the surrounding program stays ONE jitted XLA computation; XLA
+treats it as an opaque host call with declared result shapes (which is
+why, exactly like the reference, ``out`` must be pre-created with the
+right shape/dtype).  Output-less debug calls (``out=None``) lower to
+``jax.experimental.io_callback`` so dead-code elimination cannot drop
+the side effect.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import EMPTY_VAR_NAME, GRAD_SUFFIX
+from .registry import grad_maker, op
+
+# callables referenced from op attrs by integer id, exactly the
+# reference's PyFuncRegistry (py_func_op.cc:42)
+_REGISTRY: list = []
+
+
+def register_callable(fn) -> int:
+    _REGISTRY.append(fn)
+    return len(_REGISTRY) - 1
+
+
+def get_callable(idx: int):
+    return _REGISTRY[int(idx)]
+
+
+def _declared_result_shapes(ctx, names, arrays):
+    """pure_callback needs CONCRETE result shapes: declared -1 (batch)
+    leading dims resolve to the runtime batch of the first array input
+    (what the reference's infer-shape does for py_func outputs)."""
+    batch = None
+    for a in arrays:
+        shp = jnp.shape(a)
+        if shp:
+            batch = int(shp[0])
+            break
+    out = []
+    for n in names:
+        v = ctx.block._find_var_recursive(n) if ctx.block is not None else None
+        if v is None:
+            raise ValueError(
+                f"py_func output {n!r}: shape/dtype must be declared by "
+                "creating the out variable before calling py_func")
+        from ..framework.dtype import to_numpy_dtype
+
+        shape = [int(s) for s in v.shape]
+        if shape and shape[0] < 0 and batch is not None:
+            shape[0] = batch
+        if any(s < 0 for s in shape):
+            raise ValueError(
+                f"py_func output {n!r}: shape {v.shape} has a non-leading "
+                "dynamic dim; declare it concretely")
+        out.append(jax.ShapeDtypeStruct(tuple(shape),
+                                        to_numpy_dtype(v.dtype)))
+    return out
+
+
+def _call_host(fn, n_out, *arrays):
+    outs = fn(*[np.asarray(a) for a in arrays])
+    if n_out == 0:
+        return ()
+    if not isinstance(outs, (list, tuple)):
+        outs = (outs,)
+    return tuple(np.asarray(o) for o in outs)
+
+
+@op("py_func", stateful=True)
+def _py_func(ctx):
+    fn = get_callable(ctx.attr("forward_callable_id"))
+    xs = ctx.ins("X")
+    out_names = [n for n in ctx.out_names("Out") if n != EMPTY_VAR_NAME]
+    if not out_names:
+        # debug/side-effect call: io_callback survives DCE
+        from jax.experimental import io_callback
+
+        io_callback(lambda *a: (_call_host(fn, 0, *a), None)[1], None, *xs)
+        return
+    shapes = _declared_result_shapes(ctx, out_names, xs)
+    outs = jax.pure_callback(
+        lambda *a: _call_host(fn, len(shapes), *a), tuple(shapes), *xs)
+    ctx.set_out("Out", list(outs))
+
+
+@op("py_func_grad", no_grad=True, stateful=True)
+def _py_func_grad(ctx):
+    fn = get_callable(ctx.attr("backward_callable_id"))
+    ins = ctx.ins("X")          # the backward inputs, already filtered
+    dx_names = [n for n in ctx.out_names("X" + GRAD_SUFFIX)
+                if n != EMPTY_VAR_NAME]
+    shapes = _declared_result_shapes(ctx, dx_names, ins)
+    outs = jax.pure_callback(
+        lambda *a: _call_host(fn, len(shapes), *a), tuple(shapes), *ins)
+    ctx.set_out("X" + GRAD_SUFFIX, list(outs))
+
+
+@grad_maker("py_func")
+def _py_func_grad_maker(op_, no_grad_names=frozenset()):
+    if int(op_.attr("backward_callable_id", -1)) < 0:
+        return []
+    skip = set(op_.attr("backward_skip_vars", []) or [])
+    # backward inputs: x + out + out@grad, minus the skip list
+    bw_in = [n for n in list(op_.input("X")) + list(op_.output("Out"))
+             if n not in skip]
+    bw_in += [n + GRAD_SUFFIX for n in op_.output("Out")]
+    dx = [(n + GRAD_SUFFIX) if n not in no_grad_names else EMPTY_VAR_NAME
+          for n in op_.input("X")]
+    return [dict(
+        type="py_func_grad",
+        inputs={"X": bw_in},
+        outputs={"X" + GRAD_SUFFIX: dx},
+        attrs={"backward_callable_id":
+               int(op_.attr("backward_callable_id"))},
+    )]

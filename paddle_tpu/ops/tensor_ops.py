@@ -363,8 +363,12 @@ def _split_byref(ctx):
     n_out = (len(ctx.op.outputs.get("Out", [])) if ctx.op is not None
              else 0) or ctx.attr("num", 0)
     if not sections:
+        if n_out <= 0:
+            raise ValueError(
+                "split_byref: no `sections` given and the output count "
+                "is 0 — declare Out vars or the `num` attr")
         h = jnp.shape(x)[0]
-        per = h // max(1, n_out)
+        per = h // n_out
         sections = [per] * n_out
         sections[-1] += h - per * n_out
     idx = np.cumsum(sections[:-1]).tolist()

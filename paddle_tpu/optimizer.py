@@ -486,7 +486,7 @@ class AdamOptimizer(Optimizer):
                 or not flags._flags.get("FLAGS_fuse_optimizer_dygraph", True)):
             return super()._dygraph_apply(params_grads)
         lr = self._eager_lr()
-        fused, single = [], []
+        fused, fused_mp, single = [], [], []
         for p, g in params_grads:
             if g is None:
                 continue
@@ -494,11 +494,34 @@ class AdamOptimizer(Optimizer):
             if (isinstance(g, jax.Array) and g.dtype == jnp.float32
                     and p._value.dtype == jnp.float32):
                 fused.append((p, g))
+            elif (isinstance(g, jax.Array)
+                  and p._value.dtype in (jnp.bfloat16, jnp.float16)
+                  and jnp.issubdtype(g.dtype, jnp.floating)):
+                # low-precision-resident param (amp O2): fused update runs
+                # on the f32 master copy kept inside the optimizer state
+                fused_mp.append((p, g))
             else:
                 single.append((p, g))
         for p, g in single:
             state = self._param_state.setdefault(p.name, {})
             self._eager_update(p, g, state, lr)
+        if fused_mp:
+            fused_mp, deferred_mp = self._fused_pow_groups(
+                fused_mp, "@fused_mp", "_fused_mp_layout")
+            for p, g in deferred_mp:
+                state = self._param_state.setdefault(p.name, {})
+                self._eager_update(p, g, state, lr)
+            if fused_mp:
+                self._apply_fused_mp(fused_mp, lr)
+        if fused:
+            # advisor r4: a param whose carried (b1p, b2p) schedule
+            # disagrees with the fused buffer's cannot share its scalar
+            # bias correction — keep it on the per-param path
+            fused, deferred = self._fused_pow_groups(
+                fused, "@fused", "_fused_layout")
+            for p, g in deferred:
+                state = self._param_state.setdefault(p.name, {})
+                self._eager_update(p, g, state, lr)
         if not fused:
             return
         layout = tuple((p.name, int(np.prod(p._value.shape) if p._value.shape
@@ -536,9 +559,156 @@ class AdamOptimizer(Optimizer):
              "Beta1PowOut": 1, "Beta2PowOut": 1},
         )
 
+    # -- master-weight fused path (amp O2, bf16/fp16-resident params) ----
+    # reference: contrib/mixed_precision/decorator.py cast_model_to_fp16 +
+    # the multi_precision attr of adam_op.cc — params live in low
+    # precision (so the forward reads them with ZERO boundary casts) and
+    # the f32 master copy exists only here, inside the fused optimizer
+    # state.  One flat adam kernel updates the master; the low-precision
+    # shards the model sees are sliced+cast straight out of it.
+
+    def _apply_fused_mp(self, fused, lr):
+        import jax.numpy as jnp
+
+        layout = tuple((p.name,
+                        int(np.prod(p._value.shape) if p._value.shape else 1),
+                        str(p._value.dtype)) for p, _ in fused)
+        state = self._param_state.setdefault("@fused_mp", {})
+        if getattr(self, "_fused_mp_layout", None) != layout \
+                or "master" not in state:
+            self._migrate_fused_mp_state(state, layout, fused)
+        flat_g = jnp.concatenate(
+            [jnp.ravel(g).astype(jnp.float32) for _, g in fused])
+        outs = self._fused_adam_call(state["master"], flat_g, state, lr)
+        state["master"] = outs["ParamOut"][0]
+        state["m1"] = outs["Moment1Out"][0]
+        state["m2"] = outs["Moment2Out"][0]
+        state["b1p"] = outs["Beta1PowOut"][0]
+        state["b2p"] = outs["Beta2PowOut"][0]
+        new_master = state["master"]
+        off = 0
+        for p, _ in fused:
+            n = int(np.prod(p._value.shape) if p._value.shape else 1)
+            p._value = jnp.reshape(
+                new_master[off:off + n], p._value.shape).astype(p._value.dtype)
+            off += n
+
+    def _migrate_fused_mp_state(self, state, layout, fused):
+        """(Re)build the flat master/moment buffers for a new
+        low-precision parameter layout.  New params seed their master
+        from the current param value (carrying any per-param moments
+        they trained with — the pow gate upstream guarantees their
+        schedule matches); params already in the old layout carry
+        master AND moments byte-exact; params LEAVING the buffer stash
+        their moments+pows per-param so a later _eager_update resumes
+        instead of restarting bias correction (same contract as the f32
+        _migrate_fused_state)."""
+        import jax.numpy as jnp
+
+        old_layout = getattr(self, "_fused_mp_layout", None)
+        per_param = {}
+        if old_layout and "master" in state:
+            off = 0
+            for name, n, _ in old_layout:
+                per_param[name] = (state["master"][off:off + n],
+                                   state["m1"][off:off + n],
+                                   state["m2"][off:off + n])
+                off += n
+            new_names = {name for name, _, _ in layout}
+            for name, _, _ in old_layout:
+                if name not in new_names:
+                    self._param_state[name] = {
+                        "m1": per_param[name][1], "m2": per_param[name][2],
+                        "b1p": state["b1p"], "b2p": state["b2p"]}
+        masters, m1s, m2s = [], [], []
+        for p, _ in fused:
+            n = int(np.prod(p._value.shape) if p._value.shape else 1)
+            if p.name in per_param:
+                ms, m1, m2 = per_param[p.name]
+            else:
+                ms = jnp.ravel(p._value).astype(jnp.float32)
+                pst = self._param_state.get(p.name, {})
+                if "m1" in pst:
+                    m1 = jnp.ravel(pst["m1"]).astype(jnp.float32)
+                    m2 = jnp.ravel(pst["m2"]).astype(jnp.float32)
+                    self._param_state.pop(p.name, None)
+                else:
+                    m1 = jnp.zeros((n,), jnp.float32)
+                    m2 = jnp.zeros((n,), jnp.float32)
+            masters.append(ms)
+            m1s.append(m1)
+            m2s.append(m2)
+        state["master"] = jnp.concatenate(masters)
+        state["m1"] = jnp.concatenate(m1s)
+        state["m2"] = jnp.concatenate(m2s)
+        state.setdefault("b1p", jnp.ones((1,), jnp.float32))
+        state.setdefault("b2p", jnp.ones((1,), jnp.float32))
+        self._fused_mp_layout = layout
+
+    def _fused_pow_groups(self, fused, state_key, layout_attr):
+        """Split fused candidates into (fusable, per_param) by beta-pow
+        schedule.  The fused buffer keeps ONE (b1p, b2p) pair; a param
+        whose carried per-param pows differ — or a brand-new param
+        joining a mid-schedule buffer — would inherit a wrong bias
+        correction, so it stays per-param.  Params already CARRIED BY
+        the buffer (present in the current layout) share its schedule
+        by construction and always fuse.  Traced (in-jit) states skip
+        the value check — the state structure is fixed per compiled
+        step, and fresh optimizers (the jit_train_step path) are
+        homogeneous anyway."""
+        import jax
+
+        def conc(x):
+            if isinstance(x, jax.core.Tracer):
+                return None
+            return float(np.asarray(x).ravel()[0])
+
+        in_buffer = {name for name, *_ in (getattr(self, layout_attr, None)
+                                           or ())}
+        st = self._param_state.get(state_key, {})
+        target = None
+        if "b1p" in st:
+            t1, t2 = conc(st["b1p"]), conc(st["b2p"])
+            if t1 is None:
+                return fused, []
+            target = (t1, t2)
+        fusable, groups, new_params = [], {}, []
+        for pg in fused:
+            name = pg[0].name
+            pst = self._param_state.get(name, {})
+            if name in in_buffer and "m1" not in pst:
+                fusable.append(pg)  # lives in the flat buffer already
+            elif "m1" in pst and "b1p" in pst:
+                c1, c2 = conc(pst["b1p"]), conc(pst["b2p"])
+                if c1 is None:
+                    return fused, []
+                groups.setdefault((c1, c2), []).append(pg)
+            else:
+                new_params.append(pg)
+        if target is None:
+            if not groups:
+                return fused, []
+            target = max(groups, key=lambda k: len(groups[k]))
+        defer = []
+        for pows, pgs in groups.items():
+            ok = all(abs(a - b) <= 1e-6 * max(1.0, abs(b))
+                     for a, b in zip(pows, target))
+            (fusable if ok else defer).extend(pgs)
+        # new params start at unity pows: they may only join a buffer
+        # whose schedule is still at step 0
+        if all(abs(v - 1.0) <= 1e-9 for v in target):
+            fusable.extend(new_params)
+        else:
+            defer.extend(new_params)
+        order = {id(pg): i for i, pg in enumerate(fused)}
+        fusable.sort(key=lambda pg: order[id(pg)])
+        return fusable, defer
+
     def _migrate_fused_state(self, state, layout, fused):
         """(Re)build the flat moment buffers for a new parameter layout,
-        carrying over any existing per-parameter or flat state."""
+        carrying over any existing per-parameter or flat state.  The
+        _fused_pow_groups gate upstream guarantees every carried source
+        here shares one (b1p, b2p) schedule."""
         import jax.numpy as jnp
 
         old_layout = getattr(self, "_fused_layout", None)

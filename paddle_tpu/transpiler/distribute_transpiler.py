@@ -197,6 +197,16 @@ class DistributeTranspiler:
                     op_.attrs = {"table_name": wname,
                                  "emb_dim": self._sparse_tables[wname],
                                  OP_ROLE_KEY: OpRole.Backward}
+            # merge per-slot remote ops into ONE multi-Ids op per table
+            # (reference: parameter_prefetch.cc batches one RPC per
+            # table section; r5 — each host op between jit segments is a
+            # device sync, and through a real accelerator link that sync
+            # is a round-trip, so 2×n_slots ops/step became the
+            # wide_deep PS bottleneck).  Forward ops merge into the
+            # group's FIRST position (Ids are data/early vars — gated
+            # below), grad ops into the LAST (all upstream grads ready).
+            self._merge_lookup_ops(block, "distributed_lookup_table")
+            self._merge_lookup_ops(block, "distributed_lookup_table_grad")
             # drop the grad accumulators for sparse tables (the backward
             # pass sums multi-consumer W@GRAD contributions — remote
             # pushes made them dead, and their @RENAME inputs are gone)
@@ -286,6 +296,66 @@ class DistributeTranspiler:
             )
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _merge_lookup_ops(block, op_type):
+        """Merge all ``op_type`` ops with the same (table_name, emb_dim)
+        into one multi-Ids op, so each table costs one host-op device
+        sync and one (thread-fanned) RPC round per step instead of one
+        per slot.  Forward groups merge into the first member's position
+        only if every later member's Ids is a data var or defined before
+        it; grad groups merge into the last member (all grads ready)."""
+        from collections import OrderedDict
+
+        groups = OrderedDict()
+        for i, op_ in enumerate(block.ops):
+            if op_.type == op_type:
+                groups.setdefault(
+                    (op_.attr("table_name"), op_.attr("emb_dim")),
+                    []).append(i)
+        is_fwd = op_type == "distributed_lookup_table"
+        grad_slot = "Outputs@GRAD"
+        to_remove = []
+        for idxs in groups.values():
+            if len(idxs) < 2:
+                continue
+            keep = idxs[0] if is_fwd else idxs[-1]
+            if is_fwd:
+                defined = set()
+                for j in range(keep):
+                    defined.update(block.ops[j].output_arg_names)
+                ok = True
+                for i in idxs[1:]:
+                    for n in block.ops[i].input("Ids"):
+                        v = block._find_var_recursive(n)
+                        if n not in defined and not (
+                                v is not None and getattr(v, "is_data",
+                                                          False)):
+                            ok = False
+                if not ok:
+                    continue
+            keep_op = block.ops[keep]
+            ids = list(keep_op.input("Ids"))
+            outs = (list(keep_op.output("Outputs")) if is_fwd
+                    else list(keep_op.input(grad_slot)))
+            for i in idxs:
+                if i == keep:
+                    continue
+                o = block.ops[i]
+                if is_fwd:
+                    ids.extend(o.input("Ids"))
+                    outs.extend(o.output("Outputs"))
+                else:
+                    ids.extend(o.input("Ids"))
+                    outs.extend(o.input(grad_slot))
+                to_remove.append(i)
+            keep_op.inputs["Ids"] = ids
+            if is_fwd:
+                keep_op.outputs["Outputs"] = outs
+            else:
+                keep_op.inputs[grad_slot] = outs
+        for i in sorted(to_remove, reverse=True):
+            block._remove_op(i)
+
     def get_trainer_program(self, wait_port=True) -> Program:
         return self.origin_program
 

@@ -1,0 +1,262 @@
+"""AMP O2 resident-param training (r5): params live in bf16, the f32
+master copy lives ONLY inside the fused Adam state
+(optimizer.py _apply_fused_mp; reference analogs:
+contrib/mixed_precision/decorator.py cast_model_to_fp16 and the
+multi_precision attr of operators/optimizers/adam_op.cc)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.dygraph import guard, jit_train_step
+from paddle_tpu.dygraph.layers import Layer
+from paddle_tpu.dygraph.nn import BatchNorm, Linear
+
+
+class _MLP(Layer):
+    def __init__(self, din=16, hidden=32):
+        super().__init__()
+        self.l1 = Linear(din, hidden, act="relu")
+        self.l2 = Linear(hidden, 1)
+
+    def forward(self, x, y):
+        d = self.l2(self.l1(x)) - y
+        return fluid.layers.reduce_mean(d * d)
+
+
+def _data(n=16, din=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, din).astype(np.float32)
+    y = (x[:, :1] * 0.7 - 0.3).astype(np.float32)
+    return x, y
+
+
+def _set_deterministic_init(model, seed=42):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    for p in model.parameters():
+        p._value = jnp.asarray(
+            (rng.randn(*p._value.shape) * 0.2).astype(np.float32))
+
+
+def _train(amp_level, steps=12, lr=1e-2):
+    x, y = _data()
+    with guard():
+        model = _MLP()
+        _set_deterministic_init(model)
+        opt = fluid.optimizer.AdamOptimizer(
+            lr, parameter_list=model.parameters())
+        step = jit_train_step(model, opt, lambda m, a, b: m(a, b),
+                              amp=amp_level is not None,
+                              amp_level=amp_level or "O1")
+        losses = [float(np.asarray(step(x, y).value())) for _ in range(steps)]
+    return losses, model, opt
+
+
+def test_o2_params_bf16_master_f32():
+    import jax.numpy as jnp
+
+    losses, model, opt = _train("O2")
+    assert losses[-1] < losses[0]
+    for p in model.parameters():
+        assert p._value.dtype == jnp.bfloat16, p.name
+    st = opt._param_state["@fused_mp"]
+    assert st["master"].dtype == jnp.float32
+    n_total = sum(int(np.prod(p._value.shape)) for p in model.parameters())
+    assert st["master"].shape == (n_total,)
+    # the low-precision params are exactly the cast of the master slices
+    off = 0
+    for p, (name, n, _) in zip(model.parameters(), opt._fused_mp_layout):
+        assert p.name == name
+        exp = np.asarray(st["master"][off:off + n]).astype(
+            jnp.bfloat16).reshape(p._value.shape)
+        np.testing.assert_array_equal(np.asarray(p._value), np.asarray(exp))
+        off += n
+
+
+def test_o2_loss_close_to_f32():
+    """O2-resident training must track the f32 trajectory: bf16 params
+    + f32 master is the standard master-weight recipe, not a different
+    optimization problem (reference oracle shape:
+    contrib/tests/test_image_classification_fp16.py)."""
+    l32, _, _ = _train(None)
+    lo2, _, _ = _train("O2")
+    for a, b in zip(l32, lo2):
+        assert abs(a - b) / max(abs(a), 1e-6) < 0.08, (l32, lo2)
+
+
+def test_o2_batchnorm_params_stay_f32():
+    import jax.numpy as jnp
+
+    class _BNNet(Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = Linear(8, 8)
+            self.bn = BatchNorm(8)
+
+        def forward(self, x, y):
+            d = fluid.layers.reduce_mean(self.bn(self.fc(x))) - y
+            return d * d
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = np.float32(0.3)
+    with guard():
+        m = _BNNet()
+        opt = fluid.optimizer.AdamOptimizer(
+            1e-2, parameter_list=m.parameters())
+        step = jit_train_step(m, opt, lambda mm, a, b: mm(a, b),
+                              amp=True, amp_level="O2")
+        for _ in range(3):
+            loss = step(x, y)
+        assert np.isfinite(float(np.asarray(loss.value())))
+        assert m.fc.weight._value.dtype == jnp.bfloat16
+        for p in m.bn.parameters():
+            assert p._value.dtype == jnp.float32, p.name
+
+
+def test_fused_mp_migration_carries_master_and_moments():
+    """Changing the low-precision param set (e.g. unfreezing a layer)
+    must carry master AND moments byte-exact for surviving params, and
+    seed new masters from the current param value."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.dygraph.varbase import VarBase
+
+    with guard():
+        opt = fluid.optimizer.AdamOptimizer(1e-2, parameter_list=[])
+        rng = np.random.RandomState(3)
+
+        def mk(name, n):
+            p = VarBase(jnp.asarray(rng.randn(n).astype(np.float32))
+                        .astype(jnp.bfloat16))
+            p.name = name
+            return p
+
+        pa, pb = mk("a", 8), mk("b", 16)
+        ga = jnp.asarray(rng.randn(8).astype(np.float32)).astype(jnp.bfloat16)
+        gb = jnp.asarray(rng.randn(16).astype(np.float32)).astype(jnp.bfloat16)
+
+        for _ in range(3):
+            opt._dygraph_apply([(pa, ga), (pb, gb)])
+        st = opt._param_state["@fused_mp"]
+        master_a = np.asarray(st["master"][:8]).copy()
+        m1_a = np.asarray(st["m1"][:8]).copy()
+        m2_a = np.asarray(st["m2"][:8]).copy()
+        b1p = np.asarray(st["b1p"]).copy()
+        b2p = np.asarray(st["b2p"]).copy()
+
+        # param b leaves (e.g. a frozen layer) -> migration, one update
+        # (mid-schedule JOINS are per-param by design — see
+        # test_fused_mp_new_param_mid_schedule_stays_per_param)
+        opt._dygraph_apply([(pa, ga)])
+        st = opt._param_state["@fused_mp"]
+        assert [n for n, *_ in opt._fused_mp_layout] == ["a"]
+        # b's moments+pows were stashed per-param (code-review r5): a
+        # later per-param update resumes instead of restarting at step 0
+        bst = opt._param_state["b"]
+        assert "m1" in bst
+        np.testing.assert_allclose(np.asarray(bst["b1p"]), b1p)
+        # a's carried (master, m1, m2, pows) must give the SAME update a
+        # per-param adam with those states would compute
+        from paddle_tpu.ops.registry import eager_call
+
+        outs = eager_call(
+            "adam",
+            {"Param": [jnp.asarray(master_a)],
+             "Grad": [jnp.ravel(ga).astype(jnp.float32)],
+             "Moment1": [jnp.asarray(m1_a)], "Moment2": [jnp.asarray(m2_a)],
+             "Beta1Pow": [jnp.asarray(b1p)], "Beta2Pow": [jnp.asarray(b2p)],
+             "LearningRate": [jnp.asarray([1e-2], jnp.float32)]},
+            {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+            {"ParamOut": 1, "Moment1Out": 1, "Moment2Out": 1,
+             "Beta1PowOut": 1, "Beta2PowOut": 1})
+        np.testing.assert_allclose(np.asarray(st["master"][:8]),
+                                   np.asarray(outs["ParamOut"][0]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_o2_layout_stable_across_steps():
+    """After the first step the fused layout object must not be rebuilt
+    per step (the r4 coalesce overhead must not return as a per-step
+    migration)."""
+    _, model, opt = _train("O2", steps=4)
+    layout = opt._fused_mp_layout
+    st = opt._param_state["@fused_mp"]
+    x, y = _data(seed=5)
+    with guard():
+        step = jit_train_step(
+            model, opt, lambda m, a, b: m(a, b), amp=True, amp_level="O2")
+        step(x, y)
+    assert opt._fused_mp_layout is layout
+    assert "master" in opt._param_state["@fused_mp"]
+    assert opt._param_state["@fused_mp"]["master"].shape == st["master"].shape
+
+
+def test_eager_fused_adam_schedule_advances_every_step():
+    """Code-review r5 regression: params carried by the @fused buffer
+    have no per-param state — the beta-pow gate must not classify them
+    as 'new' and bounce them off the buffer on alternating steps."""
+    import jax.numpy as jnp
+
+    class _M(Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = Linear(8, 8)
+
+        def forward(self, x, y):
+            d = self.l1(x) - y
+            return fluid.layers.reduce_mean(d * d)
+
+    with guard():
+        m = _M()
+        opt = fluid.optimizer.AdamOptimizer(
+            1e-2, parameter_list=m.parameters())
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 8).astype(np.float32)
+        y = rng.randn(4, 8).astype(np.float32)
+        for step in range(4):
+            loss = m(x, y)
+            loss.backward()
+            opt.minimize(loss)
+            opt.clear_gradients()
+            st = opt._param_state
+            b1p = float(np.asarray(st["@fused"]["b1p"]).ravel()[0])
+            np.testing.assert_allclose(b1p, 0.9 ** (step + 1), rtol=1e-5)
+            assert not [k for k in st
+                        if not k.startswith("@") and "m1" in st[k]]
+
+
+def test_fused_mp_new_param_mid_schedule_stays_per_param():
+    """A bf16 param whose grad first appears after the @fused_mp buffer
+    advanced must NOT inherit the buffer's non-unity beta pows (the r4
+    advisor finding, applied to the O2 master path)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.dygraph.varbase import VarBase
+
+    with guard():
+        opt = fluid.optimizer.AdamOptimizer(1e-2, parameter_list=[])
+        rng = np.random.RandomState(1)
+
+        def mk(name, n):
+            p = VarBase(jnp.asarray(rng.randn(n).astype(np.float32))
+                        .astype(jnp.bfloat16))
+            p.name = name
+            return p
+
+        pa = mk("a", 8)
+        ga = jnp.asarray(rng.randn(8).astype(np.float32)).astype(jnp.bfloat16)
+        for _ in range(3):
+            opt._dygraph_apply([(pa, ga)])
+        # a new param joins after 3 steps: deferred to per-param with
+        # unity pows, not merged into the mid-schedule buffer
+        pb = mk("b", 4)
+        gb = jnp.asarray(rng.randn(4).astype(np.float32)).astype(jnp.bfloat16)
+        opt._dygraph_apply([(pa, ga), (pb, gb)])
+        assert [n for n, *_ in opt._fused_mp_layout] == ["a"]
+        bst = opt._param_state["b"]
+        np.testing.assert_allclose(
+            float(np.asarray(bst["b1p"]).ravel()[0]), 0.9, rtol=1e-6)
+        ast = opt._param_state["@fused_mp"]
+        np.testing.assert_allclose(
+            float(np.asarray(ast["b1p"]).ravel()[0]), 0.9 ** 4, rtol=1e-5)

@@ -1,0 +1,182 @@
+"""Op-name parity tail tests (r5, VERDICT r4 Missing #4/#6):
+LoD<->array conversion ops, conditional_block / run_program op forms,
+pslib pull/push_sparse aliases — plus the registry-diff oracle that the
+remaining absences are engine ops only."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def test_lod_array_round_trip():
+    from paddle_tpu.ops.registry import eager_call  # noqa: F401  (import check)
+    from paddle_tpu.ops import compat_ops  # noqa: F401
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3, 2])
+        lens = fluid.layers.data("lens", [1], dtype="int64")
+        blk = main.global_block()
+        table = blk.create_var(name="rt")
+        arr = blk.create_var(name="arr")
+        out = blk.create_var(name="xr", dtype="float32", shape=[-1, 3, 2])
+        out_len = blk.create_var(name="xr_len", dtype="int64", shape=[-1])
+        blk.append_op("lod_rank_table", inputs={"X": [x], "Length": [lens]},
+                      outputs={"Out": [table]})
+        blk.append_op("lod_tensor_to_array",
+                      inputs={"X": [x], "RankTable": [table],
+                              "Length": [lens]},
+                      outputs={"Out": [arr]})
+        blk.append_op("array_to_lod_tensor",
+                      inputs={"X": [arr], "RankTable": [table]},
+                      outputs={"Out": [out], "Length": [out_len]})
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 3, 2).astype(np.float32)
+    lv = np.array([[2], [3], [1], [3]], np.int64)
+    # zero the padding so the round trip is exact
+    for i, ln in enumerate(lv.ravel()):
+        xv[i, ln:] = 0.0
+    with scope_guard(Scope()):
+        got, got_len = exe.run(main, feed={"x": xv, "lens": lv},
+                               fetch_list=["xr", "xr_len"])
+    np.testing.assert_allclose(np.asarray(got), xv, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_len), lv.ravel())
+
+
+def test_split_merge_lod_tensor():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2])
+        mask = fluid.layers.data("mask", [1], dtype="bool")
+        blk = main.global_block()
+        t = blk.create_var(name="t", dtype="float32")
+        f = blk.create_var(name="f", dtype="float32")
+        m = blk.create_var(name="m", dtype="float32", shape=[-1, 2])
+        blk.append_op("split_lod_tensor", inputs={"X": [x], "Mask": [mask]},
+                      outputs={"OutTrue": [t], "OutFalse": [f]})
+        blk.append_op("merge_lod_tensor",
+                      inputs={"InTrue": [t], "InFalse": [f], "Mask": [mask],
+                              "X": [x]},
+                      outputs={"Out": [m]})
+    exe = fluid.Executor(pt.CPUPlace())
+    xv = np.arange(10, dtype=np.float32).reshape(5, 2)
+    mv = np.array([[1], [0], [1], [0], [0]], bool)
+    with scope_guard(Scope()):
+        got = exe.run(main, feed={"x": xv, "mask": mv}, fetch_list=["m"])[0]
+    np.testing.assert_allclose(np.asarray(got), xv, rtol=1e-6)
+
+
+def test_conditional_block_op_form():
+    for cond_val, expect in ((1.0, 7.0), (0.0, 3.0)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            c = fluid.layers.data("c", [1])
+            blk = main.global_block()
+            out = fluid.layers.fill_constant([1], "float32", 3.0)
+            sub = main._create_block()
+            inner = fluid.layers.fill_constant([1], "float32", 7.0)
+            main._rollback()
+            blk.append_op(
+                "conditional_block",
+                inputs={"Cond": [c], "Input": []},
+                outputs={"Out": [out.name], "Scope": []},
+                attrs={"sub_block": sub, "is_scalar_condition": True})
+            # rebind: inside the sub block, `out` is overwritten
+            sub.append_op("assign", inputs={"X": [inner]},
+                          outputs={"Out": [out.name]})
+        exe = fluid.Executor(pt.CPUPlace())
+        with scope_guard(Scope()):
+            got = exe.run(main,
+                          feed={"c": np.array([[cond_val]], np.float32)},
+                          fetch_list=[out.name])[0]
+        np.testing.assert_allclose(np.asarray(got).ravel(), [expect])
+
+
+def test_run_program_op_form():
+    inner_main, inner_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(inner_main, inner_startup):
+        xi = fluid.layers.data("rp_x", [2])
+        yi = fluid.layers.scale(xi, scale=3.0, bias=1.0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("rp_x", [2])
+        blk = main.global_block()
+        out = blk.create_var(name=yi.name, dtype="float32", shape=[-1, 2])
+        blk.append_op("run_program", inputs={"X": [x]},
+                      outputs={"Out": [out]},
+                      attrs={"program": inner_main})
+    exe = fluid.Executor(pt.CPUPlace())
+    xv = np.ones((2, 2), np.float32)
+    with scope_guard(Scope()):
+        got = exe.run(main, feed={"rp_x": xv}, fetch_list=[out.name])[0]
+    np.testing.assert_allclose(np.asarray(got), xv * 3.0 + 1.0, rtol=1e-6)
+
+
+def test_pull_push_sparse_aliases():
+    from paddle_tpu.distributed_ps import runtime
+    from paddle_tpu.distributed_ps.service import PSClient, PSServer
+
+    server = PSServer("127.0.0.1:0", n_trainers=1).start()
+    try:
+        client = PSClient([server.endpoint])
+        client.create_sparse("pslib_table_7", 4, optimizer="sgd", lr=0.5,
+                             init_range=0.1)
+        runtime.set_client(client)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [3], dtype="int64")
+            blk = main.global_block()
+            out = blk.create_var(name="ps_out", dtype="float32")
+            blk.append_op("pull_sparse", inputs={"Ids": [ids]},
+                          outputs={"Out": [out]},
+                          attrs={"TableId": 7, "EmbeddingDim": 4})
+            out.shape = (-1, 3, 4)
+            out.stop_gradient = False
+            loss = fluid.layers.reduce_sum(out)
+            pt.append_backward(loss)
+        assert any(op.type == "push_sparse"
+                   for op in main.global_block().ops)
+        exe = fluid.Executor(pt.CPUPlace())
+        ids_np = np.array([[1, 2, 3]], np.int64)
+        before = client.pull_sparse("pslib_table_7", ids_np.ravel()).copy()
+        got = exe.run(main, feed={"ids": ids_np}, fetch_list=[out.name])[0]
+        np.testing.assert_allclose(np.asarray(got).reshape(3, 4), before,
+                                   rtol=1e-5)
+        after = client.pull_sparse("pslib_table_7", ids_np.ravel())
+        np.testing.assert_allclose(after, before - 0.5, rtol=1e-5)
+        client.close()
+    finally:
+        server.stop()
+        runtime.clear()
+
+
+def test_registry_diff_is_engine_shaped():
+    """The VERDICT r4 'done' oracle for Missing #6: every reference
+    REGISTER_OPERATOR name we do not register is an engine/BoxPS op."""
+    import subprocess
+
+    from paddle_tpu.ops.registry import OPS
+
+    out = subprocess.run(
+        ["grep", "-rhoP", r"REGISTER_OPERATOR\(\s*\K[a-z0-9_]+",
+         "/root/reference/paddle/fluid/operators/"],
+        capture_output=True, text=True)
+    if out.returncode != 0 or not out.stdout:
+        import pytest
+
+        pytest.skip("reference tree not available")
+    ref = set(out.stdout.split())
+    allowed = {
+        # engine subgraph ops (XLA IS the engine on this stack)
+        "tensorrt_engine", "lite_engine", "fusion_group",
+        # BoxPS (SURVEY: out of scope)
+        "pull_box_sparse", "push_box_sparse", "push_box_extended_sparse",
+        # grep artifacts of the macro, not ops
+        "op_name", "op_type",
+        # grad-only registration names
+        "cross_entropy_grad2",
+    }
+    missing = {n for n in ref if n not in OPS and not n.endswith("_grad")}
+    assert missing <= allowed, sorted(missing - allowed)

@@ -462,3 +462,84 @@ def test_train_from_dataset_multithread(tmp_path):
         final = float(np.asarray(exe.run(
             main, feed=probe, fetch_list=[loss])[0]).ravel()[0])
         assert np.isfinite(final) and final < initial
+
+
+# --------------------------------------------------------------------------
+# r5 tail: mq2007 / common / image (reference: dataset/tests)
+# --------------------------------------------------------------------------
+def test_mq2007_parsing_and_generators():
+    from paddle_tpu.dataset import mq2007
+
+    # LETOR line parse
+    q = mq2007.Query()._parse_(
+        "2 qid:10 " + " ".join(f"{i+1}:0.{i+1:02d}" for i in range(46))
+        + " #docid = GX1")
+    assert q.relevance_score == 2 and q.query_id == 10
+    assert len(q.feature_vector) == 46 and q.description == "docid = GX1"
+    # malformed lines are skipped
+    assert mq2007.Query()._parse_("bogus line") is None
+
+    pairs = list(mq2007.train(format="pairwise"))
+    assert pairs, "synthetic fallback should yield pairs"
+    label, better, worse = pairs[0]
+    assert label.shape == (1,) and better.shape == (46,)
+
+    points = list(mq2007.train(format="pointwise"))
+    assert points and points[0][1].shape == (46,)
+
+    lists = list(mq2007.train(format="listwise"))
+    labels, feats = lists[0]
+    assert feats.shape[1] == 46 and labels.shape[0] == feats.shape[0]
+    # listwise labels are sorted descending (rank-corrected)
+    assert (np.diff(labels.ravel()) <= 0).all()
+
+
+def test_dataset_common_split_and_cluster_reader(tmp_path):
+    from paddle_tpu.dataset import common
+
+    def reader():
+        for i in range(25):
+            yield i
+
+    suffix = str(tmp_path / "part-%05d.pickle")
+    common.split(reader, 10, suffix=suffix)
+    import glob
+
+    files = sorted(glob.glob(str(tmp_path / "part-*.pickle")))
+    assert len(files) >= 2
+    r0 = common.cluster_files_reader(str(tmp_path / "part-*.pickle"), 2, 0)
+    r1 = common.cluster_files_reader(str(tmp_path / "part-*.pickle"), 2, 1)
+    got = sorted(list(r0()) + list(r1()))
+    assert got == list(range(25))
+
+
+def test_dataset_common_download_cache_only(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    import pytest
+
+    with pytest.raises(IOError):
+        common.download("http://example.com/foo.tar", "foo")
+    p = tmp_path / "foo"
+    p.mkdir(exist_ok=True)
+    (p / "foo.tar").write_bytes(b"data")
+    assert common.download("http://example.com/foo.tar", "foo").endswith(
+        "foo.tar")
+    assert common.md5file(str(p / "foo.tar")) == common.md5file(
+        str(p / "foo.tar"))
+
+
+def test_dataset_image_transforms():
+    from paddle_tpu.dataset import image
+
+    im = np.arange(32 * 48 * 3, dtype=np.uint8).reshape(32, 48, 3)
+    r = image.resize_short(im, 16)
+    assert min(r.shape[:2]) == 16 and r.shape[2] == 3
+    c = image.center_crop(r, 12)
+    assert c.shape[:2] == (12, 12)
+    f = image.left_right_flip(c)
+    np.testing.assert_array_equal(np.asarray(f[:, ::-1]), c)
+    out = image.simple_transform(im, 24, 16, is_train=False,
+                                 mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 16, 16) and out.dtype == np.float32

@@ -188,3 +188,224 @@ def test_save_inference_model_from_declarative(tmp_path):
     (out,) = exe.run(prog, feed={feeds[0]: np.asarray(x.numpy())},
                      fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# r5: loop machinery — for->while, break/continue, early return, print
+# (reference: dygraph_to_static/test_loop.py, test_break_continue.py,
+# test_return.py, test_print.py)
+# ---------------------------------------------------------------------------
+def test_for_range_tensor_bound():
+    @declarative
+    def f(x):
+        s = fluid.layers.fill_constant([1], "float32", 0.0)
+        n = fluid.layers.cast(fluid.layers.reduce_sum(x), "int64")
+        for i in range(n):
+            s = s + fluid.layers.cast(i, "float32")
+        return s
+
+    with dygraph.guard():
+        out = f(to_variable(np.ones((5,), np.float32)))
+    np.testing.assert_allclose(out.numpy(), [10.0], rtol=1e-6)
+
+
+def test_for_over_tensor_rows():
+    @declarative
+    def f(x):
+        s = fluid.layers.fill_constant([3], "float32", 0.0)
+        for row in x:
+            s = s + row
+        return s
+
+    xv = rng.randn(4, 3).astype(np.float32)
+    with dygraph.guard():
+        out = f(to_variable(xv))
+    np.testing.assert_allclose(out.numpy(), xv.sum(0), rtol=1e-5)
+
+
+def test_for_enumerate_python_list():
+    @declarative
+    def f(x):
+        s = x * 0.0
+        for i, v in enumerate([1.0, 2.0, 3.0]):
+            s = s + v * (i + 1)
+        return s
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((1,), np.float32)))
+    np.testing.assert_allclose(out.numpy(), [1 + 4 + 9], rtol=1e-6)
+
+
+def test_break_tensor_cond():
+    @declarative
+    def f():
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        s = fluid.layers.fill_constant([1], "float32", 0.0)
+        while i < 10.0:
+            if s > 6.0:
+                break
+            s = s + i
+            i = i + 1.0
+        return s
+
+    i = s = 0.0
+    while i < 10.0:
+        if s > 6.0:
+            break
+        s, i = s + i, i + 1.0
+    with dygraph.guard():
+        out = f()
+    np.testing.assert_allclose(out.numpy(), [s], rtol=1e-6)
+
+
+def test_continue_tensor_cond():
+    @declarative
+    def f():
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        s = fluid.layers.fill_constant([1], "float32", 0.0)
+        while i < 6.0:
+            i = i + 1.0
+            if i > 2.0 and i < 4.0:
+                continue
+            s = s + i
+        return s
+
+    with dygraph.guard():
+        out = f()
+    np.testing.assert_allclose(out.numpy(), [1 + 2 + 4 + 5 + 6], rtol=1e-6)
+
+
+def test_early_return_tensor_pred():
+    @declarative
+    def f(x):
+        m = fluid.layers.reduce_mean(x)
+        if m > 0.0:
+            return m + 1.0
+        return m - 1.0
+
+    with dygraph.guard():
+        pos = f(to_variable(np.full((2,), 2.0, np.float32)))
+        neg = f(to_variable(np.full((2,), -2.0, np.float32)))
+    np.testing.assert_allclose(pos.numpy(), 3.0, rtol=1e-6)
+    np.testing.assert_allclose(neg.numpy(), -3.0, rtol=1e-6)
+
+
+def test_return_inside_tensor_while():
+    @declarative
+    def f():
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        while i < 10.0:
+            if i > 3.0:
+                return i
+            i = i + 1.0
+        return i * 0.0
+
+    with dygraph.guard():
+        out = f()
+    np.testing.assert_allclose(out.numpy(), [4.0], rtol=1e-6)
+
+
+def test_print_in_converted_fn(capsys):
+    @declarative
+    def f(x):
+        y = x + 1.0
+        print("step", 3)
+        print(y)
+        return y
+
+    with dygraph.guard():
+        out = f(to_variable(np.ones((2,), np.float32)))
+    np.testing.assert_allclose(out.numpy(), [2.0, 2.0], rtol=1e-6)
+    assert "step 3" in capsys.readouterr().out
+
+
+def test_decoder_for_break_matches_python_mirror():
+    """The VERDICT r4 'done' oracle: a decode-style loop whose bound is
+    a tensor, with a data-dependent break, converts and matches the
+    plain-python computation."""
+    @declarative
+    def decode(logit, max_len):
+        out = fluid.layers.fill_constant([1], "float32", 0.0)
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.cast(max_len, "int64")
+        while fluid.layers.cast(i, "float32") < fluid.layers.cast(n, "float32"):
+            step_val = fluid.layers.reduce_sum(logit) * fluid.layers.cast(
+                i, "float32")
+            out = out + step_val
+            if out > 20.0:
+                break
+            i = i + 1
+        return out
+
+    lv = np.full((2,), 1.5, np.float32)
+
+    def mirror(mx):
+        out, i = 0.0, 0
+        while i < mx:
+            out = out + lv.sum() * i
+            if out > 20.0:
+                break
+            i += 1
+        return out
+
+    with dygraph.guard():
+        got = decode(to_variable(lv),
+                     to_variable(np.asarray([8], np.int64)))
+    np.testing.assert_allclose(got.numpy(), [mirror(8)], rtol=1e-5)
+
+
+def test_nested_loop_inner_break():
+    @declarative
+    def f():
+        s = fluid.layers.fill_constant([1], "float32", 0.0)
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        while i < 3.0:
+            j = fluid.layers.fill_constant([1], "float32", 0.0)
+            while j < 5.0:
+                if j > 1.0:
+                    break
+                s = s + 1.0
+                j = j + 1.0
+            i = i + 1.0
+        return s
+
+    with dygraph.guard():
+        out = f()
+    # inner loop adds for j=0,1 then breaks at j=2 -> 2 per outer iter
+    np.testing.assert_allclose(out.numpy(), [6.0], rtol=1e-6)
+
+
+def test_early_return_with_tail_assignments():
+    """Code-review r5: `if t: return a` followed by a tail that BINDS a
+    new name must convert — the synthetic not-returned branch fills the
+    unbound name with the RETURN_NO_VALUE magic instead of raising."""
+    @declarative
+    def f(x):
+        m = fluid.layers.reduce_mean(x)
+        if m > 0.0:
+            return m + 1.0
+        z = m * 2.0
+        y = z - 1.0
+        return y
+
+    with dygraph.guard():
+        pos = f(to_variable(np.full((2,), 2.0, np.float32)))
+        neg = f(to_variable(np.full((2,), -2.0, np.float32)))
+    np.testing.assert_allclose(pos.numpy(), 3.0, rtol=1e-6)
+    np.testing.assert_allclose(neg.numpy(), -5.0, rtol=1e-6)
+
+
+def test_for_over_dict_keeps_python_semantics():
+    """Code-review r5: `for k in dict` iterates KEYS in python; the
+    index-based rewrite must not turn it into dict[0], dict[1]..."""
+    @declarative
+    def f(x):
+        table = {"a": 1.0, "b": 2.0, "c": 3.0}
+        s = x * 0.0
+        for k in table:
+            s = s + table[k]
+        return s
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((1,), np.float32)))
+    np.testing.assert_allclose(out.numpy(), [6.0], rtol=1e-6)

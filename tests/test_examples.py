@@ -20,3 +20,42 @@ def test_example_tiny_smoke(script):
         env=env, capture_output=True, text=True, timeout=420, cwd=REPO)
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "step" in proc.stdout
+
+
+def test_r_example_call_sequence(tmp_path):
+    """CI stand-in for examples/r/mobilenet.r (no R toolchain in this
+    image): exports the model the R script consumes, then drives the
+    EXACT reticulate call sequence — AnalysisConfig(model_dir),
+    switch_use_feed_fetch_ops(False), get_input_names ->
+    get_input_handle -> reshape/copy_from_cpu -> zero_copy_run ->
+    get_output_handle -> copy_to_cpu — and checks the saved oracle."""
+    import importlib.util
+    import os
+
+    import numpy as np
+
+    here = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "r")
+    spec = importlib.util.spec_from_file_location(
+        "r_export_model", os.path.join(here, "export_model.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path)
+    mod.main(out)
+
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    config = AnalysisConfig(os.path.join(out, "model"))
+    config.switch_use_feed_fetch_ops(False)
+    config.switch_specify_input_names(True)
+    predictor = create_paddle_predictor(config)
+    names = predictor.get_input_names()
+    handle = predictor.get_input_handle(names[0])
+    data = np.load(os.path.join(out, "data.npy"))
+    handle.reshape(list(data.shape))
+    handle.copy_from_cpu(data)
+    predictor.zero_copy_run()
+    out_handle = predictor.get_output_handle(predictor.get_output_names()[0])
+    got = out_handle.copy_to_cpu()
+    want = np.load(os.path.join(out, "result.npy"))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

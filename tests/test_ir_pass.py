@@ -472,3 +472,42 @@ def test_seqpool_concat_fuse_pass():
         assert types["sequence_pool"] == 0 and types["concat"] == 0
         after = np.asarray(exe.run(main, feed=feed, fetch_list=[cat])[0])
         np.testing.assert_allclose(before, after, atol=1e-6)
+
+
+def test_transpose_flatten_concat_fuse_pass():
+    """N x (transpose2 -> flatten2) -> concat folds into ONE
+    fusion_transpose_flatten_concat with identical output (reference:
+    ir/transpose_flatten_concat_fuse_pass.cc, the SSD head pattern)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import scope_guard
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xs = [fluid.layers.data(f"tfc{i}", [4, 6, 6]) for i in range(3)]
+            flat = []
+            for x in xs:
+                t = fluid.layers.transpose(x, [0, 2, 3, 1])
+                flat.append(fluid.layers.flatten(t, axis=1))
+            out = fluid.layers.concat(flat, axis=1)
+        return main, startup, out
+
+    rng = np.random.RandomState(0)
+    feed = {f"tfc{i}": rng.rand(2, 4, 6, 6).astype(np.float32)
+            for i in range(3)}
+    main, startup, out = build()
+    exe = pt.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        want = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+
+    fused_prog, startup2, out2 = build()
+    get_pass("transpose_flatten_concat_fuse_pass").apply(fused_prog)
+    types = [op.type for op in fused_prog.global_block().ops]
+    assert "fusion_transpose_flatten_concat" in types
+    assert "transpose2" not in types and "flatten2" not in types, types
+    with scope_guard(Scope()):
+        exe.run(startup2)
+        got = np.asarray(exe.run(fused_prog, feed=feed,
+                                 fetch_list=[out2])[0])
+    np.testing.assert_allclose(got, want, rtol=1e-6)

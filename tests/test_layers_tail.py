@@ -428,10 +428,12 @@ def test_py_func_and_print():
 
     def build():
         x = L.data("xp", [3])
-        helper_out = pt.layers.create_tensor("float32") if False else None
         from paddle_tpu.layer_helper import LayerHelper
         h = LayerHelper("py_func_out")
         out = h.create_variable_for_type_inference(x.dtype)
+        # py_func contract (same as reference): out must be declared
+        # with the real shape — pure_callback needs it
+        out.shape = (-1, 3)
         res = L.py_func(my_fn, x, out)
         p = L.Print(res, message="dbg")
         return p

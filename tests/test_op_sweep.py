@@ -1315,6 +1315,20 @@ SPECS["max_sequence_len"] = S(
     ref=lambda ins, a: {"Out": np.asarray(7, np.int64)})
 
 COVERED_ELSEWHERE.update({
+    # r5 op-name parity tail — tests/test_compat_ops.py
+    "lod_rank_table": "test_compat_ops",
+    "lod_tensor_to_array": "test_compat_ops",
+    "array_to_lod_tensor": "test_compat_ops",
+    "split_lod_tensor": "test_compat_ops",
+    "merge_lod_tensor": "test_compat_ops",
+    "conditional_block": "test_compat_ops",
+    "run_program": "test_compat_ops",
+    "pull_sparse": "test_compat_ops", "pull_sparse_v2": "test_compat_ops",
+    "push_sparse": "test_compat_ops", "push_sparse_v2": "test_compat_ops",
+    # r5 py_func op form — tests/test_py_func.py
+    "py_func_grad": "test_py_func",
+})
+COVERED_ELSEWHERE.update({
     # r4 long-tail corpus — tests/test_long_tail_ops.py (NumPy oracles)
     "tree_conv": "test_long_tail_ops", "var_conv_2d": "test_long_tail_ops",
     "rank_attention": "test_long_tail_ops", "batch_fc": "test_long_tail_ops",

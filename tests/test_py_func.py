@@ -1,0 +1,104 @@
+"""py_func op tests (reference:
+python/paddle/fluid/tests/unittests/test_py_func_op.py — the tanh/
+tanh_grad custom forward+backward pattern, run under the whole-block
+jitted executor)."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _tanh(x):
+    return np.tanh(x)
+
+
+def _tanh_grad(y, dy):
+    return np.asarray(dy) * (1 - np.square(np.asarray(y)))
+
+
+def test_py_func_forward_and_backward():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        x.stop_gradient = False
+        hidden = fluid.layers.fc(x, 8)
+        out = main.current_block().create_var(
+            name="pyfunc_out", dtype=hidden.dtype, shape=hidden.shape)
+        # skip the INPUT in backward: backward_func sees (out, dout) —
+        # the reference example's exact signature
+        act = fluid.layers.py_func(func=_tanh, x=hidden, out=out,
+                                   backward_func=_tanh_grad,
+                                   skip_vars_in_backward_input=hidden)
+        loss = fluid.layers.reduce_mean(act * act)
+        grads = pt.gradients([loss], [x])
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(5, 4).astype(np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        got = exe.run(main, feed={"x": xv},
+                      fetch_list=[act.name, loss.name, grads[0].name])
+
+    # oracle: the same program with the built-in tanh instead of py_func
+    main2, startup2 = fluid.Program(), fluid.Program()
+    main2.random_seed = 3
+    with fluid.program_guard(main2, startup2):
+        x2 = fluid.layers.data("x", [4])
+        x2.stop_gradient = False
+        hidden2 = fluid.layers.fc(x2, 8)
+        act2 = fluid.layers.tanh(hidden2)
+        loss2 = fluid.layers.reduce_mean(act2 * act2)
+        grads2 = pt.gradients([loss2], [x2])
+    with scope_guard(Scope()):
+        exe.run(startup2)
+        want = exe.run(main2, feed={"x": xv},
+                       fetch_list=[act2.name, loss2.name, grads2[0].name])
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_py_func_multi_in_out():
+    def add_sub(a, b):
+        return a + b, a - b
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", [3])
+        b = fluid.layers.data("b", [3])
+        blk = main.current_block()
+        o1 = blk.create_var(name="pf_o1", dtype=a.dtype, shape=a.shape)
+        o2 = blk.create_var(name="pf_o2", dtype=a.dtype, shape=a.shape)
+        outs = fluid.layers.py_func(func=add_sub, x=[a, b], out=[o1, o2])
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(1)
+    av = rng.randn(2, 3).astype(np.float32)
+    bv = rng.randn(2, 3).astype(np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        r1, r2 = exe.run(main, feed={"a": av, "b": bv},
+                         fetch_list=[outs[0].name, outs[1].name])
+    np.testing.assert_allclose(np.asarray(r1), av + bv, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(r2), av - bv, rtol=1e-6)
+
+
+def test_py_func_debug_no_out(capsys):
+    seen = {}
+
+    def dbg(x):
+        seen["shape"] = np.asarray(x).shape
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", [2])
+        fluid.layers.py_func(func=dbg, x=a, out=None)
+        out = a * 2.0
+    exe = fluid.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        r = exe.run(main, feed={"a": np.ones((3, 2), np.float32)},
+                    fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(r[0]), np.full((3, 2), 2.0))
+    assert seen.get("shape") == (3, 2)

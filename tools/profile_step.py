@@ -54,7 +54,9 @@ def run_resnet(steps=8, batch=128, image=224, amp=True):
     return step
 
 
-def run_ernie(steps=8, batch=16, seq=512, attn_dropout=True):
+def run_ernie(steps=8, batch=None, seq=512, attn_dropout=True):
+    # defaults track bench.py's headline ERNIE config (r5: b38, AMP O2)
+    batch = batch or int(os.environ.get("BENCH_BATCH", "38"))
     import numpy as np
 
     import paddle_tpu.fluid as fluid
@@ -73,7 +75,8 @@ def run_ernie(steps=8, batch=16, seq=512, attn_dropout=True):
     opt = fluid.optimizer.AdamOptimizer(1e-4,
                                         parameter_list=model.parameters())
     fn = jit_train_step(model, opt, lambda m, i, l: m(i, l),
-                        amp=os.environ.get("BENCH_AMP", "1") != "0")
+                        amp=os.environ.get("BENCH_AMP", "1") != "0",
+                        amp_level=os.environ.get("BENCH_AMP_LEVEL", "O2"))
 
     def step():
         return fn(ids, labels)
