@@ -106,8 +106,12 @@ class AsyncCommunicator:
                 table, queue.Queue(maxsize=self._queue_size))
         with self._inflight_lock:
             self._inflight += 1
-        q.put((np.asarray(ids, np.int64).ravel(),
-               np.asarray(grads, np.float32)))
+        # enqueue RAW values: `grads` may be an in-flight device array,
+        # and np.asarray here would block the TRAINER thread on the
+        # device round-trip — the send thread materializes at merge
+        # time instead (r5; the async contract the reference's
+        # communicator send queue provides)
+        q.put((ids, grads))
 
     def recv(self, table: str) -> np.ndarray:
         """Cached param read; falls through to a direct pull the first
@@ -214,9 +218,11 @@ class AsyncCommunicator:
                     worked = True
 
                     def _sparse_push(table=table, batch=batch):
-                        ids = np.concatenate([b[0] for b in batch])
+                        pairs = [(np.asarray(b[0], np.int64).ravel(),
+                                  np.asarray(b[1], np.float32)) for b in batch]
+                        ids = np.concatenate([i for i, _ in pairs])
                         grads = np.concatenate(
-                            [b[1].reshape(b[0].size, -1) for b in batch])
+                            [g.reshape(i.size, -1) for i, g in pairs])
                         self._client.push_sparse(table, ids, grads)
 
                     try:

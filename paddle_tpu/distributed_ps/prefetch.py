@@ -39,57 +39,58 @@ _pull_ema = {}  # id(client) -> EMA pull seconds (latency-adaptive gate)
 _PARALLEL_FLOOR_S = 5e-4
 
 
-def parallel_pull(client, table: str, flat_ids_list):
-    """Pull several id vectors from one table, fanning out over the
-    thread pool when a single pull's measured latency exceeds the
-    thread-handoff cost — real-network (DCN) pulls parallelize, loopback
-    microsecond pulls stay sequential.  The first pull of every batch is
-    timed to keep the EMA current."""
+def _fanned(key, thunks):
+    """The latency-adaptive fan-out skeleton every pull/push variant
+    shares: run thunks[0] inline (timing it to keep the EMA current);
+    run the rest sequentially when the EMA says a call is cheaper than
+    a thread handoff (loopback), else over the shared pool (real-network
+    RTTs parallelize).  Returns the results in order."""
     import time
 
-    if not flat_ids_list:
+    if not thunks:
         return []
     t0 = time.perf_counter()
-    first = client.pull_sparse(table, flat_ids_list[0])
+    first = thunks[0]()
     dt = time.perf_counter() - t0
-    key = id(client)
     _pull_ema[key] = 0.5 * dt + 0.5 * _pull_ema.get(key, dt)
-    rest = flat_ids_list[1:]
+    rest = thunks[1:]
     if not rest:
         return [first]
     if _pull_ema[key] < _PARALLEL_FLOOR_S:
-        return [first] + [client.pull_sparse(table, ids) for ids in rest]
+        return [first] + [t() for t in rest]
     pool = _shared_pool()
-    futs = [pool.submit(client.pull_sparse, table, ids) for ids in rest]
+    futs = [pool.submit(t) for t in rest]
     return [first] + [f.result() for f in futs]
 
 
-def parallel_push(client, table: str, pairs, record=False):
-    """Push several (flat_ids, grad_rows) pairs to one table, fanning
-    out over the thread pool under the same latency-adaptive gate as
-    parallel_pull (row adds commute and the server serializes per-table
-    state, so concurrent pushes are exact)."""
-    import time
+def parallel_pull_multi(client, jobs):
+    """Pull (table, flat_ids) jobs — possibly spanning several tables —
+    in ONE latency-adaptive fanned round."""
+    return _fanned(id(client), [
+        (lambda t=t, ids=ids: client.pull_sparse(t, ids))
+        for t, ids in jobs])
 
-    if not pairs:
-        return
-    t0 = time.perf_counter()
-    client.push_sparse(table, pairs[0][0], pairs[0][1], record=record)
-    dt = time.perf_counter() - t0
-    key = (id(client), "push")
-    _pull_ema[key] = 0.5 * dt + 0.5 * _pull_ema.get(key, dt)
-    rest = pairs[1:]
-    if not rest:
-        return
-    if _pull_ema[key] < _PARALLEL_FLOOR_S:
-        for ids, g in rest:
-            client.push_sparse(table, ids, g, record=record)
-        return
-    pool = _shared_pool()
-    futs = [pool.submit(client.push_sparse, table, ids, g, record=record)
-            for ids, g in rest]
-    for f in futs:
-        f.result()
+
+def parallel_pull(client, table: str, flat_ids_list):
+    """Pull several id vectors from one table (see parallel_pull_multi)."""
+    return parallel_pull_multi(client,
+                               [(table, ids) for ids in flat_ids_list])
+
+
+def parallel_push_multi(client, jobs, record=False):
+    """Push (table, flat_ids, grad_rows) jobs spanning several tables in
+    one fanned round (row adds commute; the server serializes per-table
+    state, so concurrent pushes are exact)."""
+    _fanned((id(client), "push"), [
+        (lambda t=t, ids=ids, g=g:
+         client.push_sparse(t, ids, g, record=record))
+        for t, ids, g in jobs])
+
+
+def parallel_push(client, table: str, pairs, record=False):
+    """Push several (flat_ids, grad_rows) pairs to one table."""
+    parallel_push_multi(client, [(table, ids, g) for ids, g in pairs],
+                        record=record)
 
 
 class SparsePrefetcher:

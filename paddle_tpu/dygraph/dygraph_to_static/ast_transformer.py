@@ -19,8 +19,18 @@ DygraphToStaticAst.transfer_from_node_type:
 3. break/continue (break_continue_transformer.py): bool-guard rewrite —
    flags + statement guards + ``and not flag`` in the loop test.
 4. print (print_transformer.py): ``print(x)`` -> convert_print.
-5. if/while/boolop -> convert_ifelse / convert_while_loop /
+5. builtin casts + assert (cast_transformer.py, assert_transformer.py,
+   call_transformer.py's len): len/bool/int/float/assert dispatch
+   through convert_* so tensor arguments lower to ops.
+6. if/while/boolop -> convert_ifelse / convert_while_loop /
    convert_logical_* (ifelse/loop/logical transformers).
+
+Documented cut (matches layers/control_flow.py): the reference's
+list_transformer turns list-append-in-loop into a growing
+LoDTensorArray; XLA control flow needs fixed shapes, so list appends
+work in PYTHON-unrolled loops (they stay plain lists) while
+tensor-bound loops should use while_loop carries or the rnn /
+dynamic_decode layers.
 """
 from __future__ import annotations
 
@@ -325,6 +335,38 @@ class _PrintTransformer(ast.NodeTransformer):
         return node
 
 
+class _CallAndAssertTransformer(ast.NodeTransformer):
+    """reference: cast_transformer.py + len handling in call_transformer
+    + assert_transformer — builtin len/bool/int/float calls and assert
+    statements dispatch through convert_* so tensor arguments lower to
+    ops instead of raising (python falls straight through)."""
+
+    _BUILTINS = {"len": "convert_len", "bool": "convert_bool",
+                 "int": "convert_int", "float": "convert_float"}
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in self._BUILTINS
+                and len(node.args) == 1 and not node.keywords):
+            node.func = ast.Attribute(
+                value=ast.Name(id=_JST, ctx=ast.Load()),
+                attr=self._BUILTINS[node.func.id], ctx=ast.Load())
+            ast.fix_missing_locations(node)
+        return node
+
+    def visit_Assert(self, node: ast.Assert):
+        self.generic_visit(node)
+        call = ast.Expr(value=ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                               attr="convert_assert", ctx=ast.Load()),
+            args=[node.test] + ([node.msg] if node.msg else []),
+            keywords=[]))
+        ast.copy_location(call, node)
+        ast.fix_missing_locations(call)
+        return call
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self._counter = 0
@@ -489,6 +531,7 @@ class DygraphToStaticAst:
         _ReturnTransformer().transform(fdef)
         _BreakContinueTransformer().visit(tree)
         _PrintTransformer().visit(tree)
+        _CallAndAssertTransformer().visit(tree)
         ast.fix_missing_locations(tree)
         tr = _ControlFlowTransformer()
         tr._fn_assigned = set(_store_names(fdef.body)) | {

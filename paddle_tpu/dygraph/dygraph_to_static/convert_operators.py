@@ -104,7 +104,7 @@ def convert_while_loop(cond_fn, body_fn, loop_vars):
             for i, bo in e.slots:
                 seed = lv[i]
                 if seed is None or seed is UNDEFINED:
-                    seed = layers.control_flow.RETURN_NO_VALUE_MAGIC
+                    seed = layers.control_flow.magic_fill_value(bo.dtype)
                 lv[i] = layers.fill_constant(list(bo.shape), bo.dtype, seed)
             out = layers.while_loop(cond_wrap, body_wrap, lv)
         if not isinstance(out, (list, tuple)):
@@ -260,6 +260,41 @@ def convert_index(it, i):
         shp = list(it.shape[1:])
         return layers.reshape(row, shp) if shp else layers.reshape(row, [1])
     return it[int(i)]
+
+
+def convert_bool(x):
+    """bool(tensor) -> bool-cast var (reference: convert_var_dtype)."""
+    if _is_tensor(x):
+        from ... import layers
+
+        return layers.cast(x, "bool")
+    return bool(x)
+
+
+def convert_int(x):
+    if _is_tensor(x):
+        from ... import layers
+
+        return layers.cast(x, "int64")
+    return int(x)
+
+
+def convert_float(x):
+    if _is_tensor(x):
+        from ... import layers
+
+        return layers.cast(x, "float32")
+    return float(x)
+
+
+def convert_assert(test, msg=None):
+    """assert on a tensor predicate -> Assert op in the graph
+    (reference: assert_transformer.py -> layers.Assert)."""
+    if _is_tensor(test):
+        from ... import layers
+
+        return layers.Assert(_to_bool_pred(test))
+    assert test, msg if msg is not None else "assertion failed"
 
 
 def convert_print(*args, **kwargs):

@@ -162,6 +162,30 @@ class AnalysisPredictor:
                 warnings.warn(
                     f"requested precision {cfg.precision()} could not be "
                     f"applied ({e}); serving in float32")
+        self._stage_weights()
+
+    def _stage_weights(self):
+        """Move the loaded weights to the serving device ONCE (r5).
+        The executor reads state from the scope every run; host-resident
+        numpy weights would be re-uploaded per call — through a remote
+        accelerator link that upload dwarfs the inference itself.  The
+        reference predictor likewise keeps weights device-resident
+        after load (analysis_predictor.cc PrepareProgram)."""
+        import jax
+
+        import numpy as _np
+
+        for name in self._scope.local_var_names():
+            v = self._scope.get(name)
+            if v is None or isinstance(v, jax.Array):
+                continue
+            arr = _np.asarray(v)
+            if arr.dtype == object or arr.dtype.kind not in "fiub":
+                continue
+            try:
+                self._scope.set(name, jax.device_put(arr, self._device))
+            except Exception:
+                pass  # non-stageable entries stay host-side
 
     def _optimize_program(self):
         """Run the config's pass list over the loaded program

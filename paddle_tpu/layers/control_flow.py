@@ -64,6 +64,26 @@ def _free_vars(blocks, parent):
 RETURN_NO_VALUE_MAGIC = 1.77113e27
 
 
+def magic_fill_value(dtype):
+    """The RETURN_NO_VALUE sentinel, clamped to what the slot's dtype
+    can hold: 1.77113e27 overflows integer fills to INT_MIN garbage
+    (code-review r5), so integer slots use their dtype max and bool
+    slots True."""
+    from ..framework.dtype import VarType, to_numpy_dtype
+    import numpy as _np
+
+    try:
+        np_dt = _np.dtype(to_numpy_dtype(dtype)
+                          if isinstance(dtype, (int, VarType)) else dtype)
+    except Exception:
+        return RETURN_NO_VALUE_MAGIC
+    if np_dt.kind in "iu":
+        return int(_np.iinfo(np_dt).max)
+    if np_dt.kind == "b":
+        return True
+    return RETURN_NO_VALUE_MAGIC
+
+
 class CarryInitMismatch(TypeError):
     """while_loop carry i entered as a python value but the body binds a
     Variable; .slots is [(i, body_out_var)].  The first (abandoned)
@@ -101,7 +121,7 @@ def _align_branch_outputs(prog, tb, fb, t_out, f_out):
                 o = others[i]
                 v = out[i]
                 if is_undef(v):
-                    fill = RETURN_NO_VALUE_MAGIC
+                    fill = magic_fill_value(o.dtype)
                 elif isinstance(v, bool):
                     fill = bool(v)
                 elif isinstance(v, (int, float)):

@@ -1410,6 +1410,15 @@ def generate_activation_fn(op_type):
     return _simple(op_type)
 
 
+def einsum(equation, *operands):
+    """reference: paddle 2.x paddle.einsum — general contraction op."""
+    helper = LayerHelper("einsum")
+    out = helper.create_variable_for_type_inference(operands[0].dtype)
+    helper.append_op("einsum", inputs={"Operands": list(operands)},
+                     outputs={"Out": [out]}, attrs={"equation": equation})
+    return out
+
+
 # public surface: every function defined in this module (keeps the
 # star-import in layers/__init__.py from leaking np/LayerHelper/etc.)
 __all__ = [

@@ -43,17 +43,21 @@ class RankTableValue(list):
     exactly the order lod_rank_table_op.cc produces."""
 
 
-@_host("lod_rank_table", no_grad=True)
-def _lod_rank_table(ctx):
+def _build_rank_table(ctx, x) -> "RankTableValue":
+    """The ONE place the rank-table order rule lives: stable sort by
+    descending length (lod_rank_table_op.cc order)."""
     from .sequence_ops import _get_len
 
-    x = ctx.in_("X")
     lens = np.asarray(_get_len(ctx, x)).astype(np.int64)
     order = sorted(range(len(lens)), key=lambda i: (-lens[i], i))
+    return RankTableValue((i, int(lens[i])) for i in order)
+
+
+@_host("lod_rank_table", no_grad=True)
+def _lod_rank_table(ctx):
     # direct env write: set_out would splat a list-typed value across
     # the output slot (same reason write_to_array binds env directly)
-    ctx.env[ctx.op.outputs["Out"][0]] = RankTableValue(
-        (i, int(lens[i])) for i in order)
+    ctx.env[ctx.op.outputs["Out"][0]] = _build_rank_table(ctx, ctx.in_("X"))
 
 
 def _rank_table_of(ctx, x):
@@ -61,11 +65,7 @@ def _rank_table_of(ctx, x):
         rt = ctx.in_("RankTable")
         if isinstance(rt, RankTableValue):
             return rt
-    from .sequence_ops import _get_len
-
-    lens = np.asarray(_get_len(ctx, x)).astype(np.int64)
-    order = sorted(range(len(lens)), key=lambda i: (-lens[i], i))
-    return RankTableValue((i, int(lens[i])) for i in order)
+    return _build_rank_table(ctx, x)
 
 
 @_host("lod_tensor_to_array", no_grad=True)

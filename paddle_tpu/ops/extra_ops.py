@@ -841,3 +841,14 @@ def _fusion_transpose_flatten_concat(ctx):
             lead *= int(s)
         outs.append(jnp.reshape(t, (lead, -1)))
     ctx.set_out("Out", jnp.concatenate(outs, axis=caxis))
+
+
+@op("einsum")
+def _einsum(ctx):
+    """General tensor contraction (paddle 2.x einsum_op.cc; fluid-era
+    models use it through layers.einsum).  On TPU this is the layout
+    escape hatch: expressing head split/merge as one contraction lets
+    XLA write the matmul output directly in the consumer's layout
+    instead of materializing a transpose copy."""
+    eq = ctx.attr("equation")
+    ctx.set_out("Out", jnp.einsum(eq, *ctx.ins("Operands")))

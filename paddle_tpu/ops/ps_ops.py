@@ -130,9 +130,8 @@ def _distributed_lookup_table(ctx):
     from ..distributed_ps import runtime as _runtime
 
     client = _client()
-    table = ctx.attr("table_name")
-    dim = ctx.attr("emb_dim")
     ids_vals = ctx.ins("Ids")
+    tables, dims = _slot_tables(ctx, len(ids_vals))
     shapes, flats = [], []
     for ids in ids_vals:
         ids_np = np.asarray(ids).astype(np.int64)
@@ -148,17 +147,41 @@ def _distributed_lookup_table(ctx):
     missing = []
     if pre is not None:
         for i, flat in enumerate(flats):
-            rows_list[i] = pre.take(table, flat)
+            rows_list[i] = pre.take(tables[i], flat)
     for i, r in enumerate(rows_list):
         if r is None:
             missing.append(i)
     if missing:
-        pulled = _prefetch.parallel_pull(client, table,
-                                         [flats[i] for i in missing])
+        pulled = _prefetch.parallel_pull_multi(
+            client, [(tables[i], flats[i]) for i in missing])
         for i, rows in zip(missing, pulled):
             rows_list[i] = rows
-    ctx.set_out("Outputs", [rows.reshape(shape + (dim,))
-                            for rows, shape in zip(rows_list, shapes)])
+    # ONE packed host->device transfer for all slots, sliced back on
+    # device: per-slot uploads each pay a full link round-trip on a
+    # remote accelerator, and with n_slots x n_tables arrays that
+    # latency — not the pull RPCs — dominated the PS step
+    import jax
+    import jax.numpy as jnp
+
+    flat_rows = [np.asarray(r).ravel() for r in rows_list]
+    pack = jax.device_put(np.concatenate(flat_rows)) if flat_rows else None
+    outs, off = [], 0
+    for rows, shape, dim in zip(flat_rows, shapes, dims):
+        outs.append(jnp.reshape(pack[off:off + rows.size], shape + (dim,)))
+        off += rows.size
+    ctx.set_out("Outputs", outs)
+
+
+def _slot_tables(ctx, n_slots):
+    """Per-slot (table, dim) lists: the transpiler's cross-table merge
+    writes table_names/emb_dims; unmerged ops keep the scalar attrs."""
+    tables = list(ctx.attr("table_names", []) or [])
+    dims = [int(d) for d in (ctx.attr("emb_dims", []) or [])]
+    if not tables:
+        tables = [ctx.attr("table_name")] * n_slots
+    if not dims:
+        dims = [int(ctx.attr("emb_dim"))] * n_slots
+    return tables, dims
 
 
 @grad_maker("distributed_lookup_table")
@@ -182,26 +205,29 @@ def _distributed_lookup_table_grad(ctx):
     enqueued to its background sparse queue instead of blocking."""
     from ..distributed_ps import prefetch as _prefetch
 
-    table = ctx.attr("table_name")
-    dim = ctx.attr("emb_dim")
     comm = _communicator()
     use_comm = comm is not None and hasattr(comm, "send_sparse")
     client = None if use_comm else _client()
-    pairs = []
-    for ids, g in zip(ctx.ins("Ids"), ctx.ins("Outputs" + GRAD_SUFFIX)):
+    grads = ctx.ins("Outputs" + GRAD_SUFFIX)
+    tables, dims = _slot_tables(ctx, len(grads))
+    jobs = []
+    for ids, g, table, dim in zip(ctx.ins("Ids"), grads, tables, dims):
         ids_np = np.asarray(ids).astype(np.int64).ravel()
-        g_np = np.asarray(g).reshape(ids_np.size, dim)
         if use_comm:
-            comm.send_sparse(table, ids_np, g_np)
+            # async-family: hand the (possibly still in-flight device)
+            # grad straight to the communicator queue — its send thread
+            # materializes it, so the trainer never blocks on the link
+            comm.send_sparse(table, ids_np, g)
         else:
-            pairs.append((ids_np, g_np))
-    if pairs:
+            jobs.append((table, ids_np,
+                         np.asarray(g).reshape(ids_np.size, dim)))
+    if jobs:
         # record updated rows for the async recorder when an async-family
         # mode is active (the communicator's presence IS the async
-        # signal; sync pushes skip recording).  Multi-slot pushes fan
-        # out like the pulls — one RPC round-trip of latency per table.
-        _prefetch.parallel_push(client, table, pairs,
-                                record=_communicator() is not None)
+        # signal; sync pushes skip recording).  All slots of all tables
+        # fan out in ONE round — one device sync, one RPC round-trip.
+        _prefetch.parallel_push_multi(client, jobs,
+                                      record=_communicator() is not None)
 
 
 @_host("recv_save", no_grad=True)

@@ -618,19 +618,26 @@ class AdamOptimizer(Optimizer):
             for name, _, _ in old_layout:
                 if name not in new_names:
                     self._param_state[name] = {
+                        "master": per_param[name][0],
                         "m1": per_param[name][1], "m2": per_param[name][2],
                         "b1p": state["b1p"], "b2p": state["b2p"]}
         masters, m1s, m2s = [], [], []
+        carried_pows = None
         for p, _ in fused:
             n = int(np.prod(p._value.shape) if p._value.shape else 1)
             if p.name in per_param:
                 ms, m1, m2 = per_param[p.name]
             else:
-                ms = jnp.ravel(p._value).astype(jnp.float32)
                 pst = self._param_state.get(p.name, {})
+                # prefer the per-param f32 master (kept by _eager_update
+                # for low-precision params) over re-upcasting bf16
+                ms = (jnp.ravel(pst["master"]) if "master" in pst
+                      else jnp.ravel(p._value).astype(jnp.float32))
                 if "m1" in pst:
                     m1 = jnp.ravel(pst["m1"]).astype(jnp.float32)
                     m2 = jnp.ravel(pst["m2"]).astype(jnp.float32)
+                    if "b1p" in pst:
+                        carried_pows = (pst["b1p"], pst["b2p"])
                     self._param_state.pop(p.name, None)
                 else:
                     m1 = jnp.zeros((n,), jnp.float32)
@@ -641,6 +648,11 @@ class AdamOptimizer(Optimizer):
         state["master"] = jnp.concatenate(masters)
         state["m1"] = jnp.concatenate(m1s)
         state["m2"] = jnp.concatenate(m2s)
+        # per-param -> fresh-buffer migration keeps the beta-pow
+        # schedule (the pow gate guarantees all carried sources agree);
+        # resetting to 1 would restart bias correction mid-run
+        if carried_pows is not None and "b1p" not in state:
+            state["b1p"], state["b2p"] = carried_pows
         state.setdefault("b1p", jnp.ones((1,), jnp.float32))
         state.setdefault("b2p", jnp.ones((1,), jnp.float32))
         self._fused_mp_layout = layout
@@ -741,6 +753,10 @@ class AdamOptimizer(Optimizer):
                 m1s.append(jnp.ravel(st["m1"]))
                 m2s.append(jnp.ravel(st["m2"]))
                 carried_pows = (st["b1p"], st["b2p"])
+                # the buffer owns this param's state now: a stale
+                # per-param entry would make the pow gate evict it on
+                # the NEXT step (code-review r5)
+                self._param_state.pop(p.name, None)
             else:
                 m1s.append(jnp.zeros((n,), jnp.float32))
                 m2s.append(jnp.zeros((n,), jnp.float32))
@@ -760,18 +776,32 @@ class AdamOptimizer(Optimizer):
 
         from .ops.registry import eager_call
 
+        # low-precision-resident params keep the O2 master-weight
+        # contract even on the per-param path (code-review r5): a f32
+        # master lives in the state, moments stay f32, and the bf16
+        # param is the cast of the master after every step
+        low_prec = p._value.dtype in (jnp.bfloat16, jnp.float16)
+        if low_prec and "master" in state:
+            # may arrive flat from a fused-buffer migration stash
+            pv = jnp.reshape(state["master"], jnp.shape(p._value))
+        elif low_prec:
+            pv = p._value.astype(jnp.float32)
+        else:
+            pv = p._value
         if "m1" not in state:
-            state["m1"] = jnp.zeros_like(p._value)
-            state["m2"] = jnp.zeros_like(p._value)
+            state["m1"] = jnp.zeros_like(pv)
+            state["m2"] = jnp.zeros_like(pv)
             state["b1p"] = jnp.ones((1,), jnp.float32)
             state["b2p"] = jnp.ones((1,), jnp.float32)
-        elif jnp.shape(state["m1"]) != jnp.shape(p._value):
+        elif jnp.shape(state["m1"]) != jnp.shape(pv):
             # moments stashed flat by a fused-set migration
-            state["m1"] = jnp.reshape(state["m1"], jnp.shape(p._value))
-            state["m2"] = jnp.reshape(state["m2"], jnp.shape(p._value))
+            state["m1"] = jnp.reshape(state["m1"], jnp.shape(pv))
+            state["m2"] = jnp.reshape(state["m2"], jnp.shape(pv))
+        if low_prec:
+            g = jnp.asarray(g).astype(jnp.float32)
         outs = eager_call(
             self.type,
-            {"Param": [p._value], "Grad": [g], "Moment1": [state["m1"]],
+            {"Param": [pv], "Grad": [g], "Moment1": [state["m1"]],
              "Moment2": [state["m2"]], "Beta1Pow": [state["b1p"]],
              "Beta2Pow": [state["b2p"]], "LearningRate": [lr]},
             {"beta1": self._beta1, "beta2": self._beta2,
@@ -783,7 +813,11 @@ class AdamOptimizer(Optimizer):
             {"ParamOut": 1, "Moment1Out": 1, "Moment2Out": 1,
              "Beta1PowOut": 1, "Beta2PowOut": 1},
         )
-        p._value = outs["ParamOut"][0]
+        if low_prec:
+            state["master"] = outs["ParamOut"][0]
+            p._value = state["master"].astype(p._value.dtype)
+        else:
+            p._value = outs["ParamOut"][0]
         state["m1"] = outs["Moment1Out"][0]
         state["m2"] = outs["Moment2Out"][0]
         state["b1p"] = outs["Beta1PowOut"][0]

@@ -270,12 +270,17 @@ def _with_sparse_prefetch(program, it):
     if program is None:
         yield from it
         return
-    lookups = []  # (table, dim, [ids var names])
+    lookups = []  # (table, ids var name) per slot
     try:
         for op_ in program.global_block().ops:
             if op_.type == "distributed_lookup_table":
-                lookups.append((op_.attrs.get("table_name"),
-                                op_.inputs.get("Ids", [])))
+                ids = op_.inputs.get("Ids", [])
+                # r5 cross-table merge: one op carries per-slot
+                # table_names; a slot submitted under the wrong table
+                # would never be take()n and leak in the prefetcher
+                tables = (op_.attrs.get("table_names")
+                          or [op_.attrs.get("table_name")] * len(ids))
+                lookups.extend(zip(tables, ids))
     except Exception:
         lookups = []
     if not lookups:
@@ -292,12 +297,11 @@ def _with_sparse_prefetch(program, it):
             pre = _ps_runtime.prefetcher()
         except Exception:
             return
-        for table, id_names in lookups:
-            for name in id_names:
-                ids = feed.get(name)
-                if ids is None:
-                    continue
-                pre.submit(table, np.asarray(ids).astype(np.int64).ravel())
+        for table, name in lookups:
+            ids = feed.get(name)
+            if ids is None:
+                continue
+            pre.submit(table, np.asarray(ids).astype(np.int64).ravel())
 
     prev = next(it, None)
     while prev is not None:

@@ -298,63 +298,60 @@ class DistributeTranspiler:
     # ------------------------------------------------------------------
     @staticmethod
     def _merge_lookup_ops(block, op_type):
-        """Merge all ``op_type`` ops with the same (table_name, emb_dim)
-        into one multi-Ids op, so each table costs one host-op device
-        sync and one (thread-fanned) RPC round per step instead of one
-        per slot.  Forward groups merge into the first member's position
-        only if every later member's Ids is a data var or defined before
-        it; grad groups merge into the last member (all grads ready)."""
-        from collections import OrderedDict
-
-        groups = OrderedDict()
-        for i, op_ in enumerate(block.ops):
-            if op_.type == op_type:
-                groups.setdefault(
-                    (op_.attr("table_name"), op_.attr("emb_dim")),
-                    []).append(i)
+        """Merge ALL ``op_type`` ops in the block into ONE multi-Ids op
+        with per-slot table_names/emb_dims attrs, so the whole sparse
+        side costs one host-op device sync and one (thread-fanned) RPC
+        round per step instead of one per slot per table — through a
+        real accelerator link every host op between jit segments is a
+        blocking round-trip, and those dominate the PS step.  The
+        forward merges into the first member's position only if every
+        later member's Ids is a data var or defined before it; the grad
+        merges into the last member (all grads ready)."""
+        idxs = [i for i, op_ in enumerate(block.ops) if op_.type == op_type]
+        if len(idxs) < 2:
+            return
         is_fwd = op_type == "distributed_lookup_table"
         grad_slot = "Outputs@GRAD"
-        to_remove = []
-        for idxs in groups.values():
-            if len(idxs) < 2:
-                continue
-            keep = idxs[0] if is_fwd else idxs[-1]
-            if is_fwd:
-                defined = set()
-                for j in range(keep):
-                    defined.update(block.ops[j].output_arg_names)
-                ok = True
-                for i in idxs[1:]:
-                    for n in block.ops[i].input("Ids"):
-                        v = block._find_var_recursive(n)
-                        if n not in defined and not (
-                                v is not None and getattr(v, "is_data",
-                                                          False)):
-                            ok = False
-                if not ok:
-                    continue
-            keep_op = block.ops[keep]
-            ids = list(keep_op.input("Ids"))
-            outs = (list(keep_op.output("Outputs")) if is_fwd
-                    else list(keep_op.input(grad_slot)))
-            for i in idxs:
-                if i == keep:
-                    continue
-                o = block.ops[i]
-                if is_fwd:
-                    ids.extend(o.input("Ids"))
-                    outs.extend(o.output("Outputs"))
-                else:
-                    ids.extend(o.input("Ids"))
-                    outs.extend(o.input(grad_slot))
-                to_remove.append(i)
-            keep_op.inputs["Ids"] = ids
-            if is_fwd:
-                keep_op.outputs["Outputs"] = outs
-            else:
-                keep_op.inputs[grad_slot] = outs
-        for i in sorted(to_remove, reverse=True):
-            block._remove_op(i)
+        keep = idxs[0] if is_fwd else idxs[-1]
+        if is_fwd:
+            defined = set()
+            for j in range(keep):
+                defined.update(block.ops[j].output_arg_names)
+
+            def _ready(i):
+                for n in block.ops[i].input("Ids"):
+                    v = block._find_var_recursive(n)
+                    if n not in defined and not (
+                            v is not None and getattr(v, "is_data", False)):
+                        return False
+                return True
+
+            # merge only the ops whose Ids exist at the keep position;
+            # an op with later-computed Ids stays standalone instead of
+            # aborting the whole merge
+            idxs = [i for i in idxs if _ready(i)]
+            if len(idxs) < 2 or keep not in idxs:
+                return
+        keep_op = block.ops[keep]
+        ids, outs, tables, dims = [], [], [], []
+        for i in idxs:
+            o = block.ops[i]
+            o_ids = list(o.input("Ids"))
+            ids.extend(o_ids)
+            outs.extend(o.output("Outputs") if is_fwd
+                        else o.input(grad_slot))
+            tables.extend([o.attr("table_name")] * len(o_ids))
+            dims.extend([int(o.attr("emb_dim"))] * len(o_ids))
+        keep_op.inputs["Ids"] = ids
+        if is_fwd:
+            keep_op.outputs["Outputs"] = outs
+        else:
+            keep_op.inputs[grad_slot] = outs
+        keep_op.attrs["table_names"] = tables
+        keep_op.attrs["emb_dims"] = dims
+        for i in sorted(idxs, reverse=True):
+            if i != keep:
+                block._remove_op(i)
 
     def get_trainer_program(self, wait_port=True) -> Program:
         return self.origin_program

@@ -260,3 +260,67 @@ def test_fused_mp_new_param_mid_schedule_stays_per_param():
         ast = opt._param_state["@fused_mp"]
         np.testing.assert_allclose(
             float(np.asarray(ast["b1p"]).ravel()[0]), 0.9 ** 4, rtol=1e-5)
+
+
+def test_f32_fused_migration_keeps_params_fused():
+    """Code-review r5: after per-param -> fused migration, the stale
+    per-param entry must be popped, or the pow gate evicts every
+    carried param on the NEXT step (fused path permanently disabled)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.dygraph.varbase import VarBase
+    from paddle_tpu.utils import flags
+
+    with guard():
+        opt = fluid.optimizer.AdamOptimizer(1e-2, parameter_list=[])
+        rng = np.random.RandomState(5)
+        p = VarBase(jnp.asarray(rng.randn(8).astype(np.float32)))
+        p.name = "f32p"
+        g = jnp.asarray(rng.randn(8).astype(np.float32))
+        # one step per-param (fusion off), then fusion on
+        old = flags._flags.get("FLAGS_fuse_optimizer_dygraph")
+        try:
+            flags._flags["FLAGS_fuse_optimizer_dygraph"] = False
+            opt._dygraph_apply([(p, g)])
+            flags._flags["FLAGS_fuse_optimizer_dygraph"] = True
+            opt._dygraph_apply([(p, g)])   # migrates into @fused
+            assert "m1" not in opt._param_state.get("f32p", {})
+            b1p_1 = float(np.asarray(
+                opt._param_state["@fused"]["b1p"]).ravel()[0])
+            opt._dygraph_apply([(p, g)])   # must STAY fused
+            b1p_2 = float(np.asarray(
+                opt._param_state["@fused"]["b1p"]).ravel()[0])
+            np.testing.assert_allclose(b1p_2, b1p_1 * 0.9, rtol=1e-6)
+            assert "m1" not in opt._param_state.get("f32p", {})
+        finally:
+            flags._flags["FLAGS_fuse_optimizer_dygraph"] = old
+
+
+def test_deferred_low_precision_param_keeps_f32_master():
+    """Code-review r5: a bf16 param on the per-param path (deferred by
+    the pow gate) must still train against a f32 master with f32
+    moments — the O2 contract holds on every path."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.dygraph.varbase import VarBase
+
+    with guard():
+        opt = fluid.optimizer.AdamOptimizer(1e-2, parameter_list=[])
+        rng = np.random.RandomState(6)
+        pa = VarBase(jnp.asarray(rng.randn(8).astype(np.float32))
+                     .astype(jnp.bfloat16))
+        pa.name = "mp_a"
+        ga = jnp.asarray(rng.randn(8).astype(np.float32)).astype(jnp.bfloat16)
+        for _ in range(3):
+            opt._dygraph_apply([(pa, ga)])   # fused_mp buffer advances
+        pb = VarBase(jnp.asarray(rng.randn(4).astype(np.float32))
+                     .astype(jnp.bfloat16))
+        pb.name = "mp_b"
+        gb = jnp.asarray(rng.randn(4).astype(np.float32)).astype(jnp.bfloat16)
+        opt._dygraph_apply([(pa, ga), (pb, gb)])  # b deferred per-param
+        bst = opt._param_state["mp_b"]
+        assert bst["master"].dtype == jnp.float32
+        assert bst["m1"].dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(pb._value),
+            np.asarray(bst["master"].astype(jnp.bfloat16)))

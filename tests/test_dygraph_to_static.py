@@ -409,3 +409,20 @@ def test_for_over_dict_keeps_python_semantics():
     with dygraph.guard():
         out = f(to_variable(np.zeros((1,), np.float32)))
     np.testing.assert_allclose(out.numpy(), [6.0], rtol=1e-6)
+
+
+def test_builtin_casts_and_assert_convert():
+    """reference cast/assert transformer shapes: bool/int/float/len on
+    tensors lower to cast ops; assert on a tensor lowers to Assert."""
+    @declarative
+    def f(x):
+        n = float(fluid.layers.reduce_sum(x))   # tensor -> f32 cast var
+        m = int(n)                              # tensor -> i64 cast var
+        assert n > -1000.0                      # tensor assert
+        k = len([1, 2, 3])                      # python len untouched
+        return fluid.layers.cast(m, "float32") + k
+
+    with dygraph.guard():
+        out = f(to_variable(np.full((3,), 1.4, np.float32)))
+    # sum=4.2 -> int 4 -> +3
+    np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-6)

@@ -522,3 +522,21 @@ def test_dynamic_lstmp_peepholes():
     assert np.abs(c_peep - c_plain).max() > 1e-4  # peepholes change the math
     c_clip = run(True, cell_clip=0.05)
     assert np.abs(c_clip).max() <= 0.05 + 1e-6
+
+
+def test_einsum_layer_matches_numpy():
+    """layers.einsum (r5): general contraction, fwd + vjp-replay grad."""
+    def build():
+        a = L.data("ea", [4, 6])
+        b = L.data("eb", [6, 3])
+        a.stop_gradient = False
+        out = L.einsum("bij,bjk->bik", a, b)
+        return L.reduce_sum(out)
+
+    rng = np.random.RandomState(2)
+    av = rng.rand(2, 4, 6).astype(np.float32)
+    bv = rng.rand(2, 6, 3).astype(np.float32)
+    r = run_prog(build, {"ea": av, "eb": bv})
+    np.testing.assert_allclose(
+        np.asarray(r[0]).ravel()[0],
+        np.einsum("bij,bjk->bik", av, bv).sum(), rtol=1e-5)

@@ -1327,6 +1327,7 @@ COVERED_ELSEWHERE.update({
     "push_sparse": "test_compat_ops", "push_sparse_v2": "test_compat_ops",
     # r5 py_func op form — tests/test_py_func.py
     "py_func_grad": "test_py_func",
+    "einsum": "test_layers_tail",
 })
 COVERED_ELSEWHERE.update({
     # r4 long-tail corpus — tests/test_long_tail_ops.py (NumPy oracles)

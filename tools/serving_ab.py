@@ -66,8 +66,12 @@ def export_encoder(model_dir, seq, hidden=256, heads=4, layers=2):
 def run_one(model_dir, seq, batch, steps, with_mha_pass):
     from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
 
+    import paddle_tpu as pt
+
     config = AnalysisConfig(model_dir)
     config.switch_use_feed_fetch_ops(False)
+    if pt.is_compiled_with_tpu():
+        config.enable_tpu()
     if not with_mha_pass:
         config.pass_builder().delete_pass("fuse_multihead_attention_pass")
     pred = create_paddle_predictor(config)
