@@ -25,12 +25,12 @@ DygraphToStaticAst.transfer_from_node_type:
 6. if/while/boolop -> convert_ifelse / convert_while_loop /
    convert_logical_* (ifelse/loop/logical transformers).
 
-Documented cut (matches layers/control_flow.py): the reference's
-list_transformer turns list-append-in-loop into a growing
-LoDTensorArray; XLA control flow needs fixed shapes, so list appends
-work in PYTHON-unrolled loops (they stay plain lists) while
-tensor-bound loops should use while_loop carries or the rnn /
-dynamic_decode layers.
+List machinery (list_transformer.py): ``a.append``/``a.pop``/``a[i]``
+dispatch through convert_list_*; a python list crossing tensor control
+flow becomes a LoDTensorArray, and the enclosing while/cond op runs as
+a HOST loop driving device kernels (ops/control_ops.py) — the
+reference While op's own architecture — because dynamic-length arrays
+can't be fixed-shape lax carries.
 """
 from __future__ import annotations
 
@@ -335,6 +335,138 @@ class _PrintTransformer(ast.NodeTransformer):
         return node
 
 
+class _ListTransformer(ast.NodeTransformer):
+    """reference: list_transformer.py — list mutations dispatch through
+    convert_list_* so a list crossing tensor control flow becomes a
+    LoDTensorArray (runtime dispatch instead of the reference's static
+    NodeVarType analysis; convert_operators._list_to_tensor_array):
+
+    - ``a.append(x)``  (statement) -> ``a = _jst.convert_list_append(a, x)``
+      (the rebind makes ``a`` a store name, so loop/branch analysis
+      carries it)
+    - ``a.pop(i)``     (any expr)  -> ``_jst.convert_list_pop(a, i)``
+    - ``a[i]`` / ``a[i] = x`` for names that receive list mutations
+      somewhere in the function -> convert_index / convert_list_setitem
+    """
+
+    def __init__(self, local_names=()):
+        self.list_names: Set[str] = set()
+        # names assignable inside the function: rewriting append to a
+        # rebind (`a = convert_list_append(a, x)`) on a closure/global
+        # name would make it function-local -> UnboundLocalError; those
+        # keep mutation-only form
+        self.local_names: Set[str] = set(local_names)
+
+    def collect(self, tree):
+        names = self.list_names
+
+        class V(ast.NodeVisitor):
+            def visit_Call(self, n):
+                if (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("append", "pop")
+                        and isinstance(n.func.value, ast.Name)):
+                    names.add(n.func.value.id)
+                self.generic_visit(n)
+
+            def visit_Assign(self, n):
+                # a[0] = x with an int-literal index is a list write;
+                # string/var keys are more likely dict usage — leave
+                # those to plain python
+                if (len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Subscript)
+                        and isinstance(n.targets[0].value, ast.Name)):
+                    sl = n.targets[0].slice
+                    if isinstance(sl, ast.Index):
+                        sl = sl.value
+                    if (isinstance(sl, ast.Constant)
+                            and isinstance(sl.value, int)):
+                        names.add(n.targets[0].value.id)
+                self.generic_visit(n)
+
+        V().visit(tree)
+        return self
+
+    @staticmethod
+    def _jst_call(attr, args):
+        return ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                               attr=attr, ctx=ast.Load()),
+            args=args, keywords=[])
+
+    def visit_Expr(self, node: ast.Expr):
+        self.generic_visit(node)
+        v = node.value
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "append"
+                and isinstance(v.func.value, ast.Name)
+                and len(v.args) == 1 and not v.keywords):
+            tgt = v.func.value.id
+            call = self._jst_call(
+                "convert_list_append",
+                [ast.Name(id=tgt, ctx=ast.Load()), v.args[0]])
+            if tgt in self.local_names:
+                new = ast.Assign(
+                    targets=[ast.Name(id=tgt, ctx=ast.Store())], value=call)
+            else:  # closure/global list: mutation-only, no rebind
+                new = ast.Expr(value=call)
+            ast.copy_location(new, node)
+            ast.fix_missing_locations(new)
+            return new
+        return node
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "pop"
+                and isinstance(node.func.value, ast.Name)
+                and len(node.args) <= 1 and not node.keywords):
+            new = self._jst_call(
+                "convert_list_pop",
+                [ast.Name(id=node.func.value.id, ctx=ast.Load())]
+                + list(node.args))
+            ast.copy_location(new, node)
+            ast.fix_missing_locations(new)
+            return new
+        return node
+
+    def visit_Subscript(self, node: ast.Subscript):
+        self.generic_visit(node)
+        sl = node.slice
+        if isinstance(sl, ast.Index):  # py<3.9 compat shape
+            sl = sl.value
+        if (isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.list_names
+                and not isinstance(sl, ast.Slice)):
+            new = self._jst_call(
+                "convert_index",
+                [ast.Name(id=node.value.id, ctx=ast.Load()), sl])
+            ast.copy_location(new, node)
+            ast.fix_missing_locations(new)
+            return new
+        return node
+
+    def visit_Assign(self, node: ast.Assign):
+        self.generic_visit(node)
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)):
+            t = node.targets[0]
+            sl = t.slice
+            if isinstance(sl, ast.Index):
+                sl = sl.value
+            if (isinstance(t.value, ast.Name)
+                    and t.value.id in self.list_names
+                    and not isinstance(sl, ast.Slice)):
+                new = ast.Expr(value=self._jst_call(
+                    "convert_list_setitem",
+                    [ast.Name(id=t.value.id, ctx=ast.Load()), sl,
+                     node.value]))
+                ast.copy_location(new, node)
+                ast.fix_missing_locations(new)
+                return new
+        return node
+
+
 class _CallAndAssertTransformer(ast.NodeTransformer):
     """reference: cast_transformer.py + len handling in call_transformer
     + assert_transformer — builtin len/bool/int/float calls and assert
@@ -357,10 +489,16 @@ class _CallAndAssertTransformer(ast.NodeTransformer):
 
     def visit_Assert(self, node: ast.Assert):
         self.generic_visit(node)
+        # the message rides in a lambda so it is only evaluated on
+        # failure, matching plain `assert` semantics (an eager msg like
+        # `repr(rows[0])` may itself raise when the assert passes)
+        msg_args = []
+        if node.msg:
+            msg_args = [ast.Lambda(args=_no_args(), body=node.msg)]
         call = ast.Expr(value=ast.Call(
             func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
                                attr="convert_assert", ctx=ast.Load()),
-            args=[node.test] + ([node.msg] if node.msg else []),
+            args=[node.test] + msg_args,
             keywords=[]))
         ast.copy_location(call, node)
         ast.fix_missing_locations(call)
@@ -371,6 +509,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self._counter = 0
         self._fn_assigned: Set[str] = set()
+        self._list_names: Set[str] = set()
 
     def _uid(self):
         self._counter += 1
@@ -382,8 +521,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if _has_return(node.body) or _has_return(node.orelse):
             return node  # early-return branches stay python-level
         uid = self._uid()
+        # list-mutated names READ in a branch (pop / a[i]=x are calls,
+        # not stores) must also be targets: the pre-if conversion below
+        # turns them into TensorArrays so only the taken branch's
+        # mutation ops execute
+        branch_loads: Set[str] = set()
+        for st in list(node.body) + list(node.orelse or []):
+            branch_loads.update(_load_names(st))
         targets = sorted(n for n in (set(_store_names(node.body)) |
-                                     set(_store_names(node.orelse)))
+                                     set(_store_names(node.orelse)) |
+                                     (branch_loads & self._list_names
+                                      & self._fn_assigned))
                          if not n.startswith("__d2s_"))
         if not targets:
             targets = ["__d2s_dummy__"]
@@ -392,6 +540,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             node.orelse = (node.orelse or []) + [
                 ast.parse("__d2s_dummy__ = 0").body[0]]
         ret = ast.parse(f"return ({', '.join(targets)},)").body[0]
+        # names with list mutations anywhere in the function: under a
+        # tensor predicate BOTH branch bodies trace, so a python list
+        # would collect both branches' appends — convert it to a
+        # LoDTensorArray first (reference list_transformer's static
+        # replacement, done at the if boundary here)
+        list_conv = []
+        for t in sorted(set(targets) & self._list_names):
+            list_conv.append(ast.parse(
+                f"try:\n    {t} = {_JST}.maybe_to_tensor_array("
+                f"{t}, __d2s_pred_{uid})\n"
+                f"except NameError:\n    pass").body[0])
         # capture current bindings as default args so branch bodies that
         # read-then-write a name see the pre-if value (a bare closure
         # read would hit UnboundLocalError once the name is assigned)
@@ -418,7 +577,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         pred_assign = ast.Assign(
             targets=[ast.Name(id=f"__d2s_pred_{uid}", ctx=ast.Store())],
             value=node.test)
-        out = [pred_assign] + captures + [true_fn, false_fn, assign]
+        out = [pred_assign] + list_conv + captures + [true_fn, false_fn,
+                                                     assign]
         for n in out:
             ast.copy_location(n, node)
             ast.fix_missing_locations(n)
@@ -527,6 +687,10 @@ class DygraphToStaticAst:
         # pass order matters (module docstring): for->while first so
         # return/break/continue rewrites see a uniform while world, then
         # print, then the convert_* dispatch rewrite
+        fn_locals = set(_store_names(fdef.body)) | {
+            a.arg for a in fdef.args.args}
+        lt = _ListTransformer(fn_locals).collect(tree)
+        lt.visit(tree)
         _ForToWhileTransformer().visit(tree)
         _ReturnTransformer().transform(fdef)
         _BreakContinueTransformer().visit(tree)
@@ -536,6 +700,7 @@ class DygraphToStaticAst:
         tr = _ControlFlowTransformer()
         tr._fn_assigned = set(_store_names(fdef.body)) | {
             a.arg for a in fdef.args.args}
+        tr._list_names = set(lt.list_names)
         new_tree = tr.visit(tree)
         ast.fix_missing_locations(new_tree)
         return new_tree, fdef.name

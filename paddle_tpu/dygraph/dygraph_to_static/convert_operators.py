@@ -85,7 +85,8 @@ def convert_while_loop(cond_fn, body_fn, loop_vars):
     if _is_tensor(test):
         from ... import layers
 
-        loop_vars = [_materialize(v) for v in loop_vars]
+        loop_vars = [_list_to_tensor_array(v) if isinstance(v, list)
+                     else _materialize(v) for v in loop_vars]
 
         def cond_wrap(*vs):
             return _to_bool_pred(cond_fn(*vs))
@@ -155,11 +156,92 @@ def _logical(x, y, op_type):
     return out
 
 
+def _is_tensor_array(x) -> bool:
+    from ...framework.dtype import VarType
+
+    return (isinstance(x, Variable)
+            and x.type == VarType.LOD_TENSOR_ARRAY)
+
+
+def _list_to_tensor_array(lst):
+    """A python list crossing into tensor control flow becomes a
+    LoDTensorArray var (the reference ListTransformer's
+    replace_list_with_tensor_array, done at runtime dispatch instead of
+    by static NodeVarType analysis).  Elements materialize to tensors;
+    non-tensor-able lists (strings, objects) stay python and keep plain
+    semantics outside the traced region."""
+    from ... import layers
+
+    elems = [_materialize(e) for e in lst]
+    if any(not isinstance(e, Variable) for e in elems):
+        return lst
+    dtype = elems[0].dtype if elems else "float32"
+    return layers.create_array(dtype, initialized_list=elems or None)
+
+
+def convert_list_append(l, x):
+    """a.append(x): array_write at the current length for TensorArray
+    vars; plain append otherwise.  Returns the (re)bound list."""
+    if _is_tensor_array(l):
+        from ... import layers
+
+        layers.array_write(_materialize(x), layers.array_length(l), l)
+        return l
+    l.append(x)
+    return l
+
+
+def convert_list_pop(l, idx=None):
+    """a.pop([idx]) — TensorArray vars pop through the in-place host op
+    (reference: list_transformer.py convert_list_pop).  Non-list
+    containers keep plain semantics: sets/dicts pop with the original
+    argument count."""
+    if _is_tensor_array(l):
+        from ... import layers
+
+        i = -1 if idx is None else idx
+        if isinstance(i, Variable):
+            raise TypeError(
+                "pop() index on a converted tensor list must be a python "
+                "int (the reference asserts the same: list_transformer.py "
+                "tensor_array_pop)")
+        return layers.array_pop(l, int(i))
+    return l.pop() if idx is None else l.pop(idx)
+
+
+def convert_list_setitem(l, i, x):
+    """a[i] = x — array_write at i for TensorArray vars."""
+    if _is_tensor_array(l):
+        from ... import layers
+
+        if isinstance(i, int) and i < 0:
+            i = layers.array_length(l) + i
+        layers.array_write(_materialize(x), _materialize(i), l)
+        return l
+    l[i] = x
+    return l
+
+
+def maybe_to_tensor_array(v, pred):
+    """Emitted before a converted `if` for names that receive list
+    mutations somewhere in the function: under a TENSOR predicate both
+    branch bodies are traced, so a python list would see both branches'
+    appends — convert it first so each branch traces array ops into its
+    own sub-block and only the taken one executes."""
+    if isinstance(v, list) and _is_tensor(pred):
+        return _list_to_tensor_array(v)
+    return v
+
+
 def convert_len(x):
     if isinstance(x, _RangeProxy):
         return x._symbolic_len() if x.has_tensor else len(x)
     if isinstance(x, _EnumProxy):
         return convert_len(x.inner)
+    if _is_tensor_array(x):
+        from ... import layers
+
+        return layers.array_length(x)
     if _is_tensor(x):
         if x.shape and x.shape[0] >= 0:
             return x.shape[0]
@@ -248,6 +330,12 @@ def convert_index(it, i):
         return it.index(i)
     if isinstance(it, range):
         return it[int(i)]
+    if _is_tensor_array(it):
+        from ... import layers
+
+        if isinstance(i, int) and i < 0:
+            i = layers.array_length(it) + i
+        return layers.array_read(it, _materialize(i))
     if _is_tensor(it):
         from ... import layers
 
@@ -259,7 +347,19 @@ def convert_index(it, i):
             row = layers.slice(it, axes=[0], starts=[i], ends=[i + 1])
         shp = list(it.shape[1:])
         return layers.reshape(row, shp) if shp else layers.reshape(row, [1])
-    return it[int(i)]
+    try:
+        return it[i]  # plain container with a plain key (dict lookups...)
+    except TypeError:
+        # np scalar / VarBase loop counter indexing a python sequence;
+        # non-numeric keys re-raise the original error (a swallowed
+        # KeyError would surface as a confusing int() failure)
+        if hasattr(i, "__int__"):
+            return it[int(i)]
+        if hasattr(i, "numpy"):
+            import numpy as _np
+
+            return it[int(_np.asarray(i.numpy()).ravel()[0])]
+        raise
 
 
 def convert_bool(x):
@@ -294,7 +394,9 @@ def convert_assert(test, msg=None):
         from ... import layers
 
         return layers.Assert(_to_bool_pred(test))
-    assert test, msg if msg is not None else "assertion failed"
+    if not test:
+        m = msg() if callable(msg) else msg
+        raise AssertionError(m if m is not None else "assertion failed")
 
 
 def convert_print(*args, **kwargs):

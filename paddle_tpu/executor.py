@@ -94,7 +94,7 @@ def analyze_state(ops, block, feed_names, scope, skip_suffixes=()):
         d = registry.OPS.get(op_.type)
         if d is not None and d.stateful:
             uses_rng = True
-        if d is not None and d.host:
+        if registry.op_contains_host(op_):
             has_host_ops = True
         for name in op_.input_arg_names:
             if (name not in written and name not in feed_names
@@ -254,8 +254,7 @@ class Executor:
             segments: List[tuple] = []
             cur: List = []
             for op_ in ops:
-                d = registry.OPS.get(op_.type)
-                if d is not None and d.host:
+                if registry.op_contains_host(op_):
                     if cur:
                         segments.append(("jit", cur))
                         cur = []

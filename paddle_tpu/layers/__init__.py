@@ -39,6 +39,7 @@ from .control_flow import (  # noqa: F401
     Switch,
     While,
     array_length,
+    array_pop,
     array_read,
     array_write,
     case,

@@ -5,10 +5,13 @@ cond:2150, case, switch_case, increment, less_than...).  The sub-blocks
 are real Blocks in the Program (serializable, transpiler-visible); the
 ops lower to lax.cond/lax.while_loop (ops/control_ops.py).
 
-Known scope cut (documented): LoDTensorArray-based dynamic RNN
-(array_write/array_read + While) needs dynamic-length arrays that XLA
-cannot express; use while_loop with fixed-shape carries or lax.scan-style
-rnn layers instead.
+LoDTensorArray inside While/cond bodies: dynamic-length arrays can't be
+fixed-shape lax carries, so an enclosing while/cond whose blocks hold
+array ops runs as a HOST loop driving device kernels
+(ops/control_ops.py _blocks_contain_host) — the reference While op's
+own architecture.  Fixed-shape recurrence should still prefer
+while_loop tensor carries or the lax.scan-style rnn layers, which stay
+fully compiled.
 """
 from __future__ import annotations
 
@@ -167,11 +170,33 @@ def cond(pred: Variable, true_fn: Callable = None, false_fn: Callable = None,
             f"true_fn returns {len(t_out)} outputs, false_fn {len(f_out)} — "
             f"branches must match")
     t_out, f_out = _align_branch_outputs(prog, tb, fb, t_out, f_out)
+    # opaque python objects (dicts, sets...) a branch mutated but did
+    # not rebind come back as the SAME object from both branches: pass
+    # them through by identity instead of forcing a tensor slot (their
+    # host-side mutation already happened while tracing — plain-python
+    # semantics, matching the d2s dispatch fallback)
+    merged: List = [None] * len(t_out)
+    var_idx: List[int] = []
+    for i, (tv, fv) in enumerate(zip(t_out, f_out)):
+        if (not isinstance(tv, Variable) and not isinstance(fv, Variable)
+                and tv is fv):
+            merged[i] = tv
+        elif isinstance(tv, Variable) and isinstance(fv, Variable):
+            var_idx.append(i)
+        else:
+            raise ValueError(
+                f"cond output {i}: branches return incompatible kinds "
+                f"({type(tv).__name__} vs {type(fv).__name__}) — bind a "
+                "tensor in both branches or the same python object")
+    t_out = [t_out[i] for i in var_idx]
+    f_out = [f_out[i] for i in var_idx]
     outs = []
     for tv in t_out:
-        outs.append(parent.create_var(
+        ov = parent.create_var(
             name=helper.name + f"_out_{len(outs)}",
-            shape=tv.shape, dtype=tv.dtype))
+            shape=tv.shape, dtype=tv.dtype)
+        ov.type = tv.type  # TensorArray outputs stay array-typed
+        outs.append(ov)
     free = _free_vars([tb, fb], parent)
     # a branch may RETURN an outer var it never touched (a capture
     # default for a name only the other branch assigns): such names
@@ -194,9 +219,11 @@ def cond(pred: Variable, true_fn: Callable = None, false_fn: Callable = None,
             "input_names": free,
         },
     )
-    if not outs:
+    for ov, i in zip(outs, var_idx):
+        merged[i] = ov
+    if not merged:
         return None
-    return outs[0] if len(outs) == 1 else outs
+    return merged[0] if len(merged) == 1 else merged
 
 
 def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
@@ -224,9 +251,12 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
         # retry (convert_operators.convert_while_loop does)
         raise CarryInitMismatch(mism)
 
-    outs = [parent.create_var(name=helper.name + f"_out_{i}",
-                              shape=v.shape, dtype=v.dtype)
-            for i, v in enumerate(loop_vars)]
+    outs = []
+    for i, v in enumerate(loop_vars):
+        ov = parent.create_var(name=helper.name + f"_out_{i}",
+                               shape=v.shape, dtype=v.dtype)
+        ov.type = v.type  # TensorArray carries stay array-typed
+        outs.append(ov)
     carry_names = [v.name for v in loop_vars]
     free = [n for n in _free_vars([cb, bb], parent) if n not in carry_names]
     parent.append_op(
@@ -385,6 +415,17 @@ def array_length(array):
     out = helper.create_variable_for_type_inference(VarType.INT64)
     helper.append_op("lod_array_length", inputs={"X": [array]},
                      outputs={"Out": [out]})
+    return out
+
+
+def array_pop(array, index=-1):
+    """Pop element ``index`` (static python int) off a LoDTensorArray,
+    mutating it in place; used by dygraph_to_static list conversion
+    (reference: dygraph_to_static/list_transformer.py convert_list_pop)."""
+    helper = LayerHelper("array_pop")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("tensor_array_pop", inputs={"X": [array]},
+                     outputs={"Out": [out]}, attrs={"index": int(index)})
     return out
 
 

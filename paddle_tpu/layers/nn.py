@@ -963,6 +963,13 @@ def cast(x, dtype):
 
 def concat(input, axis=0, name=None):
     helper = LayerHelper("concat", name=name)
+    if (isinstance(input, Variable)
+            and input.type == VarType.LOD_TENSOR_ARRAY):
+        # concat over a LoDTensorArray (reference concat accepts one):
+        # lower through tensor_array_to_tensor
+        from .control_flow import tensor_array_to_tensor
+
+        return tensor_array_to_tensor(input, axis=axis, name=name)[0]
     xs = input if isinstance(input, (list, tuple)) else [input]
     out = helper.create_variable_for_type_inference(xs[0].dtype)
     helper.append_op("concat", inputs={"X": xs}, outputs={"Out": [out]},

@@ -40,6 +40,18 @@ def _outer_env(ctx):
     return dict(zip(names, vals))
 
 
+def _blocks_contain_host(blks) -> bool:
+    from .registry import op_contains_host
+
+    return any(op_contains_host(o) for b in blks for o in b.ops)
+
+
+def _concrete_bool(v) -> bool:
+    import numpy as _np
+
+    return bool(_np.asarray(v).ravel()[0])
+
+
 @op("cond")
 def _cond(ctx):
     """layers.cond: two sub-blocks, same output structure."""
@@ -49,6 +61,18 @@ def _cond(ctx):
     t_outs = ctx.attr("true_out_names", [])
     f_outs = ctx.attr("false_out_names", [])
     base_env = _outer_env(ctx)
+
+    if _blocks_contain_host([tb, fb]):
+        # host branch select (reference conditional_block_op.cc: inner
+        # Executor runs only the taken block): required when a branch
+        # holds host state ops (TensorArray writes) that lax.cond can't
+        # trace.  The executor routes this op to the host segment, so
+        # pred is concrete here.
+        blk, outs_names = (tb, t_outs) if _concrete_bool(pred) else (fb, f_outs)
+        local = dict(base_env)
+        _run_block(blk, local)
+        ctx.set_out("Out", [local[n] for n in outs_names])
+        return
 
     def true_fn():
         local = dict(base_env)
@@ -92,6 +116,25 @@ def _while_loop(ctx):
     carry_vals = ctx.ins("X")
     init = tuple(carry_vals)
 
+    if _blocks_contain_host([cb, bb]):
+        # Host loop driving device kernels — the reference While
+        # architecture (while_op.cc: Executor per iteration).  Needed
+        # for dynamic-length TensorArray carries (d2s list appends),
+        # which mutate by object identity across iterations.
+        local = dict(base_env)
+        local.update(zip(carry_names, carry_vals))
+        while True:
+            e = dict(local)
+            _run_block(cb, e)
+            if not _concrete_bool(e[cond_out]):
+                break
+            e = dict(local)
+            _run_block(bb, e)
+            local.update(
+                {cn: e[bn] for cn, bn in zip(carry_names, body_out_names)})
+        ctx.set_out("Out", [local[n] for n in carry_names])
+        return
+
     def cond_fun(carry):
         local = dict(base_env)
         local.update(zip(carry_names, carry))
@@ -130,6 +173,20 @@ def _while(ctx):
 
     init = (ctx.in_("Cond"),) + tuple(ctx.ins("X"))
 
+    if _blocks_contain_host([bb]):
+        # host loop (see while_loop above); the block updates cond itself
+        local = dict(base_env)
+        local[cond_name] = ctx.in_("Cond")
+        local.update(zip(carry_names, ctx.ins("X")))
+        while _concrete_bool(local[cond_name]):
+            e = dict(local)
+            _run_block(bb, e)
+            local[cond_name] = e[cond_name]
+            local.update({n: e[n] for n in carry_names})
+        ctx.set_out("CondOut", local[cond_name])
+        ctx.set_out("XOut", [local[n] for n in carry_names])
+        return
+
     def cond_fun(carry):
         return jnp.reshape(carry[0], ()).astype(bool)
 
@@ -166,9 +223,10 @@ def _select_input(ctx):
 # tensor_array_read_write_op.cc, tensor_array_to_tensor_op.cc).
 # TPU-native scope: arrays are host-side python lists in the executor env
 # (the executor's hybrid segmentation runs these between jit segments),
-# which covers the linear create->write->read/stack usage; inside a While
-# body XLA needs fixed shapes — use while_loop carries or the rnn/
-# dynamic_decode layers there (documented cut, layers/control_flow.py).
+# which covers linear create->write->read/stack usage; inside a While /
+# cond body the enclosing op falls back to a HOST loop (see
+# _blocks_contain_host above) so dynamic-length arrays work there too —
+# the reference While op's architecture (inner Executor per iteration).
 # --------------------------------------------------------------------------
 class TensorArrayValue(list):
     """Marker type for LOD_TENSOR_ARRAY values living in the env."""
@@ -231,3 +289,16 @@ def _tensor_array_to_tensor(ctx):
     ctx.set_out("Out", out)
     ctx.set_out("OutIndex", jnp.asarray(
         [jnp.shape(v)[axis] for v in vals], jnp.int32))
+
+
+@op("tensor_array_pop", no_grad=True, host=True)
+def _tensor_array_pop(ctx):
+    """In-place pop returning the removed element.  The reference's
+    dygraph_to_static composes this from slice + while
+    (list_transformer.py tensor_array_pop); with host-resident arrays
+    one op keeps it O(1) and the mutation visible by object identity."""
+    arr = ctx.env.get(ctx.op.inputs["X"][0])
+    if not isinstance(arr, (list, TensorArrayValue)) or not arr:
+        raise IndexError("tensor_array_pop: empty or missing array")
+    idx = int(ctx.attr("index", -1))
+    ctx.set_out("Out", arr.pop(idx))

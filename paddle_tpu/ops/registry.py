@@ -79,6 +79,31 @@ def is_host_op(type: str) -> bool:
     return bool(d is not None and d.host)
 
 
+def op_contains_host(op_) -> bool:
+    """True when the op is host-only OR any sub-block it holds (cond /
+    while bodies) contains a host op, transitively.  Control flow over
+    host state (LoDTensorArray writes, RPC) must execute as a host loop
+    driving device kernels — the reference While op's architecture
+    (controlflow/while_op.cc: inner Executor per iteration) — because
+    lax.while_loop/lax.cond need fixed-shape, device-resident carries."""
+    if is_host_op(op_.type):
+        return True
+    from ..framework.core import Block
+
+    for k, v in op_.attrs.items():
+        blk = None
+        if isinstance(v, Block):
+            blk = v
+        elif isinstance(v, int) and k.endswith("block"):
+            try:
+                blk = op_.block.program.blocks[v]
+            except Exception:
+                blk = None
+        if blk is not None and any(op_contains_host(sub) for sub in blk.ops):
+            return True
+    return False
+
+
 def grad_maker(type: str):
     """Decorator registering a custom grad-desc maker for ``type``."""
 
@@ -167,7 +192,11 @@ class LowerCtx:
 
     def set_out(self, slot: str, *vals):
         names = self.op.outputs.get(slot, [])
-        if len(vals) == 1 and isinstance(vals[0], (list, tuple)):
+        # exact-type check: list/tuple SUBCLASSES (TensorArrayValue,
+        # RankTableValue markers) are single host values, not a splat
+        # across the slot's var names — an empty marker would otherwise
+        # bind nothing
+        if len(vals) == 1 and type(vals[0]) in (list, tuple):
             vals = tuple(vals[0])
         for n, v in zip(names, vals):
             if n != EMPTY_VAR_NAME:
@@ -223,7 +252,7 @@ class _ReplayCtx:
         return ["_"] * self._out_arity.get(slot, 1)
 
     def set_out(self, slot, *vals):
-        if len(vals) == 1 and isinstance(vals[0], (list, tuple)):
+        if len(vals) == 1 and type(vals[0]) in (list, tuple):
             vals = tuple(vals[0])
         self.outs[slot] = list(vals)
 

@@ -426,3 +426,219 @@ def test_builtin_casts_and_assert_convert():
         out = f(to_variable(np.full((3,), 1.4, np.float32)))
     # sum=4.2 -> int 4 -> +3
     np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# list -> LoDTensorArray conversion
+# (reference: dygraph_to_static/test_list.py — append/pop in plain code,
+#  in tensor-pred if, in tensor-bound while/for; list_transformer.py)
+# ---------------------------------------------------------------------------
+def test_list_append_without_control_flow():
+    @declarative
+    def f(x):
+        a = []
+        a.append(x)
+        a.append(x * 2.0)
+        return a[0] + a[1]
+
+    with dygraph.guard():
+        out = f(to_variable(np.full((2, 2), 1.5, np.float32)))
+    np.testing.assert_allclose(out.numpy(), np.full((2, 2), 4.5), rtol=1e-6)
+
+
+def test_list_append_in_tensor_if():
+    @declarative
+    def f(x):
+        a = []
+        if fluid.layers.reduce_mean(x) > 0.0:
+            a.append(x)
+        else:
+            a.append(x - 10.0)
+        return a[0]
+
+    with dygraph.guard():
+        pos = f(to_variable(np.full((2,), 3.0, np.float32)))
+        neg = f(to_variable(np.full((2,), -3.0, np.float32)))
+    np.testing.assert_allclose(pos.numpy(), [3.0, 3.0], rtol=1e-6)
+    np.testing.assert_allclose(neg.numpy(), [-13.0, -13.0], rtol=1e-6)
+
+
+def test_list_append_in_tensor_while():
+    @declarative
+    def f(x, n):
+        a = []
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        while i < n:
+            a.append(x + fluid.layers.cast(i, "float32"))
+            i = i + 1
+        return fluid.layers.concat(a, axis=0)
+
+    with dygraph.guard():
+        x = to_variable(np.zeros((1, 3), np.float32))
+        n = to_variable(np.asarray([4], np.int64))
+        out = f(x, n)
+    expect = np.repeat(np.arange(4, dtype=np.float32)[:, None], 3, axis=1)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-6)
+
+
+def test_list_append_in_tensor_for_with_stack():
+    @declarative
+    def f(x, n):
+        a = []
+        for i in range(n):
+            a.append(x * fluid.layers.cast(i, "float32"))
+        z = a[-1]
+        return fluid.layers.concat(a, axis=0) + z * 0.0
+
+    with dygraph.guard():
+        x = to_variable(np.ones((1, 2), np.float32))
+        n = to_variable(np.asarray([3], np.int64))
+        out = f(x, n)
+    expect = np.repeat(np.arange(3, dtype=np.float32)[:, None], 2, axis=1)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-6)
+
+
+def test_list_pop_in_tensor_if():
+    @declarative
+    def f(x):
+        a = []
+        if fluid.layers.reduce_mean(x) > 0.0:
+            a.append(x)
+            a.append(x + 1.0)
+        else:
+            a.append(x - 1.0)
+            a.append(x - 2.0)
+        item = a.pop(1)
+        return item + a[0] * 0.0
+
+    with dygraph.guard():
+        pos = f(to_variable(np.full((2,), 1.0, np.float32)))
+        neg = f(to_variable(np.full((2,), -1.0, np.float32)))
+    np.testing.assert_allclose(pos.numpy(), [2.0, 2.0], rtol=1e-6)
+    np.testing.assert_allclose(neg.numpy(), [-3.0, -3.0], rtol=1e-6)
+
+
+def test_list_pop_in_tensor_while():
+    @declarative
+    def f(x, n):
+        a = []
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        while i < n:
+            a.append(x + fluid.layers.cast(i, "float32"))
+            i = i + 1
+            if i > 2:
+                a.pop()
+        return fluid.layers.concat(a, axis=0)
+
+    with dygraph.guard():
+        x = to_variable(np.zeros((1, 2), np.float32))
+        n = to_variable(np.asarray([4], np.int64))
+        out = f(x, n)
+    # appends 0,1,2,3 but pops after i=3 and i=4 -> [0, 1] remain
+    expect = np.repeat(np.arange(2, dtype=np.float32)[:, None], 2, axis=1)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-6)
+
+
+def test_list_setitem_after_tensor_loop():
+    @declarative
+    def f(x, n):
+        a = []
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        while i < n:
+            a.append(x)
+            i = i + 1
+        a[0] = x + 100.0
+        return fluid.layers.concat(a, axis=0)
+
+    with dygraph.guard():
+        x = to_variable(np.ones((1, 2), np.float32))
+        n = to_variable(np.asarray([2], np.int64))
+        out = f(x, n)
+    np.testing.assert_allclose(
+        out.numpy(), np.asarray([[101.0, 101.0], [1.0, 1.0]]), rtol=1e-6)
+
+
+def test_list_stays_python_in_unrolled_loop():
+    @declarative
+    def f(x, iter_num):
+        a = []
+        for i in range(iter_num):  # python int bound: unrolled
+            a.append(x + float(i))
+        return a[1]
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((2,), np.float32)), 3)
+    np.testing.assert_allclose(out.numpy(), [1.0, 1.0], rtol=1e-6)
+
+
+def test_dict_ops_keep_python_semantics():
+    @declarative
+    def f(x):
+        d = {"a": 1.0, "b": 2.0}
+        d.pop("b")
+        return x + d["a"]
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((2,), np.float32)))
+    np.testing.assert_allclose(out.numpy(), [1.0, 1.0], rtol=1e-6)
+
+
+def test_set_pop_keeps_python_semantics():
+    @declarative
+    def f(x):
+        s = {1.0}
+        v = s.pop()
+        return x + v
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((2,), np.float32)))
+    np.testing.assert_allclose(out.numpy(), [1.0, 1.0], rtol=1e-6)
+
+
+def test_list_pop_only_in_tensor_if_branches():
+    @declarative
+    def f(x):
+        a = [x, x + 1.0]
+        if fluid.layers.reduce_mean(x) > 0.0:
+            a.pop()
+        else:
+            a.pop(0)
+        return a[0]
+
+    with dygraph.guard():
+        pos = f(to_variable(np.full((2,), 3.0, np.float32)))
+        neg = f(to_variable(np.full((2,), -3.0, np.float32)))
+    np.testing.assert_allclose(pos.numpy(), [3.0, 3.0], rtol=1e-6)
+    np.testing.assert_allclose(neg.numpy(), [-2.0, -2.0], rtol=1e-6)
+
+
+def test_list_setitem_in_tensor_if_branches():
+    @declarative
+    def f(x):
+        a = [x]
+        if fluid.layers.reduce_mean(x) > 0.0:
+            a[0] = x + 10.0
+        else:
+            a[0] = x - 10.0
+        return a[0]
+
+    with dygraph.guard():
+        pos = f(to_variable(np.full((2,), 1.0, np.float32)))
+        neg = f(to_variable(np.full((2,), -1.0, np.float32)))
+    np.testing.assert_allclose(pos.numpy(), [11.0, 11.0], rtol=1e-6)
+    np.testing.assert_allclose(neg.numpy(), [-11.0, -11.0], rtol=1e-6)
+
+
+_module_sink = []
+
+
+def test_closure_list_append_no_unbound_local():
+    @declarative
+    def f(x):
+        _module_sink.append(1.0)
+        return x + float(len(_module_sink) > 0)
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((2,), np.float32)))
+    assert _module_sink == [1.0]
+    np.testing.assert_allclose(out.numpy(), [1.0, 1.0], rtol=1e-6)

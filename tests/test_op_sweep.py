@@ -1008,6 +1008,7 @@ COVERED_ELSEWHERE = {
     "average_accumulates": "test_failure_detection(ModelAverage oracle)",
     "create_array": "test_decoder_api", "write_to_array": "test_decoder_api",
     "read_from_array": "test_decoder_api",
+    "tensor_array_pop": "test_dygraph_to_static (list pop conversion)",
     "lod_array_length": "test_decoder_api",
     "tensor_array_to_tensor": "test_decoder_api",
     "beam_gather_states": "test_decoder_api(beam search oracle)",
