@@ -339,14 +339,13 @@ def convert_index(it, i):
     if _is_tensor(it):
         from ... import layers
 
-        if _is_tensor(i):
-            row = layers.gather(it, layers.reshape(
-                layers.cast(i, "int64"), [1]))
-        else:
-            i = int(i)
-            row = layers.slice(it, axes=[0], starts=[i], ends=[i + 1])
-        shp = list(it.shape[1:])
-        return layers.reshape(row, shp) if shp else layers.reshape(row, [1])
+        # delegate to Variable.__getitem__ (math_op_patch._getitem_impl)
+        # — one lowering for int (slice + decrease, -1 handled) and
+        # tensor (gather) indices
+        row = it[i if _is_tensor(i) else int(i)]
+        if not list(it.shape[1:]):
+            row = layers.reshape(row, [1])  # keep [1]-shaped loop items
+        return row
     try:
         return it[i]  # plain container with a plain key (dict lookups...)
     except TypeError:

@@ -49,7 +49,149 @@ def _binary(op_type, reverse=False, scalar_fn=None):
     return impl
 
 
+_INT_MAX = 2 ** 31 - 1
+_INT_MIN = -(2 ** 31)
+
+
+def _getitem_impl(self, item):
+    """reference: framework.py:1672 Variable.__getitem__ /
+    _getitem_impl_ — int / slice / tuple indexing on a static Variable
+    lowers to slice / strided_slice ops (ints drop their axis via
+    decrease_axis, matching numpy); a scalar-tensor index lowers to
+    gather; LoDTensorArray vars read elements (array_read)."""
+    from ..framework.dtype import VarType
+
+    if self.type == VarType.LOD_TENSOR_ARRAY:
+        from . import tensor as tensor_layers
+        from .control_flow import array_length, array_read
+
+        i = item
+        if isinstance(i, int):
+            if i < 0:
+                i = array_length(self) + i
+            else:
+                i = tensor_layers.fill_constant([1], "int64", i)
+        elif not isinstance(i, Variable):
+            raise TypeError(
+                f"LoDTensorArray index must be int or Variable, got "
+                f"{type(i).__name__}")
+        return array_read(self, i)
+
+    items = list(item) if isinstance(item, tuple) else [item]
+    ndim = len(self.shape)
+    if any(it is Ellipsis for it in items):
+        n_spec = sum(1 for it in items if it is not Ellipsis)
+        expanded = []
+        for it in items:
+            if it is Ellipsis:
+                expanded.extend([slice(None)] * (ndim - n_spec))
+            else:
+                expanded.append(it)
+        items = expanded
+    if len(items) > ndim:
+        raise IndexError(
+            f"too many indices ({len(items)}) for var of rank {ndim}")
+
+    # a single scalar-tensor index on the leading axis: gather + drop axis
+    if len(items) == 1 and isinstance(items[0], Variable):
+        from . import nn as nn_layers
+
+        idx = items[0]
+        row = nn_layers.gather(self, nn_layers.reshape(
+            nn_layers.cast(idx, "int64"), [1]))
+        tail = [int(d) for d in self.shape[1:]]
+        return nn_layers.reshape(row, tail) if tail else \
+            nn_layers.reshape(row, [1])
+
+    # two passes, both rank-preserving until the final decrease:
+    # non-unit-step slices -> strided_slice; ints + unit slices ->
+    # slice (ints drop their axis via decrease_axis)
+    import operator
+
+    def _bound(v, what):
+        if v is None:
+            return None
+        try:
+            return operator.index(v)  # int / np integer
+        except TypeError:
+            raise TypeError(
+                f"slice {what} on a static Variable must be a python "
+                f"int, got {type(v).__name__}; use layers.slice / "
+                "layers.gather for tensor bounds") from None
+
+    str_axes, str_starts, str_ends, str_strides = [], [], [], []
+    axes, starts, ends, decrease = [], [], [], []
+    for ax, it in enumerate(items):
+        if not isinstance(it, (slice, Variable)):
+            try:
+                it = operator.index(it)  # np integer scalars index too
+            except TypeError:
+                pass
+        if isinstance(it, int):
+            axes.append(ax)
+            starts.append(it)
+            ends.append(it + 1 if it != -1 else _INT_MAX)
+            decrease.append(ax)
+        elif isinstance(it, slice):
+            st = 1 if it.step is None else _bound(it.step, "step")
+            if st == 0:
+                raise ValueError(f"invalid slice step {it.step!r}")
+            s, e = _bound(it.start, "start"), _bound(it.stop, "stop")
+            if s is None and e is None and st == 1:
+                continue
+            if st == 1:
+                axes.append(ax)
+                starts.append(0 if s is None else s)
+                ends.append(_INT_MAX if e is None else e)
+            else:
+                str_axes.append(ax)
+                str_starts.append((0 if st > 0 else _INT_MAX)
+                                  if s is None else s)
+                str_ends.append((_INT_MAX if st > 0 else _INT_MIN)
+                                if e is None else e)
+                str_strides.append(st)
+        elif isinstance(it, Variable):
+            raise TypeError(
+                "tensor indices are only supported as a single leading "
+                "index (x[i]); combine with layers.gather/gather_nd for "
+                "more")
+        else:
+            raise TypeError(
+                f"unsupported index {it!r} for a static Variable")
+
+    out = self
+    if str_axes:
+        helper = LayerHelper("getitem")
+        sliced = helper.create_variable_for_type_inference(self.dtype)
+        helper.append_op(
+            "strided_slice", inputs={"Input": [out]},
+            outputs={"Out": [sliced]},
+            attrs={"axes": str_axes, "starts": str_starts,
+                   "ends": str_ends, "strides": str_strides})
+        out = sliced
+    if axes:
+        helper = LayerHelper("getitem")
+        sliced = helper.create_variable_for_type_inference(self.dtype)
+        helper.append_op(
+            "slice", inputs={"Input": [out]},
+            outputs={"Out": [sliced]},
+            attrs={"axes": axes, "starts": starts, "ends": ends,
+                   "decrease_axis": decrease})
+        out = sliced
+    return out
+
+
+def _not_iterable(self):
+    # __getitem__ would otherwise enable the legacy iteration protocol,
+    # and the clamping slice op never raises IndexError -> infinite loop
+    raise TypeError(
+        "static Variable is not iterable; index it (x[i]), or iterate "
+        "inside dygraph_to_static / layers.while_loop")
+
+
 def monkey_patch_variable():
+    Variable.__getitem__ = _getitem_impl
+    Variable.__iter__ = _not_iterable
     Variable.__add__ = _binary("elementwise_add",
                                scalar_fn=lambda x, s: _scalar_op(x, 1.0, s))
     Variable.__radd__ = Variable.__add__

@@ -642,3 +642,20 @@ def test_closure_list_append_no_unbound_local():
         out = f(to_variable(np.zeros((2,), np.float32)))
     assert _module_sink == [1.0]
     np.testing.assert_allclose(out.numpy(), [1.0, 1.0], rtol=1e-6)
+
+
+def test_negative_index_on_rebound_tensor():
+    # 'a' receives list mutations, then is rebound to a TENSOR by
+    # concat; a[-1] must go through the tensor path with numpy
+    # negative-index semantics
+    @declarative
+    def f(x):
+        a = []
+        a.append(x)
+        a.append(x + 1.0)
+        a = fluid.layers.concat(a, axis=0)
+        return a[-1]
+
+    with dygraph.guard():
+        out = f(to_variable(np.asarray([[1.0, 2.0]], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [2.0, 3.0], rtol=1e-6)

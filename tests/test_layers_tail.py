@@ -540,3 +540,66 @@ def test_einsum_layer_matches_numpy():
     np.testing.assert_allclose(
         np.asarray(r[0]).ravel()[0],
         np.einsum("bij,bjk->bik", av, bv).sum(), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# static Variable.__getitem__ (reference: framework.py:1672 _getitem_impl_)
+# ---------------------------------------------------------------------------
+def test_variable_getitem_int_slice_stride():
+    import paddle_tpu.fluid as fluid
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.data(name="gx", shape=[4, 5, 3], dtype="float32")
+        outs = [
+            x[1],          # drop axis 0
+            x[-1],         # negative int
+            x[1:3],        # basic slice
+            x[:, 2],       # int on axis 1
+            x[::2],        # strided
+            x[::-1],       # reversed
+            x[0, ::2],     # int + stride combined
+            x[..., 0],     # ellipsis
+            x[1:3, 0:2],   # multi-axis slice
+        ]
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(60, dtype=np.float32).reshape(4, 5, 3)
+    got = exe.run(main, feed={"gx": xv}, fetch_list=outs)
+    refs = [xv[1], xv[-1], xv[1:3], xv[:, 2], xv[::2], xv[::-1],
+            xv[0, ::2], xv[..., 0], xv[1:3, 0:2]]
+    for g, r in zip(got, refs):
+        np.testing.assert_allclose(np.asarray(g), r, rtol=1e-6)
+
+
+def test_variable_getitem_tensor_index_and_array():
+    import paddle_tpu.fluid as fluid
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.data(name="gy", shape=[4, 3], dtype="float32")
+        i = fluid.layers.fill_constant([1], "int64", 2)
+        row = x[i]                      # gather path
+        arr = fluid.layers.create_array("float32")
+        fluid.layers.array_write(x, fluid.layers.fill_constant(
+            [1], "int64", 0), arr)
+        elem = arr[0]                   # LoDTensorArray read path
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+    got = exe.run(main, feed={"gy": xv}, fetch_list=[row, elem])
+    np.testing.assert_allclose(np.asarray(got[0]), xv[2], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got[1]), xv, rtol=1e-6)
+
+
+def test_variable_getitem_rejects_tensor_bounds():
+    import pytest
+    import paddle_tpu.fluid as fluid
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.data(name="gz", shape=[4, 3], dtype="float32")
+        i = fluid.layers.fill_constant([1], "int64", 1)
+        with pytest.raises(TypeError, match="slice start"):
+            _ = x[i:3]
+        # np integer scalars index fine
+        r = x[np.int64(1)]
+    assert tuple(r.shape) == (3,)
