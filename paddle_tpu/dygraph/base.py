@@ -36,6 +36,23 @@ def disable_dygraph():
 
 
 def to_variable(value, name=None, zero_copy=None):
+    # inside a dygraph_to_static build (no tracer, capture ctx live)
+    # to_variable(ndarray) becomes layers.assign — the reference's
+    # basic_api_transformer does this as an AST rewrite
+    # (basic_api_transformer.py to_assign_node); runtime dispatch keeps
+    # eager semantics everywhere else
+    if _current_tracer() is None:
+        from .dygraph_to_static.program_translator import _capture_tls
+
+        if getattr(_capture_tls, "ctx", None) is not None:
+            import numpy as np
+
+            from .. import layers
+            from ..framework.core import Variable
+
+            if isinstance(value, Variable):
+                return value  # defensive to_variable(x) on a graph var
+            return layers.assign(np.asarray(value))
     from .varbase import VarBase
 
     return VarBase(value, name=name)

@@ -348,10 +348,11 @@ def convert_index(it, i):
         return row
     try:
         return it[i]  # plain container with a plain key (dict lookups...)
-    except TypeError:
-        # np scalar / VarBase loop counter indexing a python sequence;
-        # non-numeric keys re-raise the original error (a swallowed
-        # KeyError would surface as a confusing int() failure)
+    except (TypeError, KeyError):
+        # np scalar / VarBase loop counter indexing a python sequence
+        # or int-keyed dict; non-numeric keys re-raise the original
+        # error (a swallowed KeyError would surface as a confusing
+        # int() failure)
         if hasattr(i, "__int__"):
             return it[int(i)]
         if hasattr(i, "numpy"):

@@ -1095,6 +1095,144 @@ _FUSABLE_OPT = {
 }
 
 
+@register_pass("squared_mat_sub_fuse_pass")
+class SquaredMatSubFusePass(Pass):
+    """matmul(x,y)^2 - matmul(x^2,y^2) [* scalar]  ==>
+    fusion_squared_mat_sub (reference: ir/squared_mat_sub_fuse_pass.cc
+    building operators/fused/fusion_squared_mat_sub_op.cc — the sim-net
+    second-order feature cross).  Inference-shape rewrite."""
+
+    protected: Sequence[str] = ()
+
+    def apply_impl(self, program):
+        fused = 0
+        block = program.global_block()
+        protected = set(self.protected)
+        changed = True
+        while changed:
+            changed = False
+            cons = _consumers(block)
+            prod = producer_map(block)
+            for sub in list(block.ops):
+                if sub.type != "elementwise_sub":
+                    continue
+                sq_xy = prod.get(sub.inputs["X"][0])
+                mm_sq = prod.get(sub.inputs["Y"][0])
+                if (sq_xy is None or mm_sq is None
+                        or sq_xy.type != "square"
+                        or mm_sq.type != "matmul"):
+                    continue
+                mm_xy = prod.get(sq_xy.inputs["X"][0])
+                if mm_xy is None or mm_xy.type != "matmul":
+                    continue
+                sq_x = prod.get(mm_sq.inputs["X"][0])
+                sq_y = prod.get(mm_sq.inputs["Y"][0])
+                if (sq_x is None or sq_y is None or sq_x.type != "square"
+                        or sq_y.type != "square"):
+                    continue
+                if (sq_x.inputs["X"][0] != mm_xy.inputs["X"][0]
+                        or sq_y.inputs["X"][0] != mm_xy.inputs["Y"][0]):
+                    continue
+                if any(mm.attrs.get(k, False) for mm in (mm_xy, mm_sq)
+                       for k in ("transpose_X", "transpose_Y")):
+                    continue
+                if any(mm.attrs.get("alpha", 1.0) != 1.0
+                       for mm in (mm_xy, mm_sq)):
+                    continue  # alpha scaling is not part of the fused op
+                inner = [mm_xy.outputs["Out"][0], sq_xy.outputs["Out"][0],
+                         sq_x.outputs["Out"][0], sq_y.outputs["Out"][0],
+                         mm_sq.outputs["Out"][0]]
+                if any(len(cons.get(n, [])) != 1 or n in protected
+                       for n in inner):
+                    continue
+                out_name = sub.outputs["Out"][0]
+                dead = [mm_xy, sq_xy, sq_x, sq_y, mm_sq, sub]
+                scalar = 1.0
+                users = cons.get(out_name, [])
+                if (out_name not in protected and len(users) == 1
+                        and users[0].type == "scale"
+                        and users[0].attrs.get("bias", 0.0) == 0.0
+                        and not users[0].inputs.get("ScaleTensor")):
+                    scalar = float(users[0].attrs.get("scale", 1.0))
+                    out_name = users[0].outputs["Out"][0]
+                    dead.append(users[0])
+                # earliest dead op's slot keeps topological order (the
+                # square(x)/square(y) ops may precede the matmul)
+                idx = min(block.ops.index(o) for o in dead)
+                x_in, y_in = list(mm_xy.inputs["X"]), list(mm_xy.inputs["Y"])
+                remove_ops(block, dead)
+                block._insert_op(
+                    idx, "fusion_squared_mat_sub",
+                    inputs={"X": x_in, "Y": y_in},
+                    outputs={"Out": [out_name]},
+                    attrs={"scalar": scalar})
+                fused += 1
+                changed = True
+                break
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program
+
+
+@register_pass("repeated_fc_relu_fuse_pass")
+class RepeatedFcReluFusePass(Pass):
+    """N>=2 chained fc(relu) ops ==> fusion_repeated_fc_relu
+    (reference: ir/repeated_fc_relu_fuse_pass.cc). Run AFTER
+    fc_fuse_pass so the chain is already in fc form."""
+
+    protected: Sequence[str] = ()
+
+    def apply_impl(self, program):
+        fused = 0
+        block = program.global_block()
+        protected = set(self.protected)
+        changed = True
+        while changed:
+            changed = False
+            cons = _consumers(block)
+            prod = producer_map(block)
+
+            def is_relu_fc(op_):
+                return (op_ is not None and op_.type == "fc"
+                        and op_.attrs.get("activation_type") == "relu"
+                        and op_.attrs.get("in_num_col_dims", 1) == 1)
+
+            for head in list(block.ops):
+                if not is_relu_fc(head):
+                    continue
+                # head must START a chain: its input not from a relu-fc
+                if is_relu_fc(prod.get(head.inputs["Input"][0])):
+                    continue
+                chain = [head]
+                while True:
+                    o = chain[-1].outputs["Out"][0]
+                    users = cons.get(o, [])
+                    if (o in protected or len(users) != 1
+                            or not is_relu_fc(users[0])
+                            or users[0].inputs["Input"][0] != o):
+                        break
+                    chain.append(users[0])
+                if len(chain) < 2:
+                    continue
+                idx = block.ops.index(head)
+                inputs = {"X": list(head.inputs["Input"]),
+                          "W": [fc.inputs["W"][0] for fc in chain],
+                          "Bias": [fc.inputs["Bias"][0] for fc in chain]}
+                out_name = chain[-1].outputs["Out"][0]
+                remove_ops(block, chain)
+                block._insert_op(
+                    idx, "fusion_repeated_fc_relu", inputs=inputs,
+                    outputs={"Out": [out_name]}, attrs={})
+                fused += 1
+                changed = True
+                break
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program
+
+
 @register_pass("fuse_optimizer_ops_pass")
 class FuseOptimizerOpsPass(Pass):
     def apply_impl(self, program):

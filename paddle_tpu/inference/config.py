@@ -233,6 +233,8 @@ class PassStrategy:
         "embedding_eltwise_layernorm_fuse_pass",
         "fuse_multihead_attention_pass",
         "fc_fuse_pass",
+        "repeated_fc_relu_fuse_pass",
+        "squared_mat_sub_fuse_pass",
         "seqpool_concat_fuse_pass",
         "transpose_flatten_concat_fuse_pass",
         "delete_dropout_pass",

@@ -92,11 +92,19 @@ def _getitem_impl(self, item):
         raise IndexError(
             f"too many indices ({len(items)}) for var of rank {ndim}")
 
-    # a single scalar-tensor index on the leading axis: gather + drop axis
+    # a single tensor index on the leading axis: gather (numpy fancy-row
+    # semantics); a SCALAR index additionally drops the axis
     if len(items) == 1 and isinstance(items[0], Variable):
         from . import nn as nn_layers
 
         idx = items[0]
+        ishape = tuple(idx.shape or ())
+        if ishape not in ((), (1,)):
+            if len(ishape) != 1:
+                raise TypeError(
+                    f"tensor index must be a scalar or 1-D vector, got "
+                    f"shape {ishape}")
+            return nn_layers.gather(self, nn_layers.cast(idx, "int64"))
         row = nn_layers.gather(self, nn_layers.reshape(
             nn_layers.cast(idx, "int64"), [1]))
         tail = [int(d) for d in self.shape[1:]]

@@ -353,3 +353,37 @@ def _fused_mha_grad_maker(op_, no_grad_names=frozenset()):
         outputs["BiasQK" + GRAD_SUFFIX] = g(op_.input("BiasQK"))
     return [dict(type="fused_multihead_attention_grad", inputs=inputs,
                  outputs=outputs, attrs=dict(op_.attrs))]
+
+
+# --------------------------------------------------------------------------
+# CTR/sim-net serving fusions (reference: operators/fused/
+# fusion_squared_mat_sub_op.cc, fusion_repeated_fc_relu_op.cc; built by
+# ir/squared_mat_sub_fuse_pass.cc and ir/repeated_fc_relu_fuse_pass.cc).
+# On TPU the win is graph-size/compile-time and op-name parity — XLA
+# fuses the arithmetic either way.
+# --------------------------------------------------------------------------
+@op("fusion_squared_mat_sub")
+def _fusion_squared_mat_sub(ctx):
+    """out = scalar * ((x@y)^2 - (x^2)@(y^2))"""
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    scalar = ctx.attr("scalar", 1.0)
+    xy = jnp.matmul(x, y)
+    sq = jnp.matmul(jnp.square(x), jnp.square(y))
+    ctx.set_out("Out", scalar * (jnp.square(xy) - sq))
+    if ctx.has_output("SquaredXY"):
+        ctx.set_out("SquaredXY", jnp.square(xy))
+
+
+@op("fusion_repeated_fc_relu")
+def _fusion_repeated_fc_relu(ctx):
+    """Chain of fc+relu stages in one op (reference:
+    fusion_repeated_fc_relu_op.h ReLU(x @ w + b) repeated)."""
+    import jax.nn as _jnn
+
+    x = ctx.in_("X")
+    ws, bs = ctx.ins("W"), ctx.ins("Bias")
+    if jnp.ndim(x) > 2:
+        x = jnp.reshape(x, (jnp.shape(x)[0], -1))
+    for w, b in zip(ws, bs):
+        x = _jnn.relu(jnp.matmul(x, w) + jnp.reshape(b, (-1,)))
+    ctx.set_out("Out", x)

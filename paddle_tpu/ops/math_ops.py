@@ -40,6 +40,12 @@ def _ew(fn):
     return lower
 
 
+# NOTE (r5, measured): routing the channel-bias grad through a
+# ones-row matmul (custom_vjp, preferred_element_type=f32) to replace
+# the per-layer convert+reduce fusions (8.3 ms/step on ERNIE) was
+# A/B'd at 140.7k vs 140.7k tok/s — XLA's algebraic simplifier
+# canonicalizes the trivial matmul back into the same reduce, so the
+# plain lowering stays.
 for _name, _fn in [
     ("elementwise_add", jnp.add),
     ("elementwise_sub", jnp.subtract),

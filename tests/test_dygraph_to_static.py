@@ -659,3 +659,29 @@ def test_negative_index_on_rebound_tensor():
     with dygraph.guard():
         out = f(to_variable(np.asarray([[1.0, 2.0]], np.float32)))
     np.testing.assert_allclose(out.numpy(), [2.0, 3.0], rtol=1e-6)
+
+
+def test_to_variable_in_converted_fn_becomes_assign():
+    # reference: basic_api_transformer.py — to_variable(ndarray) inside
+    # a converted function must build (as assign), not crash
+    @declarative
+    def f(x):
+        c = to_variable(np.asarray([2.0], np.float32))
+        return x * c
+
+    with dygraph.guard():
+        out = f(to_variable(np.asarray([3.0, 4.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [6.0, 8.0], rtol=1e-6)
+
+
+def test_int_keyed_dict_tensor_index_and_defensive_to_variable():
+    @declarative
+    def f(x, which):
+        d = {0: x * 2.0, 1: x * 3.0}
+        x = to_variable(x)  # defensive re-wrap must pass through
+        return d[which] + x * 0.0
+
+    with dygraph.guard():
+        xv = to_variable(np.asarray([1.0, 2.0], np.float32))
+        out = f(xv, np.int64(1))
+    np.testing.assert_allclose(out.numpy(), [3.0, 6.0], rtol=1e-6)

@@ -511,3 +511,128 @@ def test_transpose_flatten_concat_fuse_pass():
         got = np.asarray(exe.run(fused_prog, feed=feed,
                                  fetch_list=[out2])[0])
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_squared_mat_sub_fuse_pass():
+    """matmul^2 - matmul(x^2,y^2) [*scalar] -> fusion_squared_mat_sub
+    with numeric parity (reference: ir/squared_mat_sub_fuse_pass.cc)."""
+    import collections
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.ir import get_pass
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("smx", [4])
+        y = fluid.layers.data("smy", [4, 5], append_batch_size=False)
+        xy = fluid.layers.matmul(x, y)
+        a = fluid.layers.square(xy)
+        b = fluid.layers.matmul(fluid.layers.square(x),
+                                fluid.layers.square(y))
+        out = fluid.layers.scale(a - b, scale=0.5)
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(1)
+    feed = {"smx": rng.rand(3, 4).astype(np.float32),
+            "smy": rng.rand(4, 5).astype(np.float32)}
+    with scope_guard(Scope()):
+        exe.run(startup)
+        before = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        p = get_pass("squared_mat_sub_fuse_pass", protected=(out.name,))
+        p.apply(main)
+        types = collections.Counter(o.type for o in main.global_block().ops)
+        assert p.fused_count == 1
+        assert types["fusion_squared_mat_sub"] == 1
+        assert types["matmul"] == 0 and types["square"] == 0 \
+            and types["elementwise_sub"] == 0 and types["scale"] == 0
+        after = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        np.testing.assert_allclose(before, after, atol=1e-6)
+    # parity with the unfused math
+    xv, yv = feed["smx"], feed["smy"]
+    want = 0.5 * (np.square(xv @ yv) - np.square(xv) @ np.square(yv))
+    np.testing.assert_allclose(before, want, rtol=1e-5)
+
+
+def test_repeated_fc_relu_fuse_pass():
+    """fc_fuse then chained fc(relu) -> fusion_repeated_fc_relu
+    (reference: ir/repeated_fc_relu_fuse_pass.cc)."""
+    import collections
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.ir import get_pass
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("rfx", [6])
+        h = fluid.layers.fc(x, 8, act="relu")
+        h = fluid.layers.fc(h, 8, act="relu")
+        h = fluid.layers.fc(h, 4, act="relu")
+        out = fluid.layers.fc(h, 2)  # tail without relu stays
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(2)
+    feed = {"rfx": rng.rand(5, 6).astype(np.float32)}
+    with scope_guard(Scope()):
+        exe.run(startup)
+        before = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        get_pass("fc_fuse_pass", protected=(out.name,)).apply(main)
+        p = get_pass("repeated_fc_relu_fuse_pass", protected=(out.name,))
+        p.apply(main)
+        types = collections.Counter(o.type for o in main.global_block().ops)
+        assert p.fused_count == 1
+        assert types["fusion_repeated_fc_relu"] == 1
+        assert types["fc"] == 1  # the non-relu tail
+        after = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        np.testing.assert_allclose(before, after, atol=1e-6)
+
+
+def test_squared_mat_sub_pass_insertion_order_and_alpha_guard():
+    """(1) square(x)/square(y) built BEFORE the matmul: fused op must
+    land before its consumers (topological order); (2) alpha != 1
+    matmuls must NOT fuse."""
+    import collections
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.ir import get_pass
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("sox", [4])
+        y = fluid.layers.data("soy", [4, 5], append_batch_size=False)
+        sx = fluid.layers.square(x)          # squares FIRST
+        sy = fluid.layers.square(y)
+        a = fluid.layers.square(fluid.layers.matmul(x, y))
+        diff = a - fluid.layers.matmul(sx, sy)
+        out = fluid.layers.relu(diff)        # consumer after the chain
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(3)
+    feed = {"sox": rng.rand(2, 4).astype(np.float32),
+            "soy": rng.rand(4, 5).astype(np.float32)}
+    with scope_guard(Scope()):
+        exe.run(startup)
+        before = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        p = get_pass("squared_mat_sub_fuse_pass", protected=(out.name,))
+        p.apply(main)
+        assert p.fused_count == 1
+        types = [o.type for o in main.global_block().ops]
+        assert types.index("fusion_squared_mat_sub") < types.index("relu")
+        after = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        np.testing.assert_allclose(before, after, atol=1e-6)
+
+    # alpha-guard leg
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = fluid.layers.data("sax", [4])
+        y = fluid.layers.data("say", [4, 5], append_batch_size=False)
+        a = fluid.layers.square(fluid.layers.matmul(x, y, alpha=0.5))
+        b = fluid.layers.matmul(fluid.layers.square(x),
+                                fluid.layers.square(y))
+        out2 = a - b
+    p2 = get_pass("squared_mat_sub_fuse_pass", protected=(out2.name,))
+    p2.apply(main2)
+    assert p2.fused_count == 0  # alpha != 1 must not fuse

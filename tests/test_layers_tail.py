@@ -603,3 +603,17 @@ def test_variable_getitem_rejects_tensor_bounds():
         # np integer scalars index fine
         r = x[np.int64(1)]
     assert tuple(r.shape) == (3,)
+
+
+def test_variable_getitem_vector_tensor_index():
+    import paddle_tpu.fluid as fluid
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.data(name="gv", shape=[4, 3], dtype="float32")
+        idx = fluid.layers.assign(np.asarray([0, 2], np.int64))
+        rows = x[idx]  # fancy-row gather, rank preserved
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+    got = exe.run(main, feed={"gv": xv}, fetch_list=[rows])[0]
+    np.testing.assert_allclose(np.asarray(got), xv[[0, 2]], rtol=1e-6)

@@ -1009,6 +1009,8 @@ COVERED_ELSEWHERE = {
     "create_array": "test_decoder_api", "write_to_array": "test_decoder_api",
     "read_from_array": "test_decoder_api",
     "tensor_array_pop": "test_dygraph_to_static (list pop conversion)",
+    "fusion_squared_mat_sub": "test_ir_pass (squared_mat_sub fuse)",
+    "fusion_repeated_fc_relu": "test_ir_pass (repeated_fc_relu fuse)",
     "lod_array_length": "test_decoder_api",
     "tensor_array_to_tensor": "test_decoder_api",
     "beam_gather_states": "test_decoder_api(beam search oracle)",
