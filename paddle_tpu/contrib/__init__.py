@@ -1,2 +1,3 @@
 from . import mixed_precision
 from . import slim
+from . import layers

@@ -1,0 +1,10 @@
+"""fluid.contrib.layers (reference: python/paddle/fluid/contrib/layers/
+nn.py __all__) — the ops themselves live in ops/parity_ops.py and
+ops/long_tail_ops.py; this module is the python surface."""
+from .nn import (  # noqa: F401
+    match_matrix_tensor,
+    multiclass_nms2,
+    sequence_topk_avg_pooling,
+    tdm_child,
+    tdm_sampler,
+)

@@ -1,0 +1,107 @@
+"""contrib layer wrappers (reference: fluid/contrib/layers/nn.py)."""
+from __future__ import annotations
+
+from ...framework.dtype import VarType
+from ...layer_helper import LayerHelper
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None, x_len=None, y_len=None):
+    """reference: contrib/layers/nn.py:223 — X * W * Y var-length match
+    matrix; padded [B,TL,D]/[B,TR,D] + optional Length vars here."""
+    helper = LayerHelper("match_matrix_tensor", name=name)
+    d = int(x.shape[-1])
+    w = helper.create_parameter(
+        attr=param_attr, shape=[d, channel_num * d], dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    tmp = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [x], "Y": [y], "W": [w]}
+    if x_len is not None:
+        inputs["LengthX"] = [x_len]
+    if y_len is not None:
+        inputs["LengthY"] = [y_len]
+    helper.append_op("match_matrix_tensor", inputs=inputs,
+                     outputs={"Out": [out], "Tmp": [tmp]},
+                     attrs={"dim_t": channel_num})
+    if act is not None:
+        from ... import layers
+
+        out = getattr(layers, act)(out)
+    return out, tmp
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """reference: contrib/layers/nn.py sequence_topk_avg_pooling."""
+    helper = LayerHelper("sequence_topk_avg_pooling")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pos = helper.create_variable_for_type_inference(VarType.INT32)
+    helper.append_op("sequence_topk_avg_pooling",
+                     inputs={"X": [input], "ROW": [row], "COLUMN": [col]},
+                     outputs={"Out": [out], "pos": [pos]},
+                     attrs={"topks": list(topks),
+                            "channel_num": channel_num})
+    return out
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32"):
+    """reference: contrib/layers/nn.py tdm_child — TreeInfo is a
+    [node_nums, 3 + child_nums] int parameter."""
+    helper = LayerHelper("tdm_child")
+    tree_info = helper.create_parameter(
+        attr=param_attr, shape=[node_nums, 3 + child_nums], dtype=dtype)
+    child = helper.create_variable_for_type_inference(VarType.INT64)
+    mask = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op("tdm_child", inputs={"X": [x],
+                                          "TreeInfo": [tree_info]},
+                     outputs={"Child": [child], "LeafMask": [mask]},
+                     attrs={"child_nums": child_nums})
+    return child, mask
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_dtype="int32", dtype="int32"):
+    """reference: contrib/layers/nn.py tdm_sampler."""
+    helper = LayerHelper("tdm_sampler")
+    layer_nums = len(neg_samples_num_list)
+    offsets, acc = [0], 0
+    for n in layer_node_num_list:
+        acc += int(n)
+        offsets.append(acc)
+    travel = helper.create_parameter(
+        attr=tree_travel_attr, shape=[leaf_node_num, layer_nums],
+        dtype=tree_dtype)
+    layer = helper.create_parameter(
+        attr=tree_layer_attr, shape=[acc, 1], dtype=tree_dtype)
+    out = helper.create_variable_for_type_inference(VarType.INT64)
+    labels = helper.create_variable_for_type_inference(VarType.INT64)
+    mask = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op(
+        "tdm_sampler",
+        inputs={"X": [x], "Travel": [travel], "Layer": [layer]},
+        outputs={"Out": [out], "Labels": [labels], "Mask": [mask]},
+        attrs={"neg_samples_num_list": list(neg_samples_num_list),
+               "layer_offset_lod": offsets,
+               "output_positive": output_positive, "seed": seed})
+    return out, labels, mask
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """reference: contrib/layers/nn.py multiclass_nms2."""
+    helper = LayerHelper("multiclass_nms2", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    index = helper.create_variable_for_type_inference(VarType.INT64)
+    helper.append_op(
+        "multiclass_nms2",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "Index": [index]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "normalized": normalized, "nms_eta": nms_eta,
+               "background_label": background_label})
+    if return_index:
+        return out, index
+    return out

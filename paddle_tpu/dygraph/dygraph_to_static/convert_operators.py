@@ -341,9 +341,15 @@ def convert_index(it, i):
 
         # delegate to Variable.__getitem__ (math_op_patch._getitem_impl)
         # — one lowering for int (slice + decrease, -1 handled) and
-        # tensor (gather) indices
+        # tensor (gather) indices.  Loop counters are [1]-shaped vars,
+        # which __getitem__ treats as a fancy-row index (numpy
+        # semantics, axis kept); the iteration contract here is a ROW
+        # item, so squeeze the kept axis back off.
         row = it[i if _is_tensor(i) else int(i)]
-        if not list(it.shape[1:]):
+        if _is_tensor(i) and tuple(getattr(i, "shape", ())) == (1,):
+            shp = [int(d) for d in it.shape[1:]]
+            row = layers.reshape(row, shp if shp else [1])
+        elif not list(it.shape[1:]):
             row = layers.reshape(row, [1])  # keep [1]-shaped loop items
         return row
     try:

@@ -32,6 +32,7 @@ from ..backward import append_backward, gradients
 from ..param_attr import ParamAttr, WeightNormParamAttr
 from .. import initializer
 from .. import layers
+from .. import metrics
 from .. import optimizer
 from .. import regularizer
 from .. import clip

@@ -1196,7 +1196,8 @@ class RepeatedFcReluFusePass(Pass):
             def is_relu_fc(op_):
                 return (op_ is not None and op_.type == "fc"
                         and op_.attrs.get("activation_type") == "relu"
-                        and op_.attrs.get("in_num_col_dims", 1) == 1)
+                        and op_.attrs.get("in_num_col_dims", 1) == 1
+                        and bool(op_.inputs.get("Bias")))  # fc bias optional
 
             for head in list(block.ops):
                 if not is_relu_fc(head):

@@ -576,3 +576,33 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes = concat(boxes_all, axis=0)
     variances = concat(vars_all, axis=0)
     return mbox_locs, mbox_confs, boxes, variances
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """reference: layers/detection.py detection_map -> detection_map op
+    (ops/parity_ops.py); accumulate states are host-side python values
+    threaded by name, as the op docs describe."""
+    from ..framework.dtype import VarType
+
+    helper = LayerHelper("detection_map")
+    m = helper.create_variable_for_type_inference(VarType.FP32)
+    inputs = {"DetectRes": [detect_res], "Label": [label]}
+    outputs = {"MAP": [m]}
+    if input_states is not None:
+        inputs["PosCount"] = [input_states[0]]
+    if out_states is None:
+        out_states = [helper.create_variable_for_type_inference(
+            VarType.FP32) for _ in range(3)]
+    outputs["AccumPosCount"] = [out_states[0]]
+    outputs["AccumTruePos"] = [out_states[1]]
+    outputs["AccumFalsePos"] = [out_states[2]]
+    helper.append_op(
+        "detection_map", inputs=inputs, outputs=outputs,
+        attrs={"overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version, "class_num": class_num,
+               "background_label": background_label})
+    return m

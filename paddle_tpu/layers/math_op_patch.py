@@ -99,12 +99,15 @@ def _getitem_impl(self, item):
 
         idx = items[0]
         ishape = tuple(idx.shape or ())
-        if ishape not in ((), (1,)):
+        if ishape != ():
             if len(ishape) != 1:
                 raise TypeError(
                     f"tensor index must be a scalar or 1-D vector, got "
                     f"shape {ishape}")
+            # numpy fancy-row semantics: a 1-D index (even length-1)
+            # keeps its axis — x[[0]] is (1, ...), not (...)
             return nn_layers.gather(self, nn_layers.cast(idx, "int64"))
+        # 0-d scalar index drops the axis
         row = nn_layers.gather(self, nn_layers.reshape(
             nn_layers.cast(idx, "int64"), [1]))
         tail = [int(d) for d in self.shape[1:]]

@@ -993,9 +993,42 @@ def accuracy(input, label, k=1, correct=None, total=None):
     return acc_out
 
 
-def auc(input, label, curve="ROC", num_thresholds=200, topk=1, slide_steps=1):
-    """AUC metric — simplified single-batch AUC (reference: metric_op.py auc)."""
-    raise NotImplementedError("auc metric lands with the metrics phase")
+def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1, topk=1,
+        slide_steps=1):
+    """Running ROC-AUC (reference: layers/metric_op.py auc — two auc ops
+    over persistable bin-count states: a sliding-window batch AUC and a
+    global AUC; ops/parity_ops.py implements auc_op.h's stat layout)."""
+    from .tensor import create_global_var
+
+    helper = LayerHelper("auc")
+
+    def _stats(tag, s):
+        n = (1 + s) * (num_thresholds + 1) + (1 if s > 0 else 0)
+        pos = create_global_var([n], 0.0, "int64", persistable=True,
+                                name=helper.name + f"_{tag}_pos")
+        neg = create_global_var([n], 0.0, "int64", persistable=True,
+                                name=helper.name + f"_{tag}_neg")
+        return pos, neg
+
+    batch_pos, batch_neg = _stats("batch", slide_steps)
+    stat_pos, stat_neg = _stats("global", 0)
+
+    def _auc_op(pos, neg, s):
+        out = helper.create_variable_for_type_inference(VarType.FP64)
+        helper.append_op(
+            "auc",
+            inputs={"Predict": [input], "Label": [label],
+                    "StatPos": [pos], "StatNeg": [neg]},
+            outputs={"AUC": [out], "StatPosOut": [pos],
+                     "StatNegOut": [neg]},
+            attrs={"curve": curve, "num_thresholds": num_thresholds,
+                   "slide_steps": s})
+        return out
+
+    batch_auc_out = _auc_op(batch_pos, batch_neg, slide_steps)
+    auc_out = _auc_op(stat_pos, stat_neg, 0)
+    return auc_out, batch_auc_out, [batch_pos, batch_neg,
+                                    stat_pos, stat_neg]
 
 
 def increment(x, value=1.0, in_place=True):

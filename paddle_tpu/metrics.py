@@ -176,3 +176,43 @@ class ChunkEvaluator(MetricBase):
         f1 = (2 * precision * recall / (precision + recall)
               if self.num_correct_chunks else 0.0)
         return precision, recall, f1
+
+
+class DetectionMAP(object):
+    """Graph-building mAP evaluator (reference: fluid/metrics.py:805
+    DetectionMAP): appends two detection_map ops — current-batch mAP
+    and accumulated mAP over persistable host-side state — and exposes
+    (cur_map, accum_map) via get_map_var()."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        from . import layers
+        from .layers import detection, tensor
+
+        gt_label = layers.cast(gt_label, gt_box.dtype)
+        if gt_difficult is not None:
+            gt_difficult = layers.cast(gt_difficult, gt_box.dtype)
+            label = layers.concat([gt_label, gt_difficult, gt_box], axis=-1)
+        else:
+            label = layers.concat([gt_label, gt_box], axis=-1)
+
+        self.cur_map = detection.detection_map(
+            input, label, class_num, background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version)
+
+        # accumulate states: persistable, zero-initialized in startup
+        # (the op swaps in its host-side accumulator on first run)
+        states = [tensor.create_global_var(
+            [1], 0.0, "float32", persistable=True,
+            name=f"_map_state_{i}_{id(self)}") for i in range(3)]
+        self.accum_map = detection.detection_map(
+            input, label, class_num, background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            input_states=states, out_states=states, ap_version=ap_version)
+        self.has_state = states[0]
+
+    def get_map_var(self):
+        return self.cur_map, self.accum_map

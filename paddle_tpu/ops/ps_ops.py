@@ -287,3 +287,38 @@ def _listen_and_serv(ctx):
     ep = ctx.attr("endpoint", "127.0.0.1:0")
     server = PSServer(ep, n_trainers=ctx.attr("Fanin", 1))
     server.start(block=True)
+
+
+@_host("prefetch", no_grad=True)
+def _prefetch_op(ctx):
+    """Reference: distributed_ops/prefetch_op.cc — pull sparse rows for
+    ids from the parameter server ahead of use.  Bound to the same
+    table service as distributed_lookup_table; one fan-out pull."""
+    from ..distributed_ps import prefetch as _pf
+
+    client = _client()
+    ids_vals = ctx.ins("X")
+    tables = list(ctx.attr("table_names", []) or [])
+    if not tables:
+        tables = [ctx.attr("table_name", "")] * len(ids_vals)
+    reqs, shapes = [], []
+    for t, ids in zip(tables, ids_vals):
+        flat = np.asarray(ids).astype(np.int64).ravel()
+        reqs.append((t, flat))
+        shapes.append(np.asarray(ids).shape)
+    pulled = _pf.parallel_pull_multi(client, reqs)
+    outs = []
+    for rows, shape in zip(pulled, shapes):
+        r = np.asarray(rows)
+        outs.append(jnp.asarray(r.reshape(tuple(shape) + (r.shape[-1],))))
+    ctx.set_out("Out", outs)
+
+
+@_host("push_dense", no_grad=True)
+def _push_dense_op(ctx):
+    """Reference: pslib push_dense — send a dense grad to its table
+    (async, like the communicator's send path)."""
+    client = _client()
+    table = ctx.attr("table_name", "") or str(ctx.attr("TableId", 0))
+    for g in ctx.ins("Ids") or ctx.ins("X"):
+        client.push_dense(table, np.asarray(g), sync=False)

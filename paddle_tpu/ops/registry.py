@@ -287,6 +287,11 @@ def infer_shape(op: Operator, block: Block):
         return
     if d.lower is None:
         return
+    if d.host:
+        # host lowerings touch real side state (queues, tables, env
+        # arrays) — eval_shape-tracing them would leak tracers into it;
+        # their shapes are data-dependent and resolved at run time
+        return
     _generic_infer(op, block, d)
 
 

@@ -586,7 +586,9 @@ def test_variable_getitem_tensor_index_and_array():
     exe = fluid.Executor(fluid.CPUPlace())
     xv = np.arange(12, dtype=np.float32).reshape(4, 3)
     got = exe.run(main, feed={"gy": xv}, fetch_list=[row, elem])
-    np.testing.assert_allclose(np.asarray(got[0]), xv[2], rtol=1e-6)
+    # a [1]-shaped tensor index follows numpy fancy-row semantics:
+    # x[[2]] keeps the axis -> (1, 3)
+    np.testing.assert_allclose(np.asarray(got[0]), xv[[2]], rtol=1e-6)
     np.testing.assert_allclose(np.asarray(got[1]), xv, rtol=1e-6)
 
 
@@ -617,3 +619,18 @@ def test_variable_getitem_vector_tensor_index():
     xv = np.arange(12, dtype=np.float32).reshape(4, 3)
     got = exe.run(main, feed={"gv": xv}, fetch_list=[rows])[0]
     np.testing.assert_allclose(np.asarray(got), xv[[0, 2]], rtol=1e-6)
+
+
+def test_variable_getitem_len1_vector_keeps_axis():
+    import paddle_tpu.fluid as fluid
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.data(name="g1", shape=[4, 3], dtype="float32")
+        idx = fluid.layers.assign(np.asarray([1], np.int64))
+        rows = x[idx]  # numpy: x[[1]] -> (1, 3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+    got = np.asarray(exe.run(main, feed={"g1": xv}, fetch_list=[rows])[0])
+    assert got.shape == (1, 3)
+    np.testing.assert_allclose(got, xv[[1]], rtol=1e-6)

@@ -1,0 +1,374 @@
+"""Op-name parity batch 2 (ops/parity_ops.py): auc, detection_map,
+tdm_*, match_matrix_tensor, sequence_topk_avg_pooling, queue/reader op
+forms, recurrent, lookup_table_dequant, ref_by_trainer_id, feed/fetch.
+
+Reference analogs: metrics/auc_op.h, detection/detection_map_op.h,
+tdm_child_op.h, tdm_sampler_op.h, match_matrix_tensor_op.cc,
+sequence_ops/sequence_topk_avg_pooling_op.h, recurrent_op.cc."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        if startup is not None:
+            exe.run(startup)
+        return [np.asarray(v) for v in
+                exe.run(main, feed=feed, fetch_list=fetch)]
+
+
+def test_auc_layer_matches_manual():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.data(name="ap", shape=[8, 2], dtype="float32")
+        label = fluid.data(name="al", shape=[8, 1], dtype="int64")
+        auc_out, batch_auc, states = fluid.layers.auc(
+            pred, label, num_thresholds=4095)
+    rng = np.random.RandomState(0)
+    pos_prob = rng.rand(8).astype(np.float32)
+    probs = np.stack([1 - pos_prob, pos_prob], 1)
+    labels = (pos_prob + rng.rand(8) * 0.5 > 0.75).astype(np.int64)[:, None]
+    got = _run(main, startup, {"ap": probs, "al": labels}, [auc_out])[0]
+
+    # manual trapezoid AUC at the same binning
+    def manual_auc(p, l, T=4095):
+        sp = np.zeros(T + 1)
+        sn = np.zeros(T + 1)
+        bins = (p * T).astype(int).clip(0, T)
+        for b, y in zip(bins, l.ravel()):
+            (sp if y > 0 else sn)[b] += 1
+        tp = tn = auc = 0.0
+        for i in range(T, -1, -1):
+            pp, pn = tp, tn
+            tp += sp[i]
+            tn += sn[i]
+            auc += abs(tn - pn) * (tp + pp) / 2
+        return auc / tp / tn if tp and tn else 0.0
+
+    want = manual_auc(pos_prob, labels)
+    np.testing.assert_allclose(float(got), want, atol=1e-6)
+
+
+def test_auc_accumulates_across_batches():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.data(name="p2", shape=[4, 1], dtype="float32")
+        label = fluid.data(name="l2", shape=[4, 1], dtype="int64")
+        auc_out, _, _ = fluid.layers.auc(pred, label, num_thresholds=255)
+    exe = fluid.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        # perfectly separable data fed twice -> global AUC 1.0
+        p = np.asarray([[0.1], [0.2], [0.8], [0.9]], np.float32)
+        l = np.asarray([[0], [0], [1], [1]], np.int64)
+        for _ in range(2):
+            out = np.asarray(exe.run(
+                main, feed={"p2": p, "l2": l}, fetch_list=[auc_out])[0])
+        np.testing.assert_allclose(float(out), 1.0, atol=1e-6)
+
+
+def test_detection_map_metric():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.data(name="dm_det", shape=[1, 3, 6], dtype="float32")
+        gt_box = fluid.data(name="dm_box", shape=[1, 2, 4],
+                            dtype="float32")
+        gt_label = fluid.data(name="dm_lab", shape=[1, 2, 1],
+                              dtype="float32")
+        m = pt.fluid.metrics.DetectionMAP(det, gt_label, gt_box,
+                                          class_num=3)
+        cur, accum = m.get_map_var()
+    # one gt of class 1; detections: one perfect match + one miss
+    dets = np.asarray([[[1, 0.9, 0, 0, 1, 1],
+                        [1, 0.5, 5, 5, 6, 6],
+                        [-1, 0, 0, 0, 0, 0]]], np.float32)
+    boxes = np.asarray([[[0, 0, 1, 1], [0, 0, 0, 0]]], np.float32)
+    labels = np.asarray([[[1], [-1]]], np.float32)
+    got = _run(main, startup,
+               {"dm_det": dets, "dm_box": boxes, "dm_lab": labels},
+               [cur, accum])
+    # AP: tp at score .9 (p=1, r=1), fp at .5 -> integral AP = 1.0
+    np.testing.assert_allclose(float(got[0]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(got[1]), 1.0, atol=1e-6)
+
+
+def test_multiclass_nms2_returns_index():
+    from paddle_tpu.contrib.layers import multiclass_nms2
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        bb = fluid.data(name="nb", shape=[1, 4, 4], dtype="float32")
+        sc = fluid.data(name="ns", shape=[1, 2, 4], dtype="float32")
+        out, idx = multiclass_nms2(bb, sc, score_threshold=0.1,
+                                   nms_top_k=4, keep_top_k=4,
+                                   background_label=0, return_index=True)
+    boxes = np.zeros((1, 4, 4), np.float32)
+    for i in range(4):
+        boxes[0, i] = [i * 10, 0, i * 10 + 5, 5]  # well separated
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.0, 0.0]
+    o, ind = _run(main, startup, {"nb": boxes, "ns": scores}, [out, idx])
+    assert float(o[0, 0, 1]) == pytest.approx(0.9)
+    assert int(ind[0, 0]) == 0 and int(ind[0, 1]) == 1
+
+
+def test_ref_by_trainer_id_and_fake_init():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data(name="ra", shape=[2], dtype="float32")
+        b = fluid.data(name="rb", shape=[2], dtype="float32")
+        tid = fluid.layers.fill_constant([1], "int64", 1)
+        out = main.global_block().create_var(name="ref_out")
+        main.global_block().append_op(
+            "ref_by_trainer_id", inputs={"X": [a, b], "TrainerId": [tid]},
+            outputs={"Out": [out]})
+        fk = main.global_block().create_var(name="fk_out")
+        main.global_block().append_op(
+            "fake_init", inputs={}, outputs={"Out": [fk]},
+            attrs={"shape": [3]})
+    av = np.asarray([1.0, 2.0], np.float32)
+    bv = np.asarray([3.0, 4.0], np.float32)
+    got = _run(main, None, {"ra": av, "rb": bv}, [out, fk])
+    np.testing.assert_allclose(got[0], bv)
+    np.testing.assert_allclose(got[1], np.zeros(3))
+
+
+def test_lookup_table_dequant_roundtrip():
+    rows, width = 5, 8
+    rng = np.random.RandomState(1)
+    dense = rng.randn(rows, width).astype(np.float32)
+    mins = dense.min(1)
+    maxs = dense.max(1)
+    scale = (maxs - mins) / 256.0
+    q = np.clip((dense - mins[:, None]) / scale[:, None], 0,
+                255).astype(np.uint8)
+    packed = np.zeros((rows, 2 + width // 4), np.float32)
+    packed[:, 0] = mins
+    packed[:, 1] = maxs
+    packed[:, 2:] = q.view(np.float32).reshape(rows, -1)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.data(name="qw", shape=[rows, 2 + width // 4],
+                       dtype="float32")
+        ids = fluid.data(name="qi", shape=[3], dtype="int64")
+        out = main.global_block().create_var(name="dq_out")
+        main.global_block().append_op(
+            "lookup_table_dequant", inputs={"W": [w], "Ids": [ids]},
+            outputs={"Out": [out]}, attrs={"padding_idx": -1})
+    idv = np.asarray([0, 2, 4], np.int64)
+    got = _run(main, None, {"qw": packed, "qi": idv}, [out])[0]
+    want = scale[idv][:, None] * q[idv].astype(np.float32) + mins[idv][:, None]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_tdm_child():
+    from paddle_tpu.contrib.layers import tdm_child
+
+    # tree: node1 -> children 3,4 (both leaves); node2 -> none
+    # rows: [item_id, layer_id, ancestor, child0, child1]
+    info = np.asarray([
+        [0, 0, 0, 0, 0],
+        [0, 0, 0, 3, 4],   # node 1: internal (item 0), children 3,4
+        [0, 1, 1, 0, 0],   # node 2: no children
+        [7, 1, 1, 0, 0],   # node 3: leaf item 7
+        [8, 1, 1, 0, 0],   # node 4: leaf item 8
+    ], np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="tcx", shape=[2, 1], dtype="int64")
+        child, mask = tdm_child(
+            x, node_nums=5, child_nums=2,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(
+                    info.astype(np.int32))))
+    got = _run(main, startup, {"tcx": np.asarray([[1], [2]], np.int64)},
+               [child, mask])
+    np.testing.assert_array_equal(got[0][0, 0], [3, 4])
+    np.testing.assert_array_equal(got[1][0, 0], [1, 1])
+    np.testing.assert_array_equal(got[0][1, 0], [0, 0])
+    np.testing.assert_array_equal(got[1][1, 0], [0, 0])
+
+
+def test_tdm_sampler():
+    from paddle_tpu.contrib.layers import tdm_sampler
+
+    # 2 layers: layer0 nodes [1,2], layer1 nodes [3,4,5,6]
+    travel = np.asarray([[1, 3], [1, 4], [2, 5], [2, 6]], np.int32)
+    layer_nodes = np.asarray([[1], [2], [3], [4], [5], [6]], np.int32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="tsx", shape=[2, 1], dtype="int64")
+        out, labels, mask = tdm_sampler(
+            x, neg_samples_num_list=[1, 2], layer_node_num_list=[2, 4],
+            leaf_node_num=4,
+            tree_travel_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(travel)),
+            tree_layer_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(
+                    layer_nodes)),
+            seed=3)
+    leaf = np.asarray([[0], [2]], np.int64)
+    o, l, m = _run(main, startup, {"tsx": leaf}, [out, labels, mask])
+    # layout per input: [pos0, neg0, pos1, neg1a, neg1b]
+    assert o.shape == (2, 5)
+    for i, lf in enumerate([0, 2]):
+        pos0, pos1 = travel[lf]
+        assert o[i, 0] == pos0 and l[i, 0] == 1
+        assert o[i, 1] in (1, 2) and o[i, 1] != pos0 and l[i, 1] == 0
+        assert o[i, 2] == pos1 and l[i, 2] == 1
+        negs = set(o[i, 3:5])
+        assert len(negs) == 2 and pos1 not in negs
+        assert negs.issubset({3, 4, 5, 6})
+    assert (m == 1).all()
+
+
+def test_match_matrix_and_topk_avg_pooling():
+    from paddle_tpu.contrib.layers import (match_matrix_tensor,
+                                           sequence_topk_avg_pooling)
+
+    B, TL, TR, D, C = 2, 3, 4, 5, 2
+    rng = np.random.RandomState(2)
+    xv = rng.randn(B, TL, D).astype(np.float32)
+    yv = rng.randn(B, TR, D).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="mmx", shape=[B, TL, D], dtype="float32")
+        y = fluid.data(name="mmy", shape=[B, TR, D], dtype="float32")
+        mm, _ = match_matrix_tensor(x, y, channel_num=C)
+        row_len = fluid.layers.fill_constant([B], "int32", TL)
+        col_len = fluid.layers.fill_constant([B], "int32", TR)
+        pooled = sequence_topk_avg_pooling(mm, row_len, col_len,
+                                           topks=[1, 3], channel_num=C)
+    got_mm, got_pool = _run(main, startup, {"mmx": xv, "mmy": yv},
+                            [mm, pooled])
+    # manual X*W*Y with the created parameter
+    assert got_mm.shape == (B, C, TL, TR)
+    # manual top-k avg over the op's own mm output
+    want = np.zeros((B, TL, C * 2), np.float32)
+    for b in range(B):
+        for c in range(C):
+            for r in range(TL):
+                row = np.sort(got_mm[b, c, r])[::-1]
+                want[b, r, c * 2 + 0] = row[:1].sum() / 1.0
+                want[b, r, c * 2 + 1] = row[:3].sum() / 3.0
+    np.testing.assert_allclose(got_pool, want, rtol=1e-5, atol=1e-6)
+
+
+def test_queue_ops_and_fetch_op_form():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="qx", shape=[2], dtype="float32")
+        blk = main.global_block()
+        blk.append_op("queue_generator", inputs={}, outputs={},
+                      attrs={"names": ["q_parity_test"]})
+        blk.append_op("enqueue", inputs={"X": [x]}, outputs={},
+                      attrs={"queue_name": "q_parity_test"})
+        deq = blk.create_var(name="deq_out")
+        blk.append_op("dequeue", inputs={}, outputs={"Out": [deq]},
+                      attrs={"queue_name": "q_parity_test"})
+        fetched = blk.create_var(name="fetch_form_out")
+        blk.append_op("fetch", inputs={"X": [deq]},
+                      outputs={"Out": [fetched]})
+    xv = np.asarray([4.0, 5.0], np.float32)
+    got = _run(main, None, {"qx": xv}, [fetched])[0]
+    np.testing.assert_allclose(got, xv)
+
+
+def test_recurrent_op_form():
+    """Hand-built recurrent op (time-major cumulative sum) matches
+    numpy — the op form loaded reference programs use."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="rx", shape=[4, 2], dtype="float32")  # [T, N]
+        h0 = fluid.data(name="rh", shape=[2], dtype="float32")
+        blk = main.global_block()
+        # step block reads the OUTER input name (the lowering slices
+        # op.inputs["inputs"] into the step env under the same names,
+        # like the reference's scope hierarchy does)
+        sub = main._create_block()
+        sub.create_var(name="rec_hprev", shape=(2,), dtype="float32")
+        sub.create_var(name="rec_hcur", shape=(2,), dtype="float32")
+        sub.append_op("elementwise_add",
+                      inputs={"X": ["rx"], "Y": ["rec_hprev"]},
+                      outputs={"Out": ["rec_hcur"]}, attrs={"axis": -1})
+        main._rollback()
+        out = blk.create_var(name="rec_hcur")  # outputs match by name
+        blk.append_op(
+            "recurrent",
+            inputs={"inputs": [x], "initial_states": [h0]},
+            outputs={"outputs": [out]},
+            attrs={"sub_block": sub, "ex_states": ["rec_hprev"],
+                   "states": ["rec_hcur"], "reverse": False})
+    xv = np.arange(8, dtype=np.float32).reshape(4, 2)
+    hv = np.zeros(2, np.float32)
+    got = _run(main, None, {"rx": xv, "rh": hv}, [out])[0]
+    np.testing.assert_allclose(got, np.cumsum(xv, axis=0))
+
+
+def test_cross_entropy_grad2_op_form():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        dy = fluid.data(name="ce_dy", shape=[3, 1], dtype="float32")
+        mx = fluid.data(name="ce_mx", shape=[3, 1], dtype="float32")
+        lb = fluid.data(name="ce_lb", shape=[3, 1], dtype="int64")
+        xs = blk.create_var(name="ce_xshape")
+        dx = blk.create_var(name="ce_dx")
+        blk.append_op(
+            "cross_entropy_grad2",
+            inputs={"Y@GRAD": [dy], "MatchX": [mx], "Label": [lb],
+                    "XShape": [xs]},
+            outputs={"X@GRAD": [dx]}, attrs={"class_num": 4})
+    dyv = np.asarray([[1.0], [2.0], [3.0]], np.float32)
+    mxv = np.asarray([[0.5], [0.25], [0.1]], np.float32)
+    lbv = np.asarray([[0], [2], [3]], np.int64)
+    # XShape input is declared but empty-shaped; feed a dummy
+    import paddle_tpu.framework.scope as scope_mod
+    exe = fluid.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        scope_mod.global_scope().set("ce_xshape",
+                                     np.zeros((0,), np.float32))
+        got = np.asarray(exe.run(
+            main, feed={"ce_dy": dyv, "ce_mx": mxv, "ce_lb": lbv},
+            fetch_list=[dx])[0])
+    want = np.zeros((3, 4), np.float32)
+    for i, (d, m, l) in enumerate(zip(dyv, mxv, lbv)):
+        want[i, int(l)] = -d / m
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_deformable_psroi_pooling():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = fluid.data(name="dp_x", shape=[1, 4, 8, 8], dtype="float32")
+        rois = fluid.data(name="dp_r", shape=[1, 4], dtype="float32")
+        out = blk.create_var(name="dp_out")
+        cnt = blk.create_var(name="dp_cnt")
+        blk.append_op(
+            "deformable_psroi_pooling",
+            inputs={"Input": [x], "ROIs": [rois]},
+            outputs={"Output": [out], "TopCount": [cnt]},
+            attrs={"no_trans": True, "spatial_scale": 1.0,
+                   "output_dim": 1, "group_height": 2, "group_width": 2,
+                   "pooled_height": 2, "pooled_width": 2,
+                   "part_height": 2, "part_width": 2,
+                   "sample_per_part": 2, "trans_std": 0.0})
+    # channel c constant value c: each pooled bin reads its PS channel
+    xv = np.zeros((1, 4, 8, 8), np.float32)
+    for c in range(4):
+        xv[0, c] = c
+    rv = np.asarray([[0, 0, 7, 7]], np.float32)
+    got = _run(main, None, {"dp_x": xv, "dp_r": rv}, [out])[0]
+    # bin (i,j) pools channel (0*2+i)*2+j = 2i+j -> value 2i+j
+    want = np.asarray([[[[0.0, 1.0], [2.0, 3.0]]]], np.float32)
+    np.testing.assert_allclose(got, want, atol=1e-5)
