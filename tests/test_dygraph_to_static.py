@@ -685,3 +685,35 @@ def test_int_keyed_dict_tensor_index_and_defensive_to_variable():
         xv = to_variable(np.asarray([1.0, 2.0], np.float32))
         out = f(xv, np.int64(1))
     np.testing.assert_allclose(out.numpy(), [3.0, 6.0], rtol=1e-6)
+
+
+def test_save_and_serve_list_decoder(tmp_path):
+    """A converted decoder using the list->TensorArray machinery must
+    survive save_inference_model -> AnalysisPredictor (the host-while
+    op serializes its sub-blocks and the predictor's hybrid executor
+    runs them)."""
+    @declarative
+    def decode(x, n):
+        outs = []
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        state = x
+        while i < n:
+            state = state * 0.5 + 1.0
+            outs.append(state)
+            i = i + 1
+        return fluid.layers.concat(outs, axis=0)
+
+    with dygraph.guard():
+        x = to_variable(np.zeros((1, 3), np.float32))
+        n = to_variable(np.asarray([4], np.int64))
+        want = decode(x, n).numpy()
+        decode.save_inference_model(str(tmp_path), x, n)
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference import Config, create_paddle_predictor
+    from paddle_tpu.inference import PaddleTensor
+
+    pred = create_paddle_predictor(Config(str(tmp_path)))
+    outs = pred.run([PaddleTensor(np.zeros((1, 3), np.float32)),
+                     PaddleTensor(np.asarray([4], np.int64))])
+    np.testing.assert_allclose(np.asarray(outs[0].data), want, rtol=1e-6)
