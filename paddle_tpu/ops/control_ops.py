@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import op, infer_for
+from .registry import grad_maker, infer_for, op
 from ..framework.core import Block
 
 
@@ -50,6 +50,28 @@ def _concrete_bool(v) -> bool:
     import numpy as _np
 
     return bool(_np.asarray(v).ravel()[0])
+
+
+def _host_while(cb, bb, base_env, carry_names, cond_out, body_out_names,
+                init, on_step=None):
+    """The ONE host while-loop protocol (forward host path and the grad
+    op's replay both use it): evaluate cond on a copy of the live env,
+    run the body, rebind carries positionally; ``on_step(carry)`` sees
+    the carry BEFORE each executed step (trajectory recording)."""
+    local = dict(base_env)
+    local.update(zip(carry_names, init))
+    while True:
+        e = dict(local)
+        _run_block(cb, e)
+        if not _concrete_bool(e[cond_out]):
+            break
+        if on_step is not None:
+            on_step([local[n] for n in carry_names])
+        e = dict(local)
+        _run_block(bb, e)
+        local.update(
+            {cn: e[bn] for cn, bn in zip(carry_names, body_out_names)})
+    return [local[n] for n in carry_names]
 
 
 @op("cond")
@@ -101,11 +123,13 @@ def _cond_infer(op_, block):
             dst.dtype = src.dtype
 
 
-@op("while_loop", no_grad=True)
+@op("while_loop")
 def _while_loop(ctx):
     """layers.while_loop: functional carry over cond/body sub-blocks.
-    (lax.while_loop is not reverse-differentiable; use lax.scan-style
-    fixed-length loops for differentiable recurrence.)"""
+    Differentiable via the while_loop_grad host op below (forward
+    replay + reverse vjp sweep); lax.while_loop itself is not
+    reverse-differentiable, so fixed-length recurrence should still
+    prefer the lax.scan-style rnn layers for speed."""
     cb = _resolve_block(ctx, "cond_block")
     bb = _resolve_block(ctx, "body_block")
     carry_names = ctx.attr("carry_names", [])
@@ -121,18 +145,9 @@ def _while_loop(ctx):
         # architecture (while_op.cc: Executor per iteration).  Needed
         # for dynamic-length TensorArray carries (d2s list appends),
         # which mutate by object identity across iterations.
-        local = dict(base_env)
-        local.update(zip(carry_names, carry_vals))
-        while True:
-            e = dict(local)
-            _run_block(cb, e)
-            if not _concrete_bool(e[cond_out]):
-                break
-            e = dict(local)
-            _run_block(bb, e)
-            local.update(
-                {cn: e[bn] for cn, bn in zip(carry_names, body_out_names)})
-        ctx.set_out("Out", [local[n] for n in carry_names])
+        ctx.set_out("Out", _host_while(
+            cb, bb, base_env, carry_names, cond_out, body_out_names,
+            list(carry_vals)))
         return
 
     def cond_fun(carry):
@@ -149,6 +164,124 @@ def _while_loop(ctx):
 
     outs = lax.while_loop(cond_fun, body_fun, init)
     ctx.set_out("Out", list(outs))
+
+
+@op("while_loop_grad", host=True)
+def _while_loop_grad(ctx):
+    """Reverse pass for while_loop (reference: controlflow/while_op.cc
+    WhileGradOp — inner executor over the grad block per step).
+    TPU-native shape: REPLAY the forward host loop recording each
+    step's carries (rematerialization instead of the reference's saved
+    step scopes), then sweep backward applying jax.vjp of the traced
+    body per iteration; free-var (parameter) cotangents accumulate
+    across steps.  Integer carries (loop counters) ride the recorded
+    trajectory and get no cotangent."""
+    cb = _resolve_block(ctx, "cond_block")
+    bb = _resolve_block(ctx, "body_block")
+    if _blocks_contain_host([cb, bb]):
+        raise NotImplementedError(
+            "while_loop grad over host state (TensorArray writes) is "
+            "not differentiable — use while_loop tensor carries or the "
+            "rnn layers for trainable recurrence")
+    carry_names = ctx.attr("carry_names", [])
+    cond_out = ctx.attr("cond_out_name")
+    body_out_names = ctx.attr("body_out_names", [])
+    free_names = ctx.attr("input_names", [])
+    free_vals = ctx.ins("Input")
+    init = list(ctx.ins("X"))
+
+    # ---- forward replay, recording the carry BEFORE each step ----------
+    traj = []
+    carry = _host_while(cb, bb, dict(zip(free_names, free_vals)),
+                        carry_names, cond_out, body_out_names, init,
+                        on_step=lambda c: traj.append(list(c)))
+
+    def _is_diff(v):
+        return hasattr(v, "dtype") and jnp.issubdtype(
+            jnp.result_type(v), jnp.inexact)
+
+    diff_c = [i for i, v in enumerate(init) if _is_diff(v)]
+    diff_f = [i for i, v in enumerate(free_vals) if _is_diff(v)]
+
+    # ---- incoming cotangents for the final carries ---------------------
+    gouts = ctx.ins("Out@GRAD", missing_ok=True)
+    g_full = [gouts[i] if (i < len(gouts) and gouts[i] is not None)
+              else jnp.zeros_like(carry[i]) for i in range(len(carry))]
+    g_carry = [g_full[i] for i in diff_c]
+    g_free = [jnp.zeros_like(free_vals[i]) for i in diff_f]
+
+    def step_diff(diff_carry_vals, diff_free_vals, nondiff_carry):
+        local = dict(zip(free_names, free_vals))
+        for j, i in enumerate(diff_f):
+            local[free_names[i]] = diff_free_vals[j]
+        cvals = list(nondiff_carry)
+        for j, i in enumerate(diff_c):
+            cvals[i] = diff_carry_vals[j]
+        local.update(zip(carry_names, cvals))
+        _run_block(bb, local)
+        outs = [local[n] for n in body_out_names]
+        return tuple(outs[i] for i in diff_c)
+
+    # ---- reverse sweep -------------------------------------------------
+    for t in range(len(traj) - 1, -1, -1):
+        c_t = traj[t]
+        dvals = tuple(c_t[i] for i in diff_c)
+        fvals = tuple(free_vals[i] for i in diff_f)
+        _, vjp_fn = jax.vjp(
+            lambda dc, df: step_diff(dc, df, c_t), dvals, fvals)
+        d_carry, d_free = vjp_fn(tuple(g_carry))
+        g_carry = list(d_carry)
+        g_free = [a + b for a, b in zip(g_free, d_free)]
+
+    # ---- scatter back to full (diff + zero) grads ----------------------
+    gx = [None] * len(init)
+    for j, i in enumerate(diff_c):
+        gx[i] = g_carry[j]
+    for i, v in enumerate(init):
+        if gx[i] is None:
+            gx[i] = jnp.zeros_like(v) if hasattr(v, "dtype") else None
+    gf = [None] * len(free_vals)
+    for j, i in enumerate(diff_f):
+        gf[i] = g_free[j]
+    for i, v in enumerate(free_vals):
+        if gf[i] is None:
+            gf[i] = jnp.zeros_like(v) if hasattr(v, "dtype") else None
+    ctx.set_out("X@GRAD", gx)
+    ctx.set_out("Input@GRAD", gf)
+
+
+@grad_maker("while_loop_grad")
+def _while_loop_second_order(op_, no_grad_names=frozenset()):
+    # only reached when a grad-of-grad pass actually NEEDS cotangents
+    # through the loop (backward.py gates on known_grads): fail loudly
+    # instead of silently dropping the loop's second-order contribution
+    raise NotImplementedError(
+        "second-order gradients through while_loop are not supported — "
+        "rewrite the recurrence with the scan-based rnn layers")
+
+
+@grad_maker("while_loop")
+def _while_loop_grad_maker(op_, no_grad_names=frozenset()):
+    from ..framework.core import EMPTY_VAR_NAME, GRAD_SUFFIX
+
+    def g(names):
+        return [n + GRAD_SUFFIX if n not in no_grad_names
+                else EMPTY_VAR_NAME for n in names]
+
+    return [dict(
+        type="while_loop_grad",
+        inputs={
+            "X": op_.input("X"),
+            "Input": op_.input("Input"),
+            "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX
+                                  for n in op_.output("Out")],
+        },
+        outputs={
+            "X" + GRAD_SUFFIX: g(op_.input("X")),
+            "Input" + GRAD_SUFFIX: g(op_.input("Input")),
+        },
+        attrs=dict(op_.attrs),
+    )]
 
 
 @infer_for("while_loop")

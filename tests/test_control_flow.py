@@ -107,3 +107,61 @@ def test_switch_case():
     np.testing.assert_allclose(
         exe.run(main, feed={"idx": np.array([7.0], np.float32)},
                 fetch_list=[out])[0], [-1.0, -1.0])
+
+
+# ---------------------------------------------------------------------------
+# while_loop backward (reference: controlflow/while_op.cc WhileGradOp)
+# ---------------------------------------------------------------------------
+def test_while_loop_grad_matches_unrolled():
+    """d(loss)/d(w), d(loss)/d(x) through a tensor-bound while_loop must
+    equal the hand-unrolled composition: s_{t+1} = s_t * w + x, T=3."""
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    T = 3
+
+    def build(unrolled):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="wg_x", shape=[2], dtype="float32")
+            x.stop_gradient = False
+            w = fluid.layers.create_parameter(
+                [2], "float32", name="wg_w",
+                default_initializer=fluid.initializer.ConstantInitializer(
+                    0.5))
+            if unrolled:
+                s = x * 0.0
+                for _ in range(T):
+                    s = s * w + x
+            else:
+                i = fluid.layers.fill_constant([1], "int64", 0)
+                n = fluid.layers.fill_constant([1], "int64", T)
+                s0 = x * 0.0
+
+                def cond(i, s):
+                    return fluid.layers.less_than(i, n)
+
+                def body(i, s):
+                    return i + 1, s * w + x
+
+                _, s = fluid.layers.while_loop(cond, body, [i, s0])
+            loss = fluid.layers.reduce_sum(s)
+            gmap = dict(fluid.backward.append_backward(loss))
+            gw = gmap[w]
+        return main, startup, loss, gw, "wg_x@GRAD"
+
+    import numpy as np
+    xv = np.asarray([1.0, 2.0], np.float32)
+    res = {}
+    for tag, unrolled in (("loop", False), ("unroll", True)):
+        main, startup, loss, gw, gx = build(unrolled)
+        exe = fluid.Executor(pt.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            res[tag] = [np.asarray(v) for v in exe.run(
+                main, feed={"wg_x": xv}, fetch_list=[loss, gw, gx])]
+    for a, b in zip(res["loop"], res["unroll"]):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+    # analytic: s3 = x*(w^2 + w + 1); d loss/dx = w^2 + w + 1 = 1.75
+    np.testing.assert_allclose(res["loop"][2], [1.75, 1.75], rtol=1e-5)
