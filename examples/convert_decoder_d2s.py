@@ -1,0 +1,68 @@
+"""dygraph_to_static example: a greedy decoder written the dygraph way
+(python list collecting step outputs, tensor-bound while, early pop),
+converted with @declarative, checked against eager, and exported as an
+inference model served through AnalysisPredictor.
+
+Run: python examples/convert_decoder_d2s.py [--tiny]
+(--tiny is accepted for the CI smoke; behavior is identical.)
+"""
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import ProgramTranslator, declarative, to_variable
+
+
+@declarative
+def decode(x, max_len):
+    outs = []
+    i = fluid.layers.fill_constant([1], "int64", 0)
+    state = x
+    while i < max_len:
+        state = state * 0.5 + 1.0
+        outs.append(state)
+        i = i + 1
+        if fluid.layers.reduce_mean(state) < 1.9:
+            continue
+        outs.pop()  # drop steps whose mean saturated
+    return fluid.layers.concat(outs, axis=0)
+
+
+def main():
+    with dygraph.guard():
+        x = to_variable(np.zeros((1, 4), np.float32))
+        n = to_variable(np.asarray([6], np.int64))
+        converted = decode(x, n).numpy()
+
+        ProgramTranslator().enable(False)   # eager mirror
+        eager = decode(x, n).numpy()
+        ProgramTranslator().enable(True)
+
+        np.testing.assert_allclose(converted, eager, rtol=1e-6)
+        print(f"step outputs: {converted.shape[0]} kept, "
+              f"converted == eager")
+
+        export_dir = tempfile.mkdtemp()
+        decode.save_inference_model(export_dir, x, n)
+
+    from paddle_tpu.inference import (Config, PaddleTensor,
+                                      create_paddle_predictor)
+
+    pred = create_paddle_predictor(Config(export_dir))
+    outs = pred.run([PaddleTensor(np.zeros((1, 4), np.float32)),
+                     PaddleTensor(np.asarray([6], np.int64))])
+    np.testing.assert_allclose(np.asarray(outs[0].data), converted,
+                               rtol=1e-6)
+    shutil.rmtree(export_dir, ignore_errors=True)
+    print("served decoder matches: OK")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
