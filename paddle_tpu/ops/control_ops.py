@@ -295,7 +295,7 @@ def _while_infer(op_, block):
             dst.dtype = src.dtype
 
 
-@op("while", no_grad=True)
+@op("while")
 def _while(ctx):
     """Old-style fluid While op: block updates the condition var itself.
     Carry = (cond, *carried vars); reference: controlflow/while_op.cc."""
@@ -334,6 +334,20 @@ def _while(ctx):
     # carried vars keep their own names (reference While mutates in place)
     ctx.set_out("CondOut", outs[0])
     ctx.set_out("XOut", list(outs[1:]))
+
+
+@grad_maker("while")
+def _while_grad_maker(op_, no_grad_names=frozenset()):
+    # only reached when backward actually NEEDS cotangents through the
+    # op (backward.py gates on known_grads): the in-place carry names
+    # of the old-style While make grad plumbing ambiguous, so training
+    # recurrence must use while_loop (differentiable above) or the
+    # scan-based rnn layers — fail loudly instead of silently emitting
+    # zero grads
+    raise NotImplementedError(
+        "gradients through the old-style While op are not supported — "
+        "build the loop with layers.while_loop (differentiable) or the "
+        "rnn layers")
 
 
 @infer_for("while")

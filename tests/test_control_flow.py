@@ -191,3 +191,30 @@ def test_cond_grad_selects_taken_branch():
             g = np.asarray(exe.run(main, feed={"cg_x": v},
                                    fetch_list=["cg_x@GRAD"])[0])
             np.testing.assert_allclose(g, [want, want], rtol=1e-6)
+
+
+def test_old_style_while_grad_raises_loudly():
+    """Backward through the old-style While op must raise with guidance
+    (silent zero grads would be a wrong-result trap); forward-only
+    programs keep working."""
+    import paddle_tpu.fluid as fluid
+    import numpy as np
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="ow_x", shape=[2], dtype="float32")
+        x.stop_gradient = False
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.fill_constant([1], "int64", 3)
+        s = fluid.layers.fill_constant([2], "float32", 0.0)
+        s.stop_gradient = False
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            fluid.layers.assign(s + x, s)
+            fluid.layers.increment(i)
+            fluid.layers.assign(fluid.layers.less_than(i, n), cond)
+        loss = fluid.layers.reduce_sum(s)
+        with pytest.raises(NotImplementedError, match="while_loop"):
+            fluid.backward.append_backward(loss)
