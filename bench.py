@@ -45,6 +45,29 @@ def _best_of(run_once, repeats=None):
     return max(vals)
 
 
+def _apply_bench_flags():
+    """BENCH_NHWC / BENCH_STEP_SESSION env knobs -> framework flags, so
+    the r6 levers can be A/B'd from the shell without code edits:
+    BENCH_NHWC=0|1|auto (default auto: on-accelerator only) gates the
+    layout_transform_pass, BENCH_STEP_SESSION=0|1 (default 1) gates the
+    executor's device-resident state session."""
+    from paddle_tpu.utils import flags as _flags
+
+    updates = {}
+    nhwc = os.environ.get("BENCH_NHWC")
+    if nhwc is not None:
+        updates["tpu_nhwc"] = nhwc
+    sess = os.environ.get("BENCH_STEP_SESSION")
+    if sess is not None:
+        # set_flags coerces via the bool default ("1/true/yes/on",
+        # case-insensitive)
+        updates["tpu_step_session"] = sess
+    if updates:
+        _flags.set_flags(updates)
+    return {"nhwc": _flags.flag("tpu_nhwc"),
+            "step_session": _flags.flag("tpu_step_session")}
+
+
 def bench_resnet50(batch=128, steps=240, warmup=3, image=224, classes=1000,
                    amp=True):
     import jax
@@ -52,6 +75,8 @@ def bench_resnet50(batch=128, steps=240, warmup=3, image=224, classes=1000,
     import paddle_tpu as pt
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models.resnet import build_resnet
+
+    _apply_bench_flags()
 
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 1
@@ -99,6 +124,8 @@ def bench_lenet(batch=256, steps=30, warmup=5):
     import paddle_tpu as pt
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models.lenet import build_lenet
+
+    _apply_bench_flags()
 
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 1
@@ -501,6 +528,7 @@ def main():
                           "value": round(eps, 1), "unit": "examples/sec",
                           "vs_baseline": None, **_LAST_STATS}))
         return
+    bench_cfg = _apply_bench_flags()
     ips = bench_resnet50(
         batch=int(os.environ.get("BENCH_BATCH", "128")),
         steps=int(os.environ.get("BENCH_STEPS", "240")),
@@ -521,6 +549,7 @@ def main():
         "value": round(ips, 1),
         "unit": "images/sec",
         "vs_baseline": round(ips / prev, 3) if prev else None,
+        **bench_cfg,
         **_LAST_STATS,
     }))
 

@@ -22,6 +22,7 @@ XLA so updates are in-place in HBM.
 from __future__ import annotations
 
 import logging
+import weakref
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -47,7 +48,7 @@ class _Compiled:
     run path never re-partitions per step."""
 
     __slots__ = ("fn", "raw_fn", "state_in", "state_out", "fetch_names",
-                 "donatable", "readonly", "hybrid")
+                 "donatable", "readonly", "hybrid", "feed_plan", "session")
 
     def __init__(self, fn, state_in, state_out, fetch_names):
         self.fn = fn
@@ -58,6 +59,52 @@ class _Compiled:
         self.donatable = ()
         self.readonly = ()
         self.hybrid = False
+        # per-compilation step-loop plans (built once in _compile /
+        # first _execute, reused every step):
+        self.feed_plan = None   # {feed name: numpy dtype to cast to|None}
+        self.session = None     # _StateSession — device-resident state
+
+
+class _StateSession:
+    """Device-resident state carried across steps of one (compiled,
+    scope) pair: after a step, the donated inputs are dead and
+    ``new_state`` holds their replacements — rebinding next step from
+    here skips the scope.get + isinstance + device_put walk over every
+    parameter/optimizer slot.  Invalidation is scope-mutation-counted:
+    any scope write outside the executor's own post-step writeback
+    (checkpoint load, manual set) bumps ``Scope.mutation_counter`` past
+    the recorded stamp and forces a full re-read.
+
+    ``mut`` (params + optimizer moments — the model-sized piece) holds
+    WEAK references: while the session is valid the scope's own entries
+    keep the arrays alive (they are the same objects), and once
+    something overwrites the scope the old state is free to be
+    collected — an abandoned session can never pin a second copy of the
+    model in device memory.  ``ro`` holds STRONG references: read-only
+    state is typically small (LR schedules, eval-side constants) and —
+    unlike mut — its device copy may exist nowhere else when the scope
+    holds a host-side value (numpy / LoDTensor) that state_val converted;
+    a weak ref there would die instantly and silently disable the
+    session for the rest of the run."""
+
+    __slots__ = ("scope_ref", "stamp", "mut", "ro")
+
+    def __init__(self, scope_ref, stamp, mut, ro):
+        self.scope_ref = scope_ref
+        self.stamp = stamp
+        self.mut = mut    # {name: weakref to device array}
+        self.ro = ro      # {name: device array} (strong)
+
+    def deref(self):
+        """(mut, ro) as strong dicts, or None if any mut value was
+        collected (only possible after an unstamped mutation path)."""
+        mut = {}
+        for n, r in self.mut.items():
+            v = r()
+            if v is None:
+                return None
+            mut[n] = v
+        return mut, self.ro
 
 
 def _fetch_name(f) -> str:
@@ -114,6 +161,18 @@ def analyze_state(ops, block, feed_names, scope, skip_suffixes=()):
         if RNG_VAR not in state_out:
             state_out.append(RNG_VAR)
     return state_in, state_out, uses_rng, has_host_ops
+
+
+def build_feed_plan(block, feed):
+    """Compile-time feed-conversion plan: target numpy dtype per feed
+    name (None = leave as-is).  Shared by the single-device executor and
+    the DP runner so the per-step conversion rules can't drift apart."""
+    plan = {}
+    for k in feed:
+        var = block._find_var_recursive(k)
+        plan[k] = (to_numpy_dtype(var.dtype)
+                   if var is not None and var.dtype is not None else None)
+    return plan
 
 
 def _float_outputs(op_, env):
@@ -176,6 +235,13 @@ class Executor:
         self._cache: Dict[tuple, _Compiled] = {}
         self._closed = False
 
+    def _nhwc_enabled(self) -> bool:
+        """FLAGS_tpu_nhwc resolved against this executor's place
+        ("auto" -> on-accelerator only)."""
+        from .utils.flags import nhwc_enabled
+
+        return nhwc_enabled(self.place)
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -217,6 +283,7 @@ class Executor:
         unused_check = bool(flag("enable_unused_var_check"))
         ir_passes = bool(flag("apply_ir_passes"))
         donate = bool(flag("tpu_donate_buffers"))
+        nhwc = self._nhwc_enabled()
         feed_spec = tuple(
             sorted(
                 (k, tuple(np.shape(v)),
@@ -225,7 +292,7 @@ class Executor:
             )
         )
         key = (program._uid, program._version, feed_spec, tuple(fetch_names),
-               check_nan_inf, unused_check, ir_passes, donate)
+               check_nan_inf, unused_check, ir_passes, donate, nhwc)
         hit = self._cache.get(key)
         if hit is not None:
             return hit
@@ -235,6 +302,11 @@ class Executor:
         state_in, state_out, uses_rng, has_host_ops = analyze_state(
             block.ops, block, feed, scope
         )
+
+        # feed-conversion plan: the target numpy dtype per feed name is a
+        # compile-time fact (the cache key pins feed names/shapes/dtypes),
+        # so the per-step loop never consults block vars again
+        feed_plan = build_feed_plan(block, feed)
 
         ops = list(block.ops)
         if unused_check:
@@ -357,6 +429,7 @@ class Executor:
             compiled = _Compiled(hybrid_call, state_in, state_out, fetch)
             compiled.raw_fn = hybrid_call
             compiled.hybrid = True
+            compiled.feed_plan = feed_plan
             self._cache[key] = compiled
             return compiled
 
@@ -407,6 +480,7 @@ class Executor:
         compiled.raw_fn = fn
         compiled.donatable = tuple(donatable)
         compiled.readonly = tuple(readonly)
+        compiled.feed_plan = feed_plan
         self._cache[key] = compiled
         return compiled
 
@@ -431,6 +505,10 @@ class Executor:
                        get_pass("fuse_bn_act_pass", protected=protected)]
         if types & set(_FUSABLE_OPT):
             passes.append(get_pass("fuse_optimizer_ops_pass"))
+        if self._nhwc_enabled() and types & {"conv2d", "depthwise_conv2d"}:
+            # after the bn fusions so the NHWC walk sees the fused ops
+            passes.append(get_pass("layout_transform_pass",
+                                   protected=protected))
         if not passes:
             return program
         clone = Program.from_desc_dict(program.desc_dict())
@@ -441,28 +519,35 @@ class Executor:
     # ------------------------------------------------------------------
     def _execute(self, compiled, feed, fetch_names, scope, return_numpy, program):
         device = self.place.jax_device()
-        block = program.global_block()
 
+        # ---- feed conversion: plan precomputed at compile time (dtype
+        # per name), so the step loop does no block-var lookups.  The
+        # H2D transfers are issued FIRST and asynchronously (device_put
+        # returns before the copy lands), so the host-side state binding
+        # below overlaps the transfer — the same pipelining idea as the
+        # hybrid path's copy_to_host_async D2H (double-buffering: while
+        # step N's dispatch consumes the staged feed, step N+1's run()
+        # call starts its transfer before touching state).
+        plan = compiled.feed_plan or {}
+        hybrid = compiled.hybrid
         feed_vals = {}
         for k, v in feed.items():
             if isinstance(v, LoDTensor):
                 v = v.value()
-            var = block._find_var_recursive(k)
             if isinstance(v, jax.Array):
-                # already on device: no host round-trip, device_put is a
-                # no-op when placement matches
-                feed_vals[k] = jax.device_put(v, device)
+                # already on device: skip even the device_put no-op when
+                # placement matches (the bench/reader staged path)
+                feed_vals[k] = v if v.devices() == {device} \
+                    else jax.device_put(v, device)
                 continue
             arr = np.asarray(v)
-            if var is not None and var.dtype is not None:
-                want = to_numpy_dtype(var.dtype)
-                if arr.dtype != want:
-                    arr = arr.astype(want)
+            want = plan.get(k)
+            if want is not None and arr.dtype != want:
+                arr = arr.astype(want)
             # hybrid (PS) programs: keep feeds host-side — host ops (e.g.
             # distributed_lookup_table reading feed ids) then cost no D2H
             # round-trip; jit segments device_put what they consume
-            feed_vals[k] = arr if compiled.hybrid else \
-                jax.device_put(arr, device)
+            feed_vals[k] = arr if hybrid else jax.device_put(arr, device)
 
         def state_val(name):
             if name == RNG_VAR:
@@ -488,19 +573,52 @@ class Executor:
             return val
 
         from .profiler import RecordEvent
+        from .utils.flags import flag as _flag
 
+        use_session = not hybrid and bool(_flag("tpu_step_session", True))
         with RecordEvent("executor_run"):
-            if compiled.hybrid:
+            if hybrid:
                 state_vals = {n: state_val(n) for n in compiled.state_in}
                 fetched, new_state = compiled.fn(feed_vals, state_vals)
             else:
-                # hot path: mut/ro partition precomputed at compile time
-                mut = {n: state_val(n) for n in compiled.donatable}
-                ro = {n: state_val(n) for n in compiled.readonly}
+                # hot path: mut/ro partition precomputed at compile
+                # time; the state binding itself comes from the step
+                # session when the scope hasn't been touched since our
+                # own writeback — zero scope reads per step
+                sess = compiled.session if use_session else None
+                bound = None
+                if (sess is not None and sess.scope_ref() is scope
+                        and sess.stamp == Scope.mutation_counter):
+                    bound = sess.deref()
+                if bound is not None:
+                    mut, ro = bound
+                else:
+                    if sess is not None:
+                        compiled.session = None  # stale — drop promptly
+                    mut = {n: state_val(n) for n in compiled.donatable}
+                    ro = {n: state_val(n) for n in compiled.readonly}
                 fetched, new_state = compiled.fn(mut, ro, feed_vals)
         scope_set = scope.set
         for name, val in new_state.items():
             scope_set(name, val)
+        if use_session:
+            # rebind next step's state from this step's outputs: the
+            # donated input buffers are dead, their replacements are in
+            # new_state (now also held by the scope); read-only state is
+            # still alive as-is
+            try:
+                mut_refs = {n: weakref.ref(new_state[n])
+                            for n in compiled.donatable}
+            except (KeyError, TypeError):
+                # a donated var wasn't produced, or a state value isn't
+                # weakref-able (SelectedRows pytree) — no session
+                compiled.session = None
+            else:
+                compiled.session = _StateSession(
+                    weakref.ref(scope), Scope.mutation_counter,
+                    mut_refs, ro)
+        elif not hybrid:
+            compiled.session = None
 
         if fetch_names:
             if return_numpy:

@@ -27,6 +27,10 @@ class LoDTensor:
 
     def set(self, array, place=None):
         self._value = np.asarray(array)
+        # the tensor may live in a scope slot (find_var().get_tensor()
+        # .set(...) is the reference feed/init idiom): invalidate any
+        # executor step session holding device-resident copies
+        Scope.mutation_counter += 1
 
     def set_lod(self, lod):
         self._lod = lod
@@ -62,6 +66,16 @@ class LoDTensor:
 
 
 class Scope:
+    #: process-wide write stamp: every value mutation of ANY scope bumps
+    #: it.  The executor's step session (executor._StateSession) records
+    #: the stamp after its own post-step writeback; a mismatch next step
+    #: means someone else wrote a scope (checkpoint load, manual set,
+    #: another executor) and the device-resident state must be re-read.
+    #: Process-wide (not per-scope) because Scope.set writes through the
+    #: parent chain — a parent-scope write must invalidate sessions
+    #: holding a child scope.
+    mutation_counter: int = 0
+
     def __init__(self, parent: Optional["Scope"] = None):
         self._vars: Dict[str, Any] = {}
         self._parent = parent
@@ -73,6 +87,7 @@ class Scope:
         with self._lock:
             if name not in self._vars:
                 self._vars[name] = None
+                Scope.mutation_counter += 1
         return _ScopeSlot(self, name)
 
     def find_var(self, name: str) -> Optional["_ScopeSlot"]:
@@ -113,6 +128,7 @@ class Scope:
 
     def set(self, name: str, value):
         # write where the name already lives (parent-chain), else locally
+        Scope.mutation_counter += 1
         s = self
         while s is not None:
             if name in s._vars:
@@ -122,6 +138,7 @@ class Scope:
         self._vars[name] = value
 
     def erase(self, names):
+        Scope.mutation_counter += 1
         for n in names:
             self._vars.pop(n, None)
 
@@ -141,6 +158,7 @@ class _ScopeSlot:
         if not isinstance(v, LoDTensor):
             v = LoDTensor(v)
             self._scope._vars[self._name] = v
+            Scope.mutation_counter += 1
         return v
 
     def get(self):

@@ -177,12 +177,31 @@ def cond(pred: Variable, true_fn: Callable = None, false_fn: Callable = None,
     # semantics, matching the d2s dispatch fallback)
     merged: List = [None] * len(t_out)
     var_idx: List[int] = []
+    def _equal_plain_values(a, b):
+        """Equal non-Variable values bound separately in each branch
+        (e.g. `x = 0.5` in both bodies) are distinct objects — identity
+        fails but the merge is still unambiguous.  Guarded: types whose
+        __eq__ is elementwise or raising (numpy arrays...) count as not
+        equal and fall through to the error below."""
+        if type(a) is not type(b):
+            return False
+        try:
+            return bool(a == b)
+        except Exception:
+            return False
+
     for i, (tv, fv) in enumerate(zip(t_out, f_out)):
         if (not isinstance(tv, Variable) and not isinstance(fv, Variable)
-                and tv is fv):
+                and (tv is fv or _equal_plain_values(tv, fv))):
             merged[i] = tv
         elif isinstance(tv, Variable) and isinstance(fv, Variable):
             var_idx.append(i)
+        elif type(tv) is type(fv) and not isinstance(tv, Variable):
+            raise ValueError(
+                f"cond output {i}: branches return unequal python "
+                f"{type(tv).__name__} values ({tv!r} vs {fv!r}) — a "
+                "value that differs by branch must be a tensor; bind it "
+                "with fill_constant (or return the same value)")
         else:
             raise ValueError(
                 f"cond output {i}: branches return incompatible kinds "

@@ -591,6 +591,11 @@ def detection_map(detect_res, label, class_num, background_label=0,
     m = helper.create_variable_for_type_inference(VarType.FP32)
     inputs = {"DetectRes": [detect_res], "Label": [label]}
     outputs = {"MAP": [m]}
+    if has_state is not None:
+        # HasState==0 makes the op drop its accumulated _MapState and
+        # start fresh (detection_map_op.h) — DetectionMAP.reset() zeroes
+        # this var between epochs
+        inputs["HasState"] = [has_state]
     if input_states is not None:
         inputs["PosCount"] = [input_states[0]]
     if out_states is None:

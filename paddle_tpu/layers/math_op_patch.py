@@ -79,6 +79,11 @@ def _getitem_impl(self, item):
 
     items = list(item) if isinstance(item, tuple) else [item]
     ndim = len(self.shape)
+    if sum(1 for it in items if it is Ellipsis) > 1:
+        # numpy semantics: a second Ellipsis is ambiguous, not a
+        # zero-length expansion (x[..., ..., 0] must not mean x[0])
+        raise IndexError(
+            "an index can only have a single ellipsis ('...')")
     if any(it is Ellipsis for it in items):
         n_spec = sum(1 for it in items if it is not Ellipsis)
         expanded = []

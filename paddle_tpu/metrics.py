@@ -207,12 +207,47 @@ class DetectionMAP(object):
         states = [tensor.create_global_var(
             [1], 0.0, "float32", persistable=True,
             name=f"_map_state_{i}_{id(self)}") for i in range(3)]
+        # has_state flag (reference: fluid/metrics.py DetectionMAP): 0
+        # tells the op to drop the accumulator; every run sets it back
+        # to 1, reset(exe) zeroes it
+        self.has_state = tensor.create_global_var(
+            [1], 0, "int32", persistable=True,
+            name=f"_map_has_state_{id(self)}")
         self.accum_map = detection.detection_map(
             input, label, class_num, background_label,
             overlap_threshold=overlap_threshold,
             evaluate_difficult=evaluate_difficult,
+            has_state=self.has_state,
             input_states=states, out_states=states, ap_version=ap_version)
-        self.has_state = states[0]
+        tensor.fill_constant(shape=[1], dtype="int32", value=1,
+                             out=self.has_state)
 
     def get_map_var(self):
         return self.cur_map, self.accum_map
+
+    def reset(self, executor, reset_program=None):
+        """Clear the accumulated-mAP state between epochs (reference:
+        fluid/metrics.py DetectionMAP.reset): runs a tiny program that
+        zeroes the has_state flag; the next detection_map run then
+        reinitializes its host-side _MapState instead of accumulating.
+        The default program is built once and reused — a per-epoch fresh
+        Program would add one compile-cache entry per reset call."""
+        from .framework.core import Program, program_guard
+
+        cached = reset_program is None
+        if cached and getattr(self, "_reset_program", None) is not None:
+            executor.run(self._reset_program)
+            return
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(reset_program, reset_program):
+            from .layers import tensor as tensor_layers
+
+            blk = reset_program.global_block()
+            blk.create_var(name=self.has_state.name, shape=[1],
+                           dtype=self.has_state.dtype, persistable=True)
+            tensor_layers.fill_constant(shape=[1], dtype="int32", value=0,
+                                        out=self.has_state.name)
+        if cached:
+            self._reset_program = reset_program
+        executor.run(reset_program)

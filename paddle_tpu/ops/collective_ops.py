@@ -43,6 +43,13 @@ def _in_shard_map(axis):
         return False
 
 
+def _axis_size(axis):
+    """Bound-axis size; jax<=0.4.x has no lax.axis_size."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
+
+
 def _allreduce(reduce_fn):
     def lower(ctx):
         x = ctx.in_("X")
@@ -63,7 +70,7 @@ def _c_allreduce_sum(ctx):
         if ctx.attr("use_mean", False):
             # mean without knowing nranks at graph-build time (the DGC
             # optimizer's dense path)
-            x = x / lax.axis_size(axis)
+            x = x / _axis_size(axis)
     ctx.set_out("Out", x)
 op("c_allreduce_max", no_grad=True)(_allreduce(lambda x, a: lax.pmax(x, a)))
 op("c_allreduce_min", no_grad=True)(_allreduce(lambda x, a: lax.pmin(x, a)))
@@ -123,7 +130,7 @@ def _c_split(ctx):
         from ..parallel.mesh import current_mesh
 
         idx = lax.axis_index(axis)
-        nranks = lax.axis_size(axis)
+        nranks = _axis_size(axis)
         d = jnp.shape(x)[-1] // nranks
         x = lax.dynamic_slice_in_dim(x, idx * d, d, axis=-1)
     ctx.set_out("Out", x)
@@ -139,7 +146,7 @@ def _alltoall(ctx):
     x = ctx.in_("X")
     axis = _axis(ctx)
     if _in_shard_map(axis):
-        n = lax.axis_size(axis)
+        n = _axis_size(axis)
         xs = jnp.reshape(x, (n, jnp.shape(x)[0] // n) + jnp.shape(x)[1:])
         xs = lax.all_to_all(xs, axis, split_axis=0, concat_axis=0, tiled=False)
         x = jnp.reshape(xs, (-1,) + jnp.shape(x)[1:])

@@ -53,10 +53,14 @@ def _conv_lower(ctx, transpose=False):
     algo = ctx.attr("padding_algorithm", "EXPLICIT")
     nd = jnp.ndim(x) - 2
 
-    # Layout note: logical NCHW lowers to bf01 convolutions directly.
-    # XLA:TPU canonicalizes conv dim_labels and assigns physical layouts
-    # itself — an NHWC-with-edge-transposes variant measured *identical*
-    # step time on v5e, so no channels-last rewrite is needed.
+    # Layout note: data_format == "NHWC" (set by the program builder or
+    # by framework/ir.py layout_transform_pass under FLAGS_tpu_nhwc) is
+    # the TPU-native fast path: NHWC dimension numbers go straight into
+    # lax.conv_general_dilated — no per-op transposes.  The rhs spec
+    # stays OIHW in BOTH layouts on purpose: filters (and their grads,
+    # and the optimizer state hanging off them) keep one storage layout,
+    # so the layout pass is a pure activation rewrite and flipping
+    # FLAGS_tpu_nhwc mid-training cannot corrupt checkpoints.
     if data_format in ("NCHW", "NCDHW", "AnyLayout"):
         lhs_spec = "NCHW" if nd == 2 else "NCDHW"
     else:
@@ -144,15 +148,19 @@ def _pool2d(ctx):
         ctx.set_out("Out", fn(x, axis=sp, keepdims=True))
         return
     if adaptive:
-        # divisible adaptive pooling via reshape
+        # divisible adaptive pooling via reshape (both layouts)
         oh, ow = ksize
         h, w = in_sp
         if h % oh == 0 and w % ow == 0:
+            fn = jnp.max if ptype == "max" else jnp.mean
             if nchw:
                 r = jnp.reshape(x, jnp.shape(x)[:2] + (oh, h // oh, ow, w // ow))
-                fn = jnp.max if ptype == "max" else jnp.mean
                 ctx.set_out("Out", fn(r, axis=(3, 5)))
-                return
+            else:
+                n_, c_ = jnp.shape(x)[0], jnp.shape(x)[-1]
+                r = jnp.reshape(x, (n_, oh, h // oh, ow, w // ow, c_))
+                ctx.set_out("Out", fn(r, axis=(2, 4)))
+            return
         raise NotImplementedError("non-divisible adaptive pool2d")
 
     algo = ctx.attr("padding_algorithm", "EXPLICIT")

@@ -192,6 +192,14 @@ def _detection_map(ctx):
     prev = None
     if state_name and state_name[0] is not None:
         prev = ctx.env.get(state_name[0])
+    if ctx.has_input("HasState"):
+        # detection_map_op.h: HasState==0 means "no accumulated state" —
+        # reinitialize _MapState instead of accumulating into the stale
+        # one (DetectionMAP.reset() sets the flag var to 0)
+        hs = ctx.env.get(ctx.op.inputs["HasState"][0])
+        if hs is not None and \
+                int(np.asarray(jax.device_get(hs)).ravel()[0]) == 0:
+            prev = None
     st = prev if isinstance(prev, _MapState) else _MapState(
         pos={}, tp={}, fp={})
     # gt row layout mirrors metrics.py DetectionMAP's concat:

@@ -79,17 +79,40 @@ def is_host_op(type: str) -> bool:
     return bool(d is not None and d.host)
 
 
-def op_contains_host(op_) -> bool:
+def op_contains_host(op_, _visiting=None) -> bool:
     """True when the op is host-only OR any sub-block it holds (cond /
     while bodies) contains a host op, transitively.  Control flow over
     host state (LoDTensorArray writes, RPC) must execute as a host loop
     driving device kernels — the reference While op's architecture
     (controlflow/while_op.cc: inner Executor per iteration) — because
-    lax.while_loop/lax.cond need fixed-shape, device-resident carries."""
+    lax.while_loop/lax.cond need fixed-shape, device-resident carries.
+
+    The sub-block walk is memoized per (op, program-version): the
+    executor's segmentation and every analyze_state pass call this for
+    each top-level op, and re-walking nested while/cond bodies each time
+    is quadratic compile-time work on control-flow-heavy programs.  A
+    visiting-set guards against self-referential block attrs (a block
+    already on the recursion stack is skipped, not re-entered)."""
     if is_host_op(op_.type):
         return True
+    top_level = _visiting is None
+    version = None
+    if top_level:
+        blk = getattr(op_, "block", None)
+        if blk is not None:
+            try:
+                version = blk.program._version
+            except Exception:
+                version = None
+        cached = getattr(op_, "_host_scan_cache", None)
+        if cached is not None and version is not None \
+                and cached[0] == version:
+            return cached[1]
+        _visiting = set()
+
     from ..framework.core import Block
 
+    result = False
     for k, v in op_.attrs.items():
         blk = None
         if isinstance(v, Block):
@@ -99,9 +122,20 @@ def op_contains_host(op_) -> bool:
                 blk = op_.block.program.blocks[v]
             except Exception:
                 blk = None
-        if blk is not None and any(op_contains_host(sub) for sub in blk.ops):
-            return True
-    return False
+        if blk is None or id(blk) in _visiting:
+            continue
+        _visiting.add(id(blk))
+        try:
+            if any(op_contains_host(sub, _visiting) for sub in blk.ops):
+                result = True
+                break
+        finally:
+            _visiting.discard(id(blk))
+    if top_level and version is not None:
+        # only the top-level result is cached: a sub-result computed
+        # under cycle pruning could be unsound to reuse standalone
+        op_._host_scan_cache = (version, result)
+    return result
 
 
 def grad_maker(type: str):

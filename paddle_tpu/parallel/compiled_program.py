@@ -87,6 +87,16 @@ class CompiledProgram:
         self.__dict__["_mesh"] = mesh
         return self
 
+    def with_ir_passes(self, enable: bool = True):
+        """The DP runner reuses the Executor's compile-time rewrite
+        pipeline (bn-act fusion, fused optimizers, the FLAGS_tpu_nhwc
+        layout pass) so the single-device and data-parallel hot paths
+        cannot drift apart.  ``with_ir_passes(False)`` opts this
+        CompiledProgram out — e.g. to inspect/debug the unrewritten
+        graph under DP."""
+        self.__dict__["_ir_passes"] = bool(enable)
+        return self
+
     # Executor dispatches here (executor.py Executor.run)
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
         from .data_parallel import run_data_parallel

@@ -57,7 +57,8 @@ def _analyze(program, feed_names, scope):
     return block, state_in, state_out, uses_rng
 
 
-def _compile_dp(compiled_program, program, feed, fetch_names, scope, mesh):
+def _compile_dp(compiled_program, executor, program, feed, fetch_names,
+                scope, mesh):
     feed_spec = tuple(sorted(
         (k, tuple(np.shape(v)),
          str(v.dtype) if hasattr(v, "dtype") else str(np.asarray(v).dtype))
@@ -70,10 +71,36 @@ def _compile_dp(compiled_program, program, feed, fetch_names, scope, mesh):
         for blk in program.blocks for v in blk.vars.values()
         if getattr(v, "_sharding", None)
     ))
-    key = (program._version, feed_spec, tuple(fetch_names), id(mesh), shard_sig)
+    from ..utils.flags import flag
+
+    key = (program._uid, program._version, feed_spec, tuple(fetch_names),
+           id(mesh), shard_sig, executor._nhwc_enabled(),
+           compiled_program.__dict__.get("_ir_passes", True),
+           bool(flag("apply_ir_passes")))
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})
     if key in cache:
         return cache[key]
+
+    # the DP runner goes through the same compile-time rewrite pipeline
+    # as the single-device executor (bn-act fusion, fused optimizers,
+    # FLAGS_tpu_nhwc layout pass) — the two paths must not drift apart.
+    # Sharding annotations live on the ORIGINAL program's vars; carry
+    # them over when the pipeline produced a rewritten clone.
+    rewritten = program
+    if compiled_program.__dict__.get("_ir_passes", True):
+        rewritten = executor._apply_ir_passes(program, fetch_names)
+    if rewritten is not program:
+        # the clone preserves block structure, so specs map block-by-
+        # block (a global-block-only lookup would drop sub-block specs)
+        for blk in program.blocks:
+            tgt_blk = rewritten.blocks[blk.idx]
+            for v in blk.vars.values():
+                spec = getattr(v, "_sharding", None)
+                if spec:
+                    tv = tgt_blk.vars.get(v.name)
+                    if tv is not None:
+                        tv._sharding = spec
+        program = rewritten
 
     block, state_in, state_out, uses_rng = _analyze(program, set(feed), scope)
     use_shard_map = _program_has_collectives(program)
@@ -112,13 +139,14 @@ def _compile_dp(compiled_program, program, feed, fetch_names, scope, mesh):
 
         state_specs = {n: P() for n in state_in}
         feed_specs = {k: P(axis) for k in feed}
-        fn = jax.shard_map(
+        from .mesh import shard_map_compat
+
+        fn = shard_map_compat(
             shard_fn,
             mesh=mesh,
             in_specs=(state_specs, feed_specs),
             out_specs=(tuple(P(axis) for _ in fetch_names),
                        {n: P() for n in state_out}),
-            check_vma=False,
         )
         jitted = jax.jit(fn)
     else:
@@ -132,7 +160,14 @@ def _compile_dp(compiled_program, program, feed, fetch_names, scope, mesh):
             in_shardings=(state_shardings, feed_shardings),
         )
 
-    entry = (jitted, state_in, state_out, use_shard_map, param_sharding, axis)
+    # feed-conversion plan (target numpy dtype per feed name), computed
+    # once per compilation — same helper as the single-device executor
+    from ..executor import build_feed_plan
+
+    feed_plan = build_feed_plan(block, feed)
+
+    entry = (jitted, state_in, state_out, use_shard_map, param_sharding,
+             axis, feed_plan)
     cache[key] = entry
     return entry
 
@@ -157,23 +192,19 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
         mesh = default_dp_mesh(ndev)
         compiled.__dict__["_mesh"] = mesh
 
-    jitted, state_in, state_out, use_shard_map, param_sharding, axis = \
-        _compile_dp(compiled, program, feed, fetch_names, scope, mesh)
+    jitted, state_in, state_out, use_shard_map, param_sharding, axis, \
+        feed_plan = _compile_dp(compiled, executor, program, feed,
+                                fetch_names, scope, mesh)
 
     batch_sharding = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
-    block = program.global_block()
 
     feed_vals = {}
     for k, v in feed.items():
         arr = as_numpy(v) if isinstance(v, LoDTensor) else np.asarray(v)
-        var = block._find_var_recursive(k)
-        if var is not None and var.dtype is not None:
-            from ..framework.dtype import to_numpy_dtype
-
-            want = to_numpy_dtype(var.dtype)
-            if arr.dtype != want:
-                arr = arr.astype(want)
+        want = feed_plan.get(k)
+        if want is not None and arr.dtype != want:
+            arr = arr.astype(want)
         if arr.shape and arr.shape[0] % mesh.size != 0:
             raise ValueError(
                 f"feed {k!r} batch {arr.shape[0]} not divisible by "

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 from functools import partial
 
+from .mesh import shard_map_compat
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -67,11 +69,10 @@ def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
     @partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None),
-        check_vma=False,
     )
     def run(ql, kl, vl):
         i = lax.axis_index(axis)
@@ -111,11 +112,10 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sp",
     scale = (1.0 / d ** 0.5) if scale is None else scale
 
     @partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None),
-        check_vma=False,
     )
     def run(ql, kl, vl):
         # [b, seq/s, h, d] -> [b, seq, h/s, d]

@@ -373,9 +373,15 @@ def _train_from_dataset(executor, program, dataset, scope, fetch_list,
     with _multitrainer_lock:
         # Hogwild workers share the parent scope's param buffers, so
         # buffer donation must be off (a buffer donated by worker A would
-        # be a deleted buffer in worker B's captured arguments)
+        # be a deleted buffer in worker B's captured arguments) — and so
+        # must the executor step session: workers race on one
+        # compiled.session, and a worker re-publishing its own
+        # post-step state as "current" would silently discard the
+        # updates another worker wrote to the scope in between
         old_donate = _flags._flags.get("FLAGS_tpu_donate_buffers")
+        old_session = _flags._flags.get("FLAGS_tpu_step_session")
         _flags._flags["FLAGS_tpu_donate_buffers"] = False
+        _flags._flags["FLAGS_tpu_step_session"] = False
         try:
             # first batch runs on the calling thread so the program
             # compiles once (workers then only hit the executor cache)
@@ -417,6 +423,7 @@ def _train_from_dataset(executor, program, dataset, scope, fetch_list,
                 raise errors[0]
         finally:
             _flags._flags["FLAGS_tpu_donate_buffers"] = old_donate
+            _flags._flags["FLAGS_tpu_step_session"] = old_session
     return None
 
 

@@ -46,7 +46,33 @@ _DEFAULTS: Dict[str, Any] = {
     # cheaper than threefry mask generation); "threefry2x32" restores
     # jax's default counter-based stream
     "FLAGS_tpu_prng_impl": "rbg",
+    # NHWC layout propagation for conv/bn/pool chains (framework/ir.py
+    # layout_transform_pass): "auto" enables it when the executor place
+    # is an accelerator, "1"/"0" force it on/off everywhere.  "0"
+    # restores the NCHW pipeline bit-for-bit.
+    "FLAGS_tpu_nhwc": "auto",
+    # executor step session: keep donated state device-resident across
+    # Executor.run calls (zero scope reads per steady-state step).  Off
+    # restores the per-step scope.get rebind path.
+    "FLAGS_tpu_step_session": True,
 }
+
+
+def nhwc_enabled(place=None) -> bool:
+    """Resolve FLAGS_tpu_nhwc against the executor place ("auto" means
+    on-accelerator only; truthy forces on, falsy off)."""
+    v = flag("tpu_nhwc")
+    if isinstance(v, str):
+        s = v.strip().lower()
+        if s == "auto":
+            if place is None:
+                return False
+            try:
+                return place.jax_device().platform != "cpu"
+            except Exception:
+                return False
+        return s in ("1", "true", "yes", "on")
+    return bool(v)
 
 
 def _coerce(cur, val):

@@ -1,0 +1,128 @@
+"""Regression tests for the r5 advisor findings: DetectionMAP.reset +
+detection_map HasState, cond's scalar-equality pass-through, the
+double-Ellipsis guard in __getitem__, and op_contains_host memoization."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+# --------------------------------------------------------------------------
+# DetectionMAP reset / HasState (reference: fluid/metrics.py DetectionMAP,
+# detection_map_op.h)
+# --------------------------------------------------------------------------
+def _map_feeds():
+    gl = np.array([[[1.0], [2.0]]], np.float32)
+    gb = np.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]],
+                  np.float32)
+    perfect = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                         [2, 0.8, 0.5, 0.5, 0.9, 0.9],
+                         [-1, 0, 0, 0, 0, 0]]], np.float32)
+    wrong = np.array([[[1, 0.9, 0.6, 0.6, 0.7, 0.7],
+                       [2, 0.8, 0.0, 0.0, 0.05, 0.05],
+                       [-1, 0, 0, 0, 0, 0]]], np.float32)
+    return ({"det": perfect, "gtl": gl, "gtb": gb},
+            {"det": wrong, "gtl": gl, "gtb": gb})
+
+
+def test_detection_map_reset_clears_accumulated_state():
+    from paddle_tpu.metrics import DetectionMAP
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.layers.data("det", [3, 6], append_batch_size=True)
+        gtl = fluid.layers.data("gtl", [2, 1], append_batch_size=True)
+        gtb = fluid.layers.data("gtb", [2, 4], append_batch_size=True)
+        m = DetectionMAP(det, gtl, gtb, class_num=3)
+        cur, accum = m.get_map_var()
+    exe = fluid.Executor(pt.CPUPlace())
+    good, bad = _map_feeds()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        a1 = float(exe.run(main, feed=good, fetch_list=[accum.name])[0])
+        a2 = float(exe.run(main, feed=bad, fetch_list=[accum.name])[0])
+        assert a1 == pytest.approx(1.0)
+        assert a2 < 1.0  # accumulated over both batches
+        m.reset(exe)    # reference API: reset(executor[, program])
+        a3 = float(exe.run(main, feed=good, fetch_list=[accum.name])[0])
+        assert a3 == pytest.approx(1.0)  # stale state dropped
+        # and accumulation resumes normally after the reset
+        a4 = float(exe.run(main, feed=bad, fetch_list=[accum.name])[0])
+        assert a4 < 1.0
+
+
+# --------------------------------------------------------------------------
+# cond: equal scalars from both branches, and the corrected error
+# --------------------------------------------------------------------------
+def test_cond_equal_scalar_passthrough():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        p = fluid.layers.fill_constant([1], "bool", True)
+
+        def tf():
+            return fluid.layers.fill_constant([1], "float32", 1.0), 0.5
+
+        def ff():
+            return fluid.layers.fill_constant([1], "float32", 2.0), 0.5
+
+        out = fluid.layers.cond(p, tf, ff)
+        assert out[1] == 0.5
+
+
+def test_cond_unequal_scalar_error_names_values():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        p = fluid.layers.fill_constant([1], "bool", True)
+        with pytest.raises(ValueError, match=r"unequal python float"):
+            fluid.layers.cond(p, lambda: 0.5, lambda: 0.6)
+
+
+# --------------------------------------------------------------------------
+# __getitem__: more than one Ellipsis is an IndexError (numpy semantics)
+# --------------------------------------------------------------------------
+def test_getitem_double_ellipsis_raises():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        v = fluid.layers.data("v", [4, 5])
+        with pytest.raises(IndexError, match="single ellipsis"):
+            v[..., ..., 0]
+        v[..., 0]  # single Ellipsis still fine
+
+
+# --------------------------------------------------------------------------
+# op_contains_host memoization (per op + program version, cycle-guarded)
+# --------------------------------------------------------------------------
+def test_op_contains_host_memoized_and_version_invalidated():
+    from paddle_tpu.ops import registry
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        p = fluid.layers.fill_constant([1], "bool", True)
+        a = fluid.layers.fill_constant([1], "float32", 1.0)
+        b = fluid.layers.fill_constant([1], "float32", 2.0)
+        fluid.layers.cond(p, lambda: a, lambda: b)
+    cond_op = next(o for o in prog.global_block().ops if o.type == "cond")
+    assert registry.op_contains_host(cond_op) is False
+    cached = getattr(cond_op, "_host_scan_cache", None)
+    assert cached is not None and cached[1] is False
+
+    # mutate the sub-block: a host op appears — the version bump must
+    # invalidate the cached False
+    sub = cond_op.attrs["true_block"]
+    sub.append_op("write_to_array", inputs={"X": [a.name]},
+                  outputs={"Out": [a.name]}, attrs={})
+    assert registry.is_host_op("write_to_array")
+    assert registry.op_contains_host(cond_op) is True
+
+
+def test_op_contains_host_cycle_guard():
+    """A self-referential block attr must not recurse unboundedly."""
+    from paddle_tpu.ops import registry
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.fill_constant([1], "float32", 1.0)
+    blk = prog.global_block()
+    op_ = blk.append_op("scale", inputs={"X": [x.name]},
+                        outputs={"Out": [x.name]}, attrs={"scale": 1.0})
+    op_.attrs["sub_block"] = blk  # cycle: op's block attr is its own block
+    assert registry.op_contains_host(op_) is False

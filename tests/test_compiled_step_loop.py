@@ -1,0 +1,206 @@
+"""Zero-overhead executor step loop: after the first (compiling) run,
+the non-hybrid Executor.run fast path must do no per-step feed
+re-planning (no Block var lookups, no device_put for staged feeds) and
+no per-step scope re-reads for state binding (the _StateSession carries
+donated state device-resident across steps), while external scope
+writes still invalidate the session."""
+import numpy as np
+import jax
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import core as core_mod
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _build(seed=1):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 8, 8])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        x = fluid.layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+        x = fluid.layers.batch_norm(x, act="relu")
+        x = fluid.layers.pool2d(x, pool_type="avg", global_pooling=True)
+        logits = fluid.layers.fc(x, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _staged_feed(device):
+    rng = np.random.RandomState(0)
+    return {
+        "img": jax.device_put(rng.rand(2, 3, 8, 8).astype(np.float32),
+                              device),
+        "label": jax.device_put(
+            rng.randint(0, 10, (2, 1)).astype(np.int32), device),
+    }
+
+
+def test_no_per_step_feed_replanning(monkeypatch):
+    """Steady state with device-staged feeds: zero jax.device_put and
+    zero Block._find_var_recursive calls per step."""
+    main, startup, loss = _build()
+    exe = fluid.Executor(pt.CPUPlace())
+    feed = _staged_feed(pt.CPUPlace().jax_device())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name],
+                return_numpy=False)  # compile + first bind
+
+        dp_calls, fv_calls = [0], [0]
+        real_dp = jax.device_put
+        real_fv = core_mod.Block._find_var_recursive
+
+        def counting_dp(*a, **k):
+            dp_calls[0] += 1
+            return real_dp(*a, **k)
+
+        def counting_fv(self, name):
+            fv_calls[0] += 1
+            return real_fv(self, name)
+
+        monkeypatch.setattr(jax, "device_put", counting_dp)
+        monkeypatch.setattr(core_mod.Block, "_find_var_recursive",
+                            counting_fv)
+        for _ in range(3):
+            out = exe.run(main, feed=feed, fetch_list=[loss.name],
+                          return_numpy=False)
+        monkeypatch.undo()
+        assert dp_calls[0] == 0, f"{dp_calls[0]} device_put calls/3 steps"
+        assert fv_calls[0] == 0, f"{fv_calls[0]} var lookups/3 steps"
+        assert np.isfinite(float(np.asarray(out[0].numpy()).ravel()[0]))
+
+
+def test_numpy_feed_casts_once_per_step_not_replanned(monkeypatch):
+    """Host numpy feeds still convert (cast + one device_put per feed),
+    but without re-consulting program vars."""
+    main, startup, loss = _build()
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(2, 3, 8, 8).astype(np.float32),
+            "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+    with scope_guard(Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+
+        fv_calls = [0]
+        real_fv = core_mod.Block._find_var_recursive
+
+        def counting_fv(self, name):
+            fv_calls[0] += 1
+            return real_fv(self, name)
+
+        monkeypatch.setattr(core_mod.Block, "_find_var_recursive",
+                            counting_fv)
+        v1 = float(exe.run(main, feed=feed, fetch_list=[loss.name])[0])
+        monkeypatch.undo()
+        assert fv_calls[0] == 0
+        assert np.isfinite(v1)
+
+
+def test_session_invalidated_by_external_scope_write():
+    """A scope.set between steps must be picked up (mutation-counter
+    invalidation), and training trajectories must match a fresh run."""
+    main, startup, loss = _build()
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(3)
+    feed = {"img": rng.rand(2, 3, 8, 8).astype(np.float32),
+            "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+
+    def trajectory():
+        sc = Scope()
+        with scope_guard(sc):
+            exe2 = fluid.Executor(pt.CPUPlace())
+            exe2.run(startup)
+            return [float(exe2.run(main, feed=feed,
+                                   fetch_list=[loss.name])[0])
+                    for _ in range(4)]
+
+    a, b = trajectory(), trajectory()
+    assert a == b  # session caching changes nothing observable
+
+    sc = Scope()
+    with scope_guard(sc):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        # zero a conv filter externally: next loss must reflect it
+        wname = [n for n, _ in sc.items() if "conv2d" in n
+                 and n.endswith(".w_0")][0]
+        sc.set(wname, np.zeros_like(np.asarray(sc.get(wname))))
+        after = float(exe.run(main, feed=feed, fetch_list=[loss.name])[0])
+        sc2 = Scope()
+        with scope_guard(sc2):
+            exe3 = fluid.Executor(pt.CPUPlace())
+            exe3.run(startup)
+            exe3.run(main, feed=feed, fetch_list=[loss.name])
+            exe3.run(main, feed=feed, fetch_list=[loss.name])
+            wl = np.asarray(sc2.get(wname))
+            sc2.set(wname, np.zeros_like(wl))
+            expect = float(exe3.run(main, feed=feed,
+                                    fetch_list=[loss.name])[0])
+    assert after == expect
+
+
+def test_session_recovers_after_host_side_state_write(monkeypatch):
+    """A get_tensor().set(...) on a read-only state var (the checkpoint
+    idiom) leaves a HOST value in the scope; the rebound session must
+    hold the converted device array strongly so steady state goes back
+    to zero scope reads instead of re-binding every step."""
+    from paddle_tpu.framework import scope as scope_mod
+
+    main, startup, loss = _build()
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(2, 3, 8, 8).astype(np.float32),
+            "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+    sc = Scope()
+    with scope_guard(sc):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        lr = next(k for k, _ in sc.items() if "learning_rate" in k)
+        sc.find_var(lr).get_tensor().set(np.full([1], 0.05, np.float32))
+        exe.run(main, feed=feed, fetch_list=[loss.name])  # rebind step
+
+        get_calls = [0]
+        real_get = scope_mod.Scope.get
+
+        def counting_get(self, name, default=None):
+            get_calls[0] += 1
+            return real_get(self, name, default)
+
+        monkeypatch.setattr(scope_mod.Scope, "get", counting_get)
+        for _ in range(2):
+            exe.run(main, feed=feed, fetch_list=[loss.name])
+        monkeypatch.undo()
+        assert get_calls[0] == 0, \
+            f"{get_calls[0]} scope reads/2 steps after host-side write"
+
+
+def test_session_not_shared_across_scopes():
+    """Two scopes alternating on one compiled program must not leak
+    state into each other through the session cache."""
+    main, startup, loss = _build()
+    exe = fluid.Executor(pt.CPUPlace())
+    rng = np.random.RandomState(5)
+    feed = {"img": rng.rand(2, 3, 8, 8).astype(np.float32),
+            "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+    sa, sb = Scope(), Scope()
+    with scope_guard(sa):
+        exe.run(startup)
+        # real copies: np.asarray of a CPU jax array is a zero-copy
+        # view; donation during later steps would mutate it
+        init = {k: np.array(np.asarray(v), copy=True)
+                for k, v in sa.items() if not k.startswith("@")}
+    for k, v in init.items():
+        sb.set(k, v.copy())
+    seq_a, seq_b = [], []
+    for _ in range(3):
+        seq_a.append(float(exe.run(main, feed=feed, fetch_list=[loss.name],
+                                   scope=sa)[0]))
+        seq_b.append(float(exe.run(main, feed=feed, fetch_list=[loss.name],
+                                   scope=sb)[0]))
+    np.testing.assert_allclose(seq_a, seq_b, rtol=1e-6)
