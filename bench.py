@@ -273,14 +273,151 @@ def bench_lenet_parity():
     return diff, dev_losses, cpu_losses
 
 
+def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
+    """Runs inside the forced-{n}-device subprocess: per-step loss parity
+    between single-device and each DP comm mode, plus per-mode step time,
+    collective counts / estimated wire bytes (tools/dp_comm_stats model)
+    and optimizer-state bytes per device.  Modes (r7):
+
+      pjit              with_data_parallel, replicated state (baseline)
+      pjit_sharded      FLAGS_dp_sharding=1 — ZeRO-1 optimizer sharding
+      collective        GradAllReduce program, FLAGS_fuse_grad_size_in_MB=0
+      collective_fused  bucketed c_fused_allreduce (default coalescing)
+      collective_bf16   fused + FLAGS_dp_grad_compress=bf16 wire format
+
+    Prints one SCALING=<json> line."""
+    import json as _json
+    import sys as _sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.transpiler import GradAllReduce
+    from paddle_tpu.utils import flags as _flags
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    _sys.path.insert(0, os.path.join(here, "tools"))
+    from dp_comm_stats import collect_comm_stats
+
+    def build(collective):
+        # fresh name generator per build => identical var names, so one
+        # captured init dict seeds every mode's scope
+        unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [16])
+            y = fluid.layers.data("y", [1])
+            h = fluid.layers.fc(x, 32, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+        if collective:
+            GradAllReduce().transpile(
+                startup_program=startup, main_program=main, rank=0,
+                endpoints=["127.0.0.1:6170"], nranks=n_devices)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(n_devices * 8, 16).astype(np.float32)
+    ys = (xs[:, :1] * 2 + 1).astype(np.float32)
+    exe = pt.Executor(pt.CPUPlace())
+
+    main, startup, loss = build(collective=False)
+    sa = Scope()
+    exe.run(startup, scope=sa)
+    init = {k: np.asarray(v) for k, v in sa.items() if not k.startswith("@")}
+    single = [float(exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss], scope=sa)[0])
+              for _ in range(steps)]
+
+    main_c, startup_c, loss_c = build(collective=True)
+
+    def opt_state_bytes(scope):
+        total = per_dev = 0
+        for k, v in scope.items():
+            if "moment" not in k or not isinstance(v, jax.Array):
+                continue
+            total += v.nbytes
+            per_dev += v.addressable_shards[0].data.nbytes
+        return total, per_dev
+
+    MODES = [
+        ("pjit", False, {"dp_sharding": 0}),
+        ("pjit_sharded", False, {"dp_sharding": 1}),
+        ("collective", True, {"fuse_grad_size_in_MB": 0.0}),
+        ("collective_fused", True, {"fuse_grad_size_in_MB": 32.0,
+                                    "dp_grad_compress": "none"}),
+        ("collective_bf16", True, {"fuse_grad_size_in_MB": 32.0,
+                                   "dp_grad_compress": "bf16"}),
+    ]
+    defaults = {"dp_sharding": 0, "fuse_grad_size_in_MB": 32.0,
+                "dp_grad_compress": "none"}
+    modes = {}
+    for name, collective, overrides in MODES:
+        _flags.set_flags({**defaults, **overrides})
+        mesh_mod.registry().clear()
+        mesh_mod.init_mesh()
+        mp, sp, lv = (main_c, startup_c, loss_c) if collective else \
+            (main, startup, loss)
+        sc = Scope()
+        for k, v in init.items():
+            sc.set(k, v.copy())
+        compiled = fluid.CompiledProgram(mp).with_data_parallel(
+            loss_name=lv.name)
+        dp = []
+        for _ in range(steps):
+            out = exe.run(compiled, feed={"x": xs, "y": ys},
+                          fetch_list=[lv], scope=sc)[0]
+            dp.append(float(np.mean(out)))
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            out = exe.run(compiled, feed={"x": xs, "y": ys},
+                          fetch_list=[lv], scope=sc, return_numpy=False)
+        np.asarray(out[0].value() if hasattr(out[0], "value") else out[0])
+        dt = time.perf_counter() - t0
+        rewritten = exe._apply_ir_passes(mp, [lv.name])
+        comm = collect_comm_stats(rewritten, n_devices)
+        total, per_dev = opt_state_bytes(sc)
+        modes[name] = {
+            "losses": [round(v, 6) for v in dp],
+            "max_absdiff": float(np.max(np.abs(
+                np.asarray(single) - np.asarray(dp)))),
+            "step_ms": round(dt / timed_steps * 1e3, 3),
+            "collective_ops": comm["collective_ops"],
+            "est_wire_bytes_per_chip": comm["est_wire_bytes_per_chip"],
+            "n_buckets": len(comm["buckets"]),
+            "opt_state_bytes_total": total,
+            "opt_state_bytes_per_dev": per_dev,
+        }
+    _flags.set_flags(defaults)
+    print("SCALING=" + _json.dumps({
+        "single": single,
+        "dp": modes["pjit"]["losses"],
+        "max_absdiff": modes["pjit"]["max_absdiff"],
+        "n_devices": n_devices,
+        "modes": modes,
+    }))
+
+
 def bench_scaling(n_devices=8, steps=6):
-    """DP-over-mesh correctness proxy for the allreduce-scaling metric
-    (BASELINE.md #3): on this 1-core box a virtual 8-device CPU mesh
-    cannot measure real scaling efficiency (all devices share one core;
-    ICI bandwidth needs real chips), so the bench reports the thing that
-    IS measurable: per-step loss parity between single-device and
-    8-device data-parallel execution of the same program — the
-    multi_devices_graph_pass.cc:458 correctness oracle."""
+    """DP-over-mesh correctness + comm-shape proxy for the
+    allreduce-scaling metric (BASELINE.md #3): on this 1-core box a
+    virtual 8-device CPU mesh cannot measure real scaling efficiency
+    (all devices share one core; ICI bandwidth needs real chips), so the
+    bench reports what IS measurable — per-step loss parity between
+    single-device and each DP comm mode (the
+    multi_devices_graph_pass.cc:458 correctness oracle), per-mode
+    collective counts + estimated wire bytes, and per-device
+    optimizer-state bytes under FLAGS_dp_sharding."""
     import json as _json
     import subprocess
     import sys
@@ -295,50 +432,7 @@ def bench_scaling(n_devices=8, steps=6):
     here = os.path.dirname(os.path.abspath(__file__))
     env["PYTHONPATH"] = here + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
-    code = f"""
-import jax, json
-jax.config.update('jax_platforms', 'cpu')
-import numpy as np
-import paddle_tpu as pt
-import paddle_tpu.fluid as fluid
-from paddle_tpu.framework.scope import Scope, scope_guard
-
-def build():
-    main, startup = fluid.Program(), fluid.Program()
-    main.random_seed = 3
-    with fluid.program_guard(main, startup):
-        x = fluid.layers.data('x', [16])
-        y = fluid.layers.data('y', [1])
-        h = fluid.layers.fc(x, 32, act='relu')
-        pred = fluid.layers.fc(h, 1)
-        loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(pred, y))
-        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
-    return main, startup, loss
-
-rng = np.random.RandomState(0)
-xs = rng.randn({n_devices} * 8, 16).astype(np.float32)
-ys = (xs[:, :1] * 2 + 1).astype(np.float32)
-exe = pt.Executor(pt.CPUPlace())
-
-main, startup, loss = build()
-sa, sb = Scope(), Scope()
-with scope_guard(sa):
-    exe.run(startup)
-    init = {{k: np.asarray(v) for k, v in sa.items() if not k.startswith('@')}}
-    single = [float(exe.run(main, feed={{'x': xs, 'y': ys}},
-                            fetch_list=[loss], scope=sa)[0])
-              for _ in range({steps})]
-for k, v in init.items():
-    sb.set(k, v.copy())
-compiled = fluid.CompiledProgram(main).with_data_parallel(loss_name=loss.name)
-dp = [float(exe.run(compiled, feed={{'x': xs, 'y': ys}},
-                    fetch_list=[loss], scope=sb)[0])
-      for _ in range({steps})]
-print('SCALING=' + json.dumps({{
-    'single': single, 'dp': dp,
-    'max_absdiff': float(np.max(np.abs(np.asarray(single) - np.asarray(dp)))),
-    'n_devices': {n_devices}}}))
-"""
+    code = f"import bench; bench._scaling_worker({n_devices}, {steps})"
     proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=here,
                           capture_output=True, text=True, timeout=900)
     if proc.returncode != 0:
@@ -520,6 +614,7 @@ def main():
                           "value": round(r["max_absdiff"], 6),
                           "unit": "abs loss diff",
                           "vs_baseline": round(r["max_absdiff"] / 1e-3, 4),
+                          "modes": r.get("modes"),
                           **predict_ici_scaling()}))
         return
     if model == "widedeep":

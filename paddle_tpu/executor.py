@@ -292,7 +292,9 @@ class Executor:
             )
         )
         key = (program._uid, program._version, feed_spec, tuple(fetch_names),
-               check_nan_inf, unused_check, ir_passes, donate, nhwc)
+               check_nan_inf, unused_check, ir_passes, donate, nhwc,
+               float(flag("fuse_grad_size_in_MB") or 0),
+               str(flag("dp_grad_compress", "none")))
         hit = self._cache.get(key)
         if hit is not None:
             return hit
@@ -509,6 +511,15 @@ class Executor:
             # after the bn fusions so the NHWC walk sees the fused ops
             passes.append(get_pass("layout_transform_pass",
                                    protected=protected))
+        if "c_allreduce_sum" in types:
+            mb = float(flag("fuse_grad_size_in_MB") or 0)
+            if mb > 0:
+                # coalesce per-tensor grad allreduces (the shard_map DP
+                # path) into bucketed fused collectives
+                passes.append(get_pass(
+                    "fuse_all_reduce_pass",
+                    max_bytes=int(mb * (1 << 20)),
+                    compress=str(flag("dp_grad_compress", "none"))))
         if not passes:
             return program
         clone = Program.from_desc_dict(program.desc_dict())

@@ -1502,6 +1502,118 @@ class LayoutTransformPass(Pass):
                 return False
         return True
 
+# --------------------------------------------------------------------------
+# coalesced gradient communication (reference: ir/fuse_all_reduce_op_pass.cc
+# + coalesce_grad_tensor_pass.cc): the per-tensor c_allreduce_sum ops a
+# GradAllReduce transpile inserts each pay a collective launch; bucketing
+# ~FLAGS_fuse_grad_size_in_MB of payload into one flattened collective
+# amortizes the launches and gives XLA one large transfer to overlap with
+# the remaining backward compute.
+# --------------------------------------------------------------------------
+@register_pass("fuse_all_reduce_pass")
+class FuseAllReducePass(Pass):
+    """Bucket in-place `c_allreduce_sum` ops into `c_fused_allreduce`.
+
+    Merge rules (each violation closes the current bucket):
+    * only in-place (X == Out) sum-allreduces with static shapes and no
+      `use_mean` are eligible;
+    * members share one (ring_id, dtype) — mixed-dtype buckets refuse
+      to merge;
+    * an intervening op that reads or writes a bucketed var closes the
+      bucket first (the fused collective runs at the LAST member's
+      position, so nothing may consume an unreduced value in between);
+    * a bucket closes once its payload reaches ``max_bytes`` (so every
+      full bucket carries >= max_bytes and the bucket count on an
+      N-tensor program is <= ceil(total_bytes / max_bytes));
+    * single-member buckets keep their original op — nothing to fuse.
+    """
+
+    max_bytes: int = 32 << 20
+    compress: str = "none"
+
+    def _payload_bytes(self, block, name):
+        import numpy as np
+
+        from .dtype import to_numpy_dtype
+
+        var = block._find_var_recursive(name)
+        if var is None or var.shape is None or var.dtype is None:
+            return None
+        shape = list(var.shape)
+        if not shape or any(d is None or d < 0 for d in shape):
+            return None
+        try:
+            itemsize = np.dtype(to_numpy_dtype(var.dtype)).itemsize
+        except Exception:
+            return None
+        return int(np.prod(shape)) * itemsize, var.dtype
+
+    def apply_impl(self, program):
+        self.fused_count = 0
+        if self.max_bytes <= 0:
+            return program
+        block = program.global_block()
+        buckets: List[List[Operator]] = []
+        cur: List[Operator] = []
+        cur_bytes = 0
+        cur_key = None
+        touched: set = set()
+
+        def close():
+            nonlocal cur, cur_bytes, cur_key
+            if len(cur) >= 2:
+                buckets.append(list(cur))
+            cur, cur_bytes, cur_key = [], 0, None
+            touched.clear()
+
+        for op_ in list(block.ops):
+            if (op_.type == "c_allreduce_sum"
+                    and not op_.attrs.get("use_mean", False)):
+                x = op_.inputs.get("X", [None])[0]
+                o = op_.outputs.get("Out", [None])[0]
+                info = self._payload_bytes(block, x) if x else None
+                if x is None or x != o or info is None:
+                    close()
+                    continue
+                nbytes, dtype = info
+                key = (op_.attrs.get("ring_id", 0), dtype)
+                if cur and (key != cur_key or x in touched):
+                    close()
+                cur.append(op_)
+                cur_bytes += nbytes
+                cur_key = key
+                touched.add(x)
+                if cur_bytes >= self.max_bytes:
+                    close()
+                continue
+            names = set(op_.input_arg_names) | set(op_.output_arg_names)
+            if names & touched:
+                close()
+        close()
+
+        for b in buckets:
+            xs = [o.inputs["X"][0] for o in b]
+            # the compress attr records the format that actually ships:
+            # the lowering only compresses f32 payloads, so stamping
+            # bf16 on another dtype would mislead comm accounting
+            dtype = self._payload_bytes(block, xs[0])[1]
+            compress = self.compress if dtype == VarType.FP32 else "none"
+            attrs = {"ring_id": b[0].attrs.get("ring_id", 0),
+                     "compress": compress}
+            if "op_role" in b[0].attrs:
+                attrs["op_role"] = b[0].attrs["op_role"]
+            last = max(block.ops.index(o) for o in b)
+            last -= sum(1 for o in b if block.ops.index(o) < last)
+            remove_ops(block, b)
+            block._insert_op(last, "c_fused_allreduce",
+                             inputs={"X": xs}, outputs={"Out": list(xs)},
+                             attrs=attrs)
+        self.fused_count = len(buckets)
+        if buckets:
+            program._bump_version()
+        return program
+
+
 @register_pass("fuse_optimizer_ops_pass")
 class FuseOptimizerOpsPass(Pass):
     def apply_impl(self, program):

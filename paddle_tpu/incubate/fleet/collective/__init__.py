@@ -33,6 +33,16 @@ class DistributedStrategy:
         self.use_hierarchical_allreduce = False
         self.hierarchical_allreduce_inter_nranks = 8
         self.fuse_all_reduce_ops = True
+        # ZeRO-1 optimizer-state sharding (reference: Fleet `sharding`
+        # strategy) — maps onto FLAGS_dp_sharding; None keeps the
+        # process-start flag value
+        self.sharding = None
+        # bucket size for the coalesced grad collective (reference:
+        # fuse_grad_size_in_MB build-strategy knob) — None keeps the
+        # FLAGS_fuse_grad_size_in_MB default
+        self.fuse_grad_size_in_MB = None
+        # EQuARX-style wire compression for fused buckets: "none"|"bf16"
+        self.grad_compress = None
         self.exec_strategy = ExecutionStrategy()
         self.build_strategy = BuildStrategy()
         self.forward_recompute = False
@@ -251,6 +261,34 @@ class CollectiveOptimizer(DistributedOptimizer):
         startup_program = startup_program or default_startup_program()
 
         strategy = self._strategy
+        # strategy knobs -> framework flags (the executor's IR pipeline
+        # and the DP runner read flags, like the reference's
+        # build_strategy -> pass-attr plumbing)
+        from ....utils import flags as _flags
+
+        # the strategy is the config of record: EVERY knob is set both
+        # ways (flags are process-global — a later optimizer with
+        # default settings must really clear what a previous one set,
+        # or job B silently trains with job A's sharding/compression)
+        # knobs left unconfigured (None) restore the PROCESS-START value
+        # (defaults + FLAGS_* env), not the hard-coded default — an
+        # operator's FLAGS_dp_grad_compress=bf16 env setting survives a
+        # default strategy
+        if not getattr(strategy, "fuse_all_reduce_ops", True):
+            fuse_mb = 0.0
+        elif getattr(strategy, "fuse_grad_size_in_MB", None) is not None:
+            fuse_mb = float(strategy.fuse_grad_size_in_MB)
+        else:
+            fuse_mb = _flags._INITIAL["FLAGS_fuse_grad_size_in_MB"]
+        compress = getattr(strategy, "grad_compress", None)
+        sharding = getattr(strategy, "sharding", None)
+        _flags.set_flags({
+            "dp_sharding": bool(sharding) if sharding is not None
+            else _flags._INITIAL["FLAGS_dp_sharding"],
+            "fuse_grad_size_in_MB": fuse_mb,
+            "dp_grad_compress": str(compress) if compress is not None
+            else _flags._INITIAL["FLAGS_dp_grad_compress"],
+        })
         if getattr(strategy, "use_dgc", False):
             # reference: fleet swaps Momentum for DGCMomentum when
             # use_dgc is set; DGC inserts its own (sparse) exchange, so

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import op
@@ -78,6 +79,70 @@ op("c_allreduce_prod", no_grad=True)(
     _allreduce(lambda x, a: jnp.exp(lax.psum(jnp.log(x), a)))
 )
 op("allreduce", no_grad=True)(_allreduce(lambda x, a: lax.psum(x, a)))
+
+
+def _static_axis_size(axis):
+    """Axis size as a python int (needed for reshape chunk counts): the
+    registered mesh knows it at trace time; psum(1) only yields a traced
+    value."""
+    from ..parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is not None and axis in mesh.shape:
+        return int(mesh.shape[axis])
+    return int(_axis_size(axis))
+
+
+def _bf16_wire_psum(flat, axis):
+    """EQuARX-style compressed allreduce (arxiv 2506.17615): payload
+    crosses the wire as bf16 (half the bytes of f32) in both phases of a
+    reduce-scatter/all-gather decomposition, while the reduction itself
+    accumulates in f32 — so quantization error is one rounding per
+    addend, not a cascade through the ring."""
+    n = int(flat.shape[0])
+    nranks = _static_axis_size(axis)
+    pad = (-n) % nranks
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    # phase 1 (reduce-scatter): each device ships chunk d to device d in
+    # bf16; the receiver accumulates its chunk's nranks addends in f32
+    chunks = jnp.reshape(flat, (nranks, -1)).astype(jnp.bfloat16)
+    recv = lax.all_to_all(chunks, axis, split_axis=0, concat_axis=0,
+                          tiled=False)
+    red = jnp.sum(recv.astype(jnp.float32), axis=0)
+    # phase 2 (all-gather): the reduced shard goes back out in bf16
+    out = lax.all_gather(red.astype(jnp.bfloat16), axis, axis=0, tiled=True)
+    out = out.astype(flat.dtype)
+    return out[:n] if pad else out
+
+
+@op("c_fused_allreduce", no_grad=True)
+def _c_fused_allreduce(ctx):
+    """One flattened collective over a bucket of gradient tensors
+    (reference: ir/fuse_all_reduce_op_pass.cc lowering a grad group onto
+    one coalesced buffer — framework/ir.py fuse_all_reduce_pass emits
+    this op).  All bucket members share one dtype (the pass refuses
+    mixed-dtype merges); `compress="bf16"` rides the EQuARX wire format
+    for f32 payloads and is a graph-visible attr so the compiled program
+    records which format it shipped."""
+    xs = ctx.ins("X")
+    axis = _axis(ctx)
+    if not _in_shard_map(axis):
+        ctx.set_out("Out", list(xs))
+        return
+    shapes = [jnp.shape(x) for x in xs]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    flat = jnp.concatenate([jnp.ravel(x) for x in xs])
+    if ctx.attr("compress", "none") == "bf16" and flat.dtype == jnp.float32:
+        flat = _bf16_wire_psum(flat, axis)
+    else:
+        flat = lax.psum(flat, axis)
+    outs, off = [], 0
+    for s, sz in zip(shapes, sizes):
+        outs.append(jnp.reshape(lax.slice_in_dim(flat, off, off + sz, axis=0),
+                                s))
+        off += sz
+    ctx.set_out("Out", outs)
 
 
 @op("c_broadcast", no_grad=True)

@@ -510,6 +510,7 @@ def _multiclass_nms(ctx):
     N, C, M = scores.shape
     K = keep_top_k if keep_top_k > 0 else M
     out = np.full((N, K, 6), -1.0, np.float32)
+    kept_idx = np.full((N, K), -1, np.int64)
     counts = np.zeros((N,), np.int64)
     for n in range(N):
         dets = []
@@ -532,8 +533,14 @@ def _multiclass_nms(ctx):
             out[n, j, 0] = c
             out[n, j, 1] = s
             out[n, j, 2:] = boxes[n, i]
+            kept_idx[n, j] = n * M + i
     ctx.set_out("Out", jnp.asarray(out))
     ctx.set_out("NmsRoisNum", jnp.asarray(counts))
+    if ctx.has_output("Index"):
+        # multiclass_nms2 variant: kept indices into the flattened [N*M]
+        # box list, emitted from the selection itself — a coordinate
+        # match against the boxes would mis-map duplicate boxes
+        ctx.set_out("Index", jnp.asarray(kept_idx))
 
 
 @op("target_assign", no_grad=True)

@@ -274,27 +274,11 @@ def _detection_map(ctx):
 @op("multiclass_nms2", no_grad=True, host=True)
 def _multiclass_nms2(ctx):
     """multiclass_nms + the Index output (indices into the flattened
-    [N*M] box list) — detection/multiclass_nms_op.cc NMS2 variant."""
-    d = OPS["multiclass_nms"]
-    d.lower(ctx)
-    if ctx.has_output("Index"):
-        if hasattr(ctx, "env"):
-            out = ctx.env[ctx.op.outputs["Out"][0]]
-        else:  # dygraph trace ctx
-            out = ctx.outs["Out"][0]
-        boxes = np.asarray(jax.device_get(ctx.in_("BBoxes")))
-        o = np.asarray(jax.device_get(out))
-        N, K = o.shape[0], o.shape[1]
-        idx = np.full((N, K), -1, np.int64)
-        for n in range(N):
-            for k in range(K):
-                if o[n, k, 0] < 0:
-                    continue
-                hits = np.where(
-                    (np.abs(boxes[n] - o[n, k, 2:6]) < 1e-6).all(-1))[0]
-                if hits.size:
-                    idx[n, k] = n * boxes.shape[1] + int(hits[0])
-        ctx.set_out("Index", jnp.asarray(idx))
+    [N*M] box list) — detection/multiclass_nms_op.cc NMS2 variant.  The
+    base lowering emits the kept indices directly from its selection
+    loop (an O(N·K·M) coordinate re-match here would mis-map duplicate
+    boxes to the first coordinate hit)."""
+    OPS["multiclass_nms"].lower(ctx)
 
 
 # --------------------------------------------------------------------------

@@ -28,6 +28,80 @@ from .framework.dtype import VarType
 from .layer_helper import LayerHelper
 
 
+def _dp_shard_spec():
+    """ZeRO-1 flat-state sharding target (FLAGS_dp_sharding, the Fleet
+    `sharding` strategy analog): (dp_size, NamedSharding(P('dp'))) when
+    the flag is on and a multi-device 'dp' mesh is registered, else
+    None.  The dygraph fused-Adam buffers (master / moments) shard over
+    the dp axis so each device holds 1/dp_size of the optimizer state."""
+    from .utils.flags import flag
+
+    if not flag("dp_sharding"):
+        return None
+    from .parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None or "dp" not in mesh.axis_names:
+        return None
+    dp = int(mesh.shape["dp"])
+    if dp <= 1:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return dp, NamedSharding(mesh, PartitionSpec("dp"))
+
+
+def _shard_flat(x, n, shard):
+    """Pad a flat [n] buffer to a multiple of the dp axis and place it
+    sharded.  Zero-pad is update-invariant for adam: zero grad on a zero
+    moment leaves the pad rows zero forever.  Already-placed buffers
+    (steady state) pass through without a device_put dispatch."""
+    if shard is None:
+        return x
+    import jax
+    import jax.numpy as jnp
+
+    dp, sharding = shard
+    pad = (-n) % dp
+    if int(x.shape[0]) != n + pad:
+        if int(x.shape[0]) > n:
+            x = x[:n]  # drop a previous mesh size's zero pad
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+    if getattr(x, "sharding", None) == sharding:
+        return x
+    return jax.device_put(x, sharding)
+
+
+def _reshard_fused_state(state, n, shard, keys):
+    """Normalize flat fused-optimizer buffers to the current
+    FLAGS_dp_sharding mode: ON pads each buffer to a dp-axis multiple
+    and shards it; OFF slices a previously padded buffer back to its
+    logical length.  Values are carried either way, so flipping the
+    flag mid-run continues the same trajectory (the mode-flip oracle)."""
+    import jax
+    import jax.numpy as jnp
+
+    for k in keys:
+        buf = state.get(k)
+        if buf is None:
+            continue
+        if shard is not None:
+            state[k] = _shard_flat(buf, n, shard)
+        elif int(buf.shape[0]) > n:
+            state[k] = buf[:n]
+    if shard is not None:
+        # scalar beta-pow accumulators ride along mesh-replicated so the
+        # eager fused update sees one device set throughout
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(shard[1].mesh, PartitionSpec())
+        for k in ("b1p", "b2p"):
+            v = state.get(k)
+            if v is not None and getattr(v, "sharding", None) != rep:
+                state[k] = jax.device_put(v, rep)
+
+
 class Optimizer:
     def __init__(self, learning_rate, parameter_list=None, regularization=None,
                  grad_clip=None, name=None):
@@ -529,8 +603,14 @@ class AdamOptimizer(Optimizer):
         state = self._param_state.setdefault("@fused", {})
         if getattr(self, "_fused_layout", None) != layout or "m1" not in state:
             self._migrate_fused_state(state, layout, fused)
-        flat_p = jnp.concatenate([jnp.ravel(p._value) for p, _ in fused])
-        flat_g = jnp.concatenate([jnp.ravel(g) for _, g in fused])
+        total = sum(n for _, n in layout)
+        shard = _dp_shard_spec()
+        _reshard_fused_state(state, total, shard, ("m1", "m2"))
+        flat_p = _shard_flat(
+            jnp.concatenate([jnp.ravel(p._value) for p, _ in fused]),
+            total, shard)
+        flat_g = _shard_flat(
+            jnp.concatenate([jnp.ravel(g) for _, g in fused]), total, shard)
         outs = self._fused_adam_call(flat_p, flat_g, state, lr)
         new_flat = outs["ParamOut"][0]
         state["m1"] = outs["Moment1Out"][0]
@@ -577,8 +657,13 @@ class AdamOptimizer(Optimizer):
         if getattr(self, "_fused_mp_layout", None) != layout \
                 or "master" not in state:
             self._migrate_fused_mp_state(state, layout, fused)
-        flat_g = jnp.concatenate(
-            [jnp.ravel(g).astype(jnp.float32) for _, g in fused])
+        total = sum(n for _, n, _ in layout)
+        shard = _dp_shard_spec()
+        _reshard_fused_state(state, total, shard, ("master", "m1", "m2"))
+        flat_g = _shard_flat(
+            jnp.concatenate(
+                [jnp.ravel(g).astype(jnp.float32) for _, g in fused]),
+            total, shard)
         outs = self._fused_adam_call(state["master"], flat_g, state, lr)
         state["master"] = outs["ParamOut"][0]
         state["m1"] = outs["Moment1Out"][0]

@@ -46,6 +46,58 @@ def _program_has_collectives(program) -> bool:
     return False
 
 
+def _mesh_fingerprint(mesh):
+    """Value-based cache key for a mesh: id() can be reused by a new mesh
+    after the old one is garbage-collected, silently resurrecting a
+    stale compiled entry."""
+    return (tuple(mesh.axis_names), tuple(np.asarray(mesh.devices).shape),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+#: optimizer op -> accumulator input slots that are pure per-parameter
+#: state (read+written every step, never consumed elsewhere).  Under
+#: FLAGS_dp_sharding these shard over the 'dp' axis — the ZeRO-1 piece:
+#: each device keeps 1/ndev of the moments, GSPMD reduce-scatters the
+#: grad into the shard update and all-gathers only the updated params.
+#: Beta-pow accumulators (shape [1]) stay replicated: not divisible and
+#: 8 bytes each.
+_OPT_STATE_SLOTS = {
+    "momentum": ("Velocity",),
+    "lars_momentum": ("Velocity",),
+    "adam": ("Moment1", "Moment2"),
+    "adamw": ("Moment1", "Moment2"),
+    "lamb": ("Moment1", "Moment2"),
+    "adamax": ("Moment", "InfNorm"),
+    "adagrad": ("Moment",),
+    "decayed_adagrad": ("Moment",),
+    "adadelta": ("AvgSquaredGrad", "AvgSquaredUpdate"),
+    "rmsprop": ("Moment", "MeanSquare", "MeanGrad"),
+    "fused_momentum": ("Velocity",),
+    "fused_adam": ("Moment1", "Moment2"),
+}
+
+
+def _sharded_opt_state(ops, block, ndev):
+    """Optimizer-state var names eligible for ZeRO-1 sharding: leading
+    dim divisible by the mesh (jax 0.4.x has no uneven shards) and no
+    explicit tensor-parallel annotation to respect."""
+    names = set()
+    for op_ in ops:
+        slots = _OPT_STATE_SLOTS.get(op_.type)
+        if not slots:
+            continue
+        for slot in slots:
+            for n in op_.inputs.get(slot, []):
+                var = block._find_var_recursive(n)
+                if (var is None or getattr(var, "_sharding", None)
+                        or var.shape is None or not list(var.shape)):
+                    continue
+                d0 = var.shape[0]
+                if d0 and d0 > 0 and d0 % ndev == 0:
+                    names.add(n)
+    return names
+
+
 def _analyze(program, feed_names, scope):
     """Shared read/write analysis (executor.analyze_state)."""
     from ..executor import analyze_state
@@ -74,9 +126,11 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
     from ..utils.flags import flag
 
     key = (program._uid, program._version, feed_spec, tuple(fetch_names),
-           id(mesh), shard_sig, executor._nhwc_enabled(),
+           _mesh_fingerprint(mesh), shard_sig, executor._nhwc_enabled(),
            compiled_program.__dict__.get("_ir_passes", True),
-           bool(flag("apply_ir_passes")))
+           bool(flag("apply_ir_passes")), bool(flag("dp_sharding")),
+           float(flag("fuse_grad_size_in_MB") or 0),
+           str(flag("dp_grad_compress", "none")))
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})
     if key in cache:
         return cache[key]
@@ -109,12 +163,25 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
     # ('dp','mp')); otherwise the first axis
     axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
 
+    # ZeRO-1: with FLAGS_dp_sharding, optimizer state on the pjit path
+    # shards over the dp axis (shard_map programs keep their explicit
+    # collectives and replicated state — the fuse pass handles them)
+    opt_sharded = (
+        _sharded_opt_state(ops, block, mesh.shape[axis])
+        if bool(flag("dp_sharding")) and not use_shard_map else set()
+    )
+
     def param_sharding(name):
         """Tensor-parallel annotation (parallel.tensor_parallel
         .shard_parameter) or replicated."""
         var = block._find_var_recursive(name)
         spec = getattr(var, "_sharding", None) if var is not None else None
         return NamedSharding(mesh, P(*spec)) if spec else NamedSharding(mesh, P())
+
+    def state_sharding(name):
+        if name in opt_sharded:
+            return NamedSharding(mesh, P(axis))
+        return param_sharding(name)
 
     def body(state_vals, feed_vals, per_shard: bool):
         env: Dict[str, Any] = dict(state_vals)
@@ -153,12 +220,24 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
         def global_fn(state_vals, feed_vals):
             return body(state_vals, feed_vals, per_shard=False)
 
-        state_shardings = {n: param_sharding(n) for n in state_in}
+        state_shardings = {n: state_sharding(n) for n in state_in}
         feed_shardings = {k: NamedSharding(mesh, P(axis)) for k in feed}
-        jitted = jax.jit(
-            global_fn,
-            in_shardings=(state_shardings, feed_shardings),
-        )
+        if opt_sharded:
+            # pin sharded state on the way OUT too, or jit's default
+            # layout choice could all-gather the moments back after the
+            # update and erase the 1/ndev memory win (fetches stay
+            # unconstrained — the None prefix)
+            jitted = jax.jit(
+                global_fn,
+                in_shardings=(state_shardings, feed_shardings),
+                out_shardings=(None,
+                               {n: state_sharding(n) for n in state_out}),
+            )
+        else:
+            jitted = jax.jit(
+                global_fn,
+                in_shardings=(state_shardings, feed_shardings),
+            )
 
     # feed-conversion plan (target numpy dtype per feed name), computed
     # once per compilation — same helper as the single-device executor
@@ -166,7 +245,7 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
 
     feed_plan = build_feed_plan(block, feed)
 
-    entry = (jitted, state_in, state_out, use_shard_map, param_sharding,
+    entry = (jitted, state_in, state_out, use_shard_map, state_sharding,
              axis, feed_plan)
     cache[key] = entry
     return entry
@@ -192,7 +271,7 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
         mesh = default_dp_mesh(ndev)
         compiled.__dict__["_mesh"] = mesh
 
-    jitted, state_in, state_out, use_shard_map, param_sharding, axis, \
+    jitted, state_in, state_out, use_shard_map, state_sharding, axis, \
         feed_plan = _compile_dp(compiled, executor, program, feed,
                                 fetch_names, scope, mesh)
 
@@ -228,7 +307,7 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
             )
         if isinstance(val, LoDTensor):
             val = val.numpy()
-        sharding = repl if use_shard_map else param_sharding(name)
+        sharding = repl if use_shard_map else state_sharding(name)
         state_vals[name] = jax.device_put(val, sharding)
 
     fetched, new_state = jitted(state_vals, feed_vals)

@@ -55,6 +55,24 @@ _DEFAULTS: Dict[str, Any] = {
     # Executor.run calls (zero scope reads per steady-state step).  Off
     # restores the per-step scope.get rebind path.
     "FLAGS_tpu_step_session": True,
+    # ZeRO-1 optimizer-state sharding over the 'dp' mesh axis (the Fleet
+    # `sharding` strategy analog): Adam moments / momentum velocities /
+    # the dygraph fused-Adam flat master shard 1/ndev per device, and
+    # GSPMD turns the gradient allreduce into reduce-scatter -> local
+    # shard update -> all-gather of updated params.  Off (default)
+    # replicates all optimizer state — today's behavior.
+    "FLAGS_dp_sharding": False,
+    # coalesced gradient communication (reference:
+    # ir/fuse_all_reduce_op_pass.cc + coalesce_grad_tensor_pass.cc):
+    # consecutive same-dtype c_allreduce_sum ops bucket up to this many
+    # MB of payload and lower to ONE flattened collective.  0 disables
+    # the rewrite (one collective per gradient tensor, today's graph).
+    "FLAGS_fuse_grad_size_in_MB": 32.0,
+    # compressed allreduce for fused gradient buckets (EQuARX-style,
+    # arxiv 2506.17615): "bf16" halves wire bytes by casting the bucket
+    # payload to bf16 for transport while accumulating the reduction in
+    # f32; "none" (default) keeps full-width f32 allreduce.
+    "FLAGS_dp_grad_compress": "none",
 }
 
 
@@ -89,6 +107,12 @@ _flags: Dict[str, Any] = {}
 for k, v in _DEFAULTS.items():
     env = os.environ.get(k)
     _flags[k] = _coerce(v, env) if env is not None else v
+
+#: frozen process-start values (defaults + FLAGS_* env overrides): the
+#: restore point for config layers that reset a flag to "unconfigured"
+#: (e.g. fleet DistributedStrategy knobs left at None) — restoring raw
+#: _DEFAULTS would silently discard the operator's environment settings
+_INITIAL: Dict[str, Any] = dict(_flags)
 
 
 def set_flags(d: Dict[str, Any]):

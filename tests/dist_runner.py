@@ -18,11 +18,18 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-# cross-process CPU collectives need the gloo backend
-try:
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-except Exception:
-    pass
+# Cross-process CPU collectives need the gloo backend — but gloo can
+# only initialize when a jax.distributed client exists (the jaxlib
+# binding requires one), so gate it on the coordination-service env.
+# The PS modes exchange tensors over their own socket service and never
+# touch jax collectives; configuring gloo there would abort CPU-backend
+# init ("make_gloo_tcp_collectives: distributed_client NoneType").
+if (os.environ.get("PADDLE_COORDINATOR_ADDRESS")
+        and int(os.environ.get("PADDLE_NUM_PROCESSES", "1")) > 1):
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 import numpy as np
 

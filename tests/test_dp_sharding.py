@@ -1,0 +1,461 @@
+"""ZeRO-1 optimizer-state sharding + coalesced gradient comms (r7).
+
+Oracles:
+* fuse_all_reduce_pass bucket counts on a >=20-grad-tensor program and
+  bit-identity of the fused path with compression off (reference:
+  fuse_all_reduce_op_pass.cc semantics);
+* bucket-boundary behavior: empty / one-tensor / mixed-dtype groups
+  refuse to merge;
+* bf16 wire compression stays inside its quantization error bound;
+* FLAGS_dp_sharding shards pjit-path optimizer state 1/ndev per device
+  at loss parity with single-device execution, and the dygraph
+  fused-Adam buffers carry their values across a mid-run mode flip;
+* every mode rolls back to today's behavior via its flag.
+"""
+import os
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.utils import flags as _flags
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from dp_comm_stats import (  # noqa: E402
+    build_mlp_dp_program, collect_comm_stats)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flags_and_mesh():
+    saved = dict(_flags._flags)
+    mesh_mod.registry().clear()
+    yield
+    _flags._flags.clear()
+    _flags._flags.update(saved)
+    mesh_mod.registry().clear()
+
+
+def _init_scope(startup, scope):
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    return {k: np.asarray(v) for k, v in scope.items()
+            if not k.startswith("@")}
+
+
+def _data(width=64, n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, width).astype(np.float32)
+    ys = (xs[:, :1] * 2 + 1).astype(np.float32)
+    return xs, ys
+
+
+# --------------------------------------------------------------------------
+# fuse_all_reduce_pass
+# --------------------------------------------------------------------------
+def test_fuse_pass_bucket_count_bound():
+    """>=20 grad tensors collapse to <= ceil(total_MB / threshold_MB)
+    collectives — the acceptance bound."""
+    import math
+
+    main, startup, loss = build_mlp_dp_program(n_layers=10, width=64)
+    pre = collect_comm_stats(main, 8)
+    assert pre["collective_ops"] >= 20
+
+    mb = 0.05
+    _flags.set_flags({"fuse_grad_size_in_MB": mb})
+    exe = pt.Executor(pt.CPUPlace())
+    rewritten = exe._apply_ir_passes(main, [loss.name])
+    post = collect_comm_stats(rewritten, 8)
+    total_mb = pre["payload_bytes"] / float(1 << 20)
+    assert post["collective_ops"] <= math.ceil(total_mb / mb), post
+    # payload is conserved across the rewrite
+    assert post["payload_bytes"] == pre["payload_bytes"]
+    # every bucket carries >1 tensor (single-tensor groups keep their op)
+    assert all(b["n_tensors"] >= 2 for b in post["buckets"])
+
+
+def test_fuse_pass_bit_identical_and_rollback():
+    """Fused (compress off) loses not one bit vs the unfused graph, and
+    FLAGS_fuse_grad_size_in_MB=0 restores the unfused graph exactly."""
+    mesh_mod.init_mesh()
+    width = 16
+    main, startup, loss = build_mlp_dp_program(n_layers=3, width=width,
+                                               seed=3)
+    xs, ys = _data(width)
+    exe = pt.Executor(pt.CPUPlace())
+
+    def run(mb):
+        _flags.set_flags({"fuse_grad_size_in_MB": mb,
+                          "dp_grad_compress": "none"})
+        scope = Scope()
+        for k, v in init.items():
+            scope.set(k, v.copy())
+        compiled = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        losses = [
+            np.asarray(exe.run(compiled, feed={"x": xs, "y": ys},
+                               fetch_list=[loss], scope=scope)[0])
+            for _ in range(5)
+        ]
+        params = {k: np.asarray(scope.get(k)) for k in init}
+        return losses, params
+
+    sa = Scope()
+    init = _init_scope(startup, sa)
+    fused_l, fused_p = run(mb=32)
+    unfused_l, unfused_p = run(mb=0)
+    for a, b in zip(fused_l, unfused_l):
+        np.testing.assert_array_equal(a, b)
+    for k in init:
+        np.testing.assert_array_equal(fused_p[k], unfused_p[k])
+
+    # rollback: threshold 0 leaves the program untouched by the pass
+    _flags.set_flags({"fuse_grad_size_in_MB": 0})
+    rewritten = exe._apply_ir_passes(main, [loss.name])
+    stats = collect_comm_stats(rewritten, 8)
+    assert "c_fused_allreduce" not in stats["ops_by_type"]
+    assert stats["ops_by_type"]["c_allreduce_sum"] == \
+        collect_comm_stats(main, 8)["ops_by_type"]["c_allreduce_sum"]
+
+
+def test_fuse_pass_bucket_boundaries():
+    """Empty program: no-op.  One-tensor group: original op kept.
+    Mixed dtypes: refuse to merge across the boundary."""
+    from paddle_tpu.framework.ir import get_pass
+
+    # empty — no collectives at all
+    empty = fluid.Program()
+    with fluid.program_guard(empty, fluid.Program()):
+        fluid.layers.data("e", [4])
+    p = get_pass("fuse_all_reduce_pass", max_bytes=1 << 20)
+    p.apply(empty)
+    assert p.fused_count == 0
+
+    def ar_program(specs):
+        main = fluid.Program()
+        block = main.global_block()
+        for name, dtype in specs:
+            v = block.create_var(name=name, shape=[8], dtype=dtype)
+            want = v.dtype
+            block.append_op("c_allreduce_sum", inputs={"X": [name]},
+                            outputs={"Out": [name]}, attrs={"ring_id": 0})
+            # append_op's shape inference defaults the out var to f32;
+            # restore the declared dtype (grad programs carry real ones)
+            v.dtype = want
+        return main, block
+
+    # single tensor — nothing to fuse, op list unchanged
+    main, block = ar_program([("a", "float32")])
+    p = get_pass("fuse_all_reduce_pass", max_bytes=1 << 20)
+    p.apply(main)
+    assert [o.type for o in block.ops] == ["c_allreduce_sum"]
+
+    # f32 / f64 / f32: the f64 both stays per-tensor and splits the f32s
+    main, block = ar_program(
+        [("a", "float32"), ("b", "float64"), ("c", "float32")])
+    p = get_pass("fuse_all_reduce_pass", max_bytes=1 << 20)
+    p.apply(main)
+    assert [o.type for o in block.ops] == ["c_allreduce_sum"] * 3
+
+    # two adjacent f32s merge; the trailing f64 keeps its own op
+    main, block = ar_program(
+        [("a", "float32"), ("c", "float32"), ("b", "float64")])
+    p = get_pass("fuse_all_reduce_pass", max_bytes=1 << 20)
+    p.apply(main)
+    types = [o.type for o in block.ops]
+    assert types.count("c_fused_allreduce") == 1
+    assert types.count("c_allreduce_sum") == 1
+    fused = [o for o in block.ops if o.type == "c_fused_allreduce"][0]
+    assert fused.inputs["X"] == ["a", "c"]
+
+
+def test_compressed_allreduce_error_bound():
+    """bf16 wire format: fused allreduce of random f32 payloads stays
+    within the quantization bound of the exact sum (one rounding per
+    addend — f32 accumulation, EQuARX-style)."""
+    mesh_mod.init_mesh()
+    _flags.set_flags({"fuse_grad_size_in_MB": 32,
+                      "dp_grad_compress": "bf16"})
+    main = fluid.Program()
+    block = main.global_block()
+    names = []
+    for i in range(3):
+        # static [8, 4] shape (grad tensors are static; the pass skips
+        # dynamic -1 batch dims)
+        block.create_var(name=f"x{i}", shape=[8, 4], dtype="float32")
+        block.append_op(
+            "c_allreduce_sum", inputs={"X": [f"x{i}"]},
+            outputs={"Out": [f"x{i}"]}, attrs={"ring_id": 0})
+        names.append(f"x{i}")
+    rng = np.random.RandomState(0)
+    feeds = {n: rng.randn(8, 4).astype(np.float32) for n in names}
+    exe = pt.Executor(pt.CPUPlace())
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    got = exe.run(compiled, feed=dict(feeds), fetch_list=list(names),
+                  scope=Scope())
+    # the rewritten program really shipped ONE compressed bucket
+    rewritten = exe._apply_ir_passes(main, list(names))
+    stats = collect_comm_stats(rewritten, 8)
+    assert stats["ops_by_type"] == {"c_fused_allreduce": 1}
+    assert stats["buckets"][0]["compress"] == "bf16"
+    for n, g in zip(names, got):
+        expect = feeds[n].sum(axis=0, keepdims=True)
+        assert np.asarray(g).shape == (8, 1, 4)
+        for i in range(8):
+            np.testing.assert_allclose(np.asarray(g)[i], expect,
+                                       rtol=5e-2, atol=5e-2)
+        # and the bound is real: bf16 wire cannot be bit-exact in general
+        scale = np.max(np.abs(expect))
+        assert np.max(np.abs(np.asarray(g)[0] - expect)) < 0.02 * scale + 1e-3
+
+
+# --------------------------------------------------------------------------
+# ZeRO-1: pjit path
+# --------------------------------------------------------------------------
+def _moment_shards(scope):
+    import jax
+
+    out = {}
+    for k, v in scope.items():
+        if "moment" in k and isinstance(v, jax.Array):
+            out[k] = (tuple(v.shape),
+                      v.addressable_shards[0].data.nbytes / v.nbytes)
+    return out
+
+
+def test_pjit_sharded_optimizer_parity_and_memory():
+    """FLAGS_dp_sharding=1: >=10-step loss parity with single-device
+    Adam, and every divisible moment holds 1/8 of its bytes per device
+    (the [1]-shaped pow accumulators stay replicated — the padding
+    allowance)."""
+    width = 16
+    main, startup, loss = build_mlp_dp_program(
+        n_layers=2, width=width, optimizer="adam", lr=0.01, transpile=False)
+    xs, ys = _data(width)
+    exe = pt.Executor(pt.CPUPlace())
+    sa = Scope()
+    init = _init_scope(startup, sa)
+    single = [float(exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss], scope=sa)[0])
+              for _ in range(10)]
+
+    _flags.set_flags({"dp_sharding": 1})
+    sb = Scope()
+    for k, v in init.items():
+        sb.set(k, v.copy())
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    dp = [float(exe.run(compiled, feed={"x": xs, "y": ys},
+                        fetch_list=[loss], scope=sb)[0])
+          for _ in range(10)]
+    np.testing.assert_allclose(single, dp, rtol=1e-4, atol=1e-5)
+
+    shards = _moment_shards(sb)
+    assert shards, "no optimizer state found in scope"
+    for name, (shape, frac) in shards.items():
+        if shape[0] % 8 == 0:
+            assert frac == pytest.approx(1 / 8), (name, shape, frac)
+        else:
+            assert frac == 1.0, (name, shape, frac)
+    assert any(shape[0] % 8 == 0 for shape, _ in shards.values())
+
+
+def test_pjit_sharding_rollback_replicated():
+    """Default FLAGS_dp_sharding=0 keeps every moment fully replicated —
+    today's behavior."""
+    width = 16
+    main, startup, loss = build_mlp_dp_program(
+        n_layers=2, width=width, optimizer="adam", lr=0.01, transpile=False)
+    xs, ys = _data(width)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = Scope()
+    _init_scope(startup, scope)
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    for _ in range(2):
+        exe.run(compiled, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                scope=scope)
+    for name, (shape, frac) in _moment_shards(scope).items():
+        assert frac == 1.0, (name, shape, frac)
+
+
+# --------------------------------------------------------------------------
+# ZeRO-1: dygraph fused-Adam flat buffers
+# --------------------------------------------------------------------------
+def _dygraph_train(flip_on_at=None, flip_off_at=None, steps=14):
+    import jax
+    from paddle_tpu.dygraph import Linear, Sequential, guard, to_variable
+
+    mesh_mod.registry().clear()
+    mesh_mod.init_mesh()
+    _flags.set_flags({"dp_sharding": 0})
+    xs = np.random.RandomState(0).randn(32, 8).astype(np.float32)
+    ys = (xs[:, :1] * 1.5 - 0.5).astype(np.float32)
+    with guard():
+        net = Sequential(Linear(8, 16, act="relu"), Linear(16, 1))
+        rs = np.random.RandomState(11)
+        for p in net.parameters():
+            p._value = jax.numpy.asarray(
+                (rs.rand(*p.shape).astype(np.float32) - 0.5) * 0.2)
+        opt = fluid.optimizer.AdamOptimizer(
+            0.01, parameter_list=net.parameters())
+        losses = []
+        for i in range(steps):
+            if flip_on_at is not None and i == flip_on_at:
+                _flags.set_flags({"dp_sharding": 1})
+            if flip_off_at is not None and i == flip_off_at:
+                _flags.set_flags({"dp_sharding": 0})
+            pred = net(to_variable(xs))
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, to_variable(ys)))
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+            losses.append(float(np.asarray(loss.value()).ravel()[0]))
+        state = dict(opt._param_state.get("@fused", {}))
+    _flags.set_flags({"dp_sharding": 0})
+    return losses, state
+
+
+def test_dygraph_fused_adam_sharding_mode_flip():
+    """Flat fused-Adam state survives sharding on AND off mid-run with
+    the identical trajectory, and the sharded buffer really holds
+    1/ndev (+pad) per device."""
+    base, _ = _dygraph_train(steps=14)
+    flip, state = _dygraph_train(flip_on_at=4, flip_off_at=10, steps=14)
+    np.testing.assert_allclose(base, flip, rtol=1e-6, atol=1e-7)
+    # flag is off at the end: buffers sliced back to logical length
+    n_params = 8 * 16 + 16 + 16 * 1 + 1  # 161
+    assert int(state["m1"].shape[0]) == n_params
+
+    _, sharded_state = _dygraph_train(flip_on_at=4, steps=14)
+    m1 = sharded_state["m1"]
+    padded = -(-n_params // 8) * 8
+    assert int(m1.shape[0]) == padded
+    assert len(m1.sharding.device_set) == 8
+    assert m1.addressable_shards[0].data.nbytes == m1.nbytes // 8
+
+
+def test_dygraph_fused_mp_master_sharding():
+    """amp-O2 path (_apply_fused_mp): bf16-resident params with f32
+    grads keep their f32 master sharded under FLAGS_dp_sharding, at an
+    unchanged trajectory."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(shard_from=None, steps=8):
+        mesh_mod.registry().clear()
+        mesh_mod.init_mesh()
+        _flags.set_flags({"dp_sharding": 0})
+        rs = np.random.RandomState(5)
+        params = [
+            SimpleNamespace(name=f"p{i}",
+                            _value=jnp.asarray(
+                                rs.rand(*s).astype(np.float32)
+                            ).astype(jnp.bfloat16))
+            for i, s in enumerate([(4, 8), (8,), (8, 2)])
+        ]
+        opt = fluid.optimizer.AdamOptimizer(0.01)
+        grs = np.random.RandomState(7)
+        grads_per_step = [
+            [jnp.asarray(grs.randn(*np.shape(p._value)).astype(np.float32))
+             for p in params]
+            for _ in range(steps)
+        ]
+        for i in range(steps):
+            if shard_from is not None and i == shard_from:
+                _flags.set_flags({"dp_sharding": 1})
+            opt._dygraph_apply(list(zip(params, grads_per_step[i])))
+        vals = [np.asarray(p._value.astype(jnp.float32)) for p in params]
+        state = dict(opt._param_state.get("@fused_mp", {}))
+        _flags.set_flags({"dp_sharding": 0})
+        return vals, state
+
+    base_vals, _ = run()
+    flip_vals, state = run(shard_from=3)
+    for a, b in zip(base_vals, flip_vals):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    master = state["master"]
+    n = 4 * 8 + 8 + 8 * 2  # 56 -> multiple of 8 already
+    assert int(master.shape[0]) == n
+    assert len(master.sharding.device_set) == 8
+    assert master.addressable_shards[0].data.nbytes == master.nbytes // 8
+
+
+def test_dygraph_sharding_mesh_resize_repads():
+    """A flat buffer padded for one dp size re-pads when the mesh is
+    rebuilt with another — dp=4's 164-pad must not be device_put with an
+    8-way sharding (not divisible)."""
+    import jax
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(5)
+    # 3 + 158 = 161 elements: pad 164 on dp=4, 168 on dp=8
+    params = [
+        SimpleNamespace(name=f"q{i}",
+                        _value=jnp.asarray(rs.rand(*s).astype(np.float32)))
+        for i, s in enumerate([(3,), (158,)])
+    ]
+    opt = fluid.optimizer.AdamOptimizer(0.01)
+    grs = np.random.RandomState(7)
+
+    def step():
+        grads = [jnp.asarray(grs.randn(*np.shape(p._value))
+                             .astype(np.float32)) for p in params]
+        opt._dygraph_apply(list(zip(params, grads)))
+
+    _flags.set_flags({"dp_sharding": 1})
+    mesh_mod.registry().clear()
+    mesh_mod.init_mesh((4,), ("dp",))
+    for _ in range(2):
+        step()
+    m1 = opt._param_state["@fused"]["m1"]
+    assert int(m1.shape[0]) == 164
+
+    mesh_mod.registry().clear()
+    mesh_mod.init_mesh((8,), ("dp",))
+    for _ in range(2):
+        step()
+    m1 = opt._param_state["@fused"]["m1"]
+    assert int(m1.shape[0]) == 168
+    assert len(m1.sharding.device_set) == 8
+    for p in params:
+        assert np.isfinite(np.asarray(p._value)).all()
+
+
+# --------------------------------------------------------------------------
+# multiclass_nms2 kept-index satellite
+# --------------------------------------------------------------------------
+def test_multiclass_nms2_duplicate_boxes_index():
+    """Duplicate coordinates must map to the box the NMS actually kept,
+    not to the first coordinate match (the old O(N*K*M) re-match)."""
+    from paddle_tpu.contrib.layers import multiclass_nms2
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        bb = fluid.data(name="nb", shape=[1, 3, 4], dtype="float32")
+        sc = fluid.data(name="ns", shape=[1, 2, 3], dtype="float32")
+        out, idx = multiclass_nms2(bb, sc, score_threshold=0.3,
+                                   nms_top_k=3, keep_top_k=3,
+                                   background_label=0, return_index=True)
+    boxes = np.zeros((1, 3, 4), np.float32)
+    boxes[0, 0] = [0, 0, 5, 5]
+    boxes[0, 1] = [0, 0, 5, 5]      # duplicate of box 0
+    boxes[0, 2] = [20, 20, 25, 25]  # well separated
+    scores = np.zeros((1, 2, 3), np.float32)
+    # box 0 is BELOW threshold; the kept duplicate is box 1
+    scores[0, 1] = [0.1, 0.9, 0.8]
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    o, ind = exe.run(main, feed={"nb": boxes, "ns": scores},
+                     fetch_list=[out, idx])
+    assert float(o[0, 0, 1]) == pytest.approx(0.9)
+    assert int(ind[0, 0]) == 1, ind  # the coordinate re-match said 0
+    assert int(ind[0, 1]) == 2
+    assert int(ind[0, 2]) == -1

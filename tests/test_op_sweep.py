@@ -923,7 +923,8 @@ COVERED_ELSEWHERE = {
     "c_allreduce_min": "test_parallel", "c_allreduce_prod": "test_parallel",
     "c_allreduce_sum": "test_parallel", "c_broadcast": "test_parallel",
     "c_comm_init": "test_parallel", "c_comm_init_all": "test_parallel",
-    "c_concat": "test_parallel", "c_gen_nccl_id": "test_parallel",
+    "c_concat": "test_parallel", "c_fused_allreduce": "test_dp_sharding",
+    "c_gen_nccl_id": "test_parallel",
     "c_identity": "test_parallel", "c_reducescatter": "test_parallel",
     "c_split": "test_parallel", "c_sync_calc_stream": "test_parallel",
     "c_sync_comm_stream": "test_parallel", "c_wait_calc_stream": "test_parallel",

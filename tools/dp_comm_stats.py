@@ -1,0 +1,177 @@
+"""Comm introspection for data-parallel programs: collective-op counts,
+per-bucket sizes, and estimated wire bytes — so a PR's comm regression is
+reviewable from the program graph without a chip.
+
+``collect_comm_stats(program, nranks)`` walks the (optionally IR-rewritten)
+program and models each collective's ring cost; the CLI builds a
+20-grad-tensor MLP, applies the GradAllReduce transpile plus the
+executor's IR pipeline under the current FLAGS (FLAGS_fuse_grad_size_in_MB,
+FLAGS_dp_grad_compress), and prints the before/after JSON:
+
+    python tools/dp_comm_stats.py [--nranks 8] [--mb 32] [--compress bf16]
+
+Wire model (bidirectional ring, bytes per chip):
+  allreduce        2*(n-1)/n * payload
+  reduce-scatter     (n-1)/n * payload
+  all-gather         (n-1)/n * payload
+  broadcast          (n-1)/n * payload
+  fused bucket, compress=bf16: payload halves on the wire (f32 -> bf16
+  transport, f32 accumulation — ops/collective_ops.py _bf16_wire_psum).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+#: collective type -> wire-traffic factor in units of payload bytes
+#: (multiplied by (n-1)/n for the ring)
+_RING_FACTOR = {
+    "c_allreduce_sum": 2.0,
+    "c_allreduce_max": 2.0,
+    "c_allreduce_min": 2.0,
+    "c_allreduce_prod": 2.0,
+    "allreduce": 2.0,
+    "c_fused_allreduce": 2.0,
+    "c_reducescatter": 1.0,
+    "c_allgather": 1.0,
+    "c_broadcast": 1.0,
+    "broadcast": 1.0,
+    "c_concat": 1.0,
+    "c_split": 0.0,
+    "alltoall": 1.0,
+}
+
+
+def _var_bytes(block, name):
+    from paddle_tpu.framework.dtype import to_numpy_dtype
+
+    var = block._find_var_recursive(name)
+    if var is None or var.shape is None or var.dtype is None:
+        return None
+    shape = [abs(int(d)) for d in var.shape if d is not None]
+    try:
+        itemsize = np.dtype(to_numpy_dtype(var.dtype)).itemsize
+    except Exception:
+        return None
+    return int(np.prod(shape)) * itemsize if shape else itemsize
+
+
+def collect_comm_stats(program, nranks=8):
+    """Walk every block; return collective counts, payload/wire bytes and
+    the fused-bucket inventory."""
+    ops_by_type = {}
+    payload_total = 0
+    wire_total = 0.0
+    buckets = []
+    ring = (nranks - 1) / float(nranks) if nranks > 1 else 0.0
+    for blk in program.blocks:
+        for op_ in blk.ops:
+            factor = _RING_FACTOR.get(op_.type)
+            if factor is None:
+                continue
+            names = op_.inputs.get("X", [])
+            sizes = [_var_bytes(blk, n) for n in names]
+            payload = sum(s for s in sizes if s is not None)
+            wire = factor * ring * payload
+            if (op_.type == "c_fused_allreduce"
+                    and op_.attrs.get("compress", "none") == "bf16"):
+                wire /= 2.0
+            ops_by_type[op_.type] = ops_by_type.get(op_.type, 0) + 1
+            payload_total += payload
+            wire_total += wire
+            if op_.type == "c_fused_allreduce":
+                buckets.append({
+                    "n_tensors": len(names),
+                    "payload_bytes": payload,
+                    "compress": op_.attrs.get("compress", "none"),
+                    "tensors": list(names),
+                })
+    return {
+        "nranks": nranks,
+        "collective_ops": sum(ops_by_type.values()),
+        "ops_by_type": ops_by_type,
+        "payload_bytes": payload_total,
+        "est_wire_bytes_per_chip": int(wire_total),
+        "buckets": buckets,
+    }
+
+
+def build_mlp_dp_program(n_layers=10, width=64, nranks=8, optimizer="sgd",
+                         lr=0.1, seed=3, transpile=True):
+    """An MLP with 2*n_layers grad tensors, optionally GradAllReduce-
+    transpiled — the >=20-grad-tensor shape the fuse-pass acceptance
+    criterion names.  Shared by this CLI and tests/test_dp_sharding.py
+    so the program the stats describe is the program the tests verify.
+    Returns (main, startup, loss)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.transpiler import GradAllReduce
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [width])
+        y = fluid.layers.data("y", [1])
+        h = x
+        for _ in range(n_layers - 1):
+            h = fluid.layers.fc(h, width, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        if optimizer == "adam":
+            fluid.optimizer.AdamOptimizer(lr).minimize(loss)
+        else:
+            fluid.optimizer.SGDOptimizer(lr).minimize(loss)
+    if transpile:
+        GradAllReduce().transpile(startup_program=startup, main_program=main,
+                                  rank=0, endpoints=["127.0.0.1:6170"],
+                                  nranks=nranks)
+    return main, startup, loss
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nranks", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=10)
+    ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--mb", type=float, default=None,
+                    help="override FLAGS_fuse_grad_size_in_MB")
+    ap.add_argument("--compress", default=None,
+                    help="override FLAGS_dp_grad_compress (none|bf16)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu.utils import flags
+
+    updates = {}
+    if args.mb is not None:
+        updates["fuse_grad_size_in_MB"] = args.mb
+    if args.compress is not None:
+        updates["dp_grad_compress"] = args.compress
+    if updates:
+        flags.set_flags(updates)
+
+    main_p, _, loss = build_mlp_dp_program(args.layers, args.width,
+                                           args.nranks)
+    before = collect_comm_stats(main_p, args.nranks)
+    exe = pt.Executor(pt.CPUPlace())
+    rewritten = exe._apply_ir_passes(main_p, [loss.name])
+    after = collect_comm_stats(rewritten, args.nranks)
+    print(json.dumps({
+        "fuse_grad_size_in_MB": flags.flag("fuse_grad_size_in_MB"),
+        "dp_grad_compress": flags.flag("dp_grad_compress"),
+        "unfused": before,
+        "fused": after,
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    main()
