@@ -276,14 +276,19 @@ def bench_lenet_parity():
 def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
     """Runs inside the forced-{n}-device subprocess: per-step loss parity
     between single-device and each DP comm mode, plus per-mode step time,
-    collective counts / estimated wire bytes (tools/dp_comm_stats model)
-    and optimizer-state bytes per device.  Modes (r7):
+    collective counts / estimated wire bytes / overlap schedule
+    (tools/dp_comm_stats model) and optimizer-state / parameter /
+    gradient-buffer bytes per device.  Modes (r8):
 
-      pjit              with_data_parallel, replicated state (baseline)
-      pjit_sharded      FLAGS_dp_sharding=1 — ZeRO-1 optimizer sharding
-      collective        GradAllReduce program, FLAGS_fuse_grad_size_in_MB=0
-      collective_fused  bucketed c_fused_allreduce (default coalescing)
-      collective_bf16   fused + FLAGS_dp_grad_compress=bf16 wire format
+      pjit               with_data_parallel, replicated state (stage 0)
+      pjit_sharded       FLAGS_dp_sharding=1 — ZeRO-1 optimizer sharding
+      pjit_zero2         FLAGS_dp_sharding=2 — + gradient sharding
+      pjit_zero3         FLAGS_dp_sharding=3 — + parameter sharding
+      collective         GradAllReduce program, FLAGS_fuse_grad_size_in_MB=0
+      collective_fused   bucketed c_fused_allreduce (default coalescing)
+      collective_bf16    fused + FLAGS_dp_grad_compress=bf16 wire format
+      collective_zero1-3 the sharding ladder on the shard_map/fleet path
+                         (stage 2+ lowers buckets to c_fused_reduce_scatter)
 
     Prints one SCALING=<json> line."""
     import json as _json
@@ -304,7 +309,7 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
 
     here = os.path.dirname(os.path.abspath(__file__))
     _sys.path.insert(0, os.path.join(here, "tools"))
-    from dp_comm_stats import collect_comm_stats
+    from dp_comm_stats import collect_comm_stats, grad_buffer_bytes
 
     def build(collective):
         # fresh name generator per build => identical var names, so one
@@ -341,26 +346,45 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
 
     main_c, startup_c, loss_c = build(collective=True)
 
-    def opt_state_bytes(scope):
-        total = per_dev = 0
-        for k, v in scope.items():
-            if "moment" not in k or not isinstance(v, jax.Array):
-                continue
-            total += v.nbytes
-            per_dev += v.addressable_shards[0].data.nbytes
-        return total, per_dev
+    param_names = {p.name for p in main.all_parameters()} | \
+        {p.name for p in main_c.all_parameters()}
 
+    def state_bytes(scope):
+        """(opt_total, opt_per_dev, param_total, param_per_dev) measured
+        from the live scope arrays' addressable shards."""
+        ot = od = pt_ = pd = 0
+        for k, v in scope.items():
+            if not isinstance(v, jax.Array):
+                continue
+            if "moment" in k:
+                ot += v.nbytes
+                od += v.addressable_shards[0].data.nbytes
+            elif k in param_names:
+                pt_ += v.nbytes
+                pd += v.addressable_shards[0].data.nbytes
+        return ot, od, pt_, pd
+
+    # the four FLAGS_dp_sharding stages on each DP path (r8), plus the
+    # r7 comm-format modes
     MODES = [
         ("pjit", False, {"dp_sharding": 0}),
         ("pjit_sharded", False, {"dp_sharding": 1}),
+        ("pjit_zero2", False, {"dp_sharding": 2}),
+        ("pjit_zero3", False, {"dp_sharding": 3}),
         ("collective", True, {"fuse_grad_size_in_MB": 0.0}),
         ("collective_fused", True, {"fuse_grad_size_in_MB": 32.0,
                                     "dp_grad_compress": "none"}),
         ("collective_bf16", True, {"fuse_grad_size_in_MB": 32.0,
                                    "dp_grad_compress": "bf16"}),
+        ("collective_zero1", True, {"dp_sharding": 1,
+                                    "fuse_grad_size_in_MB": 32.0}),
+        ("collective_zero2", True, {"dp_sharding": 2,
+                                    "fuse_grad_size_in_MB": 32.0}),
+        ("collective_zero3", True, {"dp_sharding": 3,
+                                    "fuse_grad_size_in_MB": 32.0}),
     ]
     defaults = {"dp_sharding": 0, "fuse_grad_size_in_MB": 32.0,
-                "dp_grad_compress": "none"}
+                "dp_grad_compress": "none", "dp_comm_overlap": 1}
     modes = {}
     for name, collective, overrides in MODES:
         _flags.set_flags({**defaults, **overrides})
@@ -386,8 +410,12 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
         dt = time.perf_counter() - t0
         rewritten = exe._apply_ir_passes(mp, [lv.name])
         comm = collect_comm_stats(rewritten, n_devices)
-        total, per_dev = opt_state_bytes(sc)
+        stage = int(_flags.flag("dp_sharding") or 0)
+        grad_total, grad_per_dev = grad_buffer_bytes(rewritten, n_devices,
+                                                     stage)
+        ot, od, pt_, pd = state_bytes(sc)
         modes[name] = {
+            "sharding_stage": stage,
             "losses": [round(v, 6) for v in dp],
             "max_absdiff": float(np.max(np.abs(
                 np.asarray(single) - np.asarray(dp)))),
@@ -395,8 +423,14 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
             "collective_ops": comm["collective_ops"],
             "est_wire_bytes_per_chip": comm["est_wire_bytes_per_chip"],
             "n_buckets": len(comm["buckets"]),
-            "opt_state_bytes_total": total,
-            "opt_state_bytes_per_dev": per_dev,
+            "n_buckets_overlapped": comm["overlap"]["n_buckets_overlapped"],
+            "est_exposed_comm_bytes": comm["overlap"]["est_exposed_comm_bytes"],
+            "opt_state_bytes_total": ot,
+            "opt_state_bytes_per_dev": od,
+            "param_bytes_total": pt_,
+            "param_bytes_per_dev": pd,
+            "grad_buffer_bytes_total": grad_total,
+            "grad_buffer_bytes_per_dev": grad_per_dev,
         }
     _flags.set_flags(defaults)
     print("SCALING=" + _json.dumps({

@@ -15,7 +15,8 @@ import numpy as np
 from .. import tensor as T
 from .. import layers as L
 
-__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag"]
 
 
 def _wrap(value, like=None, dtype="float32"):

@@ -294,7 +294,8 @@ class Executor:
         key = (program._uid, program._version, feed_spec, tuple(fetch_names),
                check_nan_inf, unused_check, ir_passes, donate, nhwc,
                float(flag("fuse_grad_size_in_MB") or 0),
-               str(flag("dp_grad_compress", "none")))
+               str(flag("dp_grad_compress", "none")),
+               int(flag("dp_sharding") or 0), bool(flag("dp_comm_overlap")))
         hit = self._cache.get(key)
         if hit is not None:
             return hit
@@ -502,11 +503,18 @@ class Executor:
 
         protected = tuple(fetch_names)
         passes = []
+        sharding_stage = int(flag("dp_sharding") or 0)
+        has_collectives = any(t.startswith("c_") for t in types)
         if "batch_norm" in types:
             passes += [get_pass("fuse_bn_add_act_pass", protected=protected),
                        get_pass("fuse_bn_act_pass", protected=protected)]
         if types & set(_FUSABLE_OPT):
-            passes.append(get_pass("fuse_optimizer_ops_pass"))
+            if not (sharding_stage >= 1 and has_collectives):
+                # FLAGS_dp_sharding on the collective path keeps
+                # per-parameter update ops: the DP runner's shard-aware
+                # wrapper slices each (param, grad, state) individually,
+                # which the multi-tensor fused forms would defeat
+                passes.append(get_pass("fuse_optimizer_ops_pass"))
         if self._nhwc_enabled() and types & {"conv2d", "depthwise_conv2d"}:
             # after the bn fusions so the NHWC walk sees the fused ops
             passes.append(get_pass("layout_transform_pass",
@@ -515,11 +523,17 @@ class Executor:
             mb = float(flag("fuse_grad_size_in_MB") or 0)
             if mb > 0:
                 # coalesce per-tensor grad allreduces (the shard_map DP
-                # path) into bucketed fused collectives
+                # path) into bucketed fused collectives, scheduled for
+                # backward overlap (and reduce-scattered under ZeRO-2)
+                from .parallel.mesh import ring_axis_size
+
                 passes.append(get_pass(
                     "fuse_all_reduce_pass",
                     max_bytes=int(mb * (1 << 20)),
-                    compress=str(flag("dp_grad_compress", "none"))))
+                    compress=str(flag("dp_grad_compress", "none")),
+                    overlap=bool(flag("dp_comm_overlap")),
+                    sharding_stage=sharding_stage,
+                    ndev=ring_axis_size(0)))
         if not passes:
             return program
         clone = Program.from_desc_dict(program.desc_dict())

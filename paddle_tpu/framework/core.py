@@ -509,18 +509,31 @@ class Program:
 
     def clone(self, for_test: bool = False) -> "Program":
         """Deep clone (reference: framework.py Program.clone).  With
-        ``for_test=True``, ops flip their ``is_test`` attr (dropout/batch_norm
-        change behavior) and ops after the last loss-relevant op are kept —
-        matching the reference's test-program cloning contract."""
+        ``for_test=True``, backward/optimize/lr-sched-role ops are pruned
+        (reference: framework.py:4194-4209 — cloning after ``minimize()``
+        yields a forward-only program) and the surviving ops flip their
+        ``is_test`` attr (dropout/batch_norm change behavior)."""
         p = Program.from_desc_dict(self.desc_dict())
         p.random_seed = self.random_seed
         if for_test:
+            # roles are recorded as op attrs at build time, so the clone
+            # needs no graph analysis to drop the training tail.  Note
+            # OpRole.RPC (3) overlaps the Backward|Optimize bits and is
+            # pruned too — an RPC op has no place in a test program.
+            from ..backward import OpRole
+
+            role_mask = OpRole.Backward | OpRole.Optimize | OpRole.LRSched
             for blk in p.blocks:
+                blk.ops[:] = [
+                    op for op in blk.ops
+                    if not (int(op.attrs.get("op_role", 0)) & role_mask)
+                ]
                 for op in blk.ops:
                     if "is_test" in op.attrs:
                         op.attrs["is_test"] = True
                     if op.type == "dropout":
                         op.attrs["is_test"] = True
+            p._bump_version()
         return p
 
     # -- serialization -----------------------------------------------------

@@ -1512,13 +1512,14 @@ class LayoutTransformPass(Pass):
 # --------------------------------------------------------------------------
 @register_pass("fuse_all_reduce_pass")
 class FuseAllReducePass(Pass):
-    """Bucket in-place `c_allreduce_sum` ops into `c_fused_allreduce`.
+    """Bucket in-place `c_allreduce_sum` ops into `c_fused_allreduce`
+    (`c_fused_reduce_scatter` under ZeRO-2 — see ``sharding_stage``).
 
     Merge rules (each violation closes the current bucket):
     * only in-place (X == Out) sum-allreduces with static shapes and no
       `use_mean` are eligible;
-    * members share one (ring_id, dtype) — mixed-dtype buckets refuse
-      to merge;
+    * members share one (ring_id, dtype, scatter-eligibility) —
+      mixed-dtype buckets refuse to merge;
     * an intervening op that reads or writes a bucketed var closes the
       bucket first (the fused collective runs at the LAST member's
       position, so nothing may consume an unreduced value in between);
@@ -1526,10 +1527,34 @@ class FuseAllReducePass(Pass):
       full bucket carries >= max_bytes and the bucket count on an
       N-tensor program is <= ceil(total_bytes / max_bytes));
     * single-member buckets keep their original op — nothing to fuse.
+
+    ``overlap=True`` (FLAGS_dp_comm_overlap, reference:
+    multi_devices_graph_pass backward-op-aware allreduce ordering)
+    additionally schedules the comm for backward overlap: buckets form
+    in *last-gradient-ready* order instead of program-tail order, and
+    each bucket's collective (plus its private in-place prologue, e.g.
+    the 1/nranks scale) moves to just after the last op producing any
+    of its inputs — so bucket 0's collective is in flight while later
+    layers are still in backward, and on the pjit path the collective
+    ops land interleaved into the backward op list where XLA's async
+    collectives can overlap them.  Placement safety: every op touching
+    a member var before its reduce sits at or before the bucket's
+    anchor (the anchor IS the last such toucher), so no op changes the
+    value it observes.
+
+    ``sharding_stage >= 2`` with ``ndev > 1`` (ZeRO-2,
+    FLAGS_dp_sharding): buckets whose every grad feeds a shard-eligible
+    optimizer update lower to ``c_fused_reduce_scatter`` — each device
+    receives only its 1/ndev row-shard of every reduced grad, which the
+    DP runner's shard-aware update consumes directly (no full-gradient
+    materialization; wire bytes halve vs allreduce).
     """
 
     max_bytes: int = 32 << 20
     compress: str = "none"
+    overlap: bool = False
+    sharding_stage: int = 0
+    ndev: int = 1
 
     def _payload_bytes(self, block, name):
         import numpy as np
@@ -1548,13 +1573,81 @@ class FuseAllReducePass(Pass):
             return None
         return int(np.prod(shape)) * itemsize, var.dtype
 
+    # -- ZeRO-2 eligibility ------------------------------------------------
+    def _scatter_names(self, block):
+        """Grad names safe to reduce-scatter: every post-reduce consumer
+        is either the (shard-eligible) optimizer update the DP runner
+        wraps, or a no-op sync — anything else would read a 1/ndev
+        shard where it expects the full tensor."""
+        if int(self.sharding_stage) < 2 or int(self.ndev) <= 1:
+            return set()
+        from ..parallel.data_parallel import _update_shard_rows
+
+        sync_ops = {"c_sync_comm_stream", "c_sync_calc_stream",
+                    "c_wait_comm_stream", "c_wait_calc_stream", "barrier"}
+        ok = set()
+        consumers: Dict[str, List[Operator]] = {}
+        for op_ in block.ops:
+            for n in set(op_.input_arg_names):
+                consumers.setdefault(n, []).append(op_)
+        for op_ in block.ops:
+            if op_.type != "c_allreduce_sum":
+                continue
+            g = op_.inputs.get("X", [None])[0]
+            if not g:
+                continue
+            update = None
+            safe = True
+            seen_reduce = False
+            for c in consumers.get(g, []):
+                if c is op_:
+                    seen_reduce = True
+                    continue
+                if not seen_reduce:
+                    continue  # pre-reduce readers see the full local grad
+                if c.type in sync_ops:
+                    continue
+                if (update is None
+                        and _update_shard_rows(c, block, int(self.ndev))
+                        and g in c.inputs.get("Grad", [])):
+                    update = c
+                    continue
+                safe = False
+                break
+            if safe and update is not None:
+                ok.add(g)
+        return ok
+
+    def _bucket_attrs(self, block, members):
+        xs = [e["x"] for e in members]
+        # the compress attr records the format that actually ships:
+        # the lowering only compresses f32 payloads, so stamping
+        # bf16 on another dtype would mislead comm accounting
+        dtype = members[0]["dtype"]
+        compress = self.compress if dtype == VarType.FP32 else "none"
+        attrs = {"ring_id": members[0]["ring"], "compress": compress}
+        if "op_role" in members[0]["op"].attrs:
+            attrs["op_role"] = members[0]["op"].attrs["op_role"]
+        return xs, attrs
+
     def apply_impl(self, program):
         self.fused_count = 0
         if self.max_bytes <= 0:
             return program
         block = program.global_block()
-        buckets: List[List[Operator]] = []
-        cur: List[Operator] = []
+        scatter_names = self._scatter_names(block)
+        if self.overlap:
+            changed = self._apply_overlap(block, scatter_names)
+        else:
+            changed = self._apply_append(block, scatter_names)
+        if changed:
+            program._bump_version()
+        return program
+
+    # -- r7 schedule: fuse in program order, issue at last member ----------
+    def _apply_append(self, block, scatter_names):
+        buckets: List[List[dict]] = []
+        cur: List[dict] = []
         cur_bytes = 0
         cur_key = None
         touched: set = set()
@@ -1576,10 +1669,12 @@ class FuseAllReducePass(Pass):
                     close()
                     continue
                 nbytes, dtype = info
-                key = (op_.attrs.get("ring_id", 0), dtype)
+                key = (op_.attrs.get("ring_id", 0), dtype,
+                       x in scatter_names)
                 if cur and (key != cur_key or x in touched):
                     close()
-                cur.append(op_)
+                cur.append({"op": op_, "x": x, "dtype": dtype,
+                            "ring": op_.attrs.get("ring_id", 0)})
                 cur_bytes += nbytes
                 cur_key = key
                 touched.add(x)
@@ -1592,26 +1687,150 @@ class FuseAllReducePass(Pass):
         close()
 
         for b in buckets:
-            xs = [o.inputs["X"][0] for o in b]
-            # the compress attr records the format that actually ships:
-            # the lowering only compresses f32 payloads, so stamping
-            # bf16 on another dtype would mislead comm accounting
-            dtype = self._payload_bytes(block, xs[0])[1]
-            compress = self.compress if dtype == VarType.FP32 else "none"
-            attrs = {"ring_id": b[0].attrs.get("ring_id", 0),
-                     "compress": compress}
-            if "op_role" in b[0].attrs:
-                attrs["op_role"] = b[0].attrs["op_role"]
-            last = max(block.ops.index(o) for o in b)
-            last -= sum(1 for o in b if block.ops.index(o) < last)
-            remove_ops(block, b)
-            block._insert_op(last, "c_fused_allreduce",
+            xs, attrs = self._bucket_attrs(block, b)
+            fused_type = ("c_fused_reduce_scatter"
+                          if b[0]["x"] in scatter_names
+                          else "c_fused_allreduce")
+            ops_ = [e["op"] for e in b]
+            last = max(block.ops.index(o) for o in ops_)
+            last -= sum(1 for o in ops_ if block.ops.index(o) < last)
+            remove_ops(block, ops_)
+            block._insert_op(last, fused_type,
                              inputs={"X": xs}, outputs={"Out": list(xs)},
                              attrs=attrs)
         self.fused_count = len(buckets)
-        if buckets:
-            program._bump_version()
-        return program
+        return bool(buckets)
+
+    # -- overlap schedule: ready-order buckets, issue at last producer -----
+    def _collect_entries(self, block, scatter_names):
+        ops = list(block.ops)
+        seen_reduce: Dict[str, int] = {}
+        entries = []
+        for i, op_ in enumerate(ops):
+            if (op_.type != "c_allreduce_sum"
+                    or op_.attrs.get("use_mean", False)):
+                continue
+            x = op_.inputs.get("X", [None])[0]
+            o = op_.outputs.get("Out", [None])[0]
+            info = self._payload_bytes(block, x) if x else None
+            if x is None or x != o or info is None:
+                continue
+            if x in seen_reduce:
+                # two reduces of one var: scheduling either would reorder
+                # them — leave both in place
+                seen_reduce[x] = -1
+                continue
+            seen_reduce[x] = len(entries)
+            nbytes, dtype = info
+            # walk back over the private in-place prologue (the
+            # transpiler's 1/nranks scale): ops touching ONLY x move
+            # with the collective; the first other toucher is the
+            # anchor this bucket may not be issued before.
+            chain: List[int] = []
+            anchor = -1
+            j = i - 1
+            while j >= 0:
+                o2 = ops[j]
+                names = set(o2.input_arg_names) | set(o2.output_arg_names)
+                if x in names:
+                    if names <= {x} and x in o2.output_arg_names:
+                        chain.append(j)
+                        j -= 1
+                        continue
+                    anchor = j
+                    break
+                j -= 1
+            chain.reverse()
+            entries.append({"op": op_, "idx": i, "x": x, "nbytes": nbytes,
+                            "dtype": dtype,
+                            "ring": op_.attrs.get("ring_id", 0),
+                            "chain": chain, "anchor": anchor})
+        return [e for e in entries
+                if seen_reduce.get(e["x"]) != -1], ops
+
+    def _apply_overlap(self, block, scatter_names):
+        entries, ops = self._collect_entries(block, scatter_names)
+        if not entries:
+            self.fused_count = 0
+            return False
+        entries.sort(key=lambda e: (e["anchor"], e["idx"]))
+
+        touch: Dict[str, List[int]] = {}
+        for i, o in enumerate(ops):
+            for n in set(o.input_arg_names) | set(o.output_arg_names):
+                touch.setdefault(n, []).append(i)
+
+        def placeable(members, anchor):
+            """A bucket issues after `anchor` (original index).  Every
+            pre-reduce toucher of a member sits at or before its own
+            anchor <= `anchor`, so those stay correct by construction —
+            but a POST-reduce consumer of a member whose own reduce sat
+            before `anchor` (e.g. the hierarchical all-gather between
+            two shard allreduces) would now run before the moved
+            collective and read an unreduced value: refuse."""
+            for e in members:
+                own = set(e["chain"])
+                own.add(e["idx"])
+                for j in touch.get(e["x"], []):
+                    if j not in own and e["idx"] < j <= anchor:
+                        return False
+            return True
+
+        buckets: List[List[dict]] = []
+        cur: List[dict] = []
+        cur_bytes = 0
+        cur_key = None
+        for e in entries:
+            key = (e["ring"], e["dtype"], e["x"] in scatter_names)
+            if cur and (key != cur_key or not placeable(
+                    cur + [e], max(m["anchor"] for m in cur + [e]))):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(e)
+            cur_bytes += e["nbytes"]
+            cur_key = key
+            if cur_bytes >= self.max_bytes:
+                buckets.append(cur)
+                cur, cur_bytes, cur_key = [], 0, None
+        if cur:
+            buckets.append(cur)
+
+        moved: set = set()
+        schedule: Dict[int, List[List[Operator]]] = {}
+        fused = 0
+        for b in buckets:  # already in ready (issue) order
+            anchor = max(e["anchor"] for e in b)
+            emit: List[Operator] = []
+            for e in b:
+                emit.extend(ops[j] for j in e["chain"])
+                moved.update(e["chain"])
+                moved.add(e["idx"])
+            if len(b) == 1:
+                emit.append(b[0]["op"])  # nothing to fuse: op kept, moved
+            else:
+                xs, attrs = self._bucket_attrs(block, b)
+                fused_type = ("c_fused_reduce_scatter"
+                              if b[0]["x"] in scatter_names
+                              else "c_fused_allreduce")
+                emit.append(Operator(block, fused_type,
+                                     inputs={"X": xs},
+                                     outputs={"Out": list(xs)},
+                                     attrs=attrs))
+                fused += 1
+            schedule.setdefault(anchor, []).append(emit)
+
+        out: List[Operator] = []
+        for emit in schedule.get(-1, []):
+            out.extend(emit)
+        for i, op_ in enumerate(ops):
+            if i in moved:
+                continue
+            out.append(op_)
+            for emit in schedule.get(i, []):
+                out.extend(emit)
+        block.ops[:] = out
+        self.fused_count = fused
+        return True
 
 
 @register_pass("fuse_optimizer_ops_pass")

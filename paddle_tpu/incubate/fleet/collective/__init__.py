@@ -35,8 +35,18 @@ class DistributedStrategy:
         self.fuse_all_reduce_ops = True
         # ZeRO-1 optimizer-state sharding (reference: Fleet `sharding`
         # strategy) — maps onto FLAGS_dp_sharding; None keeps the
-        # process-start flag value
+        # process-start flag value.  Truthy == stage 1.
         self.sharding = None
+        # Fluid sharding_stage analog (reference: fleet sharding
+        # strategy's stage knob / DygraphShardingOptimizer stages):
+        # 1 = optimizer state, 2 = + gradients (reduce-scatter into the
+        # shard update), 3 = + parameters (just-in-time all-gather).
+        # Overrides `sharding` when set; None defers to it.
+        self.sharding_stage = None
+        # backward-overlap scheduling of fused grad buckets (reference:
+        # multi_devices_graph_pass allreduce ordering) — None keeps the
+        # FLAGS_dp_comm_overlap default
+        self.comm_overlap = None
         # bucket size for the coalesced grad collective (reference:
         # fuse_grad_size_in_MB build-strategy knob) — None keeps the
         # FLAGS_fuse_grad_size_in_MB default
@@ -282,12 +292,21 @@ class CollectiveOptimizer(DistributedOptimizer):
             fuse_mb = _flags._INITIAL["FLAGS_fuse_grad_size_in_MB"]
         compress = getattr(strategy, "grad_compress", None)
         sharding = getattr(strategy, "sharding", None)
+        stage = getattr(strategy, "sharding_stage", None)
+        if stage is not None:
+            dp_sharding = int(stage)
+        elif sharding is not None:
+            dp_sharding = int(bool(sharding))
+        else:
+            dp_sharding = _flags._INITIAL["FLAGS_dp_sharding"]
+        overlap = getattr(strategy, "comm_overlap", None)
         _flags.set_flags({
-            "dp_sharding": bool(sharding) if sharding is not None
-            else _flags._INITIAL["FLAGS_dp_sharding"],
+            "dp_sharding": dp_sharding,
             "fuse_grad_size_in_MB": fuse_mb,
             "dp_grad_compress": str(compress) if compress is not None
             else _flags._INITIAL["FLAGS_dp_grad_compress"],
+            "dp_comm_overlap": bool(overlap) if overlap is not None
+            else _flags._INITIAL["FLAGS_dp_comm_overlap"],
         })
         if getattr(strategy, "use_dgc", False):
             # reference: fleet swaps Momentum for DGCMomentum when

@@ -29,14 +29,17 @@ from .layer_helper import LayerHelper
 
 
 def _dp_shard_spec():
-    """ZeRO-1 flat-state sharding target (FLAGS_dp_sharding, the Fleet
+    """Flat-state sharding target (FLAGS_dp_sharding, the Fleet
     `sharding` strategy analog): (dp_size, NamedSharding(P('dp'))) when
-    the flag is on and a multi-device 'dp' mesh is registered, else
+    the stage is >= 1 and a multi-device 'dp' mesh is registered, else
     None.  The dygraph fused-Adam buffers (master / moments) shard over
-    the dp axis so each device holds 1/dp_size of the optimizer state."""
+    the dp axis so each device holds 1/dp_size of the optimizer state —
+    the ZeRO-1 rung of the ladder; stages 2/3 (gradient / parameter
+    sharding) apply to the graph paths in parallel/data_parallel.py and
+    framework/ir.py, not the eager fused update."""
     from .utils.flags import flag
 
-    if not flag("dp_sharding"):
+    if not int(flag("dp_sharding") or 0):
         return None
     from .parallel.mesh import current_mesh
 

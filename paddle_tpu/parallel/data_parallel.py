@@ -60,7 +60,10 @@ def _mesh_fingerprint(mesh):
 #: each device keeps 1/ndev of the moments, GSPMD reduce-scatters the
 #: grad into the shard update and all-gathers only the updated params.
 #: Beta-pow accumulators (shape [1]) stay replicated: not divisible and
-#: 8 bytes each.
+#: 8 bytes each.  The table is shared by the pjit sharding planner, the
+#: shard_map update wrapper below, and fuse_all_reduce_pass's ZeRO-2
+#: scatter eligibility — one source of truth for what counts as
+#: per-parameter optimizer state.
 _OPT_STATE_SLOTS = {
     "momentum": ("Velocity",),
     "lars_momentum": ("Velocity",),
@@ -76,11 +79,62 @@ _OPT_STATE_SLOTS = {
     "fused_adam": ("Moment1", "Moment2"),
 }
 
+#: update ops whose math is strictly per-element, so running them on a
+#: row-shard of (param, grad, state) is exact — the ops the shard_map
+#: path may slice under FLAGS_dp_sharding.  LAMB and LARS are excluded:
+#: their trust ratios are per-PARAMETER norms, which a row-shard cannot
+#: compute locally.  Fused multi-tensor ops are excluded too (the
+#: collective path keeps per-param updates so the wrapper stays simple).
+_SHARDABLE_UPDATE_OPS = frozenset({
+    "sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
+    "decayed_adagrad", "adadelta", "rmsprop",
+})
+
+
+def _update_shard_rows(op_, block, ndev):
+    """Rows-per-device for a shard-eligible update op, else None.
+    Eligible: elementwise update type, single dense param/grad, every
+    tensor (param, grad, all state slots) sharing one leading dim
+    divisible by ndev, and no tensor-parallel annotation to respect.
+    Shared with fuse_all_reduce_pass so a grad only reduce-scatters
+    when the runtime wrapper will really consume the shard."""
+    from ..framework.dtype import VarType
+
+    if ndev <= 1 or op_.type not in _SHARDABLE_UPDATE_OPS:
+        return None
+    params = op_.inputs.get("Param", [])
+    grads = op_.inputs.get("Grad", [])
+    if len(params) != 1 or len(grads) != 1:
+        return None
+    names = [params[0], grads[0]]
+    for slot in _OPT_STATE_SLOTS.get(op_.type, ()):
+        names.extend(op_.inputs.get(slot, []))
+    d0 = None
+    for n in names:
+        var = block._find_var_recursive(n)
+        if (var is None or getattr(var, "_sharding", None)
+                or getattr(var, "type", None) == VarType.SELECTED_ROWS
+                or var.shape is None or not list(var.shape)):
+            return None
+        lead = var.shape[0]
+        if not lead or lead < 0:
+            return None
+        if d0 is None:
+            d0 = int(lead)
+        elif int(lead) != d0:
+            return None
+    if d0 is None or d0 % ndev:
+        return None
+    return d0 // ndev
+
 
 def _sharded_opt_state(ops, block, ndev):
-    """Optimizer-state var names eligible for ZeRO-1 sharding: leading
-    dim divisible by the mesh (jax 0.4.x has no uneven shards) and no
-    explicit tensor-parallel annotation to respect."""
+    """Optimizer-state var names eligible for ZeRO-1 sharding on the
+    pjit path: leading dim divisible by the mesh (jax 0.4.x has no
+    uneven shards) and no explicit tensor-parallel annotation to
+    respect.  GSPMD owns the update semantics there, so any op in the
+    slot table qualifies (including LAMB and the fused multi-tensor
+    forms)."""
     names = set()
     for op_ in ops:
         slots = _OPT_STATE_SLOTS.get(op_.type)
@@ -96,6 +150,110 @@ def _sharded_opt_state(ops, block, ndev):
                 if d0 and d0 > 0 and d0 % ndev == 0:
                     names.add(n)
     return names
+
+
+def _pjit_zero23_sets(ops, block, ndev, stage):
+    """ZeRO-2/3 planning for the pjit path: (sharded_params,
+    grad_constraints).  ``sharded_params`` (stage >= 3) pin their scope
+    value and jit in/out shardings to P('dp') — each device holds
+    1/ndev of every divisible parameter and GSPMD inserts the
+    just-in-time all-gather at each forward/backward consumer (the
+    gathered copy is a temporary XLA discards after use).
+    ``grad_constraints`` (stage >= 2) maps update-op id -> grad names
+    to pin with a with_sharding_constraint at the consumption point, so
+    GSPMD lowers the batch-grad psum to a reduce-scatter feeding the
+    shard update and the full gradient never materializes."""
+    sharded_params: set = set()
+    grad_constraints: Dict[int, List[str]] = {}
+    if stage < 2 or ndev <= 1:
+        return sharded_params, grad_constraints
+
+    def divisible(name):
+        var = block._find_var_recursive(name)
+        if (var is None or getattr(var, "_sharding", None)
+                or var.shape is None or not list(var.shape)):
+            return False
+        d0 = var.shape[0]
+        return bool(d0) and d0 > 0 and d0 % ndev == 0
+
+    for op_ in ops:
+        if op_.type not in _OPT_STATE_SLOTS and \
+                op_.type not in _SHARDABLE_UPDATE_OPS:
+            continue
+        params = op_.inputs.get("Param", [])
+        grads = op_.inputs.get("Grad", [])
+        if not params or len(params) != len(grads):
+            continue
+        cons = []
+        for p, g in zip(params, grads):
+            if not divisible(p) or not divisible(g):
+                continue
+            cons.append(g)
+            if stage >= 3:
+                sharded_params.add(p)
+        if cons:
+            grad_constraints[id(op_)] = cons
+    return sharded_params, grad_constraints
+
+
+def _plan_wrapped_updates(ops, block, ndev, stage):
+    """Shard-aware update plans for the shard_map/fleet-collective path
+    (extends ZeRO-1..3 beyond pjit — ROADMAP open item).  Each plan
+    tells the interpreter to slice (param, grad) to the device's row
+    block, run the elementwise update against the locally-resident
+    optimizer-state shard, and all-gather only the updated parameter
+    (stage < 3) — the reduce-scatter -> shard-update -> all-gather
+    decomposition of fleet's sharding strategy expressed over one SPMD
+    program.  Returns (plans, sharded_state, sharded_params)."""
+    plans: Dict[int, dict] = {}
+    sharded_state: set = set()
+    sharded_params: set = set()
+    if stage < 1 or ndev <= 1:
+        return plans, sharded_state, sharded_params
+    for op_ in ops:
+        rows = _update_shard_rows(op_, block, ndev)
+        if rows is None:
+            continue
+        state_names = [n for slot in _OPT_STATE_SLOTS.get(op_.type, ())
+                       for n in op_.inputs.get(slot, [])]
+        # stage 1 shards optimizer state only: wrapping a stateless
+        # update (sgd) would pay slice+gather for no memory win
+        if not state_names and stage < 2:
+            continue
+        p = op_.inputs["Param"][0]
+        plans[id(op_)] = {"param": p, "grad": op_.inputs["Grad"][0],
+                          "rows": rows, "d0": rows * ndev}
+        sharded_state.update(state_names)
+        if stage >= 3:
+            sharded_params.add(p)
+    return plans, sharded_state, sharded_params
+
+
+def _run_sharded_update(op_, env, block, plan, axis, sharded_params):
+    """Execute one update op on this device's row-shard.  The grad may
+    arrive full-width (allreduced) or already scattered to the local
+    rows by c_fused_reduce_scatter — distinguished by its leading dim.
+    ParamOut all-gathers back to full width unless the parameter itself
+    is ZeRO-3 sharded, in which case the local rows ARE the value.  A
+    full-width grad is restored after the update: later consumers (a
+    grad-norm log, EMA, ...) must keep seeing the whole tensor, not
+    this device's slice."""
+    from jax import lax
+
+    rows, d0 = plan["rows"], plan["d0"]
+    p, g = plan["param"], plan["grad"]
+    idx = lax.axis_index(axis)
+    if p not in sharded_params:
+        env[p] = lax.dynamic_slice_in_dim(env[p], idx * rows, rows, axis=0)
+    gv = env.get(g)
+    sliced_grad = gv is not None and int(gv.shape[0]) == d0
+    if sliced_grad:
+        env[g] = lax.dynamic_slice_in_dim(gv, idx * rows, rows, axis=0)
+    registry.run_op(op_, env, block)
+    if sliced_grad and g not in op_.output_arg_names:
+        env[g] = gv
+    if p not in sharded_params:
+        env[p] = lax.all_gather(env[p], axis, axis=0, tiled=True)
 
 
 def _analyze(program, feed_names, scope):
@@ -128,7 +286,8 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
     key = (program._uid, program._version, feed_spec, tuple(fetch_names),
            _mesh_fingerprint(mesh), shard_sig, executor._nhwc_enabled(),
            compiled_program.__dict__.get("_ir_passes", True),
-           bool(flag("apply_ir_passes")), bool(flag("dp_sharding")),
+           bool(flag("apply_ir_passes")), int(flag("dp_sharding") or 0),
+           bool(flag("dp_comm_overlap")),
            float(flag("fuse_grad_size_in_MB") or 0),
            str(flag("dp_grad_compress", "none")))
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})
@@ -162,18 +321,35 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
     # batch shards on the 'dp' axis when present (TP meshes are e.g.
     # ('dp','mp')); otherwise the first axis
     axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+    ndev_axis = int(mesh.shape[axis])
+    stage = int(flag("dp_sharding") or 0)
 
-    # ZeRO-1: with FLAGS_dp_sharding, optimizer state on the pjit path
-    # shards over the dp axis (shard_map programs keep their explicit
-    # collectives and replicated state — the fuse pass handles them)
-    opt_sharded = (
-        _sharded_opt_state(ops, block, mesh.shape[axis])
-        if bool(flag("dp_sharding")) and not use_shard_map else set()
-    )
+    # FLAGS_dp_sharding staging (ZeRO / fleet sharding_stage):
+    # * pjit path: stage 1 shards optimizer state, stage 2 additionally
+    #   pins gradient layouts (GSPMD reduce-scatters into the shard
+    #   update), stage 3 shards the parameters themselves with GSPMD's
+    #   just-in-time gather at each consumer;
+    # * shard_map path: the same ladder via explicit slice/update/gather
+    #   plans on the update ops (and c_fused_reduce_scatter buckets the
+    #   fuse pass emits at stage >= 2).
+    opt_sharded: set = set()
+    sharded_params: set = set()
+    grad_constraints: Dict[int, List[str]] = {}
+    wrapped_updates: Dict[int, dict] = {}
+    if stage >= 1 and ndev_axis > 1:
+        if use_shard_map:
+            wrapped_updates, opt_sharded, sharded_params = \
+                _plan_wrapped_updates(ops, block, ndev_axis, stage)
+        else:
+            opt_sharded = _sharded_opt_state(ops, block, ndev_axis)
+            sharded_params, grad_constraints = _pjit_zero23_sets(
+                ops, block, ndev_axis, stage)
 
     def param_sharding(name):
-        """Tensor-parallel annotation (parallel.tensor_parallel
-        .shard_parameter) or replicated."""
+        """ZeRO-3 dp shard, tensor-parallel annotation
+        (parallel.tensor_parallel.shard_parameter), or replicated."""
+        if name in sharded_params:
+            return NamedSharding(mesh, P(axis))
         var = block._find_var_recursive(name)
         spec = getattr(var, "_sharding", None) if var is not None else None
         return NamedSharding(mesh, P(*spec)) if spec else NamedSharding(mesh, P())
@@ -192,6 +368,35 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
                 env[RNG_VAR], jax.lax.axis_index(axis)
             )
         for op_ in ops:
+            plan = wrapped_updates.get(id(op_))
+            if plan is not None:
+                _run_sharded_update(op_, env, block, plan, axis,
+                                    sharded_params)
+                continue
+            if not per_shard and grad_constraints and stage >= 2:
+                # ZeRO-2 (pjit): pin each eligible grad to the dp shard
+                # at its consumption point — GSPMD then produces it via
+                # reduce-scatter and the full gradient never exists
+                for gname in grad_constraints.get(id(op_), ()):
+                    gval = env.get(gname)
+                    if gval is not None:
+                        env[gname] = jax.lax.with_sharding_constraint(
+                            gval, NamedSharding(mesh, P(axis)))
+            if per_shard and sharded_params:
+                # ZeRO-3 (shard_map): gather a sharded param just in
+                # time for this consumer, restore the shard right after
+                # — the gathered copy is dead the moment the op ran
+                gathered = {}
+                for n in set(op_.input_arg_names):
+                    if n in sharded_params and n in env:
+                        gathered[n] = env[n]
+                        env[n] = jax.lax.all_gather(env[n], axis, axis=0,
+                                                    tiled=True)
+                registry.run_op(op_, env, block)
+                for n, local in gathered.items():
+                    if n not in op_.output_arg_names:
+                        env[n] = local
+                continue
             registry.run_op(op_, env, block)
         fetched = tuple(env[n] for n in fetch_names)
         new_state = {n: env[n] for n in state_out if n in env}
@@ -204,7 +409,9 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
             fetched = tuple(f[None] for f in fetched)
             return fetched, new_state
 
-        state_specs = {n: P() for n in state_in}
+        sm_sharded = opt_sharded | sharded_params
+        state_specs = {n: (P(axis) if n in sm_sharded else P())
+                       for n in state_in}
         feed_specs = {k: P(axis) for k in feed}
         from .mesh import shard_map_compat
 
@@ -213,16 +420,24 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
             mesh=mesh,
             in_specs=(state_specs, feed_specs),
             out_specs=(tuple(P(axis) for _ in fetch_names),
-                       {n: P() for n in state_out}),
+                       {n: (P(axis) if n in sm_sharded else P())
+                        for n in state_out}),
         )
         jitted = jax.jit(fn)
+
+        def state_sharding(name):  # noqa: F811 — shard_map placement
+            """Scope values enter pre-placed to match the in_specs: the
+            ZeRO-sharded names arrive split over dp (1/ndev resident
+            bytes per device), everything else replicated."""
+            return NamedSharding(mesh, P(axis) if name in sm_sharded
+                                 else P())
     else:
         def global_fn(state_vals, feed_vals):
             return body(state_vals, feed_vals, per_shard=False)
 
         state_shardings = {n: state_sharding(n) for n in state_in}
         feed_shardings = {k: NamedSharding(mesh, P(axis)) for k in feed}
-        if opt_sharded:
+        if opt_sharded or sharded_params:
             # pin sharded state on the way OUT too, or jit's default
             # layout choice could all-gather the moments back after the
             # update and erase the 1/ndev memory win (fetches stay
@@ -307,8 +522,7 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
             )
         if isinstance(val, LoDTensor):
             val = val.numpy()
-        sharding = repl if use_shard_map else state_sharding(name)
-        state_vals[name] = jax.device_put(val, sharding)
+        state_vals[name] = jax.device_put(val, state_sharding(name))
 
     fetched, new_state = jitted(state_vals, feed_vals)
     for name, val in new_state.items():

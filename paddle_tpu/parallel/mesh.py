@@ -106,6 +106,19 @@ def current_mesh():
     return _registry.get()
 
 
+def ring_axis_size(ring_id: int = 0) -> int:
+    """Size of the mesh axis a collective ring maps to (1 when no mesh
+    is registered) — the `nranks` a graph pass needs to decide shard
+    eligibility at compile time."""
+    mesh = _registry.get()
+    if mesh is None:
+        return 1
+    axis = _registry.axis_for_ring(ring_id)
+    if axis is None or axis not in mesh.shape:
+        axis = mesh.axis_names[0]
+    return int(mesh.shape[axis])
+
+
 def default_dp_mesh(num_devices: Optional[int] = None):
     """Get-or-create the 1-D data-parallel mesh used by
     CompiledProgram.with_data_parallel when the user didn't configure one."""

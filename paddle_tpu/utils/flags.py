@@ -55,13 +55,20 @@ _DEFAULTS: Dict[str, Any] = {
     # Executor.run calls (zero scope reads per steady-state step).  Off
     # restores the per-step scope.get rebind path.
     "FLAGS_tpu_step_session": True,
-    # ZeRO-1 optimizer-state sharding over the 'dp' mesh axis (the Fleet
-    # `sharding` strategy analog): Adam moments / momentum velocities /
-    # the dygraph fused-Adam flat master shard 1/ndev per device, and
-    # GSPMD turns the gradient allreduce into reduce-scatter -> local
-    # shard update -> all-gather of updated params.  Off (default)
-    # replicates all optimizer state — today's behavior.
-    "FLAGS_dp_sharding": False,
+    # Sharded data parallelism over the 'dp' mesh axis (the Fleet
+    # `sharding` strategy analog), staged like fleet sharding_stage /
+    # ZeRO:
+    #   0  off (default): everything replicated — today's behavior;
+    #   1  ZeRO-1: optimizer state (Adam moments / momentum velocities /
+    #      the dygraph fused-Adam flat master) shards 1/ndev per device;
+    #   2  ZeRO-2: stage 1 + gradients shard — fused grad buckets lower
+    #      to reduce-scatter straight into the per-device shard update,
+    #      with no full-gradient materialization;
+    #   3  ZeRO-3: stage 2 + parameters shard over dp with just-in-time
+    #      all-gather at each forward/backward consumer and immediate
+    #      discard.
+    # Truthy values coerce to stage 1 (the r7 flag was a bool).
+    "FLAGS_dp_sharding": 0,
     # coalesced gradient communication (reference:
     # ir/fuse_all_reduce_op_pass.cc + coalesce_grad_tensor_pass.cc):
     # consecutive same-dtype c_allreduce_sum ops bucket up to this many
@@ -73,6 +80,13 @@ _DEFAULTS: Dict[str, Any] = {
     # payload to bf16 for transport while accumulating the reduction in
     # f32; "none" (default) keeps full-width f32 allreduce.
     "FLAGS_dp_grad_compress": "none",
+    # backward-overlap scheduling for fused gradient buckets (reference:
+    # multi_devices_graph_pass backward-op-aware allreduce ordering):
+    # order buckets by last-gradient-ready position and issue each
+    # bucket's collective right after its last input producer, so bucket
+    # 0's collective runs while later layers are still in backward.  Off
+    # restores the r7 append-at-last-member schedule.
+    "FLAGS_dp_comm_overlap": True,
 }
 
 

@@ -1,4 +1,5 @@
-"""ZeRO-1 optimizer-state sharding + coalesced gradient comms (r7).
+"""Sharded data parallelism: ZeRO-1/2/3 + coalesced, overlap-scheduled
+gradient comms (r7 + r8).
 
 Oracles:
 * fuse_all_reduce_pass bucket counts on a >=20-grad-tensor program and
@@ -7,9 +8,16 @@ Oracles:
 * bucket-boundary behavior: empty / one-tensor / mixed-dtype groups
   refuse to merge;
 * bf16 wire compression stays inside its quantization error bound;
-* FLAGS_dp_sharding shards pjit-path optimizer state 1/ndev per device
-  at loss parity with single-device execution, and the dygraph
-  fused-Adam buffers carry their values across a mid-run mode flip;
+* FLAGS_dp_sharding stages: stage 1 shards optimizer state 1/ndev per
+  device on BOTH the pjit and the shard_map/fleet-collective path,
+  stage 2 reduce-scatters fused grad buckets straight into the shard
+  update (c_fused_reduce_scatter), stage 3 shards the parameters with
+  just-in-time gather — all at loss parity with stage 0 and with
+  single-device execution, including mid-run stage flips carrying
+  state;
+* overlap scheduling: each fused bucket's collective is issued at its
+  last-gradient-ready position, before the last backward op of any
+  later bucket (FLAGS_dp_comm_overlap=0 restores the append schedule);
 * every mode rolls back to today's behavior via its flag.
 """
 import os
@@ -427,6 +435,334 @@ def test_dygraph_sharding_mesh_resize_repads():
     assert len(m1.sharding.device_set) == 8
     for p in params:
         assert np.isfinite(np.asarray(p._value)).all()
+
+
+# --------------------------------------------------------------------------
+# ZeRO-2/3 stages (r8): pjit + shard_map paths, stage flips, overlap
+# --------------------------------------------------------------------------
+def _shard_fracs(scope):
+    import jax
+
+    out = {}
+    for k, v in scope.items():
+        if isinstance(v, jax.Array) and v.ndim and v.nbytes:
+            out[k] = v.addressable_shards[0].data.nbytes / v.nbytes
+    return out
+
+
+def _run_staged(stage, init, main, startup, loss, steps=8,
+                width=16, schedule=None):
+    """Train `steps` with FLAGS_dp_sharding=stage (optionally flipping
+    per-step via `schedule`: list of stages, one per step).  Which DP
+    path runs is decided by `main` itself: transpiled programs (c_* ops)
+    take shard_map, untranspiled take pjit."""
+    mesh_mod.registry().clear()
+    mesh_mod.init_mesh()
+    _flags.set_flags({"dp_sharding": stage, "fuse_grad_size_in_MB": 32.0,
+                      "dp_grad_compress": "none", "dp_comm_overlap": 1})
+    xs, ys = _data(width)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = Scope()
+    for k, v in init.items():
+        scope.set(k, v.copy())
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    losses = []
+    for i in range(steps):
+        if schedule is not None:
+            _flags.set_flags({"dp_sharding": schedule[i]})
+        out = exe.run(compiled, feed={"x": xs, "y": ys},
+                      fetch_list=[loss], scope=scope)[0]
+        losses.append(float(np.mean(out)))
+    return losses, scope, exe
+
+
+def _staged_program(collective, optimizer="adam"):
+    from paddle_tpu.framework import unique_name
+
+    unique_name.switch()
+    main, startup, loss = build_mlp_dp_program(
+        n_layers=3, width=16, optimizer=optimizer, lr=0.01,
+        transpile=collective)
+    sa = Scope()
+    init = _init_scope(startup, sa)
+    return main, startup, loss, init
+
+
+@pytest.mark.parametrize("collective", [False, True],
+                         ids=["pjit", "shard_map"])
+def test_zero23_loss_parity_and_sharded_bytes(collective):
+    """Stages 2 and 3 match the stage-0 and stage-1 trajectories, shard
+    every divisible moment 1/8, and at stage 3 every divisible param
+    1/8 — on BOTH DP paths."""
+    main, startup, loss, init = _staged_program(collective)
+    base, scope0, _ = _run_staged(0, init, main, startup, loss)
+    ref1, _, _ = _run_staged(1, init, main, startup, loss)
+    np.testing.assert_allclose(base, ref1, rtol=1e-5, atol=1e-6)
+    for stage in (2, 3):
+        got, scope, exe = _run_staged(stage, init, main, startup, loss)
+        np.testing.assert_allclose(base, got, rtol=1e-5, atol=1e-6)
+        fr = _shard_fracs(scope)
+        moments = {k: v for k, v in fr.items() if "moment" in k}
+        assert moments
+        for k, v in moments.items():
+            want = 1 / 8 if int(scope.get(k).shape[0]) % 8 == 0 else 1.0
+            assert v == pytest.approx(want), (k, v)
+        params = {k: v for k, v in fr.items()
+                  if k.endswith(".w_0") or k.endswith(".b_0")}
+        assert params
+        for k, v in params.items():
+            want = (1 / 8 if stage >= 3
+                    and int(scope.get(k).shape[0]) % 8 == 0 else 1.0)
+            assert v == pytest.approx(want), (stage, k, v)
+        if collective and stage >= 2:
+            # the fused buckets really lowered to reduce-scatter
+            rewritten = exe._apply_ir_passes(main, [loss.name])
+            stats = collect_comm_stats(rewritten, 8)
+            assert stats["ops_by_type"].get("c_fused_reduce_scatter"), stats
+            from dp_comm_stats import grad_buffer_bytes
+
+            total, per_dev = grad_buffer_bytes(rewritten, 8, stage)
+            # every divisible grad holds 1/8; only the [1]-bias stays full
+            assert per_dev < total / 8 + 16, (total, per_dev)
+
+
+@pytest.mark.parametrize("collective", [False, True],
+                         ids=["pjit", "shard_map"])
+def test_stage_flip_mid_run_carries_state(collective):
+    """Walking the whole ladder mid-run (0 -> 1 -> 2 -> 3 -> 0) carries
+    optimizer state through every re-layout: the trajectory equals a
+    constant stage-0 run."""
+    main, startup, loss, init = _staged_program(collective)
+    base, _, _ = _run_staged(0, init, main, startup, loss, steps=10)
+    schedule = [0, 0, 1, 1, 2, 2, 3, 3, 0, 0]
+    flip, scope, _ = _run_staged(0, init, main, startup, loss,
+                                 steps=10, schedule=schedule)
+    np.testing.assert_allclose(base, flip, rtol=1e-5, atol=1e-6)
+    # back at stage 0: everything replicated again
+    for k, v in _shard_fracs(scope).items():
+        assert v == 1.0, (k, v)
+
+
+def test_shard_map_zero1_shares_slot_table():
+    """Satellite: ZeRO-1 on the fleet-collective path — SGD has no
+    state to shard (stays unwrapped at stage 1), momentum's Velocity
+    (from the shared _OPT_STATE_SLOTS table) shards 1/8 at unchanged
+    trajectory."""
+    from paddle_tpu.parallel.data_parallel import (
+        _OPT_STATE_SLOTS, _update_shard_rows)
+
+    assert _OPT_STATE_SLOTS["momentum"] == ("Velocity",)
+    from paddle_tpu.framework import unique_name
+
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1])
+        h = fluid.layers.fc(x, 32, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(loss)
+    from paddle_tpu.transpiler import GradAllReduce
+
+    GradAllReduce().transpile(startup_program=startup, main_program=main,
+                              rank=0, endpoints=["127.0.0.1:6170"], nranks=8)
+    # the shared eligibility helper sees the momentum ops
+    blk = main.global_block()
+    rows = [_update_shard_rows(o, blk, 8) for o in blk.ops
+            if o.type == "momentum"]
+    assert rows and any(r for r in rows)
+
+    sa = Scope()
+    init = _init_scope(startup, sa)
+    base, _, _ = _run_staged(0, init, main, startup, loss)
+    got, scope, _ = _run_staged(1, init, main, startup, loss)
+    np.testing.assert_allclose(base, got, rtol=1e-5, atol=1e-6)
+    vel = {k: v for k, v in _shard_fracs(scope).items() if "velocity" in k}
+    assert vel
+    assert any(v == pytest.approx(1 / 8) for v in vel.values()), vel
+
+
+# --------------------------------------------------------------------------
+# backward-overlap collective scheduling
+# --------------------------------------------------------------------------
+def _bucket_schedule(mb=0.05, overlap=True, stage=0):
+    mesh_mod.registry().clear()
+    mesh_mod.init_mesh()
+    _flags.set_flags({"fuse_grad_size_in_MB": mb, "dp_comm_overlap":
+                      int(overlap), "dp_sharding": stage,
+                      "dp_grad_compress": "none"})
+    from paddle_tpu.framework import unique_name
+
+    unique_name.switch()
+    main, startup, loss = build_mlp_dp_program(n_layers=10, width=64)
+    exe = pt.Executor(pt.CPUPlace())
+    rewritten = exe._apply_ir_passes(main, [loss.name])
+    return collect_comm_stats(rewritten, 8), main, loss, exe
+
+
+def test_overlap_schedule_orders_buckets_by_readiness():
+    """Each bucket's collective is issued at last-gradient-ready + its
+    prologue, precedes the last backward op of any LATER bucket (it is
+    in flight while their grads are still being produced), and >= half
+    the buckets land before the final backward op."""
+    stats, _, _, _ = _bucket_schedule(overlap=True)
+    buckets = stats["buckets"]
+    assert len(buckets) >= 3
+    for b in buckets:
+        assert b["ready_at_op"] < b["issued_at_op"], b
+    issued = [b["issued_at_op"] for b in buckets]
+    assert issued == sorted(issued)
+    for i, b in enumerate(buckets[:-1]):
+        for later in buckets[i + 1:]:
+            assert b["issued_at_op"] < later["ready_at_op"], (b, later)
+    ov = stats["overlap"]
+    assert ov["n_buckets_overlapped"] * 2 >= ov["n_buckets"], ov
+    assert ov["est_exposed_comm_bytes"] < sum(b["wire_bytes"]
+                                              for b in buckets), ov
+
+
+def test_overlap_rollback_restores_append_schedule():
+    """FLAGS_dp_comm_overlap=0 restores the r7 schedule: every fused
+    collective sits in the program tail, after the last backward
+    compute op — and the collective count is unchanged vs overlap=1 at
+    the default bucket size (the overlap pass reorders, never splits)."""
+    on, _, _, _ = _bucket_schedule(mb=32.0, overlap=True)
+    off, _, _, _ = _bucket_schedule(mb=32.0, overlap=False)
+    assert on["collective_ops"] == off["collective_ops"]
+    assert sum(b["payload_bytes"] for b in on["buckets"]) == \
+        sum(b["payload_bytes"] for b in off["buckets"])
+    assert all(not b["overlapped"] for b in off["buckets"]), off["buckets"]
+    assert all(b["overlapped"] for b in on["buckets"][:-1])
+
+
+def test_overlap_bit_identical_to_append():
+    """Reordering the collectives changes no value: overlap on/off
+    trains bit-identically (the same reductions run, just earlier)."""
+    mesh_mod.init_mesh()
+    width = 16
+    from paddle_tpu.framework import unique_name
+
+    unique_name.switch()
+    main, startup, loss = build_mlp_dp_program(n_layers=3, width=width,
+                                               seed=3)
+    xs, ys = _data(width)
+    exe = pt.Executor(pt.CPUPlace())
+    sa = Scope()
+    init = _init_scope(startup, sa)
+
+    def run(overlap):
+        _flags.set_flags({"fuse_grad_size_in_MB": 0.01,
+                          "dp_comm_overlap": overlap,
+                          "dp_grad_compress": "none", "dp_sharding": 0})
+        scope = Scope()
+        for k, v in init.items():
+            scope.set(k, v.copy())
+        compiled = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        losses = [np.asarray(exe.run(compiled, feed={"x": xs, "y": ys},
+                                     fetch_list=[loss], scope=scope)[0])
+                  for _ in range(5)]
+        return losses, {k: np.asarray(scope.get(k)) for k in init}
+
+    on_l, on_p = run(1)
+    off_l, off_p = run(0)
+    for a, b in zip(on_l, off_l):
+        np.testing.assert_array_equal(a, b)
+    for k in on_p:
+        np.testing.assert_array_equal(on_p[k], off_p[k])
+
+
+def test_sharded_update_restores_full_grad_for_later_consumers():
+    """A post-update consumer of a gradient (grad-norm log / EMA
+    pattern) must see the full tensor on the wrapped shard_map path,
+    not the device's slice the update consumed."""
+    from paddle_tpu.framework import unique_name
+
+    mesh_mod.init_mesh()
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [16])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(loss)
+    from paddle_tpu.transpiler import GradAllReduce
+
+    GradAllReduce().transpile(startup_program=startup, main_program=main,
+                              rank=0, endpoints=["127.0.0.1:6170"], nranks=8)
+    block = main.global_block()
+    gname = "fc_0.w_0@GRAD"
+    block.create_var(name="g_snapshot", shape=[16, 1], dtype="float32")
+    block.append_op("scale", inputs={"X": [gname]},
+                    outputs={"Out": ["g_snapshot"]}, attrs={"scale": 1.0})
+    xs, ys = _data(16)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = Scope()
+    init = _init_scope(startup, scope)
+
+    def run(stage):
+        _flags.set_flags({"dp_sharding": stage})
+        sc = Scope()
+        for k, v in init.items():
+            sc.set(k, v.copy())
+        compiled = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        snap = exe.run(compiled, feed={"x": xs, "y": ys},
+                       fetch_list=["g_snapshot"], scope=sc)[0]
+        return np.asarray(snap)
+
+    full = run(0)
+    sharded = run(1)
+    assert sharded.shape == full.shape, (sharded.shape, full.shape)
+    np.testing.assert_allclose(full, sharded, rtol=1e-6, atol=1e-7)
+
+
+def test_zero2_scatter_refuses_unsafe_consumers():
+    """A grad with a post-reduce consumer besides the shard-eligible
+    update (here: an extra elementwise read) must NOT reduce-scatter —
+    the consumer would see a 1/ndev shard."""
+    from paddle_tpu.framework.ir import get_pass
+
+    mesh_mod.registry().clear()
+    mesh_mod.init_mesh()
+    main = fluid.Program()
+    block = main.global_block()
+    for name in ("p", "g", "v", "p2", "g2", "v2"):
+        block.create_var(name=name, shape=[8, 4], dtype="float32",
+                         persistable=name in ("p", "v", "p2", "v2"))
+    block.create_var(name="lr", shape=[1], dtype="float32",
+                     persistable=True)
+    block.create_var(name="peek", shape=[8, 4], dtype="float32")
+    for g in ("g", "g2"):
+        block.append_op("c_allreduce_sum", inputs={"X": [g]},
+                        outputs={"Out": [g]},
+                        attrs={"ring_id": 0, "op_role": 1})
+    # post-reduce extra consumer of g only
+    block.append_op("scale", inputs={"X": ["g"]}, outputs={"Out": ["peek"]},
+                    attrs={"scale": 2.0})
+    for p, g, v in (("p", "g", "v"), ("p2", "g2", "v2")):
+        block.append_op("momentum",
+                        inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                                "LearningRate": ["lr"]},
+                        outputs={"ParamOut": [p], "VelocityOut": [v]},
+                        attrs={"mu": 0.9, "op_role": 2})
+    p_ = get_pass("fuse_all_reduce_pass", max_bytes=1 << 20, overlap=True,
+                  sharding_stage=2, ndev=8)
+    p_.apply(main)
+    types = [o.type for o in block.ops]
+    # g (unsafe) keeps allreduce; g2 (safe) is a 1-tensor scatter group
+    # -> no fusion but also no scatter op with g in it
+    for o in block.ops:
+        if o.type == "c_fused_reduce_scatter":
+            assert "g" not in o.inputs["X"]
+    assert "c_allreduce_sum" in types
 
 
 # --------------------------------------------------------------------------

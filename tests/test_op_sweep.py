@@ -924,6 +924,7 @@ COVERED_ELSEWHERE = {
     "c_allreduce_sum": "test_parallel", "c_broadcast": "test_parallel",
     "c_comm_init": "test_parallel", "c_comm_init_all": "test_parallel",
     "c_concat": "test_parallel", "c_fused_allreduce": "test_dp_sharding",
+    "c_fused_reduce_scatter": "test_dp_sharding",
     "c_gen_nccl_id": "test_parallel",
     "c_identity": "test_parallel", "c_reducescatter": "test_parallel",
     "c_split": "test_parallel", "c_sync_calc_stream": "test_parallel",

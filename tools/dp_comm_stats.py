@@ -1,18 +1,25 @@
 """Comm introspection for data-parallel programs: collective-op counts,
-per-bucket sizes, and estimated wire bytes — so a PR's comm regression is
-reviewable from the program graph without a chip.
+per-bucket sizes, estimated wire bytes, and the backward-overlap
+timeline — so a PR's comm OR schedule regression is reviewable from the
+program graph without a chip.
 
 ``collect_comm_stats(program, nranks)`` walks the (optionally IR-rewritten)
-program and models each collective's ring cost; the CLI builds a
-20-grad-tensor MLP, applies the GradAllReduce transpile plus the
-executor's IR pipeline under the current FLAGS (FLAGS_fuse_grad_size_in_MB,
-FLAGS_dp_grad_compress), and prints the before/after JSON:
+program and models each collective's ring cost plus, per fused bucket,
+(ready-at-op, issued-at-op, est. exposed-comm-bytes): a bucket issued
+before the final backward compute op overlaps with the remaining
+backward and exposes nothing; a bucket issued after it serializes its
+full wire cost.  The CLI builds a 20-grad-tensor MLP, applies the
+GradAllReduce transpile plus the executor's IR pipeline under the
+current FLAGS (FLAGS_fuse_grad_size_in_MB, FLAGS_dp_grad_compress,
+FLAGS_dp_comm_overlap, FLAGS_dp_sharding), and prints the before/after
+JSON:
 
     python tools/dp_comm_stats.py [--nranks 8] [--mb 32] [--compress bf16]
+                                  [--overlap 0|1] [--stage 0..3]
 
 Wire model (bidirectional ring, bytes per chip):
   allreduce        2*(n-1)/n * payload
-  reduce-scatter     (n-1)/n * payload
+  reduce-scatter     (n-1)/n * payload  (incl. ZeRO-2 fused buckets)
   all-gather         (n-1)/n * payload
   broadcast          (n-1)/n * payload
   fused bucket, compress=bf16: payload halves on the wire (f32 -> bf16
@@ -40,6 +47,7 @@ _RING_FACTOR = {
     "c_allreduce_prod": 2.0,
     "allreduce": 2.0,
     "c_fused_allreduce": 2.0,
+    "c_fused_reduce_scatter": 1.0,
     "c_reducescatter": 1.0,
     "c_allgather": 1.0,
     "c_broadcast": 1.0,
@@ -64,16 +72,60 @@ def _var_bytes(block, name):
     return int(np.prod(shape)) * itemsize if shape else itemsize
 
 
+#: fused bucket ops the overlap timeline tracks
+_BUCKET_OPS = ("c_fused_allreduce", "c_fused_reduce_scatter")
+
+
+def _overlap_timeline(blk, buckets):
+    """Annotate each fused bucket with its schedule position: ready_at_op
+    (index of the last op producing any member grad), issued_at_op (the
+    collective's index) and est_exposed_comm_bytes (the bucket's wire
+    bytes when it is issued after the final backward compute op — i.e.
+    nothing is left to hide it behind; 0 when backward still runs)."""
+    ops = list(blk.ops)
+    writers = {}
+    last_backward = -1
+    sync_ops = {"c_sync_comm_stream", "c_sync_calc_stream",
+                "c_wait_comm_stream", "c_wait_calc_stream", "barrier"}
+    for i, op_ in enumerate(ops):
+        role = op_.attrs.get("op_role", 0)
+        if (op_.type not in _RING_FACTOR and op_.type not in sync_ops
+                and int(role) & 1):
+            last_backward = i
+        if op_.type not in _BUCKET_OPS:
+            for n in op_.output_arg_names:
+                writers.setdefault(n, []).append(i)
+    for b in buckets:
+        i = b["_index"]
+        ready = max((j for n in b["tensors"]
+                     for j in writers.get(n, []) if j < i), default=-1)
+        b["ready_at_op"] = ready
+        b["issued_at_op"] = i
+        b["overlapped"] = i < last_backward
+        b["est_exposed_comm_bytes"] = (
+            0 if b["overlapped"] else int(b["wire_bytes"]))
+        del b["_index"]
+    n_over = sum(1 for b in buckets if b["overlapped"])
+    return {
+        "last_backward_op": last_backward,
+        "n_buckets": len(buckets),
+        "n_buckets_overlapped": n_over,
+        "frac_buckets_overlapped": (n_over / len(buckets)) if buckets else 0.0,
+        "est_exposed_comm_bytes": sum(b["est_exposed_comm_bytes"]
+                                      for b in buckets),
+    }
+
+
 def collect_comm_stats(program, nranks=8):
-    """Walk every block; return collective counts, payload/wire bytes and
-    the fused-bucket inventory."""
+    """Walk every block; return collective counts, payload/wire bytes,
+    the fused-bucket inventory, and the overlap timeline."""
     ops_by_type = {}
     payload_total = 0
     wire_total = 0.0
     buckets = []
     ring = (nranks - 1) / float(nranks) if nranks > 1 else 0.0
     for blk in program.blocks:
-        for op_ in blk.ops:
+        for i, op_ in enumerate(blk.ops):
             factor = _RING_FACTOR.get(op_.type)
             if factor is None:
                 continue
@@ -81,19 +133,23 @@ def collect_comm_stats(program, nranks=8):
             sizes = [_var_bytes(blk, n) for n in names]
             payload = sum(s for s in sizes if s is not None)
             wire = factor * ring * payload
-            if (op_.type == "c_fused_allreduce"
+            if (op_.type in _BUCKET_OPS
                     and op_.attrs.get("compress", "none") == "bf16"):
                 wire /= 2.0
             ops_by_type[op_.type] = ops_by_type.get(op_.type, 0) + 1
             payload_total += payload
             wire_total += wire
-            if op_.type == "c_fused_allreduce":
+            if op_.type in _BUCKET_OPS and blk.idx == 0:
                 buckets.append({
                     "n_tensors": len(names),
                     "payload_bytes": payload,
+                    "wire_bytes": int(wire),
                     "compress": op_.attrs.get("compress", "none"),
+                    "scatter": op_.type == "c_fused_reduce_scatter",
                     "tensors": list(names),
+                    "_index": i,
                 })
+    overlap = _overlap_timeline(program.global_block(), buckets)
     return {
         "nranks": nranks,
         "collective_ops": sum(ops_by_type.values()),
@@ -101,7 +157,45 @@ def collect_comm_stats(program, nranks=8):
         "payload_bytes": payload_total,
         "est_wire_bytes_per_chip": int(wire_total),
         "buckets": buckets,
+        "overlap": overlap,
     }
+
+
+def grad_buffer_bytes(program, nranks, sharding_stage=0):
+    """Steady-state gradient-buffer bytes (total, per device), modeled
+    from the program graph: a grad whose bucket reduce-scatters (ZeRO-2,
+    `c_fused_reduce_scatter`) — or, on the collective-free pjit path, an
+    eligible grad under stage >= 2's sharding constraint — holds only
+    its 1/nranks row-shard per device; everything else stays full."""
+    blk = program.global_block()
+    scattered = set()
+    has_collectives = False
+    for op_ in blk.ops:
+        if op_.type.startswith("c_") or op_.type in ("allreduce", "broadcast"):
+            has_collectives = True
+        if op_.type == "c_fused_reduce_scatter":
+            scattered.update(op_.inputs.get("X", []))
+
+    def divisible(name):
+        var = blk._find_var_recursive(name)
+        return (var is not None and var.shape and var.shape[0]
+                and var.shape[0] > 0 and var.shape[0] % nranks == 0)
+
+    grads = {}
+    for op_ in blk.ops:
+        if "Grad" in op_.inputs and "Param" in op_.inputs:
+            for g in op_.inputs.get("Grad", []):
+                b = _var_bytes(blk, g)
+                if b:
+                    grads[g] = b
+    total = sum(grads.values())
+    per_dev = 0
+    for g, b in grads.items():
+        sharded = (g in scattered
+                   or (not has_collectives and sharding_stage >= 2
+                       and divisible(g)))
+        per_dev += b // nranks if sharded else b
+    return total, per_dev
 
 
 def build_mlp_dp_program(n_layers=10, width=64, nranks=8, optimizer="sgd",
@@ -145,10 +239,23 @@ def main(argv=None):
                     help="override FLAGS_fuse_grad_size_in_MB")
     ap.add_argument("--compress", default=None,
                     help="override FLAGS_dp_grad_compress (none|bf16)")
+    ap.add_argument("--overlap", type=int, default=None,
+                    help="override FLAGS_dp_comm_overlap (0|1)")
+    ap.add_argument("--stage", type=int, default=None,
+                    help="override FLAGS_dp_sharding (0..3, ZeRO stage)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # a virtual nranks-device mesh so the ZeRO-2 scatter rewrite
+        # (which asks the mesh for the ring size) is visible on one host
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.nranks}"
+        ).strip()
     import paddle_tpu as pt
+    from paddle_tpu.parallel import mesh as mesh_mod
     from paddle_tpu.utils import flags
 
     updates = {}
@@ -156,8 +263,18 @@ def main(argv=None):
         updates["fuse_grad_size_in_MB"] = args.mb
     if args.compress is not None:
         updates["dp_grad_compress"] = args.compress
+    if args.overlap is not None:
+        updates["dp_comm_overlap"] = args.overlap
+    if args.stage is not None:
+        updates["dp_sharding"] = args.stage
     if updates:
         flags.set_flags(updates)
+    if int(flags.flag("dp_sharding") or 0) >= 2 and \
+            mesh_mod.current_mesh() is None:
+        # the ZeRO-2 scatter rewrite needs the ring size at pass time
+        import jax
+
+        mesh_mod.init_mesh((min(args.nranks, len(jax.devices())),), ("dp",))
 
     main_p, _, loss = build_mlp_dp_program(args.layers, args.width,
                                            args.nranks)
@@ -165,9 +282,16 @@ def main(argv=None):
     exe = pt.Executor(pt.CPUPlace())
     rewritten = exe._apply_ir_passes(main_p, [loss.name])
     after = collect_comm_stats(rewritten, args.nranks)
+    stage = int(flags.flag("dp_sharding") or 0)
+    grad_total, grad_per_dev = grad_buffer_bytes(rewritten, args.nranks,
+                                                 stage)
     print(json.dumps({
         "fuse_grad_size_in_MB": flags.flag("fuse_grad_size_in_MB"),
         "dp_grad_compress": flags.flag("dp_grad_compress"),
+        "dp_comm_overlap": bool(flags.flag("dp_comm_overlap")),
+        "dp_sharding": stage,
+        "grad_buffer_bytes_total": grad_total,
+        "grad_buffer_bytes_per_dev": grad_per_dev,
         "unfused": before,
         "fused": after,
     }, indent=2))
