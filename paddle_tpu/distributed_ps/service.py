@@ -463,6 +463,8 @@ class _BinaryDataClient:
 
     def __init__(self):
         self._tls = threading.local()
+        self.n_rpc = 0  # completed round trips (RTT accounting)
+        self._n_rpc_lock = threading.Lock()
 
     def _sock(self, host, port):
         socks = getattr(self._tls, "socks", None)
@@ -503,6 +505,8 @@ class _BinaryDataClient:
         if status != 0:
             raise RuntimeError(
                 f"native PS error from {host}:{port} (op {op}, {name!r})")
+        with self._n_rpc_lock:
+            self.n_rpc += 1
         return np.frombuffer(payload, np.float32).copy()
 
 
@@ -517,6 +521,13 @@ class PSClient:
         self._lock = threading.Lock()
         self._data = _BinaryDataClient()
         self._data_ports: Dict[str, tuple] = {}
+        self.n_rpc = 0  # completed JSON-path round trips
+
+    def rpc_count(self) -> int:
+        """Total completed client round trips (JSON control path +
+        native data plane) — the RTT-per-step accounting bench.py's
+        widedeep mode reports (BASELINE metric #5)."""
+        return self.n_rpc + self._data.n_rpc
 
     def _data_ep(self, ep: str):
         """(host, port) of the native data plane, or None (fallback to
@@ -559,6 +570,8 @@ class PSClient:
             raise
         if rop == "error":
             raise RuntimeError(f"PS error from {ep}: {rmeta}")
+        with self._lock:
+            self.n_rpc += 1
         return rmeta, rarrays
 
     def _ep_for(self, name: str) -> str:

@@ -293,9 +293,10 @@ class Executor:
         )
         key = (program._uid, program._version, feed_spec, tuple(fetch_names),
                check_nan_inf, unused_check, ir_passes, donate, nhwc,
-               float(flag("fuse_grad_size_in_MB") or 0),
+               str(flag("fuse_grad_size_in_MB")),
                str(flag("dp_grad_compress", "none")),
-               int(flag("dp_sharding") or 0), bool(flag("dp_comm_overlap")))
+               int(flag("dp_sharding") or 0), bool(flag("dp_comm_overlap")),
+               bool(flag("while_static_scan")))
         hit = self._cache.get(key)
         if hit is not None:
             return hit
@@ -520,11 +521,16 @@ class Executor:
             passes.append(get_pass("layout_transform_pass",
                                    protected=protected))
         if "c_allreduce_sum" in types:
-            mb = float(flag("fuse_grad_size_in_MB") or 0)
-            if mb > 0:
+            from .utils.flags import fuse_grad_mb_auto, fuse_grad_mb_value
+
+            auto = fuse_grad_mb_auto()
+            mb = fuse_grad_mb_value()
+            if mb > 0 or auto:
                 # coalesce per-tensor grad allreduces (the shard_map DP
                 # path) into bucketed fused collectives, scheduled for
-                # backward overlap (and reduce-scattered under ZeRO-2)
+                # backward overlap (and reduce-scattered under ZeRO-2);
+                # "auto" derives variable boundaries from the modeled
+                # backward timeline instead of the fixed threshold
                 from .parallel.mesh import ring_axis_size
 
                 passes.append(get_pass(
@@ -533,7 +539,8 @@ class Executor:
                     compress=str(flag("dp_grad_compress", "none")),
                     overlap=bool(flag("dp_comm_overlap")),
                     sharding_stage=sharding_stage,
-                    ndev=ring_axis_size(0)))
+                    ndev=ring_axis_size(0),
+                    autotune=auto and bool(flag("dp_comm_overlap"))))
         if not passes:
             return program
         clone = Program.from_desc_dict(program.desc_dict())

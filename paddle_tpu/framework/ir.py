@@ -1548,6 +1548,17 @@ class FuseAllReducePass(Pass):
     receives only its 1/ndev row-shard of every reduced grad, which the
     DP runner's shard-aware update consumes directly (no full-gradient
     materialization; wire bytes halve vs allreduce).
+
+    ``autotune=True`` (FLAGS_fuse_grad_size_in_MB="auto", r9): instead
+    of the fixed byte threshold, bucket boundaries come from the
+    modeled backward timeline (utils/cost_model.py).  An O(N^2) DP over
+    the ready-ordered entries picks the contiguous partition minimizing
+    the finish time of the serialized collective stream — each bucket's
+    collective (ring alpha-beta model) should complete about when the
+    next bucket's last gradient is ready, so est. exposed comm is
+    minimized rather than bucket count.  Same-key contiguity and the
+    ``placeable`` anchor-safety rule still bound every bucket; a
+    numeric flag value restores the fixed threshold bit-for-bit.
     """
 
     max_bytes: int = 32 << 20
@@ -1555,6 +1566,8 @@ class FuseAllReducePass(Pass):
     overlap: bool = False
     sharding_stage: int = 0
     ndev: int = 1
+    autotune: bool = False
+    cost_model = None  # utils.cost_model.CostModel override (tests/CLI)
 
     def _payload_bytes(self, block, name):
         import numpy as np
@@ -1776,24 +1789,45 @@ class FuseAllReducePass(Pass):
                         return False
             return True
 
-        buckets: List[List[dict]] = []
-        cur: List[dict] = []
-        cur_bytes = 0
-        cur_key = None
-        for e in entries:
-            key = (e["ring"], e["dtype"], e["x"] in scatter_names)
-            if cur and (key != cur_key or not placeable(
-                    cur + [e], max(m["anchor"] for m in cur + [e]))):
+        def placement_horizon(e):
+            """Last original index a bucket containing `e` may anchor
+            at: one before e's first post-reduce toucher (the same rule
+            placeable scans for) — inf when no such toucher exists.
+            Precomputed once so the autotune DP checks a split in O(1)
+            (running max anchor vs running min horizon) instead of
+            rescanning every member's touch list per (i, j) pair."""
+            own = set(e["chain"])
+            own.add(e["idx"])
+            h = float("inf")
+            for j in touch.get(e["x"], []):
+                if j not in own and j > e["idx"]:
+                    h = min(h, j - 1)
+            return h
+
+        buckets: List[List[dict]] = None
+        if self.autotune:
+            buckets = self._autotune_buckets(
+                entries, ops, block,
+                [placement_horizon(e) for e in entries], scatter_names)
+        if buckets is None:
+            buckets = []
+            cur: List[dict] = []
+            cur_bytes = 0
+            cur_key = None
+            for e in entries:
+                key = (e["ring"], e["dtype"], e["x"] in scatter_names)
+                if cur and (key != cur_key or not placeable(
+                        cur + [e], max(m["anchor"] for m in cur + [e]))):
+                    buckets.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(e)
+                cur_bytes += e["nbytes"]
+                cur_key = key
+                if cur_bytes >= self.max_bytes:
+                    buckets.append(cur)
+                    cur, cur_bytes, cur_key = [], 0, None
+            if cur:
                 buckets.append(cur)
-                cur, cur_bytes = [], 0
-            cur.append(e)
-            cur_bytes += e["nbytes"]
-            cur_key = key
-            if cur_bytes >= self.max_bytes:
-                buckets.append(cur)
-                cur, cur_bytes, cur_key = [], 0, None
-        if cur:
-            buckets.append(cur)
 
         moved: set = set()
         schedule: Dict[int, List[List[Operator]]] = {}
@@ -1831,6 +1865,65 @@ class FuseAllReducePass(Pass):
         block.ops[:] = out
         self.fused_count = fused
         return True
+
+    # -- measurement-driven bucket boundaries (r9 autotune) ----------------
+    def _autotune_buckets(self, entries, ops, block, horizons,
+                          scatter_names):
+        """Partition the ready-ordered entries into variable buckets by
+        minimizing the modeled finish time of the serialized collective
+        stream (utils/cost_model.py).  finish(partition) determines the
+        exposed tail past the backward horizon, so minimizing finish
+        minimizes est. exposed comm.  DP over contiguous splits:
+        best[i] = min over j of max(best[j], ready[i-1]) + comm(j..i),
+        restricted to same-key, placement-safe buckets.  Returns None
+        (caller falls back to the fixed-threshold greedy) when no valid
+        partition exists."""
+        from ..utils.cost_model import (CostModel, backward_timeline,
+                                        collective_time_s)
+
+        if not entries:
+            return None
+        cm = self.cost_model or CostModel()
+        times, _ = backward_timeline(ops, block, cm)
+        ready = [times[e["anchor"]] if e["anchor"] >= 0 else 0.0
+                 for e in entries]
+        keys = [(e["ring"], e["dtype"], e["x"] in scatter_names)
+                for e in entries]
+        nranks = max(int(self.ndev), 1)
+        N = len(entries)
+        INF = float("inf")
+        best = [INF] * (N + 1)
+        best[0] = 0.0
+        cut = [0] * (N + 1)
+        for i in range(1, N + 1):
+            nbytes = 0
+            anc = -1
+            safe = INF
+            for j in range(i - 1, -1, -1):
+                if keys[j] != keys[i - 1]:
+                    break  # buckets are same-key contiguous runs
+                nbytes += entries[j]["nbytes"]
+                # bucket [j:i) anchors at its max member anchor; safe
+                # iff that never passes any member's placement horizon
+                anc = max(anc, entries[j]["anchor"])
+                safe = min(safe, horizons[j])
+                if best[j] == INF or anc > safe:
+                    continue
+                factor = 1.0 if keys[j][2] else 2.0
+                comm = collective_time_s(nbytes, factor, nranks, cm)
+                fin = max(best[j], ready[i - 1]) + comm
+                if fin < best[i]:
+                    best[i] = fin
+                    cut[i] = j
+        if best[N] == INF:
+            return None
+        bounds = []
+        i = N
+        while i > 0:
+            bounds.append((cut[i], i))
+            i = cut[i]
+        bounds.reverse()
+        return [entries[a:b] for a, b in bounds]
 
 
 @register_pass("fuse_optimizer_ops_pass")

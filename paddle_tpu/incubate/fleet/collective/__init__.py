@@ -49,10 +49,17 @@ class DistributedStrategy:
         self.comm_overlap = None
         # bucket size for the coalesced grad collective (reference:
         # fuse_grad_size_in_MB build-strategy knob) — None keeps the
-        # FLAGS_fuse_grad_size_in_MB default
+        # FLAGS_fuse_grad_size_in_MB default; "auto" (r9) derives
+        # variable bucket boundaries from the modeled backward timeline
+        # (utils/cost_model.py) instead of a fixed threshold
         self.fuse_grad_size_in_MB = None
         # EQuARX-style wire compression for fused buckets: "none"|"bf16"
         self.grad_compress = None
+        # ZeRO-3 parameter-prefetch window (r9): hoist each sharded
+        # param's all-gather this many ops ahead of its first consumer
+        # per direction — None keeps the FLAGS_dp_prefetch_depth
+        # default, 0 restores the just-in-time per-consumer gather
+        self.prefetch_depth = None
         self.exec_strategy = ExecutionStrategy()
         self.build_strategy = BuildStrategy()
         self.forward_recompute = False
@@ -287,7 +294,10 @@ class CollectiveOptimizer(DistributedOptimizer):
         if not getattr(strategy, "fuse_all_reduce_ops", True):
             fuse_mb = 0.0
         elif getattr(strategy, "fuse_grad_size_in_MB", None) is not None:
-            fuse_mb = float(strategy.fuse_grad_size_in_MB)
+            fuse_mb = strategy.fuse_grad_size_in_MB
+            if not (isinstance(fuse_mb, str)
+                    and fuse_mb.strip().lower() == "auto"):
+                fuse_mb = float(fuse_mb)
         else:
             fuse_mb = _flags._INITIAL["FLAGS_fuse_grad_size_in_MB"]
         compress = getattr(strategy, "grad_compress", None)
@@ -300,6 +310,7 @@ class CollectiveOptimizer(DistributedOptimizer):
         else:
             dp_sharding = _flags._INITIAL["FLAGS_dp_sharding"]
         overlap = getattr(strategy, "comm_overlap", None)
+        prefetch = getattr(strategy, "prefetch_depth", None)
         _flags.set_flags({
             "dp_sharding": dp_sharding,
             "fuse_grad_size_in_MB": fuse_mb,
@@ -307,6 +318,8 @@ class CollectiveOptimizer(DistributedOptimizer):
             else _flags._INITIAL["FLAGS_dp_grad_compress"],
             "dp_comm_overlap": bool(overlap) if overlap is not None
             else _flags._INITIAL["FLAGS_dp_comm_overlap"],
+            "dp_prefetch_depth": int(prefetch) if prefetch is not None
+            else _flags._INITIAL["FLAGS_dp_prefetch_depth"],
         })
         if getattr(strategy, "use_dgc", False):
             # reference: fleet swaps Momentum for DGCMomentum when

@@ -52,6 +52,118 @@ def _concrete_bool(v) -> bool:
     return bool(_np.asarray(v).ravel()[0])
 
 
+#: trace-time counters: how many while_loop forwards / grads lowered to
+#: the static-trip lax.scan path this process (observable by tests — a
+#: jaxpr-level check would couple tests to jax internals)
+SCAN_STATS = {"forward": 0, "grad": 0}
+
+
+def _const_from(blk, name, upto=None):
+    """Static python value of `name` when its live producer is a literal
+    fill_constant (no ValueTensor input), else None."""
+    ops_ = blk.ops if upto is None else blk.ops[:upto]
+    writers = [o for o in ops_ if name in o.output_arg_names]
+    if not writers:
+        return None
+    o = writers[-1]
+    if o.type != "fill_constant" or o.inputs.get("ValueTensor"):
+        return None
+    v = o.attrs.get("value")
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _written_nonconst(blk, name):
+    """True when any op in `blk` writes `name` other than a literal
+    fill_constant — the value is not derivable statically."""
+    return any(name in o.output_arg_names
+               and (o.type != "fill_constant"
+                    or o.inputs.get("ValueTensor"))
+               for o in blk.ops)
+
+
+def _static_trip_count(ctx, cb, bb):
+    """Trip count of a while_loop as a python int when derivable from
+    the graph (VERDICT weak #3 / ISSUE 4 satellite): cond is
+    ``less_than(counter_carry, constant)``, the body advances the
+    counter by a positive constant step (scale/bias, increment, or
+    elementwise_add of a constant), and init/limit/step are integral
+    literals (so the float counter accumulates exactly).  Returns None
+    — keep lax.while_loop + host-replay grad — for anything dynamic.
+    Gated by FLAGS_while_static_scan (0 restores the old lowering
+    everywhere)."""
+    from ..utils.flags import flag
+
+    if not flag("while_static_scan", True):
+        return None
+    carry_names = ctx.attr("carry_names", [])
+    cond_out = ctx.attr("cond_out_name")
+    body_out_names = ctx.attr("body_out_names", [])
+    if not carry_names or len(carry_names) != len(body_out_names):
+        return None
+    lt = None
+    for o in cb.ops:
+        if cond_out in o.output_arg_names:
+            lt = o
+    if lt is None or lt.type != "less_than":
+        return None
+    xn = lt.inputs.get("X", [None])[0]
+    yn = lt.inputs.get("Y", [None])[0]
+    if xn not in carry_names or not yn:
+        return None
+    # the limit must be loop-invariant: a carry (or anything the body
+    # rewrites) changes across iterations, so its initial literal is
+    # NOT the trip count — e.g. body doing n = n - 1 halves it
+    if yn in carry_names or _written_nonconst(cb, yn) \
+            or _written_nonconst(bb, yn):
+        return None
+    k = carry_names.index(xn)
+    outer = ctx.block
+    try:
+        my_idx = outer.ops.index(ctx.op)
+    except ValueError:
+        return None
+    limit = _const_from(cb, yn)
+    if limit is None:
+        limit = _const_from(outer, yn, upto=my_idx)
+    init_names = ctx.op.inputs.get("X", [])
+    if k >= len(init_names):
+        return None
+    init = _const_from(outer, init_names[k], upto=my_idx)
+    # the body's counter update: last producer of the counter's slot
+    prod = None
+    for o in bb.ops:
+        if body_out_names[k] in o.output_arg_names:
+            prod = o
+    step = None
+    if prod is None:
+        return None
+    if prod.type == "scale" and prod.inputs.get("X", [None])[0] == xn \
+            and float(prod.attrs.get("scale", 1.0)) == 1.0:
+        step = float(prod.attrs.get("bias", 0.0))
+    elif prod.type == "increment" and \
+            prod.inputs.get("X", [None])[0] == xn:
+        step = float(prod.attrs.get("step", 1.0))
+    elif prod.type == "elementwise_add":
+        a = prod.inputs.get("X", [None])[0]
+        b = prod.inputs.get("Y", [None])[0]
+        cn = b if a == xn else (a if b == xn else None)
+        if cn is not None and cn not in carry_names \
+                and not _written_nonconst(bb, cn):
+            step = _const_from(bb, cn)
+            if step is None:
+                step = _const_from(outer, cn, upto=my_idx)
+    if init is None or limit is None or step is None or step <= 0:
+        return None
+    if not (float(init).is_integer() and float(limit).is_integer()
+            and float(step).is_integer()):
+        return None  # non-integral float counters may drift vs the model
+    i0, lim, st = int(init), int(limit), int(step)
+    return max(0, -(-(lim - i0) // st))
+
+
 def _guard_body_root(outs):
     """XLA:CPU-only workaround: a while body like `i = cond(p, a, b)`
     leaves the body computation rooted at a kConditional after tuple
@@ -165,6 +277,23 @@ def _while_loop(ctx):
             list(carry_vals)))
         return
 
+    tc = _static_trip_count(ctx, cb, bb)
+    if tc is not None:
+        # statically-known trip count: lax.scan instead of
+        # lax.while_loop (reverse-differentiable by construction, no
+        # conditional-root body to guard)
+        SCAN_STATS["forward"] += 1
+
+        def scan_body(carry, _):
+            local = dict(base_env)
+            local.update(zip(carry_names, carry))
+            _run_block(bb, local)
+            return tuple(local[n] for n in body_out_names), None
+
+        outs, _ = lax.scan(scan_body, init, None, length=tc)
+        ctx.set_out("Out", list(outs))
+        return
+
     def cond_fun(carry):
         local = dict(base_env)
         local.update(zip(carry_names, carry))
@@ -179,6 +308,65 @@ def _while_loop(ctx):
 
     outs = lax.while_loop(cond_fun, body_fun, init)
     ctx.set_out("Out", list(outs))
+
+
+def _scan_grad(ctx, bb, carry_names, body_out_names, free_names, free_vals,
+               init, tc):
+    """Static-trip while_loop backward: jax.vjp over a T-step lax.scan
+    of the traced body.  Carry and free-var cotangents come from scan's
+    transpose in one computation; integer carries (the loop counter)
+    ride the scan as non-differentiable values and get zero grads."""
+
+    def _is_diff(v):
+        return hasattr(v, "dtype") and jnp.issubdtype(
+            jnp.result_type(v), jnp.inexact)
+
+    diff_c = [i for i, v in enumerate(init) if _is_diff(v)]
+    diff_f = [i for i, v in enumerate(free_vals) if _is_diff(v)]
+    gouts = ctx.ins("Out@GRAD", missing_ok=True)
+    # final carries have the init's shapes/dtypes (scan invariance), so
+    # missing cotangents zero-fill from init
+    g_final = tuple(
+        gouts[i] if (i < len(gouts) and gouts[i] is not None)
+        else jnp.zeros_like(init[i]) for i in diff_c)
+
+    def loop_fn(dc_vals, df_vals):
+        free = list(free_vals)
+        for j, i in enumerate(diff_f):
+            free[i] = df_vals[j]
+        carry0 = list(init)
+        for j, i in enumerate(diff_c):
+            carry0[i] = dc_vals[j]
+        fenv = dict(zip(free_names, free))
+
+        def sbody(carry, _):
+            local = dict(fenv)
+            local.update(zip(carry_names, carry))
+            _run_block(bb, local)
+            return tuple(local[n] for n in body_out_names), None
+
+        final, _ = lax.scan(sbody, tuple(carry0), None, length=tc)
+        return tuple(final[i] for i in diff_c)
+
+    dvals = tuple(init[i] for i in diff_c)
+    fvals = tuple(free_vals[i] for i in diff_f)
+    _, vjp_fn = jax.vjp(loop_fn, dvals, fvals)
+    d_carry, d_free = vjp_fn(g_final)
+
+    gx = [None] * len(init)
+    for j, i in enumerate(diff_c):
+        gx[i] = d_carry[j]
+    for i, v in enumerate(init):
+        if gx[i] is None:
+            gx[i] = jnp.zeros_like(v) if hasattr(v, "dtype") else None
+    gf = [None] * len(free_vals)
+    for j, i in enumerate(diff_f):
+        gf[i] = d_free[j]
+    for i, v in enumerate(free_vals):
+        if gf[i] is None:
+            gf[i] = jnp.zeros_like(v) if hasattr(v, "dtype") else None
+    ctx.set_out("X@GRAD", gx)
+    ctx.set_out("Input@GRAD", gf)
 
 
 @op("while_loop_grad", host=True)
@@ -204,6 +392,16 @@ def _while_loop_grad(ctx):
     free_names = ctx.attr("input_names", [])
     free_vals = ctx.ins("Input")
     init = list(ctx.ins("X"))
+
+    tc = _static_trip_count(ctx, cb, bb)
+    if tc is not None:
+        # static trip count: ONE scan-vjp computation — scan's native
+        # transpose holds the trajectory as residuals — instead of the
+        # per-iteration host replay + python reverse sweep
+        SCAN_STATS["grad"] += 1
+        _scan_grad(ctx, bb, carry_names, body_out_names, free_names,
+                   free_vals, init, tc)
+        return
 
     # ---- forward replay, recording the carry BEFORE each step ----------
     traj = []

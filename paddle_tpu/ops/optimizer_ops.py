@@ -21,6 +21,42 @@ def _opt(type):
     return op(type, no_grad=True)
 
 
+#: when set (a mesh axis name), whole-parameter norms in LAMB/LARS
+#: reduce across the axis: the update is running on a 1/ndev row-shard
+#: (parallel/data_parallel._run_sharded_update) and the trust ratio
+#: needs the FULL parameter/update norm — psum of the local squared
+#: sums (ROADMAP r8 seed: shard_map-path LAMB/LARS sharding)
+_CROSS_SHARD_AXIS = None
+
+
+class cross_shard_norms:
+    """Context manager: norms inside optimizer lowerings psum over
+    ``axis`` (trace-time effect — the psum lands in the traced graph)."""
+
+    def __init__(self, axis):
+        self.axis = axis
+
+    def __enter__(self):
+        global _CROSS_SHARD_AXIS
+        self._prev = _CROSS_SHARD_AXIS
+        _CROSS_SHARD_AXIS = self.axis
+        return self
+
+    def __exit__(self, *exc):
+        global _CROSS_SHARD_AXIS
+        _CROSS_SHARD_AXIS = self._prev
+        return False
+
+
+def _param_norm(x):
+    """sqrt(sum(x^2)) — across every shard's rows when a cross-shard
+    axis is active."""
+    s = jnp.sum(jnp.square(x))
+    if _CROSS_SHARD_AXIS is not None:
+        s = lax.psum(s, _CROSS_SHARD_AXIS)
+    return jnp.sqrt(s)
+
+
 @_opt("sgd")
 def _sgd(ctx):
     p, g, lr = ctx.in_("Param"), ctx.in_("Grad"), ctx.in_("LearningRate")
@@ -75,8 +111,8 @@ def _lars_momentum(ctx):
     wd = ctx.attr("lars_weight_decay", 0.0005)
     eps = ctx.attr("epsilon", 0.0)
     g = g.astype(p.dtype)
-    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
-    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    p_norm = _param_norm(p)
+    g_norm = _param_norm(g)
     local_lr = jnp.where(
         (p_norm > 0) & (g_norm > 0),
         lr * coeff * p_norm / (g_norm + wd * p_norm + eps),
@@ -273,11 +309,14 @@ def _lamb(ctx):
     wd = ctx.attr("weight_decay", 0.01)
     m1_new = b1 * m1 + (1 - b1) * g
     m2_new = b2 * m2 + (1 - b2) * jnp.square(g)
-    m1_hat = m1_new / (1 - b1p.reshape(()))
-    m2_hat = m2_new / (1 - b2p.reshape(()))
+    # Beta{1,2}Pow start at 1.0 and advance in this op (like adam
+    # above), so bias-correct with the post-update power — the
+    # pre-update value is 1.0 on step one and would divide by zero.
+    m1_hat = m1_new / (1 - b1p.reshape(()) * b1)
+    m2_hat = m2_new / (1 - b2p.reshape(()) * b2)
     r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
-    w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
-    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    w_norm = _param_norm(p)
+    r_norm = _param_norm(r)
     ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
     ctx.set_out("ParamOut", p - lr * ratio * r)
     ctx.set_out("Moment1Out", m1_new)

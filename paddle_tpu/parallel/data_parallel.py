@@ -79,16 +79,24 @@ _OPT_STATE_SLOTS = {
     "fused_adam": ("Moment1", "Moment2"),
 }
 
-#: update ops whose math is strictly per-element, so running them on a
-#: row-shard of (param, grad, state) is exact — the ops the shard_map
-#: path may slice under FLAGS_dp_sharding.  LAMB and LARS are excluded:
-#: their trust ratios are per-PARAMETER norms, which a row-shard cannot
-#: compute locally.  Fused multi-tensor ops are excluded too (the
-#: collective path keeps per-param updates so the wrapper stays simple).
+#: update ops the shard_map path may slice under FLAGS_dp_sharding.
+#: Most are strictly per-element, so running them on a row-shard of
+#: (param, grad, state) is exact.  LAMB and LARS (r9) are eligible too:
+#: their per-PARAMETER trust-ratio norms are computed cross-shard — the
+#: op lowering psums the local squared norms over the dp axis when the
+#: update runs on a row-shard (ops/optimizer_ops.py cross_shard_norms),
+#: exact up to float reassociation of the norm sum.  Fused multi-tensor
+#: ops stay excluded (the collective path keeps per-param updates so
+#: the wrapper stays simple).
 _SHARDABLE_UPDATE_OPS = frozenset({
     "sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
-    "decayed_adagrad", "adadelta", "rmsprop",
+    "decayed_adagrad", "adadelta", "rmsprop", "lamb", "lars_momentum",
 })
+
+#: ops whose lowering computes whole-parameter norms — wrapped shard
+#: updates run these under the cross_shard_norms(axis) context so the
+#: trust ratio reduces over every device's rows
+_NORM_UPDATE_OPS = frozenset({"lamb", "lars_momentum"})
 
 
 def _update_shard_rows(op_, block, ndev):
@@ -229,6 +237,68 @@ def _plan_wrapped_updates(ops, block, ndev, stage):
     return plans, sharded_state, sharded_params
 
 
+def _plan_param_prefetch(ops, block, sharded_params, skip_op_ids, depth):
+    """ZeRO-3 parameter-prefetch schedule (FLAGS_dp_prefetch_depth):
+    for each sharded parameter, its all-gather hoists ``depth`` ops
+    ahead of the first consumer in each direction (forward / backward,
+    split by op_role) and the gathered copy is discarded right after
+    the last consumer of that direction — one gather per param per
+    direction instead of the r8 per-consumer just-in-time gather.
+    Optimize/LRSched-role ops (and ``skip_op_ids`` — the wrapped shard
+    updates) consume the SHARD and are never given the gathered copy.
+    Windows never cross a write to the parameter, and overlapping
+    fwd/bwd windows merge into one gather.  Returns (records,
+    gather_before, discard_after): op index -> param names to gather
+    just before / drop just after that op."""
+    records: List[dict] = []
+    gather_before: Dict[int, List[str]] = {}
+    discard_after: Dict[int, List[str]] = {}
+    if depth <= 0 or not sharded_params:
+        return records, gather_before, discard_after
+    from ..backward import OpRole
+
+    skip_roles = int(OpRole.Optimize) | int(OpRole.LRSched)
+    for p in sorted(sharded_params):
+        consumers: Dict[str, List[int]] = {}
+        writes: List[int] = []
+        for i, op_ in enumerate(ops):
+            if p in op_.output_arg_names:
+                writes.append(i)
+            if id(op_) in skip_op_ids:
+                continue
+            role = int(op_.attrs.get("op_role", 0))
+            if role & skip_roles:
+                continue
+            if p in op_.input_arg_names:
+                d = "bwd" if role & int(OpRole.Backward) else "fwd"
+                consumers.setdefault(d, []).append(i)
+        windows = []
+        for d in ("fwd", "bwd"):
+            idxs = consumers.get(d)
+            if not idxs:
+                continue
+            first, last = min(idxs), max(idxs)
+            # the gathered copy must come from the value the consumer
+            # would have seen: never hoist past a write to p
+            lo = max((w + 1 for w in writes if w < first), default=0)
+            windows.append({"param": p, "direction": d,
+                            "gather_at": max(lo, first - depth),
+                            "first_consumer": first, "last_consumer": last})
+        merged: List[dict] = []
+        for w in sorted(windows, key=lambda w: w["gather_at"]):
+            if merged and w["gather_at"] <= merged[-1]["last_consumer"]:
+                merged[-1]["last_consumer"] = max(
+                    merged[-1]["last_consumer"], w["last_consumer"])
+                merged[-1]["direction"] += "+" + w["direction"]
+            else:
+                merged.append(w)
+        for w in merged:
+            records.append(w)
+            gather_before.setdefault(w["gather_at"], []).append(p)
+            discard_after.setdefault(w["last_consumer"], []).append(p)
+    return records, gather_before, discard_after
+
+
 def _run_sharded_update(op_, env, block, plan, axis, sharded_params):
     """Execute one update op on this device's row-shard.  The grad may
     arrive full-width (allreduced) or already scattered to the local
@@ -249,7 +319,15 @@ def _run_sharded_update(op_, env, block, plan, axis, sharded_params):
     sliced_grad = gv is not None and int(gv.shape[0]) == d0
     if sliced_grad:
         env[g] = lax.dynamic_slice_in_dim(gv, idx * rows, rows, axis=0)
-    registry.run_op(op_, env, block)
+    if op_.type in _NORM_UPDATE_OPS:
+        # LAMB/LARS trust ratio: whole-parameter norms from row-shards
+        # via psum of the local squared sums (ROADMAP r8 seed)
+        from ..ops.optimizer_ops import cross_shard_norms
+
+        with cross_shard_norms(axis):
+            registry.run_op(op_, env, block)
+    else:
+        registry.run_op(op_, env, block)
     if sliced_grad and g not in op_.output_arg_names:
         env[g] = gv
     if p not in sharded_params:
@@ -288,10 +366,16 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
            compiled_program.__dict__.get("_ir_passes", True),
            bool(flag("apply_ir_passes")), int(flag("dp_sharding") or 0),
            bool(flag("dp_comm_overlap")),
-           float(flag("fuse_grad_size_in_MB") or 0),
-           str(flag("dp_grad_compress", "none")))
+           str(flag("fuse_grad_size_in_MB")),
+           str(flag("dp_grad_compress", "none")),
+           int(flag("dp_prefetch_depth") or 0),
+           bool(flag("while_static_scan")))
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})
     if key in cache:
+        # keep the introspection plan in sync with the entry served (a
+        # hit after a flag flip must not expose another config's plan)
+        compiled_program.__dict__["_prefetch_plan"] = \
+            compiled_program.__dict__.get("_prefetch_plans", {}).get(key, [])
         return cache[key]
 
     # the DP runner goes through the same compile-time rewrite pipeline
@@ -345,6 +429,22 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
             sharded_params, grad_constraints = _pjit_zero23_sets(
                 ops, block, ndev_axis, stage)
 
+    # ZeRO-3 prefetch (FLAGS_dp_prefetch_depth): hoist + dedupe the
+    # sharded params' all-gathers on both paths — explicit op-position
+    # motion on the shard_map path, gather-hint placement (an early
+    # replicated sharding constraint the window's consumers read) on
+    # the pjit path.  Depth 0 restores the on-demand gather.
+    pf_depth = int(flag("dp_prefetch_depth") or 0)
+    pf_records: List[dict] = []
+    pf_gather: Dict[int, List[str]] = {}
+    pf_discard: Dict[int, List[str]] = {}
+    if stage >= 3 and sharded_params and pf_depth > 0:
+        pf_records, pf_gather, pf_discard = _plan_param_prefetch(
+            ops, block, sharded_params, set(wrapped_updates), pf_depth)
+    compiled_program.__dict__["_prefetch_plan"] = pf_records
+    compiled_program.__dict__.setdefault("_prefetch_plans", {})[key] = \
+        pf_records
+
     def param_sharding(name):
         """ZeRO-3 dp shard, tensor-parallel annotation
         (parallel.tensor_parallel.shard_parameter), or replicated."""
@@ -367,37 +467,68 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
             env[RNG_VAR] = jax.random.fold_in(
                 env[RNG_VAR], jax.lax.axis_index(axis)
             )
-        for op_ in ops:
+        prefetched: Dict[str, Any] = {}   # shard_map: param -> full copy
+        hint_orig: Dict[str, Any] = {}    # pjit: param -> sharded value
+        hint_val: Dict[str, Any] = {}     # pjit: param -> hinted value
+        for oi, op_ in enumerate(ops):
+            # ZeRO-3 prefetch: issue the window's all-gather (or the
+            # replicated gather hint GSPMD materializes there) ahead of
+            # the first consumer
+            for p in pf_gather.get(oi, ()):
+                if p not in env:
+                    continue
+                if per_shard:
+                    prefetched[p] = jax.lax.all_gather(env[p], axis,
+                                                       axis=0, tiled=True)
+                else:
+                    hint_orig[p] = env[p]
+                    env[p] = jax.lax.with_sharding_constraint(
+                        env[p], NamedSharding(mesh, P()))
+                    hint_val[p] = env[p]
             plan = wrapped_updates.get(id(op_))
             if plan is not None:
                 _run_sharded_update(op_, env, block, plan, axis,
                                     sharded_params)
-                continue
-            if not per_shard and grad_constraints and stage >= 2:
-                # ZeRO-2 (pjit): pin each eligible grad to the dp shard
-                # at its consumption point — GSPMD then produces it via
-                # reduce-scatter and the full gradient never exists
-                for gname in grad_constraints.get(id(op_), ()):
-                    gval = env.get(gname)
-                    if gval is not None:
-                        env[gname] = jax.lax.with_sharding_constraint(
-                            gval, NamedSharding(mesh, P(axis)))
-            if per_shard and sharded_params:
-                # ZeRO-3 (shard_map): gather a sharded param just in
-                # time for this consumer, restore the shard right after
-                # — the gathered copy is dead the moment the op ran
-                gathered = {}
-                for n in set(op_.input_arg_names):
-                    if n in sharded_params and n in env:
-                        gathered[n] = env[n]
-                        env[n] = jax.lax.all_gather(env[n], axis, axis=0,
-                                                    tiled=True)
-                registry.run_op(op_, env, block)
-                for n, local in gathered.items():
-                    if n not in op_.output_arg_names:
-                        env[n] = local
-                continue
-            registry.run_op(op_, env, block)
+            else:
+                if not per_shard and grad_constraints and stage >= 2:
+                    # ZeRO-2 (pjit): pin each eligible grad to the dp
+                    # shard at its consumption point — GSPMD then
+                    # produces it via reduce-scatter and the full
+                    # gradient never exists
+                    for gname in grad_constraints.get(id(op_), ()):
+                        gval = env.get(gname)
+                        if gval is not None:
+                            env[gname] = jax.lax.with_sharding_constraint(
+                                gval, NamedSharding(mesh, P(axis)))
+                if per_shard and sharded_params:
+                    # ZeRO-3 (shard_map): consumers inside a prefetch
+                    # window read the hoisted copy; anything the plan
+                    # missed falls back to the r8 just-in-time gather.
+                    # The shard is restored right after the op.
+                    gathered = {}
+                    for n in set(op_.input_arg_names):
+                        if n in sharded_params and n in env:
+                            gathered[n] = env[n]
+                            env[n] = prefetched[n] if n in prefetched \
+                                else jax.lax.all_gather(env[n], axis,
+                                                        axis=0, tiled=True)
+                    registry.run_op(op_, env, block)
+                    for n, local in gathered.items():
+                        if n not in op_.output_arg_names:
+                            env[n] = local
+                else:
+                    registry.run_op(op_, env, block)
+            if prefetched:
+                # a write to a cached param makes the copy stale
+                for n in op_.output_arg_names:
+                    prefetched.pop(n, None)
+            for p in pf_discard.get(oi, ()):
+                # discard after the window's last consumer: the full
+                # copy dies here, the resident value stays the shard
+                prefetched.pop(p, None)
+                if p in hint_orig and env.get(p) is hint_val.get(p):
+                    env[p] = hint_orig.pop(p)
+                    hint_val.pop(p, None)
         fetched = tuple(env[n] for n in fetch_names)
         new_state = {n: env[n] for n in state_out if n in env}
         return fetched, new_state
@@ -525,6 +656,18 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
         state_vals[name] = jax.device_put(val, state_sharding(name))
 
     fetched, new_state = jitted(state_vals, feed_vals)
+
+    # keep the call handle + ABSTRACT args (shape/dtype/sharding, not
+    # the live buffers — those would pin a stale full copy of model
+    # state on device for the program's lifetime): verify_overlap.py
+    # re-lowers this step AOT to inspect the compiled HLO
+    def _spec(a):
+        return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                    sharding=getattr(a, "sharding", None))
+
+    compiled.__dict__["_last_exec"] = (
+        jitted, jax.tree_util.tree_map(_spec, state_vals),
+        jax.tree_util.tree_map(_spec, feed_vals))
     for name, val in new_state.items():
         scope.set(name, val)
 

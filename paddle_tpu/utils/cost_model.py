@@ -1,0 +1,190 @@
+"""Analytic per-op cost model for comm-schedule decisions.
+
+The measurement-driven half of the DP comm layer (reference intent:
+PaddlePaddle's adaptive distributed training, arXiv:2112.02752 — cost
+models drive the parallelization/communication plan instead of fixed
+constants).  Two consumers:
+
+* ``framework/ir.py fuse_all_reduce_pass`` under
+  ``FLAGS_fuse_grad_size_in_MB=auto`` partitions the gradient-reduce
+  entries into *variable-size* buckets by minimizing the modeled finish
+  time of the serialized collective stream against the modeled backward
+  timeline (each bucket's collective should finish roughly as the next
+  bucket's last gradient becomes ready);
+* ``tools/dp_comm_stats.py`` prints the timeline + modeled exposed-comm
+  bytes so a schedule change is reviewable without a chip.
+
+The model is deliberately coarse — max(FLOPs/peak, bytes/HBM-bw) per
+compute op, a bidirectional-ring alpha-beta model per collective — and
+its job is *relative* ordering of schedules, not absolute times.
+``CostModel.calibrated`` rescales the compute rates so the modeled
+backward matches one profiled step, which is all the bucket decision
+needs (the comm/compute ratio).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: collectives + sync ops: excluded from the compute timeline (they ride
+#: the comm stream the schedule is being built FOR)
+COMM_OPS = frozenset({
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "allreduce", "c_fused_allreduce",
+    "c_fused_reduce_scatter", "c_reducescatter", "c_allgather",
+    "c_broadcast", "broadcast", "c_concat", "c_split", "alltoall",
+    "c_sync_comm_stream", "c_sync_calc_stream", "c_wait_comm_stream",
+    "c_wait_calc_stream", "barrier", "c_comm_init", "c_comm_init_all",
+    "c_gen_nccl_id",
+})
+
+#: op type -> which input slots form a (lhs, rhs) GEMM; flops = 2*M*K*N.
+#: Grad ops replay two GEMMs (dX and dW), covered by the multiplier.
+_MATMUL_OPS: Dict[str, Tuple[str, str, float]] = {
+    "mul": ("X", "Y", 1.0),
+    "matmul": ("X", "Y", 1.0),
+    "matmul_v2": ("X", "Y", 1.0),
+    "fc": ("Input", "W", 1.0),
+    "mul_grad": ("X", "Y", 2.0),
+    "matmul_grad": ("X", "Y", 2.0),
+    "matmul_v2_grad": ("X", "Y", 2.0),
+}
+
+
+@dataclass(frozen=True)
+class CostModel:
+    """Device constants for the analytic model.  Defaults approximate a
+    single TPU core (the target the schedule ships to — on the CPU proxy
+    only the *relative* schedule matters, which these preserve)."""
+
+    flops_per_s: float = 9.0e13       # dense matmul peak
+    hbm_bytes_per_s: float = 8.0e11   # memory-bound elementwise ops
+    ici_bytes_per_s: float = 4.5e10   # per-chip ring bandwidth
+    launch_s: float = 1.0e-6          # per-collective launch/latency
+    assumed_batch: int = 64           # stands in for dynamic (-1) dims
+
+    def calibrated(self, measured_backward_s: float,
+                   modeled_backward_s: float) -> "CostModel":
+        """Rescale compute rates so the modeled backward equals a
+        profiled one; comm constants are hardware facts and stay."""
+        if measured_backward_s <= 0 or modeled_backward_s <= 0:
+            return self
+        f = modeled_backward_s / measured_backward_s
+        return replace(self, flops_per_s=self.flops_per_s * f,
+                       hbm_bytes_per_s=self.hbm_bytes_per_s * f)
+
+
+def _dims(block, name, assumed_batch) -> Optional[List[int]]:
+    var = block._find_var_recursive(name)
+    if var is None or var.shape is None:
+        return None
+    out = []
+    for d in var.shape:
+        if d is None:
+            return None
+        d = int(d)
+        out.append(assumed_batch if d < 0 else d)
+    return out
+
+
+def _numel(dims) -> int:
+    n = 1
+    for d in dims:
+        n *= max(d, 1)
+    return n
+
+
+def op_flops_bytes(op_, block, assumed_batch=64) -> Tuple[float, float]:
+    """(flops, moved bytes) for one compute op.  GEMM-shaped ops get
+    2*M*K*N flops; conv2d gets 2*out_elems*receptive-field; everything
+    else is elementwise over its touched bytes (4 B/elem assumed — the
+    model cares about ratios, not dtypes)."""
+    touched = 0
+    for names in list(op_.inputs.values()) + list(op_.outputs.values()):
+        for n in names:
+            if n == "@EMPTY@":
+                continue
+            dims = _dims(block, n, assumed_batch)
+            if dims:
+                touched += _numel(dims) * 4
+    mm = _MATMUL_OPS.get(op_.type)
+    if mm is not None:
+        lhs_slot, rhs_slot, mult = mm
+        lhs = op_.inputs.get(lhs_slot, [None])[0]
+        rhs = op_.inputs.get(rhs_slot, [None])[0]
+        ld = _dims(block, lhs, assumed_batch) if lhs else None
+        rd = _dims(block, rhs, assumed_batch) if rhs else None
+        if ld and rd and len(rd) >= 2:
+            m = _numel(ld[:-1])
+            k = ld[-1]
+            n = rd[-1]
+            return 2.0 * m * k * n * mult, float(touched)
+    if op_.type in ("conv2d", "depthwise_conv2d", "conv2d_grad",
+                    "depthwise_conv2d_grad"):
+        out_slot = "Output" if "Output" in op_.outputs else "Out"
+        out = op_.outputs.get(out_slot, [None])[0] or \
+            op_.inputs.get(out_slot, [None])[0]
+        fil = op_.inputs.get("Filter", [None])[0]
+        od = _dims(block, out, assumed_batch) if out else None
+        fd = _dims(block, fil, assumed_batch) if fil else None
+        if od and fd and len(fd) == 4:
+            mult = 2.0 if op_.type.endswith("_grad") else 1.0
+            return (2.0 * _numel(od) * fd[1] * fd[2] * fd[3] * mult,
+                    float(touched))
+    return float(_numel([1])), float(touched)
+
+
+def op_time_s(op_, block, cm: CostModel) -> float:
+    flops, nbytes = op_flops_bytes(op_, block, cm.assumed_batch)
+    return max(flops / cm.flops_per_s, nbytes / cm.hbm_bytes_per_s)
+
+
+def backward_timeline(ops: Sequence, block, cm: CostModel
+                      ) -> Tuple[List[float], float]:
+    """Cumulative modeled completion time per op index (collectives and
+    sync ops advance nothing — they ride the comm stream), plus the
+    completion time of the LAST backward compute op (t_backward_end: the
+    horizon collectives can hide behind)."""
+    times: List[float] = []
+    t = 0.0
+    t_bwd_end = 0.0
+    for op_ in ops:
+        if op_.type not in COMM_OPS:
+            t += op_time_s(op_, block, cm)
+            if int(op_.attrs.get("op_role", 0)) & 1:
+                t_bwd_end = t
+        times.append(t)
+    return times, (t_bwd_end if t_bwd_end > 0 else t)
+
+
+def collective_time_s(payload_bytes: float, ring_factor: float, nranks: int,
+                      cm: CostModel) -> float:
+    """Bidirectional-ring alpha-beta model: launch latency + wire bytes
+    over ICI bandwidth.  ``ring_factor`` is 2.0 for allreduce, 1.0 for
+    reduce-scatter/all-gather (matches tools/dp_comm_stats._RING_FACTOR)."""
+    ring = (nranks - 1) / float(nranks) if nranks > 1 else 0.0
+    return cm.launch_s + ring_factor * ring * payload_bytes / cm.ici_bytes_per_s
+
+
+def model_comm_stream(buckets: Sequence[dict], t_backward_end: float,
+                      cm: CostModel) -> dict:
+    """Serialize bucket collectives on one comm stream: bucket k starts
+    at max(ready_k, finish_{k-1}).  Returns per-bucket (start, finish)
+    and the modeled exposed tail — comm time past the backward horizon,
+    converted to bytes at ICI rate so it compares against wire bytes.
+    Each bucket dict needs ``ready_s`` and ``comm_s`` (and may carry
+    anything else through)."""
+    t = 0.0
+    out = []
+    for b in buckets:
+        start = max(t, b["ready_s"])
+        t = start + b["comm_s"]
+        out.append({**b, "start_s": start, "finish_s": t})
+    exposed_s = max(0.0, t - t_backward_end)
+    return {
+        "buckets": out,
+        "finish_s": t,
+        "t_backward_end_s": t_backward_end,
+        "exposed_s": exposed_s,
+        "est_exposed_bytes_model": int(exposed_s * cm.ici_bytes_per_s),
+    }

@@ -74,6 +74,12 @@ _DEFAULTS: Dict[str, Any] = {
     # consecutive same-dtype c_allreduce_sum ops bucket up to this many
     # MB of payload and lower to ONE flattened collective.  0 disables
     # the rewrite (one collective per gradient tensor, today's graph).
+    # "auto" (r9) derives VARIABLE bucket boundaries from the modeled
+    # backward timeline (utils/cost_model.py): buckets are chosen so the
+    # serialized collective stream finishes as early as possible —
+    # minimizing est. exposed comm rather than bucket count.  Requires
+    # FLAGS_dp_comm_overlap (with overlap off, "auto" behaves as the
+    # 32 MB default).
     "FLAGS_fuse_grad_size_in_MB": 32.0,
     # compressed allreduce for fused gradient buckets (EQuARX-style,
     # arxiv 2506.17615): "bf16" halves wire bytes by casting the bucket
@@ -87,6 +93,19 @@ _DEFAULTS: Dict[str, Any] = {
     # 0's collective runs while later layers are still in backward.  Off
     # restores the r7 append-at-last-member schedule.
     "FLAGS_dp_comm_overlap": True,
+    # ZeRO-3 parameter-prefetch window (ops): a sharded parameter's
+    # all-gather is hoisted this many ops ahead of its first consumer in
+    # each direction (forward / backward), deduping the per-consumer
+    # gathers into one gather per param per direction with discard after
+    # the last consumer — gather layer k+1 while layer k computes.  0
+    # restores the r8 just-in-time gather at every consumer.
+    "FLAGS_dp_prefetch_depth": 1,
+    # while_loop with a statically-derivable trip count (counter-vs-
+    # constant less_than cond, constant-step counter update) lowers to
+    # lax.scan: the forward stays on-device and the backward becomes one
+    # scan-vjp computation instead of the per-iteration host replay
+    # loop.  0 restores the lax.while_loop / host-replay path.
+    "FLAGS_while_static_scan": True,
 }
 
 
@@ -113,8 +132,31 @@ def _coerce(cur, val):
     if isinstance(cur, int):
         return int(val)
     if isinstance(cur, float):
+        # sentinel string modes ride float-typed flags (e.g.
+        # FLAGS_fuse_grad_size_in_MB="auto" selects bucket autotune)
+        if isinstance(val, str) and val.strip().lower() == "auto":
+            return "auto"
         return float(val)
     return val
+
+
+def fuse_grad_mb_auto() -> bool:
+    """True when FLAGS_fuse_grad_size_in_MB selects the measurement-
+    driven variable-bucket mode."""
+    v = flag("fuse_grad_size_in_MB")
+    return isinstance(v, str) and v.strip().lower() == "auto"
+
+
+def fuse_grad_mb_value(default: float = 32.0) -> float:
+    """Numeric bucket cap: the flag's value, or `default` in auto mode
+    (auto caps nothing — the cost model picks the boundaries)."""
+    v = flag("fuse_grad_size_in_MB")
+    if isinstance(v, str):
+        try:
+            return float(v)  # numeric string set through a raw layer
+        except ValueError:
+            return default  # "auto" (or garbage): cost model decides
+    return float(v or 0)
 
 
 _flags: Dict[str, Any] = {}
@@ -133,7 +175,11 @@ def set_flags(d: Dict[str, Any]):
     for k, v in d.items():
         if not k.startswith("FLAGS_"):
             k = "FLAGS_" + k
-        cur = _flags.get(k)
+        # coerce against the flag's declared (default) type, not the
+        # current runtime value: a sentinel string riding a float flag
+        # ("auto" on FLAGS_fuse_grad_size_in_MB) must not stop a later
+        # numeric set from coercing back to float
+        cur = _DEFAULTS.get(k, _flags.get(k))
         _flags[k] = _coerce(cur, v) if cur is not None else v
 
 

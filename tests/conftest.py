@@ -16,6 +16,24 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
+#: session-wide PJRT plugin health memo shared by the device-gated
+#: tests (test_native_inference, test_train_demo): a plugin that hung
+#: past its probe bound once is a dead tunnel — later tests must not
+#: burn their own bound rediscovering it.  plugin path -> "dead".
+PJRT_PLUGIN_STATUS: dict = {}
+
+
+def pjrt_probe_timeout(default=60) -> int:
+    """Seconds to wait for a PJRT plugin to open a device before
+    calling the tunnel dead; PD_PJRT_PROBE_TIMEOUT raises it for slow
+    real-chip CI."""
+    return int(os.environ.get("PD_PJRT_PROBE_TIMEOUT", default))
+
+
+def live_plugin_candidates(cands):
+    """Filter out plugins this session already proved dead."""
+    return [c for c in cands if PJRT_PLUGIN_STATUS.get(c) != "dead"]
+
 
 @pytest.fixture(autouse=True)
 def _fresh_programs():

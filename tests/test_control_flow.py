@@ -193,6 +193,143 @@ def test_cond_grad_selects_taken_branch():
             np.testing.assert_allclose(g, [want, want], rtol=1e-6)
 
 
+# ---------------------------------------------------------------------------
+# static-trip while_loop -> lax.scan (VERDICT weak #3 / ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+def _trip_program(static=True, T=4):
+    """s_{t+1} = s_t * w + x for T steps; `static` binds the limit to a
+    literal fill_constant (scan-eligible), otherwise feeds it (dynamic
+    path must keep lax.while_loop + host-replay grad)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="st_x", shape=[2], dtype="float32")
+        x.stop_gradient = False
+        w = fluid.layers.create_parameter(
+            [2], "float32", name="st_w",
+            default_initializer=fluid.initializer.ConstantInitializer(0.5))
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        if static:
+            n = fluid.layers.fill_constant([1], "int64", T)
+        else:
+            n = fluid.data(name="st_n", shape=[1], dtype="int64")
+        s0 = x * 0.0
+
+        def cond(i, s):
+            return fluid.layers.less_than(i, n)
+
+        def body(i, s):
+            return i + 1, s * w + x
+
+        _, s = fluid.layers.while_loop(cond, body, [i, s0])
+        loss = fluid.layers.reduce_sum(s)
+        gmap = dict(fluid.backward.append_backward(loss))
+        gw = gmap[w]
+    return main, startup, loss, gw
+
+
+def _run_trip(static, T=4, flag_on=True):
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.ops import control_ops
+    from paddle_tpu.utils import flags as _flags
+
+    saved = dict(_flags._flags)
+    _flags.set_flags({"while_static_scan": int(flag_on)})
+    before = dict(control_ops.SCAN_STATS)
+    try:
+        main, startup, loss, gw = _trip_program(static, T)
+        exe = fluid.Executor(pt.CPUPlace())
+        xv = np.asarray([1.0, 2.0], np.float32)
+        feed = {"st_x": xv}
+        if not static:
+            feed["st_n"] = np.asarray([T], np.int64)
+        with scope_guard(Scope()):
+            exe.run(startup)
+            vals = [np.asarray(v) for v in exe.run(
+                main, feed=feed, fetch_list=[loss, gw, "st_x@GRAD"])]
+    finally:
+        _flags._flags.clear()
+        _flags._flags.update(saved)
+    used_scan = (control_ops.SCAN_STATS["forward"] > before["forward"],
+                 control_ops.SCAN_STATS["grad"] > before["grad"])
+    return vals, used_scan
+
+
+def test_static_trip_while_lowers_to_scan_with_identical_values():
+    """A literal-bound counter loop takes the lax.scan lowering (fwd AND
+    grad) and produces the same loss/grads as the dynamic-path and the
+    analytic values; a fed limit keeps the while/host-replay path; the
+    rollback flag restores it everywhere."""
+    static_vals, static_used = _run_trip(static=True)
+    dynamic_vals, dynamic_used = _run_trip(static=False)
+    flagged_vals, flagged_used = _run_trip(static=True, flag_on=False)
+
+    assert static_used == (True, True), static_used
+    assert dynamic_used == (False, False), dynamic_used
+    assert flagged_used == (False, False), flagged_used
+    for a, b in zip(static_vals, dynamic_vals):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    for a, b in zip(static_vals, flagged_vals):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    # analytic: s4 = x*(w^3+w^2+w+1); dloss/dx = 1.875 at w=0.5
+    np.testing.assert_allclose(static_vals[2], [1.875, 1.875], rtol=1e-5)
+
+
+def test_static_trip_zero_iterations():
+    """limit <= init: scan with length 0 — carries pass through."""
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.ops import control_ops
+
+    before = control_ops.SCAN_STATS["forward"]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "float32", 5.0)
+        n = fluid.layers.fill_constant([1], "float32", 3.0)
+        acc = fluid.layers.fill_constant([1], "float32", 7.0)
+
+        def cond(i, acc):
+            return fluid.layers.less_than(i, n)
+
+        def body(i, acc):
+            return [i + 1.0, acc + 1.0]
+
+        i_out, acc_out = fluid.layers.while_loop(cond, body, [i, acc])
+    exe = pt.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        got = exe.run(main, fetch_list=[i_out, acc_out])
+    assert control_ops.SCAN_STATS["forward"] > before
+    assert float(np.asarray(got[0])) == 5.0
+    assert float(np.asarray(got[1])) == 7.0
+
+
+def test_body_mutated_limit_stays_dynamic():
+    """A limit that is itself a loop carry (body does n = n - 1) is not
+    loop-invariant: its initial literal is NOT the trip count, so the
+    analyzer must refuse the scan lowering and keep the dynamic path.
+    i0=0, n0=4 with i+=1 / n-=1 stops after 2 iterations, not 4."""
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.ops import control_ops
+
+    before = control_ops.SCAN_STATS["forward"]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        n = fluid.layers.fill_constant([1], "float32", 4.0)
+        acc = fluid.layers.fill_constant([1], "float32", 0.0)
+
+        def cond(i, n, acc):
+            return fluid.layers.less_than(i, n)
+
+        def body(i, n, acc):
+            return [i + 1.0, n - 1.0, acc + 1.0]
+
+        _, _, acc_out = fluid.layers.while_loop(cond, body, [i, n, acc])
+    exe = pt.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        got = exe.run(main, fetch_list=[acc_out])
+    assert control_ops.SCAN_STATS["forward"] == before  # no scan
+    assert float(np.asarray(got[0])) == 2.0
+
+
 def test_old_style_while_grad_raises_loudly():
     """Backward through the old-style While op must raise with guidance
     (silent zero grads would be a wrong-result trap); forward-only

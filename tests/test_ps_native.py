@@ -105,3 +105,26 @@ def test_json_fallback_parity(server):
     np.testing.assert_allclose(via_native, via_json)
     c.close()
     cj.close()
+
+
+def test_rpc_round_trip_counter(server):
+    """rpc_count() tracks completed client round trips on BOTH wire
+    paths — the RTT-per-step accounting bench.py's widedeep mode
+    reports (BASELINE metric #5, VERDICT r5 Weak #2)."""
+    c = PSClient([server.endpoint])
+    n0 = c.rpc_count()
+    c.create_dense("w", 8, optimizer="sgd", lr=0.5)
+    c.init_dense("w", np.arange(8, dtype=np.float32))
+    after_setup = c.rpc_count()
+    assert after_setup > n0
+    c.pull_dense("w")
+    c.push_dense("w", np.ones(8, np.float32))
+    assert c.rpc_count() >= after_setup + 2  # one RTT per pull/push min
+    # the JSON fallback path counts too
+    cj = PSClient([server.endpoint])
+    cj._data_ports[server.endpoint] = None
+    m0 = cj.rpc_count()
+    cj.pull_dense("w")
+    assert cj.rpc_count() > m0
+    c.close()
+    cj.close()

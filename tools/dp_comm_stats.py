@@ -1,21 +1,38 @@
 """Comm introspection for data-parallel programs: collective-op counts,
-per-bucket sizes, estimated wire bytes, and the backward-overlap
-timeline — so a PR's comm OR schedule regression is reviewable from the
-program graph without a chip.
+per-bucket sizes, estimated wire bytes, the backward-overlap timeline,
+the modeled per-op backward cost timeline, and the ZeRO-3 prefetch plan
+— so a PR's comm OR schedule regression is reviewable from the program
+graph without a chip.
 
 ``collect_comm_stats(program, nranks)`` walks the (optionally IR-rewritten)
 program and models each collective's ring cost plus, per fused bucket,
 (ready-at-op, issued-at-op, est. exposed-comm-bytes): a bucket issued
 before the final backward compute op overlaps with the remaining
 backward and exposes nothing; a bucket issued after it serializes its
-full wire cost.  The CLI builds a 20-grad-tensor MLP, applies the
-GradAllReduce transpile plus the executor's IR pipeline under the
-current FLAGS (FLAGS_fuse_grad_size_in_MB, FLAGS_dp_grad_compress,
-FLAGS_dp_comm_overlap, FLAGS_dp_sharding), and prints the before/after
-JSON:
+full wire cost.  ``timeline_stats(program, nranks)`` adds the
+measurement-driven view (utils/cost_model.py): per-bucket modeled
+(ready_s, start_s, finish_s) on a serialized comm stream against the
+modeled backward horizon, and the exposed tail in bytes.  The CLI
+builds a 20-grad-tensor MLP, applies the GradAllReduce transpile plus
+the executor's IR pipeline under the current FLAGS
+(FLAGS_fuse_grad_size_in_MB, FLAGS_dp_grad_compress,
+FLAGS_dp_comm_overlap, FLAGS_dp_sharding, FLAGS_dp_prefetch_depth),
+and prints the before/after JSON:
 
     python tools/dp_comm_stats.py [--nranks 8] [--mb 32] [--compress bf16]
                                   [--overlap 0|1] [--stage 0..3]
+                                  [--autotune] [--prefetch-depth K]
+                                  [--calibrate-ms MS]
+
+``--autotune`` (== --mb auto, FLAGS_fuse_grad_size_in_MB="auto") turns
+on the measurement-driven variable-bucket mode and prints BOTH the
+fixed-32MB and the autotuned schedule side by side, so the exposed-
+bytes win is auditable; ``--calibrate-ms`` rescales the cost model so
+the modeled backward matches a profiled step time before the
+comparison.  ``--prefetch-depth`` (with --stage 3) prints the ZeRO-3
+parameter-prefetch plan: per param per direction, where the all-gather
+is issued vs its first consumer, and the dedup ratio (consumer sites
+vs gathers issued).
 
 Wire model (bidirectional ring, bytes per chip):
   allreduce        2*(n-1)/n * payload
@@ -221,6 +238,12 @@ def build_mlp_dp_program(n_layers=10, width=64, nranks=8, optimizer="sgd",
             fluid.layers.square_error_cost(pred, y))
         if optimizer == "adam":
             fluid.optimizer.AdamOptimizer(lr).minimize(loss)
+        elif optimizer == "lamb":
+            fluid.optimizer.LambOptimizer(lr).minimize(loss)
+        elif optimizer == "lars":
+            fluid.optimizer.LarsMomentumOptimizer(lr, 0.9).minimize(loss)
+        elif optimizer == "momentum":
+            fluid.optimizer.MomentumOptimizer(lr, 0.9).minimize(loss)
         else:
             fluid.optimizer.SGDOptimizer(lr).minimize(loss)
     if transpile:
@@ -230,19 +253,100 @@ def build_mlp_dp_program(n_layers=10, width=64, nranks=8, optimizer="sgd",
     return main, startup, loss
 
 
+def timeline_stats(program, nranks, cost_model=None):
+    """Measurement-driven schedule view: per-bucket modeled (ready_s,
+    start_s, finish_s) on ONE serialized comm stream vs the modeled
+    backward horizon (utils/cost_model.py), plus the exposed tail in
+    bytes at ICI rate.  This is the objective the
+    FLAGS_fuse_grad_size_in_MB="auto" partition minimizes."""
+    from paddle_tpu.utils.cost_model import (
+        CostModel, backward_timeline, collective_time_s, model_comm_stream)
+
+    cm = cost_model or CostModel()
+    blk = program.global_block()
+    ops = list(blk.ops)
+    times, t_bwd_end = backward_timeline(ops, blk, cm)
+    stats = collect_comm_stats(program, nranks)
+    modeled = []
+    for b in stats["buckets"]:
+        ready = times[b["ready_at_op"]] if b["ready_at_op"] >= 0 else 0.0
+        factor = 1.0 if b["scatter"] else 2.0
+        modeled.append({
+            "n_tensors": b["n_tensors"],
+            "payload_bytes": b["payload_bytes"],
+            "ready_s": ready,
+            "comm_s": collective_time_s(b["payload_bytes"], factor,
+                                        nranks, cm),
+        })
+    stream = model_comm_stream(modeled, t_bwd_end, cm)
+    return {
+        "t_backward_end_s": stream["t_backward_end_s"],
+        "comm_finish_s": stream["finish_s"],
+        "exposed_s": stream["exposed_s"],
+        "est_exposed_bytes_model": stream["est_exposed_bytes_model"],
+        "buckets": [
+            {k: (round(v, 9) if isinstance(v, float) else v)
+             for k, v in b.items()}
+            for b in stream["buckets"]
+        ],
+    }
+
+
+def prefetch_stats(program, nranks, depth):
+    """ZeRO-3 prefetch-plan summary for the shard_map path: where each
+    sharded param's all-gather is issued vs its first consumer, and the
+    dedup ratio (gathers issued vs consumer sites)."""
+    from paddle_tpu.parallel.data_parallel import (
+        _plan_param_prefetch, _plan_wrapped_updates)
+
+    blk = program.global_block()
+    ops = list(blk.ops)
+    plans, _, sharded_params = _plan_wrapped_updates(ops, blk, nranks, 3)
+    records, _, _ = _plan_param_prefetch(ops, blk, sharded_params,
+                                         set(plans), depth)
+    sites = 0
+    for p in sharded_params:
+        for op_ in ops:
+            if id(op_) in plans:
+                continue
+            if p in op_.input_arg_names:
+                sites += 1
+    hoisted = [r for r in records if r["first_consumer"] > 0]
+    return {
+        "depth": depth,
+        "n_sharded_params": len(sharded_params),
+        "n_gathers": len(records),
+        "n_consumer_sites": sites,
+        "min_hoist_ops": min((r["first_consumer"] - r["gather_at"]
+                              for r in hoisted), default=0),
+        "windows": records,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--nranks", type=int, default=8)
     ap.add_argument("--layers", type=int, default=10)
     ap.add_argument("--width", type=int, default=64)
-    ap.add_argument("--mb", type=float, default=None,
-                    help="override FLAGS_fuse_grad_size_in_MB")
+    ap.add_argument("--mb", default=None,
+                    help="override FLAGS_fuse_grad_size_in_MB "
+                         "(a number, or 'auto' for the measurement-"
+                         "driven variable-bucket mode)")
     ap.add_argument("--compress", default=None,
                     help="override FLAGS_dp_grad_compress (none|bf16)")
     ap.add_argument("--overlap", type=int, default=None,
                     help="override FLAGS_dp_comm_overlap (0|1)")
     ap.add_argument("--stage", type=int, default=None,
                     help="override FLAGS_dp_sharding (0..3, ZeRO stage)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="shorthand for --mb auto; also prints the "
+                         "fixed-32MB schedule next to the autotuned one")
+    ap.add_argument("--prefetch-depth", type=int, default=None,
+                    help="override FLAGS_dp_prefetch_depth and print "
+                         "the ZeRO-3 prefetch plan (needs --stage 3)")
+    ap.add_argument("--calibrate-ms", type=float, default=None,
+                    help="measured backward time of one step: rescales "
+                         "the cost model before the schedule decision")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -259,6 +363,8 @@ def main(argv=None):
     from paddle_tpu.utils import flags
 
     updates = {}
+    if args.autotune and args.mb is None:
+        args.mb = "auto"
     if args.mb is not None:
         updates["fuse_grad_size_in_MB"] = args.mb
     if args.compress is not None:
@@ -267,14 +373,29 @@ def main(argv=None):
         updates["dp_comm_overlap"] = args.overlap
     if args.stage is not None:
         updates["dp_sharding"] = args.stage
+    if args.prefetch_depth is not None:
+        updates["dp_prefetch_depth"] = args.prefetch_depth
     if updates:
         flags.set_flags(updates)
-    if int(flags.flag("dp_sharding") or 0) >= 2 and \
+    auto = flags.fuse_grad_mb_auto()
+    if (int(flags.flag("dp_sharding") or 0) >= 2 or auto) and \
             mesh_mod.current_mesh() is None:
-        # the ZeRO-2 scatter rewrite needs the ring size at pass time
+        # the scatter rewrite AND the autotune ring model need the ring
+        # size at pass time
         import jax
 
         mesh_mod.init_mesh((min(args.nranks, len(jax.devices())),), ("dp",))
+
+    cm = None
+    if args.calibrate_ms is not None:
+        from paddle_tpu.utils.cost_model import (CostModel,
+                                                 backward_timeline)
+
+        probe, _, _ = build_mlp_dp_program(args.layers, args.width,
+                                           args.nranks)
+        blk = probe.global_block()
+        _, modeled = backward_timeline(list(blk.ops), blk, CostModel())
+        cm = CostModel().calibrated(args.calibrate_ms / 1e3, modeled)
 
     main_p, _, loss = build_mlp_dp_program(args.layers, args.width,
                                            args.nranks)
@@ -285,16 +406,32 @@ def main(argv=None):
     stage = int(flags.flag("dp_sharding") or 0)
     grad_total, grad_per_dev = grad_buffer_bytes(rewritten, args.nranks,
                                                  stage)
-    print(json.dumps({
+    out = {
         "fuse_grad_size_in_MB": flags.flag("fuse_grad_size_in_MB"),
         "dp_grad_compress": flags.flag("dp_grad_compress"),
         "dp_comm_overlap": bool(flags.flag("dp_comm_overlap")),
         "dp_sharding": stage,
+        "dp_prefetch_depth": int(flags.flag("dp_prefetch_depth") or 0),
         "grad_buffer_bytes_total": grad_total,
         "grad_buffer_bytes_per_dev": grad_per_dev,
         "unfused": before,
         "fused": after,
-    }, indent=2))
+        "timeline": timeline_stats(rewritten, args.nranks, cm),
+    }
+    if auto:
+        # the comparison the autotune exists for: same program under
+        # the fixed default threshold
+        flags.set_flags({"fuse_grad_size_in_MB": 32.0})
+        fixed_rw = exe._apply_ir_passes(main_p, [loss.name])
+        out["fixed_32mb"] = collect_comm_stats(fixed_rw, args.nranks)
+        out["fixed_32mb_timeline"] = timeline_stats(fixed_rw, args.nranks,
+                                                    cm)
+        flags.set_flags({"fuse_grad_size_in_MB": "auto"})
+    if stage >= 3 and int(flags.flag("dp_prefetch_depth") or 0) > 0:
+        out["prefetch"] = prefetch_stats(rewritten, args.nranks,
+                                         int(flags.flag(
+                                             "dp_prefetch_depth")))
+    print(json.dumps(out, indent=2))
 
 
 if __name__ == "__main__":

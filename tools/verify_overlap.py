@@ -1,0 +1,197 @@
+"""HLO-level verification that DP collectives really overlap compute.
+
+ROADMAP r8 seed: the CPU-proxy tests only prove *schedule positions*
+(the collective op sits before the last backward op in the program
+list).  Whether the collective actually runs asynchronously under the
+backward is decided by XLA — on real chips the latency-hiding scheduler
+splits each collective into an ``<op>-start`` / ``<op>-done`` pair and
+hoists compute between them.  This checker compiles the exact jitted DP
+step the executor runs and inspects the compiled HLO module:
+
+* an async collective pair with >= 1 compute op (fusion / dot /
+  convolution / custom-call / while) between start and done is VERIFIED
+  overlap — the scheduler committed to hiding the wire time;
+* a start immediately followed by its done is a non-overlapped
+  collective (the schedule exposed it);
+* on backends that never emit async pairs (XLA:CPU — the 8-virtual-
+  device proxy this repo tests on), the checker falls back to the
+  schedule-position model (tools/dp_comm_stats overlap timeline), so
+  the same invocation regression-tests the schedule on the proxy and
+  verifies true async overlap on real chips.
+
+Usage:
+
+    python tools/verify_overlap.py [--nranks 8] [--layers 10]
+                                   [--mb 32|auto] [--stage 0..3]
+                                   [--prefetch-depth K] [--require-hlo]
+
+``check_hlo_overlap(hlo_text)`` is a pure function over the HLO text so
+pass/fail fixtures are testable without a chip.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+#: async-collective opcodes whose start/done pairs the checker tracks
+ASYNC_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "async",
+)
+
+#: opcodes that count as compute when they sit between start and done
+_COMPUTE_RE = re.compile(
+    r"=\s*(?:\([^)]*\)\s*)?[a-z0-9_\[\]{},\s]*\s*"
+    r"(fusion|dot|convolution|custom-call|while|scatter|reduce-window)\(")
+
+_START_RE = re.compile(
+    r"(%[\w.\-]+)\s*=\s*(?:\([^)]*\)\s*)?\S*\s*"
+    r"(" + "|".join(ASYNC_COLLECTIVES) + r")-start\(")
+
+
+def check_hlo_overlap(hlo_text: str) -> dict:
+    """Scan an HLO module's text for async collective start/done pairs
+    and count compute ops scheduled between each pair.  Text order
+    within a computation is schedule order for a compiled (scheduled)
+    module, which is what the executor hands us."""
+    lines = hlo_text.splitlines()
+    pairs = []
+    for i, line in enumerate(lines):
+        m = _START_RE.search(line)
+        if m is None:
+            continue
+        start_var, opcode = m.group(1), m.group(2)
+        done_token = opcode + "-done("
+        # the start var must appear as a whole operand token in the
+        # done line (%x.1 must not match %x.10)
+        var_re = re.compile(re.escape(start_var) + r"(?![\w.])")
+        compute = 0
+        done_at = None
+        for j in range(i + 1, len(lines)):
+            lj = lines[j]
+            if done_token in lj and var_re.search(lj):
+                done_at = j
+                break
+            if lj.strip().startswith("}"):  # left the computation
+                break
+            if _COMPUTE_RE.search(lj):
+                compute += 1
+        if done_at is None:
+            continue
+        pairs.append({"opcode": opcode, "start_line": i + 1,
+                      "done_line": done_at + 1,
+                      "compute_between": compute,
+                      "overlapped": compute > 0})
+    n_over = sum(1 for p in pairs if p["overlapped"])
+    return {
+        "async_pairs": len(pairs),
+        "overlapped_pairs": n_over,
+        "pairs": pairs,
+        "verified": n_over > 0,
+    }
+
+
+def verify_program(nranks=8, layers=10, width=64, mb=None, stage=None,
+                   prefetch_depth=None, require_hlo=False):
+    """Build the 10-layer MLP probe, run ONE DP step through the real
+    executor path under the current FLAGS, re-lower that exact step AOT,
+    and check the compiled HLO for async overlap; falls back to the
+    schedule-position proxy on backends without async collectives."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.utils import flags
+
+    from dp_comm_stats import build_mlp_dp_program, collect_comm_stats
+
+    updates = {}
+    if mb is not None:
+        updates["fuse_grad_size_in_MB"] = mb
+    if stage is not None:
+        updates["dp_sharding"] = stage
+    if prefetch_depth is not None:
+        updates["dp_prefetch_depth"] = prefetch_depth
+    if updates:
+        flags.set_flags(updates)
+    if mesh_mod.current_mesh() is None:
+        import jax
+
+        mesh_mod.init_mesh((min(nranks, len(jax.devices())),), ("dp",))
+
+    main, startup, loss = build_mlp_dp_program(layers, width, nranks)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(nranks * 8, width).astype(np.float32)
+    ys = (xs[:, :1] * 2 + 1).astype(np.float32)
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    exe.run(compiled, feed={"x": xs, "y": ys}, fetch_list=[loss],
+            scope=scope)
+
+    jitted, state_vals, feed_vals = compiled.__dict__["_last_exec"]
+    hlo = jitted.lower(state_vals, feed_vals).compile().as_text()
+    result = check_hlo_overlap(hlo)
+    result["hlo_bytes"] = len(hlo)
+
+    import jax
+
+    backend = jax.default_backend()
+    result["backend"] = backend
+    if result["async_pairs"] > 0 or require_hlo or backend != "cpu":
+        result["mode"] = "hlo"
+        return result
+    # XLA:CPU proxy: no async collectives exist to find — regression-
+    # test the schedule positions instead (the r8 oracle)
+    rewritten = exe._apply_ir_passes(main, [loss.name])
+    stats = collect_comm_stats(rewritten, nranks)
+    ov = stats["overlap"]
+    result["mode"] = "schedule-proxy"
+    result["schedule"] = ov
+    result["verified"] = ov["n_buckets_overlapped"] > 0
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nranks", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=10)
+    ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--mb", default=None,
+                    help="FLAGS_fuse_grad_size_in_MB (number or 'auto')")
+    ap.add_argument("--stage", type=int, default=None,
+                    help="FLAGS_dp_sharding (0..3)")
+    ap.add_argument("--prefetch-depth", type=int, default=None)
+    ap.add_argument("--require-hlo", action="store_true",
+                    help="fail (verified=false) instead of falling back "
+                         "to the schedule proxy — for real-chip CI")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.nranks}"
+        ).strip()
+    result = verify_program(args.nranks, args.layers, args.width, args.mb,
+                            args.stage, args.prefetch_depth,
+                            args.require_hlo)
+    result.pop("pairs", None)
+    print(json.dumps(result, indent=2))
+    return 0 if result["verified"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
