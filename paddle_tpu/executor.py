@@ -302,6 +302,14 @@ class Executor:
             return hit
 
         program = self._apply_ir_passes(program, fetch_names)
+        from .framework import verifier
+
+        if verifier.enabled():
+            # FLAGS_verify_passes: beyond the per-pass snapshot gate
+            # (ir.Pass.apply), lint the FINAL program once per
+            # compilation
+            verifier.lint_or_raise(program, feed, fetch_names,
+                                   "executor_compile")
         block = program.global_block()
         state_in, state_out, uses_rng, has_host_ops = analyze_state(
             block.ops, block, feed, scope

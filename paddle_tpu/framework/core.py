@@ -358,12 +358,41 @@ class Block:
         self.program._bump_version()
 
     def _rename_var(self, old: str, new: str):
+        """Rename the var AND every reference to it: this block's ops,
+        their ``op_role_var`` attr lists, and ops in DESCENDANT blocks
+        (cond/while bodies capture parent vars by name) unless a block
+        on the path declares its own ``old`` — a shadowed name refers
+        to the local var, not this one.  Renaming only the local op
+        list (the pre-verifier behavior) left orphaned references the
+        static verifier now flags as ``orphaned-read``."""
         var = self.vars.pop(old)
         var.name = new
         self.vars[new] = var
-        for op in self.ops:
-            op.rename_input(old, new)
-            op.rename_output(old, new)
+        blocks = [self]
+        for blk in self.program.blocks:
+            if blk is self:
+                continue
+            # visible from blk iff self is on blk's parent chain with no
+            # intermediate (or local) declaration of `old` shadowing it
+            cur, shadowed, on_chain = blk, old in blk.vars, False
+            while cur is not None:
+                parent = cur.parent_block
+                if parent is self:
+                    on_chain = True
+                    break
+                if parent is not None and old in parent.vars:
+                    shadowed = True
+                cur = parent
+            if on_chain and not shadowed:
+                blocks.append(blk)
+        for blk in blocks:
+            for op in blk.ops:
+                op.rename_input(old, new)
+                op.rename_output(old, new)
+                rv = op.attrs.get("op_role_var")
+                if rv and old in rv:
+                    op.attrs["op_role_var"] = [
+                        new if n == old else n for n in rv]
         self.program._bump_version()
 
     # -- op management -----------------------------------------------------

@@ -32,8 +32,22 @@ class Pass:
     name: str = ""
 
     def apply(self, program: Program) -> Program:
+        """Apply the pass; under ``FLAGS_verify_passes`` every
+        application is bracketed by the static verifier
+        (framework/verifier.py): snapshot the dataflow before, check
+        for motion hazards / broken invariants after, and raise a
+        VerifyError naming this pass, the op index and the hazard.
+        Every current and future pass inherits the gate — the
+        structural replacement for per-pass bit-identity arguments."""
+        from . import verifier
+
+        snap = verifier.snapshot(program) if verifier.enabled() else None
         out = self.apply_impl(program)
-        return out if out is not None else program
+        out = out if out is not None else program
+        if snap is not None:
+            verifier.verify_pass(snap, out,
+                                 self.name or type(self).__name__)
+        return out
 
     def apply_impl(self, program: Program) -> Optional[Program]:
         raise NotImplementedError

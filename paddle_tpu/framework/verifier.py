@@ -1,0 +1,982 @@
+"""Static program verifier: dataflow / alias hazard detection for the IR
+pass pipeline.
+
+Reference intent: the C++ stack validates its graph invariants at every
+rewrite — OpDesc::CheckAttrs + OpProto slot declarations
+(framework/op_desc.cc, op_proto_maker.cc), the pattern detector's
+IsIntermediate safety rule (ir/graph_pattern_detector.cc) and the SSA
+graph checks in ir/graph_helper.cc.  Our reproduction grew five
+op-motion-heavy passes (fusion, NHWC layout, overlap anchor placement,
+autotune bucketing, ZeRO-3 prefetch hoisting), each defending correctness
+with its own local argument plus a bit-identity test.  This module is the
+ONE analyzer that proves any transformed program hazard-free instead of N
+local proofs ("End-to-end Adaptive Distributed Training on PaddlePaddle",
+arXiv:2112.02752, leans on exactly this kind of static graph checking to
+keep pass pipelines composable).
+
+Three layers of checks:
+
+* **dataflow** — per-op read/write sets (registry OpDef metadata;
+  stateful/in-place ops write their inputs: output name == input name,
+  see ir.py DeadCodeEliminationPass).  Absolute checks: possibly-
+  uninitialized reads, orphaned (never-produced, never-declared) names,
+  dead writes, sub-block capture visibility.  Pass-relative checks
+  (``snapshot`` before / ``verify_pass`` after): RAW/WAR/WAW hazards
+  introduced by op motion, found by *observed-writer correspondence* —
+  an op carried across the pass must keep reading the value of the same
+  producer (or a producer the pass itself inserted; a pass redirecting a
+  survivor to a DIFFERENT surviving producer is exactly "moved an op past
+  its anchor").
+* **registry conformance** — unregistered op types; input/output slot
+  names the op's lowering never consumes; required input slots missing;
+  attr values whose type disagrees with the lowering's declared/default
+  attrs.  Slot/attr declarations are DERIVED from the lowering itself by
+  AST analysis (``ctx.in_/ins/has_input``, ``ctx.set_out/out_names``,
+  ``ctx.attr(name, default)``), transitively through helper calls —
+  the registry's one source of truth stays the code; ``op(...,
+  spec_hint=...)`` supplements ops with dynamic slot access.
+* **pipeline postconditions** — pluggable rules: NHWC passes leave no
+  mixed-layout consumer; collective ops appear in identical order on
+  every device's program (ring-deadlock check); ZeRO-3 prefetch gather
+  windows never cross a write to their param; sub-block ops only capture
+  vars visible in an ancestor block.
+
+``FLAGS_verify_passes`` (default: on under pytest) arms the gate inside
+``Pass.apply``: snapshot before, verify after, raise ``VerifyError``
+naming the pass, the op index and the hazard.  ``tools/progcheck.py`` is
+the standalone lint CLI over constructed/saved programs.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .core import Block, Operator, Program
+from .dtype import VarType
+
+EMPTY = "@EMPTY@"
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+#: attrs the framework stamps on every op (roles, callstacks, device
+#: annotations, grad-replay bookkeeping) — never op-declared
+FRAMEWORK_ATTRS = frozenset({
+    "op_role", "op_role_var", "op_namescope", "op_callstack", "op_device",
+    "is_test", "use_mkldnn", "use_cudnn", "use_quantizer",
+    "mkldnn_data_type", "with_quant_attr", "trainable_statistics",
+    "sub_block", "block", "blocks", "skip_update",
+})
+
+
+class Diagnostic:
+    """One finding.  ``key()`` is the structural identity used to tell a
+    pass-INTRODUCED problem from a pre-existing one (op indices shift
+    across a rewrite, so the key is positional only as a last resort)."""
+
+    __slots__ = ("severity", "code", "message", "block_idx", "op_index",
+                 "op_type", "var", "pass_name")
+
+    def __init__(self, severity, code, message, block_idx=0, op_index=None,
+                 op_type=None, var=None, pass_name=None):
+        self.severity = severity
+        self.code = code
+        self.message = message
+        self.block_idx = block_idx
+        self.op_index = op_index
+        self.op_type = op_type
+        self.var = var
+        self.pass_name = pass_name
+
+    #: codes whose identity is per-op (slot/attr conformance); dataflow
+    #: findings key on the VAR alone — a pass that merely retypes the
+    #: op touching a var (fusion) must not re-key a pre-existing finding
+    _PER_OP_CODES = frozenset({
+        "unknown-input-slot", "unknown-output-slot",
+        "missing-required-input", "unknown-attr", "attr-type-mismatch",
+        "unregistered-op",
+    })
+
+    def key(self):
+        if self.code in self._PER_OP_CODES:
+            return (self.code, self.block_idx, self.op_type, self.var)
+        return (self.code, self.block_idx, self.var)
+
+    def format(self) -> str:
+        where = f"block {self.block_idx}"
+        if self.op_index is not None:
+            where += f" op #{self.op_index}"
+        if self.op_type:
+            where += f" ({self.op_type})"
+        head = self.severity.upper()
+        if self.pass_name:
+            head += f" [{self.pass_name}]"
+        return f"{head} {self.code}: {where}: {self.message}"
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return f"<Diagnostic {self.format()}>"
+
+
+class VerifyError(RuntimeError):
+    """Raised by the pass gate on error-severity findings."""
+
+    def __init__(self, diagnostics: Sequence[Diagnostic], pass_name=None):
+        self.diagnostics = list(diagnostics)
+        self.pass_name = pass_name
+        lines = [d.format() for d in self.diagnostics]
+        head = (f"IR pass {pass_name!r} broke program invariants"
+                if pass_name else "program verification failed")
+        super().__init__(head + ":\n  " + "\n  ".join(lines))
+
+
+def enabled() -> bool:
+    from ..utils.flags import flag
+
+    return bool(flag("verify_passes"))
+
+
+# --------------------------------------------------------------------------
+# OpSpec: slot/attr declarations derived from the lowering by AST scan
+# --------------------------------------------------------------------------
+class OpSpec:
+    __slots__ = ("type", "in_slots", "out_slots", "required_in", "attrs",
+                 "open_slots", "open_attrs", "_opt_in", "_delegates")
+
+    def __init__(self, type):
+        self.type = type
+        self.in_slots: set = set()
+        self.out_slots: set = set()
+        self.required_in: set = set()  # in_/ins accesses with no guard
+        self._opt_in: set = set()      # has_input / missing_ok accesses
+        self.attrs: Dict[str, Any] = {}   # name -> default (None = unknown)
+        self.open_slots = False  # dynamic slot access seen: skip slot checks
+        self.open_attrs = False  # dynamic attr access seen: skip attr checks
+        self._delegates: set = set()  # OPS["x"].lower(ctx) alias targets
+
+
+_IN_METHODS = {"in_", "ins", "has_input"}
+_OUT_METHODS = {"set_out", "out_names", "has_output"}
+_OPTIONAL_IN = {"has_input"}
+
+_spec_cache: Dict[str, Optional[OpSpec]] = {}
+
+
+def _literal(node):
+    try:
+        return True, ast.literal_eval(node)
+    except Exception:
+        return False, None
+
+
+def _scan_callable(fn, spec: OpSpec, seen: set, depth: int):
+    """Collect ctx-method usages from ``fn``'s source, following helper
+    calls resolvable through globals/closure/default args (the `_unary`
+    / `_ew` factory idiom keeps the real slot reads one level down)."""
+    if depth > 4 or not callable(fn) or id(fn) in seen:
+        return
+    seen.add(id(fn))
+    try:
+        code = fn.__code__
+    except AttributeError:
+        return
+    if "paddle_tpu" not in (code.co_filename or ""):
+        return
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except Exception:
+        spec.open_slots = spec.open_attrs = True
+        return
+
+    callees: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in ("op", "env"):
+            # direct ctx.op.inputs / ctx.env access: the lowering reads
+            # arbitrary slots — declarations can't be derived
+            spec.open_slots = True
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            callees.append(f.id)
+            if f.id == "getattr":
+                spec.open_slots = spec.open_attrs = True
+            continue
+        if not isinstance(f, ast.Attribute):
+            continue
+        meth = f.attr
+        if meth == "lower" and isinstance(f.value, ast.Subscript) and \
+                isinstance(f.value.value, ast.Name) and \
+                f.value.value.id == "OPS":
+            # the alias idiom: OPS["batch_norm"].lower(ctx) — inherit
+            # the target op's derived spec
+            ok, target = _literal(f.value.slice)
+            if ok and isinstance(target, str):
+                spec._delegates.add(target)
+            else:
+                spec.open_slots = spec.open_attrs = True
+            continue
+        if meth in _IN_METHODS or meth in _OUT_METHODS or meth == "attr":
+            if not node.args:
+                continue
+            ok, name = _literal(node.args[0])
+            if not ok or not isinstance(name, str):
+                if meth == "attr":
+                    spec.open_attrs = True
+                else:
+                    spec.open_slots = True
+                continue
+            if meth in _IN_METHODS:
+                spec.in_slots.add(name)
+                missing_ok = any(kw.arg == "missing_ok"
+                                 for kw in node.keywords) or (
+                    len(node.args) > 1 and _literal(node.args[1])[1])
+                if meth in _OPTIONAL_IN or missing_ok:
+                    spec._opt_in.add(name)
+                else:
+                    spec.required_in.add(name)
+            elif meth in _OUT_METHODS:
+                spec.out_slots.add(name)
+            else:  # attr
+                default = None
+                if len(node.args) > 1:
+                    ok, default = _literal(node.args[1])
+                    if not ok:
+                        default = None
+                for kw in node.keywords:
+                    if kw.arg == "default":
+                        ok, default = _literal(kw.value)
+                        if not ok:
+                            default = None
+                if name not in spec.attrs or spec.attrs[name] is None:
+                    spec.attrs[name] = default
+
+    # resolve helper callees: globals, closure cells, callable defaults
+    env: Dict[str, Any] = {}
+    env.update(getattr(fn, "__globals__", {}) or {})
+    freevars = code.co_freevars
+    closure = getattr(fn, "__closure__", None) or ()
+    for name, cell in zip(freevars, closure):
+        try:
+            env[name] = cell.cell_contents
+        except ValueError:
+            pass
+    for name in callees:
+        target = env.get(name)
+        if target is not None and inspect.isfunction(target):
+            _scan_callable(target, spec, seen, depth + 1)
+    for d in (getattr(fn, "__defaults__", None) or ()):
+        if inspect.isfunction(d):
+            _scan_callable(d, spec, seen, depth + 1)
+    kwd = getattr(fn, "__kwdefaults__", None) or {}
+    for d in kwd.values():
+        if inspect.isfunction(d):
+            _scan_callable(d, spec, seen, depth + 1)
+
+
+def op_spec(op_type: str) -> Optional[OpSpec]:
+    """Derived (and cached) slot/attr declarations for ``op_type``;
+    None when the op is unregistered or has no scannable lowering."""
+    if op_type in _spec_cache:
+        return _spec_cache[op_type]
+    from ..ops import registry
+
+    d = registry.OPS.get(op_type)
+    spec: Optional[OpSpec] = None
+    if d is not None and d.lower is not None:
+        spec = OpSpec(op_type)
+        _spec_cache[op_type] = spec  # break delegation cycles
+        _scan_callable(d.lower, spec, set(), 0)
+        for target in sorted(spec._delegates):
+            if target == op_type:
+                continue
+            tspec = op_spec(target)
+            if tspec is None:
+                continue
+            spec.in_slots.update(tspec.in_slots)
+            spec.out_slots.update(tspec.out_slots)
+            spec.required_in.update(tspec.required_in)
+            spec._opt_in.update(tspec._opt_in)
+            for k, v in tspec.attrs.items():
+                if spec.attrs.get(k) is None:
+                    spec.attrs[k] = v
+            spec.open_slots |= tspec.open_slots
+            spec.open_attrs |= tspec.open_attrs
+        spec.required_in -= spec._opt_in
+        hint = getattr(d, "spec_hint", None)
+        if hint:
+            spec.in_slots.update(hint.get("inputs", ()))
+            spec.out_slots.update(hint.get("outputs", ()))
+            for k, v in (hint.get("attrs", None) or {}).items():
+                spec.attrs.setdefault(k, v)
+            for s in hint.get("optional_inputs", ()):
+                spec.in_slots.add(s)
+                spec.required_in.discard(s)
+            if hint.get("open"):
+                spec.open_slots = spec.open_attrs = True
+        if d.infer_shape is not None:
+            # a custom InferShape may read slots/attrs the lowering
+            # doesn't (e.g. shape-carrying attrs) — fold it in
+            _scan_callable(d.infer_shape, spec, set(), 0)
+            spec.required_in.clear()  # infer fns read op.inputs directly
+            spec.open_slots = True
+    _spec_cache[op_type] = spec
+    return spec
+
+
+def _is_grad_type(op_type: str) -> bool:
+    return op_type.endswith("_grad")
+
+
+def _attr_type_ok(value, default) -> bool:
+    """Loose conformance: flag only clear disagreements.  int<->float
+    interchange, bool-as-int, VarType-as-int, scalar-vs-0d are all fine;
+    str-vs-number and list-vs-scalar are not."""
+    if default is None or value is None:
+        return True
+    if isinstance(default, bool):
+        return not isinstance(value, str) and not isinstance(value, (list, tuple))
+    if isinstance(default, (int, float)):
+        try:
+            import numpy as np
+
+            if isinstance(value, (bool, int, float, np.integer, np.floating,
+                                  VarType)):
+                return True
+        except Exception:
+            pass
+        return not isinstance(value, (str, list, tuple, dict))
+    if isinstance(default, str):
+        return isinstance(value, str)
+    if isinstance(default, (list, tuple)):
+        try:
+            import numpy as np
+
+            return isinstance(value, (list, tuple, np.ndarray))
+        except Exception:
+            return isinstance(value, (list, tuple))
+    return True
+
+
+# --------------------------------------------------------------------------
+# read/write event model
+# --------------------------------------------------------------------------
+def op_reads_writes(op_: Operator) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """The op's (reads, writes).  In-place/stateful ops write their
+    inputs via output name == input name, so declared outputs already
+    carry the in-place write set."""
+    reads = tuple(n for n in op_.input_arg_names if n != EMPTY)
+    writes = tuple(n for n in op_.output_arg_names if n != EMPTY)
+    return reads, writes
+
+
+def block_events(block: Block) -> List[Tuple[Operator, tuple, tuple]]:
+    return [(op_,) + op_reads_writes(op_) for op_ in block.ops]
+
+
+def _sub_block_attrs(op_: Operator) -> List[Block]:
+    out = []
+    for k, v in op_.attrs.items():
+        if isinstance(v, Block):
+            out.append(v)
+        elif isinstance(v, int) and k.endswith("block"):
+            try:
+                out.append(op_.block.program.blocks[v])
+            except Exception:
+                pass
+    return out
+
+
+def _is_loop_block(program: Program, block: Block) -> bool:
+    """Blocks owned by while-style ops carry loop-carried reads (read at
+    the top, written at the bottom) — use-before-def does not apply."""
+    for blk in program.blocks:
+        for op_ in blk.ops:
+            if op_.type in ("while", "while_loop", "recurrent"):
+                if any(b is block for b in _sub_block_attrs(op_)):
+                    return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# absolute checks (no snapshot needed)
+# --------------------------------------------------------------------------
+def check_registry(program: Program) -> List[Diagnostic]:
+    from ..ops import registry
+
+    diags: List[Diagnostic] = []
+    for blk in program.blocks:
+        for i, op_ in enumerate(blk.ops):
+            t = op_.type
+            d = registry.OPS.get(t)
+            if _is_grad_type(t):
+                if d is None or d.lower is None \
+                        or getattr(d, "_generic_grad", False):
+                    fwd = registry.OPS.get(t[: -len("_grad")])
+                    if fwd is not None and fwd.lower is not None:
+                        continue  # generic vjp grad materializes lazily
+                    diags.append(Diagnostic(
+                        SEV_ERROR, "unregistered-op",
+                        f"grad op type {t!r} has no lowering and no "
+                        f"forward op to derive a generic grad from",
+                        blk.idx, i, t))
+                    continue
+                # custom grad lowering: falls through to slot checks
+            elif d is None or d.lower is None:
+                # a grad-maker-/infer-only OpDef is as unexecutable as
+                # an unknown type — the executor would fail mid-trace
+                detail = ("is not in the registry" if d is None
+                          else "is registered without a lowering")
+                diags.append(Diagnostic(
+                    SEV_ERROR, "unregistered-op",
+                    f"op type {t!r} {detail}", blk.idx, i, t))
+                continue
+            spec = op_spec(t)
+            if spec is None:
+                continue
+            is_grad = _is_grad_type(t)
+            if not spec.open_slots:
+                for slot in op_.inputs:
+                    if slot not in spec.in_slots:
+                        diags.append(Diagnostic(
+                            SEV_WARNING, "unknown-input-slot",
+                            f"input slot {slot!r} is never consumed by the "
+                            f"{t!r} lowering", blk.idx, i, t, var=slot))
+                for slot in op_.outputs:
+                    if slot not in spec.out_slots:
+                        diags.append(Diagnostic(
+                            SEV_WARNING, "unknown-output-slot",
+                            f"output slot {slot!r} is never produced by the "
+                            f"{t!r} lowering", blk.idx, i, t, var=slot))
+                for slot in spec.required_in:
+                    names = op_.inputs.get(slot, [])
+                    if not names or all(n == EMPTY for n in names):
+                        diags.append(Diagnostic(
+                            SEV_WARNING, "missing-required-input",
+                            f"required input slot {slot!r} of {t!r} is "
+                            f"missing/empty", blk.idx, i, t, var=slot))
+            if not spec.open_attrs and not is_grad:
+                # grad ops carry a full fwd-attr snapshot by design —
+                # attr conformance applies to forward ops only
+                for name, value in op_.attrs.items():
+                    if name.startswith("__") or name in FRAMEWORK_ATTRS:
+                        continue
+                    if name not in spec.attrs:
+                        diags.append(Diagnostic(
+                            SEV_WARNING, "unknown-attr",
+                            f"attr {name!r} is never read by the {t!r} "
+                            f"lowering (undeclared)", blk.idx, i, t,
+                            var=name))
+                    elif not _attr_type_ok(value, spec.attrs[name]):
+                        diags.append(Diagnostic(
+                            SEV_ERROR, "attr-type-mismatch",
+                            f"attr {name!r} = {value!r} "
+                            f"({type(value).__name__}) disagrees with the "
+                            f"{t!r} lowering's default "
+                            f"{spec.attrs[name]!r}", blk.idx, i, t,
+                            var=name))
+    return diags
+
+
+def _visible_names(program: Program, block: Block) -> Tuple[set, set]:
+    """(declared, written) name sets visible from ``block``: its own and
+    every ancestor's var declarations and op writes."""
+    declared: set = set()
+    written: set = set()
+    blk: Optional[Block] = block
+    guard = 0
+    while blk is not None and guard < 64:
+        declared.update(blk.vars)
+        for op_ in blk.ops:
+            written.update(n for n in op_.output_arg_names if n != EMPTY)
+        blk = blk.parent_block
+        guard += 1
+    return declared, written
+
+
+def check_dataflow(program: Program, feed_names=(),
+                   fetch_names=()) -> List[Diagnostic]:
+    """Use-before-def / orphaned reads / dead writes / capture
+    visibility.  Severities are conservative (see module docstring): the
+    executor tolerates scope-resident values the program never writes,
+    so absolute findings are warnings except capture violations; the
+    pass gate upgrades NEW findings to errors."""
+    diags: List[Diagnostic] = []
+    feed_names = set(feed_names)
+    all_declared = {n for b in program.blocks for n in b.vars}
+    for blk in program.blocks:
+        declared, written_visible = _visible_names(program, blk)
+        parent = blk.parent_block
+        ancestor_written = (_visible_names(program, parent)[1]
+                            if parent is not None else set())
+        is_loop = blk.idx != 0 and _is_loop_block(program, blk)
+        events = block_events(blk)
+        written_before: set = set()
+        writes_all = set()
+        for _, _, ws in events:
+            writes_all.update(ws)
+        # sub-block free reads count as reads of the parent value
+        sub_reads: Dict[int, set] = {}
+        for i, (op_, _, _) in enumerate(events):
+            free: set = set()
+            for sb in _sub_block_attrs(op_):
+                for sop in sb.ops:
+                    free.update(n for n in sop.input_arg_names
+                                if n != EMPTY and n not in sb.vars)
+            if free:
+                sub_reads[i] = free
+        last_read: Dict[str, int] = {}
+        for i, (op_, rs, ws) in enumerate(events):
+            for n in set(rs) | sub_reads.get(i, set()):
+                last_read[n] = i
+        for i, (op_, rs, ws) in enumerate(events):
+            for n in set(rs):
+                if n.startswith("@"):
+                    continue
+                if n in ws:
+                    # in-place read+write (allreduce, optimizer update):
+                    # an unwritten-before read observes the scope value
+                    # legitimately — state, not use-before-def.  The
+                    # name must still resolve somewhere, though: a
+                    # rename that misses an in-place op (out == in)
+                    # leaves it reading stale scope state.  The op's own
+                    # write pollutes written_visible, so test declared /
+                    # ancestor writes instead.
+                    if n not in declared and n not in feed_names \
+                            and n not in written_before \
+                            and n not in ancestor_written:
+                        if blk.idx != 0 and n in all_declared:
+                            diags.append(Diagnostic(
+                                SEV_ERROR, "subblock-capture",
+                                f"op reads {n!r} in place, which is "
+                                f"declared only in a non-ancestor block "
+                                f"— sub-block ops may only capture vars "
+                                f"visible in an ancestor",
+                                blk.idx, i, op_.type, var=n))
+                        else:
+                            diags.append(Diagnostic(
+                                SEV_WARNING, "orphaned-read",
+                                f"op reads and writes {n!r} in place, "
+                                f"but no visible block declares it "
+                                f"(orphaned name — stale after a "
+                                f"rename?)", blk.idx, i, op_.type,
+                                var=n))
+                    written_before.add(n)
+                    continue
+                v = blk._find_var_recursive(n)
+                persist = v is not None and (getattr(v, "persistable", False)
+                                             or getattr(v, "is_data", False))
+                if n in written_before or n in feed_names or persist:
+                    continue
+                if n not in declared and n not in written_visible:
+                    sev = SEV_WARNING
+                    code = ("subblock-capture" if blk.idx != 0
+                            and n in all_declared else "orphaned-read")
+                    if code == "subblock-capture":
+                        sev = SEV_ERROR
+                        msg = (f"op reads {n!r}, which is declared only in "
+                               f"a non-ancestor block — sub-block ops may "
+                               f"only capture vars visible in an ancestor")
+                    else:
+                        msg = (f"op reads {n!r}, which no visible block "
+                               f"declares and no visible op writes "
+                               f"(orphaned name — stale after a rename?)")
+                    diags.append(Diagnostic(sev, code, msg, blk.idx, i,
+                                            op_.type, var=n))
+                elif n in writes_all and not is_loop:
+                    diags.append(Diagnostic(
+                        SEV_WARNING, "use-before-def",
+                        f"op reads {n!r} before the op that writes it "
+                        f"(value must come from the scope)", blk.idx, i,
+                        op_.type, var=n))
+            for n in ws:
+                written_before.add(n)
+        # dead writes: nothing (op, sub-block or fetch-side persistable)
+        # reads the value after its last write
+        if blk.idx == 0:
+            last_write: Dict[str, int] = {}
+            for i, (op_, rs, ws) in enumerate(events):
+                for n in ws:
+                    last_write[n] = i
+            for n, i in last_write.items():
+                if n.startswith("@") or last_read.get(n, -1) >= i \
+                        or n in fetch_names:
+                    continue
+                op_, rs, _ = events[i]
+                if n in rs:
+                    continue  # in-place update: the write IS the effect
+                v = blk._find_var_recursive(n)
+                if v is not None and getattr(v, "persistable", False):
+                    continue
+                diags.append(Diagnostic(
+                    SEV_WARNING, "dead-write",
+                    f"op writes {n!r} but nothing reads it afterwards",
+                    blk.idx, i, op_.type, var=n))
+    return diags
+
+
+# --------------------------------------------------------------------------
+# NHWC layout postcondition
+# --------------------------------------------------------------------------
+def check_nhwc(program: Program) -> List[Diagnostic]:
+    """After layout_transform_pass no consumer may mix layouts: an
+    NHWC-mode op must not read a value a sensitive op produced in NCHW
+    (and vice versa), and only the pass's own boundary transposes may
+    consume its ``@NHWC`` alias vars from generic ops."""
+    from .ir import _LAYOUT_AGNOSTIC, _LAYOUT_OPS, _NHWC_SUFFIX
+
+    diags: List[Diagnostic] = []
+    for blk in program.blocks:
+        label: Dict[str, str] = {}  # var -> "NHWC" | "NCHW"
+
+        def produced(names, lay):
+            for n in names:
+                if n == EMPTY:
+                    continue
+                if lay is None:
+                    label.pop(n, None)
+                else:
+                    label[n] = lay
+
+        for i, op_ in enumerate(blk.ops):
+            t = op_.type
+            if t in ("transpose2", "transpose"):
+                axis = list(op_.attrs.get("axis", ()))
+                outs = op_.outputs.get("Out", [])
+                if axis == [0, 2, 3, 1]:
+                    produced(outs, "NHWC")
+                elif axis == [0, 3, 1, 2]:
+                    produced(outs, "NCHW")
+                else:
+                    produced(outs, None)
+                continue
+            spec = _LAYOUT_OPS.get(t)
+            if spec is not None:
+                attr_name, din, dout = spec
+                mode = op_.attrs.get(attr_name, "NCHW")
+                for slot in din:
+                    for n in op_.inputs.get(slot, []):
+                        lay = label.get(n)
+                        if lay is None:
+                            continue
+                        if mode == "NHWC" and lay == "NCHW":
+                            diags.append(Diagnostic(
+                                SEV_ERROR, "mixed-layout-consumer",
+                                f"NHWC-mode {t!r} reads {n!r}, which was "
+                                f"produced in NCHW", blk.idx, i, t, var=n))
+                        elif mode != "NHWC" and lay == "NHWC":
+                            diags.append(Diagnostic(
+                                SEV_ERROR, "mixed-layout-consumer",
+                                f"{mode}-mode {t!r} reads {n!r}, which was "
+                                f"produced in NHWC", blk.idx, i, t, var=n))
+                for slot in dout:
+                    produced(op_.outputs.get(slot, []),
+                             "NHWC" if mode == "NHWC" else "NCHW")
+                continue
+            agn = _LAYOUT_AGNOSTIC.get(t)
+            if agn is not None:
+                din, dout = agn
+                lays = set()
+                for slot in din:
+                    for n in op_.inputs.get(slot, []):
+                        if n != EMPTY and n in label:
+                            lays.add(label[n])
+                if lays == {"NHWC", "NCHW"}:
+                    diags.append(Diagnostic(
+                        SEV_ERROR, "mixed-layout-consumer",
+                        f"layout-agnostic {t!r} mixes NHWC and NCHW data "
+                        f"inputs", blk.idx, i, t))
+                out_lay = "NHWC" if lays == {"NHWC"} else (
+                    "NCHW" if lays == {"NCHW"} else None)
+                for slot in dout:
+                    produced(op_.outputs.get(slot, []), out_lay)
+                continue
+            # generic op: consuming a pass-created @NHWC alias here means
+            # the pass failed to materialize the NCHW value first
+            for n in op_.input_arg_names:
+                if n.endswith(_NHWC_SUFFIX) and label.get(n) == "NHWC":
+                    diags.append(Diagnostic(
+                        SEV_ERROR, "mixed-layout-consumer",
+                        f"generic op {t!r} reads NHWC alias {n!r} (expects "
+                        f"NCHW data)", blk.idx, i, t, var=n))
+            for names in op_.outputs.values():
+                produced(names, None)
+    return diags
+
+
+# --------------------------------------------------------------------------
+# pluggable cross-program / plan rules
+# --------------------------------------------------------------------------
+_LOCAL_SYNC_OPS = frozenset({
+    "c_sync_calc_stream", "c_sync_comm_stream", "c_wait_comm_stream",
+    "c_wait_calc_stream", "c_gen_nccl_id", "c_comm_init",
+    "c_comm_init_all", "gen_nccl_id",
+})
+
+
+def collective_signature(program: Program) -> List[tuple]:
+    """Ordered (type, ring_id, payload shape) of every order-sensitive
+    collective in the program — the ring-deadlock fingerprint: two
+    devices whose sequences diverge will block each other forever."""
+    sig = []
+    for blk in program.blocks:
+        for op_ in blk.ops:
+            t = op_.type
+            if not (t.startswith("c_") or t in ("allreduce", "broadcast",
+                                                "barrier")):
+                continue
+            if t in _LOCAL_SYNC_OPS:
+                continue
+            shape = None
+            names = op_.inputs.get("X", []) or op_.input_arg_names
+            if names:
+                v = blk._find_var_recursive(names[0])
+                if v is not None and v.shape is not None:
+                    shape = tuple(v.shape)
+            sig.append((t, op_.attrs.get("ring_id", 0), len(names), shape))
+    return sig
+
+
+def check_collective_order(programs: Sequence[Program]) -> List[Diagnostic]:
+    """Every device must issue the same collectives in the same order
+    (reference: the NCCL ring-deadlock invariant multi_devices_graph_pass
+    maintains by construction)."""
+    diags: List[Diagnostic] = []
+    if len(programs) < 2:
+        return diags
+    base = collective_signature(programs[0])
+    for r, prog in enumerate(programs[1:], start=1):
+        sig = collective_signature(prog)
+        n = min(len(base), len(sig))
+        for i in range(n):
+            if base[i] != sig[i]:
+                diags.append(Diagnostic(
+                    SEV_ERROR, "collective-order-mismatch",
+                    f"device 0 issues {base[i]} as collective #{i} but "
+                    f"device {r} issues {sig[i]} — ring deadlock",
+                    op_index=i, op_type=sig[i][0]))
+                break
+        else:
+            if len(base) != len(sig):
+                diags.append(Diagnostic(
+                    SEV_ERROR, "collective-order-mismatch",
+                    f"device 0 issues {len(base)} collectives but device "
+                    f"{r} issues {len(sig)} — ring deadlock",
+                    op_index=n))
+    return diags
+
+
+def check_prefetch_plan(ops: Sequence[Operator], block: Block,
+                        records: Sequence[dict]) -> List[Diagnostic]:
+    """ZeRO-3 prefetch windows (data_parallel._plan_param_prefetch) must
+    never span a write to their parameter: a consumer after the write
+    would read the stale gathered copy.  Generalizes the planner's local
+    never-hoist-past-a-write rule to the whole window."""
+    diags: List[Diagnostic] = []
+    for rec in records:
+        p = rec.get("param")
+        lo = int(rec.get("gather_at", 0))
+        hi = int(rec.get("last_consumer", lo))
+        first = int(rec.get("first_consumer", hi))
+        if not (lo <= first <= hi):
+            diags.append(Diagnostic(
+                SEV_ERROR, "prefetch-window-invalid",
+                f"prefetch window for {p!r} is inverted: gather_at={lo}, "
+                f"first_consumer={first}, last_consumer={hi}",
+                op_index=lo, var=p, pass_name="dp_prefetch_plan"))
+            continue
+        for i in range(lo, min(hi + 1, len(ops))):
+            op_ = ops[i]
+            if p in op_.output_arg_names:
+                diags.append(Diagnostic(
+                    SEV_ERROR, "prefetch-window-crosses-write",
+                    f"prefetch window [{lo}, {hi}] for {p!r} crosses a "
+                    f"write by op #{i} ({op_.type}) — consumers after it "
+                    f"would read a stale gathered copy",
+                    op_index=i, op_type=op_.type, var=p,
+                    pass_name="dp_prefetch_plan"))
+                break
+    return diags
+
+
+# --------------------------------------------------------------------------
+# pass gate: snapshot -> apply -> verify (motion hazards + new findings)
+# --------------------------------------------------------------------------
+def _diag_keys(diags: Sequence[Diagnostic]) -> set:
+    return {d.key() for d in diags}
+
+
+#: last absolute-sweep finding keys, memoized on (program, _version):
+#: Pass.apply brackets every pass with a pre-sweep (snapshot) and a
+#: post-sweep (verify_pass), so on an unchanged program pass k+1's
+#: pre-sweep is exactly pass k's post-sweep — reuse it instead of
+#: sweeping the whole program twice per pass
+_sweep_cache: dict = {"ref": None, "version": None, "keys": None}
+
+
+def _remember_sweep(program: Program, keys: set) -> None:
+    _sweep_cache.update(ref=weakref.ref(program),
+                        version=getattr(program, "_version", None),
+                        keys=keys)
+
+
+def _absolute_sweep_keys(program: Program) -> set:
+    ref = _sweep_cache["ref"]
+    version = getattr(program, "_version", None)
+    if ref is not None and ref() is program and version is not None \
+            and _sweep_cache["version"] == version:
+        return _sweep_cache["keys"]
+    keys = _diag_keys(check_dataflow(program) + check_nhwc(program)
+                      + check_registry(program))
+    _remember_sweep(program, keys)
+    return keys
+
+
+def snapshot(program: Program) -> dict:
+    """Pre-pass state: per-block event lists (op object refs keep ids
+    stable — removed ops stay alive for the comparison) plus the
+    program's pre-existing finding keys, so the gate only fires on
+    problems the pass INTRODUCED."""
+    events = {blk.idx: block_events(blk) for blk in program.blocks}
+    return {"events": events, "pre_keys": _absolute_sweep_keys(program)}
+
+
+def _motion_hazards(before: List[tuple], after: List[tuple],
+                    block_idx: int) -> List[Diagnostic]:
+    """Observed-writer correspondence over ops carried across the pass."""
+    diags: List[Diagnostic] = []
+    before_ids = {id(op_) for op_, _, _ in before}
+    after_ids = {id(op_) for op_, _, _ in after}
+    carried = before_ids & after_ids
+
+    def writer_maps(events):
+        """op id -> {var -> writing op} for the last write BEFORE each
+        op's position, and var -> last writer overall."""
+        observed: Dict[int, Dict[str, Operator]] = {}
+        last: Dict[str, Operator] = {}
+        for op_, rs, ws in events:
+            obs = {}
+            for n in rs:
+                if n in last:
+                    obs[n] = last[n]
+            observed[id(op_)] = obs
+            for n in ws:
+                last[n] = op_
+        return observed, last
+
+    obs_before, last_before = writer_maps(before)
+    obs_after, last_after = writer_maps(after)
+    reads_before = {id(op_): set(rs) for op_, rs, _ in before}
+    pos_after = {id(op_): i for i, (op_, _, _) in enumerate(after)}
+    pos_before = {id(op_): i for i, (op_, _, _) in enumerate(before)}
+
+    for i, (op_, rs, ws) in enumerate(after):
+        oid = id(op_)
+        if oid not in carried:
+            continue
+        common = set(rs) & reads_before.get(oid, set())
+        for n in common:
+            wb = obs_before[oid].get(n)
+            wa = obs_after[oid].get(n)
+            if wa is wb:
+                continue
+            if wa is not None and id(wa) not in carried:
+                continue  # pass-inserted producer: deliberate redirect
+            was = (f"op #{pos_before[id(wb)]} ({wb.type}) of the "
+                   f"pre-pass program" if wb is not None
+                   else "the scope")
+            now = (f"op #{pos_after[id(wa)]} ({wa.type})"
+                   if wa is not None else "the scope (no write precedes it)")
+            diags.append(Diagnostic(
+                SEV_ERROR, "raw-war-hazard",
+                f"op motion changed the value this op reads: {n!r} now "
+                f"comes from {now}, was {was}", block_idx, i, op_.type,
+                var=n))
+    # WAW: the surviving final write to a var must come from the same
+    # surviving op (a pass-inserted writer is a deliberate redirect)
+    for n, wb in last_before.items():
+        wa = last_after.get(n)
+        if wa is None or wa is wb:
+            continue
+        if id(wa) not in carried or id(wb) not in carried:
+            continue
+        diags.append(Diagnostic(
+            SEV_ERROR, "waw-hazard",
+            f"op motion reordered the final write to {n!r}: now op "
+            f"#{pos_after[id(wa)]} ({wa.type}), was ({wb.type})",
+            block_idx, pos_after.get(id(wa)), wa.type, var=n))
+    return diags
+
+
+def verify_pass(snap: dict, program: Program, pass_name: str,
+                raise_on_error: bool = True) -> List[Diagnostic]:
+    """Post-pass verification: motion hazards against the snapshot plus
+    any NEW absolute finding.  Raises VerifyError (naming the pass, op
+    index and hazard) on error-severity findings."""
+    diags: List[Diagnostic] = []
+    for blk in program.blocks:
+        before = snap["events"].get(blk.idx)
+        if before is None:
+            continue  # pass-created block: absolute checks still apply
+        diags.extend(_motion_hazards(before, block_events(blk), blk.idx))
+    post = check_dataflow(program) + check_nhwc(program) + \
+        check_registry(program)
+    _remember_sweep(program, _diag_keys(post))
+    pre_keys = snap["pre_keys"]
+    new = [d for d in post if d.key() not in pre_keys]
+    for d in new:
+        if d.code in ("orphaned-read", "subblock-capture", "use-before-def"):
+            d.severity = SEV_ERROR  # pass-introduced: no scope excuse
+    diags.extend(new)
+    for d in diags:
+        d.pass_name = d.pass_name or pass_name
+    errors = [d for d in diags if d.severity == SEV_ERROR]
+    if errors and raise_on_error:
+        raise VerifyError(errors, pass_name)
+    return diags
+
+
+# --------------------------------------------------------------------------
+# standalone entry (tools/progcheck.py, --verify tool flags, tests)
+# --------------------------------------------------------------------------
+def verify_program(program: Program, feed_names=(), fetch_names=(),
+                   rules=("dataflow", "registry", "nhwc")
+                   ) -> List[Diagnostic]:
+    """Full absolute-check sweep over one program."""
+    diags: List[Diagnostic] = []
+    if "dataflow" in rules:
+        diags.extend(check_dataflow(program, feed_names, fetch_names))
+    if "registry" in rules:
+        diags.extend(check_registry(program))
+    if "nhwc" in rules:
+        diags.extend(check_nhwc(program))
+    return diags
+
+
+def lint_or_raise(program: Program, feed_names=(), fetch_names=(),
+                  where: str = "compile") -> None:
+    """Absolute sweep raising VerifyError on error-severity findings —
+    the shared final-program lint of the executor / DP compile paths
+    (unregistered ops, conformance breaks and capture violations become
+    one diagnostic instead of a mid-trace KeyError)."""
+    errs = [d for d in verify_program(program, feed_names=set(feed_names),
+                                      fetch_names=fetch_names)
+            if d.severity == SEV_ERROR]
+    if errs:
+        raise VerifyError(errs, where)
+
+
+def check_prefetch_plan_or_raise(ops: Sequence[Operator], block: Block,
+                                 records: Sequence[dict],
+                                 where: str = "prefetch_plan") -> None:
+    """check_prefetch_plan, raising on error-severity findings."""
+    bad = [d for d in check_prefetch_plan(ops, block, records)
+           if d.severity == SEV_ERROR]
+    if bad:
+        raise VerifyError(bad, where)

@@ -259,12 +259,14 @@ def _alltoall(ctx):
 
 # -- bootstrap / sync ops: no-ops under XLA ordering (kept for program
 #    compatibility; reference inserts them around every collective) --------
-@op("c_sync_calc_stream", no_grad=True)
+@op("c_sync_calc_stream", no_grad=True,
+    spec_hint={"attrs": {"ring_id": 0}})
 def _c_sync_calc(ctx):
     ctx.set_out("Out", ctx.in_("X"))
 
 
-@op("c_sync_comm_stream", no_grad=True)
+@op("c_sync_comm_stream", no_grad=True,
+    spec_hint={"attrs": {"ring_id": 0}})
 def _c_sync_comm(ctx):
     xs = ctx.ins("X")
     ctx.set_out("Out", xs)

@@ -402,6 +402,11 @@ def _fused_elemwise_activation(ctx):
 def _fused_embedding_seq_pool(ctx):
     """reference: fused/fused_embedding_seq_pool_op.cc — lookup + sum
     pool per sequence (padded (N, T) ids + length convention)."""
+    combiner = str(ctx.attr("combiner", "sum")).lower()
+    if combiner not in ("sum", ""):
+        raise NotImplementedError(
+            f"fused_embedding_seq_pool combiner {combiner!r} (only 'sum', "
+            f"like the reference kernel)")
     w = ctx.in_("W")
     ids = ctx.in_("Ids")
     if jnp.ndim(ids) == 3:

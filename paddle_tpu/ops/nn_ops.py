@@ -520,8 +520,16 @@ def _cross_entropy(ctx):
         lbl = label.astype(jnp.int32)
         if jnp.ndim(lbl) == jnp.ndim(x):
             lbl = jnp.squeeze(lbl, -1)
-        picked = jnp.take_along_axis(x, jnp.expand_dims(lbl, -1), axis=-1)
+        # ignored labels contribute 0 loss (reference:
+        # cross_entropy_op.h CrossEntropyFunctor ignore_index) — the
+        # take_along_axis index is clamped to 0 so an out-of-range
+        # ignore value (e.g. the -100 default) never faults
+        ignore_index = ctx.attr("ignore_index", -100)
+        mask = lbl != ignore_index
+        safe = jnp.where(mask, lbl, 0)
+        picked = jnp.take_along_axis(x, jnp.expand_dims(safe, -1), axis=-1)
         loss = -jnp.log(jnp.clip(picked, 1e-20, None))
+        loss = jnp.where(jnp.expand_dims(mask, -1), loss, 0.0)
     ctx.set_out("Y", loss)
 
 
@@ -531,8 +539,12 @@ def _cross_entropy2(ctx):
     label = ctx.in_("Label").astype(jnp.int32)
     if jnp.ndim(label) == jnp.ndim(x):
         label = jnp.squeeze(label, -1)
-    picked = jnp.take_along_axis(x, jnp.expand_dims(label, -1), axis=-1)
+    ignore_index = ctx.attr("ignore_index", -100)
+    mask = label != ignore_index
+    safe = jnp.where(mask, label, 0)
+    picked = jnp.take_along_axis(x, jnp.expand_dims(safe, -1), axis=-1)
     y = -jnp.log(jnp.clip(picked, 1e-20, None))
+    y = jnp.where(jnp.expand_dims(mask, -1), y, 0.0)
     ctx.set_out("Y", y)
     ctx.set_out("XShape", jnp.zeros((0,), x.dtype))
     ctx.set_out("MatchX", picked)
@@ -812,7 +824,8 @@ def _dropout_grad(ctx):
 # --------------------------------------------------------------------------
 # metrics (reference: operators/metrics/accuracy_op.cc)
 # --------------------------------------------------------------------------
-@op("accuracy", no_grad=True)
+@op("accuracy", no_grad=True,
+    spec_hint={"optional_inputs": ["Out"]})  # scores unused by the kernel
 def _accuracy(ctx):
     indices = ctx.in_("Indices")
     label = ctx.in_("Label")

@@ -256,7 +256,8 @@ def _adadelta(ctx):
     ctx.set_out("AvgSquaredUpdateOut", avg_sq_u_new)
 
 
-@_opt("rmsprop")
+@op("rmsprop", no_grad=True,
+    spec_hint={"optional_inputs": ["MeanGrad"]})  # centered mode only
 def _rmsprop(ctx):
     p, g = ctx.in_("Param"), ctx.in_("Grad").astype(ctx.in_("Param").dtype)
     ms, mom = ctx.in_("MeanSquare"), ctx.in_("Moment")
@@ -431,7 +432,10 @@ def _fused_momentum(ctx):
     ctx.set_out("VelocityOut", vouts)
 
 
-@_opt("fused_adam")
+@op("fused_adam", no_grad=True,
+    # fuse_optimizer_ops_pass copies the per-param adam attrs wholesale;
+    # lazy_mode only matters for SelectedRows grads, which never fuse
+    spec_hint={"attrs": {"lazy_mode": False}})
 def _fused_adam(ctx):
     lr = ctx.in_("LearningRate")
     b1 = ctx.attr("beta1", 0.9)

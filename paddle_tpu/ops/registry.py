@@ -45,6 +45,7 @@ class OpDef:
         "no_grad",
         "stateful",
         "host",
+        "spec_hint",
         "_generic_grad",
     )
 
@@ -56,10 +57,18 @@ class OpDef:
         self.no_grad = False
         self.stateful = False  # uses rng; grad must not replay
         self.host = False      # runs on host (RPC/IO) — cannot be jitted
+        # static-verifier declaration supplement
+        # (framework/verifier.py op_spec): the verifier derives each
+        # op's input/output slots and attr defaults from the lowering
+        # source by AST scan; lowerings with dynamic slot/attr access
+        # declare the remainder here — {"inputs": [...], "outputs":
+        # [...], "optional_inputs": [...], "attrs": {name: default},
+        # "open": True} (open skips slot/attr conformance entirely).
+        self.spec_hint: Optional[dict] = None
 
 
 def op(type: str, *, infer=None, no_grad: bool = False, stateful: bool = False,
-       host: bool = False):
+       host: bool = False, spec_hint: Optional[dict] = None):
     """Decorator registering a forward lowering for ``type``."""
 
     def deco(fn):
@@ -69,6 +78,8 @@ def op(type: str, *, infer=None, no_grad: bool = False, stateful: bool = False,
         d.no_grad = no_grad
         d.stateful = stateful
         d.host = host
+        if spec_hint is not None:
+            d.spec_hint = spec_hint
         return fn
 
     return deco

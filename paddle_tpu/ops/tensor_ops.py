@@ -189,7 +189,7 @@ def _size(ctx):
     ctx.set_out("Out", jnp.asarray(jnp.size(ctx.in_("Input")), dtype=jnp.int64))
 
 
-@op("cast")
+@op("cast", spec_hint={"attrs": {"in_dtype": None}})  # redundant w/ X dtype
 def _cast(ctx):
     dt = to_numpy_dtype(VarType(int(ctx.attr("out_dtype", int(VarType.FP32)))))
     ctx.set_out("Out", ctx.in_("X").astype(dt))
@@ -385,7 +385,7 @@ def _stack(ctx):
 def _unstack(ctx):
     x = ctx.in_("X")
     axis = ctx.attr("axis", 0)
-    n = jnp.shape(x)[axis]
+    n = int(ctx.attr("num", 0) or jnp.shape(x)[axis])
     outs = [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]
     ctx.set_out("Y", outs)
 
@@ -477,7 +477,8 @@ def _expand(ctx):
     ctx.set_out("Out", jnp.tile(x, times))
 
 
-@op("expand_as")
+@op("expand_as",
+    spec_hint={"optional_inputs": ["Y"]})  # Y is the target_tensor alias
 def _expand_as(ctx):
     x = ctx.in_("X")
     y = ctx.in_("target_tensor") if ctx.has_input("target_tensor") else ctx.in_("Y")
@@ -549,7 +550,15 @@ def _tril_triu(ctx):
 @op("diag_v2", no_grad=True)
 def _diag_v2(ctx):
     x = ctx.in_("X")
-    ctx.set_out("Out", jnp.diag(x, ctx.attr("offset", 0)))
+    offset = int(ctx.attr("offset", 0))
+    out = jnp.diag(x, offset)
+    pad = ctx.attr("padding_value", 0.0)
+    if jnp.ndim(x) == 1 and pad not in (0, 0.0):
+        # reference diag_v2 fills the off-diagonal with padding_value
+        n = int(jnp.shape(x)[0]) + abs(offset)
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        out = jnp.where(mask, out, jnp.asarray(pad, out.dtype))
+    ctx.set_out("Out", out)
 
 
 @op("unique", no_grad=True)

@@ -399,6 +399,13 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
                         tv._sharding = spec
         program = rewritten
 
+    from ..framework import verifier
+
+    if verifier.enabled():
+        # same final-program lint as the single-device compile path
+        verifier.lint_or_raise(program, feed, fetch_names,
+                               "data_parallel_compile")
+
     block, state_in, state_out, uses_rng = _analyze(program, set(feed), scope)
     use_shard_map = _program_has_collectives(program)
     ops = list(block.ops)
@@ -441,6 +448,12 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
     if stage >= 3 and sharded_params and pf_depth > 0:
         pf_records, pf_gather, pf_discard = _plan_param_prefetch(
             ops, block, sharded_params, set(wrapped_updates), pf_depth)
+        if pf_records and verifier.enabled():
+            # the verifier's window rule generalizes the planner's local
+            # never-hoist-past-a-write check: any future planner change
+            # that lets a gather window span a param write fails here
+            verifier.check_prefetch_plan_or_raise(
+                ops, block, pf_records, "dp_prefetch_plan")
     compiled_program.__dict__["_prefetch_plan"] = pf_records
     compiled_program.__dict__.setdefault("_prefetch_plans", {})[key] = \
         pf_records

@@ -8,6 +8,7 @@ defaults, runtime set_flags, strategy dataclasses elsewhere.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Any, Dict
 
 _DEFAULTS: Dict[str, Any] = {
@@ -106,6 +107,13 @@ _DEFAULTS: Dict[str, Any] = {
     # scan-vjp computation instead of the per-iteration host replay
     # loop.  0 restores the lax.while_loop / host-replay path.
     "FLAGS_while_static_scan": True,
+    # static program verifier gate (framework/verifier.py): snapshot
+    # before every IR pass, verify dataflow/registry/layout invariants
+    # after, raise a diagnostic naming the pass + op + hazard on
+    # violation.  On by default under pytest (a structural gate every
+    # pass test inherits); off in production — verification never
+    # mutates the program, so 0 restores prior behavior bit-for-bit.
+    "FLAGS_verify_passes": "pytest" in sys.modules,
 }
 
 

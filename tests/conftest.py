@@ -8,6 +8,10 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# static program verifier armed for the whole tier-1 run: every IR pass
+# application is snapshot/verified (framework/verifier.py), so every
+# existing pass test doubles as a verifier test
+os.environ.setdefault("FLAGS_verify_passes", "1")
 
 import jax
 

@@ -347,6 +347,11 @@ def main(argv=None):
     ap.add_argument("--calibrate-ms", type=float, default=None,
                     help="measured backward time of one step: rescales "
                          "the cost model before the schedule decision")
+    ap.add_argument("--verify", action="store_true",
+                    help="run tools/progcheck.py's static verifier on "
+                         "the rewritten program (plus the rank-0-vs-"
+                         "rank-1 collective-order check) and exit "
+                         "non-zero on errors")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -431,8 +436,33 @@ def main(argv=None):
         out["prefetch"] = prefetch_stats(rewritten, args.nranks,
                                          int(flags.flag(
                                              "dp_prefetch_depth")))
-    print(json.dumps(out, indent=2))
+    rc = 0
+    if args.verify:
+        from progcheck import check_cross_device, check_program
+        from paddle_tpu.transpiler import GradAllReduce
+
+        diags = [d.as_dict() for d in
+                 check_program(rewritten, feed_names=("x", "y"),
+                               fetch_names=(loss.name,))]
+        # ring-deadlock check: the same model transpiled for rank 1
+        # must issue the identical collective sequence
+        other, other_startup, other_loss = build_mlp_dp_program(
+            args.layers, args.width, args.nranks, transpile=False)
+        GradAllReduce().transpile(
+            startup_program=other_startup, main_program=other, rank=1,
+            endpoints=["127.0.0.1:6170", "127.0.0.1:6171"],
+            nranks=args.nranks)
+        other = exe._apply_ir_passes(other, [other_loss.name])
+        diags += [d.as_dict() for d in
+                  check_cross_device([rewritten, other])]
+        n_err = sum(d["severity"] == "error" for d in diags)
+        out["verify"] = {"errors": n_err,
+                         "warnings": len(diags) - n_err,
+                         "diagnostics": diags}
+        rc = 1 if n_err else 0
+    print(json.dumps(out, indent=2, default=str))
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""progcheck — static program lint: run the IR verifier on any
+constructed/saved program without executing it.
+
+Checks (framework/verifier.py): dataflow (possibly-uninitialized reads,
+orphaned names after renames, dead writes, sub-block capture
+visibility), registry conformance (unregistered ops, slot names the
+lowering never consumes, missing required inputs, attr values whose
+type disagrees with the lowering's defaults), NHWC layout consistency
+(no mixed-layout consumer), and — given two or more programs — the
+cross-device collective-order ring-deadlock check.
+
+Usage:
+    python tools/progcheck.py prog.json [prog2.json ...]
+        [--feed x,y] [--json] [--strict] [--quiet]
+
+Programs are the JSON produced by ``Program.serialize_to_string()``
+(also what ``save_inference_model`` writes as the model desc).  Exit
+status: 1 when errors are found (``--strict``: warnings too), else 0 —
+so CI and the driver can gate on constructed programs directly.
+
+The check entry points are importable: ``check_program`` /
+``check_cross_device`` are reused by ``dp_comm_stats.py --verify`` and
+``verify_overlap.py --verify``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def check_program(program, feed_names=(), fetch_names=()):
+    """All single-program absolute checks -> list of Diagnostics."""
+    from paddle_tpu.framework import verifier
+
+    return verifier.verify_program(program, feed_names=feed_names,
+                                   fetch_names=fetch_names)
+
+
+def check_cross_device(programs):
+    """Collective-order (ring-deadlock) check across device programs."""
+    from paddle_tpu.framework import verifier
+
+    return verifier.check_collective_order(programs)
+
+
+def _load(path):
+    from paddle_tpu.framework.core import Program
+
+    with open(path, "rb") as f:
+        data = f.read()
+    return Program.parse_from_string(data)
+
+
+def run(paths, feed_names=(), fetch_names=(), programs=None):
+    """Lint every program plus the cross-device check; returns
+    (diagnostics, per_program_counts)."""
+    progs = list(programs) if programs is not None else []
+    labels = [f"<program {i}>" for i in range(len(progs))]
+    for p in paths:
+        progs.append(_load(p))
+        labels.append(p)
+    diags = []
+    per_prog = []
+    for label, prog in zip(labels, progs):
+        ds = check_program(prog, feed_names=feed_names,
+                           fetch_names=fetch_names)
+        per_prog.append({"program": label,
+                         "errors": sum(d.severity == "error" for d in ds),
+                         "warnings": sum(d.severity == "warning"
+                                         for d in ds)})
+        for d in ds:
+            diags.append((label, d))
+    if len(progs) > 1:
+        for d in check_cross_device(progs):
+            diags.append(("<cross-device>", d))
+    return diags, per_prog
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("programs", nargs="+",
+                    help="serialized Program JSON file(s); two or more "
+                         "additionally run the cross-device "
+                         "collective-order check")
+    ap.add_argument("--feed", default="",
+                    help="comma-separated feed var names (suppresses "
+                         "uninitialized-read findings for them)")
+    ap.add_argument("--fetch", default="",
+                    help="comma-separated fetch var names (suppresses "
+                         "dead-write findings for them)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    ap.add_argument("--quiet", action="store_true",
+                    help="summary only, no per-finding lines")
+    args = ap.parse_args(argv)
+
+    feed_names = [n for n in args.feed.split(",") if n]
+    fetch_names = [n for n in args.fetch.split(",") if n]
+    diags, per_prog = run(args.programs, feed_names, fetch_names)
+    n_err = sum(d.severity == "error" for _, d in diags)
+    n_warn = sum(d.severity == "warning" for _, d in diags)
+
+    if args.as_json:
+        print(json.dumps({
+            "programs": per_prog,
+            "errors": n_err,
+            "warnings": n_warn,
+            "diagnostics": [dict(d.as_dict(), program=label)
+                            for label, d in diags],
+        }, indent=2, default=str))
+    else:
+        if not args.quiet:
+            for label, d in diags:
+                print(f"{label}: {d.format()}")
+        print(f"progcheck: {len(per_prog)} program(s), "
+              f"{n_err} error(s), {n_warn} warning(s)")
+    return 1 if (n_err or (args.strict and n_warn)) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

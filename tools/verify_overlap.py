@@ -99,7 +99,8 @@ def check_hlo_overlap(hlo_text: str) -> dict:
 
 
 def verify_program(nranks=8, layers=10, width=64, mb=None, stage=None,
-                   prefetch_depth=None, require_hlo=False):
+                   prefetch_depth=None, require_hlo=False,
+                   run_progcheck=False):
     """Build the 10-layer MLP probe, run ONE DP step through the real
     executor path under the current FLAGS, re-lower that exact step AOT,
     and check the compiled HLO for async overlap; falls back to the
@@ -145,6 +146,19 @@ def verify_program(nranks=8, layers=10, width=64, mb=None, stage=None,
     result = check_hlo_overlap(hlo)
     result["hlo_bytes"] = len(hlo)
 
+    if run_progcheck:
+        # static lint of the very program the step inspected — the same
+        # checks tools/progcheck.py runs on saved programs
+        from progcheck import check_program
+
+        diags = [d.as_dict() for d in check_program(
+            exe._apply_ir_passes(main, [loss.name]),
+            feed_names=("x", "y"), fetch_names=(loss.name,))]
+        n_err = sum(d["severity"] == "error" for d in diags)
+        result["progcheck"] = {"errors": n_err,
+                               "warnings": len(diags) - n_err,
+                               "diagnostics": diags}
+
     import jax
 
     backend = jax.default_backend()
@@ -176,6 +190,9 @@ def main(argv=None):
     ap.add_argument("--require-hlo", action="store_true",
                     help="fail (verified=false) instead of falling back "
                          "to the schedule proxy — for real-chip CI")
+    ap.add_argument("--verify", action="store_true",
+                    help="also run tools/progcheck.py's static verifier "
+                         "on the inspected program; errors fail the run")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -187,10 +204,12 @@ def main(argv=None):
         ).strip()
     result = verify_program(args.nranks, args.layers, args.width, args.mb,
                             args.stage, args.prefetch_depth,
-                            args.require_hlo)
+                            args.require_hlo, run_progcheck=args.verify)
     result.pop("pairs", None)
-    print(json.dumps(result, indent=2))
-    return 0 if result["verified"] else 1
+    print(json.dumps(result, indent=2, default=str))
+    ok = result["verified"] and not result.get("progcheck",
+                                               {}).get("errors")
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
