@@ -11,11 +11,14 @@ length-prefixed JSON header + raw ndarray payload over TCP sockets.
 from __future__ import annotations
 
 import json
+import os
+import random as random_mod
 import socket
 import socketserver
 import struct
 import threading
 import time
+import uuid
 from typing import Dict, Optional
 
 import numpy as np
@@ -143,6 +146,27 @@ class BarrierMonitor:
 # header: {"op": str, "name": str, "meta": {...}, "arrays": [[dtype, shape,
 #          nbytes], ...]}
 # --------------------------------------------------------------------------
+#: state-changing control-plane ops: the client stamps these with an
+#: idempotence key (meta["req_id"]) and the server's RequestDeduper
+#: short-circuits replays, so the retry layer can resend after a lost
+#: reply without double-applying (reference: brpc's built-in retry is
+#: safe only because its server dedupes log_ids the same way)
+_MUTATING_OPS = frozenset({
+    "push_dense", "push_sparse", "push_delta", "init_dense",
+    "record_sparse_update", "blob_put",
+})
+#: ops the retry layer must NOT re-enter:
+#: * barrier — a timed-out wait was already counted by the
+#:   BarrierMonitor; resending would join the NEXT round;
+#: * barrier_membership — applies a +/-delta; a lost-reply retry would
+#:   double-apply it (and the dedup ack carries no n_trainers payload);
+#: * pull_updated_rows / blob_take — DESTRUCTIVE reads (server-side
+#:   get_and_clear / pop): after a lost reply the data is gone, and a
+#:   retry would "succeed" with an empty answer, silently losing the
+#:   rows/blobs — surface the transport error to the caller instead;
+#: * stop — fire-and-forget shutdown.
+_NO_RETRY_OPS = frozenset({"barrier", "barrier_membership",
+                           "pull_updated_rows", "blob_take", "stop"})
 def _send_msg(sock, op: str, name: str = "", meta: dict = None, arrays=()):
     arrays = [np.ascontiguousarray(a) for a in arrays]
     header = json.dumps({
@@ -184,7 +208,8 @@ class PSServer:
         self.sparse: Dict[str, SparseTable] = {}
         self._barrier = threading.Barrier(max(n_trainers, 1))
         self._barrier_monitor = BarrierMonitor(n_trainers)
-        from .update_recorder import AsyncSparseParamUpdateRecorder
+        from .update_recorder import (AsyncSparseParamUpdateRecorder,
+                                      RequestDeduper)
 
         # async/geo mode: per-trainer updated-rows tracking (reference:
         # async_sparse_param_update_recorder.h — only instantiated when
@@ -193,6 +218,9 @@ class PSServer:
         # per-trainer row sets)
         self.update_recorder = AsyncSparseParamUpdateRecorder(n_trainers)
         self.record_sparse_updates = False
+        # idempotent-retry guard: req_id-stamped mutating ops replayed
+        # by a client's retry loop (lost reply) are acked, not re-applied
+        self.dedup = RequestDeduper()
         self._blobs: Dict[str, list] = {}
         self._heartbeats: Dict[int, float] = {}
         self._lock = threading.Lock()
@@ -216,6 +244,37 @@ class PSServer:
                             "table": name})
 
     def _handle_inner(self, op, name, meta, arrays, sock):
+        req_id = (meta or {}).get("req_id")
+        if not (req_id and op in _MUTATING_OPS):
+            self._dispatch(op, name, meta, arrays, sock)
+            return
+        # begin() BLOCKS while the same id is mid-apply on another
+        # thread (a fast retry can land on a new connection before the
+        # original apply finishes), then answers duplicate-or-claimed
+        if self.dedup.begin(req_id):
+            # first attempt fully applied, its reply was lost: ack
+            # without touching state
+            _send_msg(sock, "ok", meta={"duplicate": True})
+            return
+        try:
+            self._dispatch(op, name, meta, arrays, sock)
+        except (ConnectionError, OSError):
+            # mutating branches touch no sockets while applying — a
+            # transport error out of one means the APPLY completed and
+            # only the ok-reply failed to send (the exact lost-reply
+            # case): commit, so the incoming retry is acked not
+            # re-applied.
+            self.dedup.commit(req_id)
+            raise
+        except BaseException:
+            # apply failed (an "error" reply goes out via _handle):
+            # release the claim — the client does not retry app errors,
+            # but a manual resend may legitimately re-apply
+            self.dedup.abort(req_id)
+            raise
+        self.dedup.commit(req_id)
+
+    def _dispatch(self, op, name, meta, arrays, sock):
         if op == "create_dense":
             with self._lock:
                 if name not in self.dense:
@@ -368,15 +427,21 @@ class PSServer:
             _send_msg(sock, "error", meta={"what": f"unknown op {op}"})
 
     def _save(self, path: str):
-        """Checkpoint tables (reference: CheckpointNotify handler)."""
+        """Checkpoint tables (reference: CheckpointNotify handler).
+        Atomic per file (tmp + fsync + os.replace): a pserver killed
+        mid-save leaves the previous snapshot readable, never a torn
+        .npz that _load would crash on."""
         import os
+
+        from ..utils.atomic_io import atomic_savez
 
         os.makedirs(path, exist_ok=True)
         dense = {n: t.pull() for n, t in self.dense.items()}
-        np.savez(os.path.join(path, "dense.npz"), **dense)
+        atomic_savez(os.path.join(path, "dense.npz"), **dense)
         for n, t in self.sparse.items():
             ids, ws = t.export_rows()
-            np.savez(os.path.join(path, f"sparse_{n}.npz"), ids=ids, ws=ws)
+            atomic_savez(os.path.join(path, f"sparse_{n}.npz"),
+                         ids=ids, ws=ws)
 
     def _load(self, path: str):
         import os
@@ -455,16 +520,46 @@ class PSServer:
         return f"{self.host}:{self.port}"
 
 
+def _retry_policy():
+    """(retries, deadline_s, backoff_s) from the FLAGS_rpc_* knobs."""
+    from ..utils.flags import flag
+
+    return (int(flag("rpc_retry_times") or 0),
+            float(flag("rpc_deadline") or 0) / 1e3,
+            float(flag("rpc_retry_backoff_ms") or 0) / 1e3)
+
+
+def _backoff_sleep(attempt: int, backoff_s: float, deadline_left: float,
+                   rng: random_mod.Random):
+    """Bounded exponential backoff with +/-50% jitter, capped at 2 s
+    and at the remaining deadline."""
+    if backoff_s <= 0:
+        return
+    delay = min(backoff_s * (2 ** attempt), 2.0)
+    delay *= 0.5 + rng.random()  # jitter in [0.5, 1.5)x
+    delay = min(delay, max(deadline_left, 0.0))
+    if delay > 0:
+        time.sleep(delay)
+
+
 class _BinaryDataClient:
     """Client for the native binary data plane (native/ps_table.cpp
     ps_serve_*; reference: grpc_client.cc).  One socket per THREAD per
     endpoint, so concurrent trainer threads do not serialize on a shared
     connection the way the JSON control path does."""
 
+    #: binary ops safe to blind-retry: pure reads (1=pull_dense,
+    #: 3=pull_sparse).  The C++ wire protocol has no idempotence-key
+    #: field, so mutating ops (2/4/5/6) must NOT auto-retry — after an
+    #: ambiguous failure the server may already have applied the push.
+    _RETRYABLE = frozenset({1, 3})
+
     def __init__(self):
         self._tls = threading.local()
         self.n_rpc = 0  # completed round trips (RTT accounting)
+        self.n_retries = 0
         self._n_rpc_lock = threading.Lock()
+        self._rng = random_mod.Random()
 
     def _sock(self, host, port):
         socks = getattr(self._tls, "socks", None)
@@ -478,7 +573,41 @@ class _BinaryDataClient:
             socks[key] = s
         return s
 
+    def _drop_sock(self, host, port, s):
+        """A failed transaction leaves the stream desynced (possibly
+        mid-message): the cached per-thread socket must be rebuilt, or
+        every later call on this thread inherits the poison."""
+        socks = getattr(self._tls, "socks", None)
+        if socks is not None and socks.get((host, port)) is s:
+            socks.pop((host, port), None)
+        try:
+            s.close()
+        except OSError:
+            pass
+
     def call(self, host, port, op, name, arr1=None, arr2=None):
+        from ..utils import chaos
+
+        retries, deadline_s, backoff_s = _retry_policy()
+        if op not in self._RETRYABLE:
+            retries = 0
+        start = time.time()
+        attempt = 0
+        while True:
+            try:
+                return self._call_once(host, port, op, name, arr1, arr2,
+                                       chaos)
+            except (ConnectionError, OSError):
+                left = (deadline_s - (time.time() - start)
+                        if deadline_s else float("inf"))
+                if attempt >= retries or left <= 0:
+                    raise
+                with self._n_rpc_lock:
+                    self.n_retries += 1
+                _backoff_sleep(attempt, backoff_s, left, self._rng)
+                attempt += 1
+
+    def _call_once(self, host, port, op, name, arr1, arr2, chaos):
         s = self._sock(host, port)
         nm = name.encode()
         msg = [struct.pack("<BH", op, len(nm)), nm]
@@ -491,16 +620,16 @@ class _BinaryDataClient:
             msg.append(struct.pack("<Q", a2.size))
             msg.append(a2.tobytes())
         try:
+            chaos.on_rpc("send", f"bin:{op}")
             s.sendall(b"".join(msg))
+            chaos.on_rpc("recv", f"bin:{op}")
             status = _recv_exact(s, 1)[0]
             (n,) = struct.unpack("<Q", _recv_exact(s, 8))
             payload = _recv_exact(s, n * 4) if n else b""
-        except (ConnectionError, OSError):
-            self._tls.socks.pop((host, port), None)
-            try:
-                s.close()
-            except OSError:
-                pass
+        except BaseException:
+            # evict on ANY mid-transaction failure, not just OSError —
+            # a struct/decode error means the stream is desynced too
+            self._drop_sock(host, port, s)
             raise
         if status != 0:
             raise RuntimeError(
@@ -522,12 +651,30 @@ class PSClient:
         self._data = _BinaryDataClient()
         self._data_ports: Dict[str, tuple] = {}
         self.n_rpc = 0  # completed JSON-path round trips
+        self.n_retries = 0  # transport failures that were retried
+        self._rng = random_mod.Random()
+        # idempotence-key prefix: unique per client per process, so a
+        # restarted trainer can never collide with its dead self's ids
+        self._req_prefix = f"{uuid.uuid4().hex[:12]}.{os.getpid()}"
+        self._req_n = 0
 
     def rpc_count(self) -> int:
         """Total completed client round trips (JSON control path +
         native data plane) — the RTT-per-step accounting bench.py's
-        widedeep mode reports (BASELINE metric #5)."""
+        widedeep mode reports (BASELINE metric #5).  A call that
+        succeeds after N transport retries counts ONE completed round
+        trip (plus N in ``retry_count()``): the metric is end-to-end
+        RPCs, not wire attempts."""
         return self.n_rpc + self._data.n_rpc
+
+    def retry_count(self) -> int:
+        """Transport-level retries performed across both wire paths."""
+        return self.n_retries + self._data.n_retries
+
+    def _next_req_id(self) -> str:
+        with self._lock:
+            self._req_n += 1
+            return f"{self._req_prefix}.{self._req_n}"
 
     def _data_ep(self, ep: str):
         """(host, port) of the native data plane, or None (fallback to
@@ -553,13 +700,50 @@ class PSClient:
             return s
 
     def _call(self, ep, op, name="", meta=None, arrays=()):
+        """One logical RPC with deadline + bounded-backoff retry
+        (FLAGS_rpc_deadline / FLAGS_rpc_retry_times /
+        FLAGS_rpc_retry_backoff_ms).  Only TRANSPORT failures retry —
+        an "error" reply is an application answer and raises
+        immediately.  Mutating ops carry a per-call idempotence key so
+        a retry after a lost reply is acked by the server's deduper
+        instead of double-applied; barrier ops never retry (re-entering
+        a barrier would corrupt the round)."""
+        meta = dict(meta or {})
+        retries, deadline_s, backoff_s = _retry_policy()
+        if op in _NO_RETRY_OPS:
+            retries = 0
+        if op in _MUTATING_OPS and "req_id" not in meta:
+            meta["req_id"] = self._next_req_id()
+        start = time.time()
+        attempt = 0
+        while True:
+            try:
+                return self._transact(ep, op, name, meta, arrays)
+            except (ConnectionError, OSError):
+                left = (deadline_s - (time.time() - start)
+                        if deadline_s else float("inf"))
+                if attempt >= retries or left <= 0:
+                    raise
+                with self._lock:
+                    self.n_retries += 1
+                _backoff_sleep(attempt, backoff_s, left, self._rng)
+                attempt += 1
+
+    def _transact(self, ep, op, name, meta, arrays):
+        """Single wire attempt.  ANY failure mid-transaction (transport
+        error, garbled frame, injected chaos) evicts the cached socket:
+        a stream abandoned mid-message is desynced, and keeping it
+        would poison every later call on this client."""
+        from ..utils import chaos
+
         s = self._sock(ep)
         try:
             with self._lock:
+                chaos.on_rpc("send", op)
                 _send_msg(s, op, name, meta, arrays)
+                chaos.on_rpc("recv", op)
                 rop, _, rmeta, rarrays = _recv_msg(s)
-        except (ConnectionError, OSError):
-            # evict the dead socket so the next call reconnects
+        except BaseException:
             with self._lock:
                 if self._socks.get(ep) is s:
                     del self._socks[ep]
@@ -709,8 +893,22 @@ class PSClient:
         return meta["ages"]
 
     def save(self, path):
+        """Snapshot every pserver's tables.  Attempts ALL endpoints and
+        raises one aggregate error naming each shard that failed — a
+        partial checkpoint (some shards new, some old) must be loudly
+        visible, never silently treated as complete."""
+        errs = []
         for ep in self.endpoints:
-            self._call(ep, "save", meta={"path": path})
+            try:
+                self._call(ep, "save", meta={"path": path})
+            except Exception as e:
+                errs.append((ep, e))
+        if errs:
+            detail = "; ".join(f"{ep}: {type(e).__name__}: {e}"
+                               for ep, e in errs)
+            raise RuntimeError(
+                f"PS checkpoint save to {path!r} failed on "
+                f"{len(errs)}/{len(self.endpoints)} shard(s) — {detail}")
 
     def load(self, path):
         for ep in self.endpoints:

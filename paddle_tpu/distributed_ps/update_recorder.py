@@ -51,3 +51,71 @@ class AsyncSparseParamUpdateRecorder:
 
     def has_grad(self, grad_name: str) -> bool:
         return grad_name in self.grad_to_param
+
+
+class RequestDeduper:
+    """Bounded idempotence-key memory for retried mutating RPCs.
+
+    The failure this guards against: a trainer pushes a gradient, the
+    server applies it, and the REPLY is lost (socket died between apply
+    and read).  The client's retry layer resends with the same
+    ``req_id``; without dedup the server would apply the push twice —
+    a silent 2x gradient.
+
+    Protocol (three-state, closing the check-then-apply race: the
+    retry may arrive on a NEW connection/thread while the original
+    apply is still executing):
+
+    * ``begin(id)`` — blocks while the id is in flight on another
+      thread, then returns True when the id already committed
+      (duplicate: ack, don't apply) or False after claiming it (caller
+      must apply and then ``commit``/``abort``);
+    * ``commit(id)`` — the apply succeeded: remember the id so later
+      replays are acked;
+    * ``abort(id)`` — the apply failed: release the claim so a retry
+      can legitimately re-apply.
+
+    Committed-id memory is bounded FIFO (``capacity`` most recent): a
+    duplicate can only arrive within the client's retry window
+    (seconds), while capacity covers minutes of traffic."""
+
+    def __init__(self, capacity: int = 8192):
+        from collections import deque
+
+        self.capacity = int(capacity)
+        self._cv = threading.Condition()
+        self._seen: set = set()
+        self._inflight: set = set()
+        self._order = deque()
+
+    def begin(self, req_id: str) -> bool:
+        with self._cv:
+            while req_id in self._inflight:
+                self._cv.wait()
+            if req_id in self._seen:
+                return True
+            self._inflight.add(req_id)
+            return False
+
+    def commit(self, req_id: str) -> None:
+        with self._cv:
+            self._inflight.discard(req_id)
+            if req_id not in self._seen:
+                self._seen.add(req_id)
+                self._order.append(req_id)
+                while len(self._order) > self.capacity:
+                    self._seen.discard(self._order.popleft())
+            self._cv.notify_all()
+
+    def abort(self, req_id: str) -> None:
+        with self._cv:
+            self._inflight.discard(req_id)
+            self._cv.notify_all()
+
+    def seen(self, req_id: str) -> bool:
+        with self._cv:
+            return req_id in self._seen
+
+    def __len__(self):
+        with self._cv:
+            return len(self._seen)

@@ -692,3 +692,31 @@ class Executor:
 
 def scope_var_to_numpy(scope: Scope, name: str) -> np.ndarray:
     return as_numpy(scope.get(name))
+
+
+def snapshot_scope_state(scope: Scope, names) -> Dict[str, Any]:
+    """Non-blocking checkpoint snapshot of scope state.
+
+    After a step, the scope's entries for donated state ARE the step
+    session's device-resident arrays (`_StateSession` writeback keeps
+    them identical objects), so reading them here costs no device sync;
+    ``copy_to_host_async`` starts every device->host transfer
+    immediately so they pipeline while the caller keeps training.  The
+    returned values stay device arrays — jax arrays are immutable, so
+    the captured references pin the step-N values even while later
+    steps produce replacements (the checkpoint writer materializes them
+    on its own thread).  Names absent from the scope are skipped."""
+    state: Dict[str, Any] = {}
+    for n in names:
+        v = scope.get(n)
+        if v is None:
+            continue
+        if isinstance(v, LoDTensor):
+            v = v.numpy()
+        if hasattr(v, "copy_to_host_async"):
+            try:
+                v.copy_to_host_async()
+            except Exception:
+                pass
+        state[n] = v
+    return state

@@ -74,6 +74,11 @@ class Collective(Fleet):
         self._local_ip = 0
         self.startup_program = None
         self.main_program = None
+        # checkpoint-number allocator (per root path): numbers must be
+        # monotonic over IN-FLIGHT async saves too — a directory whose
+        # manifest has not landed yet is invisible to the newest-valid
+        # election but its number is still taken
+        self._alloc_nos = {}
 
     def init_worker(self):
         pass
@@ -127,34 +132,49 @@ class Collective(Fleet):
         import os
 
         with open(os.path.join(path, "fleet_train_status"), "w") as f:
-            json.dump({"epoch_no": train_status._epoch_no}, f)
+            json.dump(train_status.to_dict(), f)
 
     def _load_train_status(self, path):
         import json
         import os
 
-        r = TrainStatus()
         fname = os.path.join(path, "fleet_train_status")
         if not os.path.isfile(fname):
-            return r
+            return TrainStatus()
         with open(fname) as f:
             d = json.load(f)
         assert "epoch_no" in d and d["epoch_no"] >= 0, \
             f"invalid train_status file: {d}"
-        r._epoch_no = d["epoch_no"]
-        return r
+        return TrainStatus.from_dict(d)
 
-    def _get_last_checkpoint_no(self, root_path, fs):
-        max_no = -1
+    def _checkpoint_numbers(self, root_path, fs, valid_only=True):
+        """Sorted checkpoint numbers under root.  ``valid_only`` skips
+        stray suffixes and any dir without a commit record (a
+        ``manifest.json`` from the sharded format, or the legacy
+        ``fleet_train_status`` marker) — a save that crashed before its
+        manifest landed can never be selected as "newest"."""
+        from ....checkpoint import MANIFEST
+
+        nos = []
         for d in fs.list_dirs(root_path):
             g = d.split(".")
             if len(g) != 2 or g[0] != self._checkpoint_prefix:
                 continue
             try:
-                max_no = max(max_no, int(g[1]))
+                n = int(g[1])
             except ValueError:
-                continue
-        return max_no
+                continue  # stray suffix (".tmp", ".abc", ...)
+            if valid_only:
+                p = f"{root_path}/{d}"
+                if not (fs.stat(f"{p}/{MANIFEST}")
+                        or fs.stat(f"{p}/fleet_train_status")):
+                    continue  # crashed/in-progress save: no commit record
+            nos.append(n)
+        return sorted(nos)
+
+    def _get_last_checkpoint_no(self, root_path, fs):
+        nos = self._checkpoint_numbers(root_path, fs)
+        return nos[-1] if nos else -1
 
     def clean_redundant_check_points(self, root_path, fs=None,
                                      checkpoint_num=1):
@@ -165,97 +185,196 @@ class Collective(Fleet):
         if max_no < 0:
             return
         checkpoint_num = max(checkpoint_num, 1)
-        for d in fs.list_dirs(root_path):
-            g = d.split(".")
-            if len(g) != 2 or g[0] != self._checkpoint_prefix:
-                continue
-            try:
-                n = int(g[1])
-            except ValueError:
-                continue
+        # rotation sweeps INVALID numbered dirs too (valid_only=False):
+        # a crashed save's debris must not accumulate, it just must
+        # never win the newest-checkpoint election above
+        for n in self._checkpoint_numbers(root_path, fs, valid_only=False):
             if n <= max_no - checkpoint_num:
                 fs.rmr(f"{root_path}/{self._checkpoint_prefix}.{n}")
+
+    def _checkpoint_state(self, main_program, include_rng=True):
+        """Persistable scope state for a checkpoint, captured with the
+        non-blocking executor snapshot (D2H copies start immediately;
+        sharded jax values stay sharded — checkpoint.py writes each
+        rank's resident rows only)."""
+        from ....executor import snapshot_scope_state
+        from ....framework.scope import global_scope
+        from ....io import get_program_persistable_vars
+
+        scope = global_scope()
+        names = [v.name for v in get_program_persistable_vars(main_program)]
+        if include_rng:
+            from ....ops import registry
+
+            rng = registry.LowerCtx.RNG_VAR
+            if scope.has(rng):
+                names.append(rng)
+        return snapshot_scope_state(scope, names)
 
     def save_check_point(self, executor, path, train_status,
                          main_program=None, fs=None,
                          local_cache_path=".cache",
-                         remain_all_checkpoint=True):
-        """Save persistables + epoch number into path/<prefix>.<n>
-        atomically (tmp dir then mv), optionally rotating old epochs."""
+                         remain_all_checkpoint=True, writer=None):
+        """Save scope persistables + train status into
+        path/<prefix>.<n> as a sharded atomic checkpoint
+        (paddle_tpu/checkpoint.py): per-rank shard files for ZeRO-
+        sharded state, per-file checksums, manifest committed last.
+        ``writer`` (an AsyncCheckpointWriter) makes the save
+        non-blocking on a local FS; remote FSes stay synchronous (the
+        upload needs the files on disk)."""
+        from ....checkpoint import save_sharded
+        from ....utils.flags import flag
         from ..utils.fs import LocalFS
 
         fs = fs or LocalFS()
         main_program = main_program or self.main_program
         if not fs.stat(path):
             fs.mkdir(path)
-        max_no = self._get_last_checkpoint_no(path, fs=fs)
-        real_path = f"{path}/{self._checkpoint_prefix}.{max_no + 1}"
-        tmp_path = f"{real_path}.tmp"
-        local_fs = LocalFS()
+        all_nos = self._checkpoint_numbers(path, fs, valid_only=False)
+        next_no = max(all_nos[-1] if all_nos else -1,
+                      self._alloc_nos.get(path, -1)) + 1
+        self._alloc_nos[path] = next_no
+        real_path = f"{path}/{self._checkpoint_prefix}.{next_no}"
+        state = self._checkpoint_state(main_program)
+        train = train_status.to_dict()
+        try:
+            from ....parallel.mesh import default_dp_mesh
 
-        saved_path = tmp_path
+            mesh = default_dp_mesh()
+            mesh_info = {"axes": list(mesh.axis_names),
+                         "shape": [int(s) for s in mesh.devices.shape]}
+        except Exception:
+            mesh_info = None
+        extra = {"stage": int(flag("dp_sharding") or 0), "mesh": mesh_info}
+
         if fs.need_upload_download():
+            local_fs = LocalFS()
+            tmp_path = f"{real_path}.tmp"
             saved_path = (f"{local_cache_path}/{self._checkpoint_prefix}"
-                          f".{max_no + 1}.saved_cache")
+                          f".{next_no}.saved_cache")
+            local_fs.delete(saved_path)
             local_fs.mkdir(saved_path)
-        else:
-            local_fs.mkdir(saved_path)
-
-        self.save_persistables(executor=executor, dirname=saved_path,
-                               main_program=main_program,
-                               filename=self._param_file_name)
-        self._save_train_status(path=saved_path, train_status=train_status)
-
-        if fs.need_upload_download():
+            save_sharded(saved_path, state, train=train, extra=extra)
             fs.delete(tmp_path)
             fs.upload(saved_path, tmp_path)
-        fs.mv(tmp_path, real_path)
+            fs.mv(tmp_path, real_path)
+        else:
+            # manifest-last IS the commit: write in place.  The number
+            # is freshly allocated (never reused in-process, in-flight
+            # async dirs counted), so nothing can be squatting on it
+            # except a dead EARLIER process's debris — rotation sweeps
+            # that; the newest-valid election already ignores it.
+            if writer is not None:
+                writer.save(real_path, state, train=train, extra=extra)
+            else:
+                save_sharded(real_path, state, train=train, extra=extra)
         if not remain_all_checkpoint:
             self.clean_redundant_check_points(path, fs=fs)
         return real_path
 
+    def _load_one_checkpoint(self, executor, load_path, main_program):
+        """Load a single checkpoint dir (sharded-manifest or legacy
+        format) into the global scope; returns its TrainStatus.  Raises
+        CheckpointError on integrity failure."""
+        import os
+
+        from ....checkpoint import MANIFEST, load_sharded
+        from ....framework.scope import global_scope
+
+        if os.path.isfile(os.path.join(load_path, MANIFEST)):
+            state, manifest = load_sharded(load_path)
+            scope = global_scope()
+            for name, val in state.items():
+                scope.set(name, val)
+            return TrainStatus.from_dict(manifest.get("train", {}))
+        from .... import io
+
+        io.load_persistables(executor=executor, dirname=load_path,
+                             main_program=main_program,
+                             filename=self._param_file_name)
+        return self._load_train_status(load_path)
+
     def load_check_point(self, executor, path, trainer_id=0,
                          main_program=None, fs=None,
                          local_cache_path=".cache", ignore_empty=True):
-        """Load the newest checkpoint; returns its TrainStatus (or None
-        when the directory has no checkpoints and ignore_empty)."""
-        from .... import io
+        """Load the newest VALID checkpoint; returns its TrainStatus
+        (or None when the directory has no checkpoints and
+        ignore_empty).  A checkpoint that fails integrity validation
+        (truncated/corrupt data file, torn manifest) is rejected and
+        the previous one is tried instead — newest-first until one
+        loads."""
+        import warnings
+
+        from ....checkpoint import CheckpointError
         from ..utils.fs import LocalFS
 
         fs = fs or LocalFS()
-        max_no = self._get_last_checkpoint_no(path, fs)
+        main_program = main_program or self.main_program
+        nos = self._checkpoint_numbers(path, fs)
         if not ignore_empty:
-            assert max_no >= 0, "Can't find checkpoint"
-        if max_no < 0:
-            return None
-        real_path = f"{path}/{self._checkpoint_prefix}.{max_no}"
-        load_path = real_path
-        if fs.need_upload_download():
-            local_fs = LocalFS()
-            cache = (f"{local_cache_path}/{self._checkpoint_prefix}"
-                     f".{max_no}.load_cache.{trainer_id}")
-            local_fs.delete(cache)
-            fs.download(real_path, cache)
-            load_path = cache
-        io.load_persistables(executor=executor, dirname=load_path,
-                             main_program=main_program or self.main_program,
-                             filename=self._param_file_name)
-        return self._load_train_status(load_path)
+            assert nos, "Can't find checkpoint"
+        last_err = None
+        for no in reversed(nos):
+            real_path = f"{path}/{self._checkpoint_prefix}.{no}"
+            load_path = real_path
+            if fs.need_upload_download():
+                local_fs = LocalFS()
+                cache = (f"{local_cache_path}/{self._checkpoint_prefix}"
+                         f".{no}.load_cache.{trainer_id}")
+                local_fs.delete(cache)
+                fs.download(real_path, cache)
+                load_path = cache
+            try:
+                return self._load_one_checkpoint(executor, load_path,
+                                                 main_program)
+            except CheckpointError as e:
+                last_err = e
+                warnings.warn(
+                    f"checkpoint {real_path} rejected ({e}); falling "
+                    f"back to the previous one", RuntimeWarning)
+        if last_err is not None and not ignore_empty:
+            raise last_err
+        return None
 
 
 class TrainStatus:
     """reference: fleet/collective/__init__.py TrainStatus — the epoch
-    counter persisted next to each checkpoint."""
+    counter persisted next to each checkpoint, grown (r11) into the
+    full exact-resume record: global step, reader position (batches
+    consumed, so a resumed run feeds the SAME next batch), an optional
+    serialized host-side RNG state, and the lr-scheduler counters that
+    live outside the scope (scope-resident counters like the Adam beta
+    pows checkpoint with the state itself)."""
 
-    def __init__(self, epoch_no=-1):
+    def __init__(self, epoch_no=-1, step_no=-1, reader_offset=0,
+                 rng_state=None, lr_counters=None):
         self._epoch_no = epoch_no
+        self.step_no = step_no
+        self.reader_offset = reader_offset
+        self.rng_state = rng_state          # JSON-able, e.g. key_data list
+        self.lr_counters = dict(lr_counters or {})
 
     def next(self):
         return self._epoch_no + 1
 
+    def to_dict(self):
+        return {"epoch_no": self._epoch_no, "step_no": self.step_no,
+                "reader_offset": self.reader_offset,
+                "rng_state": self.rng_state,
+                "lr_counters": dict(self.lr_counters)}
+
+    @classmethod
+    def from_dict(cls, d):
+        """Back-compat: legacy records carry only epoch_no."""
+        return cls(epoch_no=int(d.get("epoch_no", -1)),
+                   step_no=int(d.get("step_no", -1)),
+                   reader_offset=int(d.get("reader_offset", 0)),
+                   rng_state=d.get("rng_state"),
+                   lr_counters=d.get("lr_counters") or {})
+
     def __eq__(self, other):
         return isinstance(other, TrainStatus) and \
-            self._epoch_no == other._epoch_no
+            self.to_dict() == other.to_dict()
 
     def __ne__(self, other):
         return not self == other

@@ -19,6 +19,8 @@ from .executor import as_numpy
 from .framework.core import Parameter, Program, Variable, default_main_program
 from .framework.dtype import to_numpy_dtype
 from .framework.scope import global_scope
+from .utils.atomic_io import (atomic_save_npy, atomic_savez,
+                              atomic_write_bytes)
 
 __all__ = [
     "save_vars", "load_vars", "save_params", "load_params",
@@ -61,11 +63,15 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         vars = [v for v in main_program.list_vars() if predicate(v)]
     data = _gather(executor, main_program, lambda v: True, vars)
     os.makedirs(dirname, exist_ok=True)
+    # atomic per file (tmp + fsync + os.replace): a crash mid-save must
+    # leave the previous checkpoint files intact, never a torn .npz
+    # that load_persistables half-applies or crashes on
     if filename is not None:
-        np.savez(os.path.join(dirname, filename), **data)
+        atomic_savez(os.path.join(dirname, filename), **data)
     else:
         for name, arr in data.items():
-            np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"), arr)
+            atomic_save_npy(
+                os.path.join(dirname, name.replace("/", "__") + ".npy"), arr)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
@@ -156,10 +162,10 @@ def save(program: Program, model_path: str):
               if _is_persistable(v) and not _is_parameter(v)
               and global_scope().has(v.name)}
     os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
-    np.savez(model_path + ".pdparams.npz", **params)
-    np.savez(model_path + ".pdopt.npz", **others)
-    with open(model_path + ".pdmodel", "wb") as f:
-        f.write(program.serialize_to_string())
+    atomic_savez(model_path + ".pdparams.npz", **params)
+    atomic_savez(model_path + ".pdopt.npz", **others)
+    atomic_write_bytes(model_path + ".pdmodel",
+                       program.serialize_to_string())
 
 
 def load(program: Program, model_path: str, executor=None, var_list=None):
@@ -219,8 +225,8 @@ def save_inference_model(
         "feed_names": list(feeded_var_names),
         "fetch_names": fetch_names,
     }
-    with open(os.path.join(dirname, model_filename), "w") as f:
-        json.dump(meta, f)
+    atomic_write_bytes(os.path.join(dirname, model_filename),
+                       json.dumps(meta).encode())
     if not program_only:
         # persistables referenced by the pruned program (reference saves
         # persistables, not only Parameter instances — io.py:1093)

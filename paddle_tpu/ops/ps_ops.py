@@ -114,8 +114,18 @@ def _fetch_barrier(ctx):
 
 @_host("checkpoint_notify", no_grad=True)
 def _checkpoint_notify(ctx):
-    """reference: checkpoint_notify_op.cc — tell pservers to snapshot."""
-    _client().save(ctx.attr("dirname", "./ps_checkpoint"))
+    """reference: checkpoint_notify_op.cc — tell pservers to snapshot.
+    Failures REPORT: the client's save() tries every shard, and any
+    failure surfaces here as an error naming the op, directory and the
+    failed endpoints — training must not proceed believing a checkpoint
+    exists when some shard never wrote it."""
+    dirname = ctx.attr("dirname", "./ps_checkpoint")
+    try:
+        _client().save(dirname)
+    except Exception as e:
+        raise RuntimeError(
+            f"checkpoint_notify: pserver snapshot to {dirname!r} "
+            f"failed — {e}") from e
 
 
 @_host("distributed_lookup_table")
@@ -272,9 +282,10 @@ def _recv_save(ctx):
          for p in parts], axis=0)
     if shape:
         full = full.reshape(shape)
+    from ..utils.atomic_io import atomic_save_npy
+
     os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
-    np.save(file_path if file_path.endswith(".npy") else file_path + ".npy",
-            full)
+    atomic_save_npy(file_path, full)
 
 
 @_host("listen_and_serv", no_grad=True)

@@ -99,6 +99,48 @@ _SHARDABLE_UPDATE_OPS = frozenset({
 _NORM_UPDATE_OPS = frozenset({"lamb", "lars_momentum"})
 
 
+def rank_shards(value):
+    """[(rank, device_shard)] for a jax.Array contiguously row-sharded
+    over >1 devices — i.e. exactly the ZeRO-1/2/3 state layouts this
+    module produces (P('dp') over axis 0).  Rank r's entry is that
+    device's resident row block, so the checkpoint layer
+    (paddle_tpu/checkpoint.py) can snapshot 1/ndev of the bytes per
+    rank WITHOUT gathering.  Returns None for replicated, host-side,
+    scalar, or non-axis-0/non-contiguous layouts (tensor-parallel
+    annotations) — those save full-width instead."""
+    import jax
+
+    if not isinstance(value, jax.Array) or not value.ndim \
+            or not value.nbytes:
+        return None
+    try:
+        shards = value.addressable_shards
+    except Exception:
+        return None
+    if len(shards) <= 1 or shards[0].data.nbytes >= value.nbytes:
+        return None  # single device or replicated
+    blocks: Dict[int, Any] = {}
+    for s in shards:
+        idx = s.index
+        if not idx or not isinstance(idx[0], slice):
+            return None
+        for sl in idx[1:]:
+            # only whole trailing axes: row blocks, not 2D tiles
+            if sl != slice(None, None, None):
+                return None
+        blocks.setdefault(int(idx[0].start or 0), s.data)
+    out, expect = [], 0
+    for rank, start in enumerate(sorted(blocks)):
+        d = blocks[start]
+        if start != expect:
+            return None  # gap/overlap: not a contiguous row tiling
+        expect += int(d.shape[0])
+        out.append((rank, d))
+    if expect != int(value.shape[0]):
+        return None
+    return out
+
+
 def _update_shard_rows(op_, block, ndev):
     """Rows-per-device for a shard-eligible update op, else None.
     Eligible: elementwise update type, single dense param/grad, every

@@ -1,0 +1,87 @@
+"""Atomic file writes for checkpoint/save paths.
+
+Every persistent artifact this framework writes (``io.py`` .npy/.npz
+groups, the PS server's table snapshots, the sharded fleet checkpoints
+in ``checkpoint.py``) goes through these helpers: the bytes land in a
+unique temp name in the destination directory, are fsync'd, and then
+``os.replace`` publishes them — so a reader can never observe a
+half-written file, and a crash mid-save leaves the previous version
+intact (reference invariant: fleet/collective's tmp-dir-then-mv epoch
+checkpoints, generalized down to every individual file).
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import zlib
+
+
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync so the rename itself is durable."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> int:
+    """Write ``data`` to ``path`` atomically (tmp + fsync + os.replace).
+    Returns the crc32 of the written bytes."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d)
+    return zlib.crc32(data)
+
+
+def atomic_savez(path: str, **arrays) -> int:
+    """np.savez with atomic publication.  ``path`` gains ``.npz`` when
+    missing (np.savez's own rule, applied to the FINAL name so the temp
+    file and the published file agree).  Returns the crc32."""
+    import numpy as np
+
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    return atomic_write_bytes(path, buf.getvalue())
+
+
+def atomic_save_npy(path: str, arr) -> int:
+    """np.save with atomic publication (``.npy`` appended when missing,
+    matching np.save).  Returns the crc32."""
+    import numpy as np
+
+    if not path.endswith(".npy"):
+        path = path + ".npy"
+    buf = _io.BytesIO()
+    np.save(buf, np.asarray(arr))
+    return atomic_write_bytes(path, buf.getvalue())
+
+
+def file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc
+            crc = zlib.crc32(b, crc)

@@ -1,0 +1,220 @@
+"""Deterministic fault injection (``FLAGS_chaos``).
+
+The fault-tolerance layer's oracle needs failures that are *exactly*
+reproducible: the same schedule string must kill the same step, drop the
+same RPCs and truncate the same checkpoint file on every run, so a
+train -> inject -> resume experiment (tools/chaos_train.py) can assert
+loss-trajectory parity instead of "it usually recovers".
+
+Schedule grammar — ``;``-separated events, all optional::
+
+    seed=N                 RNG seed for probabilistic events (default 0)
+    kill@K                 kill the process at the start of step K
+                           (os._exit(137)); ``kill@K:raise`` raises
+                           ChaosKilled instead (in-process tests)
+    rpc_drop=PHASE@N       drop exactly the Nth RPC (1-based, counted
+                           across the process) at PHASE: ``send`` =
+                           before the request leaves (server never sees
+                           it), ``recv`` = after it was sent but before
+                           the reply is read (server applied it; the
+                           reply is lost) — the double-apply trap
+    rpc_drop=PHASE:P       drop each RPC at PHASE with probability P
+    rpc_delay=MS:P         sleep MS milliseconds before an RPC with
+                           probability P
+    trunc_ckpt@N           after the Nth checkpoint save completes,
+                           truncate one of its data files in half
+                           (seeded choice) — load must reject it
+
+Example: ``FLAGS_chaos="seed=7;kill@12;rpc_drop=recv@3"``.
+
+Hooks are called from the PS client (``on_rpc``), the checkpoint writer
+(``on_checkpoint_saved``) and the training loop (``on_step``).  With
+``FLAGS_chaos`` unset every hook is a no-op behind one cached ``None``
+check, so production paths pay nothing.
+"""
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+import time
+from typing import Optional
+
+from . import flags
+
+
+class ChaosKilled(RuntimeError):
+    """Raised by kill@K:raise — the in-process stand-in for SIGKILL."""
+
+
+class ChaosRPCDrop(ConnectionError):
+    """Injected transport failure — a ConnectionError so the client's
+    retry/eviction path treats it exactly like a real dead socket."""
+
+
+_EVENT_RE = re.compile(r"^(?P<key>[a-z_]+)(?:[=@](?P<val>.*))?$")
+
+
+class FaultSchedule:
+    """Parsed FLAGS_chaos schedule.  All state (RPC counter, checkpoint
+    counter, RNG) lives here so determinism is per-process-run."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.seed = 0
+        self.kill_step: Optional[int] = None
+        self.kill_mode = "exit"            # "exit" | "raise"
+        self.drop_at = {}                  # phase -> set of 1-based indices
+        self.drop_p = {}                   # phase -> probability
+        self.delay_ms = 0.0
+        self.delay_p = 0.0
+        self.trunc_ckpts: set = set()      # 1-based save indices to truncate
+        self._rpc_n = 0
+        self._ckpt_n = 0
+        self._lock = threading.Lock()
+        self._parse(spec)
+        self._rng = random.Random(self.seed)
+
+    # ------------------------------------------------------------------
+    def _parse(self, spec: str):
+        for raw in spec.split(";"):
+            item = raw.strip()
+            if not item:
+                continue
+            key, _, val = item.partition("=") if "=" in item else \
+                item.partition("@")
+            key, val = key.strip(), val.strip()
+            if key == "seed":
+                self.seed = int(val)
+            elif key == "kill":
+                step, _, mode = val.partition(":")
+                self.kill_step = int(step)
+                if mode:
+                    if mode not in ("exit", "raise"):
+                        raise ValueError(f"FLAGS_chaos: bad kill mode {mode!r}")
+                    self.kill_mode = mode
+            elif key == "rpc_drop":
+                if "@" in val:
+                    phase, _, n = val.partition("@")
+                    self._phase_ok(phase)
+                    self.drop_at.setdefault(phase, set()).add(int(n))
+                else:
+                    phase, _, p = val.partition(":")
+                    self._phase_ok(phase)
+                    self.drop_p[phase] = float(p)
+            elif key == "rpc_delay":
+                ms, _, p = val.partition(":")
+                self.delay_ms = float(ms.rstrip("ms") or 0)
+                self.delay_p = float(p or 1.0)
+            elif key == "trunc_ckpt":
+                self.trunc_ckpts.add(int(val))
+            else:
+                raise ValueError(f"FLAGS_chaos: unknown event {item!r}")
+
+    @staticmethod
+    def _phase_ok(phase: str):
+        if phase not in ("send", "recv"):
+            raise ValueError(f"FLAGS_chaos: rpc phase must be send|recv, "
+                             f"got {phase!r}")
+
+    # -- hooks ---------------------------------------------------------
+    def on_step(self, step: int):
+        """Training-loop hook: kill the rank at the scheduled step."""
+        if self.kill_step is None or step != self.kill_step:
+            return
+        if self.kill_mode == "raise":
+            raise ChaosKilled(f"chaos: killed at step {step}")
+        os._exit(137)  # SIGKILL-faithful: no atexit, no flush
+
+    def on_rpc(self, phase: str, op: str = ""):
+        """PS-client hook, called once per (attempted) RPC per phase.
+        The call index is shared across phases (one RPC = one index) so
+        ``send@N`` and ``recv@N`` name the same call."""
+        with self._lock:
+            if phase == "send":
+                self._rpc_n += 1
+            n = self._rpc_n
+            delay = (self.delay_ms > 0 and phase == "send"
+                     and self._rng.random() < self.delay_p)
+            drop = (n in self.drop_at.get(phase, ())
+                    or (phase in self.drop_p
+                        and self._rng.random() < self.drop_p[phase]))
+        if delay:
+            time.sleep(self.delay_ms / 1e3)
+        if drop:
+            raise ChaosRPCDrop(
+                f"chaos: dropped rpc #{n} ({op or '?'}) at {phase}")
+
+    def on_checkpoint_saved(self, dirname: str):
+        """Checkpoint-writer hook: after the Nth completed save,
+        truncate one data file (never the manifest — the point is that
+        checksums catch a torn payload, not a missing commit record)."""
+        with self._lock:
+            self._ckpt_n += 1
+            n = self._ckpt_n
+        if n not in self.trunc_ckpts:
+            return
+        files = sorted(f for f in os.listdir(dirname)
+                       if f != "manifest.json"
+                       and os.path.isfile(os.path.join(dirname, f)))
+        if not files:
+            return
+        victim = os.path.join(
+            dirname, files[random.Random(self.seed + n).randrange(len(files))])
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(size // 2)
+        return victim
+
+    def rpc_calls(self) -> int:
+        with self._lock:
+            return self._rpc_n
+
+
+_cached: Optional[FaultSchedule] = None
+_cached_spec: Optional[str] = None
+_cache_lock = threading.Lock()
+
+
+def schedule() -> Optional[FaultSchedule]:
+    """The process's active schedule (parsed from FLAGS_chaos), or None.
+    Cached on the spec string; setting a new FLAGS_chaos value resets
+    the counters (a fresh schedule)."""
+    global _cached, _cached_spec
+    spec = flags.flag("chaos", "") or ""
+    if not str(spec).strip():
+        return None
+    spec = str(spec)
+    with _cache_lock:
+        if spec != _cached_spec:
+            _cached = FaultSchedule(spec)
+            _cached_spec = spec
+        return _cached
+
+
+def reset():
+    """Drop the cached schedule (tests: re-arm the same spec string)."""
+    global _cached, _cached_spec
+    with _cache_lock:
+        _cached = None
+        _cached_spec = None
+
+
+# thin call-site wrappers: one None check when chaos is off -------------
+def on_step(step: int):
+    s = schedule()
+    if s is not None:
+        s.on_step(step)
+
+
+def on_rpc(phase: str, op: str = ""):
+    s = schedule()
+    if s is not None:
+        s.on_rpc(phase, op)
+
+
+def on_checkpoint_saved(dirname: str):
+    s = schedule()
+    if s is not None:
+        return s.on_checkpoint_saved(dirname)

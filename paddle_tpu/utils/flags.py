@@ -27,8 +27,17 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_communicator_independent_recv_thread": True,
     "FLAGS_communicator_send_wait_times": 5,
     "FLAGS_communicator_recv_wait_ms": 50,
+    # RPC robustness (reference: flags.cc FLAGS_rpc_deadline /
+    # FLAGS_rpc_retry_times, grpc_client.cc deadline handling): a PS
+    # client call must complete within deadline ms; transport failures
+    # retry up to retry_times with bounded exponential backoff
+    # (backoff_ms * 2^attempt, capped at 2000 ms, +/-50% jitter).
+    # Mutating calls carry an idempotence key so a retry after a lost
+    # reply never double-applies (distributed_ps/update_recorder.py
+    # RequestDeduper).
     "FLAGS_rpc_deadline": 180000,
     "FLAGS_rpc_retry_times": 3,
+    "FLAGS_rpc_retry_backoff_ms": 50,
     "FLAGS_use_pinned_memory": True,
     "FLAGS_seed": 0,
     "FLAGS_enable_unused_var_check": False,
@@ -107,6 +116,11 @@ _DEFAULTS: Dict[str, Any] = {
     # scan-vjp computation instead of the per-iteration host replay
     # loop.  0 restores the lax.while_loop / host-replay path.
     "FLAGS_while_static_scan": True,
+    # deterministic fault injection (utils/chaos.py): a seeded schedule
+    # string — e.g. "seed=7;kill@12;rpc_drop=recv@3;trunc_ckpt@1" —
+    # that kills the rank at a step, drops/delays RPCs and truncates
+    # checkpoint files, reproducibly.  Empty = all hooks are no-ops.
+    "FLAGS_chaos": "",
     # static program verifier gate (framework/verifier.py): snapshot
     # before every IR pass, verify dataflow/registry/layout invariants
     # after, raise a diagnostic naming the pass + op + hazard on

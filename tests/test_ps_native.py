@@ -1,14 +1,34 @@
 """Native binary-framed PS transport (native/ps_table.cpp ps_serve_* —
 the grpc_server.cc analog): data-plane routing, exactness under
-4-trainer concurrency, and JSON-fallback parity.
+4-trainer concurrency, JSON-fallback parity, and (r11) RPC
+retry/backoff with idempotent replay under injected faults.
 """
+import struct
 import threading
+import time
 
 import numpy as np
 import pytest
 
 from paddle_tpu.distributed_ps import runtime
 from paddle_tpu.distributed_ps.service import PSClient, PSServer
+from paddle_tpu.utils import chaos
+from paddle_tpu.utils import flags as _flags
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    saved = dict(_flags._flags)
+    chaos.reset()
+    yield
+    _flags._flags.clear()
+    _flags._flags.update(saved)
+    chaos.reset()
+
+
+def _arm(spec):
+    _flags.set_flags({"chaos": spec, "rpc_retry_backoff_ms": 1})
+    chaos.reset()
 
 
 @pytest.fixture
@@ -128,3 +148,133 @@ def test_rpc_round_trip_counter(server):
     assert cj.rpc_count() > m0
     c.close()
     cj.close()
+
+
+# --------------------------------------------------------------------------
+# r11: RPC retry/backoff + idempotent replay under injected faults
+# --------------------------------------------------------------------------
+def _json_client(server):
+    c = PSClient([server.endpoint])
+    c._data_ports[server.endpoint] = None  # force the JSON control path
+    return c
+
+
+def test_retry_idempotent_push_on_lost_reply(server):
+    """The double-apply trap: the server applies a push but the REPLY
+    is lost.  The retry resends with the same req_id; the server's
+    RequestDeduper acks it without re-applying — the table moves by
+    exactly ONE update, and rpc_count counts ONE completed call."""
+    c = _json_client(server)
+    c.create_dense("w", 8, optimizer="sgd", lr=1.0)
+    c.init_dense("w", np.zeros(8, np.float32))
+    n0, r0 = c.rpc_count(), c.retry_count()
+    _arm("rpc_drop=recv@1")  # next RPC: sent, applied, reply dropped
+    c.push_dense("w", np.ones(8, np.float32))
+    _flags.set_flags({"chaos": ""})
+    chaos.reset()
+    assert c.retry_count() == r0 + 1
+    assert c.rpc_count() == n0 + 1  # one logical RPC despite two attempts
+    np.testing.assert_allclose(c.pull_dense("w"), -np.ones(8))
+    assert len(server.dedup) >= 1
+    c.close()
+
+
+def test_retry_after_dropped_send_applies_once(server):
+    """A request dropped BEFORE it reaches the wire never touched the
+    server: the retry applies it exactly once."""
+    c = _json_client(server)
+    c.create_dense("w", 4, optimizer="sgd", lr=1.0)
+    c.init_dense("w", np.zeros(4, np.float32))
+    _arm("rpc_drop=send@1")
+    c.push_dense("w", np.ones(4, np.float32))
+    _flags.set_flags({"chaos": ""})
+    chaos.reset()
+    assert c.retry_count() == 1
+    np.testing.assert_allclose(c.pull_dense("w"), -np.ones(4))
+    c.close()
+
+
+def test_rpc_deadline_bounds_retries(server):
+    """With every attempt dropped, the call fails within the deadline
+    instead of retrying forever."""
+    c = _json_client(server)
+    c.create_dense("w", 4, optimizer="sgd", lr=1.0)
+    _flags.set_flags({"chaos": "rpc_drop=send:1.0", "rpc_deadline": 300,
+                      "rpc_retry_times": 50, "rpc_retry_backoff_ms": 20})
+    chaos.reset()
+    t0 = time.time()
+    with pytest.raises(ConnectionError):
+        c.pull_dense("w")
+    assert time.time() - t0 < 5.0
+    c.close()
+
+
+def test_barrier_never_retries(server):
+    """Re-entering a barrier after a transport failure would join the
+    NEXT round and corrupt membership accounting — barrier calls must
+    surface the failure instead of retrying."""
+    c = _json_client(server)
+    _arm("rpc_drop=send@1")
+    r0 = c.retry_count()
+    with pytest.raises(ConnectionError):
+        c.barrier(timeout=5.0)
+    assert c.retry_count() == r0
+    c.close()
+
+
+def test_binary_plane_retry_policy(server):
+    """Native data plane: pure reads (pull) retry through transport
+    faults; mutating pushes have no idempotence key on the C++ wire, so
+    they surface the error instead of blind-retrying — and the failed
+    thread's cached socket is dropped, not left poisoned."""
+    c = PSClient([server.endpoint])
+    c.create_dense("w", 4, optimizer="sgd", lr=0.5)
+    c.init_dense("w", np.arange(4, dtype=np.float32))
+    assert c._data_ep(server.endpoint) is not None
+    _arm("rpc_drop=send@1")
+    np.testing.assert_allclose(c.pull_dense("w"),
+                               np.arange(4, dtype=np.float32))
+    assert c._data.n_retries == 1
+    _arm("rpc_drop=send@1")
+    with pytest.raises(ConnectionError):
+        c.push_dense("w", np.ones(4, np.float32))
+    socks = getattr(c._data._tls, "socks", {}) or {}
+    assert not socks, "failed binary socket must be evicted"
+    _flags.set_flags({"chaos": ""})
+    chaos.reset()
+    # the next push reconnects cleanly and applies once
+    c.push_dense("w", np.ones(4, np.float32))
+    np.testing.assert_allclose(c.pull_dense("w"),
+                               np.arange(4, dtype=np.float32) - 0.5)
+    c.close()
+
+
+def test_desynced_json_socket_rebuilt(server, monkeypatch):
+    """A reply that fails to PARSE (stream desync) is not an OSError —
+    the old client kept that socket cached and every later call on it
+    inherited the poison.  Now any mid-transaction failure evicts, and
+    the next call reconnects and works."""
+    import paddle_tpu.distributed_ps.service as svc
+
+    c = _json_client(server)
+    c.create_dense("w", 4, optimizer="sgd", lr=0.5)
+    c.init_dense("w", np.zeros(4, np.float32))
+    ep = server.endpoint
+    s0 = c._socks[ep]
+
+    real = svc._recv_msg
+    state = {"fired": False}
+    me = threading.current_thread()
+
+    def garbled(sock):
+        if threading.current_thread() is me and not state["fired"]:
+            state["fired"] = True
+            raise struct.error("garbled reply frame")
+        return real(sock)
+
+    monkeypatch.setattr(svc, "_recv_msg", garbled)
+    with pytest.raises(struct.error):
+        c.pull_dense("w")  # parse failure: not retryable, but evicts
+    assert c._socks.get(ep) is not s0
+    np.testing.assert_allclose(c.pull_dense("w"), np.zeros(4))
+    c.close()

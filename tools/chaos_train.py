@@ -1,0 +1,341 @@
+#!/usr/bin/env python
+"""chaos_train — kill-and-resume oracle for the fault-tolerance layer.
+
+Runs the same small DP training job three ways and asserts the
+loss trajectories are EXACTLY equal:
+
+1. ``baseline``  — N uninterrupted steps (fresh process);
+2. ``crash``     — same job with sharded async checkpoints every C
+                   steps and ``FLAGS_chaos="kill@K"`` armed: the
+                   process dies with os._exit(137) at step K, mid-run,
+                   no flushing — SIGKILL-faithful;
+3. ``resume``    — a fresh process loads the newest VALID checkpoint
+                   (fleet.load_check_point: manifest-validated,
+                   corrupt checkpoints rejected with fallback) and
+                   trains to step N.
+
+Verification: baseline[i] == crash[i] for every pre-kill step and
+baseline[i] == resume[i] for every replayed/resumed step, bit-for-bit
+(same float64 repr).  Under ``--stage 3`` the per-rank checkpoint
+files must each hold ~1/ndev of the sharded bytes (no gather on save),
+and ``--truncate`` chops the newest checkpoint's data file in half
+after the crash — resume must reject it and fall back to the previous
+checkpoint, still landing the identical trajectory.
+
+Each phase is a REAL separate process (fork-free cold start), so
+resume exactness includes compile, mesh build and scope rehydration.
+
+Usage:
+    python tools/chaos_train.py --quick            # one combo, bounded
+    python tools/chaos_train.py --all              # stages 0-3 x both paths
+    python tools/chaos_train.py --stage 3 --path shard_map --truncate
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+CKPT_ROOT = "ckpts"
+PREFIX = "__paddle_fleet_checkpoint__"
+
+
+# --------------------------------------------------------------------------
+# worker (one phase per process)
+# --------------------------------------------------------------------------
+def _batch(step: int, width: int, n: int = 64):
+    import numpy as np
+
+    rng = np.random.RandomState(1000 + step)  # reader position == step
+    xs = rng.randn(n, width).astype(np.float32)
+    ys = (xs[:, :1] * 2 + 1).astype(np.float32)
+    return xs, ys
+
+
+def run_worker(args) -> int:
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.checkpoint import AsyncCheckpointWriter
+    from paddle_tpu.incubate.fleet.collective import Collective, TrainStatus
+    from paddle_tpu.utils import flags as _flags
+    from dp_comm_stats import build_mlp_dp_program
+
+    _flags.set_flags({"dp_sharding": args.stage})
+    if args.phase == "crash" and args.kill_at >= 0:
+        _flags.set_flags({"chaos": f"seed=7;kill@{args.kill_at}"})
+    from paddle_tpu.utils import chaos
+
+    main, startup, loss = build_mlp_dp_program(
+        n_layers=args.layers, width=args.width, optimizer="adam", lr=0.01,
+        seed=3, transpile=(args.path == "shard_map"))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+
+    fleet = Collective()
+    fleet.main_program = main
+    ckpt_root = os.path.join(args.workdir, CKPT_ROOT)
+    writer = AsyncCheckpointWriter() if args.phase == "crash" else None
+
+    start_step = 0
+    if args.phase == "resume":
+        status = fleet.load_check_point(exe, ckpt_root, main_program=main)
+        assert status is not None, "resume: no loadable checkpoint"
+        start_step = int(status.step_no)
+        assert start_step == int(status.reader_offset)
+        _result(args, {"resume_from": start_step})
+
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    log = open(os.path.join(args.workdir, f"{args.phase}.losses.jsonl"),
+               "a", buffering=1)
+    for step in range(start_step, args.steps):
+        chaos.on_step(step)  # crash phase: os._exit(137) at kill_at
+        xs, ys = _batch(step, args.width)
+        out = exe.run(compiled, feed={"x": xs, "y": ys},
+                      fetch_list=[loss])[0]
+        log.write(json.dumps({"step": step,
+                              "loss": float(np.mean(out))}) + "\n")
+        done = step + 1
+        if (args.phase == "crash" and args.ckpt_every > 0
+                and done % args.ckpt_every == 0):
+            # at most ONE save in flight: drain the previous async save
+            # before enqueuing the next, so a kill can tear only the
+            # newest checkpoint (production cadence — an unbounded
+            # checkpoint queue would also pin device state)
+            writer.wait()
+            fleet.save_check_point(
+                exe, ckpt_root,
+                TrainStatus(epoch_no=0, step_no=done, reader_offset=done),
+                main_program=main, writer=writer)
+    if writer is not None:
+        writer.wait()
+        writer.close()
+    log.close()
+    return 0
+
+
+def _result(args, extra):
+    path = os.path.join(args.workdir, f"{args.phase}.result.json")
+    data = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    data.update(extra)
+    with open(path, "w") as f:
+        json.dump(data, f)
+
+
+# --------------------------------------------------------------------------
+# orchestrator
+# --------------------------------------------------------------------------
+def _spawn(phase: str, cfg: dict, workdir: str, timeout: int,
+           expect_rc=(0,)) -> int:
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", phase,
+           "--workdir", workdir]
+    for k in ("stage", "steps", "kill-at", "ckpt-every", "layers", "width"):
+        cmd += [f"--{k}", str(cfg[k.replace('-', '_')])]
+    cmd += ["--path", cfg["path"]]
+    env = dict(os.environ)
+    env.pop("FLAGS_chaos", None)
+    r = subprocess.run(cmd, cwd=ROOT, env=env, timeout=timeout,
+                       capture_output=True, text=True)
+    if r.returncode not in expect_rc:
+        raise RuntimeError(
+            f"phase {phase!r} exited {r.returncode} (expected "
+            f"{expect_rc}):\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+    return r.returncode
+
+
+def _losses(workdir: str, phase: str) -> dict:
+    out = {}
+    path = os.path.join(workdir, f"{phase}.losses.jsonl")
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    d = json.loads(line)
+                    out[int(d["step"])] = d["loss"]
+    return out
+
+
+def _newest_checkpoints(workdir: str):
+    """(root, sorted committed checkpoint numbers): only dirs whose
+    manifest landed count — the kill often catches the async writer
+    mid-save, leaving a manifest-less dir that load (correctly)
+    ignores, so the orchestrator must ignore it too."""
+    root = os.path.join(workdir, CKPT_ROOT)
+    nos = []
+    for d in os.listdir(root) if os.path.isdir(root) else []:
+        parts = d.split(".")
+        if (len(parts) == 2 and parts[0] == PREFIX and parts[1].isdigit()
+                and os.path.isfile(os.path.join(root, d, "manifest.json"))):
+            nos.append(int(parts[1]))
+    return root, sorted(nos)
+
+
+def run_combo(cfg: dict, workdir: str, timeout: int,
+              truncate: bool = False) -> dict:
+    os.makedirs(workdir, exist_ok=True)
+    _spawn("baseline", cfg, workdir, timeout)
+    _spawn("crash", cfg, workdir, timeout, expect_rc=(137,))
+    report = {"config": dict(cfg), "truncated": None}
+
+    if cfg["stage"] >= 3:
+        # no-gather acceptance: each rank file holds ~1/ndev of the
+        # sharded bytes (replicated leftovers live in common.npz)
+        root, nos = _newest_checkpoints(workdir)
+        assert nos, "crash phase produced no checkpoint"
+        d = os.path.join(root, f"{PREFIX}.{nos[-1]}")
+        ranks = sorted(f for f in os.listdir(d) if f.startswith("rank"))
+        assert len(ranks) == 8, f"expected 8 rank shards, got {ranks}"
+        sizes = [os.path.getsize(os.path.join(d, f)) for f in ranks]
+        report["rank_file_bytes"] = sizes
+        assert max(sizes) <= 2 * min(sizes), sizes
+
+    fallback_step = None
+    if truncate:
+        root, nos = _newest_checkpoints(workdir)
+        assert len(nos) >= 2, \
+            "need >=2 checkpoints to test truncation fallback"
+
+        def _step(no):
+            with open(os.path.join(root, f"{PREFIX}.{no}",
+                                   "manifest.json")) as f:
+                return int(json.load(f)["train"]["step_no"])
+
+        fallback_step = _step(nos[-2])  # where a correct fallback lands
+        assert _step(nos[-1]) > fallback_step
+        d = os.path.join(root, f"{PREFIX}.{nos[-1]}")
+        victim = sorted(f for f in os.listdir(d)
+                        if f.endswith(".npz"))[0]
+        vpath = os.path.join(d, victim)
+        with open(vpath, "r+b") as f:
+            f.truncate(os.path.getsize(vpath) // 2)
+        report["truncated"] = os.path.join(d, victim)
+
+    _spawn("resume", cfg, workdir, timeout)
+
+    base = _losses(workdir, "baseline")
+    crash = _losses(workdir, "crash")
+    resume = _losses(workdir, "resume")
+    with open(os.path.join(workdir, "resume.result.json")) as f:
+        resume_from = json.load(f)["resume_from"]
+    assert len(base) == cfg["steps"], (len(base), cfg["steps"])
+    if truncate:
+        # newest (truncated) checkpoint rejected -> resume restarted
+        # exactly at the PREVIOUS checkpoint's step
+        assert resume_from == fallback_step, (resume_from, fallback_step)
+
+    mismatches = []
+    for step, l in crash.items():
+        if base[step] != l:
+            mismatches.append(("crash", step, base[step], l))
+    for step, l in resume.items():
+        if base[step] != l:
+            mismatches.append(("resume", step, base[step], l))
+    report.update({
+        "resume_from": resume_from,
+        "steps_before_kill": len(crash),
+        "steps_resumed": len(resume),
+        "mismatches": mismatches,
+        "ok": (not mismatches and len(crash) == cfg["kill_at"]
+               and max(resume) == cfg["steps"] - 1),
+    })
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", default=None,
+                    choices=["baseline", "crash", "resume"],
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--phase", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--stage", type=int, default=3)
+    ap.add_argument("--path", default="shard_map",
+                    choices=["pjit", "shard_map"])
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--kill-at", type=int, default=7, dest="kill_at")
+    ap.add_argument("--ckpt-every", type=int, default=2, dest="ckpt_every")
+    ap.add_argument("--layers", type=int, default=3)
+    ap.add_argument("--width", type=int, default=16)
+    ap.add_argument("--truncate", action="store_true",
+                    help="corrupt the newest checkpoint after the crash; "
+                         "resume must fall back to the previous one")
+    ap.add_argument("--quick", action="store_true",
+                    help="one bounded combo (stage 3, shard_map, with "
+                         "truncation fallback) — the tier-1-safe mode")
+    ap.add_argument("--all", action="store_true",
+                    help="sweep stages 0-3 on both DP paths")
+    ap.add_argument("--timeout", type=int,
+                    default=int(os.environ.get("PD_CHAOS_TIMEOUT", 240)),
+                    help="per-phase subprocess bound, seconds")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        args.phase = args.worker
+        return run_worker(args)
+
+    import tempfile
+
+    combos = []
+    if args.all:
+        for path in ("pjit", "shard_map"):
+            for stage in range(4):
+                combos.append((dict(stage=stage, path=path,
+                                    steps=args.steps, kill_at=args.kill_at,
+                                    ckpt_every=args.ckpt_every,
+                                    layers=args.layers, width=args.width),
+                               stage == 3))
+    else:
+        combos.append((dict(stage=args.stage, path=args.path,
+                            steps=args.steps, kill_at=args.kill_at,
+                            ckpt_every=args.ckpt_every, layers=args.layers,
+                            width=args.width),
+                       args.truncate or args.quick))
+
+    reports = []
+    ok = True
+    for cfg, trunc in combos:
+        wd = tempfile.mkdtemp(
+            prefix=f"chaos_{cfg['path']}_s{cfg['stage']}_")
+        rep = run_combo(cfg, wd, args.timeout, truncate=trunc)
+        reports.append(rep)
+        ok &= rep["ok"]
+        if not args.as_json:
+            tag = f"stage={cfg['stage']} path={cfg['path']}"
+            print(f"[{'OK' if rep['ok'] else 'FAIL'}] {tag}: "
+                  f"killed@{cfg['kill_at']}, resumed from "
+                  f"{rep['resume_from']}"
+                  + (", truncated newest -> fallback" if rep["truncated"]
+                     else "")
+                  + (f", rank bytes {rep.get('rank_file_bytes', [None])[0]}"
+                     if "rank_file_bytes" in rep else ""))
+            for m in rep["mismatches"][:5]:
+                print("   mismatch:", m)
+    if args.as_json:
+        print(json.dumps({"ok": ok, "reports": reports}, indent=1))
+    else:
+        print(f"chaos_train: {len(reports)} combo(s), "
+              f"{'all green' if ok else 'FAILURES'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

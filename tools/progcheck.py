@@ -13,6 +13,12 @@ cross-device collective-order ring-deadlock check.
 Usage:
     python tools/progcheck.py prog.json [prog2.json ...]
         [--feed x,y] [--json] [--strict] [--quiet]
+    python tools/progcheck.py --manifest ckpt_dir [ckpt_dir2 ...]
+
+``--manifest`` lints saved sharded checkpoints instead of programs:
+manifest schema, per-file existence/size/crc32 and per-var file
+references (paddle_tpu/checkpoint.py validate) — the same integrity
+pass the resume path runs, exposed for CI over checkpoint stores.
 
 Programs are the JSON produced by ``Program.serialize_to_string()``
 (also what ``save_inference_model`` writes as the model desc).  Exit
@@ -83,14 +89,24 @@ def run(paths, feed_names=(), fetch_names=(), programs=None):
     return diags, per_prog
 
 
+def check_manifests(dirs):
+    """Integrity-lint checkpoint dirs -> {dir: [problems]} ([] = ok)."""
+    from paddle_tpu.checkpoint import validate
+
+    return {d: validate(d) for d in dirs}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("programs", nargs="+",
+    ap.add_argument("programs", nargs="*",
                     help="serialized Program JSON file(s); two or more "
                          "additionally run the cross-device "
                          "collective-order check")
+    ap.add_argument("--manifest", action="store_true",
+                    help="treat the positional args as sharded-checkpoint "
+                         "directories and lint their manifests instead")
     ap.add_argument("--feed", default="",
                     help="comma-separated feed var names (suppresses "
                          "uninitialized-read findings for them)")
@@ -104,6 +120,25 @@ def main(argv=None):
     ap.add_argument("--quiet", action="store_true",
                     help="summary only, no per-finding lines")
     args = ap.parse_args(argv)
+    if not args.programs:
+        ap.error("at least one program file (or --manifest checkpoint "
+                 "dir) is required")
+
+    if args.manifest:
+        results = check_manifests(args.programs)
+        n_bad = sum(bool(p) for p in results.values())
+        if args.as_json:
+            print(json.dumps({"checkpoints": results, "invalid": n_bad},
+                             indent=2))
+        else:
+            for d, problems in results.items():
+                if not args.quiet:
+                    for p in problems:
+                        print(f"{d}: {p}")
+                print(f"{d}: {'INVALID' if problems else 'ok'}")
+            print(f"progcheck: {len(results)} checkpoint(s), "
+                  f"{n_bad} invalid")
+        return 1 if n_bad else 0
 
     feed_names = [n for n in args.feed.split(",") if n]
     fetch_names = [n for n in args.fetch.split(",") if n]
