@@ -233,6 +233,14 @@ class Executor:
     def __init__(self, place: Optional[Place] = None):
         self.place = _get_paddle_place(place)
         self._cache: Dict[tuple, _Compiled] = {}
+        # serializes compilation: predictor clones share one Executor
+        # (inference/predictor.py clone), so two workers' first runs on
+        # the same shapes must not both pay the XLA compile or race the
+        # cache insert; steady-state runs only pay an uncontended
+        # acquire
+        import threading
+
+        self._compile_lock = threading.Lock()
         self._closed = False
 
     def _nhwc_enabled(self) -> bool:
@@ -277,6 +285,11 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _compile(self, program: Program, feed, fetch_names, scope) -> _Compiled:
+        with self._compile_lock:
+            return self._compile_locked(program, feed, fetch_names, scope)
+
+    def _compile_locked(self, program: Program, feed, fetch_names,
+                        scope) -> _Compiled:
         from .utils.flags import flag
 
         check_nan_inf = bool(flag("check_nan_inf"))

@@ -19,10 +19,21 @@ from .predictor import (
 from .export import export_stablehlo, load_ptw, save_ptw
 from . import native_runtime
 from .native_runtime import NativePredictor
+from .kv_cache import KVCacheConfig, PagedKVCache
+from .serving import (
+    DecoderConfig,
+    Request,
+    ServingEngine,
+    StaticBatchingEngine,
+    export_decoder,
+)
 
 __all__ = [
     "AnalysisConfig", "Config", "NativeConfig", "AnalysisPredictor",
     "PaddlePredictor", "PaddleTensor", "ZeroCopyTensor",
     "create_paddle_predictor", "create_predictor", "export_stablehlo",
     "load_ptw", "save_ptw",
+    # serving runtime (r12)
+    "KVCacheConfig", "PagedKVCache", "DecoderConfig", "Request",
+    "ServingEngine", "StaticBatchingEngine", "export_decoder",
 ]

@@ -271,16 +271,29 @@ class AnalysisPredictor:
 
     # -- management ------------------------------------------------------
     def clone(self) -> "AnalysisPredictor":
-        """Per-thread clone sharing weights (reference:
-        analysis_predictor.cc Clone — shares the scope, new executor
-        state).  The compiled XLA executable is shared via jit's global
-        compilation cache, so a clone costs no recompile."""
+        """Per-worker clone sharing weights AND compiled executables
+        (reference: analysis_predictor.cc Clone shares the scope).
+
+        The scope is shared, so the staged device weights are never
+        re-uploaded; the EXECUTOR is shared too, so the clone's runs hit
+        the parent's compile cache (keyed on program uid/version + feed
+        shapes) — a clone costs zero re-trace and zero re-compile
+        (pinned by test_serving).  A fresh Executor here would start an
+        empty cache: jax.jit closures are per-Executor objects, so
+        nothing would be shared and every worker would pay a full XLA
+        compile of the same program.  Each predictor keeps its own IO
+        staging dict + lock; compilation itself is serialized by the
+        shared executor's compile lock.  Concurrent clone runs are safe
+        for inference programs (no donated state: nothing persistable
+        is written, so the shared step session carries no mutable
+        buffers); a program that DOES write persistable state should
+        not be run from concurrent clones."""
         twin = AnalysisPredictor.__new__(AnalysisPredictor)
         twin._config = self._config
         twin._place = self._place
         twin._device = self._device
-        twin._scope = self._scope  # weights shared
-        twin._exe = Executor(self._place)
+        twin._scope = self._scope  # weights shared (staged once)
+        twin._exe = self._exe      # compiled executables shared
         twin._inputs = {}
         twin._outputs = {}
         twin._lock = threading.Lock()

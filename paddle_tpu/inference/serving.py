@@ -1,0 +1,800 @@
+"""Continuous-batching decode serving runtime.
+
+Grows AnalysisPredictor's one-shot run() into a serving engine
+(ROADMAP direction 1, "millions of users" made measurable):
+
+* **Paged KV cache** — inference/kv_cache.py allocator over device pool
+  vars the ``kv_cache_append`` op updates in place (donated buffers:
+  the pool never copies).
+* **Continuous (inflight) batching** — new requests are admitted at
+  EVERY decode step up to a token budget, finished sequences are
+  evicted (pages freed) immediately, and pool exhaustion mid-decode
+  preempts the youngest sequence back to the waiting queue
+  (recompute-on-resume, deterministically).
+* **Ragged paged attention** — the decode program's ``paged_attention``
+  op gathers each query's K/V through its block table at its true
+  length (Pallas kernel on TPU, identical-semantics gather on CPU), so
+  a mixed-length batch never pads to max-seq: feed shapes are bucketed
+  to the longest ACTIVE sequence (pages) and the next batch-size
+  bucket, never to the model maximum.
+
+The hot loop stays device-resident: prefill and decode are ordinary
+Programs run through the Executor's step session — weights and KV
+pools live on device across steps, and the jit cache is bounded by
+shape bucketing (batch sizes and block-table widths are powers of two,
+prompt lengths power-of-two bucketed), so batch composition never
+recompiles.
+
+The decoder model itself is a standard pre-LN transformer LM built
+three ways from ONE layer description: a full-sequence REFERENCE
+program in the naive attention composition (matmul/softmax/matmul —
+what an exported user model looks like; also the one-at-a-time oracle
+the tests pin token-identity against), a PREFILL program (reference
+body + ``kv_cache_append`` of the prompt's K/V, with
+``fuse_multihead_attention_pass`` applied over it — the serving pass
+pipeline), and the paged DECODE program.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import Program
+from ..framework.dtype import VarType
+from ..framework.place import CPUPlace, TPUPlace
+from ..framework.scope import Scope, scope_guard
+from ..executor import Executor
+from .kv_cache import KVCacheConfig, PagedKVCache
+
+__all__ = [
+    "DecoderConfig", "Request", "StepEvent", "ServingEngine",
+    "StaticBatchingEngine", "export_decoder", "load_decoder_config",
+    "build_decoder_program", "init_decoder_weights",
+]
+
+NEG_INF = -1e9  # additive causal-mask value (finite: padded rows stay NaN-free)
+
+
+# ==========================================================================
+# Model description
+# ==========================================================================
+@dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 128
+    hidden: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    ffn_hidden: int = 0          # 0 -> 4 * hidden
+    max_seq_len: int = 256
+    eos_id: int = -1             # -1: no EOS, run to max_new_tokens
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.num_heads
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden or 4 * self.hidden
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in (
+            "vocab_size", "hidden", "num_heads", "num_layers",
+            "ffn_hidden", "max_seq_len", "eos_id")}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DecoderConfig":
+        return cls(**{k: d[k] for k in cls().to_dict() if k in d})
+
+
+def decoder_param_specs(cfg: DecoderConfig) -> Dict[str, tuple]:
+    """name -> shape for every weight var (shared by all three program
+    forms; the decode/prefill builders re-declare the SAME names so one
+    scope serves them all)."""
+    h, f = cfg.hidden, cfg.ffn
+    specs = {
+        "dec_embed": (cfg.vocab_size, h),
+        "dec_pos_embed": (cfg.max_seq_len, h),
+        "dec_lnf_scale": (h,), "dec_lnf_bias": (h,),
+    }
+    for i in range(cfg.num_layers):
+        p = f"dec_l{i}_"
+        specs.update({
+            p + "ln1_scale": (h,), p + "ln1_bias": (h,),
+            p + "wq": (h, h), p + "wk": (h, h), p + "wv": (h, h),
+            p + "wo": (h, h),
+            p + "ln2_scale": (h,), p + "ln2_bias": (h,),
+            p + "w1": (h, f), p + "w2": (f, h),
+        })
+    return specs
+
+
+def init_decoder_weights(cfg: DecoderConfig, seed: int = 0
+                         ) -> Dict[str, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    out = {}
+    for name, shape in decoder_param_specs(cfg).items():
+        if name.endswith("_scale"):
+            out[name] = np.ones(shape, np.float32)
+        elif name.endswith("_bias"):
+            out[name] = np.zeros(shape, np.float32)
+        else:
+            out[name] = (rng.randn(*shape) / np.sqrt(shape[-1])) \
+                .astype(np.float32)
+    return out
+
+
+# ==========================================================================
+# Program builders
+# ==========================================================================
+class _B:
+    """Tiny block-building helper: explicit var names, direct append_op."""
+
+    def __init__(self, program: Program):
+        self.blk = program.global_block()
+        self._n = 0
+
+    def tmp(self, tag: str):
+        self._n += 1
+        return self.blk.create_var(name=f"_srv_{tag}_{self._n}").name
+
+    def feed(self, name, shape, dtype=VarType.FP32):
+        return self.blk.create_var(name=name, shape=shape, dtype=dtype,
+                                   is_data=True).name
+
+    def param(self, name, shape):
+        return self.blk.create_var(name=name, shape=shape,
+                                   persistable=True).name
+
+    def op(self, type, inputs, outputs, attrs=None):
+        self.blk.append_op(type, inputs=inputs, outputs=outputs,
+                           attrs=attrs or {})
+
+    # common composites --------------------------------------------------
+    def matmul(self, x, y, transpose_Y=False, alpha=1.0, tag="mm"):
+        o = self.tmp(tag)
+        self.op("matmul", {"X": [x], "Y": [y]}, {"Out": [o]},
+                {"transpose_X": False, "transpose_Y": transpose_Y,
+                 "alpha": float(alpha)})
+        return o
+
+    def add(self, x, y, tag="add"):
+        o = self.tmp(tag)
+        self.op("elementwise_add", {"X": [x], "Y": [y]}, {"Out": [o]},
+                {"axis": -1})
+        return o
+
+    def reshape(self, x, shape, tag="rs"):
+        o = self.tmp(tag)
+        self.op("reshape2", {"X": [x]}, {"Out": [o]},
+                {"shape": list(shape)})
+        return o
+
+    def transpose(self, x, perm, tag="tr"):
+        o = self.tmp(tag)
+        self.op("transpose2", {"X": [x]}, {"Out": [o]},
+                {"axis": list(perm)})
+        return o
+
+    def layer_norm(self, x, scale, bias, begin, tag="ln"):
+        o = self.tmp(tag)
+        self.op("layer_norm",
+                {"X": [x], "Scale": [scale], "Bias": [bias]},
+                {"Y": [o], "Mean": [self.tmp(tag + "_m")],
+                 "Variance": [self.tmp(tag + "_v")]},
+                {"begin_norm_axis": begin, "epsilon": 1e-5})
+        return o
+
+    def lookup(self, table, ids, tag="emb"):
+        o = self.tmp(tag)
+        self.op("lookup_table_v2", {"W": [table], "Ids": [ids]},
+                {"Out": [o]})
+        return o
+
+    def gelu(self, x):
+        o = self.tmp("gelu")
+        self.op("gelu", {"X": [x]}, {"Out": [o]})
+        return o
+
+
+def build_decoder_program(cfg: DecoderConfig, mode: str) -> tuple:
+    """Build one of the three program forms; returns
+    ``(program, feed_names, fetch_names)``.
+
+    mode="reference": full-sequence next-token program (naive attention
+      composition) — the export form and the one-at-a-time oracle.
+    mode="prefill":   reference body + kv_cache_append of every prompt
+      position's K/V at allocator-assigned slots.
+    mode="decode":    single-token batched step over the paged cache.
+    """
+    if mode not in ("reference", "prefill", "decode"):
+        raise ValueError(f"bad mode {mode!r}")
+    H, D, h = cfg.num_heads, cfg.head_dim, cfg.hidden
+    prog = Program()
+    b = _B(prog)
+    params = {n: b.param(n, s) for n, s in decoder_param_specs(cfg).items()}
+
+    paged = mode == "decode"
+    if paged:
+        tokens = b.feed("tokens", (-1,), VarType.INT32)
+        positions = b.feed("positions", (-1,), VarType.INT32)
+        tables = b.feed("block_tables", (-1, -1), VarType.INT32)
+        ctx_lens = b.feed("context_lens", (-1,), VarType.INT32)
+        slot_map = b.feed("slot_mapping", (-1,), VarType.INT32)
+        feeds = ["tokens", "positions", "block_tables", "context_lens",
+                 "slot_mapping"]
+    else:
+        tokens = b.feed("tokens", (1, -1), VarType.INT32)
+        positions = b.feed("positions", (1, -1), VarType.INT32)
+        mask = b.feed("attn_mask", (1, 1, -1, -1), VarType.FP32)
+        last_index = b.feed("last_index", (1,), VarType.INT32)
+        feeds = ["tokens", "positions", "attn_mask", "last_index"]
+        if mode == "prefill":
+            slot_map = b.feed("slot_mapping", (-1,), VarType.INT32)
+            feeds.append("slot_mapping")
+
+    x = b.lookup("dec_embed", tokens)
+    pos = b.lookup("dec_pos_embed", positions)
+    hid = b.add(x, pos, "h0")
+
+    for i in range(cfg.num_layers):
+        p = f"dec_l{i}_"
+        hn = b.layer_norm(hid, p + "ln1_scale", p + "ln1_bias",
+                          2 if not paged else 1, f"l{i}_ln1")
+        q = b.matmul(hn, p + "wq", tag=f"l{i}_q")
+        k = b.matmul(hn, p + "wk", tag=f"l{i}_k")
+        v = b.matmul(hn, p + "wv", tag=f"l{i}_v")
+        if paged:
+            q3 = b.reshape(q, [0, H, D], f"l{i}_q3")     # (B, H, D)
+            k3 = b.reshape(k, [0, H, D], f"l{i}_k3")
+            v3 = b.reshape(v, [0, H, D], f"l{i}_v3")
+            kc, vc = b.param(f"kv_k_{i}", ()), b.param(f"kv_v_{i}", ())
+            b.op("kv_cache_append",
+                 {"K": [k3], "V": [v3], "SlotMapping": [slot_map],
+                  "KCache": [kc], "VCache": [vc]},
+                 {"KCacheOut": [kc], "VCacheOut": [vc]})
+            att = b.tmp(f"l{i}_att")
+            b.op("paged_attention",
+                 {"Q": [q3], "KCache": [kc], "VCache": [vc],
+                  "BlockTables": [tables], "ContextLens": [ctx_lens]},
+                 {"Out": [att]}, {"scale": float(D ** -0.5)})
+            ctxv = b.reshape(att, [0, h], f"l{i}_ctx")
+        else:
+            # the NAIVE composition on (1, S, h): 4-D q/k/v + the
+            # matmul/softmax/matmul chain fuse_multihead_attention_pass
+            # rewrites to the flash op
+            q4 = b.transpose(b.reshape(q, [0, 0, H, D]), [0, 2, 1, 3],
+                             f"l{i}_q4")
+            k4 = b.transpose(b.reshape(k, [0, 0, H, D]), [0, 2, 1, 3],
+                             f"l{i}_k4")
+            v4 = b.transpose(b.reshape(v, [0, 0, H, D]), [0, 2, 1, 3],
+                             f"l{i}_v4")
+            if mode == "prefill":
+                # the prompt's K/V enter the pool HERE, at allocator
+                # slots; padded bucket positions carry the drop sentinel
+                k3 = b.reshape(k, [-1, H, D], f"l{i}_k3")
+                v3 = b.reshape(v, [-1, H, D], f"l{i}_v3")
+                kc = b.param(f"kv_k_{i}", ())
+                vc = b.param(f"kv_v_{i}", ())
+                b.op("kv_cache_append",
+                     {"K": [k3], "V": [v3], "SlotMapping": [slot_map],
+                      "KCache": [kc], "VCache": [vc]},
+                     {"KCacheOut": [kc], "VCacheOut": [vc]})
+            s = b.matmul(q4, k4, transpose_Y=True, alpha=D ** -0.5,
+                         tag=f"l{i}_qk")
+            s = b.add(s, mask, f"l{i}_masked")
+            sm = b.tmp(f"l{i}_probs")
+            b.op("softmax", {"X": [s]}, {"Out": [sm]}, {"axis": -1})
+            av = b.matmul(sm, v4, tag=f"l{i}_av")
+            ctxv = b.reshape(b.transpose(av, [0, 2, 1, 3]), [0, 0, h],
+                             f"l{i}_ctx")
+        hid = b.add(hid, b.matmul(ctxv, p + "wo", tag=f"l{i}_o"),
+                    f"l{i}_res1")
+        hn2 = b.layer_norm(hid, p + "ln2_scale", p + "ln2_bias",
+                           2 if not paged else 1, f"l{i}_ln2")
+        ff = b.matmul(b.gelu(b.matmul(hn2, p + "w1", tag=f"l{i}_ff1")),
+                      p + "w2", tag=f"l{i}_ff2")
+        hid = b.add(hid, ff, f"l{i}_res2")
+
+    if not paged:
+        # last REAL position's hidden row (feed-indexed: bucket padding
+        # never reaches the logits)
+        h2d = b.reshape(hid, [-1, h], "hflat")
+        hid = b.tmp("hlast")
+        b.op("gather", {"X": [h2d], "Index": [last_index]},
+             {"Out": [hid]}, {"axis": 0})
+    hf = b.layer_norm(hid, "dec_lnf_scale", "dec_lnf_bias", 1, "lnf")
+    logits = b.matmul(hf, "dec_embed", transpose_Y=True, tag="logits")
+    out_name = "next_tokens" if paged else "next_token"
+    out = b.blk.create_var(name=out_name, dtype=VarType.INT64).name
+    b.op("arg_max", {"X": [logits]}, {"Out": [out]},
+         {"axis": -1, "keepdims": False, "flatten": False})
+    prog._srv_params = params  # introspection/debug
+    return prog, feeds, [out_name]
+
+
+# ==========================================================================
+# Export / load ("the converted decoder")
+# ==========================================================================
+def export_decoder(model_dir: str, cfg: DecoderConfig, seed: int = 0,
+                   weights: Optional[Dict[str, np.ndarray]] = None) -> None:
+    """Export the decoder in its REFERENCE form (naive attention
+    composition — what a converted/exported user model looks like) plus
+    a ``decoder.json`` sidecar so the serving engine can rebuild the
+    prefill/decode forms around the same weights."""
+    prog, feeds, fetches = build_decoder_program(cfg, "reference")
+    scope = Scope()
+    for name, arr in (weights or init_decoder_weights(cfg, seed)).items():
+        scope.set(name, arr)
+    exe = Executor(CPUPlace())
+    from .. import io as pt_io
+
+    with scope_guard(scope):
+        pt_io.save_inference_model(
+            model_dir, feeds, [prog.global_block().var(fetches[0])], exe,
+            main_program=prog)
+    with open(os.path.join(model_dir, "decoder.json"), "w") as f:
+        json.dump(cfg.to_dict(), f)
+
+
+def load_decoder_config(model_dir: str) -> DecoderConfig:
+    with open(os.path.join(model_dir, "decoder.json")) as f:
+        return DecoderConfig.from_dict(json.load(f))
+
+
+# ==========================================================================
+# Requests / events
+# ==========================================================================
+@dataclass
+class Request:
+    req_id: object
+    prompt: List[int]
+    max_new_tokens: int
+    arrival_time: float = 0.0
+    # filled by the engine
+    out_tokens: List[int] = field(default_factory=list)
+    admitted_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    preemptions: int = 0
+
+
+@dataclass(frozen=True)
+class StepEvent:
+    req_id: object
+    token: int
+    finished: bool
+    time: float
+
+
+@dataclass
+class _SeqState:
+    req: Request
+    last_token: int = 0
+
+
+def _pow2_bucket(n: int, lo: int = 1, hi: Optional[int] = None) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi) if hi is not None else b
+
+
+_MASK_CACHE: Dict[int, np.ndarray] = {}
+
+
+def _causal_mask(s: int) -> np.ndarray:
+    # memoized per bucket: prefill and the oracle loop re-feed the same
+    # handful of pow2 sizes thousands of times on the hot path
+    m = _MASK_CACHE.get(s)
+    if m is None:
+        m = np.triu(np.full((s, s), NEG_INF, np.float32), k=1)[None, None]
+        _MASK_CACHE[s] = m
+    return m
+
+
+def _worst_case_pages(req: Request, kv_config: KVCacheConfig) -> int:
+    total = len(req.prompt) + req.max_new_tokens
+    return -(-total // kv_config.page_size)
+
+
+def _reject_unservable(req: Request, cfg: DecoderConfig,
+                       kv_config: KVCacheConfig):
+    """Shared submit-time gate: a request that cannot complete even
+    with the whole pool to itself would hang any scheduler (prefill
+    backpressure forever, or a preempt loop)."""
+    total = len(req.prompt) + req.max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"request {req.req_id!r}: prompt+max_new_tokens "
+            f"{len(req.prompt)}+{req.max_new_tokens} exceeds "
+            f"max_seq_len {cfg.max_seq_len}")
+    if _worst_case_pages(req, kv_config) > kv_config.num_pages:
+        raise ValueError(
+            f"request {req.req_id!r} needs more KV pages than the "
+            f"whole pool holds ({total} tokens, "
+            f"{kv_config.num_pages} pages of {kv_config.page_size})")
+
+
+class _EngineCore:
+    """Programs + scope + executor + KV pools, shared by the continuous
+    and static drivers (one model, two scheduling policies)."""
+
+    def __init__(self, cfg: DecoderConfig, weights: Dict[str, np.ndarray],
+                 num_pages: int = 64, page_size: int = 16,
+                 place=None, use_mha_fusion: bool = True,
+                 prefill_bucket_min: int = 16):
+        self.cfg = cfg
+        if place is None:
+            import paddle_tpu as pt
+
+            place = TPUPlace(0) if pt.is_compiled_with_tpu() else CPUPlace()
+        self.place = place
+        self.scope = Scope()
+        self.exe = Executor(place)
+        self.prefill_bucket_min = prefill_bucket_min
+        self.kv_config = KVCacheConfig(
+            num_pages=num_pages, page_size=page_size,
+            num_kv_heads=cfg.num_heads, head_dim=cfg.head_dim,
+            num_layers=cfg.num_layers)
+        self.kv = PagedKVCache(self.kv_config)
+
+        self.ref_prog, self.ref_feeds, self.ref_fetch = \
+            build_decoder_program(cfg, "reference")
+        self.prefill_prog, self.prefill_feeds, self.prefill_fetch = \
+            build_decoder_program(cfg, "prefill")
+        self.decode_prog, self.decode_feeds, self.decode_fetch = \
+            build_decoder_program(cfg, "decode")
+        self.mha_fused = 0
+        if use_mha_fusion:
+            # the serving pass pipeline: the naive composition the
+            # export carries is rewritten onto the fused attention op
+            # (flash kernel when it engages), verifier-gated like every
+            # pass application
+            from ..framework.ir import get_pass
+
+            for prog in (self.ref_prog, self.prefill_prog):
+                p = get_pass("fuse_multihead_attention_pass")
+                p.apply(prog)
+                self.mha_fused += p.fused_count
+
+        import jax
+
+        dev = place.jax_device()
+        for name, arr in weights.items():
+            self.scope.set(name, jax.device_put(arr, dev))
+        for i in range(cfg.num_layers):
+            self.scope.set(f"kv_k_{i}",
+                           jax.device_put(self.kv_config.make_pool(), dev))
+            self.scope.set(f"kv_v_{i}",
+                           jax.device_put(self.kv_config.make_pool(), dev))
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str, **kw) -> "_EngineCore":
+        cfg = load_decoder_config(model_dir)
+        scope = Scope()
+        exe = Executor(CPUPlace())
+        from .. import io as pt_io
+
+        with scope_guard(scope):
+            pt_io.load_inference_model(model_dir, exe)
+        weights = {n: np.asarray(scope.get(n))
+                   for n in decoder_param_specs(cfg)}
+        return cls(cfg, weights, **kw)
+
+    # -- model steps -------------------------------------------------------
+    def prefill(self, req: Request) -> Optional[int]:
+        """Write the prompt's K/V into the pool and return the first
+        generated token; None when the pool can't hold the prompt
+        (nothing is mutated — admission backpressure)."""
+        L = len(req.prompt)
+        slots = self.kv.append_tokens(req.req_id, L)
+        if slots is None:
+            return None
+        S = _pow2_bucket(L, self.prefill_bucket_min, None)
+        toks = np.zeros((1, S), np.int32)
+        toks[0, :L] = req.prompt
+        pos = np.minimum(np.arange(S, dtype=np.int32),
+                         self.cfg.max_seq_len - 1)[None]
+        slot_map = np.full(S, self.kv_config.pad_slot, np.int32)
+        slot_map[:L] = slots
+        out = self.exe.run(
+            self.prefill_prog,
+            feed={"tokens": toks, "positions": pos,
+                  "attn_mask": _causal_mask(S), "slot_mapping": slot_map,
+                  "last_index": np.array([L - 1], np.int32)},
+            fetch_list=self.prefill_fetch, scope=self.scope)
+        return int(out[0][0])
+
+    def decode_batch(self, states: Sequence[_SeqState]) -> List[int]:
+        """One continuous decode step for ``states`` (each sequence's
+        pending token enters the pool, then attends at its true length).
+        The caller guarantees page capacity.  Feed shapes bucket to the
+        next power of two in batch AND block-table width, so the jit
+        cache is bounded by (log max_batch x log max_pages) shapes."""
+        B = len(states)
+        Bp = _pow2_bucket(max(B, 1))
+        toks = np.zeros(Bp, np.int32)
+        pos = np.zeros(Bp, np.int32)
+        slot_map = np.full(Bp, self.kv_config.pad_slot, np.int32)
+        ctx = np.ones(Bp, np.int32)
+        for i, st in enumerate(states):
+            toks[i] = st.last_token
+            pos[i] = min(self.kv.context_len(st.req.req_id),
+                         self.cfg.max_seq_len - 1)
+            slots = self.kv.append_tokens(st.req.req_id, 1)
+            assert slots is not None, "caller must reserve pages"
+            slot_map[i] = slots[0]
+            ctx[i] = self.kv.context_len(st.req.req_id)
+        W = _pow2_bucket(max(
+            (self.kv.num_pages_of(st.req.req_id) for st in states),
+            default=1))
+        tables = np.zeros((Bp, W), np.int32)
+        for i, st in enumerate(states):
+            tables[i] = self.kv.block_table(st.req.req_id, W)
+        out = self.exe.run(
+            self.decode_prog,
+            feed={"tokens": toks, "positions": pos, "block_tables": tables,
+                  "context_lens": ctx, "slot_mapping": slot_map},
+            fetch_list=self.decode_fetch, scope=self.scope)
+        return [int(out[0][i]) for i in range(B)]
+
+    def reference_next_token(self, seq: Sequence[int]) -> int:
+        """One full-recompute next-token step of the reference program
+        (the one-at-a-time oracle)."""
+        L = len(seq)
+        S = _pow2_bucket(L, self.prefill_bucket_min, None)
+        toks = np.zeros((1, S), np.int32)
+        toks[0, :L] = seq
+        pos = np.minimum(np.arange(S, dtype=np.int32),
+                         self.cfg.max_seq_len - 1)[None]
+        out = self.exe.run(
+            self.ref_prog,
+            feed={"tokens": toks, "positions": pos,
+                  "attn_mask": _causal_mask(S),
+                  "last_index": np.array([L - 1], np.int32)},
+            fetch_list=self.ref_fetch, scope=self.scope)
+        return int(out[0][0])
+
+    def greedy_reference(self, prompt: Sequence[int],
+                         max_new_tokens: int) -> List[int]:
+        seq = list(prompt)
+        outs: List[int] = []
+        for _ in range(max_new_tokens):
+            t = self.reference_next_token(seq)
+            outs.append(t)
+            seq.append(t)
+            if t == self.cfg.eos_id:
+                break
+        return outs
+
+    def _finished(self, req: Request, token: int) -> bool:
+        return (len(req.out_tokens) >= req.max_new_tokens
+                or token == self.cfg.eos_id)
+
+
+class ServingEngine:
+    """Continuous (inflight) batching over one _EngineCore.
+
+    Scheduling is deterministic for a fixed request sequence: FIFO
+    admission in submit order (head-of-line blocking, no reordering),
+    immediate eviction on finish, youngest-first preemption on pool
+    exhaustion — so a seeded trace replays bit-identically (pinned by
+    test)."""
+
+    def __init__(self, cfg: Optional[DecoderConfig] = None,
+                 weights: Optional[Dict[str, np.ndarray]] = None,
+                 model_dir: Optional[str] = None,
+                 max_batch: int = 8, token_budget: int = 256,
+                 seed: int = 0, **core_kw):
+        if model_dir is not None:
+            self.core = _EngineCore.from_model_dir(model_dir, **core_kw)
+        else:
+            if cfg is None:
+                raise ValueError("need cfg or model_dir")
+            self.core = _EngineCore(
+                cfg, weights or init_decoder_weights(cfg, seed), **core_kw)
+        self.cfg = self.core.cfg
+        self.kv = self.core.kv
+        self.max_batch = max_batch
+        self.token_budget = token_budget
+        self.waiting: List[Request] = []
+        self.running: List[_SeqState] = []   # admission order
+        self.stats = {"admitted": 0, "finished": 0, "preempted": 0,
+                      "decode_steps": 0, "prefill_tokens": 0,
+                      "decode_tokens": 0}
+
+    # -- API ---------------------------------------------------------------
+    def submit(self, req: Request):
+        _reject_unservable(req, self.cfg, self.core.kv_config)
+        if len(req.prompt) + 1 > self.token_budget:
+            # admission requires prompt+1 tokens inside the budget; a
+            # larger prompt would head-of-line block the FIFO forever
+            raise ValueError(
+                f"request {req.req_id!r}: prompt of {len(req.prompt)} "
+                f"tokens can never fit token_budget {self.token_budget}")
+        self.waiting.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def step(self, now: float = 0.0) -> List[StepEvent]:
+        """One serving iteration: admit (up to the token budget and
+        pool capacity), prefill the admissions, decode every running
+        sequence once, evict finishes.  Returns this step's emitted
+        tokens."""
+        events: List[StepEvent] = []
+        # --- admission: every decode step takes new work ----------------
+        budget = self.token_budget - len(self.running)
+        while self.waiting and len(self.running) < self.max_batch:
+            req = self.waiting[0]
+            cost = len(req.prompt) + 1
+            if cost > budget:
+                break
+            if not self._admission_fits(req):
+                break  # pool backpressure: retry next step
+            tok = self.core.prefill(req)
+            if tok is None:
+                break  # pool backpressure: retry next step
+            self.waiting.pop(0)
+            budget -= cost
+            req.admitted_at = now if req.admitted_at is None else \
+                req.admitted_at
+            self.stats["admitted"] += 1
+            self.stats["prefill_tokens"] += len(req.prompt)
+            st = _SeqState(req, tok)
+            req.out_tokens.append(tok)
+            if self.core._finished(req, tok):
+                events.append(self._finish(st, tok, now))
+            else:
+                events.append(StepEvent(req.req_id, tok, False, now))
+                self.running.append(st)
+        # --- preemption: decoding adds one token per running seq --------
+        while self.running and not self._can_grow_all():
+            victim = self.running.pop()  # youngest
+            self.kv.free_sequence(victim.req.req_id)
+            victim.req.out_tokens = []
+            victim.req.preemptions += 1
+            self.waiting.insert(0, victim.req)
+            self.stats["preempted"] += 1
+        # --- decode ------------------------------------------------------
+        if self.running:
+            toks = self.core.decode_batch(self.running)
+            self.stats["decode_steps"] += 1
+            self.stats["decode_tokens"] += len(self.running)
+            still = []
+            for st, tok in zip(self.running, toks):
+                st.req.out_tokens.append(tok)
+                st.last_token = tok
+                if self.core._finished(st.req, tok):
+                    events.append(self._finish(st, tok, now))
+                else:
+                    events.append(StepEvent(st.req.req_id, tok, False, now))
+                    still.append(st)
+            self.running = still
+        return events
+
+    def _can_grow_all(self) -> bool:
+        need = sum(self.kv.pages_needed(st.req.req_id, 1)
+                   for st in self.running)
+        return need <= self.kv.num_free_pages
+
+    def _admission_fits(self, req: Request) -> bool:
+        """Admit only when, AFTER the prompt's pages are taken, every
+        running sequence plus the admission can still grow one token —
+        otherwise this step's preemption loop would immediately evict
+        the sequence we just paid a full prefill for (admit/preempt
+        churn repeating the prefill every step)."""
+        L = len(req.prompt)
+        ps = self.core.kv_config.page_size
+        prompt_pages = self.kv.pages_needed(req.req_id, L)
+        growth = sum(self.kv.pages_needed(st.req.req_id, 1)
+                     for st in self.running)
+        if req.max_new_tokens > 1:
+            # the admission's own one-token headroom — but a request
+            # that finishes AT prefill (max_new <= 1: prefill itself
+            # emits its only token) never decodes, so demanding growth
+            # room for it would livelock a prompt that exactly fills
+            # its page budget
+            growth += -(-(L + 1) // ps) - -(-L // ps)
+        return prompt_pages + growth <= self.kv.num_free_pages
+
+    def _finish(self, st: _SeqState, tok: int, now: float) -> StepEvent:
+        self.kv.free_sequence(st.req.req_id)
+        st.req.finished_at = now
+        self.stats["finished"] += 1
+        return StepEvent(st.req.req_id, tok, True, now)
+
+    def run_to_completion(self, now: float = 0.0) -> List[StepEvent]:
+        events = []
+        while self.has_work():
+            events.extend(self.step(now))
+        return events
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int) -> List[List[int]]:
+        """Convenience batch API: submit everything, drain, return each
+        prompt's generated tokens in submit order."""
+        reqs = [Request(i, list(p), max_new_tokens)
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            self.submit(r)
+        self.run_to_completion()
+        return [r.out_tokens for r in reqs]
+
+
+class StaticBatchingEngine:
+    """The A/B baseline: fixed batches run to FULL completion before
+    the next batch forms — no admission mid-decode, stragglers hold
+    their batch slots.  Shares the _EngineCore (same model, same
+    kernels); only the policy differs.
+
+    Group formation reserves WORST-CASE pages (prompt + max_new_tokens)
+    per member — the classic static-batching contract — so mid-decode
+    growth can never exhaust the pool (the continuous engine handles
+    that case with preemption; this baseline has no such mechanism)."""
+
+    def __init__(self, core: _EngineCore, batch_size: int = 8):
+        self.core = core
+        self.batch_size = batch_size
+        self.waiting: List[Request] = []
+        self.group: List[_SeqState] = []
+        self._reserved_pages = 0
+        self.stats = {"admitted": 0, "finished": 0, "decode_steps": 0,
+                      "decode_tokens": 0, "prefill_tokens": 0}
+
+    def submit(self, req: Request):
+        _reject_unservable(req, self.core.cfg, self.core.kv_config)
+        self.waiting.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.group)
+
+    def step(self, now: float = 0.0) -> List[StepEvent]:
+        events: List[StepEvent] = []
+        if not self.group:
+            self._reserved_pages = 0
+            while self.waiting and len(self.group) < self.batch_size:
+                req = self.waiting[0]
+                worst = _worst_case_pages(req, self.core.kv_config)
+                if self._reserved_pages + worst \
+                        > self.core.kv_config.num_pages:
+                    break  # group is as large as worst-case capacity allows
+                self._reserved_pages += worst
+                tok = self.core.prefill(req)
+                if tok is None:
+                    break
+                self.waiting.pop(0)
+                req.admitted_at = now
+                self.stats["admitted"] += 1
+                self.stats["prefill_tokens"] += len(req.prompt)
+                st = _SeqState(req, tok)
+                req.out_tokens.append(tok)
+                if self.core._finished(req, tok):
+                    self.core.kv.free_sequence(req.req_id)
+                    req.finished_at = now
+                    self.stats["finished"] += 1
+                    events.append(StepEvent(req.req_id, tok, True, now))
+                else:
+                    events.append(StepEvent(req.req_id, tok, False, now))
+                    self.group.append(st)
+            return events
+        toks = self.core.decode_batch(self.group)
+        self.stats["decode_steps"] += 1
+        self.stats["decode_tokens"] += len(self.group)
+        still = []
+        for st, tok in zip(self.group, toks):
+            st.req.out_tokens.append(tok)
+            st.last_token = tok
+            if self.core._finished(st.req, tok):
+                self.core.kv.free_sequence(st.req.req_id)
+                st.req.finished_at = now
+                self.stats["finished"] += 1
+                events.append(StepEvent(st.req.req_id, tok, True, now))
+            else:
+                events.append(StepEvent(st.req.req_id, tok, False, now))
+                still.append(st)
+        self.group = still
+        return events

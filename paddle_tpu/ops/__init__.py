@@ -37,3 +37,4 @@ from . import py_func_op  # noqa: F401
 from . import compat_ops  # noqa: F401
 from . import long_tail_ops  # noqa: F401
 from . import parity_ops  # noqa: F401
+from . import paged_ops  # noqa: F401

@@ -1,0 +1,73 @@
+"""Paged-KV serving ops: the decode path of the serving runtime.
+
+Two ops, shared by the continuous-batching engine
+(inference/serving.py) over the pools the paged allocator
+(inference/kv_cache.py) manages:
+
+* ``kv_cache_append`` — scatter this step's new K/V vectors into the
+  preallocated device pools at allocator-assigned flat slots.  In-place
+  on the pool vars (output name == input name, the registry's in-place
+  convention), so under buffer donation the update is a
+  dynamic-update-slice in HBM — the pool is never copied.
+* ``paged_attention`` — each decode query gathers K/V through its block
+  table at its true length (ops/pallas_kernels.py: Pallas kernel on
+  TPU, gather fallback on CPU with identical semantics).
+
+Both are serving-only (``no_grad``): the KV cache is inference state,
+not a differentiable activation.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op
+from .pallas_kernels import paged_attention as _paged_attention_impl
+
+
+@op("kv_cache_append", no_grad=True)
+def _kv_cache_append(ctx):
+    """Inputs: K/V ``(num_tokens, kv_heads, head_dim)`` — this step's new
+    keys/values (decode: one per sequence; prefill: one per prompt
+    token); SlotMapping ``(num_tokens,)`` int32 flat pool slots
+    (``page_id * page_size + offset``) from the allocator — an
+    out-of-range slot (``num_pages * page_size``, the allocator's pad
+    sentinel) drops the write, so bucket-padded positions never touch
+    the pool; KCache/VCache ``(kv_heads, num_pages, page_size,
+    head_dim)`` pools.  Outputs KCacheOut/VCacheOut alias the pool vars
+    (in-place update)."""
+    k = ctx.in_("K")
+    v = ctx.in_("V")
+    slots = ctx.in_("SlotMapping").astype(jnp.int32)
+    k_pool = ctx.in_("KCache")
+    v_pool = ctx.in_("VCache")
+    n_kv, n_pages, page_size, d = k_pool.shape
+
+    def scatter(pool, new):
+        flat = pool.reshape(n_kv, n_pages * page_size, d)
+        # (tokens, kv_heads, d) -> (kv_heads, tokens, d); 'drop' makes
+        # the pad sentinel (== n_pages * page_size) a no-op
+        flat = flat.at[:, slots, :].set(
+            new.astype(pool.dtype).transpose(1, 0, 2), mode="drop")
+        return flat.reshape(pool.shape)
+
+    ctx.set_out("KCacheOut", scatter(k_pool, k))
+    ctx.set_out("VCacheOut", scatter(v_pool, v))
+
+
+@op("paged_attention", no_grad=True)
+def _paged_attention(ctx):
+    """Inputs: Q ``(num_seqs, q_heads, head_dim)`` (one decode token per
+    sequence), KCache/VCache pools, BlockTables ``(num_seqs,
+    pages_per_seq)`` int32 (bucketed to the longest ACTIVE sequence —
+    never the model max; pad rows/entries with page 0), ContextLens
+    ``(num_seqs,)`` int32 true lengths including the current token.
+    Attr: scale (0 -> 1/sqrt(head_dim)).  Out: ``(num_seqs, q_heads,
+    head_dim)``."""
+    q = ctx.in_("Q")
+    k_pool = ctx.in_("KCache")
+    v_pool = ctx.in_("VCache")
+    tables = ctx.in_("BlockTables").astype(jnp.int32)
+    lens = ctx.in_("ContextLens").astype(jnp.int32)
+    scale = ctx.attr("scale", 0.0) or None
+    ctx.set_out("Out", _paged_attention_impl(q, k_pool, v_pool, tables,
+                                             lens, scale))

@@ -756,3 +756,166 @@ def flash_attention_bwd_res(q, k, v, out, lse, do, bias=None, causal=False,
                          "have produced an lse residual")
     return _flash_bwd(q, k, v, bias, out, lse, do, scale, causal,
                       blocks[0], blocks[1], dropout_rate, seed)
+
+
+# ==========================================================================
+# Ragged paged attention (decode) — the serving-runtime kernel
+# ==========================================================================
+# KV pools are laid out ``(kv_heads, num_pages, page_size, head_dim)``:
+# head-major so each (seq, head, page) grid step reads one contiguous
+# (page_size, head_dim) tile, page-granular so the serving allocator
+# (inference/kv_cache.py) can hand pages to sequences in any order.
+# Each decode query attends at its TRUE length: the grid walks only
+# ``block_tables.shape[1]`` pages (the scheduler buckets that to the
+# longest ACTIVE sequence, never the model max), whole pages past
+# ``context_lens[b]`` are skipped before their tiles are touched, and
+# the tail page masks per-token — mixed-length batches never pad to
+# max-seq (Ragged Paged Attention, arXiv 2604.15464).
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables,
+                              context_lens, scale=None):
+    """Dense gather oracle AND the CPU fallback — exactly the kernel's
+    semantics, so tier-1 exercises the same op contract.
+
+    q: (num_seqs, q_heads, head_dim) — one decode token per sequence.
+    k_pages/v_pages: (kv_heads, num_pages, page_size, head_dim) pools.
+    block_tables: (num_seqs, pages_per_seq) int32 — pool page ids, in
+    sequence order; entries past the sequence's last page must hold any
+    valid page id (the scheduler pads with 0) — they are masked out.
+    context_lens: (num_seqs,) int32 true lengths (including the current
+    token, whose K/V must already be in the pool).
+    GQA: q_heads must be a multiple of kv_heads; query head h reads kv
+    head ``h // (q_heads // kv_heads)``.
+    """
+    n_seqs, n_heads, d = q.shape
+    n_kv, _, page_size, _ = k_pages.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    group = n_heads // n_kv
+    flat = block_tables.reshape(-1)
+    # (kv_heads, seqs, pages*page_size, d) — sized by the BUCKETED table
+    # width (longest active sequence), not the model max
+    k = jnp.take(k_pages, flat, axis=1).reshape(
+        n_kv, n_seqs, -1, d)
+    v = jnp.take(v_pages, flat, axis=1).reshape(
+        n_kv, n_seqs, -1, d)
+    k = jnp.repeat(k, group, axis=0).transpose(1, 0, 2, 3)
+    v = jnp.repeat(v, group, axis=0).transpose(1, 0, 2, 3)
+    s = jnp.einsum("bhd,bhkd->bhk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    pos = lax.broadcasted_iota(jnp.int32, (n_seqs, 1, s.shape[-1]), 2)
+    s = jnp.where(pos < context_lens[:, None, None], s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bhkd->bhd", p.astype(v.dtype), v).astype(q.dtype)
+
+
+def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, scale, page_size,
+                         n_pages):
+    """One (seq, head, page) step of the ragged decode walk: online
+    softmax over the page's (page_size, d) K/V tile, accumulated in VMEM
+    scratch exactly like the flash kernel's kv walk."""
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    ctx = cl_ref[pl.program_id(0)]
+    start = i * page_size
+
+    @pl.when(start < ctx)
+    def _page():
+        q = q_ref[0]                                   # (1, d)
+        k = k_ref[0, 0]                                # (page_size, d)
+        v = v_ref[0, 0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        cols = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < ctx, s, DEFAULT_MASK_VALUE)
+        m_prev = m_scr[...]                            # (1, 128) lane-bcast
+        l_prev = l_scr[...]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, :1])
+        l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_next
+
+    @pl.when(i == n_pages - 1)
+    def _done():
+        l_fin = l_scr[...]
+        l_safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        o_ref[0] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
+
+
+def _paged_decode_call(q, k_pages, v_pages, block_tables, context_lens,
+                       scale):
+    n_seqs, n_heads, d = q.shape
+    n_kv, _, page_size, _ = k_pages.shape
+    group = n_heads // n_kv
+    n_pages = block_tables.shape[1]
+
+    def _q_idx(b, h, i, bt, cl):
+        return (b, h, 0)
+
+    def _kv_idx(b, h, i, bt, cl):
+        # the page to stream is data-dependent: the block table is a
+        # scalar-prefetch arg, so the index map reads it before the body
+        return (h // group, bt[b, i], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_seqs, n_heads, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), _q_idx),
+            pl.BlockSpec((1, 1, page_size, d), _kv_idx),
+            pl.BlockSpec((1, 1, page_size, d), _kv_idx),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), _q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((1, LANES), jnp.float32),
+            pltpu.VMEM((1, LANES), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale,
+                          page_size=page_size, n_pages=n_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    scale=None):
+    """Ragged paged attention for decode (one query token per sequence).
+
+    Shapes as in :func:`paged_attention_reference`.  Takes the Pallas
+    kernel on TPU (or under PT_PALLAS_INTERPRET=1); PT_PAGED_ATTENTION=0
+    forces the gather fallback, =1 forces the kernel past the backend
+    check (combine with PT_PALLAS_INTERPRET=1 off-TPU — a forced kernel
+    on plain CPU fails loudly rather than silently measuring the
+    fallback).  Hard shape constraints always gate: head_dim and
+    page_size multiples of 8 (sublane), q_heads a multiple of kv_heads;
+    anything else falls back."""
+    n_seqs, n_heads, d = q.shape
+    n_kv = k_pages.shape[0]
+    page_size = k_pages.shape[2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    force = os.environ.get("PT_PAGED_ATTENTION")
+    shape_ok = (d % 8 == 0 and page_size % 8 == 0 and n_heads % n_kv == 0)
+    eligible = shape_ok and (_use_pallas() or force == "1")
+    if force == "0" or not eligible:
+        return paged_attention_reference(q, k_pages, v_pages, block_tables,
+                                         context_lens, scale)
+    return _paged_decode_call(q, k_pages, v_pages, block_tables,
+                              context_lens, scale)

@@ -11,7 +11,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.mark.parametrize("script", ["train_resnet_static.py",
                                     "train_bert_dygraph.py",
                                     "train_wide_deep_ps.py",
-                                    "convert_decoder_d2s.py"])
+                                    "convert_decoder_d2s.py",
+                                    "serve_decoder_lm.py"])
 def test_example_tiny_smoke(script):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

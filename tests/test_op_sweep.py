@@ -967,6 +967,11 @@ COVERED_ELSEWHERE = {
     "dgc": "test_dgc", "dgc_momentum": "test_dgc",
     # fused / pallas — tests/test_pallas_attention.py
     "fused_multihead_attention": "test_pallas_attention",
+    # paged-KV serving ops — tests/test_serving.py (scatter/parity/
+    # padding-free oracles; pool-state in/out doesn't fit the one-op
+    # sweep harness)
+    "kv_cache_append": "test_serving",
+    "paged_attention": "test_serving",
     # fused BN(+add)+act — tests/test_fused_bn.py
     "fused_batch_norm_act": "test_fused_bn",
     "fused_bn_add_activation": "test_fused_bn",

@@ -86,32 +86,61 @@ def run_one(model_dir, seq, batch, steps, with_mha_pass):
     for _ in range(3):
         pred.zero_copy_run()
     np.asarray(out_h.copy_to_cpu())
+    # throughput loop UNCHANGED from prior rounds (pipelined dispatches,
+    # one sync at the end) so the ex/s metric stays comparable across
+    # BENCHMARKS.md rounds...
     t0 = time.perf_counter()
     for _ in range(steps):
         pred.zero_copy_run()
     np.asarray(out_h.copy_to_cpu())
     dt = time.perf_counter() - t0
+    # ...latencies from a SEPARATE per-step-synced loop (a sync inside
+    # the timed loop would redefine the throughput number)
+    lats = []
+    for _ in range(steps):
+        s = time.perf_counter()
+        pred.zero_copy_run()
+        np.asarray(out_h.copy_to_cpu())
+        lats.append(time.perf_counter() - s)
     prog_types = [op.type for op in pred.program().global_block().ops]
-    return batch * steps / dt, prog_types.count("fused_multihead_attention")
+    return (batch * steps / dt, lats,
+            prog_types.count("fused_multihead_attention"))
 
 
 def main():
+    from paddle_tpu.utils.loadgen import emit_json, pct
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--json", action="store_true",
+                    help="machine output only (the SERVING_AB= line)")
     args = ap.parse_args()
     with tempfile.TemporaryDirectory() as td:
         model_dir = os.path.join(td, "model")
         export_encoder(model_dir, args.seq)
-        on, n_fused = run_one(model_dir, args.seq, args.batch, args.steps,
-                              True)
-        off, n_off = run_one(model_dir, args.seq, args.batch, args.steps,
-                             False)
+        on, lat_on, n_fused = run_one(model_dir, args.seq, args.batch,
+                                      args.steps, True)
+        off, lat_off, n_off = run_one(model_dir, args.seq, args.batch,
+                                      args.steps, False)
         assert n_fused > 0 and n_off == 0, (n_fused, n_off)
-        print(f"seq={args.seq} b={args.batch}: mha-pass ON {on:.1f} ex/s "
-              f"({n_fused} fused ops) vs OFF {off:.1f} ex/s "
-              f"-> {on / off:.2f}x")
+        if not args.json:
+            print(f"seq={args.seq} b={args.batch}: mha-pass ON {on:.1f} "
+                  f"ex/s ({n_fused} fused ops) vs OFF {off:.1f} ex/s "
+                  f"-> {on / off:.2f}x")
+        # one stable line so the A/B joins the bench trajectory
+        # (same report helpers as tools/serving_bench.py)
+        emit_json("SERVING_AB", {
+            "seq": args.seq, "batch": args.batch, "steps": args.steps,
+            "fused_ops": n_fused,
+            "mha_on_ex_s": round(on, 2), "mha_off_ex_s": round(off, 2),
+            "speedup": round(on / off, 3),
+            "p50_latency_s_on": round(pct(lat_on, 50), 5),
+            "p99_latency_s_on": round(pct(lat_on, 99), 5),
+            "p50_latency_s_off": round(pct(lat_off, 50), 5),
+            "p99_latency_s_off": round(pct(lat_off, 99), 5),
+        })
 
 
 if __name__ == "__main__":
