@@ -664,6 +664,18 @@ def bench_widedeep_host(steps=60, batch=512):
     return _json.loads(line[len("WD="):])
 
 
+def _telemetry_section():
+    """Registry snapshot for the emitted BENCH line (r13): compile
+    counts, step/latency histograms — the observability spine rides the
+    artifact for free.  Never fails a bench."""
+    try:
+        from paddle_tpu.utils import telemetry
+
+        return {"telemetry": telemetry.snapshot()}
+    except Exception:
+        return {}
+
+
 def main():
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "ernie":
@@ -678,13 +690,15 @@ def main():
         )
         print(json.dumps({"metric": "ernie_base_train_tokens_per_sec_per_chip",
                           "value": round(tps, 1), "unit": "tokens/sec",
-                          "vs_baseline": None, **_LAST_STATS}))
+                          "vs_baseline": None, **_LAST_STATS,
+                          **_telemetry_section()}))
         return
     if model == "lenet":
         ips = bench_lenet()
         print(json.dumps({"metric": "lenet_mnist_train_throughput",
                           "value": round(ips, 1), "unit": "images/sec",
-                          "vs_baseline": None, **_LAST_STATS}))
+                          "vs_baseline": None, **_LAST_STATS,
+                          **_telemetry_section()}))
         return
     if model == "lenet_parity":
         diff, dev, cpu = bench_lenet_parity()
@@ -692,7 +706,8 @@ def main():
                           "value": round(diff, 6), "unit": "abs loss diff",
                           "vs_baseline": round(diff / 1e-2, 4),
                           "device_losses": [round(v, 5) for v in dev],
-                          "cpu_losses": [round(v, 5) for v in cpu]}))
+                          "cpu_losses": [round(v, 5) for v in cpu],
+                          **_telemetry_section()}))
         return
     if model == "scaling":
         r = bench_scaling()
@@ -701,7 +716,8 @@ def main():
                           "unit": "abs loss diff",
                           "vs_baseline": round(r["max_absdiff"] / 1e-3, 4),
                           "modes": r.get("modes"),
-                          **predict_ici_scaling()}))
+                          **predict_ici_scaling(),
+                          **_telemetry_section()}))
         return
     if model == "widedeep":
         # stable fields every run (VERDICT r5 Weak #2 / BASELINE metric
@@ -725,7 +741,8 @@ def main():
                                              else None),
                           "host_path_error": host_err,
                           "rtt_per_step": rtt,
-                          **stats}))
+                          **stats,
+                          **_telemetry_section()}))
         return
     bench_cfg = _apply_bench_flags()
     ips = bench_resnet50(
@@ -750,6 +767,7 @@ def main():
         "vs_baseline": round(ips / prev, 3) if prev else None,
         **bench_cfg,
         **_LAST_STATS,
+        **_telemetry_section(),
     }))
 
 

@@ -23,6 +23,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..profiler import RecordEvent
+from ..utils import telemetry as tm
 from .table import DenseTable, SparseTable
 
 
@@ -254,6 +256,9 @@ class PSServer:
         if self.dedup.begin(req_id):
             # first attempt fully applied, its reply was lost: ack
             # without touching state
+            tm.counter("ps_dedup_replays_total",
+                       "mutating RPCs acked from the server deduper "
+                       "(lost-reply retries short-circuited)").inc()
             _send_msg(sock, "ok", meta={"duplicate": True})
             return
         try:
@@ -601,13 +606,22 @@ class _BinaryDataClient:
                 left = (deadline_s - (time.time() - start)
                         if deadline_s else float("inf"))
                 if attempt >= retries or left <= 0:
+                    if left <= 0:
+                        tm.counter(
+                            "ps_rpc_deadline_exceeded_total",
+                            "RPCs abandoned because FLAGS_rpc_deadline "
+                            "expired").inc()
                     raise
                 with self._n_rpc_lock:
                     self.n_retries += 1
+                tm.counter("ps_rpc_retries_total",
+                           "transport-level RPC retries",
+                           labels=("plane",)).labels(plane="binary").inc()
                 _backoff_sleep(attempt, backoff_s, left, self._rng)
                 attempt += 1
 
     def _call_once(self, host, port, op, name, arr1, arr2, chaos):
+        t0 = time.perf_counter()
         s = self._sock(host, port)
         nm = name.encode()
         msg = [struct.pack("<BH", op, len(nm)), nm]
@@ -620,12 +634,13 @@ class _BinaryDataClient:
             msg.append(struct.pack("<Q", a2.size))
             msg.append(a2.tobytes())
         try:
-            chaos.on_rpc("send", f"bin:{op}")
-            s.sendall(b"".join(msg))
-            chaos.on_rpc("recv", f"bin:{op}")
-            status = _recv_exact(s, 1)[0]
-            (n,) = struct.unpack("<Q", _recv_exact(s, 8))
-            payload = _recv_exact(s, n * 4) if n else b""
+            with RecordEvent(f"rpc:bin:{op}", cat="rpc"):
+                chaos.on_rpc("send", f"bin:{op}")
+                s.sendall(b"".join(msg))
+                chaos.on_rpc("recv", f"bin:{op}")
+                status = _recv_exact(s, 1)[0]
+                (n,) = struct.unpack("<Q", _recv_exact(s, 8))
+                payload = _recv_exact(s, n * 4) if n else b""
         except BaseException:
             # evict on ANY mid-transaction failure, not just OSError —
             # a struct/decode error means the stream is desynced too
@@ -636,6 +651,13 @@ class _BinaryDataClient:
                 f"native PS error from {host}:{port} (op {op}, {name!r})")
         with self._n_rpc_lock:
             self.n_rpc += 1
+        opname = f"bin:{op}"
+        tm.counter("ps_rpc_total", "completed client RPC round trips",
+                   labels=("op",)).labels(op=opname).inc()
+        tm.histogram("ps_rpc_latency_s",
+                     "client-observed RPC round-trip seconds",
+                     labels=("op",)).labels(op=opname).observe(
+                         time.perf_counter() - t0)
         return np.frombuffer(payload, np.float32).copy()
 
 
@@ -723,9 +745,17 @@ class PSClient:
                 left = (deadline_s - (time.time() - start)
                         if deadline_s else float("inf"))
                 if attempt >= retries or left <= 0:
+                    if left <= 0:
+                        tm.counter(
+                            "ps_rpc_deadline_exceeded_total",
+                            "RPCs abandoned because FLAGS_rpc_deadline "
+                            "expired").inc()
                     raise
                 with self._lock:
                     self.n_retries += 1
+                tm.counter("ps_rpc_retries_total",
+                           "transport-level RPC retries",
+                           labels=("plane",)).labels(plane="json").inc()
                 _backoff_sleep(attempt, backoff_s, left, self._rng)
                 attempt += 1
 
@@ -736,9 +766,10 @@ class PSClient:
         would poison every later call on this client."""
         from ..utils import chaos
 
+        t0 = time.perf_counter()
         s = self._sock(ep)
         try:
-            with self._lock:
+            with self._lock, RecordEvent(f"rpc:{op}", cat="rpc"):
                 chaos.on_rpc("send", op)
                 _send_msg(s, op, name, meta, arrays)
                 chaos.on_rpc("recv", op)
@@ -756,6 +787,12 @@ class PSClient:
             raise RuntimeError(f"PS error from {ep}: {rmeta}")
         with self._lock:
             self.n_rpc += 1
+        tm.counter("ps_rpc_total", "completed client RPC round trips",
+                   labels=("op",)).labels(op=op).inc()
+        tm.histogram("ps_rpc_latency_s",
+                     "client-observed RPC round-trip seconds",
+                     labels=("op",)).labels(op=op).observe(
+                         time.perf_counter() - t0)
         return rmeta, rarrays
 
     def _ep_for(self, name: str) -> str:

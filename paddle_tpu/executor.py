@@ -22,6 +22,7 @@ XLA so updates are in-place in HBM.
 from __future__ import annotations
 
 import logging
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -105,6 +106,38 @@ class _StateSession:
                 return None
             mut[n] = v
         return mut, self.ro
+
+
+def device_put_owned(value, device):
+    """Stage host state that may later be DONATED.
+
+    ``jax.device_put`` of a 64-byte-aligned numpy array zero-copies on
+    XLA:CPU — the returned device buffer ALIASES the host allocation
+    (alignment is malloc luck, so whether a given array aliases is
+    nondeterministic).  Aliasing is fine for read-only state, but a
+    donated alias hands XLA memory it does not own: after donation the
+    runtime recycles those bytes into its own pool while the numpy
+    side still owns them, and a later allocation silently corrupts
+    whichever live buffer lands on the overlap (surfaced as the r13
+    serving flake — paged-decode K/V corrupted only when other engines
+    had churned the heap).  This helper re-copies through XLA whenever
+    the fast path aliased the host buffer, so the result is always
+    safe to donate; backends whose arrays expose no host pointer (TPU:
+    device_put is a real H2D copy) pass through untouched."""
+    import jax.numpy as jnp
+
+    arr = np.asarray(value)
+    out = jax.device_put(arr, device)
+    try:
+        aliased = out.unsafe_buffer_pointer() == arr.ctypes.data
+    except Exception:
+        # cannot PROVE ownership: on host-memory backends assume the
+        # worst and copy (cheap, staging-time only); accelerator
+        # device_put is a real H2D transfer by construction
+        aliased = getattr(device, "platform", "cpu") == "cpu"
+    if aliased:
+        out = jnp.copy(out)
+    return out
 
 
 def _fetch_name(f) -> str:
@@ -304,15 +337,28 @@ class Executor:
                 for k, v in feed.items()
             )
         )
+        from .utils.cost_model import calibration_version
+
         key = (program._uid, program._version, feed_spec, tuple(fetch_names),
                check_nan_inf, unused_check, ir_passes, donate, nhwc,
                str(flag("fuse_grad_size_in_MB")),
                str(flag("dp_grad_compress", "none")),
                int(flag("dp_sharding") or 0), bool(flag("dp_comm_overlap")),
-               bool(flag("while_static_scan")))
+               bool(flag("while_static_scan")),
+               # a new measured profile can move autotuned bucket
+               # boundaries — stale compilations must not be reused
+               calibration_version())
+        from .utils import telemetry as tm
+
         hit = self._cache.get(key)
         if hit is not None:
+            tm.counter("executor_compile_cache_hits_total",
+                       "Executor._compile cache hits").inc()
             return hit
+        tm.counter("executor_compile_cache_misses_total",
+                   "Executor._compile cache misses (fresh trace+jit "
+                   "construction)").inc()
+        build_t0 = time.perf_counter()
 
         program = self._apply_ir_passes(program, fetch_names)
         from .framework import verifier
@@ -456,6 +502,12 @@ class Executor:
             compiled.hybrid = True
             compiled.feed_plan = feed_plan
             self._cache[key] = compiled
+            tm.histogram(
+                "executor_compile_build_s",
+                "IR-pipeline + trace/jit construction seconds per cache "
+                "miss (XLA compilation itself is lazy: it lands in the "
+                "first step's executor_step_s)").observe(
+                    time.perf_counter() - build_t0)
             return compiled
 
         # Donate only buffers that are both read and re-written (params,
@@ -507,6 +559,12 @@ class Executor:
         compiled.readonly = tuple(readonly)
         compiled.feed_plan = feed_plan
         self._cache[key] = compiled
+        tm.histogram(
+            "executor_compile_build_s",
+            "IR-pipeline + trace/jit construction seconds per cache "
+            "miss (XLA compilation itself is lazy: it lands in the "
+            "first step's executor_step_s)").observe(
+                time.perf_counter() - build_t0)
         return compiled
 
     # ------------------------------------------------------------------
@@ -571,6 +629,9 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _execute(self, compiled, feed, fetch_names, scope, return_numpy, program):
+        from .utils import telemetry as tm
+
+        step_t0 = time.perf_counter()
         device = self.place.jax_device()
 
         # ---- feed conversion: plan precomputed at compile time (dtype
@@ -584,6 +645,7 @@ class Executor:
         plan = compiled.feed_plan or {}
         hybrid = compiled.hybrid
         feed_vals = {}
+        n_feed_conv = 0
         for k, v in feed.items():
             if isinstance(v, LoDTensor):
                 v = v.value()
@@ -597,12 +659,13 @@ class Executor:
             want = plan.get(k)
             if want is not None and arr.dtype != want:
                 arr = arr.astype(want)
+                n_feed_conv += 1
             # hybrid (PS) programs: keep feeds host-side — host ops (e.g.
             # distributed_lookup_table reading feed ids) then cost no D2H
             # round-trip; jit segments device_put what they consume
             feed_vals[k] = arr if hybrid else jax.device_put(arr, device)
 
-        def state_val(name):
+        def state_val(name, donated=False):
             if name == RNG_VAR:
                 val = scope.get(RNG_VAR)
                 if val is None:
@@ -622,7 +685,11 @@ class Executor:
             if isinstance(val, LoDTensor):
                 val = val.numpy()
             if isinstance(val, np.ndarray):
-                val = jax.device_put(val, device)
+                # donated bindings must be XLA-owned: a zero-copy
+                # device_put alias must never be donated (see
+                # device_put_owned)
+                val = device_put_owned(val, device) if donated \
+                    else jax.device_put(val, device)
             return val
 
         from .profiler import RecordEvent
@@ -647,8 +714,16 @@ class Executor:
                     mut, ro = bound
                 else:
                     if sess is not None:
-                        compiled.session = None  # stale — drop promptly
-                    mut = {n: state_val(n) for n in compiled.donatable}
+                        # stale — drop promptly (an external scope write
+                        # invalidated the device-resident binding)
+                        compiled.session = None
+                        tm.counter(
+                            "executor_step_session_invalidations_total",
+                            "step sessions dropped because the scope was "
+                            "mutated outside the executor's own "
+                            "writeback").inc()
+                    mut = {n: state_val(n, donated=True)
+                           for n in compiled.donatable}
                     ro = {n: state_val(n) for n in compiled.readonly}
                 fetched, new_state = compiled.fn(mut, ro, feed_vals)
         scope_set = scope.set
@@ -672,6 +747,16 @@ class Executor:
                     mut_refs, ro)
         elif not hybrid:
             compiled.session = None
+
+        if n_feed_conv:
+            tm.counter("executor_feed_conversions_total",
+                       "feed arrays cast to the program dtype on the "
+                       "step path (stage the right dtype to avoid "
+                       "the copy)").inc(n_feed_conv)
+        tm.histogram("executor_step_s",
+                     "Executor.run wall seconds (host dispatch; device "
+                     "work may still be in flight — fetches are "
+                     "lazy)").observe(time.perf_counter() - step_t0)
 
         if fetch_names:
             if return_numpy:

@@ -1892,12 +1892,16 @@ class FuseAllReducePass(Pass):
         restricted to same-key, placement-safe buckets.  Returns None
         (caller falls back to the fixed-threshold greedy) when no valid
         partition exists."""
-        from ..utils.cost_model import (CostModel, backward_timeline,
-                                        collective_time_s)
+        from ..utils.cost_model import (backward_timeline,
+                                        collective_time_s,
+                                        default_cost_model)
 
         if not entries:
             return None
-        cm = self.cost_model or CostModel()
+        # no explicit override: start from the measured profile when the
+        # profiler has recorded one (r13 calibration loop) — the same
+        # rates tools/dp_comm_stats models with
+        cm = self.cost_model or default_cost_model(ops, block)
         times, _ = backward_timeline(ops, block, cm)
         ready = [times[e["anchor"]] if e["anchor"] >= 0 else 0.0
                  for e in entries]

@@ -111,6 +111,21 @@ class PagedKVCache:
     def can_append(self, seq_id, n_tokens: int) -> bool:
         return self.pages_needed(seq_id, n_tokens) <= len(self._free)
 
+    def _publish_gauges(self):
+        """Pool state -> telemetry registry (r13): the gauges mirror
+        what ``stats()`` computes, updated at every allocator mutation
+        so a mid-run snapshot is never stale."""
+        from ..utils import telemetry as tm
+
+        tm.gauge("kv_pool_pages_in_use",
+                 "KV pages currently owned by live sequences").set(
+                     self.pages_in_use)
+        tm.gauge("kv_pool_utilization",
+                 "fraction of KV pool pages in use").set(self.utilization())
+        tm.gauge("kv_pool_fragmentation",
+                 "fraction of owned KV slots holding no token "
+                 "(tail-of-page waste)").set(self.fragmentation())
+
     # -- lifecycle ---------------------------------------------------------
     def append_tokens(self, seq_id, n_tokens: int) -> Optional[np.ndarray]:
         """Reserve slots for n_tokens appended to seq_id (creating it on
@@ -126,12 +141,20 @@ class PagedKVCache:
             s.pages.append(self._free.popleft())
             self.alloc_count += 1
         self.peak_pages = max(self.peak_pages, self.pages_in_use)
+        if need:
+            from ..utils import telemetry as tm
+
+            tm.counter("kv_pool_pages_alloc_total",
+                       "KV pages handed out").inc(need)
         ps = self.config.page_size
         slots = np.empty(n_tokens, np.int32)
         for j in range(n_tokens):
             pos = s.length + j
             slots[j] = s.pages[pos // ps] * ps + pos % ps
         s.length += n_tokens
+        # after the length update, and on EVERY append (a within-page
+        # append changes fragmentation too)
+        self._publish_gauges()
         return slots
 
     def free_sequence(self, seq_id):
@@ -141,6 +164,12 @@ class PagedKVCache:
             return
         self._free.extend(s.pages)
         self.free_count += len(s.pages)
+        if s.pages:
+            from ..utils import telemetry as tm
+
+            tm.counter("kv_pool_pages_freed_total",
+                       "KV pages returned to the pool").inc(len(s.pages))
+            self._publish_gauges()
 
     # -- views for the decode step ----------------------------------------
     def context_len(self, seq_id) -> int:

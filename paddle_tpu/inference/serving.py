@@ -48,6 +48,8 @@ from ..framework.dtype import VarType
 from ..framework.place import CPUPlace, TPUPlace
 from ..framework.scope import Scope, scope_guard
 from ..executor import Executor
+from ..profiler import RecordEvent, instant_event, is_profiler_enabled
+from ..utils import telemetry as tm
 from .kv_cache import KVCacheConfig, PagedKVCache
 
 __all__ = [
@@ -359,6 +361,9 @@ class Request:
     admitted_at: Optional[float] = None
     finished_at: Optional[float] = None
     preemptions: int = 0
+    # telemetry: previous emit time of the CURRENT run (reset with
+    # out_tokens on preemption, matching loadgen's final-run accounting)
+    _tm_last: Optional[float] = field(default=None, repr=False)
 
 
 @dataclass(frozen=True)
@@ -373,6 +378,29 @@ class StepEvent:
 class _SeqState:
     req: Request
     last_token: int = 0
+
+
+def _observe_token(req: Request, now: float):
+    """Per-token latency into the registry, with loadgen's exact
+    convention (utils/loadgen.py latency_report): every token's gap
+    from the previous one, the FIRST token's gap measured from arrival
+    — that first gap is also the TTFT observation.  After a preemption
+    ``out_tokens`` (and ``_tm_last``) reset, so only the final run's
+    tokens are observed from a fresh arrival baseline; histograms match
+    loadgen's percentiles exactly on preemption-free traces (pinned by
+    test) and approximately otherwise (loadgen retroactively drops the
+    evicted run's tokens, an online observer cannot)."""
+    first = len(req.out_tokens) == 1
+    prev = req.arrival_time if first or req._tm_last is None \
+        else req._tm_last
+    gap = max(now - prev, 0.0)
+    tm.histogram("serving_token_latency_s",
+                 "per-token latency (inter-token gap; first token from "
+                 "arrival)").observe(gap)
+    if first:
+        tm.histogram("serving_ttft_s",
+                     "time to first token from arrival").observe(gap)
+    req._tm_last = now
 
 
 def _pow2_bucket(n: int, lo: int = 1, hi: Optional[int] = None) -> int:
@@ -462,14 +490,18 @@ class _EngineCore:
 
         import jax
 
+        from ..executor import device_put_owned
+
         dev = place.jax_device()
         for name, arr in weights.items():
             self.scope.set(name, jax.device_put(arr, dev))
         for i in range(cfg.num_layers):
+            # the pools are DONATED every prefill/decode step: they must
+            # be XLA-owned buffers, never zero-copy host aliases
             self.scope.set(f"kv_k_{i}",
-                           jax.device_put(self.kv_config.make_pool(), dev))
+                           device_put_owned(self.kv_config.make_pool(), dev))
             self.scope.set(f"kv_v_{i}",
-                           jax.device_put(self.kv_config.make_pool(), dev))
+                           device_put_owned(self.kv_config.make_pool(), dev))
 
     @classmethod
     def from_model_dir(cls, model_dir: str, **kw) -> "_EngineCore":
@@ -500,12 +532,13 @@ class _EngineCore:
                          self.cfg.max_seq_len - 1)[None]
         slot_map = np.full(S, self.kv_config.pad_slot, np.int32)
         slot_map[:L] = slots
-        out = self.exe.run(
-            self.prefill_prog,
-            feed={"tokens": toks, "positions": pos,
-                  "attn_mask": _causal_mask(S), "slot_mapping": slot_map,
-                  "last_index": np.array([L - 1], np.int32)},
-            fetch_list=self.prefill_fetch, scope=self.scope)
+        with RecordEvent("prefill", cat="serving"):
+            out = self.exe.run(
+                self.prefill_prog,
+                feed={"tokens": toks, "positions": pos,
+                      "attn_mask": _causal_mask(S), "slot_mapping": slot_map,
+                      "last_index": np.array([L - 1], np.int32)},
+                fetch_list=self.prefill_fetch, scope=self.scope)
         return int(out[0][0])
 
     def decode_batch(self, states: Sequence[_SeqState]) -> List[int]:
@@ -534,11 +567,13 @@ class _EngineCore:
         tables = np.zeros((Bp, W), np.int32)
         for i, st in enumerate(states):
             tables[i] = self.kv.block_table(st.req.req_id, W)
-        out = self.exe.run(
-            self.decode_prog,
-            feed={"tokens": toks, "positions": pos, "block_tables": tables,
-                  "context_lens": ctx, "slot_mapping": slot_map},
-            fetch_list=self.decode_fetch, scope=self.scope)
+        with RecordEvent("decode_batch", cat="serving"):
+            out = self.exe.run(
+                self.decode_prog,
+                feed={"tokens": toks, "positions": pos,
+                      "block_tables": tables,
+                      "context_lens": ctx, "slot_mapping": slot_map},
+                fetch_list=self.decode_fetch, scope=self.scope)
         return [int(out[0][i]) for i in range(B)]
 
     def reference_next_token(self, seq: Sequence[int]) -> int:
@@ -608,13 +643,20 @@ class ServingEngine:
 
     # -- API ---------------------------------------------------------------
     def submit(self, req: Request):
-        _reject_unservable(req, self.cfg, self.core.kv_config)
-        if len(req.prompt) + 1 > self.token_budget:
-            # admission requires prompt+1 tokens inside the budget; a
-            # larger prompt would head-of-line block the FIFO forever
-            raise ValueError(
-                f"request {req.req_id!r}: prompt of {len(req.prompt)} "
-                f"tokens can never fit token_budget {self.token_budget}")
+        try:
+            _reject_unservable(req, self.cfg, self.core.kv_config)
+            if len(req.prompt) + 1 > self.token_budget:
+                # admission requires prompt+1 tokens inside the budget;
+                # a larger prompt would head-of-line block the FIFO
+                # forever
+                raise ValueError(
+                    f"request {req.req_id!r}: prompt of "
+                    f"{len(req.prompt)} tokens can never fit "
+                    f"token_budget {self.token_budget}")
+        except ValueError:
+            tm.counter("serving_rejected_total",
+                       "requests rejected at submit (unservable)").inc()
+            raise
         self.waiting.append(req)
 
     def has_work(self) -> bool:
@@ -644,8 +686,17 @@ class ServingEngine:
                 req.admitted_at
             self.stats["admitted"] += 1
             self.stats["prefill_tokens"] += len(req.prompt)
+            tm.counter("serving_admitted_total",
+                       "requests admitted (prefilled)").inc()
+            tm.counter("serving_prefill_tokens_total",
+                       "prompt tokens prefilled").inc(len(req.prompt))
+            if is_profiler_enabled():
+                instant_event("admit", cat="serving",
+                              args={"req": str(req.req_id),
+                                    "prompt": len(req.prompt)})
             st = _SeqState(req, tok)
             req.out_tokens.append(tok)
+            _observe_token(req, now)
             if self.core._finished(req, tok):
                 events.append(self._finish(st, tok, now))
             else:
@@ -656,18 +707,31 @@ class ServingEngine:
             victim = self.running.pop()  # youngest
             self.kv.free_sequence(victim.req.req_id)
             victim.req.out_tokens = []
+            victim.req._tm_last = None
             victim.req.preemptions += 1
             self.waiting.insert(0, victim.req)
             self.stats["preempted"] += 1
+            tm.counter("serving_preempted_total",
+                       "sequences preempted to the waiting queue on "
+                       "pool exhaustion").inc()
+            if is_profiler_enabled():
+                instant_event("preempt", cat="serving",
+                              args={"req": str(victim.req.req_id)})
         # --- decode ------------------------------------------------------
         if self.running:
             toks = self.core.decode_batch(self.running)
             self.stats["decode_steps"] += 1
             self.stats["decode_tokens"] += len(self.running)
+            tm.counter("serving_decode_steps_total",
+                       "batched decode steps run").inc()
+            tm.counter("serving_decode_tokens_total",
+                       "tokens produced by decode steps").inc(
+                           len(self.running))
             still = []
             for st, tok in zip(self.running, toks):
                 st.req.out_tokens.append(tok)
                 st.last_token = tok
+                _observe_token(st.req, now)
                 if self.core._finished(st.req, tok):
                     events.append(self._finish(st, tok, now))
                 else:
@@ -705,6 +769,11 @@ class ServingEngine:
         self.kv.free_sequence(st.req.req_id)
         st.req.finished_at = now
         self.stats["finished"] += 1
+        tm.counter("serving_finished_total",
+                   "requests finished (pages evicted on finish)").inc()
+        if is_profiler_enabled():
+            instant_event("evict", cat="serving",
+                          args={"req": str(st.req.req_id)})
         return StepEvent(st.req.req_id, tok, True, now)
 
     def run_to_completion(self, now: float = 0.0) -> List[StepEvent]:
@@ -746,7 +815,12 @@ class StaticBatchingEngine:
                       "decode_tokens": 0, "prefill_tokens": 0}
 
     def submit(self, req: Request):
-        _reject_unservable(req, self.core.cfg, self.core.kv_config)
+        try:
+            _reject_unservable(req, self.core.cfg, self.core.kv_config)
+        except ValueError:
+            tm.counter("serving_rejected_total",
+                       "requests rejected at submit (unservable)").inc()
+            raise
         self.waiting.append(req)
 
     def has_work(self) -> bool:
@@ -772,6 +846,7 @@ class StaticBatchingEngine:
                 self.stats["prefill_tokens"] += len(req.prompt)
                 st = _SeqState(req, tok)
                 req.out_tokens.append(tok)
+                _observe_token(req, now)
                 if self.core._finished(req, tok):
                     self.core.kv.free_sequence(req.req_id)
                     req.finished_at = now
@@ -788,6 +863,7 @@ class StaticBatchingEngine:
         for st, tok in zip(self.group, toks):
             st.req.out_tokens.append(tok)
             st.last_token = tok
+            _observe_token(st.req, now)
             if self.core._finished(st.req, tok):
                 self.core.kv.free_sequence(st.req.req_id)
                 st.req.finished_at = now

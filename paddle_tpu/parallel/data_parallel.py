@@ -401,6 +401,8 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
         for blk in program.blocks for v in blk.vars.values()
         if getattr(v, "_sharding", None)
     ))
+    from ..utils.cost_model import calibration_version as \
+        _calibration_version
     from ..utils.flags import flag
 
     key = (program._uid, program._version, feed_spec, tuple(fetch_names),
@@ -411,7 +413,8 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
            str(flag("fuse_grad_size_in_MB")),
            str(flag("dp_grad_compress", "none")),
            int(flag("dp_prefetch_depth") or 0),
-           bool(flag("while_static_scan")))
+           bool(flag("while_static_scan")),
+           _calibration_version())
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})
     if key in cache:
         # keep the introspection plan in sync with the entry served (a

@@ -16,6 +16,23 @@ TPU-native shape:
   correlation comes from ``jax.named_scope`` annotations emitted by the
   executor during tracing (the annotation-correlation trick
   device_tracer.cc uses with CUPTI correlation ids).
+
+Unified timeline (r13): events carry a *lane* (``cat``) — "host" for
+executor RecordEvents, "serving" for scheduler decisions
+(inference/serving.py), "rpc" for PS client spans
+(distributed_ps/service.py), "chaos" for injected faults
+(utils/chaos.py).  ``_write_chrome_trace`` maps each lane to its own
+pid with a ``process_name`` metadata row, so one chrome-trace /
+Perfetto file shows training, serving and RPC activity side by side
+(``tools/trace_report.py`` turns it into a phase-breakdown table).
+Zero-duration decisions (admit/preempt/evict, chaos drops) are
+*instant* events (``ph: "i"``).
+
+Closing the calibration loop: ``disable_profiler`` feeds the measured
+``executor_run`` step time (and the per-op means of the summary) into
+``utils.cost_model.set_measured_profile``, so the next
+``FLAGS_fuse_grad_size_in_MB="auto"`` bucket decision runs on measured
+rates instead of the hand-set defaults.
 """
 from __future__ import annotations
 
@@ -27,22 +44,37 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = [
-    "RecordEvent", "record_event", "enable_profiler", "disable_profiler",
-    "reset_profiler", "start_profiler", "stop_profiler", "profiler",
-    "is_profiler_enabled", "npu_profiler", "cuda_profiler",
+    "RecordEvent", "record_event", "instant_event", "enable_profiler",
+    "disable_profiler", "reset_profiler", "start_profiler", "stop_profiler",
+    "profiler", "is_profiler_enabled", "get_events", "npu_profiler",
+    "cuda_profiler", "LANES",
 ]
+
+#: lane -> chrome-trace pid.  Lanes not listed get pids allocated past
+#: the reserved block, deterministically by first appearance.
+LANES = {"host": 0, "serving": 1, "rpc": 2, "chaos": 3}
 
 _state = threading.local()
 _GLOBAL_LOCK = threading.Lock()
 _ENABLED = False
 _TRACE_DIR: Optional[str] = None
-_EVENTS: List[dict] = []  # completed events: name, ts, dur, tid, depth
+_EVENTS: List[dict] = []  # completed events: name, cat, ts, dur, tid, depth
+#: every thread's live event stack, keyed by thread ident — the
+#: thread-local fast path aliases these lists.  Kept globally so
+#: reset_profiler can clear a stack left behind by a thread that died
+#: (or errored) mid-event: before r13 such a leftover skewed ``depth``
+#: for the next session on a reused (pool) thread, and the dead
+#: thread's stack leaked.
+_STACKS: Dict[int, List[dict]] = {}
 
 
 def _stack() -> List[dict]:
-    if not hasattr(_state, "stack"):
-        _state.stack = []
-    return _state.stack
+    stack = getattr(_state, "stack", None)
+    if stack is None:
+        stack = _state.stack = []
+        with _GLOBAL_LOCK:
+            _STACKS[threading.get_ident()] = stack
+    return stack
 
 
 def is_profiler_enabled() -> bool:
@@ -52,10 +84,12 @@ def is_profiler_enabled() -> bool:
 class RecordEvent:
     """RAII host-event marker (reference: platform/profiler.h RecordEvent;
     used as ``with profiler.RecordEvent("fwd"): ...``).  Nested events
-    form a tree via depth; no-op when the profiler is off."""
+    form a tree via depth; no-op when the profiler is off.  ``cat``
+    picks the timeline lane ("host" unless a runtime says otherwise)."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, cat: str = "host"):
         self.name = name
+        self.cat = cat
         self._begin = None
 
     def __enter__(self):
@@ -70,10 +104,15 @@ class RecordEvent:
         begin, self._begin = self._begin, None
         end = time.perf_counter()
         stack = _stack()
-        stack.pop()
+        if stack:
+            # empty = reset_profiler cleared this thread's stack while
+            # the event was in flight (cross-thread reset): record the
+            # completion at depth 0 instead of crashing the worker
+            stack.pop()
         with _GLOBAL_LOCK:
             _EVENTS.append({
                 "name": self.name,
+                "cat": self.cat,
                 "ts": begin,
                 "dur": end - begin,
                 "tid": threading.get_ident(),
@@ -83,10 +122,27 @@ class RecordEvent:
 
 
 @contextlib.contextmanager
-def record_event(name: str):
+def record_event(name: str, cat: str = "host"):
     """Functional spelling of RecordEvent."""
-    with RecordEvent(name):
+    with RecordEvent(name, cat):
         yield
+
+
+def instant_event(name: str, cat: str = "host",
+                  args: Optional[dict] = None):
+    """Zero-duration marker on a lane (chrome-trace ``ph: "i"``): a
+    scheduler decision, an injected fault — things that happen AT a
+    moment rather than over one.  No-op when the profiler is off."""
+    if not _ENABLED:
+        return
+    ev = {
+        "name": name, "cat": cat, "ts": time.perf_counter(), "dur": 0.0,
+        "tid": threading.get_ident(), "depth": len(_stack()), "ph": "i",
+    }
+    if args:
+        ev["args"] = dict(args)
+    with _GLOBAL_LOCK:
+        _EVENTS.append(ev)
 
 
 def enable_profiler(state: str = "All", trace_dir: Optional[str] = None):
@@ -110,16 +166,28 @@ start_profiler = enable_profiler
 
 
 def reset_profiler():
-    """reference: profiler.py reset_profiler."""
+    """reference: profiler.py reset_profiler.  Clears completed events
+    AND every thread's live event stack — a stack abandoned mid-event
+    (crashed thread, unexited manual ``__enter__``) must not skew depth
+    for the next session (regression-tested)."""
     with _GLOBAL_LOCK:
         _EVENTS.clear()
+        live = {t.ident for t in threading.enumerate()}
+        for ident in list(_STACKS):
+            _STACKS[ident].clear()     # aliased by that thread's local
+            if ident not in live:
+                del _STACKS[ident]     # dead thread: drop the entry too
 
 
 def disable_profiler(sorted_key: Optional[str] = None,
-                     profile_path: Optional[str] = None):
+                     profile_path: Optional[str] = None,
+                     print_summary: bool = True):
     """reference: profiler.h:209 DisableProfiler — stops collection,
-    prints the summary table, optionally writes a chrome-trace JSON
-    (the profiler.proto analog; load via chrome://tracing / perfetto)."""
+    prints the summary table (``print_summary=False`` collects silently
+    for library callers), optionally writes a chrome-trace JSON (the
+    profiler.proto analog; load via chrome://tracing / perfetto), and
+    feeds the measured step time into the cost-model calibration store
+    (utils/cost_model.py) so bucket autotune runs on measured rates."""
     global _ENABLED, _TRACE_DIR
     _ENABLED = False
     if _TRACE_DIR is not None:
@@ -132,26 +200,58 @@ def disable_profiler(sorted_key: Optional[str] = None,
     if profile_path:
         _write_chrome_trace(events, profile_path)
     summary = summarize(events, sorted_key or "default")
-    if summary:
+    _feed_calibration(summary)
+    if summary and print_summary:
         print(_format_summary(summary))
-    # allocator stats line (SURVEY §2.9 #9 — allocator_facade stat shim)
-    try:
-        from .utils.memory import memory_summary
+    if print_summary:
+        # allocator stats line (SURVEY §2.9 #9 — allocator_facade shim)
+        try:
+            from .utils.memory import memory_summary
 
-        print("[memory] " + memory_summary(0))
-    except Exception:
-        pass
+            print("[memory] " + memory_summary(0))
+        except Exception:
+            pass
     return summary
 
 
 def stop_profiler(sorted_key: Optional[str] = None,
-                  profile_path: Optional[str] = None):
-    return disable_profiler(sorted_key, profile_path)
+                  profile_path: Optional[str] = None,
+                  print_summary: bool = True):
+    return disable_profiler(sorted_key, profile_path, print_summary)
+
+
+def get_events() -> List[dict]:
+    """Copy of the completed-event list (tools/tests introspection)."""
+    with _GLOBAL_LOCK:
+        return [dict(e) for e in _EVENTS]
+
+
+def _feed_calibration(summary: List[dict]):
+    """Profiled step -> cost model: the MIN ``executor_run`` wall time
+    becomes the measured step time — the steady-state floor, so a
+    compile-dominated first step can't poison the calibration (same
+    best-of discipline bench.py applies).  Per-name means ride along
+    for finer consumers.  Best-effort: calibration must never break a
+    profiling session."""
+    try:
+        row = next((r for r in summary if r["name"] == "executor_run"), None)
+        if row is None:
+            return
+        from .utils import cost_model
+
+        cost_model.set_measured_profile(
+            step_s=row["min"],
+            per_op_s={r["name"]: r["ave"] for r in summary},
+            source="profiler")
+    except Exception:
+        pass
 
 
 def summarize(events: List[dict], sorted_key: str = "default") -> List[dict]:
     rows: Dict[str, dict] = {}
     for e in events:
+        if e.get("ph") == "i":
+            continue  # instants mark moments; min/ave of 0 is noise
         r = rows.setdefault(e["name"], {
             "name": e["name"], "calls": 0, "total": 0.0,
             "max": 0.0, "min": float("inf"),
@@ -193,15 +293,53 @@ def _format_summary(rows: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def _lane_pids(events: List[dict]) -> Dict[str, int]:
+    """lane -> pid: the reserved LANES block first, then unknown lanes
+    in first-appearance order."""
+    pids = dict(LANES)
+    nxt = max(pids.values()) + 1
+    for e in events:
+        cat = e.get("cat", "host")
+        if cat not in pids:
+            pids[cat] = nxt
+            nxt += 1
+    return pids
+
+
 def _write_chrome_trace(events: List[dict], path: str):
-    trace = {"traceEvents": [
+    pids = _lane_pids(events)
+    used = {e.get("cat", "host") for e in events}
+    trace_events = [
         {
-            "name": e["name"], "ph": "X", "cat": "host",
-            "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
-            "pid": 0, "tid": e["tid"],
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"lane:{lane}"},
         }
-        for e in events
-    ]}
+        for lane, pid in sorted(pids.items(), key=lambda kv: kv[1])
+        if lane in used
+    ] + [
+        {
+            "name": "process_sort_index", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"sort_index": pid},
+        }
+        for lane, pid in sorted(pids.items(), key=lambda kv: kv[1])
+        if lane in used
+    ]
+    for e in events:
+        ev = {
+            "name": e["name"], "cat": e.get("cat", "host"),
+            "ts": e["ts"] * 1e6,
+            "pid": pids[e.get("cat", "host")], "tid": e["tid"],
+        }
+        if e.get("ph") == "i":
+            ev["ph"] = "i"
+            ev["s"] = "t"  # thread-scoped instant
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = e["dur"] * 1e6
+        if e.get("args"):
+            ev["args"] = e["args"]
+        trace_events.append(ev)
+    trace = {"traceEvents": trace_events}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -212,13 +350,14 @@ def _write_chrome_trace(events: List[dict], path: str):
 @contextlib.contextmanager
 def profiler(state: str = "All", sorted_key: Optional[str] = None,
              profile_path: Optional[str] = None,
-             trace_dir: Optional[str] = None):
+             trace_dir: Optional[str] = None,
+             print_summary: bool = True):
     """reference: fluid/profiler.py profiler context manager."""
     enable_profiler(state, trace_dir=trace_dir)
     try:
         yield
     finally:
-        disable_profiler(sorted_key, profile_path)
+        disable_profiler(sorted_key, profile_path, print_summary)
 
 
 @contextlib.contextmanager

@@ -124,6 +124,7 @@ class FaultSchedule:
         if self.kill_step is None or step != self.kill_step:
             return
         if self.kill_mode == "raise":
+            self._mark("kill", "step", step, "")
             raise ChaosKilled(f"chaos: killed at step {step}")
         os._exit(137)  # SIGKILL-faithful: no atexit, no flush
 
@@ -141,10 +142,28 @@ class FaultSchedule:
                     or (phase in self.drop_p
                         and self._rng.random() < self.drop_p[phase]))
         if delay:
+            self._mark("delay", phase, n, op)
             time.sleep(self.delay_ms / 1e3)
         if drop:
+            self._mark("drop", phase, n, op)
             raise ChaosRPCDrop(
                 f"chaos: dropped rpc #{n} ({op or '?'}) at {phase}")
+
+    @staticmethod
+    def _mark(kind: str, phase: str, n: int, op: str):
+        """Injected fault -> telemetry counter + chaos timeline lane
+        (merged into the unified chrome trace when profiling)."""
+        kind = kind if kind == "kill" else f"rpc_{kind}"
+        from . import telemetry as tm
+
+        tm.counter("chaos_injections_total",
+                   "faults injected by the FLAGS_chaos schedule",
+                   labels=("kind",)).labels(kind=kind).inc()
+        from .. import profiler
+
+        profiler.instant_event(
+            f"chaos:{kind}", cat="chaos",
+            args={"phase": phase, "n": n, "op": op or "?"})
 
     def on_checkpoint_saved(self, dirname: str):
         """Checkpoint-writer hook: after the Nth completed save,

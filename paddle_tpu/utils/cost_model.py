@@ -23,6 +23,7 @@ needs (the comm/compute ratio).
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -72,6 +73,69 @@ class CostModel:
         f = modeled_backward_s / measured_backward_s
         return replace(self, flops_per_s=self.flops_per_s * f,
                        hbm_bytes_per_s=self.hbm_bytes_per_s * f)
+
+
+# ==========================================================================
+# Measured-profile store (r13: the profiler -> autotune calibration loop)
+# ==========================================================================
+# ``profiler.disable_profiler`` publishes the measured executor step
+# time here; ``default_cost_model`` consumes it so every autotune
+# decision (framework/ir.py fuse_all_reduce_pass, tools/dp_comm_stats)
+# runs on measured rates whenever a profile exists.  The version
+# counter participates in the executor / DP compile-cache keys: a new
+# profile may move bucket boundaries, so compiled programs keyed on the
+# old rates must not be silently reused.
+_PROFILE_LOCK = threading.Lock()
+_PROFILE: Optional[dict] = None
+_CAL_VERSION = 0
+
+
+def set_measured_profile(step_s: float, per_op_s: Optional[Dict] = None,
+                         source: str = ""):
+    """Record one profiled step: ``step_s`` is the measured wall time of
+    an ``executor_run`` (stands in for the backward horizon — the
+    calibration only needs the comm/compute *ratio*), ``per_op_s``
+    optionally carries per-event mean times for finer consumers."""
+    global _PROFILE, _CAL_VERSION
+    if not step_s or step_s <= 0:
+        return
+    with _PROFILE_LOCK:
+        _PROFILE = {"step_s": float(step_s),
+                    "per_op_s": dict(per_op_s or {}), "source": source}
+        _CAL_VERSION += 1
+
+
+def measured_profile() -> Optional[dict]:
+    with _PROFILE_LOCK:
+        return dict(_PROFILE) if _PROFILE is not None else None
+
+
+def clear_measured_profile():
+    global _PROFILE, _CAL_VERSION
+    with _PROFILE_LOCK:
+        if _PROFILE is not None:
+            _PROFILE = None
+            _CAL_VERSION += 1
+
+
+def calibration_version() -> int:
+    """Bumped on every profile set/clear — compile caches key on it."""
+    with _PROFILE_LOCK:
+        return _CAL_VERSION
+
+
+def default_cost_model(ops: Optional[Sequence] = None,
+                       block=None) -> "CostModel":
+    """The cost model every schedule decision should start from: the
+    hand-set defaults, rescaled against the measured profile when one
+    exists (and a program is given to model against).  Without a
+    profile this is exactly ``CostModel()`` — the pre-r13 behavior."""
+    cm = CostModel()
+    prof = measured_profile()
+    if prof and ops is not None and block is not None:
+        _, modeled = backward_timeline(ops, block, cm)
+        cm = cm.calibrated(prof["step_s"], modeled)
+    return cm
 
 
 def _dims(block, name, assumed_batch) -> Optional[List[int]]:

@@ -121,6 +121,13 @@ _DEFAULTS: Dict[str, Any] = {
     # that kills the rank at a step, drops/delays RPCs and truncates
     # checkpoint files, reproducibly.  Empty = all hooks are no-ops.
     "FLAGS_chaos": "",
+    # unified runtime telemetry (utils/telemetry.py): the process-wide
+    # metrics registry the executor / serving engine / PS client publish
+    # to.  0 makes every instrument the shared no-op object — no
+    # registry writes, no per-call allocation — restoring prior behavior
+    # bit-for-bit (host-side bookkeeping only; it never touches program
+    # numerics either way, which the telemetry tests pin).
+    "FLAGS_telemetry": True,
     # static program verifier gate (framework/verifier.py): snapshot
     # before every IR pass, verify dataflow/registry/layout invariants
     # after, raise a diagnostic naming the pass + op + hazard on

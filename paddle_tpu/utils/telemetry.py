@@ -1,0 +1,471 @@
+"""Unified runtime telemetry: one process-wide metrics registry.
+
+Every runtime built so far reported its own numbers its own way — the
+executor through host ``RecordEvent``s, the serving engine and KV pool
+through ad-hoc ``stats()`` dicts, the PS client through ``n_rpc`` /
+``retry_count()``.  This module is the one layer they all publish to
+(reference intent: *End-to-end Adaptive Distributed Training on
+PaddlePaddle*, arXiv 2112.02752 — runtime decisions driven by measured
+profiles need the measurements to exist in one queryable place).
+
+Three instrument kinds, Prometheus-shaped:
+
+* :class:`Counter` — monotonically increasing float (``inc``);
+* :class:`Gauge` — set-to-current-value float (``set``/``inc``);
+* :class:`Histogram` — fixed log-spaced buckets (4 per decade,
+  1 µs … 1000 s: latency-scale events land mid-range) with ``sum`` and
+  ``count``, plus quantile *bracketing* (``quantile_bounds``) so a
+  reported p50/p99 carries its bucket-resolution error bars instead of
+  a false-precision point value.
+
+Instruments are **labeled families**: ``counter("ps_rpc_total",
+labels=("op",)).labels(op="pull_dense").inc()``.  Label cardinality is
+bounded per family (:data:`MAX_SERIES`); combinations past the bound
+collapse into one shared overflow series — an unbounded-cardinality bug
+costs one series, never the process.
+
+Gating — ``FLAGS_telemetry`` (default on): when off, the module-level
+factories return the shared :data:`NOOP` instrument, whose every method
+is a no-op returning ``NOOP`` itself.  No allocation happens per call on
+the off path, and no registry state is touched, so ``FLAGS_telemetry=0``
+restores prior behavior bit-for-bit (pinned by test).
+
+``snapshot()`` returns one JSON-able dict (the ``telemetry`` section
+bench.py / tools/serving_bench.py append to their BENCH artifacts);
+``to_prometheus()`` renders the standard text exposition.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from bisect import bisect_right
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "NOOP", "MAX_SERIES",
+    "enabled", "registry", "counter", "gauge", "histogram",
+    "snapshot", "to_prometheus", "default_buckets",
+]
+
+#: per-family bound on distinct label combinations; the 65th and later
+#: combinations share one overflow series (label values all "~overflow")
+MAX_SERIES = 64
+
+#: label-values tuple of the shared overflow series
+OVERFLOW = "~overflow"
+
+
+def enabled() -> bool:
+    """FLAGS_telemetry resolved at call time (runtime-toggleable)."""
+    from .flags import flag
+
+    return bool(flag("telemetry", True))
+
+
+class _Noop:
+    """The shared off-path instrument: every method is a no-op and
+    ``labels()`` returns the same singleton, so an instrumented call
+    site costs one flag check and zero allocations when telemetry is
+    off."""
+
+    __slots__ = ()
+
+    def inc(self, value=1.0):
+        pass
+
+    def set(self, value):
+        pass
+
+    def observe(self, value):
+        pass
+
+    def labels(self, **kv):
+        return self
+
+    def get(self):
+        return 0.0
+
+
+NOOP = _Noop()
+
+
+def default_buckets() -> List[float]:
+    """Fixed log-spaced bucket upper bounds in seconds: 4 per decade
+    from 1e-6 to 1e+3 (37 edges; one implicit +inf overflow bucket).
+    Shared by every histogram so exposition rows line up."""
+    return [10.0 ** (-6 + i / 4.0) for i in range(37)]
+
+
+_DEFAULT_BUCKETS = tuple(default_buckets())
+
+
+class _Child:
+    """One labeled series.  All mutation goes through the family lock —
+    increments are a few instructions, contention is negligible next to
+    the step/RPC work being measured."""
+
+    __slots__ = ("_lock", "_labels")
+
+    def __init__(self, lock, labels: Tuple[str, ...]):
+        self._lock = lock
+        self._labels = labels
+
+
+class Counter(_Child):
+    __slots__ = ("_value",)
+
+    def __init__(self, lock, labels):
+        super().__init__(lock, labels)
+        self._value = 0.0
+
+    def inc(self, value: float = 1.0):
+        if value < 0:
+            raise ValueError("Counter.inc value must be >= 0")
+        with self._lock:
+            self._value += value
+
+    def get(self) -> float:
+        return self._value
+
+
+class Gauge(_Child):
+    __slots__ = ("_value",)
+
+    def __init__(self, lock, labels):
+        super().__init__(lock, labels)
+        self._value = 0.0
+
+    def set(self, value: float):
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, value: float = 1.0):
+        with self._lock:
+            self._value += value
+
+    def get(self) -> float:
+        return self._value
+
+
+class Histogram(_Child):
+    __slots__ = ("_edges", "_counts", "_sum", "_count", "_min", "_max")
+
+    def __init__(self, lock, labels, edges=_DEFAULT_BUCKETS):
+        super().__init__(lock, labels)
+        self._edges = edges
+        self._counts = [0] * (len(edges) + 1)  # last = +inf overflow
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, value: float):
+        v = float(value)
+        i = bisect_right(self._edges, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    def get(self) -> float:
+        """Mean observation (the scalar view other kinds expose)."""
+        return self._sum / self._count if self._count else 0.0
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _bucket_of_rank(self, k: int) -> int:
+        """Index of the bucket holding the k-th (0-based) observation."""
+        c = 0
+        for i, n in enumerate(self._counts):
+            c += n
+            if k < c:
+                return i
+        return len(self._counts) - 1
+
+    def _bounds_of_bucket(self, i: int) -> Tuple[float, float]:
+        lo = self._edges[i - 1] if i > 0 else 0.0
+        hi = self._edges[i] if i < len(self._edges) else math.inf
+        # tighten by the actually observed extremes (exact, cheap)
+        if self._count:
+            lo = max(lo, self._min) if self._min <= hi else lo
+            hi = min(hi, self._max) if self._max >= lo else hi
+        return lo, hi
+
+    def quantile_bounds(self, q: float) -> Tuple[float, float]:
+        """(lo, hi) provably bracketing the q-quantile under the
+        linear-interpolation rank convention numpy uses: lo is the
+        lower edge of the bucket holding the floor-rank sample, hi the
+        upper edge of the bucket holding the ceil-rank sample.  The
+        exact sample-level quantile (utils/loadgen.py's percentile)
+        always lies inside — the property the serving p50/p99 test
+        pins.  (nan, nan) when empty."""
+        with self._lock:
+            n = self._count
+            if n == 0:
+                return (math.nan, math.nan)
+            pos = min(max(q, 0.0), 1.0) * (n - 1)
+            lo_b = self._bucket_of_rank(int(math.floor(pos)))
+            hi_b = self._bucket_of_rank(int(math.ceil(pos)))
+            return (self._bounds_of_bucket(lo_b)[0],
+                    self._bounds_of_bucket(hi_b)[1])
+
+    def quantile(self, q: float) -> float:
+        """Point estimate: geometric midpoint of the bracketing bounds
+        (log-spaced buckets make the geometric mean the unbiased
+        choice); falls back to the finite edge when one side is 0/inf."""
+        lo, hi = self.quantile_bounds(q)
+        if math.isnan(lo):
+            return math.nan
+        if lo > 0 and math.isfinite(hi):
+            return math.sqrt(lo * hi)
+        return lo if not math.isfinite(hi) else hi
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class _Family:
+    """A named instrument family: fixed kind + label names, bounded set
+    of labeled children."""
+
+    def __init__(self, name: str, kind: str, help_: str,
+                 label_names: Tuple[str, ...]):
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.label_names = label_names
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+        if not label_names:  # unlabeled: the family IS its only child
+            self._default = self._make(())
+        else:
+            self._default = None
+
+    def _make(self, values: Tuple[str, ...]) -> _Child:
+        return _KINDS[self.kind](self._lock, values)
+
+    def labels(self, **kv) -> _Child:
+        if not self.label_names:
+            if kv:
+                raise ValueError(f"{self.name} declares no labels")
+            return self._only()
+        if set(kv) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(kv)} != declared "
+                f"{sorted(self.label_names)}")
+        values = tuple(str(kv[k]) for k in self.label_names)
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                if len(self._children) >= MAX_SERIES:
+                    values = (OVERFLOW,) * len(self.label_names)
+                    child = self._children.get(values)
+                    if child is None:
+                        child = self._make(values)
+                        self._children[values] = child
+                else:
+                    child = self._make(values)
+                    self._children[values] = child
+            return child
+
+    # unlabeled convenience: the family proxies its single child
+    def _only(self) -> _Child:
+        if self._default is None:
+            raise ValueError(
+                f"{self.name} is labeled {self.label_names}: call "
+                f".labels(...) first")
+        return self._default
+
+    def inc(self, value: float = 1.0):
+        return self._only().inc(value)
+
+    def set(self, value: float):
+        return self._only().set(value)
+
+    def observe(self, value: float):
+        return self._only().observe(value)
+
+    def get(self):
+        return self._only().get()
+
+    # delegated Histogram views (unlabeled convenience)
+    @property
+    def count(self):
+        return self._only().count
+
+    @property
+    def sum(self):
+        return self._only().sum
+
+    def quantile(self, q: float):
+        return self._only().quantile(q)
+
+    def quantile_bounds(self, q: float):
+        return self._only().quantile_bounds(q)
+
+    def series(self) -> Dict[Tuple[str, ...], _Child]:
+        with self._lock:
+            if self._default is not None:  # unlabeled family
+                return {(): self._default}
+            return dict(self._children)
+
+    def reset(self):
+        with self._lock:
+            for values in list(self._children):
+                self._children[values] = self._make(values)
+            if self._default is not None:
+                self._default = self._make(())
+
+
+class Registry:
+    """Process-wide family table.  ``counter``/``gauge``/``histogram``
+    are idempotent get-or-create (re-declaring with a different kind or
+    label set is an error — two subsystems fighting over one name is a
+    bug worth surfacing)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _family(self, name: str, kind: str, help_: str,
+                labels: Sequence[str]) -> _Family:
+        label_names = tuple(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, kind, help_, label_names)
+                self._families[name] = fam
+                return fam
+        if fam.kind != kind or fam.label_names != label_names:
+            raise ValueError(
+                f"telemetry instrument {name!r} re-declared as "
+                f"{kind}{label_names} (was {fam.kind}{fam.label_names})")
+        return fam
+
+    def counter(self, name, help="", labels=()) -> _Family:
+        return self._family(name, "counter", help, labels)
+
+    def gauge(self, name, help="", labels=()) -> _Family:
+        return self._family(name, "gauge", help, labels)
+
+    def histogram(self, name, help="", labels=()) -> _Family:
+        return self._family(name, "histogram", help, labels)
+
+    def reset(self):
+        """Zero every series, keep the families (the serving bench's
+        between-warmup-and-measured zeroing, registry edition)."""
+        with self._lock:
+            fams = list(self._families.values())
+        for f in fams:
+            f.reset()
+
+    def clear(self):
+        """Drop everything (tests: a pristine registry)."""
+        with self._lock:
+            self._families.clear()
+
+    # -- export ------------------------------------------------------------
+    def snapshot(self) -> Dict:
+        """One JSON-able dict: {name: {type, help, labels, series: [...]}}.
+        Histogram series carry cumulative bucket counts as [le, count]
+        pairs (Prometheus ``le`` convention) plus sum/count/min/max."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            fams = dict(self._families)
+        for name, fam in sorted(fams.items()):
+            rows = []
+            for values, child in sorted(fam.series().items()):
+                row = {"labels": dict(zip(fam.label_names, values))}
+                if fam.kind == "histogram":
+                    cum = 0
+                    buckets = []
+                    for i, c in enumerate(child._counts):
+                        cum += c
+                        le = (child._edges[i] if i < len(child._edges)
+                              else math.inf)
+                        if c or le is math.inf:
+                            buckets.append([le if math.isfinite(le)
+                                            else "+Inf", cum])
+                    row.update({
+                        "count": child._count,
+                        "sum": child._sum,
+                        "min": (child._min if child._count else None),
+                        "max": (child._max if child._count else None),
+                        "buckets": buckets,
+                    })
+                else:
+                    row["value"] = child.get()
+                rows.append(row)
+            out[name] = {"type": fam.kind, "help": fam.help,
+                         "labels": list(fam.label_names), "series": rows}
+        return out
+
+    def to_prometheus(self) -> str:
+        """Standard text exposition (histograms: _bucket/_sum/_count)."""
+        lines: List[str] = []
+        with self._lock:
+            fams = dict(self._families)
+        for name, fam in sorted(fams.items()):
+            if fam.help:
+                lines.append(f"# HELP {name} {fam.help}")
+            lines.append(f"# TYPE {name} {fam.kind}")
+            for values, child in sorted(fam.series().items()):
+                lab = ",".join(f'{k}="{v}"'
+                               for k, v in zip(fam.label_names, values))
+                if fam.kind == "histogram":
+                    cum = 0
+                    for i, c in enumerate(child._counts):
+                        cum += c
+                        le = (repr(child._edges[i])
+                              if i < len(child._edges) else "+Inf")
+                        sep = "," if lab else ""
+                        lines.append(
+                            f'{name}_bucket{{{lab}{sep}le="{le}"}} {cum}')
+                    suffix = f"{{{lab}}}" if lab else ""
+                    lines.append(f"{name}_sum{suffix} {child._sum}")
+                    lines.append(f"{name}_count{suffix} {child._count}")
+                else:
+                    suffix = f"{{{lab}}}" if lab else ""
+                    lines.append(f"{name}{suffix} {child.get()}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    """The process registry (always real — gating lives in the
+    module-level factories below, so exporters can read a snapshot even
+    while instrumentation is switched off)."""
+    return _REGISTRY
+
+
+# -- gated factories: THE instrumentation surface --------------------------
+def counter(name, help="", labels=()):
+    return _REGISTRY.counter(name, help, labels) if enabled() else NOOP
+
+
+def gauge(name, help="", labels=()):
+    return _REGISTRY.gauge(name, help, labels) if enabled() else NOOP
+
+
+def histogram(name, help="", labels=()):
+    return _REGISTRY.histogram(name, help, labels) if enabled() else NOOP
+
+
+def snapshot() -> Dict:
+    return _REGISTRY.snapshot()
+
+
+def to_prometheus() -> str:
+    return _REGISTRY.to_prometheus()

@@ -54,6 +54,12 @@ def _fresh_programs():
 
     prev_scope = scope_mod._global_scope
     scope_mod._global_scope = scope
+    # profiler sessions feed the cost-model calibration store (r13);
+    # a profile recorded by one test must not reshape another test's
+    # autotuned comm schedule
+    from paddle_tpu.utils import cost_model
+
+    cost_model.clear_measured_profile()
     yield
     core.switch_main_program(prev_main)
     core.switch_startup_program(prev_startup)

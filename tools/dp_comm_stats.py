@@ -23,13 +23,20 @@ and prints the before/after JSON:
                                   [--overlap 0|1] [--stage 0..3]
                                   [--autotune] [--prefetch-depth K]
                                   [--calibrate-ms MS]
+                                  [--calibrate-from-trace TRACE.json]
 
 ``--autotune`` (== --mb auto, FLAGS_fuse_grad_size_in_MB="auto") turns
 on the measurement-driven variable-bucket mode and prints BOTH the
 fixed-32MB and the autotuned schedule side by side, so the exposed-
 bytes win is auditable; ``--calibrate-ms`` rescales the cost model so
 the modeled backward matches a profiled step time before the
-comparison.  ``--prefetch-depth`` (with --stage 3) prints the ZeRO-3
+comparison, and ``--calibrate-from-trace`` reads that step time out of
+a profiler chrome trace (MIN ``executor_run`` duration, the steady-
+state floor — the r13 profile -> calibrate -> autotune loop, no
+hand-copied number).  With
+neither flag, a profile already recorded in this process (utils/
+cost_model.set_measured_profile, fed by profiler.disable_profiler) is
+used automatically — the same rates the autotune pass itself sees.  ``--prefetch-depth`` (with --stage 3) prints the ZeRO-3
 parameter-prefetch plan: per param per direction, where the all-gather
 is issued vs its first consumer, and the dedup ratio (consumer sites
 vs gathers issued).
@@ -292,6 +299,33 @@ def timeline_stats(program, nranks, cost_model=None):
     }
 
 
+def measured_step_ms_from_trace(path: str) -> float:
+    """MIN ``executor_run`` duration (ms) out of a profiler chrome
+    trace — the steady-state step floor (a compile-dominated first
+    step must not poison the calibration; bench.py's best-of
+    discipline).  Raises SystemExit(2) on an unloadable trace or one
+    with no executor_run events (progcheck convention: non-zero on bad
+    input)."""
+    try:
+        from trace_report import TraceInvalid, load_trace
+    except ImportError:  # tools/ not on path (library use)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from trace_report import TraceInvalid, load_trace
+    try:
+        trace = load_trace(path)
+    except TraceInvalid as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    durs = [float(e["dur"]) for e in trace["traceEvents"]
+            if e.get("ph") == "X" and e.get("name") == "executor_run"]
+    if not durs:
+        print(f"ERROR: {path}: no executor_run events — profile a step "
+              f"first (paddle_tpu.profiler with profile_path=...)",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return min(durs) / 1e3  # trace dur is us
+
+
 def prefetch_stats(program, nranks, depth):
     """ZeRO-3 prefetch-plan summary for the shard_map path: where each
     sharded param's all-gather is issued vs its first consumer, and the
@@ -347,6 +381,13 @@ def main(argv=None):
     ap.add_argument("--calibrate-ms", type=float, default=None,
                     help="measured backward time of one step: rescales "
                          "the cost model before the schedule decision")
+    ap.add_argument("--calibrate-from-trace", default=None,
+                    metavar="TRACE",
+                    help="chrome-trace JSON from a profiled run "
+                         "(profiler profile_path / tools/trace_report): "
+                         "the MIN executor_run duration (steady-state "
+                         "floor) becomes the measured step time for "
+                         "--calibrate-ms")
     ap.add_argument("--verify", action="store_true",
                     help="run tools/progcheck.py's static verifier on "
                          "the rewritten program (plus the rank-0-vs-"
@@ -391,8 +432,14 @@ def main(argv=None):
 
         mesh_mod.init_mesh((min(args.nranks, len(jax.devices())),), ("dp",))
 
+    calibrate_ms = args.calibrate_ms
+    calibration_source = "flag" if calibrate_ms is not None else None
+    if args.calibrate_from_trace is not None:
+        calibrate_ms = measured_step_ms_from_trace(
+            args.calibrate_from_trace)
+        calibration_source = args.calibrate_from_trace
     cm = None
-    if args.calibrate_ms is not None:
+    if calibrate_ms is not None:
         from paddle_tpu.utils.cost_model import (CostModel,
                                                  backward_timeline)
 
@@ -400,7 +447,26 @@ def main(argv=None):
                                            args.nranks)
         blk = probe.global_block()
         _, modeled = backward_timeline(list(blk.ops), blk, CostModel())
-        cm = CostModel().calibrated(args.calibrate_ms / 1e3, modeled)
+        cm = CostModel().calibrated(calibrate_ms / 1e3, modeled)
+        # publish to the process store so the autotune PASS models with
+        # the SAME rates this CLI reports (the closed loop)
+        from paddle_tpu.utils import cost_model as cost_model_mod
+
+        cost_model_mod.set_measured_profile(
+            step_s=calibrate_ms / 1e3,
+            source=calibration_source or "dp_comm_stats")
+    else:
+        from paddle_tpu.utils import cost_model as cost_model_mod
+
+        prof = cost_model_mod.measured_profile()
+        if prof is not None:
+            # a profiler session already recorded a step in this
+            # process: model with it (same as the autotune pass will)
+            probe, _, _ = build_mlp_dp_program(args.layers, args.width,
+                                               args.nranks)
+            blk = probe.global_block()
+            cm = cost_model_mod.default_cost_model(list(blk.ops), blk)
+            calibration_source = prof.get("source") or "measured_profile"
 
     main_p, _, loss = build_mlp_dp_program(args.layers, args.width,
                                            args.nranks)
@@ -412,6 +478,7 @@ def main(argv=None):
     grad_total, grad_per_dev = grad_buffer_bytes(rewritten, args.nranks,
                                                  stage)
     out = {
+        "calibration": calibration_source,
         "fuse_grad_size_in_MB": flags.flag("fuse_grad_size_in_MB"),
         "dp_grad_compress": flags.flag("dp_grad_compress"),
         "dp_comm_overlap": bool(flags.flag("dp_comm_overlap")),

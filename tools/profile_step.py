@@ -2,8 +2,10 @@
 per-fusion device-time table (the r2 BENCHMARKS.md breakdown, scripted).
 
 Usage: python tools/profile_step.py [resnet50|ernie] [--steps N]
-Writes the raw trace under /tmp/pt_trace/ and prints the top device ops
-aggregated by fusion kind.
+Writes the raw trace under /tmp/pt_trace/, prints the top device ops
+aggregated by fusion kind, and ends with one stable ``PROFILE={json}``
+line (the ``SERVING=``/``BENCH=`` convention) so the driver can diff
+profiles across rounds without scraping the human tables.
 """
 from __future__ import annotations
 
@@ -114,17 +116,34 @@ def main():
     sync(out)
     wall = (time.perf_counter() - t0) / steps
     print(f"wall per step (untraced): {wall * 1e3:.2f} ms")
-    summarize(trace_dir, steps)
+    device = summarize(trace_dir, steps)
+    # the stable machine line: wall + device breakdown + the measured
+    # step time fed into the cost-model calibration store, so a
+    # profile -> autotune round is auditable end to end
+    from paddle_tpu.utils import cost_model
+    from paddle_tpu.utils.loadgen import emit_json
+
+    cost_model.set_measured_profile(step_s=wall, source="profile_step")
+    emit_json("PROFILE", {
+        "model": which,
+        "steps": steps,
+        "backend": jax.default_backend(),
+        "wall_ms_per_step": round(wall * 1e3, 3),
+        "calibration": cost_model.measured_profile()["source"],
+        "device": device,
+    })
 
 
 def summarize(trace_dir, steps):
     """Aggregate device-side event durations from the xplane protobuf via
-    the tensorboard_plugin_profile-free path: parse trace.json.gz."""
+    the tensorboard_plugin_profile-free path: parse trace.json.gz.
+    Returns the machine-readable breakdown (None when the backend wrote
+    no device trace — e.g. the CPU proxy)."""
     files = glob.glob(os.path.join(
         trace_dir, "plugins/profile/*/*.trace.json.gz"))
     if not files:
         print("no trace.json.gz found under", trace_dir)
-        return
+        return None
     path = sorted(files)[-1]
     with gzip.open(path, "rt") as f:
         data = json.load(f)
@@ -165,6 +184,16 @@ def summarize(trace_dir, steps):
     print("\ntop 30 individual HLO ops:")
     for k, v in sorted(per_ev.items(), key=lambda kv: -kv[1])[:30]:
         print(f"  {v / steps:8.3f} ms  {k[:110]}")
+    return {
+        "total_ms_per_step": round(total / steps, 3),
+        "by_kind_ms_per_step": {
+            k: round(v / steps, 3)
+            for k, v in sorted(agg.items(), key=lambda kv: -kv[1])[:25]},
+        "top_ops_ms_per_step": {
+            k[:110]: round(v / steps, 3)
+            for k, v in sorted(per_ev.items(), key=lambda kv: -kv[1])[:10]},
+        "trace": path,
+    }
 
 
 if __name__ == "__main__":

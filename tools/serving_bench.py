@@ -77,7 +77,11 @@ def make_engines(model_dir, args):
 def measure(eng, trace, warmup):
     """Replay unmeasured ``warmup`` times (populates the executor's jit
     cache for every bucket shape the trace hits — each replay drains
-    fully, freeing all pages), then once measured."""
+    fully, freeing all pages), then once measured.  Returns
+    ``(latency_report, telemetry_snapshot)`` — the registry is reset
+    with the scheduler counters, so both describe ONLY the measured
+    replay and the registry's numbers are the report's numbers."""
+    from paddle_tpu.utils import telemetry
     from paddle_tpu.utils.loadgen import latency_report, replay_trace
 
     for _ in range(warmup):
@@ -85,8 +89,9 @@ def measure(eng, trace, warmup):
     # scheduler counters must describe ONLY the measured replay (the
     # latencies next to them do) — zero the warmup's contribution
     eng.stats = {k: 0 for k in eng.stats}
+    telemetry.registry().reset()
     raw = replay_trace(eng, trace)
-    return latency_report(raw)
+    return latency_report(raw), telemetry.snapshot()
 
 
 def main(argv=None):
@@ -114,8 +119,8 @@ def main(argv=None):
         model_dir = os.path.join(td, "decoder")
         export_decoder(model_dir, cfg, seed=args.seed)
         cont_eng, static_eng = make_engines(model_dir, args)
-        cont_rep = measure(cont_eng, trace, args.warmup)
-        stat_rep = measure(static_eng, trace, args.warmup)
+        cont_rep, cont_tm = measure(cont_eng, trace, args.warmup)
+        stat_rep, stat_tm = measure(static_eng, trace, args.warmup)
 
         identical = None
         if args.quick:
@@ -152,6 +157,10 @@ def main(argv=None):
             "speedup_tokens_per_s": round(speedup, 3),
             "mha_fused_ops": cont_eng.core.mha_fused,
             "scheduler": cont_eng.stats,
+            # the registry view of the same measured replays (r13):
+            # latency histograms, scheduler counters, KV gauges —
+            # carried on the BENCH artifact for free
+            "telemetry": {"continuous": cont_tm, "static": stat_tm},
         }
         if identical is not None:
             payload["token_identical_vs_one_at_a_time"] = identical

@@ -1,0 +1,212 @@
+"""Phase-breakdown report over a unified chrome trace.
+
+The profiler's merged timeline (host executor events, serving-scheduler
+decisions, RPC spans, chaos injections — one pid lane each, see
+paddle_tpu/profiler.py LANES) is great in Perfetto and useless in a
+terminal.  This tool turns a trace file into the terminal view: one
+summary row per lane, the top events by total time inside each, and a
+stable one-line ``TRACE={json}`` (the ``SERVING=``/``BENCH=``
+convention) so the driver can diff phase breakdowns across rounds.
+
+Usage:
+  python tools/trace_report.py TRACE.json [--top N] [--json]
+  python tools/trace_report.py --quick     # bounded self-contained smoke
+
+Exit codes (progcheck convention): 0 = report produced; 1 = --quick
+smoke found the merged trace structurally wrong (a lane missing); 2 =
+the trace file is truncated / invalid JSON / not a chrome trace.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+class TraceInvalid(Exception):
+    """The file is not a loadable chrome trace (truncated mid-write,
+    wrong format, events missing required fields)."""
+
+
+def load_trace(path: str) -> dict:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        raise TraceInvalid(f"{path}: not loadable JSON ({e})") from e
+    if not isinstance(data, dict) or not isinstance(
+            data.get("traceEvents"), list):
+        raise TraceInvalid(f"{path}: no traceEvents list (not a chrome "
+                           f"trace)")
+    for i, e in enumerate(data["traceEvents"]):
+        if not isinstance(e, dict) or "ph" not in e:
+            raise TraceInvalid(f"{path}: event #{i} is not a phased "
+                               f"trace event")
+        if e["ph"] == "X" and not ("name" in e and "ts" in e
+                                   and "dur" in e):
+            raise TraceInvalid(f"{path}: complete event #{i} missing "
+                               f"name/ts/dur")
+    return data
+
+
+def report(trace: dict, top: int = 10) -> dict:
+    """Aggregate per lane: event counts, total ms, top names by total
+    duration, instant-marker counts.  Lane names come from the
+    ``process_name`` metadata the profiler writes (``lane:host`` etc.);
+    unnamed pids fall back to ``pid<N>``."""
+    events = trace["traceEvents"]
+    lane_of = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name = (e.get("args") or {}).get("name", "")
+            lane_of[e["pid"]] = name[5:] if name.startswith("lane:") \
+                else (name or f"pid{e['pid']}")
+    lanes: dict = {}
+    t_min, t_max = float("inf"), float("-inf")
+    n_events = 0
+    for e in events:
+        ph = e.get("ph")
+        if ph not in ("X", "i"):
+            continue
+        lane = lane_of.get(e.get("pid", 0), f"pid{e.get('pid', 0)}")
+        row = lanes.setdefault(lane, {
+            "events": 0, "total_ms": 0.0, "by_name": {}, "instants": {}})
+        n_events += 1
+        ts = float(e.get("ts", 0.0))
+        t_min = min(t_min, ts)
+        if ph == "i":
+            row["instants"][e["name"]] = \
+                row["instants"].get(e["name"], 0) + 1
+            t_max = max(t_max, ts)
+            continue
+        dur_ms = float(e["dur"]) / 1e3
+        t_max = max(t_max, ts + float(e["dur"]))
+        row["events"] += 1
+        row["total_ms"] += dur_ms
+        r = row["by_name"].setdefault(e["name"], {"calls": 0,
+                                                  "total_ms": 0.0})
+        r["calls"] += 1
+        r["total_ms"] += dur_ms
+    for row in lanes.values():
+        row["total_ms"] = round(row["total_ms"], 6)
+        row["by_name"] = dict(sorted(
+            row["by_name"].items(),
+            key=lambda kv: -kv[1]["total_ms"])[:top])
+        for r in row["by_name"].values():
+            r["total_ms"] = round(r["total_ms"], 6)
+    return {
+        "n_events": n_events,
+        "span_ms": (round((t_max - t_min) / 1e3, 6)
+                    if n_events else 0.0),
+        "lanes": dict(sorted(lanes.items())),
+    }
+
+
+def format_table(rep: dict) -> str:
+    lines = [f"{'Lane':<10} {'Events':>8} {'Total(ms)':>12}  Top events"]
+    for lane, row in rep["lanes"].items():
+        tops = ", ".join(
+            f"{n} ({r['total_ms']:.2f}ms x{r['calls']})"
+            for n, r in list(row["by_name"].items())[:3])
+        inst = ("  [" + ", ".join(f"{n} x{c}"
+                                  for n, c in row["instants"].items())
+                + "]") if row["instants"] else ""
+        lines.append(f"{lane:<10} {row['events']:>8} "
+                     f"{row['total_ms']:>12.3f}  {tops}{inst}")
+    lines.append(f"span: {rep['span_ms']:.3f} ms over "
+                 f"{rep['n_events']} events")
+    return "\n".join(lines)
+
+
+def run_quick(tmpdir: str) -> int:
+    """Self-contained smoke for CI: produce a real merged trace (host
+    lane from the executor, serving lane from a tiny engine, plus rpc /
+    chaos markers), then require this tool to load it and find every
+    lane.  Bounded: the decoder is minimal and the trace is tiny."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import profiler
+    from paddle_tpu.inference.serving import (DecoderConfig, Request,
+                                              ServingEngine)
+
+    path = os.path.join(tmpdir, "quick_trace.json")
+    profiler.enable_profiler("All")
+    # host lane: one tiny program through the executor
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        out = fluid.layers.mean(fluid.layers.fc(x, 4))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+            fetch_list=[out.name])
+    # serving lane: a two-request continuous-batching run
+    cfg = DecoderConfig(vocab_size=32, hidden=16, num_heads=2,
+                        num_layers=1, max_seq_len=32)
+    eng = ServingEngine(cfg, num_pages=16, page_size=4,
+                        prefill_bucket_min=4)
+    for i in range(2):
+        eng.submit(Request(i, [1 + i, 2, 3], max_new_tokens=2))
+    eng.run_to_completion()
+    # rpc + chaos lanes: representative markers (the full PS round trip
+    # is covered by tests/test_telemetry.py's merged-trace test)
+    with profiler.record_event("rpc:ping", cat="rpc"):
+        pass
+    profiler.instant_event("chaos:none", cat="chaos")
+    profiler.disable_profiler(profile_path=path, print_summary=False)
+
+    rep = report(load_trace(path))
+    print(format_table(rep))
+    print("TRACE=" + json.dumps(rep, sort_keys=True))
+    missing = [lane for lane in ("host", "serving", "rpc", "chaos")
+               if lane not in rep["lanes"]]
+    if missing:
+        print(f"FAIL: lanes missing from merged trace: {missing}",
+              file=sys.stderr)
+        return 1
+    if not rep["lanes"]["serving"]["instants"]:
+        print("FAIL: serving lane carries no scheduler decisions",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", nargs="?", help="chrome-trace JSON file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="events per lane in the breakdown")
+    ap.add_argument("--json", action="store_true",
+                    help="machine output only (the TRACE= line)")
+    ap.add_argument("--quick", action="store_true",
+                    help="bounded self-contained smoke (CI)")
+    args = ap.parse_args(argv)
+    if args.quick:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            return run_quick(td)
+    if not args.trace:
+        ap.error("need a trace file (or --quick)")
+    try:
+        rep = report(load_trace(args.trace), args.top)
+    except TraceInvalid as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    if not args.json:
+        print(format_table(rep))
+    print("TRACE=" + json.dumps(rep, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
