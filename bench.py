@@ -46,11 +46,14 @@ def _best_of(run_once, repeats=None):
 
 
 def _apply_bench_flags():
-    """BENCH_NHWC / BENCH_STEP_SESSION env knobs -> framework flags, so
-    the r6 levers can be A/B'd from the shell without code edits:
-    BENCH_NHWC=0|1|auto (default auto: on-accelerator only) gates the
-    layout_transform_pass, BENCH_STEP_SESSION=0|1 (default 1) gates the
-    executor's device-resident state session."""
+    """BENCH_NHWC / BENCH_STEP_SESSION / BENCH_FUSE / BENCH_DOUBLE_BUFFER
+    env knobs -> framework flags, so the r6/r14 levers can be A/B'd from
+    the shell without code edits: BENCH_NHWC=0|1|auto (default auto:
+    on-accelerator only) gates the layout_transform_pass,
+    BENCH_STEP_SESSION=0|1 (default 1) gates the executor's
+    device-resident state session, BENCH_FUSE=0|1|auto (default auto)
+    gates the r14 fuse_epilogue_pass, BENCH_DOUBLE_BUFFER=0|1 gates
+    input-pipeline double buffering (executor.double_buffered_feeds)."""
     from paddle_tpu.utils import flags as _flags
 
     updates = {}
@@ -62,10 +65,23 @@ def _apply_bench_flags():
         # set_flags coerces via the bool default ("1/true/yes/on",
         # case-insensitive)
         updates["tpu_step_session"] = sess
+    fuse = os.environ.get("BENCH_FUSE")
+    if fuse is not None:
+        updates["tpu_fuse"] = fuse
+    dbuf = os.environ.get("BENCH_DOUBLE_BUFFER")
+    if dbuf is not None:
+        updates["tpu_double_buffer"] = dbuf
     if updates:
         _flags.set_flags(updates)
     return {"nhwc": _flags.flag("tpu_nhwc"),
-            "step_session": _flags.flag("tpu_step_session")}
+            "step_session": _flags.flag("tpu_step_session"),
+            "fuse": _flags.flag("tpu_fuse"),
+            # null unless BENCH_DOUBLE_BUFFER is set: only then does the
+            # resnet bench route feeds through the host-fed staging path
+            # the flag gates (the default bench pre-stages one device
+            # batch, where the lever cannot act)
+            "double_buffer": (bool(_flags.flag("tpu_double_buffer"))
+                              if dbuf is not None else None)}
 
 
 def bench_resnet50(batch=128, steps=240, warmup=3, image=224, classes=1000,
@@ -104,20 +120,55 @@ def bench_resnet50(batch=128, steps=240, warmup=3, image=224, classes=1000,
         "label": jax.device_put(
             rng.randint(0, classes, (batch, 1)).astype(np.int32), device),
     }
+    # BENCH_DOUBLE_BUFFER set (either value): the input pipeline is the
+    # thing being measured — feed FRESH host batches each step through
+    # FeedStager, with FLAGS_tpu_double_buffer deciding whether batch
+    # k+1 stages on the background thread (r14 lever) or inline
+    host_fed = os.environ.get("BENCH_DOUBLE_BUFFER") is not None
+    stager = None
+    if host_fed:
+        from paddle_tpu.executor import FeedStager
+
+        stager = FeedStager(main, ["img", "label"], place)
     for _ in range(warmup):
         out = exe.run(main, feed=feed, fetch_list=[loss.name],
                       return_numpy=False)
     _sync(out)
 
+    # record which r14 fusion levers actually engaged in the compiled
+    # program (BENCH_r*.json diffs then show the lever, not just the
+    # number)
+    rew = exe._apply_ir_passes(main, [loss.name])
+    fused_ops = sum(
+        1 for o in rew.global_block().ops
+        if o.type.startswith(("fused_conv_bn_act", "fused_matmul_bias")))
+
     def run_once():
         t0 = time.perf_counter()
-        for _ in range(steps):
-            out = exe.run(main, feed=feed, fetch_list=[loss.name],
-                          return_numpy=False)
+        if host_fed:
+            from paddle_tpu.executor import double_buffered_feeds
+
+            def batches():
+                r = np.random.RandomState(1)
+                for _ in range(steps):
+                    yield {"img": r.rand(batch, 3, image, image
+                                         ).astype(np.float32),
+                           "label": r.randint(0, classes, (batch, 1)
+                                              ).astype(np.int32)}
+
+            for staged in double_buffered_feeds(batches(), stager):
+                out = exe.run(main, feed=staged, fetch_list=[loss.name],
+                              return_numpy=False)
+        else:
+            for _ in range(steps):
+                out = exe.run(main, feed=feed, fetch_list=[loss.name],
+                              return_numpy=False)
         _sync(out)
         return batch * steps / (time.perf_counter() - t0)
 
-    return _best_of(run_once)
+    ips = _best_of(run_once)
+    _LAST_STATS["fused_ops"] = fused_ops  # after _best_of's clear()
+    return ips
 
 
 def bench_lenet(batch=256, steps=30, warmup=5):

@@ -140,6 +140,82 @@ def device_put_owned(value, device):
     return out
 
 
+class FeedStager:
+    """Compile-time feed staging for the step loop: applies the
+    feed-conversion plan (target dtype per feed name — the same
+    ``build_feed_plan`` rules the executor compiles in) and puts every
+    array on device via :func:`device_put_owned`, so the staged values
+    are (a) already in the program's dtype — the hot path's cast counter
+    stays at zero, (b) XLA-owned — safe against the data loader reusing
+    its host buffers for the next batch while the transfer or the step
+    is still in flight (the r13 donation-aliasing gotcha, which a
+    background-thread pipeline would otherwise hit nondeterministically).
+    ``Executor.run`` recognizes staged values (jax arrays on the right
+    device) and skips per-step conversion entirely."""
+
+    def __init__(self, program, feed_names, place):
+        self.plan = build_feed_plan(program.global_block(),
+                                    list(feed_names))
+        self.place = _get_paddle_place(place)
+        self.device = self.place.jax_device()
+
+    def stage(self, feed: Dict[str, Any]) -> Dict[str, Any]:
+        out = {}
+        for k, v in feed.items():
+            if isinstance(v, jax.Array):
+                out[k] = v if v.devices() == {self.device} \
+                    else jax.device_put(v, self.device)
+                continue
+            if isinstance(v, LoDTensor):
+                v = v.value()
+            arr = np.asarray(v)
+            want = self.plan.get(k)
+            if want is not None and arr.dtype != want:
+                arr = arr.astype(want)
+            out[k] = device_put_owned(arr, self.device)
+        return out
+
+
+def double_buffered_feeds(feeds, stager: FeedStager):
+    """Input-pipeline double buffering for the executor step session:
+    yield staged feed dicts where batch k+1's staging (dtype cast +
+    ``device_put_owned`` H2D copies) runs on a background thread while
+    the caller executes step k — the MLPerf-style overlap of input
+    conversion with device compute (arXiv 1909.09756 §3).
+
+    ``FLAGS_tpu_double_buffer=0`` degrades to synchronous staging on the
+    caller's thread: identical values (the rollback contract the tests
+    pin), no overlap.  ``feeds`` is any iterable of feed dicts; staging
+    errors surface on the consumer thread at the offending batch."""
+    from .utils import telemetry as tm
+    from .utils.flags import flag as _flag
+
+    it = iter(feeds)
+    if not _flag("tpu_double_buffer", True):
+        for f in it:
+            yield stager.stage(f)
+        return
+    import concurrent.futures
+
+    staged = tm.counter(
+        "executor_double_buffered_batches_total",
+        "feed batches staged ahead on the double-buffer thread")
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="pt-feed-stage")
+    try:
+        fut = None
+        for f in it:
+            nxt = pool.submit(stager.stage, f)
+            if fut is not None:
+                yield fut.result()  # batch k out while k+1 stages
+            fut = nxt
+            staged.inc()
+        if fut is not None:
+            yield fut.result()
+    finally:
+        pool.shutdown(wait=False)
+
+
 def _fetch_name(f) -> str:
     if isinstance(f, Variable):
         return f.name
@@ -283,6 +359,13 @@ class Executor:
 
         return nhwc_enabled(self.place)
 
+    def _tpu_fuse_enabled(self) -> bool:
+        """FLAGS_tpu_fuse resolved against this executor's place
+        ("auto" -> on-accelerator only)."""
+        from .utils.flags import tpu_fuse_enabled
+
+        return tpu_fuse_enabled(self.place)
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -341,6 +424,7 @@ class Executor:
 
         key = (program._uid, program._version, feed_spec, tuple(fetch_names),
                check_nan_inf, unused_check, ir_passes, donate, nhwc,
+               self._tpu_fuse_enabled(),
                str(flag("fuse_grad_size_in_MB")),
                str(flag("dp_grad_compress", "none")),
                int(flag("dp_sharding") or 0), bool(flag("dp_comm_overlap")),
@@ -598,6 +682,16 @@ class Executor:
         if self._nhwc_enabled() and types & {"conv2d", "depthwise_conv2d"}:
             # after the bn fusions so the NHWC walk sees the fused ops
             passes.append(get_pass("layout_transform_pass",
+                                   protected=protected))
+        if self._tpu_fuse_enabled() and types & {
+                "conv2d", "depthwise_conv2d", "mul", "matmul", "matmul_v2"}:
+            # profile-ranked Pallas epilogue fusion (r14), AFTER the
+            # bn-act and layout passes: the chain walk then sees the
+            # fused BN forms in their final layout (fuse-after-layout;
+            # the reverse order is verifier-clean too, but this one
+            # avoids teaching the layout pass about freshly fused ops
+            # mid-pipeline)
+            passes.append(get_pass("fuse_epilogue_pass",
                                    protected=protected))
         if "c_allreduce_sum" in types:
             from .utils.flags import fuse_grad_mb_auto, fuse_grad_mb_value

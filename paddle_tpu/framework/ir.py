@@ -695,6 +695,223 @@ class FuseBNAddActPass(_FuseBNActBase):
 
 
 # --------------------------------------------------------------------------
+# profile-ranked epilogue fusion (r14) — the Pallas fusion layer's IR
+# half.  utils/cost_model.find_fusion_chains supplies the structural
+# matches (so ranking and rewrite can never disagree), and
+# rank_fusion_candidates orders them by modeled+measured memory-traffic
+# savings; this pass rewrites them best-first onto the fused ops in
+# ops/fused_ops.py (fused_conv_bn_act / fused_matmul_bias_act), forward
+# and the matching grad chain together — the same fwd+bwd-paired shape
+# as fuse_bn_act_pass, per the README "writing a safe IR pass"
+# checklist.  Gated by FLAGS_tpu_fuse in the executor pipeline, applied
+# AFTER the NHWC layout pass (the fused ops carry one layout attr and
+# both pass orders are verifier-clean).
+# --------------------------------------------------------------------------
+@register_pass("fuse_epilogue_pass")
+class FuseEpiloguePass(Pass):
+    """conv2d -> batch_norm/fused_batch_norm_act/fused_bn_add_activation
+    (+ grads) ==> fused_conv_bn_act;  mul/matmul -> elementwise_add(1-D
+    bias) -> act (+ grads) ==> fused_matmul_bias_act."""
+
+    #: vars the rewrite must not make unavailable (fetch targets)
+    protected: Sequence[str] = ()
+
+    #: attrs the fused_conv_bn_act lowering reads, by source op
+    _CONV_ATTRS = ("strides", "paddings", "dilations", "groups",
+                   "padding_algorithm", "data_format")
+    _BN_ATTRS = ("momentum", "epsilon", "is_test", "use_global_stats")
+
+    def apply_impl(self, program):
+        from ..utils import cost_model as cmod
+
+        block = program.global_block()
+        protected = set(self.protected)
+        for other in program.blocks:
+            if other is block:
+                continue
+            for op_ in other.ops:
+                for names in op_.inputs.values():
+                    protected.update(names)
+                for names in op_.outputs.values():
+                    protected.update(names)
+        # calibrate the cost model ONCE per application (the profile is
+        # fixed for the whole rewrite; only the chain set changes as
+        # rewrites land, so the per-iteration re-rank reuses this cm)
+        profile = cmod.measured_profile()
+        cm = cmod.CostModel()
+        if profile:
+            _, modeled = cmod.backward_timeline(block.ops, block, cm)
+            cm = cm.calibrated(profile["step_s"], modeled)
+        fused = 0
+        self.report: List[dict] = []
+        changed = True
+        while changed:
+            changed = False
+            # re-rank after every rewrite: a fusion changes the consumer
+            # structure the next match must see
+            for cand in cmod.rank_fusion_candidates(program,
+                                                    profile=profile, cm=cm):
+                if cand["saved_bytes"] <= 0:
+                    continue
+                if self._rewrite(block, cand["chain"], protected):
+                    fused += 1
+                    self.report.append(
+                        {k: cand[k] for k in
+                         ("kind", "ops", "out", "saved_bytes", "est_saved_s",
+                          "measured_epilogue_s", "score_s", "calibrated")})
+                    changed = True
+                    break
+        self.fused_count = fused
+        if fused:
+            program._bump_version()
+        return program
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _merged_role_attrs(*grad_ops):
+        out = {}
+        roles = [o.attrs.get("op_role") for o in grad_ops
+                 if o is not None and "op_role" in o.attrs]
+        if roles:
+            out["op_role"] = roles[0]
+        rv: List[str] = []
+        for o in grad_ops:
+            if o is not None:
+                rv.extend(o.attrs.get("op_role_var", []) or [])
+        if rv:
+            out["op_role_var"] = rv
+        return out
+
+    def _rewrite(self, block, ch, protected):
+        if ch["kind"] == "conv_bn_act":
+            return self._rewrite_conv(block, ch, protected)
+        return self._rewrite_matmul(block, ch, protected)
+
+    def _rewrite_conv(self, block, ch, protected):
+        conv, bn = ch["conv"], ch["bn"]
+        conv_grad, bn_grad = ch["conv_grad"], ch["bn_grad"]
+        act_op, act_grad = ch["act_op"], ch["act_grad"]
+        # vars the rewrite stops producing must not be fetch targets
+        gone = set()
+        if bn_grad is not None:
+            gone.add(ch["dconv"])
+        if ch.get("bn_y"):
+            gone.add(ch["bn_y"])
+            if act_grad is not None:
+                gone.add(ch["bn_y"] + "@GRAD")
+        if gone & protected:
+            return False
+        attrs = {k: conv.attrs[k] for k in self._CONV_ATTRS
+                 if k in conv.attrs}
+        attrs.update({k: bn.attrs[k] for k in self._BN_ATTRS
+                      if k in bn.attrs})
+        attrs["act_type"] = ch["act"]
+        if conv.type == "depthwise_conv2d":
+            attrs["depthwise"] = True
+        if "op_role" in bn.attrs:
+            attrs["op_role"] = bn.attrs["op_role"]
+        inputs = {
+            "Input": list(conv.inputs["Input"]),
+            "Filter": list(conv.inputs["Filter"]),
+            "Scale": list(bn.inputs["Scale"]),
+            "Bias": list(bn.inputs["Bias"]),
+            "Mean": list(bn.inputs["Mean"]),
+            "Variance": list(bn.inputs["Variance"]),
+        }
+        if ch["z"]:
+            inputs["Z"] = [ch["z"]]
+        outputs = {
+            "Output": [ch["out"]],
+            "ConvOut": [ch["conv_out"]],
+            "MeanOut": list(bn.outputs.get("MeanOut", [])),
+            "VarianceOut": list(bn.outputs.get("VarianceOut", [])),
+            "SavedMean": list(bn.outputs.get("SavedMean", [])),
+            "SavedVariance": list(bn.outputs.get("SavedVariance", [])),
+        }
+        dead_fwd = [conv, bn] + ([act_op] if act_op is not None else [])
+        last = act_op if act_op is not None else bn
+        idx = block.ops.index(last)
+        idx -= sum(1 for o in dead_fwd[:-1] if block.ops.index(o) < idx)
+        remove_ops(block, dead_fwd)
+        block._insert_op(idx, "fused_conv_bn_act",
+                         inputs=inputs, outputs=outputs, attrs=attrs)
+        if bn_grad is not None:
+            gattrs = {k: v for k, v in attrs.items() if k != "op_role"}
+            gattrs.update(self._merged_role_attrs(act_grad, bn_grad,
+                                                  conv_grad))
+            dy_in = (act_grad.inputs["Out@GRAD"] if act_grad is not None
+                     else bn_grad.inputs["Y@GRAD"])
+            ginputs = {
+                "Input": list(conv.inputs["Input"]),
+                "Filter": list(conv.inputs["Filter"]),
+                "ConvOut": [ch["conv_out"]],
+                "Output": [ch["out"]],
+                "Scale": list(bn.inputs["Scale"]),
+                "SavedMean": list(bn.outputs["SavedMean"]),
+                "SavedVariance": list(bn.outputs["SavedVariance"]),
+                "Output@GRAD": list(dy_in),
+            }
+            goutputs = {
+                "Input@GRAD": list(conv_grad.outputs.get("Input@GRAD", [])),
+                "Filter@GRAD": list(conv_grad.outputs.get("Filter@GRAD", [])),
+                "Scale@GRAD": list(bn_grad.outputs.get("Scale@GRAD", [])),
+                "Bias@GRAD": list(bn_grad.outputs.get("Bias@GRAD", [])),
+            }
+            if ch["z"] and bn_grad.outputs.get("Z@GRAD"):
+                goutputs["Z@GRAD"] = list(bn_grad.outputs["Z@GRAD"])
+            dead_bwd = ([act_grad] if act_grad is not None else []) + \
+                [bn_grad, conv_grad]
+            gidx = block.ops.index(dead_bwd[0])
+            remove_ops(block, dead_bwd)
+            block._insert_op(gidx, "fused_conv_bn_act_grad",
+                             inputs=ginputs, outputs=goutputs, attrs=gattrs)
+        return True
+
+    def _rewrite_matmul(self, block, ch, protected):
+        mm, add, act_op = ch["mm"], ch["add"], ch["act_op"]
+        mm_grad, add_grad, act_grad = \
+            ch["mm_grad"], ch["add_grad"], ch["act_grad"]
+        gone = {ch["mm_out"], ch["add_out"]}
+        if act_grad is not None:
+            gone |= {ch["add_out"] + "@GRAD", ch["mm_out"] + "@GRAD"}
+        if gone & protected:
+            return False
+        attrs = {
+            "act_type": ch["act"],
+            "x_num_col_dims": ch["xnc"],
+            "axis": add.attrs.get("axis", -1),
+        }
+        if "op_role" in act_op.attrs:
+            attrs["op_role"] = act_op.attrs["op_role"]
+        inputs = {"X": list(mm.inputs["X"]), "Y": list(mm.inputs["Y"]),
+                  "Bias": list(add.inputs["Y"])}
+        idx = block.ops.index(act_op)
+        idx -= sum(1 for o in (mm, add) if block.ops.index(o) < idx)
+        remove_ops(block, [mm, add, act_op])
+        block._insert_op(idx, "fused_matmul_bias_act", inputs=inputs,
+                         outputs={"Out": [ch["out"]]}, attrs=attrs)
+        if act_grad is not None:
+            gattrs = {k: v for k, v in attrs.items() if k != "op_role"}
+            gattrs.update(self._merged_role_attrs(act_grad, add_grad,
+                                                  mm_grad))
+            ginputs = {
+                "X": list(mm.inputs["X"]), "Y": list(mm.inputs["Y"]),
+                "Bias": list(add.inputs["Y"]),
+                "Out@GRAD": list(act_grad.inputs["Out@GRAD"]),
+            }
+            goutputs = {
+                "X@GRAD": list(mm_grad.outputs.get("X@GRAD", [])),
+                "Y@GRAD": list(mm_grad.outputs.get("Y@GRAD", [])),
+                "Bias@GRAD": list(add_grad.outputs.get("Y@GRAD", [])),
+            }
+            gidx = block.ops.index(act_grad)
+            remove_ops(block, [act_grad, add_grad, mm_grad])
+            block._insert_op(gidx, "fused_matmul_bias_act_grad",
+                             inputs=ginputs, outputs=goutputs, attrs=gattrs)
+        return True
+
+
+# --------------------------------------------------------------------------
 # conv+BN inference fold (reference: ir/conv_bn_fuse_pass.cc) — needs the
 # scope: the fold rewrites the conv FILTER VALUES (W' = W * scale*inv_std
 # per output channel) and replaces the batch_norm with a per-channel bias
@@ -1296,6 +1513,14 @@ _LAYOUT_OPS: Dict[str, tuple] = {
     "fused_bn_add_activation": ("data_layout", ("X", "Z"), ("Y",)),
     "fused_bn_add_activation_grad": ("data_layout", ("X", "Y", "Y@GRAD"),
                                      ("X@GRAD", "Z@GRAD")),
+    # r14 fused conv epilogues: ONE layout attr (data_format) governs
+    # conv and BN; Filter/Filter@GRAD stay OIHW in both layouts
+    "fused_conv_bn_act": ("data_format", ("Input", "Z"),
+                          ("Output", "ConvOut")),
+    "fused_conv_bn_act_grad": ("data_format",
+                               ("Input", "ConvOut", "Output",
+                                "Output@GRAD"),
+                               ("Input@GRAD", "Z@GRAD")),
 }
 
 #: elementwise ops that compute identically in any layout: converted to

@@ -322,6 +322,60 @@ def _fused_bn_add_act_maker(op_, no_grad_names=frozenset()):
     return _make_fused_bn_grad_desc(op_, no_grad_names, with_add=True)
 
 
+@_grad_maker("fused_conv_bn_act")
+def _fused_conv_bn_act_maker(op_, no_grad_names=frozenset()):
+    from .registry import EMPTY_VAR_NAME
+
+    def g(names):
+        return [(n + GRAD_SUFFIX) if n not in no_grad_names else EMPTY_VAR_NAME
+                for n in names]
+
+    inputs = {
+        "Input": op_.input("Input"),
+        "Filter": op_.input("Filter"),
+        "Scale": op_.input("Scale"),
+        "ConvOut": op_.output("ConvOut"),
+        "Output": op_.output("Output"),
+        "SavedMean": op_.output("SavedMean"),
+        "SavedVariance": op_.output("SavedVariance"),
+        "Output" + GRAD_SUFFIX: [n + GRAD_SUFFIX
+                                 for n in op_.output("Output")],
+    }
+    outputs = {
+        "Input" + GRAD_SUFFIX: g(op_.input("Input")),
+        "Filter" + GRAD_SUFFIX: g(op_.input("Filter")),
+        "Scale" + GRAD_SUFFIX: g(op_.input("Scale")),
+        "Bias" + GRAD_SUFFIX: g(op_.input("Bias")),
+    }
+    if op_.input("Z"):
+        outputs["Z" + GRAD_SUFFIX] = g(op_.input("Z"))
+    return [dict(type="fused_conv_bn_act_grad", inputs=inputs,
+                 outputs=outputs, attrs=dict(op_.attrs))]
+
+
+@_grad_maker("fused_matmul_bias_act")
+def _fused_matmul_bias_act_maker(op_, no_grad_names=frozenset()):
+    from .registry import EMPTY_VAR_NAME
+
+    def g(names):
+        return [(n + GRAD_SUFFIX) if n not in no_grad_names else EMPTY_VAR_NAME
+                for n in names]
+
+    inputs = {
+        "X": op_.input("X"),
+        "Y": op_.input("Y"),
+        "Bias": op_.input("Bias"),
+        "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in op_.output("Out")],
+    }
+    outputs = {
+        "X" + GRAD_SUFFIX: g(op_.input("X")),
+        "Y" + GRAD_SUFFIX: g(op_.input("Y")),
+        "Bias" + GRAD_SUFFIX: g(op_.input("Bias")),
+    }
+    return [dict(type="fused_matmul_bias_act_grad", inputs=inputs,
+                 outputs=outputs, attrs=dict(op_.attrs))]
+
+
 @_grad_maker("fused_multihead_attention")
 def _fused_mha_grad_maker(op_, no_grad_names=frozenset()):
     from .registry import EMPTY_VAR_NAME
@@ -353,6 +407,248 @@ def _fused_mha_grad_maker(op_, no_grad_names=frozenset()):
         outputs["BiasQK" + GRAD_SUFFIX] = g(op_.input("BiasQK"))
     return [dict(type="fused_multihead_attention_grad", inputs=inputs,
                  outputs=outputs, attrs=dict(op_.attrs))]
+
+
+# --------------------------------------------------------------------------
+# fused conv + BN(+add) + activation (r14) — the profile-ranked epilogue
+# fusion target (reference intent: operators/fused/conv_fusion_op.cu and
+# the MLPerf TPU-v3 per-chip wins, arXiv 1909.09756 §4).  The conv stays
+# ``lax.conv_general_dilated`` (the MXU path, shared with the ``conv2d``
+# lowering via nn_ops.conv_forward so fusion cannot change the conv);
+# the BN scale/shift (+ residual add) + activation epilogue is applied
+# in the conv output's VMEM residency by the Pallas kernels in
+# ops/pallas_kernels.py (bn_act_apply / bn_act_bwd_apply).  Off-TPU the
+# op runs the bit-identical jnp composition — the exact term order of
+# the unfused conv2d -> batch_norm(+add)(+relu) chain — so
+# ``FLAGS_tpu_fuse`` flips cost, never numerics.  OIHW filters are
+# preserved in both layouts (the conv_forward rhs spec), so checkpoints
+# stay layout- and fusion-invariant.
+#
+# Built by framework/ir.py fuse_epilogue_pass (fwd and the matching grad
+# chain together), ranked by utils/cost_model.rank_fusion_candidates.
+# --------------------------------------------------------------------------
+def _conv_attrs(ctx):
+    return dict(
+        strides=list(ctx.attr("strides", [1, 1])),
+        paddings=list(ctx.attr("paddings", [0, 0])),
+        dilations=list(ctx.attr("dilations", [1, 1])),
+        groups=ctx.attr("groups", 1) or 1,
+        data_format=ctx.attr("data_format", "NCHW"),
+        padding_algorithm=ctx.attr("padding_algorithm", "EXPLICIT"),
+        depthwise=bool(ctx.attr("depthwise", False)),
+    )
+
+
+@op("fused_conv_bn_act")
+def _fused_conv_bn_act(ctx):
+    """Inputs: Input/Filter (the conv), Scale/Bias/Mean/Variance (the
+    BN), optional Z (residual add between BN and act).  Outputs: Output
+    (post-activation), ConvOut (the BN's X — the backward residual; XLA
+    dead-code-eliminates it when nothing consumes it), MeanOut/
+    VarianceOut/SavedMean/SavedVariance exactly as batch_norm.  The
+    layout attr is ``data_format`` and governs conv AND BN — the fuse
+    pass only matches chains where the two agree."""
+    from . import pallas_kernels as pk
+    from .nn_ops import bn_shapes, bn_train_stats, conv_forward
+
+    x = ctx.in_("Input")
+    w = ctx.in_("Filter")
+    scale = ctx.in_("Scale")
+    bias = ctx.in_("Bias")
+    mean_rt = ctx.in_("Mean")
+    var_rt = ctx.in_("Variance")
+    z = ctx.in_("Z") if ctx.has_input("Z") else None
+    momentum = ctx.attr("momentum", 0.9)
+    eps = ctx.attr("epsilon", 1e-5)
+    act = ctx.attr("act_type", "relu")
+    is_test = ctx.attr("is_test", False) or ctx.attr("use_global_stats", False)
+    cattrs = _conv_attrs(ctx)
+
+    conv_out = conv_forward(x, w, **cattrs)
+    ctx.set_out("ConvOut", conv_out)
+    c_axis, red_axes, bshape, n = bn_shapes(conv_out, cattrs["data_format"])
+    if is_test:
+        mean, var = mean_rt, var_rt
+        ctx.set_out("MeanOut", mean_rt)
+        ctx.set_out("VarianceOut", var_rt)
+    else:
+        # the exact stats recipe of the unfused batch_norm (shared
+        # helper), so the fusion never changes training numerics
+        mean, var = bn_train_stats(conv_out, red_axes, bshape, n, c_axis)
+        ctx.set_out("MeanOut", momentum * mean_rt + (1.0 - momentum) * mean)
+        ctx.set_out("VarianceOut", momentum * var_rt + (1.0 - momentum) * var)
+    inv = lax.rsqrt(var + eps)
+    a = (inv * scale).astype(conv_out.dtype)
+    b = (bias - mean * inv * scale).astype(conv_out.dtype)
+    y = pk.bn_act_apply(conv_out, a, b, z=z, act=act, c_axis=c_axis)
+    if y is None:  # jnp fallback: the unfused chain's exact term order
+        y = conv_out * jnp.reshape(a, bshape) + jnp.reshape(b, bshape)
+        if z is not None:
+            y = y + z
+        y = pk.apply_act(y, act)
+    ctx.set_out("Output", y)
+    ctx.set_out("SavedMean", mean)
+    ctx.set_out("SavedVariance", inv)  # inv-std, matching batch_norm
+
+
+@op("fused_conv_bn_act_grad", no_grad=True)
+def _fused_conv_bn_act_grad(ctx):
+    """The fused grad chain act'->BN-backward->conv-backward: the
+    activation mask + dX affine run as ONE Pallas epilogue pass
+    (bn_act_bwd_apply); dInput/dFilter come from jax.vjp of the same
+    conv_forward the unfused conv2d_grad replays, keeping
+    FLAGS_tpu_fuse=0 bit-for-bit."""
+    import jax
+
+    from . import pallas_kernels as pk
+    from .nn_ops import bn_shapes, conv_forward
+
+    x = ctx.in_("Input")
+    w = ctx.in_("Filter")
+    conv_out = ctx.in_("ConvOut")
+    y = ctx.in_("Output")
+    dy = ctx.in_("Output" + GRAD_SUFFIX)
+    scale = ctx.in_("Scale")
+    mean = ctx.in_("SavedMean")        # f32 (C,)
+    inv = ctx.in_("SavedVariance")     # f32 inv-std (C,)
+    act = ctx.attr("act_type", "relu")
+    is_test = ctx.attr("is_test", False) or ctx.attr("use_global_stats", False)
+    cattrs = _conv_attrs(ctx)
+    c_axis, red_axes, bshape, n = bn_shapes(conv_out, cattrs["data_format"])
+
+    if act == "relu":
+        g = jnp.where(y > jnp.zeros((), y.dtype), dy, jnp.zeros((), dy.dtype))
+    else:
+        g = dy
+    want_g = ctx.has_output("Z" + GRAD_SUFFIX)
+    xs = conv_out.astype(jnp.float32) - jnp.reshape(mean, bshape)
+    gf = g.astype(jnp.float32)
+    sg = jnp.sum(gf, axis=red_axes)
+    sgx = jnp.sum(gf * xs, axis=red_axes) * inv
+    ctx.set_out("Scale" + GRAD_SUFFIX, sgx.astype(scale.dtype))
+    ctx.set_out("Bias" + GRAD_SUFFIX, sg.astype(scale.dtype))
+
+    a = scale * inv                       # (C,) f32
+    cg = a.astype(g.dtype)
+    if is_test:
+        # frozen-BN: batch-stat correction terms vanish (matches the
+        # unfused global-stats backward)
+        dconv = g * jnp.reshape(cg, bshape)
+        if want_g:
+            ctx.set_out("Z" + GRAD_SUFFIX, g)
+    else:
+        cx = (-a * inv * sgx / n).astype(conv_out.dtype)
+        c0 = (-a * sg / n).astype(jnp.float32)
+        fused = pk.bn_act_bwd_apply(
+            y, dy, conv_out, cg, mean.astype(conv_out.dtype), cx, c0,
+            act=act, c_axis=c_axis, want_g=want_g)
+        if fused is not None:
+            dconv, g_k = fused
+            if want_g:
+                ctx.set_out("Z" + GRAD_SUFFIX, g_k)
+        else:  # jnp fallback: fused_batch_norm_act_grad's exact dx terms
+            dconv = (g * jnp.reshape(cg, bshape)
+                     + (conv_out - jnp.reshape(mean.astype(conv_out.dtype),
+                                               bshape))
+                     * jnp.reshape(cx, bshape)
+                     + jnp.reshape(c0, bshape).astype(g.dtype))
+            if want_g:
+                ctx.set_out("Z" + GRAD_SUFFIX, g)
+    dconv = dconv.astype(conv_out.dtype)
+
+    if ctx.has_output("Input" + GRAD_SUFFIX) or \
+            ctx.has_output("Filter" + GRAD_SUFFIX):
+        # the same vjp the generic conv2d_grad replays
+        _, vjp = jax.vjp(lambda x_, w_: conv_forward(x_, w_, **cattrs), x, w)
+        dxi, dwf = vjp(dconv)
+        if ctx.has_output("Input" + GRAD_SUFFIX):
+            ctx.set_out("Input" + GRAD_SUFFIX, dxi)
+        if ctx.has_output("Filter" + GRAD_SUFFIX):
+            ctx.set_out("Filter" + GRAD_SUFFIX, dwf)
+
+
+# --------------------------------------------------------------------------
+# fused matmul + bias + activation (r14) — the fc/matmul epilogue
+# (reference: operators/fused/fused_gemm_epilogue_op.cu; built from
+# mul/matmul -> elementwise_add -> act chains by fuse_epilogue_pass).
+# The Pallas kernel applies bias+act to the f32 VMEM accumulator before
+# the single HBM write of each output tile.
+# --------------------------------------------------------------------------
+def _matmul_bias_act_jnp(x, w, bias, act, xnc, axis):
+    """The exact unfused composition: the ``mul`` lowering's flattening
+    matmul + ``elementwise_add``'s paddle-axis broadcast + the act op.
+    The fallback forward AND the fused grad's vjp replay go through
+    here, so unfused and fused paths share every term."""
+    import math as _math
+
+    from . import pallas_kernels as pk
+
+    xshape = jnp.shape(x)
+    xm = jnp.reshape(x, (_math.prod(xshape[:xnc]), -1))
+    n_out = jnp.shape(w)[-1]
+    out = jnp.reshape(jnp.matmul(xm, w), xshape[:xnc] + (n_out,))
+    nd = len(xshape[:xnc]) + 1
+    if axis is None or axis < 0:
+        axis = nd - 1
+    b = jnp.reshape(bias, (1,) * axis + (n_out,) + (1,) * (nd - axis - 1))
+    return pk.apply_act(jnp.add(out, b), act)
+
+
+def _matmul_bias_act_forward(x, w, bias, act, xnc, axis):
+    import math as _math
+
+    from . import pallas_kernels as pk
+
+    xshape = jnp.shape(x)
+    nd = len(xshape[:xnc]) + 1
+    norm_axis = nd - 1 if (axis is None or axis < 0) else axis
+    if norm_axis == nd - 1 and jnp.ndim(bias) == 1:
+        # trailing-dim bias: the kernel's epilogue layout
+        xm = jnp.reshape(x, (_math.prod(xshape[:xnc]), -1))
+        out2 = pk.matmul_bias_act(xm, w, bias, act)
+        if out2 is not None:
+            return jnp.reshape(out2, xshape[:xnc] + (jnp.shape(w)[-1],))
+    return _matmul_bias_act_jnp(x, w, bias, act, xnc, axis)
+
+
+@op("fused_matmul_bias_act")
+def _fused_matmul_bias_act(ctx):
+    x = ctx.in_("X")
+    w = ctx.in_("Y")
+    bias = ctx.in_("Bias")
+    act = ctx.attr("act_type", "")
+    xnc = ctx.attr("x_num_col_dims", 1)
+    axis = ctx.attr("axis", -1)
+    ctx.set_out("Out", _matmul_bias_act_forward(x, w, bias, act, xnc, axis))
+
+
+@op("fused_matmul_bias_act_grad", no_grad=True)
+def _fused_matmul_bias_act_grad(ctx):
+    """vjp of the shared composition — the same primitive transposes the
+    unfused act_grad -> elementwise_add_grad -> mul_grad chain emits
+    (each of those is itself a vjp replay of its forward)."""
+    import jax
+
+    x = ctx.in_("X")
+    w = ctx.in_("Y")
+    bias = ctx.in_("Bias")
+    dout = ctx.in_("Out" + GRAD_SUFFIX)
+    act = ctx.attr("act_type", "")
+    xnc = ctx.attr("x_num_col_dims", 1)
+    axis = ctx.attr("axis", -1)
+    # the fused forward may have taken the Pallas path; differentiate
+    # the jnp composition (identical semantics) so the grads are the
+    # unfused chain's exact primitives
+    _, vjp = jax.vjp(
+        lambda x_, w_, b_: _matmul_bias_act_jnp(x_, w_, b_, act, xnc, axis),
+        x, w, bias)
+    dx, dw, db = vjp(dout.astype(jnp.result_type(x, w)))
+    if ctx.has_output("X" + GRAD_SUFFIX):
+        ctx.set_out("X" + GRAD_SUFFIX, dx)
+    if ctx.has_output("Y" + GRAD_SUFFIX):
+        ctx.set_out("Y" + GRAD_SUFFIX, dw)
+    if ctx.has_output("Bias" + GRAD_SUFFIX):
+        ctx.set_out("Bias" + GRAD_SUFFIX, db)
 
 
 # --------------------------------------------------------------------------

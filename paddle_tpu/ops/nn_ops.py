@@ -42,6 +42,42 @@ def _conv_padding(paddings, algo, ndim, in_shape, k_shape, strides, dilations):
     return [(0, 0)] * ndim
 
 
+def conv_forward(x, w, *, strides, paddings, dilations, groups=1,
+                 data_format="NCHW", padding_algorithm="EXPLICIT",
+                 depthwise=False):
+    """The (non-transpose) conv2d/conv3d forward as a pure function —
+    the exact computation the ``conv2d`` lowering emits.  Shared with
+    ``fused_conv_bn_act`` (ops/fused_ops.py) so fusing a conv epilogue
+    can never change the conv itself: both paths call the same
+    ``lax.conv_general_dilated`` with the same dimension numbers, which
+    is what keeps ``FLAGS_tpu_fuse=0`` bit-for-bit."""
+    strides = list(strides)
+    dilations = list(dilations)
+    groups = groups or 1
+    nd = jnp.ndim(x) - 2
+    if data_format in ("NCHW", "NCDHW", "AnyLayout"):
+        lhs_spec = "NCHW" if nd == 2 else "NCDHW"
+    else:
+        lhs_spec = "NHWC" if nd == 2 else "NDHWC"
+    rhs_spec = "OIHW" if nd == 2 else "OIDHW"
+    dn = lax.conv_dimension_numbers(jnp.shape(x), jnp.shape(w),
+                                    (lhs_spec, rhs_spec, lhs_spec))
+    spatial_in = [jnp.shape(x)[i] for i in dn.lhs_spec[2:]]
+    k_spatial = [jnp.shape(w)[i] for i in dn.rhs_spec[2:]]
+    pads = _conv_padding(list(paddings), padding_algorithm, nd, spatial_in,
+                         k_spatial, strides, dilations)
+    if depthwise:
+        groups = jnp.shape(x)[1 if lhs_spec.startswith("NC") else -1]
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=pads,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+
+
 def _conv_lower(ctx, transpose=False):
     x = ctx.in_("Input")
     w = ctx.in_("Filter")
@@ -74,16 +110,11 @@ def _conv_lower(ctx, transpose=False):
     pads = _conv_padding(paddings, algo, nd, spatial_in, k_spatial, strides, dilations)
 
     if not transpose:
-        if ctx.op is not None and ctx.op.type == "depthwise_conv2d":
-            groups = jnp.shape(x)[1 if lhs_spec.startswith("NC") else -1]
-        out = lax.conv_general_dilated(
-            x, w,
-            window_strides=strides,
-            padding=pads,
-            rhs_dilation=dilations,
-            dimension_numbers=dn,
-            feature_group_count=groups,
-        )
+        out = conv_forward(
+            x, w, strides=strides, paddings=paddings, dilations=dilations,
+            groups=groups, data_format=data_format, padding_algorithm=algo,
+            depthwise=(ctx.op is not None
+                       and ctx.op.type == "depthwise_conv2d"))
     else:
         # conv_transpose: filter layout is (C_in, C_out/groups, *k)
         output_padding = ctx.attr("output_padding", []) or [0] * nd

@@ -894,6 +894,282 @@ def _paged_decode_call(q, k_pages, v_pages, block_tables, context_lens,
       q, k_pages, v_pages)
 
 
+# ==========================================================================
+# Fused epilogues (r14) — conv+BN+act and matmul+bias+act
+# ==========================================================================
+# The profile-ranked fusion layer (utils/cost_model.rank_fusion_candidates
+# -> framework/ir.py fuse_epilogue_pass) rewrites conv->BN(->add)->relu
+# and matmul->bias->act chains onto the fused ops in ops/fused_ops.py;
+# the kernels here are the TPU halves of those ops.  Two shapes of win
+# (MLPerf TPU-v3 pods, arXiv 1909.09756 §4: fuse the bandwidth-bound
+# epilogue into the surrounding compute):
+#
+# * ``bn_act_apply`` / ``bn_act_bwd_apply``: the BN scale/shift (+
+#   residual add) + activation applied per-channel in ONE VMEM pass over
+#   the conv output — the unfused chain pays a separate HBM read+write
+#   per epilogue op.  The conv itself stays ``lax.conv_general_dilated``
+#   (the MXU path XLA already schedules well); only the epilogue is
+#   hand-fused.  Works on the channel-last (NHWC — the layout pass's
+#   on-accelerator default) and channel-first tilings without
+#   transposing: the same kernel body sees (rows, C) or (1, C-block,
+#   cols) blocks and broadcasts the per-channel vectors either way.
+# * ``matmul_bias_act``: a tiled MXU matmul whose bias+activation
+#   epilogue is applied to the f32 VMEM accumulator before the single
+#   HBM write of the output tile.
+#
+# Engage rules follow paged_attention: kernel on TPU (or under
+# PT_PALLAS_INTERPRET=1); PT_FUSED_EPILOGUE=0 forces the jnp fallback,
+# =1 forces the kernel past the backend check; hard shape constraints
+# (block-divisible dims, sublane-multiple channels) always gate.  Every
+# entry point returns None when the kernel does not engage — the ops in
+# fused_ops.py then run the bit-identical jnp composition instead.
+
+_EPILOGUE_ROW_BLOCKS = (512, 256, 128, 8)
+_EPILOGUE_COL_BLOCKS = (512, 256, 128)
+_EPILOGUE_CH_BLOCKS = (256, 128, 64, 32, 16, 8)
+
+
+def _pick_div(n: int, candidates) -> int | None:
+    """Largest candidate that divides n (padding-free BlockSpecs only)."""
+    for c in candidates:
+        if n % c == 0:
+            return c
+    return None
+
+
+def _epilogue_engages() -> bool:
+    force = os.environ.get("PT_FUSED_EPILOGUE")
+    if force == "0":
+        return False
+    return _use_pallas() or force == "1"
+
+
+def apply_act(y, act: str):
+    """The in-kernel (and fallback) activation menu.  ``relu`` uses the
+    exact ``jnp.maximum(y, 0)`` form of the fused BN ops so kernel and
+    fallback stay term-for-term identical."""
+    if not act:
+        return y
+    if act == "relu":
+        return jnp.maximum(y, jnp.zeros((), y.dtype))
+    if act == "sigmoid":
+        return jax.nn.sigmoid(y)
+    if act == "tanh":
+        return jnp.tanh(y)
+    if act == "gelu":
+        return jax.nn.gelu(y, approximate=False)
+    raise NotImplementedError(f"fused epilogue act {act!r}")
+
+
+def _act_mask_grad(y, dy, act: str):
+    """g = act'(y) * dy from the SAVED OUTPUT y — exactly the grad form
+    the unfused relu_grad/activation chains compute, so the fused
+    backward epilogue stays bit-compatible with the fallback."""
+    if not act:
+        return dy
+    if act == "relu":
+        return jnp.where(y > jnp.zeros((), y.dtype), dy,
+                         jnp.zeros((), dy.dtype))
+    raise NotImplementedError(f"fused epilogue act grad {act!r}")
+
+
+def _scale_shift_act_kernel(x_ref, a_ref, b_ref, z_ref, o_ref, *, act):
+    """One VMEM tile of y = act(x*a + b [+ z]): a/b broadcast over rows
+    (channels-last blocks) or columns (channels-first blocks)."""
+    y = x_ref[...] * a_ref[...] + b_ref[...]
+    if z_ref is not None:
+        y = y + z_ref[...]
+    o_ref[...] = apply_act(y, act).astype(o_ref.dtype)
+
+
+def _wrap_optional_mid(body, n_lead, has_opt):
+    """Adapter: positional refs -> body(lead..., opt_ref or None, rest)."""
+
+    def kernel(*refs):
+        lead = list(refs[:n_lead])
+        opt = refs[n_lead] if has_opt else None
+        rest = refs[n_lead + 1 if has_opt else n_lead:]
+        body(*lead, opt, *rest)
+
+    return kernel
+
+
+def _channel_tiling(x, c_axis):
+    """(x_tiled, per-channel broadcast shape, specs, grid, restore) for a
+    per-channel VMEM walk over ``x``, or None when no padding-free tiling
+    exists.  channels-last: (M, C) rows blocks; channels-first:
+    (B, C, L) with (1, bc, bl) blocks."""
+    shape = jnp.shape(x)
+    nd = len(shape)
+    c = shape[c_axis]
+    if c_axis == nd - 1:
+        m = 1
+        for d in shape[:-1]:
+            m *= d
+        if c % 8 != 0:
+            return None
+        bm = _pick_div(m, _EPILOGUE_ROW_BLOCKS)
+        if bm is None:
+            return None
+        x2 = jnp.reshape(x, (m, c))
+        vec_shape = (1, c)
+        vec_spec = pl.BlockSpec((1, c), lambda i: (0, 0))
+        dat_spec = pl.BlockSpec((bm, c), lambda i: (i, 0))
+        return x2, vec_shape, dat_spec, vec_spec, (m // bm,), shape
+    if c_axis == 1 and nd >= 2:
+        b0 = shape[0]
+        l = 1
+        for d in shape[2:]:
+            l *= d
+        bl = _pick_div(l, _EPILOGUE_COL_BLOCKS)
+        bc = _pick_div(c, _EPILOGUE_CH_BLOCKS)
+        if bl is None or bc is None:
+            return None
+        x3 = jnp.reshape(x, (b0, c, l))
+        vec_shape = (1, c, 1)
+        vec_spec = pl.BlockSpec((1, bc, 1), lambda n, ci, li: (0, ci, 0))
+        dat_spec = pl.BlockSpec((1, bc, bl), lambda n, ci, li: (n, ci, li))
+        return x3, vec_shape, dat_spec, vec_spec, \
+            (b0, c // bc, l // bl), shape
+    return None
+
+
+def bn_act_apply(x, a, b, z=None, act="relu", c_axis=1):
+    """Pallas fused-epilogue forward: y = act(x*a + b [+ z]) with
+    per-channel a/b (already cast to x.dtype — the fused BN fold).
+    Returns None when the kernel does not engage; the caller must then
+    run the identical jnp composition."""
+    if not _epilogue_engages():
+        return None
+    tiling = _channel_tiling(x, c_axis)
+    if tiling is None:
+        return None
+    xt, vec_shape, dat_spec, vec_spec, grid, shape = tiling
+    a_t = jnp.reshape(a, vec_shape)
+    b_t = jnp.reshape(b, vec_shape)
+    in_specs = [dat_spec, vec_spec, vec_spec]
+    args = [xt, a_t, b_t]
+    if z is not None:
+        in_specs.append(dat_spec)
+        args.append(jnp.reshape(z, jnp.shape(xt)))
+    out = pl.pallas_call(
+        _wrap_optional_mid(
+            functools.partial(_scale_shift_act_kernel, act=act),
+            3, z is not None),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=dat_spec,
+        out_shape=jax.ShapeDtypeStruct(jnp.shape(xt), x.dtype),
+        interpret=_interpret(),
+    )(*args)
+    return jnp.reshape(out, shape)
+
+
+def _bn_act_bwd_kernel(y_ref, dy_ref, x_ref, cg_ref, mean_ref, cx_ref,
+                       c0_ref, dx_ref, g_ref, *, act, want_g):
+    """One VMEM tile of the fused backward epilogue:
+    g = act'(y)*dy;  dx = g*cg + (x - mean)*cx + c0 — the dX affine of
+    the BN backward with the batch-stat corrections folded into the
+    per-channel vectors (computed once outside)."""
+    g = _act_mask_grad(y_ref[...], dy_ref[...], act)
+    dx = (g * cg_ref[...]
+          + (x_ref[...] - mean_ref[...]) * cx_ref[...]
+          + c0_ref[...].astype(g.dtype))
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    if want_g:
+        g_ref[...] = g.astype(g_ref.dtype)
+
+
+def bn_act_bwd_apply(y, dy, x, cg, mean, cx, c0, act="relu", c_axis=1,
+                     want_g=False):
+    """Pallas fused-epilogue backward: one pass over (y, dy, x) emitting
+    dx (and g — the residual-add gradient — when ``want_g``).  The
+    per-channel vectors carry the already-reduced BN terms: cg = scale *
+    inv_std (g.dtype), mean (x.dtype), cx = -scale*inv^2*sgx/n (x.dtype),
+    c0 = -scale*inv*sg/n (f32) — the same terms the jnp fallback uses.
+    Returns None when the kernel does not engage."""
+    if not _epilogue_engages():
+        return None
+    tiling = _channel_tiling(x, c_axis)
+    if tiling is None:
+        return None
+    xt, vec_shape, dat_spec, vec_spec, grid, shape = tiling
+    args = [jnp.reshape(y, jnp.shape(xt)), jnp.reshape(dy, jnp.shape(xt)),
+            xt, jnp.reshape(cg, vec_shape), jnp.reshape(mean, vec_shape),
+            jnp.reshape(cx, vec_shape), jnp.reshape(c0, vec_shape)]
+    in_specs = [dat_spec, dat_spec, dat_spec,
+                vec_spec, vec_spec, vec_spec, vec_spec]
+    out_specs = [dat_spec]
+    out_shape = [jax.ShapeDtypeStruct(jnp.shape(xt), x.dtype)]
+    if want_g:
+        out_specs.append(dat_spec)
+        out_shape.append(jax.ShapeDtypeStruct(jnp.shape(xt), dy.dtype))
+    outs = pl.pallas_call(
+        functools.partial(_bn_act_bwd_kernel, act=act, want_g=want_g)
+        if want_g else
+        (lambda *refs: _bn_act_bwd_kernel(*refs, None, act=act,
+                                          want_g=False)),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(*args)
+    if want_g:
+        return (jnp.reshape(outs[0], shape), jnp.reshape(outs[1], shape))
+    return (jnp.reshape(outs[0], shape), None)
+
+
+def _matmul_bias_act_kernel(x_ref, w_ref, b_ref, o_ref, acc_scr, *,
+                            act, n_k):
+    """Tiled matmul with the bias+activation epilogue applied to the f32
+    VMEM accumulator on the last k step — one HBM write per output tile,
+    no separate bias/act passes."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    acc_scr[...] += lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _done():
+        y = acc_scr[...] + b_ref[...].astype(jnp.float32)
+        o_ref[...] = apply_act(y, act).astype(o_ref.dtype)
+
+
+def matmul_bias_act(x, w, bias, act=""):
+    """Pallas fused matmul+bias+activation over 2-D operands: x (M, K)
+    @ w (K, N) + bias (N,) -> act.  Returns None when the kernel does
+    not engage (off-TPU, or no padding-free block tiling exists)."""
+    if not _epilogue_engages():
+        return None
+    m, k = jnp.shape(x)
+    n = jnp.shape(w)[1]
+    bm = _pick_div(m, _EPILOGUE_ROW_BLOCKS)
+    bk = _pick_div(k, _EPILOGUE_COL_BLOCKS)
+    bn = _pick_div(n, (256, 128))
+    if bm is None or bk is None or bn is None:
+        return None
+    out_dtype = jnp.result_type(x, w)
+    return pl.pallas_call(
+        functools.partial(_matmul_bias_act_kernel, act=act, n_k=k // bk),
+        grid=(m // bm, n // bn, k // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, ki: (i, ki)),
+            pl.BlockSpec((bk, bn), lambda i, j, ki: (ki, j)),
+            pl.BlockSpec((1, bn), lambda i, j, ki: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, ki: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=_interpret(),
+    )(x, w, jnp.reshape(bias, (1, n)))
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                     scale=None):
     """Ragged paged attention for decode (one query token per sequence).

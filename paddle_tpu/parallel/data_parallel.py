@@ -407,6 +407,7 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
 
     key = (program._uid, program._version, feed_spec, tuple(fetch_names),
            _mesh_fingerprint(mesh), shard_sig, executor._nhwc_enabled(),
+           executor._tpu_fuse_enabled(),
            compiled_program.__dict__.get("_ir_passes", True),
            bool(flag("apply_ir_passes")), int(flag("dp_sharding") or 0),
            bool(flag("dp_comm_overlap")),

@@ -49,6 +49,8 @@ _MATMUL_OPS: Dict[str, Tuple[str, str, float]] = {
     "mul_grad": ("X", "Y", 2.0),
     "matmul_grad": ("X", "Y", 2.0),
     "matmul_v2_grad": ("X", "Y", 2.0),
+    "fused_matmul_bias_act": ("X", "Y", 1.0),
+    "fused_matmul_bias_act_grad": ("X", "Y", 2.0),
 }
 
 
@@ -158,11 +160,62 @@ def _numel(dims) -> int:
     return n
 
 
+#: Epilogue-shaped ops whose real HBM traffic the generic "touched
+#: bytes" default mis-states (r14 fix): the default sums EVERY declared
+#: input/output, but batch_norm's small per-channel vectors are noise
+#: while its big tensor is re-read for the normalize pass after the
+#: stats pass, and grad ops re-read the forward tensors for the
+#: reduction pass before the dx pass.  Each entry: (main-tensor slot,
+#: passes over that tensor, flops per element).  One "pass" = one full
+#: HBM read or write of the main tensor; these are exactly the numbers
+#: ``rank_fusion_candidates`` compares, so mis-stating them mis-ranks
+#: the conv+BN+act chains the fusion layer targets.
+_EPILOGUE_TRAFFIC: Dict[str, Tuple[str, float, float]] = {
+    # train BN: stats read + normalize read + y write
+    "batch_norm": ("X", 3.0, 8.0),
+    # reductions read (x, dy) + dx pass reads (x, dy) + dx write
+    "batch_norm_grad": ("X", 5.0, 12.0),
+    "fused_batch_norm_act": ("X", 3.0, 9.0),
+    "fused_batch_norm_act_grad": ("X", 5.0, 13.0),
+    # + z read / dz write
+    "fused_bn_add_activation": ("X", 4.0, 10.0),
+    "fused_bn_add_activation_grad": ("X", 6.0, 13.0),
+    # activation grads: read (out, dout), write dx — the declared X
+    # input is never touched by the jnp lowering's vjp
+    "relu_grad": ("Out", 3.0, 1.0),
+    "leaky_relu_grad": ("Out", 3.0, 1.0),
+    "sigmoid_grad": ("Out", 3.0, 2.0),
+    "tanh_grad": ("Out", 3.0, 2.0),
+    "gelu_grad": ("Out", 3.0, 6.0),
+    "elu_grad": ("Out", 3.0, 2.0),
+    # read dout, write (dx, dy) — Out/X/Y are pass-through declarations
+    "elementwise_add_grad": ("Out", 3.0, 1.0),
+}
+
+
+def _main_dims(op_, block, slot, assumed_batch):
+    names = op_.inputs.get(slot) or op_.outputs.get(slot) or [None]
+    return _dims(block, names[0], assumed_batch) if names[0] else None
+
+
 def op_flops_bytes(op_, block, assumed_batch=64) -> Tuple[float, float]:
     """(flops, moved bytes) for one compute op.  GEMM-shaped ops get
-    2*M*K*N flops; conv2d gets 2*out_elems*receptive-field; everything
-    else is elementwise over its touched bytes (4 B/elem assumed — the
-    model cares about ratios, not dtypes)."""
+    2*M*K*N flops; conv2d gets 2*out_elems*receptive-field; epilogue
+    ops (BN, activation grads) get the pass-accurate table above;
+    everything else is elementwise over its touched bytes (4 B/elem
+    assumed — the model cares about ratios, not dtypes)."""
+    ep = _EPILOGUE_TRAFFIC.get(op_.type)
+    if ep is not None:
+        slot, passes, flops_per_elem = ep
+        dims = _main_dims(op_, block, slot, assumed_batch)
+        if dims:
+            if (op_.type.startswith(("batch_norm", "fused_batch_norm",
+                                     "fused_bn_add"))
+                    and (op_.attrs.get("is_test")
+                         or op_.attrs.get("use_global_stats"))):
+                passes -= 1.0  # frozen stats: no stats pass (any BN kind)
+            numel = _numel(dims)
+            return flops_per_elem * numel, passes * numel * 4.0
     touched = 0
     for names in list(op_.inputs.values()) + list(op_.outputs.values()):
         for n in names:
@@ -184,8 +237,10 @@ def op_flops_bytes(op_, block, assumed_batch=64) -> Tuple[float, float]:
             n = rd[-1]
             return 2.0 * m * k * n * mult, float(touched)
     if op_.type in ("conv2d", "depthwise_conv2d", "conv2d_grad",
-                    "depthwise_conv2d_grad"):
-        out_slot = "Output" if "Output" in op_.outputs else "Out"
+                    "depthwise_conv2d_grad", "fused_conv_bn_act",
+                    "fused_conv_bn_act_grad"):
+        out_slot = "Output" if ("Output" in op_.outputs
+                                or "Output" in op_.inputs) else "Out"
         out = op_.outputs.get(out_slot, [None])[0] or \
             op_.inputs.get(out_slot, [None])[0]
         fil = op_.inputs.get("Filter", [None])[0]
@@ -228,6 +283,321 @@ def collective_time_s(payload_bytes: float, ring_factor: float, nranks: int,
     reduce-scatter/all-gather (matches tools/dp_comm_stats._RING_FACTOR)."""
     ring = (nranks - 1) / float(nranks) if nranks > 1 else 0.0
     return cm.launch_s + ring_factor * ring * payload_bytes / cm.ici_bytes_per_s
+
+
+# ==========================================================================
+# Profile-ranked epilogue-fusion candidates (r14)
+# ==========================================================================
+# ``find_fusion_chains`` is the structural half: walk a block for the
+# chains the Pallas fusion layer can rewrite — conv2d -> batch_norm /
+# fused_batch_norm_act / fused_bn_add_activation (with the matching grad
+# pair), and mul/matmul -> elementwise_add(1-D bias) -> activation (with
+# its grad triple).  ``rank_fusion_candidates`` is the measurement half:
+# score each chain by modeled memory-traffic savings at the cost model's
+# (profile-calibrated, see default_cost_model) HBM rate, preferring
+# measured per-op self-times when the profile carries them.  The
+# framework/ir.py fuse_epilogue_pass consumes the ranking; the finder
+# lives HERE so the ranking and the rewrite can never disagree about
+# what a fusible chain is.
+
+#: bn-shaped ops a conv epilogue can absorb.  Plain ``batch_norm`` is
+#: matched only with a trailing ``relu`` (the raw conv->BN->ReLU triple,
+#: for programs the fuse_bn_act passes haven't visited): a ReLU-less BN
+#: keeps its generic-vjp backward under FLAGS_tpu_fuse=0, and rewriting
+#: it onto the closed-form fused backward would break the flag's
+#: bit-for-bit contract.
+_BN_OPS = ("batch_norm", "fused_batch_norm_act", "fused_bn_add_activation")
+#: activations the fused matmul epilogue supports
+FUSABLE_ACTS = ("relu", "sigmoid", "tanh", "gelu")
+
+
+def _consumer_map(ops) -> Dict[str, List]:
+    cons: Dict[str, List] = {}
+    for op_ in ops:
+        for names in op_.inputs.values():
+            for n in names:
+                cons.setdefault(n, []).append(op_)
+    return cons
+
+
+def _only(users, allowed) -> bool:
+    allowed_ids = {id(a) for a in allowed if a is not None}
+    return all(id(u) in allowed_ids for u in users)
+
+
+def _first(users, pred):
+    return next((u for u in users if pred(u)), None)
+
+
+def _conv_chain(conv, cons, block):
+    y0 = conv.outputs.get("Output", [None])[0]
+    if not y0 or y0 == "@EMPTY@":
+        return None
+    users = cons.get(y0, [])
+    bn = _first(users, lambda o: o.type in _BN_OPS
+                and o.inputs.get("X", [None])[0] == y0)
+    if bn is None:
+        return None
+    cf = conv.attrs.get("data_format", "NCHW")
+    if bn.attrs.get("data_layout", "NCHW") != cf:
+        return None  # mixed-layout chain: the fused op has ONE layout attr
+    if bn.type != "batch_norm" and \
+            bn.attrs.get("act_type", "relu") != "relu":
+        return None
+    bn_grad = _first(users, lambda o: o.type == bn.type + "_grad"
+                     and o.inputs.get("X", [None])[0] == y0)
+    conv_grad = _first(users, lambda o: o.type == conv.type + "_grad"
+                       and o.inputs.get("Output", [None])[0] == y0)
+    if not _only(users, (bn, bn_grad, conv_grad)):
+        return None
+    if (bn_grad is None) != (conv_grad is None):
+        return None  # half a backward: leave it alone
+    bn_y = bn.outputs.get("Y", [None])[0]
+    act_op = act_grad = None
+    out = bn_y
+    if bn.type == "batch_norm":
+        # the raw triple: BN must feed a relu (fusing a ReLU-less plain
+        # BN would swap its generic-vjp backward for the closed form)
+        b_users = cons.get(bn_y, [])
+        act_op = _first(b_users, lambda o: o.type == "relu"
+                        and o.inputs.get("X", [None])[0] == bn_y)
+        if act_op is None:
+            return None
+        act_grad = _first(b_users, lambda o: o.type == "relu_grad"
+                          and o.inputs.get("X", [None])[0] == bn_y)
+        if not _only(b_users, (act_op, act_grad, bn_grad)):
+            return None
+        if (act_grad is None) != (bn_grad is None):
+            return None
+        out = act_op.outputs["Out"][0]
+        if bn_grad is not None:
+            dy1 = act_grad.outputs.get("X@GRAD", [None])[0]
+            if (not dy1 or bn_grad.inputs.get("Y@GRAD", [None])[0] != dy1
+                    or not _only(cons.get(dy1, []), (bn_grad,))
+                    or act_grad.inputs.get("Out", [None])[0] != out):
+                return None
+    if bn_grad is not None:
+        # the BN backward's dX must feed exactly conv_grad's Output@GRAD
+        dy0 = bn_grad.outputs.get("X@GRAD", [None])[0]
+        if (not dy0 or dy0 == "@EMPTY@"
+                or conv_grad.inputs.get("Output@GRAD", [None])[0] != dy0
+                or not _only(cons.get(dy0, []), (conv_grad,))):
+            return None
+        if bn.type != "batch_norm" and \
+                bn_grad.inputs.get("Y", [None])[0] != out:
+            return None
+    z = bn.inputs.get("Z", [None])[0] if bn.type == "fused_bn_add_activation" \
+        else None
+    return {
+        "kind": "conv_bn_act", "conv": conv, "bn": bn,
+        "conv_grad": conv_grad, "bn_grad": bn_grad,
+        "act_op": act_op, "act_grad": act_grad,
+        "act": "relu", "z": z, "conv_out": y0,
+        "bn_y": bn_y if act_op is not None else None, "out": out,
+        "dconv": (bn_grad.outputs["X@GRAD"][0] if bn_grad is not None
+                  else None),
+    }
+
+
+def _matmul_ok(op_, block):
+    if op_.type == "mul":
+        return int(op_.attrs.get("y_num_col_dims", 1)) == 1
+    if op_.type in ("matmul", "matmul_v2"):
+        if op_.attrs.get("transpose_X") or op_.attrs.get("transpose_Y") or \
+                op_.attrs.get("trans_x") or op_.attrs.get("trans_y"):
+            return False
+        if float(op_.attrs.get("alpha", 1.0) or 1.0) != 1.0:
+            return False
+        xv = block._find_var_recursive(op_.inputs.get("X", [None])[0] or "")
+        return xv is not None and xv.shape is not None and len(xv.shape) == 2
+    return False
+
+
+def _matmul_chain(mm, cons, block):
+    if not _matmul_ok(mm, block):
+        return None
+    y0 = mm.outputs.get("Out", [None])[0]
+    wv = block._find_var_recursive(mm.inputs.get("Y", [None])[0] or "")
+    if not y0 or wv is None or wv.shape is None or len(wv.shape) != 2:
+        return None
+    users = cons.get(y0, [])
+    xnc = int(mm.attrs.get("x_num_col_dims", 1))
+
+    def _bias_add(o):
+        if o.type != "elementwise_add" or o.inputs.get("X", [None])[0] != y0:
+            return False
+        bvar = block._find_var_recursive(o.inputs.get("Y", [None])[0] or "")
+        if bvar is None or bvar.shape is None or len(bvar.shape) != 1:
+            return False
+        return int(o.attrs.get("axis", -1)) in (-1, xnc)
+
+    add = _first(users, _bias_add)
+    if add is None:
+        return None
+    mm_grad = _first(users, lambda o: o.type == mm.type + "_grad")
+    add_grad = _first(users, lambda o: o.type == "elementwise_add_grad"
+                      and o.inputs.get("X", [None])[0] == y0)
+    if not _only(users, (add, add_grad, mm_grad)):
+        return None
+    ya = add.outputs["Out"][0]
+    a_users = cons.get(ya, [])
+    act_op = _first(a_users, lambda o: o.type in FUSABLE_ACTS
+                    and o.inputs.get("X", [None])[0] == ya)
+    if act_op is None:
+        return None
+    if act_op.type == "gelu" and act_op.attrs.get("approximate"):
+        return None  # kernel/fallback implement the exact erf form only
+    act_grad = _first(a_users, lambda o: o.type == act_op.type + "_grad"
+                      and o.inputs.get("X", [None])[0] == ya)
+    if not _only(a_users, (act_op, act_grad, add_grad)):
+        return None
+    grads = (act_grad, add_grad, mm_grad)
+    if any(g is None for g in grads) != all(g is None for g in grads):
+        return None  # partial backward
+    y1 = act_op.outputs["Out"][0]
+    if act_grad is not None:
+        dya = act_grad.outputs.get("X@GRAD", [None])[0]
+        if (not dya or add_grad.inputs.get("Out@GRAD", [None])[0] != dya
+                or not _only(cons.get(dya, []), (add_grad,))):
+            return None
+        dy0 = add_grad.outputs.get("X@GRAD", [None])[0]
+        if (not dy0 or mm_grad.inputs.get("Out@GRAD", [None])[0] != dy0
+                or not _only(cons.get(dy0, []), (mm_grad,))):
+            return None
+        if act_grad.inputs.get("Out", [None])[0] != y1:
+            return None
+    return {
+        "kind": "matmul_bias_act", "mm": mm, "add": add, "act_op": act_op,
+        "mm_grad": mm_grad, "add_grad": add_grad, "act_grad": act_grad,
+        "act": act_op.type, "mm_out": y0, "add_out": ya, "out": y1,
+        "xnc": xnc,
+    }
+
+
+def find_fusion_chains(block) -> List[dict]:
+    """Structural matches for every epilogue-fusable chain in ``block``
+    (fwd + the matching grad chain, or fwd-only in inference programs).
+    Safety here covers dataflow exclusivity; the IR pass adds the
+    protected/fetch and cross-block checks before rewriting."""
+    cons = _consumer_map(block.ops)
+    chains = []
+    for op_ in block.ops:
+        if op_.type in ("conv2d", "depthwise_conv2d"):
+            ch = _conv_chain(op_, cons, block)
+        elif op_.type in ("mul", "matmul", "matmul_v2"):
+            ch = _matmul_chain(op_, cons, block)
+        else:
+            ch = None
+        if ch is not None:
+            chains.append(ch)
+    return chains
+
+
+def chain_saved_traffic(chain, block, assumed_batch=64) -> dict:
+    """Modeled HBM bytes the fused rewrite stops moving, per
+    intermediate.  One saved "pass" = one full read or write of that
+    tensor at 4 B/elem.  conv chains: the conv output's separate
+    normalize-pass re-read folds into the single fused epilogue pass
+    (2 passes when frozen stats let the whole tensor die), and the grad
+    chain's dX-of-BN intermediate becomes kernel-internal (write+read).
+    matmul chains: the matmul output and the pre-act bias sum (and
+    their grad cotangents) all become tile-internal."""
+
+    def nbytes(name):
+        dims = _dims(block, name, assumed_batch)
+        return _numel(dims) * 4 if dims else 0
+
+    saved = {}
+    if chain["kind"] == "conv_bn_act":
+        frozen = bool(chain["bn"].attrs.get("is_test")
+                      or chain["bn"].attrs.get("use_global_stats"))
+        saved[chain["conv_out"]] = nbytes(chain["conv_out"]) * \
+            (2.0 if frozen else 1.0)
+        if chain.get("bn_y"):  # raw triple: the pre-relu BN output dies
+            saved[chain["bn_y"]] = nbytes(chain["bn_y"]) * 2.0
+            if chain["act_grad"] is not None:
+                saved[chain["bn_y"] + "@GRAD"] = nbytes(chain["bn_y"]) * 2.0
+        if chain["bn_grad"] is not None:
+            saved[chain["dconv"]] = nbytes(chain["dconv"]) * 2.0
+    else:
+        saved[chain["mm_out"]] = nbytes(chain["mm_out"]) * 2.0
+        saved[chain["add_out"]] = nbytes(chain["add_out"]) * 2.0
+        if chain["act_grad"] is not None:
+            saved[chain["add_out"] + "@GRAD"] = nbytes(chain["add_out"]) * 2.0
+            saved[chain["mm_out"] + "@GRAD"] = nbytes(chain["mm_out"]) * 2.0
+    return {"per_tensor": saved,
+            "total_bytes": float(sum(saved.values()))}
+
+
+def rank_fusion_candidates(program, profile=None,
+                           cm: Optional[CostModel] = None) -> List[dict]:
+    """Rank every fusible chain in ``program`` by modeled+measured
+    memory-traffic savings, best first.
+
+    ``profile``: a measured-profile dict (``measured_profile()`` shape);
+    defaults to the store the profiler feeds.  With a profile the cost
+    model is rescaled against the measured step (``default_cost_model``)
+    and, when ``per_op_s`` carries mean self-times for the chain's
+    epilogue op types, the measured time wins over the modeled one.
+    Returns dicts: kind / op types / saved_bytes / est_saved_s /
+    measured_epilogue_s / score_s / calibrated, plus the raw ``chain``
+    match for the IR pass."""
+    block = program.global_block()
+    ops = list(block.ops)
+    if profile is None:
+        profile = measured_profile()
+    if cm is None:
+        cm = CostModel()
+        if profile:
+            _, modeled = backward_timeline(ops, block, cm)
+            cm = cm.calibrated(profile["step_s"], modeled)
+    per_op = dict((profile or {}).get("per_op_s") or {})
+    # Measured per-op self-times are means PER OP TYPE (the profiler's
+    # event aggregation) — apportion each type's measured time across
+    # the chains touching it by their share of that type's modeled
+    # bytes, so same-typed chains of different sizes still rank by
+    # size instead of collapsing into a tie.
+    raw = []
+    type_bytes_total: Dict[str, float] = {}
+    for chain in find_fusion_chains(block):
+        if chain["kind"] == "conv_bn_act":
+            ep_ops = [chain["bn"], chain["act_op"], chain["bn_grad"],
+                      chain["act_grad"]]
+        else:
+            ep_ops = [chain["add"], chain["act_op"], chain["add_grad"],
+                      chain["act_grad"]]
+        ep_ops = [o for o in ep_ops if o is not None]
+        ep_bytes = {}
+        for o in ep_ops:
+            _, nbytes = op_flops_bytes(o, block, cm.assumed_batch)
+            ep_bytes[o.type] = ep_bytes.get(o.type, 0.0) + nbytes
+            type_bytes_total[o.type] = \
+                type_bytes_total.get(o.type, 0.0) + nbytes
+        raw.append((chain, ep_ops, ep_bytes))
+    out = []
+    for chain, ep_ops, ep_bytes in raw:
+        traffic = chain_saved_traffic(chain, block, cm.assumed_batch)
+        est_s = traffic["total_bytes"] / cm.hbm_bytes_per_s
+        types = [o.type for o in ep_ops]
+        measured = sum(
+            per_op[t] * (b / type_bytes_total[t])
+            for t, b in ep_bytes.items()
+            if t in per_op and type_bytes_total[t] > 0)
+        out.append({
+            "kind": chain["kind"],
+            "ops": [chain["conv"].type if chain["kind"] == "conv_bn_act"
+                    else chain["mm"].type] + types,
+            "out": chain["out"],
+            "saved_bytes": int(traffic["total_bytes"]),
+            "per_tensor": traffic["per_tensor"],
+            "est_saved_s": est_s,
+            "measured_epilogue_s": measured or None,
+            "score_s": measured if measured else est_s,
+            "calibrated": bool(profile),
+            "chain": chain,
+        })
+    out.sort(key=lambda r: -r["score_s"])
+    return out
 
 
 def model_comm_stream(buckets: Sequence[dict], t_backward_end: float,

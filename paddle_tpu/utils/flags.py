@@ -65,6 +65,21 @@ _DEFAULTS: Dict[str, Any] = {
     # Executor.run calls (zero scope reads per steady-state step).  Off
     # restores the per-step scope.get rebind path.
     "FLAGS_tpu_step_session": True,
+    # profile-ranked Pallas epilogue fusion (framework/ir.py
+    # fuse_epilogue_pass): rewrite conv2d->batch_norm(->add)->relu and
+    # matmul/mul->elementwise_add->activation chains (fwd AND the
+    # matching grad chains) into the fused_conv_bn_act /
+    # fused_matmul_bias_act ops, ranked by utils/cost_model.py
+    # rank_fusion_candidates.  "auto" enables it when the executor place
+    # is an accelerator (like FLAGS_tpu_nhwc); "1"/"0" force on/off.
+    # "0" restores the unfused pipeline bit-for-bit.
+    "FLAGS_tpu_fuse": "auto",
+    # input-pipeline double buffering (executor.py double_buffered_feeds):
+    # batch k+1's feed staging (dtype cast + device_put_owned — the
+    # donation-safe copy, see executor.device_put_owned) runs on a
+    # background thread while step k's dispatch is in flight.  0 stages
+    # synchronously on the caller's thread — same values, no overlap.
+    "FLAGS_tpu_double_buffer": True,
     # Sharded data parallelism over the 'dp' mesh axis (the Fleet
     # `sharding` strategy analog), staged like fleet sharding_stage /
     # ZeRO:
@@ -142,6 +157,25 @@ def nhwc_enabled(place=None) -> bool:
     """Resolve FLAGS_tpu_nhwc against the executor place ("auto" means
     on-accelerator only; truthy forces on, falsy off)."""
     v = flag("tpu_nhwc")
+    if isinstance(v, str):
+        s = v.strip().lower()
+        if s == "auto":
+            if place is None:
+                return False
+            try:
+                return place.jax_device().platform != "cpu"
+            except Exception:
+                return False
+        return s in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+def tpu_fuse_enabled(place=None) -> bool:
+    """Resolve FLAGS_tpu_fuse against the executor place ("auto" means
+    on-accelerator only; truthy forces on, falsy off) — the same
+    contract as :func:`nhwc_enabled` so the two fusion levers A/B the
+    same way."""
+    v = flag("tpu_fuse")
     if isinstance(v, str):
         s = v.strip().lower()
         if s == "auto":

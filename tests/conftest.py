@@ -39,6 +39,13 @@ def live_plugin_candidates(cands):
     return [c for c in cands if PJRT_PLUGIN_STATUS.get(c) != "dead"]
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (`-m 'not slow'`) — heavier "
+        "whole-model runs kept runnable on demand")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs + scope + name generator."""

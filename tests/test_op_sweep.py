@@ -975,6 +975,11 @@ COVERED_ELSEWHERE = {
     # fused BN(+add)+act — tests/test_fused_bn.py
     "fused_batch_norm_act": "test_fused_bn",
     "fused_bn_add_activation": "test_fused_bn",
+    # r14 fused epilogues (conv+BN+act, matmul+bias+act) —
+    # tests/test_fused_epilogue.py: kernel parity, program bit-identity,
+    # grad-vs-unfused checks
+    "fused_conv_bn_act": "test_fused_epilogue",
+    "fused_matmul_bias_act": "test_fused_epilogue",
     # pass-produced fused ops — tests/test_ir_pass.py
     "fused_embedding_eltwise_layernorm": "test_ir_pass",
     "fused_sgd": "test_ir_pass", "fused_momentum": "test_ir_pass",

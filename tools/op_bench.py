@@ -1,19 +1,27 @@
 """Config-driven per-op micro-benchmark (the reference's
-paddle/fluid/operators/benchmark/op_tester.cc analog).
+paddle/fluid/operators/benchmark/op_tester.cc analog) + the r14
+one-lever-at-a-time A/B harness for the epilogue-fusion layer.
 
 Usage:
     python tools/op_bench.py                      # built-in hot-op table
     python tools/op_bench.py --config cfg.json    # custom op list
     python tools/op_bench.py --op matmul --shape X=128,768 --shape Y=768,768
 
-A config entry mirrors op_tester's config format in JSON:
-    {"op": "matmul", "repeat": 50,
-     "inputs": {"X": {"shape": [128, 768]}, "Y": {"shape": [768, 768]}},
-     "attrs": {"transpose_Y": false}}
+    # r14 A/B levers: fused-vs-unfused per chain kind, double-buffer
+    # on/off — ONE lever per run line, everything else held fixed:
+    python tools/op_bench.py --ab all [--quick] [--calibrate]
 
 Each op runs through the SAME lowering registry the executor uses
 (ops.registry.eager_call), jitted, so timings reflect the real kernel
-XLA emits for that op in isolation.
+XLA emits for that op in isolation.  Each --ab lever runs a whole train
+program through the Executor pipeline with exactly one flag flipped
+(FLAGS_tpu_fuse / FLAGS_tpu_double_buffer) and emits one stable
+``OPBENCH={json}`` line (the ``BENCH=``/``SERVING=`` convention) with
+wall times, fused-op counts, modeled memory-traffic savings from
+``utils/cost_model.rank_fusion_candidates``, and a value-parity verdict.
+``--calibrate`` feeds a measured step into the cost-model store first
+(``cost_model.set_measured_profile``), so the reported rankings use
+measured rates — the profile -> rank -> fuse -> A/B loop end to end.
 """
 from __future__ import annotations
 
@@ -171,6 +179,208 @@ def bench_entry(entry, repeat=None, warmup=3):
                        entry.get("inputs", {}).items()}}
 
 
+# ==========================================================================
+# r14 A/B levers — fused epilogues and input double-buffering
+# ==========================================================================
+def _build_conv_net(image, channels, classes=10):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, image, image])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        x = fluid.layers.conv2d(img, channels, 3, padding=1,
+                                bias_attr=False)
+        x = fluid.layers.batch_norm(x, act="relu")
+        x = fluid.layers.conv2d(x, channels, 3, padding=1, bias_attr=False)
+        x = fluid.layers.batch_norm(x, act="relu")
+        x = fluid.layers.pool2d(x, pool_type="avg", global_pooling=True)
+        logits = fluid.layers.fc(x, classes)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _build_mlp(width, classes=10):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [width])
+        label = fluid.layers.data("label", [1], dtype="int64")
+        h = fluid.layers.fc(x, width, act="relu")
+        h = fluid.layers.fc(h, width, act="relu")
+        logits = fluid.layers.fc(h, classes)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _run_config(build, feed, steps, flag_updates):
+    """Fresh scope + executor per config (compile caches key on flags,
+    but a fresh Executor keeps the A/B airtight); returns (losses,
+    ms/step, rewritten-program op-type counts)."""
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.utils import flags as ptflags
+
+    ptflags.set_flags(flag_updates)
+    main, startup, loss = build()
+    exe = fluid.Executor(pt.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed=feed,
+                                fetch_list=[loss.name])[0])]
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            losses.append(float(exe.run(main, feed=feed,
+                                        fetch_list=[loss.name])[0]))
+        dt = (time.perf_counter() - t0) / steps
+    rew = exe._apply_ir_passes(main, [loss.name])
+    types = {}
+    for o in rew.global_block().ops:
+        types[o.type] = types.get(o.type, 0) + 1
+    return losses, dt * 1e3, types, (main, exe, loss)
+
+
+def _rank_summary(main, exe, loss):
+    """Modeled per-chain savings on the UNFUSED rewritten program — the
+    numbers the fuse pass ranked by."""
+    from paddle_tpu.utils import cost_model, flags as ptflags
+
+    ptflags.set_flags({"tpu_fuse": "0"})
+    rew = exe._apply_ir_passes(main, [loss.name])
+    cands = cost_model.rank_fusion_candidates(rew)
+    return {
+        "chains": len(cands),
+        "modeled_saved_bytes_total": sum(c["saved_bytes"] for c in cands),
+        "calibrated": bool(cands and cands[0]["calibrated"]),
+        "top": [{k: c[k] for k in ("kind", "ops", "saved_bytes",
+                                   "est_saved_s", "measured_epilogue_s")}
+                for c in cands[:3]],
+    }
+
+
+def _maybe_calibrate(build, feed, enabled):
+    """--calibrate: one measured unfused step -> the cost-model store,
+    so rank_fusion_candidates runs on measured rates."""
+    if not enabled:
+        return None
+    from paddle_tpu.utils import cost_model
+
+    _, ms, _, _ = _run_config(build, feed, 1, {"tpu_fuse": "0"})
+    cost_model.set_measured_profile(step_s=ms / 1e3, source="op_bench")
+    return {"step_ms": round(ms, 3),
+            "version": cost_model.calibration_version()}
+
+
+def ab_fused(kind, quick=False, steps=None, calibrate=False):
+    """One fused-vs-unfused A/B: same program, same feed, same scope
+    discipline, FLAGS_tpu_fuse is the only lever."""
+    import jax  # noqa: F401  (fail early off-jax)
+
+    rng = np.random.RandomState(0)
+    steps = steps or (3 if quick else 20)
+    if kind == "conv_bn":
+        image, ch, batch = (16, 16, 4) if quick else (32, 32, 16)
+        build = lambda: _build_conv_net(image, ch)  # noqa: E731
+        feed = {"img": rng.rand(batch, 3, image, image).astype(np.float32),
+                "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
+    else:  # matmul_bias
+        width, batch = (64, 16) if quick else (512, 128)
+        build = lambda: _build_mlp(width)  # noqa: E731
+        feed = {"x": rng.rand(batch, width).astype(np.float32),
+                "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
+    cal = _maybe_calibrate(build, feed, calibrate)
+    l0, ms0, t0, _ = _run_config(build, feed, steps, {"tpu_fuse": "0"})
+    l1, ms1, t1, ctx1 = _run_config(build, feed, steps, {"tpu_fuse": "1"})
+    fused_ops = {t: n for t, n in t1.items()
+                 if t.startswith(("fused_conv_bn_act", "fused_matmul_bias"))}
+    payload = {
+        "lever": f"fuse:{kind}",
+        "quick": quick,
+        "steps": steps,
+        "unfused_ms_per_step": round(ms0, 3),
+        "fused_ms_per_step": round(ms1, 3),
+        "fused_ops": fused_ops,
+        "loss_bit_identical": l0 == l1,
+        "rank": _rank_summary(*ctx1),
+    }
+    if cal:
+        payload["calibration"] = cal
+    return payload
+
+
+def ab_double_buffer(quick=False, steps=None):
+    """Double-buffer on/off over FRESH host batches each step (the lever
+    is input staging, so the feed must actually change): same batch
+    stream both ways, FLAGS_tpu_double_buffer is the only lever."""
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.executor import FeedStager, double_buffered_feeds
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.utils import flags as ptflags
+
+    steps = steps or (4 if quick else 30)
+    image, ch, batch = (16, 16, 4) if quick else (32, 32, 32)
+    build = lambda: _build_conv_net(image, ch)  # noqa: E731
+
+    def batches():
+        rng = np.random.RandomState(7)
+        for _ in range(steps):
+            yield {"img": rng.rand(batch, 3, image, image
+                                   ).astype(np.float32),
+                   "label": rng.randint(0, 10, (batch, 1)
+                                        ).astype(np.int64)}
+
+    results = {}
+    losses = {}
+    for mode in ("0", "1"):
+        ptflags.set_flags({"tpu_double_buffer": mode, "tpu_fuse": "0"})
+        main, startup, loss = build()
+        exe = fluid.Executor(pt.CPUPlace())
+        stager = FeedStager(main, ["img", "label"], pt.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            ls = []
+            t0 = time.perf_counter()
+            for staged in double_buffered_feeds(batches(), stager):
+                ls.append(float(exe.run(main, feed=staged,
+                                        fetch_list=[loss.name])[0]))
+            dt = (time.perf_counter() - t0) / steps
+        results[mode] = dt * 1e3
+        losses[mode] = ls
+    return {
+        "lever": "double_buffer",
+        "quick": quick,
+        "steps": steps,
+        "off_ms_per_step": round(results["0"], 3),
+        "on_ms_per_step": round(results["1"], 3),
+        "loss_bit_identical": losses["0"] == losses["1"],
+    }
+
+
+def run_ab(levers, quick=False, steps=None, calibrate=False):
+    from paddle_tpu.utils.loadgen import emit_json
+
+    out = []
+    for lever in levers:
+        if lever == "double_buffer":
+            payload = ab_double_buffer(quick=quick, steps=steps)
+        else:
+            payload = ab_fused(lever, quick=quick, steps=steps,
+                               calibrate=calibrate)
+        payload["backend"] = __import__("jax").default_backend()
+        emit_json("OPBENCH", payload)
+        out.append(payload)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", help="JSON list of op entries")
@@ -180,7 +390,23 @@ def main():
     ap.add_argument("--attr", action="append", default=[],
                     help="name=json_value")
     ap.add_argument("--repeat", type=int, default=None)
+    ap.add_argument("--ab", choices=["conv_bn", "matmul_bias",
+                                     "double_buffer", "all"],
+                    help="one-lever A/B harness (OPBENCH= lines)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes / few steps (the tier-1 smoke)")
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--calibrate", action="store_true",
+                    help="feed a measured step into the cost-model store "
+                         "so --ab rankings use measured rates")
     args = ap.parse_args()
+
+    if args.ab:
+        levers = (["conv_bn", "matmul_bias", "double_buffer"]
+                  if args.ab == "all" else [args.ab])
+        run_ab(levers, quick=args.quick, steps=args.steps,
+               calibrate=args.calibrate)
+        return
 
     if args.op:
         entry = {"op": args.op, "inputs": {}, "attrs": {}}

@@ -2,13 +2,22 @@
 per-fusion device-time table (the r2 BENCHMARKS.md breakdown, scripted).
 
 Usage: python tools/profile_step.py [resnet50|ernie] [--steps N]
+           [--top-ops N] [--quick]
 Writes the raw trace under /tmp/pt_trace/, prints the top device ops
 aggregated by fusion kind, and ends with one stable ``PROFILE={json}``
 line (the ``SERVING=``/``BENCH=`` convention) so the driver can diff
 profiles across rounds without scraping the human tables.
+
+``--top-ops N`` (r14) prints the top-N ops by measured self-time from
+the trace — or, when the backend wrote no device trace (the CPU proxy),
+by modeled time from the profile-calibrated cost model — followed by the
+ranked epilogue-fusion candidates: the human-readable front door to
+``utils/cost_model.rank_fusion_candidates``.  ``--quick`` is the
+bounded tier-1 smoke (tiny resnet, 2 steps, implies --top-ops 10).
 """
 from __future__ import annotations
 
+import argparse
 import glob
 import gzip
 import json
@@ -19,7 +28,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_resnet(steps=8, batch=128, image=224, amp=True):
+def run_resnet(steps=8, batch=128, image=224, amp=True, depth=50):
     import jax
     import numpy as np
 
@@ -32,7 +41,7 @@ def run_resnet(steps=8, batch=128, image=224, amp=True):
     with fluid.program_guard(main, startup):
         img = fluid.layers.data("img", [3, image, image])
         label = fluid.layers.data("label", [1], dtype="int64")
-        loss, acc1, acc5, logits = build_resnet(img, label, depth=50)
+        loss, acc1, acc5, logits = build_resnet(img, label, depth=depth)
         opt = fluid.optimizer.MomentumOptimizer(0.1, 0.9)
         if amp:
             opt = fluid.contrib.mixed_precision.decorate(opt)
@@ -53,6 +62,8 @@ def run_resnet(steps=8, batch=128, image=224, amp=True):
         return exe.run(main, feed=feed, fetch_list=[loss.name],
                        return_numpy=False)
 
+    # --top-ops introspects the program the step actually compiled
+    step.program, step.exe, step.loss = main, exe, loss
     return step
 
 
@@ -87,13 +98,100 @@ def run_ernie(steps=8, batch=None, seq=512, attn_dropout=True):
     return step
 
 
+def top_ops_report(step, trace_device, n):
+    """Top-N ops by measured self-time (the trace's per-event totals)
+    or, on trace-less backends, by modeled per-op time from the
+    profile-calibrated cost model — then the ranked fusion candidates
+    (the front door to rank_fusion_candidates)."""
+    rows = []
+    source = "trace"
+    if trace_device and trace_device.get("top_ops_ms_per_step"):
+        rows = sorted(trace_device["top_ops_ms_per_step"].items(),
+                      key=lambda kv: -kv[1])[:n]
+    else:
+        source = "model"
+        program = getattr(step, "program", None)
+        exe = getattr(step, "exe", None)
+        if program is None:
+            print("--top-ops: no trace and no program to model "
+                  "(dygraph model) — skipping")
+            return None
+        from paddle_tpu.utils import cost_model
+
+        rew = exe._apply_ir_passes(program,
+                                   [getattr(step, "loss").name])
+        block = rew.global_block()
+        cm = cost_model.default_cost_model(block.ops, block)
+        agg = {}
+        for op_ in block.ops:
+            if op_.type in cost_model.COMM_OPS:
+                continue
+            agg[op_.type] = agg.get(op_.type, 0.0) + \
+                cost_model.op_time_s(op_, block, cm) * 1e3
+        rows = sorted(agg.items(), key=lambda kv: -kv[1])[:n]
+    print(f"\ntop {n} ops by {'measured' if source == 'trace' else 'modeled'}"
+          f" self-time:")
+    for name, ms in rows:
+        print(f"  {ms:10.4f} ms  {name[:100]}")
+    cands = []
+    program = getattr(step, "program", None)
+    if program is not None:
+        from paddle_tpu.utils import cost_model, flags
+
+        # rank on the UNFUSED rewrite: on-accelerator the pipeline has
+        # already fused these chains (FLAGS_tpu_fuse auto), and ranking
+        # the fused program would always report zero candidates
+        old_fuse = flags._flags.get("FLAGS_tpu_fuse")
+        flags._flags["FLAGS_tpu_fuse"] = "0"
+        try:
+            rew = step.exe._apply_ir_passes(program, [step.loss.name])
+        finally:
+            flags._flags["FLAGS_tpu_fuse"] = old_fuse
+        cands = cost_model.rank_fusion_candidates(rew)
+        if cands:
+            print(f"\nranked fusion candidates ({len(cands)}, "
+                  f"{'calibrated' if cands[0]['calibrated'] else 'uncalibrated'}):")
+            for c in cands[:n]:
+                meas = (f" measured={c['measured_epilogue_s'] * 1e3:.3f}ms"
+                        if c["measured_epilogue_s"] else "")
+                print(f"  {c['saved_bytes'] / 1e6:9.2f} MB saved  "
+                      f"{'+'.join(c['ops'])}{meas}")
+        else:
+            print("\nno fusible epilogue chains "
+                  "(already fused, or none present)")
+    return {"source": source, "top": dict(rows),
+            "fusion_candidates": len(cands),
+            "fusion_saved_bytes": sum(c["saved_bytes"] for c in cands)}
+
+
 def main():
-    which = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    steps = 6
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", nargs="?", default="resnet50",
+                    choices=["resnet50", "ernie"])
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--top-ops", type=int, default=0, metavar="N",
+                    help="print top-N ops by measured (trace) or modeled "
+                         "self-time + ranked fusion candidates")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny bounded smoke (CPU-safe): resnet18 "
+                         "image=32 batch=4, 2 steps, implies --top-ops 10")
+    args = ap.parse_args()
+    which = args.model
+    steps = args.steps
+    top_n = args.top_ops
     import jax
     import numpy as np
 
-    step = run_ernie() if which == "ernie" else run_resnet()
+    if args.quick:
+        steps = 2
+        top_n = top_n or 10
+        which = "resnet18_quick"
+        step = run_resnet(steps=steps, batch=4, image=32, amp=False,
+                          depth=18)
+    elif which == "ernie":
+        step = run_ernie()
+    else:
+        step = run_resnet()
 
     def sync(out):
         v = out[0] if isinstance(out, (list, tuple)) else out
@@ -124,13 +222,18 @@ def main():
     from paddle_tpu.utils.loadgen import emit_json
 
     cost_model.set_measured_profile(step_s=wall, source="profile_step")
+    # after calibration on purpose: the modeled top-ops fallback and the
+    # fusion ranking then run on measured rates
+    top = top_ops_report(step, device, top_n) if top_n else None
     emit_json("PROFILE", {
         "model": which,
         "steps": steps,
+        "quick": args.quick,
         "backend": jax.default_backend(),
         "wall_ms_per_step": round(wall * 1e3, 3),
         "calibration": cost_model.measured_profile()["source"],
         "device": device,
+        "top_ops": top,
     })
 
 
