@@ -478,6 +478,13 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
                                                      stage)
         ot, od, pt_, pd = state_bytes(sc)
         pf_plan = compiled.__dict__.get("_prefetch_plan") or []
+        # r15 memory columns: the static planner's modeled per-device
+        # peak for THIS (stage, path) config next to the shard-aware
+        # live-arrays census of device 0
+        mem_plan = compiled.__dict__.get("_memory_plan")
+        from paddle_tpu.utils.memory import live_arrays_bytes
+
+        measured_dev = live_arrays_bytes(0)["bytes_in_use"]
         modes[name] = {
             "sharding_stage": stage,
             "prefetch_depth": int(_flags.flag("dp_prefetch_depth") or 0),
@@ -497,6 +504,14 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
             "param_bytes_per_dev": pd,
             "grad_buffer_bytes_total": grad_total,
             "grad_buffer_bytes_per_dev": grad_per_dev,
+            "modeled_peak_mb": (round(mem_plan.peak_mb, 4)
+                                if mem_plan is not None else None),
+            "modeled_resident_mb": (round(mem_plan.resident_mb, 4)
+                                    if mem_plan is not None else None),
+            "peak_op": ({"index": mem_plan.peak_op_index,
+                         "type": mem_plan.peak_op_type}
+                        if mem_plan is not None else None),
+            "measured_peak_mb": round(measured_dev / float(1 << 20), 4),
         }
     _flags.set_flags(defaults)
     print("SCALING=" + _json.dumps({

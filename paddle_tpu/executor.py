@@ -49,7 +49,8 @@ class _Compiled:
     run path never re-partitions per step."""
 
     __slots__ = ("fn", "raw_fn", "state_in", "state_out", "fetch_names",
-                 "donatable", "readonly", "hybrid", "feed_plan", "session")
+                 "donatable", "readonly", "hybrid", "feed_plan", "session",
+                 "_memory_plan")
 
     def __init__(self, fn, state_in, state_out, fetch_names):
         self.fn = fn
@@ -64,6 +65,7 @@ class _Compiled:
         # first _execute, reused every step):
         self.feed_plan = None   # {feed name: numpy dtype to cast to|None}
         self.session = None     # _StateSession — device-resident state
+        self._memory_plan = None  # framework.memory_plan.MemoryPlan
 
 
 class _StateSession:
@@ -366,6 +368,18 @@ class Executor:
 
         return tpu_fuse_enabled(self.place)
 
+    def _plan_compile_memory(self, program, block, feed, fetch_names,
+                             where, scope=None):
+        """Static HBM plan for one compilation — built, gauged,
+        budget-checked and traced by the shared
+        ``memory_plan.plan_and_surface`` (one surfacing path for the
+        executor and the DP runner)."""
+        from .framework import memory_plan as mp
+
+        return mp.plan_and_surface(program, where, feed_names=feed,
+                                   fetch_names=fetch_names, block=block,
+                                   ndev=1, scope=scope)
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -462,6 +476,14 @@ class Executor:
         # compile-time fact (the cache key pins feed names/shapes/dtypes),
         # so the per-step loop never consults block vars again
         feed_plan = build_feed_plan(block, feed)
+
+        # static HBM plan (framework/memory_plan.py): modeled per-device
+        # liveness timeline + peak, attached for introspection, gauged,
+        # and checked against FLAGS_hbm_budget_mb.  Pure analysis — the
+        # program and the traced computation are untouched.
+        mem_plan = self._plan_compile_memory(program, block, feed,
+                                             fetch_names,
+                                             "executor_compile", scope)
 
         ops = list(block.ops)
         if unused_check:
@@ -585,6 +607,7 @@ class Executor:
             compiled.raw_fn = hybrid_call
             compiled.hybrid = True
             compiled.feed_plan = feed_plan
+            compiled._memory_plan = mem_plan
             self._cache[key] = compiled
             tm.histogram(
                 "executor_compile_build_s",
@@ -642,6 +665,7 @@ class Executor:
         compiled.donatable = tuple(donatable)
         compiled.readonly = tuple(readonly)
         compiled.feed_plan = feed_plan
+        compiled._memory_plan = mem_plan
         self._cache[key] = compiled
         tm.histogram(
             "executor_compile_build_s",
@@ -790,11 +814,14 @@ class Executor:
         from .utils.flags import flag as _flag
 
         use_session = not hybrid and bool(_flag("tpu_step_session", True))
-        with RecordEvent("executor_run"):
-            if hybrid:
-                state_vals = {n: state_val(n) for n in compiled.state_in}
-                fetched, new_state = compiled.fn(feed_vals, state_vals)
-            else:
+
+        def dispatch():
+            with RecordEvent("executor_run"):
+                if hybrid:
+                    state_vals = {n: state_val(n)
+                                  for n in compiled.state_in}
+                    f, ns = compiled.fn(feed_vals, state_vals)
+                    return f, ns, None
                 # hot path: mut/ro partition precomputed at compile
                 # time; the state binding itself comes from the step
                 # session when the scope hasn't been touched since our
@@ -819,7 +846,22 @@ class Executor:
                     mut = {n: state_val(n, donated=True)
                            for n in compiled.donatable}
                     ro = {n: state_val(n) for n in compiled.readonly}
-                fetched, new_state = compiled.fn(mut, ro, feed_vals)
+                f, ns = compiled.fn(mut, ro, feed_vals)
+                return f, ns, ro
+
+        try:
+            fetched, new_state, ro_bound = dispatch()
+        except Exception as e:
+            # OOM flight recorder: a device RESOURCE_EXHAUSTED dumps
+            # plan + telemetry + trace to FLAGS_oom_debris_dir, then
+            # propagates unchanged
+            from .framework import memory_plan as mp
+
+            if mp.is_resource_exhausted(e):
+                mp.record_oom_debris("executor_step", e,
+                                     plan=compiled._memory_plan,
+                                     program=program)
+            raise
         scope_set = scope.set
         for name, val in new_state.items():
             scope_set(name, val)
@@ -838,7 +880,7 @@ class Executor:
             else:
                 compiled.session = _StateSession(
                     weakref.ref(scope), Scope.mutation_counter,
-                    mut_refs, ro)
+                    mut_refs, ro_bound)
         elif not hybrid:
             compiled.session = None
 

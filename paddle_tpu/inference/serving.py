@@ -609,6 +609,44 @@ class _EngineCore:
         return (len(req.out_tokens) >= req.max_new_tokens
                 or token == self.cfg.eos_id)
 
+    # -- memory observability (r15) ---------------------------------------
+    def kv_pool_resident_bytes(self) -> int:
+        """Device bytes pinned by the paged K/V pools for the engine's
+        lifetime: 2 pools (K and V) per layer at the allocator's fixed
+        shape — the ``kv_pool`` resident block the static planner
+        (framework/memory_plan.py) charges against the HBM budget."""
+        per_pool = int(np.prod(self.kv_config.pool_shape())) * \
+            np.dtype(self.kv_config.dtype).itemsize
+        return 2 * self.cfg.num_layers * per_pool
+
+    def memory_stats(self) -> dict:
+        """The serving-side memory section (tools/serving_bench.py):
+        fixed pool residency, the allocator's peak page usage converted
+        to bytes, weight bytes, and the device's measured view."""
+        from ..utils.memory import measured_peak
+
+        ps = self.kv.stats()
+        token_bytes = (2 * self.cfg.num_layers * self.cfg.num_heads
+                       * self.cfg.head_dim
+                       * np.dtype(self.kv_config.dtype).itemsize)
+        weights = 0
+        for n in decoder_param_specs(self.cfg):
+            v = self.scope.get(n)
+            if v is not None and hasattr(v, "nbytes"):
+                weights += int(v.nbytes)
+        try:
+            measured = measured_peak(0)
+        except Exception:
+            measured = {"peak_bytes": 0, "source": "unavailable"}
+        return {
+            "kv_pool_resident_bytes": self.kv_pool_resident_bytes(),
+            "kv_pool_peak_token_bytes": int(
+                ps["peak_pages"] * self.kv_config.page_size * token_bytes),
+            "kv_pool_peak_pages": int(ps["peak_pages"]),
+            "weight_bytes": int(weights),
+            "measured": measured,
+        }
+
 
 class ServingEngine:
     """Continuous (inflight) batching over one _EngineCore.

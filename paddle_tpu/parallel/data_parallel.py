@@ -418,10 +418,12 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
            _calibration_version())
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})
     if key in cache:
-        # keep the introspection plan in sync with the entry served (a
+        # keep the introspection plans in sync with the entry served (a
         # hit after a flag flip must not expose another config's plan)
         compiled_program.__dict__["_prefetch_plan"] = \
             compiled_program.__dict__.get("_prefetch_plans", {}).get(key, [])
+        compiled_program.__dict__["_memory_plan"] = \
+            compiled_program.__dict__.get("_memory_plans", {}).get(key)
         return cache[key]
 
     # the DP runner goes through the same compile-time rewrite pipeline
@@ -503,6 +505,22 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
     compiled_program.__dict__["_prefetch_plan"] = pf_records
     compiled_program.__dict__.setdefault("_prefetch_plans", {})[key] = \
         pf_records
+
+    # static HBM plan for THIS (stage, mesh, path) config
+    # (framework/memory_plan.py): per-device modeled timeline/peak with
+    # the ZeRO shard scaling and the exact prefetch windows compiled
+    # above; gauged, budget-checked and trace-emitted by the shared
+    # surfacing path, attached as compiled._memory_plan.
+    from ..framework import memory_plan as _mp
+
+    mem_plan = _mp.plan_and_surface(
+        program, "data_parallel_compile", feed_names=set(feed),
+        fetch_names=fetch_names, block=block, ndev=ndev_axis,
+        stage=stage, use_shard_map=use_shard_map,
+        prefetch_records=pf_records or None,
+        prefetch_depth=pf_depth, scope=scope)
+    compiled_program.__dict__["_memory_plan"] = mem_plan
+    compiled_program.__dict__.setdefault("_memory_plans", {})[key] = mem_plan
 
     def param_sharding(name):
         """ZeRO-3 dp shard, tensor-parallel annotation
@@ -714,7 +732,19 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
             val = val.numpy()
         state_vals[name] = jax.device_put(val, state_sharding(name))
 
-    fetched, new_state = jitted(state_vals, feed_vals)
+    try:
+        fetched, new_state = jitted(state_vals, feed_vals)
+    except Exception as e:
+        from ..framework import memory_plan as _mp
+
+        if _mp.is_resource_exhausted(e):
+            # OOM flight recorder (FLAGS_oom_debris_dir): dump the plan
+            # for THIS config + telemetry + trace, then re-raise
+            _mp.record_oom_debris(
+                "data_parallel_step", e,
+                plan=compiled.__dict__.get("_memory_plan"),
+                program=program)
+        raise
 
     # keep the call handle + ABSTRACT args (shape/dtype/sharding, not
     # the live buffers — those would pin a stale full copy of model

@@ -44,15 +44,15 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = [
-    "RecordEvent", "record_event", "instant_event", "enable_profiler",
-    "disable_profiler", "reset_profiler", "start_profiler", "stop_profiler",
-    "profiler", "is_profiler_enabled", "get_events", "npu_profiler",
-    "cuda_profiler", "LANES",
+    "RecordEvent", "record_event", "instant_event", "counter_event",
+    "enable_profiler", "disable_profiler", "reset_profiler",
+    "start_profiler", "stop_profiler", "profiler", "is_profiler_enabled",
+    "get_events", "npu_profiler", "cuda_profiler", "LANES",
 ]
 
 #: lane -> chrome-trace pid.  Lanes not listed get pids allocated past
 #: the reserved block, deterministically by first appearance.
-LANES = {"host": 0, "serving": 1, "rpc": 2, "chaos": 3}
+LANES = {"host": 0, "serving": 1, "rpc": 2, "chaos": 3, "memory": 4}
 
 _state = threading.local()
 _GLOBAL_LOCK = threading.Lock()
@@ -141,6 +141,26 @@ def instant_event(name: str, cat: str = "host",
     }
     if args:
         ev["args"] = dict(args)
+    with _GLOBAL_LOCK:
+        _EVENTS.append(ev)
+
+
+def counter_event(name: str, values: dict, cat: str = "memory",
+                  ts: Optional[float] = None):
+    """Chrome-trace counter sample (``ph: "C"``): a named scalar series
+    rendered as a filled lane graph (the memory lane:
+    framework/memory_plan.py emits the modeled live-bytes timeline
+    here).  ``values`` maps series name -> number; ``ts`` overrides the
+    sample time (modeled timelines space samples by modeled op time).
+    No-op when the profiler is off."""
+    if not _ENABLED:
+        return
+    ev = {
+        "name": name, "cat": cat,
+        "ts": time.perf_counter() if ts is None else float(ts),
+        "dur": 0.0, "tid": threading.get_ident(), "depth": 0, "ph": "C",
+        "args": {k: float(v) for k, v in values.items()},
+    }
     with _GLOBAL_LOCK:
         _EVENTS.append(ev)
 
@@ -250,8 +270,8 @@ def _feed_calibration(summary: List[dict]):
 def summarize(events: List[dict], sorted_key: str = "default") -> List[dict]:
     rows: Dict[str, dict] = {}
     for e in events:
-        if e.get("ph") == "i":
-            continue  # instants mark moments; min/ave of 0 is noise
+        if e.get("ph") in ("i", "C"):
+            continue  # instants/counters mark moments; min/ave is noise
         r = rows.setdefault(e["name"], {
             "name": e["name"], "calls": 0, "total": 0.0,
             "max": 0.0, "min": float("inf"),
@@ -333,6 +353,9 @@ def _write_chrome_trace(events: List[dict], path: str):
         if e.get("ph") == "i":
             ev["ph"] = "i"
             ev["s"] = "t"  # thread-scoped instant
+        elif e.get("ph") == "C":
+            ev["ph"] = "C"
+            ev["tid"] = 0  # counters are per-process series
         else:
             ev["ph"] = "X"
             ev["dur"] = e["dur"] * 1e6

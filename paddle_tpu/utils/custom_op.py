@@ -31,7 +31,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["register_op", "load_op_library"]
+__all__ = ["register_op", "load_op_library", "CUSTOM_REGISTERED"]
+
+#: op types registered at runtime through this module (pure-Python
+#: register_op and native load_op_library alike).  The memory planner's
+#: coverage gate (framework/memory_plan.py memory_audit) consults this:
+#: a custom op's memory behavior is its author's contract — the static
+#: audit cannot see into user kernels, so they are classified
+#: "custom" instead of failing the sweep.
+CUSTOM_REGISTERED: set = set()
 
 
 def register_op(op_type: str, lower: Callable, grad_lower: Callable = None,
@@ -46,8 +54,10 @@ def register_op(op_type: str, lower: Callable, grad_lower: Callable = None,
     from ..ops.registry import op as _op_dec
 
     _op_dec(op_type, no_grad=no_grad)(lower)
+    CUSTOM_REGISTERED.add(op_type)
     if grad_lower is not None:
         _op_dec(op_type + "_grad", no_grad=True)(grad_lower)
+        CUSTOM_REGISTERED.add(op_type + "_grad")
     return op_type
 
 
@@ -95,6 +105,8 @@ def load_op_library(path: str) -> List[str]:
         name = lib.lib.PD_OpName(i).decode()
         names.append(name)
         _register_native(lib, i, name)
+        CUSTOM_REGISTERED.add(name)
+        CUSTOM_REGISTERED.add(name + "_grad")
     return names
 
 

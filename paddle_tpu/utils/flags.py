@@ -143,6 +143,23 @@ _DEFAULTS: Dict[str, Any] = {
     # bit-for-bit (host-side bookkeeping only; it never touches program
     # numerics either way, which the telemetry tests pin).
     "FLAGS_telemetry": True,
+    # modeled-HBM budget gate (framework/memory_plan.py): when > 0, the
+    # executor / DP compile paths check the static liveness planner's
+    # modeled peak against this many MB and WARN naming the peak op and
+    # the top live vars; FLAGS_hbm_budget_strict upgrades the warning to
+    # MemoryBudgetError.  0 (default) skips the check entirely — the
+    # planner still runs (it is pure analysis) but nothing gates on it,
+    # and training is bit-identical either way (pinned by test).
+    "FLAGS_hbm_budget_mb": 0.0,
+    "FLAGS_hbm_budget_strict": False,
+    # OOM flight recorder (framework/memory_plan.py record_oom_debris):
+    # when set, a RESOURCE_EXHAUSTED caught in the executor step/compile
+    # paths dumps the memory plan + telemetry snapshot + profiler trace
+    # + measured memory stats into a fresh subdirectory here before
+    # re-raising, so a chip OOM is diagnosable post-mortem.  Empty
+    # (default) disables the dump; the exception propagates unchanged
+    # either way.
+    "FLAGS_oom_debris_dir": "",
     # static program verifier gate (framework/verifier.py): snapshot
     # before every IR pass, verify dataflow/registry/layout invariants
     # after, raise a diagnostic naming the pass + op + hazard on

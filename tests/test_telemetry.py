@@ -295,14 +295,20 @@ def test_serving_histograms_match_loadgen_percentiles(tiny_engine):
     snap = telemetry.snapshot()
     hist = telemetry.histogram("serving_token_latency_s")
     assert hist.count == rep["total_tokens"]
+    # the report rounds to 5 decimals (loadgen.latency_report), so the
+    # bracket — whose bounds are tightened by the RAW observed extremes
+    # — must be compared at that granularity: a p99 that IS the max can
+    # round up past the exact bound by half an ulp (latent flake,
+    # surfaced r15)
+    R = 0.5e-5
     for q, key in ((0.5, "p50_token_latency_s"),
                    (0.99, "p99_token_latency_s")):
         lo, hi = hist.quantile_bounds(q)
-        assert lo <= rep[key] <= hi, (q, lo, rep[key], hi)
+        assert lo - R <= rep[key] <= hi + R, (q, lo, rep[key], hi)
     ttft = telemetry.histogram("serving_ttft_s")
     assert ttft.count == rep["num_requests"]
     lo, hi = ttft.quantile_bounds(0.5)
-    assert lo <= rep["p50_ttft_s"] <= hi
+    assert lo - R <= rep["p50_ttft_s"] <= hi + R
     assert "serving_ttft_s" in snap and "serving_token_latency_s" in snap
 
 

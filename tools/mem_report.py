@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""Modeled-vs-measured HBM report: the runtime-reconciliation half of
+the memory observability layer (framework/memory_plan.py is the static
+half).
+
+For each requested (DP path, ZeRO stage) the tool trains a probe for a
+few steps on the mesh, reads the static planner's per-device model off
+``compiled._memory_plan``, measures the same device with
+``utils/memory.py`` (PJRT allocator counters on chip; the shard-aware
+live-arrays census on the CPU proxy — exact for framework-held state,
+blind to XLA scratch, which is why modeled RESIDENT bytes are the
+reconciliation target there and the modeled PEAK rides along as the
+chip-facing number), and prints them side by side with the
+ndev-scaling checks the ZeRO ladder claims:
+
+  stage >= 1: modeled opt-state bytes/dev ~ full/ndev
+  stage >= 3: modeled param bytes/dev     ~ full/ndev
+
+Usage:
+  python tools/mem_report.py [--probe mlp|resnet50] [--ndev 8]
+        [--stage 0..3] [--ab] [--steps 2] [--budget-mb MB] [--json]
+  python tools/mem_report.py --quick     # bounded tier-1 smoke:
+        mlp probe, stages {0,3} x both paths, asserts modeled-vs-
+        measured agreement (15%) and ndev-scaling (2%); exit 1 on miss
+
+``--ab`` sweeps the whole ZeRO ladder (stages 0-3) on BOTH DP paths
+(pjit and shard_map/fleet-collective).  One stable ``MEM={json}`` line
+(the BENCH/SERVING convention) carries every row plus the check
+verdicts.  The tool re-execs itself into a subprocess with a forced
+``--ndev`` virtual CPU mesh when the current process has fewer devices
+(the bench.py scaling pattern); on a real chip run it inline.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_MB = float(1 << 20)
+
+
+def build_args():
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--probe", choices=("mlp", "resnet50"), default="mlp")
+    ap.add_argument("--ndev", type=int, default=8)
+    ap.add_argument("--stage", type=int, default=None, choices=(0, 1, 2, 3))
+    ap.add_argument("--ab", action="store_true",
+                    help="sweep ZeRO stages 0-3 on both DP paths")
+    ap.add_argument("--steps", type=int, default=2)
+    ap.add_argument("--budget-mb", type=float, default=0.0,
+                    help="also run the FLAGS_hbm_budget_mb check against "
+                         "each config's modeled peak (reported, not "
+                         "enforced)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine output only (the MEM= line)")
+    ap.add_argument("--quick", action="store_true",
+                    help="bounded CI smoke with hard assertions")
+    ap.add_argument("--no-subprocess", action="store_true",
+                    help="never re-exec for the virtual mesh (real-chip "
+                         "runs)")
+    return ap
+
+
+def _respawn(args, argv):
+    """bench.py scaling pattern: force an ndev-device CPU mesh in a
+    child process when this one can't provide it."""
+    import subprocess
+
+    env = dict(os.environ)
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count="
+                                f"{args.ndev}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PT_MEM_REPORT_WORKER"] = "1"
+    env["PYTHONPATH"] = REPO + (os.pathsep + env["PYTHONPATH"]
+                                if env.get("PYTHONPATH") else "")
+    child_args = list(argv) if argv is not None else sys.argv[1:]
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + child_args,
+        env=env, capture_output=True, text=True, timeout=1800)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    return proc.returncode
+
+
+# --------------------------------------------------------------------------
+# probes
+# --------------------------------------------------------------------------
+def build_probe(kind: str, collective: bool, ndev: int):
+    """(main, startup, loss, feed) — a fresh probe program per config
+    (fresh name generator => one init dict could seed all, but each
+    config re-inits to keep measured bytes independent)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework import unique_name
+
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    if kind == "resnet50":
+        from paddle_tpu.models.resnet import build_resnet
+
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", [3, 32, 32])
+            label = fluid.layers.data("label", [1], dtype="int64")
+            loss, _, _, _ = build_resnet(img, label, depth=50, class_num=10)
+            fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.rand(ndev, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, (ndev, 1)).astype(np.int64)}
+    else:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from dp_comm_stats import build_mlp_dp_program
+
+        main, startup, loss = build_mlp_dp_program(
+            n_layers=3, width=64, optimizer="adam", transpile=False)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(8 * ndev, 64).astype(np.float32)
+        feed = {"x": xs, "y": (xs[:, :1] * 2 + 1).astype(np.float32)}
+    if collective:
+        from paddle_tpu.transpiler import GradAllReduce
+
+        GradAllReduce().transpile(startup_program=startup,
+                                  main_program=main, rank=0,
+                                  endpoints=["127.0.0.1:6170"],
+                                  nranks=ndev)
+    return main, startup, loss, feed
+
+
+def _ndev_scaling(plan, ndev: int):
+    """Modeled per-dev vs full/ndev expectation for params and opt
+    state: the 1/ndev claims, checked from the plan's own per-var rows
+    (full bytes are the unsharded facts, dev bytes the model)."""
+    out = {}
+    for cls in ("param", "opt_state"):
+        full = sum(v["bytes"] for v in plan.per_var.values()
+                   if v["class"] == cls)
+        dev = sum(v["dev_bytes"] for v in plan.per_var.values()
+                  if v["class"] == cls)
+        expect = full / ndev if ndev else full
+        out[cls] = {
+            "full_bytes": int(full), "dev_bytes": int(dev),
+            "expect_scaled_bytes": int(expect),
+            "err_pct": (abs(dev - expect) / expect * 100.0
+                        if expect else 0.0),
+        }
+    return out
+
+
+def run_config(kind: str, collective: bool, stage: int, ndev: int,
+               steps: int):
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.utils import flags as _flags
+    from paddle_tpu.utils.memory import PeakTracker
+
+    mesh_mod.registry().clear()
+    mesh_mod.init_mesh()
+    _flags.set_flags({"dp_sharding": stage, "fuse_grad_size_in_MB": 32.0,
+                      "dp_grad_compress": "none", "dp_comm_overlap": 1,
+                      "dp_prefetch_depth": 2 if stage >= 3 else 1})
+    main, startup, loss, feed = build_probe(kind, collective, ndev)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    tracker = PeakTracker(0)
+    last = None
+    for _ in range(max(steps, 1)):
+        last = exe.run(compiled, feed=feed, fetch_list=[loss], scope=scope)
+        tracker.sample()
+    plan = compiled.__dict__.get("_memory_plan")
+    row = {
+        "probe": kind,
+        "path": "shard_map" if collective else "pjit",
+        "stage": stage,
+        "loss": float(np.mean(last[0])) if last else None,
+        "measured": tracker.as_dict(),
+        "measured_peak_mb": round(tracker.peak_bytes / _MB, 3),
+    }
+    if plan is not None:
+        feed_bytes = sum(v["dev_bytes"] for v in plan.per_var.values()
+                         if v["class"] == "feed")
+        # the live-arrays census sees scope state, not the step's feed
+        # staging (collected when run() returns) — compare against the
+        # state-resident part of the model
+        modeled_state = plan.resident_bytes - feed_bytes
+        agree = (abs(modeled_state - tracker.peak_bytes)
+                 / max(tracker.peak_bytes, 1) * 100.0)
+        row.update({
+            "modeled_peak_mb": round(plan.peak_mb, 3),
+            "modeled_resident_mb": round(plan.resident_mb, 3),
+            "modeled_state_mb": round(modeled_state / _MB, 3),
+            "modeled_vs_measured_pct": round(agree, 2),
+            "peak_op": {"index": plan.peak_op_index,
+                        "type": plan.peak_op_type},
+            "prefetch_windows": plan.prefetch_windows,
+            "scaling": _ndev_scaling(plan, ndev),
+        })
+    return row
+
+
+def format_rows(rows):
+    hdr = (f"{'path':<10} {'stage':>5} {'modeled_peak':>13} "
+           f"{'modeled_state':>14} {'measured':>10} {'agree%':>7} "
+           f"{'param/dev':>10} {'opt/dev':>10}  peak op")
+    lines = [hdr]
+    for r in rows:
+        sc = r.get("scaling", {})
+        p = sc.get("param", {}).get("dev_bytes", 0) / _MB
+        o = sc.get("opt_state", {}).get("dev_bytes", 0) / _MB
+        lines.append(
+            f"{r['path']:<10} {r['stage']:>5} "
+            f"{r.get('modeled_peak_mb', float('nan')):>11.3f}MB "
+            f"{r.get('modeled_state_mb', float('nan')):>12.3f}MB "
+            f"{r['measured_peak_mb']:>8.3f}MB "
+            f"{r.get('modeled_vs_measured_pct', float('nan')):>7.2f} "
+            f"{p:>8.3f}MB {o:>8.3f}MB  "
+            f"#{r.get('peak_op', {}).get('index', '?')} "
+            f"{r.get('peak_op', {}).get('type', '?')}")
+    return "\n".join(lines)
+
+
+def check_rows(rows, ndev, agree_tol_pct=15.0, scale_tol_pct=2.0):
+    """The acceptance checks: stage-0 modeled-vs-measured agreement and
+    the ZeRO ndev-scaling errors.  Returns (checks_dict, ok)."""
+    checks = {"agree_tol_pct": agree_tol_pct,
+              "scale_tol_pct": scale_tol_pct, "failures": []}
+    for r in rows:
+        tag = f"{r['path']}/stage{r['stage']}"
+        if "modeled_vs_measured_pct" not in r:
+            checks["failures"].append(f"{tag}: no plan attached")
+            continue
+        if r["stage"] == 0 and r["modeled_vs_measured_pct"] > agree_tol_pct:
+            checks["failures"].append(
+                f"{tag}: modeled state vs measured differ "
+                f"{r['modeled_vs_measured_pct']:.2f}% > {agree_tol_pct}%")
+        sc = r.get("scaling", {})
+        if r["stage"] >= 1 and sc.get("opt_state", {}).get(
+                "err_pct", 0) > scale_tol_pct:
+            checks["failures"].append(
+                f"{tag}: opt-state bytes/dev off full/{ndev} by "
+                f"{sc['opt_state']['err_pct']:.2f}% > {scale_tol_pct}%")
+        if r["stage"] >= 3 and sc.get("param", {}).get(
+                "err_pct", 0) > scale_tol_pct:
+            checks["failures"].append(
+                f"{tag}: param bytes/dev off full/{ndev} by "
+                f"{sc['param']['err_pct']:.2f}% > {scale_tol_pct}%")
+    return checks, not checks["failures"]
+
+
+def main(argv=None) -> int:
+    args = build_args().parse_args(argv)
+    if args.quick:
+        args.probe = "mlp"
+        args.steps = min(args.steps, 2)
+
+    if not os.environ.get("PT_MEM_REPORT_WORKER") \
+            and not args.no_subprocess:
+        import jax
+
+        if len(jax.devices()) < args.ndev:
+            return _respawn(args, argv)
+
+    stages = ([args.stage] if args.stage is not None
+              else [0, 1, 2, 3] if args.ab
+              else [0, 3] if args.quick else [0])
+    if args.budget_mb:
+        from paddle_tpu.utils import flags as _flags
+
+        _flags.set_flags({"hbm_budget_mb": args.budget_mb})
+
+    rows = []
+    for collective in (False, True):
+        for stage in stages:
+            rows.append(run_config(args.probe, collective, stage,
+                                   args.ndev, args.steps))
+    checks, ok = check_rows(rows, args.ndev)
+    budget = {}
+    if args.budget_mb:
+        budget = {
+            "budget_mb": args.budget_mb,
+            "over": [f"{r['path']}/stage{r['stage']}" for r in rows
+                     if r.get("modeled_peak_mb", 0) > args.budget_mb],
+        }
+    payload = {
+        "probe": args.probe, "ndev": args.ndev, "steps": args.steps,
+        "quick": bool(args.quick), "rows": rows, "checks": checks,
+        "ok": ok, **({"budget": budget} if budget else {}),
+    }
+    if not args.json:
+        print(format_rows(rows))
+        for f in checks["failures"]:
+            print(f"CHECK FAIL: {f}")
+    print("MEM=" + json.dumps(payload, sort_keys=True))
+    if args.quick and not ok:
+        print("FAIL: modeled-vs-measured reconciliation out of "
+              "tolerance", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

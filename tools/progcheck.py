@@ -20,6 +20,14 @@ manifest schema, per-file existence/size/crc32 and per-var file
 references (paddle_tpu/checkpoint.py validate) — the same integrity
 pass the resume path runs, exposed for CI over checkpoint stores.
 
+``--mem`` additionally runs the static HBM planner
+(framework/memory_plan.py — the same ``plan_memory`` the compile paths
+attach as ``compiled._memory_plan``) on every program: modeled
+per-device peak, the peak op, the top live vars, and — with
+``--budget-mb`` — a non-zero exit when any program's modeled peak
+exceeds the budget.  ``--ndev`` / ``--mem-stage`` model the (mesh,
+ZeRO stage) the program would compile under.
+
 Programs are the JSON produced by ``Program.serialize_to_string()``
 (also what ``save_inference_model`` writes as the model desc).  Exit
 status: 1 when errors are found (``--strict``: warnings too), else 0 —
@@ -86,7 +94,7 @@ def run(paths, feed_names=(), fetch_names=(), programs=None):
     if len(progs) > 1:
         for d in check_cross_device(progs):
             diags.append(("<cross-device>", d))
-    return diags, per_prog
+    return diags, per_prog, list(zip(labels, progs))
 
 
 def check_manifests(dirs):
@@ -94,6 +102,17 @@ def check_manifests(dirs):
     from paddle_tpu.checkpoint import validate
 
     return {d: validate(d) for d in dirs}
+
+
+def check_memory(program, feed_names=(), fetch_names=(), ndev=1,
+                 stage=None):
+    """Static HBM plan for one program (framework/memory_plan.py) —
+    shared with the executor/DP compile paths."""
+    from paddle_tpu.framework import memory_plan
+
+    return memory_plan.plan_memory(program, feed_names=feed_names,
+                                   fetch_names=fetch_names, ndev=ndev,
+                                   stage=stage)
 
 
 def main(argv=None):
@@ -113,6 +132,19 @@ def main(argv=None):
     ap.add_argument("--fetch", default="",
                     help="comma-separated fetch var names (suppresses "
                          "dead-write findings for them)")
+    ap.add_argument("--mem", action="store_true",
+                    help="also run the static HBM planner on each "
+                         "program (modeled peak, peak op, top live vars)")
+    ap.add_argument("--budget-mb", type=float, default=0.0,
+                    help="with --mem: exit non-zero when any program's "
+                         "modeled peak exceeds this many MB")
+    ap.add_argument("--ndev", type=int, default=1,
+                    help="with --mem: mesh size to model (ZeRO scaling, "
+                         "feed sharding)")
+    ap.add_argument("--mem-stage", type=int, default=None,
+                    choices=(0, 1, 2, 3),
+                    help="with --mem: ZeRO stage to model (default: "
+                         "FLAGS_dp_sharding)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--strict", action="store_true",
@@ -142,25 +174,57 @@ def main(argv=None):
 
     feed_names = [n for n in args.feed.split(",") if n]
     fetch_names = [n for n in args.fetch.split(",") if n]
-    diags, per_prog = run(args.programs, feed_names, fetch_names)
+    diags, per_prog, progs = run(args.programs, feed_names, fetch_names)
     n_err = sum(d.severity == "error" for _, d in diags)
     n_warn = sum(d.severity == "warning" for _, d in diags)
 
+    mem_rows = []
+    mem_plans = []
+    over_budget = []
+    if args.mem:
+        for label, prog in progs:
+            plan = check_memory(prog, feed_names, fetch_names,
+                                ndev=args.ndev, stage=args.mem_stage)
+            mem_plans.append((label, plan))
+            mem_rows.append(dict(plan.as_dict(10), program=label))
+            if args.budget_mb and plan.peak_mb > args.budget_mb:
+                over_budget.append(label)
+
     if args.as_json:
-        print(json.dumps({
+        out = {
             "programs": per_prog,
             "errors": n_err,
             "warnings": n_warn,
             "diagnostics": [dict(d.as_dict(), program=label)
                             for label, d in diags],
-        }, indent=2, default=str))
+        }
+        if args.mem:
+            out["memory"] = mem_rows
+            if args.budget_mb:
+                out["budget_mb"] = args.budget_mb
+                out["over_budget"] = over_budget
+        print(json.dumps(out, indent=2, default=str))
     else:
         if not args.quiet:
             for label, d in diags:
                 print(f"{label}: {d.format()}")
+        if args.mem:
+            for (label, plan), row in zip(mem_plans, mem_rows):
+                print(f"--- memory: {label} (ndev={args.ndev}, "
+                      f"stage={row['stage']}) ---")
+                print(plan.format_table())
+                if args.budget_mb:
+                    # unrounded peak (as_dict rounds to 3 decimals): the
+                    # verdict must agree with the exit code
+                    verdict = ("OVER" if plan.peak_mb > args.budget_mb
+                               else "within")
+                    print(f"budget: {verdict} {args.budget_mb} MB "
+                          f"(modeled peak {plan.peak_mb:.6f} MB)")
         print(f"progcheck: {len(per_prog)} program(s), "
-              f"{n_err} error(s), {n_warn} warning(s)")
-    return 1 if (n_err or (args.strict and n_warn)) else 0
+              f"{n_err} error(s), {n_warn} warning(s)"
+              + (f", {len(over_budget)} over budget" if args.mem
+                 and args.budget_mb else ""))
+    return 1 if (n_err or (args.strict and n_warn) or over_budget) else 0
 
 
 if __name__ == "__main__":

@@ -157,6 +157,11 @@ def main(argv=None):
             "speedup_tokens_per_s": round(speedup, 3),
             "mha_fused_ops": cont_eng.core.mha_fused,
             "scheduler": cont_eng.stats,
+            # the memory section (r15): the KV pool's fixed residency +
+            # peak page usage and the engine's measured device view,
+            # next to the throughput it buys
+            "memory": {"continuous": cont_eng.core.memory_stats(),
+                       "static": static_eng.core.memory_stats()},
             # the registry view of the same measured replays (r13):
             # latency histograms, scheduler counters, KV gauges —
             # carried on the BENCH artifact for free

@@ -51,7 +51,22 @@ def load_trace(path: str) -> dict:
                                    and "dur" in e):
             raise TraceInvalid(f"{path}: complete event #{i} missing "
                                f"name/ts/dur")
+        if e["ph"] == "C" and not ("name" in e and "ts" in e
+                                   and isinstance(e.get("args"), dict)):
+            raise TraceInvalid(f"{path}: counter event #{i} missing "
+                               f"name/ts/args")
     return data
+
+
+def _counter_value(args: dict):
+    """The scalar a counter sample carries: the ``bytes`` series (the
+    memory lane's convention) or the first numeric arg."""
+    if "bytes" in args:
+        return float(args["bytes"])
+    for v in args.values():
+        if isinstance(v, (int, float)):
+            return float(v)
+    return 0.0
 
 
 def report(trace: dict, top: int = 10) -> dict:
@@ -67,11 +82,12 @@ def report(trace: dict, top: int = 10) -> dict:
             lane_of[e["pid"]] = name[5:] if name.startswith("lane:") \
                 else (name or f"pid{e['pid']}")
     lanes: dict = {}
+    counter_samples: dict = {}  # (lane, name) -> [(ts, value, budget)]
     t_min, t_max = float("inf"), float("-inf")
     n_events = 0
     for e in events:
         ph = e.get("ph")
-        if ph not in ("X", "i"):
+        if ph not in ("X", "i", "C"):
             continue
         lane = lane_of.get(e.get("pid", 0), f"pid{e.get('pid', 0)}")
         row = lanes.setdefault(lane, {
@@ -79,6 +95,13 @@ def report(trace: dict, top: int = 10) -> dict:
         n_events += 1
         ts = float(e.get("ts", 0.0))
         t_min = min(t_min, ts)
+        if ph == "C":
+            args = e.get("args") or {}
+            counter_samples.setdefault((lane, e["name"]), []).append(
+                (ts, _counter_value(args),
+                 float(args.get("budget_bytes", 0.0))))
+            t_max = max(t_max, ts)
+            continue
         if ph == "i":
             row["instants"][e["name"]] = \
                 row["instants"].get(e["name"], 0) + 1
@@ -92,6 +115,29 @@ def report(trace: dict, top: int = 10) -> dict:
                                                   "total_ms": 0.0})
         r["calls"] += 1
         r["total_ms"] += dur_ms
+    # counter (ph "C") series: the memory lane's modeled live-bytes
+    # timeline and friends — peak, mean, and time spent over 80% of the
+    # recorded budget (sample k holds its value until sample k+1)
+    for (lane, name), samples in counter_samples.items():
+        samples.sort(key=lambda s: s[0])
+        values = [v for _, v, _ in samples]
+        budget = max((b for _, _, b in samples), default=0.0)
+        over_ms = None
+        if budget > 0 and len(samples) > 1:
+            over_us = 0.0
+            for (ts0, v, _), (ts1, _, _) in zip(samples, samples[1:]):
+                if v >= 0.8 * budget:
+                    over_us += ts1 - ts0
+            over_ms = round(over_us / 1e3, 6)
+        row = lanes[lane].setdefault("counters", {})
+        row[name] = {
+            "samples": len(values),
+            "peak": max(values) if values else 0.0,
+            "mean": (sum(values) / len(values)) if values else 0.0,
+            **({"budget": budget,
+                "time_over_80pct_budget_ms": over_ms}
+               if budget > 0 else {}),
+        }
     for row in lanes.values():
         row["total_ms"] = round(row["total_ms"], 6)
         row["by_name"] = dict(sorted(
@@ -116,8 +162,18 @@ def format_table(rep: dict) -> str:
         inst = ("  [" + ", ".join(f"{n} x{c}"
                                   for n, c in row["instants"].items())
                 + "]") if row["instants"] else ""
+        ctr = ""
+        if row.get("counters"):
+            parts = []
+            for n, c in row["counters"].items():
+                s = f"{n}: peak {c['peak'] / (1 << 20):.2f}MB"
+                if c.get("time_over_80pct_budget_ms") is not None:
+                    s += (f", {c['time_over_80pct_budget_ms']:.3f}ms "
+                          f"over 80% budget")
+                parts.append(s)
+            ctr = "  {" + "; ".join(parts) + "}"
         lines.append(f"{lane:<10} {row['events']:>8} "
-                     f"{row['total_ms']:>12.3f}  {tops}{inst}")
+                     f"{row['total_ms']:>12.3f}  {tops}{inst}{ctr}")
     lines.append(f"span: {rep['span_ms']:.3f} ms over "
                  f"{rep['n_events']} events")
     return "\n".join(lines)
@@ -167,7 +223,8 @@ def run_quick(tmpdir: str) -> int:
     rep = report(load_trace(path))
     print(format_table(rep))
     print("TRACE=" + json.dumps(rep, sort_keys=True))
-    missing = [lane for lane in ("host", "serving", "rpc", "chaos")
+    missing = [lane for lane in ("host", "serving", "rpc", "chaos",
+                                 "memory")
                if lane not in rep["lanes"]]
     if missing:
         print(f"FAIL: lanes missing from merged trace: {missing}",
@@ -176,6 +233,11 @@ def run_quick(tmpdir: str) -> int:
     if not rep["lanes"]["serving"]["instants"]:
         print("FAIL: serving lane carries no scheduler decisions",
               file=sys.stderr)
+        return 1
+    ctr = rep["lanes"]["memory"].get("counters", {})
+    if not any(c.get("peak", 0) > 0 for c in ctr.values()):
+        print("FAIL: memory lane carries no modeled live-bytes "
+              "counters", file=sys.stderr)
         return 1
     return 0
 
