@@ -444,10 +444,16 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
         ("collective_zero3_autotune", True, {"dp_sharding": 3,
                                              "fuse_grad_size_in_MB": "auto",
                                              "dp_prefetch_depth": 2}),
+        # r16: FLAGS_dp_plan=auto — the searcher picks (stage, bucket,
+        # prefetch, overlap) per (program, mesh); the mode row carries
+        # the searched plan + its modeled step time next to every
+        # fixed-flag mode's modeled time, so the argmin is auditable
+        ("pjit_auto_plan", False, {"dp_plan": "auto"}),
+        ("collective_auto_plan", True, {"dp_plan": "auto"}),
     ]
     defaults = {"dp_sharding": 0, "fuse_grad_size_in_MB": 32.0,
                 "dp_grad_compress": "none", "dp_comm_overlap": 1,
-                "dp_prefetch_depth": 1}
+                "dp_prefetch_depth": 1, "dp_plan": ""}
     modes = {}
     for name, collective, overrides in MODES:
         _flags.set_flags({**defaults, **overrides})
@@ -471,6 +477,15 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
                           fetch_list=[lv], scope=sc, return_numpy=False)
         np.asarray(out[0].value() if hasattr(out[0], "value") else out[0])
         dt = time.perf_counter() - t0
+        # auto-plan modes: report the comm/buffer stats under the flags
+        # the SEARCHED plan compiled with, not the (inert) user flags
+        _searched = compiled.__dict__.get("_plan")
+        if _searched is not None:
+            _flags.set_flags({
+                "dp_sharding": _searched["stage"],
+                "fuse_grad_size_in_MB": _searched["bucket_mb"],
+                "dp_prefetch_depth": _searched["prefetch_depth"],
+                "dp_comm_overlap": int(_searched["overlap"])})
         rewritten = exe._apply_ir_passes(mp, [lv.name])
         comm = collect_comm_stats(rewritten, n_devices)
         stage = int(_flags.flag("dp_sharding") or 0)
@@ -485,6 +500,19 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
         from paddle_tpu.utils.memory import live_arrays_bytes
 
         measured_dev = live_arrays_bytes(0)["bytes_in_use"]
+        # r16 plan columns: every mode's config priced by the SAME
+        # model the FLAGS_dp_plan=auto searcher minimizes, so the
+        # auto modes' choice is checkable against the fixed-flag sweep
+        # (modeled vs fixed-flag step time in one stable JSON line)
+        from paddle_tpu.parallel import plan_search as _ps
+
+        searched = _searched
+        if searched is not None:
+            modeled_step_s = searched["modeled_step_s"]
+        else:
+            modeled_step_s = _ps.modeled_step_time(
+                mp, n_devices, _ps.ParallelPlan.from_flags(),
+                use_shard_map=collective)["modeled_step_s"]
         modes[name] = {
             "sharding_stage": stage,
             "prefetch_depth": int(_flags.flag("dp_prefetch_depth") or 0),
@@ -504,6 +532,12 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
             "param_bytes_per_dev": pd,
             "grad_buffer_bytes_total": grad_total,
             "grad_buffer_bytes_per_dev": grad_per_dev,
+            "dp_plan": _flags.flag("dp_plan") or "",
+            "plan": ({k: searched[k] for k in
+                      ("stage", "bucket_mb", "prefetch_depth", "overlap",
+                       "prefetch_auto", "modeled_peak_mb")}
+                     if searched is not None else None),
+            "modeled_step_ms": round(modeled_step_s * 1e3, 6),
             "modeled_peak_mb": (round(mem_plan.peak_mb, 4)
                                 if mem_plan is not None else None),
             "modeled_resident_mb": (round(mem_plan.resident_mb, 4)
@@ -548,8 +582,10 @@ def bench_scaling(n_devices=8, steps=6):
     env["PYTHONPATH"] = here + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     code = f"import bench; bench._scaling_worker({n_devices}, {steps})"
+    # 16 modes since r16 (the two *_auto_plan rows) — the old 900 s
+    # bound fit 14
     proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=here,
-                          capture_output=True, text=True, timeout=900)
+                          capture_output=True, text=True, timeout=1500)
     if proc.returncode != 0:
         raise RuntimeError(f"scaling bench failed:\n{proc.stderr[-2000:]}")
     line = [l for l in proc.stdout.splitlines() if l.startswith("SCALING=")][0]

@@ -443,6 +443,10 @@ class Executor:
                str(flag("dp_grad_compress", "none")),
                int(flag("dp_sharding") or 0), bool(flag("dp_comm_overlap")),
                bool(flag("while_static_scan")),
+               # FLAGS_dp_plan participates even though the search runs
+               # on the DP path: flipping it must never serve a compile
+               # built under the other regime
+               str(flag("dp_plan", "") or ""),
                # a new measured profile can move autotuned bucket
                # boundaries — stale compilations must not be reused
                calibration_version())

@@ -2169,6 +2169,80 @@ class FuseAllReducePass(Pass):
         return [entries[a:b] for a, b in bounds]
 
 
+@register_pass("prefetch_autotune_pass")
+class PrefetchAutotunePass(Pass):
+    """Per-parameter ZeRO-3 prefetch-depth autotune (r16, the ROADMAP
+    carry-over): instead of one FLAGS_dp_prefetch_depth for every
+    parameter, derive each sharded parameter's window depth from the
+    cost model — just deep enough that the modeled all-gather time is
+    hidden behind the compute ops preceding its first consumer
+    (utils/cost_model.py ``collective_time_s`` vs accumulated
+    ``op_time_s``, profile-calibrated when a measured step exists).
+
+    This is an ANALYSIS pass: it mutates nothing (the op-motion itself
+    stays in the DP interpreter, driven by
+    ``data_parallel._plan_param_prefetch(depths=...)``), but it runs
+    through ``Pass.apply`` so the r10 verifier bracket covers it like
+    every pass, and the windows it produces are re-validated by the
+    verifier's ``check_prefetch_plan`` gather-window-never-crosses-a-
+    param-write rule on the DP compile path.  Results land in
+    ``self.report``: ``depths`` (param -> depth) and the planned
+    ``records``.  Consumed by parallel/plan_search.py's ``auto``
+    prefetch candidates."""
+
+    ndev: int = 1
+    use_shard_map: bool = False
+    max_depth: int = 8
+    cost_model = None  # utils.cost_model.CostModel override (tests/CLI)
+
+    def apply_impl(self, program):
+        from ..parallel.data_parallel import (_pjit_zero23_sets,
+                                              _plan_param_prefetch,
+                                              _plan_wrapped_updates)
+        from ..utils.cost_model import (COMM_OPS, collective_time_s,
+                                        default_cost_model, op_time_s)
+
+        block = program.global_block()
+        ops = list(block.ops)
+        ndev = max(int(self.ndev), 1)
+        if self.use_shard_map:
+            plans, _, sharded = _plan_wrapped_updates(ops, block, ndev, 3)
+            skip = set(plans)
+        else:
+            sharded, _ = _pjit_zero23_sets(ops, block, ndev, 3)
+            skip = set()
+        self.report = {"depths": {}, "records": [], "ndev": ndev}
+        if not sharded or ndev <= 1:
+            return program
+        cm = self.cost_model or default_cost_model(ops, block)
+        op_s = [0.0 if op_.type in COMM_OPS else op_time_s(op_, block, cm)
+                for op_ in ops]
+        from ..framework import memory_plan as _mp
+
+        first_use: Dict[str, int] = {}
+        for i, op_ in enumerate(ops):
+            if id(op_) in skip:
+                continue
+            for n in set(op_.input_arg_names):
+                if n in sharded:
+                    first_use.setdefault(n, i)
+        depths: Dict[str, int] = {}
+        for p in sorted(sharded):
+            b = _mp.var_bytes(block, p) or 0
+            gather_s = collective_time_s(float(b), 1.0, ndev, cm)
+            f = first_use.get(p, 0)
+            acc, d, i = 0.0, 0, f - 1
+            while i >= 0 and d < int(self.max_depth) and acc < gather_s:
+                acc += op_s[i]
+                d += 1
+                i -= 1
+            depths[p] = max(d, 1)
+        records, _, _ = _plan_param_prefetch(ops, block, sharded, skip,
+                                             1, depths=depths)
+        self.report = {"depths": depths, "records": records, "ndev": ndev}
+        return program
+
+
 @register_pass("fuse_optimizer_ops_pass")
 class FuseOptimizerOpsPass(Pass):
     def apply_impl(self, program):

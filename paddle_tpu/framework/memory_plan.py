@@ -24,9 +24,10 @@ wrong here:
   ``FLAGS_tpu_step_session=0``) the old and new copies coexist and the
   model charges the extra copy from the update to the end of the step;
 * **ZeRO row-sharding** (``FLAGS_dp_sharding``): stage-3 parameters and
-  stage>=1 optimizer state count 1/ndev per device (same eligibility
-  tables as parallel/data_parallel.py — shared, so the model and the
-  runtime cannot drift); stage>=2 gradients count 1/ndev from their
+  stage>=1 optimizer state count 1/ndev per device (same partition-rule
+  engine + planning helpers as parallel/data_parallel.py — shared, so
+  the model and the runtime cannot drift); stage>=2 gradients count
+  1/ndev from their
   reduce-scatter point (shard_map path: after the
   ``c_fused_reduce_scatter`` op; pjit path: throughout, GSPMD never
   materializes the full gradient);

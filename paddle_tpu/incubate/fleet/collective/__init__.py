@@ -60,6 +60,13 @@ class DistributedStrategy:
         # per direction — None keeps the FLAGS_dp_prefetch_depth
         # default, 0 restores the just-in-time per-consumer gather
         self.prefetch_depth = None
+        # cost-model-driven auto-parallel plan search (r16,
+        # parallel/plan_search.py): "auto" searches ZeRO stage x bucket
+        # threshold x prefetch depth x overlap per (program, mesh) and
+        # applies the modeled-time argmin that fits
+        # FLAGS_hbm_budget_mb; it overrides the four knobs above.
+        # None keeps the FLAGS_dp_plan default ("" = flag-driven).
+        self.dp_plan = None
         self.exec_strategy = ExecutionStrategy()
         self.build_strategy = BuildStrategy()
         self.forward_recompute = False
@@ -430,6 +437,7 @@ class CollectiveOptimizer(DistributedOptimizer):
             dp_sharding = _flags._INITIAL["FLAGS_dp_sharding"]
         overlap = getattr(strategy, "comm_overlap", None)
         prefetch = getattr(strategy, "prefetch_depth", None)
+        dp_plan = getattr(strategy, "dp_plan", None)
         _flags.set_flags({
             "dp_sharding": dp_sharding,
             "fuse_grad_size_in_MB": fuse_mb,
@@ -439,6 +447,8 @@ class CollectiveOptimizer(DistributedOptimizer):
             else _flags._INITIAL["FLAGS_dp_comm_overlap"],
             "dp_prefetch_depth": int(prefetch) if prefetch is not None
             else _flags._INITIAL["FLAGS_dp_prefetch_depth"],
+            "dp_plan": str(dp_plan) if dp_plan is not None
+            else _flags._INITIAL["FLAGS_dp_plan"],
         })
         if getattr(strategy, "use_dgc", False):
             # reference: fleet swaps Momentum for DGCMomentum when

@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..framework.scope import LoDTensor
 from ..ops import registry
+from . import partition_rules
 from .mesh import default_dp_mesh
 
 RNG_VAR = registry.LowerCtx.RNG_VAR
@@ -54,49 +55,17 @@ def _mesh_fingerprint(mesh):
             tuple(d.id for d in mesh.devices.flat))
 
 
-#: optimizer op -> accumulator input slots that are pure per-parameter
-#: state (read+written every step, never consumed elsewhere).  Under
-#: FLAGS_dp_sharding these shard over the 'dp' axis — the ZeRO-1 piece:
-#: each device keeps 1/ndev of the moments, GSPMD reduce-scatters the
-#: grad into the shard update and all-gathers only the updated params.
-#: Beta-pow accumulators (shape [1]) stay replicated: not divisible and
-#: 8 bytes each.  The table is shared by the pjit sharding planner, the
-#: shard_map update wrapper below, and fuse_all_reduce_pass's ZeRO-2
-#: scatter eligibility — one source of truth for what counts as
-#: per-parameter optimizer state.
-_OPT_STATE_SLOTS = {
-    "momentum": ("Velocity",),
-    "lars_momentum": ("Velocity",),
-    "adam": ("Moment1", "Moment2"),
-    "adamw": ("Moment1", "Moment2"),
-    "lamb": ("Moment1", "Moment2"),
-    "adamax": ("Moment", "InfNorm"),
-    "adagrad": ("Moment",),
-    "decayed_adagrad": ("Moment",),
-    "adadelta": ("AvgSquaredGrad", "AvgSquaredUpdate"),
-    "rmsprop": ("Moment", "MeanSquare", "MeanGrad"),
-    "fused_momentum": ("Velocity",),
-    "fused_adam": ("Moment1", "Moment2"),
-}
-
-#: update ops the shard_map path may slice under FLAGS_dp_sharding.
-#: Most are strictly per-element, so running them on a row-shard of
-#: (param, grad, state) is exact.  LAMB and LARS (r9) are eligible too:
-#: their per-PARAMETER trust-ratio norms are computed cross-shard — the
-#: op lowering psums the local squared norms over the dp axis when the
-#: update runs on a row-shard (ops/optimizer_ops.py cross_shard_norms),
-#: exact up to float reassociation of the norm sum.  Fused multi-tensor
-#: ops stay excluded (the collective path keeps per-param updates so
-#: the wrapper stays simple).
-_SHARDABLE_UPDATE_OPS = frozenset({
-    "sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
-    "decayed_adagrad", "adadelta", "rmsprop", "lamb", "lars_momentum",
-})
-
-#: ops whose lowering computes whole-parameter norms — wrapped shard
-#: updates run these under the cross_shard_norms(axis) context so the
-#: trust ratio reduces over every device's rows
-_NORM_UPDATE_OPS = frozenset({"lamb", "lars_momentum"})
+# What counts as per-parameter optimizer state, and which update ops
+# tolerate running on a row shard, comes from the r16 partition-rule
+# engine (parallel/partition_rules.py): state slots are DERIVED from
+# each op's registered slot declarations (S read + SOut written), shard
+# certification is a first-match-wins rule table, and beta-pow scalar
+# accumulators stay replicated by rule.  Shared by the pjit sharding
+# planner, the shard_map update wrapper below, fuse_all_reduce_pass's
+# ZeRO-2 scatter eligibility, and the memory planner — one source of
+# truth (the pre-r16 _OPT_STATE_SLOTS / _SHARDABLE_UPDATE_OPS tables
+# are gone; tests/test_partition_rules.py pins the derivation equal to
+# them).
 
 
 def rank_shards(value):
@@ -150,14 +119,14 @@ def _update_shard_rows(op_, block, ndev):
     when the runtime wrapper will really consume the shard."""
     from ..framework.dtype import VarType
 
-    if ndev <= 1 or op_.type not in _SHARDABLE_UPDATE_OPS:
+    if ndev <= 1 or not partition_rules.shardable_update(op_.type):
         return None
     params = op_.inputs.get("Param", [])
     grads = op_.inputs.get("Grad", [])
     if len(params) != 1 or len(grads) != 1:
         return None
     names = [params[0], grads[0]]
-    for slot in _OPT_STATE_SLOTS.get(op_.type, ()):
+    for slot in partition_rules.opt_state_slots(op_.type):
         names.extend(op_.inputs.get(slot, []))
     d0 = None
     for n in names:
@@ -182,12 +151,12 @@ def _sharded_opt_state(ops, block, ndev):
     """Optimizer-state var names eligible for ZeRO-1 sharding on the
     pjit path: leading dim divisible by the mesh (jax 0.4.x has no
     uneven shards) and no explicit tensor-parallel annotation to
-    respect.  GSPMD owns the update semantics there, so any op in the
-    slot table qualifies (including LAMB and the fused multi-tensor
-    forms)."""
+    respect.  GSPMD owns the update semantics there, so any op with
+    derived state slots qualifies (including LAMB and the fused
+    multi-tensor forms)."""
     names = set()
     for op_ in ops:
-        slots = _OPT_STATE_SLOTS.get(op_.type)
+        slots = partition_rules.opt_state_slots(op_.type)
         if not slots:
             continue
         for slot in slots:
@@ -227,8 +196,7 @@ def _pjit_zero23_sets(ops, block, ndev, stage):
         return bool(d0) and d0 > 0 and d0 % ndev == 0
 
     for op_ in ops:
-        if op_.type not in _OPT_STATE_SLOTS and \
-                op_.type not in _SHARDABLE_UPDATE_OPS:
+        if not partition_rules.is_update_op(op_.type):
             continue
         params = op_.inputs.get("Param", [])
         grads = op_.inputs.get("Grad", [])
@@ -264,7 +232,7 @@ def _plan_wrapped_updates(ops, block, ndev, stage):
         rows = _update_shard_rows(op_, block, ndev)
         if rows is None:
             continue
-        state_names = [n for slot in _OPT_STATE_SLOTS.get(op_.type, ())
+        state_names = [n for slot in partition_rules.opt_state_slots(op_.type)
                        for n in op_.inputs.get(slot, [])]
         # stage 1 shards optimizer state only: wrapping a stateless
         # update (sgd) would pay slice+gather for no memory win
@@ -279,7 +247,8 @@ def _plan_wrapped_updates(ops, block, ndev, stage):
     return plans, sharded_state, sharded_params
 
 
-def _plan_param_prefetch(ops, block, sharded_params, skip_op_ids, depth):
+def _plan_param_prefetch(ops, block, sharded_params, skip_op_ids, depth,
+                         depths=None):
     """ZeRO-3 parameter-prefetch schedule (FLAGS_dp_prefetch_depth):
     for each sharded parameter, its all-gather hoists ``depth`` ops
     ahead of the first consumer in each direction (forward / backward,
@@ -289,18 +258,26 @@ def _plan_param_prefetch(ops, block, sharded_params, skip_op_ids, depth):
     Optimize/LRSched-role ops (and ``skip_op_ids`` — the wrapped shard
     updates) consume the SHARD and are never given the gathered copy.
     Windows never cross a write to the parameter, and overlapping
-    fwd/bwd windows merge into one gather.  Returns (records,
+    fwd/bwd windows merge into one gather.  ``depths`` (r16 per-param
+    autotune, framework/ir.py prefetch_autotune_pass) overrides the
+    uniform depth per parameter name — each param's window is just deep
+    enough to hide its modeled gather time.  Returns (records,
     gather_before, discard_after): op index -> param names to gather
     just before / drop just after that op."""
     records: List[dict] = []
     gather_before: Dict[int, List[str]] = {}
     discard_after: Dict[int, List[str]] = {}
-    if depth <= 0 or not sharded_params:
+    depths = depths or {}
+    if (depth <= 0 and not any(d > 0 for d in depths.values())) \
+            or not sharded_params:
         return records, gather_before, discard_after
     from ..backward import OpRole
 
     skip_roles = int(OpRole.Optimize) | int(OpRole.LRSched)
     for p in sorted(sharded_params):
+        p_depth = int(depths.get(p, depth))
+        if p_depth <= 0:
+            continue
         consumers: Dict[str, List[int]] = {}
         writes: List[int] = []
         for i, op_ in enumerate(ops):
@@ -324,7 +301,7 @@ def _plan_param_prefetch(ops, block, sharded_params, skip_op_ids, depth):
             # would have seen: never hoist past a write to p
             lo = max((w + 1 for w in writes if w < first), default=0)
             windows.append({"param": p, "direction": d,
-                            "gather_at": max(lo, first - depth),
+                            "gather_at": max(lo, first - p_depth),
                             "first_consumer": first, "last_consumer": last})
         merged: List[dict] = []
         for w in sorted(windows, key=lambda w: w["gather_at"]):
@@ -361,7 +338,7 @@ def _run_sharded_update(op_, env, block, plan, axis, sharded_params):
     sliced_grad = gv is not None and int(gv.shape[0]) == d0
     if sliced_grad:
         env[g] = lax.dynamic_slice_in_dim(gv, idx * rows, rows, axis=0)
-    if op_.type in _NORM_UPDATE_OPS:
+    if partition_rules.norm_update(op_.type):
         # LAMB/LARS trust ratio: whole-parameter norms from row-shards
         # via psum of the local squared sums (ROADMAP r8 seed)
         from ..ops.optimizer_ops import cross_shard_norms
@@ -403,7 +380,27 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
     ))
     from ..utils.cost_model import calibration_version as \
         _calibration_version
-    from ..utils.flags import flag
+    from ..utils.flags import dp_plan_auto, flag
+
+    # -- auto-parallel plan search (FLAGS_dp_plan=auto, r16) --------------
+    # Resolve the plan BEFORE the cache key and the IR pipeline: the
+    # searcher prices every candidate (parallel/plan_search.py) and
+    # plan_memory() rejects budget-infeasible ones before any compile;
+    # the winner's flag values are then in effect for the whole compile
+    # (applied_plan), so the result is bit-identical to setting those
+    # flags by hand.  The RESOLVED plan tuple keys the cache — a
+    # re-search after calibration changes can never serve a stale
+    # fixed-flag compile.
+    from . import plan_search as _ps
+
+    dp_axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+    plan = None
+    plan_report = None
+    if dp_plan_auto():
+        plan, plan_report = _ps.resolve_plan(
+            program, set(feed), fetch_names, _mesh_fingerprint(mesh),
+            int(mesh.shape[dp_axis]), _program_has_collectives(program),
+            scope=scope)
 
     key = (program._uid, program._version, feed_spec, tuple(fetch_names),
            _mesh_fingerprint(mesh), shard_sig, executor._nhwc_enabled(),
@@ -415,7 +412,9 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
            str(flag("dp_grad_compress", "none")),
            int(flag("dp_prefetch_depth") or 0),
            bool(flag("while_static_scan")),
-           _calibration_version())
+           _calibration_version(),
+           str(flag("dp_plan", "") or ""),
+           plan.as_tuple() if plan is not None else None)
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})
     if key in cache:
         # keep the introspection plans in sync with the entry served (a
@@ -424,7 +423,33 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
             compiled_program.__dict__.get("_prefetch_plans", {}).get(key, [])
         compiled_program.__dict__["_memory_plan"] = \
             compiled_program.__dict__.get("_memory_plans", {}).get(key)
+        compiled_program.__dict__["_plan"] = \
+            compiled_program.__dict__.get("_plans", {}).get(key)
+        compiled_program.__dict__["_plan_report"] = \
+            compiled_program.__dict__.get("_plan_reports", {}).get(key)
         return cache[key]
+
+    with _ps.applied_plan(plan):
+        entry = _compile_dp_miss(
+            compiled_program, executor, program, feed, fetch_names, scope,
+            mesh, key, plan, plan_report)
+    return entry
+
+
+def _compile_dp_miss(compiled_program, executor, program, feed,
+                       fetch_names, scope, mesh, key, plan, plan_report):
+    from ..utils.flags import flag
+
+    cache = compiled_program.__dict__.setdefault("_dp_cache", {})
+    # the chosen plan (or None under flag-driven config) is attached for
+    # introspection: bench.py scaling's plan=auto mode and the tests
+    # read it back
+    chosen = (plan_report or {}).get("chosen") if plan is not None else None
+    compiled_program.__dict__["_plan"] = chosen
+    compiled_program.__dict__.setdefault("_plans", {})[key] = chosen
+    compiled_program.__dict__["_plan_report"] = plan_report
+    compiled_program.__dict__.setdefault("_plan_reports", {})[key] = \
+        plan_report
 
     # the DP runner goes through the same compile-time rewrite pipeline
     # as the single-device executor (bn-act fusion, fused optimizers,
@@ -488,14 +513,19 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
     # sharded params' all-gathers on both paths — explicit op-position
     # motion on the shard_map path, gather-hint placement (an early
     # replicated sharding constraint the window's consumers read) on
-    # the pjit path.  Depth 0 restores the on-demand gather.
+    # the pjit path.  Depth 0 restores the on-demand gather.  A searched
+    # plan (FLAGS_dp_plan=auto) may carry PER-PARAM depths from the
+    # prefetch_autotune_pass — each window just deep enough to hide its
+    # modeled gather, still guarded by the verifier's window rule below.
     pf_depth = int(flag("dp_prefetch_depth") or 0)
+    pf_depths = dict(plan.per_param_depths) if plan is not None else None
     pf_records: List[dict] = []
     pf_gather: Dict[int, List[str]] = {}
     pf_discard: Dict[int, List[str]] = {}
-    if stage >= 3 and sharded_params and pf_depth > 0:
+    if stage >= 3 and sharded_params and (pf_depth > 0 or pf_depths):
         pf_records, pf_gather, pf_discard = _plan_param_prefetch(
-            ops, block, sharded_params, set(wrapped_updates), pf_depth)
+            ops, block, sharded_params, set(wrapped_updates), pf_depth,
+            depths=pf_depths)
         if pf_records and verifier.enabled():
             # the verifier's window rule generalizes the planner's local
             # never-hoist-past-a-write check: any future planner change
@@ -522,19 +552,47 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
     compiled_program.__dict__["_memory_plan"] = mem_plan
     compiled_program.__dict__.setdefault("_memory_plans", {})[key] = mem_plan
 
+    # per-var PartitionSpecs from the partition-rule engine: classes
+    # from program structure, logical axes from DEFAULT_LOGICAL_RULES,
+    # mesh mapping from the stage's zero_mesh_rules, eligibility from
+    # the planners above (divisibility / TP annotations), explicit
+    # tensor-parallel annotations winning over everything — the same
+    # derivation the shard_map in_specs use below.
+    param_names = {p.name for p in program.all_parameters()}
+    opt_names = {n for op_ in ops
+                 for slot in partition_rules.opt_state_slots(op_.type)
+                 for n in op_.inputs.get(slot, [])}
+
+    def _var_class(name):
+        if name in param_names:
+            return "param"
+        if name in opt_names:
+            return "opt_state"
+        if name.endswith("@GRAD"):
+            return "grad"
+        return "other"
+
+    def _annotation(name):
+        var = block._find_var_recursive(name)
+        return getattr(var, "_sharding", None) if var is not None else None
+
+    # one batch rule-engine call over every name the compile will place
+    # (state in/out covers params, optimizer state, and persistable
+    # writes; the matcher's replicated fallback covers stragglers)
+    _spec_names = sorted(set(state_in) | set(state_out))
+    _specs = partition_rules.dp_partition_specs(
+        _spec_names, {n: _var_class(n) for n in _spec_names}, stage, axis,
+        eligible=sharded_params | opt_sharded,
+        annotations={n: a for n in _spec_names
+                     if (a := _annotation(n))})
+
     def param_sharding(name):
         """ZeRO-3 dp shard, tensor-parallel annotation
-        (parallel.tensor_parallel.shard_parameter), or replicated."""
-        if name in sharded_params:
-            return NamedSharding(mesh, P(axis))
-        var = block._find_var_recursive(name)
-        spec = getattr(var, "_sharding", None) if var is not None else None
-        return NamedSharding(mesh, P(*spec)) if spec else NamedSharding(mesh, P())
+        (parallel.tensor_parallel.shard_parameter), or replicated —
+        all from the rule engine's batch derivation."""
+        return NamedSharding(mesh, P(*_specs.get(name, ())))
 
-    def state_sharding(name):
-        if name in opt_sharded:
-            return NamedSharding(mesh, P(axis))
-        return param_sharding(name)
+    state_sharding = param_sharding
 
     def body(state_vals, feed_vals, per_shard: bool):
         env: Dict[str, Any] = dict(state_vals)

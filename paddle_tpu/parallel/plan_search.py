@@ -1,0 +1,677 @@
+"""Cost-model-driven auto-parallel plan search (FLAGS_dp_plan=auto).
+
+Until r16 the user hand-picked the distributed configuration per model:
+ZeRO stage (FLAGS_dp_sharding), gradient-bucket threshold
+(FLAGS_fuse_grad_size_in_MB), prefetch depth (FLAGS_dp_prefetch_depth),
+comm overlap.  Both halves of an automatic search objective exist since
+r13/r15 — the profile-calibrated time model (utils/cost_model.py) and
+the static HBM pricer (framework/memory_plan.py plan_memory) — so this
+module closes the loop (reference intent: *End-to-end Adaptive
+Distributed Training on PaddlePaddle*, arXiv 2112.02752: the parallel
+plan is searched over a cost model, not asked of the user):
+
+1. :func:`enumerate_candidates` spans the plan space per (program,
+   mesh, DP path): ZeRO stage 0-3 x bucket threshold (fixed MB, 0 =
+   unfused, ``auto`` = the r9 variable-boundary DP) x prefetch depth
+   (fixed, 0 = JIT gather, ``auto`` = the per-param
+   ``prefetch_autotune_pass``) x comm overlap;
+2. :func:`modeled_step_time` prices each candidate with the SAME cost
+   model the autotune pass and dp_comm_stats use: modeled compute
+   horizon + exposed collective tail (``model_comm_stream``) + ZeRO
+   gather costs (stage 1/2 ParamOut all-gathers, stage-3
+   forward/backward gather windows net of what the prefetch window
+   hides);
+3. infeasible candidates are rejected by ``plan_memory()`` against
+   ``FLAGS_hbm_budget_mb`` *before any compile* — a plan that cannot
+   fit never reaches XLA;
+4. the argmin runs through the existing verifier-bracketed pass
+   pipeline exactly as if its flags had been set by hand (training is
+   bit-identical to doing so — pinned by test), lands on
+   ``compiled._plan``, is gauged in telemetry, and is explainable via
+   ``tools/dp_comm_stats.py --plan`` / ``tools/progcheck.py --plan``
+   (every candidate's modeled time + modeled peak + why rejected).
+
+The searcher never mutates a program and never compiles a candidate:
+pricing is pure analysis over the pre-rewrite program, so a full sweep
+costs milliseconds, not compiles.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["ParallelPlan", "enumerate_candidates", "modeled_step_time",
+           "search_plan", "resolve_plan", "plan_flag_overrides",
+           "applied_plan", "clear_search_cache"]
+
+_MB = float(1 << 20)
+
+
+@dataclass(frozen=True)
+class ParallelPlan:
+    """One point in the auto-parallel plan space.  ``bucket_mb`` is a
+    string so "auto" and numeric thresholds share one hashable field
+    (the flag has the same duality); ``per_param_depths`` carries the
+    prefetch autotune's (param, depth) pairs when ``prefetch_auto``."""
+
+    stage: int = 0
+    bucket_mb: str = "32.0"
+    prefetch_depth: int = 1
+    overlap: bool = True
+    prefetch_auto: bool = False
+    per_param_depths: Tuple[Tuple[str, int], ...] = field(default=())
+
+    def as_tuple(self) -> tuple:
+        """The resolved-plan cache-key tuple (compile caches key on
+        this, so a re-search after calibration changes can never serve
+        a stale fixed-flag compile)."""
+        return (int(self.stage), str(self.bucket_mb),
+                int(self.prefetch_depth), bool(self.overlap),
+                bool(self.prefetch_auto), tuple(self.per_param_depths))
+
+    def as_dict(self) -> dict:
+        return {"stage": int(self.stage), "bucket_mb": str(self.bucket_mb),
+                "prefetch_depth": int(self.prefetch_depth),
+                "overlap": bool(self.overlap),
+                "prefetch_auto": bool(self.prefetch_auto),
+                "per_param_depths": dict(self.per_param_depths)}
+
+    def flag_overrides(self) -> dict:
+        """The flag values that reproduce this plan by hand (modulo
+        ``per_param_depths``, which has no single-flag spelling — the
+        DP compile path consumes them directly)."""
+        mb: object = self.bucket_mb
+        if str(mb).strip().lower() != "auto":
+            mb = float(mb)
+        return {"dp_sharding": int(self.stage),
+                "fuse_grad_size_in_MB": mb,
+                "dp_prefetch_depth": int(self.prefetch_depth),
+                "dp_comm_overlap": int(bool(self.overlap))}
+
+    @classmethod
+    def from_flags(cls) -> "ParallelPlan":
+        """The plan today's hand flags describe — the baseline every
+        searched plan is compared against."""
+        from ..utils.flags import flag
+
+        return cls(stage=int(flag("dp_sharding") or 0),
+                   bucket_mb=str(flag("fuse_grad_size_in_MB")),
+                   prefetch_depth=int(flag("dp_prefetch_depth") or 0),
+                   overlap=bool(flag("dp_comm_overlap")))
+
+
+def plan_flag_overrides(plan: Optional[ParallelPlan]) -> dict:
+    return plan.flag_overrides() if plan is not None else {}
+
+
+class applied_plan:
+    """Context manager: the chosen plan's flags are in effect for the
+    duration of one compile (and restored after), so the entire
+    verifier-bracketed pass pipeline sees exactly the configuration a
+    hand-flagged run would — bit-identity by construction."""
+
+    def __init__(self, plan: Optional[ParallelPlan]):
+        self.plan = plan
+        self._saved: Dict[str, object] = {}
+
+    def __enter__(self):
+        if self.plan is None:
+            return self
+        from ..utils import flags as _flags
+
+        over = self.plan.flag_overrides()
+        for k in over:
+            self._saved["FLAGS_" + k] = _flags._flags.get("FLAGS_" + k)
+        _flags.set_flags(over)
+        return self
+
+    def __exit__(self, *exc):
+        if self.plan is not None:
+            from ..utils import flags as _flags
+
+            _flags._flags.update(self._saved)
+        return False
+
+
+# ==========================================================================
+# pricing
+# ==========================================================================
+def _divisible(block, name, ndev) -> bool:
+    var = block._find_var_recursive(name)
+    if (var is None or getattr(var, "_sharding", None)
+            or var.shape is None or not list(var.shape)):
+        return False
+    d0 = var.shape[0]
+    return bool(d0) and d0 > 0 and d0 % ndev == 0
+
+
+def _grad_entries(ops, block, ndev, stage, use_shard_map):
+    """One reduce entry per (param, grad) pair of every certified
+    update op: payload bytes, the index of the grad's last (non-comm)
+    producer, and whether ZeRO-2 may reduce-scatter it — the same
+    eligibility the fuse pass / GSPMD constraint planner apply."""
+    from ..framework.memory_plan import var_bytes
+    from ..utils.cost_model import COMM_OPS
+    from . import partition_rules
+    from .data_parallel import _update_shard_rows
+
+    writer: Dict[str, int] = {}
+    for i, op_ in enumerate(ops):
+        if op_.type in COMM_OPS:
+            continue
+        for n in op_.output_arg_names:
+            writer[n] = i
+    entries = []
+    seen = set()
+    for op_ in ops:
+        if not partition_rules.is_update_op(op_.type):
+            continue
+        params = op_.inputs.get("Param", [])
+        grads = op_.inputs.get("Grad", [])
+        if len(params) != len(grads):
+            continue
+        for p, g in zip(params, grads):
+            if g in seen:
+                continue
+            seen.add(g)
+            b = var_bytes(block, g)
+            if not b:
+                continue
+            scatter = False
+            if stage >= 2 and ndev > 1:
+                if use_shard_map:
+                    scatter = _update_shard_rows(op_, block, ndev) \
+                        is not None
+                else:
+                    scatter = _divisible(block, p, ndev) and \
+                        _divisible(block, g, ndev)
+            gvar = block._find_var_recursive(g)
+            entries.append({"param": p, "grad": g, "nbytes": int(b),
+                            "widx": writer.get(g, 0), "scatter": scatter,
+                            "dtype": getattr(gvar, "dtype", None)})
+    entries.sort(key=lambda e: e["widx"])
+    return entries
+
+
+def _auto_partition(entries, ready, ndev, cm):
+    """The r9 variable-boundary objective on the pricing side: O(N^2)
+    DP over contiguous same-key (scatter-eligibility + dtype) splits of
+    the ready-ordered entries minimizing the serialized comm stream's
+    finish time — the same recurrence as
+    ``fuse_all_reduce_pass._autotune_buckets``.  The pass additionally
+    enforces per-op placement-safety horizons the model cannot see
+    pre-rewrite, so this is the pass's OPTIMISTIC bound: a plan priced
+    on it can only over-estimate how well bucket=auto will do, which
+    still ranks candidates consistently (every candidate is priced the
+    same way)."""
+    from ..utils.cost_model import collective_time_s
+
+    def key(e):
+        return (e["scatter"], e["dtype"])
+
+    N = len(entries)
+    INF = float("inf")
+    best = [INF] * (N + 1)
+    best[0] = 0.0
+    cut = [0] * (N + 1)
+    for i in range(1, N + 1):
+        nbytes = 0
+        for j in range(i - 1, -1, -1):
+            if key(entries[j]) != key(entries[i - 1]):
+                break
+            nbytes += entries[j]["nbytes"]
+            if best[j] == INF:
+                continue
+            factor = 1.0 if entries[j]["scatter"] else 2.0
+            comm = collective_time_s(nbytes, factor, ndev, cm)
+            fin = max(best[j], ready[i - 1]) + comm
+            if fin < best[i]:
+                best[i] = fin
+                cut[i] = j
+    bounds = []
+    i = N
+    while i > 0:
+        bounds.append((cut[i], i))
+        i = cut[i]
+    bounds.reverse()
+    return [entries[a:b] for a, b in bounds]
+
+
+def _bucketize(entries, ready, plan: ParallelPlan, ndev, use_shard_map, cm):
+    """Candidate bucket stream: [{ready_s, comm_s}] in issue order."""
+    from ..utils.cost_model import collective_time_s
+
+    def one(members):
+        factor = 1.0 if members[0]["scatter"] else 2.0
+        nbytes = sum(m["nbytes"] for m in members)
+        return {"n_tensors": len(members), "payload_bytes": nbytes,
+                "ready_s": max(m["_ready_s"] for m in members),
+                "comm_s": collective_time_s(nbytes, factor, ndev, cm)}
+
+    for e, r in zip(entries, ready):
+        e["_ready_s"] = r
+    mb = str(plan.bucket_mb).strip().lower()
+    if not use_shard_map or mb in ("0", "0.0"):
+        # pjit (GSPMD issues per-grad collectives) / unfused: one
+        # collective per gradient tensor
+        groups = [[e] for e in entries]
+    elif mb == "auto":
+        groups = _auto_partition(entries, ready, ndev, cm)
+    else:
+        cap = float(mb) * _MB
+        groups = []
+        cur: List[dict] = []
+        cur_bytes = 0
+        for e in entries:
+            if cur and (e["scatter"], e["dtype"]) != (cur[0]["scatter"],
+                                                     cur[0]["dtype"]):
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(e)
+            cur_bytes += e["nbytes"]
+            if cur_bytes >= cap:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            groups.append(cur)
+    return [one(g) for g in groups if g]
+
+
+def modeled_step_time(program, ndev: int, plan: ParallelPlan,
+                      use_shard_map: bool, cm=None,
+                      prefetch_records: Optional[Sequence[dict]] = None,
+                      ctx: Optional[dict] = None) -> dict:
+    """Price one candidate plan: modeled step seconds =
+    compute horizon + exposed collective tail + ZeRO gather costs.
+
+    The same function prices a hand-flag configuration
+    (``ParallelPlan.from_flags()``), so "the searched plan's modeled
+    time is <= every hand configuration in the sweep" holds by
+    construction: the argmin is taken over a superset priced
+    identically.  ``ctx`` (a plain dict ``search_plan`` threads through
+    a sweep) memoizes the stage-dependent planning sets and the
+    backward timeline, which are identical across the ~dozens of
+    candidates sharing a stage."""
+    from ..framework.memory_plan import var_bytes
+    from ..utils.cost_model import (backward_timeline, collective_time_s,
+                                    default_cost_model, model_comm_stream)
+    from .data_parallel import (_pjit_zero23_sets, _plan_param_prefetch,
+                                _plan_wrapped_updates)
+    from . import partition_rules
+
+    ctx = ctx if ctx is not None else {}
+    block = program.global_block()
+    ops = list(block.ops)
+    if cm is None:
+        cm = default_cost_model(ops, block)
+    if "timeline" not in ctx:
+        ctx["timeline"] = backward_timeline(ops, block, cm)
+    times, t_bwd_end = ctx["timeline"]
+    t_compute = times[-1] if times else 0.0
+    stage = int(plan.stage)
+
+    # ---- gradient reduction stream --------------------------------------
+    ekey = ("entries", stage)
+    if ekey not in ctx:
+        ctx[ekey] = _grad_entries(ops, block, ndev, stage, use_shard_map)
+    entries = ctx[ekey]
+    ready = [times[e["widx"]] if plan.overlap else t_bwd_end
+             for e in entries]
+    buckets = _bucketize(entries, ready, plan, ndev, use_shard_map, cm)
+    stream = model_comm_stream(buckets, t_bwd_end, cm)
+    exposed_s = stream["exposed_s"]
+
+    # ---- ZeRO ladder gather costs ---------------------------------------
+    zkey = ("zero_sets", stage)
+    if zkey not in ctx:
+        sharded_params: set = set()
+        skip_ids: set = set()
+        gathered_params: set = set()
+        if stage >= 1 and ndev > 1:
+            if use_shard_map:
+                plans, _, sharded_params = _plan_wrapped_updates(
+                    ops, block, ndev, stage)
+                skip_ids = set(plans)
+                gathered_params = {pl["param"] for pl in plans.values()}
+            else:
+                sharded_params, _ = _pjit_zero23_sets(ops, block, ndev,
+                                                      stage)
+                for op_ in ops:
+                    if not partition_rules.is_update_op(op_.type):
+                        continue
+                    if not partition_rules.opt_state_slots(op_.type):
+                        continue
+                    for p in op_.inputs.get("Param", []):
+                        if _divisible(block, p, ndev):
+                            gathered_params.add(p)
+        ctx[zkey] = (sharded_params, skip_ids, gathered_params)
+    sharded_params, skip_ids, gathered_params = ctx[zkey]
+    # stage 1/2: the updated parameter all-gathers back to full width
+    # after the (shard) update — a tail cost nothing can hide behind.
+    # Stage 3 params stay sharded: no tail gather.
+    tail_gather_s = 0.0
+    for p in sorted(gathered_params - sharded_params):
+        b = var_bytes(block, p) or 0
+        tail_gather_s += collective_time_s(float(b), 1.0, ndev, cm)
+
+    # stage 3: forward/backward gather windows; the prefetch window
+    # hides min(gather, window compute), JIT (depth 0) hides nothing
+    # and pays one gather per consumer site.
+    gather_exposed_s = 0.0
+    n_windows = 0
+    if stage >= 3 and sharded_params and ndev > 1:
+        depths = dict(plan.per_param_depths) or None
+        depth = int(plan.prefetch_depth)
+        records = prefetch_records
+        if records is None and (depth > 0 or depths):
+            records, _, _ = _plan_param_prefetch(
+                ops, block, sharded_params, skip_ids, depth, depths=depths)
+        if records:
+            n_windows = len(records)
+            covered = {r["param"] for r in records}
+            for r in records:
+                b = var_bytes(block, r["param"]) or 0
+                g_s = collective_time_s(float(b), 1.0, ndev, cm)
+                lo = int(r.get("gather_at", 0))
+                first = int(r.get("first_consumer", lo))
+                window_s = max(0.0, times[min(first, len(times) - 1)]
+                               - times[min(lo, len(times) - 1)])
+                gather_exposed_s += max(0.0, g_s - window_s)
+        else:
+            covered = set()
+        from ..backward import OpRole
+
+        skip_roles = int(OpRole.Optimize) | int(OpRole.LRSched)
+        for p in sorted(sharded_params - covered):
+            # JIT gather at every fwd/bwd consumer site, fully exposed.
+            # Optimize/LRSched-role consumers (the update op itself)
+            # operate on the SHARD and never gather — the same skip
+            # rule _plan_param_prefetch applies, so depth-0 candidates
+            # aren't billed phantom gathers.
+            b = var_bytes(block, p) or 0
+            g_s = collective_time_s(float(b), 1.0, ndev, cm)
+            sites = 0
+            for op_ in ops:
+                if id(op_) in skip_ids:
+                    continue
+                if int(op_.attrs.get("op_role", 0)) & skip_roles:
+                    continue
+                if p in op_.input_arg_names:
+                    sites += 1
+            gather_exposed_s += sites * g_s
+
+    total = t_compute + exposed_s + tail_gather_s + gather_exposed_s
+    return {
+        "modeled_step_s": total,
+        "t_compute_s": t_compute,
+        "t_backward_end_s": t_bwd_end,
+        "comm_exposed_s": exposed_s,
+        "tail_gather_s": tail_gather_s,
+        "prefetch_exposed_s": gather_exposed_s,
+        "n_buckets": len(buckets),
+        "n_prefetch_windows": n_windows,
+        "wire_payload_bytes": int(sum(b["payload_bytes"] for b in buckets)),
+    }
+
+
+# ==========================================================================
+# candidate enumeration + search
+# ==========================================================================
+#: plan-space axes the searcher spans.  Bucket thresholds only matter on
+#: the shard_map path (explicit c_allreduce_sum ops to coalesce); depth
+#: variants only at stage 3.  "auto" prefetch = the per-param
+#: prefetch_autotune_pass.
+BUCKET_CANDIDATES = ("0", "4.0", "32.0", "auto")
+PREFETCH_CANDIDATES = (0, 1, 2, 4, 8, "auto")
+
+
+def enumerate_candidates(program, ndev: int, use_shard_map: bool,
+                         cm=None) -> List[ParallelPlan]:
+    from ..utils.flags import flag
+
+    base_mb = str(flag("fuse_grad_size_in_MB"))
+    # the overlap axis only exists where there is an explicit comm
+    # schedule to reorder (the shard_map fuse pass); pjit's collectives
+    # are GSPMD-placed and the flag is inert there
+    overlaps = (True, False) if use_shard_map else (True,)
+    out: List[ParallelPlan] = []
+    auto_depths: Optional[Tuple[Tuple[str, int], ...]] = None
+    for stage in (0, 1, 2, 3):
+        buckets = BUCKET_CANDIDATES if use_shard_map else (base_mb,)
+        for mb in buckets:
+            for overlap in overlaps:
+                if mb == "auto" and not overlap:
+                    continue  # the pass itself degrades auto w/o overlap
+                if stage < 3:
+                    out.append(ParallelPlan(stage=stage, bucket_mb=mb,
+                                            prefetch_depth=1,
+                                            overlap=overlap))
+                    continue
+                for depth in PREFETCH_CANDIDATES:
+                    if depth == "auto":
+                        if auto_depths is None:
+                            auto_depths = _autotune_depths(
+                                program, ndev, use_shard_map, cm)
+                        if not auto_depths:
+                            continue  # nothing sharded: == depth 1
+                        out.append(ParallelPlan(
+                            stage=3, bucket_mb=mb, prefetch_depth=1,
+                            overlap=overlap, prefetch_auto=True,
+                            per_param_depths=auto_depths))
+                    else:
+                        out.append(ParallelPlan(
+                            stage=3, bucket_mb=mb,
+                            prefetch_depth=int(depth), overlap=overlap))
+    return out
+
+
+def _autotune_depths(program, ndev, use_shard_map, cm
+                     ) -> Tuple[Tuple[str, int], ...]:
+    """Run the verifier-bracketed prefetch_autotune_pass and return its
+    per-param depths as a sorted hashable tuple."""
+    from ..framework.ir import get_pass
+
+    p = get_pass("prefetch_autotune_pass", ndev=int(ndev),
+                 use_shard_map=bool(use_shard_map), cost_model=cm)
+    p.apply(program)
+    depths = (getattr(p, "report", None) or {}).get("depths") or {}
+    return tuple(sorted((k, int(v)) for k, v in depths.items()))
+
+
+def search_plan(program, feed_names=(), fetch_names=(), *,
+                ndev: int, use_shard_map: Optional[bool] = None,
+                scope=None, budget_bytes: Optional[int] = None,
+                cm=None, assumed_batch: int = 64,
+                strict: Optional[bool] = None) -> Tuple[ParallelPlan, dict]:
+    """Enumerate -> price -> feasibility-gate -> argmin.
+
+    Returns ``(plan, report)``; ``report["candidates"]`` carries every
+    candidate's modeled step time, modeled peak, and rejection reason —
+    the explainability surface ``dp_comm_stats --plan`` and
+    ``progcheck --plan`` print.  When NO candidate fits the budget the
+    minimum-peak candidate is returned with ``report["infeasible"]`` set
+    and ``MemoryBudgetError`` raised when ``strict`` (default: the
+    FLAGS_hbm_budget_strict compile-path contract; lint tools pass
+    ``strict=False`` so they can still PRINT the table and exit
+    non-zero) — the caller still compiles something diagnosable rather
+    than dying with no plan at all."""
+    from ..framework import memory_plan as mp
+    from ..utils.cost_model import default_cost_model
+
+    block = program.global_block()
+    ops = list(block.ops)
+    if use_shard_map is None:
+        from .data_parallel import _program_has_collectives
+
+        use_shard_map = _program_has_collectives(program)
+    if budget_bytes is None:
+        budget_bytes = mp.budget_bytes()
+    if cm is None:
+        cm = default_cost_model(ops, block)
+
+    candidates = enumerate_candidates(program, ndev, use_shard_map, cm)
+    ctx: Dict = {}   # per-sweep memo: timeline + per-stage planning sets
+    mem_cache: Dict[tuple, object] = {}
+    rows: List[dict] = []
+    best = None
+    best_row = None
+    fallback = None
+    fallback_row = None
+    for cand in candidates:
+        price = modeled_step_time(program, ndev, cand, use_shard_map, cm,
+                                  ctx=ctx)
+        # bucket/overlap do not move the MEMORY plan (the liveness pass
+        # runs on the pre-rewrite program) — cache per (stage, prefetch)
+        # so a full sweep prices memory once per ladder rung
+        mem_key = (cand.stage, cand.prefetch_depth, cand.prefetch_auto,
+                   cand.per_param_depths)
+        plan_mem = mem_cache.get(mem_key)
+        if plan_mem is None:
+            from .data_parallel import _plan_param_prefetch
+
+            records = None
+            if cand.stage >= 3:
+                # the pricing call above populated the stage-3 sets
+                sharded, skip, _ = ctx[("zero_sets", 3)]
+                records, _, _ = _plan_param_prefetch(
+                    ops, block, sharded, skip, int(cand.prefetch_depth),
+                    depths=dict(cand.per_param_depths) or None)
+            plan_mem = mp.plan_memory(
+                program, feed_names=feed_names, fetch_names=fetch_names,
+                ndev=ndev, stage=cand.stage, use_shard_map=use_shard_map,
+                prefetch_records=records,
+                prefetch_depth=int(cand.prefetch_depth),
+                assumed_batch=assumed_batch, scope=scope)
+            mem_cache[mem_key] = plan_mem
+        peak = int(plan_mem.peak_bytes)
+        feasible = not budget_bytes or peak <= budget_bytes
+        reason = None
+        if not feasible:
+            reason = (f"modeled peak {peak / _MB:.2f} MB > "
+                      f"FLAGS_hbm_budget_mb={budget_bytes / _MB:g} "
+                      f"(rejected before compile)")
+        row = {**cand.as_dict(), **price,
+               "modeled_peak_bytes": peak,
+               "modeled_peak_mb": round(peak / _MB, 3),
+               "feasible": feasible, "rejected": reason, "chosen": False}
+        rows.append(row)
+        if feasible and (best is None
+                         or price["modeled_step_s"]
+                         < best_row["modeled_step_s"]):
+            best, best_row = cand, row
+        if fallback is None or peak < fallback_row["modeled_peak_bytes"]:
+            fallback, fallback_row = cand, row
+
+    infeasible = best is None
+    if infeasible:
+        best, best_row = fallback, fallback_row
+        if strict is None:
+            from ..utils.flags import flag
+
+            strict = bool(flag("hbm_budget_strict"))
+        msg = (f"auto-parallel plan search: no candidate fits "
+               f"FLAGS_hbm_budget_mb={budget_bytes / _MB:g} MB "
+               f"(min modeled peak "
+               f"{best_row['modeled_peak_bytes'] / _MB:.2f} MB); "
+               f"compiling the minimum-peak plan")
+        if strict:
+            raise mp.MemoryBudgetError(msg)
+        import warnings
+
+        warnings.warn(msg, ResourceWarning, stacklevel=2)
+    if best_row is not None:
+        best_row["chosen"] = True
+    report = {
+        "path": "shard_map" if use_shard_map else "pjit",
+        "ndev": int(ndev),
+        "budget_bytes": int(budget_bytes or 0),
+        "n_candidates": len(rows),
+        "n_rejected": sum(1 for r in rows if not r["feasible"]),
+        "infeasible": infeasible,
+        "calibrated": bool(_calibrated(cm)),
+        "chosen": best_row,
+        "candidates": rows,
+    }
+    return best, report
+
+
+def _calibrated(cm) -> bool:
+    from ..utils.cost_model import measured_profile
+
+    return measured_profile() is not None
+
+
+# ==========================================================================
+# memoized compile-path entry
+# ==========================================================================
+_CACHE_LOCK = threading.Lock()
+_SEARCH_CACHE: Dict[tuple, Tuple[ParallelPlan, dict]] = {}
+
+
+def clear_search_cache():
+    with _CACHE_LOCK:
+        _SEARCH_CACHE.clear()
+
+
+def resolve_plan(program, feed_names, fetch_names, mesh_fp, ndev,
+                 use_shard_map, scope=None) -> Tuple[ParallelPlan, dict]:
+    """The DP compile path's entry: memoized on (program identity,
+    tensor-parallel annotations, mesh, budget, calibration version) — a
+    new measured profile, budget, or `shard_parameter` annotation
+    re-runs the search, so a stale plan can never be served after any
+    of them change (its tuple keys the compile cache too)."""
+    from ..framework.memory_plan import budget_bytes
+    from ..utils.cost_model import calibration_version
+
+    # TP annotations (var._sharding) change ZeRO eligibility but do NOT
+    # bump program._version — sign them explicitly, like _compile_dp's
+    # own shard_sig
+    ann_sig = tuple(sorted(
+        (v.name, tuple(getattr(v, "_sharding", ()) or ()))
+        for blk in program.blocks for v in blk.vars.values()
+        if getattr(v, "_sharding", None)))
+    key = (program._uid, program._version, tuple(sorted(feed_names)),
+           tuple(fetch_names), mesh_fp, int(ndev), bool(use_shard_map),
+           ann_sig, int(budget_bytes() or 0), calibration_version())
+    with _CACHE_LOCK:
+        hit = _SEARCH_CACHE.get(key)
+    if hit is not None:
+        return hit
+    plan, report = search_plan(program, feed_names, fetch_names,
+                               ndev=ndev, use_shard_map=use_shard_map,
+                               scope=scope)
+    _publish_telemetry(plan, report)
+    with _CACHE_LOCK:
+        if len(_SEARCH_CACHE) > 64:
+            _SEARCH_CACHE.clear()
+        _SEARCH_CACHE[key] = (plan, report)
+    return plan, report
+
+
+def _publish_telemetry(plan: ParallelPlan, report: dict):
+    """Gauge the chosen plan so dashboards see what the searcher did."""
+    from ..utils import telemetry as tm
+
+    path = report.get("path", "")
+    tm.counter("dp_plan_searches_total",
+               "auto-parallel plan searches run "
+               "(parallel/plan_search.py)").inc()
+    tm.gauge("dp_plan_stage", "ZeRO stage the plan search selected",
+             labels=("path",)).labels(path=path).set(plan.stage)
+    tm.gauge("dp_plan_prefetch_depth",
+             "prefetch depth the plan search selected (uniform base; "
+             "per-param depths ride compiled._plan)",
+             labels=("path",)).labels(path=path).set(plan.prefetch_depth)
+    chosen = report.get("chosen") or {}
+    tm.gauge("dp_plan_modeled_step_s",
+             "modeled step seconds of the selected plan",
+             labels=("path",)).labels(path=path).set(
+                 float(chosen.get("modeled_step_s") or 0.0))
+    tm.gauge("dp_plan_modeled_peak_bytes",
+             "modeled per-device HBM peak of the selected plan",
+             labels=("path",)).labels(path=path).set(
+                 float(chosen.get("modeled_peak_bytes") or 0.0))
+    tm.counter("dp_plan_candidates_rejected_total",
+               "plan candidates rejected by plan_memory() before any "
+               "compile").inc(int(report.get("n_rejected") or 0))

@@ -125,6 +125,20 @@ _DEFAULTS: Dict[str, Any] = {
     # the last consumer — gather layer k+1 while layer k computes.  0
     # restores the r8 just-in-time gather at every consumer.
     "FLAGS_dp_prefetch_depth": 1,
+    # cost-model-driven auto-parallel plan search (parallel/
+    # plan_search.py, r16): "auto" makes the DP compile path ENUMERATE
+    # candidate plans (ZeRO stage x bucket threshold incl. "auto" x
+    # prefetch depth incl. per-param autotune x comm overlap), price
+    # each with the calibrated cost model's modeled step time, reject
+    # candidates whose plan_memory() modeled peak exceeds
+    # FLAGS_hbm_budget_mb BEFORE any compile, and run the argmin through
+    # the normal verifier-bracketed pass pipeline.  The chosen plan is
+    # attached as compiled._plan, gauged in telemetry, and explainable
+    # via tools/dp_comm_stats.py --plan.  "" (default) keeps today's
+    # flag-driven behavior bit-for-bit: FLAGS_dp_sharding /
+    # FLAGS_fuse_grad_size_in_MB / FLAGS_dp_prefetch_depth /
+    # FLAGS_dp_comm_overlap apply exactly as set.
+    "FLAGS_dp_plan": "",
     # while_loop with a statically-derivable trip count (counter-vs-
     # constant less_than cond, constant-step counter update) lowers to
     # lax.scan: the forward stays on-device and the backward becomes one
@@ -218,6 +232,13 @@ def _coerce(cur, val):
             return "auto"
         return float(val)
     return val
+
+
+def dp_plan_auto() -> bool:
+    """True when FLAGS_dp_plan selects the searched auto-parallel plan
+    (parallel/plan_search.py) instead of the hand-set flags."""
+    v = flag("dp_plan", "")
+    return isinstance(v, str) and v.strip().lower() == "auto"
 
 
 def fuse_grad_mb_auto() -> bool:

@@ -390,12 +390,16 @@ def test_shard_map_lamb_lars_cross_shard_trust_ratio(opt):
 
 def test_update_shard_rows_covers_lamb_lars():
     """The shared eligibility helper (fuse pass <-> runtime wrapper)
-    now admits lamb/lars_momentum update ops."""
-    from paddle_tpu.parallel.data_parallel import (
-        _SHARDABLE_UPDATE_OPS, _update_shard_rows)
+    admits lamb/lars_momentum update ops — certified "cross_norm" by
+    the partition-rule engine (their trust-ratio norms psum across
+    shards)."""
+    from paddle_tpu.parallel import partition_rules
+    from paddle_tpu.parallel.data_parallel import _update_shard_rows
 
-    assert "lamb" in _SHARDABLE_UPDATE_OPS
-    assert "lars_momentum" in _SHARDABLE_UPDATE_OPS
+    assert partition_rules.shardable_update("lamb")
+    assert partition_rules.shardable_update("lars_momentum")
+    assert partition_rules.update_kind("lamb") == "cross_norm"
+    assert partition_rules.update_kind("lars_momentum") == "cross_norm"
     unique_name.switch()
     main, startup, loss = build_mlp_dp_program(
         n_layers=2, width=16, optimizer="lamb", transpile=True)

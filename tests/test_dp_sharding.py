@@ -547,12 +547,12 @@ def test_stage_flip_mid_run_carries_state(collective):
 def test_shard_map_zero1_shares_slot_table():
     """Satellite: ZeRO-1 on the fleet-collective path — SGD has no
     state to shard (stays unwrapped at stage 1), momentum's Velocity
-    (from the shared _OPT_STATE_SLOTS table) shards 1/8 at unchanged
-    trajectory."""
-    from paddle_tpu.parallel.data_parallel import (
-        _OPT_STATE_SLOTS, _update_shard_rows)
+    (derived by the shared partition-rule engine from the registered
+    slot declarations) shards 1/8 at unchanged trajectory."""
+    from paddle_tpu.parallel import partition_rules
+    from paddle_tpu.parallel.data_parallel import _update_shard_rows
 
-    assert _OPT_STATE_SLOTS["momentum"] == ("Velocity",)
+    assert partition_rules.opt_state_slots("momentum") == ("Velocity",)
     from paddle_tpu.framework import unique_name
 
     unique_name.switch()

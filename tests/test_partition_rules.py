@@ -1,0 +1,260 @@
+"""The r16 partition-rule engine (parallel/partition_rules.py).
+
+Oracles:
+* regex matching: precedence is FIRST match wins (rule order is the
+  tie-break, not specificity), unmatched vars fall back to replicated;
+* the registry-metadata derivation (update-op structure + state slots)
+  reproduces the deleted legacy tables bit-for-bit — the
+  rule-table-equals-legacy-tables pin, checked on programs built for
+  BOTH DP paths;
+* uncertified update ops (ftrl, dgc_momentum, proximal_*) derive NO
+  shard eligibility: structure alone must not shard an op whose math
+  nobody certified;
+* the per-stage mesh mapping expresses the whole ZeRO ladder, and
+  dp_partition_specs reproduces the DP compile path's sharding
+  decisions (eligibility gating, TP annotations winning).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.parallel import partition_rules as pr
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from dp_comm_stats import build_mlp_dp_program  # noqa: E402
+
+#: the exact pre-r16 tables (deleted from data_parallel.py) — the
+#: derivation oracle.  If a lowering's slots change, this pin fails
+#: loudly instead of the ZeRO ladder silently changing shape.
+LEGACY_OPT_STATE_SLOTS = {
+    "momentum": ("Velocity",),
+    "lars_momentum": ("Velocity",),
+    "adam": ("Moment1", "Moment2"),
+    "adamw": ("Moment1", "Moment2"),
+    "lamb": ("Moment1", "Moment2"),
+    "adamax": ("Moment", "InfNorm"),
+    "adagrad": ("Moment",),
+    "decayed_adagrad": ("Moment",),
+    "adadelta": ("AvgSquaredGrad", "AvgSquaredUpdate"),
+    "rmsprop": ("Moment", "MeanSquare", "MeanGrad"),
+    "fused_momentum": ("Velocity",),
+    "fused_adam": ("Moment1", "Moment2"),
+}
+LEGACY_SHARDABLE_UPDATE_OPS = frozenset({
+    "sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
+    "decayed_adagrad", "adadelta", "rmsprop", "lamb", "lars_momentum",
+})
+
+
+# --------------------------------------------------------------------------
+# generic matcher semantics
+# --------------------------------------------------------------------------
+def test_first_match_wins_over_later_rules():
+    """Precedence is rule ORDER: a later, more specific rule never
+    overrides an earlier match."""
+    rules = [
+        (r"^param/", pr.AxisNames("row")),
+        (r"^param/special", pr.AxisNames()),  # unreachable: order wins
+        (r"bias", pr.AxisNames("b")),
+    ]
+    got = pr.match_partition_rules(
+        rules, ["param/special_w", "other/fc_bias", "param/w"])
+    assert got["param/special_w"] == pr.AxisNames("row")
+    assert got["other/fc_bias"] == pr.AxisNames("b")
+    assert got["param/w"] == pr.AxisNames("row")
+
+
+def test_regex_precedence_specific_first():
+    """The intended idiom: list specific rules first (the default rule
+    set puts the beta-pow exclusion ahead of the opt_state catch-all)."""
+    rules = [
+        (r"^opt_state/.*[Bb]eta\d*_?[Pp]ow", pr.AxisNames()),
+        (r"^opt_state/", pr.AxisNames("opt_row")),
+    ]
+    got = pr.match_partition_rules(
+        rules, ["opt_state/fc_0.w_0_beta1_pow_acc_0",
+                "opt_state/fc_0.w_0_moment1_0"])
+    assert got["opt_state/fc_0.w_0_beta1_pow_acc_0"] == pr.AxisNames()
+    assert got["opt_state/fc_0.w_0_moment1_0"] == pr.AxisNames("opt_row")
+
+
+def test_unmatched_var_falls_back_to_replicated():
+    """A name no rule matches gets the replicated default, not an
+    error — one exotic var must not break a whole compile."""
+    got = pr.match_partition_rules(
+        [(r"^param/", pr.AxisNames("row"))], ["mystery/thing"])
+    assert got["mystery/thing"] == pr.AxisNames()
+    # and the engine-wide default rules end in a catch-all
+    got2 = pr.match_partition_rules(pr.DEFAULT_LOGICAL_RULES,
+                                    ["other/unheard_of_var"])
+    assert got2["other/unheard_of_var"] == pr.AxisNames()
+
+
+def test_search_semantics_not_fullmatch():
+    """Rules use re.search (the SNIPPETS/t5x convention): a substring
+    pattern matches anywhere in the key."""
+    got = pr.match_partition_rules([("moment", pr.AxisNames("m"))],
+                                   ["opt_state/adam_moment1_0"])
+    assert got["opt_state/adam_moment1_0"] == pr.AxisNames("m")
+
+
+# --------------------------------------------------------------------------
+# registry-derived tables == legacy tables (the pin)
+# --------------------------------------------------------------------------
+def test_derived_state_slots_equal_legacy_table():
+    for op_type, slots in LEGACY_OPT_STATE_SLOTS.items():
+        got = pr.opt_state_slots(op_type)
+        assert set(got) == set(slots), (op_type, got, slots)
+
+
+def test_shardable_set_equals_legacy_table():
+    probe = set(LEGACY_SHARDABLE_UPDATE_OPS) | {
+        "ftrl", "dpsgd", "dgc_momentum", "proximal_gd",
+        "proximal_adagrad", "fused_sgd", "fused_adam", "fused_momentum",
+        "batch_norm", "sum", "not_an_op",
+    }
+    got = {t for t in probe if pr.shardable_update(t)}
+    assert got == LEGACY_SHARDABLE_UPDATE_OPS
+
+
+def test_union_eligibility_matches_legacy_union():
+    """is_update_op == (in legacy slots table) OR (in legacy shardable
+    set) — the exact condition _pjit_zero23_sets used."""
+    legacy_union = set(LEGACY_OPT_STATE_SLOTS) | LEGACY_SHARDABLE_UPDATE_OPS
+    probe = legacy_union | {"ftrl", "dpsgd", "dgc_momentum",
+                            "proximal_adagrad", "fused_sgd", "batch_norm"}
+    got = {t for t in probe if pr.is_update_op(t)}
+    assert got == legacy_union
+
+
+def test_uncertified_update_ops_derive_nothing():
+    """ftrl/dgc_momentum/proximal_adagrad LOOK like update ops
+    (Param+Grad+ParamOut) but no rule certifies their math on a row
+    shard — they must stay out of every shard set."""
+    for t in ("ftrl", "dgc_momentum", "proximal_adagrad", "proximal_gd",
+              "dpsgd"):
+        assert pr.update_kind(t) is None, t
+        assert pr.opt_state_slots(t) == (), t
+    # beta-pow accumulators are excluded BY RULE, not by luck
+    assert "Beta1Pow" not in pr.opt_state_slots("adam")
+    assert "Beta2Pow" not in pr.opt_state_slots("lamb")
+
+
+def test_norm_updates_flagged_cross_shard():
+    assert pr.norm_update("lamb") and pr.norm_update("lars_momentum")
+    assert not pr.norm_update("adam") and not pr.norm_update("sgd")
+    # fused multi-tensor forms: state visible to GSPMD, wrapper keeps
+    # them whole
+    assert pr.update_kind("fused_adam") == "state_only"
+    assert not pr.shardable_update("fused_adam")
+
+
+@pytest.mark.parametrize("transpile", [False, True],
+                         ids=["pjit", "shard_map"])
+def test_legacy_pin_on_real_programs_both_paths(transpile):
+    """On a real adam program built for each DP path, the planning
+    helpers (driven by the rule engine) produce exactly the shard sets
+    the legacy tables produced: every divisible moment shards, beta
+    pows never do."""
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.parallel.data_parallel import (
+        _plan_wrapped_updates, _sharded_opt_state, _update_shard_rows)
+
+    unique_name.switch()
+    main, startup, loss = build_mlp_dp_program(
+        n_layers=3, width=16, optimizer="adam", transpile=transpile)
+    blk = main.global_block()
+    ops = list(blk.ops)
+
+    if transpile:
+        plans, sharded_state, _ = _plan_wrapped_updates(ops, blk, 8, 1)
+        assert plans, "adam updates must wrap at stage 1"
+        rows = [_update_shard_rows(o, blk, 8) for o in ops
+                if o.type == "adam"]
+        assert any(rows)
+    else:
+        sharded_state = _sharded_opt_state(ops, blk, 8)
+        assert sharded_state
+
+    # exactly the legacy shape: moment accumulators of divisible params
+    legacy_state = set()
+    for op_ in ops:
+        if op_.type != "adam":
+            continue
+        for slot in LEGACY_OPT_STATE_SLOTS["adam"]:
+            for n in op_.inputs.get(slot, []):
+                var = blk._find_var_recursive(n)
+                if var is not None and var.shape and var.shape[0] % 8 == 0:
+                    legacy_state.add(n)
+    if transpile:
+        # the wrapper also requires param/grad/state to share d0; on
+        # this MLP that filters the same set
+        assert sharded_state <= legacy_state
+        assert all("beta" not in n.lower() for n in sharded_state)
+        assert sharded_state
+    else:
+        assert sharded_state == legacy_state
+    assert all("pow" not in n.lower() for n in sharded_state)
+
+
+# --------------------------------------------------------------------------
+# ladder-as-rules + spec building
+# --------------------------------------------------------------------------
+def test_zero_mesh_rules_express_ladder():
+    for stage, want in [
+        (0, {"opt_row": None, "grad_row": None, "param_row": None}),
+        (1, {"opt_row": "dp", "grad_row": None, "param_row": None}),
+        (2, {"opt_row": "dp", "grad_row": "dp", "param_row": None}),
+        (3, {"opt_row": "dp", "grad_row": "dp", "param_row": "dp"}),
+    ]:
+        table = dict(pr.zero_mesh_rules(stage, "dp"))
+        for k, v in want.items():
+            assert table[k] == v, (stage, k)
+        assert table["batch"] == "dp"
+
+
+def test_dp_partition_specs_gating_and_annotations():
+    names = ["w", "m", "b", "tp_w", "feed_x"]
+    classes = {"w": "param", "m": "opt_state", "b": "param",
+               "tp_w": "param", "feed_x": "feed"}
+    specs = pr.dp_partition_specs(
+        names, classes, stage=3, axis="dp",
+        eligible={"w", "m"},                      # b indivisible
+        annotations={"tp_w": ("mp",)})
+    assert specs["w"] == ("dp",)
+    assert specs["m"] == ("dp",)
+    assert specs["b"] == ()          # rule said shard, eligibility said no
+    assert specs["tp_w"] == ("mp",)  # TP annotation wins over ZeRO rules
+    assert specs["feed_x"] == ("dp",)
+    # stage 1: params replicated even when eligible
+    specs1 = pr.dp_partition_specs(names, classes, stage=1, axis="dp",
+                                   eligible={"w", "m"})
+    assert specs1["w"] == () and specs1["m"] == ("dp",)
+
+
+def test_shard_and_gather_fns_roundtrip():
+    """make_shard_and_gather_fns: a row-sharded placement really holds
+    1/ndev resident bytes per device and gathers back bit-identically."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.registry().clear()
+    mesh = mesh_mod.init_mesh()
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    shard_fns, gather_fns = pr.make_shard_and_gather_fns(
+        {"x": P("dp"), "y": P()}, mesh)
+    placed = shard_fns["x"](x)
+    assert isinstance(placed, jax.Array)
+    assert placed.addressable_shards[0].data.nbytes == x.nbytes // 8
+    back = gather_fns["x"](placed)
+    np.testing.assert_array_equal(back, x)
+    repl = shard_fns["y"](x)
+    assert repl.addressable_shards[0].data.nbytes == x.nbytes
+    mesh_mod.registry().clear()

@@ -24,6 +24,14 @@ and prints the before/after JSON:
                                   [--autotune] [--prefetch-depth K]
                                   [--calibrate-ms MS]
                                   [--calibrate-from-trace TRACE.json]
+                                  [--plan] [--optimizer adam]
+
+``--plan`` (r16) prints the FLAGS_dp_plan=auto searcher's full
+candidate table for the probe program — per candidate: modeled step
+time (the argmin objective), plan_memory() modeled peak, and the
+rejection reason when FLAGS_hbm_budget_mb ruled it out before compile
+— plus which candidate won.  This is how a searched plan is reviewed
+without running anything.
 
 ``--autotune`` (== --mb auto, FLAGS_fuse_grad_size_in_MB="auto") turns
 on the measurement-driven variable-bucket mode and prints BOTH the
@@ -393,6 +401,18 @@ def main(argv=None):
                          "the rewritten program (plus the rank-0-vs-"
                          "rank-1 collective-order check) and exit "
                          "non-zero on errors")
+    ap.add_argument("--plan", action="store_true",
+                    help="run the FLAGS_dp_plan=auto searcher "
+                         "(parallel/plan_search.py) on the probe program "
+                         "and print EVERY candidate's modeled step time, "
+                         "modeled HBM peak, and why it was rejected — "
+                         "the explainability surface for the searched "
+                         "plan (honors FLAGS_hbm_budget_mb; "
+                         "--calibrate-ms/-from-trace calibrate it)")
+    ap.add_argument("--optimizer", default="sgd",
+                    help="probe optimizer (sgd|adam|lamb|lars|momentum) "
+                         "— adam gives the plan search real opt state "
+                         "to shard")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -469,7 +489,8 @@ def main(argv=None):
             calibration_source = prof.get("source") or "measured_profile"
 
     main_p, _, loss = build_mlp_dp_program(args.layers, args.width,
-                                           args.nranks)
+                                           args.nranks,
+                                           optimizer=args.optimizer)
     before = collect_comm_stats(main_p, args.nranks)
     exe = pt.Executor(pt.CPUPlace())
     rewritten = exe._apply_ir_passes(main_p, [loss.name])
@@ -503,6 +524,29 @@ def main(argv=None):
         out["prefetch"] = prefetch_stats(rewritten, args.nranks,
                                          int(flags.flag(
                                              "dp_prefetch_depth")))
+    if args.plan:
+        # every candidate the FLAGS_dp_plan=auto searcher would
+        # consider, priced with the same (possibly calibrated) cost
+        # model — modeled step time, modeled peak, rejection reason
+        from paddle_tpu.parallel import plan_search
+
+        if mesh_mod.current_mesh() is None:
+            import jax
+
+            mesh_mod.init_mesh((min(args.nranks, len(jax.devices())),),
+                               ("dp",))
+        plan_sel, report = plan_search.search_plan(
+            main_p, ("x", "y"), (loss.name,), ndev=args.nranks,
+            use_shard_map=True, cm=cm, strict=False)
+        out["plan"] = report
+        print(f"# plan search: {report['n_candidates']} candidates, "
+              f"{report['n_rejected']} rejected by plan_memory(), "
+              f"chosen: stage={plan_sel.stage} "
+              f"bucket={plan_sel.bucket_mb} "
+              f"prefetch={'auto' if plan_sel.prefetch_auto else plan_sel.prefetch_depth} "
+              f"modeled={report['chosen']['modeled_step_s']:.3e}s "
+              f"peak={report['chosen']['modeled_peak_mb']}MB",
+              file=sys.stderr)
     rc = 0
     if args.verify:
         from progcheck import check_cross_device, check_program

@@ -28,6 +28,14 @@ per-device peak, the peak op, the top live vars, and — with
 exceeds the budget.  ``--ndev`` / ``--mem-stage`` model the (mesh,
 ZeRO stage) the program would compile under.
 
+``--plan`` (r16) lints a program's auto-parallel plan search
+(parallel/plan_search.py — the same searcher FLAGS_dp_plan=auto runs at
+DP compile time): prints every candidate's modeled step time, modeled
+peak and rejection reason, the chosen plan, and exits NON-ZERO when the
+only feasible plans exceed the budget (``--budget-mb``, default
+FLAGS_hbm_budget_mb) — i.e. the program cannot be compiled within the
+configured HBM.  ``--ndev`` sizes the modeled mesh.
+
 Programs are the JSON produced by ``Program.serialize_to_string()``
 (also what ``save_inference_model`` writes as the model desc).  Exit
 status: 1 when errors are found (``--strict``: warnings too), else 0 —
@@ -115,6 +123,22 @@ def check_memory(program, feed_names=(), fetch_names=(), ndev=1,
                                    stage=stage)
 
 
+def check_plan(program, feed_names=(), fetch_names=(), ndev=1,
+               budget_mb=0.0):
+    """Auto-parallel plan search for one program (the FLAGS_dp_plan=auto
+    searcher) -> (plan, report).  ``report["infeasible"]`` means no
+    candidate fits the budget — the lint failure this mode exists for."""
+    from paddle_tpu.parallel import plan_search
+
+    budget = int(float(budget_mb) * (1 << 20)) if budget_mb else None
+    # strict=False: the lint's job is to PRINT the table and exit 1 on
+    # infeasibility — a FLAGS_hbm_budget_strict environment must not
+    # turn that into a traceback with no diagnostics
+    return plan_search.search_plan(program, feed_names, fetch_names,
+                                   ndev=ndev, budget_bytes=budget,
+                                   strict=False)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -135,6 +159,11 @@ def main(argv=None):
     ap.add_argument("--mem", action="store_true",
                     help="also run the static HBM planner on each "
                          "program (modeled peak, peak op, top live vars)")
+    ap.add_argument("--plan", action="store_true",
+                    help="lint each program's auto-parallel plan search: "
+                         "candidate table (modeled time/peak/rejection), "
+                         "chosen plan, exit 1 when only infeasible plans "
+                         "remain under --budget-mb/FLAGS_hbm_budget_mb")
     ap.add_argument("--budget-mb", type=float, default=0.0,
                     help="with --mem: exit non-zero when any program's "
                          "modeled peak exceeds this many MB")
@@ -190,6 +219,24 @@ def main(argv=None):
             if args.budget_mb and plan.peak_mb > args.budget_mb:
                 over_budget.append(label)
 
+    plan_rows = []
+    plan_infeasible = []
+    if args.plan:
+        import warnings
+
+        from paddle_tpu.utils.flags import flag as _flag
+
+        budget_mb = args.budget_mb or float(_flag("hbm_budget_mb") or 0)
+        for label, prog in progs:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", ResourceWarning)
+                chosen, report = check_plan(prog, feed_names, fetch_names,
+                                            ndev=args.ndev,
+                                            budget_mb=budget_mb)
+            plan_rows.append(dict(report, program=label))
+            if report["infeasible"]:
+                plan_infeasible.append(label)
+
     if args.as_json:
         out = {
             "programs": per_prog,
@@ -203,6 +250,9 @@ def main(argv=None):
             if args.budget_mb:
                 out["budget_mb"] = args.budget_mb
                 out["over_budget"] = over_budget
+        if args.plan:
+            out["plan"] = plan_rows
+            out["plan_infeasible"] = plan_infeasible
         print(json.dumps(out, indent=2, default=str))
     else:
         if not args.quiet:
@@ -220,11 +270,37 @@ def main(argv=None):
                                else "within")
                     print(f"budget: {verdict} {args.budget_mb} MB "
                           f"(modeled peak {plan.peak_mb:.6f} MB)")
+        if args.plan:
+            for row in plan_rows:
+                ch = row.get("chosen") or {}
+                print(f"--- plan: {row['program']} (ndev={args.ndev}, "
+                      f"{row['n_candidates']} candidates, "
+                      f"{row['n_rejected']} rejected"
+                      + (", NO FEASIBLE PLAN" if row["infeasible"]
+                         else "") + ") ---")
+                if not args.quiet:
+                    for c in row["candidates"]:
+                        mark = ">" if c["chosen"] else " "
+                        why = f"  [{c['rejected']}]" if c["rejected"] \
+                            else ""
+                        pf = "auto" if c["prefetch_auto"] \
+                            else c["prefetch_depth"]
+                        print(f"{mark} stage={c['stage']} "
+                              f"bucket={c['bucket_mb']:>5} prefetch={pf} "
+                              f"modeled={c['modeled_step_s']:.3e}s "
+                              f"peak={c['modeled_peak_mb']}MB{why}")
+                print(f"chosen: stage={ch.get('stage')} "
+                      f"bucket={ch.get('bucket_mb')} "
+                      f"modeled={ch.get('modeled_step_s'):.3e}s "
+                      f"peak={ch.get('modeled_peak_mb')}MB")
         print(f"progcheck: {len(per_prog)} program(s), "
               f"{n_err} error(s), {n_warn} warning(s)"
               + (f", {len(over_budget)} over budget" if args.mem
-                 and args.budget_mb else ""))
-    return 1 if (n_err or (args.strict and n_warn) or over_budget) else 0
+                 and args.budget_mb else "")
+              + (f", {len(plan_infeasible)} plan-infeasible"
+                 if args.plan else ""))
+    return 1 if (n_err or (args.strict and n_warn) or over_budget
+                 or plan_infeasible) else 0
 
 
 if __name__ == "__main__":
