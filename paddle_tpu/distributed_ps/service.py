@@ -25,6 +25,7 @@ import numpy as np
 
 from ..profiler import RecordEvent
 from ..utils import telemetry as tm
+from ..utils import tracing
 from .table import DenseTable, SparseTable
 
 
@@ -246,30 +247,56 @@ class PSServer:
                             "table": name})
 
     def _handle_inner(self, op, name, meta, arrays, sock):
+        # server-side span (r17): a request carrying trace_ctx gets its
+        # handling recorded against the SAME trace id (parented on the
+        # client's span), so one trace shows the RPC end-to-end
+        ctx = (meta or {}).get("trace_ctx")
+        s_tr = s_span = None
+        if ctx and tracing.enabled():
+            s_tr, s_span = tracing.server_span(
+                f"ps_server:{op}", ctx, attrs={"op": op})
+        try:
+            self._handle_deduped(op, name, meta, arrays, sock,
+                                 s_tr, s_span)
+        finally:
+            if s_span is not None:
+                s_tr.end(s_span)  # no-op when a branch already ended it
+
+    def _handle_deduped(self, op, name, meta, arrays, sock, s_tr, s_span):
+        trace_id = ((meta or {}).get("trace_ctx") or {}).get("trace_id")
         req_id = (meta or {}).get("req_id")
         if not (req_id and op in _MUTATING_OPS):
-            self._dispatch(op, name, meta, arrays, sock)
+            self._dispatch_traced(op, name, meta, arrays, sock,
+                                  s_tr, s_span)
             return
         # begin() BLOCKS while the same id is mid-apply on another
         # thread (a fast retry can land on a new connection before the
         # original apply finishes), then answers duplicate-or-claimed
         if self.dedup.begin(req_id):
             # first attempt fully applied, its reply was lost: ack
-            # without touching state
+            # without touching state — the span is tagged as a dedup
+            # replay carrying the ORIGINAL apply's trace id
             tm.counter("ps_dedup_replays_total",
                        "mutating RPCs acked from the server deduper "
                        "(lost-reply retries short-circuited)").inc()
+            if s_span is not None:
+                s_tr.end(s_span, attrs={
+                    "dedup_replay": True,
+                    "origin_trace": self.dedup.origin(req_id) or ""})
             _send_msg(sock, "ok", meta={"duplicate": True})
             return
         try:
-            self._dispatch(op, name, meta, arrays, sock)
+            self._dispatch_traced(op, name, meta, arrays, sock,
+                                  s_tr, s_span)
         except (ConnectionError, OSError):
             # mutating branches touch no sockets while applying — a
             # transport error out of one means the APPLY completed and
             # only the ok-reply failed to send (the exact lost-reply
             # case): commit, so the incoming retry is acked not
             # re-applied.
-            self.dedup.commit(req_id)
+            self.dedup.commit(req_id, trace_id=trace_id)
+            if s_span is not None:
+                s_tr.end(s_span, attrs={"reply_lost": True})
             raise
         except BaseException:
             # apply failed (an "error" reply goes out via _handle):
@@ -277,7 +304,14 @@ class PSServer:
             # but a manual resend may legitimately re-apply
             self.dedup.abort(req_id)
             raise
-        self.dedup.commit(req_id)
+        self.dedup.commit(req_id, trace_id=trace_id)
+
+    def _dispatch_traced(self, op, name, meta, arrays, sock, s_tr, s_span):
+        if s_span is None:
+            self._dispatch(op, name, meta, arrays, sock)
+        else:
+            with tracing.use_span(s_tr, s_span):
+                self._dispatch(op, name, meta, arrays, sock)
 
     def _dispatch(self, op, name, meta, arrays, sock):
         if op == "create_dense":
@@ -736,11 +770,32 @@ class PSClient:
             retries = 0
         if op in _MUTATING_OPS and "req_id" not in meta:
             meta["req_id"] = self._next_req_id()
+        # trace-context propagation (r17): when the caller runs inside
+        # a request trace, this logical RPC gets ONE client span (all
+        # wire attempts inside it — chaos/retry annotations attach to
+        # it) and the wire header carries {trace_id, span_id} next to
+        # the idempotence key, so the server's span joins the same
+        # trace and a dedup-acked replay can be tagged with its origin.
+        tr = span = None
+        cur = tracing.current() if tracing.enabled() else None
+        if cur is not None:
+            tr, parent = cur
+            span = tr.start(f"ps:{op}", parent=parent,
+                            attrs={"op": op, "ep": ep})
+            meta["trace_ctx"] = {"trace_id": tr.trace_id,
+                                 "span_id": span.span_id}
         start = time.time()
         attempt = 0
         while True:
             try:
-                return self._transact(ep, op, name, meta, arrays)
+                if span is not None:
+                    with tracing.use_span(tr, span):
+                        out = self._transact(ep, op, name, meta, arrays)
+                else:
+                    out = self._transact(ep, op, name, meta, arrays)
+                if span is not None:
+                    tr.end(span, attrs={"attempts": attempt + 1})
+                return out
             except (ConnectionError, OSError):
                 left = (deadline_s - (time.time() - start)
                         if deadline_s else float("inf"))
@@ -750,6 +805,9 @@ class PSClient:
                             "ps_rpc_deadline_exceeded_total",
                             "RPCs abandoned because FLAGS_rpc_deadline "
                             "expired").inc()
+                    if span is not None:
+                        tr.end(span, attrs={"attempts": attempt + 1,
+                                            "error": "transport"})
                     raise
                 with self._lock:
                     self.n_retries += 1
@@ -758,6 +816,11 @@ class PSClient:
                            labels=("plane",)).labels(plane="json").inc()
                 _backoff_sleep(attempt, backoff_s, left, self._rng)
                 attempt += 1
+            except BaseException as e:
+                if span is not None:
+                    tr.end(span, attrs={"attempts": attempt + 1,
+                                        "error": type(e).__name__})
+                raise
 
     def _transact(self, ep, op, name, meta, arrays):
         """Single wire attempt.  ANY failure mid-transaction (transport

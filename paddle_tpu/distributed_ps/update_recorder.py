@@ -87,6 +87,10 @@ class RequestDeduper:
         self._seen: set = set()
         self._inflight: set = set()
         self._order = deque()
+        # req_id -> trace id of the ORIGINAL (committed) apply, so a
+        # dedup-acked replay can be tagged with the trace that actually
+        # mutated state (r17 trace propagation); bounded with _order
+        self._origin: dict = {}
 
     def begin(self, req_id: str) -> bool:
         with self._cv:
@@ -97,14 +101,18 @@ class RequestDeduper:
             self._inflight.add(req_id)
             return False
 
-    def commit(self, req_id: str) -> None:
+    def commit(self, req_id: str, trace_id: str = None) -> None:
         with self._cv:
             self._inflight.discard(req_id)
             if req_id not in self._seen:
                 self._seen.add(req_id)
                 self._order.append(req_id)
+                if trace_id:
+                    self._origin[req_id] = trace_id
                 while len(self._order) > self.capacity:
-                    self._seen.discard(self._order.popleft())
+                    old = self._order.popleft()
+                    self._seen.discard(old)
+                    self._origin.pop(old, None)
             self._cv.notify_all()
 
     def abort(self, req_id: str) -> None:
@@ -115,6 +123,12 @@ class RequestDeduper:
     def seen(self, req_id: str) -> bool:
         with self._cv:
             return req_id in self._seen
+
+    def origin(self, req_id: str):
+        """Trace id recorded with the original commit (None when the
+        apply was untraced or already evicted)."""
+        with self._cv:
+            return self._origin.get(req_id)
 
     def __len__(self):
         with self._cv:

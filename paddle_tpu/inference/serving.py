@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -50,6 +51,7 @@ from ..framework.scope import Scope, scope_guard
 from ..executor import Executor
 from ..profiler import RecordEvent, instant_event, is_profiler_enabled
 from ..utils import telemetry as tm
+from ..utils import tracing
 from .kv_cache import KVCacheConfig, PagedKVCache
 
 __all__ = [
@@ -364,6 +366,12 @@ class Request:
     # telemetry: previous emit time of the CURRENT run (reset with
     # out_tokens on preemption, matching loadgen's final-run accounting)
     _tm_last: Optional[float] = field(default=None, repr=False)
+    # per-token gaps of the CURRENT run (gaps[0] = TTFT; reset with
+    # out_tokens on preemption) — the SLO tracker's per-request input
+    _tm_gaps: List[float] = field(default_factory=list, repr=False)
+    # the request's span tree (utils/tracing.py Trace) when this
+    # request was head-sampled under FLAGS_trace_requests, else None
+    trace: Optional[object] = field(default=None, repr=False)
 
 
 @dataclass(frozen=True)
@@ -394,13 +402,117 @@ def _observe_token(req: Request, now: float):
     prev = req.arrival_time if first or req._tm_last is None \
         else req._tm_last
     gap = max(now - prev, 0.0)
+    req._tm_gaps.append(gap)
+    # the histogram -> trace exemplar link: a traced request's latency
+    # observation carries its trace id, so a p99 bucket names a trace
+    ex = req.trace.trace_id if req.trace is not None else None
     tm.histogram("serving_token_latency_s",
                  "per-token latency (inter-token gap; first token from "
-                 "arrival)").observe(gap)
+                 "arrival)").observe(gap, exemplar=ex)
     if first:
         tm.histogram("serving_ttft_s",
-                     "time to first token from arrival").observe(gap)
+                     "time to first token from arrival").observe(
+                         gap, exemplar=ex)
     req._tm_last = now
+
+
+# ==========================================================================
+# request-scoped tracing hooks (utils/tracing.py) — shared by both
+# schedulers.  Every hook short-circuits on req.trace is None, so with
+# FLAGS_trace_requests=0 (or an unsampled request) the scheduler runs
+# the exact pre-tracing instruction stream (bit-identity pinned).
+# ==========================================================================
+def _trace_submit(req: Request):
+    """Root + queue_wait spans at submit (head-sampled: the keep/drop
+    decision is deterministic in (FLAGS_trace_seed, req_id))."""
+    if not tracing.enabled() or not tracing.sampled(req.req_id):
+        return
+    tr = tracing.new_trace(req.req_id)
+    req.trace = tr
+    tr._root = tr.start("request", t=req.arrival_time, attrs={
+        "req": str(req.req_id), "prompt_tokens": len(req.prompt),
+        "max_new_tokens": req.max_new_tokens})
+    tr._wait = tr.start("queue_wait", t=req.arrival_time, parent=tr._root)
+
+
+def _trace_reject(req: Request, reason: str):
+    """A request rejected at submit still gets a (one-span) trace: the
+    finish/reject leg of the span taxonomy."""
+    if not tracing.enabled() or not tracing.sampled(req.req_id):
+        return
+    tr = tracing.new_trace(req.req_id)
+    root = tr.start("request", t=req.arrival_time,
+                    attrs={"req": str(req.req_id),
+                           "prompt_tokens": len(req.prompt)})
+    tr.end(root, t=req.arrival_time,
+           attrs={"status": "rejected", "reason": reason})
+    tr.finish()
+
+
+def _trace_backpressure(req: Request, kind: str):
+    """Pool backpressure repeats every step while the head request
+    waits — a counter ATTR on the open wait span keeps the signal
+    bounded (an event per blocked step would grow without limit)."""
+    tr = req.trace
+    if tr is not None and tr._wait is not None:
+        tr._wait.attrs[kind] = tr._wait.attrs.get(kind, 0) + 1
+
+
+def _trace_admit(req: Request, now: float, wall0: float, wall1: float):
+    """Successful prefill: close the open wait span (queue_wait, or the
+    preempted span of a resume cycle) and record the prefill span with
+    its real wall bounds."""
+    tr = req.trace
+    if tr is None:
+        return
+    tr.end(tr._wait, t=now)
+    tr._wait = None
+    tr.add("prefill", t0=now, wall0=wall0, wall1=wall1, parent=tr._root,
+           attrs={"prompt_tokens": len(req.prompt),
+                  "resume": req.preemptions})
+
+
+def _trace_decode(states: Sequence["_SeqState"], toks: Sequence[int],
+                  now: float, wall0: float, wall1: float, step_no: int):
+    """One decode-step span per TRACED request in the batch (shared
+    wall bounds: the batch runs as one program)."""
+    for st, tok in zip(states, toks):
+        tr = st.req.trace
+        if tr is not None:
+            tr.add("decode_step", t0=now, wall0=wall0, wall1=wall1,
+                   parent=tr._root,
+                   attrs={"step": step_no, "batch": len(states),
+                          "token": int(tok)})
+
+
+def _trace_preempt(req: Request, now: float):
+    """Preemption opens a `preempted` span — the wait leg of this
+    preempt/resume cycle; the resume's prefill closes it."""
+    tr = req.trace
+    if tr is None:
+        return
+    tr._wait = tr.start("preempted", t=now, parent=tr._root,
+                        attrs={"cycle": req.preemptions})
+
+
+def _trace_finish(req: Request, now: float):
+    """Close the root span with the request's outcome and feed the SLO
+    tracker (the tracker sees EVERY finished request — sampling only
+    gates span recording, never the goodput denominators)."""
+    tr = req.trace
+    if tr is not None:
+        attrs = {"status": "finished", "tokens": len(req.out_tokens),
+                 "preemptions": req.preemptions}
+        if req._tm_gaps:
+            attrs["ttft_s"] = round(req._tm_gaps[0], 9)
+        tr.end(tr._root, t=now, attrs=attrs)
+        tr.finish()
+    if tm.enabled():
+        tm.slo_tracker().observe_request(
+            req.req_id,
+            ttft_s=req._tm_gaps[0] if req._tm_gaps else float("nan"),
+            decode_gaps=req._tm_gaps[1:],
+            trace_id=tr.trace_id if tr is not None else None)
 
 
 def _pow2_bucket(n: int, lo: int = 1, hi: Optional[int] = None) -> int:
@@ -691,10 +803,12 @@ class ServingEngine:
                     f"request {req.req_id!r}: prompt of "
                     f"{len(req.prompt)} tokens can never fit "
                     f"token_budget {self.token_budget}")
-        except ValueError:
+        except ValueError as e:
             tm.counter("serving_rejected_total",
                        "requests rejected at submit (unservable)").inc()
+            _trace_reject(req, str(e))
             raise
+        _trace_submit(req)
         self.waiting.append(req)
 
     def has_work(self) -> bool:
@@ -714,10 +828,14 @@ class ServingEngine:
             if cost > budget:
                 break
             if not self._admission_fits(req):
+                _trace_backpressure(req, "admission_backpressure")
                 break  # pool backpressure: retry next step
+            wall0 = time.perf_counter()
             tok = self.core.prefill(req)
             if tok is None:
+                _trace_backpressure(req, "prefill_backpressure")
                 break  # pool backpressure: retry next step
+            _trace_admit(req, now, wall0, time.perf_counter())
             self.waiting.pop(0)
             budget -= cost
             req.admitted_at = now if req.admitted_at is None else \
@@ -746,7 +864,9 @@ class ServingEngine:
             self.kv.free_sequence(victim.req.req_id)
             victim.req.out_tokens = []
             victim.req._tm_last = None
+            victim.req._tm_gaps = []
             victim.req.preemptions += 1
+            _trace_preempt(victim.req, now)
             self.waiting.insert(0, victim.req)
             self.stats["preempted"] += 1
             tm.counter("serving_preempted_total",
@@ -757,9 +877,12 @@ class ServingEngine:
                               args={"req": str(victim.req.req_id)})
         # --- decode ------------------------------------------------------
         if self.running:
+            wall0 = time.perf_counter()
             toks = self.core.decode_batch(self.running)
             self.stats["decode_steps"] += 1
             self.stats["decode_tokens"] += len(self.running)
+            _trace_decode(self.running, toks, now, wall0,
+                          time.perf_counter(), self.stats["decode_steps"])
             tm.counter("serving_decode_steps_total",
                        "batched decode steps run").inc()
             tm.counter("serving_decode_tokens_total",
@@ -809,10 +932,18 @@ class ServingEngine:
         self.stats["finished"] += 1
         tm.counter("serving_finished_total",
                    "requests finished (pages evicted on finish)").inc()
+        _trace_finish(st.req, now)
         if is_profiler_enabled():
             instant_event("evict", cat="serving",
                           args={"req": str(st.req.req_id)})
         return StepEvent(st.req.req_id, tok, True, now)
+
+    def slo_hint(self) -> dict:
+        """Read hook for the (next-PR) SLO-aware admission rung: live
+        burn rate, goodput and declared targets from the process SLO
+        tracker.  This PR's admission stays FIFO and never reads it —
+        the hook only exposes the signal."""
+        return tm.slo_tracker().admission_hint()
 
     def run_to_completion(self, now: float = 0.0) -> List[StepEvent]:
         events = []
@@ -855,10 +986,12 @@ class StaticBatchingEngine:
     def submit(self, req: Request):
         try:
             _reject_unservable(req, self.core.cfg, self.core.kv_config)
-        except ValueError:
+        except ValueError as e:
             tm.counter("serving_rejected_total",
                        "requests rejected at submit (unservable)").inc()
+            _trace_reject(req, str(e))
             raise
+        _trace_submit(req)
         self.waiting.append(req)
 
     def has_work(self) -> bool:
@@ -875,9 +1008,11 @@ class StaticBatchingEngine:
                         > self.core.kv_config.num_pages:
                     break  # group is as large as worst-case capacity allows
                 self._reserved_pages += worst
+                wall0 = time.perf_counter()
                 tok = self.core.prefill(req)
                 if tok is None:
                     break
+                _trace_admit(req, now, wall0, time.perf_counter())
                 self.waiting.pop(0)
                 req.admitted_at = now
                 self.stats["admitted"] += 1
@@ -889,14 +1024,18 @@ class StaticBatchingEngine:
                     self.core.kv.free_sequence(req.req_id)
                     req.finished_at = now
                     self.stats["finished"] += 1
+                    _trace_finish(req, now)
                     events.append(StepEvent(req.req_id, tok, True, now))
                 else:
                     events.append(StepEvent(req.req_id, tok, False, now))
                     self.group.append(st)
             return events
+        wall0 = time.perf_counter()
         toks = self.core.decode_batch(self.group)
         self.stats["decode_steps"] += 1
         self.stats["decode_tokens"] += len(self.group)
+        _trace_decode(self.group, toks, now, wall0, time.perf_counter(),
+                      self.stats["decode_steps"])
         still = []
         for st, tok in zip(self.group, toks):
             st.req.out_tokens.append(tok)
@@ -906,6 +1045,7 @@ class StaticBatchingEngine:
                 self.core.kv.free_sequence(st.req.req_id)
                 st.req.finished_at = now
                 self.stats["finished"] += 1
+                _trace_finish(st.req, now)
                 events.append(StepEvent(st.req.req_id, tok, True, now))
             else:
                 events.append(StepEvent(st.req.req_id, tok, False, now))

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 
 __all__ = [
     "RecordEvent", "record_event", "instant_event", "counter_event",
+    "complete_event",
     "enable_profiler", "disable_profiler", "reset_profiler",
     "start_profiler", "stop_profiler", "profiler", "is_profiler_enabled",
     "get_events", "npu_profiler", "cuda_profiler", "LANES",
@@ -52,7 +53,10 @@ __all__ = [
 
 #: lane -> chrome-trace pid.  Lanes not listed get pids allocated past
 #: the reserved block, deterministically by first appearance.
-LANES = {"host": 0, "serving": 1, "rpc": 2, "chaos": 3, "memory": 4}
+#: "request" (r17) is the per-request tracing lane: utils/tracing.py
+#: emits each request's span tree there with tid = one row per trace.
+LANES = {"host": 0, "serving": 1, "rpc": 2, "chaos": 3, "memory": 4,
+         "request": 5}
 
 _state = threading.local()
 _GLOBAL_LOCK = threading.Lock()
@@ -165,6 +169,28 @@ def counter_event(name: str, values: dict, cat: str = "memory",
         _EVENTS.append(ev)
 
 
+def complete_event(name: str, cat: str = "host", ts: float = 0.0,
+                   dur: float = 0.0, tid: Optional[int] = None,
+                   args: Optional[dict] = None):
+    """Append an already-timed complete event (chrome ``ph: "X"``):
+    the request-tracing lane (utils/tracing.py) times spans with its
+    own clocks and records them here at span end.  ``tid`` overrides
+    the thread id so one request's spans share a row regardless of
+    which thread (client, server handler) produced them.  No-op when
+    the profiler is off."""
+    if not _ENABLED:
+        return
+    ev = {
+        "name": name, "cat": cat, "ts": float(ts), "dur": float(dur),
+        "tid": threading.get_ident() if tid is None else int(tid),
+        "depth": 0, "ph": "X",
+    }
+    if args:
+        ev["args"] = dict(args)
+    with _GLOBAL_LOCK:
+        _EVENTS.append(ev)
+
+
 def enable_profiler(state: str = "All", trace_dir: Optional[str] = None):
     """reference: profiler.h:208 EnableProfiler.  ``state`` is kept for
     API parity ('CPU'/'GPU'/'All'); device tracing starts whenever a
@@ -270,8 +296,12 @@ def _feed_calibration(summary: List[dict]):
 def summarize(events: List[dict], sorted_key: str = "default") -> List[dict]:
     rows: Dict[str, dict] = {}
     for e in events:
-        if e.get("ph") in ("i", "C"):
-            continue  # instants/counters mark moments; min/ave is noise
+        if e.get("ph") in ("i", "C", "X"):
+            # instants/counters mark moments; explicit-"X" events are
+            # pre-timed lane data (request spans) whose names overlap
+            # the host/serving RecordEvents — neither belongs in the
+            # host summary (or the calibration feed) as extra calls
+            continue
         r = rows.setdefault(e["name"], {
             "name": e["name"], "calls": 0, "total": 0.0,
             "max": 0.0, "min": float("inf"),

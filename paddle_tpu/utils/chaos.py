@@ -149,16 +149,24 @@ class FaultSchedule:
             raise ChaosRPCDrop(
                 f"chaos: dropped rpc #{n} ({op or '?'}) at {phase}")
 
-    @staticmethod
-    def _mark(kind: str, phase: str, n: int, op: str):
+    def _mark(self, kind: str, phase: str, n: int, op: str):
         """Injected fault -> telemetry counter + chaos timeline lane
-        (merged into the unified chrome trace when profiling)."""
+        (merged into the unified chrome trace when profiling) + an
+        annotation on the CURRENT request/RPC span (r17): the trace of
+        a chaos run shows WHY a span stalled — the event carries the
+        chaos kind and the schedule seed, correlating the aggregate
+        ``chaos_injections_total`` count to the affected request."""
         kind = kind if kind == "kill" else f"rpc_{kind}"
         from . import telemetry as tm
 
         tm.counter("chaos_injections_total",
                    "faults injected by the FLAGS_chaos schedule",
                    labels=("kind",)).labels(kind=kind).inc()
+        from . import tracing
+
+        tracing.annotate(f"chaos:{kind}",
+                         {"phase": phase, "n": n, "op": op or "?",
+                          "seed": self.seed})
         from .. import profiler
 
         profiler.instant_event(

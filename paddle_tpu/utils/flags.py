@@ -157,6 +157,33 @@ _DEFAULTS: Dict[str, Any] = {
     # bit-for-bit (host-side bookkeeping only; it never touches program
     # numerics either way, which the telemetry tests pin).
     "FLAGS_telemetry": True,
+    # request-scoped distributed tracing (utils/tracing.py): the
+    # serving engine records a span tree per request (submit ->
+    # queue_wait -> prefill -> decode steps -> preempt/resume cycles ->
+    # finish/reject), the PS client injects trace context next to the
+    # r11 idempotence key so the server's span joins the same trace,
+    # and spans emit as a per-request lane in the unified chrome trace.
+    # Off (default): nothing records, nothing allocates — serving token
+    # streams and training losses are bit-identical (pinned by test).
+    "FLAGS_trace_requests": False,
+    # head-based sampling for request traces: the keep/drop decision is
+    # a pure crc32 function of (FLAGS_trace_seed, req_id) made once at
+    # submit, so a seeded loadgen trace samples the SAME requests on
+    # every replay (the r12 determinism contract).  1.0 = every request.
+    "FLAGS_trace_sample_rate": 1.0,
+    "FLAGS_trace_seed": 0,
+    # declared serving SLO targets (utils/telemetry.py SLOTracker):
+    # TTFT and per-token latency bounds in ms (0 = target unset — every
+    # request counts as within), the SLO objective (fraction of
+    # requests that must meet the targets; 1-objective is the error
+    # budget the burn rate is measured against) and the rolling
+    # request window the burn rate is computed over.  Tools (slo_report
+    # / serving_bench) override these per run via
+    # telemetry.slo_tracker().configure().
+    "FLAGS_slo_ttft_ms": 0.0,
+    "FLAGS_slo_token_ms": 0.0,
+    "FLAGS_slo_objective": 0.99,
+    "FLAGS_slo_window": 256,
     # modeled-HBM budget gate (framework/memory_plan.py): when > 0, the
     # executor / DP compile paths check the static liveness planner's
     # modeled peak against this many MB and WARN naming the peak op and

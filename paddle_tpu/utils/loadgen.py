@@ -21,8 +21,8 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
-__all__ = ["poisson_trace", "replay_trace", "latency_report", "emit_json",
-           "pct"]
+__all__ = ["poisson_trace", "replay_trace", "latency_report",
+           "per_request_latency", "emit_json", "pct"]
 
 
 @dataclass(frozen=True)
@@ -136,6 +136,31 @@ def latency_report(raw: Dict) -> Dict:
         "kv_util_mean": round(float(np.mean(util)), 4) if util else 0.0,
         "kv_util_peak": round(float(np.max(util)), 4) if util else 0.0,
     }
+
+
+def per_request_latency(raw: Dict) -> Dict:
+    """Per-request TTFT + decode gaps from a replay — the INDEPENDENT
+    per-request view the online SLO tracker (utils/telemetry.py
+    SLOTracker) is reconciled against (tools/slo_report.py --quick):
+    same final-run convention as :func:`latency_report` (preempted
+    runs' tokens retroactively dropped, first gap from arrival)."""
+    out: Dict = {}
+    for rid, times in raw["token_times"].items():
+        req = raw["requests"][rid]
+        n_final = len(req.out_tokens)
+        times = times[-n_final:] if n_final else []
+        gaps, prev = [], req.arrival_time
+        for t in times:
+            gaps.append(t - prev)
+            prev = t
+        out[rid] = {
+            "ttft_s": gaps[0] if gaps else float("nan"),
+            "decode_gaps": gaps[1:],
+            "tokens": len(times),
+            "finished": req.finished_at is not None,
+            "preemptions": req.preemptions,
+        }
+    return out
 
 
 def emit_json(tag: str, payload: Dict) -> str:

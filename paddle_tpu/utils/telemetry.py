@@ -39,17 +39,30 @@ from __future__ import annotations
 import math
 import threading
 from bisect import bisect_right
+from collections import deque
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "NOOP", "MAX_SERIES",
+    "LABEL_DENYLIST", "SLOTargets", "SLOTracker", "UNSET",
     "enabled", "registry", "counter", "gauge", "histogram",
     "snapshot", "to_prometheus", "default_buckets",
+    "slo_tracker", "reset_slo",
 ]
 
 #: per-family bound on distinct label combinations; the 65th and later
 #: combinations share one overflow series (label values all "~overflow")
 MAX_SERIES = 64
+
+#: label keys the registry REJECTS at family creation: per-request
+#: identifiers mint one series per request — unbounded cardinality by
+#: construction (the overflow series would merely hide it).  Per-request
+#: values belong in span attributes (utils/tracing.py); a histogram
+#: bucket may carry ONE trace id as an exemplar instead.
+LABEL_DENYLIST = frozenset({
+    "request_id", "req_id", "req", "trace_id", "span_id",
+})
 
 #: label-values tuple of the shared overflow series
 OVERFLOW = "~overflow"
@@ -76,7 +89,7 @@ class _Noop:
     def set(self, value):
         pass
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
         pass
 
     def labels(self, **kv):
@@ -148,7 +161,8 @@ class Gauge(_Child):
 
 
 class Histogram(_Child):
-    __slots__ = ("_edges", "_counts", "_sum", "_count", "_min", "_max")
+    __slots__ = ("_edges", "_counts", "_sum", "_count", "_min", "_max",
+                 "_exemplars")
 
     def __init__(self, lock, labels, edges=_DEFAULT_BUCKETS):
         super().__init__(lock, labels)
@@ -158,8 +172,11 @@ class Histogram(_Child):
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        # bucket index -> last exemplar (a trace id): the histogram ->
+        # trace link, one string per bucket — bounded by construction
+        self._exemplars: Dict[int, str] = {}
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: Optional[str] = None):
         v = float(value)
         i = bisect_right(self._edges, v)
         with self._lock:
@@ -170,6 +187,8 @@ class Histogram(_Child):
                 self._min = v
             if v > self._max:
                 self._max = v
+            if exemplar is not None:
+                self._exemplars[i] = str(exemplar)
 
     def get(self) -> float:
         """Mean observation (the scalar view other kinds expose)."""
@@ -229,6 +248,26 @@ class Histogram(_Child):
         if lo > 0 and math.isfinite(hi):
             return math.sqrt(lo * hi)
         return lo if not math.isfinite(hi) else hi
+
+    def exemplar_for_quantile(self, q: float) -> Optional[str]:
+        """The trace id linked to the bucket holding the q-quantile
+        sample — "the p99 bucket names a trace you can pull up".  Falls
+        back to the nearest bucket with an exemplar when that exact
+        bucket recorded none (samples may be observed exemplar-less)."""
+        with self._lock:
+            if not self._count or not self._exemplars:
+                return None
+            pos = min(max(q, 0.0), 1.0) * (self._count - 1)
+            b = self._bucket_of_rank(int(math.ceil(pos)))
+            if b in self._exemplars:
+                return self._exemplars[b]
+            for i in range(b - 1, -1, -1):
+                if i in self._exemplars:
+                    return self._exemplars[i]
+            for i in range(b + 1, len(self._counts)):
+                if i in self._exemplars:
+                    return self._exemplars[i]
+            return None
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -292,8 +331,8 @@ class _Family:
     def set(self, value: float):
         return self._only().set(value)
 
-    def observe(self, value: float):
-        return self._only().observe(value)
+    def observe(self, value: float, exemplar=None):
+        return self._only().observe(value, exemplar)
 
     def get(self):
         return self._only().get()
@@ -312,6 +351,9 @@ class _Family:
 
     def quantile_bounds(self, q: float):
         return self._only().quantile_bounds(q)
+
+    def exemplar_for_quantile(self, q: float):
+        return self._only().exemplar_for_quantile(q)
 
     def series(self) -> Dict[Tuple[str, ...], _Child]:
         with self._lock:
@@ -340,6 +382,14 @@ class Registry:
     def _family(self, name: str, kind: str, help_: str,
                 labels: Sequence[str]) -> _Family:
         label_names = tuple(labels)
+        bad = sorted(l for l in label_names if l in LABEL_DENYLIST)
+        if bad:
+            raise ValueError(
+                f"telemetry instrument {name!r}: label key(s) {bad} are "
+                f"per-request identifiers — one series per request is "
+                f"unbounded cardinality.  Put per-request values in span "
+                f"attributes (utils/tracing.py) or link a trace id as a "
+                f"histogram exemplar instead.")
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
@@ -403,6 +453,16 @@ class Registry:
                         "max": (child._max if child._count else None),
                         "buckets": buckets,
                     })
+                    # copy under the child lock: a concurrent observe
+                    # may INSERT a bucket key (the other lockless reads
+                    # here are fixed-size lists/scalars)
+                    with child._lock:
+                        exemplars = dict(child._exemplars)
+                    if exemplars:
+                        row["exemplars"] = {
+                            (repr(child._edges[i])
+                             if i < len(child._edges) else "+Inf"): ex
+                            for i, ex in sorted(exemplars.items())}
                 else:
                     row["value"] = child.get()
                 rows.append(row)
@@ -469,3 +529,217 @@ def snapshot() -> Dict:
 
 def to_prometheus() -> str:
     return _REGISTRY.to_prometheus()
+
+
+# ==========================================================================
+# SLO accounting (r17): error-budget burn rate + goodput over finished
+# serving requests
+# ==========================================================================
+@dataclass(frozen=True)
+class SLOTargets:
+    """Declared serving SLO: latency bounds (None = unset, always met),
+    the objective (fraction of requests that must meet the bounds —
+    1-objective is the error budget) and the rolling request window the
+    burn rate is measured over."""
+
+    ttft_s: Optional[float] = None
+    token_s: Optional[float] = None
+    objective: float = 0.99
+    window: int = 256
+
+    def to_dict(self) -> dict:
+        return {"ttft_s": self.ttft_s, "token_s": self.token_s,
+                "objective": self.objective, "window": self.window}
+
+
+#: configure() sentinel: "argument not given — inherit the flag value"
+#: (distinct from an explicit None/0, which DISARMS the target)
+UNSET = object()
+
+
+def _flag_targets() -> SLOTargets:
+    from .flags import flag
+
+    ttft = float(flag("slo_ttft_ms", 0.0) or 0.0) / 1e3
+    token = float(flag("slo_token_ms", 0.0) or 0.0) / 1e3
+    return SLOTargets(
+        ttft_s=ttft or None, token_s=token or None,
+        objective=float(flag("slo_objective", 0.99) or 0.99),
+        window=max(int(flag("slo_window", 256) or 256), 1))
+
+
+class SLOTracker:
+    """Live SLO accounting over finished requests, fed by the serving
+    engines at finish time (inference/serving.py) with the exact
+    latency convention utils/loadgen.py reports — TTFT is the first
+    token's gap from arrival, decode gaps are the inter-token gaps of
+    the request's FINAL run — so the tracker's goodput reconciles
+    exactly with loadgen's independently computed per-request numbers
+    (pinned by tools/slo_report.py --quick).
+
+    * a request is **within SLO** when its TTFT meets the TTFT target
+      AND every decode gap meets the per-token target (unset targets
+      always met);
+    * **goodput** counts requests and tokens served within SLO vs
+      total (token granularity: the first token judged against the
+      TTFT target, each decode token against the per-token target);
+    * **burn rate** = (violating fraction of the last ``window``
+      finished requests) / (1 - objective): 1.0 means the error budget
+      drains exactly at the sustainable rate, >1 means it drains
+      faster.
+
+    ``admission_hint()`` is the read hook the next (SLO-aware
+    admission) serving rung consumes; this PR's admission stays FIFO
+    and never reads it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._targets = _flag_targets()
+        self._window: deque = deque(maxlen=self._targets.window)
+        self._req_total = 0
+        self._req_within = 0
+        self._tok_total = 0
+        self._tok_within = 0
+
+    # ------------------------------------------------------------------
+    def configure(self, ttft_s=UNSET, token_s=UNSET, objective=UNSET,
+                  window=UNSET) -> "SLOTracker":
+        """Declare targets for the next measurement window and zero the
+        accounting.  Omitted arguments inherit the FLAGS_slo_* values;
+        an EXPLICIT ``None``/``0`` target disarms it even when the flag
+        armed one (the tools' "0 = unset" CLI contract)."""
+        base = _flag_targets()
+        with self._lock:
+            self._targets = SLOTargets(
+                ttft_s=base.ttft_s if ttft_s is UNSET else (ttft_s or None),
+                token_s=(base.token_s if token_s is UNSET
+                         else (token_s or None)),
+                objective=(base.objective if objective is UNSET or not
+                           objective else float(objective)),
+                window=(base.window if window is UNSET or not window
+                        else int(window)))
+            self._window = deque(maxlen=max(self._targets.window, 1))
+            self._zero_locked()
+        return self
+
+    def reset(self):
+        """Zero the accounting, keep the declared targets (the
+        between-warmup-and-measured reset serving_bench does)."""
+        with self._lock:
+            self._window.clear()
+            self._zero_locked()
+
+    def _zero_locked(self):
+        self._req_total = self._req_within = 0
+        self._tok_total = self._tok_within = 0
+
+    @property
+    def targets(self) -> SLOTargets:
+        return self._targets
+
+    # ------------------------------------------------------------------
+    def observe_request(self, req_id, ttft_s: float,
+                        decode_gaps: Sequence[float],
+                        trace_id: Optional[str] = None) -> bool:
+        """One finished request.  ``ttft_s`` may be NaN (zero-token
+        request) — it then fails an armed TTFT target (a request that
+        never produced its first token did not meet it)."""
+        t = self._targets
+        has_first = ttft_s == ttft_s  # not NaN
+        ok_ttft = t.ttft_s is None or (has_first and ttft_s <= t.ttft_s)
+        if t.token_s is None:
+            ok_gaps, tok_gap_within = True, len(decode_gaps)
+        else:
+            tok_gap_within = sum(1 for g in decode_gaps if g <= t.token_s)
+            ok_gaps = tok_gap_within == len(decode_gaps)
+        within = bool(ok_ttft and ok_gaps)
+        ntok = (1 if has_first else 0) + len(decode_gaps)
+        ntok_within = (1 if (has_first and ok_ttft) else 0) + tok_gap_within
+        with self._lock:
+            self._req_total += 1
+            self._req_within += within
+            self._tok_total += ntok
+            self._tok_within += ntok_within
+            self._window.append(within)
+            burn = self._burn_locked()
+        # registry mirrors (gated like every instrument; per-request
+        # identity stays OUT of the labels — the trace id travels as a
+        # histogram exemplar from the engine's latency observations)
+        counter("slo_requests_total",
+                "finished requests judged against the SLO").inc()
+        counter("slo_requests_within_slo_total",
+                "finished requests that met every armed target").inc(
+                    1.0 if within else 0.0)
+        counter("slo_tokens_total",
+                "tokens judged against the SLO").inc(ntok)
+        counter("slo_tokens_within_slo_total",
+                "tokens within their latency target").inc(ntok_within)
+        gauge("slo_burn_rate",
+              "rolling-window error-budget burn rate (1.0 = budget "
+              "drains at exactly the sustainable rate)").set(burn)
+        return within
+
+    def _burn_locked(self) -> float:
+        if not self._window:
+            return 0.0
+        budget = max(1.0 - self._targets.objective, 1e-9)
+        viol = 1.0 - (sum(self._window) / len(self._window))
+        return viol / budget
+
+    def burn_rate(self) -> float:
+        with self._lock:
+            return self._burn_locked()
+
+    def goodput(self) -> Dict:
+        with self._lock:
+            return {
+                "requests_total": self._req_total,
+                "requests_within_slo": self._req_within,
+                "request_goodput": (self._req_within / self._req_total
+                                    if self._req_total else 1.0),
+                "tokens_total": self._tok_total,
+                "tokens_within_slo": self._tok_within,
+                "token_goodput": (self._tok_within / self._tok_total
+                                  if self._tok_total else 1.0),
+            }
+
+    def report(self) -> Dict:
+        """The ``slo`` section serving_bench / slo_report emit."""
+        g = self.goodput()
+        with self._lock:
+            window_n = len(self._window)
+            burn = self._burn_locked()
+        return {"targets": self._targets.to_dict(), "goodput": g,
+                "burn_rate": round(burn, 6), "window_requests": window_n}
+
+    def admission_hint(self) -> Dict:
+        """THE read hook for SLO-aware admission (ROADMAP direction 1's
+        next rung): live burn rate + goodput + declared targets.
+        Admission behavior itself stays FIFO this PR — nothing in the
+        engine reads this."""
+        g = self.goodput()
+        return {"burn_rate": self.burn_rate(),
+                "request_goodput": g["request_goodput"],
+                "token_goodput": g["token_goodput"],
+                "targets": self._targets.to_dict()}
+
+
+_SLO: Optional[SLOTracker] = None
+_SLO_LOCK = threading.Lock()
+
+
+def slo_tracker() -> SLOTracker:
+    """The process SLO tracker (lazy singleton; targets resolved from
+    the FLAGS_slo_* defaults until configure() overrides them)."""
+    global _SLO
+    if _SLO is None:
+        with _SLO_LOCK:
+            if _SLO is None:
+                _SLO = SLOTracker()
+    return _SLO
+
+
+def reset_slo():
+    """Re-resolve targets from flags and zero the accounting (tests /
+    fresh measurement windows)."""
+    slo_tracker().configure()

@@ -278,3 +278,42 @@ def test_desynced_json_socket_rebuilt(server, monkeypatch):
     assert c._socks.get(ep) is not s0
     np.testing.assert_allclose(c.pull_dense("w"), np.zeros(4))
     c.close()
+
+
+def test_dedup_replay_carries_original_trace(server):
+    """r17 trace propagation, proven via the lost-reply dedup path: the
+    client injects trace_ctx next to the idempotence key, the retry
+    resends the SAME context, and the server's dedup-acked replay span
+    is tagged with the originating trace id — one connected trace
+    shows apply + replay end-to-end."""
+    from paddle_tpu.utils import tracing
+
+    _flags.set_flags({"trace_requests": 1})
+    tracing.reset()
+    try:
+        c = _json_client(server)
+        c.create_dense("w", 8, optimizer="sgd", lr=1.0)
+        c.init_dense("w", np.zeros(8, np.float32))
+        with tracing.start_request_trace("train_push", "push-0") as tr:
+            _arm("rpc_drop=recv@1")  # sent, applied, reply dropped
+            c.push_dense("w", np.ones(8, np.float32))
+            _flags.set_flags({"chaos": ""})
+            chaos.reset()
+        # applied exactly once despite the retry
+        np.testing.assert_allclose(c.pull_dense("w"), -np.ones(8))
+        spans = tracing.store().get(tr.trace_id).spans
+        client = [s for s in spans if s.name == "ps:push_dense"]
+        srv = [s for s in spans if s.name == "ps_server:push_dense"]
+        assert len(client) == 1            # ONE logical RPC span
+        assert client[0].attrs["attempts"] == 2
+        assert [e[0] for e in client[0].events] == ["chaos:rpc_drop"]
+        assert len(srv) == 2               # original apply + replay ack
+        assert all(s.parent_id == client[0].span_id for s in srv)
+        replays = [s for s in srv if s.attrs.get("dedup_replay")]
+        assert len(replays) == 1
+        assert replays[0].attrs["origin_trace"] == tr.trace_id
+        # the deduper remembers the committing trace per req_id
+        assert tr.trace_id in server.dedup._origin.values()
+        c.close()
+    finally:
+        tracing.reset()

@@ -1,0 +1,456 @@
+"""Request-lifecycle distributed tracing + SLO/goodput accounting (r17).
+
+Oracles:
+* with FLAGS_trace_requests=0 (the default) NOTHING records and the
+  serving token stream / training loss trajectory are bit-identical to
+  the traced run (tracing is observation-only);
+* the span event stream of a seeded engine replay is deterministic:
+  two fresh engines over the same requests produce identical
+  structural streams (names, parentage, logical times, attrs);
+* preempt/resume cycles record correctly against the engine's
+  recompute-on-resume semantics: each preemption opens a `preempted`
+  wait span, each resume closes it with a fresh `prefill`, and span
+  counts reconcile EXACTLY with the scheduler's admit/preempt/finish
+  counters;
+* head-based sampling is deterministic in (FLAGS_trace_seed, req_id);
+* the SLO tracker's goodput equals an independent recomputation from
+  loadgen's per-request latencies (same judging rules, separate data
+  path), and the burn rate follows the declared error budget;
+* a PS-crossing request yields ONE connected trace: client span +
+  server span (parented on it), with chaos injections annotated on the
+  affected RPC span (name + schedule seed);
+* histogram p99 buckets link to a pull-up-able trace id (exemplars);
+* tools/slo_report.py --quick reconciles end to end (subprocess).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.scope import Scope
+from paddle_tpu.inference.serving import (DecoderConfig, Request,
+                                          ServingEngine)
+from paddle_tpu.utils import chaos
+from paddle_tpu.utils import flags as _flags
+from paddle_tpu.utils import telemetry, tracing
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = DecoderConfig(vocab_size=64, hidden=32, num_heads=4, num_layers=2,
+                    max_seq_len=128)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    saved = dict(_flags._flags)
+    telemetry.registry().clear()
+    tracing.reset()
+    chaos.reset()
+    yield
+    tracing.reset()
+    telemetry.registry().clear()
+    _flags._flags.clear()
+    _flags._flags.update(saved)
+    telemetry.reset_slo()
+    chaos.reset()
+
+
+def _arm(**kw):
+    _flags.set_flags({"trace_requests": 1, **kw})
+
+
+def make_engine(**kw):
+    kw.setdefault("num_pages", 32)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("token_budget", 64)
+    kw.setdefault("prefill_bucket_min", 8)
+    return ServingEngine(kw.pop("cfg", CFG), **kw)
+
+
+def _mixed_prompts(seed=7, n=4, vocab=64):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(0, vocab, size=ln)))
+            for ln in (3, 11, 6, 14)[:n]]
+
+
+def _drive(eng, prompts, max_new):
+    """Deterministic logical clock: step k runs at now=k (the r12
+    seeded-replay convention, with non-trivial span times)."""
+    reqs = [Request(i, list(p), max_new) for i, p in enumerate(prompts)]
+    for r in reqs:
+        eng.submit(r)
+    events, t = [], 0.0
+    while eng.has_work():
+        t += 1.0
+        events.extend((e.req_id, e.token, e.finished)
+                      for e in eng.step(t))
+    return events, reqs
+
+
+# ==========================================================================
+# off-path bit-identity
+# ==========================================================================
+def test_tracing_default_off_records_nothing():
+    eng = make_engine()
+    events, reqs = _drive(eng, _mixed_prompts(), 4)
+    assert tracing.store().traces() == []
+    assert all(r.trace is None for r in reqs)
+
+
+def test_trace_flag_off_token_stream_bit_identical():
+    prompts = _mixed_prompts(seed=11)
+    _flags.set_flags({"trace_requests": 0})
+    off, _ = _drive(make_engine(num_pages=6, page_size=4), prompts, 5)
+    _arm()
+    on, _ = _drive(make_engine(num_pages=6, page_size=4), prompts, 5)
+    assert on == off
+    assert len(tracing.store().finished_traces()) == len(prompts)
+
+
+def test_trace_flag_training_bit_identity():
+    """Tracing on vs off: identical loss trajectory and params (the
+    FLAGS_trace_requests=0 pin for training steps)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4])
+        y = fluid.layers.data("y", [1])
+        pred = fluid.layers.fc(fluid.layers.fc(x, 8, act="relu"), 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    base = Scope()
+    exe.run(startup, scope=base)
+    init = {k: np.asarray(v) for k, v in base.items()
+            if not k.startswith("@")}
+    xs = np.linspace(-1, 1, 16).reshape(4, 4).astype(np.float32)
+    ys = xs[:, :1] * 2 + 1
+
+    def run(flag):
+        _flags.set_flags({"trace_requests": flag})
+        scope = Scope()
+        for k, v in init.items():
+            scope.set(k, v.copy())
+        losses = [np.asarray(exe.run(main, feed={"x": xs, "y": ys},
+                                     fetch_list=[loss.name],
+                                     scope=scope)[0])
+                  for _ in range(3)]
+        return losses, {k: np.asarray(scope.get(k)) for k in init}
+
+    on_l, on_p = run(1)
+    off_l, off_p = run(0)
+    for a, b in zip(on_l, off_l):
+        np.testing.assert_array_equal(a, b)
+    for k in init:
+        np.testing.assert_array_equal(on_p[k], off_p[k])
+
+
+# ==========================================================================
+# span-stream determinism + structure
+# ==========================================================================
+def test_span_stream_deterministic_across_replays():
+    prompts = _mixed_prompts(seed=11)
+    _arm()
+    ev_a, _ = _drive(make_engine(num_pages=6, page_size=4), prompts, 5)
+    stream_a = tracing.span_stream()
+    tracing.reset()
+    ev_b, _ = _drive(make_engine(num_pages=6, page_size=4), prompts, 5)
+    stream_b = tracing.span_stream()
+    assert ev_a == ev_b
+    assert stream_a == stream_b
+    assert stream_a and all(spans for _, _, _, spans in stream_a)
+
+
+def test_preemption_resume_span_cycles():
+    """The tiny pool forces preemption (the r12 preemption scenario);
+    the trace must show the recompute-on-resume cycle: every
+    preemption opens a `preempted` wait span, every resume closes it
+    with a FRESH prefill (prompt recomputed), and the final run's
+    decode steps follow."""
+    prompts = _mixed_prompts(seed=9)
+    _arm()
+    eng = make_engine(num_pages=6, page_size=4, max_batch=4)
+    _drive(eng, prompts, 5)
+    assert eng.stats["preempted"] >= 1
+    traces = tracing.store().finished_traces()
+    victim = [t for t in traces if t.spans_named("preempted")]
+    assert victim
+    for tr in victim:
+        cycles = tr.spans_named("preempted")
+        prefills = tr.spans_named("prefill")
+        req_span = tr.spans_named("request")[0]
+        # one resume prefill per cycle, plus the original admission
+        assert len(prefills) == len(cycles) + 1
+        assert req_span.attrs["preemptions"] == len(cycles)
+        # every preempted wait span is CLOSED (resume happened) and the
+        # closing resume's prefill starts where the wait ended
+        for c in cycles:
+            assert c.t1 is not None and c.t1 >= c.t0
+        # span order: the resume prefill comes after its preempted span
+        order = [s.name for s in tr.spans]
+        assert order.index("preempted") < len(order) - 1
+        assert "prefill" in order[order.index("preempted"):]
+
+
+def test_spans_reconcile_with_engine_counters():
+    """Acceptance: every finished request's spans reconcile EXACTLY
+    with the engine's admit/preempt/finish counters (sample rate 1)."""
+    prompts = _mixed_prompts(seed=9)
+    _arm()
+    eng = make_engine(num_pages=6, page_size=4, max_batch=4)
+    _drive(eng, prompts, 5)
+    traces = tracing.store().finished_traces()
+    assert sum(len(t.spans_named("prefill")) for t in traces) \
+        == eng.stats["admitted"]
+    assert sum(len(t.spans_named("preempted")) for t in traces) \
+        == eng.stats["preempted"]
+    finished = [t for t in traces
+                if t.spans_named("request")
+                and t.spans_named("request")[0].attrs.get("status")
+                == "finished"]
+    assert len(finished) == eng.stats["finished"]
+    # token counts on the root match the span record: the final run's
+    # prefill token + one decode_step span per decode token
+    for tr in finished:
+        root = tr.spans_named("request")[0]
+        names = [s.name for s in tr.spans]
+        last_prefill = len(names) - 1 - names[::-1].index("prefill")
+        decode_after = names[last_prefill:].count("decode_step")
+        assert root.attrs["tokens"] == 1 + decode_after
+
+
+def test_rejected_request_gets_reject_trace():
+    _arm()
+    eng = make_engine(token_budget=8)
+    with pytest.raises(ValueError):
+        eng.submit(Request("big", list(range(12)), 2))
+    tr = tracing.store().get(tracing.trace_id_for("big"))
+    assert tr is not None and tr.finished
+    root = tr.spans_named("request")[0]
+    assert root.attrs["status"] == "rejected"
+    assert "token_budget" in root.attrs["reason"]
+
+
+def test_sampling_deterministic_head_based():
+    _arm(trace_sample_rate=0.5, trace_seed=3)
+    decisions = {i: tracing.sampled(i) for i in range(32)}
+    # deterministic: same decision on re-query and across engines
+    assert decisions == {i: tracing.sampled(i) for i in range(32)}
+    assert any(decisions.values()) and not all(decisions.values())
+    eng = make_engine()
+    prompts = _mixed_prompts()
+    reqs = [Request(i, list(p), 3) for i, p in enumerate(prompts)]
+    for r in reqs:
+        eng.submit(r)
+    while eng.has_work():
+        eng.step()
+    for r in reqs:
+        assert (r.trace is not None) == decisions[r.req_id]
+    # SLO accounting counts EVERY finished request, sampled or not
+    assert telemetry.slo_tracker().goodput()["requests_total"] \
+        >= len(reqs)
+
+
+# ==========================================================================
+# SLO tracker
+# ==========================================================================
+def test_slo_tracker_semantics_and_burn_rate():
+    t = telemetry.SLOTracker()
+    t.configure(ttft_s=0.1, token_s=0.05, objective=0.9, window=4)
+    assert t.observe_request(0, 0.05, [0.01, 0.02]) is True
+    assert t.observe_request(1, 0.2, [0.01]) is False        # ttft blown
+    assert t.observe_request(2, 0.05, [0.01, 0.2]) is False  # gap blown
+    assert t.observe_request(3, float("nan"), []) is False   # no token
+    g = t.goodput()
+    assert g["requests_total"] == 4 and g["requests_within_slo"] == 1
+    # tokens: r0 3 ok; r1 ttft-token bad + 1 ok; r2 ttft ok + 1 ok
+    # + 1 bad; r3 none
+    assert g["tokens_total"] == 3 + 2 + 3 + 0
+    assert g["tokens_within_slo"] == 3 + 1 + 2 + 0
+    # burn rate: 3/4 violations over a 0.1 budget
+    assert t.burn_rate() == pytest.approx((3 / 4) / 0.1)
+    hint = t.admission_hint()
+    assert hint["burn_rate"] == pytest.approx((3 / 4) / 0.1)
+    assert hint["targets"]["ttft_s"] == 0.1
+    # window rolls: four within-SLO requests flush the violations
+    for i in range(4):
+        t.observe_request(10 + i, 0.01, [0.01])
+    assert t.burn_rate() == 0.0
+    r = t.report()
+    assert r["window_requests"] == 4 and r["goodput"]["requests_total"] == 8
+
+
+def test_slo_tracker_matches_loadgen_per_request():
+    """Acceptance: burn rate + goodput agree with loadgen's
+    independently computed per-request TTFT/TPOT — both judge the same
+    logical token times, so the counts must be equal."""
+    from paddle_tpu.utils.loadgen import (per_request_latency,
+                                          poisson_trace, replay_trace)
+
+    eng = make_engine(num_pages=64, page_size=4, max_batch=8,
+                      token_budget=128, prefill_bucket_min=4,
+                      cfg=DecoderConfig(vocab_size=32, hidden=16,
+                                        num_heads=2, num_layers=1,
+                                        max_seq_len=64))
+    trace = poisson_trace(8, rate=200.0, vocab_size=32,
+                          prompt_len_range=(2, 6), max_new_range=(2, 4),
+                          seed=1)
+    replay_trace(eng, trace)  # warmup: compile every bucket shape
+    tr = telemetry.slo_tracker().configure(ttft_s=0.02, token_s=0.01,
+                                           objective=0.99, window=64)
+    raw = replay_trace(eng, trace)
+    per = per_request_latency(raw)
+    g = tr.goodput()
+    # independent recomputation with the same rules
+    req_within = tok_total = tok_within = 0
+    for r in per.values():
+        ok_ttft = r["ttft_s"] == r["ttft_s"] and r["ttft_s"] <= 0.02
+        gaps_ok = sum(1 for x in r["decode_gaps"] if x <= 0.01)
+        req_within += ok_ttft and gaps_ok == len(r["decode_gaps"])
+        tok_total += (1 if r["ttft_s"] == r["ttft_s"] else 0) \
+            + len(r["decode_gaps"])
+        tok_within += (1 if ok_ttft else 0) + gaps_ok
+    assert g["requests_total"] == len(per)
+    assert g["requests_within_slo"] == req_within
+    assert g["tokens_total"] == tok_total
+    assert g["tokens_within_slo"] == tok_within
+    viol = 1.0 - req_within / len(per)
+    assert tr.burn_rate() == pytest.approx(viol / 0.01)
+
+
+def test_histogram_exemplar_links_p99_to_trace():
+    _arm()
+    eng = make_engine()
+    _drive(eng, _mixed_prompts(), 4)
+    hist = telemetry.histogram("serving_ttft_s")
+    ex = hist.exemplar_for_quantile(0.99)
+    assert ex is not None
+    assert tracing.store().get(ex) is not None
+    # snapshot carries the bucket -> exemplar map
+    snap = telemetry.snapshot()["serving_ttft_s"]["series"][0]
+    assert any(v == ex for v in snap.get("exemplars", {}).values())
+
+
+# ==========================================================================
+# RPC propagation + chaos annotation
+# ==========================================================================
+def test_ps_crossing_request_single_connected_trace():
+    """Acceptance: one PS-crossing request = ONE connected trace
+    (client span + server span), with an injected chaos fault
+    annotated on the affected RPC span (event name + schedule seed)."""
+    from paddle_tpu.distributed_ps import runtime
+    from paddle_tpu.distributed_ps.service import PSClient, PSServer
+
+    _arm()
+    server = PSServer("127.0.0.1:0", n_trainers=1).start()
+    try:
+        c = PSClient([server.endpoint])
+        c._data_ports[server.endpoint] = None  # JSON control path
+        c.create_dense("w", 8, optimizer="sgd", lr=1.0)
+        c.init_dense("w", np.zeros(8, np.float32))
+        with tracing.start_request_trace("train_step", "step-0") as tr:
+            _flags.set_flags({"chaos": "seed=5;rpc_delay=1:1.0",
+                              "rpc_retry_backoff_ms": 1})
+            chaos.reset()
+            c.push_dense("w", np.ones(8, np.float32))
+            _flags.set_flags({"chaos": ""})
+            chaos.reset()
+        spans = tracing.store().get(tr.trace_id).spans
+        root = [s for s in spans if s.name == "train_step"]
+        client = [s for s in spans if s.name == "ps:push_dense"]
+        srv = [s for s in spans if s.name == "ps_server:push_dense"]
+        assert len(root) == 1 and len(client) == 1 and len(srv) == 1
+        assert client[0].parent_id == root[0].span_id
+        assert srv[0].parent_id == client[0].span_id
+        assert client[0].attrs["attempts"] == 1
+        # the chaos delay annotated the RPC span it stalled, with seed
+        ev = [e for e in client[0].events if e[0] == "chaos:rpc_delay"]
+        assert ev and ev[0][1]["seed"] == 5
+        c.close()
+    finally:
+        server.stop()
+        runtime.clear()
+        from paddle_tpu.distributed_ps.table import reset_all_tables
+
+        reset_all_tables()
+
+
+def test_untraced_rpc_carries_no_context():
+    """Outside a trace (or with the flag off) the wire meta carries no
+    trace_ctx and the server records nothing."""
+    from paddle_tpu.distributed_ps import runtime
+    from paddle_tpu.distributed_ps.service import PSClient, PSServer
+
+    _arm()
+    server = PSServer("127.0.0.1:0", n_trainers=1).start()
+    try:
+        c = PSClient([server.endpoint])
+        c._data_ports[server.endpoint] = None
+        c.create_dense("w", 4, optimizer="sgd", lr=1.0)
+        c.init_dense("w", np.zeros(4, np.float32))
+        c.push_dense("w", np.ones(4, np.float32))  # no active trace
+        assert tracing.store().traces() == []
+        c.close()
+    finally:
+        server.stop()
+        runtime.clear()
+        from paddle_tpu.distributed_ps.table import reset_all_tables
+
+        reset_all_tables()
+
+
+# ==========================================================================
+# per-request chrome-trace lane
+# ==========================================================================
+def test_request_lane_in_chrome_trace_validates(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import trace_report
+
+    from paddle_tpu import profiler
+
+    _arm()
+    path = str(tmp_path / "trace.json")
+    profiler.enable_profiler("All")
+    try:
+        eng = make_engine(num_pages=6, page_size=4)
+        _drive(eng, _mixed_prompts(seed=9), 5)
+    finally:
+        profiler.disable_profiler(profile_path=path, print_summary=False)
+    data = trace_report.load_trace(path)
+    rep = trace_report.report(data)
+    assert "request" in rep["lanes"]
+    val = trace_report.validate_request_lane(data)
+    assert val["present"] and val["traces"] == 4
+    assert trace_report.request_lane_ok(val), val
+    assert val["top_ttft"] and len(val["top_ttft"]) <= 5
+    # spans nest: break one on purpose and the validator must object
+    for e in data["traceEvents"]:
+        if e.get("ph") == "X" and (e.get("args") or {}).get("parent"):
+            e["ts"] = e["ts"] - 10_000_000  # yank outside the parent
+            break
+    bad = trace_report.validate_request_lane(data)
+    assert not trace_report.request_lane_ok(bad)
+
+
+def test_slo_report_quick_subprocess():
+    """tools/slo_report.py --quick is the bounded tier-1 smoke: spans
+    reconcile with the scheduler counters and the tracker agrees with
+    loadgen's independent accounting."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "slo_report.py"),
+         "--quick", "--json"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    assert p.returncode == 0, p.stdout + p.stderr
+    line = [l for l in p.stdout.splitlines() if l.startswith("SLO=")][-1]
+    payload = json.loads(line[len("SLO="):])
+    assert payload["agrees_with_loadgen"] is True
+    assert payload["spans_reconcile"] is True
+    assert payload["slo"]["goodput"]["requests_total"] == 8
+    assert payload["per_request"]

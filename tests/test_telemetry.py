@@ -106,6 +106,56 @@ def test_label_cardinality_bound():
     assert sum(by_label.values()) == telemetry.MAX_SERIES + 40
 
 
+def test_label_denylist_rejects_per_request_keys():
+    """Registry hardening (r17): per-request identifier label keys are
+    rejected at family creation — one series per request is unbounded
+    cardinality by construction, and the overflow series would merely
+    hide it.  Per-request values belong in span attributes."""
+    for bad in ("request_id", "trace_id", "span_id", "req_id"):
+        with pytest.raises(ValueError, match="per-request"):
+            telemetry.counter(f"t_deny_{bad}", labels=(bad,))
+        with pytest.raises(ValueError, match="per-request"):
+            telemetry.histogram(f"t_deny_h_{bad}", labels=("op", bad))
+    # legitimate bounded labels still work
+    telemetry.counter("t_deny_ok", labels=("op",)).labels(op="x").inc()
+
+
+def test_cardinality_bound_under_span_heavy_workload():
+    """Regression: a span-heavy traced serving run must never mint
+    per-request metric series — every family stays inside the 64-series
+    bound (and per-request data shows up ONLY as span attributes and
+    histogram exemplars)."""
+    from paddle_tpu.inference.serving import (DecoderConfig, Request,
+                                              ServingEngine)
+    from paddle_tpu.utils import tracing
+
+    _flags.set_flags({"trace_requests": 1})
+    tracing.reset()
+    try:
+        cfg = DecoderConfig(vocab_size=32, hidden=16, num_heads=2,
+                            num_layers=1, max_seq_len=64)
+        eng = ServingEngine(cfg, num_pages=64, page_size=4, max_batch=8,
+                            token_budget=128, prefill_bucket_min=4)
+        for i in range(80):  # more requests than MAX_SERIES
+            eng.submit(Request(f"r{i}", [1 + i % 30, 2, 3],
+                               max_new_tokens=2))
+        eng.run_to_completion()
+        snap = telemetry.snapshot()
+        for name, fam in snap.items():
+            assert len(fam["series"]) <= telemetry.MAX_SERIES + 1, name
+            for label in fam["labels"]:
+                assert label not in telemetry.LABEL_DENYLIST, name
+        # the overflow mechanics still hold next to the span traffic
+        c = telemetry.counter("t_span_heavy", labels=("uid",))
+        for i in range(telemetry.MAX_SERIES + 10):
+            c.labels(uid=i).inc()
+        series = telemetry.snapshot()["t_span_heavy"]["series"]
+        assert len(series) == telemetry.MAX_SERIES + 1
+        assert len(tracing.store().finished_traces()) == 80
+    finally:
+        tracing.reset()
+
+
 def test_thread_safety_exact_counts():
     c = telemetry.counter("t_mt_total")
     h = telemetry.histogram("t_mt_s")

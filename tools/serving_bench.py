@@ -52,6 +52,13 @@ def build_args():
     ap.add_argument("--warmup", type=int, default=1,
                     help="unmeasured trace replays to populate the jit "
                          "cache before timing")
+    ap.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                    help="TTFT SLO target in ms (0 = unset: every "
+                         "request counts as within)")
+    ap.add_argument("--slo-token-ms", type=float, default=0.0,
+                    help="per-token latency SLO target in ms (0 = unset)")
+    ap.add_argument("--slo-objective", type=float, default=0.99)
+    ap.add_argument("--slo-window", type=int, default=256)
     ap.add_argument("--quick", action="store_true",
                     help="bounded CI mode: tiny model/trace + token-"
                          "identity assertion vs one-at-a-time decoding")
@@ -78,9 +85,10 @@ def measure(eng, trace, warmup):
     """Replay unmeasured ``warmup`` times (populates the executor's jit
     cache for every bucket shape the trace hits — each replay drains
     fully, freeing all pages), then once measured.  Returns
-    ``(latency_report, telemetry_snapshot)`` — the registry is reset
-    with the scheduler counters, so both describe ONLY the measured
-    replay and the registry's numbers are the report's numbers."""
+    ``(latency_report, telemetry_snapshot, slo_report)`` — the registry
+    and the SLO tracker are reset with the scheduler counters, so all
+    three describe ONLY the measured replay and the registry's numbers
+    are the report's numbers."""
     from paddle_tpu.utils import telemetry
     from paddle_tpu.utils.loadgen import latency_report, replay_trace
 
@@ -90,8 +98,10 @@ def measure(eng, trace, warmup):
     # latencies next to them do) — zero the warmup's contribution
     eng.stats = {k: 0 for k in eng.stats}
     telemetry.registry().reset()
+    telemetry.slo_tracker().reset()
     raw = replay_trace(eng, trace)
-    return latency_report(raw), telemetry.snapshot()
+    return (latency_report(raw), telemetry.snapshot(),
+            telemetry.slo_tracker().report())
 
 
 def main(argv=None):
@@ -115,12 +125,22 @@ def main(argv=None):
         prompt_len_range=(args.prompt_min, args.prompt_max),
         max_new_range=(args.new_min, args.new_max), seed=args.seed)
 
+    # declared SLO targets: the slo section (burn rate + goodput) is
+    # sourced from the SAME per-request accounting slo_report uses
+    from paddle_tpu.utils import telemetry
+
+    telemetry.slo_tracker().configure(
+        ttft_s=(args.slo_ttft_ms / 1e3) or None,
+        token_s=(args.slo_token_ms / 1e3) or None,
+        objective=args.slo_objective, window=args.slo_window)
+
     with tempfile.TemporaryDirectory() as td:
         model_dir = os.path.join(td, "decoder")
         export_decoder(model_dir, cfg, seed=args.seed)
         cont_eng, static_eng = make_engines(model_dir, args)
-        cont_rep, cont_tm = measure(cont_eng, trace, args.warmup)
-        stat_rep, stat_tm = measure(static_eng, trace, args.warmup)
+        cont_rep, cont_tm, cont_slo = measure(cont_eng, trace, args.warmup)
+        stat_rep, stat_tm, stat_slo = measure(static_eng, trace,
+                                              args.warmup)
 
         identical = None
         if args.quick:
@@ -166,6 +186,10 @@ def main(argv=None):
             # latency histograms, scheduler counters, KV gauges —
             # carried on the BENCH artifact for free
             "telemetry": {"continuous": cont_tm, "static": stat_tm},
+            # SLO accounting (r17): burn rate + goodput per scheduler
+            # from the same per-request accounting tools/slo_report.py
+            # reports (targets via --slo-ttft-ms / --slo-token-ms)
+            "slo": {"continuous": cont_slo, "static": stat_slo},
         }
         if identical is not None:
             payload["token_identical_vs_one_at_a_time"] = identical

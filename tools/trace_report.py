@@ -153,6 +153,86 @@ def report(trace: dict, top: int = 10) -> dict:
     }
 
 
+def validate_request_lane(trace: dict, top: int = 5) -> dict:
+    """Structural validation of the per-request tracing lane (r17):
+    spans must NEST inside their parents, every non-root parent id
+    must exist in the same trace (no orphans), and every span event
+    must carry its trace/span args.  Also summarizes the top-N slowest
+    requests by TTFT (root-span ``ttft_s`` attr).  Used by ``--quick``
+    and by the default report whenever the lane is present (exit 2 on
+    malformed)."""
+    events = trace["traceEvents"]
+    lane_pid = None
+    for e in events:
+        if (e.get("ph") == "M" and e.get("name") == "process_name"
+                and (e.get("args") or {}).get("name") == "lane:request"):
+            lane_pid = e["pid"]
+    spans = ([e for e in events
+              if e.get("ph") == "X" and e.get("pid") == lane_pid]
+             if lane_pid is not None else [])
+    by_trace: dict = {}
+    malformed = []
+    for e in spans:
+        a = e.get("args") or {}
+        tid_, sid = a.get("trace"), a.get("span")
+        if not tid_ or not sid:
+            malformed.append(
+                f"span event {e.get('name')!r} missing trace/span args")
+            continue
+        by_trace.setdefault(tid_, {})[sid] = e
+    orphans, nest_bad, open_parents, tops = [], [], [], []
+    EPS = 5.0  # µs: clock-read ordering slack
+    for tid_, ss in by_trace.items():
+        # spans are emitted at span END: a still-open parent (an
+        # in-flight request when the profiler stopped) is legitimately
+        # absent.  Once the trace's ROOT is present the request
+        # finished and every referenced parent must have been emitted
+        # — a missing one is then a real orphan.
+        has_root = any(not (e.get("args") or {}).get("parent")
+                       for e in ss.values())
+        for sid, e in ss.items():
+            parent = (e.get("args") or {}).get("parent") or ""
+            if parent:
+                pe = ss.get(parent)
+                if pe is None:
+                    (orphans if has_root else open_parents).append(
+                        f"{tid_}:{sid} parent {parent} "
+                        + ("missing" if has_root else "still open"))
+                elif (e["ts"] < pe["ts"] - EPS
+                      or e["ts"] + e.get("dur", 0.0)
+                      > pe["ts"] + pe.get("dur", 0.0) + EPS):
+                    nest_bad.append(
+                        f"{tid_}:{sid} [{e['name']}] outside parent "
+                        f"{parent} [{pe['name']}]")
+            if e["name"] == "request":
+                a = e.get("args") or {}
+                tops.append({
+                    "trace": tid_, "req": a.get("req", ""),
+                    "ttft_s": (float(a["ttft_s"])
+                               if "ttft_s" in a else None),
+                    "tokens": a.get("tokens"),
+                    "wall_ms": round(e.get("dur", 0.0) / 1e3, 3),
+                })
+    with_ttft = [t for t in tops if t["ttft_s"] is not None]
+    tops = sorted(with_ttft, key=lambda r: -r["ttft_s"])[:top] \
+        or tops[:top]
+    return {
+        "present": lane_pid is not None,
+        "traces": len(by_trace),
+        "spans": len(spans),
+        "orphan_spans": orphans,
+        "open_parent_spans": open_parents,  # in-flight capture: not an error
+        "nesting_violations": nest_bad,
+        "malformed": malformed,
+        "top_ttft": tops,
+    }
+
+
+def request_lane_ok(val: dict) -> bool:
+    return not (val["orphan_spans"] or val["nesting_violations"]
+                or val["malformed"])
+
+
 def format_table(rep: dict) -> str:
     lines = [f"{'Lane':<10} {'Events':>8} {'Total(ms)':>12}  Top events"]
     for lane, row in rep["lanes"].items():
@@ -176,6 +256,18 @@ def format_table(rep: dict) -> str:
                      f"{row['total_ms']:>12.3f}  {tops}{inst}{ctr}")
     lines.append(f"span: {rep['span_ms']:.3f} ms over "
                  f"{rep['n_events']} events")
+    req = rep.get("requests")
+    if req and req.get("present"):
+        lines.append(
+            f"request lane: {req['traces']} traces / {req['spans']} "
+            f"spans, {len(req['orphan_spans'])} orphans, "
+            f"{len(req['nesting_violations'])} nesting violations")
+        for t in req["top_ttft"]:
+            ttft = ("-" if t["ttft_s"] is None
+                    else f"{t['ttft_s']:.5f}s")
+            lines.append(f"  slowest by TTFT: req {t['req']} "
+                         f"ttft={ttft} tokens={t['tokens']} "
+                         f"wall={t['wall_ms']:.3f}ms [{t['trace']}]")
     return "\n".join(lines)
 
 
@@ -191,7 +283,13 @@ def run_quick(tmpdir: str) -> int:
     from paddle_tpu import profiler
     from paddle_tpu.inference.serving import (DecoderConfig, Request,
                                               ServingEngine)
+    from paddle_tpu.utils import flags as _flags
+    from paddle_tpu.utils import tracing
 
+    # request lane (r17): trace the engine run so the per-request span
+    # tree lands in the merged file and the validator has work to do
+    _flags.set_flags({"trace_requests": 1})
+    tracing.reset()
     path = os.path.join(tmpdir, "quick_trace.json")
     profiler.enable_profiler("All")
     # host lane: one tiny program through the executor
@@ -220,11 +318,14 @@ def run_quick(tmpdir: str) -> int:
     profiler.instant_event("chaos:none", cat="chaos")
     profiler.disable_profiler(profile_path=path, print_summary=False)
 
-    rep = report(load_trace(path))
+    data = load_trace(path)
+    rep = report(data)
+    val = validate_request_lane(data)
+    rep["requests"] = val
     print(format_table(rep))
     print("TRACE=" + json.dumps(rep, sort_keys=True))
     missing = [lane for lane in ("host", "serving", "rpc", "chaos",
-                                 "memory")
+                                 "memory", "request")
                if lane not in rep["lanes"]]
     if missing:
         print(f"FAIL: lanes missing from merged trace: {missing}",
@@ -238,6 +339,16 @@ def run_quick(tmpdir: str) -> int:
     if not any(c.get("peak", 0) > 0 for c in ctr.values()):
         print("FAIL: memory lane carries no modeled live-bytes "
               "counters", file=sys.stderr)
+        return 1
+    if not val["traces"] or not val["top_ttft"]:
+        print("FAIL: request lane carries no complete request traces",
+              file=sys.stderr)
+        return 1
+    if not request_lane_ok(val):
+        print(f"FAIL: request lane malformed: "
+              f"orphans={val['orphan_spans']} "
+              f"nesting={val['nesting_violations']} "
+              f"malformed={val['malformed']}", file=sys.stderr)
         return 1
     return 0
 
@@ -260,13 +371,26 @@ def main(argv=None) -> int:
     if not args.trace:
         ap.error("need a trace file (or --quick)")
     try:
-        rep = report(load_trace(args.trace), args.top)
+        data = load_trace(args.trace)
+        rep = report(data, args.top)
     except TraceInvalid as e:
         print(f"ERROR: {e}", file=sys.stderr)
         return 2
+    # per-request lane validation (r17): a present-but-malformed lane
+    # (orphaned span ids, spans escaping their parents) is a broken
+    # trace — same exit code as a truncated file
+    val = validate_request_lane(data, args.top)
+    if val["present"]:
+        rep["requests"] = val
     if not args.json:
         print(format_table(rep))
     print("TRACE=" + json.dumps(rep, sort_keys=True))
+    if val["present"] and not request_lane_ok(val):
+        print(f"ERROR: request lane malformed: "
+              f"orphans={val['orphan_spans']} "
+              f"nesting={val['nesting_violations']} "
+              f"malformed={val['malformed']}", file=sys.stderr)
+        return 2
     return 0
 
 
