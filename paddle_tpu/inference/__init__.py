@@ -20,6 +20,13 @@ from .export import export_stablehlo, load_ptw, save_ptw
 from . import native_runtime
 from .native_runtime import NativePredictor
 from .kv_cache import KVCacheConfig, PagedKVCache
+from .admission import (
+    AdmissionPolicy,
+    FIFOPolicy,
+    RequestRejected,
+    SLOAwarePolicy,
+    get_policy,
+)
 from .serving import (
     DecoderConfig,
     Request,
@@ -36,4 +43,7 @@ __all__ = [
     # serving runtime (r12)
     "KVCacheConfig", "PagedKVCache", "DecoderConfig", "Request",
     "ServingEngine", "StaticBatchingEngine", "export_decoder",
+    # admission/preemption policy engine (r18)
+    "AdmissionPolicy", "FIFOPolicy", "SLOAwarePolicy", "RequestRejected",
+    "get_policy",
 ]

@@ -9,8 +9,17 @@ Grows AnalysisPredictor's one-shot run() into a serving engine
 * **Continuous (inflight) batching** — new requests are admitted at
   EVERY decode step up to a token budget, finished sequences are
   evicted (pages freed) immediately, and pool exhaustion mid-decode
-  preempts the youngest sequence back to the waiting queue
-  (recompute-on-resume, deterministically).
+  preempts a sequence back to the waiting queue (recompute-on-resume,
+  deterministically).
+* **Pluggable admission/preemption policy** (inference/admission.py,
+  ``FLAGS_admission_policy``) — ``fifo`` (default) keeps FIFO admission
+  + youngest-first preemption byte-identical to the pre-policy engine;
+  ``slo_aware`` orders admission by remaining SLO slack, sheds queued
+  requests whose predicted TTFT can no longer meet the declared target
+  (explicit ``shed`` outcome, traced + countered), and preempts the
+  least-lost-work victim.  ``utils/chaos.py`` serving faults
+  (decode_delay / req_burst / pool_spike) hook into the step loop for
+  the overload oracle (tools/overload_bench.py).
 * **Ragged paged attention** — the decode program's ``paged_attention``
   op gathers each query's K/V through its block table at its true
   length (Pallas kernel on TPU, identical-semantics gather on CPU), so
@@ -50,14 +59,16 @@ from ..framework.place import CPUPlace, TPUPlace
 from ..framework.scope import Scope, scope_guard
 from ..executor import Executor
 from ..profiler import RecordEvent, instant_event, is_profiler_enabled
+from ..utils import chaos
 from ..utils import telemetry as tm
 from ..utils import tracing
+from .admission import RequestRejected, get_policy
 from .kv_cache import KVCacheConfig, PagedKVCache
 
 __all__ = [
     "DecoderConfig", "Request", "StepEvent", "ServingEngine",
     "StaticBatchingEngine", "export_decoder", "load_decoder_config",
-    "build_decoder_program", "init_decoder_weights",
+    "build_decoder_program", "init_decoder_weights", "RequestRejected",
 ]
 
 NEG_INF = -1e9  # additive causal-mask value (finite: padded rows stay NaN-free)
@@ -362,7 +373,14 @@ class Request:
     out_tokens: List[int] = field(default_factory=list)
     admitted_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # set when the admission policy shed this queued request (its SLO
+    # was no longer reachable) — a third terminal outcome, distinct
+    # from finish and from the unservable submit rejection
+    shed_at: Optional[float] = None
     preemptions: int = 0
+    # engine-assigned submit sequence number: the deterministic
+    # tie-breaker slo_aware ordering sorts on (req_ids may be any type)
+    _seq: int = field(default=0, repr=False)
     # telemetry: previous emit time of the CURRENT run (reset with
     # out_tokens on preemption, matching loadgen's final-run accounting)
     _tm_last: Optional[float] = field(default=None, repr=False)
@@ -435,9 +453,11 @@ def _trace_submit(req: Request):
     tr._wait = tr.start("queue_wait", t=req.arrival_time, parent=tr._root)
 
 
-def _trace_reject(req: Request, reason: str):
+def _trace_reject(req: Request, reason: str, reason_code: str = "unservable"):
     """A request rejected at submit still gets a (one-span) trace: the
-    finish/reject leg of the span taxonomy."""
+    finish/reject leg of the span taxonomy.  ``reason_code`` is the
+    machine-readable reject reason (pool / budget / max_seq_len) —
+    the span-side mirror of ``serving_rejects_total{reason=}``."""
     if not tracing.enabled() or not tracing.sampled(req.req_id):
         return
     tr = tracing.new_trace(req.req_id)
@@ -445,7 +465,27 @@ def _trace_reject(req: Request, reason: str):
                     attrs={"req": str(req.req_id),
                            "prompt_tokens": len(req.prompt)})
     tr.end(root, t=req.arrival_time,
-           attrs={"status": "rejected", "reason": reason})
+           attrs={"status": "rejected", "reason": reason,
+                  "reject_reason": reason_code})
+    tr.finish()
+
+
+def _trace_shed(req: Request, now: float):
+    """A shed request closes its open wait span (queue_wait, or the
+    preempted span of an evicted run) and its root with
+    ``status="shed"`` — the third terminal leg of the span taxonomy,
+    distinct from finish and reject.  The SLO tracker is deliberately
+    NOT fed: a shed request is excluded from the goodput denominators
+    (the policy refused the work; nothing was served late)."""
+    tr = req.trace
+    if tr is None:
+        return
+    tr.end(tr._wait, t=now)
+    tr._wait = None
+    tr.end(tr._root, t=now, attrs={
+        "status": "shed", "reject_reason": "shed",
+        "waited_s": round(now - req.arrival_time, 9),
+        "preemptions": req.preemptions})
     tr.finish()
 
 
@@ -544,18 +584,34 @@ def _reject_unservable(req: Request, cfg: DecoderConfig,
                        kv_config: KVCacheConfig):
     """Shared submit-time gate: a request that cannot complete even
     with the whole pool to itself would hang any scheduler (prefill
-    backpressure forever, or a preempt loop)."""
+    backpressure forever, or a preempt loop).  Raises
+    :class:`RequestRejected` (a ValueError) carrying the reason code
+    for the labeled reject counter."""
     total = len(req.prompt) + req.max_new_tokens
     if total > cfg.max_seq_len:
-        raise ValueError(
+        raise RequestRejected(
             f"request {req.req_id!r}: prompt+max_new_tokens "
             f"{len(req.prompt)}+{req.max_new_tokens} exceeds "
-            f"max_seq_len {cfg.max_seq_len}")
+            f"max_seq_len {cfg.max_seq_len}", "max_seq_len")
     if _worst_case_pages(req, kv_config) > kv_config.num_pages:
-        raise ValueError(
+        raise RequestRejected(
             f"request {req.req_id!r} needs more KV pages than the "
             f"whole pool holds ({total} tokens, "
-            f"{kv_config.num_pages} pages of {kv_config.page_size})")
+            f"{kv_config.num_pages} pages of {kv_config.page_size})",
+            "pool")
+
+
+def _count_reject(e: ValueError):
+    """One rejection -> the legacy aggregate counter (back-compat) plus
+    the labeled by-reason family (r18 satellite: today all rejections
+    look alike in telemetry)."""
+    tm.counter("serving_rejected_total",
+               "requests rejected at submit (unservable)").inc()
+    tm.counter("serving_rejects_total",
+               "requests refused, by reason (pool / budget / "
+               "max_seq_len at submit; shed by the admission policy)",
+               labels=("reason",)).labels(
+                   reason=getattr(e, "reason", "unservable")).inc()
 
 
 class _EngineCore:
@@ -763,17 +819,21 @@ class _EngineCore:
 class ServingEngine:
     """Continuous (inflight) batching over one _EngineCore.
 
-    Scheduling is deterministic for a fixed request sequence: FIFO
-    admission in submit order (head-of-line blocking, no reordering),
-    immediate eviction on finish, youngest-first preemption on pool
-    exhaustion — so a seeded trace replays bit-identically (pinned by
-    test)."""
+    Scheduling is deterministic for a fixed request sequence: the
+    admission policy (inference/admission.py, ``FLAGS_admission_policy``
+    or the ``admission_policy`` kwarg) decides admission order, load
+    shedding and the preemption victim as pure functions of the queue +
+    SLO-tracker state; the default ``fifo`` policy keeps FIFO admission
+    in submit order (head-of-line blocking, no reordering, no
+    shedding), immediate eviction on finish, and youngest-first
+    preemption on pool exhaustion — so a seeded trace replays
+    bit-identically (pinned by test)."""
 
     def __init__(self, cfg: Optional[DecoderConfig] = None,
                  weights: Optional[Dict[str, np.ndarray]] = None,
                  model_dir: Optional[str] = None,
                  max_batch: int = 8, token_budget: int = 256,
-                 seed: int = 0, **core_kw):
+                 seed: int = 0, admission_policy=None, **core_kw):
         if model_dir is not None:
             self.core = _EngineCore.from_model_dir(model_dir, **core_kw)
         else:
@@ -785,11 +845,14 @@ class ServingEngine:
         self.kv = self.core.kv
         self.max_batch = max_batch
         self.token_budget = token_budget
+        self.policy = get_policy(admission_policy)
         self.waiting: List[Request] = []
         self.running: List[_SeqState] = []   # admission order
         self.stats = {"admitted": 0, "finished": 0, "preempted": 0,
-                      "decode_steps": 0, "prefill_tokens": 0,
+                      "shed": 0, "decode_steps": 0, "prefill_tokens": 0,
                       "decode_tokens": 0}
+        self._step_no = 0
+        self._submit_seq = 0
 
     # -- API ---------------------------------------------------------------
     def submit(self, req: Request):
@@ -799,15 +862,16 @@ class ServingEngine:
                 # admission requires prompt+1 tokens inside the budget;
                 # a larger prompt would head-of-line block the FIFO
                 # forever
-                raise ValueError(
+                raise RequestRejected(
                     f"request {req.req_id!r}: prompt of "
                     f"{len(req.prompt)} tokens can never fit "
-                    f"token_budget {self.token_budget}")
+                    f"token_budget {self.token_budget}", "budget")
         except ValueError as e:
-            tm.counter("serving_rejected_total",
-                       "requests rejected at submit (unservable)").inc()
-            _trace_reject(req, str(e))
+            _count_reject(e)
+            _trace_reject(req, str(e), getattr(e, "reason", "unservable"))
             raise
+        req._seq = self._submit_seq
+        self._submit_seq += 1
         _trace_submit(req)
         self.waiting.append(req)
 
@@ -815,12 +879,23 @@ class ServingEngine:
         return bool(self.waiting or self.running)
 
     def step(self, now: float = 0.0) -> List[StepEvent]:
-        """One serving iteration: admit (up to the token budget and
-        pool capacity), prefill the admissions, decode every running
+        """One serving iteration: shed what the policy gives up on,
+        admit (in policy order, up to the token budget and pool
+        capacity), prefill the admissions, decode every running
         sequence once, evict finishes.  Returns this step's emitted
         tokens."""
         events: List[StepEvent] = []
-        # --- admission: every decode step takes new work ----------------
+        self._step_no += 1
+        # chaos serving faults (pool_spike / req_burst bookkeeping) —
+        # a single cached None check when FLAGS_chaos is unset
+        chaos.on_serving_step(self, self._step_no)
+        # --- shedding: the policy gives up queued requests whose SLO
+        # is no longer reachable BEFORE paying admission for them ------
+        for req in self.policy.shed(self, now):
+            self._shed(req, now)
+        # --- admission: every decode step takes new work, in policy
+        # order (fifo: submit order — order() is a no-op) --------------
+        self.policy.order(self, now)
         budget = self.token_budget - len(self.running)
         while self.waiting and len(self.running) < self.max_batch:
             req = self.waiting[0]
@@ -860,7 +935,8 @@ class ServingEngine:
                 self.running.append(st)
         # --- preemption: decoding adds one token per running seq --------
         while self.running and not self._can_grow_all():
-            victim = self.running.pop()  # youngest
+            # fifo: index -1 (youngest); slo_aware: least lost work
+            victim = self.running.pop(self.policy.victim_index(self.running))
             self.kv.free_sequence(victim.req.req_id)
             victim.req.out_tokens = []
             victim.req._tm_last = None
@@ -877,6 +953,7 @@ class ServingEngine:
                               args={"req": str(victim.req.req_id)})
         # --- decode ------------------------------------------------------
         if self.running:
+            chaos.on_decode_step()
             wall0 = time.perf_counter()
             toks = self.core.decode_batch(self.running)
             self.stats["decode_steps"] += 1
@@ -926,6 +1003,33 @@ class ServingEngine:
             growth += -(-(L + 1) // ps) - -(-L // ps)
         return prompt_pages + growth <= self.kv.num_free_pages
 
+    def _shed(self, req: Request, now: float):
+        """Terminal `shed` outcome for a queued request: the policy
+        decided its SLO is no longer reachable, so refusing it NOW
+        keeps the admitted requests' SLO intact.  Traced (root status
+        "shed") + countered (serving_shed_total and
+        serving_rejects_total{reason="shed"}) — never fed to the SLO
+        tracker, so goodput denominators exclude it consistently with
+        tools/slo_report.py's independent recomputation."""
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            return
+        req.shed_at = now
+        self.stats["shed"] += 1
+        tm.counter("serving_shed_total",
+                   "queued requests shed by the admission policy "
+                   "(predicted TTFT can no longer meet the SLO)").inc()
+        tm.counter("serving_rejects_total",
+                   "requests refused, by reason (pool / budget / "
+                   "max_seq_len at submit; shed by the admission policy)",
+                   labels=("reason",)).labels(reason="shed").inc()
+        _trace_shed(req, now)
+        if is_profiler_enabled():
+            instant_event("shed", cat="serving",
+                          args={"req": str(req.req_id),
+                                "waited": round(now - req.arrival_time, 6)})
+
     def _finish(self, st: _SeqState, tok: int, now: float) -> StepEvent:
         self.kv.free_sequence(st.req.req_id)
         st.req.finished_at = now
@@ -939,10 +1043,10 @@ class ServingEngine:
         return StepEvent(st.req.req_id, tok, True, now)
 
     def slo_hint(self) -> dict:
-        """Read hook for the (next-PR) SLO-aware admission rung: live
-        burn rate, goodput and declared targets from the process SLO
-        tracker.  This PR's admission stays FIFO and never reads it —
-        the hook only exposes the signal."""
+        """Live burn rate, goodput and declared targets from the
+        process SLO tracker — the signal the ``slo_aware`` admission
+        policy (inference/admission.py) drives its slack ordering and
+        shed threshold from.  The ``fifo`` policy never reads it."""
         return tm.slo_tracker().admission_hint()
 
     def run_to_completion(self, now: float = 0.0) -> List[StepEvent]:
@@ -987,9 +1091,8 @@ class StaticBatchingEngine:
         try:
             _reject_unservable(req, self.core.cfg, self.core.kv_config)
         except ValueError as e:
-            tm.counter("serving_rejected_total",
-                       "requests rejected at submit (unservable)").inc()
-            _trace_reject(req, str(e))
+            _count_reject(e)
+            _trace_reject(req, str(e), getattr(e, "reason", "unservable"))
             raise
         _trace_submit(req)
         self.waiting.append(req)

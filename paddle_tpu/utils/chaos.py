@@ -25,10 +25,26 @@ Schedule grammar — ``;``-separated events, all optional::
                            truncate one of its data files in half
                            (seeded choice) — load must reject it
 
+Serving faults (r18 — hooked into the ServingEngine step loop and the
+overload loadgen, tools/overload_bench.py):
+
+    decode_delay=MS@N      sleep MS ms before the Nth batched decode
+                           step (1-based, counted per process run)
+    decode_delay=MS:P      sleep MS ms before each decode step with
+                           probability P (seeded)
+    req_burst=N@K          at serving step K, queue N extra synthetic
+                           requests for the loadgen to inject
+                           (``take_burst`` — the engine cannot fabricate
+                           requests itself)
+    pool_spike=P@K:D       at serving step K, seize up to P KV-pool
+                           pages for D steps (default 4) — admission
+                           backpressure + preemption pressure on demand
+
 Example: ``FLAGS_chaos="seed=7;kill@12;rpc_drop=recv@3"``.
 
 Hooks are called from the PS client (``on_rpc``), the checkpoint writer
-(``on_checkpoint_saved``) and the training loop (``on_step``).  With
+(``on_checkpoint_saved``), the training loop (``on_step``) and the
+serving engine (``on_serving_step`` / ``on_decode_step``).  With
 ``FLAGS_chaos`` unset every hook is a no-op behind one cached ``None``
 check, so production paths pay nothing.
 """
@@ -39,6 +55,7 @@ import random
 import re
 import threading
 import time
+import weakref
 from typing import Optional
 
 from . import flags
@@ -55,6 +72,11 @@ class ChaosRPCDrop(ConnectionError):
 
 _EVENT_RE = re.compile(r"^(?P<key>[a-z_]+)(?:[=@](?P<val>.*))?$")
 
+#: fault kinds that only mean anything inside a serving engine step
+#: loop — training tools (tools/chaos_train.py) must REJECT them with a
+#: clear parse error instead of silently arming a no-op schedule
+SERVING_FAULT_KEYS = frozenset({"decode_delay", "req_burst", "pool_spike"})
+
 
 class FaultSchedule:
     """Parsed FLAGS_chaos schedule.  All state (RPC counter, checkpoint
@@ -70,8 +92,17 @@ class FaultSchedule:
         self.delay_ms = 0.0
         self.delay_p = 0.0
         self.trunc_ckpts: set = set()      # 1-based save indices to truncate
+        # serving faults (r18)
+        self.decode_delay_ms = 0.0
+        self.decode_delay_p = 0.0
+        self.decode_delay_at = {}          # 1-based decode step -> ms
+        self.burst_at = {}                 # serving step -> extra requests
+        self.spike_at = {}                 # serving step -> (pages, steps)
         self._rpc_n = 0
         self._ckpt_n = 0
+        self._decode_n = 0
+        self._burst_pending = 0
+        self._spike_live = []              # [(release_step, kv weakref, sid)]
         self._lock = threading.Lock()
         self._parse(spec)
         self._rng = random.Random(self.seed)
@@ -109,8 +140,47 @@ class FaultSchedule:
                 self.delay_p = float(p or 1.0)
             elif key == "trunc_ckpt":
                 self.trunc_ckpts.add(int(val))
+            elif key == "decode_delay":
+                try:
+                    if "@" in val:
+                        ms, _, n = val.partition("@")
+                        self.decode_delay_at[int(n)] = \
+                            self._ms(ms, item)
+                    else:
+                        ms, _, p = val.partition(":")
+                        self.decode_delay_ms = self._ms(ms, item)
+                        self.decode_delay_p = float(p or 1.0)
+                except ValueError as e:
+                    raise ValueError(
+                        f"FLAGS_chaos: decode_delay needs MS@N or "
+                        f"MS[:P], got {item!r}") from e
+            elif key == "req_burst":
+                n, _, at = val.partition("@")
+                if not at:
+                    raise ValueError(
+                        f"FLAGS_chaos: req_burst needs N@STEP, got {item!r}")
+                self.burst_at[int(at)] = self.burst_at.get(int(at), 0) \
+                    + int(n)
+            elif key == "pool_spike":
+                pages, _, at = val.partition("@")
+                if not at:
+                    raise ValueError(
+                        f"FLAGS_chaos: pool_spike needs PAGES@STEP[:STEPS], "
+                        f"got {item!r}")
+                step, _, dur = at.partition(":")
+                self.spike_at[int(step)] = (int(pages), int(dur or 4))
             else:
                 raise ValueError(f"FLAGS_chaos: unknown event {item!r}")
+
+    @staticmethod
+    def _ms(ms: str, item: str) -> float:
+        """Strict milliseconds value: an empty or non-numeric MS must
+        be a parse error, never a silently-armed 0 ms no-op (the same
+        never-silently-ignored contract chaos_train enforces)."""
+        ms = ms.strip().rstrip("ms").strip()
+        if not ms:
+            raise ValueError(f"FLAGS_chaos: missing MS value in {item!r}")
+        return float(ms)
 
     @staticmethod
     def _phase_ok(phase: str):
@@ -142,12 +212,97 @@ class FaultSchedule:
                     or (phase in self.drop_p
                         and self._rng.random() < self.drop_p[phase]))
         if delay:
-            self._mark("delay", phase, n, op)
+            self._mark("rpc_delay", phase, n, op)
             time.sleep(self.delay_ms / 1e3)
         if drop:
-            self._mark("drop", phase, n, op)
+            self._mark("rpc_drop", phase, n, op)
             raise ChaosRPCDrop(
                 f"chaos: dropped rpc #{n} ({op or '?'}) at {phase}")
+
+    def serving_faults(self) -> set:
+        """Armed serving-only fault kinds (SERVING_FAULT_KEYS subset) —
+        training tools reject schedules where this is non-empty."""
+        out = set()
+        if self.decode_delay_at or self.decode_delay_ms > 0:
+            out.add("decode_delay")
+        if self.burst_at:
+            out.add("req_burst")
+        if self.spike_at:
+            out.add("pool_spike")
+        return out
+
+    def on_decode_step(self):
+        """Serving-engine hook, called once per batched decode step:
+        sleep before the Nth (or each, with probability P) decode."""
+        with self._lock:
+            self._decode_n += 1
+            n = self._decode_n
+            ms = self.decode_delay_at.get(n, 0.0)
+            if (not ms and self.decode_delay_ms > 0
+                    and self._rng.random() < self.decode_delay_p):
+                ms = self.decode_delay_ms
+        if ms:
+            self._mark("decode_delay", "decode", n, f"{ms}ms")
+            time.sleep(ms / 1e3)
+
+    def on_serving_step(self, engine, step: int):
+        """Engine-step hook (``step`` is the engine's own 1-based step
+        counter): apply/release pool-pressure spikes and queue request
+        bursts for the loadgen (``take_burst``).  Deterministic: both
+        are keyed on the step index, never on wall time."""
+        burst = self.burst_at.get(step, 0)
+        if burst:
+            with self._lock:
+                self._burst_pending += burst
+            self._mark("req_burst", "serving", step, f"{burst}req")
+        kv = getattr(engine, "kv", None)
+        if kv is None:
+            return
+        with self._lock:
+            # release entries for THIS engine's pool only (two engines
+            # may share one process-wide schedule with independent step
+            # counters); dead engines' entries are pruned, never freed
+            # against the wrong pool
+            release, keep = [], []
+            for rel, kvref, sid in self._spike_live:
+                target = kvref()
+                if target is None:
+                    continue                      # engine gone, prune
+                if target is kv and rel <= step:
+                    release.append(sid)
+                else:
+                    keep.append((rel, kvref, sid))
+            self._spike_live = keep
+            spike = self.spike_at.get(step)
+        for sid in release:
+            kv.free_sequence(sid)
+        if spike:
+            pages, dur = spike
+            sid = f"__chaos_spike_{step}__"
+            got = 0
+            for _ in range(pages):
+                # one full page per append; stop at pool exhaustion —
+                # a spike SQUEEZES the pool, it never deadlocks it
+                if kv.append_tokens(sid, self.page_size_of(engine)) is None:
+                    break
+                got += 1
+            if got:
+                with self._lock:
+                    self._spike_live.append(
+                        (step + dur, weakref.ref(kv), sid))
+                self._mark("pool_spike", "serving", step, f"{got}pg")
+
+    @staticmethod
+    def page_size_of(engine) -> int:
+        core = getattr(engine, "core", None)
+        return core.kv_config.page_size if core is not None else 1
+
+    def take_burst(self) -> int:
+        """Pop the pending burst count (loadgen side of req_burst)."""
+        with self._lock:
+            n = self._burst_pending
+            self._burst_pending = 0
+            return n
 
     def _mark(self, kind: str, phase: str, n: int, op: str):
         """Injected fault -> telemetry counter + chaos timeline lane
@@ -156,7 +311,6 @@ class FaultSchedule:
         a chaos run shows WHY a span stalled — the event carries the
         chaos kind and the schedule seed, correlating the aggregate
         ``chaos_injections_total`` count to the affected request."""
-        kind = kind if kind == "kill" else f"rpc_{kind}"
         from . import telemetry as tm
 
         tm.counter("chaos_injections_total",
@@ -245,3 +399,20 @@ def on_checkpoint_saved(dirname: str):
     s = schedule()
     if s is not None:
         return s.on_checkpoint_saved(dirname)
+
+
+def on_serving_step(engine, step: int):
+    s = schedule()
+    if s is not None:
+        s.on_serving_step(engine, step)
+
+
+def on_decode_step():
+    s = schedule()
+    if s is not None:
+        s.on_decode_step()
+
+
+def take_burst() -> int:
+    s = schedule()
+    return s.take_burst() if s is not None else 0

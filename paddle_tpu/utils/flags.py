@@ -184,6 +184,21 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_slo_token_ms": 0.0,
     "FLAGS_slo_objective": 0.99,
     "FLAGS_slo_window": 256,
+    # serving admission/preemption policy (inference/admission.py):
+    # "fifo" (default) keeps FIFO admission order, youngest-first
+    # preemption and no shedding — byte-identical to the pre-policy
+    # engine (token streams, event streams and telemetry counters
+    # pinned by test).  "slo_aware" orders admission by remaining SLO
+    # slack (declared TTFT target scaled down by the live burn rate
+    # from slo_hint(), minus time queued), SHEDS queued requests whose
+    # predicted TTFT can no longer meet the target (explicit `shed`
+    # outcome: traced root status="shed" +
+    # serving_rejects_total{reason="shed"} — distinct from the
+    # unservable submit rejection), and preempts the victim with the
+    # LEAST lost work (prompt + decoded tokens recomputed on resume)
+    # instead of the youngest.  Deterministic for a seeded trace on a
+    # deterministic clock (tools/overload_bench.py is the A/B oracle).
+    "FLAGS_admission_policy": "fifo",
     # modeled-HBM budget gate (framework/memory_plan.py): when > 0, the
     # executor / DP compile paths check the static liveness planner's
     # modeled peak against this many MB and WARN naming the peak op and

@@ -122,11 +122,19 @@ def latency_report(raw: Dict) -> Dict:
             if j == 0:
                 ttft.append(t - req.arrival_time)
             prev = t
-    unfinished = sum(1 for r in reqs.values() if r.finished_at is None)
+    # a shed request (admission.py slo_aware policy) is a TERMINAL
+    # outcome, not a hang: it leaves "unfinished" and is counted on its
+    # own line (fifo traces: shed == 0, unfinished unchanged)
+    shed = sum(1 for r in reqs.values()
+               if getattr(r, "shed_at", None) is not None)
+    unfinished = sum(1 for r in reqs.values()
+                     if r.finished_at is None
+                     and getattr(r, "shed_at", None) is None)
     util = raw["pool_utilization"]
     return {
         "num_requests": len(reqs),
         "unfinished": unfinished,
+        "shed": shed,
         "total_tokens": total_tokens,
         "elapsed_s": round(raw["elapsed_s"], 4),
         "tokens_per_s": round(total_tokens / max(raw["elapsed_s"], 1e-9), 2),
@@ -158,6 +166,7 @@ def per_request_latency(raw: Dict) -> Dict:
             "decode_gaps": gaps[1:],
             "tokens": len(times),
             "finished": req.finished_at is not None,
+            "shed": getattr(req, "shed_at", None) is not None,
             "preemptions": req.preemptions,
         }
     return out

@@ -588,9 +588,9 @@ class SLOTracker:
       drains exactly at the sustainable rate, >1 means it drains
       faster.
 
-    ``admission_hint()`` is the read hook the next (SLO-aware
-    admission) serving rung consumes; this PR's admission stays FIFO
-    and never reads it."""
+    ``admission_hint()`` is the read hook the SLO-aware admission
+    policy (inference/admission.py, r18) drives its slack ordering and
+    shed threshold from; the default ``fifo`` policy never reads it."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -713,10 +713,11 @@ class SLOTracker:
                 "burn_rate": round(burn, 6), "window_requests": window_n}
 
     def admission_hint(self) -> Dict:
-        """THE read hook for SLO-aware admission (ROADMAP direction 1's
-        next rung): live burn rate + goodput + declared targets.
-        Admission behavior itself stays FIFO this PR — nothing in the
-        engine reads this."""
+        """THE read hook for SLO-aware admission: live burn rate +
+        goodput + declared targets.  Consumed once per engine step by
+        inference/admission.py's ``slo_aware`` policy (slack ordering +
+        shed threshold); the ``fifo`` default never calls it.  Changing
+        its shape changes shedding behavior — it is load-bearing."""
         g = self.goodput()
         return {"burn_rate": self.burn_rate(),
                 "request_goodput": g["request_goodput"],

@@ -1,0 +1,502 @@
+"""SLO-aware overload protection (r18): pluggable admission/preemption
+policies, burn-rate-driven shedding, serving chaos faults, and the
+overload A/B oracle.
+
+Oracles:
+* the default ``fifo`` policy is byte-identical to the pre-policy
+  engine: same event streams, scheduler stats and KV counters whether
+  the policy comes from the flag default, an explicit name, or an
+  instance (and the whole pre-existing serving suite keeps passing
+  under the default — the wider pin);
+* submit rejections carry machine-readable REASONS: the labeled
+  ``serving_rejects_total{reason=}`` counter and the reject-span
+  ``reject_reason`` attribute distinguish pool / budget / max_seq_len
+  (and the policy's ``shed``) — today they no longer all look alike;
+* ``slo_aware`` orders admission by remaining slack, sheds queued
+  requests whose predicted TTFT can no longer meet the target (every
+  shed is a trace span + counter, excluded from SLO-tracker goodput
+  denominators), and preempts the LEAST-lost-work victim (prompt +
+  decoded tokens recomputed on resume) instead of the youngest;
+* ``slo_aware`` scheduling is deterministic for a seeded trace on a
+  logical clock: two fresh engines produce identical event streams,
+  span streams and stats (the r12 determinism contract extended);
+* starvation oracle: under saturating load every submitted request
+  finishes, sheds, or rejects — none hangs, the engine drains;
+* chaos serving faults (decode_delay / req_burst / pool_spike) parse,
+  inject deterministically, and are countered; unknown tokens raise;
+  tools/chaos_train.py REJECTS serving-only fault tokens with a clear
+  parse error instead of silently ignoring them;
+* tools/overload_bench.py --quick (subprocess): slo_aware strictly
+  beats fifo on goodput under the seeded saturating trace, zero
+  starvation, every shed visible.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.admission import (FIFOPolicy, SLOAwarePolicy,
+                                            get_policy, lost_work_cost)
+from paddle_tpu.inference.serving import (DecoderConfig, Request,
+                                          ServingEngine, _SeqState)
+from paddle_tpu.utils import chaos
+from paddle_tpu.utils import flags as _flags
+from paddle_tpu.utils import telemetry, tracing
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = DecoderConfig(vocab_size=64, hidden=32, num_heads=4, num_layers=2,
+                    max_seq_len=128)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    saved = dict(_flags._flags)
+    telemetry.registry().clear()
+    tracing.reset()
+    chaos.reset()
+    yield
+    tracing.reset()
+    telemetry.registry().clear()
+    _flags._flags.clear()
+    _flags._flags.update(saved)
+    telemetry.reset_slo()
+    chaos.reset()
+
+
+def make_engine(**kw):
+    kw.setdefault("num_pages", 32)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("token_budget", 64)
+    kw.setdefault("prefill_bucket_min", 8)
+    return ServingEngine(kw.pop("cfg", CFG), **kw)
+
+
+def _mixed_prompts(seed=7, n=4, vocab=64):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(0, vocab, size=ln)))
+            for ln in (3, 11, 6, 14)[:n]]
+
+
+def _drive(eng, reqs, dt=1.0, max_steps=500):
+    """Deterministic logical clock: step k runs at now = k * dt."""
+    for r in reqs:
+        eng.submit(r)
+    events, t = [], 0.0
+    while eng.has_work() and max_steps:
+        t += dt
+        max_steps -= 1
+        events.extend((e.req_id, e.token, e.finished)
+                      for e in eng.step(t))
+    return events
+
+
+# ==========================================================================
+# policy resolution + fifo byte-identity
+# ==========================================================================
+def test_policy_resolution_flag_name_instance():
+    assert make_engine().policy.name == "fifo"            # flag default
+    assert make_engine(admission_policy="slo_aware").policy.name \
+        == "slo_aware"
+    assert make_engine(admission_policy=SLOAwarePolicy()).policy.name \
+        == "slo_aware"                                    # pluggable
+    _flags.set_flags({"admission_policy": "slo_aware"})
+    assert make_engine().policy.name == "slo_aware"
+    with pytest.raises(ValueError, match="unknown admission policy"):
+        get_policy("lifo")
+
+
+def test_fifo_default_byte_identical():
+    """Default flag, explicit name and explicit instance all run the
+    exact same schedule: event streams, scheduler stats, KV counters
+    and the serving telemetry counters are identical."""
+    prompts = _mixed_prompts(seed=11)
+
+    def run(**kw):
+        telemetry.registry().clear()
+        telemetry.slo_tracker().reset()
+        eng = make_engine(num_pages=6, page_size=4, **kw)
+        ev = _drive(eng, [Request(i, list(p), 5)
+                          for i, p in enumerate(prompts)])
+        snap = telemetry.snapshot()
+        counters = {k: v["series"][0]["value"] for k, v in snap.items()
+                    if k.startswith("serving_") and v["type"] == "counter"
+                    and not v["labels"]}
+        return ev, eng.stats.copy(), eng.kv.stats(), counters
+
+    a = run()
+    b = run(admission_policy="fifo")
+    c = run(admission_policy=FIFOPolicy())
+    assert a == b == c
+    assert a[1]["preempted"] >= 1        # the pool really bites
+    assert a[1]["shed"] == 0             # fifo never sheds
+
+
+# ==========================================================================
+# labeled reject reasons (satellite 1)
+# ==========================================================================
+def _reject_count(reason):
+    snap = telemetry.snapshot()
+    fam = snap.get("serving_rejects_total", {"series": []})
+    for s in fam["series"]:
+        if s["labels"].get("reason") == reason:
+            return s["value"]
+    return 0
+
+
+def test_submit_reject_reasons_are_labeled():
+    _flags.set_flags({"trace_requests": 1})
+    eng = make_engine(num_pages=4, page_size=4, token_budget=16)
+    cases = [
+        ("seq", Request("seq", list(range(100)), 60), "max_seq_len"),
+        ("pool", Request("pool", list(range(10)), 8), "pool"),   # 18 > 16
+        # 16 tokens fill the pool exactly (4 pages) but prompt+1 > the
+        # 16-token budget: the budget gate, not the pool gate
+        ("budget", Request("budget", list(range(16)), 0), "budget"),
+    ]
+    for _, req, reason in cases:
+        with pytest.raises(ValueError):
+            eng.submit(req)
+        assert _reject_count(reason) == 1
+        tr = tracing.store().get(tracing.trace_id_for(req.req_id))
+        root = tr.spans_named("request")[0]
+        assert root.attrs["status"] == "rejected"
+        assert root.attrs["reject_reason"] == reason
+    # the legacy aggregate keeps counting every submit rejection
+    assert telemetry.snapshot()["serving_rejected_total"]["series"][0][
+        "value"] == 3
+
+
+# ==========================================================================
+# slo_aware: slack ordering, shedding, victim choice
+# ==========================================================================
+def test_slack_ordering_and_degenerate_fifo():
+    pol = SLOAwarePolicy()
+    reqs = []
+    for i, arr in enumerate([0.3, 0.1, 0.2]):
+        r = Request(i, [1], 4, arr)
+        r._seq = i
+        reqs.append(r)
+
+    class Eng:
+        waiting = reqs
+
+        @staticmethod
+        def slo_hint():
+            return {"burn_rate": 0.0, "targets": {"ttft_s": 1.0}}
+
+    pol.order(Eng, now=1.0)
+    # least slack = longest waited = earliest arrival first
+    assert [r.req_id for r in Eng.waiting] == [1, 2, 0]
+
+    class NoTarget(Eng):
+        @staticmethod
+        def slo_hint():
+            return {"burn_rate": 5.0, "targets": {"ttft_s": None}}
+
+    pol.order(NoTarget, now=1.0)   # no target: oldest-first == FIFO
+    assert [r.req_id for r in NoTarget.waiting] == [1, 2, 0]
+    # shed with no target armed: nothing
+    assert pol.shed(NoTarget, now=100.0) == []
+
+
+def test_burn_rate_tightens_shed_threshold():
+    pol = SLOAwarePolicy()
+    r = Request(0, [1], 4, 0.0)
+
+    def eng(burn):
+        class E:
+            waiting = [r]
+
+            @staticmethod
+            def slo_hint():
+                return {"burn_rate": burn, "targets": {"ttft_s": 1.0}}
+        return E
+
+    # sustainable burn: only certain misses shed (waited > target)
+    assert pol.shed(eng(0.5), now=0.9) == []
+    assert pol.shed(eng(0.5), now=1.1) == [r]
+    # burn 2x: headroom halves — shed at waited > 0.5
+    assert pol.shed(eng(2.0), now=0.6) == [r]
+    assert pol.shed(eng(2.0), now=0.4) == []
+
+
+def test_victim_is_least_lost_work_not_youngest():
+    old = Request("old", [1, 2], 8)
+    old.out_tokens = [5, 6, 7]                    # cost 2 + 3 = 5
+    young = Request("young", list(range(12)), 8)
+    young.out_tokens = [5]                        # cost 12 + 1 = 13
+    running = [_SeqState(old, 7), _SeqState(young, 5)]
+    assert SLOAwarePolicy().victim_index(running) == 0   # cheapest loss
+    assert FIFOPolicy().victim_index(running) == -1      # youngest
+    # ties break youngest-first (deterministic)
+    young2 = Request("young2", [1, 2], 8)
+    young2.out_tokens = [5, 6, 7]                 # cost 5 == old's
+    assert SLOAwarePolicy().victim_index(
+        [_SeqState(old, 7), _SeqState(young2, 5)]) == 1
+
+
+def test_shed_outcome_traced_countered_and_excluded_from_goodput():
+    _flags.set_flags({"trace_requests": 1})
+    telemetry.slo_tracker().configure(ttft_s=2.5, token_s=None,
+                                      objective=0.9, window=16)
+    eng = make_engine(max_batch=1, admission_policy="slo_aware")
+    reqs = [Request(i, list(p), 4)
+            for i, p in enumerate(_mixed_prompts(n=4) * 2)]
+    _drive(eng, reqs, dt=1.0)
+    finished = [r for r in reqs if r.finished_at is not None]
+    shed = [r for r in reqs if r.shed_at is not None]
+    assert len(finished) + len(shed) == len(reqs)
+    assert shed and finished                      # both outcomes occur
+    assert eng.stats["shed"] == len(shed)
+    # counters: dedicated total + labeled reason, all in agreement
+    snap = telemetry.snapshot()
+    assert snap["serving_shed_total"]["series"][0]["value"] == len(shed)
+    assert _reject_count("shed") == len(shed)
+    # spans: every shed decision visible, wait span closed
+    for r in shed:
+        tr = tracing.store().get(tracing.trace_id_for(r.req_id))
+        root = tr.spans_named("request")[0]
+        assert root.attrs["status"] == "shed"
+        assert root.attrs["reject_reason"] == "shed"
+        assert root.attrs["waited_s"] > 0
+        assert all(s.t1 is not None for s in tr.spans)
+        assert tr.finished
+    # goodput denominators exclude shed requests entirely
+    g = telemetry.slo_tracker().goodput()
+    assert g["requests_total"] == len(finished)
+    # every shed request had actually outwaited its (burn-scaled) target
+    for r in shed:
+        assert r.shed_at - r.arrival_time > 2.5 / max(
+            1.0, telemetry.slo_tracker().burn_rate()) - 1e-9
+
+
+def test_slo_aware_determinism_seeded_trace():
+    """The r12 determinism contract extended to slo_aware: two fresh
+    engines over the same seeded requests on the same logical clock
+    produce identical event streams, span streams and stats — shed and
+    preemption decisions included."""
+    _flags.set_flags({"trace_requests": 1})
+    prompts = _mixed_prompts(seed=9, n=4) + _mixed_prompts(seed=5, n=4)
+
+    def run():
+        tracing.reset()
+        telemetry.registry().reset()
+        telemetry.slo_tracker().configure(ttft_s=6.0, token_s=None,
+                                          objective=0.9, window=8)
+        eng = make_engine(num_pages=6, page_size=4, max_batch=4,
+                          admission_policy="slo_aware")
+        ev = _drive(eng, [Request(i, list(p), 5)
+                          for i, p in enumerate(prompts)], dt=1.0)
+        return ev, eng.stats.copy(), eng.kv.stats(), tracing.span_stream()
+
+    a = run()
+    b = run()
+    assert a == b
+    assert a[1]["preempted"] >= 1 or a[1]["shed"] >= 1  # pressure is real
+
+
+def test_lost_work_cost_span_tree_matches_fallback():
+    _flags.set_flags({"trace_requests": 1})
+    eng = make_engine()
+    reqs = [Request(i, list(p), 4) for i, p in enumerate(_mixed_prompts())]
+    for r in reqs:
+        eng.submit(r)
+    eng.step(1.0)                    # admissions + first decode
+    for st in eng.running:
+        assert lost_work_cost(st.req) \
+            == len(st.req.prompt) + len(st.req.out_tokens)
+    eng.run_to_completion(2.0)
+
+
+def test_starvation_oracle_under_saturation():
+    """Every submitted request terminates as exactly one of finished /
+    shed / rejected; the engine drains inside a bounded step count."""
+    telemetry.slo_tracker().configure(ttft_s=3.0, token_s=None,
+                                      objective=0.9, window=16)
+    rng = np.random.RandomState(3)
+    eng = make_engine(num_pages=16, page_size=4, max_batch=2,
+                      token_budget=32, admission_policy="slo_aware")
+    reqs, rejected = [], []
+    for i in range(24):
+        r = Request(i, list(map(int, rng.randint(0, 64, size=rng.randint(
+            2, 12)))), int(rng.randint(2, 7)))
+        reqs.append(r)
+        try:
+            eng.submit(r)
+        except ValueError:
+            rejected.append(r)
+    steps = 0
+    while eng.has_work():
+        steps += 1
+        assert steps < 400, "starvation: engine failed to drain"
+        eng.step(float(steps))
+    for r in reqs:
+        outcomes = [r.finished_at is not None, r.shed_at is not None,
+                    r in rejected]
+        assert sum(outcomes) == 1, (r.req_id, outcomes)
+    assert not eng.waiting and not eng.running
+
+
+# ==========================================================================
+# chaos serving faults
+# ==========================================================================
+def test_chaos_serving_fault_grammar():
+    s = chaos.FaultSchedule(
+        "seed=3;decode_delay=5@2;req_burst=4@10;pool_spike=8@3:6")
+    assert s.decode_delay_at == {2: 5.0}
+    assert s.burst_at == {10: 4}
+    assert s.spike_at == {3: (8, 6)}
+    assert s.serving_faults() == {"decode_delay", "req_burst",
+                                  "pool_spike"}
+    s2 = chaos.FaultSchedule("decode_delay=2:0.5")
+    assert s2.decode_delay_ms == 2.0 and s2.decode_delay_p == 0.5
+    assert chaos.FaultSchedule("kill@3").serving_faults() == set()
+    with pytest.raises(ValueError, match="unknown event"):
+        chaos.FaultSchedule("decode_jitter=5@2")
+    with pytest.raises(ValueError, match="req_burst"):
+        chaos.FaultSchedule("req_burst=4")
+    with pytest.raises(ValueError, match="pool_spike"):
+        chaos.FaultSchedule("pool_spike=8")
+
+
+def test_chaos_pool_spike_seizes_and_releases():
+    _flags.set_flags({"chaos": "pool_spike=4@2:3"})
+    chaos.reset()
+    eng = make_engine(num_pages=32, page_size=8)
+    assert eng.kv.num_free_pages == 32
+    eng.step(1.0)                          # step 1: nothing armed
+    assert eng.kv.num_free_pages == 32
+    eng.step(2.0)                          # step 2: spike seizes 4 pages
+    assert eng.kv.num_free_pages == 28
+    eng.step(3.0)
+    eng.step(4.0)
+    assert eng.kv.num_free_pages == 28     # held for the duration
+    eng.step(5.0)                          # step 5 = 2+3: released
+    assert eng.kv.num_free_pages == 32
+    snap = telemetry.snapshot()
+    kinds = {s["labels"]["kind"]: s["value"]
+             for s in snap["chaos_injections_total"]["series"]}
+    assert kinds.get("pool_spike") == 1
+
+
+def test_chaos_decode_delay_strict_ms():
+    # an empty/garbage MS must be a parse error, never a silently
+    # armed 0 ms no-op (the never-silently-ignored contract)
+    with pytest.raises(ValueError, match="decode_delay"):
+        chaos.FaultSchedule("decode_delay=@3")
+    with pytest.raises(ValueError, match="decode_delay"):
+        chaos.FaultSchedule("decode_delay=abc:0.5")
+    assert chaos.FaultSchedule("decode_delay=5ms@3").decode_delay_at \
+        == {3: 5.0}
+
+
+def test_chaos_pool_spike_is_per_engine():
+    """Two engines under ONE process-wide schedule, independent step
+    counters: engine B crossing the release step must neither free nor
+    drop engine A's seizure — A's pages return when A itself reaches
+    the release step."""
+    _flags.set_flags({"chaos": "pool_spike=4@2:3"})
+    chaos.reset()
+    a = make_engine(num_pages=32, page_size=8)
+    b = make_engine(num_pages=32, page_size=8)
+    a.step(1.0)
+    a.step(2.0)                            # A's spike seizes 4 pages
+    assert a.kv.num_free_pages == 28
+    for t in range(1, 7):                  # B runs past ITS release step
+        b.step(float(t))
+    assert b.kv.num_free_pages == 32       # B seized at 2, released at 5
+    assert a.kv.num_free_pages == 28       # A's seizure untouched by B
+    for t in (3.0, 4.0, 5.0):
+        a.step(t)
+    assert a.kv.num_free_pages == 32       # released on A's own clock
+
+
+def test_chaos_req_burst_queues_for_loadgen():
+    _flags.set_flags({"chaos": "req_burst=3@2"})
+    chaos.reset()
+    eng = make_engine()
+    eng.step(1.0)
+    assert chaos.take_burst() == 0
+    eng.step(2.0)
+    assert chaos.take_burst() == 3         # queued at step 2
+    assert chaos.take_burst() == 0         # popped once
+
+
+def test_chaos_decode_delay_counts_injection():
+    _flags.set_flags({"chaos": "decode_delay=1@1"})
+    chaos.reset()
+    eng = make_engine()
+    eng.submit(Request(0, [1, 2, 3], 3))
+    eng.run_to_completion()
+    snap = telemetry.snapshot()
+    kinds = {s["labels"]["kind"]: s["value"]
+             for s in snap["chaos_injections_total"]["series"]}
+    assert kinds.get("decode_delay") == 1
+    assert eng.stats["finished"] == 1      # fault injected, decode fine
+
+
+def test_chaos_off_is_free_and_byte_identical():
+    prompts = _mixed_prompts(seed=11)
+
+    def run(spec):
+        _flags.set_flags({"chaos": spec})
+        chaos.reset()
+        eng = make_engine(num_pages=6, page_size=4)
+        return _drive(eng, [Request(i, list(p), 5)
+                            for i, p in enumerate(prompts)])
+
+    # an armed-but-never-firing schedule must not change the schedule
+    assert run("") == run("decode_delay=1@100000")
+
+
+# ==========================================================================
+# CLI oracles (bounded subprocesses, PJRT-probe pattern)
+# ==========================================================================
+def test_overload_bench_quick_subprocess():
+    bound = int(os.environ.get("PD_SERVING_TIMEOUT", 300))
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "overload_bench.py"),
+         "--quick", "--json"],
+        cwd=ROOT, capture_output=True, text=True, timeout=bound,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+    line = [ln for ln in r.stdout.splitlines()
+            if ln.startswith("OVERLOAD=")][-1]
+    rep = json.loads(line[len("OVERLOAD="):])
+    comp = rep["comparison"]
+    # the acceptance oracle: strictly higher goodput, fifo never sheds
+    assert comp["slo_aware_strictly_better"] is True
+    assert comp["slo_aware_request_goodput"] > comp["fifo_request_goodput"]
+    assert comp["fifo_never_sheds"] is True
+    for policy in ("fifo", "slo_aware"):
+        p = rep["policies"][policy]
+        assert p["starvation_free"] is True
+        assert p["sheds_visible"] is True
+        assert p["outcomes"]["hung"] == 0
+    assert rep["policies"]["slo_aware"]["outcomes"]["shed"] > 0
+    # burn-rate trajectory rides along per policy
+    assert rep["policies"]["fifo"]["burn_trajectory"][-1] > 1.0
+    assert isinstance(rep["policies"]["slo_aware"]["burn_trajectory"], list)
+
+
+def test_chaos_train_rejects_serving_fault_tokens(capsys):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import chaos_train
+
+    for spec, frag in [("decode_delay=5:1", "serving-only"),
+                       ("req_burst=4@10", "serving-only"),
+                       ("pool_spike=8@3", "serving-only"),
+                       ("frobnicate@3", "unknown event"),
+                       ("kill@5", "owned by chaos_train")]:
+        with pytest.raises(SystemExit) as exc:
+            chaos_train.main(["--chaos", spec, "--quick"])
+        assert exc.value.code == 2
+        assert frag in capsys.readouterr().err
+    # a valid training-fault spec parses fine (no phases spawned here)
+    assert chaos_train._training_chaos("rpc_delay=1:0.5;trunc_ckpt@1") \
+        == "rpc_delay=1:0.5;trunc_ckpt@1"

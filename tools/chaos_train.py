@@ -77,7 +77,10 @@ def run_worker(args) -> int:
 
     _flags.set_flags({"dp_sharding": args.stage})
     if args.phase == "crash" and args.kill_at >= 0:
-        _flags.set_flags({"chaos": f"seed=7;kill@{args.kill_at}"})
+        spec = f"seed=7;kill@{args.kill_at}"
+        if args.chaos:
+            spec += ";" + args.chaos
+        _flags.set_flags({"chaos": spec})
     from paddle_tpu.utils import chaos
 
     main, startup, loss = build_mlp_dp_program(
@@ -143,6 +146,30 @@ def _result(args, extra):
 # --------------------------------------------------------------------------
 # orchestrator
 # --------------------------------------------------------------------------
+def _training_chaos(spec: str) -> str:
+    """argparse type for --chaos: parse the schedule up front so an
+    unknown or serving-only fault token fails with a CLEAR error at the
+    command line instead of being silently ignored (or arming a no-op
+    schedule) deep inside a worker phase."""
+    from paddle_tpu.utils.chaos import FaultSchedule
+
+    try:
+        sched = FaultSchedule(spec)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+    serving = sorted(sched.serving_faults())
+    if serving:
+        raise argparse.ArgumentTypeError(
+            f"serving-only fault(s) {serving} have no effect in a "
+            f"training run — chaos_train ignores nothing; use "
+            f"tools/overload_bench.py --chaos for serving faults")
+    if sched.kill_step is not None:
+        raise argparse.ArgumentTypeError(
+            "kill@K is owned by chaos_train itself (--kill-at); "
+            "--chaos only adds rpc/ckpt faults on top")
+    return spec
+
+
 def _spawn(phase: str, cfg: dict, workdir: str, timeout: int,
            expect_rc=(0,)) -> int:
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", phase,
@@ -150,6 +177,8 @@ def _spawn(phase: str, cfg: dict, workdir: str, timeout: int,
     for k in ("stage", "steps", "kill-at", "ckpt-every", "layers", "width"):
         cmd += [f"--{k}", str(cfg[k.replace('-', '_')])]
     cmd += ["--path", cfg["path"]]
+    if cfg.get("chaos"):
+        cmd += ["--chaos", cfg["chaos"]]
     env = dict(os.environ)
     env.pop("FLAGS_chaos", None)
     r = subprocess.run(cmd, cwd=ROOT, env=env, timeout=timeout,
@@ -274,6 +303,12 @@ def main(argv=None):
     ap.add_argument("--ckpt-every", type=int, default=2, dest="ckpt_every")
     ap.add_argument("--layers", type=int, default=3)
     ap.add_argument("--width", type=int, default=16)
+    ap.add_argument("--chaos", type=_training_chaos, default="",
+                    help="extra TRAINING fault events merged into the "
+                         "crash phase's schedule (rpc_drop/rpc_delay/"
+                         "trunc_ckpt).  Unknown or serving-only tokens "
+                         "(decode_delay/req_burst/pool_spike) are a "
+                         "parse error, never silently ignored")
     ap.add_argument("--truncate", action="store_true",
                     help="corrupt the newest checkpoint after the crash; "
                          "resume must fall back to the previous one")
@@ -301,13 +336,14 @@ def main(argv=None):
                 combos.append((dict(stage=stage, path=path,
                                     steps=args.steps, kill_at=args.kill_at,
                                     ckpt_every=args.ckpt_every,
-                                    layers=args.layers, width=args.width),
+                                    layers=args.layers, width=args.width,
+                                    chaos=args.chaos),
                                stage == 3))
     else:
         combos.append((dict(stage=args.stage, path=args.path,
                             steps=args.steps, kill_at=args.kill_at,
                             ckpt_every=args.ckpt_every, layers=args.layers,
-                            width=args.width),
+                            width=args.width, chaos=args.chaos),
                        args.truncate or args.quick))
 
     reports = []

@@ -52,6 +52,12 @@ def build_args():
     ap.add_argument("--warmup", type=int, default=1,
                     help="unmeasured trace replays to populate the jit "
                          "cache before timing")
+    ap.add_argument("--policy", default="fifo",
+                    choices=["fifo", "slo_aware"],
+                    help="admission policy for the CONTINUOUS engine "
+                         "(inference/admission.py; fifo = the pinned "
+                         "default; tools/overload_bench.py is the "
+                         "policy-vs-policy oracle)")
     ap.add_argument("--slo-ttft-ms", type=float, default=0.0,
                     help="TTFT SLO target in ms (0 = unset: every "
                          "request counts as within)")
@@ -74,7 +80,8 @@ def make_engines(model_dir, args):
     core_kw = dict(num_pages=args.num_pages, page_size=args.page_size,
                    prefill_bucket_min=8)
     cont = ServingEngine(model_dir=model_dir, max_batch=args.max_batch,
-                         token_budget=args.token_budget, **core_kw)
+                         token_budget=args.token_budget,
+                         admission_policy=args.policy, **core_kw)
     static = StaticBatchingEngine(
         _EngineCore.from_model_dir(model_dir, **core_kw),
         batch_size=args.static_batch)
@@ -172,6 +179,7 @@ def main(argv=None):
                       "heads": cfg.num_heads, "vocab": cfg.vocab_size},
             "pool": {"num_pages": args.num_pages,
                      "page_size": args.page_size},
+            "policy": args.policy,
             "continuous": cont_rep,
             "static": stat_rep,
             "speedup_tokens_per_s": round(speedup, 3),

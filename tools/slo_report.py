@@ -6,7 +6,9 @@ SLO-aware-admission rung will stand on:
 
 * a **per-request span table** — queue / prefill / decode / preempt
   breakdown recomputed from each request's recorded span tree
-  (utils/tracing.py), with TTFT, token count and preemption cycles;
+  (utils/tracing.py), with TTFT, token count, preemption cycles and the
+  admission OUTCOME (admitted / shed / rejected — the r18 overload-
+  protection taxonomy);
 * **SLO accounting** — declared TTFT / per-token targets, the
   rolling-window error-budget burn rate and goodput (requests/tokens
   served within SLO vs total) from utils/telemetry.py's SLOTracker;
@@ -60,6 +62,10 @@ def build_args():
     ap.add_argument("--new-min", type=int, default=4)
     ap.add_argument("--new-max", type=int, default=8)
     ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--policy", default="fifo",
+                    help="admission policy (fifo | slo_aware) — shed "
+                         "outcomes only appear under slo_aware with an "
+                         "armed TTFT target")
     ap.add_argument("--slo-ttft-ms", type=float, default=200.0,
                     help="TTFT target in ms (0 = unset)")
     ap.add_argument("--slo-token-ms", type=float, default=100.0,
@@ -73,14 +79,23 @@ def build_args():
     return ap
 
 
+#: root-span status -> admission-outcome column value
+_OUTCOMES = {"finished": "admitted", "shed": "shed", "rejected": "rejected"}
+
+
 def trace_rows(traces):
     """Per-request breakdown from the span trees: queue/preempt waits
     in LOGICAL time (the driver's clock — the only one waits exist
-    in), prefill/decode in wall time (real compute durations)."""
+    in), prefill/decode in wall time (real compute durations).  Every
+    TERMINAL request appears, with its admission outcome (admitted /
+    shed / rejected)."""
     rows = []
     for tr in traces:
         root = next((s for s in tr.spans if s.name == "request"), None)
-        if root is None or root.attrs.get("status") != "finished":
+        if root is None:
+            continue
+        outcome = _OUTCOMES.get(root.attrs.get("status"))
+        if outcome is None:
             continue
         queue_s = sum((s.t1 or s.t0) - s.t0 for s in tr.spans
                       if s.name in ("queue_wait", "preempted")
@@ -88,6 +103,7 @@ def trace_rows(traces):
         rows.append({
             "trace": tr.trace_id,
             "req": str(tr.req_id),
+            "outcome": outcome,
             "queue_s": round(queue_s, 6),
             "prefill_ms": round(sum(
                 s.wall_duration() for s in tr.spans_named("prefill")) * 1e3,
@@ -107,10 +123,12 @@ def trace_rows(traces):
 def independent_goodput(per_req, ttft_s, token_s):
     """Recompute the SLOTracker's counts from loadgen's per-request
     view — the agreement oracle (same judging rules, independent
-    data path)."""
+    data path).  Shed requests are excluded from the denominators on
+    BOTH sides: the tracker never observes them (the engine sheds
+    before finish), and this recomputation skips them explicitly."""
     req_total = req_within = tok_total = tok_within = 0
     for r in per_req.values():
-        if not r["finished"]:
+        if not r["finished"] or r.get("shed"):
             continue
         has_first = r["ttft_s"] == r["ttft_s"]
         ok_ttft = ttft_s is None or (has_first and r["ttft_s"] <= ttft_s)
@@ -159,7 +177,8 @@ def main(argv=None) -> int:
                         page_size=args.page_size,
                         max_batch=args.max_batch,
                         token_budget=args.token_budget,
-                        prefill_bucket_min=4, seed=args.seed)
+                        prefill_bucket_min=4, seed=args.seed,
+                        admission_policy=args.policy)
     trace = poisson_trace(
         args.requests, args.rate, cfg.vocab_size,
         prompt_len_range=(args.prompt_min, args.prompt_max),
@@ -185,6 +204,8 @@ def main(argv=None) -> int:
     g = slo["goodput"]
     agrees = all(g[k] == ind[k] for k in ind)
 
+    admitted_rows = [r for r in rows if r["outcome"] == "admitted"]
+    shed_rows = [r for r in rows if r["outcome"] == "shed"]
     recon = {
         "prefill_spans": sum(len(t.spans_named("prefill"))
                              for t in traces),
@@ -192,24 +213,27 @@ def main(argv=None) -> int:
         "preempted_spans": sum(len(t.spans_named("preempted"))
                                for t in traces),
         "preempted": eng.stats["preempted"],
-        "finished_traces": len(rows),
+        "finished_traces": len(admitted_rows),
         "finished": eng.stats["finished"],
+        "shed_traces": len(shed_rows),
+        "shed": eng.stats["shed"],
     }
     reconciles = (recon["prefill_spans"] == recon["admitted"]
                   and recon["preempted_spans"] == recon["preempted"]
-                  and recon["finished_traces"] == recon["finished"])
+                  and recon["finished_traces"] == recon["finished"]
+                  and recon["shed_traces"] == recon["shed"])
 
     if not args.json:
-        print(f"{'req':>6} {'queue_s':>9} {'prefill_ms':>11} "
-              f"{'decode_ms':>10} {'steps':>6} {'preempt':>8} "
-              f"{'ttft_s':>9} {'tokens':>7}")
+        print(f"{'req':>6} {'outcome':>9} {'queue_s':>9} "
+              f"{'prefill_ms':>11} {'decode_ms':>10} {'steps':>6} "
+              f"{'preempt':>8} {'ttft_s':>9} {'tokens':>7}")
         for r in rows[:20]:
             ttft = ("-" if r["ttft_s"] is None
                     else f"{r['ttft_s']:.5f}")
-            print(f"{r['req']:>6} {r['queue_s']:>9.4f} "
+            print(f"{r['req']:>6} {r['outcome']:>9} {r['queue_s']:>9.4f} "
                   f"{r['prefill_ms']:>11.3f} {r['decode_ms']:>10.3f} "
                   f"{r['decode_steps']:>6} {r['preempt_cycles']:>8} "
-                  f"{ttft:>9} {r['tokens']:>7}")
+                  f"{ttft:>9} {r['tokens'] if r['tokens'] is not None else '-':>7}")
         if len(rows) > 20:
             print(f"... {len(rows) - 20} more")
         print(f"targets: ttft<={slo['targets']['ttft_s']}s "
@@ -218,16 +242,23 @@ def main(argv=None) -> int:
         print(f"goodput: {g['requests_within_slo']}/{g['requests_total']} "
               f"requests, {g['tokens_within_slo']}/{g['tokens_total']} "
               f"tokens within SLO; burn rate {slo['burn_rate']}")
+        print(f"shed: {eng.stats['shed']}/{args.requests} "
+              f"(policy={args.policy}; shed requests excluded from the "
+              f"goodput denominators)")
         print(f"agrees_with_loadgen={agrees} spans_reconcile={reconciles}")
 
     payload = {
         "mode": "quick" if args.quick else "full",
         "requests": args.requests, "rate_req_s": args.rate,
         "seed": args.seed,
+        "policy": args.policy,
         "slo": slo,
         "latency": rep,
         "per_request": rows[:50],
         "independent": ind,
+        "shed": {"count": eng.stats["shed"],
+                 "rate": round(eng.stats["shed"] / max(args.requests, 1),
+                               6)},
         "agrees_with_loadgen": bool(agrees),
         "spans_reconcile": bool(reconciles),
         "reconciliation": recon,
