@@ -133,6 +133,12 @@ def _classify(name: str, *, params: set, opt_state: set, feeds: set,
     if name.endswith("@GRAD") or "@GRAD@" in name:
         return "grad"
     if name.startswith("kv_k_") or name.startswith("kv_v_"):
+        # the paged K/V pools: one fixed device block per layer per
+        # side, sized by the ALLOCATOR's pool shape — page-level
+        # bookkeeping (r19 CoW sharing included) happens INSIDE this
+        # block, so a page mapped by N sequences is modeled once, and
+        # the modeled kv_pool bytes agree with the runtime census
+        # whether or not prefixes are shared (pinned by test)
         return "kv_pool"
     return "state" if resident else "activation"
 

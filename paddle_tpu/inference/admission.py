@@ -82,11 +82,16 @@ class RequestRejected(ValueError):
 def lost_work_cost(req) -> int:
     """Tokens recomputed if ``req`` is preempted now and later resumed:
     the prompt is re-prefilled and every decoded token of the CURRENT
-    run is regenerated one decode step at a time.  Read off the span
-    tree when the request is traced (prompt_tokens attr of the last
-    prefill + one decode_step span per decoded token — the prefill
-    itself emits one token); identical to the untraced fallback
-    ``len(prompt) + len(out_tokens)`` by construction."""
+    run is regenerated one decode step at a time.  SHARED-PAGE-AWARE
+    (r19): prompt tokens the last prefill served from cached prefix
+    pages are subtracted — a resume re-acquires them from the index
+    instead of recomputing, so preempting a high-hit request wastes
+    less work than its raw length suggests (0 with the prefix cache
+    off — byte-identical to the r18 cost).  Read off the span tree
+    when the request is traced (prompt_tokens / cached_tokens attrs of
+    the last prefill + one decode_step span per decoded token — the
+    prefill itself emits one token); identical to the untraced fallback
+    ``len(prompt) - _prefix_hit + len(out_tokens)`` by construction."""
     tr = getattr(req, "trace", None)
     if tr is not None:
         names = [s.name for s in tr.spans]
@@ -94,8 +99,11 @@ def lost_work_cost(req) -> int:
             last = len(names) - 1 - names[::-1].index("prefill")
             prompt = tr.spans[last].attrs.get(
                 "prompt_tokens", len(req.prompt))
-            return int(prompt) + 1 + names[last:].count("decode_step")
-    return len(req.prompt) + len(req.out_tokens)
+            cached = tr.spans[last].attrs.get("cached_tokens", 0)
+            return int(prompt) - int(cached) + 1 \
+                + names[last:].count("decode_step")
+    return (len(req.prompt) - int(getattr(req, "_prefix_hit", 0))
+            + len(req.out_tokens))
 
 
 class AdmissionPolicy:

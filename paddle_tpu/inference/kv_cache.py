@@ -21,12 +21,45 @@ sequence — the property the scheduler-determinism tests pin.
 Exhaustion is BACKPRESSURE, not an error: ``append_tokens`` returns
 ``None`` (mutating nothing) when the pool cannot cover the request, and
 the scheduler defers admission until pages free up.
+
+Copy-on-write prefix caching (``FLAGS_kv_prefix_cache`` or the
+``prefix_cache=`` ctor arg; off by default — the off path is
+byte-identical to the plain allocator above, pinned by test):
+
+* every page carries a **refcount**; a page is *owned* while any live
+  sequence maps it, *cached* when its refcount reaches zero but its
+  content is still indexed, *free* otherwise.  Frees only decrement;
+  reclaim happens at refcount zero — never under a live sharer.
+* pages are **immutable once full**: a full page is registered in the
+  prefix index under a chained content digest (sha1 over the page's
+  token ids, chained through every preceding page), and appends past
+  it always open a new page.  The partial TAIL page of a prompt is
+  indexed too (under ``(chain digest, tail-token tuple)``), so prefix
+  hits are not quantized to page boundaries.
+* ``match_prefix`` walks a new prompt through the index and
+  ``acquire_prefix`` maps every already-cached page into the new
+  sequence's block table at refcount+1 — the engine skips prefilling
+  those tokens entirely.
+* the first **write into a shared partial page forks it** (CoW): the
+  writer gets a private copy-page, the fork is queued for the engine
+  (``take_forks``) to replay as a device page copy before the step
+  that writes runs, and every other sharer keeps the frozen original.
+* refcount-0 cached pages are reclaimed only when the free list runs
+  dry, in a **deterministic seeded eviction order** (free generation
+  FIFO, ``crc32(seed:page)`` as the documented tiebreak), so a seeded
+  trace replays bit-identically, eviction decisions included.
+
+``stats()`` keeps every legacy key and adds a ``prefix_cache`` section
+(hit tokens, forked/evicted pages, live shared pages, cached pages) —
+all zeros when the feature is off.
 """
 from __future__ import annotations
 
+import hashlib
+import zlib
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -63,28 +96,73 @@ class KVCacheConfig:
 class _Seq:
     pages: List[int] = field(default_factory=list)
     length: int = 0  # tokens written
+    # prefix-cache chain state (unused when the feature is off)
+    digest: bytes = b""           # chain digest after the last FULL page
+    tail: List[int] = field(default_factory=list)  # tokens in the tail page
+    opaque: bool = False          # tokens unknown -> pages never indexed
+    # acquired-but-uncommitted hit accounting (folded into the cache
+    # counters at the first successful prefill slice — see
+    # commit_prefix_hit — so blocked-admission acquire/release retries
+    # never inflate the hit numbers)
+    pending_hit: int = 0
+    pending_shared: int = 0
+
+
+def _chain(digest: bytes, tokens) -> bytes:
+    """Chained page-content digest: deterministic across processes
+    (hashlib, never the salted builtin hash)."""
+    h = hashlib.sha1(digest)
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.digest()
 
 
 class PagedKVCache:
     """Page allocator + per-sequence block tables (host side)."""
 
-    def __init__(self, config: KVCacheConfig):
+    def __init__(self, config: KVCacheConfig,
+                 prefix_cache: Optional[bool] = None, seed: int = 0):
         self.config = config
+        if prefix_cache is None:
+            from ..utils.flags import flag
+
+            prefix_cache = bool(flag("kv_prefix_cache", False))
+        self.prefix_cache = bool(prefix_cache)
+        self.seed = int(seed)
         self._free: deque = deque(range(config.num_pages))
         self._seqs: Dict[object, _Seq] = {}
+        # CoW / prefix-index state (all empty — and untouched — when
+        # prefix_cache is off, so the legacy path stays byte-identical)
+        self._refs: Dict[int, int] = {}            # page -> refcount
+        self._used: Dict[int, int] = {}            # page -> valid slots
+        self._full_key: Dict[int, bytes] = {}      # page -> full digest
+        self._index: Dict[bytes, int] = {}         # full digest -> page
+        self._partials: Dict[bytes, Dict[int, tuple]] = {}
+        self._page_partial: Dict[int, Tuple[bytes, tuple]] = {}
+        self._cached_free: Dict[int, int] = {}     # page -> free generation
+        self._free_gen = 0
+        self._pending_forks: List[Tuple[int, int, int]] = []
         # counters for the serving report
         self.alloc_count = 0
         self.free_count = 0
         self.peak_pages = 0
+        self.hit_tokens = 0
+        self.forked_pages = 0
+        self.evicted_pages = 0
+        self.shared_acquires = 0
 
     # -- capacity ----------------------------------------------------------
     @property
     def num_free_pages(self) -> int:
-        return len(self._free)
+        """Reclaimable pages: truly free plus refcount-0 cached pages
+        (evictable on demand)."""
+        return len(self._free) + len(self._cached_free)
 
     @property
     def pages_in_use(self) -> int:
-        return self.config.num_pages - len(self._free)
+        """DISTINCT pages owned by live sequences — a page shared by N
+        sequences counts once (the invariant the memory planner's
+        ``kv_pool`` reconciliation relies on)."""
+        return self.config.num_pages - self.num_free_pages
 
     def utilization(self) -> float:
         """Fraction of pool pages currently owned by live sequences."""
@@ -92,11 +170,15 @@ class PagedKVCache:
 
     def fragmentation(self) -> float:
         """Internal fragmentation: fraction of owned slots holding no
-        token (tail-of-page waste).  0.0 when nothing is allocated."""
+        token (tail-of-page waste).  0.0 when nothing is allocated.
+        Shared pages count their slots ONCE."""
         used_pages = self.pages_in_use
         if used_pages == 0:
             return 0.0
-        tokens = sum(s.length for s in self._seqs.values())
+        if self.prefix_cache:
+            tokens = sum(self._used.get(p, 0) for p in self._refs)
+        else:
+            tokens = sum(s.length for s in self._seqs.values())
         return 1.0 - tokens / (used_pages * self.config.page_size)
 
     def pages_needed(self, seq_id, n_tokens: int) -> int:
@@ -108,8 +190,22 @@ class PagedKVCache:
         need = -(-(length + n_tokens) // self.config.page_size)  # ceil
         return max(0, need - have)
 
+    def cow_fork_need(self, seq_id, n_tokens: int) -> int:
+        """Extra pages a CoW fork would consume if ``n_tokens`` were
+        appended now: 1 when the append would write into a SHARED
+        partial tail page (the write forks it), else 0.  Always 0 with
+        prefix caching off — safe to add into any capacity check."""
+        if not self.prefix_cache or n_tokens <= 0:
+            return 0
+        s = self._seqs.get(seq_id)
+        if s is None or not s.pages or s.length % self.config.page_size == 0:
+            return 0
+        return 1 if self._refs.get(s.pages[-1], 0) > 1 else 0
+
     def can_append(self, seq_id, n_tokens: int) -> bool:
-        return self.pages_needed(seq_id, n_tokens) <= len(self._free)
+        return (self.pages_needed(seq_id, n_tokens)
+                + self.cow_fork_need(seq_id, n_tokens)
+                <= self.num_free_pages)
 
     def _publish_gauges(self):
         """Pool state -> telemetry registry (r13): the gauges mirror
@@ -125,20 +221,144 @@ class PagedKVCache:
         tm.gauge("kv_pool_fragmentation",
                  "fraction of owned KV slots holding no token "
                  "(tail-of-page waste)").set(self.fragmentation())
+        if self.prefix_cache:
+            tm.gauge("kv_prefix_cached_pages",
+                     "refcount-0 pages kept as evictable prefix-cache "
+                     "entries").set(len(self._cached_free))
+            tm.gauge("kv_prefix_shared_pages",
+                     "pages currently mapped by more than one live "
+                     "sequence").set(
+                         sum(1 for r in self._refs.values() if r > 1))
+
+    # -- page pool internals ----------------------------------------------
+    def _evict_key(self, page: int):
+        """Deterministic seeded eviction order for refcount-0 cached
+        pages: oldest free generation first; ``crc32(seed:page)`` is
+        the (documented, seed-dependent) tiebreak — a pure function of
+        (seed, free order, page id), so replays evict identically."""
+        return (self._cached_free[page],
+                zlib.crc32(f"{self.seed}:{page}".encode()))
+
+    def _take_page(self) -> int:
+        """One free page, evicting the oldest cached page when the free
+        list is dry.  The caller checked capacity."""
+        if self._free:
+            return self._free.popleft()
+        page = min(self._cached_free, key=self._evict_key)
+        del self._cached_free[page]
+        self._drop_index(page)
+        self._used.pop(page, None)
+        self.evicted_pages += 1
+        from ..utils import telemetry as tm
+
+        tm.counter("kv_prefix_evicted_total",
+                   "cached prefix pages evicted to satisfy fresh "
+                   "allocations").inc()
+        return page
+
+    def _drop_index(self, page: int):
+        d = self._full_key.pop(page, None)
+        if d is not None and self._index.get(d) == page:
+            del self._index[d]
+        self._unregister_partial(page)
+
+    def _unregister_partial(self, page: int):
+        pp = self._page_partial.pop(page, None)
+        if pp is not None:
+            digest, _ = pp
+            m = self._partials.get(digest)
+            if m is not None:
+                m.pop(page, None)
+                if not m:
+                    del self._partials[digest]
+
+    def _register_chain(self, s: _Seq, tokens):
+        """Advance the sequence's chain state by ``tokens`` (the tokens
+        just appended) and register newly-full pages (immutable from
+        now on) plus the new partial tail in the prefix index."""
+        buf = s.tail + [int(t) for t in tokens]
+        ps = self.config.page_size
+        # page index the buffered tokens start at == count of pages the
+        # chain already covers (s.length was updated by the caller)
+        page_i = (s.length - len(buf)) // ps
+        while len(buf) >= ps:
+            chunk, buf = buf[:ps], buf[ps:]
+            d = _chain(s.digest, chunk)
+            page = s.pages[page_i]
+            self._unregister_partial(page)
+            if page not in self._full_key and d not in self._index:
+                self._full_key[page] = d
+                self._index[d] = page
+            s.digest = d
+            page_i += 1
+        s.tail = buf
+        if buf:
+            page = s.pages[page_i]
+            # the tail page is exclusively owned here (a write into a
+            # shared page forked first), so its entry can be refreshed
+            self._unregister_partial(page)
+            tup = tuple(buf)
+            self._partials.setdefault(s.digest, {})[page] = tup
+            self._page_partial[page] = (s.digest, tup)
 
     # -- lifecycle ---------------------------------------------------------
-    def append_tokens(self, seq_id, n_tokens: int) -> Optional[np.ndarray]:
+    def append_tokens(self, seq_id, n_tokens: int,
+                      tokens=None) -> Optional[np.ndarray]:
         """Reserve slots for n_tokens appended to seq_id (creating it on
         first touch) and return their flat slot ids ``(n_tokens,)``
         int32 for ``kv_cache_append``'s SlotMapping.  Returns None —
         with NO state change — when the pool can't cover it
-        (admission backpressure)."""
+        (admission backpressure).
+
+        ``tokens`` (prefix caching only) are the token ids being
+        appended: they feed the content index so the pages become
+        shareable.  ``tokens=None`` marks the sequence OPAQUE — its
+        pages are never indexed (chaos pool spikes, callers that don't
+        know content)."""
+        if tokens is not None:
+            tokens = list(tokens)
+            if len(tokens) != n_tokens:
+                raise ValueError(
+                    f"append_tokens: {len(tokens)} token ids for "
+                    f"{n_tokens} slots")
         need = self.pages_needed(seq_id, n_tokens)
-        if need > len(self._free):
+        fork = self.cow_fork_need(seq_id, n_tokens)
+        if need + fork > self.num_free_pages:
             return None
         s = self._seqs.setdefault(seq_id, _Seq())
+        ps = self.config.page_size
+        if self.prefix_cache:
+            if tokens is None and n_tokens:
+                if not s.opaque:
+                    s.opaque = True
+                    if s.pages and s.length % ps:
+                        # stale partial entry: content will change
+                        self._unregister_partial(s.pages[-1])
+            if fork:
+                src = s.pages[-1]
+                dst = self._take_page()
+                self._refs[src] -= 1
+                self._refs[dst] = 1
+                keep = s.length % ps
+                self._used[dst] = keep
+                s.pages[-1] = dst
+                self._pending_forks.append((src, dst, keep))
+                self.forked_pages += 1
+                self.alloc_count += 1
+                from ..utils import telemetry as tm
+
+                tm.counter("kv_prefix_forked_total",
+                           "shared partial pages forked on first write "
+                           "(copy-on-write)").inc()
+            elif (n_tokens and s.pages and s.length % ps
+                    and not s.opaque):
+                # exclusive tail about to change: retire the stale entry
+                # (re-registered with the new content below)
+                self._unregister_partial(s.pages[-1])
         for _ in range(need):
-            s.pages.append(self._free.popleft())
+            page = self._take_page()
+            s.pages.append(page)
+            self._refs[page] = 1
             self.alloc_count += 1
         self.peak_pages = max(self.peak_pages, self.pages_in_use)
         if need:
@@ -146,29 +366,141 @@ class PagedKVCache:
 
             tm.counter("kv_pool_pages_alloc_total",
                        "KV pages handed out").inc(need)
-        ps = self.config.page_size
         slots = np.empty(n_tokens, np.int32)
         for j in range(n_tokens):
             pos = s.length + j
             slots[j] = s.pages[pos // ps] * ps + pos % ps
         s.length += n_tokens
+        if self.prefix_cache:
+            # only pages covering the appended range can change — a
+            # whole-sequence rescan here would be O(len^2) host work
+            # over a sequence's life on the decode hot path
+            for i in range((s.length - n_tokens) // ps, len(s.pages)):
+                if s.length > i * ps:
+                    self._used[s.pages[i]] = \
+                        max(self._used.get(s.pages[i], 0),
+                            min(ps, s.length - i * ps))
+            if tokens is not None and not s.opaque and n_tokens:
+                self._register_chain(s, tokens)
         # after the length update, and on EVERY append (a within-page
         # append changes fragmentation too)
         self._publish_gauges()
         return slots
 
+    # -- prefix cache ------------------------------------------------------
+    def match_prefix(self, tokens) -> Tuple[int, List[int]]:
+        """Longest already-cached prefix of ``tokens``: the number of
+        covered tokens and the pages holding them (full pages via the
+        chain index, then at most one partial tail page whose frozen
+        content is a prefix of the remainder).  Read-only; the caller
+        decides how much of the match to ``acquire_prefix``."""
+        if not self.prefix_cache or not len(tokens):
+            return 0, []
+        ps = self.config.page_size
+        toks = [int(t) for t in tokens]
+        digest, i, pages = b"", 0, []
+        while i + ps <= len(toks):
+            d = _chain(digest, toks[i:i + ps])
+            page = self._index.get(d)
+            if page is None:
+                break
+            pages.append(page)
+            digest = d
+            i += ps
+        best = None
+        for page, tup in (self._partials.get(digest) or {}).items():
+            if (0 < len(tup) <= len(toks) - i
+                    and tuple(toks[i:i + len(tup)]) == tup):
+                key = (len(tup), -page)   # longest, then lowest page id
+                if best is None or key > best[0]:
+                    best = (key, page, tup)
+        if best is not None:
+            pages.append(best[1])
+            i += len(best[2])
+        return i, pages
+
+    def acquire_prefix(self, seq_id, tokens, pages: List[int]) -> int:
+        """Map an exact ``match_prefix`` result into a NEW sequence's
+        block table at refcount+1 (resurrecting refcount-0 cached pages
+        from the evictable set).  ``tokens`` are the covered prompt
+        tokens (``prompt[:hit]``).  Returns the hit length."""
+        assert seq_id not in self._seqs, f"sequence {seq_id!r} exists"
+        hit = len(tokens)
+        if not hit:
+            return 0
+        s = _Seq()
+        self._seqs[seq_id] = s
+        for page in pages:
+            prev = self._refs.get(page, 0)
+            if prev == 0:
+                self._cached_free.pop(page, None)
+            else:
+                s.pending_shared += 1
+            self._refs[page] = prev + 1
+        s.pages = list(pages)
+        s.length = hit
+        s.pending_hit = hit
+        ps = self.config.page_size
+        n_full = len(pages) if hit % ps == 0 else len(pages) - 1
+        s.digest = self._full_key[pages[n_full - 1]] if n_full else b""
+        s.tail = [int(t) for t in tokens[n_full * ps:]]
+        self.peak_pages = max(self.peak_pages, self.pages_in_use)
+        self._publish_gauges()
+        return hit
+
+    def commit_prefix_hit(self, seq_id):
+        """Fold the sequence's acquired-prefix stats into the cache
+        counters.  The engine calls this at the FIRST prefill slice
+        that actually lands, so an acquire that gets released again
+        (admission blocked, retried next step) never counts as a hit."""
+        s = self._seqs.get(seq_id)
+        if s is None or not s.pending_hit:
+            return
+        hit, s.pending_hit = s.pending_hit, 0
+        shared, s.pending_shared = s.pending_shared, 0
+        self.hit_tokens += hit
+        self.shared_acquires += shared
+        from ..utils import telemetry as tm
+
+        tm.counter("kv_prefix_hit_tokens_total",
+                   "prompt tokens served from cached prefix pages "
+                   "(prefill skipped)").inc(hit)
+
+    def take_forks(self) -> List[Tuple[int, int, int]]:
+        """Drain pending CoW forks as ``(src_page, dst_page, used)``
+        triples.  The engine must replay each as a device page copy
+        BEFORE running the program that writes the forked page."""
+        out, self._pending_forks = self._pending_forks, []
+        return out
+
     def free_sequence(self, seq_id):
-        """Return the sequence's pages to the pool (free-on-finish)."""
+        """Decrement the sequence's page refcounts; a page is reclaimed
+        only at refcount zero (indexed pages park in the evictable
+        cached set, the rest return to the free list — free-on-finish
+        order unchanged)."""
         s = self._seqs.pop(seq_id, None)
         if s is None:
             return
-        self._free.extend(s.pages)
-        self.free_count += len(s.pages)
-        if s.pages:
+        released = 0
+        for page in s.pages:
+            self._refs[page] = self._refs.get(page, 1) - 1
+            if self._refs[page] <= 0:
+                self._refs.pop(page, None)
+                released += 1
+                if self.prefix_cache and (page in self._full_key
+                                          or page in self._page_partial):
+                    self._free_gen += 1
+                    self._cached_free[page] = self._free_gen
+                else:
+                    self._free.append(page)
+                    if self.prefix_cache:
+                        self._used.pop(page, None)
+        self.free_count += released
+        if released:
             from ..utils import telemetry as tm
 
             tm.counter("kv_pool_pages_freed_total",
-                       "KV pages returned to the pool").inc(len(s.pages))
+                       "KV pages returned to the pool").inc(released)
             self._publish_gauges()
 
     # -- views for the decode step ----------------------------------------
@@ -194,6 +526,10 @@ class PagedKVCache:
     def live_sequences(self) -> List:
         return list(self._seqs)
 
+    def refcount(self, page: int) -> int:
+        """Live-sequence references to a page (0 = free or cached)."""
+        return self._refs.get(page, 0)
+
     def stats(self) -> dict:
         return {
             "pages_total": self.config.num_pages,
@@ -203,4 +539,14 @@ class PagedKVCache:
             "fragmentation": self.fragmentation(),
             "alloc_count": self.alloc_count,
             "free_count": self.free_count,
+            "prefix_cache": {
+                "enabled": self.prefix_cache,
+                "hit_tokens": self.hit_tokens,
+                "forked_pages": self.forked_pages,
+                "evicted_pages": self.evicted_pages,
+                "shared_acquires": self.shared_acquires,
+                "cached_pages": len(self._cached_free),
+                "shared_pages": sum(1 for r in self._refs.values()
+                                    if r > 1),
+            },
         }

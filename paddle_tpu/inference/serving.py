@@ -224,13 +224,92 @@ def build_decoder_program(cfg: DecoderConfig, mode: str) -> tuple:
     mode="prefill":   reference body + kv_cache_append of every prompt
       position's K/V at allocator-assigned slots.
     mode="decode":    single-token batched step over the paged cache.
+    mode="chunk":     a SLICE of one prompt at an offset: the chunk's
+      K/V enter the pool at allocator slots, and its attention runs
+      over the POOL-RESIDENT prefix (cached/previous-chunk pages
+      gathered through the sequence's block table) plus the chunk
+      itself — the program form prefix-cache-hit suffixes and chunked
+      prefill share.  The host-built mask carries both the causal
+      structure and the valid-context bound.
     """
-    if mode not in ("reference", "prefill", "decode"):
+    if mode not in ("reference", "prefill", "decode", "chunk"):
         raise ValueError(f"bad mode {mode!r}")
     H, D, h = cfg.num_heads, cfg.head_dim, cfg.hidden
     prog = Program()
     b = _B(prog)
     params = {n: b.param(n, s) for n, s in decoder_param_specs(cfg).items()}
+
+    if mode == "chunk":
+        # NOTE: this branch repeats the decoder body because its
+        # attention reads K/V through a pool gather — a shape the
+        # shared loop below can't express without growing a third
+        # conditional axis.  Any model change must land in both; drift
+        # is NOT silent: the chunked==monolithic token-identity tests
+        # (tests/test_prefix_cache.py) pin the two bodies together.
+        tokens = b.feed("tokens", (1, -1), VarType.INT32)
+        positions = b.feed("positions", (1, -1), VarType.INT32)
+        mask = b.feed("attn_mask", (1, 1, -1, -1), VarType.FP32)
+        last_index = b.feed("last_index", (1,), VarType.INT32)
+        slot_map = b.feed("slot_mapping", (-1,), VarType.INT32)
+        tables = b.feed("chunk_tables", (-1,), VarType.INT32)
+        feeds = ["tokens", "positions", "attn_mask", "last_index",
+                 "slot_mapping", "chunk_tables"]
+        x = b.lookup("dec_embed", tokens)
+        pos = b.lookup("dec_pos_embed", positions)
+        hid = b.add(x, pos, "h0")
+        for i in range(cfg.num_layers):
+            p = f"dec_l{i}_"
+            hn = b.layer_norm(hid, p + "ln1_scale", p + "ln1_bias", 2,
+                              f"l{i}_ln1")
+            q = b.matmul(hn, p + "wq", tag=f"l{i}_q")
+            k = b.matmul(hn, p + "wk", tag=f"l{i}_k")
+            v = b.matmul(hn, p + "wv", tag=f"l{i}_v")
+            # the chunk's K/V enter the pool FIRST, so the gather below
+            # sees prefix AND chunk through one block table
+            k3 = b.reshape(k, [-1, H, D], f"l{i}_k3")
+            v3 = b.reshape(v, [-1, H, D], f"l{i}_v3")
+            kc = b.param(f"kv_k_{i}", ())
+            vc = b.param(f"kv_v_{i}", ())
+            b.op("kv_cache_append",
+                 {"K": [k3], "V": [v3], "SlotMapping": [slot_map],
+                  "KCache": [kc], "VCache": [vc]},
+                 {"KCacheOut": [kc], "VCacheOut": [vc]})
+            q4 = b.transpose(b.reshape(q, [0, 0, H, D]), [0, 2, 1, 3],
+                             f"l{i}_q4")                 # (1, H, S, D)
+            kg = b.tmp(f"l{i}_kg")
+            b.op("gather", {"X": [kc], "Index": [tables]},
+                 {"Out": [kg]}, {"axis": 1})             # (H, W, ps, D)
+            k4 = b.reshape(kg, [1, H, -1, D], f"l{i}_k4")  # (1, H, C, D)
+            vg = b.tmp(f"l{i}_vg")
+            b.op("gather", {"X": [vc], "Index": [tables]},
+                 {"Out": [vg]}, {"axis": 1})
+            v4 = b.reshape(vg, [1, H, -1, D], f"l{i}_v4")
+            s = b.matmul(q4, k4, transpose_Y=True, alpha=D ** -0.5,
+                         tag=f"l{i}_qk")                 # (1, H, S, C)
+            s = b.add(s, mask, f"l{i}_masked")
+            sm = b.tmp(f"l{i}_probs")
+            b.op("softmax", {"X": [s]}, {"Out": [sm]}, {"axis": -1})
+            av = b.matmul(sm, v4, tag=f"l{i}_av")        # (1, H, S, D)
+            ctxv = b.reshape(b.transpose(av, [0, 2, 1, 3]), [0, 0, h],
+                             f"l{i}_ctx")
+            hid = b.add(hid, b.matmul(ctxv, p + "wo", tag=f"l{i}_o"),
+                        f"l{i}_res1")
+            hn2 = b.layer_norm(hid, p + "ln2_scale", p + "ln2_bias", 2,
+                               f"l{i}_ln2")
+            ff = b.matmul(b.gelu(b.matmul(hn2, p + "w1", tag=f"l{i}_ff1")),
+                          p + "w2", tag=f"l{i}_ff2")
+            hid = b.add(hid, ff, f"l{i}_res2")
+        h2d = b.reshape(hid, [-1, h], "hflat")
+        hid = b.tmp("hlast")
+        b.op("gather", {"X": [h2d], "Index": [last_index]},
+             {"Out": [hid]}, {"axis": 0})
+        hf = b.layer_norm(hid, "dec_lnf_scale", "dec_lnf_bias", 1, "lnf")
+        logits = b.matmul(hf, "dec_embed", transpose_Y=True, tag="logits")
+        out = b.blk.create_var(name="next_token", dtype=VarType.INT64).name
+        b.op("arg_max", {"X": [logits]}, {"Out": [out]},
+             {"axis": -1, "keepdims": False, "flatten": False})
+        prog._srv_params = params
+        return prog, feeds, [out]
 
     paged = mode == "decode"
     if paged:
@@ -390,6 +469,10 @@ class Request:
     # the request's span tree (utils/tracing.py Trace) when this
     # request was head-sampled under FLAGS_trace_requests, else None
     trace: Optional[object] = field(default=None, repr=False)
+    # prompt tokens served from cached prefix pages at the LAST
+    # prefill (0 with FLAGS_kv_prefix_cache off) — feeds the
+    # shared-page-aware preemption cost (admission.lost_work_cost)
+    _prefix_hit: int = field(default=0, repr=False)
 
 
 @dataclass(frozen=True)
@@ -498,18 +581,26 @@ def _trace_backpressure(req: Request, kind: str):
         tr._wait.attrs[kind] = tr._wait.attrs.get(kind, 0) + 1
 
 
-def _trace_admit(req: Request, now: float, wall0: float, wall1: float):
+def _trace_admit(req: Request, now: float, wall0: float, wall1: float,
+                 cached: int = 0, chunks: int = 0):
     """Successful prefill: close the open wait span (queue_wait, or the
     preempted span of a resume cycle) and record the prefill span with
-    its real wall bounds."""
+    its real wall bounds.  ``cached``/``chunks`` annotate prefix-cache
+    hits and chunked prefills — attrs appear ONLY when the features
+    engaged, so flag-off span streams stay byte-identical to r18."""
     tr = req.trace
     if tr is None:
         return
     tr.end(tr._wait, t=now)
     tr._wait = None
+    attrs = {"prompt_tokens": len(req.prompt),
+             "resume": req.preemptions}
+    if cached:
+        attrs["cached_tokens"] = cached
+    if chunks > 1:
+        attrs["chunks"] = chunks
     tr.add("prefill", t0=now, wall0=wall0, wall1=wall1, parent=tr._root,
-           attrs={"prompt_tokens": len(req.prompt),
-                  "resume": req.preemptions})
+           attrs=attrs)
 
 
 def _trace_decode(states: Sequence["_SeqState"], toks: Sequence[int],
@@ -552,7 +643,9 @@ def _trace_finish(req: Request, now: float):
             req.req_id,
             ttft_s=req._tm_gaps[0] if req._tm_gaps else float("nan"),
             decode_gaps=req._tm_gaps[1:],
-            trace_id=tr.trace_id if tr is not None else None)
+            trace_id=tr.trace_id if tr is not None else None,
+            prefix_hit_tokens=req._prefix_hit,
+            prompt_tokens=len(req.prompt))
 
 
 def _pow2_bucket(n: int, lo: int = 1, hi: Optional[int] = None) -> int:
@@ -578,6 +671,40 @@ def _causal_mask(s: int) -> np.ndarray:
 def _worst_case_pages(req: Request, kv_config: KVCacheConfig) -> int:
     total = len(req.prompt) + req.max_new_tokens
     return -(-total // kv_config.page_size)
+
+
+@dataclass
+class _PrefillJob:
+    """In-flight prefill of one request: ``pos`` tokens are already in
+    the pool (prefix-cache hit + completed chunks), ``first_token`` is
+    set when the final slice ran.  ``wall_s`` accumulates every
+    slice's wall time so the prefill span covers ALL chunks, not just
+    the completing one."""
+    req: Request
+    pos: int = 0
+    hit: int = 0
+    chunks: int = 0
+    first_token: Optional[int] = None
+    wall_s: float = 0.0
+
+
+_FORK_COPY = None
+
+
+def _fork_copy_fn():
+    """Jitted whole-page pool copy for CoW forks: ``pool[:, dst] =
+    pool[:, src]`` with the pool donated (in-place in HBM, the pool is
+    never duplicated).  Slots past the fork's valid count are garbage
+    the appends that triggered the fork (and the masks) never read."""
+    global _FORK_COPY
+    if _FORK_COPY is None:
+        import jax
+
+        def copy(pool, src, dst):
+            return pool.at[:, dst].set(pool[:, src])
+
+        _FORK_COPY = jax.jit(copy, donate_argnums=(0,))
+    return _FORK_COPY
 
 
 def _reject_unservable(req: Request, cfg: DecoderConfig,
@@ -621,7 +748,9 @@ class _EngineCore:
     def __init__(self, cfg: DecoderConfig, weights: Dict[str, np.ndarray],
                  num_pages: int = 64, page_size: int = 16,
                  place=None, use_mha_fusion: bool = True,
-                 prefill_bucket_min: int = 16):
+                 prefill_bucket_min: int = 16,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_seed: int = 0):
         self.cfg = cfg
         if place is None:
             import paddle_tpu as pt
@@ -635,7 +764,9 @@ class _EngineCore:
             num_pages=num_pages, page_size=page_size,
             num_kv_heads=cfg.num_heads, head_dim=cfg.head_dim,
             num_layers=cfg.num_layers)
-        self.kv = PagedKVCache(self.kv_config)
+        self.kv = PagedKVCache(self.kv_config, prefix_cache=prefix_cache,
+                               seed=prefix_seed)
+        self._chunk = None   # (prog, feeds, fetch) — built on first use
 
         self.ref_prog, self.ref_feeds, self.ref_fetch = \
             build_decoder_program(cfg, "reference")
@@ -685,29 +816,149 @@ class _EngineCore:
         return cls(cfg, weights, **kw)
 
     # -- model steps -------------------------------------------------------
+    @property
+    def chunk_prog_parts(self):
+        """The "chunk" program form (built lazily: the flag-off engine
+        never constructs it, keeping its host path identical)."""
+        if self._chunk is None:
+            self._chunk = build_decoder_program(self.cfg, "chunk")
+        return self._chunk
+
+    def _apply_forks(self):
+        """Replay pending CoW forks (kv_cache.take_forks) as device
+        page copies across every layer's K and V pool — MUST run before
+        the program whose appends triggered the forks."""
+        forks = self.kv.take_forks()
+        if not forks:
+            return
+        fn = _fork_copy_fn()
+        for src, dst, _used in forks:
+            s = np.int32(src)
+            d = np.int32(dst)
+            for i in range(self.cfg.num_layers):
+                for nm in (f"kv_k_{i}", f"kv_v_{i}"):
+                    self.scope.set(nm, fn(self.scope.get(nm), s, d))
+
+    def start_prefill(self, req: Request) -> _PrefillJob:
+        """Open a prefill job: with prefix caching on, map every
+        already-cached page of the prompt into the request's block
+        table (capped at prompt-1 tokens — the last position is always
+        computed, it produces the first output token)."""
+        job = _PrefillJob(req)
+        req._prefix_hit = 0
+        if self.kv.prefix_cache and len(req.prompt) > 1:
+            hit, pages = self.kv.match_prefix(req.prompt[:-1])
+            if hit:
+                self.kv.acquire_prefix(req.req_id, req.prompt[:hit], pages)
+                job.pos = job.hit = hit
+                req._prefix_hit = hit
+        return job
+
+    def advance_prefill(self, job: _PrefillJob,
+                        max_tokens: Optional[int] = None) -> Optional[bool]:
+        """Prefill up to ``max_tokens`` of the remaining prompt (all of
+        it when None).  Returns True when the prompt is fully prefilled
+        (``job.first_token`` set), False when chunks remain, None on
+        pool backpressure (no slice was appended this call)."""
+        req = job.req
+        L = len(req.prompt)
+        remaining = L - job.pos
+        n = remaining if max_tokens is None else \
+            min(int(max_tokens), remaining)
+        chunk = req.prompt[job.pos:job.pos + n]
+        slots = self.kv.append_tokens(req.req_id, n, tokens=chunk)
+        if slots is None:
+            return None
+        if job.chunks == 0:
+            # the FIRST slice that actually lands confirms the hit:
+            # counting here (not at acquire) keeps blocked-admission
+            # acquire/release retries out of the hit accounting
+            self.kv.commit_prefix_hit(req.req_id)
+        wall_t0 = time.perf_counter()
+        self._apply_forks()
+        final = job.pos + n == L
+        if job.pos == 0 and final:
+            # cold whole-prompt prefill: the classic (MHA-fused) path,
+            # bit-identical to the pre-chunking engine
+            S = _pow2_bucket(L, self.prefill_bucket_min, None)
+            toks = np.zeros((1, S), np.int32)
+            toks[0, :L] = req.prompt
+            pos = np.minimum(np.arange(S, dtype=np.int32),
+                             self.cfg.max_seq_len - 1)[None]
+            slot_map = np.full(S, self.kv_config.pad_slot, np.int32)
+            slot_map[:L] = slots
+            with RecordEvent("prefill", cat="serving"):
+                out = self.exe.run(
+                    self.prefill_prog,
+                    feed={"tokens": toks, "positions": pos,
+                          "attn_mask": _causal_mask(S),
+                          "slot_mapping": slot_map,
+                          "last_index": np.array([L - 1], np.int32)},
+                    fetch_list=self.prefill_fetch, scope=self.scope)
+            tok = int(out[0][0])
+        else:
+            tok = self._run_chunk(req, job.pos, chunk, slots)
+        job.wall_s += time.perf_counter() - wall_t0
+        job.pos += n
+        job.chunks += 1
+        if final:
+            job.first_token = tok
+            return True
+        return False
+
+    def _run_chunk(self, req: Request, pos: int, chunk, slots) -> int:
+        """One prompt slice at offset ``pos``: the slice's K/V enter
+        the pool, its attention runs over the pool-resident prefix plus
+        itself through the request's block table.  Bucketed in slice
+        length AND block-table width, so the jit cache stays bounded."""
+        prog, _feeds, fetch = self.chunk_prog_parts
+        n = len(chunk)
+        S = _pow2_bucket(n, self.prefill_bucket_min, None)
+        toks = np.zeros((1, S), np.int32)
+        toks[0, :n] = chunk
+        posf = np.minimum(pos + np.arange(S, dtype=np.int32),
+                          self.cfg.max_seq_len - 1)[None]
+        W = _pow2_bucket(self.kv.num_pages_of(req.req_id))
+        C = W * self.kv_config.page_size
+        tables = self.kv.block_table(req.req_id, W)
+        slot_map = np.full(S, self.kv_config.pad_slot, np.int32)
+        slot_map[:n] = slots
+        # causal + context-bound mask over the gathered pool window:
+        # slice position pos+i attends pool slots 0..pos+i (block-table
+        # order IS token order); everything else — tail garbage, padded
+        # table entries, padded slice rows — is masked
+        cols = np.arange(C, dtype=np.int64)[None, :]
+        rows = np.arange(S, dtype=np.int64)[:, None]
+        mask = np.where(cols <= pos + rows, 0.0, NEG_INF) \
+            .astype(np.float32)[None, None]
+        with RecordEvent("prefill_chunk", cat="serving"):
+            out = self.exe.run(
+                prog,
+                feed={"tokens": toks, "positions": posf,
+                      "attn_mask": mask, "slot_mapping": slot_map,
+                      "chunk_tables": tables,
+                      "last_index": np.array([n - 1], np.int32)},
+                fetch_list=fetch, scope=self.scope)
+        return int(out[0][0])
+
+    def abort_prefill(self, job: _PrefillJob):
+        """Release a job's pages (backpressure mid-prefill).  With
+        prefix caching on the completed slices stay warm in the index,
+        so the retry re-acquires them instead of recomputing."""
+        self.kv.free_sequence(job.req.req_id)
+
     def prefill(self, req: Request) -> Optional[int]:
         """Write the prompt's K/V into the pool and return the first
         generated token; None when the pool can't hold the prompt
-        (nothing is mutated — admission backpressure)."""
-        L = len(req.prompt)
-        slots = self.kv.append_tokens(req.req_id, L)
-        if slots is None:
+        (admission backpressure — with prefix caching off, nothing is
+        mutated; with it on, acquired prefix pages are released back to
+        the cache)."""
+        job = self.start_prefill(req)
+        if self.advance_prefill(job) is None:
+            if job.hit:
+                self.kv.free_sequence(req.req_id)
             return None
-        S = _pow2_bucket(L, self.prefill_bucket_min, None)
-        toks = np.zeros((1, S), np.int32)
-        toks[0, :L] = req.prompt
-        pos = np.minimum(np.arange(S, dtype=np.int32),
-                         self.cfg.max_seq_len - 1)[None]
-        slot_map = np.full(S, self.kv_config.pad_slot, np.int32)
-        slot_map[:L] = slots
-        with RecordEvent("prefill", cat="serving"):
-            out = self.exe.run(
-                self.prefill_prog,
-                feed={"tokens": toks, "positions": pos,
-                      "attn_mask": _causal_mask(S), "slot_mapping": slot_map,
-                      "last_index": np.array([L - 1], np.int32)},
-                fetch_list=self.prefill_fetch, scope=self.scope)
-        return int(out[0][0])
+        return job.first_token
 
     def decode_batch(self, states: Sequence[_SeqState]) -> List[int]:
         """One continuous decode step for ``states`` (each sequence's
@@ -725,10 +976,12 @@ class _EngineCore:
             toks[i] = st.last_token
             pos[i] = min(self.kv.context_len(st.req.req_id),
                          self.cfg.max_seq_len - 1)
-            slots = self.kv.append_tokens(st.req.req_id, 1)
+            slots = self.kv.append_tokens(st.req.req_id, 1,
+                                          tokens=[st.last_token])
             assert slots is not None, "caller must reserve pages"
             slot_map[i] = slots[0]
             ctx[i] = self.kv.context_len(st.req.req_id)
+        self._apply_forks()
         W = _pow2_bucket(max(
             (self.kv.num_pages_of(st.req.req_id) for st in states),
             default=1))
@@ -811,6 +1064,9 @@ class _EngineCore:
             "kv_pool_peak_token_bytes": int(
                 ps["peak_pages"] * self.kv_config.page_size * token_bytes),
             "kv_pool_peak_pages": int(ps["peak_pages"]),
+            # peak/in-use pages count DISTINCT pages: a CoW-shared page
+            # is one page of the (fixed) pool block the planner models
+            "prefix_cache": ps["prefix_cache"],
             "weight_bytes": int(weights),
             "measured": measured,
         }
@@ -833,7 +1089,8 @@ class ServingEngine:
                  weights: Optional[Dict[str, np.ndarray]] = None,
                  model_dir: Optional[str] = None,
                  max_batch: int = 8, token_budget: int = 256,
-                 seed: int = 0, admission_policy=None, **core_kw):
+                 seed: int = 0, admission_policy=None,
+                 prefill_chunk: Optional[int] = None, **core_kw):
         if model_dir is not None:
             self.core = _EngineCore.from_model_dir(model_dir, **core_kw)
         else:
@@ -846,11 +1103,18 @@ class ServingEngine:
         self.max_batch = max_batch
         self.token_budget = token_budget
         self.policy = get_policy(admission_policy)
+        if prefill_chunk is None:
+            from ..utils.flags import flag
+
+            prefill_chunk = int(flag("prefill_chunk_tokens", 0) or 0)
+        self.prefill_chunk = max(int(prefill_chunk), 0)
+        self._prefill_job: Optional[_PrefillJob] = None
         self.waiting: List[Request] = []
         self.running: List[_SeqState] = []   # admission order
         self.stats = {"admitted": 0, "finished": 0, "preempted": 0,
                       "shed": 0, "decode_steps": 0, "prefill_tokens": 0,
-                      "decode_tokens": 0}
+                      "decode_tokens": 0, "prefill_hit_tokens": 0,
+                      "prefill_chunks": 0, "max_prefill_step_tokens": 0}
         self._step_no = 0
         self._submit_seq = 0
 
@@ -858,10 +1122,12 @@ class ServingEngine:
     def submit(self, req: Request):
         try:
             _reject_unservable(req, self.cfg, self.core.kv_config)
-            if len(req.prompt) + 1 > self.token_budget:
+            if len(req.prompt) + 1 > self.token_budget \
+                    and not self.prefill_chunk:
                 # admission requires prompt+1 tokens inside the budget;
                 # a larger prompt would head-of-line block the FIFO
-                # forever
+                # forever — UNLESS chunked prefill is on, which serves
+                # it one budget-sized slice per step
                 raise RequestRejected(
                     f"request {req.req_id!r}: prompt of "
                     f"{len(req.prompt)} tokens can never fit "
@@ -876,7 +1142,8 @@ class ServingEngine:
         self.waiting.append(req)
 
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running
+                    or self._prefill_job is not None)
 
     def step(self, now: float = 0.0) -> List[StepEvent]:
         """One serving iteration: shed what the policy gives up on,
@@ -897,42 +1164,132 @@ class ServingEngine:
         # order (fifo: submit order — order() is a no-op) --------------
         self.policy.order(self, now)
         budget = self.token_budget - len(self.running)
-        while self.waiting and len(self.running) < self.max_batch:
+        prefilled_this_step = 0
+        # --- in-flight chunked prefill: one budget-sized slice per
+        # step, ahead of new admissions (it reached the head first);
+        # decode still runs below, so a long prompt never stalls it ----
+        if self._prefill_job is not None:
+            job = self._prefill_job
+            # the slice shrinks to this step's budget so progress is
+            # guaranteed whenever any budget exists (a slice larger
+            # than the budget would otherwise wait forever when
+            # prefill_chunk > token_budget)
+            n = min(self.prefill_chunk, len(job.req.prompt) - job.pos,
+                    budget)
+            if n > 0:
+                r = self.core.advance_prefill(job, n)
+                if r is None:
+                    # pool can no longer cover the slice: release the
+                    # pages (the prefix cache keeps finished slices
+                    # warm) and requeue at the head
+                    self.core.abort_prefill(job)
+                    self.waiting.insert(0, job.req)
+                    self._prefill_job = None
+                    _trace_backpressure(job.req, "prefill_backpressure")
+                else:
+                    # the completing slice also emits the first output
+                    # token — charge its +1 like the monolithic paths
+                    budget -= n + (1 if r else 0)
+                    prefilled_this_step += n
+                    self._count_prefill(n, job)
+                    if r:
+                        self._prefill_job = None
+                        self._admit_job(job, now, events)
+        while (self.waiting and len(self.running) < self.max_batch
+               and self._prefill_job is None):
             req = self.waiting[0]
             cost = len(req.prompt) + 1
-            if cost > budget:
-                break
-            if not self._admission_fits(req):
+            if not self.prefill_chunk and not self.kv.prefix_cache:
+                # the exact pre-feature (r18) admission path — pinned
+                # byte-identical when both flags are off
+                if cost > budget:
+                    break
+                if not self._admission_fits(req):
+                    _trace_backpressure(req, "admission_backpressure")
+                    break  # pool backpressure: retry next step
+                wall0 = time.perf_counter()
+                tok = self.core.prefill(req)
+                if tok is None:
+                    _trace_backpressure(req, "prefill_backpressure")
+                    break  # pool backpressure: retry next step
+                _trace_admit(req, now, wall0, time.perf_counter())
+                self.waiting.pop(0)
+                budget -= cost
+                prefilled_this_step += len(req.prompt)
+                req.admitted_at = now if req.admitted_at is None else \
+                    req.admitted_at
+                self.stats["admitted"] += 1
+                self.stats["prefill_tokens"] += len(req.prompt)
+                tm.counter("serving_admitted_total",
+                           "requests admitted (prefilled)").inc()
+                tm.counter("serving_prefill_tokens_total",
+                           "prompt tokens prefilled").inc(len(req.prompt))
+                if is_profiler_enabled():
+                    instant_event("admit", cat="serving",
+                                  args={"req": str(req.req_id),
+                                        "prompt": len(req.prompt)})
+                st = _SeqState(req, tok)
+                req.out_tokens.append(tok)
+                _observe_token(req, now)
+                if self.core._finished(req, tok):
+                    events.append(self._finish(st, tok, now))
+                else:
+                    events.append(StepEvent(req.req_id, tok, False, now))
+                    self.running.append(st)
+                continue
+            # feature path: prefix-cache hits shrink the admission cost
+            # to the COMPUTED suffix, and long suffixes go through the
+            # chunked path (one slice per step)
+            # gate with a READ-ONLY hit estimate first: acquiring and
+            # releasing prefix pages on every blocked step would churn
+            # the allocator (and re-hash the prompt) for nothing
+            est_hit = self.kv.match_prefix(req.prompt[:-1])[0] \
+                if self.kv.prefix_cache and len(req.prompt) > 1 else 0
+            if not self._admission_fits(req, len(req.prompt) - est_hit):
                 _trace_backpressure(req, "admission_backpressure")
-                break  # pool backpressure: retry next step
-            wall0 = time.perf_counter()
-            tok = self.core.prefill(req)
-            if tok is None:
-                _trace_backpressure(req, "prefill_backpressure")
-                break  # pool backpressure: retry next step
-            _trace_admit(req, now, wall0, time.perf_counter())
-            self.waiting.pop(0)
-            budget -= cost
-            req.admitted_at = now if req.admitted_at is None else \
-                req.admitted_at
-            self.stats["admitted"] += 1
-            self.stats["prefill_tokens"] += len(req.prompt)
-            tm.counter("serving_admitted_total",
-                       "requests admitted (prefilled)").inc()
-            tm.counter("serving_prefill_tokens_total",
-                       "prompt tokens prefilled").inc(len(req.prompt))
-            if is_profiler_enabled():
-                instant_event("admit", cat="serving",
-                              args={"req": str(req.req_id),
-                                    "prompt": len(req.prompt)})
-            st = _SeqState(req, tok)
-            req.out_tokens.append(tok)
-            _observe_token(req, now)
-            if self.core._finished(req, tok):
-                events.append(self._finish(st, tok, now))
+                break
+            job = self.core.start_prefill(req)
+            remaining = len(req.prompt) - job.pos
+            # chunk whenever the remainder exceeds the chunk budget OR
+            # can't fit this step's token budget whole — the second arm
+            # is what keeps a prompt with remaining in [budget,
+            # prefill_chunk] schedulable instead of head-of-line
+            # blocking forever (submit waived the budget reject)
+            if self.prefill_chunk and (remaining > self.prefill_chunk
+                                       or remaining + 1 > budget):
+                n = min(self.prefill_chunk, remaining, budget)
+                if n <= 0:
+                    self.core.abort_prefill(job)
+                    break  # wait for budget headroom
+                r = self.core.advance_prefill(job, n)
+                if r is None:
+                    self.core.abort_prefill(job)
+                    _trace_backpressure(req, "prefill_backpressure")
+                    break
+                self.waiting.pop(0)
+                budget -= n + (1 if r else 0)   # +1: first output token
+                prefilled_this_step += n
+                self._count_prefill(n, job)
+                if r:
+                    self._admit_job(job, now, events)
+                    continue
+                self._prefill_job = job
+                # one chunked prefill in flight at a time: admission
+                # resumes when it completes (loop condition above)
             else:
-                events.append(StepEvent(req.req_id, tok, False, now))
-                self.running.append(st)
+                if remaining + 1 > budget:
+                    self.core.abort_prefill(job)
+                    break
+                r = self.core.advance_prefill(job)
+                if r is None:
+                    self.core.abort_prefill(job)
+                    _trace_backpressure(req, "prefill_backpressure")
+                    break
+                self.waiting.pop(0)
+                budget -= remaining + 1
+                prefilled_this_step += remaining
+                self._count_prefill(remaining, job)
+                self._admit_job(job, now, events)
         # --- preemption: decoding adds one token per running seq --------
         while self.running and not self._can_grow_all():
             # fifo: index -1 (youngest); slo_aware: least lost work
@@ -976,23 +1333,71 @@ class ServingEngine:
                     events.append(StepEvent(st.req.req_id, tok, False, now))
                     still.append(st)
             self.running = still
+        self.stats["max_prefill_step_tokens"] = max(
+            self.stats["max_prefill_step_tokens"], prefilled_this_step)
         return events
+
+    def _count_prefill(self, n: int, job: _PrefillJob):
+        """Feature-path prefill accounting: ``prefill_tokens`` counts
+        tokens COMPUTED (cache hits excluded — the 2x-drop metric),
+        hits are counted once per job at its first slice."""
+        self.stats["prefill_tokens"] += n
+        self.stats["prefill_chunks"] += 1
+        if job.chunks == 1 and job.hit:
+            self.stats["prefill_hit_tokens"] += job.hit
+        tm.counter("serving_prefill_tokens_total",
+                   "prompt tokens prefilled").inc(n)
+
+    def _admit_job(self, job: _PrefillJob, now: float, events: list):
+        """Completed prefill job -> running sequence (the feature-path
+        twin of the inline r18 admission bookkeeping).  The prefill
+        span's wall bounds are synthesized from the job's accumulated
+        slice time, so a 5-chunk prefill reports 5 chunks' worth of
+        wall, not the last slice's."""
+        req, tok = job.req, job.first_token
+        wall1 = time.perf_counter()
+        _trace_admit(req, now, wall1 - job.wall_s, wall1,
+                     cached=job.hit, chunks=job.chunks)
+        req.admitted_at = now if req.admitted_at is None else \
+            req.admitted_at
+        self.stats["admitted"] += 1
+        tm.counter("serving_admitted_total",
+                   "requests admitted (prefilled)").inc()
+        if is_profiler_enabled():
+            instant_event("admit", cat="serving",
+                          args={"req": str(req.req_id),
+                                "prompt": len(req.prompt)})
+        st = _SeqState(req, tok)
+        req.out_tokens.append(tok)
+        _observe_token(req, now)
+        if self.core._finished(req, tok):
+            events.append(self._finish(st, tok, now))
+        else:
+            events.append(StepEvent(req.req_id, tok, False, now))
+            self.running.append(st)
 
     def _can_grow_all(self) -> bool:
         need = sum(self.kv.pages_needed(st.req.req_id, 1)
+                   + self.kv.cow_fork_need(st.req.req_id, 1)
                    for st in self.running)
         return need <= self.kv.num_free_pages
 
-    def _admission_fits(self, req: Request) -> bool:
+    def _admission_fits(self, req: Request,
+                        n_tokens: Optional[int] = None) -> bool:
         """Admit only when, AFTER the prompt's pages are taken, every
         running sequence plus the admission can still grow one token —
         otherwise this step's preemption loop would immediately evict
         the sequence we just paid a full prefill for (admit/preempt
-        churn repeating the prefill every step)."""
-        L = len(req.prompt)
+        churn repeating the prefill every step).  ``n_tokens`` narrows
+        the check to the COMPUTED suffix after a prefix-cache hit (the
+        request's sequence already maps the hit pages)."""
+        P = len(req.prompt)
+        L = P if n_tokens is None else n_tokens
         ps = self.core.kv_config.page_size
-        prompt_pages = self.kv.pages_needed(req.req_id, L)
+        prompt_pages = self.kv.pages_needed(req.req_id, L) \
+            + self.kv.cow_fork_need(req.req_id, L)
         growth = sum(self.kv.pages_needed(st.req.req_id, 1)
+                     + self.kv.cow_fork_need(st.req.req_id, 1)
                      for st in self.running)
         if req.max_new_tokens > 1:
             # the admission's own one-token headroom — but a request
@@ -1000,7 +1405,7 @@ class ServingEngine:
             # emits its only token) never decodes, so demanding growth
             # room for it would livelock a prompt that exactly fills
             # its page budget
-            growth += -(-(L + 1) // ps) - -(-L // ps)
+            growth += -(-(P + 1) // ps) - -(-P // ps)
         return prompt_pages + growth <= self.kv.num_free_pages
 
     def _shed(self, req: Request, now: float):

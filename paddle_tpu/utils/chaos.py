@@ -38,7 +38,12 @@ overload loadgen, tools/overload_bench.py):
                            requests itself)
     pool_spike=P@K:D       at serving step K, seize up to P KV-pool
                            pages for D steps (default 4) — admission
-                           backpressure + preemption pressure on demand
+                           backpressure + preemption pressure on
+                           demand.  Refcount-correct under CoW prefix
+                           caching: only refcount-0 pages are seized
+                           (a live shared prefix is never invalidated)
+                           and release decrements through the normal
+                           free path
 
 Example: ``FLAGS_chaos="seed=7;kill@12;rpc_drop=recv@3"``.
 
@@ -282,8 +287,19 @@ class FaultSchedule:
             got = 0
             for _ in range(pages):
                 # one full page per append; stop at pool exhaustion —
-                # a spike SQUEEZES the pool, it never deadlocks it
-                if kv.append_tokens(sid, self.page_size_of(engine)) is None:
+                # a spike SQUEEZES the pool, it never deadlocks it.
+                # With CoW prefix caching (r19) the seizure stays
+                # refcount-correct by construction: append_tokens only
+                # hands out refcount-0 pages (free, or cached entries
+                # through the seeded eviction order), NEVER a page a
+                # live sequence maps — a spike can evict a cold cached
+                # prefix but can't invalidate a live shared one — and
+                # tokens=None marks the spike sequence OPAQUE so its
+                # garbage pages are never indexed as cache content.
+                # The release below decrements refcounts through the
+                # same free_sequence path every sequence uses.
+                if kv.append_tokens(sid, self.page_size_of(engine),
+                                    tokens=None) is None:
                     break
                 got += 1
             if got:

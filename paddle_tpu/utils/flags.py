@@ -199,6 +199,28 @@ _DEFAULTS: Dict[str, Any] = {
     # instead of the youngest.  Deterministic for a seeded trace on a
     # deterministic clock (tools/overload_bench.py is the A/B oracle).
     "FLAGS_admission_policy": "fifo",
+    # copy-on-write KV prefix caching (inference/kv_cache.py): pages
+    # become refcounted and immutable-once-full, full (and partial-tail)
+    # prompt pages are indexed by a chained content hash, and a new
+    # request's prefill SKIPS every already-cached page of its prompt —
+    # the pages map into its block table at refcount+1; the first write
+    # into a shared partial page forks it (CoW), frees decrement
+    # refcounts and reclaim only at zero, and refcount-0 pages stay in
+    # the index as evictable cached pages (deterministic seeded
+    # eviction order) until fresh pages run out.  Off (default): the
+    # allocator runs the exact r12 FIFO handout — byte-identical
+    # (pinned by test).
+    "FLAGS_kv_prefix_cache": False,
+    # chunked prefill (inference/serving.py): when > 0, a prompt whose
+    # uncached suffix exceeds this many tokens prefills in chunks of at
+    # most this size, one chunk per engine step, through the normal
+    # per-step admission loop — decode admission never stalls behind a
+    # long prompt (the max prefill work in any step is bounded by this
+    # budget), and prompts larger than the token budget become
+    # servable.  Each chunk attends over the pool-resident prefix K/V
+    # (the "chunk" program form).  0 (default): monolithic prefill,
+    # byte-identical to r18 (pinned by test).
+    "FLAGS_prefill_chunk_tokens": 0,
     # modeled-HBM budget gate (framework/memory_plan.py): when > 0, the
     # executor / DP compile paths check the static liveness planner's
     # modeled peak against this many MB and WARN naming the peak op and

@@ -35,12 +35,27 @@ class TraceEntry:
 
 def poisson_trace(num_requests: int, rate: float, vocab_size: int,
                   prompt_len_range=(4, 32), max_new_range=(4, 32),
-                  seed: int = 0) -> List[TraceEntry]:
+                  seed: int = 0, prefix_len: int = 0,
+                  prefix_share: float = 0.0) -> List[TraceEntry]:
     """Seeded open-loop trace: exponential inter-arrivals at ``rate``
     req/s, uniform prompt lengths and output budgets.  The same seed
     yields the same trace for every engine under test (the A/B
-    contract)."""
+    contract).
+
+    ``prefix_len`` > 0 arms the SHARED-PREFIX workload (the dominant
+    real-traffic pattern: system prompts / few-shot headers): one
+    seeded common prefix of that many tokens is prepended to each
+    request's own suffix with probability ``prefix_share`` — the trace
+    the CoW prefix cache is measured on.  ``prefix_len=0`` (default)
+    reproduces the exact pre-r19 trace for every seed (the RNG draw
+    order is unchanged)."""
     rng = np.random.RandomState(seed)
+    prefix: List[int] = []
+    if prefix_len > 0:
+        # drawn from a DERIVED seed so arming the prefix knobs never
+        # perturbs the per-request draws below
+        prefix = np.random.RandomState(seed + 7919).randint(
+            0, vocab_size, size=prefix_len).astype(int).tolist()
     t = 0.0
     out = []
     for i in range(num_requests):
@@ -48,6 +63,8 @@ def poisson_trace(num_requests: int, rate: float, vocab_size: int,
         n = int(rng.randint(prompt_len_range[0], prompt_len_range[1] + 1))
         m = int(rng.randint(max_new_range[0], max_new_range[1] + 1))
         prompt = rng.randint(0, vocab_size, size=n).astype(int).tolist()
+        if prefix and rng.random_sample() < prefix_share:
+            prompt = prefix + prompt
         out.append(TraceEntry(i, t, prompt, m))
     return out
 

@@ -600,6 +600,8 @@ class SLOTracker:
         self._req_within = 0
         self._tok_total = 0
         self._tok_within = 0
+        self._prefix_hit_tokens = 0
+        self._prompt_tokens = 0
 
     # ------------------------------------------------------------------
     def configure(self, ttft_s=UNSET, token_s=UNSET, objective=UNSET,
@@ -632,6 +634,7 @@ class SLOTracker:
     def _zero_locked(self):
         self._req_total = self._req_within = 0
         self._tok_total = self._tok_within = 0
+        self._prefix_hit_tokens = self._prompt_tokens = 0
 
     @property
     def targets(self) -> SLOTargets:
@@ -640,10 +643,16 @@ class SLOTracker:
     # ------------------------------------------------------------------
     def observe_request(self, req_id, ttft_s: float,
                         decode_gaps: Sequence[float],
-                        trace_id: Optional[str] = None) -> bool:
+                        trace_id: Optional[str] = None,
+                        prefix_hit_tokens: int = 0,
+                        prompt_tokens: int = 0) -> bool:
         """One finished request.  ``ttft_s`` may be NaN (zero-token
         request) — it then fails an armed TTFT target (a request that
-        never produced its first token did not meet it)."""
+        never produced its first token did not meet it).
+        ``prefix_hit_tokens``/``prompt_tokens`` (r19) aggregate the
+        prefix-cache hit ratio the report/admission hint expose — a
+        high ratio means admission is cheap (prefills mostly skip), the
+        context a burn-rate-driven policy reads next to the burn."""
         t = self._targets
         has_first = ttft_s == ttft_s  # not NaN
         ok_ttft = t.ttft_s is None or (has_first and ttft_s <= t.ttft_s)
@@ -660,6 +669,8 @@ class SLOTracker:
             self._req_within += within
             self._tok_total += ntok
             self._tok_within += ntok_within
+            self._prefix_hit_tokens += int(prefix_hit_tokens)
+            self._prompt_tokens += int(prompt_tokens)
             self._window.append(within)
             burn = self._burn_locked()
         # registry mirrors (gated like every instrument; per-request
@@ -703,14 +714,25 @@ class SLOTracker:
                                   if self._tok_total else 1.0),
             }
 
+    def prefix_hit_ratio(self) -> float:
+        """Fraction of finished requests' prompt tokens served from
+        cached prefix pages (0.0 with the cache off or nothing
+        finished)."""
+        with self._lock:
+            return (self._prefix_hit_tokens / self._prompt_tokens
+                    if self._prompt_tokens else 0.0)
+
     def report(self) -> Dict:
         """The ``slo`` section serving_bench / slo_report emit."""
         g = self.goodput()
         with self._lock:
             window_n = len(self._window)
             burn = self._burn_locked()
+            hit = (self._prefix_hit_tokens / self._prompt_tokens
+                   if self._prompt_tokens else 0.0)
         return {"targets": self._targets.to_dict(), "goodput": g,
-                "burn_rate": round(burn, 6), "window_requests": window_n}
+                "burn_rate": round(burn, 6), "window_requests": window_n,
+                "prefix_hit_ratio": round(hit, 6)}
 
     def admission_hint(self) -> Dict:
         """THE read hook for SLO-aware admission: live burn rate +
@@ -722,6 +744,7 @@ class SLOTracker:
         return {"burn_rate": self.burn_rate(),
                 "request_goodput": g["request_goodput"],
                 "token_goodput": g["token_goodput"],
+                "prefix_hit_ratio": self.prefix_hit_ratio(),
                 "targets": self._targets.to_dict()}
 
 

@@ -327,8 +327,12 @@ def test_donated_state_is_never_a_host_alias():
 
     dev = CPUPlace().jax_device()
     seen_alias = False
+    keep = []   # hold every buffer: without this, malloc recycles ONE
+    # block across all iterations and the probe is a single alignment
+    # trial (flaky under heap-state drift from unrelated tests)
     for _ in range(40):
         a_np = np.zeros((4, 16, 8, 8), np.float32)
+        keep.append(a_np)
         plain = jax.device_put(a_np, dev)
         owned = device_put_owned(a_np, dev)
         try:

@@ -16,6 +16,14 @@ ndev-scaling checks the ZeRO ladder claims:
   stage >= 1: modeled opt-state bytes/dev ~ full/ndev
   stage >= 3: modeled param bytes/dev     ~ full/ndev
 
+Serving-side note (r19): the planner's ``kv_pool`` class models the
+paged K/V pools as FIXED blocks sized by the allocator's pool shape —
+CoW prefix sharing happens at page granularity INSIDE those blocks, so
+a page mapped by N sequences is modeled (and census'd) exactly once
+and the agreement tolerance here is unaffected by
+``FLAGS_kv_prefix_cache`` (tests/test_prefix_cache.py pins the
+shared-pages-counted-once reconciliation directly).
+
 Usage:
   python tools/mem_report.py [--probe mlp|resnet50] [--ndev 8]
         [--stage 0..3] [--ab] [--steps 2] [--budget-mb MB] [--json]

@@ -70,6 +70,11 @@ def build_args():
     ap.add_argument("--prompt-max", type=int, default=10)
     ap.add_argument("--new-min", type=int, default=4)
     ap.add_argument("--new-max", type=int, default=8)
+    ap.add_argument("--prefix-len", type=int, default=12,
+                    help="shared-prefix tokens for the SECOND A/B pass "
+                         "(run with the CoW prefix cache armed; 0 "
+                         "skips the pass)")
+    ap.add_argument("--prefix-share", type=float, default=0.8)
     ap.add_argument("--dt", type=float, default=0.05,
                     help="logical seconds per engine step")
     ap.add_argument("--slo-ttft", type=float, default=0.5,
@@ -91,9 +96,10 @@ def build_args():
     return ap
 
 
-def drive(policy: str, args, cfg, trace):
+def drive(policy: str, args, cfg, trace, prefix_cache: bool = False):
     """One policy's full run: fresh engine, fresh telemetry/tracing/
-    chaos state, deterministic logical clock."""
+    chaos state, deterministic logical clock.  ``prefix_cache`` arms
+    the CoW prefix cache (the shared-prefix A/B pass)."""
     import numpy as np
 
     from paddle_tpu.inference.serving import Request, ServingEngine
@@ -112,7 +118,8 @@ def drive(policy: str, args, cfg, trace):
                         page_size=args.page_size, max_batch=args.max_batch,
                         token_budget=args.token_budget,
                         prefill_bucket_min=4, seed=args.seed,
-                        admission_policy=policy)
+                        admission_policy=policy,
+                        prefix_cache=prefix_cache)
     pending = sorted(trace, key=lambda e: (e.arrival, e.req_id))
     burst_rng = np.random.RandomState(args.seed + 9173)
     reqs, rejected = {}, {}
@@ -196,6 +203,7 @@ def drive(policy: str, args, cfg, trace):
         "sheds_visible": bool(spans_ok and counters_ok),
         "preempted": eng.stats["preempted"],
         "scheduler": dict(eng.stats),
+        "prefix_cache": eng.kv.stats()["prefix_cache"],
     }
 
 
@@ -224,33 +232,60 @@ def main(argv=None) -> int:
         max_new_range=(args.new_min, args.new_max), seed=args.seed)
 
     policies = [p.strip() for p in args.policies.split(",") if p.strip()]
-    results = {}
-    for policy in policies:
-        results[policy] = drive(policy, args, cfg, trace)
-        if not args.json:
-            r = results[policy]
-            print(f"[{policy}] steps={r['steps']} "
-                  f"outcomes={r['outcomes']} "
-                  f"goodput={r['goodput']['requests_within_slo']}"
-                  f"/{r['goodput']['requests_total']} requests "
-                  f"({r['goodput']['request_goodput']:.3f}) "
-                  f"shed_rate={r['shed_rate']:.3f} "
-                  f"preempted={r['preempted']} "
-                  f"starvation_free={r['starvation_free']} "
-                  f"sheds_visible={r['sheds_visible']}")
 
-    comparison = {}
-    if "fifo" in results and "slo_aware" in results:
-        f, s = results["fifo"]["goodput"], results["slo_aware"]["goodput"]
-        comparison = {
-            "fifo_requests_within_slo": f["requests_within_slo"],
-            "slo_aware_requests_within_slo": s["requests_within_slo"],
-            "fifo_request_goodput": f["request_goodput"],
-            "slo_aware_request_goodput": s["request_goodput"],
-            "slo_aware_strictly_better": bool(
-                s["request_goodput"] > f["request_goodput"]
-                and s["requests_within_slo"] >= f["requests_within_slo"]),
-            "fifo_never_sheds": results["fifo"]["outcomes"]["shed"] == 0,
+    def run_ab(ab_trace, prefix_cache, tag):
+        results = {}
+        for policy in policies:
+            results[policy] = drive(policy, args, cfg, ab_trace,
+                                    prefix_cache=prefix_cache)
+            if not args.json:
+                r = results[policy]
+                print(f"[{tag}:{policy}] steps={r['steps']} "
+                      f"outcomes={r['outcomes']} "
+                      f"goodput={r['goodput']['requests_within_slo']}"
+                      f"/{r['goodput']['requests_total']} requests "
+                      f"({r['goodput']['request_goodput']:.3f}) "
+                      f"shed_rate={r['shed_rate']:.3f} "
+                      f"preempted={r['preempted']} "
+                      f"starvation_free={r['starvation_free']} "
+                      f"sheds_visible={r['sheds_visible']}")
+        comparison = {}
+        if "fifo" in results and "slo_aware" in results:
+            f = results["fifo"]["goodput"]
+            s = results["slo_aware"]["goodput"]
+            comparison = {
+                "fifo_requests_within_slo": f["requests_within_slo"],
+                "slo_aware_requests_within_slo": s["requests_within_slo"],
+                "fifo_request_goodput": f["request_goodput"],
+                "slo_aware_request_goodput": s["request_goodput"],
+                "slo_aware_strictly_better": bool(
+                    s["request_goodput"] > f["request_goodput"]
+                    and s["requests_within_slo"]
+                    >= f["requests_within_slo"]),
+                "fifo_never_sheds":
+                    results["fifo"]["outcomes"]["shed"] == 0,
+            }
+        return results, comparison
+
+    results, comparison = run_ab(trace, False, "plain")
+
+    # the r19 pass: the SAME policy A/B on the seeded SHARED-PREFIX
+    # trace with the CoW prefix cache armed — cheaper admission must
+    # not invert the policy ordering (slo_aware still strictly beats
+    # fifo), pinned by the quick gate
+    prefix_section = None
+    if args.prefix_len > 0:
+        ptrace = poisson_trace(
+            args.requests, args.rate, cfg.vocab_size,
+            prompt_len_range=(args.prompt_min, args.prompt_max),
+            max_new_range=(args.new_min, args.new_max), seed=args.seed,
+            prefix_len=args.prefix_len, prefix_share=args.prefix_share)
+        p_results, p_comparison = run_ab(ptrace, True, "prefix")
+        prefix_section = {
+            "prefix_len": args.prefix_len,
+            "prefix_share": args.prefix_share,
+            "policies": p_results,
+            "comparison": p_comparison,
         }
 
     payload = {
@@ -263,6 +298,7 @@ def main(argv=None) -> int:
         "chaos": args.chaos,
         "policies": results,
         "comparison": comparison,
+        **({"prefix_trace": prefix_section} if prefix_section else {}),
     }
     emit_json("OVERLOAD", payload)
 
@@ -271,9 +307,18 @@ def main(argv=None) -> int:
     if comparison:
         ok = ok and comparison["slo_aware_strictly_better"] \
             and comparison["fifo_never_sheds"]
+    if prefix_section:
+        ok = ok and all(
+            r["starvation_free"] and r["sheds_visible"]
+            for r in prefix_section["policies"].values())
+        if prefix_section["comparison"]:
+            ok = ok and prefix_section["comparison"][
+                "slo_aware_strictly_better"]
     if args.quick and not ok:
         print("FAIL: overload oracle did not hold "
-              f"(comparison={comparison})", file=sys.stderr)
+              f"(comparison={comparison}, prefix="
+              f"{prefix_section and prefix_section['comparison']})",
+              file=sys.stderr)
         return 1
     return 0
 

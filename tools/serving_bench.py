@@ -49,6 +49,18 @@ def build_args():
     ap.add_argument("--prompt-max", type=int, default=32)
     ap.add_argument("--new-min", type=int, default=4)
     ap.add_argument("--new-max", type=int, default=32)
+    ap.add_argument("--prefix-len", type=int, default=0,
+                    help="shared-prefix workload: common prompt prefix "
+                         "of this many tokens (0 = off); arms the "
+                         "prefix_cache report section (CoW prefix "
+                         "caching + chunked prefill A/B on the seeded "
+                         "shared-prefix trace)")
+    ap.add_argument("--prefix-share", type=float, default=0.8,
+                    help="fraction of requests carrying the shared "
+                         "prefix (seeded)")
+    ap.add_argument("--chunk-tokens", type=int, default=16,
+                    help="chunked-prefill budget for the prefix_cache "
+                         "section's decode-admission-gap A/B")
     ap.add_argument("--warmup", type=int, default=1,
                     help="unmeasured trace replays to populate the jit "
                          "cache before timing")
@@ -88,6 +100,129 @@ def make_engines(model_dir, args):
     return cont, static
 
 
+def _ttft_once(eng, prompt, rid, max_new=2):
+    """Wall-clock TTFT of one request driven alone on the engine."""
+    import time as _t
+
+    from paddle_tpu.inference.serving import Request
+
+    req = Request(rid, list(prompt), max_new, 0.0)
+    t0 = _t.perf_counter()
+    eng.submit(req)
+    first = None
+    while eng.has_work():
+        evs = eng.step(_t.perf_counter() - t0)
+        done = _t.perf_counter() - t0   # after the step's prefill ran
+        if first is None and any(ev.req_id == rid for ev in evs):
+            first = done
+    return first
+
+
+def prefix_cache_section(model_dir, cfg, args):
+    """The r19 A/B on the seeded shared-prefix trace: prefill tokens
+    computed cold vs with the CoW prefix cache, warm-vs-cold TTFT, and
+    the decode-admission gap with and without chunked prefill."""
+    import numpy as np
+
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.utils.loadgen import poisson_trace, replay_trace
+
+    core_kw = dict(num_pages=args.num_pages, page_size=args.page_size,
+                   prefill_bucket_min=8)
+    trace = poisson_trace(
+        args.requests, args.rate, cfg.vocab_size,
+        prompt_len_range=(args.prompt_min, args.prompt_max),
+        max_new_range=(args.new_min, args.new_max), seed=args.seed,
+        prefix_len=args.prefix_len, prefix_share=args.prefix_share)
+    total_prompt_tokens = sum(len(e.prompt) for e in trace)
+
+    # --- prefill-tokens-computed A/B (cold vs prefix cache) -----------
+    cold = ServingEngine(model_dir=model_dir, max_batch=args.max_batch,
+                         token_budget=args.token_budget, **core_kw)
+    replay_trace(cold, trace)
+    warm = ServingEngine(model_dir=model_dir, max_batch=args.max_batch,
+                         token_budget=args.token_budget,
+                         prefix_cache=True, **core_kw)
+    raw = replay_trace(warm, trace)
+    kvs = warm.kv.stats()["prefix_cache"]
+    computed = warm.stats["prefill_tokens"]
+    reduction = (cold.stats["prefill_tokens"] / computed
+                 if computed else float("inf"))
+
+    # token identity on the shared-prefix trace: every request's warm
+    # (possibly prefix-hit) output vs the one-at-a-time reference
+    identical = all(
+        raw["requests"][e.req_id].out_tokens
+        == warm.core.greedy_reference(e.prompt, e.max_new_tokens)
+        for e in trace)
+
+    # --- warm-vs-cold TTFT (compile paths pre-warmed on both sides) ---
+    rng = np.random.RandomState(args.seed + 131)
+    pfx = np.random.RandomState(args.seed + 7919).randint(
+        0, cfg.vocab_size, size=args.prefix_len).astype(int).tolist()
+    alt = [rng.randint(0, cfg.vocab_size, size=args.prefix_len)
+           .astype(int).tolist() for _ in range(4)]
+    sfx = [rng.randint(0, cfg.vocab_size, size=max(args.prompt_min, 4))
+           .astype(int).tolist() for _ in range(8)]
+    eng = ServingEngine(model_dir=model_dir, max_batch=args.max_batch,
+                        token_budget=args.token_budget,
+                        prefix_cache=True, **core_kw)
+    _ttft_once(eng, pfx + sfx[0], "w0")   # compiles prefill, seeds cache
+    _ttft_once(eng, pfx + sfx[1], "w1")   # compiles the chunk path
+    _ttft_once(eng, alt[0] + sfx[2], "c0")  # cold path at full length
+    warm_t = min(_ttft_once(eng, pfx + sfx[3 + i], f"wm{i}")
+                 for i in range(3))
+    cold_t = min(_ttft_once(eng, alt[1 + i] + sfx[5 + i], f"cd{i}")
+                 for i in range(3))
+
+    # --- decode-admission gap: long prompt amid running decodes -------
+    def gap(chunk):
+        e = ServingEngine(model_dir=model_dir, max_batch=args.max_batch,
+                          token_budget=max(args.token_budget,
+                                           args.prefix_len
+                                           + args.prompt_max + 1),
+                          prefill_chunk=chunk, **core_kw)
+        g = np.random.RandomState(args.seed + 5)
+        longp = g.randint(0, cfg.vocab_size,
+                          size=args.prefix_len + args.prompt_max) \
+            .astype(int).tolist()
+        for i in range(2):
+            e.submit(Request(i, g.randint(0, cfg.vocab_size, size=4)
+                             .astype(int).tolist(), 24))
+        e.step()
+        e.step()
+        e.stats["max_prefill_step_tokens"] = 0
+        e.submit(Request("long", longp, 4))
+        while e.has_work():
+            e.step()
+        return e.stats["max_prefill_step_tokens"]
+
+    gap_off, gap_on = gap(0), gap(args.chunk_tokens)
+
+    return {
+        "trace": {"prefix_len": args.prefix_len,
+                  "prefix_share": args.prefix_share,
+                  "requests": args.requests,
+                  "prompt_tokens": total_prompt_tokens},
+        "hit_tokens": int(warm.stats["prefill_hit_tokens"]),
+        "forked_pages": int(kvs["forked_pages"]),
+        "evicted_pages": int(kvs["evicted_pages"]),
+        "cached_pages": int(kvs["cached_pages"]),
+        "prefill_tokens_cold": int(cold.stats["prefill_tokens"]),
+        "prefill_tokens_computed": int(computed),
+        "prefill_reduction_x": round(reduction, 3),
+        "ttft_cold_s": round(cold_t, 6),
+        "ttft_warm_s": round(warm_t, 6),
+        "ttft_warm_below_cold": bool(warm_t < cold_t),
+        "token_identical": bool(identical),
+        "chunked": {"budget": args.chunk_tokens,
+                    "max_prefill_step_tokens_off": int(gap_off),
+                    "max_prefill_step_tokens_on": int(gap_on),
+                    "gap_bounded_by_budget": bool(
+                        gap_on <= args.chunk_tokens < gap_off)},
+    }
+
+
 def measure(eng, trace, warmup):
     """Replay unmeasured ``warmup`` times (populates the executor's jit
     cache for every bucket shape the trace hits — each replay drains
@@ -120,6 +255,8 @@ def main(argv=None):
         args.max_seq, args.num_pages, args.page_size = 128, 64, 8
         args.prompt_max, args.new_max = 12, 8
         args.warmup = max(args.warmup, 1)
+        if args.prefix_len == 0:
+            args.prefix_len = 24   # the quick shared-prefix oracle
 
     from paddle_tpu.inference.serving import DecoderConfig, export_decoder
     from paddle_tpu.utils.loadgen import emit_json, poisson_trace
@@ -201,6 +338,12 @@ def main(argv=None):
         }
         if identical is not None:
             payload["token_identical_vs_one_at_a_time"] = identical
+        if args.prefix_len > 0:
+            # the r19 section: CoW prefix caching + chunked prefill on
+            # the seeded shared-prefix trace (hit tokens, forked pages,
+            # cold-vs-warm TTFT, decode-admission gap A/B)
+            payload["prefix_cache"] = prefix_cache_section(
+                model_dir, cfg, args)
         if not args.json:
             print(json.dumps(payload, indent=2, sort_keys=True))
         emit_json("SERVING", payload)
@@ -208,6 +351,15 @@ def main(argv=None):
             print("FAIL: continuous batching diverged from one-at-a-time "
                   "decoding", file=sys.stderr)
             return 1
+        if args.quick and args.prefix_len > 0:
+            sec = payload["prefix_cache"]
+            if not (sec["hit_tokens"] > 0 and sec["token_identical"]
+                    and sec["chunked"]["gap_bounded_by_budget"]):
+                print("FAIL: prefix-cache oracle did not hold "
+                      f"(hit_tokens={sec['hit_tokens']}, "
+                      f"token_identical={sec['token_identical']}, "
+                      f"chunked={sec['chunked']})", file=sys.stderr)
+                return 1
     return 0
 
 

@@ -66,6 +66,14 @@ def build_args():
                     help="admission policy (fifo | slo_aware) — shed "
                          "outcomes only appear under slo_aware with an "
                          "armed TTFT target")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="arm the CoW KV prefix cache (r19); the "
+                         "cached/chunks columns light up")
+    ap.add_argument("--chunk-tokens", type=int, default=0,
+                    help="chunked-prefill budget (0 = monolithic)")
+    ap.add_argument("--prefix-len", type=int, default=0,
+                    help="shared-prefix tokens in the seeded trace")
+    ap.add_argument("--prefix-share", type=float, default=0.8)
     ap.add_argument("--slo-ttft-ms", type=float, default=200.0,
                     help="TTFT target in ms (0 = unset)")
     ap.add_argument("--slo-token-ms", type=float, default=100.0,
@@ -100,19 +108,27 @@ def trace_rows(traces):
         queue_s = sum((s.t1 or s.t0) - s.t0 for s in tr.spans
                       if s.name in ("queue_wait", "preempted")
                       and s.t1 is not None)
+        prefills = tr.spans_named("prefill")
         rows.append({
             "trace": tr.trace_id,
             "req": str(tr.req_id),
             "outcome": outcome,
             "queue_s": round(queue_s, 6),
             "prefill_ms": round(sum(
-                s.wall_duration() for s in tr.spans_named("prefill")) * 1e3,
-                3),
+                s.wall_duration() for s in prefills) * 1e3, 3),
             "decode_ms": round(sum(
                 s.wall_duration() for s in tr.spans_named("decode_step"))
                 * 1e3, 3),
             "decode_steps": len(tr.spans_named("decode_step")),
             "preempt_cycles": len(tr.spans_named("preempted")),
+            # r19 columns: prompt tokens the LAST prefill served from
+            # cached prefix pages, and how many chunks it ran in
+            # (attrs only exist when the features engaged — 0/1 means
+            # cold monolithic)
+            "cached_tokens": int(prefills[-1].attrs.get(
+                "cached_tokens", 0)) if prefills else 0,
+            "prefill_chunks": int(prefills[-1].attrs.get(
+                "chunks", 1)) if prefills else 0,
             "ttft_s": root.attrs.get("ttft_s"),
             "tokens": root.attrs.get("tokens"),
         })
@@ -178,11 +194,14 @@ def main(argv=None) -> int:
                         max_batch=args.max_batch,
                         token_budget=args.token_budget,
                         prefill_bucket_min=4, seed=args.seed,
-                        admission_policy=args.policy)
+                        admission_policy=args.policy,
+                        prefix_cache=args.prefix_cache or None,
+                        prefill_chunk=args.chunk_tokens)
     trace = poisson_trace(
         args.requests, args.rate, cfg.vocab_size,
         prompt_len_range=(args.prompt_min, args.prompt_max),
-        max_new_range=(args.new_min, args.new_max), seed=args.seed)
+        max_new_range=(args.new_min, args.new_max), seed=args.seed,
+        prefix_len=args.prefix_len, prefix_share=args.prefix_share)
 
     for _ in range(args.warmup):
         replay_trace(eng, trace)
@@ -226,13 +245,15 @@ def main(argv=None) -> int:
     if not args.json:
         print(f"{'req':>6} {'outcome':>9} {'queue_s':>9} "
               f"{'prefill_ms':>11} {'decode_ms':>10} {'steps':>6} "
-              f"{'preempt':>8} {'ttft_s':>9} {'tokens':>7}")
+              f"{'preempt':>8} {'cached':>7} {'chunks':>7} "
+              f"{'ttft_s':>9} {'tokens':>7}")
         for r in rows[:20]:
             ttft = ("-" if r["ttft_s"] is None
                     else f"{r['ttft_s']:.5f}")
             print(f"{r['req']:>6} {r['outcome']:>9} {r['queue_s']:>9.4f} "
                   f"{r['prefill_ms']:>11.3f} {r['decode_ms']:>10.3f} "
                   f"{r['decode_steps']:>6} {r['preempt_cycles']:>8} "
+                  f"{r['cached_tokens']:>7} {r['prefill_chunks']:>7} "
                   f"{ttft:>9} {r['tokens'] if r['tokens'] is not None else '-':>7}")
         if len(rows) > 20:
             print(f"... {len(rows) - 20} more")
