@@ -5,11 +5,23 @@ OptimizerWithMixedPrecision / :218 decorate — wraps an optimizer so
 minimize() rewrites the program to mixed precision and (for fp16) applies
 dynamic loss scaling (:333).  TPU-first: the default low dtype is bf16,
 whose exponent range equals fp32, so loss scaling defaults OFF; the
-dynamic-loss-scaling machinery (isfinite check + scale update) is
-implemented for fp16 parity.
+dynamic-loss-scaling machinery is the reference-shaped in-program state
+machine — loss scaled by a persistable ``loss_scaling`` var,
+``amp_check_finite_and_scale`` unscales the grads (zeroing them on a
+found-Inf step) and ``update_loss_scaling`` walks the scale/counter
+state (ops/extra_ops.py).
+
+Observability (r20): the found_inf flag and the live scale are
+persistable program state, so the numerics probe stream
+(framework/numerics.py, ``FLAGS_numerics_probe=1``) picks them up by op
+type and emits ``amp_found_inf_total`` / ``amp_loss_scale`` telemetry,
+annotates the current span on found-Inf steps, and feeds the
+HealthMonitor — a silent run of skipped updates is now a visible one.
 """
 from __future__ import annotations
 
+from ...backward import OP_ROLE_KEY, OpRole
+from ...framework import unique_name
 from ...framework.core import default_main_program
 from ...framework.dtype import VarType
 from ...layers import nn as nn_layers
@@ -27,19 +39,100 @@ class OptimizerWithMixedPrecision:
         self._amp_lists = amp_lists or AutoMixedPrecisionLists()
         self._loss_scaling = init_loss_scaling
         self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
         self._dest_dtype = dest_dtype
         self._scaled_loss = None
+        self._loss_scaling_var = None
+        self._found_inf_var = None
+        self._good_steps_var = None
+        self._bad_steps_var = None
 
     def get_loss_scaling(self):
+        """The python-side init value; under dynamic scaling the LIVE
+        scale is the persistable var (``get_loss_scaling_var``)."""
         return self._loss_scaling
+
+    def get_loss_scaling_var(self):
+        return self._loss_scaling_var
+
+    def get_found_inf_var(self):
+        return self._found_inf_var
 
     def get_scaled_loss(self):
         return self._scaled_loss
 
+    # ------------------------------------------------------------------
+    def _dynamic(self) -> bool:
+        return (self._dest_dtype == VarType.FP16
+                and self._use_dynamic_loss_scaling)
+
+    def _init_scaling_state(self):
+        if self._loss_scaling_var is not None:
+            return
+        self._loss_scaling_var = tensor_layers.create_global_var(
+            shape=[1], value=float(self._loss_scaling), dtype="float32",
+            persistable=True, name=unique_name.generate("loss_scaling"))
+        self._good_steps_var = tensor_layers.create_global_var(
+            shape=[1], value=0, dtype="int32", persistable=True,
+            name=unique_name.generate("loss_scaling_good_steps"))
+        self._bad_steps_var = tensor_layers.create_global_var(
+            shape=[1], value=0, dtype="int32", persistable=True,
+            name=unique_name.generate("loss_scaling_bad_steps"))
+
+    def _append_dynamic_unscale(self, block, params_grads):
+        """After backward: unscale every grad by the live 1/scale
+        (zeroing them all when any is non-finite) and step the
+        loss-scaling state machine — all in-program, so the executor,
+        checkpointing and the numerics probes see it as ordinary
+        persistable state."""
+        grads = [g.name for _, g in params_grads if g is not None]
+        if not grads:
+            return
+        scale = self._loss_scaling_var.name
+        inv = unique_name.generate("loss_scaling_inv")
+        block.create_var(name=inv, shape=[1], dtype=VarType.FP32)
+        block.append_op("reciprocal", inputs={"X": [scale]},
+                        outputs={"Out": [inv]},
+                        attrs={OP_ROLE_KEY: int(OpRole.Backward)})
+        found = unique_name.generate("found_infinite")
+        self._found_inf_var = block.create_var(
+            name=found, shape=[1], dtype=VarType.BOOL, persistable=True)
+        block.append_op(
+            "amp_check_finite_and_scale",
+            inputs={"X": list(grads), "Scale": [inv]},
+            outputs={"Out": list(grads), "FoundInfinite": [found]},
+            attrs={OP_ROLE_KEY: int(OpRole.Backward)})
+        good, bad = self._good_steps_var.name, self._bad_steps_var.name
+        block.append_op(
+            "update_loss_scaling",
+            inputs={"FoundInfinite": [found], "PrevLossScaling": [scale],
+                    "InGoodSteps": [good], "InBadSteps": [bad]},
+            outputs={"LossScalingOut": [scale], "OutGoodSteps": [good],
+                     "OutBadSteps": [bad]},
+            attrs={"incr_every_n_steps": int(self._incr_every_n_steps),
+                   "decr_every_n_nan_or_inf":
+                       int(self._decr_every_n_nan_or_inf),
+                   "incr_ratio": float(self._incr_ratio),
+                   "decr_ratio": float(self._decr_ratio),
+                   OP_ROLE_KEY: int(OpRole.Optimize)})
+
+    # ------------------------------------------------------------------
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
         program = loss.block.program
         rewrite_program(program, self._amp_lists, self._dest_dtype)
+        if self._dynamic():
+            self._init_scaling_state()
+            self._scaled_loss = nn_layers.elementwise_mul(
+                loss, self._loss_scaling_var)
+            params_grads = self._optimizer.backward(
+                self._scaled_loss, startup_program, parameter_list,
+                no_grad_set, callbacks)
+            self._append_dynamic_unscale(loss.block, params_grads)
+            return params_grads
         needs_scaling = (self._dest_dtype == VarType.FP16
                          and self._loss_scaling != 1.0)
         if needs_scaling:
@@ -80,7 +173,8 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
              incr_ratio=2.0, decr_ratio=0.8,
              use_dynamic_loss_scaling=True, use_fp16=False):
     """reference: decorator.py:218 decorate.  Default dtype is bf16 (no
-    loss scaling); pass use_fp16=True for reference-exact fp16 semantics."""
+    loss scaling); pass use_fp16=True for reference-exact fp16 semantics
+    including the dynamic loss-scaling state machine."""
     dest = VarType.FP16 if use_fp16 else VarType.BF16
     if dest == VarType.BF16:
         init_loss_scaling = 1.0

@@ -50,7 +50,7 @@ class _Compiled:
 
     __slots__ = ("fn", "raw_fn", "state_in", "state_out", "fetch_names",
                  "donatable", "readonly", "hybrid", "feed_plan", "session",
-                 "_memory_plan")
+                 "_memory_plan", "numerics")
 
     def __init__(self, fn, state_in, state_out, fetch_names):
         self.fn = fn
@@ -66,6 +66,7 @@ class _Compiled:
         self.feed_plan = None   # {feed name: numpy dtype to cast to|None}
         self.session = None     # _StateSession — device-resident state
         self._memory_plan = None  # framework.memory_plan.MemoryPlan
+        self.numerics = None    # probe layout (framework/numerics.py)
 
 
 class _StateSession:
@@ -434,6 +435,8 @@ class Executor:
                 for k, v in feed.items()
             )
         )
+        from .framework import numerics as _numerics
+        from .utils import chaos as _chaos
         from .utils.cost_model import calibration_version
 
         key = (program._uid, program._version, feed_spec, tuple(fetch_names),
@@ -449,7 +452,11 @@ class Executor:
                str(flag("dp_plan", "") or ""),
                # a new measured profile can move autotuned bucket
                # boundaries — stale compilations must not be reused
-               calibration_version())
+               calibration_version(),
+               # probe config + any armed chaos NaN injection: step K of
+               # a nan_inject schedule must trace the poisoned variant
+               # and step K+1 must fall back to the clean cached one
+               _numerics.probe_signature(), _chaos.nan_poison_target())
         from .utils import telemetry as tm
 
         hit = self._cache.get(key)
@@ -493,6 +500,12 @@ class Executor:
         if unused_check:
             _report_unused_vars(ops, fetch_names, state_out)
         fetch = list(fetch_names)
+        # numerics probe (FLAGS_numerics_probe): the pass left one
+        # packed stats vector — fetch it alongside the user's fetches;
+        # _execute strips it and routes it to numerics.on_step
+        n_layout = getattr(program, "_numerics_layout", None)
+        if n_layout:
+            fetch.append(_numerics.STATS_VAR)
         souts = list(state_out)
 
         if has_host_ops:
@@ -612,6 +625,7 @@ class Executor:
             compiled.hybrid = True
             compiled.feed_plan = feed_plan
             compiled._memory_plan = mem_plan
+            compiled.numerics = n_layout
             self._cache[key] = compiled
             tm.histogram(
                 "executor_compile_build_s",
@@ -670,6 +684,7 @@ class Executor:
         compiled.readonly = tuple(readonly)
         compiled.feed_plan = feed_plan
         compiled._memory_plan = mem_plan
+        compiled.numerics = n_layout
         self._cache[key] = compiled
         tm.histogram(
             "executor_compile_build_s",
@@ -688,15 +703,15 @@ class Executor:
         program, so the clone+rewrite happens once per compilation."""
         from .utils.flags import flag
 
-        if not flag("apply_ir_passes"):
-            return program
-        types = {o.type for b in program.blocks for o in b.ops}
         from .framework.ir import _FUSABLE_OPT, PassManager, get_pass
 
+        types = {o.type for b in program.blocks for o in b.ops}
         protected = tuple(fetch_names)
         passes = []
         sharding_stage = int(flag("dp_sharding") or 0)
         has_collectives = any(t.startswith("c_") for t in types)
+        if not flag("apply_ir_passes"):
+            types = set()  # skip the rewrite pipeline, not the probe
         if "batch_norm" in types:
             passes += [get_pass("fuse_bn_add_act_pass", protected=protected),
                        get_pass("fuse_bn_act_pass", protected=protected)]
@@ -742,6 +757,14 @@ class Executor:
                     sharding_stage=sharding_stage,
                     ndev=ring_axis_size(0),
                     autotune=auto and bool(flag("dp_comm_overlap"))))
+        from .framework import numerics as _numerics
+
+        if _numerics.probe_armed():
+            # LAST in the pipeline: probes read final values, so every
+            # rewrite (fusion, layout, bucketing) must already have
+            # happened — the probed var set is the compiled program's
+            passes.append(get_pass("numerics_probe_pass",
+                                   ops_regex=_numerics.probe_ops_regex()))
         if not passes:
             return program
         clone = Program.from_desc_dict(program.desc_dict())
@@ -860,12 +883,37 @@ class Executor:
             # plan + telemetry + trace to FLAGS_oom_debris_dir, then
             # propagates unchanged
             from .framework import memory_plan as mp
+            from .framework import numerics as nm
 
             if mp.is_resource_exhausted(e):
                 mp.record_oom_debris("executor_step", e,
                                      plan=compiled._memory_plan,
                                      program=program)
+            # NaN/Inf flight recorder: an armed FLAGS_check_nan_inf
+            # failure (eager or checkify path) dumps the failing op +
+            # stats ring to FLAGS_numerics_debris_dir, then propagates
+            # unchanged
+            nm.maybe_record_check_failure("executor_step", e,
+                                          program=program)
             raise
+        finally:
+            # a chaos nan_inject armed for THIS step is spent once the
+            # dispatch ran (or raised) — it must never leak into a
+            # later unrelated compile when no further on_step disarms
+            from .utils import chaos as _chaos_mod
+
+            if _chaos_mod.nan_poison_target() is not None:
+                _chaos_mod.consume_nan_poison()
+        if compiled.numerics:
+            # probe stream: strip the packed stats vector off the fetch
+            # tail and feed the three consumers (telemetry, the
+            # HealthMonitor, capture sinks).  np.asarray is the step's
+            # one forced device sync — armed-probe cost only.
+            from .framework import numerics as nm
+
+            nm.on_step(compiled.numerics, np.asarray(fetched[-1]),
+                       where="executor")
+            fetched = fetched[:-1]
         scope_set = scope.set
         for name, val in new_state.items():
             scope_set(name, val)

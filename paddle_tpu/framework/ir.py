@@ -2243,6 +2243,246 @@ class PrefetchAutotunePass(Pass):
         return program
 
 
+# --------------------------------------------------------------------------
+# numerics probe (r20) — the observability mirror of the fusion passes:
+# instead of rewriting compute, append cheap stat reductions over
+# selected op outputs so every step fetches ONE packed vector of per-var
+# health partials (framework/numerics.py finalizes and consumes them).
+# Existing registered ops only (cast/abs/square/reduce_max/reduce_sum/
+# isfinite_v2/size/stack + c_allreduce_{max,sum} for cross-shard
+# combines), so the pass adds no op-sweep surface.
+# --------------------------------------------------------------------------
+@register_pass("numerics_probe_pass")
+class NumericsProbePass(Pass):
+    """Append in-program tensor-stat probes (FLAGS_numerics_probe).
+
+    For every selected var (grad/param/update-role always, plus outputs
+    of ops matching ``ops_regex`` — see
+    ``numerics.select_probe_targets``) the pass emits five partial
+    reductions in f32 — absmax, sum, sum-of-squares, finite-count,
+    numel — and packs all of them into one ``@numerics_stats@`` vector
+    via a single ``stack`` op.  Probes read FINAL values (appended
+    after every producer), so their order is the program order of each
+    var's last writer — the first-divergence order
+    tools/bisect_divergence.py reports in.
+
+    On the shard_map DP path (the program carries ``c_*`` ops) each
+    partial of a *shard-variant* var — batch-sharded activation,
+    ZeRO-sharded optimizer state, reduce-scattered grad — is combined
+    across shards with ``c_allreduce_max`` / ``c_allreduce_sum`` (the
+    ``cross_shard_norms`` trick), so finalized stats are layout-,
+    ZeRO-stage- and DP-path-invariant; replicated values are combined
+    with nothing (a psum would multiply them by ndev).  Outside a mesh
+    the combines are identity, so the probed program still runs
+    anywhere.
+
+    Probe ops carry ``op_role=Optimize``: they consume ZeRO-3 params as
+    shard-or-gathered values like update ops do, keeping them out of
+    the prefetch planner's consumer windows (a forward-role read at the
+    block end would drag every gather window across the param's update
+    write — exactly what the verifier's window rule forbids)."""
+
+    ops_regex: str = ""
+
+    _COMBINE = {"absmax": "c_allreduce_max", "sum": "c_allreduce_sum",
+                "sumsq": "c_allreduce_sum", "nonfinite": "c_allreduce_sum",
+                "numel": "c_allreduce_sum"}
+
+    #: collective ops whose output is replicated across shards — they
+    #: CLEAR shard-variance in the taint walk
+    _CLEARS = frozenset({
+        "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+        "c_allreduce_prod", "allreduce", "c_fused_allreduce",
+        "c_allgather", "c_broadcast", "broadcast",
+    })
+    #: collective ops whose output is a per-device shard — they SET it
+    _SHARDS = frozenset({"c_fused_reduce_scatter", "c_reducescatter",
+                         "c_split", "alltoall"})
+
+    def apply_impl(self, program):
+        from . import numerics
+        from ..backward import OP_ROLE_KEY, OpRole
+
+        block = program.global_block()
+        if block.has_var(numerics.STATS_VAR):
+            program._numerics_layout = getattr(program,
+                                               "_numerics_layout", None)
+            return program  # already probed (pass is idempotent)
+        targets = numerics.select_probe_targets(program, block,
+                                                self.ops_regex)
+        self.report = {"targets": targets}
+        program._numerics_layout = None
+        if not targets:
+            return program
+        # the taint walk runs exactly when the DP runner would pick the
+        # shard_map path — same predicate, so the two can never drift
+        from ..parallel.data_parallel import _program_has_collectives
+
+        tainted = (self._shard_variant_names(block)
+                   if _program_has_collectives(program) else set())
+        self._attrs = {OP_ROLE_KEY: int(OpRole.Optimize),
+                       "op_namescope": "/numerics_probe/"}
+        scalars: List[str] = []
+        for i, t in enumerate(targets):
+            scalars.extend(self._emit(block, t, i,
+                                      combine=t["var"] in tainted))
+        block.create_var(name=numerics.STATS_VAR,
+                         shape=[len(scalars)], dtype=VarType.FP32)
+        block.append_op("stack", inputs={"X": scalars},
+                        outputs={"Y": [numerics.STATS_VAR]},
+                        attrs=dict(self._attrs, axis=0))
+        program._numerics_layout = targets
+        program._bump_version()
+        return program
+
+    # -- emission ----------------------------------------------------------
+    def _mk(self, block, name, shape, dtype):
+        if not block.has_var(name):
+            block.create_var(name=name, shape=list(shape), dtype=dtype)
+        return name
+
+    def _emit(self, block, t, idx, combine):
+        """Probe ops for one target; returns the 5 scalar names in
+        PARTIALS order (globally combined when ``combine``)."""
+        var = t["var"]
+        v = block._find_var_recursive(var)
+        shape = list(v.shape) if v.shape else [-1]
+        is_float = v.dtype in (VarType.FP16, VarType.BF16, VarType.FP32,
+                               VarType.FP64)
+        base = f"@nprobe@{idx}@"
+        A = self._attrs
+        f32 = self._mk(block, base + "f32", shape, VarType.FP32)
+        block.append_op("cast", inputs={"X": [var]}, outputs={"Out": [f32]},
+                        attrs=dict(A, out_dtype=int(VarType.FP32)))
+        absv = self._mk(block, base + "abs", shape, VarType.FP32)
+        block.append_op("abs", inputs={"X": [f32]},
+                        outputs={"Out": [absv]}, attrs=dict(A))
+        sq = self._mk(block, base + "sq", shape, VarType.FP32)
+        block.append_op("square", inputs={"X": [f32]},
+                        outputs={"Out": [sq]}, attrs=dict(A))
+        # NON-finite mask, counted directly: summing a mask of zeros is
+        # exact in f32 at ANY tensor size, where summing the finite
+        # mask's ones loses integer precision past 2^24 elements and a
+        # host-side `numel - finite` would report phantom nonfinites on
+        # large healthy tensors.  isfinite runs on the raw value for
+        # float vars (an f32 cast of f64 could overflow large-but-
+        # finite values to inf), on the f32 copy for bool/int vars
+        # (isfinite rejects bool inputs).
+        finb = self._mk(block, base + "finb", shape, VarType.BOOL)
+        block.append_op("isfinite_v2",
+                        inputs={"X": [var if is_float else f32]},
+                        outputs={"Out": [finb]}, attrs=dict(A))
+        nfb = self._mk(block, base + "nfb", shape, VarType.BOOL)
+        block.append_op("logical_not", inputs={"X": [finb]},
+                        outputs={"Out": [nfb]}, attrs=dict(A))
+        nff = self._mk(block, base + "nf", shape, VarType.FP32)
+        block.append_op("cast", inputs={"X": [nfb]},
+                        outputs={"Out": [nff]},
+                        attrs=dict(A, out_dtype=int(VarType.FP32)))
+        # numel via shape -> f32 -> reduce_prod (the `size` op would
+        # request an int64 the x64-disabled runtime warns about)
+        shp = self._mk(block, base + "shape", [len(shape)], VarType.INT32)
+        block.append_op("shape", inputs={"Input": [var]},
+                        outputs={"Out": [shp]}, attrs=dict(A))
+        shpf = self._mk(block, base + "shapef", [len(shape)], VarType.FP32)
+        block.append_op("cast", inputs={"X": [shp]},
+                        outputs={"Out": [shpf]},
+                        attrs=dict(A, out_dtype=int(VarType.FP32)))
+
+        red = dict(A, dim=[0], keep_dim=False, reduce_all=True)
+        out: List[str] = []
+        for part, src, rop in (
+                ("absmax", absv, "reduce_max"), ("sum", f32, "reduce_sum"),
+                ("sumsq", sq, "reduce_sum"),
+                ("nonfinite", nff, "reduce_sum")):
+            local = self._mk(block, base + part, [], VarType.FP32)
+            block.append_op(rop, inputs={"X": [src]},
+                            outputs={"Out": [local]}, attrs=dict(red))
+            out.append(local)
+        numel = self._mk(block, base + "numel", [], VarType.FP32)
+        block.append_op("reduce_prod", inputs={"X": [shpf]},
+                        outputs={"Out": [numel]}, attrs=dict(red))
+        out.append(numel)
+        if combine:
+            combined = []
+            for part, local in zip(("absmax", "sum", "sumsq", "nonfinite",
+                                    "numel"), out):
+                g = self._mk(block, base + part + "_g", [], VarType.FP32)
+                block.append_op(self._COMBINE[part], inputs={"X": [local]},
+                                outputs={"Out": [g]},
+                                attrs=dict(A, ring_id=0))
+                combined.append(g)
+            out = combined
+        return out
+
+    # -- shard-variance taint walk (shard_map path only) -------------------
+    def _shard_variant_names(self, block):
+        """Names whose runtime value differs per shard inside the
+        shard_map body: seeded by feed-like vars (read-before-write,
+        non-persistable), ZeRO-sharded optimizer state, and RNG-derived
+        outputs (the body folds the key per shard); propagated forward;
+        cleared by replicating collectives; set by scattering ones.
+        Wrapped shard updates (data_parallel._run_sharded_update)
+        gather ParamOut back to full width (or leave a ZeRO-3 param as
+        a shard every consumer auto-gathers), so the param output
+        clears while state-slot outputs stay shard-resident."""
+        from ..ops import registry as _registry
+        from ..utils.flags import flag
+
+        ops = list(block.ops)
+        stage = int(flag("dp_sharding") or 0)
+        try:
+            from ..parallel.mesh import ring_axis_size
+
+            ndev = int(ring_axis_size(0))
+        except Exception:
+            ndev = 1
+        plans = {}
+        sharded_state: set = set()
+        if stage >= 1 and ndev > 1:
+            from ..parallel.data_parallel import _plan_wrapped_updates
+
+            plans, sharded_state, _ = _plan_wrapped_updates(
+                ops, block, ndev, stage)
+
+        written: set = set()
+        feeds: set = set()
+        for op_ in ops:
+            for n in op_.input_arg_names:
+                if n in written or n == "@EMPTY@":
+                    continue
+                var = block._find_var_recursive(n)
+                if var is None or not getattr(var, "persistable", False):
+                    feeds.add(n)
+            written.update(op_.output_arg_names)
+
+        tainted = set(feeds) | set(sharded_state)
+        for op_ in ops:
+            outs = [n for n in op_.output_arg_names if n != "@EMPTY@"]
+            plan = plans.get(id(op_))
+            if plan is not None:
+                for n in outs:
+                    if n == plan["param"]:
+                        tainted.discard(n)
+                    else:
+                        tainted.add(n)
+                continue
+            if op_.type in self._CLEARS:
+                tainted.difference_update(outs)
+                continue
+            if op_.type in self._SHARDS:
+                tainted.update(outs)
+                continue
+            d = _registry.OPS.get(op_.type)
+            stateful = d is not None and d.stateful
+            if stateful or any(n in tainted
+                               for n in op_.input_arg_names):
+                tainted.update(outs)
+            else:
+                tainted.difference_update(outs)
+        return tainted
+
+
 @register_pass("fuse_optimizer_ops_pass")
 class FuseOptimizerOpsPass(Pass):
     def apply_impl(self, program):

@@ -367,7 +367,8 @@ tdm_sampler teacher_student_sigmoid_loss temporal_shift tensor_array_pop
 tensor_array_to_tensor thresholded_relu tile top_k top_k_v2 trace transpose
 transpose2 tree_conv tril_triu trilinear_interp truncated_gaussian_random
 unbind unfold uniform_random uniform_random_batch_size_like unique
-unique_with_counts unpool unsqueeze unsqueeze2 unstack var_conv_2d warpctc
+unique_with_counts unpool unsqueeze unsqueeze2 unstack
+update_loss_scaling var_conv_2d warpctc
 where where_index while_loop_grad write_to_array yolo_box yolov3_loss
 select_input select_output kv_cache_append
 allreduce alltoall barrier broadcast c_allreduce_max c_allreduce_min

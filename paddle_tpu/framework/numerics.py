@@ -1,0 +1,615 @@
+"""Numerics observability: in-program tensor-stats probes, a NaN/Inf
+flight recorder, and the health stream they feed.
+
+Every perf rung ahead of this layer (KV page quantization, quantized
+collectives, remat/offload) is a *numerics-risk* change, and until now
+the only runtime numerics tool was the fail-fast ``FLAGS_check_nan_inf``
+(executor.py), which names an op and dies.  This module is the
+continuous counterpart — the r13/r15/r17 observability arc applied to
+numbers instead of requests or memory:
+
+* **probe stream** — ``numerics_probe_pass`` (framework/ir.py) appends
+  cheap in-program stat reductions over selected op outputs
+  (grad/param/update-role vars always; ``FLAGS_numerics_probe_ops``
+  widens by op-type regex), packed into ONE extra fetched vector per
+  step.  Five partials per var — absmax / sum / sum-of-squares /
+  finite-count / numel — each with an associative cross-shard combine
+  (max or sum), so on the shard_map DP path a shard-resident or
+  batch-sharded value reduces its local shard and psums (the
+  ``cross_shard_norms`` trick), making the finalized stats
+  layout/ZeRO-stage/DP-path-invariant.  ``on_step`` finalizes partials
+  into {absmax, mean, rms, nonfinite, numel} per var.
+* **telemetry** — ``numerics_grad_norm`` / ``numerics_param_norm`` /
+  ``numerics_update_ratio`` gauges, ``numerics_nonfinite_total``
+  counter, plus the AMP instruments (``amp_found_inf_total``,
+  ``amp_loss_scale``) when the program carries dynamic-loss-scaling
+  ops.
+* **HealthMonitor** — a windowed loss-spike detector + nonfinite
+  tripwire with declared thresholds and a ``health()`` read hook shaped
+  like ``telemetry.slo_tracker()``'s.
+* **NaN/Inf flight recorder** — symmetric to the r15 OOM recorder: when
+  the armed ``FLAGS_check_nan_inf`` check (eager or checkify path)
+  raises, or the monitor trips, ``record_nan_debris`` dumps the failing
+  op, the last-K steps of the per-var stats ring buffer, loss history,
+  a telemetry snapshot and the chrome trace into
+  ``FLAGS_numerics_debris_dir``; the original exception (if any) keeps
+  propagating unchanged.
+
+``FLAGS_numerics_probe=0`` (default) is bit-identical to the unprobed
+pipeline: the pass never runs, no extra fetch exists, no instrument is
+touched (pinned by tests/test_numerics.py).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+import traceback
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core import Block, Program
+
+__all__ = [
+    "STATS_VAR", "PARTIALS", "probe_armed", "probe_ops_regex",
+    "probe_signature", "select_probe_targets", "finalize", "on_step",
+    "capture", "stream", "HealthMonitor", "health_monitor", "health",
+    "record_nan_debris", "is_nan_check_error", "maybe_record_check_failure",
+    "reset",
+]
+
+#: the single packed stats vector the probe pass produces and the
+#: executor / DP runner fetch (one extra fetch per step)
+STATS_VAR = "@numerics_stats@"
+
+#: per-var partial order inside the packed vector (5 scalars per
+#: target).  The nonfinite count is reduced DIRECTLY (sum of the
+#: not-isfinite mask): a healthy tensor's partial is a sum of zeros —
+#: exact in f32 at any size — where a finite-count/numel subtraction
+#: would report phantom nonfinites past 2^24 elements.
+PARTIALS = ("absmax", "sum", "sumsq", "nonfinite", "numel")
+
+#: finalized per-var stats on_step derives from the partials
+STATS = ("absmax", "mean", "rms", "nonfinite", "numel")
+
+#: float var dtypes eligible for probing (VarType ints resolved lazily)
+def _float_dtypes():
+    from .dtype import VarType
+
+    return (VarType.FP16, VarType.BF16, VarType.FP32, VarType.FP64)
+
+
+def probe_armed() -> bool:
+    """FLAGS_numerics_probe resolved at call time."""
+    from ..utils.flags import flag
+
+    return bool(flag("numerics_probe", False))
+
+
+def probe_ops_regex() -> str:
+    from ..utils.flags import flag
+
+    return str(flag("numerics_probe_ops", "") or "")
+
+
+def probe_signature():
+    """The probe config tuple compile caches key on: flipping the flag
+    (or the widening regex) must never serve a compile built under the
+    other regime."""
+    return (probe_armed(), probe_ops_regex())
+
+
+# ==========================================================================
+# probe target selection (shared by the IR pass and the tools)
+# ==========================================================================
+def select_probe_targets(program: Program, block: Block,
+                         ops_regex: str = "") -> List[dict]:
+    """Ordered probe targets for one program: ``[{var, kind, op_index,
+    op_type}, ...]`` in program order of each var's LAST writer (the
+    probes read final values, so program order is the bisector's
+    first-divergence order).
+
+    Kinds: ``grad`` / ``param`` / ``update`` (optimizer-state outputs)
+    are always selected; ``loss`` (the var the Backward|Loss seed
+    differentiates); ``amp_found`` / ``amp_scale`` (dynamic loss
+    scaling); ``op`` for outputs of any op whose type matches
+    ``ops_regex``.  Non-float vars, SelectedRows, sub-block-local names
+    and probe artifacts are skipped."""
+    from ..backward import OP_ROLE_KEY, OP_ROLE_VAR_KEY, OpRole
+    from ..parallel import partition_rules
+    from .dtype import VarType
+
+    floats = _float_dtypes()
+    rx = re.compile(ops_regex) if ops_regex else None
+    last_writer: Dict[str, int] = {}
+    for i, op_ in enumerate(block.ops):
+        for n in op_.output_arg_names:
+            if n != "@EMPTY@":
+                last_writer[n] = i
+
+    def var_ok(name, allow_bool=False):
+        if not name or name == "@EMPTY@" or name == STATS_VAR \
+                or name.startswith("@nprobe@"):
+            return False
+        v = block._find_var_recursive(name)
+        if v is None:
+            return False
+        if getattr(v, "type", None) == VarType.SELECTED_ROWS:
+            return False
+        if v.dtype in floats:
+            return True
+        return allow_bool and v.dtype in (VarType.BOOL, VarType.INT32,
+                                          VarType.INT64)
+
+    picked: Dict[str, str] = {}  # var -> kind (first pick wins by pass)
+
+    def pick(name, kind, allow_bool=False):
+        if name in picked or not var_ok(name, allow_bool):
+            return
+        picked[name] = kind
+
+    mask = int(OpRole.Backward)
+    for i, op_ in enumerate(block.ops):
+        role = int(op_.attrs.get(OP_ROLE_KEY, 0) or 0)
+        # AMP dynamic loss scaling: the found_inf flag and the live scale
+        if op_.type == "amp_check_finite_and_scale":
+            for n in op_.outputs.get("FoundInfinite", []):
+                pick(n, "amp_found", allow_bool=True)
+        if op_.type == "update_loss_scaling":
+            for n in op_.outputs.get("LossScalingOut", []):
+                pick(n, "amp_scale")
+        # loss var: the append_backward seed op (Backward|Loss role)
+        # writes `<loss>@GRAD`
+        if role == int(OpRole.Backward) | int(OpRole.Loss):
+            for n in op_.output_arg_names:
+                if n.endswith("@GRAD"):
+                    pick(n[: -len("@GRAD")], "loss")
+        # grads: op_role_var pairs [param, grad, ...] on backward ops
+        if role & mask:
+            rv = op_.attrs.get(OP_ROLE_VAR_KEY) or []
+            for j in range(1, len(rv), 2):
+                pick(rv[j], "grad")
+        # update ops — Param+Grad slots cover the per-parameter forms
+        # (partition_rules.is_update_op) AND the multi-slot fused ones
+        # (fused_sgd/fused_momentum/fused_adam) the optimizer-fusion
+        # pass emits before this pass runs: params, grads, and every
+        # non-param output (optimizer state) are probed
+        if (op_.inputs.get("Param") and op_.inputs.get("Grad")) \
+                or partition_rules.is_update_op(op_.type):
+            params = op_.inputs.get("Param", [])
+            for n in op_.inputs.get("Grad", []):
+                pick(n, "grad")
+            for n in params:
+                pick(n, "param")
+            for slot, names in op_.outputs.items():
+                for n in names:
+                    if n not in params:
+                        pick(n, "update")
+        if rx is not None and rx.search(op_.type) \
+                and op_.attrs.get("op_namescope") != "/numerics_probe/":
+            for n in op_.output_arg_names:
+                pick(n, "op")
+
+    targets = []
+    for name, kind in picked.items():
+        i = last_writer.get(name)
+        if i is None:
+            continue  # scope-only value: no in-program producer to blame
+        targets.append({"var": name, "kind": kind, "op_index": i,
+                        "op_type": block.ops[i].type})
+    targets.sort(key=lambda t: (t["op_index"], t["var"]))
+    return targets
+
+
+# ==========================================================================
+# stats stream: ring buffer + telemetry + capture sinks
+# ==========================================================================
+class NumericsStream:
+    """Process-wide probe stream state: a last-K-steps ring buffer of
+    per-var finalized stats, the loss history, and any live capture
+    sinks (the bisector records through one)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        from ..utils.flags import flag
+
+        k = max(int(flag("numerics_ring_steps", 8) or 8), 1)
+        self.ring: deque = deque(maxlen=k)
+        self.loss_history: deque = deque(maxlen=max(8 * k, 64))
+        self.step = 0
+        self.sinks: List[list] = []
+
+    def record(self, entry: dict):
+        with self._lock:
+            self.step += 1
+            entry = dict(entry, step=self.step)
+            self.ring.append(entry)
+            if entry.get("loss") is not None:
+                self.loss_history.append(
+                    {"step": self.step, "loss": entry["loss"]})
+            for s in self.sinks:
+                s.append(entry)
+        return entry
+
+    def ring_list(self) -> List[dict]:
+        with self._lock:
+            return list(self.ring)
+
+    def losses(self) -> List[dict]:
+        with self._lock:
+            return list(self.loss_history)
+
+
+_STREAM: Optional[NumericsStream] = None
+_STREAM_LOCK = threading.Lock()
+
+
+def stream() -> NumericsStream:
+    global _STREAM
+    if _STREAM is None:
+        with _STREAM_LOCK:
+            if _STREAM is None:
+                _STREAM = NumericsStream()
+    return _STREAM
+
+
+@contextmanager
+def capture():
+    """Collect every probed step recorded while the context is live —
+    the bisector's tap into the stream.  Yields the list the entries
+    append to (each: {step, where, loss, stats: {var: {...}},
+    order: [var, ...]})."""
+    sink: list = []
+    s = stream()
+    with s._lock:
+        s.sinks.append(sink)
+    try:
+        yield sink
+    finally:
+        with s._lock:
+            if sink in s.sinks:
+                s.sinks.remove(sink)
+
+
+def finalize(layout: Sequence[dict], vec) -> Dict[str, dict]:
+    """Partials -> finalized stats, ordered like ``layout``.  ``vec`` is
+    the fetched ``STATS_VAR`` vector (5 scalars per target)."""
+    vec = np.asarray(vec, dtype=np.float64).reshape(-1)
+    out: Dict[str, dict] = {}
+    for i, t in enumerate(layout):
+        absmax, s, sq, nf, numel = vec[5 * i: 5 * i + 5]
+        n = max(float(numel), 0.0)
+        mean = float(s / n) if n else 0.0
+        rms = float(math.sqrt(max(sq, 0.0) / n)) if n else 0.0
+        nonfinite = int(round(max(float(nf), 0.0)))
+        out[t["var"]] = {
+            "kind": t["kind"], "op_type": t["op_type"],
+            "op_index": t["op_index"], "absmax": float(absmax),
+            "mean": mean, "rms": rms, "nonfinite": nonfinite,
+            "numel": int(round(n)),
+        }
+    return out
+
+
+def on_step(layout: Sequence[dict], vec, where: str = "executor"):
+    """THE per-step consumer: finalize the fetched partials, feed the
+    three consumers (telemetry gauges/counters, the HealthMonitor, any
+    capture sinks).  Called by the executor step path and both DP paths
+    whenever the probe pass armed a compile."""
+    from ..utils import telemetry as tm
+
+    stats = finalize(layout, vec)
+    grad_sq = param_sq = 0.0
+    nonfinite_total = 0
+    loss = None
+    amp_found = None
+    amp_scale = None
+    for var, st in stats.items():
+        nonfinite_total += st["nonfinite"]
+        k = st["kind"]
+        if k == "grad":
+            grad_sq += st["rms"] ** 2 * st["numel"]
+        elif k == "param":
+            param_sq += st["rms"] ** 2 * st["numel"]
+        elif k == "loss" and loss is None:
+            loss = st["mean"]
+        elif k == "amp_found":
+            amp_found = st["absmax"] > 0.0
+        elif k == "amp_scale":
+            amp_scale = st["mean"]
+    grad_norm = math.sqrt(grad_sq)
+    param_norm = math.sqrt(param_sq)
+    tm.gauge("numerics_grad_norm",
+             "global gradient norm over probed grad-role vars "
+             "(sqrt of cross-var sum of squares)").set(grad_norm)
+    tm.gauge("numerics_param_norm",
+             "global parameter norm over probed param-role vars").set(
+                 param_norm)
+    if param_norm > 0.0:
+        tm.gauge("numerics_update_ratio",
+                 "grad-to-param norm ratio (the weight-relative update "
+                 "scale a healthy run keeps roughly constant)").set(
+                     grad_norm / param_norm)
+    if nonfinite_total:
+        tm.counter("numerics_nonfinite_total",
+                   "non-finite elements observed across all probed "
+                   "vars").inc(nonfinite_total)
+    if amp_found is not None:
+        if amp_found:
+            tm.counter("amp_found_inf_total",
+                       "AMP dynamic-loss-scaling steps whose gradients "
+                       "contained Inf/NaN (update skipped, scale "
+                       "backing off)").inc()
+            from ..utils import tracing
+
+            tracing.annotate("amp:found_inf",
+                             {"loss_scale": amp_scale, "where": where})
+    if amp_scale is not None:
+        tm.gauge("amp_loss_scale",
+                 "live AMP dynamic loss scale").set(amp_scale)
+    entry = stream().record({
+        "where": where, "loss": loss,
+        "grad_norm": grad_norm, "param_norm": param_norm,
+        "nonfinite": nonfinite_total,
+        "amp_found_inf": amp_found, "amp_loss_scale": amp_scale,
+        "stats": stats, "order": [t["var"] for t in layout],
+    })
+    health_monitor().observe_step(entry)
+    return entry
+
+
+# ==========================================================================
+# HealthMonitor: declared thresholds, health() read hook
+# ==========================================================================
+UNSET = object()
+
+
+class HealthMonitor:
+    """Windowed numerics health over the probe stream.
+
+    * **nonfinite tripwire** — any probed var with nonfinite elements
+      trips (the first such var in program order names the op);
+    * **loss-spike detector** — a finite loss more than ``spike_factor``
+      times the rolling window mean (after ``min_steps`` warmup) trips;
+    * **AMP found_inf** feeds the window context (never trips by
+      itself — backing the scale off is the designed response).
+
+    A trip dumps flight-recorder debris (once per trip kind until
+    ``reset``) and latches ``health()["healthy"] = False``.  The
+    ``health()`` hook is shaped like ``telemetry.slo_tracker()``'s
+    ``admission_hint()``: one dict, read per step by whoever closes a
+    loop on it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.configure()
+
+    def configure(self, spike_window=UNSET, spike_factor=UNSET,
+                  min_steps=UNSET) -> "HealthMonitor":
+        from ..utils.flags import flag
+
+        with self._lock:
+            self._spike_window = int(
+                flag("numerics_spike_window", 32) or 32) \
+                if spike_window is UNSET else int(spike_window)
+            self._spike_factor = float(
+                flag("numerics_spike_factor", 4.0) or 4.0) \
+                if spike_factor is UNSET else float(spike_factor)
+            self._min_steps = 8 if min_steps is UNSET else int(min_steps)
+            self._window: deque = deque(maxlen=max(self._spike_window, 1))
+            self._trips: List[dict] = []
+            self._dumped_kinds: set = set()
+            self._nonfinite_total = 0
+            self._last = {}
+        return self
+
+    def reset(self):
+        self.configure()
+
+    # ------------------------------------------------------------------
+    def observe_step(self, entry: dict):
+        from ..utils import telemetry as tm
+
+        trips: List[dict] = []
+        with self._lock:
+            self._last = entry
+            self._nonfinite_total += int(entry.get("nonfinite") or 0)
+            if entry.get("nonfinite"):
+                first = next(
+                    (dict(var=v, **{k: st[k] for k in
+                                    ("op_type", "op_index", "nonfinite")})
+                     for v, st in entry["stats"].items()
+                     if st["nonfinite"]), None)
+                trips.append({"kind": "nonfinite", "step": entry["step"],
+                              "detail": first})
+            loss = entry.get("loss")
+            if loss is not None and math.isfinite(loss):
+                if (len(self._window) >= self._min_steps
+                        and loss > self._spike_factor
+                        * (sum(self._window) / len(self._window))):
+                    trips.append({"kind": "loss_spike",
+                                  "step": entry["step"],
+                                  "detail": {"loss": loss,
+                                             "window_mean":
+                                                 sum(self._window)
+                                                 / len(self._window),
+                                             "factor": self._spike_factor}})
+                self._window.append(loss)
+            self._trips.extend(trips)
+            need_dump = [t for t in trips
+                         if t["kind"] not in self._dumped_kinds]
+            self._dumped_kinds.update(t["kind"] for t in need_dump)
+        for t in trips:
+            tm.counter("numerics_health_trips_total",
+                       "HealthMonitor trips by kind",
+                       labels=("kind",)).labels(kind=t["kind"]).inc()
+        for t in need_dump:
+            record_nan_debris(f"monitor_{t['kind']}", trip=t)
+        return trips
+
+    def observe_loss(self, loss: float, step: Optional[int] = None):
+        """Direct feed for training loops that fetch their own loss
+        (probe-off runs can still drive the spike detector)."""
+        return self.observe_step({"step": step or (stream().step + 1),
+                                  "loss": float(loss), "nonfinite": 0,
+                                  "stats": {}})
+
+    # ------------------------------------------------------------------
+    def health(self) -> Dict:
+        """THE read hook: live health + declared thresholds — the
+        numerics analog of ``slo_tracker().admission_hint()``."""
+        with self._lock:
+            last = self._last
+            return {
+                "healthy": not self._trips,
+                "trips": list(self._trips),
+                "nonfinite_total": self._nonfinite_total,
+                "last_step": last.get("step"),
+                "loss": last.get("loss"),
+                "grad_norm": last.get("grad_norm"),
+                "update_ratio": (
+                    (last.get("grad_norm") or 0.0)
+                    / last["param_norm"]
+                    if last.get("param_norm") else None),
+                "amp_loss_scale": last.get("amp_loss_scale"),
+                "thresholds": {"spike_window": self._spike_window,
+                               "spike_factor": self._spike_factor,
+                               "min_steps": self._min_steps},
+            }
+
+
+_MONITOR: Optional[HealthMonitor] = None
+_MONITOR_LOCK = threading.Lock()
+
+
+def health_monitor() -> HealthMonitor:
+    global _MONITOR
+    if _MONITOR is None:
+        with _MONITOR_LOCK:
+            if _MONITOR is None:
+                _MONITOR = HealthMonitor()
+    return _MONITOR
+
+
+def health() -> Dict:
+    return health_monitor().health()
+
+
+def reset():
+    """Fresh stream + monitor (tests / new measurement windows)."""
+    global _STREAM, _MONITOR
+    with _STREAM_LOCK:
+        _STREAM = None
+    with _MONITOR_LOCK:
+        _MONITOR = None
+
+
+# ==========================================================================
+# NaN/Inf flight recorder (symmetric to memory_plan.record_oom_debris)
+# ==========================================================================
+_debris_lock = threading.Lock()
+_debris_seq = 0
+
+#: substring both NaN-check paths emit (executor._eager_nan_check and
+#: the checkify message share the format string)
+_CHECK_MARKER = "contains Inf/Nan"
+_CHECK_OP_RE = re.compile(r"Operator '([^']+)' output '([^']+)'")
+
+
+def is_nan_check_error(exc: BaseException) -> bool:
+    """True when ``exc`` is the FLAGS_check_nan_inf sentinel (raised by
+    the eager per-op check or re-raised from the checkify path)."""
+    return _CHECK_MARKER in f"{exc}"
+
+
+def maybe_record_check_failure(where: str, exc: BaseException,
+                               program: Optional[Program] = None):
+    """Step-path hook: dump NaN debris when the armed check tripped,
+    then let the caller re-raise unchanged.  Never raises."""
+    try:
+        if is_nan_check_error(exc):
+            record_nan_debris(where, exc=exc, program=program)
+    except Exception:
+        pass
+
+
+def record_nan_debris(where: str, exc: Optional[BaseException] = None,
+                      trip: Optional[dict] = None,
+                      program: Optional[Program] = None) -> Optional[str]:
+    """Dump a post-mortem debris directory for a numerics failure: the
+    failing op (parsed from the check's error, or the monitor trip
+    detail), the last-K steps of the per-var stats ring buffer, the
+    loss history, a telemetry snapshot and the profiler's chrome trace.
+    Returns the directory path, or None when
+    ``FLAGS_numerics_debris_dir`` is unset.  Never raises — a caught
+    exception must keep propagating unchanged."""
+    from ..utils.flags import flag
+
+    root = flag("numerics_debris_dir") or ""
+    if not root:
+        return None
+    global _debris_seq
+    try:
+        with _debris_lock:
+            _debris_seq += 1
+            seq = _debris_seq
+        d = os.path.join(str(root), f"nan_{where}_{os.getpid()}_{seq}")
+        os.makedirs(d, exist_ok=True)
+        failing = None
+        if exc is not None:
+            m = _CHECK_OP_RE.search(f"{exc}")
+            if m:
+                failing = {"op_type": m.group(1), "var": m.group(2)}
+            with open(os.path.join(d, "error.txt"), "w") as f:
+                f.write(f"where: {where}\n")
+                f.write(f"type: {type(exc).__name__}\n")
+                f.write(f"error: {exc}\n\n")
+                f.write("".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__)))
+        if trip is not None and failing is None:
+            det = trip.get("detail") or {}
+            if det.get("var"):
+                failing = {"op_type": det.get("op_type"),
+                           "var": det.get("var")}
+        s = stream()
+        with open(os.path.join(d, "debris.json"), "w") as f:
+            json.dump({
+                "where": where, "failing_op": failing, "trip": trip,
+                "health": health_monitor().health(),
+                "stats_ring": s.ring_list(),
+                "loss_history": s.losses(),
+            }, f, indent=2, default=str)
+        try:
+            from ..utils import telemetry
+
+            with open(os.path.join(d, "telemetry.json"), "w") as f:
+                json.dump(telemetry.snapshot(), f, indent=2)
+        except Exception:
+            pass
+        try:
+            from .. import profiler
+
+            events = profiler.get_events()
+            if events:
+                profiler._write_chrome_trace(
+                    events, os.path.join(d, "trace.json"))
+        except Exception:
+            pass
+        if program is not None:
+            try:
+                counts: Dict[str, int] = {}
+                for blk in program.blocks:
+                    for op_ in blk.ops:
+                        counts[op_.type] = counts.get(op_.type, 0) + 1
+                with open(os.path.join(d, "program.json"), "w") as f:
+                    json.dump({"op_counts": counts}, f, indent=2)
+            except Exception:
+                pass
+        return d
+    except Exception:
+        return None

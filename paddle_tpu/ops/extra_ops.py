@@ -201,6 +201,40 @@ def _amp_check_finite_and_scale(ctx):
                         for x in xs])
 
 
+@op("update_loss_scaling", no_grad=True)
+def _update_loss_scaling(ctx):
+    """reference: amp/update_loss_scaling_op.cc — the dynamic
+    loss-scaling state machine: a found-Inf step zeroes the good-step
+    run and bumps the bad-step run (scale *= decr_ratio once bad hits
+    decr_every_n_nan_or_inf); a clean step bumps the good-step run
+    (scale *= incr_ratio once good hits incr_every_n_steps).  Counters
+    reset when their threshold fires; the scale never drops below a
+    tiny positive floor (an underflowed scale would silently zero every
+    gradient forever)."""
+    found = ctx.in_("FoundInfinite").reshape(()).astype(jnp.bool_)
+    scale = ctx.in_("PrevLossScaling").reshape(())
+    good = ctx.in_("InGoodSteps").reshape(())
+    bad = ctx.in_("InBadSteps").reshape(())
+    incr_n = int(ctx.attr("incr_every_n_steps", 1000))
+    decr_n = int(ctx.attr("decr_every_n_nan_or_inf", 2))
+    incr_ratio = float(ctx.attr("incr_ratio", 2.0))
+    decr_ratio = float(ctx.attr("decr_ratio", 0.5))
+    good1 = jnp.where(found, jnp.zeros_like(good), good + 1)
+    bad1 = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+    do_incr = good1 >= incr_n
+    do_decr = bad1 >= decr_n
+    new_scale = jnp.where(do_decr, scale * decr_ratio,
+                          jnp.where(do_incr, scale * incr_ratio, scale))
+    new_scale = jnp.maximum(new_scale, jnp.asarray(1e-10, scale.dtype))
+    ctx.set_out("LossScalingOut", new_scale.reshape((1,)))
+    ctx.set_out("OutGoodSteps",
+                jnp.where(do_incr, jnp.zeros_like(good1),
+                          good1).reshape((1,)))
+    ctx.set_out("OutBadSteps",
+                jnp.where(do_decr, jnp.zeros_like(bad1),
+                          bad1).reshape((1,)))
+
+
 # ==========================================================================
 # sequence / vision
 # ==========================================================================

@@ -30,6 +30,7 @@ import numpy as np
 
 from ..framework.core import GRAD_SUFFIX, EMPTY_VAR_NAME, Operator, Block
 from ..framework.dtype import VarType, to_numpy_dtype, convert_dtype
+from ..utils import chaos as _chaos
 
 _SENTINEL_DIM = 97  # stands in for -1 (dynamic batch) during eval_shape
 
@@ -422,7 +423,37 @@ def run_op(op: Operator, env: Dict[str, Any], block=None):
             d.lower(ctx)
     except Exception as e:
         _raise_with_callstack(op, e)
+    if _chaos.nan_poison_target() is not None:
+        # chaos nan_inject=NAME@K: this step's trace poisons the named
+        # op's float outputs (utils/chaos.py; one module-global None
+        # check per op when chaos is off)
+        _nan_poison_outputs(op, env)
     return ctx
+
+
+def _nan_poison_outputs(op: Operator, env: Dict[str, Any]):
+    """Overwrite the op's float outputs with NaN when the armed chaos
+    target names this op (by type — every instance — or by one of its
+    output var names).  Probe ops are never poisoned: the measurement
+    must observe the fault, not be it."""
+    tgt = _chaos.nan_poison_target()
+    if tgt is None:
+        return
+    if op.type != tgt and tgt not in op.output_arg_names:
+        return
+    if op.attrs.get("op_namescope") == "/numerics_probe/":
+        return
+    for name in op.output_arg_names:
+        if name == EMPTY_VAR_NAME:
+            continue
+        v = env.get(name)
+        if v is None:
+            continue
+        try:
+            if jnp.issubdtype(jnp.result_type(v), jnp.inexact):
+                env[name] = v * float("nan")
+        except Exception:
+            continue
 
 
 def _raise_with_callstack(op: Operator, e: Exception):

@@ -402,6 +402,9 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
             int(mesh.shape[dp_axis]), _program_has_collectives(program),
             scope=scope)
 
+    from ..framework import numerics as _numerics
+    from ..utils import chaos as _chaos
+
     key = (program._uid, program._version, feed_spec, tuple(fetch_names),
            _mesh_fingerprint(mesh), shard_sig, executor._nhwc_enabled(),
            executor._tpu_fuse_enabled(),
@@ -414,6 +417,11 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
            bool(flag("while_static_scan")),
            _calibration_version(),
            str(flag("dp_plan", "") or ""),
+           # probe config + armed chaos NaN injection (see the
+           # executor compile key for the step-K recompile contract)
+           _numerics.probe_signature(), _chaos.nan_poison_target(),
+           # the resolved plan stays LAST: introspection (tests,
+           # dp_comm_stats --plan) reads key[-1] as the plan tuple
            plan.as_tuple() if plan is not None else None)
     cache = compiled_program.__dict__.setdefault("_dp_cache", {})
     if key in cache:
@@ -478,6 +486,16 @@ def _compile_dp_miss(compiled_program, executor, program, feed,
         # same final-program lint as the single-device compile path
         verifier.lint_or_raise(program, feed, fetch_names,
                                "data_parallel_compile")
+
+    # numerics probe (FLAGS_numerics_probe): the shared IR pipeline left
+    # one packed stats vector — fetch it on this path too, so the probe
+    # stream covers pjit AND shard_map runs (run_data_parallel strips
+    # it and feeds numerics.on_step)
+    from ..framework import numerics as _numerics
+
+    n_layout = getattr(program, "_numerics_layout", None)
+    if n_layout:
+        fetch_names = list(fetch_names) + [_numerics.STATS_VAR]
 
     block, state_in, state_out, uses_rng = _analyze(program, set(feed), scope)
     use_shard_map = _program_has_collectives(program)
@@ -727,7 +745,7 @@ def _compile_dp_miss(compiled_program, executor, program, feed,
     feed_plan = build_feed_plan(block, feed)
 
     entry = (jitted, state_in, state_out, use_shard_map, state_sharding,
-             axis, feed_plan)
+             axis, feed_plan, n_layout)
     cache[key] = entry
     return entry
 
@@ -753,8 +771,8 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
         compiled.__dict__["_mesh"] = mesh
 
     jitted, state_in, state_out, use_shard_map, state_sharding, axis, \
-        feed_plan = _compile_dp(compiled, executor, program, feed,
-                                fetch_names, scope, mesh)
+        feed_plan, n_layout = _compile_dp(compiled, executor, program, feed,
+                                          fetch_names, scope, mesh)
 
     batch_sharding = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
@@ -794,6 +812,7 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
         fetched, new_state = jitted(state_vals, feed_vals)
     except Exception as e:
         from ..framework import memory_plan as _mp
+        from ..framework import numerics as _nm
 
         if _mp.is_resource_exhausted(e):
             # OOM flight recorder (FLAGS_oom_debris_dir): dump the plan
@@ -802,7 +821,29 @@ def run_data_parallel(compiled, executor, feed, fetch_list, scope, return_numpy)
                 "data_parallel_step", e,
                 plan=compiled.__dict__.get("_memory_plan"),
                 program=program)
+        # NaN/Inf flight recorder (FLAGS_numerics_debris_dir): an armed
+        # check failure dumps the failing op + stats ring, then re-raise
+        _nm.maybe_record_check_failure("data_parallel_step", e,
+                                       program=program)
         raise
+    finally:
+        # step-scoped chaos nan_inject: spent once this dispatch ran
+        # (see Executor._execute)
+        from ..utils import chaos as _chaos_mod
+
+        if _chaos_mod.nan_poison_target() is not None:
+            _chaos_mod.consume_nan_poison()
+    if n_layout:
+        # probe stream: the stats vector rides the fetch tail.  Its
+        # partials are cross-shard-combined in-program, so on the
+        # shard_map path every stacked row is identical — row 0 is THE
+        # value; the pjit fetch is already global.
+        from ..framework import numerics as _nm
+
+        sv = np.asarray(fetched[-1])
+        _nm.on_step(n_layout, sv[0] if use_shard_map else sv,
+                    where="data_parallel")
+        fetched = fetched[:-1]
 
     # keep the call handle + ABSTRACT args (shape/dtype/sharding, not
     # the live buffers — those would pin a stale full copy of model

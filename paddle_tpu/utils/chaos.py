@@ -24,6 +24,18 @@ Schedule grammar — ``;``-separated events, all optional::
     trunc_ckpt@N           after the Nth checkpoint save completes,
                            truncate one of its data files in half
                            (seeded choice) — load must reject it
+    nan_inject=NAME@K      at the start of step K (on_step), arm a NaN
+                           poison on the op NAME names — an op TYPE
+                           (every instance) or an output VAR name.  The
+                           poisoned op's float outputs become NaN for
+                           that one step (the executor/DP compile caches
+                           key on the armed target, so step K traces a
+                           poisoned variant and step K+1 falls back to
+                           the clean cached compile) — the end-to-end
+                           numerics oracle: the FLAGS_check_nan_inf
+                           sentinel names the op, the NaN/Inf flight
+                           recorder dumps debris, and
+                           tools/bisect_divergence.py localizes to it
 
 Serving faults (r18 — hooked into the ServingEngine step loop and the
 overload loadgen, tools/overload_bench.py):
@@ -97,6 +109,7 @@ class FaultSchedule:
         self.delay_ms = 0.0
         self.delay_p = 0.0
         self.trunc_ckpts: set = set()      # 1-based save indices to truncate
+        self.nan_at = {}                   # step -> op type / out-var name
         # serving faults (r18)
         self.decode_delay_ms = 0.0
         self.decode_delay_p = 0.0
@@ -111,6 +124,7 @@ class FaultSchedule:
         self._lock = threading.Lock()
         self._parse(spec)
         self._rng = random.Random(self.seed)
+        _set_nan_poison(None)  # a fresh schedule starts disarmed
 
     # ------------------------------------------------------------------
     def _parse(self, spec: str):
@@ -145,6 +159,14 @@ class FaultSchedule:
                 self.delay_p = float(p or 1.0)
             elif key == "trunc_ckpt":
                 self.trunc_ckpts.add(int(val))
+            elif key == "nan_inject":
+                name, _, at = val.partition("@")
+                name = name.strip()
+                if not name or not at:
+                    raise ValueError(
+                        f"FLAGS_chaos: nan_inject needs OP@STEP, "
+                        f"got {item!r}")
+                self.nan_at[int(at)] = name
             elif key == "decode_delay":
                 try:
                     if "@" in val:
@@ -195,7 +217,12 @@ class FaultSchedule:
 
     # -- hooks ---------------------------------------------------------
     def on_step(self, step: int):
-        """Training-loop hook: kill the rank at the scheduled step."""
+        """Training-loop hook: arm/disarm the NaN poison for this step
+        and kill the rank at the scheduled step."""
+        tgt = self.nan_at.get(step)
+        _set_nan_poison(tgt)
+        if tgt is not None:
+            self._mark("nan_inject", "step", step, tgt)
         if self.kill_step is None or step != self.kill_step:
             return
         if self.kill_mode == "raise":
@@ -369,6 +396,33 @@ class FaultSchedule:
             return self._rpc_n
 
 
+#: the armed NaN-poison target (nan_inject=NAME@K, set for the duration
+#: of step K by on_step).  A bare module global read on the op-dispatch
+#: path (ops/registry.py run_op) — one None check when chaos is off.
+_NAN_POISON: Optional[str] = None
+
+
+def _set_nan_poison(target: Optional[str]):
+    global _NAN_POISON
+    _NAN_POISON = target
+
+
+def nan_poison_target() -> Optional[str]:
+    """The op type / output var the current step must poison with NaN,
+    or None.  Consumed by ops/registry.run_op and the compile cache
+    keys (executor / DP) so the poisoned trace is never reused."""
+    return _NAN_POISON
+
+
+def consume_nan_poison():
+    """Disarm after the dispatch that ran under the armed target — the
+    executor / DP step paths call this when their run completes (or
+    raises), so a poison armed at the FINAL step of a loop can never
+    leak into an unrelated later compile in the same process (the next
+    ``on_step`` call is not guaranteed to exist)."""
+    _set_nan_poison(None)
+
+
 _cached: Optional[FaultSchedule] = None
 _cached_spec: Optional[str] = None
 _cache_lock = threading.Lock()
@@ -396,6 +450,7 @@ def reset():
     with _cache_lock:
         _cached = None
         _cached_spec = None
+    _set_nan_poison(None)
 
 
 # thin call-site wrappers: one None check when chaos is off -------------

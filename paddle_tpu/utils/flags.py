@@ -230,6 +230,35 @@ _DEFAULTS: Dict[str, Any] = {
     # and training is bit-identical either way (pinned by test).
     "FLAGS_hbm_budget_mb": 0.0,
     "FLAGS_hbm_budget_strict": False,
+    # numerics observability (framework/numerics.py + framework/ir.py
+    # numerics_probe_pass): when on, every compile appends cheap
+    # in-program stat reductions (absmax/mean/rms/nonfinite-count) over
+    # grad/param/update-role vars — one extra fetched vector per step —
+    # feeding the numerics_* telemetry gauges, the HealthMonitor
+    # (numerics.health()) and the stats ring the NaN/Inf flight
+    # recorder dumps.  0 (default) is bit-identical to the unprobed
+    # pipeline: no pass, no extra fetch, no instrument (pinned by
+    # test).
+    "FLAGS_numerics_probe": False,
+    # regex over op TYPES widening the probe beyond role-selected vars:
+    # every output of a matching op is probed too (the bisector's
+    # per-op stream; e.g. ".*" probes everything on a tiny program)
+    "FLAGS_numerics_probe_ops": "",
+    # last-K-steps per-var stats ring buffer depth (the flight
+    # recorder's post-mortem window)
+    "FLAGS_numerics_ring_steps": 8,
+    # HealthMonitor loss-spike detector: a finite loss more than
+    # spike_factor x the rolling window mean (after 8 warmup steps)
+    # trips the monitor
+    "FLAGS_numerics_spike_window": 32,
+    "FLAGS_numerics_spike_factor": 4.0,
+    # NaN/Inf flight recorder (framework/numerics.py record_nan_debris,
+    # symmetric to FLAGS_oom_debris_dir): when set, an armed
+    # FLAGS_check_nan_inf failure or a HealthMonitor trip dumps the
+    # failing op, the stats ring, loss history, telemetry snapshot and
+    # chrome trace into a fresh subdirectory here; exceptions propagate
+    # unchanged either way.  Empty (default) disables the dump.
+    "FLAGS_numerics_debris_dir": "",
     # OOM flight recorder (framework/memory_plan.py record_oom_debris):
     # when set, a RESOURCE_EXHAUSTED caught in the executor step/compile
     # paths dumps the memory plan + telemetry snapshot + profiler trace

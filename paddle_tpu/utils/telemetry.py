@@ -162,7 +162,7 @@ class Gauge(_Child):
 
 class Histogram(_Child):
     __slots__ = ("_edges", "_counts", "_sum", "_count", "_min", "_max",
-                 "_exemplars")
+                 "_exemplars", "_nonfinite")
 
     def __init__(self, lock, labels, edges=_DEFAULT_BUCKETS):
         super().__init__(lock, labels)
@@ -172,12 +172,22 @@ class Histogram(_Child):
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        # non-finite observations land HERE, never in the buckets:
+        # bisect_right(edges, nan) files NaN into an arbitrary bucket
+        # and one NaN makes _sum/_min/_max NaN forever, silently
+        # poisoning every later quantile bracket.  (SLOTracker
+        # legitimately feeds NaN TTFTs for zero-token requests.)
+        self._nonfinite = 0
         # bucket index -> last exemplar (a trace id): the histogram ->
         # trace link, one string per bucket — bounded by construction
         self._exemplars: Dict[int, str] = {}
 
     def observe(self, value: float, exemplar: Optional[str] = None):
         v = float(value)
+        if not math.isfinite(v):
+            with self._lock:
+                self._nonfinite += 1
+            return
         i = bisect_right(self._edges, v)
         with self._lock:
             self._counts[i] += 1
@@ -201,6 +211,11 @@ class Histogram(_Child):
     @property
     def sum(self) -> float:
         return self._sum
+
+    @property
+    def nonfinite(self) -> int:
+        """Observations excluded from buckets/sum for being NaN/Inf."""
+        return self._nonfinite
 
     def _bucket_of_rank(self, k: int) -> int:
         """Index of the bucket holding the k-th (0-based) observation."""
@@ -346,6 +361,10 @@ class _Family:
     def sum(self):
         return self._only().sum
 
+    @property
+    def nonfinite(self):
+        return self._only().nonfinite
+
     def quantile(self, q: float):
         return self._only().quantile(q)
 
@@ -453,6 +472,10 @@ class Registry:
                         "max": (child._max if child._count else None),
                         "buckets": buckets,
                     })
+                    if child._nonfinite:
+                        # only when observed: a zero field on every row
+                        # would churn existing snapshot consumers
+                        row["nonfinite"] = child._nonfinite
                     # copy under the child lock: a concurrent observe
                     # may INSERT a bucket key (the other lockless reads
                     # here are fixed-size lists/scalars)
@@ -494,6 +517,9 @@ class Registry:
                     suffix = f"{{{lab}}}" if lab else ""
                     lines.append(f"{name}_sum{suffix} {child._sum}")
                     lines.append(f"{name}_count{suffix} {child._count}")
+                    if child._nonfinite:
+                        lines.append(f"{name}_nonfinite{suffix} "
+                                     f"{child._nonfinite}")
                 else:
                     suffix = f"{{{lab}}}" if lab else ""
                     lines.append(f"{name}{suffix} {child.get()}")

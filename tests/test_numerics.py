@@ -1,0 +1,533 @@
+"""Numerics observability (r20): in-program tensor-stat probes, the
+NaN/Inf flight recorder, the first-divergence bisector, chaos
+nan_inject, and AMP dynamic-loss-scaling instrumentation.
+
+Oracles:
+* FLAGS_numerics_probe is observation-only: training losses/params and
+  serving token streams are bit-identical with the probe on vs off, and
+  the default-off pipeline emits no probe ops, no extra fetch and no
+  numerics_* telemetry;
+* probe stats are CORRECT: finalized absmax/mean/rms/nonfinite agree
+  with a numpy recompute on a known program, for role-selected vars and
+  regex-widened op outputs;
+* probe stats are ZeRO-stage- and DP-path-invariant: stages 0-3 on the
+  pjit path and the shard_map/fleet-collective path agree (grad/param/
+  update stats within fp-reduction tolerance of the single-compile
+  stage-0 reference);
+* the flight recorder dumps debris naming the failing op when the armed
+  check trips or the HealthMonitor sees nonfinite stats — and dumps
+  NOTHING on clean runs or when the dir is unset;
+* chaos ``nan_inject=op@K`` is seeded, parse-validated, counted, and
+  localized end-to-end by tools/bisect_divergence.py (subprocess
+  --quick), which also exits 0 on identical configs;
+* numerics_probe_pass is verifier-clean (FLAGS_verify_passes armed for
+  the whole suite brackets every application);
+* AMP dynamic loss scaling (fp16): the in-program state machine walks
+  the scale up/down, and the probe stream emits amp_found_inf_total /
+  amp_loss_scale and feeds the HealthMonitor.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import numerics, unique_name
+from paddle_tpu.framework.ir import get_pass
+from paddle_tpu.framework.scope import Scope
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.utils import chaos
+from paddle_tpu.utils import flags as _flags
+from paddle_tpu.utils import telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+from dp_comm_stats import build_mlp_dp_program  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_numerics():
+    saved = dict(_flags._flags)
+    numerics.reset()
+    chaos.reset()
+    yield
+    _flags._flags.clear()
+    _flags._flags.update(saved)
+    chaos.reset()
+    numerics.reset()
+    mesh_mod.registry().clear()
+
+
+def _mlp(layers=2, width=8, seed=7, transpile=False, optimizer="sgd"):
+    with unique_name.guard():
+        return build_mlp_dp_program(n_layers=layers, width=width,
+                                    seed=seed, transpile=transpile,
+                                    optimizer=optimizer)
+
+
+def _data(width=8, n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, width).astype(np.float32)
+    return xs, (xs[:, :1] * 2 + 1).astype(np.float32)
+
+
+def _train(main, startup, loss, steps=3, width=8, probe=0, scope=None,
+           on_step=None):
+    _flags.set_flags({"numerics_probe": probe})
+    scope = scope or Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    xs, ys = _data(width)
+    losses = []
+    for s in range(1, steps + 1):
+        if on_step:
+            on_step(s)
+        out = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                      scope=scope)
+        losses.append(np.asarray(out[0]))
+    return losses, scope
+
+
+# ==========================================================================
+# off-default bit-identity + probe-on observation-only
+# ==========================================================================
+def test_probe_off_emits_nothing():
+    """Default-off: no probe pass output, no extra fetch var, no
+    numerics_* telemetry families."""
+    main, startup, loss = _mlp()
+    exe = pt.Executor(pt.CPUPlace())
+    rewritten = exe._apply_ir_passes(main, [loss.name])
+    assert not rewritten.global_block().has_var(numerics.STATS_VAR)
+    assert getattr(rewritten, "_numerics_layout", None) is None
+    telemetry.registry().clear()
+    _train(main, startup, loss, probe=0)
+    snap = telemetry.snapshot()
+    assert not [k for k in snap if k.startswith("numerics_")]
+
+
+def test_probe_is_observation_only_training_bit_identity():
+    """The probe changes NOTHING it observes: losses and final params
+    are bit-identical with the probe on vs off."""
+    main, startup, loss = _mlp()
+
+    def run(probe):
+        losses, scope = _train(main, startup, loss, probe=probe)
+        params = {k: np.asarray(v) for k, v in scope.items()
+                  if not k.startswith("@")}
+        return losses, params
+
+    on_l, on_p = run(1)
+    off_l, off_p = run(0)
+    for a, b in zip(on_l, off_l):
+        np.testing.assert_array_equal(a, b)
+    assert sorted(on_p) == sorted(off_p)
+    for k in off_p:
+        np.testing.assert_array_equal(on_p[k], off_p[k])
+
+
+def test_probe_serving_token_bit_identity():
+    """Serving token streams are identical probe-on vs probe-off (the
+    engine's decode path shares the process the flag flips in)."""
+    from paddle_tpu.inference.serving import (DecoderConfig, Request,
+                                              ServingEngine)
+
+    cfg = DecoderConfig(vocab_size=32, hidden=16, num_heads=2,
+                        num_layers=1, max_seq_len=64)
+
+    def run(probe):
+        _flags.set_flags({"numerics_probe": probe})
+        eng = ServingEngine(cfg, num_pages=16, page_size=4, max_batch=4,
+                            token_budget=32, prefill_bucket_min=4)
+        return eng.generate([[1 + i, 2, 3] for i in range(3)],
+                            max_new_tokens=4)
+
+    a, b = run(1), run(0)
+    assert len(a) == 3
+    for ta, tb in zip(a, b):
+        assert list(ta) == list(tb)
+
+
+# ==========================================================================
+# probe-stats correctness vs numpy
+# ==========================================================================
+def test_probe_stats_match_numpy():
+    """Finalized stats == numpy recompute: params/grads from the scope
+    and a regex-probed activation from an explicit fetch."""
+    main, startup, loss = _mlp()
+    _flags.set_flags({"numerics_probe": 1, "numerics_probe_ops": "relu"})
+    scope = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    xs, ys = _data()
+    relu_var = next(op.outputs["Out"][0]
+                    for op in main.global_block().ops if op.type == "relu")
+    with numerics.capture() as cap:
+        fetched = exe.run(main, feed={"x": xs, "y": ys},
+                          fetch_list=[loss.name, relu_var], scope=scope)
+    stats = cap[-1]["stats"]
+
+    def expect(v):
+        v = np.asarray(v, np.float64)
+        return {"absmax": np.max(np.abs(v)), "mean": np.mean(v),
+                "rms": np.sqrt(np.mean(v * v)),
+                "nonfinite": int(v.size - np.isfinite(v).sum()),
+                "numel": v.size}
+
+    # loss + the regex-widened relu activation, from the SAME run's
+    # fetches (post-update params can't check these)
+    checks = {next(v for v, s in stats.items() if s["kind"] == "loss"):
+              expect(fetched[0]), relu_var: expect(fetched[1])}
+    # params: the scope holds exactly the post-update values probed
+    for v, s in stats.items():
+        if s["kind"] == "param":
+            checks[v] = expect(scope.get(v))
+    assert any(s["kind"] == "op" for s in stats.values())
+    for var, exp in checks.items():
+        got = stats[var]
+        assert got["numel"] == exp["numel"], var
+        assert got["nonfinite"] == exp["nonfinite"], var
+        for k in ("absmax", "mean", "rms"):
+            assert abs(got[k] - exp[k]) <= 1e-5 + 1e-5 * abs(exp[k]), \
+                (var, k, got[k], exp[k])
+
+
+# ==========================================================================
+# ZeRO-stage x DP-path invariance
+# ==========================================================================
+def _dp_stream(transpile, stage, steps=2):
+    _flags.set_flags({"numerics_probe": 1, "dp_sharding": stage})
+    mesh_mod.registry().clear()
+    mesh_mod.init_mesh()
+    numerics.reset()
+    main, startup, loss = _mlp(layers=3, width=16, seed=3,
+                               transpile=transpile, optimizer="momentum")
+    scope = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    xs, ys = _data(width=16)
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    with numerics.capture() as cap:
+        for _ in range(steps):
+            exe.run(compiled, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                    scope=scope)
+    return cap
+
+
+def test_probe_stats_zero_stage_and_path_invariant():
+    """Stages 0-3 x {pjit, shard_map} agree: grad/param/update stats
+    within fp-reduction tolerance of the stage-0 pjit reference (the
+    loss scalar compares on mean — per-shard loss values are the DP
+    reality; their cross-shard mean IS the global loss)."""
+    ref = _dp_stream(False, 0)
+    assert ref and ref[0]["stats"]
+    for transpile, stage in [(False, 1), (False, 3), (True, 0), (True, 2),
+                             (True, 3)]:
+        st = _dp_stream(transpile, stage)
+        assert len(st) == len(ref)
+        for ea, eb in zip(ref, st):
+            assert sorted(ea["stats"]) == sorted(eb["stats"]), \
+                (transpile, stage)
+            for v, sa in ea["stats"].items():
+                sb = eb["stats"][v]
+                assert sa["kind"] == sb["kind"]
+                keys = (("mean",) if sa["kind"] == "loss"
+                        else ("absmax", "rms", "mean", "nonfinite"))
+                for k in keys:
+                    tol = 1e-5 + 1e-5 * abs(sa[k])
+                    assert abs(sa[k] - sb[k]) <= tol, \
+                        (transpile, stage, v, k, sa[k], sb[k])
+
+
+# ==========================================================================
+# flight recorder
+# ==========================================================================
+def test_debris_on_armed_check_trip(tmp_path):
+    """FLAGS_check_nan_inf + nan_inject: the checkify error names the
+    op, debris lands in FLAGS_numerics_debris_dir with the parsed
+    failing op + the stats ring, and the exception type is unchanged."""
+    main, startup, loss = _mlp()
+    _flags.set_flags({"check_nan_inf": 1,
+                      "numerics_debris_dir": str(tmp_path),
+                      "chaos": "seed=3;nan_inject=relu@3"})
+    with pytest.raises(Exception, match="contains Inf/Nan"):
+        _train(main, startup, loss, steps=4, probe=1,
+               on_step=chaos.on_step)
+    dirs = os.listdir(tmp_path)
+    assert len(dirs) == 1 and dirs[0].startswith("nan_executor_step")
+    d = tmp_path / dirs[0]
+    deb = json.loads((d / "debris.json").read_text())
+    assert deb["failing_op"]["op_type"] == "relu"
+    assert (d / "error.txt").exists() and (d / "telemetry.json").exists()
+    # the ring holds the healthy pre-trip steps
+    assert [e["step"] for e in deb["stats_ring"]] == [1, 2]
+    snap = telemetry.snapshot()
+    kinds = {tuple(r["labels"].values()): r["value"]
+             for r in snap["chaos_injections_total"]["series"]}
+    assert kinds.get(("nan_inject",)) == 1
+
+
+def test_debris_on_monitor_trip_without_check(tmp_path):
+    """Check unarmed: the probe stream's HealthMonitor sees the
+    nonfinite stats, trips once, dumps debris naming the first bad var,
+    and health() latches unhealthy — training itself keeps running."""
+    main, startup, loss = _mlp()
+    _flags.set_flags({"numerics_debris_dir": str(tmp_path),
+                      "chaos": "seed=3;nan_inject=relu@2"})
+    _train(main, startup, loss, steps=3, probe=1, on_step=chaos.on_step)
+    h = numerics.health()
+    assert not h["healthy"]
+    assert h["trips"] and h["trips"][0]["kind"] == "nonfinite"
+    assert h["trips"][0]["step"] == 2
+    dirs = [d for d in os.listdir(tmp_path)
+            if d.startswith("nan_monitor_nonfinite")]
+    assert len(dirs) == 1  # latched: one dump per trip kind
+    deb = json.loads((tmp_path / dirs[0] / "debris.json").read_text())
+    assert deb["trip"]["detail"]["nonfinite"] > 0
+    snap = telemetry.snapshot()
+    assert snap["numerics_nonfinite_total"]["series"][0]["value"] > 0
+
+
+def test_no_debris_when_clean_or_unset(tmp_path):
+    main, startup, loss = _mlp()
+    # clean probed run, dir armed -> nothing dumped
+    _flags.set_flags({"numerics_debris_dir": str(tmp_path)})
+    _train(main, startup, loss, probe=1)
+    assert numerics.health()["healthy"]
+    assert os.listdir(tmp_path) == []
+    # dir unset -> recorder is a no-op even on an explicit call
+    _flags.set_flags({"numerics_debris_dir": ""})
+    assert numerics.record_nan_debris("unit", exc=RuntimeError("x")) is None
+
+
+def test_health_monitor_loss_spike_detector():
+    """Declared-threshold spike detector via the direct observe_loss
+    feed: a flat window then a >factor x mean loss trips loss_spike."""
+    numerics.reset()
+    mon = numerics.health_monitor().configure(spike_window=8,
+                                              spike_factor=3.0,
+                                              min_steps=4)
+    for i in range(6):
+        mon.observe_loss(1.0, step=i + 1)
+    assert numerics.health()["healthy"]
+    trips = mon.observe_loss(10.0, step=7)
+    assert trips and trips[0]["kind"] == "loss_spike"
+    assert not numerics.health()["healthy"]
+
+
+# ==========================================================================
+# chaos nan_inject semantics
+# ==========================================================================
+def test_nan_inject_parse_validation():
+    with pytest.raises(ValueError, match="nan_inject"):
+        chaos.FaultSchedule("nan_inject=relu")  # missing @STEP
+    with pytest.raises(ValueError, match="nan_inject"):
+        chaos.FaultSchedule("nan_inject=@3")    # missing op
+    s = chaos.FaultSchedule("seed=5;nan_inject=mul@4")
+    assert s.nan_at == {4: "mul"} and s.seed == 5
+    # a training fault: never classified serving-only
+    assert not s.serving_faults()
+
+
+def test_nan_inject_poisons_only_step_k():
+    """Step K NaNs; step K+1 falls back to the clean cached compile —
+    but state poisoned at K stays poisoned (a realistic blow-up)."""
+    main, startup, loss = _mlp()
+    _flags.set_flags({"chaos": "seed=1;nan_inject=relu@2"})
+    losses, _ = _train(main, startup, loss, steps=3, probe=0,
+                       on_step=chaos.on_step)
+    assert np.isfinite(losses[0]).all()
+    assert not np.isfinite(losses[1]).all()
+    # clean recompile at step 3, but params already carry NaN
+    assert chaos.nan_poison_target() is None
+    assert not np.isfinite(losses[2]).all()
+
+
+# ==========================================================================
+# bisector + report CLIs (bounded tier-1 smokes)
+# ==========================================================================
+def test_bisect_divergence_quick_subprocess():
+    """tools/bisect_divergence.py --quick: identical configs agree,
+    seeded nan_inject localizes to the injected op."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "bisect_divergence.py"), "--quick"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = [l for l in r.stdout.splitlines()
+            if l.startswith("BISECT=")][-1]
+    rep = json.loads(line[len("BISECT="):])
+    assert rep["identical_agree"] and rep["nan_inject_localized"]
+    first = rep["nan_inject"]["first"]
+    assert first["op_type"] == "relu" and first["step"] == 2
+
+
+def test_numerics_report_quick_subprocess():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "numerics_report.py"), "--quick"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = [l for l in r.stdout.splitlines()
+            if l.startswith("NUMERICS=")][-1]
+    rep = json.loads(line[len("NUMERICS="):])
+    assert rep["quick"] and rep["healthy"] \
+        and rep["stats_agree_with_numpy"]
+
+
+def test_bisect_ref_host_ground_truth_agrees():
+    """--ref-host mode: the compiled pipeline's probe stream agrees
+    with the op-by-op host replay's float64 stats (ground truth for
+    'the pipeline did not change the math')."""
+    import bisect_divergence as bd
+
+    args = bd.build_args().parse_args(
+        ["--ref-host", "--steps", "2", "--layers", "2", "--width", "8",
+         "--batch", "8", "--rtol", "2e-4", "--atol", "1e-5"])
+    rep = bd.bisect(args, {}, {})
+    assert not rep["diverged"], rep["first"]
+    assert rep["probed_vars"] > 10 and rep["stats_compared"] > 50
+
+
+@pytest.mark.slow
+def test_bisect_dp_grad_compress_localizes():
+    """Acceptance oracle: FLAGS_dp_grad_compress none-vs-bf16 on the
+    shard_map DP path localizes to the FIRST grad probe downstream of
+    the compressed collective, with bf16-rounding-sized deltas."""
+    import bisect_divergence as bd
+
+    args = bd.build_args().parse_args(
+        ["--dp", "--b", "dp_grad_compress=bf16", "--steps", "2",
+         "--rtol", "1e-6"])
+    rep = bd.bisect(args, {}, bd.parse_flagset(args.b))
+    assert rep["diverged"]
+    f = rep["first"]
+    assert f["kind"] in ("grad", "op") and f["step"] == 1
+    assert "@GRAD" in f["var"]
+    # bf16 wire: ~1e-3 relative rounding, not a blow-up
+    assert abs(f["a"] - f["b"]) / (abs(f["a"]) + 1e-9) < 2e-2
+
+
+# ==========================================================================
+# verifier-clean pass application
+# ==========================================================================
+def test_probe_pass_verifier_clean_and_idempotent():
+    """Direct application under the armed verifier (conftest arms
+    FLAGS_verify_passes): the bracketed apply raises on any hazard, the
+    layout lands on the program, and re-application is a no-op."""
+    main, startup, loss = _mlp()
+    p = get_pass("numerics_probe_pass", ops_regex="relu")
+    out = p.apply(main)
+    blk = out.global_block()
+    assert blk.has_var(numerics.STATS_VAR)
+    layout = out._numerics_layout
+    assert layout and any(t["kind"] == "grad" for t in layout)
+    assert any(t["kind"] == "op" and t["op_type"] == "relu"
+               for t in layout)
+    # program order: layout sorted by producing-op index
+    idxs = [t["op_index"] for t in layout]
+    assert idxs == sorted(idxs)
+    n_ops = len(blk.ops)
+    out2 = get_pass("numerics_probe_pass", ops_regex="relu").apply(out)
+    assert len(out2.global_block().ops) == n_ops  # idempotent
+
+
+# ==========================================================================
+# AMP dynamic loss scaling
+# ==========================================================================
+def _amp_program(incr_every=2, decr_every=1):
+    with unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [8])
+            y = fluid.layers.data("y", [1])
+            h = fluid.layers.fc(x, 8, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            opt = fluid.optimizer.MomentumOptimizer(0.01, 0.9)
+            amp = fluid.contrib.mixed_precision.decorate(
+                opt, use_fp16=True, init_loss_scaling=8.0,
+                incr_every_n_steps=incr_every,
+                decr_every_n_nan_or_inf=decr_every,
+                incr_ratio=2.0, decr_ratio=0.5)
+            amp.minimize(loss)
+    return main, startup, loss, amp
+
+
+def test_amp_dynamic_loss_scaling_state_machine():
+    """Scale doubles after incr_every_n_steps clean steps, halves on a
+    found-Inf step (whose grads are zeroed -> params keep their
+    momentum-only trajectory), all as in-program persistable state."""
+    main, startup, loss, amp = _amp_program()
+    scope = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    xs, ys = _data()
+    scale_name = amp.get_loss_scaling_var().name
+
+    def scale():
+        return float(np.asarray(scope.get(scale_name)).reshape(-1)[0])
+
+    seen = []
+    for i in range(5):
+        f = {"x": xs * np.float32(1e30), "y": ys} if i == 2 \
+            else {"x": xs, "y": ys}
+        exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+        seen.append(scale())
+    assert seen == [8.0, 16.0, 8.0, 8.0, 16.0]
+    found = np.asarray(scope.get(amp.get_found_inf_var().name))
+    assert found.dtype == np.bool_
+    # params never went non-finite: the found-inf step's grads were
+    # zeroed before the update
+    for p in main.all_parameters():
+        assert np.isfinite(np.asarray(scope.get(p.name))).all(), p.name
+
+
+def test_amp_found_inf_feeds_probe_stream_and_telemetry():
+    main, startup, loss, amp = _amp_program()
+    _flags.set_flags({"numerics_probe": 1})
+    telemetry.registry().clear()
+    scope = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    xs, ys = _data()
+    with numerics.capture() as cap:
+        for i in range(3):
+            f = {"x": xs * np.float32(1e30), "y": ys} if i == 1 \
+                else {"x": xs, "y": ys}
+            exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+    assert [e["amp_found_inf"] for e in cap] == [False, True, False]
+    # 8 -> (clean, good=1) 8 -> (inf: halve) 4 -> (clean, good=1) 4
+    assert cap[-1]["amp_loss_scale"] == 4.0
+    snap = telemetry.snapshot()
+    assert snap["amp_found_inf_total"]["series"][0]["value"] == 1
+    assert snap["amp_loss_scale"]["series"][0]["value"] == \
+        cap[-1]["amp_loss_scale"]
+    assert numerics.health()["amp_loss_scale"] == cap[-1]["amp_loss_scale"]
+
+
+def test_amp_bf16_default_unchanged():
+    """decorate() without use_fp16 stays the static bf16 path: no
+    loss-scaling state vars, no update_loss_scaling op."""
+    with unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [8])
+            y = fluid.layers.data("y", [1])
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            amp = fluid.contrib.mixed_precision.decorate(
+                fluid.optimizer.SGDOptimizer(0.1))
+            amp.minimize(loss)
+    types = {op.type for op in main.global_block().ops}
+    assert "update_loss_scaling" not in types
+    assert "amp_check_finite_and_scale" not in types
+    assert amp.get_loss_scaling_var() is None

@@ -1366,6 +1366,8 @@ COVERED_ELSEWHERE.update({
     # r5 py_func op form — tests/test_py_func.py
     "py_func_grad": "test_py_func",
     "einsum": "test_layers_tail",
+    # r20 AMP dynamic loss scaling — tests/test_numerics.py
+    "update_loss_scaling": "test_numerics",
 })
 COVERED_ELSEWHERE.update({
     # r4 long-tail corpus — tests/test_long_tail_ops.py (NumPy oracles)

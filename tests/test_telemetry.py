@@ -94,6 +94,40 @@ def test_histogram_quantile_brackets_sample_percentiles():
     assert hi / lo < 10 ** 0.75
 
 
+def test_histogram_nan_inf_never_poison_buckets():
+    """r20 satellite fix: a NaN/Inf observation must not land in a
+    bucket (bisect_right files NaN arbitrarily) nor make _sum/_min/_max
+    NaN forever — it counts in the explicit ``nonfinite`` field,
+    excluded from buckets/sum/count, and quantile brackets stay exact.
+    (SLOTracker legitimately feeds NaN TTFTs for zero-token requests.)"""
+    h = telemetry.histogram("t_nan_hist")
+    h.observe(0.01)
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    h.observe(float("-inf"))
+    h.observe(0.04)
+    assert h.count == 2
+    assert h.nonfinite == 3
+    assert h.sum == pytest.approx(0.05)
+    lo, hi = h.quantile_bounds(0.99)
+    assert np.isfinite(lo) and np.isfinite(hi) and lo <= 0.04 <= hi
+    row = telemetry.snapshot()["t_nan_hist"]["series"][0]
+    assert row["nonfinite"] == 3
+    assert row["count"] == 2 and np.isfinite(row["sum"])
+    assert row["min"] == 0.01 and row["max"] == 0.04
+    # cumulative bucket counts never include the non-finite observations
+    assert row["buckets"][-1][1] == 2
+    text = telemetry.to_prometheus()
+    assert "t_nan_hist_nonfinite 3" in text
+    # a clean histogram's exposition/snapshot carries NO nonfinite row
+    # (bit-identical to the pre-fix shape)
+    h2 = telemetry.histogram("t_clean_hist")
+    h2.observe(0.01)
+    assert "nonfinite" not in telemetry.snapshot()["t_clean_hist"][
+        "series"][0]
+    assert "t_clean_hist_nonfinite" not in telemetry.to_prometheus()
+
+
 def test_label_cardinality_bound():
     c = telemetry.counter("t_cardinality", labels=("uid",))
     for i in range(telemetry.MAX_SERIES + 40):

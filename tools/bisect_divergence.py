@@ -1,0 +1,342 @@
+"""First-divergence bisector: localize WHERE two configs' numerics part.
+
+Runs one seeded program under config A and config B (any FLAGS_* set —
+e.g. ``FLAGS_tpu_fuse`` 0/1, ``FLAGS_dp_grad_compress`` none/bf16 — and
+optionally a chaos schedule per side), replays the SAME seeded feeds,
+captures both per-op numerics probe streams
+(framework/numerics.py, ``FLAGS_numerics_probe_ops`` widened to every
+op by default), and reports the FIRST probe — by step, then by program
+order of the producing op — whose stats diverge beyond tolerance.  The
+manual version of this is a human diffing loss printouts between two
+flag settings; this is how the repo's bit-identity oracles get debugged,
+mechanized.
+
+Modes:
+
+* default — single-device executor path;
+* ``--dp`` — the shard_map/fleet-collective DP path on the virtual
+  8-device mesh (the regime where ``FLAGS_dp_grad_compress`` /
+  bucketing flags actually change numerics);
+* ``--ref-host`` — instead of config B, compare config A against an
+  op-by-op HOST replay of the un-rewritten program (numpy/float64
+  stats after every op) — ground truth for "did the compiled pipeline
+  change the math";
+* ``--quick`` — bounded tier-1 smoke: identical configs must NOT
+  diverge, and a seeded ``nan_inject`` on one side must localize to the
+  injected op.  Exit 2 on smoke failure.
+
+The last line is the stable one-line ``BISECT={json}``.  Exit code: 0
+when the streams agree everywhere, 1 on divergence (the finding, not a
+failure), 2 on smoke/usage errors.
+
+Usage:
+  python tools/bisect_divergence.py --b "tpu_fuse=1" [--a "tpu_fuse=0"]
+      [--steps 4] [--rtol 1e-5] [--atol 1e-7] [--probe-ops ".*"]
+      [--chaos-b "seed=3;nan_inject=relu@2"] [--dp] [--layers 3]
+      [--width 16] [--json]
+  python tools/bisect_divergence.py --ref-host [--a "..."]
+  python tools/bisect_divergence.py --quick
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+if os.path.join(REPO, "tools") not in sys.path:
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+
+STATS_COMPARED = ("absmax", "mean", "rms", "nonfinite")
+
+
+def build_args():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--a", default="", help="config A flags, k=v[,k=v...]")
+    ap.add_argument("--b", default="", help="config B flags, k=v[,k=v...]")
+    ap.add_argument("--chaos-a", default="", help="FLAGS_chaos for A only")
+    ap.add_argument("--chaos-b", default="", help="FLAGS_chaos for B only")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=3)
+    ap.add_argument("--width", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--rtol", type=float, default=1e-5)
+    ap.add_argument("--atol", type=float, default=1e-7)
+    ap.add_argument("--probe-ops", default=".*",
+                    help="FLAGS_numerics_probe_ops regex (default: every "
+                         "op — the full per-op stream)")
+    ap.add_argument("--dp", action="store_true",
+                    help="run on the shard_map DP path (8-dev virtual "
+                         "mesh, GradAllReduce-transpiled program)")
+    ap.add_argument("--ref-host", action="store_true",
+                    help="compare config A against the op-by-op host "
+                         "replay instead of config B")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--quick", action="store_true",
+                    help="bounded tier-1 smoke (see module docstring)")
+    return ap
+
+
+def parse_flagset(s: str) -> dict:
+    out = {}
+    for item in (s or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise SystemExit(f"bad flag item {item!r}: need k=v")
+        k, _, v = item.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _build(args):
+    from dp_comm_stats import build_mlp_dp_program
+
+    from paddle_tpu.framework import unique_name
+
+    with unique_name.guard():
+        main, startup, loss = build_mlp_dp_program(
+            n_layers=args.layers, width=args.width, seed=args.seed,
+            optimizer=args.optimizer, transpile=args.dp)
+    return main, startup, loss
+
+
+def _feeds(args):
+    import numpy as np
+
+    rng = np.random.RandomState(args.seed)
+    feeds = []
+    for _ in range(args.steps):
+        xs = rng.randn(args.batch, args.width).astype(np.float32)
+        ys = (xs[:, :1] * 2 + 1).astype(np.float32)
+        feeds.append({"x": xs, "y": ys})
+    return feeds
+
+
+def run_config(args, main, startup, loss, flagset, chaos_spec):
+    """One config's probe stream: [per-step {var: stats, order}] plus
+    whether the run truncated (an armed check raised)."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework import numerics
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.utils import chaos
+    from paddle_tpu.utils import flags as _flags
+
+    saved = dict(_flags._flags)
+    try:
+        _flags.set_flags({"numerics_probe": 1,
+                          "numerics_probe_ops": args.probe_ops,
+                          "chaos": chaos_spec or ""})
+        if flagset:
+            _flags.set_flags(flagset)
+        chaos.reset()
+        numerics.reset()
+        scope = Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        compiled = main
+        if args.dp:
+            import paddle_tpu.fluid as fluid
+            from paddle_tpu.parallel import mesh as mesh_mod
+
+            mesh_mod.registry().clear()
+            mesh_mod.init_mesh()
+            compiled = fluid.CompiledProgram(main).with_data_parallel()
+        truncated = None
+        with numerics.capture() as cap:
+            for step, feed in enumerate(_feeds(args), start=1):
+                chaos.on_step(step)
+                try:
+                    exe.run(compiled, feed=feed, fetch_list=[loss],
+                            scope=scope)
+                except Exception as e:
+                    truncated = {"step": step, "error": str(e)[:200]}
+                    break
+        return list(cap), truncated
+    finally:
+        chaos.reset()
+        _flags._flags.clear()
+        _flags._flags.update(saved)
+
+
+def run_host_reference(args, main, startup, loss):
+    """Ground truth: replay the UN-rewritten program op by op on the
+    host, computing float64 numpy stats after every op — the stream the
+    compiled pipeline's probes must agree with."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.framework import numerics
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.ops import registry
+
+    scope = Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    block = main.global_block()
+    targets = numerics.select_probe_targets(main, block, args.probe_ops)
+    by_idx = {}
+    for t in targets:
+        by_idx.setdefault(t["op_index"], []).append(t)
+    state = {k: np.asarray(v) for k, v in scope.items()
+             if not k.startswith("@")}
+    steps = []
+    for feed in _feeds(args):
+        env = dict(state)
+        env.update(feed)
+        stats = {}
+        order = []
+        for i, op_ in enumerate(block.ops):
+            registry.run_op(op_, env, block)
+            for t in by_idx.get(i, ()):
+                v = np.asarray(env[t["var"]], dtype=np.float64)
+                finite = np.isfinite(v)
+                stats[t["var"]] = {
+                    "kind": t["kind"], "op_type": t["op_type"],
+                    "op_index": t["op_index"],
+                    "absmax": float(np.max(np.abs(v))) if v.size else 0.0,
+                    "mean": float(np.mean(v)) if v.size else 0.0,
+                    "rms": float(np.sqrt(np.mean(np.square(v))))
+                    if v.size else 0.0,
+                    "nonfinite": int(v.size - finite.sum()),
+                    "numel": int(v.size),
+                }
+                order.append(t["var"])
+        for name in list(state):
+            if name in env:
+                state[name] = np.asarray(env[name])
+        steps.append({"stats": stats, "order": order})
+    return steps, None
+
+
+def first_divergence(stream_a, stream_b, rtol, atol):
+    """(finding | None, n_compared).  Streams are compared per step, in
+    program order of config A's layout; a var missing on one side is
+    skipped (a rewrite may rename intermediates) — role-selected vars
+    always exist on both."""
+    compared = 0
+    for step_i, (ea, eb) in enumerate(zip(stream_a, stream_b), start=1):
+        sa, sb = ea["stats"], eb["stats"]
+        for var in ea["order"]:
+            if var not in sb:
+                continue
+            a, b = sa[var], sb[var]
+            for stat in STATS_COMPARED:
+                x, y = float(a[stat]), float(b[stat])
+                compared += 1
+                if x == y or (x != x and y != y):
+                    continue
+                if stat == "nonfinite" or x != x or y != y \
+                        or abs(x - y) > atol + rtol * max(abs(x), abs(y)):
+                    return {
+                        "step": step_i, "var": var, "stat": stat,
+                        "a": x, "b": y, "kind": a["kind"],
+                        "op_type": a["op_type"],
+                        "op_index": a["op_index"],
+                    }, compared
+    return None, compared
+
+
+def bisect(args, flags_a, flags_b):
+    main, startup, loss = _build(args)
+    stream_a, trunc_a = run_config(args, main, startup, loss, flags_a,
+                                   args.chaos_a)
+    if args.ref_host:
+        stream_b, trunc_b = run_host_reference(args, main, startup, loss)
+    else:
+        stream_b, trunc_b = run_config(args, main, startup, loss, flags_b,
+                                       args.chaos_b)
+    finding, compared = first_divergence(stream_a, stream_b,
+                                         args.rtol, args.atol)
+    if finding is None and len(stream_a) != len(stream_b):
+        short = min(len(stream_a), len(stream_b))
+        finding = {"step": short + 1, "var": None, "stat": "truncated",
+                   "a": len(stream_a), "b": len(stream_b),
+                   "kind": None, "op_type": None, "op_index": None}
+    return {
+        "mode": ("ref_host" if args.ref_host
+                 else ("dp" if args.dp else "executor")),
+        "steps": args.steps, "probed_vars": len(stream_a[0]["order"])
+        if stream_a else 0,
+        "flags_a": flags_a, "flags_b": flags_b,
+        "chaos_a": args.chaos_a, "chaos_b": args.chaos_b,
+        "rtol": args.rtol, "atol": args.atol,
+        "stats_compared": compared,
+        "truncated_a": trunc_a, "truncated_b": trunc_b,
+        "diverged": finding is not None, "first": finding,
+    }
+
+
+def human(rep):
+    print(f"bisect_divergence: mode={rep['mode']} steps={rep['steps']} "
+          f"probed_vars={rep['probed_vars']} "
+          f"stats_compared={rep['stats_compared']}")
+    print(f"  A: flags={rep['flags_a']} chaos={rep['chaos_a'] or '-'}")
+    print(f"  B: flags={rep['flags_b']} chaos={rep['chaos_b'] or '-'}")
+    if not rep["diverged"]:
+        print("  streams agree everywhere within tolerance")
+        return
+    f = rep["first"]
+    print(f"  FIRST DIVERGENCE: step {f['step']}, var {f['var']!r} "
+          f"({f['kind']}), stat {f['stat']}: A={f['a']} B={f['b']}")
+    print(f"  produced by op #{f['op_index']} ({f['op_type']}) — the "
+          f"earliest probe (program order) the configs disagree on")
+
+
+def quick(args):
+    """Smoke: (1) A==B must not diverge; (2) a seeded nan_inject on B
+    must localize to the injected op."""
+    args.steps = 3
+    args.layers = 2
+    args.width = 8
+    args.batch = 8
+    rep1 = bisect(args, {}, {})
+    ok1 = not rep1["diverged"]
+    args.chaos_b = "seed=3;nan_inject=relu@2"
+    rep2 = bisect(args, {}, {})
+    f = rep2["first"] or {}
+    ok2 = (rep2["diverged"] and f.get("step") == 2
+           and (f.get("op_type") == "relu"
+                or str(f.get("var", "")).startswith("relu")
+                or f.get("stat") == "nonfinite"))
+    rep = {"quick": True, "identical_agree": ok1,
+           "nan_inject_localized": ok2,
+           "identical": rep1, "nan_inject": rep2}
+    print(f"quick: identical_agree={ok1} nan_inject_localized={ok2} "
+          f"(first={f.get('op_type')}@step{f.get('step')})")
+    print("BISECT=" + json.dumps(rep, default=str))
+    return 0 if (ok1 and ok2) else 2
+
+
+def main():
+    args = build_args().parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.dp and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device"
+                                     "_count=8").strip()
+    if args.quick:
+        sys.exit(quick(args))
+    flags_a = parse_flagset(args.a)
+    flags_b = parse_flagset(args.b)
+    if not args.ref_host and not flags_b and not args.chaos_b \
+            and not flags_a and not args.chaos_a:
+        print("nothing to compare: give --b/--chaos-b (or --ref-host); "
+              "see --help", file=sys.stderr)
+        sys.exit(2)
+    rep = bisect(args, flags_a, flags_b)
+    if not args.json:
+        human(rep)
+    print("BISECT=" + json.dumps(rep, default=str))
+    sys.exit(1 if rep["diverged"] else 0)
+
+
+if __name__ == "__main__":
+    main()
