@@ -203,6 +203,20 @@ def _t_paged_attention(op_, block, ndev, assumed_batch):
     return total
 
 
+def _t_sample_token(op_, block, ndev, assumed_batch):
+    """The top-k/top-p filters sort the logits rows and build filtered
+    copies before the categorical draw: ~3 logits-sized f32 temporaries
+    (sorted values, cumulative probs, masked logits) beyond the
+    (num_rows,) output — charged explicitly because the output is tiny
+    and would hide them under the default."""
+    total = 0
+    for n in op_.inputs.get("Logits", []):
+        b = var_bytes(block, n, assumed_batch)
+        if b:
+            total += 3 * b
+    return total
+
+
 def _t_subblock(op_, block, ndev, assumed_batch):
     """Control-flow ops: the body's own peak (computed over vars the
     sub-block declares — loop carries alias the parent's values under
@@ -267,6 +281,7 @@ TRANSIENT_BYTES = {
     "c_concat": _t_allgather,          # all-gather then concat: same peak
     "coalesce_tensor": _t_coalesce,
     "paged_attention": _t_paged_attention,
+    "sample_token": _t_sample_token,
     "while": _t_subblock,
     "while_loop": _t_subblock,
     "recurrent": _t_subblock,

@@ -87,10 +87,15 @@ def lost_work_cost(req) -> int:
     pages are subtracted — a resume re-acquires them from the index
     instead of recomputing, so preempting a high-hit request wastes
     less work than its raw length suggests (0 with the prefix cache
-    off — byte-identical to the r18 cost).  Read off the span tree
-    when the request is traced (prompt_tokens / cached_tokens attrs of
-    the last prefill + one decode_step span per decoded token — the
-    prefill itself emits one token); identical to the untraced fallback
+    off — byte-identical to the r18 cost).  SPEC-DECODE-AWARE (r21):
+    a speculative decode_step emits ``accepted + 1`` tokens in one
+    verify call, so its span counts that many — only ACCEPTED tokens
+    are lost work; rejected drafts were never emitted and cost nothing
+    to regenerate (spans without the ``accepted`` attr count 1, so the
+    cost is unchanged with spec off).  Read off the span tree when the
+    request is traced (prompt_tokens / cached_tokens attrs of the last
+    prefill + accepted+1 per decode_step span — the prefill itself
+    emits one token); identical to the untraced fallback
     ``len(prompt) - _prefix_hit + len(out_tokens)`` by construction."""
     tr = getattr(req, "trace", None)
     if tr is not None:
@@ -100,8 +105,10 @@ def lost_work_cost(req) -> int:
             prompt = tr.spans[last].attrs.get(
                 "prompt_tokens", len(req.prompt))
             cached = tr.spans[last].attrs.get("cached_tokens", 0)
-            return int(prompt) - int(cached) + 1 \
-                + names[last:].count("decode_step")
+            decoded = sum(
+                int(s.attrs.get("accepted", 0)) + 1
+                for s in tr.spans[last:] if s.name == "decode_step")
+            return int(prompt) - int(cached) + 1 + decoded
     return (len(req.prompt) - int(getattr(req, "_prefix_hit", 0))
             + len(req.out_tokens))
 

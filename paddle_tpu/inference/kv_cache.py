@@ -99,6 +99,10 @@ class _Seq:
     # prefix-cache chain state (unused when the feature is off)
     digest: bytes = b""           # chain digest after the last FULL page
     tail: List[int] = field(default_factory=list)  # tokens in the tail page
+    # full token history (prefix caching only, opaque sequences
+    # excepted) — what lets truncate_tokens rewind the chain/index
+    # state to ANY earlier length, not just page boundaries
+    tokens: List[int] = field(default_factory=list)
     opaque: bool = False          # tokens unknown -> pages never indexed
     # acquired-but-uncommitted hit accounting (folded into the cache
     # counters at the first successful prefill slice — see
@@ -381,6 +385,7 @@ class PagedKVCache:
                         max(self._used.get(s.pages[i], 0),
                             min(ps, s.length - i * ps))
             if tokens is not None and not s.opaque and n_tokens:
+                s.tokens.extend(int(t) for t in tokens)
                 self._register_chain(s, tokens)
         # after the length update, and on EVERY append (a within-page
         # append changes fragmentation too)
@@ -439,6 +444,7 @@ class PagedKVCache:
             self._refs[page] = prev + 1
         s.pages = list(pages)
         s.length = hit
+        s.tokens = [int(t) for t in tokens]
         s.pending_hit = hit
         ps = self.config.page_size
         n_full = len(pages) if hit % ps == 0 else len(pages) - 1
@@ -465,6 +471,73 @@ class PagedKVCache:
         tm.counter("kv_prefix_hit_tokens_total",
                    "prompt tokens served from cached prefix pages "
                    "(prefill skipped)").inc(hit)
+
+    def truncate_tokens(self, seq_id, n_tokens: int):
+        """Roll back the LAST ``n_tokens`` of ``seq_id`` — the
+        spec-decode reject path: drafted tokens whose verify failed are
+        un-appended so the next append re-writes their slots.  Pages
+        are append-only (r19), so device-side this is free; host-side
+        it pops now-empty pages (refcount decrement, exactly the
+        free_sequence reclaim rules) and rewinds the prefix chain/index
+        state to the kept length using the sequence's token history.
+
+        A kept partial tail page that is EXCLUSIVELY owned gets its
+        stale index entries dropped and its kept content re-registered
+        (future appends will overwrite the rejected slots); a shared
+        tail page stays frozen — the CoW fork rules already cover the
+        next write into it."""
+        if n_tokens <= 0:
+            return
+        s = self._seqs[seq_id]
+        if n_tokens > s.length:
+            raise ValueError(
+                f"truncate_tokens: {n_tokens} > length {s.length} of "
+                f"sequence {seq_id!r}")
+        ps = self.config.page_size
+        new_len = s.length - n_tokens
+        keep = -(-new_len // ps)  # ceil
+        dropped, s.pages = s.pages[keep:], s.pages[:keep]
+        released = 0
+        for page in dropped:
+            self._refs[page] = self._refs.get(page, 1) - 1
+            if self._refs[page] <= 0:
+                self._refs.pop(page, None)
+                released += 1
+                if self.prefix_cache and (page in self._full_key
+                                          or page in self._page_partial):
+                    self._free_gen += 1
+                    self._cached_free[page] = self._free_gen
+                else:
+                    self._free.append(page)
+                    if self.prefix_cache:
+                        self._used.pop(page, None)
+        s.length = new_len
+        if self.prefix_cache and not s.opaque:
+            s.tokens = s.tokens[:new_len]
+            n_full = new_len // ps
+            digest = b""
+            for i in range(n_full):
+                digest = _chain(digest, s.tokens[i * ps:(i + 1) * ps])
+            s.digest = digest
+            s.tail = list(s.tokens[n_full * ps:])
+            if s.tail and self._refs.get(s.pages[-1], 0) == 1:
+                page = s.pages[-1]
+                self._drop_index(page)
+                tup = tuple(s.tail)
+                self._partials.setdefault(s.digest, {})[page] = tup
+                self._page_partial[page] = (s.digest, tup)
+                self._used[page] = len(s.tail)
+        elif self.prefix_cache and s.opaque:
+            if s.pages and new_len % ps \
+                    and self._refs.get(s.pages[-1], 0) == 1:
+                self._used[s.pages[-1]] = new_len % ps
+        if released:
+            self.free_count += released
+            from ..utils import telemetry as tm
+
+            tm.counter("kv_pool_pages_freed_total",
+                       "KV pages returned to the pool").inc(released)
+        self._publish_gauges()
 
     def take_forks(self) -> List[Tuple[int, int, int]]:
         """Drain pending CoW forks as ``(src_page, dst_page, used)``

@@ -64,11 +64,14 @@ from ..utils import telemetry as tm
 from ..utils import tracing
 from .admission import RequestRejected, get_policy
 from .kv_cache import KVCacheConfig, PagedKVCache
+from .spec_decode import NGramProposer, Proposer, SamplingParams, \
+    get_proposer, rng_lane
 
 __all__ = [
     "DecoderConfig", "Request", "StepEvent", "ServingEngine",
     "StaticBatchingEngine", "export_decoder", "load_decoder_config",
     "build_decoder_program", "init_decoder_weights", "RequestRejected",
+    "SamplingParams",
 ]
 
 NEG_INF = -1e9  # additive causal-mask value (finite: padded rows stay NaN-free)
@@ -215,8 +218,33 @@ class _B:
         return o
 
 
-def build_decoder_program(cfg: DecoderConfig, mode: str) -> tuple:
-    """Build one of the three program forms; returns
+def _sampled(sampling) -> bool:
+    return sampling is not None and not sampling.greedy
+
+
+def _emit_head(b: _B, logits: str, out_name: str, sampling,
+               seeds: Optional[str]) -> str:
+    """The token head every program form shares: argmax by default (the
+    bit-identity baseline), the in-program ``sample_token`` op when
+    sampling is armed — sampling params are baked as attrs, the per-row
+    RNG lanes arrive through the ``seeds`` feed."""
+    out = b.blk.create_var(name=out_name, dtype=VarType.INT64).name
+    if _sampled(sampling):
+        b.op("sample_token", {"Logits": [logits], "Seeds": [seeds]},
+             {"Out": [out]},
+             {"temperature": float(sampling.temperature),
+              "top_k": int(sampling.top_k),
+              "top_p": float(sampling.top_p)})
+    else:
+        b.op("arg_max", {"X": [logits]}, {"Out": [out]},
+             {"axis": -1, "keepdims": False, "flatten": False})
+    return out
+
+
+def build_decoder_program(cfg: DecoderConfig, mode: str,
+                          sampling: Optional[SamplingParams] = None
+                          ) -> tuple:
+    """Build one of the program forms; returns
     ``(program, feed_names, fetch_names)``.
 
     mode="reference": full-sequence next-token program (naive attention
@@ -231,9 +259,24 @@ def build_decoder_program(cfg: DecoderConfig, mode: str) -> tuple:
       itself — the program form prefix-cache-hit suffixes and chunked
       prefill share.  The host-built mask carries both the causal
       structure and the valid-context bound.
+    mode="verify":    the chunk form BATCHED over B sequences — the
+      spec-decode accept-prefix verify kernel.  Each row is one
+      request's ``[last_token, draft...]`` slice; ALL row positions'
+      logits are scored (no last_index), so row j yields the target
+      model's next token after chunk position j — exactly what
+      accept-prefix compares the draft against.  One call scores
+      K+1 positions for the whole batch.
+
+    ``sampling`` (serving forms only): when armed (temperature > 0) the
+    argmax head is replaced by the in-program ``sample_token`` op and
+    the program grows a ``sample_seeds`` RNG-lane feed (one lane per
+    emitted row).  ``None``/greedy builds the exact default programs.
     """
-    if mode not in ("reference", "prefill", "decode", "chunk"):
+    if mode not in ("reference", "prefill", "decode", "chunk", "verify"):
         raise ValueError(f"bad mode {mode!r}")
+    if _sampled(sampling) and mode == "reference":
+        raise ValueError("the reference form is the greedy oracle; "
+                         "sampling applies to serving forms only")
     H, D, h = cfg.num_heads, cfg.head_dim, cfg.hidden
     prog = Program()
     b = _B(prog)
@@ -254,6 +297,10 @@ def build_decoder_program(cfg: DecoderConfig, mode: str) -> tuple:
         tables = b.feed("chunk_tables", (-1,), VarType.INT32)
         feeds = ["tokens", "positions", "attn_mask", "last_index",
                  "slot_mapping", "chunk_tables"]
+        seeds = None
+        if _sampled(sampling):
+            seeds = b.feed("sample_seeds", (1,), VarType.INT32)
+            feeds.append("sample_seeds")
         x = b.lookup("dec_embed", tokens)
         pos = b.lookup("dec_pos_embed", positions)
         hid = b.add(x, pos, "h0")
@@ -305,10 +352,81 @@ def build_decoder_program(cfg: DecoderConfig, mode: str) -> tuple:
              {"Out": [hid]}, {"axis": 0})
         hf = b.layer_norm(hid, "dec_lnf_scale", "dec_lnf_bias", 1, "lnf")
         logits = b.matmul(hf, "dec_embed", transpose_Y=True, tag="logits")
-        out = b.blk.create_var(name="next_token", dtype=VarType.INT64).name
-        b.op("arg_max", {"X": [logits]}, {"Out": [out]},
-             {"axis": -1, "keepdims": False, "flatten": False})
+        out = _emit_head(b, logits, "next_token", sampling, seeds)
         prog._srv_params = params
+        return prog, feeds, [out]
+
+    if mode == "verify":
+        # NOTE: the chunk body again, batched — same drift guard: the
+        # verify==reference logits-parity test (tests/test_spec_decode)
+        # pins this body to the reference composition.
+        tokens = b.feed("tokens", (-1, -1), VarType.INT32)         # (B, S)
+        positions = b.feed("positions", (-1, -1), VarType.INT32)
+        mask = b.feed("attn_mask", (-1, 1, -1, -1), VarType.FP32)  # (B,1,S,C)
+        slot_map = b.feed("slot_mapping", (-1,), VarType.INT32)    # (B*S,)
+        tables = b.feed("verify_tables", (-1, -1), VarType.INT32)  # (B, W)
+        feeds = ["tokens", "positions", "attn_mask", "slot_mapping",
+                 "verify_tables"]
+        seeds = None
+        if _sampled(sampling):
+            seeds = b.feed("sample_seeds", (-1,), VarType.INT32)   # (B*S,)
+            feeds.append("sample_seeds")
+        x = b.lookup("dec_embed", tokens)
+        pos = b.lookup("dec_pos_embed", positions)
+        hid = b.add(x, pos, "h0")
+        for i in range(cfg.num_layers):
+            p = f"dec_l{i}_"
+            hn = b.layer_norm(hid, p + "ln1_scale", p + "ln1_bias", 2,
+                              f"l{i}_ln1")
+            q = b.matmul(hn, p + "wq", tag=f"l{i}_q")
+            k = b.matmul(hn, p + "wk", tag=f"l{i}_k")
+            v = b.matmul(hn, p + "wv", tag=f"l{i}_v")
+            # every row's K/V enter the pool first (flattened over the
+            # batch), so the per-row gather sees prefix AND chunk
+            k3 = b.reshape(k, [-1, H, D], f"l{i}_k3")       # (B*S, H, D)
+            v3 = b.reshape(v, [-1, H, D], f"l{i}_v3")
+            kc = b.param(f"kv_k_{i}", ())
+            vc = b.param(f"kv_v_{i}", ())
+            b.op("kv_cache_append",
+                 {"K": [k3], "V": [v3], "SlotMapping": [slot_map],
+                  "KCache": [kc], "VCache": [vc]},
+                 {"KCacheOut": [kc], "VCacheOut": [vc]})
+            q4 = b.transpose(b.reshape(q, [0, 0, H, D]), [0, 2, 1, 3],
+                             f"l{i}_q4")                    # (B, H, S, D)
+            # per-row block-table gather: (H, P, ps, D) indexed by the
+            # (B, W) tables -> (H, B, W, ps, D), batch-major, flattened
+            # to each row's context window
+            kg = b.tmp(f"l{i}_kg")
+            b.op("gather", {"X": [kc], "Index": [tables]},
+                 {"Out": [kg]}, {"axis": 1})
+            k4 = b.reshape(b.transpose(kg, [1, 0, 2, 3, 4]),
+                           [0, 0, -1, D], f"l{i}_k4")       # (B, H, C, D)
+            vg = b.tmp(f"l{i}_vg")
+            b.op("gather", {"X": [vc], "Index": [tables]},
+                 {"Out": [vg]}, {"axis": 1})
+            v4 = b.reshape(b.transpose(vg, [1, 0, 2, 3, 4]),
+                           [0, 0, -1, D], f"l{i}_v4")
+            s = b.matmul(q4, k4, transpose_Y=True, alpha=D ** -0.5,
+                         tag=f"l{i}_qk")                    # (B, H, S, C)
+            s = b.add(s, mask, f"l{i}_masked")
+            sm = b.tmp(f"l{i}_probs")
+            b.op("softmax", {"X": [s]}, {"Out": [sm]}, {"axis": -1})
+            av = b.matmul(sm, v4, tag=f"l{i}_av")           # (B, H, S, D)
+            ctxv = b.reshape(b.transpose(av, [0, 2, 1, 3]), [0, 0, h],
+                             f"l{i}_ctx")
+            hid = b.add(hid, b.matmul(ctxv, p + "wo", tag=f"l{i}_o"),
+                        f"l{i}_res1")
+            hn2 = b.layer_norm(hid, p + "ln2_scale", p + "ln2_bias", 2,
+                               f"l{i}_ln2")
+            ff = b.matmul(b.gelu(b.matmul(hn2, p + "w1", tag=f"l{i}_ff1")),
+                          p + "w2", tag=f"l{i}_ff2")
+            hid = b.add(hid, ff, f"l{i}_res2")
+        h2d = b.reshape(hid, [-1, h], "hflat")              # (B*S, h)
+        hf = b.layer_norm(h2d, "dec_lnf_scale", "dec_lnf_bias", 1, "lnf")
+        logits = b.matmul(hf, "dec_embed", transpose_Y=True, tag="logits")
+        out = _emit_head(b, logits, "next_tokens", sampling, seeds)
+        prog._srv_params = params
+        prog._srv_logits = logits   # the verify==reference parity hook
         return prog, feeds, [out]
 
     paged = mode == "decode"
@@ -329,6 +447,13 @@ def build_decoder_program(cfg: DecoderConfig, mode: str) -> tuple:
         if mode == "prefill":
             slot_map = b.feed("slot_mapping", (-1,), VarType.INT32)
             feeds.append("slot_mapping")
+    seeds = None
+    if _sampled(sampling):
+        # one RNG lane per emitted row: B lanes for the paged decode
+        # batch, a single lane for the prefill's first token
+        seeds = b.feed("sample_seeds", (-1,) if paged else (1,),
+                       VarType.INT32)
+        feeds.append("sample_seeds")
 
     x = b.lookup("dec_embed", tokens)
     pos = b.lookup("dec_pos_embed", positions)
@@ -403,10 +528,9 @@ def build_decoder_program(cfg: DecoderConfig, mode: str) -> tuple:
     hf = b.layer_norm(hid, "dec_lnf_scale", "dec_lnf_bias", 1, "lnf")
     logits = b.matmul(hf, "dec_embed", transpose_Y=True, tag="logits")
     out_name = "next_tokens" if paged else "next_token"
-    out = b.blk.create_var(name=out_name, dtype=VarType.INT64).name
-    b.op("arg_max", {"X": [logits]}, {"Out": [out]},
-         {"axis": -1, "keepdims": False, "flatten": False})
+    _emit_head(b, logits, out_name, sampling, seeds)
     prog._srv_params = params  # introspection/debug
+    prog._srv_logits = logits  # the verify==reference parity hook
     return prog, feeds, [out_name]
 
 
@@ -604,16 +728,23 @@ def _trace_admit(req: Request, now: float, wall0: float, wall1: float,
 
 
 def _trace_decode(states: Sequence["_SeqState"], toks: Sequence[int],
-                  now: float, wall0: float, wall1: float, step_no: int):
+                  now: float, wall0: float, wall1: float, step_no: int,
+                  spec: Optional[Sequence[tuple]] = None):
     """One decode-step span per TRACED request in the batch (shared
-    wall bounds: the batch runs as one program)."""
-    for st, tok in zip(states, toks):
+    wall bounds: the batch runs as one program).  ``spec`` (the
+    speculative path only) carries per-request ``(proposed, accepted)``
+    draft counts — the attrs appear ONLY when spec decode engaged, so
+    flag-off span streams stay byte-identical (the r19 pattern)."""
+    for i, (st, tok) in enumerate(zip(states, toks)):
         tr = st.req.trace
         if tr is not None:
+            attrs = {"step": step_no, "batch": len(states),
+                     "token": int(tok)}
+            if spec is not None:
+                attrs["proposed"] = int(spec[i][0])
+                attrs["accepted"] = int(spec[i][1])
             tr.add("decode_step", t0=now, wall0=wall0, wall1=wall1,
-                   parent=tr._root,
-                   attrs={"step": step_no, "batch": len(states),
-                          "token": int(tok)})
+                   parent=tr._root, attrs=attrs)
 
 
 def _trace_preempt(req: Request, now: float):
@@ -750,8 +881,15 @@ class _EngineCore:
                  place=None, use_mha_fusion: bool = True,
                  prefill_bucket_min: int = 16,
                  prefix_cache: Optional[bool] = None,
-                 prefix_seed: int = 0):
+                 prefix_seed: int = 0,
+                 sampling: Optional[SamplingParams] = None,
+                 sample_seed: int = 0):
         self.cfg = cfg
+        # greedy sampling normalizes to None: the serving programs are
+        # then built EXACTLY as before (argmax head, no seeds feed) —
+        # the flag-off bit-identity baseline
+        self.sampling = sampling if _sampled(sampling) else None
+        self.sample_seed = int(sample_seed)
         if place is None:
             import paddle_tpu as pt
 
@@ -767,13 +905,14 @@ class _EngineCore:
         self.kv = PagedKVCache(self.kv_config, prefix_cache=prefix_cache,
                                seed=prefix_seed)
         self._chunk = None   # (prog, feeds, fetch) — built on first use
+        self._verify = None  # spec-decode verify form — built on first use
 
         self.ref_prog, self.ref_feeds, self.ref_fetch = \
             build_decoder_program(cfg, "reference")
         self.prefill_prog, self.prefill_feeds, self.prefill_fetch = \
-            build_decoder_program(cfg, "prefill")
+            build_decoder_program(cfg, "prefill", sampling=self.sampling)
         self.decode_prog, self.decode_feeds, self.decode_fetch = \
-            build_decoder_program(cfg, "decode")
+            build_decoder_program(cfg, "decode", sampling=self.sampling)
         self.mha_fused = 0
         if use_mha_fusion:
             # the serving pass pipeline: the naive composition the
@@ -821,8 +960,27 @@ class _EngineCore:
         """The "chunk" program form (built lazily: the flag-off engine
         never constructs it, keeping its host path identical)."""
         if self._chunk is None:
-            self._chunk = build_decoder_program(self.cfg, "chunk")
+            self._chunk = build_decoder_program(self.cfg, "chunk",
+                                                sampling=self.sampling)
         return self._chunk
+
+    @property
+    def verify_prog_parts(self):
+        """The spec-decode "verify" program form (lazy like chunk: a
+        spec-off engine never constructs it)."""
+        if self._verify is None:
+            self._verify = build_decoder_program(self.cfg, "verify",
+                                                 sampling=self.sampling)
+        return self._verify
+
+    def _lane(self, req: Request, offset: int = 0) -> int:
+        """RNG lane for the token ``offset`` positions past the
+        request's next emission — ``len(prompt) + len(out_tokens)`` is
+        the absolute index of the next token to draw, a pure function
+        of request state, so lanes are preemption/resume-invariant and
+        identical between monolithic and speculative decode."""
+        return rng_lane(self.sample_seed, req.req_id,
+                        len(req.prompt) + len(req.out_tokens) + offset)
 
     def _apply_forks(self):
         """Replay pending CoW forks (kv_cache.take_forks) as device
@@ -887,13 +1045,15 @@ class _EngineCore:
                              self.cfg.max_seq_len - 1)[None]
             slot_map = np.full(S, self.kv_config.pad_slot, np.int32)
             slot_map[:L] = slots
+            feed = {"tokens": toks, "positions": pos,
+                    "attn_mask": _causal_mask(S),
+                    "slot_mapping": slot_map,
+                    "last_index": np.array([L - 1], np.int32)}
+            if self.sampling is not None:
+                feed["sample_seeds"] = np.array([self._lane(req)], np.int32)
             with RecordEvent("prefill", cat="serving"):
                 out = self.exe.run(
-                    self.prefill_prog,
-                    feed={"tokens": toks, "positions": pos,
-                          "attn_mask": _causal_mask(S),
-                          "slot_mapping": slot_map,
-                          "last_index": np.array([L - 1], np.int32)},
+                    self.prefill_prog, feed=feed,
                     fetch_list=self.prefill_fetch, scope=self.scope)
             tok = int(out[0][0])
         else:
@@ -931,14 +1091,19 @@ class _EngineCore:
         rows = np.arange(S, dtype=np.int64)[:, None]
         mask = np.where(cols <= pos + rows, 0.0, NEG_INF) \
             .astype(np.float32)[None, None]
+        feed = {"tokens": toks, "positions": posf,
+                "attn_mask": mask, "slot_mapping": slot_map,
+                "chunk_tables": tables,
+                "last_index": np.array([n - 1], np.int32)}
+        if self.sampling is not None:
+            # the slice's token lands at absolute position pos+n; only
+            # the FINAL slice's draw is consumed (pos+n == len(prompt)),
+            # so its lane matches the monolithic prefill's exactly
+            feed["sample_seeds"] = np.array(
+                [rng_lane(self.sample_seed, req.req_id, pos + n)], np.int32)
         with RecordEvent("prefill_chunk", cat="serving"):
-            out = self.exe.run(
-                prog,
-                feed={"tokens": toks, "positions": posf,
-                      "attn_mask": mask, "slot_mapping": slot_map,
-                      "chunk_tables": tables,
-                      "last_index": np.array([n - 1], np.int32)},
-                fetch_list=fetch, scope=self.scope)
+            out = self.exe.run(prog, feed=feed,
+                               fetch_list=fetch, scope=self.scope)
         return int(out[0][0])
 
     def abort_prefill(self, job: _PrefillJob):
@@ -988,14 +1153,87 @@ class _EngineCore:
         tables = np.zeros((Bp, W), np.int32)
         for i, st in enumerate(states):
             tables[i] = self.kv.block_table(st.req.req_id, W)
+        feed = {"tokens": toks, "positions": pos,
+                "block_tables": tables,
+                "context_lens": ctx, "slot_mapping": slot_map}
+        if self.sampling is not None:
+            lanes = np.zeros(Bp, np.int32)
+            for i, st in enumerate(states):
+                lanes[i] = self._lane(st.req)
+            feed["sample_seeds"] = lanes
         with RecordEvent("decode_batch", cat="serving"):
             out = self.exe.run(
-                self.decode_prog,
-                feed={"tokens": toks, "positions": pos,
-                      "block_tables": tables,
-                      "context_lens": ctx, "slot_mapping": slot_map},
+                self.decode_prog, feed=feed,
                 fetch_list=self.decode_fetch, scope=self.scope)
         return [int(out[0][i]) for i in range(B)]
+
+    def verify_batch(self, items) -> List[List[int]]:
+        """One spec-decode verify step: ``items`` is a list of
+        ``(_SeqState, draft_tokens)`` pairs.  Each sequence's chunk
+        ``[last_token] + draft`` enters the pool at allocator slots
+        (the caller guaranteed page capacity), then ONE verify-program
+        call scores every chunk position of every sequence against the
+        pool-resident context.  Returns, per item, the target model's
+        next token after each chunk position (``len(draft) + 1``
+        tokens) — row j is what the baseline would emit after accepting
+        the first j draft tokens, so accept-prefix comparison against
+        it is exact.  Feed shapes bucket in batch, chunk length AND
+        block-table width (all powers of two), keeping the jit cache
+        bounded like every other serving form."""
+        prog, _feeds, fetch = self.verify_prog_parts
+        B = len(items)
+        Bp = _pow2_bucket(max(B, 1))
+        S = _pow2_bucket(max(1 + len(d) for _, d in items))
+        toks = np.zeros((Bp, S), np.int32)
+        posf = np.zeros((Bp, S), np.int32)
+        slot_map = np.full(Bp * S, self.kv_config.pad_slot, np.int32)
+        pos0 = []
+        for i, (st, draft) in enumerate(items):
+            rid = st.req.req_id
+            chunk = [int(st.last_token)] + [int(t) for t in draft]
+            n = len(chunk)
+            p0 = self.kv.context_len(rid)
+            pos0.append(p0)
+            slots = self.kv.append_tokens(rid, n, tokens=chunk)
+            assert slots is not None, "caller must reserve pages"
+            toks[i, :n] = chunk
+            posf[i] = np.minimum(p0 + np.arange(S, dtype=np.int32),
+                                 self.cfg.max_seq_len - 1)
+            slot_map[i * S:i * S + n] = slots
+        self._apply_forks()
+        W = _pow2_bucket(max(
+            (self.kv.num_pages_of(st.req.req_id) for st, _ in items),
+            default=1))
+        C = W * self.kv_config.page_size
+        tables = np.zeros((Bp, W), np.int32)
+        for i, (st, _d) in enumerate(items):
+            tables[i] = self.kv.block_table(st.req.req_id, W)
+        # per-row causal + context-bound mask (the chunk form's rule,
+        # one slice per batch row); padded batch rows are fully masked
+        # — softmax over finite NEG_INF stays NaN-free by construction
+        cols = np.arange(C, dtype=np.int64)[None, None, :]
+        rows = np.arange(S, dtype=np.int64)[None, :, None]
+        base = np.asarray(pos0 + [-1] * (Bp - B),
+                          dtype=np.int64)[:, None, None]
+        mask = np.where(cols <= base + rows, 0.0, NEG_INF) \
+            .astype(np.float32)[:, None]
+        feed = {"tokens": toks, "positions": posf, "attn_mask": mask,
+                "slot_mapping": slot_map, "verify_tables": tables}
+        if self.sampling is not None:
+            lanes = np.zeros(Bp * S, np.int32)
+            for i, (st, draft) in enumerate(items):
+                for j in range(len(draft) + 1):
+                    # row j draws the token the sequence would emit at
+                    # absolute position len(prompt)+len(out)+j — the
+                    # SAME lane monolithic decode would use there
+                    lanes[i * S + j] = self._lane(st.req, j)
+            feed["sample_seeds"] = lanes
+        with RecordEvent("verify_batch", cat="serving"):
+            out = self.exe.run(prog, feed=feed,
+                               fetch_list=fetch, scope=self.scope)
+        flat = out[0]
+        return [[int(flat[i * S + j]) for j in range(len(d) + 1)]
+                for i, (_st, d) in enumerate(items)]
 
     def reference_next_token(self, seq: Sequence[int]) -> int:
         """One full-recompute next-token step of the reference program
@@ -1090,7 +1328,22 @@ class ServingEngine:
                  model_dir: Optional[str] = None,
                  max_batch: int = 8, token_budget: int = 256,
                  seed: int = 0, admission_policy=None,
-                 prefill_chunk: Optional[int] = None, **core_kw):
+                 prefill_chunk: Optional[int] = None,
+                 spec_k: Optional[int] = None,
+                 proposer=None,
+                 sampling: Optional[SamplingParams] = None, **core_kw):
+        from ..utils.flags import flag
+
+        if sampling is None:
+            # FLAGS_sample_temperature > 0 arms sampled decode with the
+            # default nucleus-off/top-k-off params; richer configs come
+            # through the kwarg (a SamplingParams)
+            temp = float(flag("sample_temperature", 0.0) or 0.0)
+            if temp > 0.0:
+                sampling = SamplingParams(temperature=temp)
+        self.sampling = sampling if _sampled(sampling) else None
+        core_kw.setdefault("sampling", self.sampling)
+        core_kw.setdefault("sample_seed", seed)
         if model_dir is not None:
             self.core = _EngineCore.from_model_dir(model_dir, **core_kw)
         else:
@@ -1104,17 +1357,30 @@ class ServingEngine:
         self.token_budget = token_budget
         self.policy = get_policy(admission_policy)
         if prefill_chunk is None:
-            from ..utils.flags import flag
-
             prefill_chunk = int(flag("prefill_chunk_tokens", 0) or 0)
         self.prefill_chunk = max(int(prefill_chunk), 0)
+        if spec_k is None:
+            spec_k = int(flag("spec_decode_k", 0) or 0)
+        self.spec_k = max(int(spec_k), 0)
+        if isinstance(proposer, str):
+            proposer = get_proposer(proposer)
+        self.proposer: Optional[Proposer] = \
+            proposer if proposer is not None else \
+            (NGramProposer() if self.spec_k else None)
+        # verify-call budget debt: tokens a verify emitted BEYOND the
+        # one-per-sequence this step's budget already charged; settled
+        # against the NEXT step's budget, so a verify call charges
+        # accepted+1 tokens exactly like the monolithic paths (always 0
+        # with spec off, and 0 at zero acceptance)
+        self._spec_debt = 0
         self._prefill_job: Optional[_PrefillJob] = None
         self.waiting: List[Request] = []
         self.running: List[_SeqState] = []   # admission order
         self.stats = {"admitted": 0, "finished": 0, "preempted": 0,
                       "shed": 0, "decode_steps": 0, "prefill_tokens": 0,
                       "decode_tokens": 0, "prefill_hit_tokens": 0,
-                      "prefill_chunks": 0, "max_prefill_step_tokens": 0}
+                      "prefill_chunks": 0, "max_prefill_step_tokens": 0,
+                      "spec_proposed": 0, "spec_accepted": 0}
         self._step_no = 0
         self._submit_seq = 0
 
@@ -1163,7 +1429,12 @@ class ServingEngine:
         # --- admission: every decode step takes new work, in policy
         # order (fifo: submit order — order() is a no-op) --------------
         self.policy.order(self, now)
-        budget = self.token_budget - len(self.running)
+        # settle last step's verify debt: tokens a verify call emitted
+        # beyond one-per-sequence charge THIS step's budget, so spec
+        # decode pays accepted+1 exactly like the monolithic paths
+        # (_spec_debt is always 0 with spec off — the term vanishes)
+        budget = self.token_budget - len(self.running) - self._spec_debt
+        self._spec_debt = 0
         prefilled_this_step = 0
         # --- in-flight chunked prefill: one budget-sized slice per
         # step, ahead of new admissions (it reached the head first);
@@ -1309,7 +1580,9 @@ class ServingEngine:
                 instant_event("preempt", cat="serving",
                               args={"req": str(victim.req.req_id)})
         # --- decode ------------------------------------------------------
-        if self.running:
+        if self.running and self.spec_k:
+            events.extend(self._spec_decode_step(now))
+        elif self.running:
             chaos.on_decode_step()
             wall0 = time.perf_counter()
             toks = self.core.decode_batch(self.running)
@@ -1335,6 +1608,120 @@ class ServingEngine:
             self.running = still
         self.stats["max_prefill_step_tokens"] = max(
             self.stats["max_prefill_step_tokens"], prefilled_this_step)
+        return events
+
+    def _spec_decode_step(self, now: float) -> List[StepEvent]:
+        """One speculative decode iteration (``spec_k > 0``): draft up
+        to ``spec_k`` tokens per running sequence, verify every chunk
+        in ONE program call, emit each sequence's longest agreeing
+        draft prefix PLUS the verify's own next token, truncate
+        rejected drafts back out of the KV cache.
+
+        Greedy acceptance is exact-argmax match, so the emitted stream
+        is token-identical to monolithic decode (pinned by test).
+        Sampled acceptance draws row j from position j's RNG lane —
+        the same lane monolithic decode uses there — so every emitted
+        token is a valid lane-keyed draw from the target distribution;
+        the stream can still differ from monolithic sampled decode at
+        nucleus/top-k filter boundaries, because the verify and decode
+        program forms are different FP compositions and
+        ``jax.random.categorical`` is not ULP-robust the way argmax is
+        (top_k=1 sampling IS exactly baseline — pinned by test; the
+        sampled contracts are seeded-replay determinism and
+        resume-invariant lanes, see tests/test_spec_decode.py).  A
+        zero-accept step emits exactly one token per sequence —
+        baseline step count and budget accounting."""
+        events: List[StepEvent] = []
+        chaos.on_decode_step()
+        batch = self.running
+        # page capacity: the preemption loop guaranteed one token of
+        # growth per sequence; drafts spend only what remains AFTER
+        # those base reservations, each shrinking until it fits (a
+        # draft can never steal another sequence's guaranteed token)
+        bases = [self.kv.pages_needed(st.req.req_id, 1)
+                 + self.kv.cow_fork_need(st.req.req_id, 1)
+                 for st in batch]
+        avail = self.kv.num_free_pages - sum(bases)
+        drafts: List[List[int]] = []
+        for st, base in zip(batch, bases):
+            req = st.req
+            # never draft past max_new_tokens - 1: the verify's bonus
+            # token always lands, so a full accept finishes exactly AT
+            # the cap, never beyond it
+            cap = min(self.spec_k,
+                      req.max_new_tokens - len(req.out_tokens) - 1)
+            d = [int(t) for t in self.proposer.propose(req, cap)][:cap] \
+                if cap > 0 else []
+            while d:
+                extra = (self.kv.pages_needed(req.req_id, 1 + len(d))
+                         + self.kv.cow_fork_need(req.req_id, 1 + len(d))
+                         - base)
+                if extra <= avail:
+                    avail -= extra
+                    break
+                d.pop()
+            drafts.append(d)
+        wall0 = time.perf_counter()
+        targets = self.core.verify_batch(list(zip(batch, drafts)))
+        wall1 = time.perf_counter()
+        self.stats["decode_steps"] += 1
+        tm.counter("serving_decode_steps_total",
+                   "batched decode steps run").inc()
+        # per sequence: accept while the target agrees with the draft,
+        # then pre-truncate the emission at max_new_tokens / EOS so the
+        # token stream ends exactly where monolithic decode would stop
+        accepts, emits = [], []
+        for st, d, tgt in zip(batch, drafts, targets):
+            a = 0
+            while a < len(d) and tgt[a] == d[a]:
+                a += 1
+            accepts.append(a)
+            room = st.req.max_new_tokens - len(st.req.out_tokens)
+            emit = tgt[:min(a + 1, room)]
+            if self.cfg.eos_id in emit:
+                emit = emit[:emit.index(self.cfg.eos_id) + 1]
+            emits.append(emit)
+        _trace_decode(batch, [e[-1] for e in emits], now, wall0, wall1,
+                      self.stats["decode_steps"],
+                      spec=[(len(d), a) for d, a in zip(drafts, accepts)])
+        still = []
+        for st, d, a, emit in zip(batch, drafts, accepts, emits):
+            req = st.req
+            fin = False
+            for tok in emit:
+                req.out_tokens.append(tok)
+                _observe_token(req, now)
+                if self.core._finished(req, tok):
+                    events.append(self._finish(st, tok, now))
+                    fin = True
+                    break
+                events.append(StepEvent(req.req_id, tok, False, now))
+            if not fin:
+                # roll the rejected draft suffix back out of the pool
+                # (a finished sequence was freed whole — no rollback)
+                if len(d) > a:
+                    self.kv.truncate_tokens(req.req_id, len(d) - a)
+                st.last_token = emit[-1]
+                still.append(st)
+        self.running = still
+        n_prop = sum(len(d) for d in drafts)
+        n_acc = sum(accepts)
+        used = sum(len(e) for e in emits)
+        self.stats["decode_tokens"] += used
+        self.stats["spec_proposed"] += n_prop
+        self.stats["spec_accepted"] += n_acc
+        self._spec_debt = used - len(batch)
+        tm.counter("serving_decode_tokens_total",
+                   "tokens produced by decode steps").inc(used)
+        tm.counter("spec_proposed_total",
+                   "draft tokens proposed to spec-decode verify").inc(n_prop)
+        tm.counter("spec_accepted_total",
+                   "draft tokens accepted by spec-decode verify").inc(n_acc)
+        if self.stats["spec_proposed"]:
+            tm.gauge("spec_accept_rate",
+                     "cumulative spec-decode draft acceptance rate").set(
+                         self.stats["spec_accepted"]
+                         / self.stats["spec_proposed"])
         return events
 
     def _count_prefill(self, n: int, job: _PrefillJob):

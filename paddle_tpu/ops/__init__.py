@@ -38,3 +38,4 @@ from . import compat_ops  # noqa: F401
 from . import long_tail_ops  # noqa: F401
 from . import parity_ops  # noqa: F401
 from . import paged_ops  # noqa: F401
+from . import sampling_ops  # noqa: F401

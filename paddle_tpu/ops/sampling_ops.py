@@ -1,0 +1,76 @@
+"""In-program token sampling for the serving decode paths.
+
+One op, ``sample_token``: temperature / top-k / top-p (nucleus)
+sampling over a batch of decode logits, with an EXPLICIT per-row RNG
+lane feed instead of the threaded program rng state the training-side
+random ops use (tensor_ops ``uniform_random`` etc.).  The lane keys
+are computed on the host as a pure function of (engine seed, req_id,
+position) — inference/spec_decode.py ``rng_lane`` — and fed per slot,
+so a sampled decode step is a deterministic function of its feeds:
+
+* the same seeded trace replays bit-identically (the event-stream
+  oracle extends to sampled decode), and
+* a preempted-and-resumed request redraws the SAME tokens at the same
+  positions (the lane is recomputed from position, never carried as
+  engine state across steps).
+
+``temperature <= 0`` degrades to argmax (greedy) — the serving engine
+never builds this op on the greedy path (the default programs end in
+``arg_max`` exactly as before), the degenerate attr is just kept total.
+
+Sampling-parameter attrs are BAKED into the program (engine-level
+sampling config, like every other program attr); only the lanes are
+per-slot feeds.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.prng import prng_key as _prng_key
+from .registry import op
+
+
+@op("sample_token", no_grad=True)
+def _sample_token(ctx):
+    """Inputs: Logits ``(num_rows, vocab)`` f32; Seeds ``(num_rows,)``
+    int32 RNG lane keys (one independent stream per row; padded bucket
+    rows feed lane 0 and their draws are never read).  Attrs:
+    ``temperature`` (<= 0 -> argmax), ``top_k`` (0 -> off), ``top_p``
+    (>= 1 -> off).  Out: ``(num_rows,)`` int64 sampled token ids.
+
+    Filtering order is the standard one (temperature, then top-k, then
+    nucleus), ties kept; the draw is ``jax.random.categorical`` under a
+    per-row key ``fold_in(base, lane)`` — a pure function of the feeds,
+    never of threaded rng state, so replay/resume determinism holds by
+    construction."""
+    logits = ctx.in_("Logits").astype(jnp.float32)
+    seeds = ctx.in_("Seeds").astype(jnp.uint32)
+    temp = float(ctx.attr("temperature", 1.0))
+    top_k = int(ctx.attr("top_k", 0))
+    top_p = float(ctx.attr("top_p", 1.0))
+    if temp <= 0.0:
+        ctx.set_out("Out", jnp.argmax(logits, axis=-1).astype(jnp.int64))
+        return
+    x = logits / temp
+    vocab = x.shape[-1]
+    if 0 < top_k < vocab:
+        kth = jnp.sort(x, axis=-1)[..., vocab - top_k][..., None]
+        x = jnp.where(x < kth, -jnp.inf, x)
+    if 0.0 < top_p < 1.0:
+        # nucleus: keep the smallest prefix of the probability-sorted
+        # vocab whose EXCLUSIVE cumulative mass is < top_p (the top
+        # token always survives), implemented as a threshold on the
+        # sorted logits so ties are kept deterministically
+        xs = jnp.sort(x, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(xs, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p
+        kth = jnp.min(jnp.where(keep, xs, jnp.inf), axis=-1, keepdims=True)
+        x = jnp.where(x < kth, -jnp.inf, x)
+    base = _prng_key(0)
+
+    def draw(lane, row):
+        return jax.random.categorical(jax.random.fold_in(base, lane), row)
+
+    ctx.set_out("Out", jax.vmap(draw)(seeds, x).astype(jnp.int64))

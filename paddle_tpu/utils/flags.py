@@ -221,6 +221,28 @@ _DEFAULTS: Dict[str, Any] = {
     # (the "chunk" program form).  0 (default): monolithic prefill,
     # byte-identical to r18 (pinned by test).
     "FLAGS_prefill_chunk_tokens": 0,
+    # speculative decoding (inference/serving.py + spec_decode.py): when
+    # > 0, each decode step drafts up to this many candidate tokens per
+    # sequence (n-gram prompt-lookup proposer by default, no draft
+    # model), scores all K+1 positions in ONE chunk-form verify program
+    # call against the pool-resident K/V, accepts the longest agreeing
+    # prefix (greedy: exact-argmax match, so greedy spec-decode is
+    # token-identical to the monolithic baseline) and truncates the
+    # rejected K/V appends in place.  The verify charges accepted+1
+    # tokens against the token budget exactly like the monolithic path
+    # (zero-accept degrades to baseline step count and accounting).
+    # 0 (default): the r20 decode loop runs byte-identically (pinned
+    # by test).
+    "FLAGS_spec_decode_k": 0,
+    # in-program sampling (ops/sampling_ops.py): when > 0, decode/
+    # prefill/chunk/verify programs end in the sample_token op
+    # (temperature + engine-level top-k/top-p) under per-slot RNG lane
+    # feeds rng_lane(seed, req_id, position) — seeded traces replay
+    # bit-identically and lanes are resume-invariant under preemption
+    # (recomputed from position, never carried).  0.0 (default): the
+    # programs end in arg_max exactly as before — byte-identical
+    # (pinned by test).
+    "FLAGS_sample_temperature": 0.0,
     # modeled-HBM budget gate (framework/memory_plan.py): when > 0, the
     # executor / DP compile paths check the static liveness planner's
     # modeled peak against this many MB and WARN naming the peak op and

@@ -36,7 +36,8 @@ class TraceEntry:
 def poisson_trace(num_requests: int, rate: float, vocab_size: int,
                   prompt_len_range=(4, 32), max_new_range=(4, 32),
                   seed: int = 0, prefix_len: int = 0,
-                  prefix_share: float = 0.0) -> List[TraceEntry]:
+                  prefix_share: float = 0.0,
+                  repeat_frac: float = 0.0) -> List[TraceEntry]:
     """Seeded open-loop trace: exponential inter-arrivals at ``rate``
     req/s, uniform prompt lengths and output budgets.  The same seed
     yields the same trace for every engine under test (the A/B
@@ -48,7 +49,18 @@ def poisson_trace(num_requests: int, rate: float, vocab_size: int,
     request's own suffix with probability ``prefix_share`` — the trace
     the CoW prefix cache is measured on.  ``prefix_len=0`` (default)
     reproduces the exact pre-r19 trace for every seed (the RNG draw
-    order is unchanged)."""
+    order is unchanged).
+
+    ``repeat_frac`` > 0 arms the SELF-SIMILAR workload (code,
+    templated text, retry storms): each prompt is rewritten so roughly
+    that fraction of its tokens repeat an n-gram drawn from earlier in
+    the same prompt — the trace the n-gram prompt-lookup drafter
+    (inference/spec_decode.py) gets its acceptance from, per the
+    prompt-lookup-decoding observation that generated continuations of
+    repeated spans mostly copy their earlier continuation.  Like the
+    prefix knobs it draws from a DERIVED seed, so ``repeat_frac=0``
+    (default) is bit-identical to the pre-r21 trace for every seed
+    (pinned by test)."""
     rng = np.random.RandomState(seed)
     prefix: List[int] = []
     if prefix_len > 0:
@@ -56,6 +68,7 @@ def poisson_trace(num_requests: int, rate: float, vocab_size: int,
         # perturbs the per-request draws below
         prefix = np.random.RandomState(seed + 7919).randint(
             0, vocab_size, size=prefix_len).astype(int).tolist()
+    rep_rng = np.random.RandomState(seed + 6007) if repeat_frac > 0 else None
     t = 0.0
     out = []
     for i in range(num_requests):
@@ -65,6 +78,18 @@ def poisson_trace(num_requests: int, rate: float, vocab_size: int,
         prompt = rng.randint(0, vocab_size, size=n).astype(int).tolist()
         if prefix and rng.random_sample() < prefix_share:
             prompt = prefix + prompt
+        if rep_rng is not None and len(prompt) >= 4:
+            # splice copies of earlier spans over ~repeat_frac of the
+            # prompt tail (length preserved; arrival/length draws above
+            # came from the primary stream, untouched)
+            budget = int(round(repeat_frac * len(prompt)))
+            pos = max(2, len(prompt) - budget)
+            while pos < len(prompt):
+                src = int(rep_rng.randint(0, pos - 1))
+                span = int(rep_rng.randint(2, 5))
+                span = min(span, len(prompt) - pos, pos - src)
+                prompt[pos:pos + span] = prompt[src:src + span]
+                pos += span
         out.append(TraceEntry(i, t, prompt, m))
     return out
 

@@ -972,6 +972,10 @@ COVERED_ELSEWHERE = {
     # sweep harness)
     "kv_cache_append": "test_serving",
     "paged_attention": "test_serving",
+    # in-program sampling head — tests/test_spec_decode.py (RNG-lane
+    # determinism + filter-support oracles; the categorical draw has no
+    # closed-form reference for the one-op sweep harness)
+    "sample_token": "test_spec_decode",
     # fused BN(+add)+act — tests/test_fused_bn.py
     "fused_batch_norm_act": "test_fused_bn",
     "fused_bn_add_activation": "test_fused_bn",

@@ -74,6 +74,10 @@ def build_args():
     ap.add_argument("--prefix-len", type=int, default=0,
                     help="shared-prefix tokens in the seeded trace")
     ap.add_argument("--prefix-share", type=float, default=0.8)
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decoding draft length (r21); the "
+                         "accepted column + spec accept-rate section "
+                         "light up")
     ap.add_argument("--slo-ttft-ms", type=float, default=200.0,
                     help="TTFT target in ms (0 = unset)")
     ap.add_argument("--slo-token-ms", type=float, default=100.0,
@@ -129,6 +133,12 @@ def trace_rows(traces):
                 "cached_tokens", 0)) if prefills else 0,
             "prefill_chunks": int(prefills[-1].attrs.get(
                 "chunks", 1)) if prefills else 0,
+            # r21 column: draft tokens the verify calls accepted (the
+            # accepted attr only exists when spec-decode engaged — a
+            # monolithic decode_step counts 0)
+            "accepted_tokens": sum(
+                int(s.attrs.get("accepted", 0))
+                for s in tr.spans_named("decode_step")),
             "ttft_s": root.attrs.get("ttft_s"),
             "tokens": root.attrs.get("tokens"),
         })
@@ -196,7 +206,8 @@ def main(argv=None) -> int:
                         prefill_bucket_min=4, seed=args.seed,
                         admission_policy=args.policy,
                         prefix_cache=args.prefix_cache or None,
-                        prefill_chunk=args.chunk_tokens)
+                        prefill_chunk=args.chunk_tokens,
+                        spec_k=args.spec_k or None)
     trace = poisson_trace(
         args.requests, args.rate, cfg.vocab_size,
         prompt_len_range=(args.prompt_min, args.prompt_max),
@@ -246,7 +257,7 @@ def main(argv=None) -> int:
         print(f"{'req':>6} {'outcome':>9} {'queue_s':>9} "
               f"{'prefill_ms':>11} {'decode_ms':>10} {'steps':>6} "
               f"{'preempt':>8} {'cached':>7} {'chunks':>7} "
-              f"{'ttft_s':>9} {'tokens':>7}")
+              f"{'accepted':>9} {'ttft_s':>9} {'tokens':>7}")
         for r in rows[:20]:
             ttft = ("-" if r["ttft_s"] is None
                     else f"{r['ttft_s']:.5f}")
@@ -254,6 +265,7 @@ def main(argv=None) -> int:
                   f"{r['prefill_ms']:>11.3f} {r['decode_ms']:>10.3f} "
                   f"{r['decode_steps']:>6} {r['preempt_cycles']:>8} "
                   f"{r['cached_tokens']:>7} {r['prefill_chunks']:>7} "
+                  f"{r['accepted_tokens']:>9} "
                   f"{ttft:>9} {r['tokens'] if r['tokens'] is not None else '-':>7}")
         if len(rows) > 20:
             print(f"... {len(rows) - 20} more")
@@ -280,6 +292,15 @@ def main(argv=None) -> int:
         "shed": {"count": eng.stats["shed"],
                  "rate": round(eng.stats["shed"] / max(args.requests, 1),
                                6)},
+        # r21: verify-call acceptance over the measured replay (zeros
+        # with spec off — the keys are unconditional, like the stats)
+        "spec": {"spec_k": args.spec_k,
+                 "proposed": int(eng.stats["spec_proposed"]),
+                 "accepted": int(eng.stats["spec_accepted"]),
+                 "accept_rate": round(
+                     eng.stats["spec_accepted"]
+                     / eng.stats["spec_proposed"], 4)
+                 if eng.stats["spec_proposed"] else 0.0},
         "agrees_with_loadgen": bool(agrees),
         "spans_reconcile": bool(reconciles),
         "reconciliation": recon,
