@@ -385,7 +385,7 @@ unbind unfold uniform_random uniform_random_batch_size_like unique
 unique_with_counts unpool unsqueeze unsqueeze2 unstack
 update_loss_scaling var_conv_2d warpctc
 where where_index while_loop_grad write_to_array yolo_box yolov3_loss
-select_input select_output kv_cache_append
+select_input select_output kv_cache_append kv_dequant
 allreduce alltoall barrier broadcast c_allreduce_max c_allreduce_min
 c_allreduce_prod c_allreduce_sum c_broadcast c_comm_init c_comm_init_all
 c_gen_nccl_id c_identity c_reducescatter c_split c_sync_calc_stream
@@ -404,6 +404,7 @@ fusion_squared_mat_sub fusion_transpose_flatten_concat
 # Audit notes (what kept suspects OFF the default list): in-place
 # psum-style allreduces write their input (no second buffer);
 # `kv_cache_append` scatters in place into the donated pool;
+# `kv_dequant` is an elementwise cast(+scale) into its declared slot;
 # `c_identity`/`c_split` are views.  ON the explicit table instead:
 # fused bucket collectives (flat concat payload), `c_allgather` /
 # `c_concat` (ndev x payload), `coalesce_tensor` (flat FusedOutput),

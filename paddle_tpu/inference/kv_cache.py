@@ -82,6 +82,12 @@ class KVCacheConfig:
         no-ops."""
         return self.num_pages * self.page_size
 
+    @property
+    def quantized(self) -> bool:
+        """True when the pool dtype needs a parallel scale pool (int8:
+        pages store ``round(x / scale * 127)`` per (kv_head, page))."""
+        return self.dtype == "int8"
+
     def pool_shape(self):
         return (self.num_kv_heads, self.num_pages, self.page_size,
                 self.head_dim)
@@ -90,6 +96,22 @@ class KVCacheConfig:
         """One zeroed host-side pool (K or V, one layer); the engine
         stages it to the device once via scope.set + device_put."""
         return np.zeros(self.pool_shape(), dtype=self.dtype)
+
+    def scale_shape(self):
+        """Per-(kv_head, page) absmax scale pool (int8 only)."""
+        return (self.num_kv_heads, self.num_pages)
+
+    def make_scale_pool(self) -> np.ndarray:
+        """Zeroed f32 scale pool — scale 0 marks a never-written page
+        (``kv_cache_append`` raises it monotonically per page)."""
+        return np.zeros(self.scale_shape(), dtype="float32")
+
+    def scale_bytes(self) -> int:
+        """Scale-pool bytes for ONE side (K or V) of ONE layer; 0 for
+        unquantized dtypes (no scale pool exists)."""
+        if not self.quantized:
+            return 0
+        return int(np.prod(self.scale_shape())) * 4
 
 
 @dataclass
@@ -233,6 +255,16 @@ class PagedKVCache:
                      "pages currently mapped by more than one live "
                      "sequence").set(
                          sum(1 for r in self._refs.values() if r > 1))
+        if self.config.dtype != "float32":
+            # published only when quantization is engaged, so the
+            # default-f32 gauge namespace stays byte-identical
+            tm.gauge("kv_quant_scale_bytes",
+                     "per-side per-layer scale-pool bytes backing the "
+                     "quantized KV pool").set(self.config.scale_bytes())
+            tm.gauge("kv_quant_capacity_tokens",
+                     "token slots the quantized pool holds at its fixed "
+                     "byte budget").set(
+                         self.config.num_pages * self.config.page_size)
 
     # -- page pool internals ----------------------------------------------
     def _evict_key(self, page: int):
@@ -605,6 +637,10 @@ class PagedKVCache:
 
     def stats(self) -> dict:
         return {
+            "dtype": self.config.dtype,
+            "scale_bytes": self.config.scale_bytes(),
+            "effective_capacity_tokens":
+                self.config.num_pages * self.config.page_size,
             "pages_total": self.config.num_pages,
             "pages_in_use": self.pages_in_use,
             "peak_pages": self.peak_pages,

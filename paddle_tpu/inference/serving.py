@@ -54,7 +54,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..framework.core import Program
-from ..framework.dtype import VarType
+from ..framework.dtype import VarType, convert_dtype
 from ..framework.place import CPUPlace, TPUPlace
 from ..framework.scope import Scope, scope_guard
 from ..executor import Executor
@@ -163,8 +163,8 @@ class _B:
         return self.blk.create_var(name=name, shape=shape, dtype=dtype,
                                    is_data=True).name
 
-    def param(self, name, shape):
-        return self.blk.create_var(name=name, shape=shape,
+    def param(self, name, shape, dtype=VarType.FP32):
+        return self.blk.create_var(name=name, shape=shape, dtype=dtype,
                                    persistable=True).name
 
     def op(self, type, inputs, outputs, attrs=None):
@@ -241,9 +241,61 @@ def _emit_head(b: _B, logits: str, out_name: str, sampling,
     return out
 
 
+def _kv_pool_params(b: _B, i: int, quant: bool, kv_dtype: str = "float32"):
+    """Declare layer ``i``'s K/V pool vars (plus the int8 scale pools
+    when ``quant``); returns ``(kc, vc, ksc, vsc)`` — scale names are
+    None for unquantized storage, so the default program grows NO new
+    vars (the byte-identity pin).  The pool var descs carry the STORAGE
+    dtype (shape stays (): the runtime pools are scope-priced), so an
+    offline ``progcheck --mem`` of a serialized program can still
+    report what the pool stores."""
+    dt = convert_dtype(kv_dtype)
+    kc = b.param(f"kv_k_{i}", (), dtype=dt)
+    vc = b.param(f"kv_v_{i}", (), dtype=dt)
+    if not quant:
+        return kc, vc, None, None
+    return kc, vc, b.param(f"kv_k_scale_{i}", ()), \
+        b.param(f"kv_v_scale_{i}", ())
+
+
+def _kv_append(b: _B, k3, v3, slot_map, kc, vc, ksc, vsc):
+    """One ``kv_cache_append`` — quantize-on-write when the scale pools
+    ride along (int8 storage)."""
+    ins = {"K": [k3], "V": [v3], "SlotMapping": [slot_map],
+           "KCache": [kc], "VCache": [vc]}
+    outs = {"KCacheOut": [kc], "VCacheOut": [vc]}
+    if ksc is not None:
+        ins["KScale"], ins["VScale"] = [ksc], [vsc]
+        outs["KScaleOut"], outs["VScaleOut"] = [ksc], [vsc]
+    b.op("kv_cache_append", ins, outs)
+
+
+def _kv_gather_deq(b: _B, pool, scale, tables, kv_dtype, tag):
+    """Pool gather for the dense (chunk/verify) attention forms, with
+    the storage-dtype read path: gather pages through the block table,
+    then ``kv_dequant`` back to f32 (int8: the SAME gather applied to
+    the scale pool rides along, so each page meets its own scale).  The
+    f32 path emits the plain gather — byte-identical to the unquantized
+    program."""
+    g = b.tmp(tag)
+    b.op("gather", {"X": [pool], "Index": [tables]}, {"Out": [g]},
+         {"axis": 1})
+    if kv_dtype == "float32":
+        return g
+    ins = {"X": [g]}
+    if scale is not None:
+        sg = b.tmp(tag + "_sc")
+        b.op("gather", {"X": [scale], "Index": [tables]}, {"Out": [sg]},
+             {"axis": 1})
+        ins["Scale"] = [sg]
+    dq = b.tmp(tag + "_dq")
+    b.op("kv_dequant", ins, {"Out": [dq]})
+    return dq
+
+
 def build_decoder_program(cfg: DecoderConfig, mode: str,
-                          sampling: Optional[SamplingParams] = None
-                          ) -> tuple:
+                          sampling: Optional[SamplingParams] = None,
+                          kv_dtype: str = "float32") -> tuple:
     """Build one of the program forms; returns
     ``(program, feed_names, fetch_names)``.
 
@@ -271,9 +323,20 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
     argmax head is replaced by the in-program ``sample_token`` op and
     the program grows a ``sample_seeds`` RNG-lane feed (one lane per
     emitted row).  ``None``/greedy builds the exact default programs.
+
+    ``kv_dtype`` (serving forms only; FLAGS_kv_cache_dtype): the KV
+    pool storage dtype.  "float32" (default) builds the exact legacy
+    programs.  "bfloat16" adds a ``kv_dequant`` cast after every pool
+    gather; "int8" also threads the per-(kv_head, page) scale pools
+    through ``kv_cache_append`` (quantize-on-write) and the reads, so
+    attention always accumulates in f32.  The reference form never
+    touches the pool and ignores it.
     """
     if mode not in ("reference", "prefill", "decode", "chunk", "verify"):
         raise ValueError(f"bad mode {mode!r}")
+    if kv_dtype not in ("float32", "bfloat16", "int8"):
+        raise ValueError(f"bad kv_dtype {kv_dtype!r}")
+    quant = kv_dtype == "int8"
     if _sampled(sampling) and mode == "reference":
         raise ValueError("the reference form is the greedy oracle; "
                          "sampling applies to serving forms only")
@@ -315,21 +378,15 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
             # sees prefix AND chunk through one block table
             k3 = b.reshape(k, [-1, H, D], f"l{i}_k3")
             v3 = b.reshape(v, [-1, H, D], f"l{i}_v3")
-            kc = b.param(f"kv_k_{i}", ())
-            vc = b.param(f"kv_v_{i}", ())
-            b.op("kv_cache_append",
-                 {"K": [k3], "V": [v3], "SlotMapping": [slot_map],
-                  "KCache": [kc], "VCache": [vc]},
-                 {"KCacheOut": [kc], "VCacheOut": [vc]})
+            kc, vc, ksc, vsc = _kv_pool_params(b, i, quant, kv_dtype)
+            _kv_append(b, k3, v3, slot_map, kc, vc, ksc, vsc)
             q4 = b.transpose(b.reshape(q, [0, 0, H, D]), [0, 2, 1, 3],
                              f"l{i}_q4")                 # (1, H, S, D)
-            kg = b.tmp(f"l{i}_kg")
-            b.op("gather", {"X": [kc], "Index": [tables]},
-                 {"Out": [kg]}, {"axis": 1})             # (H, W, ps, D)
+            kg = _kv_gather_deq(b, kc, ksc, tables, kv_dtype,
+                                f"l{i}_kg")              # (H, W, ps, D)
             k4 = b.reshape(kg, [1, H, -1, D], f"l{i}_k4")  # (1, H, C, D)
-            vg = b.tmp(f"l{i}_vg")
-            b.op("gather", {"X": [vc], "Index": [tables]},
-                 {"Out": [vg]}, {"axis": 1})
+            vg = _kv_gather_deq(b, vc, vsc, tables, kv_dtype,
+                                f"l{i}_vg")
             v4 = b.reshape(vg, [1, H, -1, D], f"l{i}_v4")
             s = b.matmul(q4, k4, transpose_Y=True, alpha=D ** -0.5,
                          tag=f"l{i}_qk")                 # (1, H, S, C)
@@ -385,25 +442,18 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
             # batch), so the per-row gather sees prefix AND chunk
             k3 = b.reshape(k, [-1, H, D], f"l{i}_k3")       # (B*S, H, D)
             v3 = b.reshape(v, [-1, H, D], f"l{i}_v3")
-            kc = b.param(f"kv_k_{i}", ())
-            vc = b.param(f"kv_v_{i}", ())
-            b.op("kv_cache_append",
-                 {"K": [k3], "V": [v3], "SlotMapping": [slot_map],
-                  "KCache": [kc], "VCache": [vc]},
-                 {"KCacheOut": [kc], "VCacheOut": [vc]})
+            kc, vc, ksc, vsc = _kv_pool_params(b, i, quant, kv_dtype)
+            _kv_append(b, k3, v3, slot_map, kc, vc, ksc, vsc)
             q4 = b.transpose(b.reshape(q, [0, 0, H, D]), [0, 2, 1, 3],
                              f"l{i}_q4")                    # (B, H, S, D)
             # per-row block-table gather: (H, P, ps, D) indexed by the
-            # (B, W) tables -> (H, B, W, ps, D), batch-major, flattened
-            # to each row's context window
-            kg = b.tmp(f"l{i}_kg")
-            b.op("gather", {"X": [kc], "Index": [tables]},
-                 {"Out": [kg]}, {"axis": 1})
+            # (B, W) tables -> (H, B, W, ps, D) (dequantized back to f32
+            # for quantized storage), batch-major, flattened to each
+            # row's context window
+            kg = _kv_gather_deq(b, kc, ksc, tables, kv_dtype, f"l{i}_kg")
             k4 = b.reshape(b.transpose(kg, [1, 0, 2, 3, 4]),
                            [0, 0, -1, D], f"l{i}_k4")       # (B, H, C, D)
-            vg = b.tmp(f"l{i}_vg")
-            b.op("gather", {"X": [vc], "Index": [tables]},
-                 {"Out": [vg]}, {"axis": 1})
+            vg = _kv_gather_deq(b, vc, vsc, tables, kv_dtype, f"l{i}_vg")
             v4 = b.reshape(b.transpose(vg, [1, 0, 2, 3, 4]),
                            [0, 0, -1, D], f"l{i}_v4")
             s = b.matmul(q4, k4, transpose_Y=True, alpha=D ** -0.5,
@@ -470,15 +520,17 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
             q3 = b.reshape(q, [0, H, D], f"l{i}_q3")     # (B, H, D)
             k3 = b.reshape(k, [0, H, D], f"l{i}_k3")
             v3 = b.reshape(v, [0, H, D], f"l{i}_v3")
-            kc, vc = b.param(f"kv_k_{i}", ()), b.param(f"kv_v_{i}", ())
-            b.op("kv_cache_append",
-                 {"K": [k3], "V": [v3], "SlotMapping": [slot_map],
-                  "KCache": [kc], "VCache": [vc]},
-                 {"KCacheOut": [kc], "VCacheOut": [vc]})
+            kc, vc, ksc, vsc = _kv_pool_params(b, i, quant, kv_dtype)
+            _kv_append(b, k3, v3, slot_map, kc, vc, ksc, vsc)
             att = b.tmp(f"l{i}_att")
-            b.op("paged_attention",
-                 {"Q": [q3], "KCache": [kc], "VCache": [vc],
-                  "BlockTables": [tables], "ContextLens": [ctx_lens]},
+            pa_ins = {"Q": [q3], "KCache": [kc], "VCache": [vc],
+                      "BlockTables": [tables], "ContextLens": [ctx_lens]}
+            if quant:
+                # the kernel dequantizes per page inside its online-
+                # softmax loop — quantized pages never round-trip
+                # through a dense f32 gather
+                pa_ins["KScale"], pa_ins["VScale"] = [ksc], [vsc]
+            b.op("paged_attention", pa_ins,
                  {"Out": [att]}, {"scale": float(D ** -0.5)})
             ctxv = b.reshape(att, [0, h], f"l{i}_ctx")
         else:
@@ -496,12 +548,8 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
                 # slots; padded bucket positions carry the drop sentinel
                 k3 = b.reshape(k, [-1, H, D], f"l{i}_k3")
                 v3 = b.reshape(v, [-1, H, D], f"l{i}_v3")
-                kc = b.param(f"kv_k_{i}", ())
-                vc = b.param(f"kv_v_{i}", ())
-                b.op("kv_cache_append",
-                     {"K": [k3], "V": [v3], "SlotMapping": [slot_map],
-                      "KCache": [kc], "VCache": [vc]},
-                     {"KCacheOut": [kc], "VCacheOut": [vc]})
+                kc, vc, ksc, vsc = _kv_pool_params(b, i, quant, kv_dtype)
+                _kv_append(b, k3, v3, slot_map, kc, vc, ksc, vsc)
             s = b.matmul(q4, k4, transpose_Y=True, alpha=D ** -0.5,
                          tag=f"l{i}_qk")
             s = b.add(s, mask, f"l{i}_masked")
@@ -883,13 +931,22 @@ class _EngineCore:
                  prefix_cache: Optional[bool] = None,
                  prefix_seed: int = 0,
                  sampling: Optional[SamplingParams] = None,
-                 sample_seed: int = 0):
+                 sample_seed: int = 0,
+                 kv_dtype: Optional[str] = None,
+                 kv_budget_mb: float = 0.0):
+        from ..utils.flags import flag
+
         self.cfg = cfg
         # greedy sampling normalizes to None: the serving programs are
         # then built EXACTLY as before (argmax head, no seeds feed) —
         # the flag-off bit-identity baseline
         self.sampling = sampling if _sampled(sampling) else None
         self.sample_seed = int(sample_seed)
+        if kv_dtype is None:
+            kv_dtype = str(flag("kv_cache_dtype", "float32") or "float32")
+        if kv_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(f"bad kv_cache_dtype {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
         if place is None:
             import paddle_tpu as pt
 
@@ -898,10 +955,21 @@ class _EngineCore:
         self.scope = Scope()
         self.exe = Executor(place)
         self.prefill_bucket_min = prefill_bucket_min
+        if kv_budget_mb and kv_budget_mb > 0:
+            # pool sizing from a FIXED byte budget: page count is what
+            # the budget buys at the storage dtype, so a cheaper dtype
+            # is more CAPACITY at the same HBM (2x bf16 / 4x int8 —
+            # the scale pool is charged as overhead on top, ~1.6% at
+            # the default page geometry, not folded into the divisor:
+            # folding it in would turn the exact 4x into 3.94x)
+            page_bytes = (2 * cfg.num_layers * cfg.num_heads * page_size
+                          * cfg.head_dim * np.dtype(kv_dtype).itemsize)
+            num_pages = max(1, int(kv_budget_mb * (1 << 20)) // page_bytes)
+        self.kv_budget_mb = float(kv_budget_mb or 0.0)
         self.kv_config = KVCacheConfig(
             num_pages=num_pages, page_size=page_size,
             num_kv_heads=cfg.num_heads, head_dim=cfg.head_dim,
-            num_layers=cfg.num_layers)
+            num_layers=cfg.num_layers, dtype=kv_dtype)
         self.kv = PagedKVCache(self.kv_config, prefix_cache=prefix_cache,
                                seed=prefix_seed)
         self._chunk = None   # (prog, feeds, fetch) — built on first use
@@ -910,9 +978,11 @@ class _EngineCore:
         self.ref_prog, self.ref_feeds, self.ref_fetch = \
             build_decoder_program(cfg, "reference")
         self.prefill_prog, self.prefill_feeds, self.prefill_fetch = \
-            build_decoder_program(cfg, "prefill", sampling=self.sampling)
+            build_decoder_program(cfg, "prefill", sampling=self.sampling,
+                                  kv_dtype=kv_dtype)
         self.decode_prog, self.decode_feeds, self.decode_fetch = \
-            build_decoder_program(cfg, "decode", sampling=self.sampling)
+            build_decoder_program(cfg, "decode", sampling=self.sampling,
+                                  kv_dtype=kv_dtype)
         self.mha_fused = 0
         if use_mha_fusion:
             # the serving pass pipeline: the naive composition the
@@ -940,6 +1010,13 @@ class _EngineCore:
                            device_put_owned(self.kv_config.make_pool(), dev))
             self.scope.set(f"kv_v_{i}",
                            device_put_owned(self.kv_config.make_pool(), dev))
+            if self.kv_config.quantized:
+                self.scope.set(
+                    f"kv_k_scale_{i}",
+                    device_put_owned(self.kv_config.make_scale_pool(), dev))
+                self.scope.set(
+                    f"kv_v_scale_{i}",
+                    device_put_owned(self.kv_config.make_scale_pool(), dev))
 
     @classmethod
     def from_model_dir(cls, model_dir: str, **kw) -> "_EngineCore":
@@ -961,7 +1038,8 @@ class _EngineCore:
         never constructs it, keeping its host path identical)."""
         if self._chunk is None:
             self._chunk = build_decoder_program(self.cfg, "chunk",
-                                                sampling=self.sampling)
+                                                sampling=self.sampling,
+                                                kv_dtype=self.kv_dtype)
         return self._chunk
 
     @property
@@ -970,7 +1048,8 @@ class _EngineCore:
         spec-off engine never constructs it)."""
         if self._verify is None:
             self._verify = build_decoder_program(self.cfg, "verify",
-                                                 sampling=self.sampling)
+                                                 sampling=self.sampling,
+                                                 kv_dtype=self.kv_dtype)
         return self._verify
 
     def _lane(self, req: Request, offset: int = 0) -> int:
@@ -990,12 +1069,19 @@ class _EngineCore:
         if not forks:
             return
         fn = _fork_copy_fn()
+        names = [f"kv_{side}_{i}" for i in range(self.cfg.num_layers)
+                 for side in ("k", "v")]
+        if self.kv_config.quantized:
+            # pages AND their scales copy verbatim — a fork never
+            # requantizes, so shared pages stay bit-stable (pinned)
+            names += [f"kv_{side}_scale_{i}"
+                      for i in range(self.cfg.num_layers)
+                      for side in ("k", "v")]
         for src, dst, _used in forks:
             s = np.int32(src)
             d = np.int32(dst)
-            for i in range(self.cfg.num_layers):
-                for nm in (f"kv_k_{i}", f"kv_v_{i}"):
-                    self.scope.set(nm, fn(self.scope.get(nm), s, d))
+            for nm in names:
+                self.scope.set(nm, fn(self.scope.get(nm), s, d))
 
     def start_prefill(self, req: Request) -> _PrefillJob:
         """Open a prefill job: with prefix caching on, map every
@@ -1272,10 +1358,12 @@ class _EngineCore:
     def kv_pool_resident_bytes(self) -> int:
         """Device bytes pinned by the paged K/V pools for the engine's
         lifetime: 2 pools (K and V) per layer at the allocator's fixed
-        shape — the ``kv_pool`` resident block the static planner
+        shape, PLUS the int8 scale pools when the storage is quantized —
+        the ``kv_pool`` resident block the static planner
         (framework/memory_plan.py) charges against the HBM budget."""
         per_pool = int(np.prod(self.kv_config.pool_shape())) * \
             np.dtype(self.kv_config.dtype).itemsize
+        per_pool += self.kv_config.scale_bytes()
         return 2 * self.cfg.num_layers * per_pool
 
     def memory_stats(self) -> dict:
@@ -1299,6 +1387,10 @@ class _EngineCore:
             measured = {"peak_bytes": 0, "source": "unavailable"}
         return {
             "kv_pool_resident_bytes": self.kv_pool_resident_bytes(),
+            "kv_pool_dtype": self.kv_config.dtype,
+            "kv_pool_scale_bytes": int(
+                2 * self.cfg.num_layers * self.kv_config.scale_bytes()),
+            "kv_pool_capacity_tokens": int(ps["effective_capacity_tokens"]),
             "kv_pool_peak_token_bytes": int(
                 ps["peak_pages"] * self.kv_config.page_size * token_bytes),
             "kv_pool_peak_pages": int(ps["peak_pages"]),
@@ -1353,6 +1445,7 @@ class ServingEngine:
                 cfg, weights or init_decoder_weights(cfg, seed), **core_kw)
         self.cfg = self.core.cfg
         self.kv = self.core.kv
+        self.kv_dtype = self.core.kv_dtype
         self.max_batch = max_batch
         self.token_budget = token_budget
         self.policy = get_policy(admission_policy)

@@ -774,7 +774,8 @@ def flash_attention_bwd_res(q, k, v, out, lse, do, bias=None, causal=False,
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
-                              context_lens, scale=None):
+                              context_lens, scale=None,
+                              k_scale=None, v_scale=None):
     """Dense gather oracle AND the CPU fallback — exactly the kernel's
     semantics, so tier-1 exercises the same op contract.
 
@@ -787,6 +788,12 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
     token, whose K/V must already be in the pool).
     GQA: q_heads must be a multiple of kv_heads; query head h reads kv
     head ``h // (q_heads // kv_heads)``.
+    k_scale/v_scale: optional (kv_heads, num_pages) f32 per-page absmax
+    scales for int8 pools — pages dequantize as ``q * scale / 127``
+    right after the gather, and attention runs in f32 from there.  A
+    bf16 pool (no scales) casts to f32 after the gather instead, so
+    every quantized dtype accumulates attention in full precision; the
+    f32 path is untouched (the cast is a trace-time no-op).
     """
     n_seqs, n_heads, d = q.shape
     n_kv, _, page_size, _ = k_pages.shape
@@ -794,12 +801,20 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
         scale = 1.0 / (d ** 0.5)
     group = n_heads // n_kv
     flat = block_tables.reshape(-1)
-    # (kv_heads, seqs, pages*page_size, d) — sized by the BUCKETED table
+    # (kv_heads, seqs*pages, page_size, d) — sized by the BUCKETED table
     # width (longest active sequence), not the model max
-    k = jnp.take(k_pages, flat, axis=1).reshape(
-        n_kv, n_seqs, -1, d)
-    v = jnp.take(v_pages, flat, axis=1).reshape(
-        n_kv, n_seqs, -1, d)
+    k = jnp.take(k_pages, flat, axis=1)
+    v = jnp.take(v_pages, flat, axis=1)
+    if k_scale is not None:
+        ks = jnp.take(k_scale, flat, axis=1)[..., None, None]
+        vs = jnp.take(v_scale, flat, axis=1)[..., None, None]
+        k = k.astype(jnp.float32) * ks / 127.0
+        v = v.astype(jnp.float32) * vs / 127.0
+    elif k.dtype != jnp.float32:
+        k = k.astype(jnp.float32)
+        v = v.astype(jnp.float32)
+    k = k.reshape(n_kv, n_seqs, -1, d)
+    v = v.reshape(n_kv, n_seqs, -1, d)
     k = jnp.repeat(k, group, axis=0).transpose(1, 0, 2, 3)
     v = jnp.repeat(v, group, axis=0).transpose(1, 0, 2, 3)
     s = jnp.einsum("bhd,bhkd->bhk", q, k,
@@ -810,12 +825,22 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
     return jnp.einsum("bhk,bhkd->bhd", p.astype(v.dtype), v).astype(q.dtype)
 
 
-def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, scale, page_size,
-                         n_pages):
+def _paged_decode_kernel(*refs, scale, page_size, n_pages, group, quant):
     """One (seq, head, page) step of the ragged decode walk: online
     softmax over the page's (page_size, d) K/V tile, accumulated in VMEM
-    scratch exactly like the flash kernel's kv walk."""
+    scratch exactly like the flash kernel's kv walk.
+
+    ``quant`` (static): two extra scalar-prefetch refs carry the
+    per-(kv_head, page) int8 absmax scales; the page's K/V tiles
+    dequantize to f32 (``q * scale / 127``) INSIDE the loop — HBM
+    traffic stays int8, both dots accumulate in f32.  A bf16 pool (no
+    scales) casts its tiles to f32 the same way."""
+    if quant:
+        (bt_ref, cl_ref, ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        (bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
     i = pl.program_id(2)
 
     @pl.when(i == 0)
@@ -824,14 +849,26 @@ def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    ctx = cl_ref[pl.program_id(0)]
+    b_idx = pl.program_id(0)
+    ctx = cl_ref[b_idx]
     start = i * page_size
+    if quant:
+        page = bt_ref[b_idx, i]
+        h_kv = pl.program_id(1) // group
+        k_deq = ks_ref[h_kv, page] / 127.0
+        v_deq = vs_ref[h_kv, page] / 127.0
 
     @pl.when(start < ctx)
     def _page():
         q = q_ref[0]                                   # (1, d)
         k = k_ref[0, 0]                                # (page_size, d)
         v = v_ref[0, 0]
+        if quant:
+            k = k.astype(jnp.float32) * k_deq
+            v = v.astype(jnp.float32) * v_deq
+        elif k_ref.dtype != jnp.float32:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         cols = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -855,22 +892,25 @@ def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_decode_call(q, k_pages, v_pages, block_tables, context_lens,
-                       scale):
+                       scale, k_scale=None, v_scale=None):
     n_seqs, n_heads, d = q.shape
     n_kv, _, page_size, _ = k_pages.shape
     group = n_heads // n_kv
     n_pages = block_tables.shape[1]
+    quant = k_scale is not None
 
-    def _q_idx(b, h, i, bt, cl):
+    def _q_idx(b, h, i, bt, cl, *_):
         return (b, h, 0)
 
-    def _kv_idx(b, h, i, bt, cl):
+    def _kv_idx(b, h, i, bt, cl, *_):
         # the page to stream is data-dependent: the block table is a
         # scalar-prefetch arg, so the index map reads it before the body
         return (h // group, bt[b, i], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        # the int8 scale pools ride as scalar-prefetch args too — tiny
+        # (kv_heads, num_pages) f32 tables indexed per (head, page)
+        num_scalar_prefetch=4 if quant else 2,
         grid=(n_seqs, n_heads, n_pages),
         in_specs=[
             pl.BlockSpec((1, 1, d), _q_idx),
@@ -884,14 +924,20 @@ def _paged_decode_call(q, k_pages, v_pages, block_tables, context_lens,
             pltpu.VMEM((1, d), jnp.float32),
         ],
     )
-    return pl.pallas_call(
+    call = pl.pallas_call(
         functools.partial(_paged_decode_kernel, scale=scale,
-                          page_size=page_size, n_pages=n_pages),
+                          page_size=page_size, n_pages=n_pages,
+                          group=group, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
-      q, k_pages, v_pages)
+    )
+    bt = block_tables.astype(jnp.int32)
+    cl = context_lens.astype(jnp.int32)
+    if quant:
+        return call(bt, cl, k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32), q, k_pages, v_pages)
+    return call(bt, cl, q, k_pages, v_pages)
 
 
 # ==========================================================================
@@ -1171,17 +1217,20 @@ def matmul_bias_act(x, w, bias, act=""):
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
-                    scale=None):
+                    scale=None, k_scale=None, v_scale=None):
     """Ragged paged attention for decode (one query token per sequence).
 
-    Shapes as in :func:`paged_attention_reference`.  Takes the Pallas
-    kernel on TPU (or under PT_PALLAS_INTERPRET=1); PT_PAGED_ATTENTION=0
-    forces the gather fallback, =1 forces the kernel past the backend
-    check (combine with PT_PALLAS_INTERPRET=1 off-TPU — a forced kernel
-    on plain CPU fails loudly rather than silently measuring the
-    fallback).  Hard shape constraints always gate: head_dim and
-    page_size multiples of 8 (sublane), q_heads a multiple of kv_heads;
-    anything else falls back."""
+    Shapes as in :func:`paged_attention_reference`; ``k_scale`` /
+    ``v_scale`` are the optional int8 per-(kv_head, page) scale pools
+    (quantized pages dequantize inside the kernel's online-softmax
+    loop, so HBM traffic shrinks with the storage dtype).  Takes the
+    Pallas kernel on TPU (or under PT_PALLAS_INTERPRET=1);
+    PT_PAGED_ATTENTION=0 forces the gather fallback, =1 forces the
+    kernel past the backend check (combine with PT_PALLAS_INTERPRET=1
+    off-TPU — a forced kernel on plain CPU fails loudly rather than
+    silently measuring the fallback).  Hard shape constraints always
+    gate: head_dim and page_size multiples of 8 (sublane), q_heads a
+    multiple of kv_heads; anything else falls back."""
     n_seqs, n_heads, d = q.shape
     n_kv = k_pages.shape[0]
     page_size = k_pages.shape[2]
@@ -1192,6 +1241,8 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     eligible = shape_ok and (_use_pallas() or force == "1")
     if force == "0" or not eligible:
         return paged_attention_reference(q, k_pages, v_pages, block_tables,
-                                         context_lens, scale)
+                                         context_lens, scale,
+                                         k_scale=k_scale, v_scale=v_scale)
     return _paged_decode_call(q, k_pages, v_pages, block_tables,
-                              context_lens, scale)
+                              context_lens, scale,
+                              k_scale=k_scale, v_scale=v_scale)

@@ -234,6 +234,24 @@ _DEFAULTS: Dict[str, Any] = {
     # 0 (default): the r20 decode loop runs byte-identically (pinned
     # by test).
     "FLAGS_spec_decode_k": 0,
+    # quantized KV page pool (inference/kv_cache.py + ops/paged_ops.py):
+    # the serving engine stores K/V pages in this dtype — "bfloat16"
+    # halves pool bytes, "int8" quarters them and carries a
+    # per-(kv_head, page) absmax scale in a parallel f32 scale pool
+    # (~1.6% overhead at page_size=16/head_dim=32).  Every attention
+    # read (paged decode kernel + jnp fallback, chunk and spec-verify
+    # gathers) dequantizes inline and accumulates in f32; writes
+    # quantize in-program (int8: monotone per-page scale with touched-
+    # page requant, so append order never rescales untouched pages
+    # destructively).  CoW forks copy pages+scales verbatim, truncate
+    # leaves surviving scales alone, and the prefix digest is a
+    # function of token ids only, so prefix hits stay dtype-
+    # independent.  The engine derives num_pages from a fixed byte
+    # budget, so the dtype buys 2x/4x pool CAPACITY at the same HBM,
+    # not just cheaper bytes.  "float32" (default): byte-identical to
+    # the unquantized engine — no scale pool, no extra program vars
+    # (pinned by test).
+    "FLAGS_kv_cache_dtype": "float32",
     # in-program sampling (ops/sampling_ops.py): when > 0, decode/
     # prefill/chunk/verify programs end in the sample_token op
     # (temperature + engine-level top-k/top-p) under per-slot RNG lane

@@ -1372,6 +1372,9 @@ COVERED_ELSEWHERE.update({
     "einsum": "test_layers_tail",
     # r20 AMP dynamic loss scaling — tests/test_numerics.py
     "update_loss_scaling": "test_numerics",
+    # r22 KV quantization — tests/test_kv_quant.py (roundtrip bounds,
+    # scale rules, kernel parity) + quantized engine runs
+    "kv_dequant": "test_kv_quant",
 })
 COVERED_ELSEWHERE.update({
     # r4 long-tail corpus — tests/test_long_tail_ops.py (NumPy oracles)

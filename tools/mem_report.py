@@ -222,6 +222,68 @@ def run_config(kind: str, collective: bool, stage: int, ndev: int,
     return row
 
 
+def serving_kv_rows():
+    """The r23 serving-side reconciliation: one row per KV storage
+    dtype (``FLAGS_kv_cache_dtype``) on a tiny decode engine at a FIXED
+    byte budget.  The planner's ``kv_pool`` class must EQUAL the
+    engine's census for every dtype — both count the pools at their
+    storage itemsize plus the int8 scale pools — and the row carries
+    the capacity the dtype buys (pages, tokens, tokens/GB) at the same
+    bytes."""
+    from paddle_tpu.framework import memory_plan as mp
+    from paddle_tpu.inference.serving import (DecoderConfig, _EngineCore,
+                                              init_decoder_weights)
+
+    cfg = DecoderConfig(vocab_size=32, hidden=16, num_heads=2,
+                        num_layers=2, max_seq_len=32)
+    page_size = 4
+    page_bytes_f32 = (2 * cfg.num_layers * cfg.num_heads * page_size
+                      * cfg.head_dim * 4)
+    budget_mb = 16 * page_bytes_f32 / _MB
+    rows = []
+    for dtype in ("float32", "bfloat16", "int8"):
+        core = _EngineCore(cfg, init_decoder_weights(cfg),
+                           page_size=page_size, kv_dtype=dtype,
+                           kv_budget_mb=budget_mb)
+        plan = mp.plan_memory(core.decode_prog,
+                              feed_names=core.decode_feeds,
+                              fetch_names=core.decode_fetch,
+                              scope=core.scope)
+        modeled = int(plan.resident_by_class["kv_pool"])
+        census = int(core.kv_pool_resident_bytes())
+        ms = core.memory_stats()
+        tokens = core.kv_config.num_pages * page_size
+        rows.append({
+            "dtype": dtype,
+            "num_pages": int(core.kv_config.num_pages),
+            "modeled_kv_pool_bytes": modeled,
+            "census_kv_pool_bytes": census,
+            "modeled_eq_census": bool(modeled == census),
+            "scale_pool_bytes": int(ms["kv_pool_scale_bytes"]),
+            "capacity_tokens": int(tokens),
+            "tokens_per_gb": int((1 << 30) * tokens
+                                 // max(int(budget_mb * _MB), 1)),
+        })
+    return {"budget_mb": round(budget_mb, 6), "rows": rows,
+            "all_reconciled": bool(all(r["modeled_eq_census"]
+                                       for r in rows))}
+
+
+def format_serving_kv(section):
+    lines = [f"serving kv_pool @ {section['budget_mb']:.4f}MB budget:",
+             f"  {'dtype':<10} {'pages':>6} {'modeled':>9} {'census':>9} "
+             f"{'eq':>3} {'scale_B':>8} {'tokens':>7} {'tok/GB':>9}"]
+    for r in section["rows"]:
+        lines.append(
+            f"  {r['dtype']:<10} {r['num_pages']:>6} "
+            f"{r['modeled_kv_pool_bytes']:>9} "
+            f"{r['census_kv_pool_bytes']:>9} "
+            f"{'ok' if r['modeled_eq_census'] else 'NO':>3} "
+            f"{r['scale_pool_bytes']:>8} {r['capacity_tokens']:>7} "
+            f"{r['tokens_per_gb']:>9}")
+    return "\n".join(lines)
+
+
 def format_rows(rows):
     hdr = (f"{'path':<10} {'stage':>5} {'modeled_peak':>13} "
            f"{'modeled_state':>14} {'measured':>10} {'agree%':>7} "
@@ -298,6 +360,15 @@ def main(argv=None) -> int:
             rows.append(run_config(args.probe, collective, stage,
                                    args.ndev, args.steps))
     checks, ok = check_rows(rows, args.ndev)
+    # the r23 serving-side pin: modeled == census for every KV storage
+    # dtype (the quantized pools + int8 scale pools price correctly)
+    serving_kv = serving_kv_rows()
+    if not serving_kv["all_reconciled"]:
+        checks["failures"].append(
+            "serving kv_pool: modeled != census for "
+            + ", ".join(r["dtype"] for r in serving_kv["rows"]
+                        if not r["modeled_eq_census"]))
+        ok = False
     budget = {}
     if args.budget_mb:
         budget = {
@@ -308,10 +379,12 @@ def main(argv=None) -> int:
     payload = {
         "probe": args.probe, "ndev": args.ndev, "steps": args.steps,
         "quick": bool(args.quick), "rows": rows, "checks": checks,
-        "ok": ok, **({"budget": budget} if budget else {}),
+        "serving_kv": serving_kv, "ok": ok,
+        **({"budget": budget} if budget else {}),
     }
     if not args.json:
         print(format_rows(rows))
+        print(format_serving_kv(serving_kv))
         for f in checks["failures"]:
             print(f"CHECK FAIL: {f}")
     print("MEM=" + json.dumps(payload, sort_keys=True))

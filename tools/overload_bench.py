@@ -86,6 +86,14 @@ def build_args():
     ap.add_argument("--chaos", default="req_burst=8@10;pool_spike=20@16:12",
                     help="serving-fault schedule replayed for BOTH "
                          "policies ('' = none)")
+    ap.add_argument("--kv-dtype", default="",
+                    choices=["", "bfloat16", "int8"],
+                    help="arm the kv_quant A/B: replay the SAME trace + "
+                         "chaos schedule per policy with the quantized "
+                         "KV pool at the f32 pool's byte budget (2-4x "
+                         "pages at fixed HBM) — shed rate and preemption "
+                         "pressure must not regress and must improve in "
+                         "aggregate ('' = off)")
     ap.add_argument("--max-steps", type=int, default=5000,
                     help="starvation bound on engine steps per policy")
     ap.add_argument("--policies", default="fifo,slo_aware")
@@ -96,10 +104,13 @@ def build_args():
     return ap
 
 
-def drive(policy: str, args, cfg, trace, prefix_cache: bool = False):
+def drive(policy: str, args, cfg, trace, prefix_cache: bool = False,
+          kv_dtype: str = "", kv_budget_mb: float = 0.0):
     """One policy's full run: fresh engine, fresh telemetry/tracing/
     chaos state, deterministic logical clock.  ``prefix_cache`` arms
-    the CoW prefix cache (the shared-prefix A/B pass)."""
+    the CoW prefix cache (the shared-prefix A/B pass); ``kv_dtype`` +
+    ``kv_budget_mb`` arm the quantized-pool pass (num_pages derived
+    from the byte budget instead of --num-pages)."""
     import numpy as np
 
     from paddle_tpu.inference.serving import Request, ServingEngine
@@ -114,12 +125,14 @@ def drive(policy: str, args, cfg, trace, prefix_cache: bool = False):
         ttft_s=args.slo_ttft or None, token_s=args.slo_token or None,
         objective=args.objective, window=args.window)
 
+    kv_kw = (dict(kv_dtype=kv_dtype, kv_budget_mb=kv_budget_mb)
+             if kv_dtype else {})
     eng = ServingEngine(cfg, num_pages=args.num_pages,
                         page_size=args.page_size, max_batch=args.max_batch,
                         token_budget=args.token_budget,
                         prefill_bucket_min=4, seed=args.seed,
                         admission_policy=policy,
-                        prefix_cache=prefix_cache)
+                        prefix_cache=prefix_cache, **kv_kw)
     pending = sorted(trace, key=lambda e: (e.arrival, e.req_id))
     burst_rng = np.random.RandomState(args.seed + 9173)
     reqs, rejected = {}, {}
@@ -204,6 +217,8 @@ def drive(policy: str, args, cfg, trace, prefix_cache: bool = False):
         "preempted": eng.stats["preempted"],
         "scheduler": dict(eng.stats),
         "prefix_cache": eng.kv.stats()["prefix_cache"],
+        "kv_pool": {"dtype": eng.kv_dtype,
+                    "num_pages": eng.core.kv_config.num_pages},
     }
 
 
@@ -218,6 +233,8 @@ def main(argv=None) -> int:
         args.slo_ttft = args.slo_ttft or 0.3
         args.chaos = "req_burst=6@6;pool_spike=20@10:8"
         args.max_steps = min(args.max_steps, 2000)
+        if not args.kv_dtype:
+            args.kv_dtype = "int8"  # the quick kv-quant headroom oracle
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from paddle_tpu.inference.serving import DecoderConfig
@@ -288,6 +305,59 @@ def main(argv=None) -> int:
             "comparison": p_comparison,
         }
 
+    # the r23 pass: the SAME trace + chaos schedule per policy with the
+    # quantized KV pool at the f32 pool's BYTE budget — 2-4x pages at
+    # fixed HBM.  The capacity must show up as overload headroom: per
+    # policy, shed count and preemption count no worse than the f32
+    # baseline, and in aggregate strictly fewer preemptions (the
+    # pool_spike chaos seizes an absolute page count, so the bigger
+    # pool keeps more sequences resident through the spike).
+    kv_section = None
+    if args.kv_dtype:
+        head_dim = cfg.hidden // cfg.num_heads
+        page_bytes_f32 = (2 * cfg.num_layers * cfg.num_heads
+                          * args.page_size * head_dim * 4)
+        budget_mb = args.num_pages * page_bytes_f32 / float(1 << 20)
+        k_results = {}
+        for policy in policies:
+            k_results[policy] = drive(policy, args, cfg, trace,
+                                      kv_dtype=args.kv_dtype,
+                                      kv_budget_mb=budget_mb)
+            if not args.json:
+                r = k_results[policy]
+                print(f"[kv:{policy}] pages={r['kv_pool']['num_pages']} "
+                      f"outcomes={r['outcomes']} "
+                      f"shed_rate={r['shed_rate']:.3f} "
+                      f"preempted={r['preempted']} "
+                      f"starvation_free={r['starvation_free']}")
+        k_comparison = {}
+        if all(p in results and p in k_results for p in policies):
+            base_shed = sum(results[p]["outcomes"]["shed"]
+                            for p in policies)
+            base_pre = sum(results[p]["preempted"] for p in policies)
+            kv_shed = sum(k_results[p]["outcomes"]["shed"]
+                          for p in policies)
+            kv_pre = sum(k_results[p]["preempted"] for p in policies)
+            k_comparison = {
+                "f32_shed_total": base_shed, "kv_shed_total": kv_shed,
+                "f32_preempted_total": base_pre,
+                "kv_preempted_total": kv_pre,
+                "per_policy_no_worse": bool(all(
+                    k_results[p]["outcomes"]["shed"]
+                    <= results[p]["outcomes"]["shed"]
+                    and k_results[p]["preempted"] <= results[p]["preempted"]
+                    for p in policies)),
+                "pressure_strictly_improved": bool(
+                    kv_pre < base_pre
+                    and kv_shed <= base_shed),
+            }
+        kv_section = {
+            "kv_dtype": args.kv_dtype,
+            "budget_mb": round(budget_mb, 6),
+            "policies": k_results,
+            "comparison": k_comparison,
+        }
+
     payload = {
         "mode": "quick" if args.quick else "full",
         "requests": args.requests, "rate_req_s": args.rate,
@@ -299,6 +369,7 @@ def main(argv=None) -> int:
         "policies": results,
         "comparison": comparison,
         **({"prefix_trace": prefix_section} if prefix_section else {}),
+        **({"kv_quant": kv_section} if kv_section else {}),
     }
     emit_json("OVERLOAD", payload)
 
@@ -314,10 +385,18 @@ def main(argv=None) -> int:
         if prefix_section["comparison"]:
             ok = ok and prefix_section["comparison"][
                 "slo_aware_strictly_better"]
+    if kv_section:
+        ok = ok and all(
+            r["starvation_free"] and r["sheds_visible"]
+            for r in kv_section["policies"].values())
+        if kv_section["comparison"]:
+            ok = ok and kv_section["comparison"]["per_policy_no_worse"] \
+                and kv_section["comparison"]["pressure_strictly_improved"]
     if args.quick and not ok:
         print("FAIL: overload oracle did not hold "
               f"(comparison={comparison}, prefix="
-              f"{prefix_section and prefix_section['comparison']})",
+              f"{prefix_section and prefix_section['comparison']}, kv="
+              f"{kv_section and kv_section['comparison']})",
               file=sys.stderr)
         return 1
     return 0

@@ -123,6 +123,50 @@ def check_memory(program, feed_names=(), fetch_names=(), ndev=1,
                                    stage=stage)
 
 
+def kv_pool_detail(program, plan):
+    """The r23 kv_pool row for --mem: what the decode program's KV pools
+    STORE (dtype from the var descs — the serving builder stamps the
+    storage dtype on the pool vars), the int8 scale pools' share of the
+    bytes, and the effective tokens-per-GB when the pool geometry is
+    known (full 4D shapes; runtime pools are ()-declared/scope-priced,
+    so geometry may be absent offline).  None when the program has no
+    kv_pool-class residents."""
+    from paddle_tpu.framework.dtype import dtype_name
+
+    rows = {n: v for n, v in plan.per_var.items()
+            if v["class"] == "kv_pool"}
+    total = int(plan.resident_by_class.get("kv_pool", 0))
+    blk = program.global_block()
+    names = [n for n in blk.vars if n.startswith(("kv_k_", "kv_v_"))]
+    if not rows and not total and not names:
+        return None
+    dtypes, tokens = set(), 0
+    for n in names:
+        if "_scale_" in n:
+            continue
+        v = blk.var(n)
+        try:
+            dtypes.add(dtype_name(v.dtype))
+        except (KeyError, ValueError):
+            pass
+        shp = tuple(v.shape or ())
+        if len(shp) == 4 and n.startswith("kv_k_") and not tokens:
+            tokens = int(shp[1] * shp[2])   # num_pages * page_size
+    scale_bytes = sum(int(v["dev_bytes"]) for n, v in rows.items()
+                      if "_scale_" in n)
+    scale_vars = sum(1 for n in names if "_scale_" in n)
+    return {
+        "dtype": (sorted(dtypes)[0] if len(dtypes) == 1
+                  else sorted(dtypes) or None),
+        "resident_bytes": total,
+        "scale_pool_bytes": int(scale_bytes),
+        "scale_pool_vars": int(scale_vars),
+        "capacity_tokens": tokens or None,
+        "tokens_per_gb": (int(tokens * (1 << 30) // total)
+                          if tokens and total else None),
+    }
+
+
 def check_plan(program, feed_names=(), fetch_names=(), ndev=1,
                budget_mb=0.0):
     """Auto-parallel plan search for one program (the FLAGS_dp_plan=auto
@@ -215,7 +259,11 @@ def main(argv=None):
             plan = check_memory(prog, feed_names, fetch_names,
                                 ndev=args.ndev, stage=args.mem_stage)
             mem_plans.append((label, plan))
-            mem_rows.append(dict(plan.as_dict(10), program=label))
+            row = dict(plan.as_dict(10), program=label)
+            kv = kv_pool_detail(prog, plan)
+            if kv is not None:
+                row["kv_pool"] = kv
+            mem_rows.append(row)
             if args.budget_mb and plan.peak_mb > args.budget_mb:
                 over_budget.append(label)
 
@@ -263,6 +311,14 @@ def main(argv=None):
                 print(f"--- memory: {label} (ndev={args.ndev}, "
                       f"stage={row['stage']}) ---")
                 print(plan.format_table())
+                if "kv_pool" in row:
+                    kv = row["kv_pool"]
+                    print(f"kv_pool: dtype={kv['dtype']} "
+                          f"resident={kv['resident_bytes']}B "
+                          f"scale={kv['scale_pool_bytes']}B "
+                          f"({kv['scale_pool_vars']} vars) "
+                          f"tokens={kv['capacity_tokens']} "
+                          f"tokens/GB={kv['tokens_per_gb']}")
                 if args.budget_mb:
                     # unrounded peak (as_dict rounds to 3 decimals): the
                     # verdict must agree with the exit code

@@ -75,6 +75,14 @@ def build_args():
                     help="top-k filter for --sample > 0 (0 = off)")
     ap.add_argument("--top-p", type=float, default=1.0,
                     help="nucleus filter for --sample > 0 (1 = off)")
+    ap.add_argument("--kv-dtype", default="",
+                    choices=["", "bfloat16", "int8"],
+                    help="arm the kv_quant report section: quantized KV "
+                         "pool (FLAGS_kv_cache_dtype) A/B vs float32 at "
+                         "FIXED HBM bytes — pool capacity ratio, "
+                         "within-dtype token-identity oracles, "
+                         "admission-gap + preemption A/B under a tight "
+                         "budget, spec accept-rate delta ('' = off)")
     ap.add_argument("--repeat-frac", type=float, default=0.0,
                     help="self-similar trace knob for the spec section "
                          "(fraction of each prompt rewritten as "
@@ -316,6 +324,167 @@ def spec_section(model_dir, cfg, args):
     }
 
 
+def kv_quant_section(model_dir, cfg, args):
+    """The r23 A/B at FIXED HBM bytes: the quantized KV pool
+    (``--kv-dtype``) vs the float32 pool under the SAME byte budget
+    (``kv_budget_mb`` — both engines derive num_pages from it, so the
+    capacity ratio IS the dtype's bytes-per-value ratio).  Reports:
+
+    * **capacity** — pages + effective tokens/GB per dtype, the scale
+      pool's overhead on top, and the modeled ratio vs expected
+      (4/itemsize: 2x bf16, 4x int8);
+    * **within-dtype token identity** — quantization may change WHICH
+      tokens come out vs f32 (that is the accuracy trade), but every
+      serving path within one dtype must agree: prefix-hit == cold,
+      chunked == monolithic, spec-verify == baseline;
+    * **admission A/B** — the same submit-all trace on a TIGHT budget
+      (just over one worst-case request at f32): the dtype's extra
+      pages must show up as scheduling headroom (first-token admission
+      gap and preemption count no worse than f32);
+    * **spec accept-rate delta** — the n-gram drafter's accept rate at
+      f32 vs the quantized pool on the self-similar trace: the
+      quantization error budget, spent where it is observable.
+    """
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.utils.loadgen import poisson_trace
+
+    dtype = args.kv_dtype
+    head_dim = cfg.hidden // cfg.num_heads
+    page_bytes_f32 = (2 * cfg.num_layers * cfg.num_heads * args.page_size
+                      * head_dim * 4)
+    budget_mb = args.num_pages * page_bytes_f32 / float(1 << 20)
+    expected_x = 4.0 / np.dtype(dtype).itemsize
+
+    def make(dt, budget, **kw):
+        return ServingEngine(model_dir=model_dir, max_batch=args.max_batch,
+                             token_budget=args.token_budget, seed=args.seed,
+                             page_size=args.page_size, kv_dtype=dt,
+                             kv_budget_mb=budget, prefill_bucket_min=8,
+                             **kw)
+
+    # --- capacity at fixed HBM bytes ----------------------------------
+    e32 = make("float32", budget_mb)
+    eq = make(dtype, budget_mb)
+    budget_bytes = int(budget_mb * (1 << 20))
+    q_tokens = eq.core.kv_config.num_pages * args.page_size
+    capacity = {
+        "budget_mb": round(budget_mb, 6),
+        "f32_pages": int(e32.core.kv_config.num_pages),
+        "quant_pages": int(eq.core.kv_config.num_pages),
+        "ratio_x": round(eq.core.kv_config.num_pages
+                         / e32.core.kv_config.num_pages, 3),
+        "expected_x": expected_x,
+        "f32_resident_bytes": int(e32.core.kv_pool_resident_bytes()),
+        "quant_resident_bytes": int(eq.core.kv_pool_resident_bytes()),
+        "scale_bytes_per_pool": int(eq.kv.stats()["scale_bytes"]),
+        "tokens_per_gb_f32": int(
+            (1 << 30) * e32.core.kv_config.num_pages * args.page_size
+            // budget_bytes),
+        "tokens_per_gb_quant": int((1 << 30) * q_tokens // budget_bytes),
+    }
+
+    # --- within-dtype token identity ----------------------------------
+    prefix_len = args.prefix_len or 16
+    ptrace = poisson_trace(
+        args.requests, args.rate, cfg.vocab_size,
+        prompt_len_range=(args.prompt_min, args.prompt_max),
+        max_new_range=(args.new_min, args.new_max), seed=args.seed,
+        prefix_len=prefix_len, prefix_share=args.prefix_share)
+    pprompts = [e.prompt for e in ptrace]
+    cold = make(dtype, budget_mb)
+    cold_out = cold.generate(pprompts, max_new_tokens=args.new_max)
+    warm = make(dtype, budget_mb, prefix_cache=True)
+    warm_out = warm.generate(pprompts, max_new_tokens=args.new_max)
+    chunk = make(dtype, budget_mb, prefill_chunk=args.chunk_tokens)
+    chunk_out = chunk.generate(pprompts, max_new_tokens=args.new_max)
+    identity = {
+        "prefix_hit_vs_cold": bool(warm_out == cold_out),
+        "prefix_hit_tokens": int(warm.stats["prefill_hit_tokens"]),
+        "chunked_vs_monolithic": bool(chunk_out == cold_out),
+    }
+
+    # --- spec-verify identity + accept-rate delta ---------------------
+    spec_k = args.spec_k or 4
+    rtrace = poisson_trace(
+        args.requests, args.rate, cfg.vocab_size,
+        prompt_len_range=(args.prompt_min, args.prompt_max),
+        max_new_range=(args.new_min, args.new_max), seed=args.seed,
+        repeat_frac=args.repeat_frac or 0.5)
+    rprompts = [e.prompt for e in rtrace]
+    base_q = make(dtype, budget_mb)
+    base_q_out = base_q.generate(rprompts, max_new_tokens=args.new_max)
+    spec_q = make(dtype, budget_mb, spec_k=spec_k)
+    spec_q_out = spec_q.generate(rprompts, max_new_tokens=args.new_max)
+    spec_f = make("float32", budget_mb, spec_k=spec_k)
+    spec_f.generate(rprompts, max_new_tokens=args.new_max)
+    identity["spec_vs_baseline"] = bool(spec_q_out == base_q_out)
+
+    def _rate(e):
+        p = int(e.stats["spec_proposed"])
+        return round(int(e.stats["spec_accepted"]) / p, 4) if p else 0.0
+
+    rate_f, rate_q = _rate(spec_f), _rate(spec_q)
+    spec_accept = {
+        "spec_k": spec_k,
+        "accept_rate_f32": rate_f,
+        "accept_rate_quant": rate_q,
+        "delta": round(rate_q - rate_f, 4),
+        "accepted_quant": int(spec_q.stats["spec_accepted"]),
+    }
+
+    # --- admission gap + preemption under a TIGHT budget --------------
+    # budget = one worst-case request + one page at f32: the f32 engine
+    # serves nearly one-at-a-time with heavy preemption; the quantized
+    # engine's 2-4x pages admit more concurrently at the SAME bytes
+    longest = args.prompt_max + args.new_max
+    pages_long = -(-longest // args.page_size)
+    tight_mb = (pages_long + 1) * page_bytes_f32 / float(1 << 20)
+    trace = poisson_trace(
+        args.requests, args.rate, cfg.vocab_size,
+        prompt_len_range=(args.prompt_min, args.prompt_max),
+        max_new_range=(args.new_min, args.new_max), seed=args.seed)
+
+    def admission(dt):
+        e = make(dt, tight_mb)
+        for i, ev in enumerate(trace):
+            e.submit(Request(f"q{i}", list(ev.prompt),
+                             ev.max_new_tokens, 0.0))
+        first, step = {}, 0
+        while e.has_work() and step < 5000:
+            step += 1
+            for out in e.step():
+                first.setdefault(out.req_id, step)
+        gaps = sorted(first.values())
+        return {
+            "pages": int(e.core.kv_config.num_pages),
+            "steps": int(step),
+            "preempted": int(e.stats["preempted"]),
+            "first_token_step_max": int(gaps[-1]) if gaps else int(step),
+            "first_token_step_mean": (round(sum(gaps) / len(gaps), 3)
+                                      if gaps else float(step)),
+        }
+
+    adm_f32 = admission("float32")
+    adm_q = admission(dtype)
+    admission_ab = {
+        "tight_budget_mb": round(tight_mb, 6),
+        "float32": adm_f32,
+        dtype: adm_q,
+        "gap_no_worse": bool(
+            adm_q["first_token_step_max"] <= adm_f32["first_token_step_max"]),
+        "preempt_no_worse": bool(
+            adm_q["preempted"] <= adm_f32["preempted"]),
+    }
+
+    return {
+        "kv_dtype": dtype,
+        "capacity": capacity,
+        "identity": identity,
+        "admission": admission_ab,
+        "spec_accept": spec_accept,
+    }
+
+
 def measure(eng, trace, warmup):
     """Replay unmeasured ``warmup`` times (populates the executor's jit
     cache for every bucket shape the trace hits — each replay drains
@@ -354,6 +523,8 @@ def main(argv=None):
             args.spec_k = 4        # the quick spec-decode oracle
         if args.repeat_frac == 0.0:
             args.repeat_frac = 0.5
+        if not args.kv_dtype:
+            args.kv_dtype = "int8"  # the quick kv-quant oracle
 
     from paddle_tpu.inference.serving import DecoderConfig, export_decoder
     from paddle_tpu.utils.loadgen import emit_json, poisson_trace
@@ -446,6 +617,11 @@ def main(argv=None):
             # self-similar trace (accept rate, decode calls saved,
             # TTFT/TPOT A/B, greedy token identity)
             payload["spec"] = spec_section(model_dir, cfg, args)
+        if args.kv_dtype:
+            # the r23 section: quantized KV pool vs float32 at fixed
+            # HBM bytes (capacity ratio, within-dtype identity,
+            # admission headroom, spec accept-rate delta)
+            payload["kv_quant"] = kv_quant_section(model_dir, cfg, args)
         if not args.json:
             print(json.dumps(payload, indent=2, sort_keys=True))
         emit_json("SERVING", payload)
@@ -476,6 +652,25 @@ def main(argv=None):
                       f"accepted={sec['accepted']}, "
                       f"decode_calls={sec['decode_calls_spec']}/"
                       f"{sec['decode_calls_baseline']})", file=sys.stderr)
+                return 1
+        if args.quick and args.kv_dtype:
+            # the kv-quant oracle: every serving path within the
+            # quantized dtype token-identical, the capacity ratio at
+            # least the dtype's bytes ratio (2x bf16 / 4x int8), and
+            # the extra pages visible as admission headroom
+            sec = payload["kv_quant"]
+            idn = sec["identity"]
+            if not (idn["prefix_hit_vs_cold"]
+                    and idn["chunked_vs_monolithic"]
+                    and idn["spec_vs_baseline"]
+                    and sec["capacity"]["ratio_x"]
+                    >= sec["capacity"]["expected_x"]
+                    and sec["admission"]["gap_no_worse"]):
+                print("FAIL: kv-quant oracle did not hold "
+                      f"(identity={idn}, "
+                      f"ratio={sec['capacity']['ratio_x']}x vs "
+                      f"{sec['capacity']['expected_x']}x expected, "
+                      f"admission={sec['admission']})", file=sys.stderr)
                 return 1
     return 0
 

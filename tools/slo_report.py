@@ -78,6 +78,11 @@ def build_args():
                     help="speculative decoding draft length (r21); the "
                          "accepted column + spec accept-rate section "
                          "light up")
+    ap.add_argument("--kv-dtype", default="",
+                    help="KV pool storage dtype (float32 | bfloat16 | "
+                         "int8; '' = FLAGS_kv_cache_dtype) — reported "
+                         "in the payload so traces from quantized-vs-"
+                         "f32 A/B runs are distinguishable")
     ap.add_argument("--slo-ttft-ms", type=float, default=200.0,
                     help="TTFT target in ms (0 = unset)")
     ap.add_argument("--slo-token-ms", type=float, default=100.0,
@@ -207,7 +212,8 @@ def main(argv=None) -> int:
                         admission_policy=args.policy,
                         prefix_cache=args.prefix_cache or None,
                         prefill_chunk=args.chunk_tokens,
-                        spec_k=args.spec_k or None)
+                        spec_k=args.spec_k or None,
+                        kv_dtype=args.kv_dtype or None)
     trace = poisson_trace(
         args.requests, args.rate, cfg.vocab_size,
         prompt_len_range=(args.prompt_min, args.prompt_max),
@@ -278,6 +284,8 @@ def main(argv=None) -> int:
         print(f"shed: {eng.stats['shed']}/{args.requests} "
               f"(policy={args.policy}; shed requests excluded from the "
               f"goodput denominators)")
+        print(f"kv_pool: dtype={eng.kv_dtype} "
+              f"pages={eng.core.kv_config.num_pages}")
         print(f"agrees_with_loadgen={agrees} spans_reconcile={reconciles}")
 
     payload = {
@@ -285,6 +293,11 @@ def main(argv=None) -> int:
         "requests": args.requests, "rate_req_s": args.rate,
         "seed": args.seed,
         "policy": args.policy,
+        # r23: the pool's storage dtype — quantized-vs-f32 A/B traces
+        # are otherwise indistinguishable in this report
+        "kv_pool": {"dtype": eng.kv_dtype,
+                    "num_pages": int(eng.core.kv_config.num_pages),
+                    "scale_bytes": int(eng.kv.stats()["scale_bytes"])},
         "slo": slo,
         "latency": rep,
         "per_request": rows[:50],
