@@ -50,7 +50,7 @@ class _Compiled:
 
     __slots__ = ("fn", "raw_fn", "state_in", "state_out", "fetch_names",
                  "donatable", "readonly", "hybrid", "feed_plan", "session",
-                 "_memory_plan", "numerics")
+                 "_memory_plan", "numerics", "tp_shard")
 
     def __init__(self, fn, state_in, state_out, fetch_names):
         self.fn = fn
@@ -61,6 +61,9 @@ class _Compiled:
         self.donatable = ()
         self.readonly = ()
         self.hybrid = False
+        # tensor-parallel serving: {"axis", "degree", "mesh"} when the
+        # program is compiled under shard_map (None on every other path)
+        self.tp_shard = None
         # per-compilation step-loop plans (built once in _compile /
         # first _execute, reused every step):
         self.feed_plan = None   # {feed name: numpy dtype to cast to|None}
@@ -381,6 +384,17 @@ class Executor:
                                    fetch_names=fetch_names, block=block,
                                    ndev=1, scope=scope)
 
+    @staticmethod
+    def _tp_signature(program):
+        """Hashable cache-key element for a TP serving program: the
+        mesh axis, degree, and exact device list (None everywhere
+        else, so non-TP keys are unchanged)."""
+        tp = getattr(program, "_tp_shard", None)
+        if tp is None:
+            return None
+        return (tp["axis"], int(tp["degree"]),
+                tuple(str(d) for d in tp["mesh"].devices.flat))
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -456,7 +470,10 @@ class Executor:
                # probe config + any armed chaos NaN injection: step K of
                # a nan_inject schedule must trace the poisoned variant
                # and step K+1 must fall back to the clean cached one
-               _numerics.probe_signature(), _chaos.nan_poison_target())
+               _numerics.probe_signature(), _chaos.nan_poison_target(),
+               # tensor-parallel serving: the same program compiled over
+               # a different mesh/degree is a different executable
+               self._tp_signature(program))
         from .utils import telemetry as tm
 
         hit = self._cache.get(key)
@@ -469,7 +486,18 @@ class Executor:
                    "construction)").inc()
         build_t0 = time.perf_counter()
 
+        tp_shard = getattr(program, "_tp_shard", None)
+        src_block = program.global_block()
         program = self._apply_ir_passes(program, fetch_names)
+        if tp_shard is not None and program is not src_block.program:
+            # the IR pipeline cloned through a desc round-trip, which
+            # drops python-side sharding annotations — re-attach them so
+            # the shard_map in/out specs below see the placements
+            nb = program.global_block()
+            for name, v in src_block.vars.items():
+                s = getattr(v, "_sharding", None)
+                if s is not None and name in nb.vars:
+                    nb.vars[name]._sharding = s
         from .framework import verifier
 
         if verifier.enabled():
@@ -508,6 +536,10 @@ class Executor:
             fetch.append(_numerics.STATS_VAR)
         souts = list(state_out)
 
+        if has_host_ops and tp_shard is not None:
+            raise RuntimeError(
+                "tensor-parallel serving programs cannot contain host "
+                "ops: the whole step must trace into one shard_map")
         if has_host_ops:
             # Hybrid path (PS programs): host (RPC) ops run eagerly on
             # the Python side; the XLA ops BETWEEN them are grouped into
@@ -655,6 +687,32 @@ class Executor:
             new_state = {n: env[n] for n in souts if n in env}
             return fetched, new_state
 
+        if tp_shard is not None:
+            # tensor-parallel serving (FLAGS_serving_tp > 1): the whole
+            # traced step runs under shard_map over the serving mesh —
+            # each rank executes the SHARD program on its 1/tp of the
+            # weights and KV pool, the inserted c_* collectives resolve
+            # their mesh axis through the ring registry, and fetches
+            # (tokens) come back replicated.  State in/out specs follow
+            # the per-var logical-axis annotations; feeds are replicated.
+            from jax.sharding import PartitionSpec as _P
+
+            from .parallel.mesh import shard_map_compat
+
+            def _pspec(name):
+                v = block._find_var_recursive(name)
+                s = getattr(v, "_sharding", None) if v is not None else None
+                return _P(*s) if s else _P()
+
+            in_specs = ({n: _pspec(n) for n in donatable},
+                        {n: _pspec(n) for n in readonly},
+                        {n: _P() for n in feed})
+            out_specs = (tuple(_P() for _ in fetch),
+                         {n: _pspec(n) for n in souts})
+            fn = shard_map_compat(fn, mesh=tp_shard["mesh"],
+                                  in_specs=in_specs, out_specs=out_specs,
+                                  check=False)
+
         if check_nan_inf:
             # FLAGS_check_nan_inf (reference: operator.cc:1020
             # CheckOpHasNanOrInf) — functionalize the per-op checks with
@@ -680,6 +738,7 @@ class Executor:
             jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
         compiled = _Compiled(jitted, state_in, state_out, fetch)
         compiled.raw_fn = fn
+        compiled.tp_shard = tp_shard
         compiled.donatable = tuple(donatable)
         compiled.readonly = tuple(readonly)
         compiled.feed_plan = feed_plan
@@ -778,6 +837,16 @@ class Executor:
 
         step_t0 = time.perf_counter()
         device = self.place.jax_device()
+        tp_shard = getattr(compiled, "tp_shard", None)
+        if tp_shard is not None:
+            # TP serving: feeds and any host-side state stage REPLICATED
+            # over the serving mesh (the shard_map in_specs say P());
+            # sharded weights/pools arrive as already-placed jax arrays
+            # from the engine and pass through state_val untouched
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
+
+            device = NamedSharding(tp_shard["mesh"], _P())
 
         # ---- feed conversion: plan precomputed at compile time (dtype
         # per name), so the step loop does no block-var lookups.  The

@@ -15,6 +15,7 @@ code elimination to cut trace/compile time.
 """
 from __future__ import annotations
 
+import re
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .core import Block, Operator, Program
@@ -2525,5 +2526,101 @@ class FuseOptimizerOpsPass(Pass):
             fused += 1
         self.fused_count = fused
         if fused:
+            program._bump_version()
+        return program
+
+
+# --------------------------------------------------------------------------
+# tensor-parallel serving decoder (inference/serving.py, FLAGS_serving_tp)
+# --------------------------------------------------------------------------
+@register_pass("serving_tp_pass")
+class ServingTPPass(Pass):
+    """Insert the Megatron combine collectives into a serving decoder
+    SHARD program (one built with ``build_decoder_program(..., tp>1)``,
+    whose head/width reshapes already bake the local sizes):
+
+    * after the token+position embedding sum (``_srv_h0_*`` — both
+      tables are hidden-sharded, so each rank holds ``1/tp`` of the
+      columns): a ``c_concat`` (last-dim all-gather) reassembles the
+      full residual width;
+    * after each block's attention out-projection (``_srv_l{i}_o_*``)
+      and MLP down-projection (``_srv_l{i}_ff2_*``) — the row-parallel
+      matmuls whose outputs are partial sums: a ``c_allreduce_sum``;
+    * around the tied-embedding logits head (``_srv_logits_*``): a
+      ``c_split`` slices the full-width final hidden back to this
+      rank's columns (matching ``dec_embed``'s shard), the matmul's
+      partial logits then ``c_allreduce_sum`` to the full row.
+
+    Consumers are rewired onto the combined values (pass-inserted
+    producers are deliberate redirects under the verifier bracket).
+    Every collective carries the serving TP ``ring_id`` so the
+    lowering resolves the ``mp`` mesh axis, never the data-parallel
+    ring.  ``inserted_count`` reports how many collectives landed —
+    2 per block + 3 model-level for every program form."""
+
+    ring_id: int = 0
+
+    _H0 = re.compile(r"_srv_h0_\d+")
+    _COMBINE = re.compile(r"_srv_l\d+_(?:o|ff2)_\d+")
+    _LOGITS = re.compile(r"_srv_logits_\d+")
+
+    def _redirect(self, block, start, old, new):
+        for op_ in block.ops[start:]:
+            op_.rename_input(old, new)
+
+    def apply_impl(self, program):
+        block = program.global_block()
+        attrs = {"ring_id": int(self.ring_id)}
+        inserted = 0
+        i = 0
+        while i < len(block.ops):
+            op_ = block.ops[i]
+            outs = [n for ns in op_.outputs.values() for n in ns]
+            out = outs[0] if outs else None
+            if op_.type == "elementwise_add" and out is not None \
+                    and self._H0.fullmatch(out):
+                full = block.create_var(name=out + "@TP_AG").name
+                block._insert_op(i + 1, "c_concat",
+                                 inputs={"X": [out]},
+                                 outputs={"Out": [full]},
+                                 attrs=dict(attrs))
+                self._redirect(block, i + 2, out, full)
+                inserted += 1
+                i += 2
+                continue
+            if op_.type == "matmul" and out is not None \
+                    and self._COMBINE.fullmatch(out):
+                red = block.create_var(name=out + "@TP_AR").name
+                block._insert_op(i + 1, "c_allreduce_sum",
+                                 inputs={"X": [out]},
+                                 outputs={"Out": [red]},
+                                 attrs=dict(attrs))
+                self._redirect(block, i + 2, out, red)
+                inserted += 1
+                i += 2
+                continue
+            if op_.type == "matmul" and out is not None \
+                    and self._LOGITS.fullmatch(out):
+                hf = op_.inputs["X"][0]
+                loc = block.create_var(name=hf + "@TP_SPLIT").name
+                block._insert_op(i, "c_split",
+                                 inputs={"X": [hf]},
+                                 outputs={"Out": [loc]},
+                                 attrs=dict(attrs))
+                op_.rename_input(hf, loc)
+                red = block.create_var(name=out + "@TP_AR").name
+                block._insert_op(i + 2, "c_allreduce_sum",
+                                 inputs={"X": [out]},
+                                 outputs={"Out": [red]},
+                                 attrs=dict(attrs))
+                self._redirect(block, i + 3, out, red)
+                if getattr(program, "_srv_logits", None) == out:
+                    program._srv_logits = red
+                inserted += 2
+                i += 3
+                continue
+            i += 1
+        self.inserted_count = inserted
+        if inserted:
             program._bump_version()
         return program

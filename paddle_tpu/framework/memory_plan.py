@@ -562,6 +562,39 @@ def _zero_shard_sets(program: Program, block: Block, ops, ndev: int,
     return opt_sharded, sharded_params, grad_sharded, scatter_at
 
 
+def _tp_predicate(block: Block, tp: int, tp_rules: Optional[Dict]):
+    """name -> True when the var holds 1/tp per device under tensor
+    parallelism: it matches a ``tp_rules`` pattern (exact name or
+    fullmatch regex — the same resolution ``apply_tensor_parallel``
+    uses), or, with no rules given, it carries a ``shard_parameter``
+    annotation (``var._sharding``)."""
+    if tp <= 1:
+        return lambda name: False
+    if tp_rules:
+        import re as _re
+
+        pats = []
+        for p in tp_rules:
+            try:
+                pats.append((p, _re.compile(p)))
+            except _re.error:
+                pats.append((p, None))
+
+        def match(name: str) -> bool:
+            for p, rx in pats:
+                if name == p or (rx is not None and rx.fullmatch(name)):
+                    return True
+            return False
+
+        return match
+
+    def annotated(name: str) -> bool:
+        v = block._find_var_recursive(name)
+        return bool(getattr(v, "_sharding", None))
+
+    return annotated
+
+
 def plan_memory(program: Program, feed_names: Sequence[str] = (),
                 fetch_names: Sequence[str] = (), *,
                 ndev: int = 1, stage: Optional[int] = None,
@@ -571,6 +604,8 @@ def plan_memory(program: Program, feed_names: Sequence[str] = (),
                 prefetch_depth: Optional[int] = None,
                 assumed_batch: int = 64,
                 extra_resident: Optional[Dict[str, int]] = None,
+                tp: int = 1,
+                tp_rules: Optional[Dict] = None,
                 scope=None) -> MemoryPlan:
     """Compute the modeled per-device HBM plan for ``program``.
 
@@ -585,6 +620,15 @@ def plan_memory(program: Program, feed_names: Sequence[str] = (),
     declares SHAPELESS (the serving K/V pools: persistable block vars
     whose real array lives only in the scope) — the compile paths pass
     their scope so those fixed blocks are charged at true size.
+
+    ``tp`` (with ``tp_rules``, a name/regex -> spec dict like
+    ``decoder_tp_rules``'s) prices tensor parallelism: a var matching a
+    rule — or, with no rules given, carrying a ``_sharding``
+    annotation — holds ``1/tp`` of its global bytes per device
+    (weights, KV pools and scale pools shard; activations, block
+    tables and the allocator stay replicated).  ``extra_resident``
+    entries matching a rule divide too, so an engine-held pool priced
+    from outside the program scales with the candidate degree.
     """
     from ..utils.flags import flag
     from ..parallel.data_parallel import _program_has_collectives
@@ -605,6 +649,9 @@ def plan_memory(program: Program, feed_names: Sequence[str] = (),
 
     opt_sharded, sharded_params, grad_sharded, scatter_at = \
         _zero_shard_sets(program, block, ops, ndev, stage, use_shard_map)
+
+    tp = max(int(tp), 1)
+    tp_sharded = _tp_predicate(block, tp, tp_rules)
 
     params = {p.name for p in program.all_parameters()}
     events = [op_reads_writes(op_) for op_ in ops]
@@ -669,6 +716,12 @@ def plan_memory(program: Program, feed_names: Sequence[str] = (),
                 b = sb
         if b is None:
             return None
+        if tp > 1 and tp_sharded(name):
+            # tensor-parallel shard: weights / KV pools hold 1/tp of
+            # the global bytes per device (scope arrays report the
+            # GLOBAL logical nbytes under a NamedSharding, so the
+            # division applies on that path too)
+            b //= tp
         if ndev > 1:
             if name in sharded_params or name in opt_sharded \
                     or name in feed_names:
@@ -737,11 +790,15 @@ def plan_memory(program: Program, feed_names: Sequence[str] = (),
                       "dev_bytes": int(eff), "class": cls,
                       "first": lo, "last": hi, "resident": is_res,
                       "sharded": bool(sharded_grad
+                                      or (tp > 1 and tp_sharded(n))
                                       or (ndev > 1
                                           and (n in sharded_params
                                                or n in opt_sharded)))}
 
     extra_resident = dict(extra_resident or {})
+    if tp > 1:
+        extra_resident = {k: (int(v) // tp if tp_sharded(k) else int(v))
+                          for k, v in extra_resident.items()}
     extra_bytes = int(sum(extra_resident.values()))
     resident_bytes += extra_bytes
     if extra_bytes:

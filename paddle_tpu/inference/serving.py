@@ -71,10 +71,18 @@ __all__ = [
     "DecoderConfig", "Request", "StepEvent", "ServingEngine",
     "StaticBatchingEngine", "export_decoder", "load_decoder_config",
     "build_decoder_program", "init_decoder_weights", "RequestRejected",
-    "SamplingParams",
+    "SamplingParams", "decoder_tp_rules", "validate_tp_degree",
+    "SERVING_TP_AXIS", "SERVING_TP_RING_ID",
 ]
 
 NEG_INF = -1e9  # additive causal-mask value (finite: padded rows stay NaN-free)
+
+# tensor-parallel decode (FLAGS_serving_tp): the mesh axis the decoder
+# shards over, and the dedicated collective ring its allreduces run on
+# (ring 0 belongs to the data-parallel paths — the serving mesh must
+# never capture it)
+SERVING_TP_AXIS = "mp"
+SERVING_TP_RING_ID = 7
 
 
 # ==========================================================================
@@ -293,9 +301,64 @@ def _kv_gather_deq(b: _B, pool, scale, tables, kv_dtype, tag):
     return dq
 
 
+def validate_tp_degree(cfg: DecoderConfig, tp: int) -> None:
+    """Bugfix rider: reject infeasible TP degrees at engine/program
+    construction with a clear error, instead of a shape crash
+    mid-prefill.  Every sharded dimension — attention/KV heads (the
+    pool's split axis AND the kernel's head grouping), the hidden
+    width, and the MLP width — must divide evenly by ``tp``."""
+    tp = int(tp or 1)
+    if tp < 1:
+        raise ValueError(f"serving_tp must be >= 1, got {tp}")
+    if tp == 1:
+        return
+    bad = []
+    if cfg.num_heads % tp:
+        bad.append(f"num_heads={cfg.num_heads} (the KV pool and the "
+                   f"paged_attention head grouping shard on kv_heads)")
+    if cfg.hidden % tp:
+        bad.append(f"hidden={cfg.hidden}")
+    if cfg.ffn % tp:
+        bad.append(f"ffn={cfg.ffn}")
+    if bad:
+        raise ValueError(
+            f"serving_tp={tp} does not divide " + ", ".join(bad) +
+            "; pick a degree that splits every sharded dim evenly")
+
+
+def decoder_tp_rules(cfg: DecoderConfig, axis: str = SERVING_TP_AXIS,
+                     kv_dtype: str = "float32"
+                     ) -> Dict[str, tuple]:
+    """Regex -> logical-axis spec for the serving decoder, composed
+    from the generic partition-rule constructors
+    (parallel/tensor_parallel.py): Megatron attention-head + MLP
+    column/row sharding per block, hidden-sharded embeddings (the
+    positional table follows the token table so the embed sum stays
+    local), plus the paged KV pools split on their ``kv_heads`` dim
+    (layout ``(kv_heads, pages, page_size, head_dim)``) and the int8
+    scale pools alongside.  LayerNorm scales/biases stay replicated
+    (no rule).  The derivation is pinned against hand-written specs by
+    tests/test_serving_tp.py."""
+    from ..parallel.tensor_parallel import attention_head_rules, \
+        embedding_rules, megatron_mlp_rules
+
+    rules: Dict[str, tuple] = {}
+    rules.update(attention_head_rules(
+        r"dec_l\d+_wq", r"dec_l\d+_wk", r"dec_l\d+_wv", r"dec_l\d+_wo",
+        axis=axis))
+    rules.update(megatron_mlp_rules(
+        [r"dec_l\d+_w1", r"dec_l\d+_w2"], axis=axis))
+    rules.update(embedding_rules("dec_embed", axis=axis, mode="hidden"))
+    rules["dec_pos_embed"] = (None, axis)
+    rules[r"kv_[kv]_\d+"] = (axis, None, None, None)
+    if kv_dtype == "int8":
+        rules[r"kv_[kv]_scale_\d+"] = (axis, None)
+    return {k: tuple(v) for k, v in rules.items()}
+
+
 def build_decoder_program(cfg: DecoderConfig, mode: str,
                           sampling: Optional[SamplingParams] = None,
-                          kv_dtype: str = "float32") -> tuple:
+                          kv_dtype: str = "float32", tp: int = 1) -> tuple:
     """Build one of the program forms; returns
     ``(program, feed_names, fetch_names)``.
 
@@ -331,6 +394,14 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
     through ``kv_cache_append`` (quantize-on-write) and the reads, so
     attention always accumulates in f32.  The reference form never
     touches the pool and ignores it.
+
+    ``tp`` > 1 builds the tensor-parallel SHARD body: every head/width
+    reshape bakes the LOCAL head count (``num_heads // tp``) and local
+    context width (``hidden // tp``) — the per-device program each mesh
+    rank runs under shard_map.  The combines (per-block allreduces, the
+    embedding all-gather, the logits split/reduce) are NOT built here;
+    the verifier-bracketed ``serving_tp_pass`` inserts them.  ``tp=1``
+    is byte-identical to the unsharded builder (pinned).
     """
     if mode not in ("reference", "prefill", "decode", "chunk", "verify"):
         raise ValueError(f"bad mode {mode!r}")
@@ -340,7 +411,15 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
     if _sampled(sampling) and mode == "reference":
         raise ValueError("the reference form is the greedy oracle; "
                          "sampling applies to serving forms only")
-    H, D, h = cfg.num_heads, cfg.head_dim, cfg.hidden
+    tp = int(tp or 1)
+    validate_tp_degree(cfg, tp)
+    # H/h below are the PER-DEVICE head count and attention-context
+    # width (== the global values at tp=1): the sharded body computes
+    # on 1/tp of the heads; full-width sites (residual stream, final
+    # layer norm, hflat) keep cfg.hidden because the inserted
+    # collectives re-assemble the hidden dim before them
+    H, D, h = cfg.num_heads // tp, cfg.head_dim, cfg.hidden
+    hl = h // tp
     prog = Program()
     b = _B(prog)
     params = {n: b.param(n, s) for n, s in decoder_param_specs(cfg).items()}
@@ -394,7 +473,7 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
             sm = b.tmp(f"l{i}_probs")
             b.op("softmax", {"X": [s]}, {"Out": [sm]}, {"axis": -1})
             av = b.matmul(sm, v4, tag=f"l{i}_av")        # (1, H, S, D)
-            ctxv = b.reshape(b.transpose(av, [0, 2, 1, 3]), [0, 0, h],
+            ctxv = b.reshape(b.transpose(av, [0, 2, 1, 3]), [0, 0, hl],
                              f"l{i}_ctx")
             hid = b.add(hid, b.matmul(ctxv, p + "wo", tag=f"l{i}_o"),
                         f"l{i}_res1")
@@ -411,6 +490,7 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
         logits = b.matmul(hf, "dec_embed", transpose_Y=True, tag="logits")
         out = _emit_head(b, logits, "next_token", sampling, seeds)
         prog._srv_params = params
+        prog._tp_degree = tp
         return prog, feeds, [out]
 
     if mode == "verify":
@@ -462,7 +542,7 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
             sm = b.tmp(f"l{i}_probs")
             b.op("softmax", {"X": [s]}, {"Out": [sm]}, {"axis": -1})
             av = b.matmul(sm, v4, tag=f"l{i}_av")           # (B, H, S, D)
-            ctxv = b.reshape(b.transpose(av, [0, 2, 1, 3]), [0, 0, h],
+            ctxv = b.reshape(b.transpose(av, [0, 2, 1, 3]), [0, 0, hl],
                              f"l{i}_ctx")
             hid = b.add(hid, b.matmul(ctxv, p + "wo", tag=f"l{i}_o"),
                         f"l{i}_res1")
@@ -477,6 +557,7 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
         out = _emit_head(b, logits, "next_tokens", sampling, seeds)
         prog._srv_params = params
         prog._srv_logits = logits   # the verify==reference parity hook
+        prog._tp_degree = tp
         return prog, feeds, [out]
 
     paged = mode == "decode"
@@ -532,7 +613,7 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
                 pa_ins["KScale"], pa_ins["VScale"] = [ksc], [vsc]
             b.op("paged_attention", pa_ins,
                  {"Out": [att]}, {"scale": float(D ** -0.5)})
-            ctxv = b.reshape(att, [0, h], f"l{i}_ctx")
+            ctxv = b.reshape(att, [0, hl], f"l{i}_ctx")
         else:
             # the NAIVE composition on (1, S, h): 4-D q/k/v + the
             # matmul/softmax/matmul chain fuse_multihead_attention_pass
@@ -556,7 +637,7 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
             sm = b.tmp(f"l{i}_probs")
             b.op("softmax", {"X": [s]}, {"Out": [sm]}, {"axis": -1})
             av = b.matmul(sm, v4, tag=f"l{i}_av")
-            ctxv = b.reshape(b.transpose(av, [0, 2, 1, 3]), [0, 0, h],
+            ctxv = b.reshape(b.transpose(av, [0, 2, 1, 3]), [0, 0, hl],
                              f"l{i}_ctx")
         hid = b.add(hid, b.matmul(ctxv, p + "wo", tag=f"l{i}_o"),
                     f"l{i}_res1")
@@ -579,6 +660,7 @@ def build_decoder_program(cfg: DecoderConfig, mode: str,
     _emit_head(b, logits, out_name, sampling, seeds)
     prog._srv_params = params  # introspection/debug
     prog._srv_logits = logits  # the verify==reference parity hook
+    prog._tp_degree = tp
     return prog, feeds, [out_name]
 
 
@@ -777,12 +859,14 @@ def _trace_admit(req: Request, now: float, wall0: float, wall1: float,
 
 def _trace_decode(states: Sequence["_SeqState"], toks: Sequence[int],
                   now: float, wall0: float, wall1: float, step_no: int,
-                  spec: Optional[Sequence[tuple]] = None):
+                  spec: Optional[Sequence[tuple]] = None, tp: int = 1):
     """One decode-step span per TRACED request in the batch (shared
     wall bounds: the batch runs as one program).  ``spec`` (the
     speculative path only) carries per-request ``(proposed, accepted)``
     draft counts — the attrs appear ONLY when spec decode engaged, so
-    flag-off span streams stay byte-identical (the r19 pattern)."""
+    flag-off span streams stay byte-identical (the r19 pattern).
+    ``tp`` > 1 (tensor-parallel decode) annotates the TP degree the
+    same engage-only way."""
     for i, (st, tok) in enumerate(zip(states, toks)):
         tr = st.req.trace
         if tr is not None:
@@ -791,6 +875,8 @@ def _trace_decode(states: Sequence["_SeqState"], toks: Sequence[int],
             if spec is not None:
                 attrs["proposed"] = int(spec[i][0])
                 attrs["accepted"] = int(spec[i][1])
+            if tp > 1:
+                attrs["tp"] = int(tp)
             tr.add("decode_step", t0=now, wall0=wall0, wall1=wall1,
                    parent=tr._root, attrs=attrs)
 
@@ -933,10 +1019,37 @@ class _EngineCore:
                  sampling: Optional[SamplingParams] = None,
                  sample_seed: int = 0,
                  kv_dtype: Optional[str] = None,
-                 kv_budget_mb: float = 0.0):
+                 kv_budget_mb: float = 0.0,
+                 tp: Optional[int] = None):
         from ..utils.flags import flag
 
         self.cfg = cfg
+        if tp is None:
+            tp = int(flag("serving_tp", 1) or 1)
+        self.tp = int(tp)
+        validate_tp_degree(cfg, self.tp)  # bugfix rider: fail loud here
+        self.tp_mesh = None
+        if self.tp > 1:
+            import jax as _jax
+
+            devs = _jax.devices()
+            if self.tp > len(devs):
+                raise ValueError(
+                    f"serving_tp={self.tp} needs {self.tp} devices, have "
+                    f"{len(devs)}")
+            from jax.sharding import Mesh as _Mesh
+
+            from ..parallel.mesh import registry as _mesh_registry
+
+            # construct the serving mesh DIRECTLY (MeshRegistry.
+            # create_mesh would also make it the process-wide current
+            # mesh and capture ring 0 — both belong to data parallel);
+            # only the dedicated TP ring maps onto the "mp" axis
+            self.tp_mesh = _Mesh(np.array(devs[:self.tp]),
+                                 (SERVING_TP_AXIS,))
+            _mesh_registry().register_ring(
+                SERVING_TP_RING_ID, SERVING_TP_AXIS,
+                mesh_name="serving_tp")
         # greedy sampling normalizes to None: the serving programs are
         # then built EXACTLY as before (argmax head, no seeds feed) —
         # the flag-off bit-identity baseline
@@ -962,8 +1075,13 @@ class _EngineCore:
             # the scale pool is charged as overhead on top, ~1.6% at
             # the default page geometry, not folded into the divisor:
             # folding it in would turn the exact 4x into 3.94x)
-            page_bytes = (2 * cfg.num_layers * cfg.num_heads * page_size
-                          * cfg.head_dim * np.dtype(kv_dtype).itemsize)
+            # PER-DEVICE page bytes: under TP the pool shards on
+            # kv_heads, so each device stores num_heads/tp of every
+            # page — the same per-device budget buys tp x more pages
+            # (the capacity headline; == the legacy expression at tp=1)
+            page_bytes = (2 * cfg.num_layers * (cfg.num_heads // self.tp)
+                          * page_size * cfg.head_dim
+                          * np.dtype(kv_dtype).itemsize)
             num_pages = max(1, int(kv_budget_mb * (1 << 20)) // page_bytes)
         self.kv_budget_mb = float(kv_budget_mb or 0.0)
         self.kv_config = KVCacheConfig(
@@ -975,14 +1093,16 @@ class _EngineCore:
         self._chunk = None   # (prog, feeds, fetch) — built on first use
         self._verify = None  # spec-decode verify form — built on first use
 
+        self._tp_rules = decoder_tp_rules(cfg, kv_dtype=kv_dtype) \
+            if self.tp > 1 else {}
         self.ref_prog, self.ref_feeds, self.ref_fetch = \
-            build_decoder_program(cfg, "reference")
+            self._build_form("reference")
         self.prefill_prog, self.prefill_feeds, self.prefill_fetch = \
-            build_decoder_program(cfg, "prefill", sampling=self.sampling,
-                                  kv_dtype=kv_dtype)
+            self._build_form("prefill", sampling=self.sampling,
+                             kv_dtype=kv_dtype)
         self.decode_prog, self.decode_feeds, self.decode_fetch = \
-            build_decoder_program(cfg, "decode", sampling=self.sampling,
-                                  kv_dtype=kv_dtype)
+            self._build_form("decode", sampling=self.sampling,
+                             kv_dtype=kv_dtype)
         self.mha_fused = 0
         if use_mha_fusion:
             # the serving pass pipeline: the naive composition the
@@ -1000,23 +1120,56 @@ class _EngineCore:
 
         from ..executor import device_put_owned
 
-        dev = place.jax_device()
+        if self.tp > 1:
+            # stage every weight/pool SHARDED over the serving mesh per
+            # its partition-rule placement (replicated when no rule):
+            # each device holds 1/tp of the bytes, and the executor's
+            # shard_map in_specs see exactly these placements
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
+
+            def _target(name):
+                s = self._tp_spec(name)
+                return NamedSharding(self.tp_mesh,
+                                     _P(*s) if s else _P())
+            dev_of = _target
+        else:
+            dev = place.jax_device()
+
+            def dev_of(name):
+                return dev
         for name, arr in weights.items():
-            self.scope.set(name, jax.device_put(arr, dev))
+            self.scope.set(name, jax.device_put(arr, dev_of(name)))
         for i in range(cfg.num_layers):
             # the pools are DONATED every prefill/decode step: they must
             # be XLA-owned buffers, never zero-copy host aliases
             self.scope.set(f"kv_k_{i}",
-                           device_put_owned(self.kv_config.make_pool(), dev))
+                           device_put_owned(self.kv_config.make_pool(),
+                                            dev_of(f"kv_k_{i}")))
             self.scope.set(f"kv_v_{i}",
-                           device_put_owned(self.kv_config.make_pool(), dev))
+                           device_put_owned(self.kv_config.make_pool(),
+                                            dev_of(f"kv_v_{i}")))
             if self.kv_config.quantized:
                 self.scope.set(
                     f"kv_k_scale_{i}",
-                    device_put_owned(self.kv_config.make_scale_pool(), dev))
+                    device_put_owned(self.kv_config.make_scale_pool(),
+                                     dev_of(f"kv_k_scale_{i}")))
                 self.scope.set(
                     f"kv_v_scale_{i}",
-                    device_put_owned(self.kv_config.make_scale_pool(), dev))
+                    device_put_owned(self.kv_config.make_scale_pool(),
+                                     dev_of(f"kv_v_scale_{i}")))
+        if self.tp > 1:
+            # engage-only telemetry (the flag-off registry is untouched):
+            # the TP degree gauge plus each device's share of the pool
+            tm.gauge("serving_tp_degree",
+                     "tensor-parallel degree of the serving engine "
+                     "mesh").set(self.tp)
+            per_dev = self.kv_pool_resident_bytes()
+            g = tm.gauge("kv_pool_resident_bytes",
+                         "per-device KV pool residency under TP "
+                         "(kv_heads-sharded)", labels=("device",))
+            for d in self.tp_mesh.devices.flat:
+                g.labels(device=str(d)).set(per_dev)
 
     @classmethod
     def from_model_dir(cls, model_dir: str, **kw) -> "_EngineCore":
@@ -1031,15 +1184,54 @@ class _EngineCore:
                    for n in decoder_param_specs(cfg)}
         return cls(cfg, weights, **kw)
 
+    def _tp_spec(self, name: str):
+        """Partition spec for one weight/pool var (None = replicated),
+        resolved from the same rule set the programs are annotated
+        with (exact name first, then regex fullmatch)."""
+        import re as _re
+
+        for pat, spec in self._tp_rules.items():
+            if pat == name or _re.fullmatch(pat, name):
+                return spec
+        return None
+
     # -- model steps -------------------------------------------------------
+    def _build_form(self, mode: str, sampling=None,
+                    kv_dtype: str = "float32") -> tuple:
+        """Build one program form at the engine's TP degree.  tp=1 is
+        the exact legacy builder call.  tp>1 builds the shard body,
+        runs the verifier-bracketed ``serving_tp_pass`` (combine
+        collectives on the serving ring), annotates every weight/pool
+        var with its partition-rule placement, and tags the program
+        with the mesh so the executor compiles it under shard_map."""
+        prog, feeds, fetch = build_decoder_program(
+            self.cfg, mode, sampling=sampling, kv_dtype=kv_dtype,
+            tp=self.tp)
+        if self.tp > 1:
+            from ..framework.ir import get_pass
+            from ..parallel.tensor_parallel import apply_tensor_parallel
+
+            get_pass("serving_tp_pass",
+                     ring_id=SERVING_TP_RING_ID).apply(prog)
+            rules = self._tp_rules
+            if mode == "reference":
+                # the reference form never touches the KV pool — its
+                # rule set must not demand pool vars that don't exist
+                rules = {k: v for k, v in rules.items()
+                         if not k.startswith("kv_")}
+            apply_tensor_parallel(prog, rules)
+            prog._tp_shard = {"axis": SERVING_TP_AXIS, "degree": self.tp,
+                              "mesh": self.tp_mesh}
+        return prog, feeds, fetch
+
     @property
     def chunk_prog_parts(self):
         """The "chunk" program form (built lazily: the flag-off engine
         never constructs it, keeping its host path identical)."""
         if self._chunk is None:
-            self._chunk = build_decoder_program(self.cfg, "chunk",
-                                                sampling=self.sampling,
-                                                kv_dtype=self.kv_dtype)
+            self._chunk = self._build_form("chunk",
+                                           sampling=self.sampling,
+                                           kv_dtype=self.kv_dtype)
         return self._chunk
 
     @property
@@ -1047,9 +1239,9 @@ class _EngineCore:
         """The spec-decode "verify" program form (lazy like chunk: a
         spec-off engine never constructs it)."""
         if self._verify is None:
-            self._verify = build_decoder_program(self.cfg, "verify",
-                                                 sampling=self.sampling,
-                                                 kv_dtype=self.kv_dtype)
+            self._verify = self._build_form("verify",
+                                            sampling=self.sampling,
+                                            kv_dtype=self.kv_dtype)
         return self._verify
 
     def _lane(self, req: Request, offset: int = 0) -> int:
@@ -1356,15 +1548,18 @@ class _EngineCore:
 
     # -- memory observability (r15) ---------------------------------------
     def kv_pool_resident_bytes(self) -> int:
-        """Device bytes pinned by the paged K/V pools for the engine's
-        lifetime: 2 pools (K and V) per layer at the allocator's fixed
-        shape, PLUS the int8 scale pools when the storage is quantized —
-        the ``kv_pool`` resident block the static planner
-        (framework/memory_plan.py) charges against the HBM budget."""
+        """PER-DEVICE bytes pinned by the paged K/V pools for the
+        engine's lifetime: 2 pools (K and V) per layer at the
+        allocator's fixed shape, PLUS the int8 scale pools when the
+        storage is quantized — the ``kv_pool`` resident block the
+        static planner (framework/memory_plan.py) charges against the
+        HBM budget.  Under TP the pools (and scale pools) shard on
+        kv_heads, so each device holds exactly 1/tp of the global
+        bytes (every sharded dim divides evenly — validate_tp_degree)."""
         per_pool = int(np.prod(self.kv_config.pool_shape())) * \
             np.dtype(self.kv_config.dtype).itemsize
         per_pool += self.kv_config.scale_bytes()
-        return 2 * self.cfg.num_layers * per_pool
+        return 2 * self.cfg.num_layers * per_pool // self.tp
 
     def memory_stats(self) -> dict:
         """The serving-side memory section (tools/serving_bench.py):
@@ -1380,7 +1575,10 @@ class _EngineCore:
         for n in decoder_param_specs(self.cfg):
             v = self.scope.get(n)
             if v is not None and hasattr(v, "nbytes"):
-                weights += int(v.nbytes)
+                nb = int(v.nbytes)  # global bytes (sharded or not)
+                if self.tp > 1 and self._tp_spec(n) is not None:
+                    nb //= self.tp  # this device's shard of the var
+                weights += nb
         try:
             measured = measured_peak(0)
         except Exception:
@@ -1398,6 +1596,7 @@ class _EngineCore:
             # is one page of the (fixed) pool block the planner models
             "prefix_cache": ps["prefix_cache"],
             "weight_bytes": int(weights),
+            "tp": self.tp,
             "measured": measured,
         }
 
@@ -1682,7 +1881,8 @@ class ServingEngine:
             self.stats["decode_steps"] += 1
             self.stats["decode_tokens"] += len(self.running)
             _trace_decode(self.running, toks, now, wall0,
-                          time.perf_counter(), self.stats["decode_steps"])
+                          time.perf_counter(), self.stats["decode_steps"],
+                          tp=self.core.tp)
             tm.counter("serving_decode_steps_total",
                        "batched decode steps run").inc()
             tm.counter("serving_decode_tokens_total",
@@ -1776,7 +1976,8 @@ class ServingEngine:
             emits.append(emit)
         _trace_decode(batch, [e[-1] for e in emits], now, wall0, wall1,
                       self.stats["decode_steps"],
-                      spec=[(len(d), a) for d, a in zip(drafts, accepts)])
+                      spec=[(len(d), a) for d, a in zip(drafts, accepts)],
+                      tp=self.core.tp)
         still = []
         for st, d, a, emit in zip(batch, drafts, accepts, emits):
             req = st.req
@@ -2023,7 +2224,7 @@ class StaticBatchingEngine:
         self.stats["decode_steps"] += 1
         self.stats["decode_tokens"] += len(self.group)
         _trace_decode(self.group, toks, now, wall0, time.perf_counter(),
-                      self.stats["decode_steps"])
+                      self.stats["decode_steps"], tp=self.core.tp)
         still = []
         for st, tok in zip(self.group, toks):
             st.req.out_tokens.append(tok)

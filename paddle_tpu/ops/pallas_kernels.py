@@ -773,6 +773,23 @@ def flash_attention_bwd_res(q, k, v, out, lse, do, bias=None, causal=False,
 # max-seq (Ragged Paged Attention, arXiv 2604.15464).
 
 
+def _gqa_group(n_heads: int, n_kv: int) -> int:
+    """Query-per-KV-head group size, validated: a silent floor division
+    here would read the wrong KV head for every query past the first
+    group.  Under tensor parallelism both counts arrive already divided
+    by the degree (the pool shards on its kv_heads dim), so the LOCAL
+    counts must still divide — the engine guards ``num_heads % tp`` at
+    construction, and this catches a mismatched pool handed in
+    directly."""
+    if n_kv <= 0 or n_heads % n_kv:
+        raise ValueError(
+            f"paged_attention: q_heads={n_heads} is not a positive "
+            f"multiple of kv_heads={n_kv} (GQA grouping; with "
+            f"tensor-parallel serving both are per-device LOCAL counts "
+            f"— pick a tp that divides both)")
+    return n_heads // n_kv
+
+
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
                               context_lens, scale=None,
                               k_scale=None, v_scale=None):
@@ -799,7 +816,7 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
     n_kv, _, page_size, _ = k_pages.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    group = n_heads // n_kv
+    group = _gqa_group(n_heads, n_kv)
     flat = block_tables.reshape(-1)
     # (kv_heads, seqs*pages, page_size, d) — sized by the BUCKETED table
     # width (longest active sequence), not the model max
@@ -895,7 +912,7 @@ def _paged_decode_call(q, k_pages, v_pages, block_tables, context_lens,
                        scale, k_scale=None, v_scale=None):
     n_seqs, n_heads, d = q.shape
     n_kv, _, page_size, _ = k_pages.shape
-    group = n_heads // n_kv
+    group = _gqa_group(n_heads, n_kv)
     n_pages = block_tables.shape[1]
     quant = k_scale is not None
 

@@ -37,8 +37,9 @@ costs milliseconds, not compiles.
 """
 from __future__ import annotations
 
+import re
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["ParallelPlan", "enumerate_candidates", "modeled_step_time",
@@ -61,6 +62,11 @@ class ParallelPlan:
     overlap: bool = True
     prefetch_auto: bool = False
     per_param_depths: Tuple[Tuple[str, int], ...] = field(default=())
+    # tensor-parallel degree (r23): 1 = off.  Only enumerated for
+    # programs that declare candidate degrees (``program._tp_candidates``
+    # — the serving engine's decoder forms); training sweeps keep the
+    # single tp=1 column and price identically to r22.
+    tp: int = 1
 
     def as_tuple(self) -> tuple:
         """The resolved-plan cache-key tuple (compile caches key on
@@ -68,14 +74,16 @@ class ParallelPlan:
         a stale fixed-flag compile)."""
         return (int(self.stage), str(self.bucket_mb),
                 int(self.prefetch_depth), bool(self.overlap),
-                bool(self.prefetch_auto), tuple(self.per_param_depths))
+                bool(self.prefetch_auto), tuple(self.per_param_depths),
+                int(self.tp))
 
     def as_dict(self) -> dict:
         return {"stage": int(self.stage), "bucket_mb": str(self.bucket_mb),
                 "prefetch_depth": int(self.prefetch_depth),
                 "overlap": bool(self.overlap),
                 "prefetch_auto": bool(self.prefetch_auto),
-                "per_param_depths": dict(self.per_param_depths)}
+                "per_param_depths": dict(self.per_param_depths),
+                "tp": int(self.tp)}
 
     def flag_overrides(self) -> dict:
         """The flag values that reproduce this plan by hand (modulo
@@ -84,10 +92,13 @@ class ParallelPlan:
         mb: object = self.bucket_mb
         if str(mb).strip().lower() != "auto":
             mb = float(mb)
-        return {"dp_sharding": int(self.stage),
+        over = {"dp_sharding": int(self.stage),
                 "fuse_grad_size_in_MB": mb,
                 "dp_prefetch_depth": int(self.prefetch_depth),
                 "dp_comm_overlap": int(bool(self.overlap))}
+        if int(self.tp) != 1:
+            over["serving_tp"] = int(self.tp)
+        return over
 
     @classmethod
     def from_flags(cls) -> "ParallelPlan":
@@ -98,7 +109,8 @@ class ParallelPlan:
         return cls(stage=int(flag("dp_sharding") or 0),
                    bucket_mb=str(flag("fuse_grad_size_in_MB")),
                    prefetch_depth=int(flag("dp_prefetch_depth") or 0),
-                   overlap=bool(flag("dp_comm_overlap")))
+                   overlap=bool(flag("dp_comm_overlap")),
+                   tp=int(flag("serving_tp", 1) or 1))
 
 
 def plan_flag_overrides(plan: Optional[ParallelPlan]) -> dict:
@@ -137,6 +149,40 @@ class applied_plan:
 # ==========================================================================
 # pricing
 # ==========================================================================
+#: the serving-TP combine sites ``serving_tp_pass`` will insert, matched
+#: on the PRE-rewrite program by the same output-name patterns the pass
+#: uses (framework/ir.py ServingTPPass): the post-embedding all-gather
+#: (factor 1.0) and the row-parallel partial-sum allreduces (ring
+#: allreduce factor 2.0) after each attention out-projection, each MLP
+#: down-projection, and the logits head.
+_TP_SITES = (
+    (re.compile(r"_srv_h0_\d+"), "elementwise_add", 1.0),
+    (re.compile(r"_srv_l\d+_(?:o|ff2)_\d+"), "matmul", 2.0),
+    (re.compile(r"_srv_logits_\d+"), "matmul", 2.0),
+)
+
+
+def _tp_collective_sites(block, assumed_batch: int = 64
+                         ) -> List[Tuple[int, float]]:
+    """(payload_bytes, alpha-beta factor) per combine the TP rewrite
+    would insert — the collective tail a tp>1 candidate pays per step."""
+    from ..framework.memory_plan import var_bytes
+
+    sites: List[Tuple[int, float]] = []
+    for op_ in block.ops:
+        outs = [n for ns in op_.outputs.values() for n in ns]
+        out = outs[0] if outs else None
+        if out is None:
+            continue
+        for rx, typ, factor in _TP_SITES:
+            if op_.type == typ and rx.fullmatch(out):
+                b = var_bytes(block, out, assumed_batch)
+                if b:
+                    sites.append((int(b), factor))
+                break
+    return sites
+
+
 def _divisible(block, name, ndev) -> bool:
     var = block._find_var_recursive(name)
     if (var is None or getattr(var, "_sharding", None)
@@ -401,9 +447,30 @@ def modeled_step_time(program, ndev: int, plan: ParallelPlan,
                     sites += 1
             gather_exposed_s += sites * g_s
 
-    total = t_compute + exposed_s + tail_gather_s + gather_exposed_s
+    # ---- tensor-parallel axis (r23) -------------------------------------
+    # tp>1 splits every sharded matmul's flops 1/tp but pays the
+    # Megatron combine pattern: one allreduce per row-parallel
+    # projection (2 per block + logits) and the post-embedding
+    # all-gather, priced on the calibrated alpha-beta model.  Only
+    # programs with recognizable combine sites scale — a program with
+    # no TP-able structure keeps its tp=1 price (so tp can never look
+    # free on a program the rewrite cannot shard).
+    tp = int(getattr(plan, "tp", 1) or 1)
+    tp_comm_s = 0.0
+    if tp > 1:
+        if "tp_sites" not in ctx:
+            ctx["tp_sites"] = _tp_collective_sites(block)
+        sites = ctx["tp_sites"]
+        if sites:
+            tp_comm_s = sum(collective_time_s(float(b), f, tp, cm)
+                            for b, f in sites)
+            t_compute = t_compute / tp
+
+    total = t_compute + exposed_s + tail_gather_s + gather_exposed_s \
+        + tp_comm_s
     return {
         "modeled_step_s": total,
+        "tp_comm_s": tp_comm_s,
         "t_compute_s": t_compute,
         "t_backward_end_s": t_bwd_end,
         "comm_exposed_s": exposed_s,
@@ -463,6 +530,14 @@ def enumerate_candidates(program, ndev: int, use_shard_map: bool,
                         out.append(ParallelPlan(
                             stage=3, bucket_mb=mb,
                             prefetch_depth=int(depth), overlap=overlap))
+    # tensor-parallel axis: only spanned when the program declares its
+    # candidate degrees (the serving engine's decoder forms set
+    # ``_tp_candidates``); every DP point is crossed with every degree
+    tps = tuple(int(t) for t in
+                (getattr(program, "_tp_candidates", None) or ()) if t)
+    if tps:
+        out = [replace(p, tp=t) for t in sorted(set(tps) | {1})
+               for p in out]
     return out
 
 
@@ -525,7 +600,7 @@ def search_plan(program, feed_names=(), fetch_names=(), *,
         # runs on the pre-rewrite program) — cache per (stage, prefetch)
         # so a full sweep prices memory once per ladder rung
         mem_key = (cand.stage, cand.prefetch_depth, cand.prefetch_auto,
-                   cand.per_param_depths)
+                   cand.per_param_depths, cand.tp)
         plan_mem = mem_cache.get(mem_key)
         if plan_mem is None:
             from .data_parallel import _plan_param_prefetch
@@ -542,7 +617,11 @@ def search_plan(program, feed_names=(), fetch_names=(), *,
                 ndev=ndev, stage=cand.stage, use_shard_map=use_shard_map,
                 prefetch_records=records,
                 prefetch_depth=int(cand.prefetch_depth),
-                assumed_batch=assumed_batch, scope=scope)
+                assumed_batch=assumed_batch, scope=scope,
+                tp=int(cand.tp),
+                tp_rules=getattr(program, "_tp_rule_set", None),
+                extra_resident=getattr(program, "_tp_extra_resident",
+                                       None))
             mem_cache[mem_key] = plan_mem
         peak = int(plan_mem.peak_bytes)
         feasible = not budget_bytes or peak <= budget_bytes

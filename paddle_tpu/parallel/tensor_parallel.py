@@ -32,6 +32,13 @@ def apply_tensor_parallel(program, rules: Dict[str, Sequence[Optional[str]]]):
     regex).  Returns the list of (name, spec) applied."""
     applied = []
     params = {p.name: p for p in program.all_parameters()}
+    # serving programs declare their weights (and KV pool vars) as
+    # persistable Variables rather than Parameter descs — they shard
+    # exactly the same way, so rules may target them too
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            if getattr(v, "persistable", False) and v.name not in params:
+                params[v.name] = v
     for pat, spec in rules.items():
         if pat in params:
             shard_parameter(params[pat], spec)

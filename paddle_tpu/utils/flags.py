@@ -252,6 +252,19 @@ _DEFAULTS: Dict[str, Any] = {
     # the unquantized engine — no scale pool, no extra program vars
     # (pinned by test).
     "FLAGS_kv_cache_dtype": "float32",
+    # tensor-parallel decode (inference/serving.py + parallel/
+    # tensor_parallel.py): shard the serving decoder over an "mp" mesh
+    # axis of this many devices — each device holds 1/tp of the
+    # attention heads, MLP width and embedding columns (Megatron
+    # placements derived from partition rules), with the two per-block
+    # c_allreduce_sum combines inserted by the serving_tp_pass.  The
+    # paged KV pool shards on its kv_heads dim, so a fixed PER-DEVICE
+    # kv_budget_mb buys tp x more pages (the capacity headline).
+    # Greedy decode is token-identical to tp=1 on seeded traces
+    # (pinned).  1 (default): single-device engine, byte-identical to
+    # the pre-TP serving paths — no mesh, no collectives (pinned by
+    # test).
+    "FLAGS_serving_tp": 1,
     # in-program sampling (ops/sampling_ops.py): when > 0, decode/
     # prefill/chunk/verify programs end in the sample_token op
     # (temperature + engine-level top-k/top-p) under per-slot RNG lane

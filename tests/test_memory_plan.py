@@ -653,6 +653,34 @@ def test_progcheck_mem_budget_exit(tmp_path):
                     "--budget-mb", "1e-5"]) == 1
 
 
+def test_progcheck_mem_tp_division(tmp_path, capsys):
+    """--mem --tp N --tp-rules: rule-matched vars are charged 1/tp per
+    device in the planner rows (the serving-decoder modeling knob), and
+    the engage-only ``tp`` field marks the row."""
+    from progcheck import main as pc_main
+
+    main, startup, loss = _tiny_program(seed=8)
+    p = tmp_path / "prog.json"
+    p.write_bytes(main.serialize_to_string())
+
+    def mem_row(extra):
+        assert pc_main([str(p), "--mem", "--feed", "x,y", "--quiet",
+                        "--json"] + extra) == 0
+        out = json.loads(capsys.readouterr().out)
+        return out["memory"][0]
+
+    base = mem_row([])
+    # the rule covers every fc param (weights AND biases), so the param
+    # class halves exactly; opt-state moments don't match and hold
+    tp = mem_row(["--tp", "2", "--tp-rules", r"fc_\d+\.(w|b)_0"])
+    assert base["resident_by_class"]["param"] > 0
+    assert tp["resident_by_class"]["param"] * 2 == \
+        base["resident_by_class"]["param"]
+    assert tp["resident_by_class"]["opt_state"] == \
+        base["resident_by_class"]["opt_state"]
+    assert tp["tp"] == 2 and "tp" not in base
+
+
 def test_mem_report_quick_subprocess():
     """tools/mem_report.py --quick: the bounded tier-1 reconciliation
     smoke — MLP probe, stages {0,3} x both DP paths, hard 15%/2%
@@ -678,6 +706,16 @@ def test_mem_report_quick_subprocess():
         if r_["stage"] >= 3:
             assert r_["scaling"]["param"]["err_pct"] <= 2.0
             assert r_["scaling"]["opt_state"]["err_pct"] <= 2.0
+    # r24: the serving TP reconciliation rows — per-device modeled
+    # (plan_memory tp/tp_rules) == engine census for kv_pool AND the
+    # decoder weights, and pages scale exactly tp x, every KV dtype
+    tp_sec = rep["serving_kv"]["tensor_parallel"]
+    assert tp_sec["available"] is True and tp_sec["all_reconciled"] is True
+    assert {r_["dtype"] for r_ in tp_sec["rows"]} == {
+        "float32", "bfloat16", "int8"}
+    for r_ in tp_sec["rows"]:
+        assert r_["modeled_eq_census"] is True
+        assert r_["pages_scale_x"] == float(tp_sec["tp"])
 
 
 def test_executor_plan_attached_and_gauged():

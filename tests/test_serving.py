@@ -479,3 +479,15 @@ def test_serving_bench_quick_subprocess():
         assert "executor_step_s" in snap
     assert rep["telemetry"]["continuous"]["serving_admitted_total"][
         "series"][0]["value"] == rep["scheduler"]["admitted"]
+    # r24: quick mode arms --tp 2 — the tensor_parallel section's own
+    # oracles (token identity vs tp=1 AND vs the greedy reference, tp x
+    # page capacity at fixed per-device budget, a feasible TP plan with
+    # tp=1 rows rejected before compile)
+    tps = rep["tensor_parallel"]
+    assert tps["tp"] == 2
+    assert tps["identity"]["tp_vs_tp1"] is True
+    assert tps["identity"]["tp_vs_reference"] is True
+    assert tps["capacity"]["ratio_x"] >= tps["capacity"]["expected_x"]
+    assert tps["plan"]["chosen_tp"] == 2
+    assert tps["plan"]["infeasible"] is False
+    assert tps["plan"]["n_rejected_before_compile"] > 0

@@ -222,14 +222,21 @@ def run_config(kind: str, collective: bool, stage: int, ndev: int,
     return row
 
 
-def serving_kv_rows():
+def serving_kv_rows(tp: int = 2):
     """The r23 serving-side reconciliation: one row per KV storage
     dtype (``FLAGS_kv_cache_dtype``) on a tiny decode engine at a FIXED
     byte budget.  The planner's ``kv_pool`` class must EQUAL the
     engine's census for every dtype — both count the pools at their
     storage itemsize plus the int8 scale pools — and the row carries
     the capacity the dtype buys (pages, tokens, tokens/GB) at the same
-    bytes."""
+    bytes.
+
+    The r24 ``tensor_parallel`` sub-section repeats the reconciliation
+    on a ``tp``-way engine at the SAME per-device budget: the planner's
+    ``tp``/``tp_rules`` division must reproduce the engine census for
+    BOTH the kv_pool class AND the decoder weights (per-device 1/tp of
+    the global bytes), and the pages the budget buys must scale exactly
+    tp x (the capacity headline)."""
     from paddle_tpu.framework import memory_plan as mp
     from paddle_tpu.inference.serving import (DecoderConfig, _EngineCore,
                                               init_decoder_weights)
@@ -240,33 +247,67 @@ def serving_kv_rows():
     page_bytes_f32 = (2 * cfg.num_layers * cfg.num_heads * page_size
                       * cfg.head_dim * 4)
     budget_mb = 16 * page_bytes_f32 / _MB
-    rows = []
-    for dtype in ("float32", "bfloat16", "int8"):
+
+    def build_row(dtype, degree):
         core = _EngineCore(cfg, init_decoder_weights(cfg),
                            page_size=page_size, kv_dtype=dtype,
-                           kv_budget_mb=budget_mb)
+                           kv_budget_mb=budget_mb, tp=degree)
         plan = mp.plan_memory(core.decode_prog,
                               feed_names=core.decode_feeds,
                               fetch_names=core.decode_fetch,
-                              scope=core.scope)
+                              scope=core.scope, tp=core.tp,
+                              tp_rules=core._tp_rules or None)
         modeled = int(plan.resident_by_class["kv_pool"])
         census = int(core.kv_pool_resident_bytes())
+        # decoder weights land in the planner's "state" class; the
+        # engine census is memory_stats()["weight_bytes"] — both are
+        # PER-DEVICE (1/tp of global for rule-matched vars)
+        modeled_w = int(sum(v["dev_bytes"] for v in plan.per_var.values()
+                            if v["class"] == "state"))
+        census_w = int(core.memory_stats()["weight_bytes"])
         ms = core.memory_stats()
         tokens = core.kv_config.num_pages * page_size
-        rows.append({
+        return {
             "dtype": dtype,
             "num_pages": int(core.kv_config.num_pages),
             "modeled_kv_pool_bytes": modeled,
             "census_kv_pool_bytes": census,
-            "modeled_eq_census": bool(modeled == census),
+            "modeled_weight_bytes": modeled_w,
+            "census_weight_bytes": census_w,
+            "modeled_eq_census": bool(modeled == census
+                                      and modeled_w == census_w),
             "scale_pool_bytes": int(ms["kv_pool_scale_bytes"]),
             "capacity_tokens": int(tokens),
             "tokens_per_gb": int((1 << 30) * tokens
                                  // max(int(budget_mb * _MB), 1)),
-        })
+        }
+
+    rows = [build_row(dtype, 1)
+            for dtype in ("float32", "bfloat16", "int8")]
+
+    import jax
+
+    tp = max(int(tp), 1)
+    can_tp = (tp > 1 and len(jax.devices()) >= tp
+              and cfg.num_heads % tp == 0)
+    tp_rows = []
+    if can_tp:
+        for r1 in rows:
+            row = build_row(r1["dtype"], tp)
+            row["pages_scale_x"] = round(
+                row["num_pages"] / max(r1["num_pages"], 1), 3)
+            row["capacity_ok"] = bool(
+                row["num_pages"] == tp * r1["num_pages"])
+            tp_rows.append(row)
     return {"budget_mb": round(budget_mb, 6), "rows": rows,
             "all_reconciled": bool(all(r["modeled_eq_census"]
-                                       for r in rows))}
+                                       for r in rows)),
+            "tensor_parallel": {
+                "tp": tp, "available": bool(can_tp), "rows": tp_rows,
+                "all_reconciled": bool(all(
+                    r["modeled_eq_census"] and r["capacity_ok"]
+                    for r in tp_rows)) if can_tp else None,
+            }}
 
 
 def format_serving_kv(section):
@@ -281,6 +322,23 @@ def format_serving_kv(section):
             f"{'ok' if r['modeled_eq_census'] else 'NO':>3} "
             f"{r['scale_pool_bytes']:>8} {r['capacity_tokens']:>7} "
             f"{r['tokens_per_gb']:>9}")
+    tp_sec = section.get("tensor_parallel") or {}
+    if tp_sec.get("available"):
+        lines.append(f"serving kv_pool tp={tp_sec['tp']} (same per-device "
+                     f"budget; modeled/census are PER-DEVICE):")
+        lines.append(f"  {'dtype':<10} {'pages':>6} {'x':>5} "
+                     f"{'kv_mod':>9} {'kv_cen':>9} {'w_mod':>8} "
+                     f"{'w_cen':>8} {'eq':>3}")
+        for r in tp_sec["rows"]:
+            ok = r["modeled_eq_census"] and r["capacity_ok"]
+            lines.append(
+                f"  {r['dtype']:<10} {r['num_pages']:>6} "
+                f"{r['pages_scale_x']:>5} "
+                f"{r['modeled_kv_pool_bytes']:>9} "
+                f"{r['census_kv_pool_bytes']:>9} "
+                f"{r['modeled_weight_bytes']:>8} "
+                f"{r['census_weight_bytes']:>8} "
+                f"{'ok' if ok else 'NO':>3}")
     return "\n".join(lines)
 
 
@@ -368,6 +426,17 @@ def main(argv=None) -> int:
             "serving kv_pool: modeled != census for "
             + ", ".join(r["dtype"] for r in serving_kv["rows"]
                         if not r["modeled_eq_census"]))
+        ok = False
+    # the r24 TP pin: per-device modeled (plan_memory tp/tp_rules) ==
+    # census AND tp x pages at the same per-device budget
+    tp_sec = serving_kv["tensor_parallel"]
+    if tp_sec["available"] and not tp_sec["all_reconciled"]:
+        checks["failures"].append(
+            f"serving kv_pool tp={tp_sec['tp']}: modeled != census or "
+            "capacity != tp x for "
+            + ", ".join(r["dtype"] for r in tp_sec["rows"]
+                        if not (r["modeled_eq_census"]
+                                and r["capacity_ok"])))
         ok = False
     budget = {}
     if args.budget_mb:

@@ -113,14 +113,17 @@ def check_manifests(dirs):
 
 
 def check_memory(program, feed_names=(), fetch_names=(), ndev=1,
-                 stage=None):
+                 stage=None, tp=1, tp_rules=None):
     """Static HBM plan for one program (framework/memory_plan.py) —
-    shared with the executor/DP compile paths."""
+    shared with the executor/DP compile paths.  ``tp``/``tp_rules``
+    model tensor-parallel serving: rule-matched vars (exact names or
+    fullmatch regexes; with no rules, vars carrying a ``_sharding``
+    annotation) are charged 1/tp per device."""
     from paddle_tpu.framework import memory_plan
 
     return memory_plan.plan_memory(program, feed_names=feed_names,
                                    fetch_names=fetch_names, ndev=ndev,
-                                   stage=stage)
+                                   stage=stage, tp=tp, tp_rules=tp_rules)
 
 
 def kv_pool_detail(program, plan):
@@ -218,6 +221,17 @@ def main(argv=None):
                     choices=(0, 1, 2, 3),
                     help="with --mem: ZeRO stage to model (default: "
                          "FLAGS_dp_sharding)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="with --mem: tensor-parallel degree to model — "
+                         "vars matching --tp-rules (or carrying a "
+                         "_sharding annotation) are charged 1/tp per "
+                         "device (serving decoder weights + KV pools)")
+    ap.add_argument("--tp-rules", default="",
+                    help="with --tp: comma-separated var names / "
+                         "fullmatch regexes to shard; the literal "
+                         "'serving' presets the serving decoder+KV "
+                         "patterns; empty falls back to _sharding "
+                         "annotations")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--strict", action="store_true",
@@ -251,15 +265,31 @@ def main(argv=None):
     n_err = sum(d.severity == "error" for _, d in diags)
     n_warn = sum(d.severity == "warning" for _, d in diags)
 
+    # --tp-rules: explicit patterns, or the "serving" preset (the same
+    # name space decoder_tp_rules covers — usable offline, where the
+    # deserialized program carries no _sharding annotations)
+    _SERVING_TP_PATS = (r"dec_embed", r"dec_pos_embed",
+                        r"dec_l\d+_w[qkvo12]",
+                        r"kv_[kv]_\d+", r"kv_[kv]_scale_\d+")
+    tp_rules = None
+    if args.tp_rules.strip() == "serving":
+        tp_rules = {p: None for p in _SERVING_TP_PATS}
+    elif args.tp_rules.strip():
+        tp_rules = {p.strip(): None
+                    for p in args.tp_rules.split(",") if p.strip()}
+
     mem_rows = []
     mem_plans = []
     over_budget = []
     if args.mem:
         for label, prog in progs:
             plan = check_memory(prog, feed_names, fetch_names,
-                                ndev=args.ndev, stage=args.mem_stage)
+                                ndev=args.ndev, stage=args.mem_stage,
+                                tp=args.tp, tp_rules=tp_rules)
             mem_plans.append((label, plan))
             row = dict(plan.as_dict(10), program=label)
+            if args.tp > 1:
+                row["tp"] = int(args.tp)
             kv = kv_pool_detail(prog, plan)
             if kv is not None:
                 row["kv_pool"] = kv

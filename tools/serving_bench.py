@@ -83,6 +83,16 @@ def build_args():
                          "within-dtype token-identity oracles, "
                          "admission-gap + preemption A/B under a tight "
                          "budget, spec accept-rate delta ('' = off)")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="arm the tensor_parallel report section: shard "
+                         "the decoder + paged KV pool over an 'mp' mesh "
+                         "axis of this degree (FLAGS_serving_tp) and A/B "
+                         "vs tp=1 — per-device weight + pool bytes, pool "
+                         "capacity at FIXED per-device kv_budget_mb, "
+                         "greedy token-identity oracle, admission-gap "
+                         "under a tight budget, and the plan-search "
+                         "feasibility rows (0 = off; needs >= tp "
+                         "devices, host-platform virtual devices count)")
     ap.add_argument("--repeat-frac", type=float, default=0.0,
                     help="self-similar trace knob for the spec section "
                          "(fraction of each prompt rewritten as "
@@ -485,6 +495,161 @@ def kv_quant_section(model_dir, cfg, args):
     }
 
 
+def tensor_parallel_section(model_dir, cfg, args):
+    """The r24 A/B at FIXED per-device HBM bytes: the tensor-parallel
+    engine (``--tp`` — decoder weights sharded by the Megatron
+    column/row rules, the paged KV pool sharded on its kv_heads dim
+    over the ``mp`` mesh axis) vs tp=1.  Reports:
+
+    * **memory** — per-device decoder-weight and KV-pool resident
+      bytes at each degree: sharded classes must scale ~1/tp while the
+      replicated allocator state does not;
+    * **capacity** — both engines sized from the SAME ``kv_budget_mb``
+      (a PER-DEVICE budget): the tp engine's pool must hold >= tp x the
+      pages, because each device stores only 1/tp of every page's
+      heads — the headline claim;
+    * **token identity** — greedy decode over the seeded trace must be
+      token-identical to tp=1 AND to the one-at-a-time reference (the
+      combine collectives are exact sums, not approximations);
+    * **admission A/B** — the same submit-all trace on a tight
+      per-device budget: the tp engine's extra pages must show up as
+      scheduling headroom (first-token gap / preemptions no worse);
+    * **plan** — ``plan_search`` over the decode form with tp in the
+      candidate space: the modeled per-device peak, the TP collective
+      tail, and whether tp=1 was rejected before compile under the
+      equivalent budget.
+    """
+    from paddle_tpu.inference.serving import (Request, ServingEngine,
+                                              build_decoder_program,
+                                              decoder_tp_rules)
+    from paddle_tpu.parallel.plan_search import search_plan
+    from paddle_tpu.utils.loadgen import poisson_trace
+    from paddle_tpu.utils import flags as _flags
+
+    tp = int(args.tp)
+    head_dim = cfg.hidden // cfg.num_heads
+    page_bytes_f32 = (2 * cfg.num_layers * cfg.num_heads * args.page_size
+                      * head_dim * 4)
+    budget_mb = args.num_pages * page_bytes_f32 / float(1 << 20)
+
+    def make(degree, budget, **kw):
+        return ServingEngine(model_dir=model_dir, max_batch=args.max_batch,
+                             token_budget=args.token_budget, seed=args.seed,
+                             page_size=args.page_size, kv_budget_mb=budget,
+                             prefill_bucket_min=8, tp=degree, **kw)
+
+    # --- capacity + per-device memory at fixed per-device bytes -------
+    e1 = make(1, budget_mb)
+    etp = make(tp, budget_mb)
+    mem1, memtp = e1.core.memory_stats(), etp.core.memory_stats()
+    capacity = {
+        "budget_mb_per_device": round(budget_mb, 6),
+        "tp1_pages": int(e1.core.kv_config.num_pages),
+        "tp_pages": int(etp.core.kv_config.num_pages),
+        "ratio_x": round(etp.core.kv_config.num_pages
+                         / e1.core.kv_config.num_pages, 3),
+        "expected_x": float(tp),
+        "tp1_pool_bytes_per_device": int(e1.core.kv_pool_resident_bytes()),
+        "tp_pool_bytes_per_device": int(etp.core.kv_pool_resident_bytes()),
+    }
+    memory = {
+        "tp1": {"weight_bytes": int(mem1["weight_bytes"]),
+                "kv_pool_resident_bytes":
+                    int(mem1["kv_pool_resident_bytes"])},
+        f"tp{tp}": {"weight_bytes": int(memtp["weight_bytes"]),
+                    "kv_pool_resident_bytes":
+                        int(memtp["kv_pool_resident_bytes"])},
+        "weights_scale_x": round(mem1["weight_bytes"]
+                                 / max(memtp["weight_bytes"], 1), 3),
+    }
+
+    # --- greedy token identity on the seeded trace --------------------
+    trace = poisson_trace(
+        args.requests, args.rate, cfg.vocab_size,
+        prompt_len_range=(args.prompt_min, args.prompt_max),
+        max_new_range=(args.new_min, args.new_max), seed=args.seed)
+    prompts = [e.prompt for e in trace]
+    out1 = e1.generate(prompts, max_new_tokens=args.new_max)
+    outtp = etp.generate(prompts, max_new_tokens=args.new_max)
+    oracle = [e1.core.greedy_reference(e.prompt, args.new_max)
+              for e in trace]
+    identity = {
+        "tp_vs_tp1": bool(outtp == out1),
+        "tp_vs_reference": bool(outtp == oracle),
+    }
+
+    # --- admission gap under a tight per-device budget ----------------
+    longest = args.prompt_max + args.new_max
+    pages_long = -(-longest // args.page_size)
+    tight_mb = (pages_long + 1) * page_bytes_f32 / float(1 << 20)
+
+    def admission(degree):
+        e = make(degree, tight_mb)
+        for i, ev in enumerate(trace):
+            e.submit(Request(f"t{i}", list(ev.prompt),
+                             ev.max_new_tokens, 0.0))
+        first, step = {}, 0
+        while e.has_work() and step < 5000:
+            step += 1
+            for out in e.step():
+                first.setdefault(out.req_id, step)
+        gaps = sorted(first.values())
+        return {
+            "pages": int(e.core.kv_config.num_pages),
+            "steps": int(step),
+            "preempted": int(e.stats["preempted"]),
+            "first_token_step_max": int(gaps[-1]) if gaps else int(step),
+        }
+
+    adm1, admtp = admission(1), admission(tp)
+    admission_ab = {
+        "tight_budget_mb_per_device": round(tight_mb, 6),
+        "tp1": adm1, f"tp{tp}": admtp,
+        "gap_no_worse": bool(admtp["first_token_step_max"]
+                             <= adm1["first_token_step_max"]),
+        "preempt_no_worse": bool(admtp["preempted"] <= adm1["preempted"]),
+    }
+
+    # --- plan-search feasibility rows ---------------------------------
+    # price the decode form with tp in the candidate space under a
+    # budget that the tp=1 weights+pool cannot fit: the tp=1 column
+    # must be rejected BEFORE any compile, a tp>1 column chosen
+    prog, feeds, fetches = build_decoder_program(cfg, "decode")[:3]
+    prog._tp_candidates = (tp,)
+    prog._tp_rule_set = decoder_tp_rules(cfg)
+    pool_bytes = args.num_pages * page_bytes_f32  # all layers, both sides
+    prog._tp_extra_resident = {"kv_k_0": pool_bytes // 2,
+                               "kv_v_0": pool_bytes // 2}
+    wb = int(mem1["weight_bytes"])
+    squeeze_mb = (wb + pool_bytes) * 0.75 / float(1 << 20)
+    saved = _flags.flag("hbm_budget_mb")
+    _flags.set_flags({"FLAGS_hbm_budget_mb": squeeze_mb})
+    try:
+        plan, report = search_plan(prog, feeds, fetches, ndev=1,
+                                   use_shard_map=False, strict=False)
+    finally:
+        _flags.set_flags({"FLAGS_hbm_budget_mb": saved or 0})
+    chosen = report["chosen"] or {}
+    plan_sec = {
+        "budget_mb": round(squeeze_mb, 3),
+        "chosen_tp": int(plan.tp),
+        "chosen_peak_mb": chosen.get("modeled_peak_mb"),
+        "chosen_step_s": chosen.get("modeled_step_s"),
+        "tp_comm_s": chosen.get("tp_comm_s"),
+        "n_rejected_before_compile": int(report["n_rejected"]),
+        "infeasible": bool(report["infeasible"]),
+    }
+
+    return {
+        "tp": tp,
+        "capacity": capacity,
+        "memory": memory,
+        "identity": identity,
+        "admission": admission_ab,
+        "plan": plan_sec,
+    }
+
+
 def measure(eng, trace, warmup):
     """Replay unmeasured ``warmup`` times (populates the executor's jit
     cache for every bucket shape the trace hits — each replay drains
@@ -525,6 +690,16 @@ def main(argv=None):
             args.repeat_frac = 0.5
         if not args.kv_dtype:
             args.kv_dtype = "int8"  # the quick kv-quant oracle
+        if args.tp == 0:
+            args.tp = 2            # the quick tensor-parallel oracle
+    if args.tp > 1 and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # the mp mesh needs >= tp devices; on the CPU proxy, virtual
+        # host devices stand in (must be set before jax initializes,
+        # which the paddle_tpu imports below trigger)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={max(args.tp, 8)}")
 
     from paddle_tpu.inference.serving import DecoderConfig, export_decoder
     from paddle_tpu.utils.loadgen import emit_json, poisson_trace
@@ -622,6 +797,12 @@ def main(argv=None):
             # HBM bytes (capacity ratio, within-dtype identity,
             # admission headroom, spec accept-rate delta)
             payload["kv_quant"] = kv_quant_section(model_dir, cfg, args)
+        if args.tp > 1:
+            # the r24 section: tensor-parallel decode vs tp=1 at fixed
+            # per-device bytes (capacity, per-device memory, token
+            # identity, admission headroom, plan-search rows)
+            payload["tensor_parallel"] = tensor_parallel_section(
+                model_dir, cfg, args)
         if not args.json:
             print(json.dumps(payload, indent=2, sort_keys=True))
         emit_json("SERVING", payload)
@@ -671,6 +852,21 @@ def main(argv=None):
                       f"ratio={sec['capacity']['ratio_x']}x vs "
                       f"{sec['capacity']['expected_x']}x expected, "
                       f"admission={sec['admission']})", file=sys.stderr)
+                return 1
+        if args.quick and args.tp > 1:
+            # the tensor-parallel oracle: greedy decode token-identical
+            # to tp=1 AND the reference, pool capacity strictly higher
+            # (>= tp x) at the same per-device budget
+            sec = payload["tensor_parallel"]
+            idn = sec["identity"]
+            if not (idn["tp_vs_tp1"] and idn["tp_vs_reference"]
+                    and sec["capacity"]["tp_pages"]
+                    > sec["capacity"]["tp1_pages"]
+                    and sec["capacity"]["ratio_x"]
+                    >= sec["capacity"]["expected_x"]):
+                print("FAIL: tensor-parallel oracle did not hold "
+                      f"(identity={idn}, "
+                      f"capacity={sec['capacity']})", file=sys.stderr)
                 return 1
     return 0
 

@@ -83,6 +83,10 @@ def build_args():
                          "int8; '' = FLAGS_kv_cache_dtype) — reported "
                          "in the payload so traces from quantized-vs-"
                          "f32 A/B runs are distinguishable")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree for the engine (r24); "
+                         "reported in the payload so TP-vs-single "
+                         "traces are distinguishable")
     ap.add_argument("--slo-ttft-ms", type=float, default=200.0,
                     help="TTFT target in ms (0 = unset)")
     ap.add_argument("--slo-token-ms", type=float, default=100.0,
@@ -187,6 +191,13 @@ def main(argv=None) -> int:
         args.warmup = max(args.warmup, 1)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.tp > 1 and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # the TP engine needs tp devices; force a virtual CPU mesh
+        # before jax initializes (no-op on a real multi-chip host)
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + f" --xla_force_host_platform_device"
+                                     f"_count={max(args.tp, 8)}").strip()
     from paddle_tpu.inference.serving import DecoderConfig, ServingEngine
     from paddle_tpu.utils import flags as _flags
     from paddle_tpu.utils import telemetry, tracing
@@ -213,7 +224,8 @@ def main(argv=None) -> int:
                         prefix_cache=args.prefix_cache or None,
                         prefill_chunk=args.chunk_tokens,
                         spec_k=args.spec_k or None,
-                        kv_dtype=args.kv_dtype or None)
+                        kv_dtype=args.kv_dtype or None,
+                        tp=args.tp)
     trace = poisson_trace(
         args.requests, args.rate, cfg.vocab_size,
         prompt_len_range=(args.prompt_min, args.prompt_max),
@@ -293,6 +305,9 @@ def main(argv=None) -> int:
         "requests": args.requests, "rate_req_s": args.rate,
         "seed": args.seed,
         "policy": args.policy,
+        # r24: the engine's tensor-parallel degree — TP-vs-single
+        # traces are otherwise indistinguishable in this report
+        "tp": int(eng.core.tp),
         # r23: the pool's storage dtype — quantized-vs-f32 A/B traces
         # are otherwise indistinguishable in this report
         "kv_pool": {"dtype": eng.kv_dtype,
