@@ -513,6 +513,27 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
             modeled_step_s = _ps.modeled_step_time(
                 mp, n_devices, _ps.ParallelPlan.from_flags(),
                 use_shard_map=collective)["modeled_step_s"]
+        # r25 relief columns: dry-run the memory_relief pass at half
+        # this mode's modeled peak on the rewritten program — what the
+        # relieved peak / modeled overhead would be if the budget
+        # forced it (relief itself stays off for the timed runs)
+        relief_peak_mb = relief_overhead_ms = None
+        if mem_plan is not None and mem_plan.peak_bytes > 0:
+            from paddle_tpu.framework.ir import get_pass as _get_pass
+            try:
+                _rp = _get_pass(
+                    "memory_relief_pass", mode="auto",
+                    budget=int(mem_plan.peak_bytes // 2),
+                    feed_names=("x", "y"), fetch_names=(lv.name,),
+                    ndev=n_devices, allow_escalate=False)
+                _rp.apply(rewritten.clone())
+                if _rp.report and _rp.report.get("engaged"):
+                    relief_peak_mb = round(
+                        _rp.report["peak_after_bytes"] / float(1 << 20), 4)
+                    relief_overhead_ms = round(
+                        _rp.report["modeled_overhead_s"] * 1e3, 6)
+            except Exception:
+                pass
         modes[name] = {
             "sharding_stage": stage,
             "prefetch_depth": int(_flags.flag("dp_prefetch_depth") or 0),
@@ -546,6 +567,8 @@ def _scaling_worker(n_devices=8, steps=6, timed_steps=30):
                          "type": mem_plan.peak_op_type}
                         if mem_plan is not None else None),
             "measured_peak_mb": round(measured_dev / float(1 << 20), 4),
+            "relief_peak_mb": relief_peak_mb,
+            "relief_overhead_ms": relief_overhead_ms,
         }
     _flags.set_flags(defaults)
     print("SCALING=" + _json.dumps({

@@ -467,6 +467,11 @@ class Executor:
                # a new measured profile can move autotuned bucket
                # boundaries — stale compilations must not be reused
                calibration_version(),
+               # memory relief rewrites the traced program: flipping the
+               # mode or the HBM budget must never serve a compilation
+               # built under the other regime
+               str(flag("memory_relief", "off") or "off"),
+               str(flag("hbm_budget_mb") or 0),
                # probe config + any armed chaos NaN injection: step K of
                # a nan_inject schedule must trace the poisoned variant
                # and step K+1 must fall back to the clean cached one
@@ -488,7 +493,15 @@ class Executor:
 
         tp_shard = getattr(program, "_tp_shard", None)
         src_block = program.global_block()
-        program = self._apply_ir_passes(program, fetch_names)
+        program = self._apply_ir_passes(
+            program, fetch_names, feed_names=tuple(sorted(feed)),
+            scope=scope,
+            # single-device compile: remat/offload only — there is no
+            # parallel plan to escalate.  TP serving programs are never
+            # relieved (the shard_map trace must match the engine's
+            # weight placement op-for-op)
+            relief_ctx=(None if tp_shard is not None
+                        else {"ndev": 1, "allow_escalate": False}))
         if tp_shard is not None and program is not src_block.program:
             # the IR pipeline cloned through a desc round-trip, which
             # drops python-side sharding annotations — re-attach them so
@@ -754,12 +767,22 @@ class Executor:
         return compiled
 
     # ------------------------------------------------------------------
-    def _apply_ir_passes(self, program: Program, fetch_names):
+    def _apply_ir_passes(self, program: Program, fetch_names,
+                         feed_names=(), scope=None, relief_ctx=None):
         """Training-time fusion pipeline (reference: BuildStrategy
         fuse_bn_act_ops / fuse_bn_add_act_ops applied in
         parallel_executor.cc:581).  Runs on a clone so the user's program
         stays introspectable; the compile cache is keyed on the original
-        program, so the clone+rewrite happens once per compilation."""
+        program, so the clone+rewrite happens once per compilation.
+
+        When ``relief_ctx`` is given (a dict of memory_relief_pass
+        attrs: ndev / stage / use_shard_map / allow_escalate / ...) and
+        ``FLAGS_memory_relief`` != off with an HBM budget set, the
+        relief pass joins the pipeline after every fusion pass (it must
+        price the final op stream) and before the numerics probe (the
+        probes must see the relieved program); its decision report is
+        attached to the clone as ``_memory_relief`` for
+        ``plan_and_surface`` to pick up."""
         from .utils.flags import flag
 
         from .framework.ir import _FUSABLE_OPT, PassManager, get_pass
@@ -816,12 +839,24 @@ class Executor:
                     sharding_stage=sharding_stage,
                     ndev=ring_axis_size(0),
                     autotune=auto and bool(flag("dp_comm_overlap"))))
+        relief = None
+        if relief_ctx is not None:
+            from .framework import memory_plan as _mp
+
+            mode = str(flag("memory_relief", "off") or "off")
+            if mode != "off" and _mp.budget_bytes() > 0:
+                relief = get_pass("memory_relief_pass", mode=mode,
+                                  feed_names=tuple(feed_names),
+                                  fetch_names=tuple(fetch_names),
+                                  scope=scope, **relief_ctx)
+                passes.append(relief)
         from .framework import numerics as _numerics
 
         if _numerics.probe_armed():
             # LAST in the pipeline: probes read final values, so every
-            # rewrite (fusion, layout, bucketing) must already have
-            # happened — the probed var set is the compiled program's
+            # rewrite (fusion, layout, bucketing, relief) must already
+            # have happened — the probed var set is the compiled
+            # program's
             passes.append(get_pass("numerics_probe_pass",
                                    ops_regex=_numerics.probe_ops_regex()))
         if not passes:
@@ -829,6 +864,8 @@ class Executor:
         clone = Program.from_desc_dict(program.desc_dict())
         clone.random_seed = program.random_seed
         PassManager(passes).apply(clone)
+        if relief is not None and relief.report is not None:
+            clone._memory_relief = relief.report
         return clone
 
     # ------------------------------------------------------------------

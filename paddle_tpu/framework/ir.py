@@ -2624,3 +2624,489 @@ class ServingTPPass(Pass):
         if inserted:
             program._bump_version()
         return program
+
+
+# ==========================================================================
+# Plan-driven memory relief (rematerialization / host offload / plan
+# escalation), priced per-var by the calibrated cost model
+# ==========================================================================
+_RELIEF_SCOPE = "/memory_relief/"
+_RELIEF_MARK = "@RELIEF@"
+_REMAT_SUFFIX = "@RELIEF@REMAT"
+_D2H_SUFFIX = "@RELIEF@D2H"   # endswith @D2H => zero device bytes (planner)
+_H2D_SUFFIX = "@RELIEF@H2D"
+
+
+def _role_of(op_) -> int:
+    try:
+        return int(op_.attrs.get("op_role", 0))
+    except Exception:
+        return 0
+
+
+def _read_in_subblocks(program: Program, name: str) -> bool:
+    for blk in program.blocks:
+        if blk.idx == 0:
+            continue
+        for op_ in blk.ops:
+            if name in op_.input_arg_names:
+                return True
+    return False
+
+
+def price_relief_candidates(program: Program, plan, cm, mode: str = "auto",
+                            done=()) -> List[dict]:
+    """Price remat / offload fixes for every activation whose lifetime
+    crosses the modeled peak op, cheapest modeled seconds-per-byte-saved
+    first.  ``plan`` is a ``MemoryPlan``; ``cm`` a ``CostModel``.  Only
+    fixes that can actually lower *the* peak qualify: the var must be
+    produced before and next consumed after ``plan.peak_op_index``."""
+    from ..backward import OpRole
+    from ..ops.registry import OPS
+    from ..utils.cost_model import COMM_OPS, op_time_s
+    from .verifier import EMPTY
+
+    block = program.global_block()
+    ops = list(block.ops)
+    peak_i = plan.peak_op_index
+    if peak_i is None:
+        return []
+    done = set(done)
+    producer_at: Dict[str, int] = {}
+    consumers: Dict[str, List[int]] = {}
+    writers: Dict[str, List[int]] = {}
+    for i, op_ in enumerate(ops):
+        for nm in op_.input_arg_names:
+            consumers.setdefault(nm, []).append(i)
+        for nm in op_.output_arg_names:
+            producer_at.setdefault(nm, i)
+            writers.setdefault(nm, []).append(i)
+    # per-op compute time; collectives ride the comm stream and hide
+    # nothing for the host link
+    op_s = [0.0 if op_.type in COMM_OPS else op_time_s(op_, block, cm)
+            for op_ in ops]
+    cum = [0.0]
+    for s in op_s:
+        cum.append(cum[-1] + s)  # cum[i] = compute time before op i
+
+    bwd_bit = int(OpRole.Backward)
+    out: List[dict] = []
+    for name, info in (plan.per_var or {}).items():
+        if info.get("class") != "activation" or info.get("resident"):
+            continue
+        if name in done or _RELIEF_MARK in name or name == EMPTY:
+            continue
+        saved = int(info.get("dev_bytes") or 0)
+        if saved <= 0:
+            continue
+        p = producer_at.get(name)
+        cons = consumers.get(name, [])
+        bwd = [i for i in cons if _role_of(ops[i]) & bwd_bit]
+        fwd = [i for i in cons if not (_role_of(ops[i]) & bwd_bit)]
+        if p is None or not bwd:
+            continue
+        f_last = max(fwd) if fwd else p
+        b_first = min(bwd)
+        if not (f_last < peak_i < b_first):
+            continue
+        v = block._find_var_recursive(name)
+        if v is None or v.shape is None:
+            continue
+        if _read_in_subblocks(block.program, name):
+            continue  # sub-block capture: renaming would miss readers
+        # ---- (a) rematerialize: replay the producer before b_first ----
+        if mode in ("remat", "auto") and fwd:
+            P = ops[p]
+            d = OPS.get(P.type)
+            real_outs = [o for o in P.output_arg_names if o != EMPTY]
+            ok = (d is not None and not d.stateful and not d.host
+                  and P.type not in COMM_OPS
+                  and real_outs == [name]
+                  and name not in P.input_arg_names
+                  and not any(isinstance(a, Block)
+                              for a in P.attrs.values()))
+            if ok:
+                # every producer input must still hold the same value
+                # at the replay point
+                for nm in set(P.input_arg_names):
+                    if any(p < w < b_first for w in writers.get(nm, ())):
+                        ok = False
+                        break
+            # replaying the producer revives its inputs: any input
+            # that currently dies before the peak would be dragged back
+            # across it, un-saving its own bytes — charge that against
+            # the fix (single-op replay granularity: a chain remat that
+            # nets zero is skipped, offload covers those vars instead)
+            net = saved
+            if ok:
+                for nm in set(P.input_arg_names):
+                    inm = (plan.per_var or {}).get(nm)
+                    if inm is None or inm.get("resident"):
+                        continue
+                    last_use = max(consumers.get(nm, [p]) + [p])
+                    if last_use < peak_i:
+                        net -= int(inm.get("dev_bytes") or 0)
+            if ok and net > 0:
+                cost = max(op_s[p], cm.launch_s)
+                out.append({"var": name, "fix": "remat",
+                            "saved_bytes": net, "cost_s": cost,
+                            "seconds_per_byte": cost / net,
+                            "producer_index": p, "f_last": f_last,
+                            "b_first": b_first})
+        # ---- (b) host offload: d2h after f_last, h2d hoisted so the
+        # transfer hides behind backward compute (r14 double-buffering) --
+        if mode in ("offload", "auto"):
+            d2h_s = saved / cm.d2h_bytes_per_s
+            h2d_s = saved / cm.h2d_bytes_per_s
+            hide_d2h = max(cum[peak_i] - cum[min(f_last + 1, len(ops))],
+                           0.0)
+            # hoist the h2d back from the consumer until the transfer
+            # hides behind backward compute — but never at-or-before
+            # the peak op, else the value is back on device at the
+            # peak and the fix saves nothing
+            h = b_first
+            acc = 0.0
+            while h - 1 > max(f_last + 1, peak_i) and acc < h2d_s:
+                h -= 1
+                acc += op_s[h]
+            cost = (2.0 * cm.launch_s + max(0.0, d2h_s - hide_d2h)
+                    + max(0.0, h2d_s - acc))
+            out.append({"var": name, "fix": "offload",
+                        "saved_bytes": saved, "cost_s": cost,
+                        "seconds_per_byte": cost / saved,
+                        "f_last": f_last, "b_first": b_first,
+                        "h_insert": h})
+    out.sort(key=lambda c: (c["seconds_per_byte"], c["var"], c["fix"]))
+    return out
+
+
+def relief_candidate_summary(program: Program, plan, top: int = 3,
+                             feed_names: Sequence[str] = (),
+                             fetch_names: Sequence[str] = ()) -> List[dict]:
+    """Cheapest candidate fix per var, for the over-budget warning
+    (actionable even with FLAGS_memory_relief=off)."""
+    from ..utils.cost_model import default_cost_model
+
+    block = program.global_block()
+    cm = default_cost_model(list(block.ops), block)
+    best: Dict[str, dict] = {}
+    for c in price_relief_candidates(program, plan, cm, mode="auto"):
+        best.setdefault(c["var"], c)  # already sorted cheapest-first
+    return [{k: c[k] for k in ("var", "fix", "saved_bytes", "cost_s",
+                               "seconds_per_byte")}
+            for c in list(best.values())[:int(top)]]
+
+
+@register_pass("memory_relief_pass")
+class MemoryReliefPass(Pass):
+    """Spend modeled recompute time or host-transfer time to buy back
+    HBM when ``plan_memory()``'s modeled peak exceeds
+    ``FLAGS_hbm_budget_mb`` (``FLAGS_memory_relief={off,remat,offload,
+    auto}``; ``off`` leaves the pipeline byte-identical).
+
+    Greedy loop: price every candidate fix (remat / offload / plan
+    escalation), apply the cheapest by modeled seconds-per-byte-saved,
+    re-run ``plan_memory()`` so savings compound, repeat until the peak
+    fits.  Decisions land in ``self.report`` (attached to
+    ``compiled._memory_plan.relief`` by ``plan_and_surface``):
+
+    * **remat** — the producing op is replayed immediately before the
+      first backward consumer (same op, same inputs: bit-identical) and
+      backward readers are redirected to the ``@RELIEF@REMAT`` copy, so
+      the original activation dies at its last forward consumer.
+    * **offload** — a ``memcpy_d2h`` right after the last forward
+      consumer stages the value to host (``@D2H`` names charge zero
+      device bytes in the planner) and a ``memcpy_h2d`` hoisted far
+      enough ahead of the backward consumer that the transfer hides
+      behind backward compute (the r14 double-buffering rule; the
+      resulting windows are validated by the r10
+      ``check_prefetch_plan`` rule).
+    * **plan** — when modeled cheaper, escalate the r16 parallel plan
+      instead (raise the ZeRO stage / shrink the prefetch window); the
+      caller picks the new ``stage``/``prefetch_depth`` out of the
+      report.
+
+    Raises ``MemoryBudgetError`` naming the residual gap when the peak
+    still does not fit and ``FLAGS_hbm_budget_strict`` is set.
+    """
+
+    feed_names: Sequence[str] = ()
+    fetch_names: Sequence[str] = ()
+    ndev: int = 1
+    stage = None            # None: FLAGS_dp_sharding
+    use_shard_map = None
+    prefetch_depth = None   # None: FLAGS_dp_prefetch_depth
+    scope = None
+    mode: str = "auto"
+    budget = None           # bytes; None: FLAGS_hbm_budget_mb
+    allow_escalate: bool = False
+    max_fixes: int = 64
+    report: Optional[dict] = None
+
+    def apply_impl(self, program: Program) -> Program:
+        from ..utils.cost_model import default_cost_model
+        from ..utils.flags import flag
+        from . import memory_plan as _mp
+
+        block = program.global_block()
+        budget = int(self.budget) if self.budget else _mp.budget_bytes()
+        mode = str(self.mode or "auto")
+        stage = self.stage
+        if stage is None:
+            stage = int(flag("dp_sharding") or 0)
+        pf_depth = self.prefetch_depth
+        if pf_depth is None:
+            pf_depth = int(flag("dp_prefetch_depth") or 0)
+        report = self.report = {
+            "mode": mode, "engaged": False, "budget_bytes": int(budget),
+            "peak_before_bytes": 0, "peak_after_bytes": 0, "fixes": [],
+            "bytes_saved": 0, "modeled_overhead_s": 0.0,
+            "stage": int(stage), "prefetch_depth": int(pf_depth),
+            "offload_windows": [],
+        }
+        if not budget or mode == "off":
+            return program
+
+        def replan(st=None, pf=None):
+            return _mp.plan_memory(
+                program, feed_names=tuple(self.feed_names),
+                fetch_names=tuple(self.fetch_names), ndev=int(self.ndev),
+                stage=(stage if st is None else st),
+                use_shard_map=self.use_shard_map,
+                prefetch_depth=(pf_depth if pf is None else pf),
+                scope=self.scope)
+
+        plan = replan()
+        report["peak_before_bytes"] = int(plan.peak_bytes)
+        report["peak_after_bytes"] = int(plan.peak_bytes)
+        if plan.peak_bytes <= budget:
+            return program
+        report["engaged"] = True
+        cm = default_cost_model(list(block.ops), block)
+        done: set = set()
+        changed = False
+        while (plan.peak_bytes > budget
+               and len(report["fixes"]) < int(self.max_fixes)):
+            cands = price_relief_candidates(program, plan, cm, mode=mode,
+                                            done=done)
+            cands += self._price_h2d_sinks(block, plan, cm)
+            cands.sort(key=lambda c: c["seconds_per_byte"])
+            best = cands[0] if cands else None
+            if self.allow_escalate and mode == "auto":
+                esc = self._price_escalation(program, plan, cm, replan,
+                                             stage, pf_depth)
+                if esc is not None and (
+                        best is None
+                        or esc["seconds_per_byte"]
+                        < best["seconds_per_byte"]):
+                    best = esc
+            if best is None:
+                break
+            before = plan.peak_bytes
+            if best["fix"] == "remat":
+                self._apply_remat(block, best)
+                done.add(best["var"])
+            elif best["fix"] == "offload":
+                self._apply_offload(block, best)
+                done.add(best["var"])
+            elif best["fix"] == "sink":
+                op_ = block.ops.pop(best["op_index"])
+                block.ops.insert(best["new_index"], op_)
+                changed = True
+            else:  # plan escalation
+                stage = int(best["stage"])
+                pf_depth = int(best["prefetch_depth"])
+                report["stage"] = stage
+                report["prefetch_depth"] = pf_depth
+            plan = replan()
+            fx = {"var": best["var"], "fix": best["fix"],
+                  "saved_bytes": int(max(before - plan.peak_bytes, 0)),
+                  "modeled_cost_s": float(best["cost_s"]),
+                  "seconds_per_byte": float(best["seconds_per_byte"])}
+            if best["fix"] == "plan":
+                fx["stage"] = stage
+                fx["prefetch_depth"] = pf_depth
+            report["fixes"].append(fx)
+            report["modeled_overhead_s"] = float(
+                report["modeled_overhead_s"] + best["cost_s"])
+            if best["fix"] != "plan":
+                changed = True
+        report["peak_after_bytes"] = int(plan.peak_bytes)
+        report["bytes_saved"] = int(
+            max(report["peak_before_bytes"] - plan.peak_bytes, 0))
+        if changed:
+            program._bump_version()
+            self._check_offload_windows(block)
+        if plan.peak_bytes > budget:
+            gap_mb = (plan.peak_bytes - budget) / float(1 << 20)
+            report["residual_gap_mb"] = round(gap_mb, 3)
+            from ..utils.flags import flag as _flag
+            if bool(_flag("hbm_budget_strict")):
+                raise _mp.MemoryBudgetError(
+                    f"[memory_relief] modeled HBM peak "
+                    f"{plan.peak_bytes / float(1 << 20):.1f} MB still "
+                    f"exceeds FLAGS_hbm_budget_mb="
+                    f"{budget / float(1 << 20):.1f} MB after "
+                    f"{len(report['fixes'])} relief fix(es): residual "
+                    f"gap {gap_mb:.3f} MB (mode={mode}; raise the "
+                    f"budget, enable more fix kinds, or shrink the "
+                    f"model)")
+        return program
+
+    # -- fix application ---------------------------------------------------
+    def _apply_remat(self, block: Block, cand: dict) -> None:
+        from ..backward import OP_ROLE_KEY, OpRole
+
+        name = cand["var"]
+        b_first = cand["b_first"]
+        P = block.ops[cand["producer_index"]]
+        new = name + _REMAT_SUFFIX
+        src = block._find_var_recursive(name)
+        if not block.has_var(new):
+            block.create_var(name=new, shape=list(src.shape),
+                             dtype=src.dtype)
+        outputs = {slot: [new if n == name else n for n in names]
+                   for slot, names in P.outputs.items()}
+        attrs = dict(P.attrs)
+        attrs[OP_ROLE_KEY] = int(OpRole.Backward)
+        attrs["op_namescope"] = _RELIEF_SCOPE
+        block._insert_op(b_first, P.type,
+                         inputs={k: list(v) for k, v in P.inputs.items()},
+                         outputs=outputs, attrs=attrs)
+        for op_ in block.ops[b_first + 1:]:
+            op_.rename_input(name, new)
+
+    def _apply_offload(self, block: Block, cand: dict) -> None:
+        from ..backward import OP_ROLE_KEY, OpRole
+
+        name = cand["var"]
+        f_last, h = cand["f_last"], cand["h_insert"]
+        src = block._find_var_recursive(name)
+        d2h, h2d = name + _D2H_SUFFIX, name + _H2D_SUFFIX
+        for nm in (d2h, h2d):
+            if not block.has_var(nm):
+                block.create_var(name=nm, shape=list(src.shape),
+                                 dtype=src.dtype)
+        role_fwd = _role_of(block.ops[f_last])
+        block._insert_op(f_last + 1, "memcpy_d2h",
+                         inputs={"X": [name]}, outputs={"Out": [d2h]},
+                         attrs={OP_ROLE_KEY: int(role_fwd),
+                                "op_namescope": _RELIEF_SCOPE})
+        hi = h + 1  # shifted by the d2h insert
+        block._insert_op(hi, "memcpy_h2d",
+                         inputs={"X": [d2h]}, outputs={"Out": [h2d]},
+                         attrs={OP_ROLE_KEY: int(OpRole.Backward),
+                                "op_namescope": _RELIEF_SCOPE})
+        for op_ in block.ops[hi + 1:]:
+            op_.rename_input(name, h2d)
+
+    # -- window tightening: an h2d staged for overlap can end up BEFORE
+    # the (moved) peak as the greedy loop reshapes the timeline — sinking
+    # it just past the peak trades exposed transfer time for peak bytes
+    def _price_h2d_sinks(self, block, plan, cm):
+        from ..utils.cost_model import COMM_OPS, op_time_s
+
+        peak_i = plan.peak_op_index
+        if peak_i is None:
+            return []
+        ops = list(block.ops)
+        op_s = [0.0 if o.type in COMM_OPS else op_time_s(o, block, cm)
+                for o in ops]
+        cum = [0.0]
+        for t in op_s:
+            cum.append(cum[-1] + t)
+        out = []
+        for i, op_ in enumerate(ops):
+            if op_.type != "memcpy_h2d" \
+                    or op_.attrs.get("op_namescope") != _RELIEF_SCOPE \
+                    or i >= peak_i:
+                continue
+            nm = (op_.outputs.get("Out") or [None])[0]
+            cons = [j for j in range(i + 1, len(ops))
+                    if nm in ops[j].input_arg_names]
+            if not cons or min(cons) <= peak_i:
+                continue  # value needed at/before the peak: cannot sink
+            saved = int((plan.per_var or {}).get(nm, {}).get("dev_bytes")
+                        or 0)
+            if saved <= 0:
+                continue
+            fc = min(cons)
+            src = (op_.inputs.get("X") or [None])[0]
+            h2d_s = saved / cm.h2d_bytes_per_s
+            exposed_old = max(0.0, h2d_s - (cum[fc] - cum[i + 1]))
+            exposed_new = max(0.0, h2d_s - (cum[fc] - cum[peak_i + 1]))
+            cost = max(exposed_new - exposed_old, 0.0) + cm.launch_s
+            out.append({"var": nm, "fix": "sink", "saved_bytes": saved,
+                        "cost_s": cost, "seconds_per_byte": cost / saved,
+                        "op_index": i, "new_index": peak_i,
+                        "first_consumer": fc, "src": src})
+        return out
+
+    # -- fix (c): escalate the r16 parallel plan ---------------------------
+    def _price_escalation(self, program, plan, cm, replan, stage,
+                          pf_depth):
+        if int(self.ndev) <= 1:
+            return None
+        import dataclasses
+
+        from ..parallel import plan_search as _ps
+
+        base = _ps.ParallelPlan.from_flags()
+        base = dataclasses.replace(base, stage=int(stage),
+                                   prefetch_depth=int(pf_depth))
+        usm = bool(self.use_shard_map)
+        try:
+            t0 = _ps.modeled_step_time(
+                program, int(self.ndev), base, usm)["modeled_step_s"]
+        except Exception:
+            return None
+        moves = []
+        if int(stage) < 3:
+            moves.append((int(stage) + 1, int(pf_depth)))
+        elif int(pf_depth) > 0:
+            moves.append((int(stage), 0))
+        best = None
+        for st, pf in moves:
+            try:
+                p2 = replan(st=st, pf=pf)
+                t2 = _ps.modeled_step_time(
+                    program, int(self.ndev),
+                    dataclasses.replace(base, stage=st,
+                                        prefetch_depth=pf),
+                    usm)["modeled_step_s"]
+            except Exception:
+                continue
+            saved = int(plan.peak_bytes - p2.peak_bytes)
+            if saved <= 0:
+                continue
+            cost = max(float(t2 - t0), 0.0) + cm.launch_s
+            cand = {"var": "<plan>", "fix": "plan", "saved_bytes": saved,
+                    "cost_s": cost, "seconds_per_byte": cost / saved,
+                    "stage": st, "prefetch_depth": pf}
+            if best is None or (cand["seconds_per_byte"]
+                                < best["seconds_per_byte"]):
+                best = cand
+        return best
+
+    # -- offload windows must satisfy the r10 prefetch-window rule ---------
+    def _check_offload_windows(self, block: Block) -> None:
+        from . import verifier
+
+        ops = list(block.ops)
+        records = []
+        for i, op_ in enumerate(ops):
+            if op_.type != "memcpy_h2d" \
+                    or op_.attrs.get("op_namescope") != _RELIEF_SCOPE:
+                continue
+            out = (op_.outputs.get("Out") or [None])[0]
+            cons = [j for j in range(i + 1, len(ops))
+                    if out in ops[j].input_arg_names]
+            if not cons:
+                continue
+            records.append({"param": out, "gather_at": i + 1,
+                            "first_consumer": min(cons),
+                            "last_consumer": max(cons)})
+        self.report["offload_windows"] = records
+        if records and verifier.enabled():
+            verifier.check_prefetch_plan_or_raise(
+                ops, block, records, "memory_relief_offload")

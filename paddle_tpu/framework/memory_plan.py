@@ -349,6 +349,7 @@ margin_rank_loss masked_select match_matrix_tensor matmul matmul_v2
 matmul_with_flatten max_pool2d_with_index max_pool3d_with_index
 max_sequence_len maximum maxout mean mean_iou memcpy merge_ids
 merge_lod_tensor merge_lod_tensor_infer merge_selected_rows meshgrid
+memcpy_d2h memcpy_h2d
 mine_hard_examples minimum minus modified_huber_loss momentum
 moving_average_abs_max_scale mse_loss mul multiclass_nms multiclass_nms2
 multihead_matmul multiplex nce nearest_interp nll_loss norm not_equal
@@ -452,6 +453,23 @@ def transient_bytes(op_, block: Block, ndev: int = 1,
         return 0
 
 
+def _relief_mode() -> str:
+    """The configured FLAGS_memory_relief mode ("off" default)."""
+    from ..utils.flags import flag
+
+    try:
+        return str(flag("memory_relief", "off") or "off")
+    except Exception:
+        return "off"
+
+
+#: host-staging suffix the memory_relief_pass gives its offloaded
+#: copies: a ``...@D2H`` var lives in host RAM between the paired
+#: memcpy_d2h / memcpy_h2d ops and holds ZERO device bytes — the whole
+#: point of the offload fix
+HOST_STAGE_SUFFIX = "@D2H"
+
+
 # ==========================================================================
 # the plan
 # ==========================================================================
@@ -467,7 +485,7 @@ class MemoryPlan:
                  "resident_bytes", "resident_by_class", "per_var",
                  "transients", "top_at_peak", "ndev", "stage", "donate",
                  "path", "assumed_batch", "n_ops", "extra_resident_bytes",
-                 "prefetch_windows")
+                 "prefetch_windows", "relief", "relief_candidates")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -509,6 +527,11 @@ class MemoryPlan:
             "path": self.path,
             "donate": bool(self.donate),
             "assumed_batch": self.assumed_batch,
+            # relief decision table (memory_relief_pass) — the OOM
+            # debris plan.json carries it for free; with the pass off
+            # the entry says so explicitly
+            "relief": (self.relief if self.relief is not None
+                       else {"mode": _relief_mode(), "engaged": False}),
         }
 
     def format_table(self, top: int = 10) -> str:
@@ -526,6 +549,20 @@ class MemoryPlan:
         for row in d["top_live_at_peak"]:
             lines.append(f"{row['var'][:44]:<44} "
                          f"{row['bytes'] / _MB:>10.3f}  {row['class']}")
+        relief = d.get("relief") or {}
+        if relief.get("engaged"):
+            lines.append(
+                f"relief[{relief.get('mode')}]: peak "
+                f"{relief.get('peak_before_bytes', 0) / _MB:.3f} -> "
+                f"{relief.get('peak_after_bytes', 0) / _MB:.3f} MB, "
+                f"saved {relief.get('bytes_saved', 0) / _MB:.3f} MB for "
+                f"{relief.get('modeled_overhead_s', 0.0):.3e} s modeled")
+            lines.append(f"{'Relief fixes':<44} {'MB saved':>10}  fix")
+            for fx in relief.get("fixes", ()):
+                lines.append(
+                    f"{str(fx.get('var', ''))[:44]:<44} "
+                    f"{fx.get('saved_bytes', 0) / _MB:>10.3f}  "
+                    f"{fx.get('fix')}")
         return "\n".join(lines)
 
 
@@ -705,6 +742,9 @@ def plan_memory(program: Program, feed_names: Sequence[str] = (),
         return int(nb) if nb else None
 
     def dev_bytes(name: str) -> Optional[int]:
+        if name.endswith(HOST_STAGE_SUFFIX):
+            # relief offload staging buffer: host RAM, not HBM
+            return 0
         b = var_bytes(block, name, assumed_batch)
         v = block._find_var_recursive(name)
         if b is None or v is None or not v.shape:
@@ -910,6 +950,26 @@ def plan_and_surface(program: Program, where: str,
              "modeled per-device HBM peak of the last compilation "
              "(framework/memory_plan.py)",
              labels=("where",)).labels(where=where).set(plan.peak_bytes)
+    # memory_relief_pass decisions (framework/ir.py): the compile
+    # pipeline leaves its report on the program; the plan carries it to
+    # compiled._memory_plan, the OOM debris dump, and the relief gauges
+    relief = getattr(program, "_memory_relief", None)
+    if relief is not None:
+        plan.relief = relief
+        if relief.get("engaged"):
+            surface_relief(relief, where)
+    b = budget_bytes()
+    if b and plan.peak_bytes > b and plan.relief_candidates is None:
+        # over budget with no relief applied: price the top candidate
+        # fixes so the warning is actionable even with relief off
+        try:
+            from .ir import relief_candidate_summary
+
+            plan.relief_candidates = relief_candidate_summary(
+                program, plan, feed_names=feed_names,
+                fetch_names=fetch_names)
+        except Exception:
+            plan.relief_candidates = []
     check_budget(plan, where)
     try:
         emit_trace_counters(plan, block if block is not None
@@ -953,12 +1013,50 @@ def check_budget(plan: MemoryPlan, where: str = "compile",
            f"FLAGS_hbm_budget_mb={b / _MB:g} at op "
            f"#{plan.peak_op_index} ({plan.peak_op_type}); top live vars: "
            f"{tops}")
+    cands = getattr(plan, "relief_candidates", None)
+    if cands:
+        # priced by the memory_relief_pass machinery: what turning
+        # FLAGS_memory_relief on would do, cheapest first
+        fixes = ", ".join(
+            f"{c['var']} {c['fix']} saves {c['saved_bytes'] / _MB:.2f}MB "
+            f"@{c['seconds_per_byte']:.1e}s/B" for c in cands[:3])
+        msg += (f"; candidate fixes (set FLAGS_memory_relief to apply): "
+                f"{fixes}")
     if strict:
         raise MemoryBudgetError(msg)
     import warnings
 
     warnings.warn(msg, ResourceWarning, stacklevel=3)
     return msg
+
+
+def surface_relief(report: dict, where: str) -> None:
+    """Publish one relief report (memory_relief_pass.report) onto the
+    hbm_relief_* gauges.  Best-effort: telemetry failure must not take
+    compilation down."""
+    try:
+        from ..utils import telemetry as tm
+
+        tm.gauge("hbm_relief_bytes_saved",
+                 "modeled HBM bytes the memory_relief_pass bought back "
+                 "at the last compilation",
+                 labels=("where",)).labels(where=where).set(
+            int(report.get("bytes_saved", 0)))
+        tm.gauge("hbm_relief_modeled_overhead_s",
+                 "modeled seconds/step the relief fixes spend "
+                 "(recompute + exposed host transfer + plan delta)",
+                 labels=("where",)).labels(where=where).set(
+            float(report.get("modeled_overhead_s", 0.0)))
+        counts: Dict[str, int] = {}
+        for fx in report.get("fixes", ()):
+            counts[fx.get("fix", "?")] = counts.get(fx.get("fix", "?"), 0) + 1
+        g = tm.gauge("hbm_relief_vars",
+                     "relieved vars by fix kind at the last compilation",
+                     labels=("where", "fix"))
+        for fix in ("remat", "offload", "plan"):
+            g.labels(where=where, fix=fix).set(counts.get(fix, 0))
+    except Exception:
+        pass
 
 
 # ==========================================================================

@@ -595,6 +595,22 @@ def _memcpy(ctx):
     ctx.set_out("Out", ctx.in_("X"))
 
 
+# memory_relief_pass offload pair (framework/ir.py): on the CPU proxy
+# both stages lower to identity (XLA aliases the value, so offloaded
+# training is bit-identical); the HBM cost lives in the memory planner
+# (an @D2H-staged var holds 0 device bytes) and the time cost in the
+# cost model's d2h/h2d bandwidth terms.  no_grad: the pass inserts them
+# after the backward already exists.
+@op("memcpy_d2h", no_grad=True)
+def _memcpy_d2h(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
+@op("memcpy_h2d", no_grad=True)
+def _memcpy_h2d(ctx):
+    ctx.set_out("Out", ctx.in_("X"))
+
+
 @op("print", no_grad=True)
 def _print(ctx):
     x = ctx.in_("In")

@@ -416,6 +416,10 @@ def _compile_dp(compiled_program, executor, program, feed, fetch_names,
            int(flag("dp_prefetch_depth") or 0),
            bool(flag("while_static_scan")),
            _calibration_version(),
+           # memory relief rewrites the compiled program (see the
+           # executor compile key): mode or budget flips recompile
+           str(flag("memory_relief", "off") or "off"),
+           str(flag("hbm_budget_mb") or 0),
            str(flag("dp_plan", "") or ""),
            # probe config + armed chaos NaN injection (see the
            # executor compile key for the step-K recompile contract)
@@ -466,7 +470,18 @@ def _compile_dp_miss(compiled_program, executor, program, feed,
     # them over when the pipeline produced a rewritten clone.
     rewritten = program
     if compiled_program.__dict__.get("_ir_passes", True):
-        rewritten = executor._apply_ir_passes(program, fetch_names)
+        # memory relief context: the pass prices fixes against THIS
+        # config's modeled plan (ndev on the batch axis, the shard_map
+        # vs pjit path, the stage/prefetch flags applied_plan already
+        # set) and may escalate the parallel plan in auto mode
+        relief_mode = str(flag("memory_relief", "off") or "off")
+        axis0 = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+        rewritten = executor._apply_ir_passes(
+            program, fetch_names, feed_names=tuple(sorted(set(feed))),
+            scope=scope,
+            relief_ctx={"ndev": int(mesh.shape[axis0]),
+                        "use_shard_map": _program_has_collectives(program),
+                        "allow_escalate": relief_mode == "auto"})
     if rewritten is not program:
         # the clone preserves block structure, so specs map block-by-
         # block (a global-block-only lookup would drop sub-block specs)
@@ -505,6 +520,13 @@ def _compile_dp_miss(compiled_program, executor, program, feed,
     axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
     ndev_axis = int(mesh.shape[axis])
     stage = int(flag("dp_sharding") or 0)
+    relief_rep = getattr(program, "_memory_relief", None)
+    if relief_rep and relief_rep.get("engaged"):
+        # relief fix (c) may have escalated the plan: the pass's chosen
+        # stage overrides the flag-derived config for the rest of this
+        # compilation (the flags themselves stay untouched — the cache
+        # key is a deterministic pre-relief-config -> artifact map)
+        stage = int(relief_rep.get("stage", stage))
 
     # FLAGS_dp_sharding staging (ZeRO / fleet sharding_stage):
     # * pjit path: stage 1 shards optimizer state, stage 2 additionally
@@ -536,6 +558,8 @@ def _compile_dp_miss(compiled_program, executor, program, feed,
     # prefetch_autotune_pass — each window just deep enough to hide its
     # modeled gather, still guarded by the verifier's window rule below.
     pf_depth = int(flag("dp_prefetch_depth") or 0)
+    if relief_rep and relief_rep.get("engaged"):
+        pf_depth = int(relief_rep.get("prefetch_depth", pf_depth))
     pf_depths = dict(plan.per_param_depths) if plan is not None else None
     pf_records: List[dict] = []
     pf_gather: Dict[int, List[str]] = {}

@@ -65,6 +65,12 @@ class CostModel:
     ici_bytes_per_s: float = 4.5e10   # per-chip ring bandwidth
     launch_s: float = 1.0e-6          # per-collective launch/latency
     assumed_batch: int = 64           # stands in for dynamic (-1) dims
+    # host link (PCIe-class DMA): the memory_relief_pass prices its
+    # memcpy_d2h / memcpy_h2d offload pairs against these; like the ICI
+    # constants they are hardware facts and are NOT rescaled by
+    # ``calibrated`` (which only retunes the compute rates)
+    d2h_bytes_per_s: float = 1.2e10
+    h2d_bytes_per_s: float = 1.2e10
 
     def calibrated(self, measured_backward_s: float,
                    modeled_backward_s: float) -> "CostModel":

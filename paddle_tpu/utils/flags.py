@@ -283,6 +283,21 @@ _DEFAULTS: Dict[str, Any] = {
     # and training is bit-identical either way (pinned by test).
     "FLAGS_hbm_budget_mb": 0.0,
     "FLAGS_hbm_budget_strict": False,
+    # plan-driven memory relief (framework/ir.py memory_relief_pass):
+    # when the modeled peak exceeds FLAGS_hbm_budget_mb, the compile
+    # paths rewrite the program to fit — per over-budget activation the
+    # pass prices (a) "remat" (replay the producing op before its
+    # backward consumer: bit-identical, costs modeled recompute time),
+    # (b) "offload" (paired memcpy_d2h/memcpy_h2d staged under the
+    # double-buffering window: costs modeled host-link time), and on
+    # the DP path (c) a plan escalation (raised ZeRO stage / shrunk
+    # prefetch window), picking the cheapest by modeled
+    # time-per-byte-saved and re-running plan_memory() after each fix.
+    # "remat"/"offload" restrict the menu to that fix; "auto" allows
+    # all three.  "off" (default): the pass never runs and the whole
+    # pipeline is byte-identical to a relief-less build (pinned by
+    # test).
+    "FLAGS_memory_relief": "off",
     # numerics observability (framework/numerics.py + framework/ir.py
     # numerics_probe_pass): when on, every compile appends cheap
     # in-program stat reductions (absmax/mean/rms/nonfinite-count) over

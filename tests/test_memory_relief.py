@@ -1,0 +1,517 @@
+"""Plan-driven memory relief (r25): liveness-guided rematerialization +
+host offload + plan escalation, priced by the calibrated cost model.
+
+The contracts pinned here:
+
+* ``FLAGS_memory_relief=off`` (the default) is BYTE-identical to the
+  unrelieved pipeline — losses, params, and serving tokens.
+* remat relief is bit-identical by construction (same ops, same inputs,
+  no fp reordering) even when the unmodified modeled peak is > 2x the
+  budget; offload staging is identity-lowered on the CPU proxy, so the
+  whole auto mode stays bit-identical here too.
+* the modeled peak after relief fits the budget, and the report's
+  ``peak_after_bytes`` equals an independent ``plan_memory()`` re-plan
+  of the relieved program.
+* offload double-buffer windows satisfy the r10 prefetch-window rule
+  (``verifier.check_prefetch_plan``).
+* strict mode raises naming the residual gap when relief cannot fit.
+* ZeRO stages 0-3 x both DP paths compose, the pass is verifier-clean
+  and idempotent, and the numerics probe stream is unchanged by relief.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import memory_plan as mp
+from paddle_tpu.framework import unique_name, verifier
+from paddle_tpu.framework.ir import get_pass, relief_candidate_summary
+from paddle_tpu.framework.scope import Scope
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.utils import flags as _flags
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+from dp_comm_stats import build_mlp_dp_program  # noqa: E402
+
+_MB = float(1 << 20)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flags_and_mesh():
+    saved = dict(_flags._flags)
+    mesh_mod.registry().clear()
+    yield
+    _flags._flags.clear()
+    _flags._flags.update(saved)
+    mesh_mod.registry().clear()
+
+
+def _probe(n_layers=6, width=16, optimizer="sgd", transpile=False):
+    """Activation-dominated MLP: batch (64) >> width, so the planner's
+    peak is mostly relievable activation bytes and budget = peak/2 is
+    reachable (params stay tiny)."""
+    unique_name.switch()
+    return build_mlp_dp_program(n_layers=n_layers, width=width,
+                                optimizer=optimizer, transpile=transpile)
+
+
+def _data(width=16, n=64):
+    rng = np.random.RandomState(0)
+    xs = rng.randn(n, width).astype(np.float32)
+    return xs, (xs[:, :1] * 2 + 1).astype(np.float32)
+
+
+def _train(main, startup, loss, steps=3, width=16):
+    """Executor-path training run -> (per-step losses, params, plan)."""
+    exe = pt.Executor(pt.CPUPlace())
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    xs, ys = _data(width)
+    losses = []
+    for _ in range(steps):
+        out = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                      scope=scope)
+        losses.append(np.asarray(out[0]).copy())
+    params = {p.name: np.asarray(scope.find_var(p.name).get_tensor())
+              for p in main.all_parameters()}
+    plan = list(exe._cache.values())[-1]._memory_plan
+    return losses, params, plan
+
+
+def _dp_train(main, startup, loss, stage, steps=2, width=16, depth=1,
+              extra_flags=None):
+    mesh_mod.registry().clear()
+    mesh_mod.init_mesh()
+    _flags.set_flags({"dp_sharding": stage, "fuse_grad_size_in_MB": 32.0,
+                      "dp_grad_compress": "none", "dp_comm_overlap": 1,
+                      "dp_prefetch_depth": depth, **(extra_flags or {})})
+    exe = pt.Executor(pt.CPUPlace())
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    xs, ys = _data(width)
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    losses = []
+    for _ in range(steps):
+        out = exe.run(compiled, feed={"x": xs, "y": ys},
+                      fetch_list=[loss], scope=scope)
+        losses.append(np.asarray(out[0]).copy())
+    return losses, compiled.__dict__["_memory_plan"]
+
+
+def _apply_relief(program, mode, budget, feed=("x", "y"), fetch=(),
+                  **attrs):
+    p = get_pass("memory_relief_pass", mode=mode, budget=int(budget),
+                 feed_names=tuple(feed), fetch_names=tuple(fetch), **attrs)
+    p.apply(program)
+    return p.report
+
+
+# ==========================================================================
+# default off: byte-identical pipeline
+# ==========================================================================
+def test_default_off_byte_identity():
+    """No budget / relief off: losses and params byte-equal across (a)
+    no flags, (b) explicit off + budget, and the plan carries no relief
+    report."""
+    main, startup, loss = _probe()
+    base_l, base_p, plan0 = _train(main.clone(), startup, loss)
+    assert plan0.relief is None
+    assert plan0.as_dict()["relief"] == {"mode": "off", "engaged": False}
+
+    _flags.set_flags({"memory_relief": "off",
+                      "hbm_budget_mb": plan0.peak_bytes / 2 / _MB})
+    off_l, off_p, plan1 = _train(main.clone(), startup, loss)
+    assert plan1.relief is None
+    for a, b in zip(base_l, off_l):
+        assert np.array_equal(a, b)
+    for k in base_p:
+        assert np.array_equal(base_p[k], off_p[k])
+
+
+# ==========================================================================
+# the end-to-end oracle: >2x budget, relieved, bit-identical
+# ==========================================================================
+@pytest.mark.parametrize("mode", ["remat", "auto"])
+def test_over_budget_probe_trains_bit_identical(mode):
+    """Unmodified modeled peak > 2x budget; under relief the program
+    trains with bit-identical losses AND params (remat replays the same
+    ops on the same inputs; offload staging is identity on the CPU
+    proxy), and auto lands the modeled peak under budget."""
+    main, startup, loss = _probe()
+    base_l, base_p, plan0 = _train(main.clone(), startup, loss)
+    budget_mb = plan0.peak_bytes / 2 / _MB
+    assert plan0.peak_bytes > 2 * budget_mb * _MB * 0.999
+
+    _flags.set_flags({"memory_relief": mode, "hbm_budget_mb": budget_mb})
+    rel_l, rel_p, plan1 = _train(main.clone(), startup, loss)
+    rep = plan1.relief
+    assert rep is not None and rep["engaged"]
+    assert rep["mode"] == mode and len(rep["fixes"]) > 0
+    assert rep["peak_after_bytes"] < rep["peak_before_bytes"]
+    if mode == "auto":
+        # remat alone cannot reach peak/2 on this probe; auto (remat +
+        # offload + window sinking) must
+        assert rep["peak_after_bytes"] <= rep["budget_bytes"]
+    for a, b in zip(base_l, rel_l):
+        assert np.array_equal(a, b)
+    for k in base_p:
+        assert np.array_equal(base_p[k], rel_p[k])
+
+
+def test_conv_mlp_probe_remat_bit_identical():
+    """ISSUE oracle shape: an MLP+conv probe whose unmodified peak is
+    > 2x budget still trains bit-identically under remat relief, and
+    at least one conv activation is among the relieved vars."""
+    unique_name.switch()
+    main = pt.Program()
+    startup = pt.Program()
+    with pt.program_guard(main, startup):
+        img = fluid.data("img", shape=(8, 1, 12, 12), dtype="float32")
+        y = fluid.data("y", shape=(8, 1), dtype="float32")
+        h = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        h = fluid.layers.conv2d(h, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        h = fluid.layers.reshape(h, (8, 4 * 12 * 12))
+        for _ in range(3):
+            h = fluid.layers.fc(h, size=32, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+        pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    xs = rng.randn(8, 1, 12, 12).astype(np.float32)
+    ys = rng.randn(8, 1).astype(np.float32)
+
+    def run(flags):
+        saved = dict(_flags._flags)
+        try:
+            _flags.set_flags(flags)
+            exe = pt.Executor(pt.CPUPlace())
+            scope = Scope()
+            exe.run(startup, scope=scope)
+            ls = [np.asarray(exe.run(main.clone(), feed={"img": xs, "y": ys},
+                                     fetch_list=[loss], scope=scope)[0])
+                  for _ in range(3)]
+            return ls, list(exe._cache.values())[-1]._memory_plan
+        finally:
+            _flags._flags.clear()
+            _flags._flags.update(saved)
+
+    base_l, plan0 = run({})
+    budget_mb = plan0.peak_bytes / 2 / _MB
+    assert plan0.peak_bytes > 2 * budget_mb * _MB * 0.999
+    rel_l, plan1 = run({"memory_relief": "remat",
+                        "hbm_budget_mb": budget_mb})
+    rep = plan1.relief
+    assert rep is not None and rep["engaged"]
+    assert rep["peak_after_bytes"] < rep["peak_before_bytes"]
+    assert any(f["fix"] == "remat" for f in rep["fixes"])
+    for a, b in zip(base_l, rel_l):
+        assert np.array_equal(a, b)
+
+
+def test_peak_after_matches_replan():
+    """The report's peak_after_bytes IS plan_memory() of the relieved
+    program — no separate accounting to drift."""
+    main, startup, loss = _probe()
+    plan0 = mp.plan_memory(main, feed_names=("x", "y"),
+                           fetch_names=(loss.name,))
+    prog = main.clone()
+    rep = _apply_relief(prog, "auto", plan0.peak_bytes // 2,
+                        fetch=(loss.name,))
+    assert rep["engaged"]
+    replan = mp.plan_memory(prog, feed_names=("x", "y"),
+                            fetch_names=(loss.name,))
+    assert rep["peak_after_bytes"] == replan.peak_bytes
+    assert rep["bytes_saved"] == plan0.peak_bytes - replan.peak_bytes
+    # decision rows carry the modeled economics
+    for f in rep["fixes"]:
+        assert f["fix"] in ("remat", "offload", "sink", "plan")
+        assert f["modeled_cost_s"] >= 0.0
+    assert rep["modeled_overhead_s"] >= 0.0
+
+
+# ==========================================================================
+# offload schedule: the r10 window rule
+# ==========================================================================
+def test_offload_windows_satisfy_r10_rule():
+    """Every memcpy_h2d the pass schedules forms a (gather_at,
+    first_consumer, last_consumer) window that check_prefetch_plan
+    accepts: no inverted windows, no writes crossing the staged copy."""
+    main, startup, loss = _probe()
+    plan0 = mp.plan_memory(main, feed_names=("x", "y"),
+                           fetch_names=(loss.name,))
+    prog = main.clone()
+    rep = _apply_relief(prog, "offload", plan0.peak_bytes // 2,
+                        fetch=(loss.name,))
+    assert rep["engaged"]
+    assert any(f["fix"] == "offload" for f in rep["fixes"])
+    records = rep["offload_windows"]
+    assert records, "offload engaged but produced no windows"
+    block = prog.global_block()
+    ops = list(block.ops)
+    diags = verifier.check_prefetch_plan(ops, block, records)
+    assert diags == [], [d.format() for d in diags]
+    for r in records:
+        # h2d issues before its first consumer; the d2h source exists
+        assert r["gather_at"] <= r["first_consumer"] <= r["last_consumer"]
+        assert r["param"].endswith("@RELIEF@H2D")
+    # each pair is d2h -> h2d on the same var, with the d2h source
+    # dying in the forward region (that is what buys the bytes back)
+    h2d_ops = [o for o in ops if o.type == "memcpy_h2d"]
+    assert len(h2d_ops) == len(records)
+    for o in h2d_ops:
+        src = o.inputs["X"][0]
+        assert src.endswith("@RELIEF@D2H")
+        assert any(p.type == "memcpy_d2h"
+                   and p.outputs["Out"][0] == src for p in ops)
+
+
+# ==========================================================================
+# strict mode: residual gap is named
+# ==========================================================================
+def test_strict_mode_names_residual_gap():
+    """An unreachable budget under FLAGS_hbm_budget_strict raises
+    MemoryBudgetError naming the residual gap after the fixes."""
+    main, _, loss = _probe(n_layers=3)
+    _flags.set_flags({"hbm_budget_strict": True})
+    prog = main.clone()
+    with pytest.raises(mp.MemoryBudgetError, match="residual"):
+        _apply_relief(prog, "auto", 1024, fetch=(loss.name,))
+    # non-strict: same residual is reported, not raised
+    _flags.set_flags({"hbm_budget_strict": False})
+    rep = _apply_relief(main.clone(), "auto", 1024, fetch=(loss.name,))
+    assert rep["engaged"] and rep["residual_gap_mb"] > 0
+
+
+# ==========================================================================
+# verifier-clean + idempotent
+# ==========================================================================
+def test_pass_is_verifier_clean_and_idempotent():
+    """FLAGS_verify_passes brackets every apply (snapshot diff + the
+    absolute sweep); a second application finds nothing left to fix and
+    leaves the program unchanged."""
+    assert verifier.enabled()  # armed under pytest
+    main, _, loss = _probe()
+    plan0 = mp.plan_memory(main, feed_names=("x", "y"),
+                           fetch_names=(loss.name,))
+    prog = main.clone()
+    rep1 = _apply_relief(prog, "auto", plan0.peak_bytes // 2,
+                         fetch=(loss.name,))
+    assert rep1["engaged"] and rep1["fixes"]
+    ops_before = [(o.type, tuple(o.input_arg_names),
+                   tuple(o.output_arg_names))
+                  for o in prog.global_block().ops]
+    rep2 = _apply_relief(prog, "auto", plan0.peak_bytes // 2,
+                         fetch=(loss.name,))
+    ops_after = [(o.type, tuple(o.input_arg_names),
+                  tuple(o.output_arg_names))
+                 for o in prog.global_block().ops]
+    assert ops_before == ops_after
+    assert rep2["fixes"] == [] or all(
+        f["fix"] == "sink" and f["saved_bytes"] == 0
+        for f in rep2["fixes"])
+    assert rep2["peak_after_bytes"] == rep1["peak_after_bytes"]
+
+
+# ==========================================================================
+# ZeRO 0-3 x both DP paths
+# ==========================================================================
+@pytest.mark.parametrize("collective", [False, True],
+                         ids=["pjit", "shard_map"])
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_dp_paths_compose(collective, stage):
+    """Relief engages inside the DP compile pipeline on both paths at
+    every ZeRO stage, fits the budget, and the losses stay
+    bit-identical to the unrelieved run."""
+    main, startup, loss = _probe(transpile=collective)
+    base_l, plan0 = _dp_train(main, startup, loss, stage)
+    budget_mb = plan0.peak_bytes * 0.55 / _MB
+    rel_l, plan1 = _dp_train(main, startup, loss, stage,
+                             extra_flags={"memory_relief": "auto",
+                                          "hbm_budget_mb": budget_mb})
+    rep = plan1.relief
+    assert rep is not None and rep["engaged"]
+    assert rep["peak_after_bytes"] <= rep["budget_bytes"]
+    assert plan1.path == ("shard_map" if collective else "pjit")
+    for a, b in zip(base_l, rel_l):
+        assert np.array_equal(a, b)
+
+
+def test_plan_escalation_raises_stage():
+    """Fix (c): with opt-state-heavy residents (adam at stage 0) and a
+    budget below the unsharded resident bytes, escalating the ZeRO
+    stage is the cheapest modeled fix — the report carries the raised
+    stage, compiled._memory_plan reflects it, and training still
+    matches the unrelieved losses."""
+    main, startup, loss = _probe(n_layers=8, width=64, optimizer="adam")
+    base_l, plan0 = _dp_train(main, startup, loss, 0, width=64, depth=0)
+    budget_mb = plan0.resident_bytes * 0.7 / _MB
+    rel_l, plan1 = _dp_train(main, startup, loss, 0, width=64, depth=0,
+                             extra_flags={"memory_relief": "auto",
+                                          "hbm_budget_mb": budget_mb})
+    rep = plan1.relief
+    assert rep is not None and rep["engaged"]
+    assert any(f["fix"] == "plan" for f in rep["fixes"])
+    assert rep["stage"] > 0
+    assert plan1.stage == rep["stage"]
+    assert rep["peak_after_bytes"] <= rep["budget_bytes"]
+    for a, b in zip(base_l, rel_l):
+        assert np.allclose(a, b, rtol=1e-6, atol=0)
+
+
+# ==========================================================================
+# numerics probe composes
+# ==========================================================================
+def test_numerics_probe_composes_with_relief():
+    """Probe-on losses == probe-off losses under relief (the probe pass
+    runs AFTER relief, so it observes the relieved program without
+    changing its math)."""
+    main, startup, loss = _probe()
+    plan0 = mp.plan_memory(main, feed_names=("x", "y"),
+                           fetch_names=(loss.name,))
+    budget_mb = plan0.peak_bytes / 2 / _MB
+    _flags.set_flags({"memory_relief": "auto", "hbm_budget_mb": budget_mb})
+    off_l, _, _ = _train(main.clone(), startup, loss)
+    _flags.set_flags({"numerics_probe": 1})
+    on_l, _, plan = _train(main.clone(), startup, loss)
+    assert plan.relief is not None and plan.relief["engaged"]
+    for a, b in zip(off_l, on_l):
+        assert np.array_equal(a, b)
+
+
+# ==========================================================================
+# satellite: the over-budget warning names candidate fixes
+# ==========================================================================
+def test_over_budget_warning_names_candidate_fixes():
+    """With relief OFF, the r15 budget warning now also names the top
+    priced fixes (var, kind, MB saved, s/B) so it is actionable."""
+    main, startup, loss = _probe()
+    plan0 = mp.plan_memory(main, feed_names=("x", "y"),
+                           fetch_names=(loss.name,))
+    _flags.set_flags({"hbm_budget_mb": plan0.peak_bytes / 2 / _MB})
+    with pytest.warns(ResourceWarning) as rec:
+        _train(main.clone(), startup, loss, steps=1)
+    msgs = [str(w.message) for w in rec
+            if "modeled HBM peak" in str(w.message)]
+    assert msgs, [str(w.message) for w in rec]
+    msg = msgs[0]
+    # the r15 pins stay; the candidate-fix tail is new
+    assert "top live vars" in msg
+    assert "candidate fixes" in msg
+    assert "FLAGS_memory_relief" in msg
+    assert ("remat" in msg) or ("offload" in msg)
+    assert "s/B" in msg
+
+    cands = relief_candidate_summary(main, plan0, feed_names=("x", "y"),
+                                     fetch_names=(loss.name,))
+    assert cands and all(
+        c["fix"] in ("remat", "offload") and c["saved_bytes"] > 0
+        and c["seconds_per_byte"] >= 0.0 for c in cands)
+
+
+# ==========================================================================
+# satellite: OOM debris carries the relief decision table
+# ==========================================================================
+def test_oom_debris_carries_relief_table(tmp_path):
+    """plan.json in a debris bundle shows what the pass did (or that
+    relief was off)."""
+    import json
+
+    main, startup, loss = _probe()
+    plan0 = mp.plan_memory(main, feed_names=("x", "y"),
+                           fetch_names=(loss.name,))
+    _flags.set_flags({"oom_debris_dir": str(tmp_path),
+                      "memory_relief": "auto",
+                      "hbm_budget_mb": plan0.peak_bytes / 2 / _MB})
+    _, _, plan = _train(main.clone(), startup, loss, steps=1)
+    d = mp.record_oom_debris("test", RuntimeError("RESOURCE_EXHAUSTED"),
+                             plan=plan)
+    with open(os.path.join(d, "plan.json")) as f:
+        dumped = json.load(f)
+    assert dumped["relief"]["engaged"]
+    assert dumped["relief"]["fixes"]
+    # relief off: the entry says so explicitly
+    d2 = mp.record_oom_debris("test", RuntimeError("RESOURCE_EXHAUSTED"),
+                              plan=plan0)
+    with open(os.path.join(d2, "plan.json")) as f:
+        dumped2 = json.load(f)
+    assert dumped2["relief"] == {"mode": "auto", "engaged": False}
+
+
+# ==========================================================================
+# satellite: gauges
+# ==========================================================================
+def test_relief_gauges_published():
+    from paddle_tpu.utils import telemetry as tm
+
+    main, startup, loss = _probe()
+    plan0 = mp.plan_memory(main, feed_names=("x", "y"),
+                           fetch_names=(loss.name,))
+    _flags.set_flags({"memory_relief": "auto",
+                      "hbm_budget_mb": plan0.peak_bytes / 2 / _MB})
+    _, _, plan = _train(main.clone(), startup, loss, steps=1)
+    snap = tm.snapshot()
+    names = set(snap)
+    assert "hbm_relief_bytes_saved" in names
+    assert "hbm_relief_modeled_overhead_s" in names
+    assert "hbm_relief_vars" in names
+
+
+# ==========================================================================
+# flag flips recompile (cache key)
+# ==========================================================================
+def test_relief_flag_flips_recompile():
+    """memory_relief / hbm_budget_mb participate in the executor compile
+    key: flipping them mid-session serves a different compilation."""
+    main, startup, loss = _probe()
+    exe = pt.Executor(pt.CPUPlace())
+    scope = Scope()
+    exe.run(startup, scope=scope)
+    xs, ys = _data()
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss], scope=scope)
+    n0 = len(exe._cache)
+    plan0 = list(exe._cache.values())[-1]._memory_plan
+    _flags.set_flags({"memory_relief": "auto",
+                      "hbm_budget_mb": plan0.peak_bytes / 2 / _MB})
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss], scope=scope)
+    assert len(exe._cache) == n0 + 1
+    plan1 = list(exe._cache.values())[-1]._memory_plan
+    assert plan1.relief is not None and plan1.relief["engaged"]
+    # flipping back serves the ORIGINAL unrelieved compilation
+    _flags.set_flags({"memory_relief": "off", "hbm_budget_mb": 0})
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss], scope=scope)
+    assert len(exe._cache) == n0 + 1
+
+
+# ==========================================================================
+# serving stays untouched
+# ==========================================================================
+def test_serving_tokens_unchanged_by_relief_flags():
+    """TP-less serving decode under relief flags: token-identical to the
+    default pipeline (relief never rewrites serving programs)."""
+    from paddle_tpu.inference.serving import DecoderConfig, ServingEngine
+
+    cfg = DecoderConfig(vocab_size=32, hidden=16, num_heads=2,
+                        num_layers=2, max_seq_len=32)
+
+    def tokens(flags):
+        saved = dict(_flags._flags)
+        try:
+            _flags.set_flags(flags)
+            eng = ServingEngine(cfg, num_pages=16, page_size=4,
+                                max_batch=2)
+            return eng.generate([[1, 2, 3]], max_new_tokens=8)
+        finally:
+            _flags._flags.clear()
+            _flags._flags.update(saved)
+
+    base = tokens({})
+    relieved = tokens({"memory_relief": "auto", "hbm_budget_mb": 0.001})
+    assert base == relieved

@@ -353,6 +353,13 @@ SPECS["broadcast_tensors"] = S({"X": [("ba", f32(1, 4)), ("bb", f32(3, 1))]},
 SPECS["concat"] = S({"X": [("ca", f32(2, 3)), ("cb", f32(2, 2))]}, {"axis": 1},
                     ref=lambda ins, a: {"Out": np.concatenate(ins["X"], 1)})
 SPECS["assign"] = S({"X": f32(3, 4)}, ref=lambda ins, a: {"Out": ins["X"]})
+# r25 memory relief host-offload pair: identity on the CPU proxy — the
+# planner (@D2H zero device bytes) and cost model (d2h/h2d bandwidth
+# terms) carry the semantics
+SPECS["memcpy_d2h"] = S({"X": fn32(3, 4)},
+                        ref=lambda ins, a: {"Out": ins["X"]})
+SPECS["memcpy_h2d"] = S({"X": fn32(3, 4)},
+                        ref=lambda ins, a: {"Out": ins["X"]})
 SPECS["shape"] = S({"Input": f32(3, 4)},
                    ref=lambda ins, a: {"Out": np.array([3, 4], np.int32)})
 SPECS["size"] = S({"Input": f32(3, 4)},

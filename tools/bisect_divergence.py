@@ -57,6 +57,12 @@ def build_args():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--a", default="", help="config A flags, k=v[,k=v...]")
     ap.add_argument("--b", default="", help="config B flags, k=v[,k=v...]")
+    ap.add_argument("--flags", default="",
+                    help="shared base flags merged into BOTH sides "
+                         "(per-side --a/--b win per key) — e.g. "
+                         "--flags hbm_budget_mb=0.05 --b "
+                         "memory_relief=auto bisects relief-on vs "
+                         "relief-off under one budget")
     ap.add_argument("--chaos-a", default="", help="FLAGS_chaos for A only")
     ap.add_argument("--chaos-b", default="", help="FLAGS_chaos for B only")
     ap.add_argument("--steps", type=int, default=4)
@@ -324,12 +330,14 @@ def main():
                                      "_count=8").strip()
     if args.quick:
         sys.exit(quick(args))
-    flags_a = parse_flagset(args.a)
-    flags_b = parse_flagset(args.b)
-    if not args.ref_host and not flags_b and not args.chaos_b \
-            and not flags_a and not args.chaos_a:
-        print("nothing to compare: give --b/--chaos-b (or --ref-host); "
-              "see --help", file=sys.stderr)
+    shared = parse_flagset(args.flags)
+    flags_a = {**shared, **parse_flagset(args.a)}
+    flags_b = {**shared, **parse_flagset(args.b)}
+    if not args.ref_host and not args.chaos_a and not args.chaos_b \
+            and flags_a == flags_b:
+        print("nothing to compare: the two sides resolve to the same "
+              "config — give --b/--chaos-b a difference (or "
+              "--ref-host); see --help", file=sys.stderr)
         sys.exit(2)
     rep = bisect(args, flags_a, flags_b)
     if not args.json:

@@ -310,6 +310,92 @@ def serving_kv_rows(tp: int = 2):
             }}
 
 
+def relief_rows(steps: int = 3):
+    """r25 memory relief gate: train an over-budget probe (unmodified
+    modeled peak > 2x FLAGS_hbm_budget_mb) unconstrained and again
+    under ``FLAGS_memory_relief=auto``, and require the pass to land
+    the modeled peak under budget with bit-identical losses — on the
+    CPU proxy the remat replays and identity-lowered memcpy staging
+    must not change a single bit."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.scope import Scope
+    from paddle_tpu.utils import flags as _flags
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from dp_comm_stats import build_mlp_dp_program
+
+    def train(flags):
+        saved = dict(_flags._flags)
+        try:
+            _flags.set_flags(flags)
+            unique_name.switch()
+            main, startup, loss = build_mlp_dp_program(
+                n_layers=6, width=16, optimizer="sgd", transpile=False)
+            exe = pt.Executor(pt.CPUPlace())
+            scope = Scope()
+            exe.run(startup, scope=scope)
+            rng = np.random.RandomState(0)
+            xs = rng.randn(64, 16).astype(np.float32)
+            ys = (xs[:, :1] * 2 + 1).astype(np.float32)
+            losses = []
+            for _ in range(max(steps, 1)):
+                out = exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss], scope=scope)
+                losses.append(np.asarray(out[0]).copy())
+            plan = list(exe._cache.values())[-1]._memory_plan
+            return losses, plan
+        finally:
+            _flags._flags.clear()
+            _flags._flags.update(saved)
+
+    base, plan0 = train({})
+    budget_mb = plan0.peak_bytes / 2.0 / _MB
+    relieved, plan1 = train({"hbm_budget_mb": budget_mb,
+                             "memory_relief": "auto"})
+    rep = plan1.relief or {}
+    bit_identical = all(np.array_equal(a, b)
+                        for a, b in zip(base, relieved))
+    under = (int(rep.get("peak_after_bytes", 1 << 62))
+             <= int(rep.get("budget_bytes") or 0))
+    return {
+        "probe": "mlp-sgd", "budget_mb": round(budget_mb, 6),
+        "unconstrained_peak_mb": round(plan0.peak_bytes / _MB, 6),
+        "modeled_peak_before_mb": round(
+            rep.get("peak_before_bytes", 0) / _MB, 6),
+        "modeled_peak_after_mb": round(
+            rep.get("peak_after_bytes", 0) / _MB, 6),
+        "engaged": bool(rep.get("engaged")),
+        "n_fixes": len(rep.get("fixes", [])),
+        "fixes": rep.get("fixes", []),
+        "modeled_overhead_s": float(rep.get("modeled_overhead_s", 0.0)),
+        "under_budget": bool(under),
+        "loss_bit_identical": bool(bit_identical),
+        "ok": bool(rep.get("engaged") and under and bit_identical),
+    }
+
+
+def format_relief(section):
+    lines = [
+        f"relief (memory_relief=auto @ {section['budget_mb']:.4f}MB "
+        f"budget, unconstrained peak "
+        f"{section['unconstrained_peak_mb']:.4f}MB):",
+        f"  modeled peak {section['modeled_peak_before_mb']:.4f}MB -> "
+        f"{section['modeled_peak_after_mb']:.4f}MB in "
+        f"{section['n_fixes']} fix(es), modeled overhead "
+        f"{section['modeled_overhead_s']:.2e}s, under_budget="
+        f"{section['under_budget']} bit_identical="
+        f"{section['loss_bit_identical']}",
+        f"  {'var':<34} {'fix':<8} {'saved_B':>9} {'cost_s':>10}"]
+    for f in section["fixes"][:12]:
+        lines.append(f"  {f['var']:<34} {f['fix']:<8} "
+                     f"{f['saved_bytes']:>9} "
+                     f"{f['modeled_cost_s']:>10.2e}")
+    return "\n".join(lines)
+
+
 def format_serving_kv(section):
     lines = [f"serving kv_pool @ {section['budget_mb']:.4f}MB budget:",
              f"  {'dtype':<10} {'pages':>6} {'modeled':>9} {'census':>9} "
@@ -438,6 +524,16 @@ def main(argv=None) -> int:
                         if not (r["modeled_eq_census"]
                                 and r["capacity_ok"])))
         ok = False
+    # the r25 relief gate: an over-budget probe must land under budget
+    # with bit-identical losses once FLAGS_memory_relief=auto engages
+    relief = relief_rows(args.steps)
+    if not relief["ok"]:
+        checks["failures"].append(
+            "relief: over-budget probe did not land under budget with "
+            f"bit-identical loss (engaged={relief['engaged']} "
+            f"under_budget={relief['under_budget']} "
+            f"bit_identical={relief['loss_bit_identical']})")
+        ok = False
     budget = {}
     if args.budget_mb:
         budget = {
@@ -448,12 +544,13 @@ def main(argv=None) -> int:
     payload = {
         "probe": args.probe, "ndev": args.ndev, "steps": args.steps,
         "quick": bool(args.quick), "rows": rows, "checks": checks,
-        "serving_kv": serving_kv, "ok": ok,
+        "serving_kv": serving_kv, "relief": relief, "ok": ok,
         **({"budget": budget} if budget else {}),
     }
     if not args.json:
         print(format_rows(rows))
         print(format_serving_kv(serving_kv))
+        print(format_relief(relief))
         for f in checks["failures"]:
             print(f"CHECK FAIL: {f}")
     print("MEM=" + json.dumps(payload, sort_keys=True))

@@ -170,6 +170,34 @@ def kv_pool_detail(program, plan):
     }
 
 
+def apply_relief(program, mode, budget_mb, feed_names=(), fetch_names=(),
+                 ndev=1, stage=None):
+    """Apply the r25 memory_relief_pass to a clone and re-plan: with
+    ``--relief`` (or FLAGS_memory_relief) active, the ``--mem`` verdict
+    keys on the POST-relief residual peak and the printed table carries
+    the pass's decision list.  Strict-mode raises are swallowed — the
+    lint's job is to print the residual and exit 1, not traceback."""
+    from paddle_tpu.framework import memory_plan
+    from paddle_tpu.framework.ir import get_pass
+
+    clone = program.clone()
+    p = get_pass("memory_relief_pass", mode=mode,
+                 budget=int(float(budget_mb) * (1 << 20)),
+                 feed_names=tuple(feed_names),
+                 fetch_names=tuple(fetch_names), ndev=int(ndev),
+                 stage=stage, allow_escalate=(mode == "auto"))
+    try:
+        p.apply(clone)
+    except memory_plan.MemoryBudgetError:
+        pass  # report is complete; the residual keys the exit code
+    rep = p.report or {}
+    plan = memory_plan.plan_memory(clone, feed_names=feed_names,
+                                   fetch_names=fetch_names, ndev=ndev,
+                                   stage=rep.get("stage", stage))
+    plan.relief = rep
+    return plan
+
+
 def check_plan(program, feed_names=(), fetch_names=(), ndev=1,
                budget_mb=0.0):
     """Auto-parallel plan search for one program (the FLAGS_dp_plan=auto
@@ -221,6 +249,12 @@ def main(argv=None):
                     choices=(0, 1, 2, 3),
                     help="with --mem: ZeRO stage to model (default: "
                          "FLAGS_dp_sharding)")
+    ap.add_argument("--relief", default=None,
+                    choices=("off", "remat", "offload", "auto"),
+                    help="with --mem: apply the memory_relief_pass to "
+                         "over-budget programs before the verdict — the "
+                         "exit code keys on the POST-relief residual "
+                         "peak (default: FLAGS_memory_relief, i.e. off)")
     ap.add_argument("--tp", type=int, default=1,
                     help="with --mem: tensor-parallel degree to model — "
                          "vars matching --tp-rules (or carrying a "
@@ -282,10 +316,21 @@ def main(argv=None):
     mem_plans = []
     over_budget = []
     if args.mem:
+        from paddle_tpu.utils.flags import flag as _flag
+
+        relief_mode = (args.relief if args.relief is not None
+                       else str(_flag("memory_relief", "off") or "off"))
+        relief_budget = args.budget_mb or float(_flag("hbm_budget_mb")
+                                                or 0)
         for label, prog in progs:
             plan = check_memory(prog, feed_names, fetch_names,
                                 ndev=args.ndev, stage=args.mem_stage,
                                 tp=args.tp, tp_rules=tp_rules)
+            if (relief_mode != "off" and relief_budget
+                    and plan.peak_mb > relief_budget):
+                plan = apply_relief(prog, relief_mode, relief_budget,
+                                    feed_names, fetch_names,
+                                    ndev=args.ndev, stage=args.mem_stage)
             mem_plans.append((label, plan))
             row = dict(plan.as_dict(10), program=label)
             if args.tp > 1:
