@@ -859,8 +859,26 @@ class Executor:
             # program's
             passes.append(get_pass("numerics_probe_pass",
                                    ops_regex=_numerics.probe_ops_regex()))
+        shard_gate = None
+        if has_collectives and flag("shard_safety"):
+            # after even the numerics probe: the analyzer checks the
+            # probe's cross-shard stat contract too.  Analysis only —
+            # warns (or raises under FLAGS_shard_safety_strict), never
+            # rewrites, and non-collective programs skip it entirely,
+            # so defaults stay bit-identical.
+            shard_gate = get_pass("shard_safety_pass",
+                                  feed_names=tuple(feed_names),
+                                  fetch_names=tuple(fetch_names),
+                                  where="executor_compile")
         if not passes:
+            if shard_gate is not None:
+                # no rewrite pipeline to run: gate the original program
+                # directly instead of paying a full desc-dict clone for
+                # an analysis that cannot mutate it
+                shard_gate.apply(program)
             return program
+        if shard_gate is not None:
+            passes.append(shard_gate)
         clone = Program.from_desc_dict(program.desc_dict())
         clone.random_seed = program.random_seed
         PassManager(passes).apply(clone)

@@ -2289,17 +2289,6 @@ class NumericsProbePass(Pass):
                 "sumsq": "c_allreduce_sum", "nonfinite": "c_allreduce_sum",
                 "numel": "c_allreduce_sum"}
 
-    #: collective ops whose output is replicated across shards — they
-    #: CLEAR shard-variance in the taint walk
-    _CLEARS = frozenset({
-        "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
-        "c_allreduce_prod", "allreduce", "c_fused_allreduce",
-        "c_allgather", "c_broadcast", "broadcast",
-    })
-    #: collective ops whose output is a per-device shard — they SET it
-    _SHARDS = frozenset({"c_fused_reduce_scatter", "c_reducescatter",
-                         "c_split", "alltoall"})
-
     def apply_impl(self, program):
         from . import numerics
         from ..backward import OP_ROLE_KEY, OpRole
@@ -2315,11 +2304,14 @@ class NumericsProbePass(Pass):
         program._numerics_layout = None
         if not targets:
             return program
-        # the taint walk runs exactly when the DP runner would pick the
+        # shard-variance via the shared distribution-state engine
+        # (framework/shard_analysis.py — r26 replaced the pass's private
+        # taint walk); it runs exactly when the DP runner would pick the
         # shard_map path — same predicate, so the two can never drift
+        from . import shard_analysis
         from ..parallel.data_parallel import _program_has_collectives
 
-        tainted = (self._shard_variant_names(block)
+        tainted = (shard_analysis.variant_names(program, block)
                    if _program_has_collectives(program) else set())
         self._attrs = {OP_ROLE_KEY: int(OpRole.Optimize),
                        "op_namescope": "/numerics_probe/"}
@@ -2416,72 +2408,38 @@ class NumericsProbePass(Pass):
             out = combined
         return out
 
-    # -- shard-variance taint walk (shard_map path only) -------------------
-    def _shard_variant_names(self, block):
-        """Names whose runtime value differs per shard inside the
-        shard_map body: seeded by feed-like vars (read-before-write,
-        non-persistable), ZeRO-sharded optimizer state, and RNG-derived
-        outputs (the body folds the key per shard); propagated forward;
-        cleared by replicating collectives; set by scattering ones.
-        Wrapped shard updates (data_parallel._run_sharded_update)
-        gather ParamOut back to full width (or leave a ZeRO-3 param as
-        a shard every consumer auto-gathers), so the param output
-        clears while state-slot outputs stay shard-resident."""
-        from ..ops import registry as _registry
-        from ..utils.flags import flag
+@register_pass("shard_safety_pass")
+class ShardSafetyPass(Pass):
+    """Static SPMD shard-safety gate (framework/shard_analysis.py): runs
+    the distribution-state abstract interpreter and its check catalog —
+    replication soundness, collectives under divergent control flow,
+    comm/compute hazards — over the compiled program.  Analysis-only:
+    the program is returned untouched, findings land in ``self.report``
+    and are warned (or raised under ``FLAGS_shard_safety_strict``) by
+    the shared :func:`shard_analysis.gate`.  Appended LAST in the
+    pipeline so it sees every pass's output, including the numerics
+    probe's cross-shard stat contract."""
 
-        ops = list(block.ops)
-        stage = int(flag("dp_sharding") or 0)
-        try:
-            from ..parallel.mesh import ring_axis_size
+    feed_names: tuple = ()
+    fetch_names: tuple = ()
+    where: str = "shard_safety_pass"
 
-            ndev = int(ring_axis_size(0))
-        except Exception:
-            ndev = 1
-        plans = {}
-        sharded_state: set = set()
-        if stage >= 1 and ndev > 1:
-            from ..parallel.data_parallel import _plan_wrapped_updates
+    def apply(self, program):
+        # Analysis-only: the program cannot be mutated, so the base
+        # class's snapshot/verify bracket would only re-prove what the
+        # pass never touches.  Skipping it keeps the gate's per-compile
+        # cost at the cost of the analysis itself.
+        out = self.apply_impl(program)
+        return out if out is not None else program
 
-            plans, sharded_state, _ = _plan_wrapped_updates(
-                ops, block, ndev, stage)
+    def apply_impl(self, program):
+        from . import shard_analysis
 
-        written: set = set()
-        feeds: set = set()
-        for op_ in ops:
-            for n in op_.input_arg_names:
-                if n in written or n == "@EMPTY@":
-                    continue
-                var = block._find_var_recursive(n)
-                if var is None or not getattr(var, "persistable", False):
-                    feeds.add(n)
-            written.update(op_.output_arg_names)
-
-        tainted = set(feeds) | set(sharded_state)
-        for op_ in ops:
-            outs = [n for n in op_.output_arg_names if n != "@EMPTY@"]
-            plan = plans.get(id(op_))
-            if plan is not None:
-                for n in outs:
-                    if n == plan["param"]:
-                        tainted.discard(n)
-                    else:
-                        tainted.add(n)
-                continue
-            if op_.type in self._CLEARS:
-                tainted.difference_update(outs)
-                continue
-            if op_.type in self._SHARDS:
-                tainted.update(outs)
-                continue
-            d = _registry.OPS.get(op_.type)
-            stateful = d is not None and d.stateful
-            if stateful or any(n in tainted
-                               for n in op_.input_arg_names):
-                tainted.update(outs)
-            else:
-                tainted.difference_update(outs)
-        return tainted
+        diags = shard_analysis.gate(
+            program, feed_names=tuple(self.feed_names),
+            fetch_names=tuple(self.fetch_names), where=self.where)
+        self.report = {"diagnostics": [d.as_dict() for d in diags]}
+        return program
 
 
 @register_pass("fuse_optimizer_ops_pass")

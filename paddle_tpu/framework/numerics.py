@@ -17,8 +17,13 @@ numbers instead of requests or memory:
   (max or sum), so on the shard_map DP path a shard-resident or
   batch-sharded value reduces its local shard and psums (the
   ``cross_shard_norms`` trick), making the finalized stats
-  layout/ZeRO-stage/DP-path-invariant.  ``on_step`` finalizes partials
-  into {absmax, mean, rms, nonfinite, numel} per var.
+  layout/ZeRO-stage/DP-path-invariant.  Which vars need the combine is
+  decided by the shared distribution-state engine
+  (``framework/shard_analysis.py variant_names`` — since r26 the same
+  abstract interpretation the shard-safety checks run, which also
+  audits the packed ``STATS_VAR``'s replication contract after the
+  pass).  ``on_step`` finalizes partials into {absmax, mean, rms,
+  nonfinite, numel} per var.
 * **telemetry** — ``numerics_grad_norm`` / ``numerics_param_norm`` /
   ``numerics_update_ratio`` gauges, ``numerics_nonfinite_total``
   counter, plus the AMP instruments (``amp_found_inf_total``,

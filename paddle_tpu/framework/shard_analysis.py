@@ -1,0 +1,608 @@
+"""Static SPMD shard-safety analysis: one abstract interpreter over
+Program/Block that every compiled program form shares.
+
+Until r26 the repo had two unrelated static guards over its growing set
+of per-device programs: the r10 verifier's flat ``check_collective_order``
+fingerprint and the r20 numerics probe's private shard-variance taint
+walk (``NumericsProbePass._shard_variant_names``).  Every upcoming rung
+on ROADMAP directions 2/3 — pipeline-bubble plan axes, per-bucket wire
+compression, hierarchical ICI x DCN collectives, elastic
+shrink-and-continue — multiplies the number of distinct programs whose
+collectives must agree, so this module builds the checker ONCE as a
+first-class analysis ("End-to-end Adaptive Distributed Training on
+PaddlePaddle", arXiv:2112.02752, validates derived parallel plans before
+execution; EQuARX, arXiv:2506.17615, previews mixed-precision
+collectives whose dtype/ring mismatches are exactly the bug class a
+static checker catches).
+
+**Distribution-state lattice.**  Each var name carries one of three
+states, ordered ``replicated < sharded < variant``:
+
+* ``replicated`` — provably the same value on every device (parameters,
+  counters, the output of a replicating collective);
+* ``sharded``    — a deterministic 1/ndev shard of a global value
+  (reduce-scattered grads, ZeRO-sharded optimizer state, ``c_split``
+  outputs, tensor-parallel annotated weights);
+* ``variant``    — arbitrary per-device divergence (batch-sharded
+  feeds, RNG-derived values, anything computed from either).
+
+States are seeded from feeds (read-before-write non-persistable names),
+RNG/stateful ops, partition-rule specs (``_sharding`` annotations) and
+ZeRO-sharded state (``data_parallel._plan_wrapped_updates``), then
+propagated forward through op read/write sets: replicating collectives
+(:data:`REPLICATING_COLLECTIVES`) clear to ``replicated``, scattering
+ones (:data:`SHARDING_COLLECTIVES`) set ``sharded``, wrapped shard
+updates gather their ParamOut back while their state slots stay
+shard-resident, and everything else joins its inputs.  The
+``variant_names`` view of the final states is the exact r20 taint walk
+(parity pinned by tests/test_shard_analysis.py), and
+``framework/ir.py numerics_probe_pass`` consumes it — the old private
+walk is deleted.
+
+**Checks** (each finding carries op index, var name and the inferred
+state chain):
+
+1. :func:`check_replication_soundness` — a var consumed where a
+   replicated value is required (update-op replicated slots per
+   ``partition_rules.REPLICATED_SLOT_RULES`` + LearningRate, host-op
+   reads, the numerics probe's packed stats vector) must be provably
+   replicated at that read;
+2. :func:`check_collective_context` — collectives under a shard-variant
+   branch predicate or inside a loop body whose trip count can diverge
+   per device (the classic SPMD deadlock), found by descending into
+   cond / while / while_loop sub-blocks;
+3. :func:`check_comm_hazards` — an in-place write must not clobber a
+   buffer a still-outstanding overlapped collective reads (the r9
+   overlap schedule issues bucket collectives early; XLA's async
+   collectives are in flight until the first consumer), and r16
+   prefetch gather windows must not cross a write to their param;
+4. :func:`check_member_programs` — cross-program agreement over the
+   verifier's EXTENDED collective signature (ring, reduce-op, dtype,
+   sharded payload shape; sub-block descent) for tp/dp member sets,
+   reusable offline via ``tools/progcheck.py --shard``.
+
+The :func:`gate` entry is flag-guarded (``FLAGS_shard_safety``, default
+ON as warn; ``FLAGS_shard_safety_strict`` raises ``VerifyError``) and
+analysis-only: it never mutates a program, so defaults are
+bit-identical.  Programs without collectives short-circuit to zero
+findings — single-device programs have no SPMD obligations.
+"""
+from __future__ import annotations
+
+import re
+import warnings
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .core import Block, Operator, Program
+from .verifier import (Diagnostic, SEV_ERROR, SEV_WARNING, VerifyError,
+                       _LOCAL_SYNC_OPS, _sub_block_attrs, EMPTY)
+
+__all__ = [
+    "REPLICATED", "SHARDED", "VARIANT", "DistState", "ShardAnalysis",
+    "REPLICATING_COLLECTIVES", "SHARDING_COLLECTIVES", "analyze",
+    "variant_names", "check_replication_soundness",
+    "check_collective_context", "check_comm_hazards", "check_program",
+    "check_member_programs", "gate", "enabled", "strict",
+]
+
+REPLICATED = "replicated"
+SHARDED = "sharded"
+VARIANT = "variant"
+
+_RANK = {REPLICATED: 0, SHARDED: 1, VARIANT: 2}
+
+#: collective ops whose output is replicated across shards — they CLEAR
+#: shard-variance (the r20 walk's _CLEARS set, now shared)
+REPLICATING_COLLECTIVES = frozenset({
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "allreduce", "c_fused_allreduce",
+    "c_allgather", "c_broadcast", "broadcast",
+})
+#: collective ops whose output is a per-device shard — they SET it
+#: (the r20 walk's _SHARDS set, now shared)
+SHARDING_COLLECTIVES = frozenset({
+    "c_fused_reduce_scatter", "c_reducescatter", "c_split", "alltoall",
+})
+
+#: control-flow ops whose sub-blocks the context check descends into
+_CONTROL_OPS = frozenset({"cond", "while", "while_loop", "recurrent"})
+
+_CHAIN_CAP = 6  # provenance entries kept per state (head ... tail)
+
+
+def _is_collective(op_type: str) -> bool:
+    if op_type in _LOCAL_SYNC_OPS:
+        return False
+    return (op_type.startswith("c_")
+            or op_type in ("allreduce", "broadcast", "barrier"))
+
+
+class DistState:
+    """One var's distribution state plus its provenance chain."""
+
+    __slots__ = ("kind", "axis", "chain")
+
+    def __init__(self, kind: str, axis=None, chain: Tuple[str, ...] = ()):
+        self.kind = kind
+        self.axis = axis
+        self.chain = chain
+
+    @property
+    def replicated(self) -> bool:
+        return self.kind == REPLICATED
+
+    def extend(self, note: str) -> "DistState":
+        chain = self.chain + (note,)
+        if len(chain) > _CHAIN_CAP:
+            chain = chain[:1] + ("...",) + chain[-(_CHAIN_CAP - 2):]
+        return DistState(self.kind, self.axis, chain)
+
+    def describe(self) -> str:
+        where = self.kind if self.axis is None \
+            else f"{self.kind}[{self.axis}]"
+        if not self.chain:
+            return where
+        return f"{where} ({' -> '.join(self.chain)})"
+
+    def __repr__(self):
+        return f"<DistState {self.describe()}>"
+
+
+_REPL = DistState(REPLICATED)
+
+
+def _join(states: Sequence[DistState]) -> DistState:
+    best = _REPL
+    for s in states:
+        if _RANK[s.kind] > _RANK[best.kind]:
+            best = s
+    return best
+
+
+def _zero_plan(ops, block):
+    """(wrapped-update plans, ZeRO-sharded state names) for the current
+    FLAGS_dp_sharding / mesh config — the same derivation the DP
+    runner's shard_map path uses, so the two can never drift."""
+    from ..utils.flags import flag
+
+    stage = int(flag("dp_sharding") or 0)
+    try:
+        from ..parallel.mesh import ring_axis_size
+
+        ndev = int(ring_axis_size(0))
+    except Exception:
+        ndev = 1
+    if stage < 1 or ndev <= 1:
+        return {}, set(), stage
+    from ..parallel.data_parallel import _plan_wrapped_updates
+
+    plans, sharded_state, _ = _plan_wrapped_updates(ops, block, ndev, stage)
+    return plans, sharded_state, stage
+
+
+def _shard_annotations(block) -> Dict[str, object]:
+    """Vars carrying a partition-rule / tensor-parallel ``_sharding``
+    spec that names at least one mesh axis (tensor_parallel helpers)."""
+    from ..parallel.tensor_parallel import annotated_shard_axes
+
+    return annotated_shard_axes(block)
+
+
+class ShardAnalysis:
+    """Forward abstract interpretation of one block's op list.
+
+    ``states`` holds the FINAL per-name states after the walk;
+    flow-sensitive consumers (the replication-soundness check) pass an
+    ``on_op(i, op_, states)`` observer, called before each op's write
+    effects apply — i.e. with the states its reads observe."""
+
+    def __init__(self, program: Program, block: Optional[Block] = None):
+        self.program = program
+        self.block = block if block is not None \
+            else program.global_block()
+        self.states: Dict[str, DistState] = {}
+        self.plans: Dict[int, dict] = {}
+        self.stage = 0
+
+    # -- seeding -----------------------------------------------------------
+    def seed(self) -> "ShardAnalysis":
+        from ..ops import registry as _registry
+
+        block = self.block
+        ops = list(block.ops)
+        self.plans, sharded_state, self.stage = _zero_plan(ops, block)
+
+        written: set = set()
+        for op_ in ops:
+            for n in op_.input_arg_names:
+                if n in written or n == EMPTY or n in self.states:
+                    continue
+                var = block._find_var_recursive(n)
+                if var is None or not getattr(var, "persistable", False):
+                    self.states[n] = DistState(
+                        VARIANT, chain=(
+                            f"seed: {n!r} feed-like (read before write, "
+                            f"non-persistable)",))
+            written.update(op_.output_arg_names)
+
+        for n in sharded_state:
+            self.states[n] = DistState(
+                SHARDED, axis="dp", chain=(
+                    f"seed: {n!r} ZeRO-sharded optimizer state "
+                    f"(stage {self.stage})",))
+        for n, axes in _shard_annotations(block).items():
+            if n not in self.states:
+                self.states[n] = DistState(
+                    SHARDED, axis=next(a for a in axes if a is not None),
+                    chain=(f"seed: {n!r} partition-rule spec {axes!r}",))
+        self._registry = _registry
+        return self
+
+    # -- propagation -------------------------------------------------------
+    def _ring_axis(self, op_) -> object:
+        ring = op_.attrs.get("ring_id", 0)
+        try:
+            from ..parallel.mesh import registry as _mesh_registry
+
+            axis = _mesh_registry().axis_for_ring(ring)
+        except Exception:
+            axis = None
+        return axis if axis is not None else f"ring{ring}"
+
+    def propagate(self, on_op: Optional[Callable] = None
+                  ) -> "ShardAnalysis":
+        states = self.states
+        for i, op_ in enumerate(self.block.ops):
+            if on_op is not None:
+                on_op(i, op_, states)
+            outs = [n for n in op_.output_arg_names if n != EMPTY]
+            plan = self.plans.get(id(op_))
+            if plan is not None:
+                # wrapped shard update: ParamOut gathers back to full
+                # width (or stays a shard every consumer auto-gathers);
+                # state-slot outputs stay shard-resident
+                for n in outs:
+                    if n == plan["param"]:
+                        states.pop(n, None)
+                    else:
+                        states[n] = DistState(
+                            SHARDED, axis="dp",
+                            chain=(f"op #{i} ({op_.type}) shard-wrapped "
+                                   f"update writes {n!r}",))
+                continue
+            if op_.type in REPLICATING_COLLECTIVES:
+                for n in outs:
+                    states.pop(n, None)
+                continue
+            if op_.type in SHARDING_COLLECTIVES:
+                axis = self._ring_axis(op_)
+                for n in outs:
+                    states[n] = DistState(
+                        SHARDED, axis=axis,
+                        chain=(f"op #{i} ({op_.type}) scatters {n!r}",))
+                continue
+            d = self._registry.OPS.get(op_.type)
+            stateful = d is not None and d.stateful
+            if stateful:
+                for n in outs:
+                    states[n] = DistState(
+                        VARIANT, chain=(
+                            f"op #{i} ({op_.type}) is stateful/RNG — "
+                            f"per-device stream",))
+                continue
+            src = _join([states[n] for n in op_.input_arg_names
+                         if n in states])
+            if src.replicated:
+                for n in outs:
+                    states.pop(n, None)
+            else:
+                carried = src.extend(f"op #{i} ({op_.type})")
+                for n in outs:
+                    states[n] = carried
+        return self
+
+    # -- views -------------------------------------------------------------
+    def state_of(self, name: str) -> DistState:
+        return self.states.get(name, _REPL)
+
+    def variant_names(self) -> set:
+        """Names whose runtime value differs per device — the exact
+        contract of the r20 numerics taint walk (sharded counts: a
+        shard IS a per-device-different value)."""
+        return set(self.states)
+
+
+def analyze(program: Program, block: Optional[Block] = None,
+            on_op: Optional[Callable] = None) -> ShardAnalysis:
+    return ShardAnalysis(program, block).seed().propagate(on_op=on_op)
+
+
+def variant_names(program: Program, block: Optional[Block] = None) -> set:
+    """Shard-variant names of ``block`` (default: global block) — the
+    shared engine behind ``numerics_probe_pass``'s cross-shard stat
+    combines."""
+    return analyze(program, block).variant_names()
+
+
+# ==========================================================================
+# check 1: replication soundness
+# ==========================================================================
+def _replicated_slots(op_) -> List[str]:
+    from ..parallel.partition_rules import (REPLICATED_SLOT_RULES,
+                                            is_update_op)
+
+    if not is_update_op(op_.type):
+        return []
+    slots = [s for s in op_.inputs
+             if s == "LearningRate"
+             or any(re.search(p, s) for p in REPLICATED_SLOT_RULES)]
+    return slots
+
+
+def _replication_observer(block, diags: List[Diagnostic]) -> Callable:
+    """The per-op half of replication soundness, as an ``analyze``
+    observer so callers can piggyback it on a walk they already pay
+    for (``check_program`` shares ONE walk across checks 1 and 2)."""
+    from ..ops import registry as _registry
+
+    def on_op(i, op_, states):
+        for slot in _replicated_slots(op_):
+            for n in op_.inputs.get(slot, []):
+                st = states.get(n)
+                if st is None or n == EMPTY:
+                    continue
+                diags.append(Diagnostic(
+                    SEV_ERROR, "replication-required",
+                    f"update op consumes {n!r} in replicated slot "
+                    f"{slot!r}, but it is {st.describe()} — the slot's "
+                    f"math assumes one global value per device",
+                    block.idx, i, op_.type, var=n,
+                    pass_name="shard_safety"))
+        d = _registry.OPS.get(op_.type)
+        if d is not None and d.host and op_.type not in _CONTROL_OPS \
+                and op_.type not in _LOCAL_SYNC_OPS:
+            for n in op_.input_arg_names:
+                st = states.get(n)
+                if st is None or st.kind != VARIANT or n == EMPTY:
+                    continue
+                diags.append(Diagnostic(
+                    SEV_ERROR, "replication-required",
+                    f"host op reads {n!r}, which is {st.describe()} — "
+                    f"a host read has no defined value when shards "
+                    f"diverge", block.idx, i, op_.type, var=n,
+                    pass_name="shard_safety"))
+
+    return on_op
+
+
+def _stats_var_diags(analysis: ShardAnalysis, block) -> List[Diagnostic]:
+    """Post-walk half of replication soundness: the numerics probe's
+    packed stats vector must end the program replicated."""
+    from . import numerics as _numerics
+
+    diags: List[Diagnostic] = []
+    if block.has_var(_numerics.STATS_VAR):
+        st = analysis.state_of(_numerics.STATS_VAR)
+        if not st.replicated:
+            diags.append(Diagnostic(
+                SEV_ERROR, "replication-required",
+                f"numerics stats vector {_numerics.STATS_VAR!r} is "
+                f"{st.describe()} — probe partials of a shard-variant "
+                f"var were not cross-shard combined",
+                block.idx, var=_numerics.STATS_VAR,
+                pass_name="shard_safety"))
+    return diags
+
+
+def check_replication_soundness(program: Program,
+                                fetch_names: Sequence[str] = (),
+                                ) -> List[Diagnostic]:
+    """Vars consumed where a replicated value is required must be
+    provably replicated: update-op replicated slots (beta-pow scalar
+    accumulators, the learning rate), host-op reads (a host value is
+    materialized once — divergent shards have no defined host value),
+    and the numerics probe's packed stats vector (the probe stream
+    treats row 0 as THE value)."""
+    diags: List[Diagnostic] = []
+    block = program.global_block()
+    res = analyze(program, block, on_op=_replication_observer(block, diags))
+    diags.extend(_stats_var_diags(res, block))
+    return diags
+
+
+# ==========================================================================
+# check 2: collectives under divergent control flow (SPMD deadlock)
+# ==========================================================================
+def _sub_collectives(blocks, _seen=None) -> List[str]:
+    """Recursively collect collective op types inside sub-blocks."""
+    out: List[str] = []
+    seen = _seen if _seen is not None else set()
+    for blk in blocks:
+        if id(blk) in seen:
+            continue
+        seen.add(id(blk))
+        for op_ in blk.ops:
+            if _is_collective(op_.type):
+                out.append(op_.type)
+            out.extend(_sub_collectives(_sub_block_attrs(op_), seen))
+    return out
+
+
+def _predicate_state(op_, analysis: ShardAnalysis) -> DistState:
+    """Joined state of every value the control decision depends on:
+    the Cond input plus — for while_loop, whose predicate is computed
+    by its cond block — the carries and the cond block's free reads."""
+    names = list(op_.inputs.get("Cond", []))
+    if op_.type == "while_loop":
+        names.extend(op_.input_arg_names)
+        for sb in _sub_block_attrs(op_):
+            for sop in sb.ops:
+                names.extend(n for n in sop.input_arg_names
+                             if n not in sb.vars)
+    return _join([analysis.state_of(n) for n in set(names)
+                  if n != EMPTY])
+
+
+def check_collective_context(program: Program,
+                             analysis: Optional[ShardAnalysis] = None,
+                             ) -> List[Diagnostic]:
+    """A collective under a shard-variant predicate deadlocks: devices
+    whose predicate (or trip count) diverges issue different collective
+    sequences and block each other forever.  Replicated predicates are
+    fine (every device takes the same path), and divergent control flow
+    WITHOUT collectives is legal SPMD — only the combination flags.
+    Pass ``analysis`` to reuse an already-computed walk."""
+    diags: List[Diagnostic] = []
+    if analysis is None:
+        analysis = analyze(program)
+    block = program.global_block()
+    for i, op_ in enumerate(block.ops):
+        if op_.type not in _CONTROL_OPS:
+            continue
+        subs = _sub_block_attrs(op_)
+        if not subs:
+            continue
+        inner = _sub_collectives(subs)
+        if not inner:
+            continue
+        pred = _predicate_state(op_, analysis)
+        if pred.replicated:
+            continue
+        loopish = op_.type != "cond"
+        code = ("divergent-trip-count" if loopish
+                else "collective-under-variant-predicate")
+        what = ("per-device trip counts can diverge" if loopish
+                else "devices can take different branches")
+        diags.append(Diagnostic(
+            SEV_ERROR, code,
+            f"{op_.type!r} predicate is {pred.describe()} and its "
+            f"sub-block issues collective(s) {sorted(set(inner))} — "
+            f"{what}, so the collective sequences desynchronize "
+            f"(SPMD deadlock)", block.idx, i, op_.type,
+            var=(op_.inputs.get("Cond") or [None])[0],
+            pass_name="shard_safety"))
+    return diags
+
+
+# ==========================================================================
+# check 3: comm/compute hazard (overlap + prefetch windows)
+# ==========================================================================
+def check_comm_hazards(program: Program,
+                       prefetch_records: Sequence[dict] = (),
+                       ) -> List[Diagnostic]:
+    """An overlapped collective is outstanding from its issue point to
+    the first read of its result (XLA async collectives; the r9 overlap
+    schedule deliberately issues bucket collectives early).  An op that
+    WRITES the payload buffer inside that window — an in-place update,
+    a donation-reusing rewrite — races the DMA.  The first READ closes
+    the window (in-place read+write consumers observe the reduced value
+    first).  The r16 prefetch gather windows are the same hazard for
+    the runtime all-gathers: delegated to the verifier's window rule."""
+    diags: List[Diagnostic] = []
+    block = program.global_block()
+    ops = list(block.ops)
+    for i, op_ in enumerate(ops):
+        if not _is_collective(op_.type) or op_.type == "barrier":
+            continue
+        payload = [n for n in op_.output_arg_names if n != EMPTY]
+        for x in payload:
+            for j in range(i + 1, len(ops)):
+                nxt = ops[j]
+                if x in nxt.input_arg_names:
+                    break  # first consumer: the collective is awaited
+                if x in nxt.output_arg_names:
+                    diags.append(Diagnostic(
+                        SEV_ERROR, "comm-compute-hazard",
+                        f"op #{j} ({nxt.type}) writes {x!r} while the "
+                        f"collective issued at op #{i} ({op_.type}) is "
+                        f"still outstanding (no read between them) — "
+                        f"the write races the in-flight transfer",
+                        block.idx, j, nxt.type, var=x,
+                        pass_name="shard_safety"))
+                    break
+    if prefetch_records:
+        from .verifier import check_prefetch_plan
+
+        for d in check_prefetch_plan(ops, block, prefetch_records):
+            d.pass_name = "shard_safety"
+            diags.append(d)
+    return diags
+
+
+# ==========================================================================
+# check 4: cross-program (tp/dp member) agreement
+# ==========================================================================
+def check_member_programs(programs: Sequence[Program],
+                          labels: Optional[Sequence[str]] = None,
+                          ) -> List[Diagnostic]:
+    """Every member of a tp/dp program set must issue the same
+    collectives in the same order with the same (ring, reduce-op,
+    dtype, payload shape) — the verifier's EXTENDED signature, so a
+    dtype or reduce-op divergence is as fatal as a reorder."""
+    from . import verifier
+
+    diags = list(verifier.check_collective_order(programs))
+    for d in diags:
+        d.pass_name = d.pass_name or "shard_safety"
+    return diags
+
+
+# ==========================================================================
+# program-level driver + flag-guarded gate
+# ==========================================================================
+def check_program(program: Program, feed_names: Sequence[str] = (),
+                  fetch_names: Sequence[str] = (),
+                  prefetch_records: Sequence[dict] = (),
+                  ) -> List[Diagnostic]:
+    """All single-program shard-safety checks.  Programs without
+    collectives short-circuit: they carry no SPMD obligations, so the
+    zoo of single-device programs yields zero findings by
+    construction."""
+    from ..parallel.data_parallel import _program_has_collectives
+
+    if not _program_has_collectives(program):
+        return []
+    block = program.global_block()
+    diags: List[Diagnostic] = []
+    # ONE abstract-interpretation walk shared by checks 1 and 2: the
+    # replication observer fires per op, the same final state feeds the
+    # control-flow check and the stats-vector contract.
+    analysis = analyze(program, block,
+                       on_op=_replication_observer(block, diags))
+    diags.extend(_stats_var_diags(analysis, block))
+    diags.extend(check_collective_context(program, analysis=analysis))
+    diags.extend(check_comm_hazards(program, prefetch_records))
+    return diags
+
+
+def enabled() -> bool:
+    from ..utils.flags import flag
+
+    return bool(flag("shard_safety"))
+
+
+def strict() -> bool:
+    from ..utils.flags import flag
+
+    return bool(flag("shard_safety_strict"))
+
+
+def gate(program: Program, feed_names: Sequence[str] = (),
+         fetch_names: Sequence[str] = (),
+         prefetch_records: Sequence[dict] = (),
+         where: str = "shard_safety") -> List[Diagnostic]:
+    """The compile-pipeline gate: run every check, WARN by default
+    (``FLAGS_shard_safety``; analysis only — the program is never
+    touched), raise ``VerifyError`` under ``FLAGS_shard_safety_strict``.
+    Returns the findings either way so callers can attach a report."""
+    if not enabled():
+        return []
+    diags = check_program(program, feed_names, fetch_names,
+                          prefetch_records)
+    errors = [d for d in diags if d.severity == SEV_ERROR]
+    if errors and strict():
+        raise VerifyError(errors, where)
+    for d in diags:
+        warnings.warn(f"[{where}] {d.format()}", RuntimeWarning,
+                      stacklevel=2)
+    return diags

@@ -720,26 +720,67 @@ _LOCAL_SYNC_OPS = frozenset({
 })
 
 
+#: reduce operation implied by the collective op TYPE (the lowering
+#: dispatches on the type, not an attr — see ops/collective_ops.py)
+_REDUCE_OF_TYPE = {
+    "c_allreduce_sum": "sum", "c_allreduce_max": "max",
+    "c_allreduce_min": "min", "c_allreduce_prod": "prod",
+    "c_fused_allreduce": "sum", "allreduce": "sum",
+    "c_reducescatter": "sum", "c_fused_reduce_scatter": "sum",
+    "c_reduce_sum": "sum", "c_reduce_max": "max", "c_reduce_min": "min",
+}
+
+
+def _signature_walk(blk, sig, visited):
+    from .dtype import dtype_name
+
+    if id(blk) in visited:
+        return
+    visited.add(id(blk))
+    for op_ in blk.ops:
+        t = op_.type
+        # a collective inside a sub-block (while body, cond branch)
+        # executes AT the parent op's position — descend in place so the
+        # fingerprint reflects issue order, the property NCCL rings care
+        # about (a while lowers to scan: its body's collectives repeat
+        # here, identically on every device or not at all)
+        for sub in _sub_block_attrs(op_):
+            _signature_walk(sub, sig, visited)
+        if not (t.startswith("c_") or t in ("allreduce", "broadcast",
+                                            "barrier")):
+            continue
+        if t in _LOCAL_SYNC_OPS:
+            continue
+        shape = dt = None
+        names = op_.inputs.get("X", []) or op_.input_arg_names
+        if names:
+            v = blk._find_var_recursive(names[0])
+            if v is not None and v.shape is not None:
+                shape = tuple(v.shape)
+            if v is not None and v.dtype is not None:
+                try:
+                    dt = dtype_name(v.dtype)
+                except ValueError:
+                    dt = str(v.dtype)
+        sig.append((t, op_.attrs.get("ring_id", 0), len(names), shape,
+                    _REDUCE_OF_TYPE.get(t), dt))
+    return
+
+
 def collective_signature(program: Program) -> List[tuple]:
-    """Ordered (type, ring_id, payload shape) of every order-sensitive
-    collective in the program — the ring-deadlock fingerprint: two
-    devices whose sequences diverge will block each other forever."""
-    sig = []
+    """Ordered (type, ring_id, nargs, payload shape, reduce-op, dtype)
+    of every order-sensitive collective — the ring-deadlock fingerprint:
+    two devices whose sequences diverge will block each other forever,
+    and (r26) a reduce-op/dtype divergence on the SAME slot corrupts
+    data silently instead, so both ride one signature.  Sub-blocks are
+    visited at their parent op's position (issue order), then any block
+    unreachable from block 0 is swept for coverage."""
+    sig: List[tuple] = []
+    visited: set = set()
+    if program.blocks:
+        _signature_walk(program.blocks[0], sig, visited)
     for blk in program.blocks:
-        for op_ in blk.ops:
-            t = op_.type
-            if not (t.startswith("c_") or t in ("allreduce", "broadcast",
-                                                "barrier")):
-                continue
-            if t in _LOCAL_SYNC_OPS:
-                continue
-            shape = None
-            names = op_.inputs.get("X", []) or op_.input_arg_names
-            if names:
-                v = blk._find_var_recursive(names[0])
-                if v is not None and v.shape is not None:
-                    shape = tuple(v.shape)
-            sig.append((t, op_.attrs.get("ring_id", 0), len(names), shape))
+        _signature_walk(blk, sig, visited)
     return sig
 
 

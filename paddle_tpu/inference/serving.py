@@ -1222,6 +1222,17 @@ class _EngineCore:
             apply_tensor_parallel(prog, rules)
             prog._tp_shard = {"axis": SERVING_TP_AXIS, "degree": self.tp,
                               "mesh": self.tp_mesh}
+            # static shard-safety gate over the finished shard body:
+            # the combines just inserted plus the decoder_tp_rules
+            # annotations are exactly what the analyzer audits (a
+            # collective under a per-rank predicate, or a replicated-
+            # slot read of a shard-resident value, deadlocks/corrupts
+            # every rank of the serving mesh at once)
+            from ..framework import shard_analysis
+
+            shard_analysis.gate(prog, feed_names=tuple(feeds),
+                                fetch_names=tuple(fetch),
+                                where=f"serving_tp_compile[{mode}]")
         return prog, feeds, fetch
 
     @property

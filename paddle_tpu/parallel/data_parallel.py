@@ -578,6 +578,18 @@ def _compile_dp_miss(compiled_program, executor, program, feed,
     compiled_program.__dict__.setdefault("_prefetch_plans", {})[key] = \
         pf_records
 
+    # static SPMD shard-safety gate (framework/shard_analysis.py): the
+    # distribution-state checks over the FINAL per-device program, with
+    # this compile's prefetch windows so the comm/compute hazard check
+    # covers the r16 gather motion too.  Warn-only by default;
+    # FLAGS_shard_safety_strict raises before anything is traced.
+    from ..framework import shard_analysis
+
+    shard_analysis.gate(program, feed_names=tuple(feed),
+                        fetch_names=tuple(fetch_names),
+                        prefetch_records=pf_records,
+                        where="data_parallel_compile")
+
     # static HBM plan for THIS (stage, mesh, path) config
     # (framework/memory_plan.py): per-device modeled timeline/peak with
     # the ZeRO shard scaling and the exact prefetch windows compiled

@@ -672,6 +672,18 @@ def search_plan(program, feed_names=(), fetch_names=(), *,
         "chosen": best_row,
         "candidates": rows,
     }
+    # shard-safety validation of the CHOSEN candidate before anything
+    # compiles: the analyzer re-derives distribution states under the
+    # candidate's flag overlay (its ZeRO stage changes which optimizer
+    # state is shard-resident), so an unsound plan is flagged here with
+    # the same diagnostics the compile gate would raise later
+    from ..framework import shard_analysis
+
+    if best is not None and shard_analysis.enabled():
+        with applied_plan(best):
+            diags = shard_analysis.check_program(
+                program, feed_names, fetch_names)
+        report["shard_safety"] = [d.as_dict() for d in diags]
     return best, report
 
 

@@ -63,6 +63,25 @@ def apply_tensor_parallel(program, rules: Dict[str, Sequence[Optional[str]]]):
     return applied
 
 
+def annotated_shard_axes(program_or_block) -> Dict[str, Tuple]:
+    """name → PartitionSpec of every var annotated with a spec that
+    names at least one mesh axis.  The shard-safety analyzer
+    (framework/shard_analysis.py) seeds these names as ``sharded`` —
+    GSPMD materializes them as per-device shards, so any consumer that
+    needs a replicated value must pass through a gathering collective
+    first.  Accepts a Program or a single Block."""
+    blocks = getattr(program_or_block, "blocks", None)
+    if blocks is None:
+        blocks = [program_or_block]
+    out: Dict[str, Tuple] = {}
+    for blk in blocks:
+        for v in blk.vars.values():
+            spec = get_sharding(v)
+            if spec is not None and any(a is not None for a in spec):
+                out[v.name] = tuple(spec)
+    return out
+
+
 def megatron_mlp_rules(fc_names: Sequence[str], axis: str = "mp"
                        ) -> Dict[str, Sequence[Optional[str]]]:
     """Alternating column/row-parallel specs for a stack of fc weights:

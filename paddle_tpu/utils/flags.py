@@ -342,6 +342,17 @@ _DEFAULTS: Dict[str, Any] = {
     # pass test inherits); off in production — verification never
     # mutates the program, so 0 restores prior behavior bit-for-bit.
     "FLAGS_verify_passes": "pytest" in sys.modules,
+    # static SPMD shard-safety analysis (framework/shard_analysis.py +
+    # the shard_safety_pass compile gate): abstract-interpret each
+    # compiled program's per-var distribution state (replicated /
+    # sharded / shard-variant) and check replication soundness,
+    # collectives under divergent control flow, and comm/compute
+    # hazards.  Analysis only — ON by default as warnings, and programs
+    # without collectives short-circuit, so defaults are bit-identical.
+    "FLAGS_shard_safety": True,
+    # escalate shard-safety ERROR findings from warnings to a raised
+    # VerifyError at compile time (CI / pre-deploy linting posture)
+    "FLAGS_shard_safety_strict": False,
 }
 
 

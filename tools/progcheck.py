@@ -72,6 +72,69 @@ def check_cross_device(programs):
     return verifier.check_collective_order(programs)
 
 
+def check_shard(program, feed_names=(), fetch_names=()):
+    """Static SPMD shard-safety checks for one program
+    (framework/shard_analysis.py): replication soundness, collectives
+    under divergent control flow, comm/compute hazards.  The
+    cross-program member-agreement leg rides the existing cross-device
+    check (the r26 extended signature carries ring, reduce-op, dtype
+    and payload shape)."""
+    from paddle_tpu.framework import shard_analysis
+
+    return shard_analysis.check_program(program, feed_names, fetch_names)
+
+
+def _quick_member(ring=0, reduce_type="c_allreduce_sum"):
+    """A minimal two-op collective member program for --quick: feed ->
+    scale -> allreduce.  Pure graph construction, nothing traced."""
+    from paddle_tpu.framework.core import Program
+    from paddle_tpu.framework.dtype import VarType
+
+    prog = Program()
+    b = prog.global_block()
+    b.create_var(name="x", shape=[4], dtype=VarType.FP32)
+    b.create_var(name="g", shape=[4], dtype=VarType.FP32)
+    b.create_var(name="s", shape=[4], dtype=VarType.FP32)
+    b.append_op("scale", inputs={"X": ["x"]}, outputs={"Out": ["g"]},
+                attrs={"scale": 1.0, "bias": 0.0,
+                       "bias_after_scale": True})
+    b.append_op(reduce_type, inputs={"X": ["g"]}, outputs={"Out": ["s"]},
+                attrs={"ring_id": int(ring)})
+    return prog
+
+
+def quick_selftest(as_json=False):
+    """Bounded in-process smoke for CI (--shard --quick): a clean
+    member pair must produce zero findings, and seeded ring / reduce-op
+    mismatches must each be caught by the member-agreement check.  Exit
+    0 only when both directions hold — i.e. the analyzer is wired AND
+    not crying wolf."""
+    from paddle_tpu.framework import shard_analysis
+
+    good = [_quick_member(ring=0), _quick_member(ring=0)]
+    clean = (not shard_analysis.check_member_programs(good)
+             and not check_shard(good[0], feed_names=("x",)))
+    ring_bad = shard_analysis.check_member_programs(
+        [_quick_member(ring=0), _quick_member(ring=1)])
+    op_bad = shard_analysis.check_member_programs(
+        [_quick_member(reduce_type="c_allreduce_sum"),
+         _quick_member(reduce_type="c_allreduce_max")])
+    ok = bool(clean and ring_bad and op_bad)
+    if as_json:
+        print(json.dumps({
+            "quick": {"clean_pair_ok": bool(clean),
+                      "ring_mismatch_caught": bool(ring_bad),
+                      "reduce_op_mismatch_caught": bool(op_bad),
+                      "ok": ok}}, indent=2))
+    else:
+        print(f"shard quick-smoke: clean-pair={'ok' if clean else 'FAIL'} "
+              f"ring-mismatch={'caught' if ring_bad else 'MISSED'} "
+              f"reduce-op-mismatch={'caught' if op_bad else 'MISSED'}")
+        print(f"progcheck: quick shard self-test "
+              f"{'ok' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
 def _load(path):
     from paddle_tpu.framework.core import Program
 
@@ -266,6 +329,19 @@ def main(argv=None):
                          "'serving' presets the serving decoder+KV "
                          "patterns; empty falls back to _sharding "
                          "annotations")
+    ap.add_argument("--shard", action="store_true",
+                    help="also run the static SPMD shard-safety checks "
+                         "(framework/shard_analysis.py) on each program: "
+                         "replication soundness, collectives under "
+                         "divergent control flow, comm/compute hazards; "
+                         "with 2+ programs the cross-device check already "
+                         "compares the extended (ring, reduce-op, dtype, "
+                         "shape) collective signature")
+    ap.add_argument("--quick", action="store_true",
+                    help="with --shard: run the bounded in-process "
+                         "self-test instead of linting files (clean pair "
+                         "-> 0 findings, seeded ring/reduce-op mismatch "
+                         "-> caught); needs no program arguments")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--strict", action="store_true",
@@ -273,6 +349,10 @@ def main(argv=None):
     ap.add_argument("--quiet", action="store_true",
                     help="summary only, no per-finding lines")
     args = ap.parse_args(argv)
+    if args.quick:
+        if not args.shard:
+            ap.error("--quick requires --shard")
+        return quick_selftest(as_json=args.as_json)
     if not args.programs:
         ap.error("at least one program file (or --manifest checkpoint "
                  "dir) is required")
@@ -342,6 +422,19 @@ def main(argv=None):
             if args.budget_mb and plan.peak_mb > args.budget_mb:
                 over_budget.append(label)
 
+    shard_rows = []
+    shard_diags = []
+    if args.shard:
+        for label, prog in progs:
+            ds = check_shard(prog, feed_names, fetch_names)
+            shard_rows.append({
+                "program": label,
+                "errors": sum(d.severity == "error" for d in ds),
+                "warnings": sum(d.severity == "warning" for d in ds)})
+            for d in ds:
+                shard_diags.append((label, d))
+    n_shard_err = sum(d.severity == "error" for _, d in shard_diags)
+
     plan_rows = []
     plan_infeasible = []
     if args.plan:
@@ -376,10 +469,19 @@ def main(argv=None):
         if args.plan:
             out["plan"] = plan_rows
             out["plan_infeasible"] = plan_infeasible
+        if args.shard:
+            out["shard"] = {
+                "programs": shard_rows,
+                "errors": n_shard_err,
+                "diagnostics": [dict(d.as_dict(), program=label)
+                                for label, d in shard_diags]}
         print(json.dumps(out, indent=2, default=str))
     else:
         if not args.quiet:
             for label, d in diags:
+                print(f"{label}: {d.format()}")
+        if args.shard and not args.quiet:
+            for label, d in shard_diags:
                 print(f"{label}: {d.format()}")
         if args.mem:
             for (label, plan), row in zip(mem_plans, mem_rows):
@@ -429,9 +531,10 @@ def main(argv=None):
               + (f", {len(over_budget)} over budget" if args.mem
                  and args.budget_mb else "")
               + (f", {len(plan_infeasible)} plan-infeasible"
-                 if args.plan else ""))
+                 if args.plan else "")
+              + (f", {n_shard_err} shard error(s)" if args.shard else ""))
     return 1 if (n_err or (args.strict and n_warn) or over_budget
-                 or plan_infeasible) else 0
+                 or plan_infeasible or n_shard_err) else 0
 
 
 if __name__ == "__main__":
